@@ -1,0 +1,16 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework.
+
+A ground-up rebuild of the capability set of SynapseML/MMLSpark (reference:
+Scala/Spark + JNI-native compute) as a JAX/XLA/Pallas-first framework:
+columnar DataFrames feeding padded device batches, Estimator/Transformer
+pipelines, ONNX→JAX compiled inference, distributed histogram-GBDT training
+over a device mesh, explainers, featurization, serving, and HTTP transformers.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (DataFrame, Estimator, Model, Pipeline, PipelineModel,
+                   PipelineStage, Transformer, concat)
+
+__all__ = ["DataFrame", "concat", "PipelineStage", "Transformer", "Estimator",
+           "Model", "Pipeline", "PipelineModel", "__version__"]

@@ -1,0 +1,17 @@
+from .dataframe import DataFrame, concat
+from .params import (ComplexParam, Param, Params, ParamMap, HasInputCol,
+                     HasOutputCol, HasInputCols, HasOutputCols, HasLabelCol,
+                     HasFeaturesCol, HasWeightCol, HasPredictionCol,
+                     HasProbabilityCol, HasBatchSize, HasErrorCol, HasSeed)
+from .pipeline import (Estimator, Model, Pipeline, PipelineModel,
+                       PipelineStage, Transformer)
+
+__all__ = [
+    "DataFrame", "concat",
+    "Param", "ComplexParam", "Params", "ParamMap",
+    "HasInputCol", "HasOutputCol", "HasInputCols", "HasOutputCols",
+    "HasLabelCol", "HasFeaturesCol", "HasWeightCol", "HasPredictionCol",
+    "HasProbabilityCol", "HasBatchSize", "HasErrorCol", "HasSeed",
+    "PipelineStage", "Transformer", "Estimator", "Model",
+    "Pipeline", "PipelineModel",
+]

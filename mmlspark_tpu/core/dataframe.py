@@ -1,0 +1,253 @@
+"""Columnar DataFrame — the host-side data plane of the framework.
+
+The reference is built on Spark DataFrames (lazy, partitioned, JVM row
+iterators). A TPU-first framework wants the opposite at the boundary:
+**columnar, contiguous, zero-copy into ``jax.device_put``**. This DataFrame is
+a thin partitioned wrapper over numpy arrays:
+
+* dense numeric columns → ``np.ndarray`` (1-D, or n-D for tensor columns)
+* strings / ragged / struct values → object arrays
+* partitions are row-ranges, not separate allocations, so repartitioning is
+  free and device feeds stay contiguous.
+
+Interop with pandas and pyarrow is provided for IO. Transformers operate on
+whole columns (vectorized) or via ``map_partitions`` when they need the
+per-partition device pinning the reference gets from Spark ``mapPartitions``
+(e.g. ``ONNXModel.scala:499-508``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["DataFrame", "concat"]
+
+
+def _as_column(values) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    if hasattr(values, "to_numpy"):
+        return values.to_numpy()
+    values = list(values)
+    if values and isinstance(values[0], (str, bytes, dict, list, tuple, np.ndarray)):
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    return np.asarray(values)
+
+
+class DataFrame:
+    """An immutable-ish columnar table with logical partitions."""
+
+    def __init__(self, columns: Dict[str, Union[np.ndarray, Sequence]],
+                 npartitions: int = 1, metadata: Optional[Dict[str, dict]] = None):
+        self._columns: Dict[str, np.ndarray] = {}
+        self._metadata: Dict[str, dict] = dict(metadata or {})
+        n = None
+        for name, col in columns.items():
+            arr = _as_column(col)
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(arr)} rows, expected {n}")
+            self._columns[name] = arr
+        self._nrows = n if n is not None else 0
+        self._npartitions = max(1, min(int(npartitions), max(1, self._nrows)))
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_pandas(pdf, npartitions: int = 1) -> "DataFrame":
+        return DataFrame({c: pdf[c].to_numpy() for c in pdf.columns}, npartitions)
+
+    @staticmethod
+    def from_arrow(table, npartitions: int = 1) -> "DataFrame":
+        cols = {}
+        for name in table.column_names:
+            col = table.column(name)
+            try:
+                cols[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                cols[name] = _as_column(col.to_pylist())
+        return DataFrame(cols, npartitions)
+
+    @staticmethod
+    def from_rows(rows: Iterable[dict], npartitions: int = 1) -> "DataFrame":
+        rows = list(rows)
+        if not rows:
+            return DataFrame({}, npartitions)
+        keys = list(rows[0].keys())
+        return DataFrame({k: _as_column([r[k] for r in rows]) for k in keys},
+                         npartitions)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if v.dtype == object else v
+                             for k, v in self._columns.items()})
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    @property
+    def npartitions(self) -> int:
+        return self._npartitions
+
+    def __len__(self) -> int:
+        return self._nrows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._columns:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._columns[name]
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    # -- column metadata (parity: Spark column Metadata / Categoricals) -----
+    def column_metadata(self, name: str) -> dict:
+        return dict(self._metadata.get(name, {}))
+
+    def with_column_metadata(self, name: str, meta: dict) -> "DataFrame":
+        md = dict(self._metadata)
+        md[name] = {**md.get(name, {}), **meta}
+        return DataFrame(self._columns, self._npartitions, md)
+
+    def _meta_for(self, names) -> Dict[str, dict]:
+        return {k: v for k, v in self._metadata.items() if k in names}
+
+    def schema(self) -> Dict[str, str]:
+        out = {}
+        for k, v in self._columns.items():
+            if v.dtype == object and len(v):
+                out[k] = type(v[0]).__name__
+            else:
+                out[k] = str(v.dtype)
+        return out
+
+    # -- transformations (all return new DataFrames) ------------------------
+    def with_column(self, name: str, values) -> "DataFrame":
+        cols = dict(self._columns)
+        cols[name] = _as_column(values)
+        return DataFrame(cols, self._npartitions, self._metadata)
+
+    def with_columns(self, new: Dict[str, Union[np.ndarray, Sequence]]) -> "DataFrame":
+        cols = dict(self._columns)
+        for k, v in new.items():
+            cols[k] = _as_column(v)
+        return DataFrame(cols, self._npartitions, self._metadata)
+
+    def select(self, names: Sequence[str]) -> "DataFrame":
+        return DataFrame({n: self[n] for n in names}, self._npartitions,
+                         self._meta_for(names))
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [k for k in self._columns if k not in names]
+        return DataFrame({k: self._columns[k] for k in keep}, self._npartitions,
+                         self._meta_for(keep))
+
+    def rename(self, mapping: Dict[str, str]) -> "DataFrame":
+        md = {mapping.get(k, k): v for k, v in self._metadata.items()}
+        return DataFrame({mapping.get(k, k): v for k, v in self._columns.items()},
+                         self._npartitions, md)
+
+    def filter(self, mask: np.ndarray) -> "DataFrame":
+        mask = np.asarray(mask)
+        if mask.dtype != bool:
+            raise TypeError("filter expects a boolean mask")
+        return DataFrame({k: v[mask] for k, v in self._columns.items()},
+                         self._npartitions, self._metadata)
+
+    def take(self, indices) -> "DataFrame":
+        idx = np.asarray(indices)
+        return DataFrame({k: v[idx] for k, v in self._columns.items()},
+                         self._npartitions, self._metadata)
+
+    def head(self, n: int) -> "DataFrame":
+        return DataFrame({k: v[:n] for k, v in self._columns.items()}, 1, self._metadata)
+
+    def repartition(self, npartitions: int) -> "DataFrame":
+        return DataFrame(self._columns, npartitions, self._metadata)
+
+    def sort_values(self, by: str, ascending: bool = True) -> "DataFrame":
+        order = np.argsort(self[by], kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self.take(order)
+
+    def sample(self, frac: float, seed: int = 0, replace: bool = False) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        k = int(round(frac * self._nrows))
+        idx = rng.choice(self._nrows, size=k, replace=replace)
+        return self.take(idx)
+
+    def shuffle(self, seed: int = 0) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        return self.take(rng.permutation(self._nrows))
+
+    def cache(self) -> "DataFrame":
+        return self  # materialized already; parity no-op (stages/Cacher)
+
+    # -- partition machinery ------------------------------------------------
+    def partition_bounds(self) -> List[tuple]:
+        n, p = self._nrows, self._npartitions
+        base, rem = divmod(n, p)
+        bounds, start = [], 0
+        for i in range(p):
+            size = base + (1 if i < rem else 0)
+            bounds.append((start, start + size))
+            start += size
+        return bounds
+
+    def partitions(self) -> Iterator["DataFrame"]:
+        for lo, hi in self.partition_bounds():
+            yield DataFrame({k: v[lo:hi] for k, v in self._columns.items()}, 1,
+                            self._metadata)
+
+    def map_partitions(self, fn: Callable[["DataFrame", int], "DataFrame"]) -> "DataFrame":
+        """Apply ``fn(part_df, part_index)`` to each partition and concat.
+
+        The moral equivalent of Spark ``mapPartitions`` — the unit at which
+        device pinning and batching happen.
+        """
+        parts = [fn(p, i) for i, p in enumerate(self.partitions())]
+        return concat(parts, npartitions=self._npartitions)
+
+    # -- row view (for HTTP/serving paths that are row-oriented) ------------
+    def iter_rows(self) -> Iterator[dict]:
+        names = self.columns
+        cols = [self._columns[n] for n in names]
+        for i in range(self._nrows):
+            yield {n: c[i] for n, c in zip(names, cols)}
+
+    def to_rows(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def __repr__(self):
+        return (f"DataFrame({self._nrows} rows x {len(self._columns)} cols, "
+                f"{self._npartitions} partitions: {self.schema()})")
+
+
+def concat(dfs: Sequence[DataFrame], npartitions: Optional[int] = None) -> DataFrame:
+    dfs = [d for d in dfs if len(d.columns) > 0 or len(d) > 0]
+    if not dfs:
+        return DataFrame({})
+    names = dfs[0].columns
+    for d in dfs[1:]:
+        if d.columns != names:
+            raise ValueError(f"column mismatch in concat: {names} vs {d.columns}")
+    cols = {}
+    for n in names:
+        # np.concatenate promotes mixed parts to object dtype on its own
+        cols[n] = np.concatenate([d[n] for d in dfs])
+    md = {}
+    for d in dfs:
+        md.update(d._metadata)
+    return DataFrame(cols, npartitions or dfs[0].npartitions, md)

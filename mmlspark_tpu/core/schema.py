@@ -1,0 +1,110 @@
+"""Schema & metadata utilities.
+
+Parity surface: ``core/schema`` in the reference — ``Categoricals`` (314 LoC),
+``SparkSchema`` label/score metadata (225 LoC),
+``DatasetExtensions.findUnusedColumnName``, and the ``SparkBindings`` struct
+codecs (``core/schema/SparkBindings.scala:13-47``). Here column metadata is a
+plain dict carried by the DataFrame; these helpers standardize the keys.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .dataframe import DataFrame
+
+__all__ = [
+    "find_unused_column_name",
+    "set_categorical_metadata",
+    "get_categorical_levels",
+    "is_categorical",
+    "set_label_metadata",
+    "get_label_metadata",
+    "assemble_vector",
+    "struct_column",
+    "unpack_struct_column",
+]
+
+CATEGORICAL_KEY = "ml_categorical"
+LABEL_KEY = "ml_label"
+SCORE_KEY = "ml_score"
+
+
+def find_unused_column_name(base: str, df: DataFrame) -> str:
+    """Reference: ``DatasetExtensions.findUnusedColumnName``."""
+    name = base
+    i = 0
+    while name in df:
+        i += 1
+        name = f"{base}_{i}"
+    return name
+
+
+# -- categorical metadata ----------------------------------------------------
+
+def set_categorical_metadata(df: DataFrame, col: str, levels: Sequence) -> DataFrame:
+    return df.with_column_metadata(col, {CATEGORICAL_KEY: {
+        "levels": [l.item() if isinstance(l, np.generic) else l for l in levels]}})
+
+
+def get_categorical_levels(df: DataFrame, col: str) -> Optional[List]:
+    meta = df.column_metadata(col).get(CATEGORICAL_KEY)
+    return None if meta is None else list(meta["levels"])
+
+
+def is_categorical(df: DataFrame, col: str) -> bool:
+    return CATEGORICAL_KEY in df.column_metadata(col)
+
+
+# -- label/score metadata (reference: SparkSchema.scala) ---------------------
+
+def set_label_metadata(df: DataFrame, col: str, num_classes: Optional[int] = None,
+                       classes: Optional[Sequence] = None) -> DataFrame:
+    meta: Dict = {}
+    if num_classes is not None:
+        meta["num_classes"] = int(num_classes)
+    if classes is not None:
+        meta["classes"] = [c.item() if isinstance(c, np.generic) else c for c in classes]
+    return df.with_column_metadata(col, {LABEL_KEY: meta})
+
+
+def get_label_metadata(df: DataFrame, col: str) -> dict:
+    return df.column_metadata(col).get(LABEL_KEY, {})
+
+
+# -- vector assembly (reference: FastVectorAssembler) ------------------------
+
+def assemble_vector(df: DataFrame, input_cols: Sequence[str]) -> np.ndarray:
+    """Stack numeric/vector columns into a dense 2-D float array (n, d)."""
+    parts = []
+    for c in input_cols:
+        col = df[c]
+        if col.dtype == object:
+            col = np.stack([np.asarray(v, dtype=np.float64).ravel() for v in col])
+        col = np.asarray(col, dtype=np.float64)
+        if col.ndim == 1:
+            col = col[:, None]
+        elif col.ndim > 2:
+            col = col.reshape(len(col), -1)
+        parts.append(col)
+    if not parts:
+        return np.zeros((len(df), 0))
+    return np.concatenate(parts, axis=1)
+
+
+# -- struct columns (reference: SparkBindings row codecs) --------------------
+
+def struct_column(dicts: Sequence[dict]) -> np.ndarray:
+    arr = np.empty(len(dicts), dtype=object)
+    for i, d in enumerate(dicts):
+        arr[i] = d
+    return arr
+
+
+def unpack_struct_column(col: np.ndarray, field: str) -> np.ndarray:
+    out = np.empty(len(col), dtype=object)
+    for i, v in enumerate(col):
+        out[i] = None if v is None else v.get(field)
+    return out

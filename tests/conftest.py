@@ -1,0 +1,27 @@
+"""Test harness: simulate an 8-device TPU topology on CPU.
+
+Mirrors the reference's strategy of testing distributed behavior in-process on
+a local-mode SparkSession (``core/src/test/.../base/SparkSessionFactory.scala``);
+here an 8-device virtual CPU mesh stands in for a TPU slice
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_save(tmp_path):
+    return str(tmp_path / "stage_save")

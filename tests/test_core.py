@@ -1,0 +1,175 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import (ComplexParam, DataFrame, Estimator, Model,
+                               Param, Pipeline, PipelineModel, PipelineStage,
+                               Transformer, concat)
+from mmlspark_tpu.core import schema as S
+
+
+class AddConst(Transformer):
+    input_col = Param(str, default="x", doc="in")
+    output_col = Param(str, default="y", doc="out")
+    amount = Param(float, default=1.0, doc="value to add")
+
+    def _transform(self, df):
+        return df.with_column(self.output_col, df[self.input_col] + self.amount)
+
+
+class MeanCenter(Estimator):
+    input_col = Param(str, default="x", doc="in")
+
+    def _fit(self, df):
+        return MeanCenterModel(mean=float(np.mean(df[self.input_col])),
+                               input_col=self.input_col)
+
+
+class MeanCenterModel(Model):
+    input_col = Param(str, default="x", doc="in")
+    mean = Param(float, default=0.0, doc="fitted mean")
+
+    def _transform(self, df):
+        return df.with_column(self.input_col, df[self.input_col] - self.mean)
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        t = AddConst()
+        assert t.amount == 1.0
+        t.set(amount=2)
+        assert t.amount == 2.0
+        t.amount = 3.5
+        assert t.get("amount") == 3.5
+
+    def test_constructor_kwargs(self):
+        t = AddConst(amount=5, input_col="a")
+        assert t.amount == 5.0 and t.input_col == "a"
+
+    def test_type_errors(self):
+        with pytest.raises(TypeError):
+            AddConst(amount="nope")
+        with pytest.raises(KeyError):
+            AddConst(bogus=1)
+
+    def test_copy_isolated(self):
+        t = AddConst(amount=1)
+        t2 = t.copy({"amount": 9})
+        assert t.amount == 1.0 and t2.amount == 9.0
+
+    def test_explain(self):
+        assert "value to add" in AddConst().explain_params()
+
+    def test_uids_unique(self):
+        assert AddConst().uid != AddConst().uid
+
+
+class TestDataFrame:
+    def test_basic(self):
+        df = DataFrame({"x": [1.0, 2.0, 3.0], "s": ["a", "b", "c"]}, npartitions=2)
+        assert len(df) == 3
+        assert df.columns == ["x", "s"]
+        assert df["s"].dtype == object
+        assert df.schema()["x"] == "float64"
+
+    def test_partitions(self):
+        df = DataFrame({"x": np.arange(10)}, npartitions=3)
+        parts = list(df.partitions())
+        assert [len(p) for p in parts] == [4, 3, 3]
+        assert np.array_equal(concat(parts)["x"], np.arange(10))
+
+    def test_map_partitions(self):
+        df = DataFrame({"x": np.arange(10, dtype=np.float64)}, npartitions=4)
+        out = df.map_partitions(lambda p, i: p.with_column("pid", np.full(len(p), i)))
+        assert len(out) == 10
+        assert sorted(set(out["pid"])) == [0, 1, 2, 3]
+
+    def test_ops(self):
+        df = DataFrame({"x": [1, 2, 3], "y": [4, 5, 6]})
+        assert df.select(["y"]).columns == ["y"]
+        assert df.drop("x").columns == ["y"]
+        assert df.rename({"x": "z"}).columns == ["z", "y"]
+        assert list(df.filter(np.array([True, False, True]))["x"]) == [1, 3]
+        assert list(df.sort_values("x", ascending=False)["x"]) == [3, 2, 1]
+
+    def test_pandas_roundtrip(self):
+        import pandas as pd
+        pdf = pd.DataFrame({"a": [1.5, 2.5], "b": ["x", "y"]})
+        df = DataFrame.from_pandas(pdf, npartitions=2)
+        back = df.to_pandas()
+        assert list(back["a"]) == [1.5, 2.5]
+        assert list(back["b"]) == ["x", "y"]
+
+    def test_metadata_preserved(self):
+        df = DataFrame({"x": [1, 2], "y": [3, 4]})
+        df = S.set_categorical_metadata(df, "x", ["lo", "hi"])
+        assert S.get_categorical_levels(df.select(["x"]), "x") == ["lo", "hi"]
+        assert S.get_categorical_levels(df.with_column("z", [0, 0]), "x") == ["lo", "hi"]
+        assert S.get_categorical_levels(df.rename({"x": "w"}), "w") == ["lo", "hi"]
+        assert not S.is_categorical(df, "y")
+
+    def test_unused_column_name(self):
+        df = DataFrame({"x": [1], "x_1": [2]})
+        assert S.find_unused_column_name("x", df) == "x_2"
+
+    def test_assemble_vector(self):
+        df = DataFrame({"a": [1.0, 2.0],
+                        "v": [np.array([3.0, 4.0]), np.array([5.0, 6.0])]})
+        X = S.assemble_vector(df, ["a", "v"])
+        assert X.shape == (2, 3)
+        assert list(X[1]) == [2.0, 5.0, 6.0]
+
+
+class TestPipeline:
+    def test_fit_transform(self):
+        df = DataFrame({"x": [1.0, 2.0, 3.0]})
+        pipe = Pipeline([MeanCenter(), AddConst(amount=10)])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        assert np.allclose(out["y"], [9.0, 10.0, 11.0])
+
+    def test_transform_params_override(self):
+        df = DataFrame({"x": [0.0]})
+        out = AddConst().transform(df, {"amount": 7.0})
+        assert out["y"][0] == 7.0
+
+
+class TestSerialization:
+    def test_transformer_roundtrip(self, tmp_save):
+        t = AddConst(amount=3.25, output_col="zz")
+        t.save(tmp_save)
+        t2 = PipelineStage.load(tmp_save)
+        assert isinstance(t2, AddConst)
+        assert t2.amount == 3.25 and t2.output_col == "zz"
+        assert t2.uid == t.uid
+
+    def test_pipeline_model_roundtrip(self, tmp_save):
+        df = DataFrame({"x": [1.0, 2.0, 3.0]})
+        model = Pipeline([MeanCenter(), AddConst(amount=10)]).fit(df)
+        model.save(tmp_save)
+        model2 = PipelineModel.load(tmp_save)
+        out1, out2 = model.transform(df), model2.transform(df)
+        assert np.allclose(out1["y"], out2["y"])
+
+    def test_complex_values(self, tmp_save):
+        from mmlspark_tpu.core import serialize
+
+        class Holder(Transformer):
+            payload = ComplexParam(doc="arbitrary blob")
+
+            def _transform(self, df):
+                return df
+
+        h = Holder()
+        h.set(payload={"w": np.arange(6).reshape(2, 3).astype(np.float32),
+                       "b": [np.ones(3), 2.0]})
+        h.save(tmp_save)
+        # class lives in a test function namespace → patch resolution
+        loaded_meta_cls = serialize._resolve_class
+        try:
+            serialize._resolve_class = lambda p: Holder
+            h2 = PipelineStage.load(tmp_save)
+        finally:
+            serialize._resolve_class = loaded_meta_cls
+        p = h2.get("payload")
+        assert np.array_equal(p["w"], h.get("payload")["w"])
+        assert p["b"][1] == 2.0
