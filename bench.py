@@ -1,0 +1,64 @@
+"""Headline benchmark: ResNet-50 ONNX inference through DataFrame.transform.
+
+Mirrors BASELINE.json config #1 — the reference runs a ResNet-class ONNX
+model through ``ONNXModel.transform`` on onnxruntime (CUDA EP on GPU, CPU EP
+in the quickstart). Here the same user-visible pipeline (DataFrame →
+minibatch → ONNX graph → output column) executes as an XLA-compiled program
+on the local TPU chip. Prints ONE JSON line with images/sec/chip;
+``vs_baseline`` is against the 3000 img/s/chip north-star target.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+TARGET_IMG_PER_SEC = 3000.0
+
+
+def main():
+    import jax
+
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.onnx_model import ONNXModel
+    from mmlspark_tpu.models.zoo.resnet import RESNET50, export_resnet_onnx
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "2048"))
+    rng = np.random.default_rng(0)
+
+    model_bytes = export_resnet_onnx(RESNET50, seed=0)
+    m = ONNXModel(model_bytes,
+                  feed_dict={"input": "image"},
+                  fetch_dict={"logits": "logits"},
+                  mini_batch_size=batch,
+                  compute_dtype="bfloat16")
+
+    X = rng.normal(0, 1, (n_rows, 3, 224, 224)).astype(np.float32)
+    col = np.empty(n_rows, dtype=object)
+    for i in range(n_rows):
+        col[i] = X[i]
+    df = DataFrame({"image": col})
+
+    # warmup: compile + first transfer
+    warm = df.head(batch)
+    m.transform(warm)
+    jax.block_until_ready(jax.device_put(0))
+
+    t0 = time.perf_counter()
+    out = m.transform(df)
+    elapsed = time.perf_counter() - t0
+    assert len(out) == n_rows
+    ips = n_rows / elapsed
+
+    print(json.dumps({
+        "metric": "resnet50_onnx_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / TARGET_IMG_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

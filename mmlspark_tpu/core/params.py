@@ -143,7 +143,9 @@ class Param:
             self._convert = _to_list_of(_CONVERTERS.get(dtype[1], identity))
         else:
             self._convert = identity
-        self.default = default if default is Param._NO_DEFAULT else self._convert(default)
+        self.default = default if (default is Param._NO_DEFAULT
+                                   or default is None) \
+            else self._convert(default)
         self.name: str = "<unbound>"
         self.owner: Optional[type] = None
 
@@ -156,6 +158,12 @@ class Param:
         return self.default is not Param._NO_DEFAULT
 
     def convert(self, value):
+        if value is None:
+            # None is only a legal value for optional params (default None);
+            # for typed params with a real default it would bypass validation
+            if self.default is None:
+                return None
+            raise TypeError(f"param {self.name} does not accept None")
         v = self._convert(value)
         if self.choices is not None and v not in self.choices:
             raise ValueError(f"param {self.name}: {v!r} not in {self.choices}")

@@ -1,0 +1,10 @@
+from .binning import BinMapper
+from .booster import Booster
+from .estimators import (LightGBMClassificationModel, LightGBMClassifier,
+                         LightGBMRanker, LightGBMRankerModel,
+                         LightGBMRegressionModel, LightGBMRegressor)
+from .train import train
+
+__all__ = ["BinMapper", "Booster", "train", "LightGBMClassifier",
+           "LightGBMClassificationModel", "LightGBMRegressor",
+           "LightGBMRegressionModel", "LightGBMRanker", "LightGBMRankerModel"]

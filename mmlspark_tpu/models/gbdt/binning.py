@@ -1,0 +1,105 @@
+"""Feature binning — dataset construction for histogram GBDT.
+
+Plays the role of LightGBM's native dataset build
+(``LGBM_DatasetCreateFromMat/CSR`` reached through
+``lightgbm/.../dataset/DatasetAggregator.scala:331-356,441-465``): continuous
+features are quantile-discretized into at most ``max_bin`` integer bins so
+tree training operates on a dense uint8/uint16 matrix — the layout the TPU
+histogram kernel wants (small integer gather/scatter indices, contiguous
+rows).
+
+Bin 0 is reserved for missing values (NaN), matching LightGBM's
+missing-handling semantics. Bin upper bounds are stored so fitted models
+split on *raw* thresholds and prediction never needs the bin mapper.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["BinMapper", "MAX_BIN_DEFAULT"]
+
+MAX_BIN_DEFAULT = 255
+
+
+class BinMapper:
+    """Per-feature quantile binning. Fit on (a sample of) the data."""
+
+    def __init__(self, max_bin: int = MAX_BIN_DEFAULT,
+                 sample_cnt: int = 200_000, seed: int = 0):
+        if not 2 <= max_bin <= 65535:
+            raise ValueError(f"max_bin must be in [2, 65535], got {max_bin}")
+        self.max_bin = int(max_bin)
+        self.sample_cnt = sample_cnt
+        self.seed = seed
+        self.upper_bounds: List[np.ndarray] = []  # per feature, ascending
+        self.n_features: Optional[int] = None
+
+    def fit(self, X: np.ndarray) -> "BinMapper":
+        X = np.asarray(X, dtype=np.float64)
+        n, f = X.shape
+        self.n_features = f
+        if n > self.sample_cnt:
+            rng = np.random.default_rng(self.seed)
+            X = X[rng.choice(n, self.sample_cnt, replace=False)]
+        self.upper_bounds = []
+        for j in range(f):
+            col = X[:, j]
+            col = col[~np.isnan(col)]
+            if col.size == 0:
+                self.upper_bounds.append(np.array([np.inf]))
+                continue
+            uniq = np.unique(col)
+            if len(uniq) <= self.max_bin - 1:
+                # exact: one bin per distinct value; bound = midpoint
+                mids = (uniq[:-1] + uniq[1:]) / 2
+                bounds = np.append(mids, np.inf)
+            else:
+                qs = np.quantile(col, np.linspace(0, 1, self.max_bin),
+                                 method="linear")
+                bounds = np.unique(qs[1:-1])
+                bounds = np.append(bounds, np.inf)
+            self.upper_bounds.append(bounds.astype(np.float64))
+        return self
+
+    @property
+    def n_bins(self) -> int:
+        """Max bins over features incl. the missing bin (index 0)."""
+        return 1 + max((len(b) for b in self.upper_bounds), default=1)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        n, f = X.shape
+        if f != self.n_features:
+            raise ValueError(f"expected {self.n_features} features, got {f}")
+        dtype = np.uint8 if self.n_bins <= 256 else np.uint16
+        out = np.zeros((n, f), dtype=dtype)
+        for j in range(f):
+            col = X[:, j]
+            # bins 1..len(bounds); searchsorted gives 0-based interval index
+            binned = np.searchsorted(self.upper_bounds[j], col, side="left") + 1
+            binned = np.where(np.isnan(col), 0, binned)
+            out[:, j] = binned.astype(dtype)
+        return out
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        return self.fit(X).transform(X)
+
+    def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
+        """Raw-value threshold for "go left if x <= threshold" at this bin."""
+        bounds = self.upper_bounds[feature]
+        i = min(max(int(bin_idx) - 1, 0), len(bounds) - 1)
+        return float(bounds[i])
+
+    def to_dict(self) -> dict:
+        return {"max_bin": self.max_bin,
+                "upper_bounds": [b.tolist() for b in self.upper_bounds]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        bm = BinMapper(max_bin=d["max_bin"])
+        bm.upper_bounds = [np.asarray(b) for b in d["upper_bounds"]]
+        bm.n_features = len(bm.upper_bounds)
+        return bm

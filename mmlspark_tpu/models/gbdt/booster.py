@@ -1,0 +1,186 @@
+"""Booster — fitted GBDT model container.
+
+Parity surface: ``LightGBMBooster``
+(``lightgbm/.../booster/LightGBMBooster.scala``): score/raw score
+(``score:390-401``), leaf prediction (``predictLeaf:403-412``), TreeSHAP
+feature contributions (``featuresShap:414-423``), save/load model string
+(``saveToString:269-274``), booster merging for batch warm-start
+(``mergeBooster:252-256``), feature importances (``:491-498``).
+
+Trees live as stacked fixed-shape arrays (T, …) so prediction is one
+``lax.scan`` over trees of vectorized gathers — no per-node pointer chasing.
+TreeSHAP is the polynomial-time path-dependent algorithm, vectorized over
+samples (the recursion visits tree nodes; per-sample state is only the
+one_fraction vector), using training-set covers stored at fit time.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .binning import BinMapper
+from .trees import predict_leaf_indices, predict_trees
+
+__all__ = ["Booster"]
+
+
+class Booster:
+    def __init__(self, depth: int, n_features: int, objective: str,
+                 base_score: float = 0.0, num_class: int = 1,
+                 feats: Optional[np.ndarray] = None,
+                 thr_raw: Optional[np.ndarray] = None,
+                 leaf_values: Optional[np.ndarray] = None,
+                 gains: Optional[np.ndarray] = None,
+                 covers: Optional[np.ndarray] = None,
+                 best_iteration: int = -1):
+        self.depth = depth
+        self.n_features = n_features
+        self.objective = objective
+        self.base_score = base_score
+        self.num_class = num_class
+        n_int = 2 ** depth - 1
+        n_leaf = 2 ** depth
+        n_all = 2 ** (depth + 1) - 1
+        shape_leaf = (0, num_class, n_leaf) if num_class > 1 else (0, n_leaf)
+        # tree arrays accumulate in a pending list (appending per boosting
+        # iteration must be O(1), not a full re-concatenation) and are stacked
+        # lazily behind cached properties
+        self._base = {
+            "feats": feats if feats is not None else np.zeros((0, n_int), np.int32),
+            "thr_raw": thr_raw if thr_raw is not None else np.zeros((0, n_int), np.float32),
+            "leaf_values": leaf_values if leaf_values is not None else
+                np.zeros(shape_leaf, np.float32),
+            "gains": gains if gains is not None else np.zeros((0, n_int), np.float32),
+            "covers": covers if covers is not None else np.zeros((0, n_all), np.float32),
+        }
+        self._pending: List[tuple] = []
+        self.best_iteration = best_iteration
+
+    # -- bookkeeping --------------------------------------------------------
+    _FIELDS = ("feats", "thr_raw", "leaf_values", "gains", "covers")
+
+    def _materialize(self) -> None:
+        if self._pending:
+            for i, name in enumerate(self._FIELDS):
+                self._base[name] = np.concatenate(
+                    [self._base[name]] + [np.asarray(p[i])[None]
+                                          for p in self._pending])
+            self._pending = []
+
+    def __getattr__(self, name):
+        if name in Booster._FIELDS:
+            self._materialize()
+            return self._base[name]
+        raise AttributeError(name)
+
+    @property
+    def num_trees(self) -> int:
+        return len(self._base["feats"]) + len(self._pending)
+
+    def append_tree(self, feat, thr_raw, leaf_value, gain, cover):
+        self._pending.append((feat, thr_raw, leaf_value, gain, cover))
+
+    def truncated(self, n_trees: int) -> "Booster":
+        """Model truncated to the first n_trees (early-stopping cutoff)."""
+        return Booster(self.depth, self.n_features, self.objective,
+                       self.base_score, self.num_class,
+                       self.feats[:n_trees], self.thr_raw[:n_trees],
+                       self.leaf_values[:n_trees], self.gains[:n_trees],
+                       self.covers[:n_trees], best_iteration=n_trees)
+
+    def merge(self, other: "Booster") -> "Booster":
+        """Concatenate trees (parity: mergeBooster for numBatches training)."""
+        assert self.depth == other.depth and self.num_class == other.num_class
+        return Booster(
+            self.depth, self.n_features, self.objective, self.base_score,
+            self.num_class,
+            np.concatenate([self.feats, other.feats]),
+            np.concatenate([self.thr_raw, other.thr_raw]),
+            np.concatenate([self.leaf_values, other.leaf_values]),
+            np.concatenate([self.gains, other.gains]),
+            np.concatenate([self.covers, other.covers]))
+
+    # -- prediction ---------------------------------------------------------
+    def raw_score(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float32)
+        if self.num_trees == 0:
+            shape = (len(X), self.num_class) if self.num_class > 1 else (len(X),)
+            return np.full(shape, self.base_score, dtype=np.float32)
+        out = predict_trees(self.feats, self.thr_raw, self.leaf_values,
+                            X, depth=self.depth)
+        return np.asarray(out) + self.base_score
+
+    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        raw = self.raw_score(X)
+        if raw_score:
+            return raw
+        from .objectives import get_objective
+        obj = get_objective(self.objective, num_class=max(self.num_class, 2))
+        return np.asarray(obj.transform(raw))
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float32)
+        return np.asarray(predict_leaf_indices(self.feats, self.thr_raw, X,
+                                               depth=self.depth))
+
+    # -- TreeSHAP -----------------------------------------------------------
+    def shap_values(self, X: np.ndarray) -> np.ndarray:
+        """Path-dependent TreeSHAP. Returns (n, F+1): per-feature
+        contributions plus the expected value in the last column (the layout
+        LightGBM's predict_contrib emits)."""
+        from .treeshap import tree_shap
+        X = np.asarray(X, dtype=np.float32)
+        n = len(X)
+        K = self.num_class if self.num_class > 1 else 1
+        phi = np.zeros((K, n, self.n_features + 1), dtype=np.float64)
+        for t in range(self.num_trees):
+            lv = self.leaf_values[t]
+            for k in range(K):
+                tree_shap(self.feats[t], self.thr_raw[t],
+                          lv[k] if self.num_class > 1 else lv,
+                          self.covers[t], self.depth, X, phi[k])
+        phi[:, :, -1] += self.base_score
+        out = phi if self.num_class > 1 else phi[0]
+        return out.astype(np.float32)
+
+    # -- importances --------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        imp = np.zeros(self.n_features)
+        valid = self.feats >= 0
+        if importance_type == "split":
+            np.add.at(imp, self.feats[valid], 1)
+        elif importance_type == "gain":
+            np.add.at(imp, self.feats[valid], self.gains[valid])
+        else:
+            raise ValueError(f"importance_type {importance_type!r}")
+        return imp
+
+    # -- persistence (parity: saveToString / loadFromString) ----------------
+    def to_string(self) -> str:
+        buf = io.BytesIO()
+        np.savez_compressed(buf, feats=self.feats, thr_raw=self.thr_raw,
+                            leaf_values=self.leaf_values, gains=self.gains,
+                            covers=self.covers)
+        meta = {"depth": self.depth, "n_features": self.n_features,
+                "objective": self.objective, "base_score": self.base_score,
+                "num_class": self.num_class,
+                "best_iteration": self.best_iteration,
+                "arrays": base64.b64encode(buf.getvalue()).decode("ascii")}
+        return json.dumps(meta)
+
+    @staticmethod
+    def from_string(s: str) -> "Booster":
+        meta = json.loads(s)
+        buf = io.BytesIO(base64.b64decode(meta["arrays"]))
+        with np.load(buf) as z:
+            arrays = {k: z[k] for k in z.files}
+        return Booster(meta["depth"], meta["n_features"], meta["objective"],
+                       meta["base_score"], meta["num_class"],
+                       arrays["feats"], arrays["thr_raw"],
+                       arrays["leaf_values"], arrays["gains"],
+                       arrays["covers"], meta["best_iteration"])

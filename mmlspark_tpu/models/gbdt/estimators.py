@@ -1,0 +1,261 @@
+"""LightGBM-parity estimators over the DataFrame pipeline API.
+
+Parity surface: ``LightGBMClassifier`` (``lightgbm/.../LightGBMClassifier.scala:26-100``),
+``LightGBMRegressor`` (tweedie/quantile objectives), ``LightGBMRanker``
+(lambdarank with group column), their fitted models with
+predict/leaf/SHAP output columns (``LightGBMModelMethods``), warm start via
+model string (``LightGBMBase.scala:49-61``), and the main training params
+(``params/LightGBMParams.scala``). ``tree_learner`` values map to the mesh:
+``serial`` = single chip, ``data_parallel``/``voting_parallel`` = histogram
+psum over the default mesh's ``data`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.dataframe import DataFrame
+from ...core.params import (ComplexParam, Param, HasFeaturesCol, HasLabelCol,
+                            HasPredictionCol, HasProbabilityCol, HasWeightCol)
+from ...core.pipeline import Estimator, Model
+from ...core.schema import assemble_vector, get_label_metadata, set_label_metadata
+from ...parallel.mesh import get_default_mesh
+from .booster import Booster
+from .train import resolve_params, train
+
+__all__ = ["LightGBMClassifier", "LightGBMRegressor", "LightGBMRanker",
+           "LightGBMClassificationModel", "LightGBMRegressionModel",
+           "LightGBMRankerModel"]
+
+
+class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
+    num_iterations = Param(int, default=100, doc="boosting rounds")
+    learning_rate = Param(float, default=0.1, doc="shrinkage rate")
+    num_leaves = Param(int, default=31, doc="max leaves per tree")
+    max_depth = Param(int, default=-1, doc="max tree depth (-1: from num_leaves)")
+    lambda_l1 = Param(float, default=0.0, doc="L1 regularization")
+    lambda_l2 = Param(float, default=0.0, doc="L2 regularization")
+    min_data_in_leaf = Param(int, default=20, doc="min rows per leaf")
+    min_sum_hessian_in_leaf = Param(float, default=1e-3, doc="min hessian per leaf")
+    min_gain_to_split = Param(float, default=0.0, doc="min split gain")
+    feature_fraction = Param(float, default=1.0, doc="feature subsample per tree")
+    bagging_fraction = Param(float, default=1.0, doc="row subsample")
+    bagging_freq = Param(int, default=0, doc="bagging every k iterations")
+    max_bin = Param(int, default=255, doc="max histogram bins")
+    early_stopping_round = Param(int, default=0, doc="early stopping patience")
+    parallelism = Param(str, default="serial",
+                        choices=["serial", "data_parallel", "voting_parallel"],
+                        doc="tree learner (reference LightGBMParams.parallelism)")
+    metric = Param(str, default="auto", doc="eval metric name")
+    seed = Param(int, default=0, doc="random seed")
+    validation_indicator_col = Param(str, default=None,
+                                     doc="bool column marking validation rows")
+    model_string = Param(str, default=None,
+                         doc="serialized booster for warm start")
+    leaf_prediction_col = Param(str, default=None, doc="emit leaf indices here")
+    features_shap_col = Param(str, default=None, doc="emit SHAP contributions here")
+
+    def _train_params(self, extra: dict) -> dict:
+        keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
+                "lambda_l1", "lambda_l2", "min_data_in_leaf",
+                "min_sum_hessian_in_leaf", "min_gain_to_split",
+                "feature_fraction", "bagging_fraction", "bagging_freq",
+                "max_bin", "early_stopping_round", "metric", "seed"]
+        p = {k: self.get(k) for k in keys}
+        p["tree_learner"] = self.parallelism
+        p.update(extra)
+        return p
+
+    def _split_valid(self, df: DataFrame):
+        vcol = self.get_or_none("validation_indicator_col")
+        if vcol and vcol in df:
+            mask = np.asarray(df[vcol], dtype=bool)
+            return df.filter(~mask), df.filter(mask)
+        return df, None
+
+    def _fit_core(self, df: DataFrame, extra_params: dict,
+                  group_col: Optional[str] = None) -> Booster:
+        train_df, valid_df = self._split_valid(df)
+        X = assemble_vector(train_df, [self.features_col])
+        y = np.asarray(train_df[self.label_col], dtype=np.float64)
+        w = (np.asarray(train_df[self.weight_col], dtype=np.float64)
+             if self.get_or_none("weight_col") and self.weight_col in train_df
+             else None)
+        valid_sets = None
+        if valid_df is not None and len(valid_df):
+            valid_sets = [(assemble_vector(valid_df, [self.features_col]),
+                           np.asarray(valid_df[self.label_col], dtype=np.float64))]
+        group = None
+        if group_col is not None:
+            gcol = np.asarray(train_df[group_col])
+            # lambdarank consumes contiguous runs; a group id reappearing
+            # after another would silently mix queries — reject it
+            boundaries = np.flatnonzero(gcol[1:] != gcol[:-1]) + 1
+            starts = np.concatenate([[0], boundaries, [len(gcol)]])
+            run_ids = gcol[starts[:-1]]
+            if len(np.unique(run_ids)) != len(run_ids):
+                raise ValueError(
+                    f"group column {group_col!r} is not contiguous: the same "
+                    "group id appears in separate runs; sort the DataFrame by "
+                    "group first")
+            group = np.diff(starts)
+        init_model = None
+        ms = self.get_or_none("model_string")
+        if ms:
+            init_model = Booster.from_string(ms)
+        mesh = get_default_mesh() if self.parallelism != "serial" else None
+        return train(self._train_params(extra_params), X, y, sample_weight=w,
+                     group=group, valid_sets=valid_sets, init_model=init_model,
+                     mesh=mesh)
+
+
+class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
+    booster_string = ComplexParam(doc="fitted booster payload")
+    leaf_prediction_col = Param(str, default=None, doc="emit leaf indices here")
+    features_shap_col = Param(str, default=None, doc="emit SHAP contributions here")
+
+    def __init__(self, booster: Optional[Booster] = None, **kw):
+        super().__init__(**kw)
+        self._booster = booster
+        if booster is not None:
+            self.set(booster_string=booster.to_string().encode())
+
+    @property
+    def booster(self) -> Booster:
+        if getattr(self, "_booster", None) is None:
+            self._booster = Booster.from_string(
+                self.get("booster_string").decode())
+        return self._booster
+
+    def _load_extra(self, path):
+        self._booster = None
+
+    def _features(self, df: DataFrame) -> np.ndarray:
+        return assemble_vector(df, [self.features_col]).astype(np.float32)
+
+    def _add_aux_cols(self, df: DataFrame, X: np.ndarray) -> DataFrame:
+        lcol = self.get_or_none("leaf_prediction_col")
+        if lcol:
+            leaves = self.booster.predict_leaf(X)
+            vals = np.empty(len(leaves), dtype=object)
+            for i, row in enumerate(leaves):
+                vals[i] = row.astype(np.float64)
+            df = df.with_column(lcol, vals)
+        scol = self.get_or_none("features_shap_col")
+        if scol:
+            shap = self.booster.shap_values(X)
+            if shap.ndim == 3:
+                shap = np.concatenate(list(shap), axis=-1)
+            vals = np.empty(len(shap), dtype=object)
+            for i, row in enumerate(shap):
+                vals[i] = row
+            df = df.with_column(scol, vals)
+        return df
+
+    def feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        return self.booster.feature_importance(importance_type)
+
+
+class LightGBMClassifier(Estimator, _LightGBMParams, HasPredictionCol,
+                         HasProbabilityCol):
+    objective = Param(str, default="binary", doc="binary or multiclass")
+    prediction_col = Param(str, default="prediction", doc="predicted label")
+    probability_col = Param(str, default="probability", doc="class probabilities")
+    raw_prediction_col = Param(str, default="rawPrediction", doc="raw scores")
+
+    def _fit(self, df: DataFrame) -> "LightGBMClassificationModel":
+        y = np.asarray(df[self.label_col])
+        classes = np.unique(y[~np.isnan(y.astype(np.float64))])
+        n_classes = len(classes)
+        objective = self.objective
+        if n_classes > 2 and objective == "binary":
+            objective = "multiclass"
+        extra = {"objective": objective}
+        if objective in ("multiclass", "softmax"):
+            extra["num_class"] = n_classes
+        booster = self._fit_core(df, extra)
+        model = LightGBMClassificationModel(
+            booster,
+            features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            probability_col=self.probability_col,
+            raw_prediction_col=self.get("raw_prediction_col"),
+            leaf_prediction_col=self.get_or_none("leaf_prediction_col"),
+            features_shap_col=self.get_or_none("features_shap_col"),
+            num_classes=n_classes)
+        return model
+
+
+class LightGBMClassificationModel(_LightGBMModelBase, HasProbabilityCol):
+    raw_prediction_col = Param(str, default="rawPrediction", doc="raw scores")
+    num_classes = Param(int, default=2, doc="number of classes")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features(df)
+        raw = self.booster.predict(X, raw_score=True)
+        prob = self.booster.predict(X)
+        if prob.ndim == 1:
+            prob2 = np.stack([1 - prob, prob], axis=1)
+            raw2 = np.stack([-raw, raw], axis=1)
+        else:
+            prob2, raw2 = prob, raw
+        pred = prob2.argmax(axis=1).astype(np.float64)
+        obj = np.empty(len(prob2), dtype=object)
+        for i in range(len(prob2)):
+            obj[i] = prob2[i].astype(np.float64)
+        rawo = np.empty(len(raw2), dtype=object)
+        for i in range(len(raw2)):
+            rawo[i] = np.asarray(raw2[i], dtype=np.float64).ravel()
+        out = (df.with_column(self.get("raw_prediction_col"), rawo)
+                 .with_column(self.probability_col, obj)
+                 .with_column(self.prediction_col, pred))
+        out = set_label_metadata(out, self.prediction_col,
+                                 num_classes=self.num_classes)
+        return self._add_aux_cols(out, X)
+
+
+class LightGBMRegressor(Estimator, _LightGBMParams, HasPredictionCol):
+    objective = Param(str, default="regression",
+                      doc="regression/l1/huber/quantile/poisson/tweedie/gamma")
+    alpha = Param(float, default=0.9, doc="huber/quantile parameter")
+    tweedie_variance_power = Param(float, default=1.5, doc="tweedie power")
+
+    def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
+        booster = self._fit_core(df, {
+            "objective": self.objective, "alpha": self.alpha,
+            "tweedie_variance_power": self.tweedie_variance_power})
+        return LightGBMRegressionModel(
+            booster, features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            leaf_prediction_col=self.get_or_none("leaf_prediction_col"),
+            features_shap_col=self.get_or_none("features_shap_col"))
+
+
+class LightGBMRegressionModel(_LightGBMModelBase):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features(df)
+        pred = self.booster.predict(X).astype(np.float64)
+        return self._add_aux_cols(df.with_column(self.prediction_col, pred), X)
+
+
+class LightGBMRanker(Estimator, _LightGBMParams, HasPredictionCol):
+    group_col = Param(str, default="group", doc="query-group column")
+    evaluate_at = Param((list, int), default=[5], doc="NDCG@k positions")
+
+    def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
+        booster = self._fit_core(df, {"objective": "lambdarank"},
+                                 group_col=self.group_col)
+        return LightGBMRankerModel(
+            booster, features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            leaf_prediction_col=self.get_or_none("leaf_prediction_col"),
+            features_shap_col=self.get_or_none("features_shap_col"))
+
+
+class LightGBMRankerModel(_LightGBMModelBase):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features(df)
+        pred = self.booster.predict(X, raw_score=True).astype(np.float64)
+        return self._add_aux_cols(df.with_column(self.prediction_col, pred), X)

@@ -1,0 +1,223 @@
+"""GBDT objectives and eval metrics.
+
+Parity surface: LightGBM objectives exposed through the reference
+(``lightgbm/.../params/TrainParams.scala:10-100`` renders
+``objective=binary|multiclass|regression|...``; custom objectives via
+``FObjTrait`` gradients, ``TrainUtils.scala:67-90``). Each objective maps
+raw scores → (grad, hess) as pure jax functions so the boosting loop stays
+inside one jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["get_objective", "get_metric", "OBJECTIVES", "METRICS",
+           "Objective"]
+
+
+class Objective:
+    """grad/hess + score→prediction transform + #model outputs per row."""
+
+    def __init__(self, grad_hess: Callable, transform: Callable,
+                 n_scores: int = 1, init_score: Optional[Callable] = None):
+        self.grad_hess = grad_hess
+        self.transform = transform
+        self.n_scores = n_scores
+        self.init_score = init_score or (lambda y, w: 0.0)
+
+
+# -- regression --------------------------------------------------------------
+
+def _l2_grad(scores, y, w):
+    g = scores - y
+    h = jnp.ones_like(scores)
+    return g * w, h * w
+
+
+def _l1_grad(scores, y, w):
+    g = jnp.sign(scores - y)
+    h = jnp.ones_like(scores)  # LightGBM uses hessian 1 for L1
+    return g * w, h * w
+
+
+def _huber_grad(delta):
+    def f(scores, y, w):
+        r = scores - y
+        g = jnp.where(jnp.abs(r) <= delta, r, delta * jnp.sign(r))
+        h = jnp.ones_like(scores)
+        return g * w, h * w
+    return f
+
+
+def _quantile_grad(alpha):
+    def f(scores, y, w):
+        r = scores - y
+        g = jnp.where(r >= 0, 1.0 - alpha, -alpha) * 2 * 0.5  # slope of pinball
+        g = jnp.where(r >= 0, (1.0 - alpha), -alpha)
+        h = jnp.ones_like(scores)
+        return g * w, h * w
+    return f
+
+
+def _poisson_grad(scores, y, w):
+    mu = jnp.exp(scores)
+    return (mu - y) * w, mu * w
+
+
+def _tweedie_grad(rho):
+    def f(scores, y, w):
+        mu = jnp.exp(scores)
+        g = -y * jnp.exp((1.0 - rho) * scores) + jnp.exp((2.0 - rho) * scores)
+        h = (-y * (1.0 - rho) * jnp.exp((1.0 - rho) * scores)
+             + (2.0 - rho) * jnp.exp((2.0 - rho) * scores))
+        return g * w, h * w
+    return f
+
+
+def _gamma_grad(scores, y, w):
+    g = 1.0 - y * jnp.exp(-scores)
+    h = y * jnp.exp(-scores)
+    return g * w, h * w
+
+
+# -- classification ----------------------------------------------------------
+
+def _binary_grad(scores, y, w):
+    p = jax.nn.sigmoid(scores)
+    return (p - y) * w, jnp.maximum(p * (1 - p), 1e-16) * w
+
+
+def _multiclass_grad(scores, y, w):
+    # scores: (n, K); y int labels (n,)
+    p = jax.nn.softmax(scores, axis=-1)
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), scores.shape[-1],
+                            dtype=scores.dtype)
+    g = (p - onehot) * w[:, None]
+    h = jnp.maximum(p * (1 - p), 1e-16) * 2.0 * w[:, None]
+    return g, h
+
+
+OBJECTIVES: Dict[str, Callable[..., Objective]] = {
+    "regression": lambda **kw: Objective(
+        _l2_grad, lambda s: s,
+        init_score=lambda y, w: float(np.average(y, weights=w))),
+    "regression_l1": lambda **kw: Objective(
+        _l1_grad, lambda s: s,
+        init_score=lambda y, w: float(np.median(y))),
+    "huber": lambda alpha=0.9, **kw: Objective(_huber_grad(alpha), lambda s: s),
+    "quantile": lambda alpha=0.5, **kw: Objective(
+        _quantile_grad(alpha), lambda s: s,
+        init_score=lambda y, w: float(np.quantile(y, alpha))),
+    "poisson": lambda **kw: Objective(
+        _poisson_grad, jnp.exp,
+        init_score=lambda y, w: float(np.log(max(np.average(y, weights=w), 1e-9)))),
+    "tweedie": lambda tweedie_variance_power=1.5, **kw: Objective(
+        _tweedie_grad(tweedie_variance_power), jnp.exp,
+        init_score=lambda y, w: float(np.log(max(np.average(y, weights=w), 1e-9)))),
+    "gamma": lambda **kw: Objective(
+        _gamma_grad, jnp.exp,
+        init_score=lambda y, w: float(np.log(max(np.average(y, weights=w), 1e-9)))),
+    "binary": lambda **kw: Objective(
+        _binary_grad, jax.nn.sigmoid,
+        init_score=lambda y, w: float(np.log(max(np.average(y, weights=w), 1e-9)
+                                             / max(1 - np.average(y, weights=w), 1e-9))),
+    ),
+    "multiclass": lambda num_class=2, **kw: Objective(
+        _multiclass_grad, lambda s: jax.nn.softmax(s, axis=-1),
+        n_scores=num_class),
+    "lambdarank": lambda **kw: Objective(None, jax.nn.sigmoid),  # special-cased
+}
+
+# aliases (parity with LightGBM names)
+OBJECTIVES["l2"] = OBJECTIVES["mse"] = OBJECTIVES["mean_squared_error"] = \
+    OBJECTIVES["regression"]
+OBJECTIVES["l1"] = OBJECTIVES["mae"] = OBJECTIVES["regression_l1"]
+OBJECTIVES["softmax"] = OBJECTIVES["multiclass"]
+
+
+def get_objective(name: str, **kw) -> Objective:
+    if name not in OBJECTIVES:
+        raise ValueError(f"unknown objective {name!r}; known: {sorted(OBJECTIVES)}")
+    return OBJECTIVES[name](**kw)
+
+
+# -- eval metrics (host-side numpy; used for early stopping & logging) -------
+
+def _auc(y, p, w):
+    order = np.argsort(-p)
+    y, w = np.asarray(y)[order], np.asarray(w)[order]
+    tp = np.cumsum(y * w)
+    fp = np.cumsum((1 - y) * w)
+    tot_p, tot_n = tp[-1], fp[-1]
+    if tot_p == 0 or tot_n == 0:
+        return 0.5
+    # trapezoid over ROC
+    tpr = np.concatenate([[0], tp / tot_p])
+    fpr = np.concatenate([[0], fp / tot_n])
+    return float(np.trapezoid(tpr, fpr))
+
+
+def _binary_logloss(y, p, w):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(np.average(-(y * np.log(p) + (1 - y) * np.log(1 - p)),
+                            weights=w))
+
+
+def _multi_logloss(y, p, w):
+    p = np.clip(p, 1e-15, 1.0)
+    ll = -np.log(p[np.arange(len(y)), np.asarray(y, dtype=int)])
+    return float(np.average(ll, weights=w))
+
+
+def _ndcg_at(k):
+    def f(y, p, w, groups=None):
+        if groups is None:
+            groups = np.array([len(y)])
+        scores, start = [], 0
+        for g in groups:
+            g = int(g)
+            yy, pp = np.asarray(y[start:start + g]), p[start:start + g]
+            start += g
+            if g == 0:
+                continue
+            order = np.argsort(-pp)[:k]
+            gains = (2.0 ** yy[order] - 1) / np.log2(np.arange(2, len(order) + 2))
+            ideal_order = np.argsort(-yy)[:k]
+            ideal = (2.0 ** yy[ideal_order] - 1) / np.log2(np.arange(2, len(ideal_order) + 2))
+            scores.append(gains.sum() / ideal.sum() if ideal.sum() > 0 else 1.0)
+        return float(np.mean(scores)) if scores else 1.0
+    return f
+
+
+METRICS: Dict[str, Tuple[Callable, bool]] = {
+    # name → (fn(y, pred, w), higher_is_better)
+    "l2": (lambda y, p, w: float(np.average((p - y) ** 2, weights=w)), False),
+    "rmse": (lambda y, p, w: float(np.sqrt(np.average((p - y) ** 2, weights=w))), False),
+    "l1": (lambda y, p, w: float(np.average(np.abs(p - y), weights=w)), False),
+    "auc": (_auc, True),
+    "binary_logloss": (_binary_logloss, False),
+    "multi_logloss": (_multi_logloss, False),
+    "binary_error": (lambda y, p, w: float(np.average((p > 0.5) != (y > 0.5),
+                                                      weights=w)), False),
+    "multi_error": (lambda y, p, w: float(np.average(np.argmax(p, 1) != y,
+                                                     weights=w)), False),
+    "ndcg": (_ndcg_at(10), True),
+}
+
+_DEFAULT_METRIC = {"regression": "l2", "regression_l1": "l1", "huber": "l2",
+                   "quantile": "l2", "poisson": "l2", "tweedie": "l2",
+                   "gamma": "l2", "binary": "binary_logloss",
+                   "multiclass": "multi_logloss", "lambdarank": "ndcg"}
+
+
+def get_metric(name: str, objective: Optional[str] = None):
+    if name in ("", "auto", None) and objective:
+        name = _DEFAULT_METRIC.get(objective, "l2")
+    if name not in METRICS:
+        raise ValueError(f"unknown metric {name!r}; known: {sorted(METRICS)}")
+    return name, METRICS[name]

@@ -1,0 +1,213 @@
+"""Histogram tree construction — the XLA compute core of the GBDT trainer.
+
+Replaces LightGBM's native distributed tree learner (histogram build +
+socket-ring allreduce + split/partition inside ``LGBM_BoosterUpdateOneIter``,
+reached via ``lightgbm/.../booster/LightGBMBooster.scala:351-361``) with a
+TPU formulation:
+
+* trees grow **depth-wise** with a complete binary tree of static depth, so
+  every step is fixed-shape: one histogram scatter-add per level
+  (``segment_sum`` over (node, bin) ids, vmapped over features), one
+  vectorized split search, one gather-based row routing. No data-dependent
+  control flow — the whole ``build_tree`` jits.
+* data parallelism = ``psum`` of the (nodes, F, B, 3) histogram over the mesh
+  axis — the exact collective LightGBM's ``tree_learner=data_parallel``
+  performs over its socket ring (``params/LightGBMParams.scala:16-21``).
+* early-stopped nodes route all rows left with a sentinel split, so the
+  complete-tree shape is preserved and leaf values computed at the bottom
+  level are correct for stopped subtrees too.
+
+Trees store raw-value thresholds (converted from bins by the caller) so
+prediction is independent of the bin mapper; NaN always routes left,
+mirroring the missing-value bin 0 used during training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TreeArrays", "build_tree", "predict_trees", "predict_leaf_indices"]
+
+
+class TreeArrays(NamedTuple):
+    """One fitted tree in complete-binary-tree layout (depth D).
+
+    feat: (2^D - 1,) int32 — split feature per internal node, -1 = leaf/stub
+    thr_bin: (2^D - 1,) int32 — split bin (left iff bin <= thr_bin)
+    thr_raw: (2^D - 1,) float32 — raw threshold (left iff x <= thr or NaN)
+    leaf_value: (2^D,) float32 — values at bottom level
+    """
+    feat: jnp.ndarray
+    thr_bin: jnp.ndarray
+    thr_raw: jnp.ndarray
+    leaf_value: jnp.ndarray
+
+
+def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
+    """(n,F) bins × per-row (g,h,count) → (n_nodes, F, B, 3) histogram."""
+    data = jnp.stack([g, h, w_count], axis=-1)  # (n, 3)
+
+    def per_feature(bins_col):
+        seg = node_rel * n_bins + bins_col.astype(jnp.int32)
+        return jax.ops.segment_sum(data, seg, num_segments=n_nodes * n_bins)
+
+    hist = jax.vmap(per_feature, in_axes=1)(xb)      # (F, nodes*B, 3)
+    hist = jnp.transpose(hist.reshape(xb.shape[1], n_nodes, n_bins, 3),
+                         (1, 0, 2, 3))               # (nodes, F, B, 3)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
+                 feature_mask):
+    """hist (nodes, F, B, 3) → best (gain, feat, bin) per node."""
+    G = hist[..., 0]
+    H = hist[..., 1]
+    C = hist[..., 2]
+    GL = jnp.cumsum(G, axis=-1)
+    HL = jnp.cumsum(H, axis=-1)
+    CL = jnp.cumsum(C, axis=-1)
+    Gt = GL[..., -1:]
+    Ht = HL[..., -1:]
+    Ct = CL[..., -1:]
+    GR, HR, CR = Gt - GL, Ht - HL, Ct - CL
+
+    def score(g, h):
+        return (g * g) / (h + lam)
+
+    gain = 0.5 * (score(GL, HL) + score(GR, HR) - score(Gt, Ht))
+    valid = ((HL >= min_child_weight) & (HR >= min_child_weight)
+             & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
+             & (gain > min_gain))
+    if feature_mask is not None:
+        valid = valid & feature_mask[None, :, None]
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(gain.shape[0], -1)           # (nodes, F*B)
+    best = jnp.argmax(flat, axis=-1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
+    n_bins = hist.shape[2]
+    best_feat = (best // n_bins).astype(jnp.int32)
+    best_bin = (best % n_bins).astype(jnp.int32)
+    ok = jnp.isfinite(best_gain)
+    return (jnp.where(ok, best_feat, -1),
+            jnp.where(ok, best_bin, n_bins),         # sentinel: all rows left
+            jnp.where(ok, best_gain, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name"))
+def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+               sample_weight_count: jnp.ndarray,
+               depth: int, n_bins: int,
+               lam: float = 1e-3, alpha: float = 0.0, min_gain: float = 0.0,
+               min_child_weight: float = 1e-3, min_data_in_leaf: float = 1.0,
+               feature_mask: Optional[jnp.ndarray] = None,
+               axis_name: Optional[str] = None):
+    """Grow one depth-`depth` tree. All shapes static; jits once per config.
+
+    xb: (n, F) int bins; g/h: (n,) gradients/hessians (already weighted);
+    sample_weight_count: (n,) 1.0 for live rows, 0.0 for padding/bagged-out.
+    Returns (feat, thr_bin, leaf_value, leaf_index_per_row).
+    """
+    n, F = xb.shape
+    n_internal = 2 ** depth - 1
+    feats = jnp.full(n_internal, -1, dtype=jnp.int32)
+    thrs = jnp.full(n_internal, n_bins, dtype=jnp.int32)
+    gains = jnp.zeros(n_internal, dtype=jnp.float32)
+    covers = jnp.zeros(2 ** (depth + 1) - 1, dtype=jnp.float32)
+    node_rel = jnp.zeros(n, dtype=jnp.int32)
+
+    for d in range(depth):
+        n_nodes = 2 ** d
+        level_off = 2 ** d - 1
+        hist = _level_histogram(xb, node_rel, g, h, sample_weight_count,
+                                n_nodes, n_bins, axis_name)
+        level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
+        covers = jax.lax.dynamic_update_slice(covers, level_cover, (level_off,))
+        bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
+                                  min_data_in_leaf, feature_mask)
+        feats = jax.lax.dynamic_update_slice(feats, bf, (level_off,))
+        thrs = jax.lax.dynamic_update_slice(thrs, bb, (level_off,))
+        gains = jax.lax.dynamic_update_slice(gains, bg.astype(jnp.float32),
+                                             (level_off,))
+        # route rows: bin <= thr → left. Stub splits have thr = n_bins → left.
+        row_feat = jnp.clip(bf[node_rel], 0, F - 1)
+        row_bin = jnp.take_along_axis(xb, row_feat[:, None].astype(jnp.int32),
+                                      axis=1)[:, 0]
+        go_right = row_bin.astype(jnp.int32) > bb[node_rel]
+        node_rel = node_rel * 2 + go_right.astype(jnp.int32)
+
+    # leaf values from bottom-level stats
+    n_leaves = 2 ** depth
+    data = jnp.stack([g, h], axis=-1)
+    sums = jax.ops.segment_sum(data, node_rel, num_segments=n_leaves)
+    if axis_name is not None:
+        sums = jax.lax.psum(sums, axis_name)
+    G = sums[:, 0]
+    G_reg = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)  # L1 shrink
+    leaf_value = -G_reg / (sums[:, 1] + lam)
+    leaf_value = jnp.where(jnp.abs(sums[:, 1]) > 0, leaf_value, 0.0)
+    leaf_counts = jax.ops.segment_sum(sample_weight_count, node_rel,
+                                      num_segments=n_leaves)
+    if axis_name is not None:
+        leaf_counts = jax.lax.psum(leaf_counts, axis_name)
+    covers = jax.lax.dynamic_update_slice(covers, leaf_counts,
+                                          (2 ** depth - 1,))
+    return feats, thrs, leaf_value.astype(jnp.float32), node_rel, gains, covers
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def predict_trees(feats, thr_raw, leaf_values, X, depth: int):
+    """Sum of tree outputs for raw features.
+
+    feats (T, 2^D-1) int32, thr_raw (T, 2^D-1) f32, leaf_values (T, 2^D) or
+    (T, K, 2^D); X (n, F) float. Returns (n,) or (n, K).
+    """
+    n = X.shape[0]
+
+    def one_tree(carry, tree):
+        f, t, lv = tree
+        idx = jnp.zeros(n, dtype=jnp.int32)
+        for _ in range(depth):
+            nf = f[idx]
+            nt = t[idx]
+            x = jnp.take_along_axis(X, jnp.clip(nf, 0, X.shape[1] - 1)[:, None],
+                                    axis=1)[:, 0]
+            go_left = (nf < 0) | (x <= nt) | jnp.isnan(x)
+            idx = 2 * idx + 1 + (1 - go_left.astype(jnp.int32))
+        leaf = idx - (2 ** depth - 1)
+        contrib = jnp.take(lv, leaf, axis=-1)        # (n,) or (K, n)
+        if contrib.ndim == 2:
+            contrib = contrib.T
+        return carry + contrib, None
+
+    k_dim = leaf_values.shape[1] if leaf_values.ndim == 3 else None
+    init = jnp.zeros((n, k_dim) if k_dim else (n,), dtype=jnp.float32)
+    out, _ = jax.lax.scan(one_tree, init, (feats, thr_raw, leaf_values))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def predict_leaf_indices(feats, thr_raw, X, depth: int):
+    """Leaf index per (row, tree) — parity with LightGBM predictLeaf."""
+    n = X.shape[0]
+
+    def one_tree(_, tree):
+        f, t = tree
+        idx = jnp.zeros(n, dtype=jnp.int32)
+        for _ in range(depth):
+            nf = f[idx]
+            nt = t[idx]
+            x = jnp.take_along_axis(X, jnp.clip(nf, 0, X.shape[1] - 1)[:, None],
+                                    axis=1)[:, 0]
+            go_left = (nf < 0) | (x <= nt) | jnp.isnan(x)
+            idx = 2 * idx + 1 + (1 - go_left.astype(jnp.int32))
+        return None, idx - (2 ** depth - 1)
+
+    _, leaves = jax.lax.scan(one_tree, None, (feats, thr_raw))
+    return leaves.T  # (n, T)

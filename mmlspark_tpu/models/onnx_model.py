@@ -1,0 +1,167 @@
+"""ONNXModel — batched ONNX inference on TPU through the DataFrame API.
+
+Parity surface: the reference's ``ONNXModel``
+(``deep-learning/.../onnx/ONNXModel.scala``):
+
+* ``feed_dict`` {model input → column} / ``fetch_dict`` {column → model
+  output} (`SharedParams.scala:9-33`)
+* ``softmax_dict`` / ``argmax_dict`` post-ops (`ONNXModel.scala:519-562`)
+* minibatch → coerce → run per partition → flatten (`ONNXModel.scala:482-517`)
+* device selection per partition (`ONNXModel.scala:293-303`) → here chips
+  round-robin via ``parallel.device_for_partition``.
+
+TPU-first differences: the graph is compiled by XLA (no ORT session); batches
+are padded to power-of-two buckets so the jit cache stays small
+(`ops/padding.py`); model I/O metadata comes from the proto directly
+(`ONNXModel.scala:437-457` needs a live ORT session for this).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Model, Transformer
+from ..onnx.convert import ConvertedModel, convert_model
+from ..ops.padding import bucket_size, pad_axis
+from ..parallel.mesh import device_for_partition
+from ..stages.batching import FixedMiniBatchTransformer, FlattenBatch, batch_slices
+
+__all__ = ["ONNXModel"]
+
+
+class ONNXModel(Model):
+    model_bytes = ComplexParam(doc="serialized ONNX ModelProto")
+    feed_dict = Param(dict, default={}, doc="{model input name: dataframe column}")
+    fetch_dict = Param(dict, default={}, doc="{output column: model output name}")
+    mini_batch_size = Param(int, default=64, doc="rows per device batch")
+    softmax_dict = Param(dict, default={}, doc="{output col: col to softmax}")
+    argmax_dict = Param(dict, default={}, doc="{output col: col to argmax}")
+    compute_dtype = Param(str, default="float32",
+                          doc="cast float inputs/params to this dtype "
+                              "(bfloat16 recommended on TPU)")
+    pin_devices = Param(bool, default=True,
+                        doc="round-robin partitions over local chips")
+
+    def __init__(self, model_bytes: Optional[bytes] = None, **kw):
+        super().__init__(**kw)
+        if model_bytes is not None:
+            self.set(model_bytes=model_bytes)
+        self._converted: Optional[ConvertedModel] = None
+        self._jitted = None
+        self._device_params: Dict[int, dict] = {}
+
+    # -- metadata (proto-only, no session) ----------------------------------
+    def _ensure_converted(self) -> ConvertedModel:
+        if self._converted is None:
+            self._converted = convert_model(self.get("model_bytes"))
+            self._jitted = jax.jit(self._converted.__call__)
+        return self._converted
+
+    def model_inputs(self) -> Dict[str, tuple]:
+        cm = self._ensure_converted()
+        return {vi.name: (vi.numpy_dtype, tuple(vi.shape)) for vi in cm.inputs}
+
+    def model_outputs(self) -> Dict[str, tuple]:
+        cm = self._ensure_converted()
+        return {vi.name: (vi.numpy_dtype, tuple(vi.shape)) for vi in cm.outputs}
+
+    # -- column coercion (parity: ONNXModel.coerceBatchedDf :564-584) -------
+    def _coerce(self, col: np.ndarray, dtype, shape) -> np.ndarray:
+        if col.dtype == object:
+            col = np.stack([np.asarray(v) for v in col])
+        arr = np.asarray(col)
+        want = np.dtype(dtype)
+        if want.kind == "f" and self.compute_dtype != "float32":
+            want = jnp.dtype(self.compute_dtype)
+        if arr.dtype != want:
+            arr = arr.astype(want)
+        # reshape flat rows to the model's per-row shape if one is declared
+        row_shape = [d for d in shape[1:] if isinstance(d, int)]
+        if row_shape and list(arr.shape[1:]) != row_shape \
+                and int(np.prod(arr.shape[1:])) == int(np.prod(row_shape)):
+            arr = arr.reshape((arr.shape[0],) + tuple(row_shape))
+        return arr
+
+    def _params_for_device(self, device) -> dict:
+        key = id(device)
+        if key not in self._device_params:
+            cm = self._ensure_converted()
+            params = cm.params
+            if self.compute_dtype != "float32":
+                dt = jnp.dtype(self.compute_dtype)
+                params = {k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating)
+                              else v) for k, v in params.items()}
+            self._device_params[key] = jax.device_put(params, device)
+        return self._device_params[key]
+
+    # -- execution ----------------------------------------------------------
+    def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
+        cm = self._ensure_converted()
+        feed = self.feed_dict or {cm.input_names[0]: part.columns[0]}
+        fetch = self.fetch_dict or {n: n for n in cm.output_names}
+        in_meta = {vi.name: vi for vi in cm.inputs}
+
+        device = device_for_partition(pidx) if self.pin_devices else None
+        params = self._params_for_device(device) if device is not None \
+            else self._params_for_device(jax.devices()[0])
+
+        n = len(part)
+        out_cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetch}
+        for sl in batch_slices(n, self.mini_batch_size):
+            feeds = {}
+            b = None
+            for input_name, col_name in feed.items():
+                vi = in_meta[input_name]
+                arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape)
+                b = len(arr)
+                target = bucket_size(b)
+                arr = pad_axis(arr, target)
+                feeds[input_name] = jax.device_put(arr, device)
+            outs = self._jitted(params, feeds)
+            for col_name, out_name in fetch.items():
+                res = np.asarray(outs[out_name])[:b]
+                out_cols[col_name].append(res)
+        merged = {}
+        for col_name, chunks in out_cols.items():
+            if chunks:
+                merged[col_name] = np.concatenate(chunks)
+            else:
+                merged[col_name] = np.zeros((0,))
+        out = part
+        for col_name, arr in merged.items():
+            vals = np.empty(len(arr), dtype=object)
+            for i in range(len(arr)):
+                vals[i] = arr[i]
+            out = out.with_column(col_name, vals if arr.ndim > 1 else arr)
+        return out
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        out = df.map_partitions(self._run_batches)
+        # post-ops (parity: softMaxTransform/argMaxTransform :519-562)
+        for out_col, src_col in self.softmax_dict.items():
+            col = out[src_col]
+            probs = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col):
+                v = np.asarray(v, dtype=np.float64)
+                e = np.exp(v - v.max(axis=-1, keepdims=True))
+                probs[i] = e / e.sum(axis=-1, keepdims=True)
+            out = out.with_column(out_col, probs)
+        for out_col, src_col in self.argmax_dict.items():
+            col = out[src_col]
+            out = out.with_column(
+                out_col,
+                np.asarray([int(np.argmax(np.asarray(v))) for v in col],
+                           dtype=np.int64))
+        return out
+
+    # -- persistence: rebuild session state after load ----------------------
+    def _load_extra(self, path: str) -> None:
+        self._converted = None
+        self._jitted = None
+        self._device_params = {}

@@ -1,0 +1,185 @@
+"""Transformer encoder — native JAX, mesh-sharded (dp × tp with Megatron-style
+sequence parallelism), plus a full training step.
+
+The reference has **no** intra-model sharding anywhere (SURVEY.md §2.8) — its
+largest models run whole-per-executor through ONNX/CNTK sessions. This module
+is where the TPU rebuild goes past parity: a BERT-class encoder whose weights
+and activations are laid out over a ``Mesh(('dp','tp'))``:
+
+* batch sharded over ``dp``;
+* attention heads and MLP hidden dim sharded over ``tp`` (Megatron split:
+  QKV/W1 column-parallel, O/W2 row-parallel — XLA inserts the psum);
+* activations outside attention/MLP sharded over the sequence axis on ``tp``
+  (sequence parallelism), so layernorm/residual memory scales with 1/tp;
+* ring attention over long sequences lives in ``parallel/ring.py`` and mounts
+  on the same mesh (axis ``sp``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
+           "train_step", "param_shardings", "BERT_BASE", "BERT_MINI"]
+
+
+class TransformerConfig(NamedTuple):
+    vocab: int = 30522
+    layers: int = 12
+    d_model: int = 768
+    heads: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+BERT_BASE = TransformerConfig()
+BERT_MINI = TransformerConfig(vocab=1024, layers=4, d_model=256, heads=8,
+                              d_ff=1024, max_len=128)
+
+
+def init_transformer(cfg: TransformerConfig, seed: int = 0) -> Dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(din, dout, scale=None):
+        s = scale or np.sqrt(2.0 / (din + dout))
+        return rng.normal(0, s, (din, dout)).astype(np.float32)
+
+    params: Dict = {
+        "embed": {"tok": dense(cfg.vocab, cfg.d_model, 0.02),
+                  "pos": dense(cfg.max_len, cfg.d_model, 0.02)},
+        "layers": [],
+        "final_ln": {"scale": np.ones(cfg.d_model, np.float32),
+                     "bias": np.zeros(cfg.d_model, np.float32)},
+        "lm_head": {"w": dense(cfg.d_model, cfg.vocab, 0.02)},
+    }
+    for _ in range(cfg.layers):
+        params["layers"].append({
+            "ln1": {"scale": np.ones(cfg.d_model, np.float32),
+                    "bias": np.zeros(cfg.d_model, np.float32)},
+            "qkv": {"w": dense(cfg.d_model, 3 * cfg.d_model),
+                    "b": np.zeros(3 * cfg.d_model, np.float32)},
+            "out": {"w": dense(cfg.d_model, cfg.d_model),
+                    "b": np.zeros(cfg.d_model, np.float32)},
+            "ln2": {"scale": np.ones(cfg.d_model, np.float32),
+                    "bias": np.zeros(cfg.d_model, np.float32)},
+            "w1": {"w": dense(cfg.d_model, cfg.d_ff),
+                   "b": np.zeros(cfg.d_ff, np.float32)},
+            "w2": {"w": dense(cfg.d_ff, cfg.d_model),
+                   "b": np.zeros(cfg.d_model, np.float32)},
+        })
+    return params
+
+
+def param_shardings(mesh: Mesh) -> Dict:
+    """PartitionSpec pytree matching ``init_transformer`` (Megatron layout)."""
+    def layer_spec():
+        return {
+            "ln1": {"scale": P(), "bias": P()},
+            "qkv": {"w": P(None, "tp"), "b": P("tp")},      # column-parallel
+            "out": {"w": P("tp", None), "b": P()},          # row-parallel
+            "ln2": {"scale": P(), "bias": P()},
+            "w1": {"w": P(None, "tp"), "b": P("tp")},
+            "w2": {"w": P("tp", None), "b": P()},
+        }
+
+    return {
+        "embed": {"tok": P(None, "tp"), "pos": P(None, "tp")},
+        "layers": [],  # filled dynamically by tree mapping below
+        "final_ln": {"scale": P(), "bias": P()},
+        "lm_head": {"w": P(None, "tp")},
+        "_layer_template": layer_spec,
+    }
+
+
+def shardings_for(params: Dict, mesh: Mesh) -> Dict:
+    spec = param_shardings(mesh)
+    template = spec.pop("_layer_template")
+    spec["layers"] = [template() for _ in params["layers"]]
+    return jax.tree.map(lambda p: NamedSharding(mesh, p), spec,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _ln(x, p, eps=1e-5):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * p["scale"] + p["bias"]
+
+
+def transformer_apply(params: Dict, ids: jnp.ndarray,
+                      cfg: TransformerConfig,
+                      mesh: Optional[Mesh] = None,
+                      mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Encoder forward → final hidden states (B, S, D) in cfg.dtype."""
+    dt = cfg.dtype
+    B, S = ids.shape
+
+    def constrain(x, spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return x
+
+    h = params["embed"]["tok"].astype(dt)[ids] + \
+        params["embed"]["pos"].astype(dt)[:S][None, :, :]
+    # sequence-parallel region: activations sharded (dp, tp) on (B, S)
+    h = constrain(h, P("dp", "tp", None))
+
+    if mask is not None:
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(jnp.float32)
+    else:
+        bias = None
+
+    for lp in params["layers"]:
+        x = _ln(h.astype(jnp.float32), lp["ln1"]).astype(dt)
+        x = constrain(x, P("dp", None, None))  # gather sequence for attention
+        qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
+        qkv = constrain(qkv, P("dp", None, "tp"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = cfg.d_model // cfg.heads
+
+        def heads(t):
+            return t.reshape(B, S, cfg.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / np.sqrt(hd)
+        if bias is not None:
+            scores = scores + bias
+        attn = jax.nn.softmax(scores, axis=-1).astype(dt)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v,
+                         preferred_element_type=dt)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
+        proj = ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
+        h = h + constrain(proj, P("dp", "tp", None))  # back to sequence-parallel
+
+        x = _ln(h.astype(jnp.float32), lp["ln2"]).astype(dt)
+        x = constrain(x, P("dp", None, None))
+        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
+        y = constrain(y, P("dp", None, "tp"))
+        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        h = h + constrain(y, P("dp", "tp", None))
+
+    return _ln(h.astype(jnp.float32), params["final_ln"]).astype(dt)
+
+
+def loss_fn(params, ids, labels, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    hidden = transformer_apply(params, ids, cfg, mesh)
+    logits = (hidden.astype(jnp.float32) @ params["lm_head"]["w"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def train_step(params, opt_state, ids, labels, cfg: TransformerConfig,
+               mesh: Optional[Mesh] = None, lr: float = 1e-4):
+    """One SGD-with-momentum step; grads/opt-state shard like params."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels, cfg, mesh)
+    new_m = jax.tree.map(lambda m, g: 0.9 * m + g, opt_state, grads)
+    new_p = jax.tree.map(lambda p, m: p - lr * m, params, new_m)
+    return new_p, new_m, loss

@@ -1,0 +1,1012 @@
+"""ONNX graph → JAX function.
+
+Replaces onnxruntime as the execution engine behind ONNXModel (reference:
+``deep-learning/.../onnx/ONNXModel.scala:173-193`` builds an ORT session with
+the CUDA execution provider; here the graph becomes a pure jittable function
+XLA compiles for TPU).
+
+Design notes:
+
+* Node handlers are written with ``jax.numpy``; anything derived only from
+  initializers/constants stays **concrete** during tracing (jnp on ndarrays
+  executes eagerly), so shape-carrying ops (``Shape`` → ``Reshape``/``Slice``)
+  fold at trace time instead of producing dynamic shapes XLA can't tile.
+* ``Shape`` returns the static shape as a numpy array — even for tracers the
+  shape is known at trace time, which is what makes BERT-style graphs with
+  shape arithmetic compile to static-shape XLA programs.
+* The converted callable has signature ``fn(params, feeds) -> {name: out}``
+  with ``params`` passed explicitly so jit can donate/shard them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .proto import (GraphProto, ModelProto, NodeProto, ValueInfo,
+                    ONNX_TO_NUMPY, parse_model, tensor_to_numpy)
+
+__all__ = ["ConvertedModel", "convert_model", "OP_HANDLERS", "register_op"]
+
+
+class UnsupportedOp(NotImplementedError):
+    pass
+
+
+def _concrete(v, what: str) -> np.ndarray:
+    """Require a trace-time-constant value (e.g. a Reshape target)."""
+    try:
+        return np.asarray(v)
+    except Exception as e:
+        raise UnsupportedOp(
+            f"{what} must be computable at trace time, got a traced value; "
+            "this graph is data-dependently shaped") from e
+
+
+OP_HANDLERS: Dict[str, Callable] = {}
+
+
+def register_op(name: str):
+    def deco(fn):
+        OP_HANDLERS[name] = fn
+        return fn
+    return deco
+
+
+# -- elementwise -------------------------------------------------------------
+
+def _variadic(fn):
+    def h(node, inputs, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = fn(out, x)
+        return out
+    return h
+
+
+def _onnx_div(a, b):
+    # integer Div truncates toward zero (C semantics), float Div is true div
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.integer):
+        q = jnp.asarray(a) / jnp.asarray(b)
+        return jnp.trunc(q).astype(jnp.asarray(a).dtype)
+    return jnp.divide(a, b)
+
+
+def _onnx_pow(a, b):
+    b = jnp.asarray(b)
+    if b.dtype != jnp.asarray(a).dtype:
+        b = b.astype(jnp.asarray(a).dtype)
+    return jnp.power(a, b)
+
+
+for _name, _fn in [
+    ("Add", jnp.add), ("Sub", jnp.subtract), ("Mul", jnp.multiply),
+    ("Div", _onnx_div), ("Pow", _onnx_pow), ("Mod", jnp.mod),
+    ("And", jnp.logical_and), ("Or", jnp.logical_or), ("Xor", jnp.logical_xor),
+]:
+    OP_HANDLERS[_name] = _variadic(_fn)
+
+OP_HANDLERS["Min"] = _variadic(jnp.minimum)
+OP_HANDLERS["Max"] = _variadic(jnp.maximum)
+OP_HANDLERS["Sum"] = _variadic(jnp.add)
+
+
+@register_op("Mean")
+def _mean(node, inputs, ctx):
+    return _variadic(jnp.add)(node, inputs, ctx) / len(inputs)
+
+
+for _name, _u in [
+    ("Abs", jnp.abs), ("Neg", jnp.negative), ("Exp", jnp.exp), ("Log", jnp.log),
+    ("Sqrt", jnp.sqrt), ("Floor", jnp.floor), ("Ceil", jnp.ceil),
+    ("Round", jnp.round), ("Sign", jnp.sign), ("Tanh", jnp.tanh),
+    ("Sin", jnp.sin), ("Cos", jnp.cos), ("Tan", jnp.tan),
+    ("Asin", jnp.arcsin), ("Acos", jnp.arccos), ("Atan", jnp.arctan),
+    ("Sinh", jnp.sinh), ("Cosh", jnp.cosh),
+    ("Asinh", jnp.arcsinh), ("Acosh", jnp.arccosh), ("Atanh", jnp.arctanh),
+    ("Not", jnp.logical_not), ("Erf", lambda x: jax.scipy.special.erf(x)),
+    ("Reciprocal", lambda x: 1.0 / x), ("Identity", lambda x: x),
+    ("Relu", jax.nn.relu), ("Sigmoid", jax.nn.sigmoid),
+    ("Softsign", jax.nn.soft_sign), ("IsNaN", jnp.isnan),
+]:
+    OP_HANDLERS[_name] = (lambda f: lambda node, inputs, ctx: f(inputs[0]))(_u)
+
+for _name, _cmp in [("Equal", jnp.equal), ("Greater", jnp.greater),
+                    ("GreaterOrEqual", jnp.greater_equal),
+                    ("Less", jnp.less), ("LessOrEqual", jnp.less_equal)]:
+    OP_HANDLERS[_name] = (lambda f: lambda n, i, c: f(i[0], i[1]))(_cmp)
+
+
+@register_op("LeakyRelu")
+def _leaky(node, inputs, ctx):
+    return jax.nn.leaky_relu(inputs[0], node.attr("alpha", 0.01))
+
+
+@register_op("Elu")
+def _elu(node, inputs, ctx):
+    return jax.nn.elu(inputs[0], node.attr("alpha", 1.0))
+
+
+@register_op("Selu")
+def _selu(node, inputs, ctx):
+    alpha = node.attr("alpha", 1.6732632423543772)
+    gamma = node.attr("gamma", 1.0507009873554805)
+    x = inputs[0]
+    return gamma * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("Celu")
+def _celu(node, inputs, ctx):
+    return jax.nn.celu(inputs[0], node.attr("alpha", 1.0))
+
+
+@register_op("Softplus")
+def _softplus(node, inputs, ctx):
+    return jax.nn.softplus(inputs[0])
+
+
+@register_op("HardSigmoid")
+def _hardsigmoid(node, inputs, ctx):
+    a, b = node.attr("alpha", 0.2), node.attr("beta", 0.5)
+    return jnp.clip(a * inputs[0] + b, 0.0, 1.0)
+
+
+@register_op("HardSwish")
+def _hardswish(node, inputs, ctx):
+    x = inputs[0]
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register_op("Gelu")
+def _gelu(node, inputs, ctx):
+    approx = node.attr("approximate", "none") == "tanh"
+    return jax.nn.gelu(inputs[0], approximate=approx)
+
+
+@register_op("PRelu")
+def _prelu(node, inputs, ctx):
+    x, slope = inputs
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@register_op("Clip")
+def _clip(node, inputs, ctx):
+    x = inputs[0]
+    lo = node.attr("min") if ctx.opset < 11 else (inputs[1] if len(inputs) > 1 and inputs[1] is not None else None)
+    hi = node.attr("max") if ctx.opset < 11 else (inputs[2] if len(inputs) > 2 and inputs[2] is not None else None)
+    return jnp.clip(x, lo, hi)
+
+
+@register_op("Dropout")
+def _dropout(node, inputs, ctx):
+    x = inputs[0]
+    if len(node.output) > 1:
+        return x, jnp.ones_like(x, dtype=bool)
+    return x
+
+
+@register_op("Cast")
+def _cast(node, inputs, ctx):
+    to = ONNX_TO_NUMPY[node.attr("to")]
+    x = inputs[0]
+    if isinstance(x, np.ndarray):
+        return x.astype(to)
+    return x.astype(to)
+
+
+@register_op("CastLike")
+def _castlike(node, inputs, ctx):
+    return inputs[0].astype(jnp.asarray(inputs[1]).dtype)
+
+
+@register_op("Where")
+def _where(node, inputs, ctx):
+    return jnp.where(inputs[0], inputs[1], inputs[2])
+
+
+# -- matmul family -----------------------------------------------------------
+
+@register_op("MatMul")
+def _matmul(node, inputs, ctx):
+    return jnp.matmul(inputs[0], inputs[1],
+                      preferred_element_type=jnp.asarray(inputs[0]).dtype)
+
+
+@register_op("Gemm")
+def _gemm(node, inputs, ctx):
+    a, b = inputs[0], inputs[1]
+    if node.attr("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    y = node.attr("alpha", 1.0) * jnp.matmul(a, b)
+    if len(inputs) > 2 and inputs[2] is not None:
+        y = y + node.attr("beta", 1.0) * inputs[2]
+    return y
+
+
+@register_op("Einsum")
+def _einsum(node, inputs, ctx):
+    return jnp.einsum(node.attr("equation"), *inputs)
+
+
+# -- conv / pool -------------------------------------------------------------
+
+def _onnx_pads_to_lax(pads: Optional[Sequence[int]], rank: int,
+                      auto_pad: str, x_shape, k_shape, strides, dilations):
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        out = []
+        for i in range(rank):
+            eff_k = (k_shape[i] - 1) * dilations[i] + 1
+            out_dim = -(-x_shape[i] // strides[i])
+            total = max(0, (out_dim - 1) * strides[i] + eff_k - x_shape[i])
+            lo = total // 2 if auto_pad == "SAME_UPPER" else (total + 1) // 2
+            out.append((lo, total - lo))
+        return out
+    if pads is None:
+        return [(0, 0)] * rank
+    return [(pads[i], pads[i + rank]) for i in range(rank)]
+
+
+@register_op("Conv")
+def _conv(node, inputs, ctx):
+    x, w = inputs[0], inputs[1]
+    rank = jnp.asarray(w).ndim - 2
+    strides = node.attr("strides", [1] * rank)
+    dilations = node.attr("dilations", [1] * rank)
+    group = node.attr("group", 1)
+    auto_pad = node.attr("auto_pad", "NOTSET")
+    k_shape = node.attr("kernel_shape", list(jnp.asarray(w).shape[2:]))
+    pads = _onnx_pads_to_lax(node.attr("pads"), rank, auto_pad,
+                             jnp.asarray(x).shape[2:], k_shape, strides, dilations)
+    spatial = "DHW"[-rank:] if rank <= 3 else None
+    if spatial is None:
+        raise UnsupportedOp(f"Conv rank {rank}")
+    dn = lax.conv_dimension_numbers(
+        jnp.asarray(x).shape, jnp.asarray(w).shape,
+        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=pads,
+        rhs_dilation=tuple(dilations), dimension_numbers=dn,
+        feature_group_count=group,
+        preferred_element_type=jnp.asarray(x).dtype)
+    if len(inputs) > 2 and inputs[2] is not None:
+        b = inputs[2]
+        out = out + b.reshape((1, -1) + (1,) * rank)
+    return out
+
+
+@register_op("ConvTranspose")
+def _conv_transpose(node, inputs, ctx):
+    x, w = inputs[0], inputs[1]
+    rank = jnp.asarray(w).ndim - 2
+    strides = tuple(node.attr("strides", [1] * rank))
+    dilations = tuple(node.attr("dilations", [1] * rank))
+    group = node.attr("group", 1)
+    if group != 1:
+        raise UnsupportedOp("grouped ConvTranspose")
+    pads = node.attr("pads", [0] * (2 * rank))
+    output_padding = node.attr("output_padding", [0] * rank)
+    spatial = "DHW"[-rank:]
+    dn = lax.conv_dimension_numbers(
+        jnp.asarray(x).shape, jnp.asarray(w).shape,
+        (f"NC{spatial}", f"IO{spatial}", f"NC{spatial}"))
+    # lax.conv_transpose padding: ONNX pads shrink the output
+    pad_cfg = [(dilations[i] * (jnp.asarray(w).shape[2 + i] - 1) - pads[i],
+                dilations[i] * (jnp.asarray(w).shape[2 + i] - 1) - pads[i + rank]
+                + output_padding[i])
+               for i in range(rank)]
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1,) * rank, padding=pad_cfg,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, transpose_kernel=True)
+
+
+def _pool(node, inputs, ctx, reducer, init, is_avg=False):
+    x = jnp.asarray(inputs[0])
+    k = node.attr("kernel_shape")
+    rank = len(k)
+    strides = node.attr("strides", [1] * rank)
+    dilations = node.attr("dilations", [1] * rank)
+    auto_pad = node.attr("auto_pad", "NOTSET")
+    pads = _onnx_pads_to_lax(node.attr("pads"), rank, auto_pad,
+                             x.shape[2:], k, strides, dilations)
+    if node.attr("ceil_mode", 0):
+        # grow the trailing pad so the last partial window is included
+        new_pads = []
+        for i in range(rank):
+            eff_k = (k[i] - 1) * dilations[i] + 1
+            span = x.shape[2 + i] + pads[i][0] + pads[i][1] - eff_k
+            rem = span % strides[i]
+            extra = (strides[i] - rem) if rem else 0
+            new_pads.append((pads[i][0], pads[i][1] + extra))
+        pads = new_pads
+    window = (1, 1) + tuple(k)
+    strides_full = (1, 1) + tuple(strides)
+    dil_full = (1, 1) + tuple(dilations)
+    pads_full = [(0, 0), (0, 0)] + list(pads)
+    if is_avg:
+        count_include_pad = node.attr("count_include_pad", 0)
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides_full,
+                                   pads_full, window_dilation=dil_full)
+        if count_include_pad:
+            denom = float(np.prod(k))
+            return summed / denom
+        ones = jnp.ones(x.shape[2:], dtype=x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, tuple(k), tuple(strides),
+                                   pads, window_dilation=tuple(dilations))
+        return summed / counts
+    return lax.reduce_window(x, init, reducer, window, strides_full,
+                             pads_full, window_dilation=dil_full)
+
+
+@register_op("MaxPool")
+def _maxpool(node, inputs, ctx):
+    if len(node.output) > 1:
+        raise UnsupportedOp("MaxPool with Indices output")
+    return _pool(node, inputs, ctx, lax.max, -jnp.inf)
+
+
+@register_op("AveragePool")
+def _avgpool(node, inputs, ctx):
+    return _pool(node, inputs, ctx, lax.add, 0.0, is_avg=True)
+
+
+@register_op("GlobalAveragePool")
+def _gap(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    axes = tuple(range(2, x.ndim))
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+@register_op("GlobalMaxPool")
+def _gmp(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@register_op("LpNormalization")
+def _lpnorm(node, inputs, ctx):
+    x = inputs[0]
+    axis, p = node.attr("axis", -1), node.attr("p", 2)
+    if p == 1:
+        n = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    else:
+        n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return x / jnp.maximum(n, 1e-12)
+
+
+# -- normalization -----------------------------------------------------------
+
+@register_op("BatchNormalization")
+def _batchnorm(node, inputs, ctx):
+    x, scale, bias, mean, var = inputs[:5]
+    eps = node.attr("epsilon", 1e-5)
+    rank = jnp.asarray(x).ndim
+    shape = (1, -1) + (1,) * (rank - 2)
+    inv = lax.rsqrt(jnp.asarray(var, dtype=jnp.float32) + eps).astype(jnp.asarray(x).dtype)
+    return (x - mean.reshape(shape)) * (inv.reshape(shape) * scale.reshape(shape)) \
+        + bias.reshape(shape)
+
+
+@register_op("InstanceNormalization")
+def _instancenorm(node, inputs, ctx):
+    x, scale, bias = inputs
+    eps = node.attr("epsilon", 1e-5)
+    rank = jnp.asarray(x).ndim
+    axes = tuple(range(2, rank))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (rank - 2)
+    return (x - mean) * lax.rsqrt(var + eps) * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op("LayerNormalization")
+def _layernorm(node, inputs, ctx):
+    x = inputs[0]
+    scale = inputs[1]
+    bias = inputs[2] if len(inputs) > 2 and inputs[2] is not None else None
+    axis = node.attr("axis", -1)
+    eps = node.attr("epsilon", 1e-5)
+    rank = jnp.asarray(x).ndim
+    if axis < 0:
+        axis += rank
+    axes = tuple(range(axis, rank))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv * scale
+    if bias is not None:
+        y = y + bias
+    if len(node.output) > 1:
+        return tuple([y, mean, inv][:len(node.output)])
+    return y
+
+
+@register_op("GroupNormalization")
+def _groupnorm(node, inputs, ctx):
+    x, scale, bias = inputs
+    g = node.attr("num_groups")
+    eps = node.attr("epsilon", 1e-5)
+    xs = jnp.asarray(x)
+    n, c = xs.shape[:2]
+    grouped = xs.reshape((n, g, c // g) + xs.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    y = ((grouped - mean) * lax.rsqrt(var + eps)).reshape(xs.shape)
+    shape = (1, -1) + (1,) * (xs.ndim - 2)
+    return y * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op("Softmax")
+def _softmax(node, inputs, ctx):
+    axis = node.attr("axis", -1 if ctx.opset >= 13 else 1)
+    x = inputs[0]
+    if ctx.opset >= 13:
+        return jax.nn.softmax(x, axis=axis)
+    xs = jnp.asarray(x)
+    flat = xs.reshape(int(np.prod(xs.shape[:axis]) or 1), -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(xs.shape)
+
+
+@register_op("LogSoftmax")
+def _logsoftmax(node, inputs, ctx):
+    axis = node.attr("axis", -1 if ctx.opset >= 13 else 1)
+    return jax.nn.log_softmax(inputs[0], axis=axis)
+
+
+# -- reductions --------------------------------------------------------------
+
+def _reduce(jfn, axes_as_input_since: int):
+    def h(node, inputs, ctx):
+        x = inputs[0]
+        axes = None
+        if ctx.opset >= axes_as_input_since and len(inputs) > 1 and inputs[1] is not None:
+            axes = tuple(int(a) for a in _concrete(inputs[1], "reduce axes"))
+        else:
+            a = node.attr("axes")
+            axes = tuple(a) if a else None
+        if axes == ():
+            axes = None
+        keepdims = bool(node.attr("keepdims", 1))
+        if axes is None and node.attr("noop_with_empty_axes", 0):
+            return x
+        return jfn(x, axis=axes, keepdims=keepdims)
+    return h
+
+
+OP_HANDLERS["ReduceSum"] = _reduce(jnp.sum, 13)
+OP_HANDLERS["ReduceMean"] = _reduce(jnp.mean, 18)
+OP_HANDLERS["ReduceMax"] = _reduce(jnp.max, 18)
+OP_HANDLERS["ReduceMin"] = _reduce(jnp.min, 18)
+OP_HANDLERS["ReduceProd"] = _reduce(jnp.prod, 18)
+OP_HANDLERS["ReduceL1"] = _reduce(lambda x, axis, keepdims:
+                                  jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), 18)
+OP_HANDLERS["ReduceL2"] = _reduce(lambda x, axis, keepdims:
+                                  jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)), 18)
+OP_HANDLERS["ReduceSumSquare"] = _reduce(lambda x, axis, keepdims:
+                                         jnp.sum(x * x, axis=axis, keepdims=keepdims), 18)
+OP_HANDLERS["ReduceLogSumExp"] = _reduce(
+    lambda x, axis, keepdims: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims), 18)
+
+
+@register_op("ArgMax")
+def _argmax(node, inputs, ctx):
+    axis = node.attr("axis", 0)
+    out = jnp.argmax(inputs[0], axis=axis)
+    if node.attr("keepdims", 1):
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+@register_op("ArgMin")
+def _argmin(node, inputs, ctx):
+    axis = node.attr("axis", 0)
+    out = jnp.argmin(inputs[0], axis=axis)
+    if node.attr("keepdims", 1):
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+@register_op("TopK")
+def _topk(node, inputs, ctx):
+    k = int(_concrete(inputs[1], "TopK k").ravel()[0])
+    axis = node.attr("axis", -1)
+    largest = node.attr("largest", 1)
+    x = jnp.asarray(inputs[0])
+    x_moved = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(x_moved if largest else -x_moved, k)
+    if not largest:
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+# -- shape ops ---------------------------------------------------------------
+
+@register_op("Shape")
+def _shape(node, inputs, ctx):
+    shape = np.asarray(jnp.asarray(inputs[0]).shape, dtype=np.int64)
+    start = node.attr("start", 0)
+    end = node.attr("end")
+    return shape[start:end if end is not None else len(shape)]
+
+
+@register_op("Size")
+def _size(node, inputs, ctx):
+    return np.asarray(jnp.asarray(inputs[0]).size, dtype=np.int64)
+
+
+@register_op("Reshape")
+def _reshape(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    target = [int(d) for d in _concrete(inputs[1], "Reshape shape").ravel()]
+    if not node.attr("allowzero", 0):
+        target = [x.shape[i] if d == 0 else d for i, d in enumerate(target)]
+    return jnp.reshape(x, target)
+
+
+@register_op("Flatten")
+def _flatten(node, inputs, ctx):
+    axis = node.attr("axis", 1)
+    x = jnp.asarray(inputs[0])
+    if axis < 0:
+        axis += x.ndim
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("Transpose")
+def _transpose(node, inputs, ctx):
+    perm = node.attr("perm")
+    x = jnp.asarray(inputs[0])
+    return jnp.transpose(x, perm if perm else tuple(reversed(range(x.ndim))))
+
+
+@register_op("Squeeze")
+def _squeeze(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    if ctx.opset >= 13 and len(inputs) > 1 and inputs[1] is not None:
+        axes = tuple(int(a) for a in _concrete(inputs[1], "Squeeze axes"))
+    else:
+        a = node.attr("axes")
+        axes = tuple(a) if a else None
+    if axes is None:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis=axes)
+
+
+@register_op("Unsqueeze")
+def _unsqueeze(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    if ctx.opset >= 13 and len(inputs) > 1 and inputs[1] is not None:
+        axes = [int(a) for a in _concrete(inputs[1], "Unsqueeze axes")]
+    else:
+        axes = list(node.attr("axes"))
+    out_rank = x.ndim + len(axes)
+    axes = sorted(a + out_rank if a < 0 else a for a in axes)
+    for a in axes:
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_op("Concat")
+def _concat(node, inputs, ctx):
+    axis = node.attr("axis")
+    if all(isinstance(x, np.ndarray) for x in inputs):
+        return np.concatenate(inputs, axis=axis)
+    return jnp.concatenate(inputs, axis=axis)
+
+
+@register_op("Split")
+def _split(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    axis = node.attr("axis", 0)
+    if len(inputs) > 1 and inputs[1] is not None:
+        sizes = [int(s) for s in _concrete(inputs[1], "Split sizes")]
+    elif node.attr("split"):
+        sizes = list(node.attr("split"))
+    else:
+        n_out = node.attr("num_outputs", len(node.output))
+        dim = x.shape[axis]
+        base = -(-dim // n_out)
+        sizes = [base] * (n_out - 1) + [dim - base * (n_out - 1)]
+    offsets = np.cumsum([0] + sizes)
+    return tuple(lax.slice_in_dim(x, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+                 for i in range(len(sizes)))
+
+
+@register_op("Slice")
+def _slice(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    if ctx.opset >= 10:
+        starts = [int(v) for v in _concrete(inputs[1], "Slice starts")]
+        ends = [int(v) for v in _concrete(inputs[2], "Slice ends")]
+        axes = ([int(v) for v in _concrete(inputs[3], "Slice axes")]
+                if len(inputs) > 3 and inputs[3] is not None else list(range(len(starts))))
+        steps = ([int(v) for v in _concrete(inputs[4], "Slice steps")]
+                 if len(inputs) > 4 and inputs[4] is not None else [1] * len(starts))
+    else:
+        starts = list(node.attr("starts"))
+        ends = list(node.attr("ends"))
+        axes = list(node.attr("axes", range(len(starts))))
+        steps = [1] * len(starts)
+    slices = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        INT_MAX = np.iinfo(np.int64).max
+        en_val = None if en >= INT_MAX // 2 else (None if sp < 0 and en == -INT_MAX - 1 else en)
+        slices[ax] = slice(st, en_val, sp)
+    return x[tuple(slices)]
+
+
+@register_op("Gather")
+def _gather(node, inputs, ctx):
+    axis = node.attr("axis", 0)
+    x, idx = inputs
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+@register_op("GatherElements")
+def _gather_elements(node, inputs, ctx):
+    axis = node.attr("axis", 0)
+    return jnp.take_along_axis(jnp.asarray(inputs[0]), jnp.asarray(inputs[1]),
+                               axis=axis)
+
+
+@register_op("GatherND")
+def _gathernd(node, inputs, ctx):
+    if node.attr("batch_dims", 0):
+        raise UnsupportedOp("GatherND batch_dims")
+    x, idx = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@register_op("ScatterND")
+def _scatternd(node, inputs, ctx):
+    x, idx, upd = (jnp.asarray(v) for v in inputs)
+    return x.at[tuple(jnp.moveaxis(idx, -1, 0))].set(upd)
+
+
+@register_op("Expand")
+def _expand(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    shape = [int(d) for d in _concrete(inputs[1], "Expand shape")]
+    # ONNX Expand uses broadcasting semantics: dims of 1 broadcast, and the
+    # input may have more dims than the target
+    out_shape = list(np.broadcast_shapes(tuple(x.shape), tuple(shape)))
+    return jnp.broadcast_to(x, out_shape)
+
+
+@register_op("Tile")
+def _tile(node, inputs, ctx):
+    reps = [int(r) for r in _concrete(inputs[1], "Tile repeats")]
+    return jnp.tile(jnp.asarray(inputs[0]), reps)
+
+
+@register_op("Pad")
+def _pad(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    mode = node.attr("mode", "constant")
+    if ctx.opset >= 11:
+        pads = [int(p) for p in _concrete(inputs[1], "Pad pads")]
+        cval = (float(np.asarray(_concrete(inputs[2], "Pad value")).ravel()[0])
+                if len(inputs) > 2 and inputs[2] is not None else 0.0)
+        axes = ([int(a) for a in _concrete(inputs[3], "Pad axes")]
+                if len(inputs) > 3 and inputs[3] is not None else list(range(x.ndim)))
+    else:
+        pads = list(node.attr("pads"))
+        cval = node.attr("value", 0.0)
+        axes = list(range(x.ndim))
+    half = len(pads) // 2
+    widths = [(0, 0)] * x.ndim
+    for i, ax in enumerate(axes):
+        widths[ax] = (pads[i], pads[i + half])
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge",
+             "wrap": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=cval)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+@register_op("Resize")
+def _resize(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    mode = node.attr("mode", "nearest")
+    sizes = None
+    if len(inputs) > 3 and inputs[3] is not None:
+        sizes = [int(s) for s in _concrete(inputs[3], "Resize sizes")]
+    elif len(inputs) > 2 and inputs[2] is not None:
+        scales = np.asarray(_concrete(inputs[2], "Resize scales")).ravel()
+        if scales.size:
+            sizes = [int(round(d * s)) for d, s in zip(x.shape, scales)]
+    if sizes is None:
+        raise UnsupportedOp("Resize without sizes/scales")
+    method = {"nearest": "nearest", "linear": "linear", "cubic": "cubic"}[mode]
+    return jax.image.resize(x, sizes, method=method)
+
+
+@register_op("Upsample")
+def _upsample(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    scales = np.asarray(_concrete(inputs[1], "Upsample scales")).ravel() \
+        if len(inputs) > 1 else np.asarray(node.attr("scales"))
+    sizes = [int(round(d * s)) for d, s in zip(x.shape, scales)]
+    method = {"nearest": "nearest", "linear": "linear"}[node.attr("mode", "nearest")]
+    return jax.image.resize(x, sizes, method=method)
+
+
+@register_op("DepthToSpace")
+def _depth_to_space(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    b = node.attr("blocksize")
+    n, c, h, w = x.shape
+    if node.attr("mode", "DCR") == "DCR":
+        y = x.reshape(n, b, b, c // (b * b), h, w)
+        y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    else:
+        y = x.reshape(n, c // (b * b), b, b, h, w)
+        y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("SpaceToDepth")
+def _space_to_depth(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    b = node.attr("blocksize")
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("Constant")
+def _constant(node, inputs, ctx):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints", "value_string", "value_strings"):
+        v = node.attr(key)
+        if v is not None:
+            return np.asarray(v) if not isinstance(v, np.ndarray) else v
+    raise ValueError(f"Constant node {node.name} has no value")
+
+
+@register_op("ConstantOfShape")
+def _constant_of_shape(node, inputs, ctx):
+    shape = [int(d) for d in _concrete(inputs[0], "ConstantOfShape shape")]
+    value = node.attr("value")
+    if value is None:
+        return np.zeros(shape, dtype=np.float32)
+    value = np.asarray(value)
+    return np.full(shape, value.ravel()[0], dtype=value.dtype)
+
+
+@register_op("Range")
+def _range(node, inputs, ctx):
+    s, l, d = (np.asarray(_concrete(v, "Range args")).ravel()[0] for v in inputs)
+    return np.arange(s, l, d)
+
+
+@register_op("OneHot")
+def _onehot(node, inputs, ctx):
+    idx = jnp.asarray(inputs[0])
+    depth = int(np.asarray(_concrete(inputs[1], "OneHot depth")).ravel()[0])
+    values = inputs[2]
+    axis = node.attr("axis", -1)
+    off, on = values[0], values[1]
+    oh = jax.nn.one_hot(jnp.mod(idx, depth), depth, axis=axis)
+    return oh * (on - off) + off
+
+
+@register_op("CumSum")
+def _cumsum(node, inputs, ctx):
+    axis = int(np.asarray(_concrete(inputs[1], "CumSum axis")).ravel()[0])
+    x = jnp.asarray(inputs[0])
+    out = jnp.cumsum(jnp.flip(x, axis) if node.attr("reverse", 0) else x, axis=axis)
+    if node.attr("exclusive", 0):
+        out = jnp.roll(out, 1, axis=axis)
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(0, 1)
+        out = out.at[tuple(idx)].set(0)
+    if node.attr("reverse", 0):
+        out = jnp.flip(out, axis)
+    return out
+
+
+@register_op("Trilu")
+def _trilu(node, inputs, ctx):
+    k = int(np.asarray(_concrete(inputs[1], "Trilu k")).ravel()[0]) \
+        if len(inputs) > 1 and inputs[1] is not None else 0
+    x = jnp.asarray(inputs[0])
+    return jnp.tril(x, k) if node.attr("upper", 1) == 0 else jnp.triu(x, k)
+
+
+@register_op("EyeLike")
+def _eyelike(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    dt = ONNX_TO_NUMPY.get(node.attr("dtype"), x.dtype)
+    return jnp.eye(x.shape[0], x.shape[1], k=node.attr("k", 0), dtype=dt)
+
+
+@register_op("QuantizeLinear")
+def _quantize(node, inputs, ctx):
+    x, scale = inputs[0], inputs[1]
+    zp = inputs[2] if len(inputs) > 2 and inputs[2] is not None else np.int8(0)
+    zp_arr = jnp.asarray(zp)
+    info = jnp.iinfo(zp_arr.dtype)
+    return jnp.clip(jnp.round(x / scale) + zp_arr.astype(jnp.int32),
+                    info.min, info.max).astype(zp_arr.dtype)
+
+
+@register_op("DequantizeLinear")
+def _dequantize(node, inputs, ctx):
+    x, scale = inputs[0], inputs[1]
+    zp = inputs[2] if len(inputs) > 2 and inputs[2] is not None else 0
+    return (jnp.asarray(x).astype(jnp.float32)
+            - jnp.asarray(zp).astype(jnp.float32)) * scale
+
+
+# ---------------------------------------------------------------------------
+# Static (trace-time) evaluation.
+#
+# Under jit, every jnp op is staged — even on constants — so shape arithmetic
+# (Shape → Gather → Concat → Reshape chains that every BERT/ResNet exporter
+# emits) would produce tracers and kill static shapes. Nodes whose inputs are
+# all plain numpy arrays are therefore evaluated with these numpy handlers,
+# keeping the shape pipeline concrete through arbitrary arithmetic.
+# ---------------------------------------------------------------------------
+
+def _np_slice(node, inputs, ctx):
+    x = inputs[0]
+    starts = [int(v) for v in np.ravel(inputs[1])]
+    ends = [int(v) for v in np.ravel(inputs[2])]
+    axes = ([int(v) for v in np.ravel(inputs[3])]
+            if len(inputs) > 3 and inputs[3] is not None else list(range(len(starts))))
+    steps = ([int(v) for v in np.ravel(inputs[4])]
+             if len(inputs) > 4 and inputs[4] is not None else [1] * len(starts))
+    sl = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        sl[ax] = slice(st, None if abs(en) >= np.iinfo(np.int64).max // 2 else en, sp)
+    return x[tuple(sl)]
+
+
+def _np_unsqueeze(node, inputs, ctx):
+    x = inputs[0]
+    axes = ([int(a) for a in np.ravel(inputs[1])] if len(inputs) > 1
+            and inputs[1] is not None else list(node.attr("axes")))
+    out_rank = x.ndim + len(axes)
+    for a in sorted(a + out_rank if a < 0 else a for a in axes):
+        x = np.expand_dims(x, a)
+    return x
+
+
+def _np_squeeze(node, inputs, ctx):
+    x = inputs[0]
+    axes = ([int(a) for a in np.ravel(inputs[1])] if len(inputs) > 1
+            and inputs[1] is not None else node.attr("axes"))
+    return np.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+NUMPY_OPS: Dict[str, Callable] = {
+    "Add": lambda n, i, c: i[0] + i[1],
+    "Sub": lambda n, i, c: i[0] - i[1],
+    "Mul": lambda n, i, c: i[0] * i[1],
+    "Div": lambda n, i, c: (np.trunc(i[0] / i[1]).astype(i[0].dtype)
+                            if i[0].dtype.kind in "iu" else i[0] / i[1]),
+    "Mod": lambda n, i, c: np.mod(i[0], i[1]),
+    "Neg": lambda n, i, c: -i[0],
+    "Abs": lambda n, i, c: np.abs(i[0]),
+    "Min": lambda n, i, c: np.minimum.reduce(i),
+    "Max": lambda n, i, c: np.maximum.reduce(i),
+    "Equal": lambda n, i, c: i[0] == i[1],
+    "Greater": lambda n, i, c: i[0] > i[1],
+    "Less": lambda n, i, c: i[0] < i[1],
+    "Where": lambda n, i, c: np.where(i[0], i[1], i[2]),
+    "Cast": lambda n, i, c: i[0].astype(ONNX_TO_NUMPY[n.attr("to")]),
+    "Concat": lambda n, i, c: np.concatenate(i, axis=n.attr("axis")),
+    "Gather": lambda n, i, c: np.take(i[0], i[1], axis=n.attr("axis", 0)),
+    "Reshape": lambda n, i, c: i[0].reshape(
+        [i[0].shape[k] if d == 0 and not n.attr("allowzero", 0) else d
+         for k, d in enumerate(int(x) for x in np.ravel(i[1]))]),
+    "Transpose": lambda n, i, c: np.transpose(
+        i[0], n.attr("perm") or tuple(reversed(range(i[0].ndim)))),
+    "ReduceProd": lambda n, i, c: np.prod(
+        i[0], axis=tuple(n.attr("axes")) if n.attr("axes") else None,
+        keepdims=bool(n.attr("keepdims", 1))),
+    "ReduceSum": lambda n, i, c: np.sum(
+        i[0],
+        axis=(tuple(int(a) for a in np.ravel(i[1]))
+              if c.opset >= 13 and len(i) > 1 and i[1] is not None
+              else (tuple(n.attr("axes")) if n.attr("axes") else None)),
+        keepdims=bool(n.attr("keepdims", 1))),
+    "Slice": _np_slice,
+    "Unsqueeze": _np_unsqueeze,
+    "Squeeze": _np_squeeze,
+    "Identity": lambda n, i, c: i[0],
+    "Floor": lambda n, i, c: np.floor(i[0]),
+    "Ceil": lambda n, i, c: np.ceil(i[0]),
+    "Sqrt": lambda n, i, c: np.sqrt(i[0]),
+    "Expand": lambda n, i, c: np.broadcast_to(
+        i[0], np.broadcast_shapes(i[0].shape, tuple(int(d) for d in np.ravel(i[1])))),
+    "Tile": lambda n, i, c: np.tile(i[0], [int(r) for r in np.ravel(i[1])]),
+    "Range": lambda n, i, c: np.arange(np.ravel(i[0])[0], np.ravel(i[1])[0],
+                                       np.ravel(i[2])[0]),
+}
+
+
+class _Ctx:
+    def __init__(self, opset: int):
+        self.opset = opset
+
+
+class ConvertedModel:
+    """An ONNX graph compiled to a JAX callable.
+
+    ``fn(params, feeds)`` returns ``{output_name: array}``; ``params`` is the
+    initializer dict so callers can shard/donate/quantize it independently.
+    """
+
+    def __init__(self, model: ModelProto):
+        self.model = model
+        g = model.graph
+        all_inits = {t.name: tensor_to_numpy(t) for t in g.initializers}
+        # Integer/bool initializers are shape constants, axes, split sizes,
+        # gather indices — they must stay concrete at trace time, so they are
+        # baked into the function instead of traveling as (traced) jit args.
+        self.const_params: Dict[str, np.ndarray] = {
+            k: v for k, v in all_inits.items()
+            if v.dtype.kind in "iub" or v.ndim == 0}
+        self.params: Dict[str, np.ndarray] = {
+            k: v for k, v in all_inits.items() if k not in self.const_params}
+        init_names = set(all_inits)
+        self.inputs: List[ValueInfo] = [vi for vi in g.inputs
+                                        if vi.name not in init_names]
+        self.outputs: List[ValueInfo] = list(g.outputs)
+        self.input_names = [vi.name for vi in self.inputs]
+        self.output_names = [vi.name for vi in self.outputs]
+        self._ctx = _Ctx(model.opset)
+
+    def __call__(self, params: Dict[str, np.ndarray],
+                 feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        env: Dict[str, object] = {}
+        env.update(self.const_params)
+        env.update(params)
+        for name, val in feeds.items():
+            env[name] = val
+        env[""] = None
+        for node in self.model.graph.nodes:
+            ins = [env[i] if i else None for i in node.input]
+            np_handler = NUMPY_OPS.get(node.op_type)
+            if np_handler is not None and all(
+                    v is None or isinstance(v, (np.ndarray, np.generic))
+                    for v in ins) and any(v is not None for v in ins):
+                out = np_handler(node, ins, self._ctx)
+            else:
+                handler = OP_HANDLERS.get(node.op_type)
+                if handler is None:
+                    raise UnsupportedOp(
+                        f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
+                        f"supported; {len(OP_HANDLERS)} ops available")
+                out = handler(node, ins, self._ctx)
+            if isinstance(out, tuple):
+                for name, val in zip(node.output, out):
+                    if name:
+                        env[name] = val
+            else:
+                env[node.output[0]] = out
+        missing = [o for o in self.output_names if o not in env]
+        if missing:
+            raise ValueError(f"graph did not produce outputs {missing}")
+        return {o: jnp.asarray(env[o]) for o in self.output_names}
+
+    def jit(self, donate_params: bool = False):
+        return jax.jit(self.__call__,
+                       donate_argnums=(0,) if donate_params else ())
+
+
+def convert_model(model_bytes: bytes) -> ConvertedModel:
+    return ConvertedModel(parse_model(model_bytes))

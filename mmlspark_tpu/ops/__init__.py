@@ -1,0 +1,5 @@
+from .padding import (PaddedBatch, bucket_size, default_buckets, pad_axis,
+                      pad_batch, unpad)
+
+__all__ = ["PaddedBatch", "bucket_size", "default_buckets", "pad_axis",
+           "pad_batch", "unpad"]

@@ -1,0 +1,91 @@
+"""Static-shape discipline: padding, masking, and shape bucketing.
+
+XLA compiles one executable per input shape. The reference tolerates ragged
+batches everywhere (``DynamicMiniBatchTransformer``, variable last batch —
+``stages/MiniBatchTransformer.scala:51-251``); on TPU that would trigger a
+recompile per ragged size. This module gives every device feed a bounded
+shape vocabulary:
+
+* ``bucket_size(n)`` — smallest allowed batch size ≥ n (powers of two by
+  default), so the jit cache holds O(log max_batch) entries, not O(batches).
+* ``pad_batch`` / ``unpad`` — pad rows with zeros + boolean validity mask,
+  with mask-correct semantics left to the consumer (e.g. mean over mask).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["bucket_size", "default_buckets", "pad_batch", "pad_axis", "unpad",
+           "PaddedBatch"]
+
+
+def default_buckets(max_size: int = 1 << 20) -> List[int]:
+    out, b = [], 1
+    while b < max_size:
+        out.append(b)
+        b <<= 1
+    out.append(max_size)
+    return out
+
+
+def bucket_size(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest bucket ≥ n. Default: next power of two."""
+    if n <= 0:
+        return 1
+    if buckets is None:
+        return 1 << (n - 1).bit_length()
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    raise ValueError(f"batch of {n} rows exceeds largest bucket {buckets[-1]}")
+
+
+class PaddedBatch:
+    """A dict of equal-leading-dim arrays padded to a common bucket + mask."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray], mask: np.ndarray, n_valid: int):
+        self.arrays = arrays
+        self.mask = mask
+        self.n_valid = int(n_valid)
+
+    def __getitem__(self, k):
+        return self.arrays[k]
+
+    @property
+    def padded_size(self) -> int:
+        return len(self.mask)
+
+
+def pad_axis(arr: np.ndarray, size: int, axis: int = 0,
+             fill=0) -> np.ndarray:
+    cur = arr.shape[axis]
+    if cur == size:
+        return arr
+    if cur > size:
+        raise ValueError(f"array dim {cur} exceeds pad target {size}")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, size - cur)
+    return np.pad(arr, widths, mode="constant", constant_values=fill)
+
+
+def pad_batch(arrays: Dict[str, np.ndarray],
+              buckets: Optional[Sequence[int]] = None,
+              pad_to: Optional[int] = None) -> PaddedBatch:
+    """Pad every array's leading dim to a shared bucket; returns mask."""
+    sizes = {k: len(v) for k, v in arrays.items()}
+    ns = set(sizes.values())
+    if len(ns) > 1:
+        raise ValueError(f"inconsistent batch sizes: {sizes}")
+    n = ns.pop() if ns else 0
+    target = pad_to if pad_to is not None else bucket_size(n, buckets)
+    padded = {k: pad_axis(np.asarray(v), target) for k, v in arrays.items()}
+    mask = np.zeros(target, dtype=bool)
+    mask[:n] = True
+    return PaddedBatch(padded, mask, n)
+
+
+def unpad(arr: np.ndarray, n_valid: int) -> np.ndarray:
+    return np.asarray(arr)[:n_valid]

@@ -1,0 +1,7 @@
+from .mesh import (MeshContext, data_parallel_sharding, device_for_partition,
+                   get_default_mesh, local_devices, make_mesh,
+                   replicated_sharding, set_default_mesh)
+
+__all__ = ["MeshContext", "make_mesh", "local_devices", "device_for_partition",
+           "data_parallel_sharding", "replicated_sharding",
+           "get_default_mesh", "set_default_mesh"]

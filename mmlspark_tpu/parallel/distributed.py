@@ -1,0 +1,119 @@
+"""Multi-host rendezvous & collectives backend.
+
+Replaces the reference's three hand-rolled TCP mechanisms (SURVEY.md §5):
+driver ServerSocket rendezvous (``LightGBMBase.scala:399-437``), LightGBM's
+native socket ring (``TrainUtils.scala:280-296``), and VW's spanning-tree
+AllReduce (``VowpalWabbitBase.scala:432-460``). On TPU all data-plane
+collectives are XLA over ICI/DCN; the only thing left to bootstrap is world
+membership, which ``jax.distributed.initialize`` handles given a coordinator
+address. A tiny TCP rendezvous helper remains for launchers that have no
+shared env (the moral successor of the driver-socket trick, but control-plane
+only — it never carries tensor data).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+import jax
+
+__all__ = ["initialize", "is_initialized", "world_info",
+           "coordinator_rendezvous", "find_open_port"]
+
+_initialized = False
+
+
+def find_open_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the JAX distributed world (idempotent).
+
+    Resolution order: explicit args → ``MMLSPARK_TPU_COORDINATOR`` env →
+    single-process fallback (no-op).
+    """
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
+    if addr is None:
+        return  # single-process: jax.devices() is already the world
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("MMLSPARK_TPU_NUM_PROCESSES", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("MMLSPARK_TPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def world_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def coordinator_rendezvous(role: str, driver_host: str, driver_port: int,
+                           num_workers: int, timeout_s: float = 120.0) -> str:
+    """Control-plane rendezvous: workers learn the coordinator address.
+
+    ``role='driver'`` hosts a listener that hands every connecting worker the
+    coordinator address (its own host + a fresh port) and returns it;
+    ``role='worker'`` connects and reads it. Mirrors the reference's text
+    protocol of host:port exchange, but only to bootstrap
+    ``jax.distributed`` — no training data ever crosses these sockets.
+    """
+    if role == "driver":
+        coord_port = find_open_port()
+        payload = json.dumps({"coordinator": f"{driver_host}:{coord_port}",
+                              "num_workers": num_workers}).encode()
+
+        def serve():
+            srv = socket.socket()
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((driver_host, driver_port))
+            srv.listen(num_workers)
+            srv.settimeout(timeout_s)
+            served = 0
+            try:
+                while served < num_workers:
+                    conn, _ = srv.accept()
+                    with conn:
+                        conn.sendall(payload)
+                    served += 1
+            finally:
+                srv.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return f"{driver_host}:{coord_port}"
+    # worker
+    deadline = time.monotonic() + timeout_s
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((driver_host, driver_port),
+                                          timeout=5) as s:
+                data = s.recv(4096)
+            return json.loads(data.decode())["coordinator"]
+        except OSError as e:
+            last_err = e
+            time.sleep(0.25)
+    raise TimeoutError(f"rendezvous with {driver_host}:{driver_port} timed "
+                       f"out after {timeout_s}s") from last_err

@@ -1,0 +1,95 @@
+"""Device topology & mesh utilities.
+
+Replaces the reference's cluster-topology discovery + GPU pinning:
+``ClusterUtil`` (``core/utils/ClusterUtil.scala:20-126``) and
+``ONNXModel.selectGpuDevice`` (``deep-learning/.../onnx/ONNXModel.scala:293-303``).
+On TPU the unit of scheduling is the chip within a ``jax.sharding.Mesh``;
+partitions of a DataFrame are pinned round-robin to local chips for
+embarrassingly-parallel inference, while training shards one global batch
+over the mesh with XLA collectives riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["local_devices", "device_for_partition", "make_mesh",
+           "data_parallel_sharding", "replicated_sharding", "MeshContext",
+           "get_default_mesh", "set_default_mesh"]
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def device_for_partition(partition_index: int):
+    """Pin a data partition to a process-local chip, round-robin.
+
+    TPU-native stand-in for ``TaskContext.resources("gpu")`` pinning
+    (``ONNXModel.scala:293-303``).
+    """
+    devs = jax.local_devices()
+    return devs[partition_index % len(devs)]
+
+
+def make_mesh(axis_shapes: Optional[dict] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; -1 means "all remaining devices".
+
+    Default: 1-D data-parallel mesh over every visible device.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_shapes:
+        axis_shapes = {"data": len(devices)}
+    names, sizes = list(axis_shapes.keys()), list(axis_shapes.values())
+    n = len(devices)
+    known = int(np.prod([s for s in sizes if s != -1]))
+    sizes = [s if s != -1 else max(1, n // known) for s in sizes]
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {n}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+class MeshContext:
+    """``with MeshContext({'data': -1}):`` installs a default mesh for stages."""
+
+    def __init__(self, axis_shapes: Optional[dict] = None,
+                 devices: Optional[Sequence] = None):
+        self.mesh = make_mesh(axis_shapes, devices)
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self) -> Mesh:
+        self._prev = get_default_mesh()
+        set_default_mesh(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        set_default_mesh(self._prev)
+        return False

@@ -1,0 +1,121 @@
+"""Long-context attention: ring attention + Ulysses-style all-to-all.
+
+The reference never shards a sequence (SURVEY.md §5 "Long-context … absent");
+its longest-document story is byte-bounded text chunking
+(``featurize/text/PageSplitter.scala``). For a TPU framework long context is
+a first-class design axis, so the mesh layer ships two sequence-parallel
+attention schemes that mount on a ``Mesh`` axis (canonically ``sp``):
+
+* :func:`ring_attention` — K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each chip keeps a flash-style streaming softmax
+  (running max + normalizer), so no chip ever materializes the full S×S
+  score matrix and the sequence scales with the number of chips. Comm rides
+  ICI neighbor links — bandwidth-optimal for 1-D rings.
+* :func:`ulysses_attention` — ``lax.all_to_all`` reshards (seq → heads)
+  before attention and back after, trading one collective for fully local
+  attention; better when heads ≫ ring hops.
+
+Both are pure SPMD functions meant to be used inside ``shard_map``; see
+``wrap_ring_attention`` for the canonical mounting.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "wrap_ring_attention",
+           "local_attention"]
+
+
+def local_attention(q, k, v, scale: Optional[float] = None):
+    """Plain softmax attention, (B, H, S, D) layout, fp32 accumulation."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v, preferred_element_type=v.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, axis_size: int,
+                   scale: Optional[float] = None):
+    """SPMD ring attention over a sequence-sharded axis.
+
+    Args are local shards (B, H, S/n, D). Returns the local output shard.
+    Streaming-softmax accumulators are fp32; K/V rotate ``axis_size`` hops.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    # accumulators must carry the same "varying over axis_name" type as the
+    # rotating K/V blocks for the fori_loop carry to typecheck under shard_map
+    o = lax.pcast(jnp.zeros(q.shape, dtype=jnp.float32), (axis_name,), to='varying')
+    m = lax.pcast(jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32),
+                  (axis_name,), to='varying')
+    l = lax.pcast(jnp.zeros(q.shape[:-1], dtype=jnp.float32), (axis_name,), to='varying')
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
+                       preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_next, v_next
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
+                      scale: Optional[float] = None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
+
+    Local shards are (B, H, S/n, D) with heads replicated; the all-to-all
+    swaps to (B, H/n, S, D) — full sequence, a slice of heads — runs plain
+    attention locally, and swaps back.
+    """
+    def scatter_heads(t):
+        # (B, H, S/n, D) -> (B, H/n, S, D)
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(t):
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = local_attention(qh, kh, vh, scale)
+    return gather_heads(out)
+
+
+def wrap_ring_attention(mesh: Mesh, axis_name: str = "sp",
+                        impl: str = "ring"):
+    """Lift the SPMD kernel to global arrays via shard_map.
+
+    Returns ``fn(q, k, v)`` over global (B, H, S, D) arrays sequence-sharded
+    on ``axis_name``.
+    """
+    n = mesh.shape[axis_name]
+    kernel = ring_attention if impl == "ring" else ulysses_attention
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    def fn(q, k, v):
+        return kernel(q, k, v, axis_name=axis_name, axis_size=n)
+
+    return fn

@@ -1,0 +1,9 @@
+from .batching import (DynamicBufferedBatcher, DynamicMiniBatchTransformer,
+                       FixedMiniBatchTransformer, FlattenBatch, HasMiniBatcher,
+                       TimeIntervalBatcher, TimeIntervalMiniBatchTransformer)
+
+__all__ = [
+    "FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
+    "TimeIntervalMiniBatchTransformer", "FlattenBatch", "HasMiniBatcher",
+    "DynamicBufferedBatcher", "TimeIntervalBatcher",
+]

@@ -1,0 +1,255 @@
+"""Minibatching: the DataFrame → batch → tensor boundary.
+
+Parity surface (reference ``stages/MiniBatchTransformer.scala:17-251`` and
+``stages/Batchers.scala:12-152``):
+
+* ``FixedMiniBatchTransformer`` — groups every ``batch_size`` rows into one
+  batch row whose cells are stacked arrays (the reference transposes
+  rows→columnar batches in ``MiniBatchBase.transform``).
+* ``DynamicMiniBatchTransformer`` — batches whatever is buffered, bounded by
+  ``max_batch_size``; in the eager columnar world this means one batch per
+  partition chunk.
+* ``TimeIntervalMiniBatchTransformer`` — batches a *stream* by wall-clock
+  interval (used by serving); operates on row iterators.
+* ``FlattenBatch`` — the inverse transpose (``MiniBatchTransformer.scala:187-251``).
+* Iterator batchers with a background prefetch thread mirror
+  ``DynamicBufferedBatcher`` (``Batchers.scala:12-56``).
+
+Batched columns are object arrays whose elements are per-batch ndarrays
+(numeric columns) or lists (string/struct columns).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, concat
+from ..core.params import Param, Params
+from ..core.pipeline import Transformer
+
+__all__ = ["FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
+           "TimeIntervalMiniBatchTransformer", "FlattenBatch", "HasMiniBatcher",
+           "DynamicBufferedBatcher", "TimeIntervalBatcher", "batch_slices"]
+
+
+def _stack_cell(col: np.ndarray) -> object:
+    """Rows of one column for one batch → a single batch cell."""
+    if col.dtype == object:
+        vals = list(col)
+        if vals and isinstance(vals[0], np.ndarray):
+            shapes = {v.shape for v in vals}
+            if len(shapes) == 1:
+                return np.stack(vals)
+        return vals
+    return np.asarray(col)
+
+
+def batch_slices(n: int, batch_size: int) -> List[slice]:
+    return [slice(i, min(i + batch_size, n)) for i in range(0, n, batch_size)]
+
+
+class _MiniBatchBase(Transformer):
+    """Shared transpose logic: slices of rows → one batch-row per slice."""
+
+    def _slices(self, part: DataFrame) -> List[slice]:
+        raise NotImplementedError
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        def per_part(part: DataFrame, _i: int) -> DataFrame:
+            slices = self._slices(part)
+            cols: Dict[str, np.ndarray] = {}
+            for name in part.columns:
+                col = part[name]
+                cell = np.empty(len(slices), dtype=object)
+                for j, sl in enumerate(slices):
+                    cell[j] = _stack_cell(col[sl])
+                cols[name] = cell
+            return DataFrame(cols, 1, metadata={c: part.column_metadata(c)
+                                                for c in part.columns})
+
+        return df.map_partitions(per_part)
+
+
+class FixedMiniBatchTransformer(_MiniBatchBase):
+    """Reference: ``FixedMiniBatchTransformer`` (MiniBatchTransformer.scala:151)."""
+
+    batch_size = Param(int, default=10, doc="rows per batch")
+
+    def _slices(self, part: DataFrame) -> List[slice]:
+        return batch_slices(len(part), self.batch_size)
+
+
+class DynamicMiniBatchTransformer(_MiniBatchBase):
+    """Reference: ``DynamicMiniBatchTransformer`` (MiniBatchTransformer.scala:53)."""
+
+    max_batch_size = Param(int, default=1 << 30, doc="upper bound on batch size")
+
+    def _slices(self, part: DataFrame) -> List[slice]:
+        return batch_slices(len(part), min(self.max_batch_size, max(1, len(part))))
+
+
+class TimeIntervalMiniBatchTransformer(_MiniBatchBase):
+    """Reference: ``TimeIntervalMiniBatchTransformer`` (MiniBatchTransformer.scala:77).
+
+    On a materialized DataFrame the wall-clock interval degenerates to one
+    batch per partition; the interval semantics matter on streams — use
+    :class:`TimeIntervalBatcher` for those.
+    """
+
+    millis_to_wait = Param(int, default=1000, doc="batch window in milliseconds")
+    max_batch_size = Param(int, default=1 << 30, doc="upper bound on batch size")
+
+    def _slices(self, part: DataFrame) -> List[slice]:
+        return batch_slices(len(part), min(self.max_batch_size, max(1, len(part))))
+
+
+class FlattenBatch(Transformer):
+    """Inverse transpose (reference ``FlattenBatch``, MiniBatchTransformer.scala:187)."""
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        def per_part(part: DataFrame, _i: int) -> DataFrame:
+            out_cols: Dict[str, List] = {c: [] for c in part.columns}
+            lengths: List[int] = []
+            for bi in range(len(part)):
+                cell_lens = set()
+                for c in part.columns:
+                    cell = part[c][bi]
+                    cell_lens.add(len(cell))
+                if len(cell_lens) != 1:
+                    raise ValueError(
+                        f"ragged batch row {bi}: cell lengths {cell_lens}")
+                lengths.append(cell_lens.pop())
+            for c in part.columns:
+                col = part[c]
+                vals: List = []
+                for bi in range(len(part)):
+                    cell = col[bi]
+                    vals.extend(list(cell))
+                out_cols[c] = vals
+            return DataFrame(out_cols, 1, metadata={c: part.column_metadata(c)
+                                                    for c in part.columns})
+
+        return df.map_partitions(per_part)
+
+
+class HasMiniBatcher(Params):
+    """Reference: ``HasMiniBatcher`` (MiniBatchTransformer.scala:108)."""
+
+    from ..core.params import ComplexParam as _CP
+    mini_batcher = _CP(default=None, doc="minibatch transformer to apply first")
+
+    def get_mini_batcher(self) -> Optional[Transformer]:
+        return self.get_or_none("mini_batcher")
+
+
+# ---------------------------------------------------------------------------
+# Streaming batchers (serving / iterator paths)
+# ---------------------------------------------------------------------------
+
+class DynamicBufferedBatcher:
+    """Background-thread prefetching batcher over a row iterator.
+
+    Reference: ``DynamicBufferedBatcher`` (Batchers.scala:12-56) — a producer
+    thread fills a bounded queue while the consumer drains *everything
+    currently available* into one batch.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterable, max_buffer_size: int = 1024):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
+        self._error: List[BaseException] = []
+
+        def produce():
+            try:
+                for row in it:
+                    self._queue.put(row)
+            except BaseException as e:  # surfaced on the consumer side
+                self._error.append(e)
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+        self._done = False
+
+    def __iter__(self) -> Iterator[List]:
+        while not self._done:
+            first = self._queue.get()
+            if first is self._SENTINEL:
+                self._done = True
+                break
+            batch = [first]
+            while True:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is self._SENTINEL:
+                    self._done = True
+                    break
+                batch.append(nxt)
+            yield batch
+        if self._error:
+            raise self._error[0]
+
+
+class TimeIntervalBatcher:
+    """Wall-clock-windowed batcher (reference ``TimeIntervalBatcher``,
+    Batchers.scala:95-152).
+
+    Consumes its own producer queue with timed ``get`` so a pending batch is
+    flushed when the window elapses even if the source stream stalls.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterable, millis: int = 1000,
+                 max_batch_size: int = 1 << 30, max_buffer_size: int = 1024):
+        self._millis = millis
+        self._max_batch = max_batch_size
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
+        self._error: List[BaseException] = []
+
+        def produce():
+            try:
+                for row in it:
+                    self._queue.put(row)
+            except BaseException as e:
+                self._error.append(e)
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        threading.Thread(target=produce, daemon=True).start()
+
+    def __iter__(self) -> Iterator[List]:
+        pending: List = []
+        window = self._millis / 1e3
+        deadline = time.monotonic() + window
+        done = False
+        while not done:
+            timeout = max(0.0, deadline - time.monotonic())
+            try:
+                item = self._queue.get(timeout=timeout)
+                if item is self._SENTINEL:
+                    done = True
+                else:
+                    pending.append(item)
+            except queue.Empty:
+                pass
+            now = time.monotonic()
+            while len(pending) >= self._max_batch:
+                yield pending[:self._max_batch]
+                pending = pending[self._max_batch:]
+                deadline = now + window
+            if (now >= deadline or done) and pending:
+                yield pending
+                pending = []
+                deadline = now + window
+        if self._error:
+            raise self._error[0]

@@ -8,10 +8,17 @@ here an 8-device virtual CPU mesh stands in for a TPU slice
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The session env pins JAX_PLATFORMS to a real-TPU tunnel platform, and
+# setting JAX_PLATFORMS=cpu via env hangs platform init under it — so drop the
+# var entirely and select cpu through jax.config before any backend spins up.
+os.environ.pop("JAX_PLATFORMS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.ops.padding import bucket_size, pad_batch, unpad
+from mmlspark_tpu.stages.batching import (DynamicBufferedBatcher,
+                                          DynamicMiniBatchTransformer,
+                                          FixedMiniBatchTransformer,
+                                          FlattenBatch, TimeIntervalBatcher)
+
+
+class TestPadding:
+    def test_bucket_size(self):
+        assert bucket_size(0) == 1
+        assert bucket_size(1) == 1
+        assert bucket_size(5) == 8
+        assert bucket_size(8) == 8
+        assert bucket_size(9) == 16
+        assert bucket_size(5, buckets=[4, 6, 10]) == 6
+        with pytest.raises(ValueError):
+            bucket_size(11, buckets=[4, 6, 10])
+
+    def test_pad_batch_and_mask(self):
+        pb = pad_batch({"x": np.ones((5, 3)), "y": np.arange(5)})
+        assert pb["x"].shape == (8, 3)
+        assert pb.mask.sum() == 5
+        assert np.array_equal(unpad(pb["y"], pb.n_valid), np.arange(5))
+        assert pb["x"][5:].sum() == 0
+
+    def test_pad_batch_inconsistent(self):
+        with pytest.raises(ValueError):
+            pad_batch({"x": np.ones(3), "y": np.ones(4)})
+
+
+class TestMiniBatch:
+    def test_fixed_roundtrip(self):
+        df = DataFrame({"x": np.arange(23, dtype=np.float64),
+                        "s": [f"r{i}" for i in range(23)]}, npartitions=2)
+        batched = FixedMiniBatchTransformer(batch_size=10).transform(df)
+        # partition sizes 12 + 11 → batches [10,2] + [10,1]
+        assert len(batched) == 4
+        assert isinstance(batched["x"][0], np.ndarray)
+        assert len(batched["x"][0]) == 10
+        assert isinstance(batched["s"][0], list)
+        flat = FlattenBatch().transform(batched)
+        assert np.array_equal(np.sort(flat["x"]), np.arange(23))
+        assert list(flat["s"][:3]) == ["r0", "r1", "r2"]
+
+    def test_vector_column_stacks(self):
+        df = DataFrame({"v": [np.full(4, i, dtype=np.float32) for i in range(6)]})
+        b = FixedMiniBatchTransformer(batch_size=3).transform(df)
+        assert b["v"][0].shape == (3, 4)
+        flat = FlattenBatch().transform(b)
+        assert flat["v"][5].shape == (4,)
+
+    def test_dynamic(self):
+        df = DataFrame({"x": np.arange(10)}, npartitions=3)
+        b = DynamicMiniBatchTransformer().transform(df)
+        assert len(b) == 3  # one batch per partition
+        b2 = DynamicMiniBatchTransformer(max_batch_size=2).transform(df)
+        assert all(len(cell) <= 2 for cell in b2["x"])
+
+    def test_flatten_ragged_error(self):
+        df = DataFrame({"a": [np.ones(2), np.ones(3)],
+                        "b": [np.ones(2), np.ones(4)]})
+        with pytest.raises(ValueError):
+            FlattenBatch().transform(df)
+
+
+class TestStreamingBatchers:
+    def test_buffered_batcher_all_rows(self):
+        rows = list(range(100))
+        got = [r for batch in DynamicBufferedBatcher(iter(rows)) for r in batch]
+        assert got == rows
+
+    def test_buffered_batcher_propagates_error(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            for _ in DynamicBufferedBatcher(gen()):
+                pass
+
+    def test_time_interval_batcher(self):
+        rows = list(range(50))
+        batches = list(TimeIntervalBatcher(iter(rows), millis=1, max_batch_size=7))
+        assert [r for b in batches for r in b] == rows
+        assert all(len(b) <= 7 for b in batches)
+
+
+class TestMesh:
+    def test_make_mesh_cpu(self):
+        import jax
+        from mmlspark_tpu.parallel import make_mesh
+        n = len(jax.devices())
+        assert n == 8  # conftest forces 8 virtual devices
+        mesh = make_mesh({"data": -1})
+        assert mesh.shape == {"data": 8}
+        mesh2 = make_mesh({"data": 2, "model": -1})
+        assert mesh2.shape == {"data": 2, "model": 4}
+
+    def test_device_for_partition(self):
+        from mmlspark_tpu.parallel import device_for_partition
+        d0 = device_for_partition(0)
+        d8 = device_for_partition(8)
+        assert d0 == d8

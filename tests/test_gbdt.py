@@ -1,0 +1,266 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, PipelineStage
+from mmlspark_tpu.models.gbdt import (BinMapper, Booster, LightGBMClassifier,
+                                      LightGBMRanker, LightGBMRegressor, train)
+
+
+def make_binary(n=600, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, f))
+    logit = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(0, 0.3, n) > 0).astype(np.float64)
+    return X, y
+
+
+class TestBinning:
+    def test_roundtrip_monotone(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(0, 1, (500, 3))
+        bm = BinMapper(max_bin=16).fit(X)
+        xb = bm.transform(X)
+        assert xb.dtype == np.uint8
+        assert xb.min() >= 1  # no missing
+        # binning preserves order within a feature
+        j = 0
+        order = np.argsort(X[:, j])
+        assert (np.diff(xb[order, j].astype(int)) >= 0).all()
+
+    def test_missing_to_bin0(self):
+        X = np.array([[1.0], [np.nan], [2.0]])
+        bm = BinMapper(max_bin=4).fit(X)
+        xb = bm.transform(X)
+        assert xb[1, 0] == 0 and xb[0, 0] >= 1
+
+    def test_threshold_semantics(self):
+        X = np.linspace(0, 1, 100).reshape(-1, 1)
+        bm = BinMapper(max_bin=8).fit(X)
+        xb = bm.transform(X)[:, 0]
+        for b in range(1, xb.max()):
+            t = bm.bin_threshold_value(0, b)
+            lhs = X[:, 0][xb <= b]
+            rhs = X[:, 0][xb > b]
+            assert (lhs <= t + 1e-12).all() and (rhs > t - 1e-12).all()
+
+
+class TestTrainCore:
+    def test_regression_learns(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(0, 1, (800, 5))
+        y = 3 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=800)
+        b = train({"objective": "regression", "num_iterations": 60,
+                   "learning_rate": 0.2, "num_leaves": 15,
+                   "min_data_in_leaf": 5}, X, y)
+        pred = b.predict(X)
+        r2 = 1 - np.var(y - pred) / np.var(y)
+        assert r2 > 0.9, r2
+
+    def test_binary_auc_vs_sklearn(self):
+        from sklearn.ensemble import GradientBoostingClassifier
+        from sklearn.metrics import roc_auc_score
+        X, y = make_binary()
+        Xtr, ytr, Xte, yte = X[:400], y[:400], X[400:], y[400:]
+        b = train({"objective": "binary", "num_iterations": 80,
+                   "learning_rate": 0.15, "num_leaves": 15,
+                   "min_data_in_leaf": 5}, Xtr, ytr)
+        ours = roc_auc_score(yte, b.predict(Xte))
+        skl = GradientBoostingClassifier(n_estimators=80, max_depth=4)
+        skl.fit(Xtr, ytr)
+        theirs = roc_auc_score(yte, skl.predict_proba(Xte)[:, 1])
+        assert ours > 0.9
+        assert ours > theirs - 0.05, (ours, theirs)
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(2)
+        n = 600
+        X = rng.normal(0, 1, (n, 4))
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+        b = train({"objective": "multiclass", "num_class": 3,
+                   "num_iterations": 40, "learning_rate": 0.3,
+                   "num_leaves": 15, "min_data_in_leaf": 5}, X, y)
+        p = b.predict(X)
+        assert p.shape == (n, 3)
+        np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-5)
+        acc = (p.argmax(1) == y).mean()
+        assert acc > 0.9, acc
+
+    def test_early_stopping(self):
+        X, y = make_binary(seed=3)
+        log = []
+        b = train({"objective": "binary", "num_iterations": 200,
+                   "learning_rate": 0.3, "num_leaves": 31,
+                   "early_stopping_round": 5, "metric": "binary_logloss",
+                   "min_data_in_leaf": 2},
+                  X[:300], y[:300], valid_sets=[(X[300:], y[300:])],
+                  eval_log=log)
+        assert b.num_trees < 200
+        assert b.best_iteration > 0
+
+    def test_weights_respected(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(0, 1, (400, 2))
+        y = (X[:, 0] > 0).astype(float)
+        w = np.where(X[:, 1] > 0, 1.0, 1e-6)  # only care about x1>0 rows
+        b = train({"objective": "binary", "num_iterations": 20,
+                   "min_data_in_leaf": 1}, X, y, sample_weight=w)
+        assert b.num_trees == 20
+
+    def test_warm_start_early_stop_keeps_init_trees(self):
+        X, y = make_binary(seed=15)
+        b1 = train({"objective": "binary", "num_iterations": 15,
+                    "min_data_in_leaf": 2}, X[:300], y[:300])
+        b2 = train({"objective": "binary", "num_iterations": 100,
+                    "learning_rate": 0.3, "early_stopping_round": 3,
+                    "min_data_in_leaf": 2},
+                   X[:300], y[:300], init_model=b1,
+                   valid_sets=[(X[300:], y[300:])])
+        assert b2.num_trees >= 15  # init trees never dropped
+        # continued model should not be worse than init alone on train data
+        from sklearn.metrics import roc_auc_score
+        auc1 = roc_auc_score(y[:300], b1.predict(X[:300]))
+        auc2 = roc_auc_score(y[:300], b2.predict(X[:300]))
+        assert auc2 >= auc1 - 0.01
+
+    def test_ranker_non_contiguous_groups_rejected(self):
+        rng = np.random.default_rng(16)
+        X = rng.normal(0, 1, (8, 2))
+        y = rng.integers(0, 3, 8).astype(float)
+        df = DataFrame({"features": [X[i] for i in range(8)], "label": y,
+                        "group": np.array([0, 1, 0, 1, 0, 1, 0, 1])})
+        with pytest.raises(ValueError, match="not contiguous"):
+            LightGBMRanker(num_iterations=2).fit(df)
+
+    def test_warm_start_merge(self):
+        X, y = make_binary(seed=5)
+        b1 = train({"objective": "binary", "num_iterations": 10}, X, y)
+        b2 = train({"objective": "binary", "num_iterations": 10}, X, y,
+                   init_model=b1)
+        assert b2.num_trees == 20
+        s = b2.to_string()
+        b3 = Booster.from_string(s)
+        np.testing.assert_allclose(b2.predict(X[:10]), b3.predict(X[:10]),
+                                   rtol=1e-6)
+
+
+class TestBoosterOutputs:
+    def test_leaf_prediction(self):
+        X, y = make_binary(seed=6)
+        b = train({"objective": "binary", "num_iterations": 5}, X, y)
+        leaves = b.predict_leaf(X[:20])
+        assert leaves.shape == (20, 5)
+        assert leaves.min() >= 0
+
+    def test_shap_sums_to_prediction(self):
+        X, y = make_binary(n=200, seed=7)
+        b = train({"objective": "binary", "num_iterations": 8,
+                   "num_leaves": 7, "min_data_in_leaf": 5}, X, y)
+        sub = X[:32]
+        shap = b.shap_values(sub)
+        assert shap.shape == (32, X.shape[1] + 1)
+        raw = b.predict(sub, raw_score=True)
+        np.testing.assert_allclose(shap.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+    def test_feature_importance(self):
+        X, y = make_binary(seed=8)
+        b = train({"objective": "binary", "num_iterations": 20}, X, y)
+        imp = b.feature_importance("split")
+        assert imp.sum() > 0 and imp[0] > 0
+        gain = b.feature_importance("gain")
+        # x0 is the dominant signal → top total gain
+        assert gain[0] == gain.max()
+
+    def test_nan_handling(self):
+        rng = np.random.default_rng(9)
+        X = rng.normal(0, 1, (400, 3))
+        y = 2 * X[:, 0] + rng.normal(0, 0.1, 400)
+        Xm = X.copy()
+        Xm[::7, 0] = np.nan
+        b = train({"objective": "regression", "num_iterations": 30,
+                   "min_data_in_leaf": 3}, Xm, y)
+        pred = b.predict(Xm)
+        assert np.isfinite(pred).all()
+
+
+class TestDistributed:
+    def test_data_parallel_matches_serial(self):
+        from mmlspark_tpu.parallel import make_mesh
+        X, y = make_binary(n=500, seed=10)
+        params = {"objective": "binary", "num_iterations": 10,
+                  "learning_rate": 0.2, "num_leaves": 15,
+                  "min_data_in_leaf": 5}
+        b_serial = train(dict(params), X, y)
+        mesh = make_mesh({"data": 8})
+        b_dist = train(dict(params, tree_learner="data_parallel"), X, y,
+                       mesh=mesh)
+        np.testing.assert_allclose(b_serial.predict(X[:50]),
+                                   b_dist.predict(X[:50]), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestEstimators:
+    def _df(self, X, y, extra=None):
+        cols = {"features": [X[i] for i in range(len(X))], "label": y}
+        if extra:
+            cols.update(extra)
+        return DataFrame(cols)
+
+    def test_classifier_pipeline(self, tmp_save):
+        X, y = make_binary(seed=11)
+        df = self._df(X, y)
+        clf = LightGBMClassifier(num_iterations=30, num_leaves=15,
+                                 min_data_in_leaf=5)
+        model = clf.fit(df)
+        out = model.transform(df)
+        assert "prediction" in out and "probability" in out
+        acc = (np.asarray(out["prediction"]) == y).mean()
+        assert acc > 0.9
+        p0 = out["probability"][0]
+        assert len(p0) == 2 and abs(p0.sum() - 1) < 1e-6
+        model.save(tmp_save)
+        m2 = PipelineStage.load(tmp_save)
+        out2 = m2.transform(df)
+        np.testing.assert_allclose(np.asarray(out["prediction"]),
+                                   np.asarray(out2["prediction"]))
+
+    def test_regressor_with_shap_cols(self):
+        rng = np.random.default_rng(12)
+        X = rng.normal(0, 1, (300, 4))
+        y = X[:, 0] * 2 + rng.normal(0, 0.1, 300)
+        df = self._df(X, y)
+        reg = LightGBMRegressor(num_iterations=20, min_data_in_leaf=5,
+                                leaf_prediction_col="leaves",
+                                features_shap_col="shap")
+        model = reg.fit(df)
+        out = model.transform(df.head(10))
+        assert len(out["leaves"][0]) == model.booster.num_trees
+        assert len(out["shap"][0]) == 5
+
+    def test_ranker(self):
+        rng = np.random.default_rng(13)
+        n_q, per_q = 30, 10
+        X = rng.normal(0, 1, (n_q * per_q, 4))
+        rel = np.clip((X[:, 0] * 2 + rng.normal(0, 0.5, n_q * per_q)).round(),
+                      0, 3)
+        qid = np.repeat(np.arange(n_q), per_q)
+        df = self._df(X, rel, extra={"group": qid})
+        rk = LightGBMRanker(num_iterations=20, num_leaves=7,
+                            min_data_in_leaf=3)
+        model = rk.fit(df)
+        out = model.transform(df)
+        # predicted order should correlate with relevance
+        from scipy.stats import spearmanr
+        rho = spearmanr(np.asarray(out["prediction"]), rel).statistic
+        assert rho > 0.5, rho
+
+    def test_validation_indicator_early_stop(self):
+        X, y = make_binary(seed=14)
+        is_val = np.zeros(len(y), dtype=bool)
+        is_val[::4] = True
+        df = self._df(X, y, extra={"isVal": is_val})
+        clf = LightGBMClassifier(num_iterations=200, learning_rate=0.3,
+                                 early_stopping_round=5,
+                                 validation_indicator_col="isVal",
+                                 min_data_in_leaf=2)
+        model = clf.fit(df)
+        assert model.booster.num_trees < 200

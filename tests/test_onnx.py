@@ -1,0 +1,269 @@
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx as O
+from mmlspark_tpu.core import DataFrame, PipelineStage
+
+
+def mlp_model(din=8, dhid=16, dout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w1 = rng.normal(0, 0.5, (din, dhid)).astype(np.float32)
+    b1 = rng.normal(0, 0.1, dhid).astype(np.float32)
+    w2 = rng.normal(0, 0.5, (dhid, dout)).astype(np.float32)
+    b2 = rng.normal(0, 0.1, dout).astype(np.float32)
+    nodes = [
+        O.make_node("MatMul", ["x", "w1"], ["h0"]),
+        O.make_node("Add", ["h0", "b1"], ["h1"]),
+        O.make_node("Relu", ["h1"], ["h2"]),
+        O.make_node("Gemm", ["h2", "w2", "b2"], ["logits"], transB=0),
+        O.make_node("Softmax", ["logits"], ["probs"], axis=-1),
+    ]
+    graph = O.make_graph(
+        nodes, "mlp",
+        inputs=[O.make_tensor_value_info("x", np.float32, ["N", din])],
+        outputs=[O.make_tensor_value_info("logits", np.float32, ["N", dout]),
+                 O.make_tensor_value_info("probs", np.float32, ["N", dout])],
+        initializers={"w1": w1, "b1": b1, "w2": w2, "b2": b2})
+    return O.make_model(graph), (w1, b1, w2, b2)
+
+
+class TestWireRoundtrip:
+    def test_parse_built_model(self):
+        data, _ = mlp_model()
+        m = O.parse_model(data)
+        assert m.producer_name == "mmlspark_tpu"
+        assert m.opset == 17
+        g = m.graph
+        assert [n.op_type for n in g.nodes] == ["MatMul", "Add", "Relu", "Gemm",
+                                                "Softmax"]
+        assert len(g.initializers) == 4
+        assert g.inputs[0].name == "x"
+        assert g.inputs[0].shape == ["N", 8]
+        w1 = O.tensor_to_numpy(g.initializers[0])
+        assert w1.shape == (8, 16) and w1.dtype == np.float32
+
+    def test_negative_int_attr(self):
+        n = O.make_node("Softmax", ["x"], ["y"], axis=-1)
+        g = O.make_graph([n], "g",
+                         [O.make_tensor_value_info("x", np.float32, [2, 3])],
+                         [O.make_tensor_value_info("y", np.float32, [2, 3])])
+        m = O.parse_model(O.make_model(g))
+        assert m.graph.nodes[0].attr("axis") == -1
+
+    def test_tensor_dtypes(self):
+        for arr in [np.arange(6, dtype=np.int64).reshape(2, 3),
+                    np.ones((3,), dtype=np.bool_),
+                    np.linspace(0, 1, 4, dtype=np.float64)]:
+            enc = O.make_tensor("t", arr)
+            dec = O.tensor_to_numpy(
+                __import__("mmlspark_tpu.onnx.proto", fromlist=["TensorProto"])
+                .TensorProto.parse(enc.to_bytes()))
+            assert np.array_equal(dec, arr)
+            assert dec.dtype == arr.dtype
+
+
+class TestConverter:
+    def test_mlp_vs_numpy(self):
+        data, (w1, b1, w2, b2) = mlp_model()
+        cm = O.convert_model(data)
+        x = np.random.default_rng(1).normal(0, 1, (5, 8)).astype(np.float32)
+        out = cm(cm.params, {"x": x})
+        ref_h = np.maximum(x @ w1 + b1, 0)
+        ref_logits = ref_h @ w2 + b2
+        np.testing.assert_allclose(np.asarray(out["logits"]), ref_logits,
+                                   rtol=1e-5, atol=1e-5)
+        e = np.exp(ref_logits - ref_logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out["probs"]),
+                                   e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mlp_vs_torch(self):
+        import torch
+        data, (w1, b1, w2, b2) = mlp_model()
+        cm = O.convert_model(data)
+        x = np.random.default_rng(2).normal(0, 1, (4, 8)).astype(np.float32)
+        with torch.no_grad():
+            t = torch.relu(torch.from_numpy(x) @ torch.from_numpy(w1)
+                           + torch.from_numpy(b1))
+            ref = (t @ torch.from_numpy(w2) + torch.from_numpy(b2)).numpy()
+        out = cm(cm.params, {"x": x})
+        np.testing.assert_allclose(np.asarray(out["logits"]), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_conv_block_vs_torch(self):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.default_rng(3)
+        w = rng.normal(0, 0.2, (6, 3, 3, 3)).astype(np.float32)
+        b = rng.normal(0, 0.1, 6).astype(np.float32)
+        gamma = rng.uniform(0.5, 1.5, 6).astype(np.float32)
+        beta = rng.normal(0, 0.1, 6).astype(np.float32)
+        mean = rng.normal(0, 0.1, 6).astype(np.float32)
+        var = rng.uniform(0.5, 1.5, 6).astype(np.float32)
+        nodes = [
+            O.make_node("Conv", ["x", "w", "b"], ["c"], strides=[2, 2],
+                        pads=[1, 1, 1, 1], kernel_shape=[3, 3]),
+            O.make_node("BatchNormalization",
+                        ["c", "gamma", "beta", "mean", "var"], ["bn"],
+                        epsilon=1e-5),
+            O.make_node("Relu", ["bn"], ["r"]),
+            O.make_node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                        strides=[2, 2]),
+            O.make_node("GlobalAveragePool", ["p"], ["g"]),
+            O.make_node("Flatten", ["g"], ["y"], axis=1),
+        ]
+        graph = O.make_graph(
+            nodes, "convnet",
+            [O.make_tensor_value_info("x", np.float32, ["N", 3, 16, 16])],
+            [O.make_tensor_value_info("y", np.float32, ["N", 6])],
+            initializers={"w": w, "b": b, "gamma": gamma, "beta": beta,
+                          "mean": mean, "var": var})
+        cm = O.convert_model(O.make_model(graph))
+        x = rng.normal(0, 1, (2, 3, 16, 16)).astype(np.float32)
+        with torch.no_grad():
+            t = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                         torch.from_numpy(b), stride=2, padding=1)
+            t = F.batch_norm(t, torch.from_numpy(mean), torch.from_numpy(var),
+                             torch.from_numpy(gamma), torch.from_numpy(beta),
+                             eps=1e-5)
+            t = F.relu(t)
+            t = F.max_pool2d(t, 2, 2)
+            ref = t.mean(dim=(2, 3)).numpy()
+        out = cm(cm.params, {"x": x})
+        np.testing.assert_allclose(np.asarray(out["y"]), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_shape_arithmetic_jits(self):
+        import jax
+        # BERT-style: y = reshape(x, [Shape(x)[0], -1]) then layernorm
+        rng = np.random.default_rng(4)
+        scale = np.ones(12, dtype=np.float32)
+        bias = np.zeros(12, dtype=np.float32)
+        nodes = [
+            O.make_node("Shape", ["x"], ["shp"]),
+            O.make_node("Gather", ["shp", "zero"], ["n"], axis=0),
+            O.make_node("Unsqueeze", ["n", "zero_axes"], ["n1"]),
+            O.make_node("Concat", ["n1", "negone"], ["target"], axis=0),
+            O.make_node("Reshape", ["x", "target"], ["flat"]),
+            O.make_node("LayerNormalization", ["flat", "scale", "bias"], ["y"],
+                        axis=-1, epsilon=1e-5),
+        ]
+        graph = O.make_graph(
+            nodes, "shapes",
+            [O.make_tensor_value_info("x", np.float32, ["N", 3, 4])],
+            [O.make_tensor_value_info("y", np.float32, ["N", 12])],
+            initializers={"zero": np.array(0, dtype=np.int64),
+                          "zero_axes": np.array([0], dtype=np.int64),
+                          "negone": np.array([-1], dtype=np.int64),
+                          "scale": scale, "bias": bias})
+        cm = O.convert_model(O.make_model(graph))
+        x = rng.normal(0, 1, (5, 3, 4)).astype(np.float32)
+        jitted = jax.jit(cm.__call__)
+        out = jitted(cm.params, {"x": x})
+        flat = x.reshape(5, 12)
+        ref = (flat - flat.mean(-1, keepdims=True)) / np.sqrt(
+            flat.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out["y"]), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_ops_misc_vs_numpy(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 1, (3, 4, 5)).astype(np.float32)
+        cases = [
+            (O.make_node("Transpose", ["x"], ["y"], perm=[2, 0, 1]),
+             np.transpose(x, (2, 0, 1))),
+            (O.make_node("ReduceMean", ["x"], ["y"], axes=[1], keepdims=0),
+             x.mean(axis=1)),
+            (O.make_node("Sigmoid", ["x"], ["y"]), 1 / (1 + np.exp(-x))),
+            (O.make_node("Clip", ["x"], ["y"]), x),
+        ]
+        for node, expected in cases:
+            g = O.make_graph(
+                [node], "t",
+                [O.make_tensor_value_info("x", np.float32, list(x.shape))],
+                [O.make_tensor_value_info("y", np.float32, None or [])])
+            cm = O.convert_model(O.make_model(g))
+            out = cm(cm.params, {"x": x})
+            np.testing.assert_allclose(np.asarray(out["y"]), expected,
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_slice_gather_concat(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        nodes = [
+            O.make_node("Slice", ["x", "starts", "ends", "axes"], ["s"]),
+            O.make_node("Gather", ["x", "idx"], ["g"], axis=2),
+            O.make_node("Concat", ["s", "s"], ["c"], axis=0),
+        ]
+        g = O.make_graph(
+            nodes, "t",
+            [O.make_tensor_value_info("x", np.float32, [2, 3, 4])],
+            [O.make_tensor_value_info("s", np.float32, []),
+             O.make_tensor_value_info("g", np.float32, []),
+             O.make_tensor_value_info("c", np.float32, [])],
+            initializers={"starts": np.array([1], dtype=np.int64),
+                          "ends": np.array([3], dtype=np.int64),
+                          "axes": np.array([1], dtype=np.int64),
+                          "idx": np.array([0, 3], dtype=np.int64)})
+        cm = O.convert_model(O.make_model(g))
+        out = cm(cm.params, {"x": x})
+        np.testing.assert_array_equal(np.asarray(out["s"]), x[:, 1:3])
+        np.testing.assert_array_equal(np.asarray(out["g"]), x[:, :, [0, 3]])
+        np.testing.assert_array_equal(np.asarray(out["c"]),
+                                      np.concatenate([x[:, 1:3]] * 2, axis=0))
+
+    def test_unsupported_op_message(self):
+        g = O.make_graph(
+            [O.make_node("FancyNewOp", ["x"], ["y"])], "t",
+            [O.make_tensor_value_info("x", np.float32, [1])],
+            [O.make_tensor_value_info("y", np.float32, [1])])
+        cm = O.convert_model(O.make_model(g))
+        with pytest.raises(NotImplementedError, match="FancyNewOp"):
+            cm(cm.params, {"x": np.zeros(1, dtype=np.float32)})
+
+
+class TestONNXModelTransformer:
+    def test_transform_with_post_ops(self):
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        data, (w1, b1, w2, b2) = mlp_model()
+        rng = np.random.default_rng(7)
+        X = rng.normal(0, 1, (37, 8)).astype(np.float32)
+        df = DataFrame({"feats": [X[i] for i in range(len(X))]}, npartitions=3)
+        m = ONNXModel(data,
+                      feed_dict={"x": "feats"},
+                      fetch_dict={"logits_col": "logits"},
+                      mini_batch_size=16,
+                      softmax_dict={"probs_col": "logits_col"},
+                      argmax_dict={"pred": "logits_col"})
+        out = m.transform(df)
+        assert len(out) == 37
+        ref_logits = np.maximum(X @ w1 + b1, 0) @ w2 + b2
+        got = np.stack(list(out["logits_col"]))
+        np.testing.assert_allclose(got, ref_logits, rtol=1e-4, atol=1e-4)
+        preds = out["pred"]
+        np.testing.assert_array_equal(preds, ref_logits.argmax(1))
+        p0 = out["probs_col"][0]
+        assert abs(p0.sum() - 1.0) < 1e-6
+
+    def test_save_load(self, tmp_save):
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        data, _ = mlp_model()
+        m = ONNXModel(data, feed_dict={"x": "feats"},
+                      fetch_dict={"out": "logits"}, mini_batch_size=8)
+        m.save(tmp_save)
+        m2 = PipelineStage.load(tmp_save)
+        rng = np.random.default_rng(8)
+        X = rng.normal(0, 1, (5, 8)).astype(np.float32)
+        df = DataFrame({"feats": [X[i] for i in range(5)]})
+        o1 = np.stack(list(m.transform(df)["out"]))
+        o2 = np.stack(list(m2.transform(df)["out"]))
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    def test_metadata_without_session(self):
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        data, _ = mlp_model()
+        m = ONNXModel(data)
+        ins = m.model_inputs()
+        outs = m.model_outputs()
+        assert list(ins) == ["x"]
+        assert ins["x"][1] == ("N", 8)
+        assert set(outs) == {"logits", "probs"}

@@ -1,0 +1,123 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TestResNet:
+    def test_forward_shapes_small(self):
+        from mmlspark_tpu.models.zoo.resnet import (ResNetConfig, init_resnet,
+                                                    resnet_apply)
+        cfg = ResNetConfig([1, 1], num_classes=7, width=8, dtype=jnp.float32)
+        params = init_resnet(cfg, seed=0)
+        x = np.random.default_rng(0).normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+        logits = resnet_apply(params, jnp.asarray(x), cfg)
+        assert logits.shape == (2, 7)
+        feats = resnet_apply(params, jnp.asarray(x), cfg, features_only=True)
+        assert feats.shape[0] == 2
+
+    def test_onnx_export_matches_native(self):
+        """The NCHW ONNX export and the native NHWC path agree numerically."""
+        from mmlspark_tpu.models.zoo.resnet import (ResNetConfig,
+                                                    export_resnet_onnx,
+                                                    init_resnet, resnet_apply)
+        from mmlspark_tpu.onnx import convert_model
+        cfg = ResNetConfig([1, 1], num_classes=5, width=8, dtype=jnp.float32)
+        params = init_resnet(cfg, seed=1)
+        onnx_bytes = export_resnet_onnx(cfg, params=params, input_size=32)
+        cm = convert_model(onnx_bytes)
+        x = np.random.default_rng(1).normal(0, 1, (2, 3, 32, 32)).astype(np.float32)
+        out = cm(cm.params, {"input": x})
+        native = resnet_apply(params, jnp.asarray(np.transpose(x, (0, 2, 3, 1))),
+                              cfg)
+        np.testing.assert_allclose(np.asarray(out["logits"]),
+                                   np.asarray(native), rtol=2e-3, atol=2e-3)
+
+
+class TestTransformer:
+    def test_forward_and_train_step_single(self):
+        from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                         init_transformer,
+                                                         train_step)
+        cfg = TransformerConfig(vocab=64, layers=2, d_model=32, heads=4,
+                                d_ff=64, max_len=16, dtype=jnp.float32)
+        params = init_transformer(cfg)
+        opt = jax.tree.map(jnp.zeros_like, params)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 64, (4, 12))
+        labels = rng.integers(0, 64, (4, 12))
+        step = jax.jit(functools.partial(train_step, cfg=cfg))
+        p2, o2, loss = step(params, opt, ids, labels)
+        assert np.isfinite(float(loss))
+        # loss decreases over a few steps on a fixed batch
+        for _ in range(5):
+            p2, o2, loss2 = step(p2, o2, ids, labels)
+        assert float(loss2) < float(loss)
+
+    def test_sharded_matches_unsharded(self):
+        from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                         init_transformer,
+                                                         shardings_for,
+                                                         transformer_apply)
+        cfg = TransformerConfig(vocab=32, layers=1, d_model=32, heads=4,
+                                d_ff=64, max_len=16, dtype=jnp.float32)
+        params = init_transformer(cfg)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 32, (4, 8))
+        ref = transformer_apply(params, jnp.asarray(ids), cfg)
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(2, 2), ("dp", "tp"))
+        sharded_params = jax.device_put(params, shardings_for(params, mesh))
+        ids_s = jax.device_put(jnp.asarray(ids), NamedSharding(mesh, P("dp", None)))
+        out = jax.jit(functools.partial(transformer_apply, cfg=cfg, mesh=mesh))(
+            sharded_params, ids_s)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestRingAttention:
+    def _qkv(self, B=2, H=4, S=32, D=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
+                for _ in range(3))
+
+    def test_ring_matches_local(self):
+        from mmlspark_tpu.parallel.mesh import make_mesh
+        from mmlspark_tpu.parallel.ring import (local_attention,
+                                                wrap_ring_attention)
+        q, k, v = self._qkv()
+        ref = local_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        mesh = make_mesh({"sp": 8})
+        fn = wrap_ring_attention(mesh, "sp", impl="ring")
+        out = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ulysses_matches_local(self):
+        from mmlspark_tpu.parallel.mesh import make_mesh
+        from mmlspark_tpu.parallel.ring import (local_attention,
+                                                wrap_ring_attention)
+        q, k, v = self._qkv(H=8, seed=2)
+        ref = local_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        mesh = make_mesh({"sp": 8})
+        fn = wrap_ring_attention(mesh, "sp", impl="ulysses")
+        out = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import sys
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as ge
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        assert np.asarray(out).shape == (8, 1000)
+
+    def test_dryrun_multichip(self):
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(8)
