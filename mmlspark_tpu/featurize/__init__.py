@@ -1,0 +1,17 @@
+from .clean_missing import CleanMissingData, CleanMissingDataModel
+from .count_selector import CountSelector, CountSelectorModel
+from .data_conversion import DataConversion
+from .featurize import Featurize, FeaturizeModel
+from .text import (IDF, HashingTF, IDFModel, MultiNGram, NGram, PageSplitter,
+                   TextFeaturizer, TextFeaturizerModel, Tokenizer)
+from .value_indexer import IndexToValue, ValueIndexer, ValueIndexerModel
+
+__all__ = [
+    "CleanMissingData", "CleanMissingDataModel",
+    "CountSelector", "CountSelectorModel",
+    "DataConversion",
+    "Featurize", "FeaturizeModel",
+    "ValueIndexer", "ValueIndexerModel", "IndexToValue",
+    "Tokenizer", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
+    "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
+]

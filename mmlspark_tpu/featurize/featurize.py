@@ -1,0 +1,124 @@
+"""Automatic featurization.
+
+Parity surface: ``Featurize`` (reference
+``core/.../featurize/Featurize.scala:37``): inspect each input column's type
+and assemble a per-type sub-pipeline (impute numerics, index/one-hot
+categoricals, hash text), concatenating everything into one dense features
+vector — the column every trainer consumes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import HasInputCols, HasOutputCol, Param
+from ..core.pipeline import Estimator, Model
+from ..core.schema import get_categorical_levels
+
+__all__ = ["Featurize", "FeaturizeModel"]
+
+
+def _is_numeric(col: np.ndarray) -> bool:
+    return col.dtype != object and np.issubdtype(col.dtype, np.number)
+
+
+def _is_text(col: np.ndarray) -> bool:
+    return col.dtype == object and len(col) > 0 and isinstance(col[0], str)
+
+
+def _is_vector(col: np.ndarray) -> bool:
+    if col.dtype == object:
+        return len(col) > 0 and isinstance(col[0], (np.ndarray, list, tuple))
+    return col.ndim > 1
+
+
+class Featurize(Estimator, HasInputCols, HasOutputCol):
+    one_hot_encode_categoricals = Param(bool, default=True,
+                                        doc="one-hot string/categorical columns")
+    num_features = Param(int, default=1 << 8,
+                         doc="hash space for high-cardinality text")
+    impute_missing = Param(bool, default=True, doc="mean-impute numeric NaNs")
+
+    def __init__(self, input_cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        self._set_default(output_col="features")
+        if input_cols is not None:
+            self.set(input_cols=list(input_cols))
+
+    def _fit(self, df: DataFrame) -> "FeaturizeModel":
+        plans: List[dict] = []
+        for c in self.get("input_cols"):
+            col = df[c]
+            if _is_vector(col):
+                plans.append({"col": c, "kind": "vector"})
+            elif _is_numeric(col):
+                fill = None
+                if self.get("impute_missing"):
+                    f = col.astype(np.float64)
+                    fill = float(np.nanmean(f)) if np.isnan(f).any() else 0.0
+                plans.append({"col": c, "kind": "numeric", "fill": fill})
+            elif _is_text(col):
+                levels = get_categorical_levels(df, c)
+                if levels is None:
+                    levels = sorted({str(v) for v in col})
+                if (self.get("one_hot_encode_categoricals")
+                        and len(levels) <= self.get("num_features")):
+                    plans.append({"col": c, "kind": "onehot",
+                                  "levels": [str(l) for l in levels]})
+                else:
+                    plans.append({"col": c, "kind": "hash",
+                                  "n": self.get("num_features")})
+            else:
+                raise TypeError(f"cannot featurize column {c!r} of "
+                                f"type {df.schema()[c]}")
+        m = FeaturizeModel()
+        m.set(input_cols=self.get("input_cols"), output_col=self.get("output_col"),
+              plans=plans)
+        return m
+
+
+class FeaturizeModel(Model, HasInputCols, HasOutputCol):
+    plans = Param(list, default=[], doc="per-column featurization plan")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        from .text import _fnv1a
+        parts: List[np.ndarray] = []
+        n = len(df)
+        for plan in self.get("plans"):
+            col = df[plan["col"]]
+            kind = plan["kind"]
+            if kind == "vector":
+                if col.dtype == object:
+                    part = np.stack([np.asarray(v, dtype=np.float64).ravel()
+                                     for v in col])
+                else:
+                    part = np.asarray(col, dtype=np.float64).reshape(n, -1)
+            elif kind == "numeric":
+                part = col.astype(np.float64)[:, None].copy()
+                if plan["fill"] is not None:
+                    part[np.isnan(part)] = plan["fill"]
+            elif kind == "onehot":
+                levels = plan["levels"]
+                table = {v: i for i, v in enumerate(levels)}
+                part = np.zeros((n, len(levels)))
+                for i, v in enumerate(col):
+                    j = table.get(str(v))
+                    if j is not None:
+                        part[i, j] = 1.0
+            elif kind == "hash":
+                nf = plan["n"]
+                part = np.zeros((n, nf))
+                for i, v in enumerate(col):
+                    for tok in str(v).lower().split():
+                        part[i, _fnv1a(tok, nf)] += 1.0
+            else:
+                raise ValueError(f"unknown plan kind {kind!r}")
+            parts.append(part)
+        X = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = X[i]
+        return df.with_column(self.get("output_col"), out)

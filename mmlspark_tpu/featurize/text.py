@@ -1,0 +1,203 @@
+"""Text featurization.
+
+Parity surface: ``TextFeaturizer:197`` (tokenize → n-grams → hashing TF →
+IDF), ``MultiNGram:25`` (several n-gram widths concatenated), ``PageSplitter:23``
+(split documents into byte-bounded pages) — reference
+``core/.../featurize/text/*.scala``. The hashing-TF → IDF product is a dense
+matmul-shaped op, so fitted transforms stay vectorized numpy feeding the
+device path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Estimator, Model, Transformer
+
+__all__ = ["Tokenizer", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
+           "TextFeaturizer", "TextFeaturizerModel", "PageSplitter"]
+
+
+def _fnv1a(token: str, n_features: int) -> int:
+    h = 0x811C9DC5
+    for b in token.encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h % n_features
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    pattern = Param(str, default=r"\s+", doc="split regex")
+    to_lowercase = Param(bool, default=True, doc="lowercase before split")
+    min_token_length = Param(int, default=1, doc="drop shorter tokens")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        rx = re.compile(self.get("pattern"))
+        out = np.empty(len(df), dtype=object)
+        for i, text in enumerate(df[self.get("input_col")]):
+            t = str(text)
+            if self.get("to_lowercase"):
+                t = t.lower()
+            out[i] = [tok for tok in rx.split(t)
+                      if len(tok) >= self.get("min_token_length")]
+        return df.with_column(self.get("output_col"), out)
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol):
+    n = Param(int, default=2, doc="gram width")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n = self.get("n")
+        out = np.empty(len(df), dtype=object)
+        for i, toks in enumerate(df[self.get("input_col")]):
+            out[i] = [" ".join(toks[j:j + n]) for j in range(len(toks) - n + 1)]
+        return df.with_column(self.get("output_col"), out)
+
+
+class MultiNGram(Transformer, HasInputCol, HasOutputCol):
+    """Concatenate n-grams for several widths
+    (reference ``featurize/text/MultiNGram.scala:25``)."""
+
+    lengths = Param((list, int), default=[1, 2, 3], doc="gram widths")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        widths = self.get("lengths")
+        out = np.empty(len(df), dtype=object)
+        for i, toks in enumerate(df[self.get("input_col")]):
+            grams: List[str] = []
+            for n in widths:
+                grams.extend(" ".join(toks[j:j + n])
+                             for j in range(len(toks) - n + 1))
+            out[i] = grams
+        return df.with_column(self.get("output_col"), out)
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol):
+    num_features = Param(int, default=1 << 18, doc="hash space size")
+    binary = Param(bool, default=False, doc="presence instead of counts")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n = self.get("num_features")
+        out = np.empty(len(df), dtype=object)
+        for i, toks in enumerate(df[self.get("input_col")]):
+            vec = np.zeros(n, dtype=np.float32)
+            for tok in toks:
+                vec[_fnv1a(tok, n)] += 1.0
+            if self.get("binary"):
+                vec = (vec > 0).astype(np.float32)
+            out[i] = vec
+        return df.with_column(self.get("output_col"), out)
+
+
+class IDF(Estimator, HasInputCol, HasOutputCol):
+    min_doc_freq = Param(int, default=0, doc="zero out rare terms")
+
+    def _fit(self, df: DataFrame) -> "IDFModel":
+        col = df[self.get("input_col")]
+        X = np.stack([np.asarray(v, dtype=np.float64) for v in col])
+        docfreq = (X > 0).sum(axis=0)
+        n = len(X)
+        idf = np.log((n + 1.0) / (docfreq + 1.0))
+        idf[docfreq < self.get("min_doc_freq")] = 0.0
+        m = IDFModel()
+        m.set(input_col=self.get("input_col"), output_col=self.get("output_col"),
+              idf=idf.astype(np.float32))
+        return m
+
+
+class IDFModel(Model, HasInputCol, HasOutputCol):
+    from ..core.params import ComplexParam as _CP
+    idf = _CP(default=None, doc="per-slot idf weights")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        idf = np.asarray(self.get("idf"))
+        col = df[self.get("input_col")]
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = (np.asarray(v, dtype=np.float32) * idf)
+        return df.with_column(self.get("output_col"), out)
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    """Composed tokenize → [n-gram] → hashing TF → [IDF] pipeline
+    (reference ``featurize/text/TextFeaturizer.scala:197``)."""
+
+    use_tokenizer = Param(bool, default=True, doc="split text into tokens")
+    tokenizer_pattern = Param(str, default=r"\s+", doc="split regex")
+    to_lowercase = Param(bool, default=True, doc="lowercase text")
+    use_ngram = Param(bool, default=False, doc="insert an n-gram stage")
+    n_gram_length = Param(int, default=2, doc="gram width")
+    num_features = Param(int, default=1 << 18, doc="hash space size")
+    binary = Param(bool, default=False, doc="binary term counts")
+    use_idf = Param(bool, default=True, doc="apply inverse document frequency")
+    min_doc_freq = Param(int, default=1, doc="IDF min document frequency")
+
+    def _fit(self, df: DataFrame) -> "TextFeaturizerModel":
+        from ..core.pipeline import Pipeline
+        inp, outp = self.get("input_col"), self.get("output_col")
+        stages: List = []
+        cur = inp
+        if self.get("use_tokenizer"):
+            stages.append(Tokenizer(input_col=cur, output_col="_tf_tokens",
+                                    pattern=self.get("tokenizer_pattern"),
+                                    to_lowercase=self.get("to_lowercase")))
+            cur = "_tf_tokens"
+        if self.get("use_ngram"):
+            stages.append(NGram(input_col=cur, output_col="_tf_ngrams",
+                                n=self.get("n_gram_length")))
+            cur = "_tf_ngrams"
+        tf_out = "_tf_counts" if self.get("use_idf") else outp
+        stages.append(HashingTF(input_col=cur, output_col=tf_out,
+                                num_features=self.get("num_features"),
+                                binary=self.get("binary")))
+        if self.get("use_idf"):
+            stages.append(IDF(input_col=tf_out, output_col=outp,
+                              min_doc_freq=self.get("min_doc_freq")))
+        pipeline_model = Pipeline(stages).fit(df)
+        m = TextFeaturizerModel()
+        m.set(input_col=inp, output_col=outp, pipeline=pipeline_model)
+        return m
+
+
+class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
+    from ..core.params import ComplexParam as _CP
+    pipeline = _CP(default=None, doc="fitted internal pipeline")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        out = self.get("pipeline").transform(df)
+        return out.drop("_tf_tokens", "_tf_ngrams", "_tf_counts")
+
+
+class PageSplitter(Transformer, HasInputCol, HasOutputCol):
+    """Split documents into byte-bounded pages on whitespace/word boundaries
+    (reference ``featurize/text/PageSplitter.scala:23``)."""
+
+    maximum_page_length = Param(int, default=5000, doc="max bytes per page")
+    minimum_page_length = Param(int, default=4500,
+                                doc="prefer boundaries after this many bytes")
+    boundary_regex = Param(str, default=r"\s", doc="soft break pattern")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        lo, hi = self.get("minimum_page_length"), self.get("maximum_page_length")
+        rx = re.compile(self.get("boundary_regex"))
+        out = np.empty(len(df), dtype=object)
+        for i, text in enumerate(df[self.get("input_col")]):
+            t = str(text)
+            pages, start = [], 0
+            while start < len(t):
+                if len(t) - start <= hi:
+                    pages.append(t[start:])
+                    break
+                window = t[start + lo:start + hi]
+                soft = None
+                for mm in rx.finditer(window):
+                    soft = mm.end()
+                cut = start + lo + soft if soft is not None else start + hi
+                pages.append(t[start:cut])
+                start = cut
+            out[i] = pages
+        return df.with_column(self.get("output_col"), out)

@@ -1,9 +1,20 @@
 from .batching import (DynamicBufferedBatcher, DynamicMiniBatchTransformer,
                        FixedMiniBatchTransformer, FlattenBatch, HasMiniBatcher,
                        TimeIntervalBatcher, TimeIntervalMiniBatchTransformer)
+from .misc import (Cacher, ClassBalancer, ClassBalancerModel, DropColumns,
+                   EnsembleByKey, Explode, Lambda, MultiColumnAdapter,
+                   PartitionConsolidator, RenameColumn, Repartition,
+                   SelectColumns, StratifiedRepartition, SummarizeData,
+                   TextPreprocessor, Timer, TimerModel, UDFTransformer,
+                   UnicodeNormalize)
 
 __all__ = [
     "FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
     "TimeIntervalMiniBatchTransformer", "FlattenBatch", "HasMiniBatcher",
     "DynamicBufferedBatcher", "TimeIntervalBatcher",
+    "Cacher", "DropColumns", "SelectColumns", "RenameColumn", "Repartition",
+    "Explode", "Lambda", "UDFTransformer", "MultiColumnAdapter",
+    "ClassBalancer", "ClassBalancerModel", "EnsembleByKey",
+    "StratifiedRepartition", "SummarizeData", "TextPreprocessor",
+    "UnicodeNormalize", "Timer", "TimerModel", "PartitionConsolidator",
 ]

@@ -1,0 +1,13 @@
+from .async_utils import buffered_await, map_buffered
+from .cluster import (device_for_partition, get_driver_host, global_devices,
+                      local_devices, num_processes, num_tasks, process_index)
+from .fault import retry_with_backoff, retry_with_timeout
+from .shared import SharedSingleton, SharedVariable, StopWatch
+
+__all__ = [
+    "buffered_await", "map_buffered",
+    "num_processes", "process_index", "local_devices", "global_devices",
+    "num_tasks", "get_driver_host", "device_for_partition",
+    "retry_with_timeout", "retry_with_backoff",
+    "SharedVariable", "SharedSingleton", "StopWatch",
+]

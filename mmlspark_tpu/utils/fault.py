@@ -1,0 +1,51 @@
+"""Retry / timeout helpers.
+
+Parity surface: ``FaultToleranceUtils.retryWithTimeout``
+(``core/.../core/utils/FaultToleranceUtils.scala:10-22``) and the exponential
+backoff used around LightGBM network init (``TrainUtils.scala:280-296``,
+constants ``LightGBMConstants.scala:49-56``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["retry_with_timeout", "retry_with_backoff"]
+
+DEFAULT_WAITS_MS = (0, 100, 500, 1000, 3000, 5000)
+
+
+def retry_with_timeout(fn: Callable[[], T], timeout_s: float,
+                       retries: int = 3) -> T:
+    """Run ``fn`` with a wall-clock timeout, retrying on failure/timeout."""
+    err: Optional[Exception] = None
+    for _ in range(max(1, retries)):
+        # No context manager: `with` would block in shutdown(wait=True) until
+        # a hung fn returns, defeating the timeout entirely.
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = ex.submit(fn)
+        try:
+            return fut.result(timeout=timeout_s)
+        except Exception as e:  # noqa: BLE001 — retry ladder
+            err = e
+        finally:
+            ex.shutdown(wait=False, cancel_futures=True)
+    raise err  # type: ignore[misc]
+
+
+def retry_with_backoff(fn: Callable[[], T],
+                       waits_ms: Sequence[int] = DEFAULT_WAITS_MS) -> T:
+    """Retry with fixed backoff schedule (reference default waits)."""
+    err: Optional[Exception] = None
+    for wait in waits_ms:
+        if wait:
+            time.sleep(wait / 1e3)
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            err = e
+    raise err  # type: ignore[misc]

@@ -1,0 +1,125 @@
+"""Tests for the featurize package (reference: featurize/* test suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.featurize import (IDF, CleanMissingData, CountSelector,
+                                    DataConversion, Featurize, HashingTF,
+                                    IndexToValue, MultiNGram, PageSplitter,
+                                    TextFeaturizer, Tokenizer, ValueIndexer)
+
+
+def test_clean_missing_mean():
+    df = DataFrame({"x": [1.0, np.nan, 3.0]})
+    model = CleanMissingData(["x"], ["x_clean"]).fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["x_clean"], [1.0, 2.0, 3.0])
+
+
+def test_clean_missing_custom_roundtrip(tmp_save):
+    df = DataFrame({"x": [1.0, np.nan]})
+    model = CleanMissingData(["x"], ["x"], cleaning_mode="Custom",
+                            custom_value=-1.0).fit(df)
+    model.save(tmp_save)
+    from mmlspark_tpu.featurize import CleanMissingDataModel
+    loaded = CleanMissingDataModel.load(tmp_save)
+    np.testing.assert_allclose(loaded.transform(df)["x"], [1.0, -1.0])
+
+
+def test_value_indexer_roundtrip():
+    df = DataFrame({"cat": ["b", "a", "b", "c"]})
+    model = ValueIndexer(input_col="cat", output_col="idx").fit(df)
+    out = model.transform(df)
+    assert list(out["idx"]) == [1, 0, 1, 2]
+    back = IndexToValue(input_col="idx", output_col="orig").transform(out)
+    assert list(back["orig"]) == ["b", "a", "b", "c"]
+
+
+def test_value_indexer_unseen_raises():
+    model = ValueIndexer(input_col="c", output_col="i").fit(
+        DataFrame({"c": ["a"]}))
+    with pytest.raises(ValueError):
+        model.transform(DataFrame({"c": ["zzz"]}))
+
+
+def test_data_conversion_casts():
+    df = DataFrame({"x": [1.5, 2.5]})
+    out = DataConversion(input_cols=["x"], convert_to="integer").transform(df)
+    assert out["x"].dtype == np.int32
+
+
+def test_count_selector():
+    col = np.empty(2, dtype=object)
+    col[0] = np.array([1.0, 0.0, 2.0])
+    col[1] = np.array([3.0, 0.0, 0.0])
+    df = DataFrame({"features": col})
+    model = CountSelector(input_col="features", output_col="out").fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["out"][0], [1.0, 2.0])
+
+
+def test_tokenizer_ngram_hashing_idf():
+    df = DataFrame({"text": ["the cat sat", "the dog ran fast"]})
+    toks = Tokenizer(input_col="text", output_col="toks").transform(df)
+    assert toks["toks"][0] == ["the", "cat", "sat"]
+    grams = MultiNGram(input_col="toks", output_col="grams",
+                       lengths=[1, 2]).transform(toks)
+    assert "the cat" in grams["grams"][0]
+    tf = HashingTF(input_col="toks", output_col="tf",
+                   num_features=64).transform(toks)
+    assert tf["tf"][0].sum() == 3.0
+    idf_model = IDF(input_col="tf", output_col="tfidf").fit(tf)
+    out = idf_model.transform(tf)
+    assert out["tfidf"][0].shape == (64,)
+
+
+def test_text_featurizer_end_to_end(tmp_save):
+    df = DataFrame({"text": ["good movie great plot", "bad film poor plot",
+                             "great film good acting"]})
+    model = TextFeaturizer(input_col="text", output_col="features",
+                           num_features=128).fit(df)
+    out = model.transform(df)
+    assert out["features"][0].shape == (128,)
+    assert "_tf_tokens" not in out.columns
+    model.save(tmp_save)
+    from mmlspark_tpu.featurize import TextFeaturizerModel
+    loaded = TextFeaturizerModel.load(tmp_save)
+    np.testing.assert_allclose(loaded.transform(df)["features"][1],
+                               out["features"][1])
+
+
+def test_page_splitter():
+    df = DataFrame({"doc": ["word " * 100]})
+    out = PageSplitter(input_col="doc", output_col="pages",
+                       minimum_page_length=50,
+                       maximum_page_length=100).transform(df)
+    pages = out["pages"][0]
+    assert all(len(p) <= 100 for p in pages)
+    assert "".join(pages) == "word " * 100
+
+
+def test_featurize_mixed_types():
+    df = DataFrame({
+        "num": np.array([1.0, np.nan, 3.0]),
+        "cat": ["a", "b", "a"],
+        "vec": [np.array([1.0, 2.0]), np.array([3.0, 4.0]),
+                np.array([5.0, 6.0])],
+    })
+    model = Featurize(["num", "cat", "vec"]).fit(df)
+    out = model.transform(df)
+    X = np.stack(list(out["features"]))
+    # 1 numeric + 2 one-hot + 2 vector slots
+    assert X.shape == (3, 5)
+    assert X[1, 0] == 2.0  # mean-imputed
+
+
+def test_featurize_roundtrip(tmp_save):
+    df = DataFrame({"num": [1.0, 2.0], "cat": ["x", "y"]})
+    model = Featurize(["num", "cat"]).fit(df)
+    model.save(tmp_save)
+    from mmlspark_tpu.featurize import FeaturizeModel
+    loaded = FeaturizeModel.load(tmp_save)
+    np.testing.assert_allclose(
+        np.stack(list(loaded.transform(df)["features"])),
+        np.stack(list(model.transform(df)["features"])))

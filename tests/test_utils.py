@@ -1,0 +1,73 @@
+"""Tests for runtime utilities (reference: ClusterUtil, FaultToleranceUtils,
+AsyncUtils, SharedVariable — SURVEY.md §2.1 core/utils row)."""
+
+import time
+
+import pytest
+
+from mmlspark_tpu.utils import (SharedSingleton, SharedVariable, StopWatch,
+                                buffered_await, device_for_partition,
+                                global_devices, local_devices, map_buffered,
+                                num_tasks, retry_with_backoff,
+                                retry_with_timeout)
+
+
+def test_cluster_topology():
+    assert len(global_devices()) == 8  # virtual CPU mesh from conftest
+    assert num_tasks() == 8
+    assert num_tasks(3) == 3
+    devs = local_devices()
+    assert device_for_partition(0) == devs[0]
+    assert device_for_partition(len(devs)) == devs[0]
+
+
+def test_retry_with_timeout():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert retry_with_timeout(flaky, timeout_s=5, retries=5) == "ok"
+    with pytest.raises(RuntimeError):
+        retry_with_timeout(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                           timeout_s=1, retries=2)
+
+
+def test_retry_with_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ValueError("no")
+        return 42
+
+    assert retry_with_backoff(flaky, waits_ms=[0, 1, 1]) == 42
+
+
+def test_buffered_await_order():
+    out = list(map_buffered(lambda x: x * x, range(10), concurrency=3))
+    assert out == [x * x for x in range(10)]
+
+
+def test_shared_variable_single_creation():
+    count = []
+    sv = SharedVariable(lambda: count.append(1) or "v")
+    assert sv.get() == "v" and sv.get() == "v"
+    assert len(count) == 1
+    SharedSingleton.reset()
+    a = SharedSingleton.get("k", lambda: object())
+    b = SharedSingleton.get("k", lambda: object())
+    assert a is b
+
+
+def test_stopwatch():
+    sw = StopWatch()
+    with sw:
+        time.sleep(0.01)
+    assert sw.elapsed_s >= 0.01
+    sw.measure(lambda: time.sleep(0.005))
+    assert sw.elapsed_s >= 0.015
