@@ -1,0 +1,9 @@
+from .hyperparam import (DiscreteHyperParam, GridSpace, HyperparamBuilder,
+                         RangeHyperParam, RandomSpace)
+from .tune import FindBestModel, FindBestModelResult, TuneHyperparameters
+
+__all__ = [
+    "DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
+    "GridSpace", "RandomSpace",
+    "TuneHyperparameters", "FindBestModel", "FindBestModelResult",
+]

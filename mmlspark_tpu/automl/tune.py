@@ -1,0 +1,142 @@
+"""Hyperparameter search and model selection.
+
+Parity surface: ``TuneHyperparameters`` (reference
+``core/.../automl/TuneHyperparameters.scala:36-225`` — parallel random/grid
+search across executors with train/validation split) and ``FindBestModel``
+(``FindBestModel.scala:50`` — evaluate candidate models, keep the best).
+
+The reference parallelizes trials across Spark executors; here trials run as
+threads (each trial's device compute is already XLA-parallel), matching the
+model/ensemble-parallel row of SURVEY §2.8.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, HasLabelCol, Param
+from ..core.pipeline import Estimator, Model, Transformer
+from ..train.metrics import ComputeModelStatistics
+
+__all__ = ["TuneHyperparameters", "FindBestModel", "FindBestModelResult"]
+
+_MAXIMIZE = {"accuracy", "precision", "recall", "AUC", "R^2"}
+
+
+def _evaluate(model: Transformer, df: DataFrame, label_col: str,
+              metric: str) -> float:
+    scored = model.transform(df)
+    pred_col = (model.get("prediction_col")
+                if model.has_param("prediction_col") else "prediction")
+    prob_col = (model.get("probability_col")
+                if model.has_param("probability_col") else "probability")
+    stats = ComputeModelStatistics(
+        label_col=label_col, scores_col=pred_col,
+        scored_probabilities_col=prob_col).transform(scored)
+    if metric not in stats:
+        raise ValueError(f"metric {metric!r} not in {stats.columns}")
+    return float(stats[metric][0])
+
+
+def _apply_params(est: Estimator, pm: dict) -> Estimator:
+    """Copy ``est`` with overrides, routing unknown keys to a wrapped inner
+    estimator (``model`` param) — so tuning a ``TrainClassifier(model=lr)``
+    can target the learner's hyperparameters directly."""
+    own = {k: v for k, v in pm.items() if est.has_param(k)}
+    inner_overrides = {k: v for k, v in pm.items() if not est.has_param(k)}
+    out = est.copy(own)
+    if inner_overrides:
+        if not est.has_param("model"):
+            unknown = sorted(inner_overrides)
+            raise KeyError(f"{type(est).__name__} has no params {unknown} "
+                           "and no inner 'model' to route them to")
+        inner = out.get("model")
+        out.set(model=inner.copy(inner_overrides))
+    return out
+
+
+class TuneHyperparameters(Estimator, HasLabelCol):
+    """Random/grid search over an estimator's hyperparameters."""
+
+    model = ComplexParam(default=None, doc="estimator to tune")
+    search_space = ComplexParam(default=None,
+                                doc="GridSpace or RandomSpace instance")
+    number_of_iterations = Param(int, default=10,
+                                 doc="trials (random search only)")
+    evaluation_metric = Param(str, default="accuracy", doc="selection metric")
+    train_fraction = Param(float, default=0.8, doc="train/validation split")
+    parallelism = Param(int, default=4, doc="concurrent trials")
+    seed = Param(int, default=0, doc="split seed")
+
+    best_metric: Optional[float] = None
+    best_params: Optional[dict] = None
+
+    def _fit(self, df: DataFrame) -> Model:
+        from .hyperparam import GridSpace, RandomSpace
+        space = self.get("search_space")
+        if isinstance(space, dict):
+            space = RandomSpace(space, seed=self.get("seed"))
+        if isinstance(space, GridSpace):
+            param_maps = list(space.param_maps())
+        else:
+            param_maps = list(space.param_maps(self.get("number_of_iterations")))
+
+        shuffled = df.shuffle(self.get("seed"))
+        n_train = int(round(self.get("train_fraction") * len(df)))
+        train = shuffled.take(np.arange(n_train))
+        valid = shuffled.take(np.arange(n_train, len(df)))
+
+        est: Estimator = self.get("model")
+        metric = self.get("evaluation_metric")
+        maximize = metric in _MAXIMIZE
+
+        def trial(pm: dict):
+            model = _apply_params(est, pm).fit(train)
+            return _evaluate(model, valid, self.get("label_col"), metric), model, pm
+
+        results = []
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, self.get("parallelism"))) as ex:
+            for res in ex.map(trial, param_maps):
+                results.append(res)
+        if not results:
+            raise ValueError("empty search space")
+        best = (max if maximize else min)(results, key=lambda r: r[0])
+        self.best_metric, best_model, self.best_params = best[0], best[1], best[2]
+        return best_model
+
+
+class FindBestModelResult(Model):
+    best_model = ComplexParam(default=None, doc="winning fitted model")
+    all_model_metrics = Param(list, default=[], doc="[(index, metric)] per candidate")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.get("best_model").transform(df)
+
+
+class FindBestModel(Estimator, HasLabelCol):
+    """Evaluate pre-fitted candidate models on the given frame; keep the best."""
+
+    models = ComplexParam(default=[], doc="candidate fitted models")
+    evaluation_metric = Param(str, default="accuracy", doc="selection metric")
+
+    def __init__(self, models: Optional[Sequence[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        if models is not None:
+            self.set(models=list(models))
+
+    def _fit(self, df: DataFrame) -> FindBestModelResult:
+        metric = self.get("evaluation_metric")
+        maximize = metric in _MAXIMIZE
+        scores: List[float] = []
+        for m in self.get("models"):
+            scores.append(_evaluate(m, df, self.get("label_col"), metric))
+        best_i = int(np.argmax(scores) if maximize else np.argmin(scores))
+        res = FindBestModelResult()
+        res.set(best_model=self.get("models")[best_i],
+                all_model_metrics=[[i, s] for i, s in enumerate(scores)])
+        return res

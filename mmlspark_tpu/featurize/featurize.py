@@ -26,6 +26,8 @@ def _is_numeric(col: np.ndarray) -> bool:
 
 
 def _is_text(col: np.ndarray) -> bool:
+    if col.dtype.kind in ("U", "S"):
+        return True
     return col.dtype == object and len(col) > 0 and isinstance(col[0], str)
 
 
@@ -57,8 +59,9 @@ class Featurize(Estimator, HasInputCols, HasOutputCol):
             elif _is_numeric(col):
                 fill = None
                 if self.get("impute_missing"):
-                    f = col.astype(np.float64)
-                    fill = float(np.nanmean(f)) if np.isnan(f).any() else 0.0
+                    # unconditional training mean: serving data may have NaNs
+                    # even when the training sample had none
+                    fill = float(np.nanmean(col.astype(np.float64)))
                 plans.append({"col": c, "kind": "numeric", "fill": fill})
             elif _is_text(col):
                 levels = get_categorical_levels(df, c)

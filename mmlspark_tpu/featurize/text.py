@@ -77,7 +77,9 @@ class MultiNGram(Transformer, HasInputCol, HasOutputCol):
 
 
 class HashingTF(Transformer, HasInputCol, HasOutputCol):
-    num_features = Param(int, default=1 << 18, doc="hash space size")
+    # vectors are dense here (they feed device matmuls), so the default hash
+    # space is far below the reference's sparse 2^18
+    num_features = Param(int, default=1 << 12, doc="hash space size")
     binary = Param(bool, default=False, doc="presence instead of counts")
 
     def _transform(self, df: DataFrame) -> DataFrame:
@@ -98,9 +100,14 @@ class IDF(Estimator, HasInputCol, HasOutputCol):
 
     def _fit(self, df: DataFrame) -> "IDFModel":
         col = df[self.get("input_col")]
-        X = np.stack([np.asarray(v, dtype=np.float64) for v in col])
-        docfreq = (X > 0).sum(axis=0)
-        n = len(X)
+        # incremental docfreq: never materialize the (n_docs, n_features) stack
+        docfreq = None
+        for v in col:
+            row = np.asarray(v) > 0
+            docfreq = row.astype(np.int64) if docfreq is None else docfreq + row
+        n = len(col)
+        if docfreq is None:
+            docfreq = np.zeros(0, dtype=np.int64)
         idf = np.log((n + 1.0) / (docfreq + 1.0))
         idf[docfreq < self.get("min_doc_freq")] = 0.0
         m = IDFModel()
@@ -131,7 +138,7 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     to_lowercase = Param(bool, default=True, doc="lowercase text")
     use_ngram = Param(bool, default=False, doc="insert an n-gram stage")
     n_gram_length = Param(int, default=2, doc="gram width")
-    num_features = Param(int, default=1 << 18, doc="hash space size")
+    num_features = Param(int, default=1 << 12, doc="hash space size")
     binary = Param(bool, default=False, doc="binary term counts")
     use_idf = Param(bool, default=True, doc="apply inverse document frequency")
     min_doc_freq = Param(int, default=1, doc="IDF min document frequency")
@@ -187,16 +194,21 @@ class PageSplitter(Transformer, HasInputCol, HasOutputCol):
         out = np.empty(len(df), dtype=object)
         for i, text in enumerate(df[self.get("input_col")]):
             t = str(text)
+            nbytes = [len(ch.encode("utf-8")) for ch in t]
             pages, start = [], 0
             while start < len(t):
-                if len(t) - start <= hi:
+                # greedily take chars while the page stays within hi BYTES,
+                # remembering the last soft boundary past lo bytes
+                size, j, soft = 0, start, None
+                while j < len(t) and size + nbytes[j] <= hi:
+                    size += nbytes[j]
+                    j += 1
+                    if size >= lo and rx.match(t[j - 1]):
+                        soft = j
+                if j >= len(t):
                     pages.append(t[start:])
                     break
-                window = t[start + lo:start + hi]
-                soft = None
-                for mm in rx.finditer(window):
-                    soft = mm.end()
-                cut = start + lo + soft if soft is not None else start + hi
+                cut = soft if soft is not None else max(j, start + 1)
                 pages.append(t[start:cut])
                 start = cut
             out[i] = pages

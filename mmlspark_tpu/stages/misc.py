@@ -264,18 +264,30 @@ class StratifiedRepartition(Transformer, HasLabelCol, HasSeed):
     def _transform(self, df: DataFrame) -> DataFrame:
         if self.get("mode") == "original":
             return df
+        import collections
         labels = df[self.get("label_col")]
         rng = np.random.default_rng(self.get("seed"))
-        nparts = df.npartitions
-        buckets: List[List[int]] = [[] for _ in range(nparts)]
-        for v in np.unique(labels):
-            idxs = rng.permutation(np.flatnonzero(labels == v))
-            for j, i in enumerate(idxs):
-                buckets[j % nparts].append(int(i))
-        # partition_bounds gives the remainder to the earliest partitions, so
-        # align by placing larger buckets first
-        buckets.sort(key=len, reverse=True)
-        order = [i for b in buckets for i in b]
+        queues = [collections.deque(rng.permutation(np.flatnonzero(labels == v)))
+                  for v in np.unique(labels)]
+        caps = [hi - lo for lo, hi in df.partition_bounds()]
+        parts: List[List[int]] = [[] for _ in caps]
+        # phase 1: one row of every label to every partition (while supplies
+        # last) — the actual contract of the reference's equal mode
+        for q in queues:
+            for p in range(len(parts)):
+                if q and len(parts[p]) < caps[p]:
+                    parts[p].append(int(q.popleft()))
+        # phase 2: fill remaining capacity cycling the label queues
+        li = 0
+        for p in range(len(parts)):
+            while len(parts[p]) < caps[p]:
+                for k in range(len(queues)):
+                    q = queues[(li + k) % len(queues)]
+                    if q:
+                        parts[p].append(int(q.popleft()))
+                        li = (li + k + 1) % len(queues)
+                        break
+        order = [i for part in parts for i in part]
         return df.take(np.array(order))
 
 

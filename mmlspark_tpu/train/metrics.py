@@ -1,0 +1,134 @@
+"""Model evaluation metrics.
+
+Parity surface: ``ComputeModelStatistics`` (reference
+``core/.../train/ComputeModelStatistics.scala:59-474``: confusion matrix,
+accuracy/precision/recall, AUC via ``MetricsLogger``; regression MSE/RMSE/R²/MAE)
+and ``ComputePerInstanceStatistics`` (``ComputePerInstanceStatistics.scala:45``:
+per-row losses). Metric math runs as vectorized array ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import HasLabelCol, Param
+from ..core.pipeline import Transformer
+
+__all__ = ["ComputeModelStatistics", "ComputePerInstanceStatistics",
+           "roc_auc", "confusion_matrix"]
+
+
+def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray, n: int) -> np.ndarray:
+    cm = np.zeros((n, n), dtype=np.int64)
+    np.add.at(cm, (y_true.astype(np.int64), y_pred.astype(np.int64)), 1)
+    return cm
+
+
+def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
+    """AUC by the rank statistic (equivalent to trapezoidal ROC integration)."""
+    y = np.asarray(y_true).astype(bool)
+    s = np.asarray(scores, dtype=np.float64)
+    n_pos, n_neg = int(y.sum()), int((~y).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), dtype=np.float64)
+    ranks[order] = np.arange(1, len(s) + 1)
+    # tie correction: average ranks within equal scores
+    sorted_s = s[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = (i + j + 2) / 2.0
+        i = j + 1
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class ComputeModelStatistics(Transformer, HasLabelCol):
+    """Emit a one-row DataFrame of metrics for a scored frame."""
+
+    scores_col = Param(str, default="prediction", doc="prediction column")
+    scored_probabilities_col = Param(str, default="probability",
+                                     doc="probability column (classification)")
+    evaluation_metric = Param(str, default="auto",
+                              choices=["auto", "classification", "regression"],
+                              doc="task type; auto sniffs the columns")
+
+    def _task(self, df: DataFrame) -> str:
+        mode = self.get("evaluation_metric")
+        if mode != "auto":
+            return mode
+        return ("classification"
+                if self.get("scored_probabilities_col") in df else "regression")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        y = df[self.get("label_col")]
+        pred = df[self.get("scores_col")]
+        if self._task(df) == "classification":
+            classes, y_idx = np.unique(y, return_inverse=True)
+            table = {c.item() if isinstance(c, np.generic) else c: i
+                     for i, c in enumerate(classes)}
+            p_idx = np.asarray([table.get(
+                v.item() if isinstance(v, np.generic) else v, -1)
+                for v in pred])
+            n = len(classes)
+            cm = confusion_matrix(y_idx, np.clip(p_idx, 0, n - 1), n)
+            acc = float((y_idx == p_idx).mean())
+            tp = np.diag(cm).astype(np.float64)
+            prec = float(np.nanmean(tp / np.maximum(cm.sum(axis=0), 1)))
+            rec = float(np.nanmean(tp / np.maximum(cm.sum(axis=1), 1)))
+            row = {"accuracy": acc, "precision": prec, "recall": rec,
+                   "confusion_matrix": cm}
+            prob_col = self.get("scored_probabilities_col")
+            if n == 2 and prob_col in df:
+                probs = df[prob_col]
+                pos_scores = np.asarray([np.asarray(p).ravel()[-1]
+                                         for p in probs])
+                row["AUC"] = roc_auc(y_idx == 1, pos_scores)
+            return DataFrame.from_rows([row])
+        yf = y.astype(np.float64)
+        pf = pred.astype(np.float64)
+        err = yf - pf
+        mse = float(np.mean(err ** 2))
+        ss_tot = float(np.sum((yf - yf.mean()) ** 2))
+        return DataFrame.from_rows([{
+            "mean_squared_error": mse,
+            "root_mean_squared_error": float(np.sqrt(mse)),
+            "mean_absolute_error": float(np.mean(np.abs(err))),
+            "R^2": 1.0 - float(np.sum(err ** 2)) / ss_tot if ss_tot else
+            float("nan"),
+        }])
+
+
+class ComputePerInstanceStatistics(Transformer, HasLabelCol):
+    """Append per-row loss columns (reference
+    ``ComputePerInstanceStatistics.scala:45``)."""
+
+    scores_col = Param(str, default="prediction", doc="prediction column")
+    scored_probabilities_col = Param(str, default="probability",
+                                     doc="probability column (classification)")
+    evaluation_metric = Param(str, default="auto",
+                              choices=["auto", "classification", "regression"],
+                              doc="task type")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        y = df[self.get("label_col")]
+        prob_col = self.get("scored_probabilities_col")
+        is_cls = (self.get("evaluation_metric") == "classification"
+                  or (self.get("evaluation_metric") == "auto" and prob_col in df))
+        if is_cls:
+            classes, y_idx = np.unique(y, return_inverse=True)
+            probs = np.stack([np.asarray(p).ravel() for p in df[prob_col]])
+            p_true = probs[np.arange(len(y_idx)), np.clip(y_idx, 0,
+                                                          probs.shape[1] - 1)]
+            return df.with_column("log_loss", -np.log(np.maximum(p_true, 1e-15)))
+        pf = df[self.get("scores_col")].astype(np.float64)
+        err = y.astype(np.float64) - pf
+        return (df.with_column("L1_loss", np.abs(err))
+                  .with_column("L2_loss", err ** 2))

@@ -8,7 +8,8 @@ constants ``LightGBMConstants.scala:49-56``).
 
 from __future__ import annotations
 
-import concurrent.futures
+import queue
+import threading
 import time
 from typing import Callable, Optional, Sequence, TypeVar
 
@@ -22,18 +23,28 @@ DEFAULT_WAITS_MS = (0, 100, 500, 1000, 3000, 5000)
 def retry_with_timeout(fn: Callable[[], T], timeout_s: float,
                        retries: int = 3) -> T:
     """Run ``fn`` with a wall-clock timeout, retrying on failure/timeout."""
+    # Bare daemon threads, not ThreadPoolExecutor: its atexit hook joins
+    # worker threads, so a permanently hung fn would block interpreter exit
+    # even after the timeout fired here.
     err: Optional[Exception] = None
     for _ in range(max(1, retries)):
-        # No context manager: `with` would block in shutdown(wait=True) until
-        # a hung fn returns, defeating the timeout entirely.
-        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        fut = ex.submit(fn)
+        box: "queue.Queue" = queue.Queue(1)
+
+        def run():
+            try:
+                box.put(("ok", fn()))
+            except Exception as e:  # noqa: BLE001 — shipped to the caller
+                box.put(("err", e))
+
+        threading.Thread(target=run, daemon=True).start()
         try:
-            return fut.result(timeout=timeout_s)
-        except Exception as e:  # noqa: BLE001 — retry ladder
-            err = e
-        finally:
-            ex.shutdown(wait=False, cancel_futures=True)
+            kind, payload = box.get(timeout=timeout_s)
+        except queue.Empty:
+            err = TimeoutError(f"call exceeded {timeout_s}s")
+            continue
+        if kind == "ok":
+            return payload
+        err = payload
     raise err  # type: ignore[misc]
 
 

@@ -1,0 +1,132 @@
+"""Tests for train wrappers, metrics, linear learners, and automl
+(reference: VerifyTrainClassifier / TuneHyperparameters suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.automl import (DiscreteHyperParam, FindBestModel, GridSpace,
+                                 HyperparamBuilder, RandomSpace,
+                                 RangeHyperParam, TuneHyperparameters)
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.models.linear import LinearRegression, LogisticRegression
+from mmlspark_tpu.train import (ComputeModelStatistics,
+                                ComputePerInstanceStatistics, TrainClassifier,
+                                TrainRegressor, roc_auc)
+
+
+def _cls_df(n=80, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = X[i]
+    return DataFrame({"features": col, "label": y})
+
+
+def test_logistic_regression_learns():
+    df = _cls_df()
+    model = LogisticRegression(max_iter=300).fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"] == df["label"]).mean()
+    assert acc > 0.9
+    assert out["probability"][0].shape == (2,)
+
+
+def test_linear_regression_learns():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (100, 2))
+    y = 2.0 * X[:, 0] - 1.0 * X[:, 1] + 0.5
+    col = np.empty(100, dtype=object)
+    for i in range(100):
+        col[i] = X[i]
+    df = DataFrame({"features": col, "label": y})
+    model = LinearRegression(max_iter=500, learning_rate=0.2).fit(df)
+    pred = model.transform(df)["prediction"]
+    assert np.mean((pred - y) ** 2) < 0.05
+
+
+def test_train_classifier_auto_featurize():
+    rng = np.random.default_rng(1)
+    n = 60
+    df = DataFrame({
+        "num": rng.normal(0, 1, n),
+        "cat": np.where(rng.random(n) > 0.5, "a", "b"),
+        "label": np.where(rng.random(n) > 0.5, "yes", "no"),
+    })
+    # make label learnable from cat
+    labels = np.where(df["cat"] == "a", "yes", "no")
+    df = df.with_column("label", labels)
+    model = TrainClassifier(model=LogisticRegression(max_iter=300)).fit(df)
+    out = model.transform(df)
+    assert set(np.unique(out["prediction"])) <= {"yes", "no"}
+    acc = (out["prediction"] == labels).mean()
+    assert acc > 0.95
+
+
+def test_train_regressor_and_stats():
+    rng = np.random.default_rng(2)
+    n = 80
+    x = rng.normal(0, 1, n)
+    df = DataFrame({"x": x, "label": 3.0 * x + 1.0})
+    model = TrainRegressor(model=LinearRegression(max_iter=500,
+                                                  learning_rate=0.2)).fit(df)
+    scored = model.transform(df)
+    stats = ComputeModelStatistics(label_col="label").transform(scored)
+    assert stats["R^2"][0] > 0.95
+    per = ComputePerInstanceStatistics(label_col="label").transform(scored)
+    assert "L2_loss" in per.columns
+
+
+def test_classification_stats_and_auc():
+    df = _cls_df()
+    model = LogisticRegression(max_iter=300).fit(df)
+    scored = model.transform(df)
+    stats = ComputeModelStatistics(label_col="label").transform(scored)
+    assert stats["accuracy"][0] > 0.9
+    assert stats["AUC"][0] > 0.9
+    cm = stats["confusion_matrix"][0]
+    assert cm.sum() == len(df)
+
+
+def test_roc_auc_known_value():
+    y = np.array([0, 0, 1, 1])
+    s = np.array([0.1, 0.4, 0.35, 0.8])
+    assert abs(roc_auc(y, s) - 0.75) < 1e-9
+    assert roc_auc(np.array([1, 1]), np.array([0.5, 0.5])) != roc_auc(
+        np.array([0, 1]), np.array([0.5, 0.5]))  # nan vs 0.5
+
+
+def test_tune_hyperparameters_random():
+    df = _cls_df(n=60)
+    space = (HyperparamBuilder()
+             .add_hyperparam("learning_rate", RangeHyperParam(0.01, 0.5, is_log=True))
+             .add_hyperparam("max_iter", DiscreteHyperParam([50, 150]))
+             .build())
+    tuner = TuneHyperparameters(
+        model=LogisticRegression(), search_space=RandomSpace(space, seed=3),
+        number_of_iterations=4, evaluation_metric="accuracy",
+        label_col="label", parallelism=2)
+    best = tuner.fit(df)
+    assert tuner.best_metric is not None and tuner.best_metric > 0.6
+    assert set(tuner.best_params) == {"learning_rate", "max_iter"}
+    assert "prediction" in best.transform(df).columns
+
+
+def test_tune_grid_space_enumeration():
+    space = (HyperparamBuilder()
+             .add_hyperparam("a", DiscreteHyperParam([1, 2]))
+             .add_hyperparam("b", DiscreteHyperParam(["x", "y"]))
+             .build())
+    maps = list(GridSpace(space).param_maps())
+    assert len(maps) == 4
+
+
+def test_find_best_model():
+    df = _cls_df(n=60)
+    good = LogisticRegression(max_iter=300).fit(df)
+    bad = LogisticRegression(max_iter=1).fit(df)
+    result = FindBestModel([bad, good], label_col="label").fit(df)
+    metrics = dict((i, m) for i, m in result.get("all_model_metrics"))
+    assert result.get("best_model") is good or metrics[1] >= metrics[0]
+    assert "prediction" in result.transform(df).columns
