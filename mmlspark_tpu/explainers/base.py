@@ -1,0 +1,64 @@
+"""Local explainer base.
+
+Parity surface: ``LocalExplainer`` (reference
+``explainers/LocalExplainer.scala:16-72``) — shared plumbing for LIME/SHAP:
+wrap an inner model, score perturbed samples through it, and emit one
+attribution vector per explained row.
+
+TPU-first: all rows' perturbations are concatenated into ONE frame and scored
+in ONE ``model.transform`` call (the reference scores per row), so the inner
+model sees a large static batch; surrogate fits then run as a single vmapped
+solve (``regression.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, concat
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Transformer
+
+__all__ = ["LocalExplainer", "shapley_kernel_weights"]
+
+
+class LocalExplainer(Transformer):
+    model = ComplexParam(default=None, doc="inner model to explain")
+    target_col = Param(str, default="probability",
+                       doc="model output column to explain")
+    target_classes = Param((list, int), default=[1],
+                           doc="class indices summed into the scalar target")
+    output_col = Param(str, default="explanation",
+                       doc="per-row attribution vector column")
+    num_samples = Param(int, default=256, doc="perturbations per row")
+    seed = Param(int, default=0, doc="sampling seed")
+
+    def _score_frame(self, samples_df: DataFrame) -> np.ndarray:
+        """Run the inner model over a frame of perturbed samples; reduce the
+        target column to one scalar per row."""
+        out = self.get("model").transform(samples_df)
+        col = out[self.get("target_col")]
+        targets = self.get("target_classes")
+        if col.dtype == object:
+            vals = np.stack([np.asarray(v, dtype=np.float64).ravel()
+                             for v in col])
+            idx = [t for t in targets if t < vals.shape[1]]
+            return vals[:, idx].sum(axis=1)
+        return col.astype(np.float64)
+
+
+def shapley_kernel_weights(masks: np.ndarray) -> np.ndarray:
+    """KernelSHAP weights for binary coalition masks (m, d)
+    (reference ``KernelSHAPBase.scala:43-94`` sampling weights)."""
+    from math import comb
+    d = masks.shape[1]
+    sizes = masks.sum(axis=1).astype(int)
+    w = np.empty(len(masks), dtype=np.float64)
+    for i, s in enumerate(sizes):
+        if s == 0 or s == d:
+            w[i] = 1e6  # constraint rows: f(empty)=base, f(full)=fx
+        else:
+            w[i] = (d - 1) / (comb(d, s) * s * (d - s))
+    return w

@@ -1,0 +1,61 @@
+"""SLIC-style superpixel segmentation.
+
+Parity surface: ``Superpixel`` (reference ``core/.../lime/Superpixel.scala:148``
+— SLIC-like clustering used to build image interpretable features for
+ImageLIME/ImageSHAP). Vectorized numpy k-means over (x, y, rgb) space.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["slic_superpixels", "mask_image"]
+
+
+def slic_superpixels(image: np.ndarray, cell_size: int = 16,
+                     modifier: float = 10.0, iters: int = 5) -> np.ndarray:
+    """Segment an (H, W, C) image into superpixels.
+
+    Returns an (H, W) int array of segment labels. ``cell_size`` plays the
+    role of the reference's ``cellSize``; ``modifier`` balances color vs
+    spatial distance.
+    """
+    H, W = image.shape[:2]
+    img = image.astype(np.float64)
+    if img.ndim == 2:
+        img = img[..., None]
+    gy = np.arange(cell_size // 2, H, cell_size)
+    gx = np.arange(cell_size // 2, W, cell_size)
+    centers_yx = np.array([(y, x) for y in gy for x in gx], dtype=np.float64)
+    k = len(centers_yx)
+    centers_rgb = img[centers_yx[:, 0].astype(int), centers_yx[:, 1].astype(int)]
+
+    yy, xx = np.mgrid[0:H, 0:W]
+    coords = np.stack([yy.ravel(), xx.ravel()], axis=1).astype(np.float64)
+    pix = img.reshape(-1, img.shape[-1])
+    spatial_scale = modifier / cell_size
+
+    labels = np.zeros(H * W, dtype=np.int64)
+    for _ in range(iters):
+        # distance to every center: color + scaled spatial
+        d_sp = ((coords[:, None, :] - centers_yx[None]) ** 2).sum(-1)
+        d_col = ((pix[:, None, :] - centers_rgb[None]) ** 2).sum(-1)
+        labels = np.argmin(d_col + (spatial_scale ** 2) * d_sp, axis=1)
+        for c in range(k):
+            m = labels == c
+            if m.any():
+                centers_yx[c] = coords[m].mean(axis=0)
+                centers_rgb[c] = pix[m].mean(axis=0)
+    # compact label ids
+    _, labels = np.unique(labels, return_inverse=True)
+    return labels.reshape(H, W)
+
+
+def mask_image(image: np.ndarray, segments: np.ndarray, keep: np.ndarray,
+               background: float = 0.0) -> np.ndarray:
+    """Zero out (or fill) all segments not in ``keep`` (a bool vector over
+    segment ids) — the LIME image perturbation."""
+    mask = keep[segments]
+    out = np.where(mask[..., None] if image.ndim == 3 else mask,
+                   image, background)
+    return out.astype(image.dtype)

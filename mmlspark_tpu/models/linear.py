@@ -12,7 +12,6 @@ round-trips. The X·W matmul dominates and lands on the MXU.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import numpy as np
@@ -28,23 +27,14 @@ __all__ = ["LogisticRegression", "LogisticRegressionModel",
            "LinearRegression", "LinearRegressionModel"]
 
 
-def _fit_linear(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
-                n_out: int, loss_kind: str, reg: float, lr: float,
-                steps: int, seed: int):
-    """One jitted lax.scan over Adam steps; returns (W, b) as numpy."""
+def _run_linear(Xd, yd, wd, params, n_out, loss_kind, reg, lr, steps):
+    """Module-level jitted trainer: data/params are traced arguments so
+    same-shape fits (e.g. TuneHyperparameters trials) hit the jit cache
+    instead of re-compiling with the dataset baked in as constants."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    Xd = jnp.asarray(X, dtype=jnp.float32)
-    yd = jnp.asarray(y)
-    wd = jnp.ones(len(X), jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
-
-    key = jax.random.PRNGKey(seed)
-    params = {
-        "W": jax.random.normal(key, (X.shape[1], n_out)) * 0.01,
-        "b": jnp.zeros((n_out,)),
-    }
     opt = optax.adam(lr)
 
     def loss_fn(p):
@@ -54,23 +44,50 @@ def _fit_linear(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
                 logits, yd.astype(jnp.int32))
         else:
             ll = 0.5 * (logits[:, 0] - yd.astype(jnp.float32)) ** 2
-        l2 = sum(jnp.sum(v * v) for v in jax.tree.leaves(p))
-        return jnp.sum(ll * wd) / jnp.sum(wd) + reg * l2
+        # SparkML parity: the intercept is not penalized
+        return jnp.sum(ll * wd) / jnp.sum(wd) + reg * jnp.sum(p["W"] ** 2)
 
-    @jax.jit
-    def run(params):
-        state = opt.init(params)
+    state = opt.init(params)
 
-        def step(carry, _):
-            p, s = carry
-            g = jax.grad(loss_fn)(p)
-            updates, s = opt.update(g, s, p)
-            return (optax.apply_updates(p, updates), s), None
+    def step(carry, _):
+        p, s = carry
+        g = jax.grad(loss_fn)(p)
+        updates, s = opt.update(g, s, p)
+        return (optax.apply_updates(p, updates), s), None
 
-        (p, _), _ = jax.lax.scan(step, (params, state), None, length=steps)
-        return p
+    (p, _), _ = jax.lax.scan(step, (params, state), None, length=steps)
+    return p
 
-    p = run(params)
+
+def _jitted_runner():
+    import jax
+    if _jitted_runner._cached is None:
+        _jitted_runner._cached = jax.jit(
+            _run_linear,
+            static_argnames=("n_out", "loss_kind", "reg", "lr", "steps"))
+    return _jitted_runner._cached
+
+
+_jitted_runner._cached = None
+
+
+def _fit_linear(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
+                n_out: int, loss_kind: str, reg: float, lr: float,
+                steps: int, seed: int):
+    """Run the jitted trainer; returns (W, b) as numpy."""
+    import jax
+    import jax.numpy as jnp
+
+    Xd = jnp.asarray(X, dtype=jnp.float32)
+    yd = jnp.asarray(y)
+    wd = jnp.ones(len(X), jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    params = {
+        "W": jax.random.normal(key, (X.shape[1], n_out)) * 0.01,
+        "b": jnp.zeros((n_out,)),
+    }
+    p = _jitted_runner()(Xd, yd, wd, params, n_out=n_out, loss_kind=loss_kind,
+                         reg=reg, lr=lr, steps=steps)
     return np.asarray(p["W"]), np.asarray(p["b"])
 
 
@@ -122,8 +139,14 @@ class LogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol,
         prob_col = np.empty(len(X), dtype=object)
         for i in range(len(X)):
             prob_col[i] = probs[i]
-        return (df.with_column(self.get("prediction_col"), classes[pred_idx])
-                  .with_column(self.get("probability_col"), prob_col))
+        from ..core.schema import set_label_metadata
+        out = (df.with_column(self.get("prediction_col"), classes[pred_idx])
+                 .with_column(self.get("probability_col"), prob_col))
+        # class order travels with the frame so metrics index probabilities
+        # correctly even when the eval labels are a subset
+        return set_label_metadata(out, self.get("prediction_col"),
+                                  num_classes=len(classes),
+                                  classes=self.get("classes"))
 
 
 class LinearRegression(Estimator, _LinearParams, HasPredictionCol):

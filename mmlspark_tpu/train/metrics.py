@@ -21,6 +21,25 @@ __all__ = ["ComputeModelStatistics", "ComputePerInstanceStatistics",
            "roc_auc", "confusion_matrix"]
 
 
+def _plain(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _class_order(df: DataFrame, scores_col: str, label_col: str,
+                 y: np.ndarray, pred: np.ndarray) -> list:
+    """Class values in *model* order: the label metadata a trained model
+    attaches to its prediction column wins; otherwise the sorted union of
+    observed labels and predictions (an eval frame may contain only a subset
+    of the model's classes)."""
+    from ..core.schema import get_label_metadata
+    for col in (scores_col, label_col):
+        meta = get_label_metadata(df, col)
+        if meta.get("classes"):
+            return [_plain(c) for c in meta["classes"]]
+    seen = {_plain(v) for v in y} | {_plain(v) for v in pred}
+    return sorted(seen, key=lambda v: (str(type(v)), v))
+
+
 def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray, n: int) -> np.ndarray:
     cm = np.zeros((n, n), dtype=np.int64)
     np.add.at(cm, (y_true.astype(np.int64), y_pred.astype(np.int64)), 1)
@@ -71,14 +90,13 @@ class ComputeModelStatistics(Transformer, HasLabelCol):
         y = df[self.get("label_col")]
         pred = df[self.get("scores_col")]
         if self._task(df) == "classification":
-            classes, y_idx = np.unique(y, return_inverse=True)
-            table = {c.item() if isinstance(c, np.generic) else c: i
-                     for i, c in enumerate(classes)}
-            p_idx = np.asarray([table.get(
-                v.item() if isinstance(v, np.generic) else v, -1)
-                for v in pred])
+            classes = _class_order(df, self.get("scores_col"),
+                                   self.get("label_col"), y, pred)
+            table = {c: i for i, c in enumerate(classes)}
+            y_idx = np.asarray([table[_plain(v)] for v in y])
+            p_idx = np.asarray([table[_plain(v)] for v in pred])
             n = len(classes)
-            cm = confusion_matrix(y_idx, np.clip(p_idx, 0, n - 1), n)
+            cm = confusion_matrix(y_idx, p_idx, n)
             acc = float((y_idx == p_idx).mean())
             tp = np.diag(cm).astype(np.float64)
             prec = float(np.nanmean(tp / np.maximum(cm.sum(axis=0), 1)))
@@ -123,10 +141,18 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol):
         is_cls = (self.get("evaluation_metric") == "classification"
                   or (self.get("evaluation_metric") == "auto" and prob_col in df))
         if is_cls:
-            classes, y_idx = np.unique(y, return_inverse=True)
+            pred = df[self.get("scores_col")] if self.get("scores_col") in df else y
+            classes = _class_order(df, self.get("scores_col"),
+                                   self.get("label_col"), y, pred)
+            table = {c: i for i, c in enumerate(classes)}
+            y_idx = np.asarray([table[_plain(v)] for v in y])
             probs = np.stack([np.asarray(p).ravel() for p in df[prob_col]])
-            p_true = probs[np.arange(len(y_idx)), np.clip(y_idx, 0,
-                                                          probs.shape[1] - 1)]
+            if probs.shape[1] < len(classes):
+                raise ValueError(
+                    f"probability column has {probs.shape[1]} entries but "
+                    f"{len(classes)} classes are in play; attach label "
+                    "metadata with the model's class order")
+            p_true = probs[np.arange(len(y_idx)), y_idx]
             return df.with_column("log_loss", -np.log(np.maximum(p_true, 1e-15)))
         pf = df[self.get("scores_col")].astype(np.float64)
         err = y.astype(np.float64) - pf

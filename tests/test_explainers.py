@@ -1,0 +1,196 @@
+"""Tests for LIME/SHAP/ICE explainers (reference: explainers test split1-3)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.explainers import (ICETransformer, ImageLIME, ImageSHAP,
+                                     TabularLIME, TabularSHAP, TextLIME,
+                                     TextSHAP, VectorLIME, VectorSHAP,
+                                     batched_lasso, batched_weighted_lstsq,
+                                     slic_superpixels)
+from mmlspark_tpu.models.linear import LogisticRegression
+
+
+def _vector_df(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 4))
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = X[i]
+    return DataFrame({"features": col}), X
+
+
+class _LinearModel(Transformer):
+    """Deterministic scoring stub: f(x) = 3*x0 - 2*x1 (features 2,3 unused)."""
+    def _transform(self, df):
+        X = np.stack([np.asarray(v, dtype=np.float64) for v in df["features"]])
+        return df.with_column("prediction", 3.0 * X[:, 0] - 2.0 * X[:, 1])
+
+
+class _TabularModel(Transformer):
+    def _transform(self, df):
+        return df.with_column("prediction",
+                              2.0 * df["a"].astype(float) - df["b"].astype(float))
+
+
+class _TextModel(Transformer):
+    """Score = 1 if 'good' appears, else 0."""
+    def _transform(self, df):
+        return df.with_column(
+            "prediction",
+            np.asarray([1.0 if "good" in str(t).split() else 0.0
+                        for t in df["text"]]))
+
+
+class _ImageModel(Transformer):
+    """Score = mean brightness of the top-left quadrant."""
+    def _transform(self, df):
+        scores = [float(np.asarray(v)[:16, :16].mean()) for v in df["image"]]
+        return df.with_column("prediction", np.asarray(scores))
+
+
+def test_batched_solvers_recover_coefs():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (3, 50, 4))
+    beta = np.array([1.0, -2.0, 0.0, 3.0])
+    y = X @ beta
+    w = np.ones((3, 50))
+    coefs, inter = batched_weighted_lstsq(X, y, w)
+    np.testing.assert_allclose(coefs, np.tile(beta, (3, 1)), atol=1e-3)
+    coefs2, _ = batched_lasso(X, y, w, alpha=1e-4, steps=500)
+    np.testing.assert_allclose(coefs2, np.tile(beta, (3, 1)), atol=0.1)
+
+
+def test_vector_lime_identifies_important_features():
+    df, X = _vector_df()
+    lime = VectorLIME(model=_LinearModel(), target_col="prediction",
+                      num_samples=200)
+    out = lime.transform(df)
+    exp = np.stack(list(out["explanation"]))
+    # features 0 and 1 drive the model; 2 and 3 do not
+    assert np.abs(exp[:, 0]).mean() > 5 * np.abs(exp[:, 2]).mean()
+    assert np.abs(exp[:, 1]).mean() > 5 * np.abs(exp[:, 3]).mean()
+    assert (exp[:, 0] > 0).all() and (exp[:, 1] < 0).all()
+
+
+def test_vector_shap_efficiency():
+    df, X = _vector_df(n=4)
+    shap = VectorSHAP(model=_LinearModel(), target_col="prediction",
+                      num_samples=128)
+    out = shap.transform(df)
+    phis = np.stack(list(out["explanation"]))  # [base, phi_0..phi_3]
+    fx = 3.0 * X[:, 0] - 2.0 * X[:, 1]
+    np.testing.assert_allclose(phis.sum(axis=1), fx, atol=0.05)
+    assert np.abs(phis[:, 1]).mean() > 5 * np.abs(phis[:, 3]).mean()
+
+
+def test_tabular_lime_and_shap():
+    rng = np.random.default_rng(1)
+    df = DataFrame({"a": rng.normal(0, 1, 6), "b": rng.normal(0, 1, 6),
+                    "c": rng.normal(0, 1, 6)})
+    lime = TabularLIME(model=_TabularModel(), target_col="prediction",
+                       input_cols=["a", "b", "c"], num_samples=200)
+    exp = np.stack(list(lime.transform(df)["explanation"]))
+    assert np.abs(exp[:, 0]).mean() > 5 * np.abs(exp[:, 2]).mean()
+
+    shap = TabularSHAP(model=_TabularModel(), target_col="prediction",
+                       input_cols=["a", "b", "c"], num_samples=128)
+    phis = np.stack(list(shap.transform(df)["explanation"]))
+    fx = 2.0 * df["a"] - df["b"]
+    np.testing.assert_allclose(phis.sum(axis=1), fx, atol=0.05)
+
+
+def test_text_lime_and_shap():
+    df = DataFrame({"text": ["good plot strong cast", "dull film bad cast"]})
+    lime = TextLIME(model=_TextModel(), target_col="prediction",
+                    num_samples=64)
+    out = lime.transform(df)
+    toks = out["tokens"][0]
+    exp = out["explanation"][0]
+    assert toks[int(np.argmax(exp))] == "good"
+
+    shap = TextSHAP(model=_TextModel(), target_col="prediction",
+                    num_samples=64)
+    out2 = shap.transform(df)
+    phis = out2["explanation"][0]  # [base, phi per token]
+    assert out2["tokens"][0][int(np.argmax(phis[1:]))] == "good"
+
+
+def test_image_lime_highlights_active_quadrant():
+    rng = np.random.default_rng(0)
+    img = rng.random((32, 32, 3)).astype(np.float32)
+    col = np.empty(1, dtype=object)
+    col[0] = img
+    df = DataFrame({"image": col})
+    lime = ImageLIME(model=_ImageModel(), target_col="prediction",
+                     num_samples=64, cell_size=16)
+    out = lime.transform(df)
+    exp = out["explanation"][0]
+    segs = out["superpixels"][0]
+    assert segs.shape == (32, 32)
+    # the superpixel covering the top-left quadrant must dominate
+    tl_seg = segs[8, 8]
+    assert exp[tl_seg] == exp.max()
+
+
+def test_image_shap_efficiency():
+    rng = np.random.default_rng(0)
+    img = rng.random((32, 32, 3)).astype(np.float32)
+    col = np.empty(1, dtype=object)
+    col[0] = img
+    df = DataFrame({"image": col})
+    shap = ImageSHAP(model=_ImageModel(), target_col="prediction",
+                     num_samples=64, cell_size=16)
+    out = shap.transform(df)
+    phis = out["explanation"][0]
+    fx = float(img[:16, :16].mean())
+    assert abs(phis.sum() - fx) < 0.05
+
+
+def test_ice_transformer():
+    rng = np.random.default_rng(2)
+    df = DataFrame({"a": rng.normal(0, 1, 5), "b": rng.normal(0, 1, 5)})
+    ice = ICETransformer(model=_TabularModel(), target_col="prediction",
+                         numeric_features=["a"], num_splits=7)
+    out = ice.transform(df)
+    curves = out["a_dependence"]
+    assert curves[0].shape == (7,)
+    # f = 2a - b: each curve strictly increasing in a
+    assert (np.diff(curves[0]) > 0).all()
+    grid = out.column_metadata("a_dependence")["ice_grid"]
+    assert len(grid) == 7
+
+    pdp = ICETransformer(model=_TabularModel(), target_col="prediction",
+                         numeric_features=["a"], kind="average",
+                         num_splits=5).transform(df)
+    np.testing.assert_allclose(pdp["a_dependence"][0],
+                               pdp["a_dependence"][1])
+
+
+def test_slic_superpixels_cover_image():
+    img = np.zeros((32, 32, 3))
+    img[:, 16:] = 1.0
+    segs = slic_superpixels(img, cell_size=16)
+    assert segs.shape == (32, 32)
+    # left and right halves should never share a segment
+    assert not (set(np.unique(segs[:, :8])) & set(np.unique(segs[:, 24:])))
+
+
+def test_explainer_with_real_model():
+    rng = np.random.default_rng(3)
+    n = 60
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] > 0).astype(np.int64)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = X[i]
+    df = DataFrame({"features": col, "label": y})
+    model = LogisticRegression(max_iter=200).fit(df)
+    shap = VectorSHAP(model=model, target_col="probability",
+                      target_classes=[1], num_samples=128)
+    out = shap.transform(df.head(4))
+    phis = np.stack(list(out["explanation"]))
+    assert np.abs(phis[:, 1]).mean() > np.abs(phis[:, 2]).mean()

@@ -89,6 +89,27 @@ def test_classification_stats_and_auc():
     assert cm.sum() == len(df)
 
 
+def test_metrics_with_subset_eval_labels():
+    # model trained on 3 classes, eval frame holds only 2: probability
+    # indexing must follow the model's class order (via label metadata)
+    rng = np.random.default_rng(5)
+    n = 90
+    X = rng.normal(0, 1, (n, 2))
+    y = np.where(X[:, 0] > 0.5, 2, np.where(X[:, 0] < -0.5, 0, 1))
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = X[i]
+    df = DataFrame({"features": col, "label": y})
+    model = LogisticRegression(max_iter=300).fit(df)
+    sub = df.filter(df["label"] != 1)
+    scored = model.transform(sub)
+    per = ComputePerInstanceStatistics(label_col="label").transform(scored)
+    # correct indexing: log-loss for well-separated rows must be small
+    assert np.median(per["log_loss"]) < 0.7
+    stats = ComputeModelStatistics(label_col="label").transform(scored)
+    assert stats["confusion_matrix"][0].shape == (3, 3)
+
+
 def test_roc_auc_known_value():
     y = np.array([0, 0, 1, 1])
     s = np.array([0.1, 0.4, 0.35, 0.8])
