@@ -44,21 +44,34 @@ class LocalExplainer(Transformer):
         if col.dtype == object:
             vals = np.stack([np.asarray(v, dtype=np.float64).ravel()
                              for v in col])
-            idx = [t for t in targets if t < vals.shape[1]]
-            return vals[:, idx].sum(axis=1)
+            bad = [t for t in targets if t >= vals.shape[1]]
+            if bad:
+                raise ValueError(
+                    f"target_classes {bad} out of range for "
+                    f"{self.get('target_col')!r} vectors of length "
+                    f"{vals.shape[1]}")
+            return vals[:, targets].sum(axis=1)
         return col.astype(np.float64)
 
 
-def shapley_kernel_weights(masks: np.ndarray) -> np.ndarray:
+def shapley_kernel_weights(masks: np.ndarray,
+                           pinned_weight: float = 0.0) -> np.ndarray:
     """KernelSHAP weights for binary coalition masks (m, d)
-    (reference ``KernelSHAPBase.scala:43-94`` sampling weights)."""
+    (reference ``KernelSHAPBase.scala:43-94`` sampling weights).
+
+    Empty/full coalitions get ``pinned_weight``: the solver handles the
+    f(empty)=base and f(full)=fx constraints by elimination, not by the
+    huge-weight trick (whose 1e6..1e-9 dynamic range is unsolvable in the
+    float32 the device math runs in). Weights are normalized to max 1.
+    """
     from math import comb
     d = masks.shape[1]
     sizes = masks.sum(axis=1).astype(int)
     w = np.empty(len(masks), dtype=np.float64)
     for i, s in enumerate(sizes):
         if s == 0 or s == d:
-            w[i] = 1e6  # constraint rows: f(empty)=base, f(full)=fx
+            w[i] = pinned_weight
         else:
             w[i] = (d - 1) / (comb(d, s) * s * (d - s))
-    return w
+    peak = w.max()
+    return w / peak if peak > 0 else w

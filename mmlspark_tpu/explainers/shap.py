@@ -34,12 +34,25 @@ def _coalitions(m: int, d: int, rng: np.random.Generator) -> np.ndarray:
 
 
 def _shap_solve(masks: np.ndarray, scores: np.ndarray) -> np.ndarray:
-    """masks: (B, m, d); scores: (B, m) → phis (B, d+1) incl. base value."""
+    """masks: (B, m, d) with rows 0/1 pinned to empty/full; scores: (B, m)
+    → phis (B, d+1) incl. base value.
+
+    The efficiency constraint sum(phi) = f(x) − base is enforced by
+    eliminating the last feature (the SHAP-library formulation), keeping the
+    weight range float32-friendly instead of using 1e6 constraint weights.
+    """
     B, m, d = masks.shape
+    base, fx = scores[:, 0], scores[:, 1]
+    if d == 1:
+        return np.stack([base, fx - base], axis=1)
+    Z = masks.astype(np.float64)
     w = np.stack([shapley_kernel_weights(masks[b]) for b in range(B)])
-    coefs, intercept = batched_weighted_lstsq(
-        masks.astype(np.float64), scores, w, fit_intercept=True)
-    return np.concatenate([intercept[:, None], coefs], axis=1)
+    # substitute phi_d = (fx - base) - sum(phi_1..d-1)
+    Zr = Z[:, :, :-1] - Z[:, :, -1:]
+    yr = scores - base[:, None] - Z[:, :, -1] * (fx - base)[:, None]
+    coefs, _ = batched_weighted_lstsq(Zr, yr, w, fit_intercept=False)
+    phi_last = (fx - base) - coefs.sum(axis=1)
+    return np.concatenate([base[:, None], coefs, phi_last[:, None]], axis=1)
 
 
 class _SHAPParams(LocalExplainer):

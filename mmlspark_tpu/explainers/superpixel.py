@@ -26,6 +26,11 @@ def slic_superpixels(image: np.ndarray, cell_size: int = 16,
         img = img[..., None]
     gy = np.arange(cell_size // 2, H, cell_size)
     gx = np.arange(cell_size // 2, W, cell_size)
+    # tiny images: degrade to (at least) a single centered cell
+    if len(gy) == 0:
+        gy = np.array([H // 2])
+    if len(gx) == 0:
+        gx = np.array([W // 2])
     centers_yx = np.array([(y, x) for y in gy for x in gx], dtype=np.float64)
     k = len(centers_yx)
     centers_rgb = img[centers_yx[:, 0].astype(int), centers_yx[:, 1].astype(int)]

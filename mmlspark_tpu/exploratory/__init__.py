@@ -1,0 +1,5 @@
+from .balance import (AggregateBalanceMeasure, DistributionBalanceMeasure,
+                      FeatureBalanceMeasure)
+
+__all__ = ["FeatureBalanceMeasure", "DistributionBalanceMeasure",
+           "AggregateBalanceMeasure"]

@@ -1,0 +1,3 @@
+from .iforest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

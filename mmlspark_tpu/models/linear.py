@@ -27,7 +27,7 @@ __all__ = ["LogisticRegression", "LogisticRegressionModel",
            "LinearRegression", "LinearRegressionModel"]
 
 
-def _run_linear(Xd, yd, wd, params, n_out, loss_kind, reg, lr, steps):
+def _run_linear(Xd, yd, wd, params, reg, lr, n_out, loss_kind, steps):
     """Module-level jitted trainer: data/params are traced arguments so
     same-shape fits (e.g. TuneHyperparameters trials) hit the jit cache
     instead of re-compiling with the dataset baked in as constants."""
@@ -62,9 +62,10 @@ def _run_linear(Xd, yd, wd, params, n_out, loss_kind, reg, lr, steps):
 def _jitted_runner():
     import jax
     if _jitted_runner._cached is None:
+        # reg/lr are traced scalars so hyperparameter sweeps share ONE
+        # compilation; only shape-determining knobs are static
         _jitted_runner._cached = jax.jit(
-            _run_linear,
-            static_argnames=("n_out", "loss_kind", "reg", "lr", "steps"))
+            _run_linear, static_argnames=("n_out", "loss_kind", "steps"))
     return _jitted_runner._cached
 
 
@@ -86,8 +87,8 @@ def _fit_linear(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
         "W": jax.random.normal(key, (X.shape[1], n_out)) * 0.01,
         "b": jnp.zeros((n_out,)),
     }
-    p = _jitted_runner()(Xd, yd, wd, params, n_out=n_out, loss_kind=loss_kind,
-                         reg=reg, lr=lr, steps=steps)
+    p = _jitted_runner()(Xd, yd, wd, params, jnp.float32(reg), jnp.float32(lr),
+                         n_out=n_out, loss_kind=loss_kind, steps=steps)
     return np.asarray(p["W"]), np.asarray(p["b"])
 
 

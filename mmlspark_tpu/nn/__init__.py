@@ -1,0 +1,6 @@
+from .balltree import BallTree
+from .knn import (KNN, ConditionalKNN, ConditionalKNNModel, KNNModel,
+                  brute_force_knn)
+
+__all__ = ["BallTree", "KNN", "KNNModel", "ConditionalKNN",
+           "ConditionalKNNModel", "brute_force_knn"]

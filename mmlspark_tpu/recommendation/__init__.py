@@ -1,0 +1,11 @@
+from .ranking import (RankingAdapter, RankingAdapterModel, RankingEvaluator,
+                      RankingTrainValidationSplit, RecommendationIndexer,
+                      RecommendationIndexerModel)
+from .sar import SAR, SARModel
+
+__all__ = [
+    "SAR", "SARModel",
+    "RecommendationIndexer", "RecommendationIndexerModel",
+    "RankingEvaluator", "RankingAdapter", "RankingAdapterModel",
+    "RankingTrainValidationSplit",
+]

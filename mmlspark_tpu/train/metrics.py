@@ -32,11 +32,15 @@ def _class_order(df: DataFrame, scores_col: str, label_col: str,
     observed labels and predictions (an eval frame may contain only a subset
     of the model's classes)."""
     from ..core.schema import get_label_metadata
+    seen = {_plain(v) for v in y} | {_plain(v) for v in pred}
     for col in (scores_col, label_col):
         meta = get_label_metadata(df, col)
         if meta.get("classes"):
-            return [_plain(c) for c in meta["classes"]]
-    seen = {_plain(v) for v in y} | {_plain(v) for v in pred}
+            classes = [_plain(c) for c in meta["classes"]]
+            # tolerate labels the model never saw: append after model classes
+            extras = sorted(seen - set(classes),
+                            key=lambda v: (str(type(v)), v))
+            return classes + extras
     return sorted(seen, key=lambda v: (str(type(v)), v))
 
 
@@ -147,11 +151,20 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol):
             table = {c: i for i, c in enumerate(classes)}
             y_idx = np.asarray([table[_plain(v)] for v in y])
             probs = np.stack([np.asarray(p).ravel() for p in df[prob_col]])
+            from ..core.schema import get_label_metadata
+            has_meta = any(get_label_metadata(df, c).get("classes")
+                           for c in (self.get("scores_col"),
+                                     self.get("label_col")))
+            if probs.shape[1] != len(classes) and not has_meta:
+                raise ValueError(
+                    f"probability vectors have {probs.shape[1]} entries but "
+                    f"{len(classes)} distinct label/prediction values were "
+                    "observed; without label metadata the class order is "
+                    "ambiguous — attach it via set_label_metadata")
             if probs.shape[1] < len(classes):
                 raise ValueError(
                     f"probability column has {probs.shape[1]} entries but "
-                    f"{len(classes)} classes are in play; attach label "
-                    "metadata with the model's class order")
+                    f"{len(classes)} classes are in play")
             p_true = probs[np.arange(len(y_idx)), y_idx]
             return df.with_column("log_loss", -np.log(np.maximum(p_true, 1e-15)))
         pf = df[self.get("scores_col")].astype(np.float64)

@@ -1,0 +1,181 @@
+"""Tests for KNN/BallTree, SAR recommendation, isolation forest, and data
+balance (reference: nn/, recommendation/, isolationforest/, exploratory/)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.exploratory import (AggregateBalanceMeasure,
+                                      DistributionBalanceMeasure,
+                                      FeatureBalanceMeasure)
+from mmlspark_tpu.isolationforest import IsolationForest
+from mmlspark_tpu.nn import KNN, BallTree, ConditionalKNN, brute_force_knn
+from mmlspark_tpu.recommendation import (SAR, RankingEvaluator,
+                                         RankingTrainValidationSplit,
+                                         RecommendationIndexer)
+
+
+def _vec_col(X):
+    col = np.empty(len(X), dtype=object)
+    for i in range(len(X)):
+        col[i] = X[i]
+    return col
+
+
+def test_balltree_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (200, 5))
+    tree = BallTree(X, leaf_size=16)
+    q = rng.normal(0, 1, 5)
+    idx, dist = tree.query(q, k=7)
+    expected = np.argsort(np.linalg.norm(X - q, axis=1))[:7]
+    np.testing.assert_array_equal(np.sort(idx), np.sort(expected))
+    bf_idx, bf_dist = brute_force_knn(X, q[None], 7)
+    np.testing.assert_array_equal(np.sort(bf_idx[0]), np.sort(expected))
+    np.testing.assert_allclose(np.sort(dist), np.sort(bf_dist[0]), atol=1e-4)
+
+
+def test_balltree_serialization_roundtrip():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (50, 3))
+    tree = BallTree(X, labels=np.arange(50) % 3)
+    tree2 = BallTree.from_tree(tree.to_tree())
+    q = rng.normal(0, 1, 3)
+    assert tree.query(q, k=3)[0] == tree2.query(q, k=3)[0]
+
+
+def test_knn_model(tmp_save):
+    rng = np.random.default_rng(2)
+    X = rng.normal(0, 1, (100, 4))
+    df = DataFrame({"features": _vec_col(X),
+                    "values": [f"doc{i}" for i in range(100)]})
+    model = KNN(k=3).fit(df)
+    out = model.transform(df.head(5))
+    matches = out["output"][0]
+    assert len(matches) == 3
+    assert matches[0]["value"] == "doc0"  # self is its own nearest
+    assert matches[0]["distance"] <= matches[1]["distance"]
+    model.save(tmp_save)
+    from mmlspark_tpu.nn import KNNModel
+    loaded = KNNModel.load(tmp_save)
+    out2 = loaded.transform(df.head(5))
+    assert out2["output"][0][0]["value"] == "doc0"
+
+
+def test_conditional_knn_label_filter():
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (60, 3))
+    labels = np.array(["a", "b", "c"] * 20)
+    df = DataFrame({"features": _vec_col(X), "labels": labels,
+                    "values": list(range(60))})
+    model = ConditionalKNN(k=4).fit(df)
+    qdf = DataFrame({"features": _vec_col(X[:3]),
+                     "conditioner": [["a"], ["b"], ["a", "c"]]})
+    out = model.transform(qdf)
+    assert all(m["label"] == "a" for m in out["output"][0])
+    assert all(m["label"] == "b" for m in out["output"][1])
+    assert all(m["label"] in ("a", "c") for m in out["output"][2])
+
+
+def test_sar_recommends_similar_items():
+    # users 0-4 like items {0,1}, users 5-9 like items {2,3}
+    rows = []
+    for u in range(5):
+        rows += [(u, 0), (u, 1)]
+    for u in range(5, 10):
+        rows += [(u, 2), (u, 3)]
+    rows.append((0, 0))  # duplicate interaction
+    df = DataFrame({"user": [r[0] for r in rows],
+                    "item": [r[1] for r in rows]})
+    model = SAR(support_threshold=1).fit(df)
+    S = np.asarray(model.get("item_similarity"))
+    assert S[0, 1] > S[0, 2]  # co-liked items are similar
+    recs = model.recommend_for_all_users(k=2, remove_seen=True)
+    # user 0 saw items 0,1; recommendations must be from {2,3}
+    assert set(recs["recommendations"][0]) <= {2, 3}
+
+    scored = model.transform(DataFrame({"user": [0, 0], "item": [1, 2]}))
+    assert scored["prediction"][0] > scored["prediction"][1]
+
+
+def test_recommendation_indexer():
+    df = DataFrame({"user": ["u1", "u2", "u1"], "item": ["iA", "iB", "iB"]})
+    model = RecommendationIndexer().fit(df)
+    out = model.transform(df)
+    assert out["user_idx"].dtype == np.int64
+    assert model.recover_user(out["user_idx"][0]) == "u1"
+    assert model.recover_item(out["item_idx"][1]) == "iB"
+
+
+def test_ranking_evaluator():
+    df = DataFrame({
+        "recommendations": [[1, 2, 3], [4, 5, 6]],
+        "labels": [[1, 3], [9]],
+    })
+    ev = RankingEvaluator(k=3)
+    row = ev.transform(df)
+    assert 0.0 < row["ndcgAt"][0] < 1.0
+    assert row["recallAtK"][0] == 0.5  # user1 fully recalled, user2 zero
+    assert ev.evaluate(df) == row["ndcgAt"][0]
+
+
+def test_ranking_train_validation_split():
+    rng = np.random.default_rng(4)
+    rows = [(u, i) for u in range(6) for i in range(8)
+            if rng.random() > 0.3]
+    df = DataFrame({"user": [r[0] for r in rows],
+                    "item": [r[1] for r in rows]})
+    tvs = RankingTrainValidationSplit(
+        recommender=SAR(support_threshold=1), train_ratio=0.7, k=4, seed=0)
+    model = tvs.fit(df)
+    assert tvs.validation_metrics is not None
+    assert set(tvs.validation_metrics) == {"ndcgAt", "map", "precisionAtk",
+                                           "recallAtK"}
+    out = model.transform(df)
+    assert "recommendations" in out.columns
+
+
+def test_isolation_forest_flags_outliers(tmp_save):
+    rng = np.random.default_rng(5)
+    inliers = rng.normal(0, 0.5, (200, 2))
+    outliers = np.array([[6.0, 6.0], [-7.0, 7.0], [8.0, -6.0]])
+    X = np.vstack([inliers, outliers])
+    df = DataFrame({"features": _vec_col(X)})
+    model = IsolationForest(num_estimators=50, max_samples=64,
+                            contamination=3 / 203).fit(df)
+    out = model.transform(df)
+    scores = out["outlierScore"]
+    assert scores[200:].min() > scores[:200].mean()
+    assert out["prediction"][200:].sum() == 3
+    model.save(tmp_save)
+    from mmlspark_tpu.isolationforest import IsolationForestModel
+    loaded = IsolationForestModel.load(tmp_save)
+    np.testing.assert_allclose(loaded.transform(df)["outlierScore"], scores)
+
+
+def test_feature_balance_measure():
+    df = DataFrame({
+        "gender": ["m"] * 6 + ["f"] * 4,
+        "label": [1, 1, 1, 1, 0, 0, 1, 0, 0, 0],
+    })
+    out = FeatureBalanceMeasure(sensitive_cols=["gender"],
+                                label_col="label").transform(df)
+    row = out.to_rows()[0]
+    # P(pos|f)=0.25, P(pos|m)=2/3 → dp = P(pos|ClassA) - P(pos|ClassB)
+    assert abs(abs(row["dp"]) - abs(2 / 3 - 0.25)) < 1e-9
+
+
+def test_distribution_and_aggregate_balance():
+    df = DataFrame({"col": ["a"] * 8 + ["b"] * 2})
+    dist = DistributionBalanceMeasure(sensitive_cols=["col"]).transform(df)
+    assert dist["kl_divergence"][0] > 0
+    assert 0 < dist["total_variation_dist"][0] <= 1
+
+    uniform = DataFrame({"col": ["a", "b"] * 5})
+    d2 = DistributionBalanceMeasure(sensitive_cols=["col"]).transform(uniform)
+    assert abs(d2["kl_divergence"][0]) < 1e-12
+
+    agg = AggregateBalanceMeasure(sensitive_cols=["col"]).transform(df)
+    agg_u = AggregateBalanceMeasure(sensitive_cols=["col"]).transform(uniform)
+    assert agg["atkinson_index"][0] > agg_u["atkinson_index"][0]
+    assert abs(agg_u["theil_t_index"][0]) < 1e-12
