@@ -22,7 +22,16 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 
 import numpy as np
 
-__all__ = ["DataFrame", "concat"]
+__all__ = ["DataFrame", "concat", "object_col"]
+
+
+def object_col(values) -> np.ndarray:
+    """Build a 1-D object column without numpy coercing nested sequences."""
+    values = list(values) if not isinstance(values, (list, np.ndarray)) else values
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
 
 
 def _as_column(values) -> np.ndarray:

@@ -1,0 +1,33 @@
+"""HTTP-on-DataFrame: embed web services as pipeline stages.
+
+Parity surface: the reference's HTTP-on-Spark package
+(``core/src/main/scala/com/microsoft/azure/synapse/ml/io/http/``):
+request/response row bindings (``HTTPSchema.scala:26-208``), pooled +
+async clients with a retry ladder honouring 429 Retry-After
+(``HTTPClients.scala:27-170``, ``HandlingUtils.sendWithRetries:75-125``),
+input/output parsers (``Parsers.scala``), and the
+``HTTPTransformer``/``SimpleHTTPTransformer`` stages
+(``HTTPTransformer.scala:91-146``, ``SimpleHTTPTransformer.scala:64-171``).
+
+TPU-first framing: outbound HTTP is host-side work and never touches the
+device; concurrency is a thread pool with bounded in-flight futures
+(the reference's ``AsyncUtils.bufferedAwait`` pattern) so a service stage
+can saturate the network while the accelerator pipeline keeps streaming.
+"""
+
+from .schema import (EntityData, HeaderData, HTTPRequestData,
+                     HTTPResponseData, StatusLineData)
+from .clients import (AsyncHTTPClient, SingleThreadedHTTPClient,
+                      advanced_handler, basic_handler, send_with_retries)
+from .parsers import (CustomInputParser, CustomOutputParser, JSONInputParser,
+                      JSONOutputParser, StringOutputParser)
+from .http_transformer import HTTPTransformer, SimpleHTTPTransformer
+
+__all__ = [
+    "HeaderData", "EntityData", "StatusLineData", "HTTPRequestData",
+    "HTTPResponseData", "send_with_retries", "advanced_handler",
+    "basic_handler", "SingleThreadedHTTPClient", "AsyncHTTPClient",
+    "JSONInputParser", "CustomInputParser", "JSONOutputParser",
+    "StringOutputParser", "CustomOutputParser", "HTTPTransformer",
+    "SimpleHTTPTransformer",
+]

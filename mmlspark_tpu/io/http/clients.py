@@ -1,0 +1,142 @@
+"""Pooled + async HTTP clients with the reference's retry ladder.
+
+Parity: ``io/http/HTTPClients.scala`` / ``Clients.scala``:
+
+* ``send_with_retries`` — status handling of ``HandlingUtils.sendWithRetries``
+  (``HTTPClients.scala:75-125``): 200/201/202/400 succeed, 429 sleeps for the
+  ``Retry-After`` header and does NOT consume a retry, anything else burns one
+  entry of the backoff ladder (default 100/500/1000 ms).
+* ``advanced_handler`` / ``basic_handler`` — ``HandlingUtils.advanced/basic``
+  (``:126-155``); socket timeouts return ``None`` like the reference.
+* ``SingleThreadedHTTPClient`` / ``AsyncHTTPClient`` — the sync and
+  bounded-concurrency clients (``Clients.scala:26-62``); the async variant
+  keeps at most ``concurrency`` requests in flight via
+  :func:`mmlspark_tpu.utils.async_utils.map_buffered`, the futures+
+  ``bufferedAwait`` pattern of the reference.
+
+One pooled ``requests.Session`` is shared per process via ``SharedVariable``,
+mirroring the reference's per-JVM client sharing
+(``HTTPTransformer.scala:101-113``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import requests
+
+from ...utils.async_utils import map_buffered
+from ...utils.shared import SharedVariable
+from .schema import (EntityData, HeaderData, HTTPRequestData,
+                     HTTPResponseData, StatusLineData)
+
+__all__ = ["send_with_retries", "advanced_handler", "basic_handler",
+           "SingleThreadedHTTPClient", "AsyncHTTPClient", "shared_session"]
+
+DEFAULT_BACKOFFS_MS = (100, 500, 1000)
+
+#: per-process pooled session (reference: SharedVariable[CloseableHttpClient])
+shared_session: SharedVariable = SharedVariable(lambda: requests.Session())
+
+
+def _to_response(resp: requests.Response) -> HTTPResponseData:
+    headers = [HeaderData(k, v) for k, v in resp.headers.items()]
+    ct = resp.headers.get("Content-Type")
+    entity = EntityData(
+        content=resp.content or b"",
+        content_length=len(resp.content or b""),
+        content_type=HeaderData("Content-Type", ct) if ct else None)
+    return HTTPResponseData(
+        headers=headers, entity=entity,
+        status_line=StatusLineData("HTTP/1.1", resp.status_code, resp.reason or ""))
+
+
+def _execute(session: requests.Session, request: HTTPRequestData,
+             timeout: float) -> requests.Response:
+    body = request.entity.content if request.entity else None
+    return session.request(request.method, request.url,
+                           headers=request.header_map(), data=body,
+                           timeout=timeout)
+
+
+def send_with_retries(session: requests.Session, request: HTTPRequestData,
+                      backoffs_ms: Iterable[int] = DEFAULT_BACKOFFS_MS,
+                      timeout: float = 60.0) -> HTTPResponseData:
+    """Reference semantics of ``HandlingUtils.sendWithRetries:75-125``."""
+    retries: List[int] = list(backoffs_ms)
+    while True:
+        resp = _execute(session, request, timeout)
+        code = resp.status_code
+        if code in (200, 201, 202, 400):
+            return _to_response(resp)
+        if code == 429:
+            retry_after = resp.headers.get("Retry-After")
+            if retry_after is not None:
+                try:
+                    time.sleep(float(retry_after))
+                except ValueError:
+                    pass
+            # rate limiting does not consume a retry (reference :115-118)
+            if not retries:
+                return _to_response(resp)
+            time.sleep(retries[0] / 1000.0)
+            continue
+        if not retries:
+            return _to_response(resp)
+        time.sleep(retries.pop(0) / 1000.0)
+
+
+def advanced_handler(*backoffs_ms: int, timeout: float = 60.0
+                     ) -> Callable[[requests.Session, HTTPRequestData],
+                                   Optional[HTTPResponseData]]:
+    """``HandlingUtils.advanced`` — retries; timeout → None (``:126-144``)."""
+    ladder = backoffs_ms or DEFAULT_BACKOFFS_MS
+
+    def handle(session, request):
+        try:
+            return send_with_retries(session, request, ladder, timeout)
+        except (requests.Timeout, requests.ConnectionError):
+            return None
+
+    return handle
+
+
+def basic_handler(session: requests.Session,
+                  request: HTTPRequestData) -> HTTPResponseData:
+    """``HandlingUtils.basic`` — one shot, no retries (``:147-152``)."""
+    return _to_response(_execute(session, request, 60.0))
+
+
+class SingleThreadedHTTPClient:
+    """Sequential client (reference ``SingleThreadedHTTPClient``)."""
+
+    def __init__(self, handler=None, timeout: float = 60.0):
+        self.handler = handler or advanced_handler(timeout=timeout)
+
+    def send(self, requests_it: Iterable[Optional[HTTPRequestData]]
+             ) -> Iterator[Optional[HTTPResponseData]]:
+        session = shared_session.get()
+        for req in requests_it:
+            yield None if req is None else self.handler(session, req)
+
+
+class AsyncHTTPClient:
+    """Bounded-concurrency client: ≤ ``concurrency`` requests in flight,
+    results yielded in submission order (reference ``AsyncClient`` +
+    ``AsyncUtils.bufferedAwait``, ``Clients.scala:48-62``)."""
+
+    def __init__(self, concurrency: int, handler=None, timeout: float = 60.0):
+        if concurrency < 1:
+            raise ValueError("concurrency must be >= 1")
+        self.concurrency = concurrency
+        self.handler = handler or advanced_handler(timeout=timeout)
+
+    def send(self, requests_it: Iterable[Optional[HTTPRequestData]]
+             ) -> Iterator[Optional[HTTPResponseData]]:
+        session = shared_session.get()
+
+        def one(req):
+            return None if req is None else self.handler(session, req)
+
+        yield from map_buffered(one, requests_it, self.concurrency)

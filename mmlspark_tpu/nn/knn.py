@@ -33,21 +33,38 @@ def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
     return np.asarray(vals, dtype=np.float64).reshape(len(df), -1)
 
 
+_BRUTE_KNN = None
+
+
+def _brute_knn_jitted():
+    # module-level cache so repeated transforms hit jax's jit cache instead
+    # of recompiling per call
+    global _BRUTE_KNN
+    if _BRUTE_KNN is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def run(C, Q, k):
+            c2 = jnp.sum(C * C, axis=1)
+            q2 = jnp.sum(Q * Q, axis=1)
+            d2 = q2[:, None] + c2[None, :] - 2.0 * (Q @ C.T)  # MXU matmul
+            neg, idx = jax.lax.top_k(-d2, k)
+            return idx, jnp.sqrt(jnp.maximum(-neg, 0.0))
+
+        _BRUTE_KNN = run
+    return _BRUTE_KNN
+
+
 def brute_force_knn(corpus: np.ndarray, queries: np.ndarray, k: int):
     """Batched exact top-k on device. Returns (indices, distances)."""
-    import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def run(C, Q):
-        c2 = jnp.sum(C * C, axis=1)
-        q2 = jnp.sum(Q * Q, axis=1)
-        d2 = q2[:, None] + c2[None, :] - 2.0 * (Q @ C.T)  # MXU matmul
-        neg, idx = jax.lax.top_k(-d2, k)
-        return idx, jnp.sqrt(jnp.maximum(-neg, 0.0))
-
+    run = _brute_knn_jitted()
     idx, dist = run(jnp.asarray(corpus, jnp.float32),
-                    jnp.asarray(queries, jnp.float32))
+                    jnp.asarray(queries, jnp.float32), int(k))
     return np.asarray(idx), np.asarray(dist)
 
 

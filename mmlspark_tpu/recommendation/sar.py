@@ -21,9 +21,16 @@ from ..core.pipeline import Estimator, Model
 
 __all__ = ["SAR", "SARModel"]
 
+_JITTED = {}
 
-class _SARParams:
-    pass
+
+def _jitted(name, fn):
+    # module-level jit cache — per-call @jax.jit closures would retrace on
+    # every invocation
+    if name not in _JITTED:
+        import jax
+        _JITTED[name] = jax.jit(fn)
+    return _JITTED[name]
 
 
 class SAR(Estimator):
@@ -40,7 +47,6 @@ class SAR(Estimator):
                              doc="half-life in days for affinity decay")
 
     def _fit(self, df: DataFrame) -> "SARModel":
-        import jax
         import jax.numpy as jnp
 
         users = df[self.get("user_col")].astype(np.int64)
@@ -69,10 +75,8 @@ class SAR(Estimator):
         np.add.at(occ, (users, items), 1.0)
         occ = (occ > 0).astype(np.float32)
 
-        @jax.jit
-        def cooccur(O):
-            return O.T @ O  # (items, items) co-occurrence on the MXU
-
+        # (items, items) co-occurrence on the MXU
+        cooccur = _jitted("cooccur", lambda O: O.T @ O)
         C = np.asarray(cooccur(jnp.asarray(occ)))
         C = np.where(C >= self.get("support_threshold"), C, 0.0)
         diag = np.diag(C).copy()
@@ -102,13 +106,9 @@ class SARModel(Model):
     user_affinity = ComplexParam(default=None, doc="(users, items) matrix")
 
     def _scores(self) -> np.ndarray:
-        import jax
         import jax.numpy as jnp
 
-        @jax.jit
-        def run(A, S):
-            return A @ S
-
+        run = _jitted("affinity_matmul", lambda A, S: A @ S)
         return np.asarray(run(jnp.asarray(self.get("user_affinity")),
                               jnp.asarray(self.get("item_similarity"))))
 
@@ -135,8 +135,10 @@ class SARModel(Model):
         recs = np.empty(n_users, dtype=object)
         ratings = np.empty(n_users, dtype=object)
         for u in range(n_users):
-            recs[u] = top[u].tolist()
-            ratings[u] = [float(scores[u, i]) if np.isfinite(scores[u, i])
-                          else 0.0 for i in top[u]]
+            # seen items were masked to -inf; a user with < k unseen items
+            # gets a shorter list rather than padded fake recommendations
+            keep = [i for i in top[u] if np.isfinite(scores[u, i])]
+            recs[u] = [int(i) for i in keep]
+            ratings[u] = [float(scores[u, i]) for i in keep]
         return DataFrame({self.get("user_col"): np.arange(n_users),
                           "recommendations": recs, "ratings": ratings})

@@ -1,0 +1,28 @@
+"""DataFrame-as-microservice: serve a pipeline over HTTP.
+
+Parity surface: the reference's Spark Serving
+(``org/apache/spark/sql/execution/streaming/{HTTPSource,DistributedHTTPSource}.scala``
+and ``continuous/{HTTPSourceV2,HTTPSinkV2}.scala``):
+
+* per-worker HTTP server with epoch-keyed request queues
+  (``HTTPSourceV2.scala:476-697``, queues ``:512-518``)
+* reply routing back to the originating connection
+  (``HTTPSinkV2.scala:105-148``, ``WorkerServer.replyTo:536-554``)
+* failure replay: unanswered requests of an epoch are re-served after a
+  worker restart (``registerPartition`` rehydration, ``:489-506,556-568``)
+* the ``IOImplicits`` DSL (``io/IOImplicits.scala:20-220``):
+  ``parse_request`` / ``make_reply`` here are module functions instead of
+  DataFrame extension methods.
+
+TPU-first framing: requests buffer on the host and drain as *columnar
+batches* into the same minibatch→pad→device path every other stage uses, so
+a served model hits the chip with large static-shape batches instead of
+row-at-a-time inference.
+"""
+
+from .server import CachedRequest, WorkerServer
+from .source import HTTPSource, parse_request, make_reply, HTTPSink
+from .engine import ServingEngine
+
+__all__ = ["CachedRequest", "WorkerServer", "HTTPSource", "HTTPSink",
+           "parse_request", "make_reply", "ServingEngine"]

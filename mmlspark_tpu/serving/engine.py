@@ -1,0 +1,100 @@
+"""The serving loop: drain requests → transform → route replies.
+
+Parity: the continuous-mode request lifecycle of the reference
+(SURVEY.md §3.3): requests park in the worker server, a reader turns them
+into rows, the user pipeline computes a reply column, the sink routes
+replies back, and each drained batch closes an epoch. The reference spreads
+this across Spark's continuous-processing engine; here it is an explicit
+background loop per host — the pipeline's ``transform`` still executes on
+the TPU through the normal batching layer, so served traffic gets the same
+large static-shape device batches as offline scoring.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from typing import Callable, Dict, Optional
+
+from ..core.dataframe import DataFrame
+from .server import WorkerServer
+from .source import HTTPSink, HTTPSource, parse_request
+
+__all__ = ["ServingEngine"]
+
+_log = logging.getLogger("mmlspark_tpu.serving")
+
+
+class ServingEngine:
+    """Run ``transform_fn`` (typically ``pipeline_model.transform``) over
+    incoming HTTP requests.
+
+    ``schema`` maps JSON body fields to column types; ``reply_col`` names the
+    column whose values are JSON-encoded back to the caller.
+    """
+
+    def __init__(self, transform_fn: Callable[[DataFrame], DataFrame],
+                 schema: Optional[Dict[str, type]] = None,
+                 reply_col: str = "reply",
+                 host: str = "127.0.0.1", port: int = 0, api_path: str = "/",
+                 max_batch: int = 1024, poll_timeout: float = 0.05,
+                 reply_timeout: float = 60.0):
+        self.transform_fn = transform_fn
+        self.schema = schema
+        self.reply_col = reply_col
+        self.max_batch = max_batch
+        self.poll_timeout = poll_timeout
+        self.server = WorkerServer(host, port, api_path,
+                                   reply_timeout=reply_timeout)
+        self.source = HTTPSource(self.server)
+        self.sink = HTTPSink(self.server, reply_col=self.reply_col)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self) -> "ServingEngine":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"serving-engine-{self.server.port}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            df = self.source.read_batch(self.max_batch, self.poll_timeout)
+            if len(df) == 0:
+                continue
+            ids = df["id"]
+            try:
+                parsed = parse_request(df, self.schema)
+                out = self.transform_fn(parsed)
+                self.sink.write_batch(out)
+                # rows the transform dropped (filters etc.) must still be
+                # answered, or their CachedRequests leak in the routing table
+                surviving = set(out["id"]) if "id" in out else set()
+                for rid in ids:
+                    if rid not in surviving:
+                        self.server.reply_json(
+                            rid, {"error": "row dropped by pipeline"},
+                            status=400)
+            except Exception:
+                _log.error("serving batch failed:\n%s", traceback.format_exc())
+                for rid in ids:
+                    self.server.reply_json(
+                        rid, {"error": "internal error"}, status=500)
+            self.server.commit_epoch()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.server.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,195 @@
+"""Per-worker HTTP server with epoch-keyed queues and replay.
+
+Parity: ``WorkerServer`` (``HTTPSourceV2.scala:476-697``) — a lightweight
+HTTP server per worker process; incoming requests are parked in an
+epoch-keyed queue (``:512-518``), handed to the engine as batches, and
+answered later through a routing table (``replyTo``/``respondToHTTPExchange``,
+``:536-554``). Unanswered requests of an epoch survive an engine restart and
+are re-served (history rehydration, ``:489-506,556-568``).
+
+Implementation: ``ThreadingHTTPServer`` (one thread per connection, parked on
+a per-request ``threading.Event`` until the reply lands) — the Python shape
+of the reference's ``com.sun.net.httpserver`` + blocked ``HttpExchange``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
+                              HTTPResponseData, StatusLineData)
+
+__all__ = ["CachedRequest", "WorkerServer"]
+
+
+@dataclass
+class CachedRequest:
+    """Parity: ``CachedRequest`` — a parked exchange + its id."""
+    request_id: str
+    epoch: int
+    request: HTTPRequestData
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+    _response: Optional[HTTPResponseData] = field(default=None, repr=False)
+
+    def respond(self, response: HTTPResponseData) -> None:
+        self._response = response
+        self._done.set()
+
+    def wait(self, timeout: Optional[float]) -> Optional[HTTPResponseData]:
+        if self._done.wait(timeout):
+            return self._response
+        return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mmlspark-tpu-serving/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _handle(self):
+        ws: "WorkerServer" = self.server.worker_server  # type: ignore[attr-defined]
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        req = HTTPRequestData(
+            url=self.path, method=self.command,
+            headers=[HeaderData(k, v) for k, v in self.headers.items()],
+            entity=EntityData(content=body, content_length=len(body)) if body else None)
+        cached = ws._enqueue(req)
+        resp = cached.wait(ws.reply_timeout)
+        if resp is None:
+            self.send_response(504, "serving reply timeout")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        payload = resp.entity.content if resp.entity else b""
+        self.send_response(resp.status_line.status_code,
+                           resp.status_line.reason_phrase or None)
+        sent = {h.name.lower() for h in resp.headers}
+        for h in resp.headers:
+            if h.name.lower() not in ("content-length", "connection"):
+                self.send_header(h.name, h.value)
+        if "content-type" not in sent and payload:
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        if payload:
+            self.wfile.write(payload)
+
+    do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+
+class WorkerServer:
+    """HTTP listener + epoch request queue + reply routing table."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 60.0,
+                 max_queue: int = 10_000):
+        self.reply_timeout = reply_timeout
+        self._queue: "queue.Queue[CachedRequest]" = queue.Queue(max_queue)
+        #: request_id → CachedRequest (reference: routingTable ``:689``)
+        self._routing: Dict[str, CachedRequest] = {}
+        #: epoch → {request_id: CachedRequest} (reference: historyQueues)
+        self._history: Dict[int, Dict[str, CachedRequest]] = {}
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.worker_server = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.api_path = api_path
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"serving-{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    # -- ingest -------------------------------------------------------------
+    def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
+        with self._lock:
+            cached = CachedRequest(uuid.uuid4().hex, self._epoch, request)
+            self._routing[cached.request_id] = cached
+            self._history.setdefault(cached.epoch, {})[cached.request_id] = cached
+        self._queue.put(cached)
+        return cached
+
+    # -- engine side --------------------------------------------------------
+    def get_batch(self, max_rows: int, timeout: float = 0.1):
+        """Drain up to ``max_rows`` parked requests (blocks up to ``timeout``
+        for the first one). Returns a list of :class:`CachedRequest`."""
+        out = []
+        try:
+            out.append(self._queue.get(timeout=timeout))
+        except queue.Empty:
+            return out
+        while len(out) < max_rows:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def reply(self, request_id: str, response: HTTPResponseData) -> bool:
+        """Route a response to the parked connection
+        (parity: ``replyTo`` ``:536-554``)."""
+        with self._lock:
+            cached = self._routing.pop(request_id, None)
+            if cached is not None:
+                self._history.get(cached.epoch, {}).pop(request_id, None)
+        if cached is None:
+            return False
+        cached.respond(response)
+        return True
+
+    def reply_json(self, request_id: str, payload, status: int = 200) -> bool:
+        import json as _json
+        ent = EntityData.from_string(_json.dumps(payload))
+        return self.reply(request_id, HTTPResponseData(
+            entity=ent, status_line=StatusLineData(status_code=status)))
+
+    # -- epoch / replay -----------------------------------------------------
+    def commit_epoch(self) -> int:
+        """Close the current epoch; fully-answered epochs drop their history
+        (parity: ``commit`` ``:609-645``)."""
+        with self._lock:
+            done = [e for e, reqs in self._history.items()
+                    if e < self._epoch and not reqs]
+            for e in done:
+                del self._history[e]
+            self._epoch += 1
+            return self._epoch
+
+    def replay_unanswered(self) -> int:
+        """Re-enqueue every routed-but-unanswered request — the recovery a
+        restarted reader performs (parity: ``registerPartition`` rehydration
+        ``:489-506``). Returns the number of requests replayed."""
+        # drain the live queue BEFORE snapshotting: a request that arrives
+        # between snapshot and drain would otherwise be drained but absent
+        # from the snapshot, and so lost
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            pending = [c for c in self._routing.values() if not c._done.is_set()]
+        for c in pending:
+            self._queue.put(c)
+        return len(pending)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._routing)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
