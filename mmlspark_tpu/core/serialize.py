@@ -28,7 +28,17 @@ import numpy as np
 from .params import ComplexParam
 from .pipeline import PipelineStage
 
-__all__ = ["save_stage", "load_stage", "save_value", "load_value"]
+__all__ = ["save_stage", "load_stage", "save_value", "load_value",
+           "to_jsonable"]
+
+
+def to_jsonable(v):
+    """Coerce numpy scalars/arrays to JSON-encodable python values."""
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
 
 _FORMAT_VERSION = 1
 

@@ -1,0 +1,28 @@
+"""Image pipeline stages.
+
+Parity surface: the reference's ``opencv`` module
+(``opencv/.../ImageTransformer.scala``, ``ImageSetAugmenter.scala``) and the
+JVM-side image helpers in core
+(``image/UnrollImage.scala``, ``image/ResizeImageTransformer.scala``).
+
+TPU-first framing: decode/resize/crop are host-side preprocessing on
+uint8 HWC arrays (cv2 — the same native OpenCV the reference reaches via
+JNI); normalization to CHW/NHWC float tensors is the device-feed boundary
+and is vectorized per batch so ``device_put`` sees one contiguous array.
+"""
+
+from .schema import (ImageSchema, decode_image, encode_image, make_image,
+                     to_nchw_tensor, to_nhwc_tensor)
+from .transforms import (Blur, CenterCropImage, ColorFormat, CropImage, Flip,
+                         GaussianKernel, ImageTransformer, ResizeImage,
+                         Threshold)
+from .unroll import ResizeImageTransformer, UnrollBinaryImage, UnrollImage
+from .augment import ImageSetAugmenter
+
+__all__ = [
+    "ImageSchema", "make_image", "decode_image", "encode_image",
+    "to_nchw_tensor", "to_nhwc_tensor", "ImageTransformer", "ResizeImage",
+    "CropImage", "CenterCropImage", "ColorFormat", "Blur", "Threshold",
+    "GaussianKernel", "Flip", "UnrollImage", "UnrollBinaryImage",
+    "ResizeImageTransformer", "ImageSetAugmenter",
+]

@@ -1,0 +1,119 @@
+"""Unroll/resize stages (the opencv-free JVM path of the reference).
+
+Parity: ``core/.../image/UnrollImage.scala:31-152`` (HWC uint8 image →
+flat CHW double vector, with ``roll`` inverse), ``UnrollBinaryImage:187``
+(decode+resize+unroll straight from compressed bytes), and
+``ResizeImageTransformer.scala:59`` (resize without the OpenCV module).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, object_col
+from ..core.params import HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Transformer
+from .schema import ImageSchema, decode_image, make_image
+
+__all__ = ["unroll", "roll", "UnrollImage", "UnrollBinaryImage",
+           "ResizeImageTransformer"]
+
+
+def unroll(image: dict) -> np.ndarray:
+    """HWC uint8 → flat float64 vector in CHW order
+    (parity: ``UnrollImage.unroll:31-56``)."""
+    data = np.asarray(image["data"], dtype=np.uint8)
+    return np.transpose(data, (2, 0, 1)).astype(np.float64).ravel()
+
+
+def roll(values: np.ndarray, like: dict) -> dict:
+    """Inverse of :func:`unroll` (parity: ``UnrollImage.roll:58-127``)."""
+    h, w, c = like["height"], like["width"], like["nChannels"]
+    arr = np.clip(np.round(np.asarray(values, np.float64)), 0, 255)
+    chw = arr.reshape(c, h, w).astype(np.uint8)
+    return make_image(np.transpose(chw, (1, 2, 0)), like.get("origin", ""))
+
+
+def _resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    try:
+        import cv2
+        out = cv2.resize(img, (width, height))
+    except ImportError:
+        from PIL import Image
+        bgr = img[:, :, ::-1] if img.shape[-1] == 3 else img[:, :, 0]
+        out = np.asarray(Image.fromarray(bgr).resize((width, height)))
+        if out.ndim == 3:
+            out = out[:, :, ::-1]
+    return out[:, :, None] if out.ndim == 2 else out
+
+
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """Image struct column → flat float vector column."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._set_default(input_col="image", output_col="<image>")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("input_col")]
+        return df.with_column(
+            self.get("output_col"),
+            object_col([None if c is None else unroll(c) for c in col]))
+
+
+class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
+    """Compressed bytes column → decode (+optional resize) → flat vector
+    (parity: ``UnrollBinaryImage:187``, ``unrollBytes:129-150``)."""
+
+    height = Param(int, default=None, doc="resize height (optional)")
+    width = Param(int, default=None, doc="resize width (optional)")
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._set_default(input_col="image", output_col="<image>")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        h, w = self.get_or_none("height"), self.get_or_none("width")
+        out = []
+        for c in df[self.get("input_col")]:
+            if c is None:
+                out.append(None)
+                continue
+            img = decode_image(bytes(c)) if isinstance(c, (bytes, bytearray)) else c
+            if img is None:
+                out.append(None)
+                continue
+            data = img["data"]
+            if h is not None and w is not None:
+                data = _resize(data, h, w)
+            out.append(unroll(make_image(data, img.get("origin", ""))))
+        return df.with_column(self.get("output_col"), object_col(out))
+
+
+class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Resize image structs (parity: ``ResizeImageTransformer.scala:59``)."""
+
+    height = Param(int, doc="target height")
+    width = Param(int, doc="target width")
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._set_default(input_col="image", output_col="image")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        h, w = self.get("height"), self.get("width")
+        out = []
+        for c in df[self.get("input_col")]:
+            if c is None:
+                out.append(None)
+                continue
+            if isinstance(c, (bytes, bytearray)):
+                c = decode_image(bytes(c))
+                if c is None:
+                    out.append(None)
+                    continue
+            out.append(make_image(_resize(np.asarray(c["data"], np.uint8), h, w),
+                                  c.get("origin", "")))
+        return df.with_column(self.get("output_col"), object_col(out))

@@ -1,0 +1,12 @@
+"""IO: HTTP-on-DataFrame, binary/image file ingestion, POST sinks.
+
+Parity surface: the reference's ``core/.../ml/io`` package (http, binary,
+image, powerbi) — see the submodules for per-component citations.
+"""
+
+from .binary import list_binary_files, read_binary_files
+from .image_io import read_images
+from .powerbi import PowerBIWriter, write_to_powerbi
+
+__all__ = ["list_binary_files", "read_binary_files", "read_images",
+           "PowerBIWriter", "write_to_powerbi"]

@@ -14,20 +14,20 @@ Parity: ``io/http/HTTPClients.scala`` / ``Clients.scala``:
   :func:`mmlspark_tpu.utils.async_utils.map_buffered`, the futures+
   ``bufferedAwait`` pattern of the reference.
 
-One pooled ``requests.Session`` is shared per process via ``SharedVariable``,
-mirroring the reference's per-JVM client sharing
+Sessions are pooled per thread (``requests.Session`` is not thread-safe),
+mirroring the intent of the reference's per-JVM client sharing
 (``HTTPTransformer.scala:101-113``).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
 import requests
 
 from ...utils.async_utils import map_buffered
-from ...utils.shared import SharedVariable
 from .schema import (EntityData, HeaderData, HTTPRequestData,
                      HTTPResponseData, StatusLineData)
 
@@ -36,8 +36,26 @@ __all__ = ["send_with_retries", "advanced_handler", "basic_handler",
 
 DEFAULT_BACKOFFS_MS = (100, 500, 1000)
 
-#: per-process pooled session (reference: SharedVariable[CloseableHttpClient])
-shared_session: SharedVariable = SharedVariable(lambda: requests.Session())
+
+class _ThreadLocalSession:
+    """One pooled ``requests.Session`` per thread. The reference shares one
+    thread-safe ``CloseableHttpClient`` per JVM; ``requests.Session`` is NOT
+    thread-safe (cookie jar mutation), so the per-process sharing happens at
+    thread granularity here."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def get(self) -> requests.Session:
+        s = getattr(self._local, "session", None)
+        if s is None:
+            s = requests.Session()
+            self._local.session = s
+        return s
+
+
+#: per-process pooled sessions (reference: SharedVariable[CloseableHttpClient])
+shared_session = _ThreadLocalSession()
 
 
 def _to_response(resp: requests.Response) -> HTTPResponseData:
@@ -134,9 +152,9 @@ class AsyncHTTPClient:
 
     def send(self, requests_it: Iterable[Optional[HTTPRequestData]]
              ) -> Iterator[Optional[HTTPResponseData]]:
-        session = shared_session.get()
-
         def one(req):
-            return None if req is None else self.handler(session, req)
+            # resolve the session inside the worker thread: sessions are
+            # thread-local, not process-global
+            return None if req is None else self.handler(shared_session.get(), req)
 
         yield from map_buffered(one, requests_it, self.concurrency)

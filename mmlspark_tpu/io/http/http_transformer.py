@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
 
 from ...core.dataframe import DataFrame, object_col
 from ...core.params import (ComplexParam, HasErrorCol, HasInputCol,

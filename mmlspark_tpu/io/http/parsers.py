@@ -14,6 +14,7 @@ import numpy as np
 
 from ...core.dataframe import DataFrame, object_col
 from ...core.params import ComplexParam, HasInputCol, HasOutputCol, Param
+from ...core.serialize import to_jsonable
 from ...core.pipeline import Transformer
 from .schema import HeaderData, HTTPRequestData, HTTPResponseData
 
@@ -38,17 +39,9 @@ class JSONInputParser(HTTPInputParser):
         hdrs = [HeaderData(k, v) for k, v in self.get("headers").items()]
         url, method = self.get("url"), self.get("method")
         col = df[self.get("input_col")]
-        reqs = [HTTPRequestData.from_json(url, _jsonable(v), method, hdrs)
+        reqs = [HTTPRequestData.from_json(url, to_jsonable(v), method, hdrs)
                 for v in col]
         return df.with_column(self.get("output_col"), object_col(reqs))
-
-
-def _jsonable(v):
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    if isinstance(v, np.generic):
-        return v.item()
-    return v
 
 
 class CustomInputParser(HTTPInputParser):

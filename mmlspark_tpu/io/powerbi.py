@@ -1,0 +1,62 @@
+"""Streaming/batch POST sink for row data.
+
+Parity: ``io/powerbi/PowerBIWriter.scala:114`` — serialize row batches to
+JSON and POST them to a push endpoint, with the shared retry ladder
+(429 Retry-After handled by :mod:`mmlspark_tpu.io.http.clients`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+from ..core.dataframe import DataFrame
+from .http.clients import send_with_retries, shared_session
+from .http.schema import HTTPRequestData
+
+__all__ = ["write_to_powerbi", "PowerBIWriter"]
+
+
+def _json_rows(df: DataFrame, cols: Optional[Sequence[str]]):
+    from ..core.serialize import to_jsonable
+    names = list(cols) if cols else df.columns
+    for row in df.iter_rows():
+        yield {k: to_jsonable(row[k]) for k in names}
+
+
+def write_to_powerbi(df: DataFrame, url: str, batch_size: int = 1000,
+                     cols: Optional[Sequence[str]] = None,
+                     backoffs_ms: Sequence[int] = (100, 500, 1000)) -> int:
+    """POST rows in batches; returns the number of batches sent. Raises on a
+    terminally-failed batch (parity: writer fails the stream task)."""
+    session = shared_session.get()
+    batch, sent = [], 0
+    for row in _json_rows(df, cols):
+        batch.append(row)
+        if len(batch) >= batch_size:
+            _post(session, url, batch, backoffs_ms)
+            sent += 1
+            batch = []
+    if batch:
+        _post(session, url, batch, backoffs_ms)
+        sent += 1
+    return sent
+
+
+def _post(session, url, rows, backoffs_ms):
+    req = HTTPRequestData.from_json(url, {"rows": rows})
+    resp = send_with_retries(session, req, list(backoffs_ms))
+    if resp.status_code not in (200, 201, 202):
+        raise IOError(f"PowerBI push failed: {resp.status_code} "
+                      f"{resp.string_content()[:200]}")
+
+
+class PowerBIWriter:
+    """Object form mirroring ``PowerBIWriter``'s stream/batch API."""
+
+    def __init__(self, url: str, batch_size: int = 1000):
+        self.url = url
+        self.batch_size = batch_size
+
+    def write(self, df: DataFrame) -> int:
+        return write_to_powerbi(df, self.url, self.batch_size)

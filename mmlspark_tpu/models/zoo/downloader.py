@@ -1,0 +1,117 @@
+"""Pretrained-model repository.
+
+Parity: ``deep-learning/.../downloader/ModelDownloader.scala``
+(``Repository[S]:26``, ``HDFSRepo:42``, ``DefaultModelRepo:112``) and the
+``ModelSchema`` metadata (``downloader/Schema.scala``) the featurizer uses
+to find layer names/input shapes.
+
+This environment has zero egress, so the "remote" repository is the
+built-in generator zoo (ResNet family ONNX export); ``LocalRepo`` plays
+the HDFSRepo role for models already materialized on disk. The schema
+format is JSON and the layout is one directory per model, so a real
+remote repo can be mounted the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ModelSchema", "ModelDownloader", "LocalRepo", "BUILTIN_MODELS"]
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Parity: ``downloader/Schema.scala:89`` — the metadata a featurizer
+    needs (input shape, layer names to cut, output info)."""
+    name: str
+    dataset: str = "ImageNet"
+    model_type: str = "image"
+    uri: str = ""
+    input_size: int = 224
+    num_outputs: int = 1000
+    #: outputs ordered head→features: cutOutputLayers indexes into this
+    layer_names: List[str] = dataclasses.field(
+        default_factory=lambda: ["logits", "feat"])
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ModelSchema":
+        return ModelSchema(**json.loads(s))
+
+
+def _gen_resnet50() -> bytes:
+    from .resnet import RESNET50, export_resnet_onnx
+    return export_resnet_onnx(RESNET50, seed=0)
+
+
+def _gen_resnet18() -> bytes:
+    from .resnet import RESNET18_CFG, export_resnet_onnx
+    return export_resnet_onnx(RESNET18_CFG, seed=0)
+
+
+BUILTIN_MODELS: Dict[str, tuple] = {
+    # name → (schema, generator)
+    "ResNet50": (ModelSchema("ResNet50"), _gen_resnet50),
+    "ResNet18": (ModelSchema("ResNet18"), _gen_resnet18),
+}
+
+
+class ModelDownloader:
+    """Materialize models into a local directory and enumerate them
+    (parity: ``ModelDownloader.downloadModel`` / ``models`` iterator)."""
+
+    def __init__(self, local_path: str,
+                 generators: Optional[Dict[str, tuple]] = None):
+        self.local_path = local_path
+        self.generators = dict(generators or BUILTIN_MODELS)
+        os.makedirs(local_path, exist_ok=True)
+
+    def remote_models(self) -> List[ModelSchema]:
+        return [schema for schema, _gen in self.generators.values()]
+
+    def local_models(self) -> List[ModelSchema]:
+        out = []
+        for name in sorted(os.listdir(self.local_path)):
+            meta = os.path.join(self.local_path, name, "schema.json")
+            if os.path.isfile(meta):
+                with open(meta) as f:
+                    out.append(ModelSchema.from_json(f.read()))
+        return out
+
+    def download_model(self, name: str) -> ModelSchema:
+        """Generate/copy the model into the local repo; idempotent."""
+        if name not in self.generators:
+            raise KeyError(f"unknown model {name!r}; "
+                           f"known: {sorted(self.generators)}")
+        schema, gen = self.generators[name]
+        mdir = os.path.join(self.local_path, name)
+        onnx_path = os.path.join(mdir, "model.onnx")
+        if not os.path.isfile(onnx_path):
+            os.makedirs(mdir, exist_ok=True)
+            with open(onnx_path, "wb") as f:
+                f.write(gen())
+            schema = dataclasses.replace(schema, uri=onnx_path)
+            with open(os.path.join(mdir, "schema.json"), "w") as f:
+                f.write(schema.to_json())
+        with open(os.path.join(mdir, "schema.json")) as f:
+            return ModelSchema.from_json(f.read())
+
+    def load_bytes(self, name: str) -> bytes:
+        schema = self.download_model(name)
+        with open(schema.uri, "rb") as f:
+            return f.read()
+
+
+class LocalRepo:
+    """Enumerate an already-materialized model directory (HDFSRepo parity)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def models(self) -> List[ModelSchema]:
+        return ModelDownloader(self.path, generators={}).local_models()

@@ -53,10 +53,35 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
+    def _read_body(self) -> bytes:
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            # drain chunked framing; leaving it unread would corrupt the
+            # keep-alive connection for the next pipelined request
+            chunks = []
+            while True:
+                size_line = self.rfile.readline(65536).strip()
+                size = int(size_line.split(b";")[0] or b"0", 16)
+                if size == 0:
+                    while self.rfile.readline(65536) not in (b"\r\n", b"\n", b""):
+                        pass  # trailers
+                    break
+                chunks.append(self.rfile.read(size))
+                self.rfile.read(2)  # CRLF after each chunk
+            return b"".join(chunks)
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
     def _handle(self):
         ws: "WorkerServer" = self.server.worker_server  # type: ignore[attr-defined]
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
+        try:
+            body = self._read_body()
+        except (ValueError, ConnectionError):
+            self.send_response(400, "bad request body")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            self.close_connection = True
+            return
         req = HTTPRequestData(
             url=self.path, method=self.command,
             headers=[HeaderData(k, v) for k, v in self.headers.items()],

@@ -16,6 +16,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame, object_col
 from ..core.params import Param
+from ..core.serialize import to_jsonable
 from ..core.pipeline import Transformer
 from .server import WorkerServer
 
@@ -56,17 +57,9 @@ class HTTPSink:
     def write_batch(self, df: DataFrame) -> int:
         n = 0
         for rid, val in zip(df[self.id_col], df[self.reply_col]):
-            ok = self.server.reply_json(rid, _jsonable(val))
+            ok = self.server.reply_json(rid, to_jsonable(val))
             n += int(ok)
         return n
-
-
-def _jsonable(v):
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    if isinstance(v, np.generic):
-        return v.item()
-    return v
 
 
 def parse_request(df: DataFrame, schema: Optional[Dict[str, type]] = None,

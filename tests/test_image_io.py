@@ -1,0 +1,187 @@
+"""Tests for the image package, binary/image IO, ImageFeaturizer, and the
+model downloader — mirrors the reference's opencv + io + deep-learning
+image suites."""
+
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.dataframe import object_col
+from mmlspark_tpu.image import (Blur, CenterCropImage, ColorFormat, CropImage,
+                                Flip, GaussianKernel, ImageSetAugmenter,
+                                ImageTransformer, ResizeImage,
+                                ResizeImageTransformer, Threshold,
+                                UnrollBinaryImage, UnrollImage, decode_image,
+                                encode_image, make_image)
+from mmlspark_tpu.image.unroll import roll, unroll
+from mmlspark_tpu.io import read_binary_files, read_images
+
+
+def _checker(h=32, w=48):
+    img = np.zeros((h, w, 3), dtype=np.uint8)
+    img[::2, ::2] = [255, 0, 0]
+    img[1::2, 1::2] = [0, 255, 0]
+    return img
+
+
+def _img_df(n=3, h=32, w=48):
+    return DataFrame({"image": object_col(
+        [make_image(_checker(h, w), origin=f"img{i}") for i in range(n)])})
+
+
+def test_codec_roundtrip():
+    img = make_image(_checker())
+    raw = encode_image(img, ".png")
+    back = decode_image(raw, origin="x")
+    assert back["height"] == 32 and back["width"] == 48
+    np.testing.assert_array_equal(back["data"], img["data"])
+
+
+def test_resize_and_aspect():
+    out = ImageTransformer(stages=[ResizeImage(height=16, width=24)]) \
+        .transform(_img_df())
+    im = out["image"][0]
+    assert (im["height"], im["width"]) == (16, 24)
+    # shorter-side resize keeps aspect
+    out2 = ImageTransformer(
+        stages=[ResizeImage(size=16, keep_aspect_ratio=True)]) \
+        .transform(_img_df(h=32, w=48))
+    im2 = out2["image"][0]
+    assert im2["height"] == 16 and im2["width"] == 24
+
+
+def test_crop_centercrop_flip():
+    t = ImageTransformer(stages=[CropImage(x=4, y=2, height=10, width=20)])
+    im = t.transform(_img_df())["image"][0]
+    assert (im["height"], im["width"]) == (10, 20)
+    t2 = ImageTransformer(stages=[CenterCropImage(height=10, width=20)])
+    im2 = t2.transform(_img_df())["image"][0]
+    assert (im2["height"], im2["width"]) == (10, 20)
+    src = _img_df(1)
+    lr = ImageTransformer(stages=[Flip(Flip.FLIP_LEFT_RIGHT)]).transform(src)
+    np.testing.assert_array_equal(lr["image"][0]["data"],
+                                  src["image"][0]["data"][:, ::-1])
+
+
+def test_blur_threshold_gaussian_colorformat():
+    import cv2
+    df = _img_df(1)
+    b = ImageTransformer(stages=[Blur(3, 3)]).transform(df)["image"][0]
+    assert b["data"].shape == (32, 48, 3)
+    th = ImageTransformer(stages=[
+        ColorFormat(cv2.COLOR_BGR2GRAY),
+        Threshold(127, 255, cv2.THRESH_BINARY)]).transform(df)["image"][0]
+    assert th["nChannels"] == 1
+    assert set(np.unique(th["data"])) <= {0, 255}
+    g = ImageTransformer(stages=[GaussianKernel(3, 1.0)]).transform(df)["image"][0]
+    assert g["data"].shape == (32, 48, 3)
+
+
+def test_pipelined_ops_and_tensor_output():
+    t = (ImageTransformer(to_tensor=True, normalize_mean=[0.485, 0.456, 0.406],
+                          normalize_std=[0.229, 0.224, 0.225])
+         .resize(height=8, width=8))
+    out = t.transform(_img_df(2))
+    x = out["image"][0]
+    assert x.shape == (3, 8, 8) and x.dtype == np.float32
+
+
+def test_image_transformer_save_load(tmp_path):
+    t = ImageTransformer(stages=[ResizeImage(height=8, width=8), Flip(1)],
+                         to_tensor=False)
+    t.save(str(tmp_path / "it"))
+    t2 = ImageTransformer.load(str(tmp_path / "it"))
+    a = t.transform(_img_df(1))["image"][0]["data"]
+    b = t2.transform(_img_df(1))["image"][0]["data"]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_unroll_roll_roundtrip():
+    img = make_image(_checker(4, 5))
+    v = unroll(img)
+    assert v.shape == (4 * 5 * 3,)
+    # CHW order: first H*W entries are channel 0 (blue in BGR)
+    np.testing.assert_array_equal(
+        v[:20].reshape(4, 5), img["data"][:, :, 0].astype(np.float64))
+    back = roll(v, img)
+    np.testing.assert_array_equal(back["data"], img["data"])
+
+
+def test_unroll_stages():
+    df = _img_df(2, 8, 8)
+    out = UnrollImage().transform(df)
+    assert out["<image>"][0].shape == (8 * 8 * 3,)
+    raw = DataFrame({"image": object_col(
+        [encode_image(make_image(_checker(16, 16))) for _ in range(2)])})
+    out2 = UnrollBinaryImage(height=8, width=8).transform(raw)
+    assert out2["<image>"][0].shape == (8 * 8 * 3,)
+
+
+def test_resize_image_transformer_and_augmenter():
+    df = _img_df(2)
+    out = ResizeImageTransformer(height=8, width=8).transform(df)
+    assert out["image"][0]["height"] == 8
+    aug = ImageSetAugmenter(flip_left_right=True, flip_up_down=True)
+    out2 = aug.transform(df)
+    assert len(out2) == 6
+
+
+def test_binary_and_image_readers(tmp_path):
+    d = tmp_path / "files"
+    os.makedirs(d)
+    for i in range(3):
+        with open(d / f"img{i}.png", "wb") as f:
+            f.write(encode_image(make_image(_checker(8, 8))))
+    with open(d / "junk.txt", "wb") as f:
+        f.write(b"not an image")
+    with zipfile.ZipFile(d / "pack.zip", "w") as zf:
+        zf.writestr("inner.bin", b"\x01\x02")
+    raw = read_binary_files(str(d))
+    assert len(raw) == 5  # 3 png + junk + zip member
+    assert any(p.endswith("pack.zip/inner.bin") for p in raw["path"])
+    pngs = read_binary_files(str(d), pattern="*.png")
+    assert len(pngs) == 3
+    imgs = read_images(str(d), pattern="*")
+    assert len(imgs) == 3  # junk + zip member dropped
+    assert all(im["height"] == 8 for im in imgs["image"])
+
+
+def test_model_downloader_and_featurizer(tmp_path):
+    from mmlspark_tpu.models.featurizer import ImageFeaturizer
+    from mmlspark_tpu.models.zoo.downloader import (BUILTIN_MODELS,
+                                                    ModelDownloader)
+    assert "ResNet50" in BUILTIN_MODELS
+    dl = ModelDownloader(str(tmp_path / "models"))
+    schema = dl.download_model("ResNet18")
+    assert os.path.isfile(schema.uri)
+    assert schema.layer_names == ["logits", "feat"]
+    # idempotent
+    schema2 = dl.download_model("ResNet18")
+    assert schema2.uri == schema.uri
+    assert [m.name for m in dl.local_models()] == ["ResNet18"]
+
+    model_bytes = dl.load_bytes("ResNet18")
+    df = _img_df(3, 50, 40)
+    feat = ImageFeaturizer(model_bytes, input_size=32, mini_batch_size=2,
+                           output_col="features")
+    out = feat.transform(df)
+    f0 = np.asarray(out["features"][0])
+    assert f0.shape == (512 * 4,)  # resnet18 final width (64*8 blocks *4)
+    # cut_output_layers=0 → logits
+    logits = ImageFeaturizer(model_bytes, input_size=32, cut_output_layers=0,
+                             output_col="logits").transform(df)
+    l0 = np.asarray(logits["logits"][0])
+    assert l0.shape == (1000,)
+
+
+def test_featurizer_drops_bad_rows():
+    from mmlspark_tpu.models.featurizer import ImageFeaturizer
+    from mmlspark_tpu.models.zoo.downloader import _gen_resnet18
+    model_bytes = _gen_resnet18()
+    cells = [make_image(_checker(8, 8)), None, b"garbagebytes"]
+    df = DataFrame({"image": object_col(cells), "rowid": np.arange(3)})
+    out = ImageFeaturizer(model_bytes, input_size=32).transform(df)
+    assert len(out) == 1 and out["rowid"][0] == 0
