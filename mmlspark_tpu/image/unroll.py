@@ -42,10 +42,16 @@ def _resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
         out = cv2.resize(img, (width, height))
     except ImportError:
         from PIL import Image
-        bgr = img[:, :, ::-1] if img.shape[-1] == 3 else img[:, :, 0]
-        out = np.asarray(Image.fromarray(bgr).resize((width, height)))
-        if out.ndim == 3:
-            out = out[:, :, ::-1]
+        c = img.shape[-1]
+        if c == 1:
+            rgbish = img[:, :, 0]
+        elif c == 4:  # BGRA → RGBA for PIL, keep all 4 channels
+            rgbish = img[:, :, [2, 1, 0, 3]]
+        else:
+            rgbish = img[:, :, ::-1]  # BGR → RGB
+        out = np.asarray(Image.fromarray(rgbish).resize((width, height)))
+        if out.ndim == 3:  # undo the channel swap
+            out = out[:, :, [2, 1, 0, 3]] if c == 4 else out[:, :, ::-1]
     return out[:, :, None] if out.ndim == 2 else out
 
 

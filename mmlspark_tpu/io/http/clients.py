@@ -32,7 +32,8 @@ from .schema import (EntityData, HeaderData, HTTPRequestData,
                      HTTPResponseData, StatusLineData)
 
 __all__ = ["send_with_retries", "advanced_handler", "basic_handler",
-           "SingleThreadedHTTPClient", "AsyncHTTPClient", "shared_session"]
+           "SingleThreadedHTTPClient", "AsyncHTTPClient", "shared_session",
+           "post_json_batches"]
 
 DEFAULT_BACKOFFS_MS = (100, 500, 1000)
 
@@ -158,3 +159,32 @@ class AsyncHTTPClient:
             return None if req is None else self.handler(shared_session.get(), req)
 
         yield from map_buffered(one, requests_it, self.concurrency)
+
+
+def post_json_batches(url: str, rows: Iterable[dict], batch_size: int,
+                      wrap, headers=(),
+                      backoffs_ms: Iterable[int] = DEFAULT_BACKOFFS_MS,
+                      what: str = "batched POST") -> int:
+    """Accumulate ``rows`` into batches of ``batch_size``, POST each as
+    ``wrap(batch)`` JSON, raise on a terminally-failed batch. Shared by the
+    PowerBI and search-index sinks. Returns the number of batches sent."""
+    session = shared_session.get()
+    batch, sent = [], 0
+
+    def flush():
+        req = HTTPRequestData.from_json(url, wrap(batch), headers=list(headers))
+        resp = send_with_retries(session, req, list(backoffs_ms))
+        if resp.status_code not in (200, 201, 202):
+            raise IOError(f"{what} failed: {resp.status_code} "
+                          f"{resp.string_content()[:200]}")
+
+    for row in rows:
+        batch.append(row)
+        if len(batch) >= batch_size:
+            flush()
+            sent += 1
+            batch = []
+    if batch:
+        flush()
+        sent += 1
+    return sent

@@ -10,8 +10,6 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-import numpy as np
-
 from ...core.dataframe import DataFrame, object_col
 from ...core.params import ComplexParam, HasInputCol, HasOutputCol, Param
 from ...core.serialize import to_jsonable

@@ -7,12 +7,10 @@ JSON and POST them to a push endpoint, with the shared retry ladder
 
 from __future__ import annotations
 
-import json
 from typing import Optional, Sequence
 
 from ..core.dataframe import DataFrame
-from .http.clients import send_with_retries, shared_session
-from .http.schema import HTTPRequestData
+from .http.clients import post_json_batches
 
 __all__ = ["write_to_powerbi", "PowerBIWriter"]
 
@@ -29,26 +27,9 @@ def write_to_powerbi(df: DataFrame, url: str, batch_size: int = 1000,
                      backoffs_ms: Sequence[int] = (100, 500, 1000)) -> int:
     """POST rows in batches; returns the number of batches sent. Raises on a
     terminally-failed batch (parity: writer fails the stream task)."""
-    session = shared_session.get()
-    batch, sent = [], 0
-    for row in _json_rows(df, cols):
-        batch.append(row)
-        if len(batch) >= batch_size:
-            _post(session, url, batch, backoffs_ms)
-            sent += 1
-            batch = []
-    if batch:
-        _post(session, url, batch, backoffs_ms)
-        sent += 1
-    return sent
-
-
-def _post(session, url, rows, backoffs_ms):
-    req = HTTPRequestData.from_json(url, {"rows": rows})
-    resp = send_with_retries(session, req, list(backoffs_ms))
-    if resp.status_code not in (200, 201, 202):
-        raise IOError(f"PowerBI push failed: {resp.status_code} "
-                      f"{resp.string_content()[:200]}")
+    return post_json_batches(url, _json_rows(df, cols), batch_size,
+                             wrap=lambda b: {"rows": b},
+                             backoffs_ms=backoffs_ms, what="PowerBI push")
 
 
 class PowerBIWriter:

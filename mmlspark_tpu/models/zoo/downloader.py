@@ -90,15 +90,22 @@ class ModelDownloader:
                            f"known: {sorted(self.generators)}")
         schema, gen = self.generators[name]
         mdir = os.path.join(self.local_path, name)
+        schema_path = os.path.join(mdir, "schema.json")
         onnx_path = os.path.join(mdir, "model.onnx")
-        if not os.path.isfile(onnx_path):
+        # schema.json is the commit marker and is written LAST via rename, so
+        # a crash mid-download leaves a repairable dir, never a bricked one
+        if not os.path.isfile(schema_path):
             os.makedirs(mdir, exist_ok=True)
-            with open(onnx_path, "wb") as f:
+            tmp = onnx_path + ".tmp"
+            with open(tmp, "wb") as f:
                 f.write(gen())
+            os.replace(tmp, onnx_path)
             schema = dataclasses.replace(schema, uri=onnx_path)
-            with open(os.path.join(mdir, "schema.json"), "w") as f:
+            tmp_s = schema_path + ".tmp"
+            with open(tmp_s, "w") as f:
                 f.write(schema.to_json())
-        with open(os.path.join(mdir, "schema.json")) as f:
+            os.replace(tmp_s, schema_path)
+        with open(schema_path) as f:
             return ModelSchema.from_json(f.read())
 
     def load_bytes(self, name: str) -> bytes:

@@ -21,16 +21,7 @@ from ..core.pipeline import Estimator, Model
 
 __all__ = ["SAR", "SARModel"]
 
-_JITTED = {}
-
-
-def _jitted(name, fn):
-    # module-level jit cache — per-call @jax.jit closures would retrace on
-    # every invocation
-    if name not in _JITTED:
-        import jax
-        _JITTED[name] = jax.jit(fn)
-    return _JITTED[name]
+from ..utils.jit_cache import jitted as _jitted
 
 
 class SAR(Estimator):
@@ -76,7 +67,7 @@ class SAR(Estimator):
         occ = (occ > 0).astype(np.float32)
 
         # (items, items) co-occurrence on the MXU
-        cooccur = _jitted("cooccur", lambda O: O.T @ O)
+        cooccur = _jitted("sar.cooccur", lambda O: O.T @ O)
         C = np.asarray(cooccur(jnp.asarray(occ)))
         C = np.where(C >= self.get("support_threshold"), C, 0.0)
         diag = np.diag(C).copy()
@@ -108,7 +99,7 @@ class SARModel(Model):
     def _scores(self) -> np.ndarray:
         import jax.numpy as jnp
 
-        run = _jitted("affinity_matmul", lambda A, S: A @ S)
+        run = _jitted("sar.affinity_matmul", lambda A, S: A @ S)
         return np.asarray(run(jnp.asarray(self.get("user_affinity")),
                               jnp.asarray(self.get("item_similarity"))))
 

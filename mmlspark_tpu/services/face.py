@@ -1,0 +1,48 @@
+"""Face service transformers.
+
+Parity: ``cognitive/.../Face.scala`` (351 LoC): ``DetectFace``,
+``VerifyFaces``, ``GroupFaces``, ``IdentifyFaces``.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceParam, ServiceTransformer
+
+__all__ = ["DetectFace", "VerifyFaces", "GroupFaces", "IdentifyFaces"]
+
+
+class DetectFace(ServiceTransformer):
+    image_url = ServiceParam(str, is_required=True, payload_name="url",
+                             doc="image URL")
+    return_face_id = ServiceParam(bool, is_url_param=True,
+                                  payload_name="returnFaceId", default=True,
+                                  doc="return detected face ids")
+    return_face_landmarks = ServiceParam(bool, is_url_param=True,
+                                         payload_name="returnFaceLandmarks",
+                                         doc="return 27-point landmarks")
+    return_face_attributes = ServiceParam(str, is_url_param=True,
+                                          payload_name="returnFaceAttributes",
+                                          doc="comma-joined attribute list")
+
+
+class VerifyFaces(ServiceTransformer):
+    face_id1 = ServiceParam(str, is_required=True, payload_name="faceId1",
+                            doc="first face id")
+    face_id2 = ServiceParam(str, is_required=True, payload_name="faceId2",
+                            doc="second face id")
+
+
+class GroupFaces(ServiceTransformer):
+    face_ids = ServiceParam(list, is_required=True, payload_name="faceIds",
+                            doc="face ids to cluster")
+
+
+class IdentifyFaces(ServiceTransformer):
+    face_ids = ServiceParam(list, is_required=True, payload_name="faceIds",
+                            doc="face ids to identify")
+    person_group_id = ServiceParam(str, payload_name="personGroupId",
+                                   doc="person group to search")
+    max_candidates = ServiceParam(int, payload_name="maxNumOfCandidatesReturned",
+                                  doc="max candidates per face")
+    confidence_threshold = ServiceParam(float, payload_name="confidenceThreshold",
+                                        doc="identification threshold")

@@ -1,0 +1,38 @@
+"""Form-recognizer service transformers.
+
+Parity: ``cognitive/.../FormRecognizer.scala`` (353 LoC): layout/invoice/
+receipt analysis — async 202 + Operation-Location polling like OCR.
+"""
+
+from __future__ import annotations
+
+from .base import HasAsyncReply, ServiceParam
+from .vision import VisionBase
+
+__all__ = ["FormRecognizerBase", "AnalyzeLayout", "AnalyzeInvoices",
+           "AnalyzeReceipts"]
+
+
+class FormRecognizerBase(VisionBase, HasAsyncReply):
+    """POST document url/bytes, long-poll the analyzeResults."""
+
+    def _parse(self, body):
+        if isinstance(body, dict) and "analyzeResult" in body:
+            return body["analyzeResult"]
+        return body
+
+
+class AnalyzeLayout(FormRecognizerBase):
+    pass
+
+
+class AnalyzeInvoices(FormRecognizerBase):
+    include_text_details = ServiceParam(bool, is_url_param=True,
+                                        payload_name="includeTextDetails",
+                                        doc="include raw OCR lines")
+
+
+class AnalyzeReceipts(FormRecognizerBase):
+    include_text_details = ServiceParam(bool, is_url_param=True,
+                                        payload_name="includeTextDetails",
+                                        doc="include raw OCR lines")

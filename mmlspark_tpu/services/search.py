@@ -1,0 +1,81 @@
+"""Search service transformers/sinks.
+
+Parity: ``cognitive/.../AzureSearch.scala`` (356 LoC index sink) and
+``BingImageSearch.scala`` (309 LoC).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param
+from ..core.serialize import to_jsonable
+from ..io.http.clients import post_json_batches
+from ..io.http.schema import HeaderData, HTTPRequestData
+from .base import ServiceParam, ServiceTransformer
+
+__all__ = ["AzureSearchWriter", "BingImageSearch"]
+
+
+class BingImageSearch(ServiceTransformer):
+    """Parity: ``BingImageSearch`` — GET /images/search?q=... with offset/
+    count paging params; output is the raw value array."""
+
+    query = ServiceParam(str, is_required=True, is_url_param=True,
+                         payload_name="q", doc="search query")
+    count = ServiceParam(int, is_url_param=True, doc="results per page")
+    offset = ServiceParam(int, is_url_param=True, doc="result offset")
+    image_type = ServiceParam(str, is_url_param=True, payload_name="imageType",
+                              doc="photo/clipart/...")
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._set_default(method="GET")
+
+    def _parse(self, body):
+        if isinstance(body, dict):
+            return body.get("value", body)
+        return body
+
+    @staticmethod
+    def download_from_urls(df: DataFrame, url_col: str, out_col: str = "bytes",
+                           concurrency: int = 4, timeout: float = 30.0
+                           ) -> DataFrame:
+        """Parity: ``BingImageSearch.downloadFromUrls`` helper."""
+        from ..core.dataframe import object_col
+        from ..io.http.clients import AsyncHTTPClient
+        reqs = [None if u is None else HTTPRequestData(url=u, method="GET")
+                for u in df[url_col]]
+        client = AsyncHTTPClient(concurrency, timeout=timeout)
+        outs = [None if r is None or r.status_code != 200
+                else (r.entity.content if r.entity else None)
+                for r in client.send(iter(reqs))]
+        return df.with_column(out_col, object_col(outs))
+
+
+class AzureSearchWriter:
+    """Index-upload sink (parity: ``AzureSearchWriter.write``): POSTs
+    ``{"value": [{"@search.action": "upload", ...row}, ...]}`` batches."""
+
+    def __init__(self, url: str, api_key: str = "", batch_size: int = 100,
+                 action: str = "upload"):
+        self.url = url
+        self.api_key = api_key
+        self.batch_size = batch_size
+        self.action = action
+
+    def write(self, df: DataFrame, cols: Optional[Sequence[str]] = None) -> int:
+        names = list(cols) if cols else df.columns
+
+        def docs():
+            for row in df.iter_rows():
+                doc = {"@search.action": self.action}
+                doc.update({k: to_jsonable(row[k]) for k in names})
+                yield doc
+
+        return post_json_batches(
+            self.url, docs(), self.batch_size, wrap=lambda b: {"value": b},
+            headers=[HeaderData("api-key", self.api_key)],
+            what="search index upload")

@@ -1,0 +1,77 @@
+"""Text-analytics service transformers.
+
+Parity: ``cognitive/.../TextAnalytics.scala`` (626 LoC): ``TextSentiment``,
+``LanguageDetector``, ``EntityDetector``, ``NER``, ``KeyPhraseExtractor`` —
+all POST ``{"documents": [{id, text, language}]}`` and unpack the per-doc
+result. Rows are batched per request like the reference's minibatched text
+analytics (one row per document id here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import ServiceParam, ServiceTransformer
+
+__all__ = ["TextAnalyticsBase", "TextSentiment", "LanguageDetector",
+           "EntityDetector", "NER", "KeyPhraseExtractor"]
+
+
+class TextAnalyticsBase(ServiceTransformer):
+    text = ServiceParam(str, is_required=True, doc="document text")
+    language = ServiceParam(str, doc="document language hint")
+
+    def _payload(self, row: dict):
+        doc = {"id": "0", "text": self.get_value_opt(row, "text")}
+        lang = self.get_value_opt(row, "language")
+        if lang:
+            doc["language"] = lang
+        return {"documents": [doc]}
+
+    def _parse(self, body):
+        docs = body.get("documents") or []
+        return docs[0] if docs else None
+
+
+class TextSentiment(TextAnalyticsBase):
+    """Parity: ``TextSentiment`` — sentiment label + confidence scores."""
+
+    def _parse(self, body):
+        doc = super()._parse(body)
+        if doc is None:
+            return None
+        return {"sentiment": doc.get("sentiment"),
+                "confidenceScores": doc.get("confidenceScores"),
+                "sentences": doc.get("sentences")}
+
+
+class LanguageDetector(TextAnalyticsBase):
+    """Parity: ``LanguageDetector`` — detectedLanguage per document."""
+
+    def _parse(self, body):
+        doc = super()._parse(body)
+        return None if doc is None else doc.get("detectedLanguage", doc)
+
+
+class EntityDetector(TextAnalyticsBase):
+    """Parity: ``EntityDetector`` (linked entities)."""
+
+    def _parse(self, body):
+        doc = super()._parse(body)
+        return None if doc is None else doc.get("entities", doc)
+
+
+class NER(TextAnalyticsBase):
+    """Parity: ``NER`` (named entity recognition)."""
+
+    def _parse(self, body):
+        doc = super()._parse(body)
+        return None if doc is None else doc.get("entities", doc)
+
+
+class KeyPhraseExtractor(TextAnalyticsBase):
+    """Parity: ``KeyPhraseExtractor``."""
+
+    def _parse(self, body):
+        doc = super()._parse(body)
+        return None if doc is None else doc.get("keyPhrases", doc)

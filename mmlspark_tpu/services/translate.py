@@ -1,0 +1,61 @@
+"""Translation service transformers.
+
+Parity: ``cognitive/.../TextTranslator.scala`` (550 LoC): ``Translate``,
+``Transliterate``, ``Detect``, ``BreakSentence`` — POST
+``[{"Text": ...}]`` arrays with to/from/script URL params.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceParam, ServiceTransformer
+
+__all__ = ["TranslatorBase", "Translate", "Transliterate", "DetectLanguage",
+           "BreakSentence"]
+
+
+class TranslatorBase(ServiceTransformer):
+    text = ServiceParam(str, is_required=True, doc="text to process")
+
+    def _payload(self, row: dict):
+        return [{"Text": self.get_value_opt(row, "text")}]
+
+    def _parse(self, body):
+        if isinstance(body, list) and body:
+            return body[0]
+        return body
+
+
+class Translate(TranslatorBase):
+    to_language = ServiceParam(str, is_url_param=True, payload_name="to",
+                               is_required=True, doc="target language(s)")
+    from_language = ServiceParam(str, is_url_param=True, payload_name="from",
+                                 doc="source language (auto-detect if unset)")
+
+    def _parse(self, body):
+        first = super()._parse(body)
+        if isinstance(first, dict):
+            return first.get("translations", first)
+        return first
+
+
+class Transliterate(TranslatorBase):
+    language = ServiceParam(str, is_url_param=True, is_required=True,
+                            doc="language of the text")
+    from_script = ServiceParam(str, is_url_param=True, payload_name="fromScript",
+                               is_required=True, doc="source script")
+    to_script = ServiceParam(str, is_url_param=True, payload_name="toScript",
+                             is_required=True, doc="target script")
+
+
+class DetectLanguage(TranslatorBase):
+    """Parity: translator ``Detect``."""
+
+
+class BreakSentence(TranslatorBase):
+    language = ServiceParam(str, is_url_param=True, doc="language hint")
+
+    def _parse(self, body):
+        first = super()._parse(body)
+        if isinstance(first, dict):
+            return first.get("sentLen", first)
+        return first

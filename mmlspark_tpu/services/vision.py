@@ -1,0 +1,74 @@
+"""Computer-vision service transformers.
+
+Parity: ``cognitive/.../ComputerVision.scala`` (630 LoC): ``AnalyzeImage``,
+``OCR``, ``DescribeImage``, ``TagImage`` — POST either ``{"url": ...}`` or
+raw image bytes; OCR-style calls long-poll via ``HasAsyncReply``
+(``ComputerVision.scala:290-330``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..io.http.schema import EntityData, HeaderData, HTTPRequestData
+from .base import HasAsyncReply, ServiceParam, ServiceTransformer
+
+__all__ = ["VisionBase", "AnalyzeImage", "OCR", "DescribeImage", "TagImage"]
+
+
+class VisionBase(ServiceTransformer):
+    image_url = ServiceParam(str, doc="URL of the image to analyze")
+    image_bytes = ServiceParam(bytes, doc="raw image bytes (alternative to url)")
+
+    def _build_request(self, row: dict) -> Optional[HTTPRequestData]:
+        url_v = self.get_value_opt(row, "image_url")
+        bytes_v = self.get_value_opt(row, "image_bytes")
+        if url_v is None and bytes_v is None:
+            return None
+        if self.should_skip(row):
+            return None
+        headers = self._headers(row)
+        if bytes_v is not None:
+            headers = [h for h in headers if h.name != "Content-Type"]
+            headers.append(HeaderData("Content-Type", "application/octet-stream"))
+            entity = EntityData(content=bytes(bytes_v),
+                                content_length=len(bytes_v))
+        else:
+            import json as _json
+            entity = EntityData.from_string(_json.dumps({"url": url_v}))
+        return HTTPRequestData(url=self._full_url(row), method="POST",
+                               headers=headers, entity=entity)
+
+
+class AnalyzeImage(VisionBase):
+    """Parity: ``AnalyzeImage`` — visualFeatures/details/language URL params."""
+
+    visual_features = ServiceParam(str, is_url_param=True,
+                                   payload_name="visualFeatures",
+                                   doc="comma-joined feature list")
+    details = ServiceParam(str, is_url_param=True, doc="celebrity/landmark")
+    language = ServiceParam(str, is_url_param=True, default="en",
+                            doc="response language")
+
+
+class OCR(VisionBase, HasAsyncReply):
+    """Parity: ``OCR``/``ReadImage`` — async 202 + Operation-Location poll."""
+
+    detect_orientation = ServiceParam(bool, is_url_param=True,
+                                      payload_name="detectOrientation",
+                                      doc="detect text orientation")
+    language = ServiceParam(str, is_url_param=True, doc="OCR language")
+
+
+class DescribeImage(VisionBase):
+    max_candidates = ServiceParam(int, is_url_param=True,
+                                  payload_name="maxCandidates", default=1,
+                                  doc="number of caption candidates")
+
+
+class TagImage(VisionBase):
+    language = ServiceParam(str, is_url_param=True, default="en",
+                            doc="response language")
+
+    def _parse(self, body):
+        return body.get("tags", body)

@@ -1,0 +1,26 @@
+"""Process-wide cache of jitted functions.
+
+Per-call ``@jax.jit`` closures create a fresh function object every
+invocation, so jax's jit cache never hits and every transform recompiles.
+Stages register their kernels here once, keyed by a stable name.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+_CACHE: Dict[str, Callable] = {}
+
+__all__ = ["jitted"]
+
+
+def jitted(name: str, fn: Callable,
+           static_argnums: Optional[Tuple[int, ...]] = None) -> Callable:
+    """Return a jitted version of ``fn`` cached under ``name``. The first
+    caller's ``fn`` wins — callers must pass a pure function whose behavior
+    is fully determined by its arguments (+ static args)."""
+    if name not in _CACHE:
+        import jax
+        _CACHE[name] = (jax.jit(fn, static_argnums=static_argnums)
+                        if static_argnums is not None else jax.jit(fn))
+    return _CACHE[name]

@@ -1,0 +1,234 @@
+"""Tests for the service-transformer framework + families against a local
+mock server — the reference tests cognitive services the same way (recorded
+replies / live endpoints)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.dataframe import object_col
+from mmlspark_tpu.services import (AnalyzeImage, BingImageSearch,
+                                   DetectAnomalies, LanguageDetector, OCR,
+                                   SimpleDetectAnomalies, TextSentiment,
+                                   Translate)
+from mmlspark_tpu.services.search import AzureSearchWriter
+
+_state = {"ops": {}, "search_docs": [], "op_counter": 0}
+
+
+class _MockService(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, status=200, headers=()):
+        out = json.dumps(obj).encode()
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def do_GET(self):
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        if path.path.startswith("/operations/"):
+            op = path.path.rsplit("/", 1)[1]
+            n = _state["ops"].get(op, 0)
+            _state["ops"][op] = n + 1
+            if n < 2:  # not ready the first two polls
+                self._reply({"status": "running"})
+            else:
+                self._reply({"status": "succeeded",
+                             "analyzeResult": {"lines": ["hello world"]}})
+        elif path.path == "/images/search":
+            self._reply({"value": [{"contentUrl": "http://x/img.png",
+                                    "name": q["q"][0]}]})
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        body = json.loads(raw) if raw and raw[:1] in (b"{", b"[") else raw
+        if path.path == "/text/sentiment":
+            assert self.headers.get("Ocp-Apim-Subscription-Key") == "secret"
+            doc = body["documents"][0]
+            sent = "positive" if "good" in doc["text"] else "negative"
+            self._reply({"documents": [
+                {"id": doc["id"], "sentiment": sent,
+                 "confidenceScores": {"positive": 0.9}}]})
+        elif path.path == "/text/languages":
+            self._reply({"documents": [
+                {"id": "0", "detectedLanguage": {"iso6391Name": "fr"}}]})
+        elif path.path == "/translate":
+            to = q["to"][0]
+            self._reply([{"translations":
+                          [{"text": f"<{to}>{body[0]['Text']}", "to": to}]}])
+        elif path.path == "/vision/analyze":
+            assert "visualFeatures" in q
+            self._reply({"categories": [{"name": "outdoor", "score": 0.9}],
+                         "url_seen": body.get("url")})
+        elif path.path == "/vision/ocr":
+            _state["op_counter"] += 1
+            op = f"op{_state['op_counter']}"
+            _state["ops"][op] = 0
+            host = self.headers["Host"]
+            self._reply({}, status=202,
+                        headers=[("Operation-Location",
+                                  f"http://{host}/operations/{op}")])
+        elif path.path == "/anomaly/entire":
+            series = body["series"]
+            vals = [p["value"] for p in series]
+            med = sorted(vals)[len(vals) // 2]
+            self._reply({"isAnomaly": [abs(v - med) > 50 for v in vals]})
+        elif path.path == "/search/index":
+            assert self.headers.get("api-key") == "sk"
+            _state["search_docs"].extend(body["value"])
+            self._reply({"value": []})
+        else:
+            self._reply({"error": "not found"}, 404)
+
+
+@pytest.fixture(scope="module")
+def svc():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _MockService)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_text_sentiment_scalar_and_vector_params(svc):
+    df = DataFrame({"txt": object_col(["good day", "bad day", None])})
+    t = TextSentiment(url=svc + "/text/sentiment", output_col="out",
+                      error_col="err", concurrency=2)
+    t.set_scalar_param("subscription_key", "secret")
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    assert out["out"][0]["sentiment"] == "positive"
+    assert out["out"][1]["sentiment"] == "negative"
+    # null required param → skipped row: null output AND null error
+    assert out["out"][2] is None and out["err"][2] is None
+
+
+def test_language_detector(svc):
+    df = DataFrame({"text": object_col(["bonjour"])})
+    t = LanguageDetector(url=svc + "/text/languages", output_col="lang")
+    t.set_vector_param("text", "text")
+    out = t.transform(df)
+    assert out["lang"][0]["iso6391Name"] == "fr"
+
+
+def test_translate_url_params(svc):
+    df = DataFrame({"text": object_col(["hello"])})
+    t = Translate(url=svc + "/translate", output_col="tr")
+    t.set_vector_param("text", "text")
+    t.set_scalar_param("to_language", "de")
+    out = t.transform(df)
+    assert out["tr"][0][0]["text"] == "<de>hello"
+
+
+def test_analyze_image(svc):
+    df = DataFrame({"url": object_col(["http://images/1.png"])})
+    t = AnalyzeImage(url=svc + "/vision/analyze", output_col="an")
+    t.set_vector_param("image_url", "url")
+    t.set_scalar_param("visual_features", "Categories,Tags")
+    out = t.transform(df)
+    assert out["an"][0]["categories"][0]["name"] == "outdoor"
+    assert out["an"][0]["url_seen"] == "http://images/1.png"
+
+
+def test_ocr_async_polling(svc):
+    df = DataFrame({"url": object_col(["http://images/2.png"])})
+    t = OCR(url=svc + "/vision/ocr", output_col="ocr", polling_delay_ms=20)
+    t.set_vector_param("image_url", "url")
+    out = t.transform(df)
+    assert out["ocr"][0]["status"] == "succeeded"
+    assert out["ocr"][0]["analyzeResult"]["lines"] == ["hello world"]
+
+
+def test_detect_anomalies_service(svc):
+    series = [{"timestamp": str(i), "value": float(v)}
+              for i, v in enumerate([1, 2, 1, 2, 99, 2])]
+    df = DataFrame({"s": object_col([series])})
+    t = DetectAnomalies(url=svc + "/anomaly/entire", output_col="an")
+    t.set_vector_param("series", "s")
+    out = t.transform(df)
+    assert out["an"][0]["isAnomaly"] == [False, False, False, False, True, False]
+
+
+def test_simple_detect_anomalies_grouped_service(svc):
+    n = 6
+    df = DataFrame({
+        "group": object_col(["a"] * n + ["b"] * n),
+        "timestamp": np.arange(2 * n),
+        "value": np.asarray([1, 2, 1, 2, 99, 2] + [5, 5, 5, 5, 5, -80],
+                            dtype=np.float64),
+    })
+    t = SimpleDetectAnomalies(url=svc + "/anomaly/entire", output_col="an")
+    out = t.transform(df)
+    flags = [v["isAnomaly"] for v in out["an"]]
+    assert flags[4] is True and flags[11] is True
+    assert sum(flags) == 2
+
+
+def test_simple_detect_anomalies_local():
+    vals = np.asarray([1, 1.1, 0.9, 1, 25.0, 1.05, 0.98, 1.02], np.float64)
+    df = DataFrame({"group": object_col(["g"] * len(vals)),
+                    "timestamp": np.arange(len(vals)),
+                    "value": vals})
+    t = SimpleDetectAnomalies(output_col="an")  # no url → local MAD detector
+    out = t.transform(df)
+    flags = [v["isAnomaly"] for v in out["an"]]
+    assert flags == [False, False, False, False, True, False, False, False]
+
+
+def test_bing_image_search_get(svc):
+    df = DataFrame({"q": object_col(["cats"])})
+    t = BingImageSearch(url=svc + "/images/search", output_col="imgs")
+    t.set_vector_param("query", "q")
+    out = t.transform(df)
+    assert out["imgs"][0][0]["name"] == "cats"
+
+
+def test_azure_search_writer(svc):
+    _state["search_docs"].clear()
+    df = DataFrame({"id": object_col(["1", "2", "3"]),
+                    "score": np.asarray([0.1, 0.2, 0.3])})
+    w = AzureSearchWriter(svc + "/search/index", api_key="sk", batch_size=2)
+    n = w.write(df)
+    assert n == 2
+    assert len(_state["search_docs"]) == 3
+    assert _state["search_docs"][0]["@search.action"] == "upload"
+
+
+def test_service_transformer_save_load(tmp_path, svc):
+    t = TextSentiment(url=svc + "/text/sentiment", output_col="out",
+                      error_col="err")
+    t.set_scalar_param("subscription_key", "secret")
+    t.set_vector_param("text", "txt")
+    t.save(str(tmp_path / "svc"))
+    t2 = TextSentiment.load(str(tmp_path / "svc"))
+    df = DataFrame({"txt": object_col(["good"])})
+    assert t2.transform(df)["out"][0]["sentiment"] == "positive"
+
+
+def test_error_column_on_bad_endpoint(svc):
+    df = DataFrame({"txt": object_col(["x"])})
+    t = TextSentiment(url=svc + "/nope", output_col="out", error_col="err")
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    assert out["out"][0] is None
+    assert out["err"][0]["statusCode"] == 404
