@@ -1,0 +1,30 @@
+"""VW-equivalent module: hashed-feature online linear learning.
+
+Parity surface: the reference's ``vw`` module (SURVEY.md §2.4) —
+``VowpalWabbitFeaturizer`` (columns → murmur-hashed namespaces),
+``VowpalWabbitInteractions`` (namespace crossing),
+``VowpalWabbitClassifier``/``VowpalWabbitRegressor`` (online SGD with
+per-pass AllReduce, ``vw/.../VowpalWabbitBase.scala:432-460``), and
+``VowpalWabbitContextualBandit``.
+
+TPU-native redesign: no C++ VW core and no spanning-tree daemon. Hashing is
+host-side (murmur3, same family as ``VowpalWabbitMurmurWithPrefix.scala``);
+the learner is a single jitted ``lax.scan`` over minibatches doing
+adagrad-scaled sparse updates (gather + scatter-add, which XLA lowers to
+efficient TPU scatters), and distributed data parallelism is per-pass weight
+averaging with ``jax.lax.pmean`` over a device mesh — the XLA-collective
+equivalent of VW's ``--span_server`` AllReduce.
+"""
+
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .learners import (VowpalWabbitClassifier, VowpalWabbitClassifierModel,
+                       VowpalWabbitRegressor, VowpalWabbitRegressorModel)
+from .bandit import (VowpalWabbitContextualBandit,
+                     VowpalWabbitContextualBanditModel)
+
+__all__ = [
+    "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+    "VowpalWabbitClassifier", "VowpalWabbitClassifierModel",
+    "VowpalWabbitRegressor", "VowpalWabbitRegressorModel",
+    "VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel",
+]
