@@ -115,7 +115,9 @@ def advanced_handler(*backoffs_ms: int, timeout: float = 60.0
     def handle(session, request):
         try:
             return send_with_retries(session, request, ladder, timeout)
-        except (requests.Timeout, requests.ConnectionError):
+        except requests.RequestException:
+            # any transport-level failure (timeout, connection, malformed
+            # URL, ...) becomes a per-row error, never a whole-transform crash
             return None
 
     return handle

@@ -24,6 +24,14 @@ __all__ = ["AnomalyBase", "DetectLastAnomaly", "DetectAnomalies",
            "SimpleDetectAnomalies"]
 
 
+def _group_indices(groups) -> "dict":
+    """One-pass {group: np.ndarray(row indices)}, insertion-ordered."""
+    bucket: dict = {}
+    for i, g in enumerate(groups):
+        bucket.setdefault(g, []).append(i)
+    return {g: np.asarray(ix) for g, ix in bucket.items()}
+
+
 class AnomalyBase(ServiceTransformer):
     series = ServiceParam(list, is_required=True,
                           doc="list of {timestamp, value} points")
@@ -74,37 +82,37 @@ class SimpleDetectAnomalies(AnomalyBase):
                 raise ValueError(
                     f"SimpleDetectAnomalies: service param {n!r} is bound to a "
                     "column; grouped mode only supports scalar params")
-        groups = df[self.get("group_col")]
+        group_rows = _group_indices(df[self.get("group_col")])
+        ts = df[self.get("timestamp_col")]
+        vals = df[self.get("value_col")]
+        series_col = []
+        for idxs in group_rows.values():
+            series_col.append([{"timestamp": str(ts[i]), "value": float(vals[i])}
+                               for i in idxs])
+        # ONE batched probe transform: every group's request goes through the
+        # same client at the transformer's concurrency
+        probe = DetectAnomalies(url=self.get("url"),
+                                concurrency=self.get("concurrency"),
+                                timeout=self.get("timeout"),
+                                key_header=self.get("key_header"),
+                                method=self.get("method"),
+                                output_col="__out__", error_col="__err__")
+        for n in self._service_params():   # scalar service params (key, …)
+            if n != "series" and self.get_or_none(n) is not None:
+                probe.set(**{n: self.get(n)})
+        probe.set_vector_param("series", "__series__")
+        res = probe.transform(DataFrame({"__series__": object_col(series_col)}))
+
         out = np.empty(len(df), dtype=object)
         errs = np.empty(len(df), dtype=object)
-        for g in dict.fromkeys(groups):  # preserve order
-            mask = np.asarray([x == g for x in groups], dtype=bool)
-            sub = df.filter(mask)
-            series = [{"timestamp": str(t), "value": float(v)}
-                      for t, v in zip(sub[self.get("timestamp_col")],
-                                      sub[self.get("value_col")])]
-            res, err = self._run_one(series)
-            idxs = np.nonzero(mask)[0]
-            flags = (res or {}).get("isAnomaly", [None] * len(idxs))
+        for g_i, idxs in enumerate(group_rows.values()):
+            parsed, err = res["__out__"][g_i], res["__err__"][g_i]
+            flags = (parsed or {}).get("isAnomaly", [None] * len(idxs))
             for j, i in enumerate(idxs):
                 out[i] = {"isAnomaly": flags[j] if j < len(flags) else None}
                 errs[i] = err
         return (df.with_column(self.get("output_col"), out)
                   .with_column(self.get("error_col"), errs))
-
-    def _run_one(self, series):
-        """Returns (parsed_result, error) for one group's series."""
-        sub_df = DataFrame({"__one__": object_col([series])})
-        probe = DetectAnomalies(url=self.get("url"),
-                                concurrency=1, timeout=self.get("timeout"),
-                                output_col="__out__", error_col="__err__")
-        # forward every scalar service param (sensitivity, granularity, key…)
-        for n in self._service_params():
-            if n != "series" and self.get_or_none(n) is not None:
-                probe.set(**{n: self.get(n)})
-        probe.set_vector_param("series", "__one__")
-        res = probe.transform(sub_df)
-        return res["__out__"][0], res["__err__"][0]
 
     def _local_transform(self, df: DataFrame) -> DataFrame:
         from ..utils.jit_cache import jitted
@@ -116,14 +124,11 @@ class SimpleDetectAnomalies(AnomalyBase):
             return 0.6745 * jnp.abs(v - med) / mad
 
         fn = jitted("services.anomaly.mad_z", mad_z)
-        groups = df[self.get("group_col")]
         vals = np.asarray(df[self.get("value_col")], dtype=np.float32)
         out = np.empty(len(df), dtype=object)
         thr = self.get("local_threshold")
-        for g in dict.fromkeys(groups):
-            mask = np.asarray([x == g for x in groups], dtype=bool)
-            z = np.asarray(fn(vals[mask]))
-            idxs = np.nonzero(mask)[0]
+        for idxs in _group_indices(df[self.get("group_col")]).values():
+            z = np.asarray(fn(vals[idxs]))
             for j, i in enumerate(idxs):
                 out[i] = {"isAnomaly": bool(z[j] > thr),
                           "score": float(z[j])}

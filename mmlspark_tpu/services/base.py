@@ -105,6 +105,8 @@ class HasServiceParams(Params):
             if p.is_url_param:
                 v = self.get_value_opt(row, n)
                 if v is not None:
+                    if isinstance(v, bool):
+                        v = "true" if v else "false"   # not Python's str(bool)
                     out[p.payload_name or n] = v
         return out
 
@@ -125,7 +127,6 @@ class HasAsyncReply(Params):
             return initial
         import json as _json
 
-        from .base import _send  # self-import safe at call time
         for _ in range(self.get("max_polling_retries")):
             time.sleep(self.get("polling_delay_ms") / 1000.0)
             resp = _send(session, HTTPRequestData(url=loc, method="GET",

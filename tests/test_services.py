@@ -61,7 +61,9 @@ class _MockService(BaseHTTPRequestHandler):
         path = urlparse(self.path)
         q = parse_qs(path.query)
         body = json.loads(raw) if raw and raw[:1] in (b"{", b"[") else raw
-        if path.path == "/text/sentiment":
+        if path.path == "/echo_query":
+            self._reply({"query": q})
+        elif path.path == "/text/sentiment":
             assert self.headers.get("Ocp-Apim-Subscription-Key") == "secret"
             doc = body["documents"][0]
             sent = "positive" if "good" in doc["text"] else "negative"
@@ -232,3 +234,31 @@ def test_error_column_on_bad_endpoint(svc):
     out = t.transform(df)
     assert out["out"][0] is None
     assert out["err"][0]["statusCode"] == 404
+
+
+def test_malformed_url_lands_in_error_column():
+    """A transport-level failure (bad URL) must not crash the transform."""
+    df = DataFrame({"txt": object_col(["x", "y"])})
+    t = TextSentiment(url="notaurl", output_col="out", error_col="err",
+                      timeout=2.0)
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    assert out["out"][0] is None and out["out"][1] is None
+    assert out["err"][0]["reasonPhrase"] == "request failed"
+
+
+def test_bool_url_params_lowercase(svc):
+    """Bool URL params render as JSON-style true/false, not Python True."""
+    from mmlspark_tpu.services.base import ServiceParam, ServiceTransformer
+
+    class _BoolSvc(ServiceTransformer):
+        flag = ServiceParam(bool, default=True, is_url_param=True,
+                            payload_name="returnFaceId")
+        text = ServiceParam(str, is_required=True)
+
+    t = _BoolSvc(url=svc + "/echo_query", output_col="out", error_col="err")
+    t.set_vector_param("text", "txt")
+    df = DataFrame({"txt": object_col(["a"])})
+    out = t.transform(df)
+    assert out["err"][0] is None
+    assert out["out"][0]["query"]["returnFaceId"] == ["true"]
