@@ -49,16 +49,28 @@ class TreeArrays(NamedTuple):
 
 
 def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
-    """(n,F) bins × per-row (g,h,count) → (n_nodes, F, B, 3) histogram."""
-    data = jnp.stack([g, h, w_count], axis=-1)  # (n, 3)
+    """(n,F) bins × per-row (g,h,count) → (n_nodes, F, B, 3) histogram.
 
-    def per_feature(bins_col):
-        seg = node_rel * n_bins + bins_col.astype(jnp.int32)
-        return jax.ops.segment_sum(data, seg, num_segments=n_nodes * n_bins)
+    Two interchangeable builders: the Pallas MXU kernel
+    (``ops/pallas_kernels.py``, used on TPU) and an XLA ``segment_sum``
+    fallback. Both replace LightGBM's native C++ histogram construction.
+    """
+    from ...ops.pallas_kernels import histogram_enabled, level_histogram_pallas
+    if histogram_enabled():
+        # force-on off-TPU runs the interpreter (Mosaic can't compile there)
+        hist = level_histogram_pallas(xb, node_rel, g, h, w_count,
+                                      n_nodes, n_bins,
+                                      interpret=jax.default_backend() != "tpu")
+    else:
+        data = jnp.stack([g, h, w_count], axis=-1)  # (n, 3)
 
-    hist = jax.vmap(per_feature, in_axes=1)(xb)      # (F, nodes*B, 3)
-    hist = jnp.transpose(hist.reshape(xb.shape[1], n_nodes, n_bins, 3),
-                         (1, 0, 2, 3))               # (nodes, F, B, 3)
+        def per_feature(bins_col):
+            seg = node_rel * n_bins + bins_col.astype(jnp.int32)
+            return jax.ops.segment_sum(data, seg, num_segments=n_nodes * n_bins)
+
+        hist = jax.vmap(per_feature, in_axes=1)(xb)      # (F, nodes*B, 3)
+        hist = jnp.transpose(hist.reshape(xb.shape[1], n_nodes, n_bins, 3),
+                             (1, 0, 2, 3))               # (nodes, F, B, 3)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist

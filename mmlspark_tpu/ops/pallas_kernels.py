@@ -1,0 +1,133 @@
+"""Pallas TPU kernels for the GBDT hot loop.
+
+The reference's histogram build lives inside LightGBM's C++
+(`LGBM_BoosterUpdateOneIter`, reached from
+``lightgbm/.../booster/LightGBMBooster.scala:351-361``) — a hand-tuned
+scatter-add over (node, feature, bin). The XLA fallback here is
+``segment_sum`` (see ``models/gbdt/trees.py``); this module provides a
+hand-written Pallas equivalent that reformulates the scatter as a
+one-hot × data matmul so the accumulation rides the MXU instead of a
+serialized scatter unit:
+
+    for each (feature, row-block) grid step:
+        onehot[b, r] = 1 if bin(row r, feature) == b          (VPU compare)
+        for node in nodes:                                     (unrolled)
+            hist[node] += (data * node_mask) @ onehot^T        (MXU matmul)
+
+The (3, nodes*bins) accumulator stays resident in VMEM across the row-block
+grid dimension, so HBM traffic is one read of the bins plus one write of the
+final histogram — the minimum possible.
+
+Selection: ``histogram_enabled()`` — env ``MMLSPARK_TPU_PALLAS`` = ``1``
+(force on, interpreted off-TPU), ``0`` (off), default ``auto`` (on when the
+default backend is TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["level_histogram_pallas", "histogram_enabled"]
+
+_LANE = 128
+
+
+def histogram_enabled() -> bool:
+    flag = os.environ.get("MMLSPARK_TPU_PALLAS", "auto").lower()
+    if flag in ("1", "true", "on"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
+    """One (feature, row-block) grid step. Shapes:
+    bins_ref (1, 1, R) int32 | node_ref (1, R) int32 | data_ref (3, R) f32
+    out_ref (1, 3, n_nodes*bpad) f32 — resident across the row-block dim.
+    """
+    from jax.experimental import pallas as pl
+
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    b = bins_ref[0, 0, :]                                # (R,)
+    node = node_ref[0, :]                                # (R,)
+    data = data_ref[...]                                 # (3, R)
+    R = b.shape[0]
+    combined_bytes = n_nodes * bpad * R * 4
+    if combined_bytes <= 6 * 1024 * 1024:
+        # one-hot over the fused (node, bin) id → ONE big MXU matmul
+        seg = node * bpad + b                            # (R,)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes * bpad, R), 0)
+        onehot = (iota == seg[None, :]).astype(jnp.float32)
+        out_ref[0, :, :] += jnp.dot(
+            data, onehot.T, precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)          # (3, nodes*bpad)
+    else:
+        # deep levels: per-node masked matmul keeps VMEM bounded
+        iota = jax.lax.broadcasted_iota(jnp.int32, (bpad, R), 0)
+        onehot = (iota == b[None, :]).astype(jnp.float32)    # (bpad, R)
+        for nd in range(n_nodes):                        # static unroll
+            mask = (node == nd).astype(jnp.float32)      # (R,)
+            md = data * mask[None, :]                    # (3, R)
+            contrib = jnp.dot(md, onehot.T,
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)  # (3, bpad)
+            sl = pl.ds(nd * bpad, bpad)
+            out_ref[0, :, sl] += contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "row_block",
+                                    "interpret"))
+def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
+                           n_bins: int, row_block: int = 512,
+                           interpret: bool = False):
+    """Drop-in for the segment-sum histogram: returns (n_nodes, F, B, 3).
+
+    xb (n, F) int bins; node_rel (n,) int32; g/h/w_count (n,) float32.
+    """
+    from jax.experimental import pallas as pl
+
+    n, F = xb.shape
+    bpad = _round_up(max(n_bins, _LANE), _LANE)
+    npad = _round_up(max(n, row_block), row_block)
+    pad = npad - n
+
+    # (F, 1, npad): the singleton keeps the block's last-two dims legal
+    # ((1, R) with 1 == full dim) for the TPU lowering's tiling rules
+    xb_t = jnp.pad(xb.astype(jnp.int32).T, ((0, 0), (0, pad)))[:, None, :]
+    node = jnp.pad(node_rel.astype(jnp.int32), (0, pad))[None, :]   # (1, npad)
+    data = jnp.stack([g, h, w_count]).astype(jnp.float32)           # (3, n)
+    data = jnp.pad(data, ((0, 0), (0, pad)))                        # zeros kill
+    # padded rows' contributions regardless of their (0) bin/node ids
+
+    nblocks = npad // row_block
+    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(F, nblocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, row_block), lambda f, r: (f, 0, r)),
+            pl.BlockSpec((1, row_block), lambda f, r: (0, r)),
+            pl.BlockSpec((3, row_block), lambda f, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, n_nodes * bpad), lambda f, r: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3, n_nodes * bpad), jnp.float32),
+        interpret=interpret,
+    )(xb_t, node, data)
+
+    hist = out.reshape(F, 3, n_nodes, bpad)[:, :, :, :n_bins]
+    return jnp.transpose(hist, (2, 0, 3, 1))            # (nodes, F, B, 3)

@@ -105,7 +105,8 @@ class HasServiceParams(Params):
             if p.is_url_param:
                 v = self.get_value_opt(row, n)
                 if v is not None:
-                    if isinstance(v, bool):
+                    import numpy as _np
+                    if isinstance(v, (bool, _np.bool_)):
                         v = "true" if v else "false"   # not Python's str(bool)
                     out[p.payload_name or n] = v
         return out

@@ -262,3 +262,12 @@ def test_bool_url_params_lowercase(svc):
     out = t.transform(df)
     assert out["err"][0] is None
     assert out["out"][0]["query"]["returnFaceId"] == ["true"]
+
+    # column-bound flag: rows yield np.bool_, which must also lowercase
+    t2 = _BoolSvc(url=svc + "/echo_query", output_col="out", error_col="err")
+    t2.set_vector_param("text", "txt")
+    t2.set_vector_param("flag", "flagcol")
+    df2 = DataFrame({"txt": object_col(["a"]), "flagcol": [False]})
+    out2 = t2.transform(df2)
+    assert out2["err"][0] is None
+    assert out2["out"][0]["query"]["returnFaceId"] == ["false"]
