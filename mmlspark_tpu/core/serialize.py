@@ -85,6 +85,13 @@ def save_value(value: Any, path: str) -> str:
             json.dump(len(value), f)
         return "stage_list"
     if isinstance(value, np.ndarray):
+        if value.dtype == object or value.dtype.kind in "US":
+            # string/object columns (e.g. KNN values/labels) can't go through
+            # savez without pickle (save would succeed, load would fail) —
+            # store as shape-preserving JSON, or fail fast at save time
+            with open(os.path.join(path, "objarray.json"), "w") as f:
+                json.dump(_obj_array_to_json(value), f)
+            return "objarray"
         np.savez(os.path.join(path, "array.npz"), value=value)
         return "ndarray"
     if isinstance(value, (bytes, bytearray)):
@@ -119,6 +126,9 @@ def load_value(tag: str, path: str) -> Any:
     if tag == "ndarray":
         with np.load(os.path.join(path, "array.npz"), allow_pickle=False) as z:
             return z["value"]
+    if tag == "objarray":
+        with open(os.path.join(path, "objarray.json")) as f:
+            return _obj_array_from_json(json.load(f))
     if tag == "bytes":
         with open(os.path.join(path, "value.bin"), "rb") as f:
             return f.read()
@@ -138,6 +148,27 @@ def load_value(tag: str, path: str) -> Any:
 
 # -- minimal pytree codec (dict/list nesting, ndarray/number leaves) --------
 
+def _canon_scalar(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _obj_array_to_json(arr: np.ndarray) -> dict:
+    """String/object ndarray → {"shape": [...], "values": flat list}.
+    Raises TypeError when elements are not JSON-able (fail at SAVE, never
+    at load)."""
+    flat = [_canon_scalar(v) for v in arr.ravel()]
+    payload = {"shape": list(arr.shape), "values": flat}
+    json.dumps(payload)   # TypeError on non-JSON-able elements
+    return payload
+
+
+def _obj_array_from_json(payload: dict) -> np.ndarray:
+    out = np.empty(len(payload["values"]), dtype=object)
+    for i, v in enumerate(payload["values"]):
+        out[i] = v
+    return out.reshape(payload["shape"])
+
+
 def _try_flatten_tree(value):
     leaves: List[np.ndarray] = []
 
@@ -145,6 +176,10 @@ def _try_flatten_tree(value):
         if isinstance(v, str):
             raise TypeError  # strings are not leaves; JSON path handles them
         if isinstance(v, np.ndarray):
+            if v.dtype == object or v.dtype.kind in "US":
+                # string/object leaves (e.g. BallTree labels) go inline as
+                # JSON — savez would silently pickle them and fail on load
+                return {"strs": _obj_array_to_json(v)}
             leaves.append(v)
             return {"leaf": len(leaves) - 1}
         if np.isscalar(v):
@@ -174,6 +209,8 @@ def _try_flatten_tree(value):
 
 
 def _unflatten_tree(treedef, leaves):
+    if "strs" in treedef:
+        return _obj_array_from_json(treedef["strs"])
     if "leaf" in treedef:
         arr = leaves[treedef["leaf"]]
         return arr.item() if treedef.get("scalar") else arr

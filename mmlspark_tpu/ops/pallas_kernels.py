@@ -49,7 +49,8 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
+def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad,
+                 combined_limit):
     """One (feature, row-block) grid step. Shapes:
     bins_ref (1, 1, R) int32 | node_ref (1, R) int32 | data_ref (3, R) f32
     out_ref (1, 3, n_nodes*bpad) f32 — resident across the row-block dim.
@@ -67,7 +68,7 @@ def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
     data = data_ref[...]                                 # (3, R)
     R = b.shape[0]
     combined_bytes = n_nodes * bpad * R * 4
-    if combined_bytes <= 6 * 1024 * 1024:
+    if combined_bytes <= combined_limit:
         # one-hot over the fused (node, bin) id → ONE big MXU matmul
         seg = node * bpad + b                            # (R,)
         iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes * bpad, R), 0)
@@ -91,10 +92,11 @@ def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
 
 @functools.partial(jax.jit,
                    static_argnames=("n_nodes", "n_bins", "row_block",
-                                    "interpret"))
+                                    "interpret", "combined_limit"))
 def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
                            n_bins: int, row_block: int = 512,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           combined_limit: int = 6 * 1024 * 1024):
     """Drop-in for the segment-sum histogram: returns (n_nodes, F, B, 3).
 
     xb (n, F) int bins; node_rel (n,) int32; g/h/w_count (n,) float32.
@@ -115,7 +117,8 @@ def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
     # padded rows' contributions regardless of their (0) bin/node ids
 
     nblocks = npad // row_block
-    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad)
+    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad,
+                               combined_limit=combined_limit)
     out = pl.pallas_call(
         kernel,
         grid=(F, nblocks),

@@ -19,6 +19,8 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from ..core.dataframe import DataFrame, object_col
 from ..core.params import HasErrorCol, HasOutputCol, Param, Params, identity
 from ..core.pipeline import Transformer
@@ -79,8 +81,14 @@ class HasServiceParams(Params):
         if tagged is None:
             return None
         if tagged["kind"] == _COL:
-            return row.get(tagged["value"])
-        return tagged["value"]
+            v = row.get(tagged["value"])
+        else:
+            v = tagged["value"]
+        # numpy scalars from DataFrame rows must behave like Python scalars
+        # everywhere downstream (JSON bodies, urlencode, bool checks)
+        if isinstance(v, np.generic):
+            v = v.item()
+        return v
 
     def should_skip(self, row: dict) -> bool:
         """True if any required service param is null for this row."""
@@ -105,8 +113,7 @@ class HasServiceParams(Params):
             if p.is_url_param:
                 v = self.get_value_opt(row, n)
                 if v is not None:
-                    import numpy as _np
-                    if isinstance(v, (bool, _np.bool_)):
+                    if isinstance(v, bool):
                         v = "true" if v else "false"   # not Python's str(bool)
                     out[p.payload_name or n] = v
         return out

@@ -42,6 +42,23 @@ def test_pallas_histogram_matches_segment_sum(rng, n, F, n_nodes, n_bins):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
+def test_pallas_histogram_per_node_branch(rng):
+    """Force the deep-level per-node masked-matmul branch (combined one-hot
+    over VMEM budget) and check it against the reference too."""
+    n, F, n_nodes, n_bins = 400, 2, 8, 32
+    xb = rng.integers(0, n_bins, (n, F)).astype(np.int32)
+    node = rng.integers(0, n_nodes, n).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32)
+    w = np.ones(n, np.float32)
+    got = np.asarray(level_histogram_pallas(
+        jnp.asarray(xb), jnp.asarray(node), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(w), n_nodes, n_bins, row_block=128, interpret=True,
+        combined_limit=1))     # always take the per-node path
+    want = _reference_hist(xb, node, g, h, w, n_nodes, n_bins)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
 def test_histogram_enabled_env(monkeypatch):
     monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "1")
     assert histogram_enabled()
