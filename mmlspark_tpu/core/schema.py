@@ -16,6 +16,7 @@ import numpy as np
 from .dataframe import DataFrame
 
 __all__ = [
+    "py_scalar",
     "find_unused_column_name",
     "set_categorical_metadata",
     "get_categorical_levels",
@@ -30,6 +31,11 @@ __all__ = [
 CATEGORICAL_KEY = "ml_categorical"
 LABEL_KEY = "ml_label"
 SCORE_KEY = "ml_score"
+
+
+def py_scalar(v):
+    """numpy scalar → plain Python scalar (identity otherwise)."""
+    return v.item() if isinstance(v, np.generic) else v
 
 
 def find_unused_column_name(base: str, df: DataFrame) -> str:

@@ -149,7 +149,8 @@ def load_value(tag: str, path: str) -> Any:
 # -- minimal pytree codec (dict/list nesting, ndarray/number leaves) --------
 
 def _canon_scalar(v):
-    return v.item() if isinstance(v, np.generic) else v
+    from .schema import py_scalar
+    return py_scalar(v)
 
 
 def _obj_array_to_json(arr: np.ndarray) -> dict:

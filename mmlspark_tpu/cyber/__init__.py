@@ -1,0 +1,33 @@
+"""CyberML: access-anomaly detection on (tenant, user, resource) logs.
+
+Parity surface: the reference's pure-Python ``synapse/ml/cyber`` package
+(``core/src/main/python/synapse/ml/cyber/**``):
+
+* feature: per-tenant id indexers and scalers (``feature/indexers.py``,
+  ``feature/scalers.py``)
+* anomaly: ``ComplementAccessTransformer`` (``anomaly/complement_access.py``)
+  and the ``AccessAnomaly`` collaborative-filtering estimator
+  (``anomaly/collaborative_filtering.py``)
+* ``DataFactory`` demo-data generator (``dataset.py``)
+
+TPU-native redesign: Spark ALS is replaced by a jitted alternating
+least-squares in JAX — per-user/per-item ridge systems are built with
+einsums and solved as one batched ``jnp.linalg.solve``, so each half-step
+is a handful of large MXU ops instead of a Spark shuffle.
+"""
+
+from .features import (IdIndexer, IdIndexerModel, LinearScalarScaler,
+                       LinearScalarScalerModel, MultiIndexer,
+                       MultiIndexerModel, StandardScalarScaler,
+                       StandardScalarScalerModel)
+from .complement_access import ComplementAccessTransformer
+from .access_anomaly import AccessAnomaly, AccessAnomalyModel
+from .dataset import DataFactory
+
+__all__ = [
+    "IdIndexer", "IdIndexerModel", "MultiIndexer", "MultiIndexerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+    "ComplementAccessTransformer", "AccessAnomaly", "AccessAnomalyModel",
+    "DataFactory",
+]

@@ -1,0 +1,150 @@
+"""CyberML tests: indexers, scalers, complement sampling, AccessAnomaly.
+
+Mirrors the intent of the reference's cyber test suite: inter-cluster
+accesses must score strictly higher (more anomalous) than intra-cluster
+ones after CF training.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.dataframe import object_col
+from mmlspark_tpu.cyber import (AccessAnomaly, AccessAnomalyModel,
+                                ComplementAccessTransformer, DataFactory,
+                                IdIndexer, LinearScalarScaler, MultiIndexer,
+                                StandardScalarScaler)
+
+
+def _acc_df():
+    return DataFrame({
+        "tenant": object_col(["a", "a", "a", "b", "b"]),
+        "user": object_col(["u1", "u2", "u1", "u1", "u3"]),
+        "res": object_col(["r1", "r1", "r2", "r9", "r9"]),
+        "likelihood": np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    })
+
+
+def test_id_indexer_per_tenant():
+    df = _acc_df()
+    model = IdIndexer(input_col="user", output_col="uidx",
+                      partition_key="tenant").fit(df)
+    out = model.transform(df)
+    # per-tenant contiguous, 1-based; tenant b restarts at 1
+    assert list(out["uidx"]) == [1, 2, 1, 1, 2]
+    # unseen id maps to 0
+    q = DataFrame({"tenant": object_col(["a"]), "user": object_col(["zz"])})
+    assert model.transform(q)["uidx"][0] == 0
+    # undo_transform recovers names
+    undo = model.undo_transform(out.select(["tenant", "uidx"]))
+    assert list(undo["user"]) == ["u1", "u2", "u1", "u1", "u3"]
+
+
+def test_multi_indexer_lookup():
+    df = _acc_df()
+    mi = MultiIndexer([
+        IdIndexer(input_col="user", output_col="uidx", partition_key="tenant"),
+        IdIndexer(input_col="res", output_col="ridx", partition_key="tenant"),
+    ]).fit(df)
+    out = mi.transform(df)
+    assert "uidx" in out.columns and "ridx" in out.columns
+    assert mi.get_model_by_input_col("res").get("output_col") == "ridx"
+
+
+def test_standard_scaler_per_tenant():
+    df = _acc_df()
+    out = StandardScalarScaler(input_col="likelihood", output_col="z",
+                               partition_key="tenant").fit(df).transform(df)
+    za = out["z"][:3]
+    assert abs(za.mean()) < 1e-9        # per-tenant zero mean
+    assert abs(np.std(za) - 1.0) < 1e-9
+
+
+def test_linear_scaler_range():
+    df = _acc_df()
+    out = LinearScalarScaler(input_col="likelihood", output_col="s",
+                             partition_key="tenant",
+                             min_required_value=5.0,
+                             max_required_value=10.0).fit(df).transform(df)
+    assert out["s"].min() == 5.0 and out["s"].max() == 10.0
+
+
+def test_complement_access_excludes_observed():
+    df = DataFrame({"u": np.array([1, 1, 2, 2]),
+                    "r": np.array([1, 2, 1, 2])})
+    # indices span 1..2 × 1..2, all 4 observed → complement is empty
+    out = ComplementAccessTransformer(
+        indexed_col_names=["u", "r"], complementset_factor=4).transform(df)
+    assert len(out) == 0
+    df2 = DataFrame({"u": np.array([1, 2, 3]), "r": np.array([1, 2, 3])})
+    out2 = ComplementAccessTransformer(
+        indexed_col_names=["u", "r"], complementset_factor=8,
+        seed=1).transform(df2)
+    seen = {(1, 1), (2, 2), (3, 3)}
+    got = set(zip(out2["u"], out2["r"]))
+    assert got and not (got & seen)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    factory = DataFactory(num_hr_users=10, num_hr_resources=15,
+                          num_fin_users=10, num_fin_resources=15, seed=2)
+    train = factory.create_clustered_training_data(ratio=0.4)
+    model = AccessAnomaly(rank_param=6, max_iter=15, seed=0).fit(train)
+    return factory, train, model
+
+
+def test_access_anomaly_separates_clusters(fitted):
+    factory, train, model = fitted
+    intra = model.transform(factory.create_clustered_intra_test_data(30))
+    inter = model.transform(factory.create_clustered_inter_test_data(30))
+
+    def scores(df):
+        return np.array([s for s in df["anomaly_score"]
+                         if s is not None and np.isfinite(s)])
+
+    si, sx = scores(intra), scores(inter)
+    assert len(si) > 5 and len(sx) > 5
+    # inter-cluster (anomalous) accesses score clearly higher
+    assert sx.mean() > si.mean() + 0.5
+
+
+def test_access_anomaly_history_and_unknowns(fitted):
+    factory, train, model = fitted
+    out = model.transform(train.head(3))
+    assert all(s == 0.0 for s in out["anomaly_score"])  # seen → 0
+    q = DataFrame({"tenant": object_col(["t0"]),
+                   "user": object_col(["nobody"]),
+                   "res": object_col(["hr_res_0"])})
+    assert model.transform(q)["anomaly_score"][0] is None
+
+
+def test_access_anomaly_save_load(fitted, tmp_path):
+    factory, train, model = fitted
+    test = factory.create_clustered_inter_test_data(10)
+    ref = model.transform(test)["anomaly_score"]
+    p = str(tmp_path / "aa")
+    model.save(p)
+    again = AccessAnomalyModel.load(p)
+    got = again.transform(test)["anomaly_score"]
+    for a, b in zip(ref, got):
+        if a is None:
+            assert b is None
+        else:
+            assert abs(a - b) < 1e-6
+
+
+def test_access_anomaly_explicit_mode():
+    factory = DataFactory(num_hr_users=8, num_hr_resources=10,
+                          num_fin_users=8, num_fin_resources=10, seed=3)
+    train = factory.create_clustered_training_data(ratio=0.5)
+    model = AccessAnomaly(rank_param=5, max_iter=10,
+                          apply_implicit_cf=False, seed=0).fit(train)
+    inter = model.transform(factory.create_clustered_inter_test_data(20))
+    intra = model.transform(factory.create_clustered_intra_test_data(20))
+
+    def scores(df):
+        return np.array([s for s in df["anomaly_score"]
+                         if s is not None and np.isfinite(s)])
+
+    assert scores(inter).mean() > scores(intra).mean()

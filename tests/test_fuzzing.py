@@ -107,6 +107,13 @@ def _registry():
     from mmlspark_tpu.automl.hyperparam import (DiscreteHyperParam,
                                                 HyperparamBuilder, RandomSpace)
     from mmlspark_tpu.automl.tune import FindBestModel, TuneHyperparameters
+    from mmlspark_tpu.cyber import (AccessAnomaly as CyAccessAnomaly,
+                                    ComplementAccessTransformer as CyComplement,
+                                    DataFactory,
+                                    IdIndexer as CyIdIndexer,
+                                    LinearScalarScaler as CyLinearScaler,
+                                    MultiIndexer as CyMultiIndexer,
+                                    StandardScalarScaler as CyStandardScaler)
     from mmlspark_tpu.explainers.ice import ICETransformer
     from mmlspark_tpu.explainers.lime import (ImageLIME, TabularLIME,
                                               TextLIME, VectorLIME)
@@ -455,6 +462,29 @@ def _registry():
                 input_col="num", output_col="out",
                 input_parser=JSONInputParser(url="http://localhost:1/x")),
             experiment=False),
+        # cyber
+        CyIdIndexer: lambda: TestObject(
+            CyIdIndexer(input_col="cat", output_col="cidx",
+                        partition_key="cat"), fit_df=df),
+        CyMultiIndexer: lambda: TestObject(
+            CyMultiIndexer([CyIdIndexer(input_col="cat", output_col="cidx")]),
+            fit_df=df),
+        CyStandardScaler: lambda: TestObject(
+            CyStandardScaler(input_col="num", output_col="z"), fit_df=df),
+        CyLinearScaler: lambda: TestObject(
+            CyLinearScaler(input_col="num", output_col="s",
+                           min_required_value=1.0, max_required_value=2.0),
+            fit_df=df),
+        CyComplement: lambda: TestObject(
+            CyComplement(indexed_col_names=["iu", "ir"],
+                         complementset_factor=2, seed=0),
+            transform_df=DataFrame({"iu": np.array([1, 2, 3]),
+                                    "ir": np.array([1, 2, 3])})),
+        CyAccessAnomaly: lambda: TestObject(
+            CyAccessAnomaly(rank_param=3, max_iter=4, seed=0),
+            fit_df=DataFactory(num_hr_users=4, num_hr_resources=5,
+                               num_fin_users=4, num_fin_resources=5,
+                               seed=1).create_clustered_training_data(0.5)),
         # serving
         ParseRequest: lambda: TestObject(ParseRequest(), experiment=False),
         MakeReply: lambda: TestObject(MakeReply(value_col="out"),
