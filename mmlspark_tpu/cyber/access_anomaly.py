@@ -157,8 +157,9 @@ class AccessAnomaly(Estimator):
         stats: Dict = {}
         seen: Dict = {}
         comps: Dict = {}
-        for t in dict.fromkeys(tenants):
-            mask = tenants == t
+        for t_raw in dict.fromkeys(tenants):
+            t = _py(t_raw)   # plain scalar: keys must survive JSON save
+            mask = tenants == t_raw
             sub_u = scaled["__uidx__"][mask]
             sub_r = scaled["__ridx__"][mask]
             sub_s = scaled["__scaled__"][mask].astype(np.float64)
@@ -192,20 +193,15 @@ class AccessAnomaly(Estimator):
             sd = sd if sd > 1e-12 else 1.0
             stats[t] = (mu, sd)
 
-            # raw id → vector maps
-            u_inv = {}
-            r_inv = {}
-            for name, idx in zip(df[ucol][mask], sub_u):
-                u_inv[_py(name)] = U[int(idx) - 1]
-            for name, idx in zip(df[rcol][mask], sub_r):
-                r_inv[_py(name)] = V[int(idx) - 1]
-            user_maps[t] = u_inv
-            res_maps[t] = r_inv
-            seen[t] = set(zip((_py(x) for x in df[ucol][mask]),
-                              (_py(x) for x in df[rcol][mask])))
-            comps[t] = ConnectedComponents.components(
-                [_py(x) for x in df[ucol][mask]],
-                [_py(x) for x in df[rcol][mask]])
+            # raw id → vector maps (names converted once, reused thrice)
+            us = [_py(x) for x in df[ucol][mask]]
+            rs = [_py(x) for x in df[rcol][mask]]
+            user_maps[t] = {name: U[int(idx) - 1]
+                            for name, idx in zip(us, sub_u)}
+            res_maps[t] = {name: V[int(idx) - 1]
+                           for name, idx in zip(rs, sub_r)}
+            seen[t] = set(zip(us, rs))
+            comps[t] = ConnectedComponents.components(us, rs)
 
         m = AccessAnomalyModel()
         m.set(tenant_col=tcol, user_col=ucol, res_col=rcol,

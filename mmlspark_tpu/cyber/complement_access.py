@@ -61,8 +61,5 @@ class ComplementAccessTransformer(Transformer):
 
         data = {c: np.asarray(out_cols[c], dtype=np.int64) for c in cols}
         if key is not None:
-            tcol = np.empty(len(out_tenant), dtype=object)
-            for i, t in enumerate(out_tenant):
-                tcol[i] = t
-            data = {key: tcol, **data}
+            data = {key: object_col(out_tenant), **data}
         return DataFrame(data)

@@ -43,15 +43,18 @@ class IdIndexer(Estimator, HasInputCol, HasOutputCol):
         tenants = _tenants(df, key)
         vals = df[self.get("input_col")]
         vocab: Dict = {}
+        # store plain Python scalars so the fitted vocab is JSON-serializable
         if self.get("reset_per_partition"):
             counters: Dict = {}
             for t, v in zip(tenants, vals):
+                t, v = _py(t), _py(v)
                 if (t, v) not in vocab:
                     counters[t] = counters.get(t, 0) + 1
                     vocab[(t, v)] = counters[t]
         else:
             nxt = 1
             for t, v in zip(tenants, vals):
+                t, v = _py(t), _py(v)
                 if (t, v) not in vocab:
                     vocab[(t, v)] = nxt
                     nxt += 1
@@ -73,7 +76,8 @@ class IdIndexerModel(Model, HasInputCol, HasOutputCol):
         lut = self._lookup()
         tenants = _tenants(df, self.get_or_none("partition_key"))
         vals = df[self.get("input_col")]
-        out = np.array([lut.get((t, _py(v)), 0) for t, v in zip(tenants, vals)],
+        out = np.array([lut.get((_py(t), _py(v)), 0)
+                        for t, v in zip(tenants, vals)],
                        dtype=np.int64)   # 0 = unseen id
         return df.with_column(self.get("output_col"), out)
 
@@ -82,7 +86,8 @@ class IdIndexerModel(Model, HasInputCol, HasOutputCol):
         inv = {(t, i): v for t, v, i in self.get("vocab")}
         tenants = _tenants(df, self.get_or_none("partition_key"))
         idx = df[self.get("output_col")]
-        vals = object_col([inv.get((t, int(i))) for t, i in zip(tenants, idx)])
+        vals = object_col([inv.get((_py(t), int(i)))
+                           for t, i in zip(tenants, idx)])
         return df.with_column(self.get("input_col"), vals)
 
 

@@ -22,10 +22,16 @@ from .text import (EntityDetector, KeyPhraseExtractor, LanguageDetector,
                    NER, TextSentiment)
 from .vision import AnalyzeImage, DescribeImage, OCR, TagImage
 from .anomaly import DetectAnomalies, DetectLastAnomaly, SimpleDetectAnomalies
-from .translate import BreakSentence, DetectLanguage, Translate, Transliterate
+from .translate import (BreakSentence, DetectLanguage, DocumentTranslator,
+                        Translate, Transliterate)
 from .face import DetectFace, GroupFaces, IdentifyFaces, VerifyFaces
-from .form import AnalyzeLayout, AnalyzeInvoices, AnalyzeReceipts
+from .form import (AnalyzeLayout, AnalyzeInvoices, AnalyzeReceipts,
+                   FormOntologyLearner, FormOntologyTransformer)
 from .search import AzureSearchWriter, BingImageSearch
+from .speech import SpeechToText, SpeechToTextSDK, TextToSpeech
+from .mvad import DetectMultivariateAnomaly, FitMultivariateAnomaly
+from .geospatial import (AddressGeocoder, CheckPointInPolygon,
+                         ReverseAddressGeocoder)
 
 __all__ = [
     "ServiceParam", "HasServiceParams", "ServiceTransformer", "HasAsyncReply",
@@ -36,4 +42,8 @@ __all__ = [
     "DetectFace", "VerifyFaces", "GroupFaces", "IdentifyFaces",
     "AnalyzeLayout", "AnalyzeInvoices", "AnalyzeReceipts",
     "AzureSearchWriter", "BingImageSearch",
+    "DocumentTranslator", "FormOntologyLearner", "FormOntologyTransformer",
+    "SpeechToText", "SpeechToTextSDK", "TextToSpeech",
+    "FitMultivariateAnomaly", "DetectMultivariateAnomaly",
+    "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
 ]

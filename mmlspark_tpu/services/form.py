@@ -6,11 +6,15 @@ receipt analysis — async 202 + Operation-Location polling like OCR.
 
 from __future__ import annotations
 
+from ..core.dataframe import DataFrame, object_col
+from ..core.params import Param
+from ..core.pipeline import Estimator, Model
 from .base import HasAsyncReply, ServiceParam
 from .vision import VisionBase
 
 __all__ = ["FormRecognizerBase", "AnalyzeLayout", "AnalyzeInvoices",
-           "AnalyzeReceipts"]
+           "AnalyzeReceipts", "FormOntologyLearner",
+           "FormOntologyTransformer"]
 
 
 class FormRecognizerBase(VisionBase, HasAsyncReply):
@@ -36,3 +40,61 @@ class AnalyzeReceipts(FormRecognizerBase):
     include_text_details = ServiceParam(bool, is_url_param=True,
                                         payload_name="includeTextDetails",
                                         doc="include raw OCR lines")
+
+
+class FormOntologyLearner(Estimator):
+    """Learn a unified field ontology from form-analysis outputs.
+
+    Parity: ``cognitive/.../FormOntologyLearner.scala:42-75`` — merge the
+    ``fields`` structures of every row's AnalyzeResponse into one schema;
+    the fitted transformer projects each response onto that schema as a
+    plain {field: value} struct column.
+    """
+
+    input_col = Param(str, default="form", doc="column of analyze outputs")
+    output_col = Param(str, default="ontology", doc="extracted struct column")
+
+    @staticmethod
+    def _fields_of(resp) -> dict:
+        if resp is None:
+            return {}
+        ar = resp.get("analyzeResult", resp) if isinstance(resp, dict) else {}
+        docs = ar.get("documentResults") or []
+        return (docs[0] or {}).get("fields", {}) if docs else {}
+
+    def _fit(self, df: DataFrame) -> "FormOntologyTransformer":
+        merged: dict = {}
+        for resp in df[self.get("input_col")]:
+            for name, spec in self._fields_of(resp).items():
+                t = (spec or {}).get("type", "string")
+                prev = merged.get(name)
+                # type union: conflicting types widen to string
+                merged[name] = t if prev in (None, t) else "string"
+        m = FormOntologyTransformer()
+        m.set(input_col=self.get("input_col"),
+              output_col=self.get("output_col"),
+              ontology={k: merged[k] for k in sorted(merged)})
+        return m
+
+
+class FormOntologyTransformer(Model):
+    input_col = Param(str, default="form", doc="column of analyze outputs")
+    output_col = Param(str, default="ontology", doc="extracted struct column")
+    ontology = Param(dict, default={}, doc="field name → type")
+
+    _VALUE_KEYS = {"number": "valueNumber", "date": "valueDate",
+                   "time": "valueTime", "phoneNumber": "valuePhoneNumber",
+                   "integer": "valueInteger", "string": "valueString"}
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        onto = self.get("ontology")
+        out = []
+        for resp in df[self.get("input_col")]:
+            fields = FormOntologyLearner._fields_of(resp)
+            row = {}
+            for name, t in onto.items():
+                spec = fields.get(name) or {}
+                row[name] = spec.get(self._VALUE_KEYS.get(t, "valueString"),
+                                     spec.get("text"))
+            out.append(row)
+        return df.with_column(self.get("output_col"), object_col(out))

@@ -1,0 +1,84 @@
+"""Geospatial (Azure-Maps-style) service transformers.
+
+Parity: ``cognitive/.../geospatial/Geocoders.scala`` (``AddressGeocoder``,
+``ReverseAddressGeocoder`` — batch POST ``{"batchItems": [...]}`` to the
+search endpoints, output the ``batchItems`` array) and
+``CheckPointInPolygon.scala`` (GET per point against a stored geofence).
+Subscription key rides as the ``subscription-key`` URL param, as Azure Maps
+expects (``AzureMapsTraits.scala``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..io.http.schema import EntityData, HTTPRequestData
+from .base import ServiceParam, ServiceTransformer
+
+__all__ = ["AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon"]
+
+
+class _MapsBase(ServiceTransformer):
+    """Azure-Maps auth: key goes in the query string, not a header."""
+
+    def _headers(self, row):
+        from ..io.http.schema import HeaderData
+        return [HeaderData("Content-Type", "application/json")]
+
+    def _full_url(self, row: dict) -> str:
+        from urllib.parse import quote
+        url = super()._full_url(row)
+        key = self.get_value_opt(row, "subscription_key")
+        if key:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}subscription-key={quote(str(key))}"
+        return url
+
+
+class AddressGeocoder(_MapsBase):
+    """Batch forward geocoding: address strings → candidate coordinates."""
+
+    address = ServiceParam(list, is_required=True,
+                           doc="list of address strings per row (a batch)")
+
+    def _payload(self, row: dict):
+        addrs = self.get_value_opt(row, "address")
+        return {"batchItems": [{"query": f"?query={a}"} for a in addrs]}
+
+    def _parse(self, body):
+        if isinstance(body, dict):
+            return body.get("batchItems", body)
+        return body
+
+
+class ReverseAddressGeocoder(_MapsBase):
+    """Batch reverse geocoding: (lat, lon) pairs → addresses."""
+
+    coordinates = ServiceParam(list, is_required=True,
+                               doc="list of [lat, lon] pairs per row")
+
+    def _payload(self, row: dict):
+        pts = self.get_value_opt(row, "coordinates")
+        return {"batchItems": [{"query": f"?query={lat},{lon}"}
+                               for lat, lon in pts]}
+
+    def _parse(self, body):
+        if isinstance(body, dict):
+            return body.get("batchItems", body)
+        return body
+
+
+class CheckPointInPolygon(_MapsBase):
+    """Point-in-geofence check (GET per row)."""
+
+    lat = ServiceParam(float, is_required=True, is_url_param=True,
+                       doc="point latitude")
+    lon = ServiceParam(float, is_required=True, is_url_param=True,
+                       doc="point longitude")
+    user_data_identifier = ServiceParam(str, is_url_param=True,
+                                        payload_name="udid",
+                                        doc="uploaded polygon id")
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._set_default(method="GET")

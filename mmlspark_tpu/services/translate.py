@@ -7,9 +7,10 @@ Parity: ``cognitive/.../TextTranslator.scala`` (550 LoC): ``Translate``,
 
 from __future__ import annotations
 
-from .base import ServiceParam, ServiceTransformer
+from .base import HasAsyncReply, ServiceParam, ServiceTransformer
 
 __all__ = ["TranslatorBase", "Translate", "Transliterate", "DetectLanguage",
+           "DocumentTranslator",
            "BreakSentence"]
 
 
@@ -59,3 +60,27 @@ class BreakSentence(TranslatorBase):
         if isinstance(first, dict):
             return first.get("sentLen", first)
         return first
+
+
+class DocumentTranslator(ServiceTransformer, HasAsyncReply):
+    """Batch document translation (parity: ``DocumentTranslator.scala``,
+    167 LoC): POST ``{"inputs": [{source, targets}]}`` to ``/batches``;
+    the 202 + Operation-Location long-poll is inherited from HasAsyncReply."""
+
+    source_url = ServiceParam(str, is_required=True,
+                              doc="container URL of source documents")
+    target_url = ServiceParam(str, is_required=True,
+                              doc="container URL for translated output")
+    target_language = ServiceParam(str, is_required=True,
+                                   doc="language code to translate to")
+    storage_type = ServiceParam(str, doc="Folder or File")
+
+    def _payload(self, row: dict):
+        target = {"targetUrl": self.get_value_opt(row, "target_url"),
+                  "language": self.get_value_opt(row, "target_language")}
+        inp = {"source": {"sourceUrl": self.get_value_opt(row, "source_url")},
+               "targets": [target]}
+        st = self.get_value_opt(row, "storage_type")
+        if st is not None:
+            inp["storageType"] = st
+        return {"inputs": [inp]}

@@ -148,3 +148,33 @@ def test_access_anomaly_explicit_mode():
                          if s is not None and np.isfinite(s)])
 
     assert scores(inter).mean() > scores(intra).mean()
+
+
+def test_id_indexer_numeric_ids_serializable(tmp_path):
+    """Numeric id/tenant columns must produce a JSON-serializable vocab."""
+    df = DataFrame({"tenant": np.array([1, 1, 2]),
+                    "user": np.array([10, 20, 10])})
+    model = IdIndexer(input_col="user", output_col="uidx",
+                      partition_key="tenant").fit(df)
+    out = model.transform(df)
+    assert list(out["uidx"]) == [1, 2, 1]
+    p = str(tmp_path / "ix")
+    model.save(p)
+    from mmlspark_tpu.cyber import IdIndexerModel
+    again = IdIndexerModel.load(p)
+    assert list(again.transform(df)["uidx"]) == [1, 2, 1]
+
+
+def test_access_anomaly_numeric_tenant_save(tmp_path):
+    df = DataFrame({
+        "tenant": np.array([7] * 6),
+        "user": object_col(["u1", "u2", "u3", "u1", "u2", "u3"]),
+        "res": object_col(["r1", "r1", "r2", "r2", "r3", "r3"]),
+        "likelihood": np.ones(6),
+    })
+    model = AccessAnomaly(rank_param=2, max_iter=3).fit(df)
+    p = str(tmp_path / "aa_num")
+    model.save(p)
+    again = AccessAnomalyModel.load(p)
+    out = again.transform(df)
+    assert all(s == 0.0 for s in out["anomaly_score"])  # all seen

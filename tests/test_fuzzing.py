@@ -500,6 +500,19 @@ def _registry():
         return lambda: TestObject(cls(url="http://localhost:1/x", **kw),
                                   experiment=False)
 
+    from mmlspark_tpu.services import geospatial as SG, mvad as SM, \
+        speech as SSp
+
+    # FormOntologyLearner runs fully locally → full experiment fuzz
+    forms = object_col([
+        {"analyzeResult": {"documentResults": [{"fields": {
+            "Total": {"type": "number", "valueNumber": 1.0}}}]}}])
+    R[SFo.FormOntologyLearner] = lambda: TestObject(
+        SFo.FormOntologyLearner(input_col="form", output_col="onto"),
+        fit_df=DataFrame({"form": forms}))
+    R[SM.FitMultivariateAnomaly] = lambda: TestObject(
+        SM.FitMultivariateAnomaly(url="http://localhost:1/x"),
+        experiment=False)
     for cls in (ST.TextSentiment, ST.LanguageDetector, ST.EntityDetector,
                 ST.KeyPhraseExtractor, ST.NER,
                 SV.AnalyzeImage, SV.DescribeImage, SV.OCR, SV.TagImage,
@@ -510,7 +523,10 @@ def _registry():
                 STr.DetectLanguage,
                 SSe.BingImageSearch,
                 SA.DetectAnomalies, SA.DetectLastAnomaly,
-                SA.SimpleDetectAnomalies):
+                SA.SimpleDetectAnomalies,
+                SSp.SpeechToText, SSp.SpeechToTextSDK, SSp.TextToSpeech,
+                SG.AddressGeocoder, SG.ReverseAddressGeocoder,
+                SG.CheckPointInPolygon, STr.DocumentTranslator):
         R[cls] = _svc(cls)
     return R
 

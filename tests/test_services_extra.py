@@ -1,0 +1,228 @@
+"""Mock-server tests for the speech / MVAD / geospatial / doc-translation /
+form-ontology service families."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.dataframe import object_col
+from mmlspark_tpu.services import (AddressGeocoder, CheckPointInPolygon,
+                                   DetectMultivariateAnomaly,
+                                   DocumentTranslator, FitMultivariateAnomaly,
+                                   FormOntologyLearner, ReverseAddressGeocoder,
+                                   SpeechToText, SpeechToTextSDK, TextToSpeech)
+
+_state = {"mvad_polls": {}, "docop_polls": {}}
+
+
+class _Mock(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, status=200, headers=(), raw=None):
+        out = raw if raw is not None else json.dumps(obj).encode()
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Type",
+                         "application/json" if raw is None else
+                         "application/octet-stream")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def do_GET(self):
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        if path.path.startswith("/mvad/models/"):
+            mid = path.path.rsplit("/", 1)[1]
+            n = _state["mvad_polls"].get(mid, 0)
+            _state["mvad_polls"][mid] = n + 1
+            status = "READY" if n >= 1 else "CREATED"
+            self._reply({"modelInfo": {"status": status}})
+        elif path.path.startswith("/mvad/results/"):
+            self._reply({"summary": {"status": "READY"},
+                         "results": [
+                             {"timestamp": "t0", "value": {"isAnomaly": False}},
+                             {"timestamp": "t1", "value": {"isAnomaly": True}}]})
+        elif path.path == "/geofence":
+            lat = float(q["lat"][0])
+            self._reply({"result": {"isInside": lat < 50.0}})
+        elif path.path.startswith("/docop/"):
+            op = path.path.rsplit("/", 1)[1]
+            n = _state["docop_polls"].get(op, 0)
+            _state["docop_polls"][op] = n + 1
+            self._reply({"status": "Succeeded" if n >= 1 else "Running",
+                         "summary": {"success": 1}})
+        else:
+            self._reply({"error": "nf"}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        if path.path == "/stt":
+            assert self.headers["Content-Type"].startswith("audio/wav")
+            text = f"heard {len(raw)} bytes in {q['language'][0]}"
+            self._reply({"RecognitionStatus": "Success",
+                         "DisplayText": text})
+        elif path.path == "/tts":
+            assert self.headers["Content-Type"].startswith("application/ssml")
+            assert self.headers.get("X-Microsoft-OutputFormat")
+            self._reply(None, raw=b"RIFFfakeaudio" + raw[:8])
+        elif path.path == "/mvad/models":
+            self._reply({}, status=201,
+                        headers=[("Location", "http://x/mvad/models/m123")])
+        elif path.path == "/mvad/models/m123/detect":
+            self._reply({}, status=201,
+                        headers=[("Location", "http://x/mvad/results/r99")])
+        elif path.path == "/geocode":
+            body = json.loads(raw)
+            items = [{"response": {"ok": True, "q": it["query"]}}
+                     for it in body["batchItems"]]
+            self._reply({"batchItems": items})
+        elif path.path == "/docbatches":
+            body = json.loads(raw)
+            assert body["inputs"][0]["targets"][0]["language"] == "fr"
+            self._reply({}, status=202,
+                        headers=[("Operation-Location",
+                                  f"{_state['base']}/docop/op7")])
+        else:
+            self._reply({"error": "nf"}, 404)
+
+
+@pytest.fixture(scope="module")
+def svc():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Mock)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    _state["base"] = base
+    yield base
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_speech_to_text(svc):
+    df = DataFrame({"audio": object_col([b"\x00" * 100, None])})
+    t = SpeechToText(url=svc + "/stt", output_col="out", error_col="err")
+    t.set_vector_param("audio_data", "audio")
+    out = t.transform(df)
+    assert out["out"][0]["DisplayText"] == "heard 100 bytes in en-US"
+    assert out["out"][1] is None   # null audio → skipped row
+
+
+def test_speech_to_text_sdk_chunks(svc):
+    df = DataFrame({"audio": object_col([b"\x01" * 70000])})
+    t = SpeechToTextSDK(url=svc + "/stt", chunk_bytes=32768,
+                        output_col="out", error_col="err")
+    t.set_vector_param("audio_data", "audio")
+    out = t.transform(df)
+    results = out["out"][0]
+    assert len(results) == 3       # 70000 / 32768 → 3 chunks
+    assert results[0]["DisplayText"].startswith("heard 32768")
+    assert results[2]["DisplayText"].startswith("heard 4464")
+
+
+def test_text_to_speech_writes_files(svc, tmp_path):
+    paths = [str(tmp_path / "a.wav"), str(tmp_path / "b.wav")]
+    df = DataFrame({"text": object_col(["hello", "world"]),
+                    "outputFile": object_col(paths)})
+    t = TextToSpeech(url=svc + "/tts", error_col="err")
+    t.set_vector_param("text", "text")
+    out = t.transform(df)
+    assert out["err"][0] is None and out["err"][1] is None
+    for p in paths:
+        with open(p, "rb") as f:
+            assert f.read().startswith(b"RIFF")
+
+
+def test_mvad_fit_and_detect(svc):
+    est = FitMultivariateAnomaly(url=svc + "/mvad/models",
+                                 source="http://blob/x.zip",
+                                 start_time="t0", end_time="t9",
+                                 polling_delay_ms=10)
+    df = DataFrame({"timestamp": object_col(["t0", "t1"])})
+    model = est.fit(df)
+    assert model.get("model_id") == "m123"
+    out = model.transform(df)
+    assert out["result"][0] == {"isAnomaly": False}
+    assert out["result"][1] == {"isAnomaly": True}
+    assert out["error"][0] is None
+
+
+def test_mvad_model_roundtrip(svc, tmp_path):
+    est = FitMultivariateAnomaly(url=svc + "/mvad/models",
+                                 polling_delay_ms=10)
+    model = est.fit(DataFrame({"timestamp": object_col(["t0"])}))
+    p = str(tmp_path / "mvad")
+    model.save(p)
+    again = DetectMultivariateAnomaly.load(p)
+    assert again.get("model_id") == "m123"
+
+
+def test_address_geocoder_batch(svc):
+    df = DataFrame({"addr": object_col([["1 Main St", "2 High St"]])})
+    g = AddressGeocoder(url=svc + "/geocode", output_col="out",
+                        error_col="err", subscription_key="k")
+    g.set_vector_param("address", "addr")
+    out = g.transform(df)
+    assert len(out["out"][0]) == 2
+    assert out["out"][0][0]["response"]["ok"]
+
+
+def test_reverse_geocoder_and_key_in_url(svc):
+    df = DataFrame({"pts": object_col([[[47.6, -122.3]]])})
+    g = ReverseAddressGeocoder(url=svc + "/geocode", output_col="out",
+                               error_col="err", subscription_key="secret")
+    g.set_vector_param("coordinates", "pts")
+    out = g.transform(df)
+    assert out["out"][0][0]["response"]["q"] == "?query=47.6,-122.3"
+
+
+def test_point_in_polygon(svc):
+    df = DataFrame({"la": np.array([47.6, 80.0]), "lo": np.array([1.0, 2.0])})
+    c = CheckPointInPolygon(url=svc + "/geofence", output_col="out",
+                            error_col="err")
+    c.set_vector_param("lat", "la")
+    c.set_vector_param("lon", "lo")
+    out = c.transform(df)
+    assert out["out"][0]["result"]["isInside"] is True
+    assert out["out"][1]["result"]["isInside"] is False
+
+
+def test_document_translator_polls(svc):
+    df = DataFrame({"src": object_col(["http://blob/in"])})
+    t = DocumentTranslator(url=svc + "/docbatches", output_col="out",
+                           error_col="err", polling_delay_ms=10,
+                           target_url="http://blob/out",
+                           target_language="fr")
+    t.set_vector_param("source_url", "src")
+    out = t.transform(df)
+    assert out["err"][0] is None
+    assert out["out"][0]["status"] == "Succeeded"
+
+
+def test_form_ontology_learner():
+    forms = [
+        {"analyzeResult": {"documentResults": [{"fields": {
+            "Total": {"type": "number", "valueNumber": 12.5, "text": "12.5"},
+            "Vendor": {"type": "string", "valueString": "ACME"}}}]}},
+        {"analyzeResult": {"documentResults": [{"fields": {
+            "Total": {"type": "number", "valueNumber": 3.0},
+            "Date": {"type": "date", "valueDate": "2021-01-01"}}}]}},
+    ]
+    df = DataFrame({"form": object_col(forms)})
+    model = FormOntologyLearner(input_col="form", output_col="onto").fit(df)
+    assert set(model.get("ontology")) == {"Total", "Vendor", "Date"}
+    out = model.transform(df)
+    assert out["onto"][0] == {"Total": 12.5, "Vendor": "ACME", "Date": None}
+    assert out["onto"][1]["Date"] == "2021-01-01"
