@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.dataframe import DataFrame
+from ..core.dataframe import DataFrame, object_col
 from ..core.params import Param
 from ..core.pipeline import Transformer
 

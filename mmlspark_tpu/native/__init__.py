@@ -1,0 +1,122 @@
+"""Native host-side fast paths with pure-Python fallback.
+
+``fastpath.cpp`` is compiled on demand with the system C++ toolchain into a
+CPython extension (no pybind11 needed). If compilation is unavailable the
+same API is served by numpy/pure-Python implementations, so the package has
+no hard native dependency — mirroring the reference's NativeLoader pattern
+(``core/.../core/env/NativeLoader.java``) of shipping a loadable native
+payload behind a stable interface.
+
+API:
+    available() -> bool
+    murmur3(data: bytes, seed: int) -> int
+    murmur3_batch(seq_of_bytes, seed, mask) -> np.uint32[n]
+    pad_sparse(rows, K) -> (np.int32[n,K], np.float32[n,K])
+    stack_rows(seq_of_float_vectors, d) -> np.float32[n,d]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+
+__all__ = ["available", "murmur3", "murmur3_batch", "pad_sparse",
+           "stack_rows"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastpath.cpp")
+_SO = os.path.join(_HERE, f"_fastpath{sysconfig.get_config_var('EXT_SUFFIX')}")
+
+_impl = None
+
+
+def _compile() -> bool:
+    """Build the extension in place; returns success."""
+    try:
+        include_py = sysconfig.get_paths()["include"]
+        include_np = np.get_include()
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               f"-I{include_py}", f"-I{include_np}", _SRC, "-o", _SO]
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def _load():
+    global _impl
+    if _impl is not None:
+        return _impl
+    if os.environ.get("MMLSPARK_TPU_NO_NATIVE") == "1":
+        _impl = False
+        return _impl
+    newer = (os.path.exists(_SO)
+             and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+    if not newer and not _compile():
+        _impl = False
+        return _impl
+    try:
+        sys.path.insert(0, _HERE)
+        import _fastpath  # noqa
+        _impl = _fastpath
+    except Exception:
+        _impl = False
+    finally:
+        if _HERE in sys.path:
+            sys.path.remove(_HERE)
+    return _impl
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+# -- dispatching wrappers ----------------------------------------------------
+
+def murmur3(data: bytes, seed: int = 0) -> int:
+    impl = _load()
+    if impl:
+        return impl.murmur3(data, seed & 0xFFFFFFFF)
+    from ..vw.murmur import _murmur3_32_py
+    return _murmur3_32_py(data, seed)
+
+
+def murmur3_batch(items, seed: int, mask: int) -> np.ndarray:
+    impl = _load()
+    if impl:
+        return impl.murmur3_batch(list(items), seed & 0xFFFFFFFF, mask)
+    from ..vw.murmur import _murmur3_32_py
+    return np.asarray([_murmur3_32_py(b, seed) & mask for b in items],
+                      dtype=np.uint32)
+
+
+def pad_sparse(rows, K: int):
+    impl = _load()
+    if impl:
+        return impl.pad_sparse(list(rows), int(K))
+    n = len(rows)
+    idx = np.zeros((n, K), dtype=np.int32)
+    val = np.zeros((n, K), dtype=np.float32)
+    for i, (ri, rv) in enumerate(rows):
+        ri = np.asarray(ri)
+        rv = np.asarray(rv)
+        k = min(len(ri), len(rv), K)   # clamp like the native path
+        idx[i, :k] = ri[:k].astype(np.int64)
+        val[i, :k] = rv[:k]
+    return idx, val
+
+
+def stack_rows(rows, d: int) -> np.ndarray:
+    impl = _load()
+    if impl:
+        return impl.stack_rows(list(rows), int(d))
+    out = np.zeros((len(rows), d), dtype=np.float32)
+    for i, r in enumerate(rows):
+        a = np.asarray(r, dtype=np.float32).ravel()
+        k = min(len(a), d)
+        out[i, :k] = a[:k]
+    return out

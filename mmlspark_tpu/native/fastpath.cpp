@@ -1,0 +1,181 @@
+/* Native host-side fast paths.
+ *
+ * Role of the reference's native runtime layer (SURVEY.md L0/L1): the
+ * reference reaches C++ for everything between the JVM and the accelerator —
+ * LightGBM's ChunkedArray marshalling (dataset/DatasetAggregator.scala),
+ * VW's murmur hashing (VowpalWabbitMurmurWithPrefix.scala), ONNX tensor
+ * creation (ONNXModel.scala:357-402, "the throughput killer").  Here the
+ * device math belongs to XLA, but the host-side marshalling before
+ * jax.device_put is pure Python loops — these are their C++ replacements:
+ *
+ *   murmur3        — single feature-name hash (VW featurizer)
+ *   murmur3_batch  — hash a sequence of byte-strings in one call
+ *   pad_sparse     — (indices, values) object rows -> padded [n,K] buffers
+ *   stack_rows     — object column of float vectors -> dense (n,d) float32
+ *
+ * Exposed through mmlspark_tpu/native/__init__.py with pure-Python
+ * fallbacks, so the package works without a compiler.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <Python.h>
+#include <numpy/arrayobject.h>
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static uint32_t murmur3_32(const uint8_t* data, size_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const size_t nblocks = len / 4;
+  for (size_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + 4 * i, 4);
+    k *= c1; k = rotl32(k, 15); k *= c2;
+    h ^= k; h = rotl32(h, 13); h = h * 5 + 0xe6546b64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+    case 1: k1 ^= (uint32_t)tail[0];
+            k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h ^= k1;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16; h *= 0x85ebca6bu; h ^= h >> 13; h *= 0xc2b2ae35u; h ^= h >> 16;
+  return h;
+}
+
+static PyObject* py_murmur3(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  unsigned int seed;
+  if (!PyArg_ParseTuple(args, "y*I", &buf, &seed)) return nullptr;
+  uint32_t h = murmur3_32((const uint8_t*)buf.buf, (size_t)buf.len, seed);
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLong(h);
+}
+
+/* murmur3_batch(seq_of_bytes, seed, mask) -> uint32[n] */
+static PyObject* py_murmur3_batch(PyObject*, PyObject* args) {
+  PyObject* seq;
+  unsigned int seed, mask;
+  if (!PyArg_ParseTuple(args, "OII", &seq, &seed, &mask)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "murmur3_batch expects a sequence");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  npy_intp dims[1] = {n};
+  PyArrayObject* out =
+      (PyArrayObject*)PyArray_SimpleNew(1, dims, NPY_UINT32);
+  if (!out) { Py_DECREF(fast); return nullptr; }
+  uint32_t* o = (uint32_t*)PyArray_DATA(out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
+    char* p; Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(it, &p, &len) < 0) {
+      Py_DECREF(fast); Py_DECREF(out); return nullptr;
+    }
+    o[i] = murmur3_32((const uint8_t*)p, (size_t)len, seed) & mask;
+  }
+  Py_DECREF(fast);
+  return (PyObject*)out;
+}
+
+/* pad_sparse(rows, K) -> (int32[n,K], float32[n,K])
+ * rows: sequence of (indices, values) array pairs. */
+static PyObject* py_pad_sparse(PyObject*, PyObject* args) {
+  PyObject* seq;
+  int K;
+  if (!PyArg_ParseTuple(args, "Oi", &seq, &K)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "pad_sparse expects a sequence");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  npy_intp dims[2] = {n, K};
+  PyArrayObject* idx = (PyArrayObject*)PyArray_ZEROS(2, dims, NPY_INT32, 0);
+  PyArrayObject* val = (PyArrayObject*)PyArray_ZEROS(2, dims, NPY_FLOAT32, 0);
+  if (!idx || !val) {
+    Py_XDECREF(idx); Py_XDECREF(val); Py_DECREF(fast); return nullptr;
+  }
+  int32_t* ip = (int32_t*)PyArray_DATA(idx);
+  float* vp = (float*)PyArray_DATA(val);
+  for (Py_ssize_t r = 0; r < n; r++) {
+    PyObject* pair = PySequence_Fast_GET_ITEM(fast, r);
+    PyObject* pi = PySequence_GetItem(pair, 0);
+    PyObject* pv = PySequence_GetItem(pair, 1);
+    if (!pi || !pv) { goto fail; }
+    {
+      PyArrayObject* ai = (PyArrayObject*)PyArray_FROM_OTF(
+          pi, NPY_INT64, NPY_ARRAY_IN_ARRAY | NPY_ARRAY_FORCECAST);
+      PyArrayObject* av = (PyArrayObject*)PyArray_FROM_OTF(
+          pv, NPY_FLOAT32, NPY_ARRAY_IN_ARRAY | NPY_ARRAY_FORCECAST);
+      Py_DECREF(pi); Py_DECREF(pv);
+      if (!ai || !av) { Py_XDECREF(ai); Py_XDECREF(av); goto fail; }
+      Py_ssize_t k = PyArray_SIZE(ai);
+      Py_ssize_t kv = PyArray_SIZE(av);
+      if (kv < k) k = kv;   /* malformed row: clamp, never read past values */
+      if (k > K) k = K;
+      const int64_t* si = (const int64_t*)PyArray_DATA(ai);
+      const float* sv = (const float*)PyArray_DATA(av);
+      for (Py_ssize_t j = 0; j < k; j++) {
+        ip[r * K + j] = (int32_t)si[j];
+        vp[r * K + j] = sv[j];
+      }
+      Py_DECREF(ai); Py_DECREF(av);
+    }
+  }
+  Py_DECREF(fast);
+  return Py_BuildValue("(NN)", idx, val);
+fail:
+  Py_DECREF(fast); Py_DECREF(idx); Py_DECREF(val);
+  return nullptr;
+}
+
+/* stack_rows(seq_of_float_vectors, d) -> float32[n, d] (pad/truncate to d) */
+static PyObject* py_stack_rows(PyObject*, PyObject* args) {
+  PyObject* seq;
+  int d;
+  if (!PyArg_ParseTuple(args, "Oi", &seq, &d)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "stack_rows expects a sequence");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  npy_intp dims[2] = {n, d};
+  PyArrayObject* out = (PyArrayObject*)PyArray_ZEROS(2, dims, NPY_FLOAT32, 0);
+  if (!out) { Py_DECREF(fast); return nullptr; }
+  float* op = (float*)PyArray_DATA(out);
+  for (Py_ssize_t r = 0; r < n; r++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, r);
+    PyArrayObject* a = (PyArrayObject*)PyArray_FROM_OTF(
+        item, NPY_FLOAT32, NPY_ARRAY_IN_ARRAY | NPY_ARRAY_FORCECAST);
+    if (!a) { Py_DECREF(fast); Py_DECREF(out); return nullptr; }
+    Py_ssize_t k = PyArray_SIZE(a);
+    if (k > d) k = d;
+    std::memcpy(op + (size_t)r * d, PyArray_DATA(a), (size_t)k * sizeof(float));
+    Py_DECREF(a);
+  }
+  Py_DECREF(fast);
+  return (PyObject*)out;
+}
+
+static PyMethodDef Methods[] = {
+    {"murmur3", py_murmur3, METH_VARARGS, "murmur3(data: bytes, seed) -> uint32"},
+    {"murmur3_batch", py_murmur3_batch, METH_VARARGS,
+     "murmur3_batch(seq_of_bytes, seed, mask) -> uint32[n]"},
+    {"pad_sparse", py_pad_sparse, METH_VARARGS,
+     "pad_sparse(rows, K) -> (int32[n,K], float32[n,K])"},
+    {"stack_rows", py_stack_rows, METH_VARARGS,
+     "stack_rows(seq, d) -> float32[n,d]"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastpath", nullptr, -1, Methods,
+    nullptr, nullptr, nullptr, nullptr};
+
+PyMODINIT_FUNC PyInit__fastpath(void) {
+  import_array();
+  return PyModule_Create(&moduledef);
+}

@@ -10,9 +10,6 @@ expects (``AzureMapsTraits.scala``).
 
 from __future__ import annotations
 
-from typing import Optional
-
-from ..io.http.schema import EntityData, HTTPRequestData
 from .base import ServiceParam, ServiceTransformer
 
 __all__ = ["AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon"]

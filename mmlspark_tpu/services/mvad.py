@@ -13,9 +13,6 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Optional
-
-import numpy as np
 
 from ..core.dataframe import DataFrame, object_col
 from ..core.params import Param
@@ -37,10 +34,6 @@ def _json_request(url, method, key, key_header, payload=None):
         entity = EntityData(content=body, content_length=len(body))
     return HTTPRequestData(url=url, method=method, headers=headers,
                            entity=entity)
-
-
-class _MVADParams:
-    pass
 
 
 class FitMultivariateAnomaly(Estimator):
@@ -101,7 +94,10 @@ class FitMultivariateAnomaly(Estimator):
                 self.get("key_header")), self.get("timeout"))
             if r is None:
                 continue
-            info = r.json_content().get("modelInfo", {})
+            try:
+                info = r.json_content().get("modelInfo", {})
+            except (json.JSONDecodeError, ValueError):
+                continue   # transient non-JSON body: keep polling
             status = str(info.get("status", "")).upper()
             if status in ("READY", "FAILED"):
                 break
@@ -173,7 +169,10 @@ class DetectMultivariateAnomaly(Model):
                 key, self.get("key_header")), self.get("timeout"))
             if r is None:
                 continue
-            body = r.json_content()
+            try:
+                body = r.json_content()
+            except (json.JSONDecodeError, ValueError):
+                continue   # transient non-JSON body: keep polling
             if str(body.get("summary", {}).get("status", "")).upper() == "READY":
                 results = body.get("results", [])
                 break

@@ -70,6 +70,11 @@ class SpeechToTextSDK(SpeechToText):
                              "column (set_vector_param)")
         col = tagged["value"]
         audio = df[col]
+        # other column-bound service params must travel with each chunk row
+        extra_cols = [t["value"] for n, t in
+                      ((n, self.get_or_none(n)) for n in self._service_params())
+                      if n != "audio_data" and t is not None
+                      and t["kind"] == "col"]
         # explode every row's audio into chunks, transcribe flat, regroup
         flat, owners = [], []
         for i, a in enumerate(audio):
@@ -78,7 +83,12 @@ class SpeechToTextSDK(SpeechToText):
             for off in range(0, len(a), size):
                 flat.append(a[off:off + size])
                 owners.append(i)
-        sub = DataFrame({col: object_col(flat)}) if flat else None
+        sub = None
+        if flat:
+            data = {col: object_col(flat)}
+            for c in extra_cols:
+                data[c] = object_col([df[c][i] for i in owners])
+            sub = DataFrame(data)
         outs = np.empty(len(df), dtype=object)
         errs = np.empty(len(df), dtype=object)
         for i in range(len(df)):
@@ -117,11 +127,12 @@ class TextToSpeech(ServiceTransformer):
     def _build_request(self, row: dict) -> Optional[HTTPRequestData]:
         if self.should_skip(row):
             return None
-        text = self.get_value_opt(row, "text")
-        lang = self.get_value_opt(row, "language")
-        voice = self.get_value_opt(row, "voice_name")
-        ssml = (f"<speak version='1.0' xml:lang='{lang}'>"
-                f"<voice xml:lang='{lang}' name='{voice}'>"
+        from xml.sax.saxutils import escape, quoteattr
+        text = escape(str(self.get_value_opt(row, "text")))
+        lang = quoteattr(str(self.get_value_opt(row, "language")))
+        voice = quoteattr(str(self.get_value_opt(row, "voice_name")))
+        ssml = (f"<speak version='1.0' xml:lang={lang}>"
+                f"<voice xml:lang={lang} name={voice}>"
                 f"{text}</voice></speak>")
         headers = [h for h in self._headers(row)
                    if h.name.lower() != "content-type"]

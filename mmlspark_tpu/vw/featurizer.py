@@ -53,6 +53,14 @@ def max_nnz(col: np.ndarray) -> int:
     return max((len(r[0]) for r in col), default=0)
 
 
+def _is_string_col(col: np.ndarray) -> bool:
+    if col.dtype.kind == "U":
+        return True
+    if col.dtype == object:
+        return all(v is None or isinstance(v, str) for v in col)
+    return False
+
+
 def _dedupe_sum(idx: np.ndarray, val: np.ndarray):
     """Sum values of colliding indices (``sumCollisions`` in the reference)."""
     if len(idx) < 2:
@@ -131,15 +139,42 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         split_cols = set(self.get("string_split_cols"))
         seeds = {c: namespace_seed(c, self.get("seed")) for c in cols}
         n = len(df)
+        idx_rows: list = [[] for _ in range(n)]
+        val_rows: list = [[] for _ in range(n)]
+        for c in cols:
+            col = df[c]
+            split = c in split_cols
+            if _is_string_col(col):
+                # column-major batch hash through the native fast path —
+                # the host-side equivalent of VW's C++ example parser
+                from ..native import murmur3_batch
+                toks_per_row = [[] if v is None else
+                                (v.split() if split else [v]) for v in col]
+                flat = [(c + _SEP + t).encode("utf-8")
+                        for toks in toks_per_row for t in toks]
+                hashed = murmur3_batch(flat, seeds[c], mask)
+                off = 0
+                for r, toks in enumerate(toks_per_row):
+                    k = len(toks)
+                    if k:
+                        idx_rows[r].append(hashed[off:off + k])
+                        val_rows[r].append(np.ones(k, np.float32))
+                    off += k
+            else:
+                for r in range(n):
+                    io: list = []
+                    vo: list = []
+                    self._featurize_value(col[r], c, seeds[c], mask, split,
+                                          io, vo)
+                    if io:
+                        idx_rows[r].append(np.asarray(io, dtype=np.uint32))
+                        val_rows[r].append(np.asarray(vo, dtype=np.float32))
         rows = []
         for r in range(n):
-            idx_out: list = []
-            val_out: list = []
-            for c in cols:
-                self._featurize_value(df[c][r], c, seeds[c], mask,
-                                      c in split_cols, idx_out, val_out)
-            idx = np.asarray(idx_out, dtype=np.uint32)
-            val = np.asarray(val_out, dtype=np.float32)
+            idx = (np.concatenate(idx_rows[r]).astype(np.uint32)
+                   if idx_rows[r] else np.array([], dtype=np.uint32))
+            val = (np.concatenate(val_rows[r])
+                   if val_rows[r] else np.array([], dtype=np.float32))
             if self.get("sum_collisions"):
                 idx, val = _dedupe_sum(idx, val)
             rows.append((idx, val))

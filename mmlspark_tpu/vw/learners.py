@@ -45,18 +45,11 @@ def pad_sparse(col, max_nnz: Optional[int] = None) -> Tuple[np.ndarray, np.ndarr
     Padding slots get index 0 with value 0 — a zero-value feature is a no-op
     for both prediction (contributes 0) and the gradient (scales by value).
     """
-    n = len(col)
     if max_nnz is None:
         max_nnz = max((len(r[0]) for r in col), default=0)
     K = max(1, max_nnz)
-    idx = np.zeros((n, K), dtype=np.int32)
-    val = np.zeros((n, K), dtype=np.float32)
-    for i, (ri, rv) in enumerate(col):
-        ri = np.asarray(ri)
-        k = min(len(ri), K)
-        idx[i, :k] = ri[:k].astype(np.int64)
-        val[i, :k] = np.asarray(rv)[:k]
-    return idx, val
+    from ..native import pad_sparse as native_pad
+    return native_pad(list(col), K)
 
 
 def _make_pass_fn(loss: str, quantile_tau: float, n_passes: int,

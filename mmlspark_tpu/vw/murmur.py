@@ -26,7 +26,27 @@ def _rotl(x: int, r: int) -> int:
 
 
 def murmur3_32(data: bytes, seed: int = 0) -> int:
-    """MurmurHash3 x86_32 of ``data`` with ``seed``; returns uint32."""
+    """MurmurHash3 x86_32 of ``data`` with ``seed``; returns uint32.
+
+    Dispatches to the native extension when built (this pure-Python body is
+    the reference implementation and the fallback)."""
+    global _native_fn
+    if _native_fn is None:
+        try:
+            from .. import native
+            impl = native._load()
+            _native_fn = impl.murmur3 if impl else False
+        except Exception:
+            _native_fn = False
+    if _native_fn:
+        return _native_fn(data, seed & _M32)
+    return _murmur3_32_py(data, seed)
+
+
+_native_fn = None
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
     h = seed & _M32
     n = len(data)
     nblocks = n // 4

@@ -178,3 +178,15 @@ def test_access_anomaly_numeric_tenant_save(tmp_path):
     again = AccessAnomalyModel.load(p)
     out = again.transform(df)
     assert all(s == 0.0 for s in out["anomaly_score"])  # all seen
+
+
+def test_complement_access_with_partition_key():
+    df = DataFrame({"tenant": object_col(["a", "a", "b", "b"]),
+                    "u": np.array([1, 2, 1, 3]),
+                    "r": np.array([1, 2, 1, 3])})
+    out = ComplementAccessTransformer(
+        partition_key="tenant", indexed_col_names=["u", "r"],
+        complementset_factor=6, seed=0).transform(df)
+    assert "tenant" in out.columns
+    for t, u, r in zip(out["tenant"], out["u"], out["r"]):
+        assert (u, r) not in {(1, 1), (2, 2)} if t == "a" else True

@@ -226,3 +226,26 @@ def test_form_ontology_learner():
     out = model.transform(df)
     assert out["onto"][0] == {"Total": 12.5, "Vendor": "ACME", "Date": None}
     assert out["onto"][1]["Date"] == "2021-01-01"
+
+
+def test_tts_escapes_xml(svc, tmp_path):
+    p = str(tmp_path / "amp.wav")
+    df = DataFrame({"text": object_col(["AT&T <rocks>"]),
+                    "outputFile": object_col([p])})
+    t = TextToSpeech(url=svc + "/tts", error_col="err")
+    t.set_vector_param("text", "text")
+    out = t.transform(df)
+    assert out["err"][0] is None     # mock asserts valid ssml content-type
+
+
+def test_stt_sdk_column_bound_language(svc):
+    df = DataFrame({"audio": object_col([b"\x02" * 40000]),
+                    "lang": object_col(["de-DE"])})
+    t = SpeechToTextSDK(url=svc + "/stt", chunk_bytes=32768,
+                        output_col="out", error_col="err")
+    t.set_vector_param("audio_data", "audio")
+    t.set_vector_param("language", "lang")
+    out = t.transform(df)
+    results = out["out"][0]
+    assert len(results) == 2
+    assert results[0]["DisplayText"].endswith("de-DE")
