@@ -62,7 +62,9 @@ class Transformer(PipelineStage):
     def transform(self, df: DataFrame, params: Optional[dict] = None) -> DataFrame:
         stage = self.copy(params) if params else self
         t0 = time.perf_counter()
-        out = stage._transform(df)
+        from ..utils.profiling import annotate
+        with annotate(f"{type(stage).__name__}.transform"):
+            out = stage._transform(df)
         _log_event(stage, "transform", rows=len(df),
                    millis=round(1e3 * (time.perf_counter() - t0), 3))
         return out
@@ -80,7 +82,9 @@ class Estimator(PipelineStage):
     def fit(self, df: DataFrame, params: Optional[dict] = None) -> "Model":
         est = self.copy(params) if params else self
         t0 = time.perf_counter()
-        model = est._fit(df)
+        from ..utils.profiling import annotate
+        with annotate(f"{type(est).__name__}.fit"):
+            model = est._fit(df)
         _log_event(est, "fit", rows=len(df),
                    millis=round(1e3 * (time.perf_counter() - t0), 3))
         return model

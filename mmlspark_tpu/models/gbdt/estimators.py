@@ -56,14 +56,21 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                          doc="serialized booster for warm start")
     leaf_prediction_col = Param(str, default=None, doc="emit leaf indices here")
     features_shap_col = Param(str, default=None, doc="emit SHAP contributions here")
+    checkpoint_dir = Param(str, default=None,
+                           doc="directory for step-level checkpoint/resume")
+    checkpoint_interval = Param(int, default=0,
+                                doc="iterations between checkpoints (0 = off)")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
                 "lambda_l1", "lambda_l2", "min_data_in_leaf",
                 "min_sum_hessian_in_leaf", "min_gain_to_split",
                 "feature_fraction", "bagging_fraction", "bagging_freq",
-                "max_bin", "early_stopping_round", "metric", "seed"]
+                "max_bin", "early_stopping_round", "metric", "seed",
+                "checkpoint_interval"]
         p = {k: self.get(k) for k in keys}
+        if self.get_or_none("checkpoint_dir"):
+            p["checkpoint_dir"] = self.get("checkpoint_dir")
         p["tree_learner"] = self.parallelism
         p.update(extra)
         return p

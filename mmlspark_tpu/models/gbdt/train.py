@@ -56,6 +56,8 @@ _DEFAULTS = dict(
     alpha=0.9,                      # huber/quantile parameter
     tweedie_variance_power=1.5,
     verbosity=-1,
+    checkpoint_dir=None,            # step-level checkpoint/resume
+    checkpoint_interval=0,          # iterations between checkpoints (0 = off)
 )
 
 
@@ -170,6 +172,21 @@ def train(params: Dict,
     xb = mapper.fit_transform(X)
     n_bins = mapper.n_bins
 
+    # step-level checkpoint/resume (beyond the reference's model-level
+    # warm start): a run killed mid-training resumes from the last step
+    ckpt = None
+    resumed_iters = 0
+    if p["checkpoint_dir"]:
+        from ...utils.checkpoint import TrainingCheckpointer
+        ckpt = TrainingCheckpointer(str(p["checkpoint_dir"]))
+        latest = ckpt.latest()
+        if latest is not None:
+            _, files = latest
+            meta = TrainingCheckpointer.read_json(files["meta.json"])
+            resumed_iters = int(meta["completed_iterations"])
+            init_model = Booster.from_string(
+                TrainingCheckpointer.read_text(files["booster.txt"]))
+
     if init_model is not None:
         booster = init_model
         base_score = booster.base_score
@@ -239,7 +256,8 @@ def train(params: Dict,
     grad_fn = jax.jit(obj.grad_hess) if obj.grad_hess is not None else None
     lr = float(p["learning_rate"])
     rng = np.random.default_rng(int(p["seed"]))
-    n_iter = int(p["num_iterations"])
+    n_iter = max(0, int(p["num_iterations"]) - resumed_iters)
+    ckpt_iv = int(p["checkpoint_interval"]) if ckpt is not None else 0
 
     # eval bookkeeping
     metric_name, (metric_fn, higher_better) = get_metric(
@@ -356,10 +374,29 @@ def train(params: Dict,
                 best_iter = it + 1
             elif patience and (it + 1 - best_iter) >= patience:
                 booster.best_iteration = best_iter
-                return booster.truncated(
+                final = booster.truncated(
                     init_trees + best_iter * (num_class if is_multi else 1))
+                if ckpt is not None:
+                    # mark the run complete (full budget) so an idempotent
+                    # rerun returns this truncated booster, not a resumed one
+                    ckpt.save(int(p["num_iterations"]), {
+                        "booster.txt": final.to_string(),
+                        "meta.json": {"completed_iterations":
+                                      int(p["num_iterations"])},
+                    })
+                return final
         for cb in (callbacks or []):
             cb(it, booster, scores)
+        if ckpt_iv and (it + 1) % ckpt_iv == 0:
+            ckpt.save(resumed_iters + it + 1, {
+                "booster.txt": booster.to_string(),
+                "meta.json": {"completed_iterations": resumed_iters + it + 1},
+            })
 
-    booster.best_iteration = best_iter if valid_sets else n_iter
+    if ckpt is not None and n_iter > 0:
+        ckpt.save(resumed_iters + n_iter, {
+            "booster.txt": booster.to_string(),
+            "meta.json": {"completed_iterations": resumed_iters + n_iter},
+        })
+    booster.best_iteration = best_iter if valid_sets else resumed_iters + n_iter
     return booster

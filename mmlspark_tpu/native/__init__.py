@@ -39,10 +39,16 @@ def _compile() -> bool:
     try:
         include_py = sysconfig.get_paths()["include"]
         include_np = np.get_include()
+        # build to a unique temp name, then atomically publish: concurrent
+        # importers on a shared filesystem never see a half-written .so
+        tmp = f"{_SO}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               f"-I{include_py}", f"-I{include_np}", _SRC, "-o", _SO]
+               f"-I{include_py}", f"-I{include_np}", _SRC, "-o", tmp]
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-        return res.returncode == 0 and os.path.exists(_SO)
+        if res.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _SO)
+        return True
     except Exception:
         return False
 
@@ -54,9 +60,12 @@ def _load():
     if os.environ.get("MMLSPARK_TPU_NO_NATIVE") == "1":
         _impl = False
         return _impl
-    newer = (os.path.exists(_SO)
-             and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
-    if not newer and not _compile():
+    # a shipped .so without the source is fine — only rebuild when the
+    # source exists and is newer than the binary
+    usable = os.path.exists(_SO) and (
+        not os.path.exists(_SRC)
+        or os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+    if not usable and not (os.path.exists(_SRC) and _compile()):
         _impl = False
         return _impl
     try:

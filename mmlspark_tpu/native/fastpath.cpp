@@ -107,7 +107,7 @@ static PyObject* py_pad_sparse(PyObject*, PyObject* args) {
     PyObject* pair = PySequence_Fast_GET_ITEM(fast, r);
     PyObject* pi = PySequence_GetItem(pair, 0);
     PyObject* pv = PySequence_GetItem(pair, 1);
-    if (!pi || !pv) { goto fail; }
+    if (!pi || !pv) { Py_XDECREF(pi); Py_XDECREF(pv); goto fail; }
     {
       PyArrayObject* ai = (PyArrayObject*)PyArray_FROM_OTF(
           pi, NPY_INT64, NPY_ARRAY_IN_ARRAY | NPY_ARRAY_FORCECAST);

@@ -1,0 +1,109 @@
+"""Step-level training checkpoint/resume.
+
+The reference checkpoints at *model* granularity only (SURVEY.md §5:
+LightGBM warm-start via model strings ``LightGBMBase.scala:49-61``, VW
+``initialModel`` bytes). For long TPU training runs that is not enough —
+a preempted pod slice must resume mid-run — so this adds a step-granular
+checkpointer used by the GBDT trainer (``checkpoint_dir`` /
+``checkpoint_interval`` params) and usable by any loop.
+
+Layout: ``<dir>/step_<N>/`` holding the payload files plus ``meta.json``;
+writes go to a temp dir and are atomically renamed, and ``LATEST`` is
+updated last — a crash mid-write never corrupts the resumable state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+__all__ = ["TrainingCheckpointer"]
+
+Payload = Dict[str, Union[bytes, str, dict, np.ndarray]]
+
+
+class TrainingCheckpointer:
+    def __init__(self, directory: str, keep: int = 2):
+        self.directory = directory
+        self.keep = max(1, int(keep))
+        os.makedirs(directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def save(self, step: int, payload: Payload) -> None:
+        tmp = tempfile.mkdtemp(dir=self.directory, prefix=".tmp_")
+        try:
+            for name, value in payload.items():
+                path = os.path.join(tmp, name)
+                if isinstance(value, bytes):
+                    with open(path, "wb") as f:
+                        f.write(value)
+                elif isinstance(value, str):
+                    with open(path, "w") as f:
+                        f.write(value)
+                elif isinstance(value, np.ndarray):
+                    np.save(path if path.endswith(".npy") else path + ".npy",
+                            value, allow_pickle=False)
+                else:
+                    with open(path, "w") as f:
+                        json.dump(value, f)
+            final = self._step_dir(step)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # LATEST is updated last: readers never see a half-written step
+        latest_tmp = os.path.join(self.directory, ".LATEST.tmp")
+        with open(latest_tmp, "w") as f:
+            f.write(str(step))
+        os.replace(latest_tmp, os.path.join(self.directory, "LATEST"))
+        self._prune()
+
+    def _steps(self):
+        out = []
+        for d in os.listdir(self.directory):
+            if d.startswith("step_"):
+                try:
+                    out.append(int(d[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _prune(self):
+        for s in self._steps()[:-self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def latest_step(self) -> Optional[int]:
+        path = os.path.join(self.directory, "LATEST")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            step = int(f.read().strip())
+        return step if os.path.isdir(self._step_dir(step)) else None
+
+    def latest(self) -> Optional[Tuple[int, Dict[str, str]]]:
+        """Returns (step, {filename: absolute path}) for the newest step."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        d = self._step_dir(step)
+        return step, {name: os.path.join(d, name) for name in os.listdir(d)}
+
+    # convenience readers ----------------------------------------------------
+    @staticmethod
+    def read_text(path: str) -> str:
+        with open(path) as f:
+            return f.read()
+
+    @staticmethod
+    def read_json(path: str) -> dict:
+        with open(path) as f:
+            return json.load(f)

@@ -1,0 +1,38 @@
+"""Profiling: jax.profiler traces + stage annotations.
+
+The reference has no tracer — only ad-hoc ``StopWatch``/``Timer`` timings
+(SURVEY.md §5). The TPU-native replacement is the XLA profiler:
+:func:`trace` captures a TensorBoard-loadable device trace and
+:func:`annotate` scopes host work so stage names appear on the timeline.
+``PipelineStage`` fit/transform calls are annotated automatically (see
+``core/pipeline.py``), giving per-stage device attribution for free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+__all__ = ["trace", "annotate", "StopWatch"]
+
+from .shared import StopWatch  # re-export: the reference-style wall timer
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace into ``log_dir`` (TensorBoard format)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named scope on the profiler timeline; no-op outside a trace."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()

@@ -1,0 +1,115 @@
+"""Step-level checkpoint/resume + profiling hooks."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.utils.checkpoint import TrainingCheckpointer
+
+
+def test_checkpointer_atomic_save_load(tmp_path):
+    c = TrainingCheckpointer(str(tmp_path / "ck"), keep=2)
+    assert c.latest() is None
+    c.save(5, {"booster.txt": "model-at-5",
+               "meta.json": {"completed_iterations": 5},
+               "weights.npy": np.arange(4.0)})
+    c.save(10, {"booster.txt": "model-at-10",
+                "meta.json": {"completed_iterations": 10}})
+    step, files = c.latest()
+    assert step == 10
+    assert TrainingCheckpointer.read_text(files["booster.txt"]) == "model-at-10"
+    assert TrainingCheckpointer.read_json(files["meta.json"]) \
+        == {"completed_iterations": 10}
+    # pruning: keep=2 retains both; a third save drops step 5
+    c.save(15, {"booster.txt": "x", "meta.json": {"completed_iterations": 15}})
+    steps = sorted(int(d[5:]) for d in os.listdir(str(tmp_path / "ck"))
+                   if d.startswith("step_"))
+    assert steps == [10, 15]
+
+
+def test_checkpointer_ignores_stale_latest(tmp_path):
+    c = TrainingCheckpointer(str(tmp_path / "ck"))
+    c.save(3, {"meta.json": {"completed_iterations": 3}})
+    # simulate a crash that removed the step dir but left LATEST behind
+    import shutil
+    shutil.rmtree(os.path.join(str(tmp_path / "ck"), "step_00000003"))
+    assert c.latest() is None
+
+
+def _df(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    feats = np.empty(n, dtype=object)
+    for i in range(n):
+        feats[i] = X[i]
+    return DataFrame({"features": feats, "label": y})
+
+
+def test_gbdt_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Train 12 iters straight vs 6 iters, 'crash', resume for 12 total —
+    the resumed booster must end with the same number of trees and
+    near-identical predictions."""
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    df = _df()
+    common = dict(num_leaves=7, learning_rate=0.3, min_data_in_leaf=5, seed=0)
+    full = LightGBMClassifier(num_iterations=12, **common).fit(df)
+
+    ckdir = str(tmp_path / "gbdt_ck")
+    LightGBMClassifier(num_iterations=6, checkpoint_dir=ckdir,
+                       checkpoint_interval=2, **common).fit(df)
+    c = TrainingCheckpointer(ckdir)
+    assert c.latest_step() == 6
+
+    resumed = LightGBMClassifier(num_iterations=12, checkpoint_dir=ckdir,
+                                 checkpoint_interval=2, **common).fit(df)
+    assert c.latest_step() == 12
+    out_f = full.transform(df)["prediction"]
+    out_r = resumed.transform(df)["prediction"]
+    # tree-for-tree equality is not guaranteed (gradient state is recomputed
+    # from scores at resume, which matches exactly for this loss) — require
+    # prediction agreement
+    assert (out_f == out_r).mean() > 0.98
+
+
+def test_gbdt_checkpoint_noop_when_complete(tmp_path):
+    from mmlspark_tpu.models.gbdt.booster import Booster
+    from mmlspark_tpu.models.gbdt.train import train
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(100, 3))
+    y = (X[:, 0] > 0).astype(float)
+    ckdir = str(tmp_path / "ck2")
+    b1 = train({"objective": "binary", "num_iterations": 5,
+                "min_data_in_leaf": 2, "checkpoint_dir": ckdir,
+                "checkpoint_interval": 1}, X, y)
+    # re-invoking with the same budget trains 0 further iterations
+    b2 = train({"objective": "binary", "num_iterations": 5,
+                "min_data_in_leaf": 2, "checkpoint_dir": ckdir,
+                "checkpoint_interval": 1}, X, y)
+    assert b1.num_trees == b2.num_trees == 5
+
+
+def test_profiling_annotate_and_stopwatch():
+    from mmlspark_tpu.utils.profiling import StopWatch, annotate
+    with annotate("test.scope"):
+        pass   # must not raise outside a trace
+    sw = StopWatch()
+    sw.measure(lambda: sum(range(1000)))
+    assert sw.elapsed_ns >= 0
+
+
+def test_profiler_trace_writes_files(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.utils.profiling import trace
+    d = str(tmp_path / "prof")
+    with trace(d):
+        jnp.arange(16.0).sum().block_until_ready()
+    found = []
+    for root, _dirs, files in os.walk(d):
+        found += files
+    assert found, "profiler trace produced no files"
