@@ -18,6 +18,7 @@ per-partition device pinning the reference gets from Spark ``mapPartitions``
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -94,8 +95,9 @@ class DataFrame:
 
     def to_pandas(self):
         import pandas as pd
-        return pd.DataFrame({k: list(v) if v.dtype == object else v
-                             for k, v in self._columns.items()})
+        # object and n-D tensor columns become per-row lists of arrays
+        return pd.DataFrame({k: list(v) if (v.dtype == object or v.ndim > 1)
+                             else v for k, v in self._columns.items()})
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -220,14 +222,31 @@ class DataFrame:
             yield DataFrame({k: v[lo:hi] for k, v in self._columns.items()}, 1,
                             self._metadata)
 
-    def map_partitions(self, fn: Callable[["DataFrame", int], "DataFrame"]) -> "DataFrame":
+    def map_partitions(self, fn: Callable[["DataFrame", int], "DataFrame"],
+                       max_workers: Optional[int] = None) -> "DataFrame":
         """Apply ``fn(part_df, part_index)`` to each partition and concat.
 
         The moral equivalent of Spark ``mapPartitions`` — the unit at which
-        device pinning and batching happen.
+        device pinning and batching happen. Partitions run **concurrently**
+        on a thread pool (Spark runs one task per core the same way,
+        ``ONNXModel.scala:499-508``): numpy and JAX release the GIL during
+        heavy work and JAX dispatch is async, so round-robin device pinning
+        actually keeps k local chips busy. Results preserve partition order;
+        the first exception propagates. ``max_workers=1`` forces the
+        sequential path; env ``MMLSPARK_TPU_PARTITION_THREADS`` overrides
+        the default pool size.
         """
-        parts = [fn(p, i) for i, p in enumerate(self.partitions())]
-        return concat(parts, npartitions=self._npartitions)
+        parts = list(self.partitions())
+        if max_workers is None:
+            max_workers = int(os.environ.get("MMLSPARK_TPU_PARTITION_THREADS", "0")) \
+                or min(len(parts), 8)
+        if len(parts) <= 1 or max_workers <= 1:
+            results = [fn(p, i) for i, p in enumerate(parts)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=max_workers) as ex:
+                results = list(ex.map(fn, parts, range(len(parts))))
+        return concat(results, npartitions=self._npartitions)
 
     # -- row view (for HTTP/serving paths that are row-oriented) ------------
     def iter_rows(self) -> Iterator[dict]:

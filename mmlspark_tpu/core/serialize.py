@@ -158,7 +158,8 @@ def _obj_array_to_json(arr: np.ndarray) -> dict:
     Raises TypeError when elements are not JSON-able (fail at SAVE, never
     at load)."""
     flat = [_canon_scalar(v) for v in arr.ravel()]
-    payload = {"shape": list(arr.shape), "values": flat}
+    payload = {"shape": list(arr.shape), "values": flat,
+               "dtype": arr.dtype.str}
     json.dumps(payload)   # TypeError on non-JSON-able elements
     return payload
 
@@ -167,7 +168,12 @@ def _obj_array_from_json(payload: dict) -> np.ndarray:
     out = np.empty(len(payload["values"]), dtype=object)
     for i, v in enumerate(payload["values"]):
         out[i] = v
-    return out.reshape(payload["shape"])
+    out = out.reshape(payload["shape"])
+    # restore string ('U'/'S') dtypes so loaded arrays match what was saved
+    dt = payload.get("dtype")
+    if dt and np.dtype(dt).kind in "US":
+        out = out.astype(dt)
+    return out
 
 
 def _try_flatten_tree(value):

@@ -263,14 +263,18 @@ class AccessAnomalyModel(Model):
                       for t, c in s["comps"].items()],
             "user_keys": [[t, list(m.keys())] for t, m in s["user_maps"].items()],
             "res_keys": [[t, list(m.keys())] for t, m in s["res_maps"].items()],
+            "factors_format": "ordinal_v2",
         }
         with open(os.path.join(path, "state.json"), "w") as f:
             json.dump(blob, f)
+        # arrays keyed by tenant *ordinal* (u_0, r_0, ...): tenant names can
+        # contain zip-hostile characters ('/', ...); the tenant order is the
+        # order of user_keys/res_keys in state.json
         arrays = {}
-        for t, m in s["user_maps"].items():
-            arrays[f"u_{t}"] = np.stack(list(m.values())) if m else np.zeros((0, 1))
-        for t, m in s["res_maps"].items():
-            arrays[f"r_{t}"] = np.stack(list(m.values())) if m else np.zeros((0, 1))
+        for i, (t, m) in enumerate(s["user_maps"].items()):
+            arrays[f"u_{i}"] = np.stack(list(m.values())) if m else np.zeros((0, 1))
+        for i, (t, m) in enumerate(s["res_maps"].items()):
+            arrays[f"r_{i}"] = np.stack(list(m.values())) if m else np.zeros((0, 1))
         np.savez(os.path.join(path, "factors.npz"), **arrays)
 
     def _load_extra(self, path: str) -> None:
@@ -288,10 +292,13 @@ class AccessAnomalyModel(Model):
         for t, uc, rc in blob["comps"]:
             s["comps"][t] = (dict((k, v) for k, v in uc),
                              dict((k, v) for k, v in rc))
-        for t, keys in blob["user_keys"]:
-            U = z[f"u_{t}"]
+        # explicit format marker — key-presence probing would misroute legacy
+        # archives whose tenant names are themselves numeric strings
+        ordinal = blob.get("factors_format") == "ordinal_v2"
+        for j, (t, keys) in enumerate(blob["user_keys"]):
+            U = z[f"u_{j}"] if ordinal else z[f"u_{t}"]
             s["user_maps"][t] = {k: U[i] for i, k in enumerate(keys)}
-        for t, keys in blob["res_keys"]:
-            V = z[f"r_{t}"]
+        for j, (t, keys) in enumerate(blob["res_keys"]):
+            V = z[f"r_{j}"] if ordinal else z[f"r_{t}"]
             s["res_maps"][t] = {k: V[i] for i, k in enumerate(keys)}
         self._state = s

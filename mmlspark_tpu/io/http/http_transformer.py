@@ -31,6 +31,12 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     """Column of requests → column of responses."""
 
     concurrency = Param(int, default=1, doc="max in-flight requests per partition")
+    partition_parallelism = Param(int, default=1,
+                                  doc="partitions processed at once; total "
+                                      "in-flight = this × concurrency (the "
+                                      "Spark analogue is concurrent tasks × "
+                                      "concurrency), so the default keeps the "
+                                      "user-set concurrency cap exact")
     timeout = Param(float, default=60.0, doc="per-request timeout seconds")
     backoffs_ms = Param((list, int), default=[100, 500, 1000],
                         doc="retry backoff ladder in milliseconds")
@@ -54,7 +60,8 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
             resps = list(client.send(iter(part[in_col])))
             return part.with_column(out_col, object_col(resps))
 
-        return df.map_partitions(run)
+        return df.map_partitions(run,
+                                 max_workers=self.get("partition_parallelism"))
 
 
 class ErrorUtils:

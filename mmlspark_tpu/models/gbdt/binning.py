@@ -36,14 +36,20 @@ class BinMapper:
         self.seed = seed
         self.upper_bounds: List[np.ndarray] = []  # per feature, ascending
         self.n_features: Optional[int] = None
+        self._table = None
 
     def fit(self, X: np.ndarray) -> "BinMapper":
-        X = np.asarray(X, dtype=np.float64)
+        X = np.asarray(X)
         n, f = X.shape
         self.n_features = f
+        self._table = None
         if n > self.sample_cnt:
+            # sample *rows indices* first so only the sample is ever copied /
+            # upcast — fitting on HIGGS-scale input must not materialize an
+            # n×f float64 matrix
             rng = np.random.default_rng(self.seed)
-            X = X[rng.choice(n, self.sample_cnt, replace=False)]
+            X = X[np.sort(rng.choice(n, self.sample_cnt, replace=False))]
+        X = np.asarray(X, dtype=np.float64)
         self.upper_bounds = []
         for j in range(f):
             col = X[:, j]
@@ -70,22 +76,43 @@ class BinMapper:
         return 1 + max((len(b) for b in self.upper_bounds), default=1)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, dtype=np.float64)
+        """Bin a matrix, streaming column-by-column.
+
+        Never materializes a float64 copy of the input: only per-column
+        temporaries (O(n)) exist at any moment, so an 11M×28 float32 HIGGS
+        matrix bins without doubling resident memory.
+        """
+        X = np.asarray(X)
         n, f = X.shape
         if f != self.n_features:
             raise ValueError(f"expected {self.n_features} features, got {f}")
+        is_float = X.dtype.kind == "f"
         dtype = np.uint8 if self.n_bins <= 256 else np.uint16
         out = np.zeros((n, f), dtype=dtype)
         for j in range(f):
             col = X[:, j]
             # bins 1..len(bounds); searchsorted gives 0-based interval index
             binned = np.searchsorted(self.upper_bounds[j], col, side="left") + 1
-            binned = np.where(np.isnan(col), 0, binned)
+            if is_float:
+                binned = np.where(np.isnan(col), 0, binned)
             out[:, j] = binned.astype(dtype)
         return out
 
     def fit_transform(self, X: np.ndarray) -> np.ndarray:
         return self.fit(X).transform(X)
+
+    def bounds_table(self):
+        """Padded (n_features, max_len) bounds matrix + per-feature lengths,
+        for vectorized bin→threshold lookups (cached)."""
+        if self._table is None:
+            lengths = np.array([len(b) for b in self.upper_bounds],
+                               dtype=np.int64)
+            L = int(lengths.max()) if len(lengths) else 1
+            table = np.full((max(1, len(self.upper_bounds)), L), np.inf)
+            for j, b in enumerate(self.upper_bounds):
+                table[j, :len(b)] = b
+            self._table = (table, lengths)
+        return self._table
 
     def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
         """Raw-value threshold for "go left if x <= threshold" at this bin."""

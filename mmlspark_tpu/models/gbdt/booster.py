@@ -106,6 +106,11 @@ class Booster:
             np.concatenate([self.covers, other.covers]))
 
     # -- prediction ---------------------------------------------------------
+    # NOTE: thresholds and feature comparisons are float32 end-to-end (the
+    # TPU-native layout; f64 is emulated on TPU). Features needing exact
+    # splits must be distinguishable in float32 (|x| < 2^23 for integer ids, so bin-midpoint
+    # thresholds stay representable)
+    # — a deliberate deviation from LightGBM's double-precision thresholds.
     def raw_score(self, X: np.ndarray) -> np.ndarray:
         X = np.asarray(X, dtype=np.float32)
         if self.num_trees == 0:

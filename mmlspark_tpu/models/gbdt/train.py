@@ -88,51 +88,80 @@ def _depth_for(p: Dict) -> int:
 
 def _thr_bins_to_raw(feats: np.ndarray, thr_bin: np.ndarray,
                      mapper: BinMapper, n_bins: int) -> np.ndarray:
-    """Map split bins → raw thresholds ("x <= thr" ≡ "bin <= thr_bin")."""
+    """Map split bins → raw thresholds ("x <= thr" ≡ "bin <= thr_bin").
+
+    Fully vectorized over (tree, node) via the mapper's padded bounds table —
+    the per-entry Python loop was a HIGGS-scale bottleneck (trees × nodes
+    entries per iteration).
+    """
+    table, lengths = mapper.bounds_table()
     out = np.full(thr_bin.shape, np.inf, dtype=np.float32)
-    flat_f = feats.ravel()
-    flat_b = thr_bin.ravel()
-    flat_o = out.ravel()
-    for i in range(flat_f.size):
-        f = flat_f[i]
-        if f >= 0 and flat_b[i] < n_bins:
-            flat_o[i] = mapper.bin_threshold_value(int(f), int(flat_b[i]))
-    return flat_o.reshape(thr_bin.shape)
+    valid = (feats >= 0) & (thr_bin < n_bins)
+    f = np.clip(feats, 0, table.shape[0] - 1).astype(np.int64)
+    i = np.clip(thr_bin.astype(np.int64) - 1, 0, np.maximum(lengths[f] - 1, 0))
+    vals = table[f, i].astype(np.float32)
+    out[valid] = vals[valid]
+    return out
 
 
 def _lambdarank_grad(scores: np.ndarray, y: np.ndarray, groups: np.ndarray,
                      sigma: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
-    """LambdaRank gradients with |ΔNDCG| weighting, per query group."""
+    """LambdaRank gradients with |ΔNDCG| weighting, per query group.
+
+    Vectorized: groups are padded to the max group size and all pairwise
+    terms computed as (chunk, M, M) tensors, chunked so peak memory stays
+    bounded — the per-group Python loop was a HIGGS-scale bottleneck.
+    """
     g = np.zeros_like(scores)
     h = np.zeros_like(scores)
-    start = 0
-    for cnt in groups:
-        cnt = int(cnt)
-        if cnt <= 1:
-            start += cnt
-            continue
-        sl = slice(start, start + cnt)
-        s, yy = scores[sl], y[sl]
-        order = np.argsort(-s)
-        rank = np.empty(cnt, dtype=np.int64)
-        rank[order] = np.arange(cnt)
-        gain = 2.0 ** yy - 1
-        disc = 1.0 / np.log2(rank + 2.0)
-        ideal = np.sort(gain)[::-1] / np.log2(np.arange(2, cnt + 2))
-        idcg = max(ideal.sum(), 1e-12)
-        sd = s[:, None] - s[None, :]
-        label_diff = yy[:, None] - yy[None, :]
-        Sij = np.sign(label_diff)
-        rho = 1.0 / (1.0 + np.exp(sigma * sd * Sij))
-        delta_ndcg = np.abs((gain[:, None] - gain[None, :])
-                            * (disc[:, None] - disc[None, :])) / idcg
-        lam = -sigma * rho * delta_ndcg * Sij
-        gi = np.where(Sij != 0, lam, 0.0)
-        hi = np.where(Sij != 0, sigma * sigma * rho * (1 - rho) * delta_ndcg,
-                      0.0)
-        g[sl] = gi.sum(axis=1)
-        h[sl] = np.maximum(hi.sum(axis=1), 1e-9)
-        start += cnt
+    groups = np.asarray(groups, dtype=np.int64)
+    if len(groups) == 0:
+        return g, h
+    offs = np.concatenate([[0], np.cumsum(groups)])
+    M = int(groups.max())
+    if M <= 1:
+        return g, h
+    nG = len(groups)
+    # padded (G, M) row-index matrix + validity mask
+    idx = offs[:-1, None] + np.arange(M)[None, :]
+    mask = np.arange(M)[None, :] < groups[:, None]
+    idx = np.minimum(idx, len(scores) - 1)
+
+    # chunk so the (C, M, M) pair tensors stay ~tens of MB
+    chunk = max(1, int(4e6 / (M * M)))
+    for lo in range(0, nG, chunk):
+        sl = slice(lo, min(lo + chunk, nG))
+        m = mask[sl]                                    # (C, M)
+        ix = idx[sl]
+        cnt = groups[sl]
+        s = np.where(m, scores[ix], 0.0)
+        yy = np.where(m, y[ix], 0.0)
+        # ranks: padded entries sort last via -inf key
+        key = np.where(m, s, -np.inf)
+        order = np.argsort(-key, axis=1, kind="stable")
+        rank = np.empty_like(order)
+        np.put_along_axis(rank, order, np.arange(M)[None, :], axis=1)
+        gain = np.where(m, 2.0 ** yy - 1, 0.0)
+        disc = np.where(m, 1.0 / np.log2(rank + 2.0), 0.0)
+        # idcg: zero-gain padding contributes 0 at any position
+        ideal = -np.sort(-gain, axis=1) / np.log2(np.arange(2, M + 2))[None, :]
+        idcg = np.maximum(ideal.sum(axis=1), 1e-12)
+        pm = m[:, :, None] & m[:, None, :]              # valid pair mask
+        sd = s[:, :, None] - s[:, None, :]
+        Sij = np.sign(yy[:, :, None] - yy[:, None, :])
+        live = pm & (Sij != 0)
+        with np.errstate(over="ignore"):
+            rho = 1.0 / (1.0 + np.exp(sigma * sd * Sij))
+        delta_ndcg = np.abs((gain[:, :, None] - gain[:, None, :])
+                            * (disc[:, :, None] - disc[:, None, :])) \
+            / idcg[:, None, None]
+        gi = np.where(live, -sigma * rho * delta_ndcg * Sij, 0.0)
+        hi = np.where(live, sigma * sigma * rho * (1 - rho) * delta_ndcg, 0.0)
+        grow = gi.sum(axis=2)
+        hrow = np.maximum(hi.sum(axis=2), 1e-9)
+        multi = (cnt > 1)[:, None] & m                  # cnt<=1 groups stay 0
+        g[ix[multi]] = grow[multi]
+        h[ix[multi]] = hrow[multi]
     return g, h
 
 
@@ -154,7 +183,13 @@ def train(params: Dict,
           eval_log: Optional[List] = None) -> Booster:
     """Fit a GBDT. ``params`` uses LightGBM names (aliases accepted)."""
     p = resolve_params(params)
-    X = np.asarray(X, dtype=np.float64)
+    # keep X in its incoming float width — a HIGGS-scale float32 matrix must
+    # not be silently doubled to float64 (binning only ever copies a sample
+    # and per-column temporaries); integers upcast to float64 so large ids
+    # (> 2^24) stay distinct
+    X = np.asarray(X)
+    if X.dtype.kind != "f":
+        X = X.astype(np.float64)
     y = np.asarray(y, dtype=np.float64)
     n, F = X.shape
     w = (np.asarray(sample_weight, dtype=np.float64) if sample_weight is not None
@@ -190,7 +225,9 @@ def train(params: Dict,
     if init_model is not None:
         booster = init_model
         base_score = booster.base_score
-        scores = booster.raw_score(X.astype(np.float32)).astype(np.float64)
+        scores = booster.raw_score(
+            X if X.dtype == np.float32 else X.astype(np.float32)
+        ).astype(np.float64)
         init_trees = booster.num_trees
     else:
         init_trees = 0
@@ -398,5 +435,11 @@ def train(params: Dict,
             "booster.txt": booster.to_string(),
             "meta.json": {"completed_iterations": resumed_iters + n_iter},
         })
-    booster.best_iteration = best_iter if valid_sets else resumed_iters + n_iter
+    if valid_sets and n_iter == 0:
+        # fully-completed checkpointed run rerun idempotently: the eval loop
+        # never executed, so keep the restored booster's best_iteration
+        pass
+    else:
+        booster.best_iteration = best_iter if valid_sets \
+            else resumed_iters + n_iter
     return booster

@@ -18,6 +18,7 @@ are padded to power-of-two buckets so the jit cache stays small
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import jax
@@ -29,7 +30,7 @@ from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model, Transformer
 from ..onnx.convert import ConvertedModel, convert_model
 from ..ops.padding import bucket_size, pad_axis
-from ..parallel.mesh import device_for_partition
+from ..parallel.mesh import device_for_partition, local_devices
 from ..stages.batching import FixedMiniBatchTransformer, FlattenBatch, batch_slices
 
 __all__ = ["ONNXModel"]
@@ -54,14 +55,54 @@ class ONNXModel(Model):
             self.set(model_bytes=model_bytes)
         self._converted: Optional[ConvertedModel] = None
         self._jitted = None
-        self._device_params: Dict[int, dict] = {}
+        self._jit_sig = None
+        self._fused_cols: set = set()
+        self._argmax_cols: set = set()
+        self._out_col_names: List[str] = []
+        self._device_params: Dict[Optional[int], dict] = {}
+        self._params_lock = threading.Lock()
 
     # -- metadata (proto-only, no session) ----------------------------------
     def _ensure_converted(self) -> ConvertedModel:
         if self._converted is None:
             self._converted = convert_model(self.get("model_bytes"))
-            self._jitted = jax.jit(self._converted.__call__)
         return self._converted
+
+    def _fetch_map(self, cm: ConvertedModel) -> Dict[str, str]:
+        return dict(self.fetch_dict) or {n: n for n in cm.output_names}
+
+    def _ensure_jitted(self):
+        """One jitted program: model graph + softmax/argmax post-ops fused.
+
+        The reference applies softmax/argmax as per-row UDFs *after* the
+        inference pass (``ONNXModel.scala:519-562``); on TPU those are free
+        when fused into the XLA graph, so outputs cross the host boundary
+        exactly once.
+        """
+        cm = self._ensure_converted()
+        fetch = self._fetch_map(cm)
+        softmax = {k: v for k, v in self.softmax_dict.items() if v in fetch}
+        argmax = {k: v for k, v in self.argmax_dict.items() if v in fetch}
+        sig = (tuple(sorted(fetch.items())), tuple(sorted(softmax.items())),
+               tuple(sorted(argmax.items())))
+        if self._jitted is None or self._jit_sig != sig:
+            def run(params, feeds):
+                outs = cm(params, feeds)
+                cols = {col: outs[name] for col, name in fetch.items()}
+                for out_col, src in softmax.items():
+                    cols[out_col] = jax.nn.softmax(
+                        cols[src].astype(jnp.float32), axis=-1)
+                for out_col, src in argmax.items():
+                    cols[out_col] = jnp.argmax(cols[src], axis=-1).astype(jnp.int32)
+                return cols
+
+            self._jitted = jax.jit(run)
+            self._jit_sig = sig
+            self._fused_cols = set(softmax) | set(argmax)
+            self._argmax_cols = set(argmax)
+            self._out_col_names = list(fetch) + \
+                [c for c in self._fused_cols if c not in fetch]
+        return self._jitted
 
     def model_inputs(self) -> Dict[str, tuple]:
         cm = self._ensure_converted()
@@ -89,79 +130,112 @@ class ONNXModel(Model):
         return arr
 
     def _params_for_device(self, device) -> dict:
-        key = id(device)
-        if key not in self._device_params:
-            cm = self._ensure_converted()
-            params = cm.params
-            if self.compute_dtype != "float32":
-                dt = jnp.dtype(self.compute_dtype)
-                params = {k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating)
-                              else v) for k, v in params.items()}
-            self._device_params[key] = jax.device_put(params, device)
-        return self._device_params[key]
+        if device is None:
+            # normalize to the concrete default device so pinned and
+            # unpinned callers share one cached weight copy
+            devs = local_devices()
+            device = devs[0] if devs else None
+        key = id(device) if device is not None else None
+        with self._params_lock:
+            if key not in self._device_params:
+                cm = self._ensure_converted()
+                params = cm.params
+                if self.compute_dtype != "float32":
+                    dt = jnp.dtype(self.compute_dtype)
+                    params = {k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating)
+                                  else v) for k, v in params.items()}
+                self._device_params[key] = (jax.device_put(params, device)
+                                            if device is not None
+                                            else jax.device_put(params))
+            return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
     def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
+        """Dispatch every minibatch asynchronously, drain once at the end.
+
+        JAX dispatch returns futures, so host coerce/pad of batch k+1
+        overlaps device compute of batch k; outputs stay on device until the
+        partition finishes (the reference's per-batch ``session.run`` +
+        NIO-buffer marshalling, ``ONNXModel.scala:305-402``, is fully
+        synchronous — this pipelining is the TPU-side throughput win).
+        """
         cm = self._ensure_converted()
+        jitted = self._ensure_jitted()
         feed = self.feed_dict or {cm.input_names[0]: part.columns[0]}
-        fetch = self.fetch_dict or {n: n for n in cm.output_names}
         in_meta = {vi.name: vi for vi in cm.inputs}
 
         device = device_for_partition(pidx) if self.pin_devices else None
-        params = self._params_for_device(device) if device is not None \
-            else self._params_for_device(jax.devices()[0])
+        params = self._params_for_device(device)
 
         n = len(part)
-        out_cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetch}
+        pending = []  # (device outputs dict, valid rows) per batch, in order
         for sl in batch_slices(n, self.mini_batch_size):
             feeds = {}
-            b = None
+            b = 0
             for input_name, col_name in feed.items():
                 vi = in_meta[input_name]
                 arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape)
                 b = len(arr)
-                target = bucket_size(b)
-                arr = pad_axis(arr, target)
-                feeds[input_name] = jax.device_put(arr, device)
-            outs = self._jitted(params, feeds)
-            for col_name, out_name in fetch.items():
-                res = np.asarray(outs[out_name])[:b]
-                out_cols[col_name].append(res)
-        merged = {}
-        for col_name, chunks in out_cols.items():
-            if chunks:
-                merged[col_name] = np.concatenate(chunks)
-            else:
-                merged[col_name] = np.zeros((0,))
+                arr = pad_axis(arr, bucket_size(b))
+                feeds[input_name] = (jax.device_put(arr, device)
+                                     if device is not None else arr)
+            pending.append((jitted(params, feeds), b))
+
         out = part
-        for col_name, arr in merged.items():
-            vals = np.empty(len(arr), dtype=object)
-            for i in range(len(arr)):
-                vals[i] = arr[i]
-            out = out.with_column(col_name, vals if arr.ndim > 1 else arr)
+        for col_name in self._out_col_names:
+            chunks = [np.asarray(outs[col_name])[:b] for outs, b in pending]
+            arr = np.concatenate(chunks) if chunks \
+                else np.zeros((0,), dtype=np.float32)
+            if arr.dtype == jnp.bfloat16:
+                arr = arr.astype(np.float32)
+            if col_name in self._argmax_cols:
+                arr = arr.astype(np.int64)
+            out = out.with_column(col_name, arr)
         return out
 
     def _transform(self, df: DataFrame) -> DataFrame:
+        self._ensure_converted()
+        self._ensure_jitted()
         out = df.map_partitions(self._run_batches)
-        # post-ops (parity: softMaxTransform/argMaxTransform :519-562)
+        # host fallback for post-ops whose source column does not come out of
+        # the jitted graph (parity: softMaxTransform/argMaxTransform :519-562)
         for out_col, src_col in self.softmax_dict.items():
-            col = out[src_col]
-            probs = np.empty(len(col), dtype=object)
-            for i, v in enumerate(col):
-                v = np.asarray(v, dtype=np.float64)
-                e = np.exp(v - v.max(axis=-1, keepdims=True))
-                probs[i] = e / e.sum(axis=-1, keepdims=True)
-            out = out.with_column(out_col, probs)
+            if out_col in self._fused_cols:
+                continue
+            out = out.with_column(out_col, _host_softmax(out[src_col]))
         for out_col, src_col in self.argmax_dict.items():
-            col = out[src_col]
-            out = out.with_column(
-                out_col,
-                np.asarray([int(np.argmax(np.asarray(v))) for v in col],
-                           dtype=np.int64))
+            if out_col in self._fused_cols:
+                continue
+            out = out.with_column(out_col, _host_argmax(out[src_col]))
         return out
 
     # -- persistence: rebuild session state after load ----------------------
     def _load_extra(self, path: str) -> None:
         self._converted = None
         self._jitted = None
+        self._jit_sig = None
+        self._fused_cols = set()
+        self._argmax_cols = set()
+        self._out_col_names = []
         self._device_params = {}
+        self._params_lock = threading.Lock()
+
+
+def _host_softmax(col: np.ndarray) -> np.ndarray:
+    if col.dtype != object:
+        v = np.asarray(col, dtype=np.float64)
+        e = np.exp(v - v.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    probs = np.empty(len(col), dtype=object)
+    for i, v in enumerate(col):
+        v = np.asarray(v, dtype=np.float64)
+        e = np.exp(v - v.max(axis=-1, keepdims=True))
+        probs[i] = e / e.sum(axis=-1, keepdims=True)
+    return probs
+
+
+def _host_argmax(col: np.ndarray) -> np.ndarray:
+    if col.dtype != object:
+        return np.argmax(np.asarray(col), axis=-1).astype(np.int64)
+    return np.asarray([int(np.argmax(np.asarray(v))) for v in col],
+                      dtype=np.int64)

@@ -23,16 +23,33 @@ __all__ = ["local_devices", "device_for_partition", "make_mesh",
 
 
 def local_devices():
-    return jax.local_devices()
+    """Process-local devices, degrading instead of crashing.
+
+    Backend init can fail transiently (e.g. the TPU plugin is briefly
+    unavailable); the reference's device pinning is best-effort too
+    (``ONNXModel.scala:293-303`` falls through when no GPU resource is
+    present). Order: default backend → explicit CPU backend → [].
+    """
+    try:
+        return jax.local_devices()
+    except Exception:
+        pass
+    try:
+        return jax.devices("cpu")
+    except Exception:
+        return []
 
 
 def device_for_partition(partition_index: int):
     """Pin a data partition to a process-local chip, round-robin.
 
     TPU-native stand-in for ``TaskContext.resources("gpu")`` pinning
-    (``ONNXModel.scala:293-303``).
+    (``ONNXModel.scala:293-303``). Returns ``None`` (= default placement)
+    when no backend is reachable, so callers degrade rather than crash.
     """
-    devs = jax.local_devices()
+    devs = local_devices()
+    if not devs:
+        return None
     return devs[partition_index % len(devs)]
 
 

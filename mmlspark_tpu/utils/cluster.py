@@ -32,9 +32,11 @@ def process_index() -> int:
 
 def local_devices() -> List:
     """Chips attached to this host (reference: tasks-per-executor,
-    ``ClusterUtil.getNumTasksPerExecutor:20``)."""
-    import jax
-    return jax.local_devices()
+    ``ClusterUtil.getNumTasksPerExecutor:20``). Shares the degrading
+    implementation in ``parallel.mesh`` — backend-init failure must never
+    crash callers."""
+    from ..parallel.mesh import local_devices as _ld
+    return _ld()
 
 
 def global_devices() -> List:
@@ -72,6 +74,7 @@ def device_for_partition(part_index: int):
 
     Replaces the reference's GPU pinning from task resources
     (``ONNXModel.scala:293-303`` — ``selectGpuDevice(TaskContext.resources)``).
+    Shares the degrading implementation in ``parallel.mesh``.
     """
-    devs = local_devices()
-    return devs[part_index % len(devs)]
+    from ..parallel.mesh import device_for_partition as _dfp
+    return _dfp(part_index)

@@ -92,7 +92,15 @@ class VowpalWabbitContextualBandit(Estimator, HasLabelCol):
         weights = []
         clip = self.get("prob_clip")
         for r in range(len(df)):
-            a = actions[r][chosen[r] - 1]            # 1-based (VW convention)
+            acts = actions[r]
+            if len(acts) == 0:
+                raise ValueError(f"row {r}: empty action list")
+            c = int(chosen[r])
+            if not 1 <= c <= len(acts):
+                raise ValueError(
+                    f"row {r}: chosen_action {c} out of range 1..{len(acts)} "
+                    "(VW actions are 1-based)")
+            a = acts[c - 1]                          # 1-based (VW convention)
             rows.append(_cross(shared[r], a, mask))
             if self.get("cb_type") == "ips":
                 weights.append(1.0 / max(float(prob[r]), clip))
@@ -149,6 +157,8 @@ class VowpalWabbitContextualBanditModel(Model):
         scores_col = np.empty(len(df), dtype=object)
         pmf_col = np.empty(len(df), dtype=object)
         for r in range(len(df)):
+            if len(actions[r]) == 0:
+                raise ValueError(f"row {r}: empty action list")
             crossed = [_cross(shared[r], a, mask) for a in actions[r]]
             idx, val = pad_sparse(sparse_column(crossed))
             scores = (w[idx] * val).sum(axis=1)

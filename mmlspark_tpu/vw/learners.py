@@ -98,12 +98,14 @@ def _make_pass_fn(loss: str, quantile_tau: float, n_passes: int,
             step = lr * (t + 1.0) ** (-power_t)
             upd = step * g / denom
             w = w.at[bidx].add(-upd)
-            # proximal-ish shrinkage on touched coords only (sparse l1/l2)
+            # proximal-ish shrinkage on touched coords only (sparse l1/l2);
+            # padding slots (index 0, value 0) must not count as touched or
+            # bucket 0 gets over-regularized every step
             if True:
                 wt = w[bidx]
                 shrunk = jnp.sign(wt) * jnp.maximum(jnp.abs(wt) - step * l1, 0.0)
                 shrunk = shrunk * (1.0 - step * l2)
-                w = w.at[bidx].set(shrunk)
+                w = w.at[bidx].set(jnp.where(bval != 0.0, shrunk, wt))
             return (w, G, t + 1.0), None
 
         def one_pass(carry, _):

@@ -83,6 +83,40 @@ class TestDataFrame:
         assert len(out) == 10
         assert sorted(set(out["pid"])) == [0, 1, 2, 3]
 
+    def test_map_partitions_runs_concurrently(self):
+        # partitions must overlap in time — this is what makes round-robin
+        # chip pinning actually use k chips at once. Asserted via an
+        # in-flight counter (robust to machine load, unlike wall-clock).
+        import threading
+        import time
+        df = DataFrame({"x": np.arange(8, dtype=np.float64)}, npartitions=4)
+        lock = threading.Lock()
+        state = {"cur": 0, "peak": 0}
+
+        def slow(p, i):
+            with lock:
+                state["cur"] += 1
+                state["peak"] = max(state["peak"], state["cur"])
+            time.sleep(0.1)
+            with lock:
+                state["cur"] -= 1
+            return p
+
+        out = df.map_partitions(slow, max_workers=4)
+        assert len(out) == 8
+        assert state["peak"] >= 2, f"partitions never overlapped: {state}"
+
+    def test_map_partitions_order_and_errors(self):
+        df = DataFrame({"x": np.arange(12, dtype=np.int64)}, npartitions=3)
+        out = df.map_partitions(lambda p, i: p)
+        assert list(out["x"]) == list(range(12))  # partition order preserved
+        import pytest
+        with pytest.raises(ValueError, match="boom"):
+            df.map_partitions(lambda p, i: (_ for _ in ()).throw(ValueError("boom")))
+        # max_workers=1 forces the sequential path
+        out = df.map_partitions(lambda p, i: p, max_workers=1)
+        assert list(out["x"]) == list(range(12))
+
     def test_ops(self):
         df = DataFrame({"x": [1, 2, 3], "y": [4, 5, 6]})
         assert df.select(["y"]).columns == ["y"]
@@ -173,3 +207,17 @@ class TestSerialization:
         p = h2.get("payload")
         assert np.array_equal(p["w"], h.get("payload")["w"])
         assert p["b"][1] == 2.0
+
+
+def test_string_array_dtype_roundtrip(tmp_path):
+    """'U'-dtype ndarrays keep their dtype through save/load (ADVICE r1)."""
+    from mmlspark_tpu.core.serialize import load_value, save_value
+    arr = np.array(["abc", "de", "f"])
+    assert arr.dtype.kind == "U"
+    p = str(tmp_path / "val")
+    import os
+    os.makedirs(p, exist_ok=True)
+    tag = save_value({"labels": arr, "w": np.ones(2)}, p)
+    back = load_value(tag, p)
+    assert back["labels"].dtype == arr.dtype
+    assert list(back["labels"]) == list(arr)

@@ -190,3 +190,23 @@ def test_complement_access_with_partition_key():
     assert "tenant" in out.columns
     for t, u, r in zip(out["tenant"], out["u"], out["r"]):
         assert (u, r) not in {(1, 1), (2, 2)} if t == "a" else True
+
+
+def test_access_anomaly_zip_hostile_tenant_names(tmp_path):
+    """Tenant names with '/' must survive save/load (ADVICE r1: npz archive
+    entries were keyed by raw tenant name)."""
+    factory = DataFactory(num_hr_users=6, num_hr_resources=8,
+                          num_fin_users=6, num_fin_resources=8, seed=3)
+    train = factory.create_clustered_training_data(ratio=0.5)
+    weird = object_col([f"ten/ant:{t}" for t in train["tenant"]])
+    train = train.with_column("tenant", weird)
+    model = AccessAnomaly(rank_param=4, max_iter=5, seed=0).fit(train)
+    test = train.head(5)
+    ref = model.transform(test)["anomaly_score"]
+    p = str(tmp_path / "aa_slash")
+    model.save(p)
+    got = AccessAnomalyModel.load(p).transform(test)["anomaly_score"]
+    for a, b in zip(ref, got):
+        assert (a is None) == (b is None)
+        if a is not None:
+            assert abs(a - b) < 1e-6

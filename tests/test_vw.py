@@ -220,3 +220,33 @@ def test_pad_sparse_shapes():
     idx, val = pad_sparse(col)
     assert idx.shape == (2, 2) and val.shape == (2, 2)
     assert val[1].sum() == 0
+
+
+def test_contextual_bandit_validates_inputs(rng):
+    """chosen_action is 1-based and action lists must be non-empty
+    (ADVICE r1: silent actions[-1] indexing / opaque argmin crash)."""
+    bits = 10
+    sh = sparse_column([(np.array([1], np.uint32), np.array([1.], np.float32))])
+    acts = sparse_column([[(np.array([2], np.uint32), np.array([1.], np.float32))]])
+    base = {
+        "shared": sh, "features": acts,
+        "chosenAction": np.array([0]),               # invalid: 0 is not 1-based
+        "label": np.array([0.5], dtype=np.float32),
+        "probability": np.array([0.5], dtype=np.float32),
+    }
+    df = DataFrame(base).with_column_metadata("features", {NUM_BITS_KEY: bits})
+    with pytest.raises(ValueError, match="out of range"):
+        VowpalWabbitContextualBandit().fit(df)
+
+    empty = DataFrame({**base, "chosenAction": np.array([1]),
+                       "features": sparse_column([[]])}) \
+        .with_column_metadata("features", {NUM_BITS_KEY: bits})
+    with pytest.raises(ValueError, match="empty action list"):
+        VowpalWabbitContextualBandit().fit(empty)
+
+    # transform-time: empty action list raises a clear error too
+    m = VowpalWabbitContextualBandit(num_passes=1).fit(
+        DataFrame({**base, "chosenAction": np.array([1])})
+        .with_column_metadata("features", {NUM_BITS_KEY: bits}))
+    with pytest.raises(ValueError, match="empty action list"):
+        m.transform(DataFrame({"shared": sh, "features": sparse_column([[]])}))
