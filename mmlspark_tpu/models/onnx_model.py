@@ -48,6 +48,9 @@ class ONNXModel(Model):
                               "(bfloat16 recommended on TPU)")
     pin_devices = Param(bool, default=True,
                         doc="round-robin partitions over local chips")
+    external_data_dir = Param(str, default="",
+                              doc="directory with sidecar files for models "
+                                  "saved with external data")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -65,7 +68,9 @@ class ONNXModel(Model):
     # -- metadata (proto-only, no session) ----------------------------------
     def _ensure_converted(self) -> ConvertedModel:
         if self._converted is None:
-            self._converted = convert_model(self.get("model_bytes"))
+            self._converted = convert_model(
+                self.get("model_bytes"),
+                external_data_dir=self.external_data_dir or None)
         return self._converted
 
     def _fetch_map(self, cm: ConvertedModel) -> Dict[str, str]:

@@ -15,8 +15,8 @@ import numpy as np
 from .proto import DataType, NUMPY_TO_ONNX
 from .wire import WireWriter
 
-__all__ = ["make_node", "make_tensor", "make_tensor_value_info", "make_graph",
-           "make_model", "Node"]
+__all__ = ["make_node", "make_tensor", "make_external_tensor",
+           "make_tensor_value_info", "make_graph", "make_model", "Node"]
 
 
 class Node:
@@ -57,6 +57,39 @@ def _encode_tensor(name: str, arr: np.ndarray) -> WireWriter:
 
 def make_tensor(name: str, arr: np.ndarray) -> WireWriter:
     return _encode_tensor(name, arr)
+
+
+def make_external_tensor(name: str, arr: np.ndarray, location: str,
+                         data_dir: str, offset: int = 0) -> WireWriter:
+    """Emit a TensorProto with ``data_location=EXTERNAL`` and write the
+    payload into ``data_dir/location`` at ``offset`` (the torch exporter's
+    ``save_as_external_data`` layout). Returns the proto writer."""
+    import os
+    arr = np.ascontiguousarray(arr)
+    onnx_dtype = NUMPY_TO_ONNX.get(arr.dtype)
+    if onnx_dtype is None:
+        raise TypeError(f"no ONNX dtype for numpy {arr.dtype}")
+    payload = arr.tobytes()
+    path = os.path.join(data_dir, location)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    mode = "r+b" if os.path.exists(path) else "wb"
+    with open(path, mode) as f:
+        f.seek(offset)
+        f.write(payload)
+
+    w = WireWriter()
+    if arr.shape:
+        w.packed_varints(1, arr.shape)
+    w.varint(2, onnx_dtype)
+    w.string(8, name)
+    for key, val in (("location", location), ("offset", str(offset)),
+                     ("length", str(len(payload)))):
+        entry = WireWriter()
+        entry.string(1, key)
+        entry.string(2, val)
+        w.message(13, entry)
+    w.varint(14, 1)  # data_location = EXTERNAL
+    return w
 
 
 def _encode_attribute(name: str, value) -> WireWriter:
@@ -137,7 +170,9 @@ def make_graph(nodes: Sequence[Node], name: str,
         w.message(1, _encode_node(n))
     w.string(2, name)
     for tname, arr in (initializers or {}).items():
-        w.message(5, _encode_tensor(tname, arr))
+        # pre-encoded writers (e.g. make_external_tensor) pass through
+        w.message(5, arr if isinstance(arr, WireWriter)
+                  else _encode_tensor(tname, arr))
     for vi in inputs:
         w.message(11, vi)
     for vi in outputs:

@@ -950,10 +950,11 @@ class ConvertedModel:
     initializer dict so callers can shard/donate/quantize it independently.
     """
 
-    def __init__(self, model: ModelProto):
+    def __init__(self, model: ModelProto, external_data_dir=None):
         self.model = model
         g = model.graph
-        all_inits = {t.name: tensor_to_numpy(t) for t in g.initializers}
+        all_inits = {t.name: tensor_to_numpy(t, external_data_dir)
+                     for t in g.initializers}
         # Integer/bool initializers are shape constants, axes, split sizes,
         # gather indices — they must stay concrete at trace time, so they are
         # baked into the function instead of traveling as (traced) jit args.
@@ -1008,5 +1009,8 @@ class ConvertedModel:
                        donate_argnums=(0,) if donate_params else ())
 
 
-def convert_model(model_bytes: bytes) -> ConvertedModel:
-    return ConvertedModel(parse_model(model_bytes))
+def convert_model(model_bytes: bytes,
+                  external_data_dir=None) -> ConvertedModel:
+    """``external_data_dir``: directory holding sidecar files for models
+    saved with external data (torch's ``save_as_external_data``)."""
+    return ConvertedModel(parse_model(model_bytes), external_data_dir)

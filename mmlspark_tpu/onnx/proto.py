@@ -98,6 +98,13 @@ class TensorProto:
     raw_data: bytes = b""
     double_data: List[float] = field(default_factory=list)
     uint64_data: List[int] = field(default_factory=list)
+    # torch/onnx exporters spill big initializers to sidecar files
+    # (save_as_external_data): data_location=EXTERNAL(1) + external_data
+    # entries {location, offset, length}
+    data_location: int = 0
+    external_data: Dict[str, str] = field(default_factory=dict)
+
+    EXTERNAL = 1
 
     @staticmethod
     def parse(data: bytes) -> "TensorProto":
@@ -123,12 +130,49 @@ class TensorProto:
                 t.double_data.extend(_unpack_numeric(v, w, "<f8"))
             elif f == 11:
                 t.uint64_data.extend(_unpack_varints(v, w, signed=False))
+            elif f == 13:  # StringStringEntryProto {key=1, value=2}
+                key = val = ""
+                for f2, _w2, v2 in iter_fields(v):
+                    if f2 == 1:
+                        key = v2.decode("utf-8")
+                    elif f2 == 2:
+                        val = v2.decode("utf-8")
+                t.external_data[key] = val
+            elif f == 14:
+                t.data_location = v
         return t
 
 
-def tensor_to_numpy(t: TensorProto) -> np.ndarray:
+def tensor_to_numpy(t: TensorProto,
+                    external_dir: Optional[str] = None) -> np.ndarray:
     shape = tuple(t.dims)
     np_dtype = ONNX_TO_NUMPY.get(t.data_type)
+    if t.data_location == TensorProto.EXTERNAL:
+        import os
+        if external_dir is None:
+            raise ValueError(
+                f"initializer {t.name!r} stores its data externally "
+                f"({t.external_data.get('location')!r}); pass "
+                "external_data_dir (the directory holding the sidecar files)")
+        loc = t.external_data.get("location", "")
+        # the location is spec'd relative to the model file; forbid escapes
+        base = os.path.abspath(external_dir)
+        path = os.path.abspath(os.path.join(base, loc))
+        if not path.startswith(base + os.sep):
+            raise ValueError(f"external data location {loc!r} escapes "
+                             f"{external_dir!r}")
+        offset = int(t.external_data.get("offset", 0) or 0)
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if np_dtype is None and t.data_type != DataType.BFLOAT16:
+            raise ValueError(
+                f"unsupported external tensor dtype {t.data_type}")
+        if t.data_type == DataType.BFLOAT16:
+            import jax.numpy as jnp
+            raw = np.fromfile(path, dtype=np.uint16, count=count,
+                              offset=offset)
+            return raw.view(jnp.bfloat16.dtype).reshape(shape)
+        return np.fromfile(path, dtype=np_dtype, count=count,
+                           offset=offset).reshape(shape)
     if t.data_type == DataType.STRING:
         arr = np.array([s.decode("utf-8", "replace") for s in t.string_data],
                        dtype=object)

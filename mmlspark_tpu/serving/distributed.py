@@ -1,0 +1,396 @@
+"""Distributed serving: driver registry + cross-worker routing/forwarding.
+
+Parity surface (the round-1 gap): the reference's multi-worker continuous
+serving — per-executor ``WorkerServer``s register with a driver rendezvous
+service (``DriverServiceUtils.createDriverService``,
+``HTTPSourceV2.scala:134-195``), the driver keeps a routing table of live
+workers (``:689``), failed/restarted readers re-register under the same id
+and rehydrate their unanswered requests (``registerPartition``
+``:489-506``), replies are routed to the worker holding the client
+connection (``HTTPSourceStateHolder.getServer(machineIp).replyTo``,
+``:536-554``), and an internal load balancer forwards requests between
+servers (``:679-687``).
+
+TPU-first shape: the engine (the DataFrame pipeline loop) polls *all* local
+workers; replies travel back by worker id — over HTTP when the owning worker
+is remote, in-process otherwise. Everything is testable with N workers in
+one process, exactly how the reference tests distributed behavior in
+local-mode Spark (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..io.http.schema import (EntityData, HTTPRequestData, HTTPResponseData,
+                              StatusLineData)
+from .server import CachedRequest, WorkerServer
+
+__all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
+
+
+def _http_json(url: str, payload: Optional[dict] = None,
+               timeout: float = 10.0) -> dict:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+class _RegistryHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        reg: "DriverRegistry" = self.server.registry  # type: ignore[attr-defined]
+        length = int(self.headers.get("Content-Length") or 0)
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        if self.path == "/register":
+            info = reg.register(payload["worker_id"], payload["address"])
+            self._json(200, info)
+        elif self.path == "/deregister":
+            reg.deregister(payload["worker_id"])
+            self._json(200, {"ok": True})
+        elif self.path == "/heartbeat":
+            known = reg.heartbeat(payload["worker_id"])
+            self._json(200 if known else 410, {"known": known})
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+    def do_GET(self):
+        reg: "DriverRegistry" = self.server.registry  # type: ignore[attr-defined]
+        if self.path == "/routing":
+            self._json(200, reg.routing_table())
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+
+class DriverRegistry:
+    """Driver-side worker registry + routing table.
+
+    Re-registration with a known ``worker_id`` *replaces* the address and
+    bumps the generation — that is the failure-recovery contract
+    (``registerPartition`` sees the same epoch and rehydrates,
+    ``HTTPSourceV2.scala:489-506``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 liveness_timeout: float = 30.0):
+        self._workers: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._generation = 0
+        self.liveness_timeout = liveness_timeout
+        self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
+        self._httpd.registry = self  # type: ignore[attr-defined]
+        self.host, self.port = host, self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"driver-registry-{self.port}",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _prune_locked(self, now: float) -> None:
+        stale = [w for w, i in self._workers.items()
+                 if now - i["last_seen"] >= self.liveness_timeout]
+        for w in stale:
+            del self._workers[w]
+
+    def register(self, worker_id: str, address: str) -> dict:
+        now = time.time()
+        with self._lock:
+            self._prune_locked(now)  # crashed workers never /deregister
+            prior = self._workers.get(worker_id)
+            self._generation += 1
+            self._workers[worker_id] = {"address": address,
+                                        "generation": self._generation,
+                                        "last_seen": now}
+            return {"generation": self._generation,
+                    "recovered": prior is not None,
+                    "peers": {w: i["address"]
+                              for w, i in self._workers.items()}}
+
+    def deregister(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+            self._generation += 1
+
+    def heartbeat(self, worker_id: str) -> bool:
+        with self._lock:
+            info = self._workers.get(worker_id)
+            if info is None:
+                return False
+            info["last_seen"] = time.time()
+            return True
+
+    def routing_table(self) -> Dict[str, str]:
+        now = time.time()
+        with self._lock:
+            self._prune_locked(now)
+            return {w: i["address"] for w, i in self._workers.items()}
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+class DistributedWorker:
+    """A WorkerServer registered with a driver, with cross-worker routing.
+
+    Internal control endpoints (parity: the reference's internal server +
+    load balancer, ``HTTPSourceV2.scala:664-697``):
+
+    * ``/_reply`` — accept a routed reply for a request parked *here*
+    * ``/_forward`` — accept a forwarded public request (served locally even
+      when this worker is in forwarding mode, to prevent loops)
+    """
+
+    def __init__(self, driver_url: str, worker_id: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 reply_timeout: float = 60.0,
+                 heartbeat_interval: float = 10.0):
+        self.driver_url = driver_url
+        self.worker_id = worker_id
+        self.server = WorkerServer(host=host, port=port,
+                                   reply_timeout=reply_timeout)
+        self.server.control_routes["/_reply"] = self._handle_remote_reply
+        self.has_engine = True
+        self._peers: Dict[str, str] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+        info = _http_json(driver_url + "/register",
+                          {"worker_id": worker_id,
+                           "address": self.server.address.rstrip("/")})
+        self.generation = info["generation"]
+        self.recovered = info["recovered"]
+        self._peers = {w: a for w, a in info["peers"].items()
+                       if w != worker_id}
+        # forwarding entry: serve locally, never re-forward
+        self.server.control_routes["/_forward"] = self._handle_forwarded
+        # keep last_seen fresh — without this the registry's liveness filter
+        # would silently drop every worker after liveness_timeout
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_interval,),
+            name=f"heartbeat-{worker_id}", daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._hb_stop.wait(interval):
+            if not self.heartbeat():
+                # registry forgot us (pruned while unreachable) → re-register
+                try:
+                    _http_json(self.driver_url + "/register",
+                               {"worker_id": self.worker_id,
+                                "address": self.server.address.rstrip("/")})
+                except Exception:
+                    pass
+
+    # -- registry interaction ----------------------------------------------
+    def refresh_peers(self) -> Dict[str, str]:
+        table = _http_json(self.driver_url + "/routing")
+        with self._lock:
+            self._peers = {w: a for w, a in table.items()
+                           if w != self.worker_id}
+            return dict(self._peers)
+
+    def heartbeat(self) -> bool:
+        try:
+            return _http_json(self.driver_url + "/heartbeat",
+                              {"worker_id": self.worker_id}).get("known", False)
+        except Exception:
+            return False
+
+    # -- engine surface ------------------------------------------------------
+    def get_batch(self, max_rows: int, timeout: float = 0.1
+                  ) -> List[Tuple[str, CachedRequest]]:
+        return [(self.worker_id, c)
+                for c in self.server.get_batch(max_rows, timeout)]
+
+    # -- reply routing -------------------------------------------------------
+    def reply(self, owner_id: str, request_id: str,
+              response: HTTPResponseData) -> bool:
+        """Reply to a request parked on ``owner_id`` — locally or over HTTP
+        (parity: ``HTTPSourceStateHolder.getServer(ip).replyTo``)."""
+        if owner_id == self.worker_id:
+            return self.server.reply(request_id, response)
+        addr = self._peers.get(owner_id)
+        if addr is None:
+            try:
+                self.refresh_peers()
+            except Exception:
+                return False
+            addr = self._peers.get(owner_id)
+            if addr is None:
+                return False
+        try:
+            out = _http_json(addr + "/_reply",
+                             {"request_id": request_id,
+                              "response": response.to_dict()})
+        except Exception:
+            # same contract as the local branch: an already-answered /
+            # timed-out / unreachable target is False, never an exception
+            return False
+        return bool(out.get("ok"))
+
+    def _handle_remote_reply(self, req: HTTPRequestData) -> HTTPResponseData:
+        payload = json.loads(req.entity.content if req.entity else b"{}")
+        ok = self.server.reply(payload["request_id"],
+                               HTTPResponseData.from_dict(payload["response"]))
+        return HTTPResponseData(
+            entity=EntityData.from_string(json.dumps({"ok": ok})),
+            status_line=StatusLineData(status_code=200 if ok else 404))
+
+    # -- request forwarding (load balancing) ---------------------------------
+    _FWD_PREFIX = "/_forward"
+    _FWD_HDR = "X-Mmlspark-Original-Method"
+
+    def _handle_forwarded(self, req: HTTPRequestData) -> HTTPResponseData:
+        # restore the client's original path/query and method before parking
+        if req.url.startswith(self._FWD_PREFIX):
+            req.url = req.url[len(self._FWD_PREFIX):] or "/"
+        for h in req.headers:
+            if h.name == self._FWD_HDR:
+                req.method = h.value
+        req.headers = [h for h in req.headers if h.name != self._FWD_HDR]
+        cached = self.server._enqueue(req)
+        resp = cached.wait(self.server.reply_timeout)
+        if resp is None:
+            return HTTPResponseData(
+                status_line=StatusLineData(status_code=504,
+                                           reason_phrase="forwarded timeout"))
+        return resp
+
+    def enable_forwarding(self) -> None:
+        """Engine detached: forward public requests round-robin to peers
+        instead of parking them (parity: load balancer ``:679-687``)."""
+        self.has_engine = False
+        self.server.control_routes["/"] = self._forward_out
+
+    def disable_forwarding(self) -> None:
+        self.has_engine = True
+        self.server.control_routes.pop("/", None)
+
+    def _forward_out(self, req: HTTPRequestData) -> HTTPResponseData:
+        with self._lock:
+            peers = [a for w, a in sorted(self._peers.items())]
+            if not peers:
+                return HTTPResponseData(
+                    status_line=StatusLineData(status_code=503,
+                                               reason_phrase="no peers"))
+            addr = peers[self._rr % len(peers)]
+            self._rr += 1
+        body = req.entity.content if req.entity else None
+        # carry the client's path/query, method, and headers across the hop
+        hop_hdrs = {h.name: h.value for h in req.headers
+                    if h.name.lower() not in ("host", "content-length",
+                                              "connection")}
+        hop_hdrs[self._FWD_HDR] = req.method
+        fwd = urllib.request.Request(
+            addr + self._FWD_PREFIX + req.url, data=body, headers=hop_hdrs,
+            method="POST" if body else "GET")
+        try:
+            with urllib.request.urlopen(
+                    fwd, timeout=self.server.reply_timeout) as r:
+                payload = r.read()
+                return HTTPResponseData(
+                    entity=EntityData(content=payload,
+                                      content_length=len(payload)),
+                    status_line=StatusLineData(status_code=r.status))
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            return HTTPResponseData(
+                entity=EntityData(content=payload,
+                                  content_length=len(payload)),
+                status_line=StatusLineData(status_code=e.code))
+        except Exception:
+            return HTTPResponseData(
+                status_line=StatusLineData(status_code=502,
+                                           reason_phrase="peer unreachable"))
+
+    def close(self, deregister: bool = True) -> None:
+        self._hb_stop.set()
+        if deregister:
+            try:
+                _http_json(self.driver_url + "/deregister",
+                           {"worker_id": self.worker_id})
+            except Exception:
+                pass
+        self.server.close()
+        self._hb_thread.join(timeout=2)
+
+
+class ServingCluster:
+    """N distributed workers + driver registry in one process — the test
+    harness shape (reference tests distributed serving in local mode too,
+    SURVEY §4). The aggregate ``get_batch``/``reply`` pair is the
+    distributed source/sink surface an engine loop drives."""
+
+    def __init__(self, n_workers: int, reply_timeout: float = 60.0):
+        self.driver = DriverRegistry()
+        self.workers: List[DistributedWorker] = [
+            DistributedWorker(self.driver.url, f"worker-{i}",
+                              reply_timeout=reply_timeout)
+            for i in range(n_workers)]
+        for w in self.workers:
+            w.refresh_peers()
+
+    def worker(self, worker_id: str) -> DistributedWorker:
+        for w in self.workers:
+            if w.worker_id == worker_id:
+                return w
+        raise KeyError(worker_id)
+
+    def get_batch(self, max_rows: int, timeout: float = 0.05
+                  ) -> List[Tuple[str, CachedRequest]]:
+        # non-blocking sweep over every worker; one short sleep only if the
+        # whole cluster is idle (a per-worker blocking get would add
+        # N*timeout dead time to each poll)
+        def sweep():
+            got: List[Tuple[str, CachedRequest]] = []
+            for w in self.workers:
+                if not w.has_engine:
+                    continue
+                got.extend(w.get_batch(max_rows - len(got), timeout=0.0))
+                if len(got) >= max_rows:
+                    break
+            return got
+
+        out = sweep()
+        if not out and timeout > 0:
+            time.sleep(timeout)
+            out = sweep()
+        return out
+
+    def reply(self, owner_id: str, request_id: str,
+              response: HTTPResponseData) -> bool:
+        # any live worker can route the reply; prefer the owner directly
+        try:
+            return self.worker(owner_id).server.reply(request_id, response)
+        except KeyError:
+            return self.workers[0].reply(owner_id, request_id, response)
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.close()
+        self.driver.close()

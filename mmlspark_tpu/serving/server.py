@@ -86,8 +86,19 @@ class _Handler(BaseHTTPRequestHandler):
             url=self.path, method=self.command,
             headers=[HeaderData(k, v) for k, v in self.headers.items()],
             entity=EntityData(content=body, content_length=len(body)) if body else None)
-        cached = ws._enqueue(req)
-        resp = cached.wait(ws.reply_timeout)
+        # control routes (internal cross-worker endpoints: reply forwarding,
+        # request forwarding) answer synchronously, bypassing the queue
+        ctrl = ws._control_route(self.path)
+        if ctrl is not None:
+            try:
+                resp = ctrl(req)
+            except Exception as e:  # control failures must not park forever
+                resp = HTTPResponseData(
+                    entity=EntityData.from_string(str(e)),
+                    status_line=StatusLineData(status_code=500))
+        else:
+            cached = ws._enqueue(req)
+            resp = cached.wait(ws.reply_timeout)
         if resp is None:
             self.send_response(504, "serving reply timeout")
             self.send_header("Content-Length", "0")
@@ -117,6 +128,8 @@ class WorkerServer:
                  api_path: str = "/", reply_timeout: float = 60.0,
                  max_queue: int = 10_000):
         self.reply_timeout = reply_timeout
+        #: path prefix → fn(HTTPRequestData) -> HTTPResponseData
+        self.control_routes: Dict[str, object] = {}
         self._queue: "queue.Queue[CachedRequest]" = queue.Queue(max_queue)
         #: request_id → CachedRequest (reference: routingTable ``:689``)
         self._routing: Dict[str, CachedRequest] = {}
@@ -136,6 +149,12 @@ class WorkerServer:
     @property
     def address(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def _control_route(self, path: str):
+        for prefix, fn in self.control_routes.items():
+            if path.startswith(prefix):
+                return fn
+        return None
 
     # -- ingest -------------------------------------------------------------
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:

@@ -1,0 +1,79 @@
+"""HIGGS-scale GBDT training benchmark (BASELINE.json configs[1]).
+
+The reference's north-star training config is distributed LightGBM on
+HIGGS-11M (28 features, binary). With zero egress we generate a synthetic
+HIGGS-shaped matrix (11M x 28 float32, mixed gaussian signal/background);
+for sec/iter timing the data distribution is irrelevant — the cost is
+histogram building + split finding over n x F x bins.
+
+Prints one JSON line per size with bin time and sec/iter.
+
+Usage: python scripts/bench_gbdt_higgs.py [sizes...]  (default 1e6 2e6 4e6)
+Env: HIGGS_ITERS (default 10), HIGGS_LEAVES (31), HIGGS_BIN (255)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_higgs_like(n: int, f: int = 28, seed: int = 0) -> tuple:
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.53).astype(np.float64)  # HIGGS class balance
+    X = rng.normal(0, 1, (n, f)).astype(np.float32)
+    # signal rows get a correlated shift so trees have real structure to find
+    shift = (0.3 * rng.normal(1, 0.2, f)).astype(np.float32)
+    X[y == 1] += shift
+    return X, y
+
+
+def main():
+    sizes = [int(float(s)) for s in sys.argv[1:]] or [1_000_000, 2_000_000,
+                                                      4_000_000]
+    iters = int(os.environ.get("HIGGS_ITERS", "10"))
+    leaves = int(os.environ.get("HIGGS_LEAVES", "31"))
+    max_bin = int(os.environ.get("HIGGS_BIN", "255"))
+
+    import importlib
+
+    import jax
+    gtrain = importlib.import_module("mmlspark_tpu.models.gbdt.train")
+
+    platform = jax.devices()[0].platform
+    for n in sizes:
+        X, y = make_higgs_like(n)
+        params = {"objective": "binary", "num_iterations": iters,
+                  "num_leaves": leaves, "max_bin": max_bin,
+                  "learning_rate": 0.1, "min_data_in_leaf": 20}
+        # warmup run compiles the tree builder for this shape
+        t0 = time.perf_counter()
+        gtrain.train({**params, "num_iterations": 1}, X, y)
+        warm = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        booster = gtrain.train(params, X, y)
+        total = time.perf_counter() - t0
+        auc_in = _auc(y, booster.predict(X))
+        print(json.dumps({
+            "metric": "gbdt_higgs_sec_per_iter",
+            "n_rows": n, "n_features": X.shape[1],
+            "value": round(total / iters, 4), "unit": "sec/iter",
+            "warmup_sec": round(warm, 2),
+            "train_auc": round(float(auc_in), 4),
+            "platform": platform,
+        }), flush=True)
+        del X, y, booster
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p)); ranks[order] = np.arange(1, len(p) + 1)
+    pos = y == 1
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+if __name__ == "__main__":
+    main()

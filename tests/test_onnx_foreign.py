@@ -1,0 +1,171 @@
+"""Converter vs foreign-exporter graph patterns.
+
+Round-1 verdict: the ONNX importer was only ever tested on its own builder's
+clean graphs. These tests exercise what real exporters emit (torch-style):
+opset 11/13/17 attribute-vs-input variants, decomposed LayerNorm/GELU,
+dynamic batch axes (dim_param), Shape-arithmetic reshapes, attention-mask
+subgraphs, and external-data initializers — parity target:
+``ONNXModel.scala:195-245`` type coverage against real ORT-consumable models.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.models.onnx_model import ONNXModel
+from mmlspark_tpu.models.zoo.bert_onnx import (BertOnnxConfig, bert_reference,
+                                               export_bert_onnx,
+                                               init_bert_params)
+from mmlspark_tpu.onnx.builder import (make_graph, make_model, make_node,
+                                       make_tensor_value_info)
+from mmlspark_tpu.onnx.convert import convert_model
+
+CFG = BertOnnxConfig(vocab=97, layers=2, d_model=48, heads=4, d_ff=96,
+                     max_len=32)
+
+
+def _bert_io(seed=0, B=3, S=17):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, CFG.vocab, (B, S))
+    mask = np.ones((B, S), dtype=np.int64)
+    mask[0, S - 4:] = 0  # ragged row
+    mask[2, S - 1:] = 0
+    return ids.astype(np.int64), mask
+
+
+@pytest.mark.parametrize("opset", [11, 13, 17])
+def test_bert_torch_style_matches_reference(opset):
+    """The full attention pattern — Shape arithmetic, decomposed LN/GELU,
+    mask bias — must match a numpy re-implementation at every opset."""
+    params = init_bert_params(CFG, seed=1)
+    mb = export_bert_onnx(CFG, opset=opset, params=params)
+    cm = convert_model(mb)
+    assert cm.input_names == ["input_ids", "attention_mask"]
+    ids, mask = _bert_io()
+    out = cm(cm.params, {"input_ids": ids, "attention_mask": mask})
+    got = np.asarray(out["last_hidden_state"])
+    want = bert_reference(params, ids, mask, CFG)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_bert_dynamic_batch_axes():
+    """dim_param inputs: the same converted model must serve several batch
+    and sequence sizes (bucketed jit, no fixed shapes baked in)."""
+    params = init_bert_params(CFG, seed=2)
+    cm = convert_model(export_bert_onnx(CFG, params=params))
+    vi = {v.name: v for v in cm.inputs}
+    assert vi["input_ids"].shape == ["batch", "seq"]
+    for B, S in [(1, 5), (4, 12), (2, 32)]:
+        ids, mask = np.ones((B, S), np.int64), np.ones((B, S), np.int64)
+        out = np.asarray(cm(cm.params, {"input_ids": ids,
+                                        "attention_mask": mask})["last_hidden_state"])
+        assert out.shape == (B, S, CFG.d_model)
+        np.testing.assert_allclose(out, bert_reference(params, ids, mask, CFG),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_bert_external_data(tmp_path):
+    """External-data initializers (torch save_as_external_data layout):
+    offset-packed single sidecar file."""
+    params = init_bert_params(CFG, seed=3)
+    d = str(tmp_path)
+    mb = export_bert_onnx(CFG, params=params, external_data_dir=d)
+    assert (tmp_path / "weights.bin").stat().st_size > 0
+    # without the dir the converter must fail loudly, not silently zero-fill
+    with pytest.raises(ValueError, match="external"):
+        convert_model(mb)
+    cm = convert_model(mb, external_data_dir=d)
+    ids, mask = _bert_io(seed=4)
+    got = np.asarray(cm(cm.params, {"input_ids": ids,
+                                    "attention_mask": mask})["last_hidden_state"])
+    np.testing.assert_allclose(got, bert_reference(params, ids, mask, CFG),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_external_data_path_escape_rejected(tmp_path):
+    from mmlspark_tpu.onnx.proto import TensorProto, tensor_to_numpy
+    t = TensorProto(dims=[2], data_type=1, name="w",
+                    data_location=TensorProto.EXTERNAL,
+                    external_data={"location": "../../etc/passwd"})
+    with pytest.raises(ValueError, match="escapes"):
+        tensor_to_numpy(t, str(tmp_path))
+
+
+def test_onnx_model_stage_external_data(tmp_path):
+    """ONNXModel end-to-end with external weights through the DataFrame API."""
+    params = init_bert_params(CFG, seed=5)
+    d = str(tmp_path)
+    mb = export_bert_onnx(CFG, params=params, external_data_dir=d)
+    m = ONNXModel(mb, feed_dict={"input_ids": "ids", "attention_mask": "mask"},
+                  fetch_dict={"emb": "last_hidden_state"},
+                  mini_batch_size=4, external_data_dir=d, pin_devices=False)
+    ids, mask = _bert_io(seed=6, B=6, S=9)
+    def col(a):
+        o = np.empty(len(a), dtype=object)
+        for i, r in enumerate(a):
+            o[i] = r
+        return o
+    out = m.transform(DataFrame({"ids": col(ids), "mask": col(mask)}))
+    got = np.stack(list(out["emb"]))
+    np.testing.assert_allclose(got, bert_reference(params, ids, mask, CFG),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("opset", [11, 13, 18])
+def test_opset_attr_vs_input_variants(opset):
+    """Squeeze/Unsqueeze/ReduceSum/Clip/Split across their opset boundary
+    forms, in one graph, numerically checked."""
+    from mmlspark_tpu.onnx.builder import make_tensor  # noqa: F401
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    nodes, inits = [], {}
+
+    def c(name, arr):
+        inits[name] = np.asarray(arr)
+        return name
+
+    if opset >= 13:
+        nodes.append(make_node("Unsqueeze", ["x", c("ax0", np.array([0], np.int64))], ["u"]))
+        nodes.append(make_node("Squeeze", ["u", c("ax1", np.array([0], np.int64))], ["s"]))
+    else:
+        nodes.append(make_node("Unsqueeze", ["x"], ["u"], axes=[0]))
+        nodes.append(make_node("Squeeze", ["u"], ["s"], axes=[0]))
+    if opset >= 13:
+        nodes.append(make_node("ReduceSum", ["s", c("ax2", np.array([2], np.int64))], ["r"], keepdims=0))
+    else:
+        nodes.append(make_node("ReduceSum", ["s"], ["r"], axes=[2], keepdims=0))
+    if opset >= 11:
+        nodes.append(make_node("Clip", ["r", c("lo", np.array(5.0, np.float32)),
+                                        c("hi", np.array(60.0, np.float32))], ["cl"]))
+    else:
+        nodes.append(make_node("Clip", ["r"], ["cl"], min=5.0, max=60.0))
+    if opset >= 13:
+        nodes.append(make_node("Split", ["cl", c("sp", np.array([1, 1], np.int64))],
+                               ["a", "b"], axis=0))
+    else:
+        nodes.append(make_node("Split", ["cl"], ["a", "b"], axis=0, split=[1, 1]))
+    nodes.append(make_node("Concat", ["a", "b"], ["y"], axis=0))
+
+    graph = make_graph(nodes, "variants",
+                       inputs=[make_tensor_value_info("x", np.float32, (2, 3, 4))],
+                       outputs=[make_tensor_value_info("y", np.float32, (2, 3))],
+                       initializers=inits)
+    cm = convert_model(make_model(graph, opset=opset))
+    got = np.asarray(cm(cm.params, {"x": x})["y"])
+    want = np.clip(x.sum(axis=2), 5.0, 60.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_softmax_pre13_coercion_semantics():
+    """Opset<13 Softmax flattens trailing dims from `axis` — different from
+    the 13+ per-axis semantics when axis is not the last dim."""
+    x = np.random.default_rng(0).normal(0, 1, (2, 3, 4)).astype(np.float32)
+    nodes = [make_node("Softmax", ["x"], ["y"], axis=1)]
+    graph = make_graph(nodes, "sm",
+                       inputs=[make_tensor_value_info("x", np.float32, (2, 3, 4))],
+                       outputs=[make_tensor_value_info("y", np.float32, (2, 3, 4))])
+    cm = convert_model(make_model(graph, opset=11))
+    got = np.asarray(cm({}, {"x": x})["y"])
+    flat = x.reshape(2, 12)
+    e = np.exp(flat - flat.max(-1, keepdims=True))
+    want = (e / e.sum(-1, keepdims=True)).reshape(2, 3, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

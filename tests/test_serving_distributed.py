@@ -1,0 +1,170 @@
+"""Distributed serving: registry, cross-worker reply routing, forwarding,
+kill-and-replay — the round-1 missing piece (parity:
+``HTTPSourceV2.scala:476-697``, ``DriverServiceUtils:134-195``)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.serving.distributed import (DistributedWorker,
+                                              DriverRegistry, ServingCluster)
+
+
+def _post(url, payload, timeout=20.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode() or "{}")
+
+
+def _client(url, payload, out, idx):
+    try:
+        out[idx] = _post(url, payload)
+    except Exception as e:  # pragma: no cover - surfaced via assert
+        out[idx] = e
+
+
+def test_registry_register_recover_liveness():
+    reg = DriverRegistry(liveness_timeout=0.5)
+    try:
+        info = reg.register("w0", "http://127.0.0.1:1")
+        assert not info["recovered"]
+        info2 = reg.register("w0", "http://127.0.0.1:2")  # restart, same id
+        assert info2["recovered"]
+        assert reg.routing_table()["w0"] == "http://127.0.0.1:2"
+        assert info2["generation"] > info["generation"]
+        time.sleep(0.6)  # no heartbeat → drops from the routing table
+        assert "w0" not in reg.routing_table()
+        assert not reg.heartbeat("nobody")
+    finally:
+        reg.close()
+
+
+def test_cross_worker_reply_routing():
+    """Request parked on worker A; the reply is issued *through worker B*
+    (the engine ran on B's host) and must route back over HTTP to A."""
+    cluster = ServingCluster(2, reply_timeout=15.0)
+    try:
+        wa, wb = cluster.workers
+        out = [None]
+        t = threading.Thread(target=_client,
+                             args=(wa.server.address, {"x": 1}, out, 0))
+        t.start()
+        batch = []
+        deadline = time.time() + 10
+        while not batch and time.time() < deadline:
+            batch = wa.get_batch(4, timeout=0.2)
+        assert batch, "request never reached worker A's queue"
+        owner_id, cached = batch[0]
+        assert owner_id == wa.worker_id
+        from mmlspark_tpu.io.http.schema import (EntityData,
+                                                 HTTPResponseData,
+                                                 StatusLineData)
+        resp = HTTPResponseData(
+            entity=EntityData.from_string(json.dumps({"answered_by": "B"})),
+            status_line=StatusLineData(status_code=200))
+        ok = wb.reply(owner_id, cached.request_id, resp)  # remote route
+        assert ok
+        t.join(timeout=15)
+        status, payload = out[0]
+        assert status == 200 and payload == {"answered_by": "B"}
+    finally:
+        cluster.close()
+
+
+def test_forwarding_round_robin():
+    """Worker A has no engine: public requests forward to peers and the
+    client still gets the answer through A (load-balancer parity)."""
+    cluster = ServingCluster(3, reply_timeout=15.0)
+    try:
+        wa = cluster.workers[0]
+        wa.enable_forwarding()
+        for w in cluster.workers:
+            w.refresh_peers()
+
+        stop = threading.Event()
+        seen_urls = []
+
+        def engine():
+            while not stop.is_set():
+                for owner, cached in cluster.get_batch(8, timeout=0.05):
+                    seen_urls.append((cached.request.url,
+                                      cached.request.method))
+                    cluster.reply(owner, cached.request_id, _json_resp(
+                        {"served": owner}))
+
+        eng = threading.Thread(target=engine, daemon=True)
+        eng.start()
+        outs = [None, None, None, None]
+        threads = [threading.Thread(target=_client,
+                                    args=(wa.server.address.rstrip("/")
+                                          + f"/score?i={i}", {"i": i},
+                                          outs, i))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        stop.set()
+        eng.join(timeout=5)
+        served = set()
+        for o in outs:
+            assert isinstance(o, tuple), f"client failed: {o!r}"
+            status, payload = o
+            assert status == 200
+            served.add(payload["served"])
+        # A forwards round-robin → both B and C served something
+        assert served == {"worker-1", "worker-2"}
+        # the client's original path/query and method survive the hop
+        assert all(u.startswith("/score?i=") and m == "POST"
+                   for u, m in seen_urls), seen_urls
+    finally:
+        cluster.close()
+
+
+def _json_resp(payload, status=200):
+    from mmlspark_tpu.io.http.schema import (EntityData, HTTPResponseData,
+                                             StatusLineData)
+    return HTTPResponseData(
+        entity=EntityData.from_string(json.dumps(payload)),
+        status_line=StatusLineData(status_code=status))
+
+
+def test_kill_and_replay():
+    """An engine takes a batch and dies without replying; after the worker
+    re-registers and replays, a second engine answers the SAME parked client
+    connection (parity: registerPartition rehydration :489-506)."""
+    reg = DriverRegistry()
+    try:
+        w = DistributedWorker(reg.url, "w0", reply_timeout=20.0)
+        out = [None]
+        t = threading.Thread(target=_client,
+                             args=(w.server.address, {"q": 42}, out, 0))
+        t.start()
+        batch = []
+        deadline = time.time() + 10
+        while not batch and time.time() < deadline:
+            batch = w.get_batch(4, timeout=0.2)
+        assert batch
+        # engine 1 crashes here — no reply. Simulate task retry:
+        w2_info_recovered = DistributedWorker(reg.url, "w0",
+                                              reply_timeout=20.0)
+        assert w2_info_recovered.recovered  # driver saw the same worker id
+        w2_info_recovered.close(deregister=False)
+        n = w.server.replay_unanswered()
+        assert n == 1
+        batch2 = w.get_batch(4, timeout=1.0)
+        assert len(batch2) == 1
+        owner, cached = batch2[0]
+        assert cached.request_id == batch[0][1].request_id
+        assert w.reply(owner, cached.request_id, _json_resp({"ok": True}))
+        t.join(timeout=20)
+        status, payload = out[0]
+        assert status == 200 and payload == {"ok": True}
+        w.close()
+    finally:
+        reg.close()
