@@ -1,0 +1,288 @@
+"""Generated API surface (L6).
+
+The reference generates its entire user-facing surface from stage reflection:
+every stage mixes in ``Wrappable`` and PySpark/R wrapper classes are emitted
+from Scala reflection over the Param system
+(``core/src/main/scala/com/microsoft/azure/synapse/ml/codegen/Wrappable.scala:68-180``,
+``codegen/CodeGen.scala:29-43``, type mapping ``ParamInfo`` ``Wrappable.scala:20-65``).
+
+In a Python-first framework the moral equivalent is not a second Python
+wrapper layer (the stages *are* Python) but the typed surface around them:
+
+* **PEP 561 type stubs** (``.pyi``) for every module that defines pipeline
+  stages — typed param attributes, fully-typed keyword constructors
+  (``Literal`` for choice params), generated from the same reflective scan
+  the fuzzing coverage gate uses.
+* **API reference docs** (markdown) — one page per subpackage with a
+  per-stage param table (name, type, default, doc), the analogue of the
+  generated doc surface under ``website/``.
+
+``python -m mmlspark_tpu.codegen`` regenerates both; a freshness test fails
+if the checked-in surface drifts from the code (the analogue of the codegen
+CI job in ``pipeline.yaml``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+from typing import Dict, List, Optional, Tuple
+
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transformer
+
+__all__ = [
+    "discover_stages",
+    "param_annotation",
+    "generate_module_stub",
+    "generate_all_stubs",
+    "generate_docs",
+    "write_surface",
+]
+
+
+def discover_stages() -> List[type]:
+    """Import every mmlspark_tpu module and return all PipelineStage
+    subclasses, sorted by (module, qualname).
+
+    The reflective scan plays the role of ``JarLoadingUtils`` in the
+    reference (``core/utils/JarLoadingUtils``), which codegen and the
+    fuzzing coverage gate both rely on.
+    """
+    import mmlspark_tpu
+
+    for m in pkgutil.walk_packages(mmlspark_tpu.__path__, "mmlspark_tpu."):
+        importlib.import_module(m.name)
+    seen = {}
+
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            if sub.__module__.startswith("mmlspark_tpu"):
+                seen[sub] = True
+            walk(sub)
+
+    walk(PipelineStage)
+    return sorted(seen, key=lambda c: (c.__module__, c.__qualname__))
+
+
+# ---------------------------------------------------------------------------
+# Param → annotation mapping (the ParamInfo table, Wrappable.scala:20-65)
+# ---------------------------------------------------------------------------
+
+_BASIC = {int: "int", float: "float", bool: "bool", str: "str",
+          dict: "Dict[str, Any]", list: "List[Any]", None: "Any"}
+
+
+def param_annotation(p: Param) -> str:
+    """Annotation string for a param value, e.g. ``Optional[str]`` or
+    ``Literal['serial', 'data_parallel', 'voting_parallel']``."""
+    if isinstance(p, ComplexParam):
+        return "Any"
+    if p.choices is not None and all(isinstance(c, str) for c in p.choices):
+        inner = ", ".join(repr(c) for c in p.choices)
+        ann = f"Literal[{inner}]"
+    elif isinstance(p.dtype, tuple) and len(p.dtype) == 2 and p.dtype[0] is list:
+        ann = f"List[{_BASIC.get(p.dtype[1], 'Any')}]"
+    else:
+        ann = _BASIC.get(p.dtype, "Any")
+    if p.has_default and p.default is None and ann not in ("Any",):
+        ann = f"Optional[{ann}]"
+    return ann
+
+
+def _stage_classes_in(module_name: str, stages: List[type]) -> List[type]:
+    return [c for c in stages if c.__module__ == module_name]
+
+
+def _base_decl(cls: type) -> Tuple[str, List[Tuple[str, str]]]:
+    """Return (bases-string, imports) for a class declaration in a stub."""
+    names, imports = [], []
+    for b in cls.__bases__:
+        if b is object:
+            continue
+        names.append(b.__name__)
+        if b.__module__ != cls.__module__:
+            imports.append((b.__module__, b.__name__))
+    return ", ".join(names) or "Params", imports
+
+
+def _public_functions(module) -> List:
+    out = []
+    for name, obj in sorted(vars(module).items()):
+        if name.startswith("_") or not inspect.isfunction(obj):
+            continue
+        if obj.__module__ != module.__name__:
+            continue
+        out.append(obj)
+    return out
+
+
+def _fn_stub(fn) -> str:
+    """Permissive signature stub for a module-level function."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return f"def {fn.__name__}(*args: Any, **kwargs: Any) -> Any: ..."
+    parts = []
+    for p in sig.parameters.values():
+        if p.kind is inspect.Parameter.VAR_POSITIONAL:
+            parts.append(f"*{p.name}: Any")
+        elif p.kind is inspect.Parameter.VAR_KEYWORD:
+            parts.append(f"**{p.name}: Any")
+        elif p.default is not inspect.Parameter.empty:
+            parts.append(f"{p.name}: Any = ...")
+        else:
+            parts.append(f"{p.name}: Any")
+    return f"def {fn.__name__}({', '.join(parts)}) -> Any: ..."
+
+
+def generate_module_stub(module_name: str, stages: List[type]) -> Optional[str]:
+    """Generate ``.pyi`` text for one module, or None if it has no stages."""
+    classes = _stage_classes_in(module_name, stages)
+    if not classes:
+        return None
+    # bases before subclasses (readability; some checkers dislike fwd bases)
+    order = {c: i for i, c in enumerate(classes)}
+    classes = sorted(classes, key=lambda c: (len(c.__mro__), order[c]))
+    module = importlib.import_module(module_name)
+    imports: Dict[str, set] = {}
+    bodies = []
+    for cls in classes:
+        bases, base_imports = _base_decl(cls)
+        for mod, name in base_imports:
+            imports.setdefault(mod, set()).add(name)
+        lines = [f"class {cls.__name__}({bases}):"]
+        doc = inspect.getdoc(cls)
+        if doc:
+            first = doc.splitlines()[0].strip()
+            if first:
+                lines.append(f'    """{first}"""')
+        params = cls.params()
+        for name in sorted(params):
+            lines.append(f"    {name}: {param_annotation(params[name])}")
+        if params:
+            kw = ", ".join(
+                f"{n}: {param_annotation(params[n])} = ..." for n in sorted(params))
+            lines.append(
+                f"    def __init__(self, *, {kw}, **kwargs: Any) -> None: ...")
+        else:
+            lines.append("    def __init__(self, **kwargs: Any) -> None: ...")
+        bodies.append("\n".join(lines))
+    for fn in _public_functions(module):
+        bodies.append(_fn_stub(fn))
+
+    header = [
+        "# AUTO-GENERATED by `python -m mmlspark_tpu.codegen` — do not edit.",
+        "# Typed surface for the Param system; parity role of the reference's",
+        "# generated PySpark wrappers (codegen/Wrappable.scala:68-180).",
+        "from typing import Any, Dict, List, Literal, Optional",
+        "",
+        "from mmlspark_tpu.core.params import Params",
+    ]
+    for mod in sorted(imports):
+        names = ", ".join(sorted(imports[mod]))
+        header.append(f"from {mod} import {names}")
+    footer = ["", "def __getattr__(name: str) -> Any: ...", ""]
+    return "\n".join(header + [""] + ["\n\n".join(bodies)] + footer)
+
+
+def generate_all_stubs() -> Dict[str, str]:
+    """{module_name: stub_text} for every module defining stages."""
+    stages = discover_stages()
+    out = {}
+    for module_name in sorted({c.__module__ for c in stages}):
+        text = generate_module_stub(module_name, stages)
+        if text:
+            out[module_name] = text
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Docs generation
+# ---------------------------------------------------------------------------
+
+def _fmt_default(p: Param) -> str:
+    if not p.has_default:
+        return "*(required)*"
+    if isinstance(p, ComplexParam):
+        return "—"
+    return f"`{p.default!r}`"
+
+
+def _stage_doc(cls: type) -> str:
+    lines = [f"### `{cls.__name__}`", ""]
+    kind = ("Estimator" if issubclass(cls, Estimator)
+            else "Model" if issubclass(cls, Model)
+            else "Transformer" if issubclass(cls, Transformer)
+            else "Stage")
+    lines.append(f"*{kind}* — `{cls.__module__}.{cls.__qualname__}`")
+    lines.append("")
+    doc = inspect.getdoc(cls)
+    if doc:
+        lines.append(doc.split("\n\n")[0].strip())
+        lines.append("")
+    params = cls.params()
+    if params:
+        lines.append("| param | type | default | doc |")
+        lines.append("|---|---|---|---|")
+        for name in sorted(params):
+            p = params[name]
+            d = (p.doc or "").replace("|", "\\|")
+            lines.append(f"| `{name}` | `{param_annotation(p)}` | "
+                         f"{_fmt_default(p)} | {d} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def generate_docs() -> Dict[str, str]:
+    """{subpackage: markdown} API reference, one page per subpackage."""
+    stages = discover_stages()
+    by_pkg: Dict[str, List[type]] = {}
+    for c in stages:
+        pkg = c.__module__.split(".")[1]
+        by_pkg.setdefault(pkg, []).append(c)
+    pages = {}
+    for pkg in sorted(by_pkg):
+        classes = sorted(by_pkg[pkg], key=lambda c: c.__qualname__)
+        lines = [f"# `mmlspark_tpu.{pkg}` API reference", "",
+                 "*Generated by `python -m mmlspark_tpu.codegen` — do not edit.*",
+                 ""]
+        for cls in classes:
+            lines.append(_stage_doc(cls))
+        pages[pkg] = "\n".join(lines)
+    index = ["# API reference", "",
+             "*Generated by `python -m mmlspark_tpu.codegen`.*", "",
+             "| package | stages |", "|---|---|"]
+    for pkg in sorted(by_pkg):
+        index.append(f"| [`mmlspark_tpu.{pkg}`]({pkg}.md) | {len(by_pkg[pkg])} |")
+    pages["index"] = "\n".join(index) + "\n"
+    return pages
+
+
+def write_surface(repo_root: str) -> List[str]:
+    """Write stubs next to their modules and docs under docs/api/.
+    Returns the list of paths written."""
+    import os
+
+    written = []
+    for module_name, text in generate_all_stubs().items():
+        mod = importlib.import_module(module_name)
+        src = inspect.getsourcefile(mod)
+        path = os.path.splitext(src)[0] + ".pyi"
+        with open(path, "w") as f:
+            f.write(text)
+        written.append(path)
+    docs_dir = os.path.join(repo_root, "docs", "api")
+    os.makedirs(docs_dir, exist_ok=True)
+    for page, text in generate_docs().items():
+        path = os.path.join(docs_dir, f"{page}.md")
+        with open(path, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        written.append(path)
+    # PEP 561 marker so type checkers honor the generated stubs
+    marker = os.path.join(repo_root, "mmlspark_tpu", "py.typed")
+    with open(marker, "w") as f:
+        f.write("")
+    written.append(marker)
+    return sorted(written)

@@ -1,0 +1,16 @@
+"""Regenerate the typed API surface: ``python -m mmlspark_tpu.codegen``."""
+
+import os
+
+from mmlspark_tpu.codegen import write_surface
+
+
+def main():
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for path in write_surface(repo_root):
+        print(path)
+
+
+if __name__ == "__main__":
+    main()
