@@ -95,14 +95,41 @@ def _stage_classes_in(module_name: str, stages: List[type]) -> List[type]:
     return [c for c in stages if c.__module__ == module_name]
 
 
-def _base_decl(cls: type) -> Tuple[str, List[Tuple[str, str]]]:
+def _closure_for_stubs(stages: List[type]) -> Dict[str, List[type]]:
+    """{stub module: classes to emit}. A stub shadows its whole module for
+    type checkers, so every base class defined in a stubbed module must be
+    emitted there too (bases living in un-stubbed modules resolve through
+    their real source)."""
+    stub_modules = {c.__module__ for c in stages}
+    emit: Dict[str, Dict[type, bool]] = {m: {} for m in stub_modules}
+    for c in stages:
+        emit[c.__module__][c] = True
+    frontier = list(stages)
+    while frontier:
+        cls = frontier.pop()
+        for b in cls.__bases__:
+            if b is object or not b.__module__.startswith("mmlspark_tpu"):
+                continue
+            if b.__module__ in stub_modules and b not in emit[b.__module__]:
+                emit[b.__module__][b] = True
+                frontier.append(b)
+    out = {}
+    for m, classes in emit.items():
+        cs = list(classes)
+        order = {c: i for i, c in enumerate(sorted(
+            cs, key=lambda c: c.__qualname__))}
+        out[m] = sorted(cs, key=lambda c: (len(c.__mro__), order[c]))
+    return out
+
+
+def _base_decl(cls: type, emitted_here: set) -> Tuple[str, List[Tuple[str, str]]]:
     """Return (bases-string, imports) for a class declaration in a stub."""
     names, imports = [], []
     for b in cls.__bases__:
         if b is object:
             continue
         names.append(b.__name__)
-        if b.__module__ != cls.__module__:
+        if b.__module__ != cls.__module__ and b.__name__ not in emitted_here:
             imports.append((b.__module__, b.__name__))
     return ", ".join(names) or "Params", imports
 
@@ -137,38 +164,94 @@ def _fn_stub(fn) -> str:
     return f"def {fn.__name__}({', '.join(parts)}) -> Any: ..."
 
 
-def generate_module_stub(module_name: str, stages: List[type]) -> Optional[str]:
-    """Generate ``.pyi`` text for one module, or None if it has no stages."""
-    classes = _stage_classes_in(module_name, stages)
+def _init_stub(cls: type) -> str:
+    """Constructor stub. Classes with a custom ``__init__`` keep their real
+    positional parameters (``ONNXModel(model_bytes, ...)`` must type-check);
+    declared params not in the signature become typed keyword-only args."""
+    params = cls.params()
+    own_init = cls.__init__ is not Params.__init__
+    pos_parts, seen = [], set()
+    if own_init:
+        try:
+            sig = inspect.signature(cls.__init__)
+        except (TypeError, ValueError):
+            sig = None
+        if sig is not None:
+            for p in list(sig.parameters.values())[1:]:  # drop self
+                if p.kind is inspect.Parameter.VAR_KEYWORD:
+                    continue
+                if p.kind is inspect.Parameter.VAR_POSITIONAL:
+                    pos_parts.append(f"*{p.name}: Any")
+                    continue
+                ann = (param_annotation(params[p.name])
+                       if p.name in params else "Any")
+                default = " = ..." if p.default is not inspect.Parameter.empty \
+                    else ""
+                pos_parts.append(f"{p.name}: {ann}{default}")
+                seen.add(p.name)
+    kw_parts = [f"{n}: {param_annotation(params[n])} = ..."
+                for n in sorted(params) if n not in seen]
+    parts = ["self"] + pos_parts
+    if kw_parts:
+        if not any(p.startswith("*") for p in pos_parts):
+            parts.append("*")
+        parts += kw_parts
+    parts.append("**kwargs: Any")
+    return f"    def __init__({', '.join(parts)}) -> None: ..."
+
+
+#: typed signatures for the core stage API — a stub shadows its module, so
+#: these must be re-declared wherever the real def is hidden by a stub
+_KNOWN_METHODS = {
+    "transform": ("    def transform(self, df: DataFrame, "
+                  "params: Optional[dict] = ...) -> DataFrame: ..."),
+    "fit": ("    def fit(self, df: DataFrame, "
+            "params: Optional[dict] = ...) -> Model: ..."),
+    "fit_multiple": ("    def fit_multiple(self, df: DataFrame, "
+                     "param_maps: Any) -> List[Model]: ..."),
+    "save": "    def save(self, path: str, overwrite: bool = ...) -> None: ...",
+    "load": ("    @classmethod\n"
+             "    def load(cls, path: str) -> PipelineStage: ..."),
+}
+
+
+def generate_module_stub(module_name: str,
+                         classes: List[type]) -> Optional[str]:
+    """Generate ``.pyi`` text for one module from its emit-closure classes
+    (stages plus any base classes other stubs reference here)."""
     if not classes:
         return None
-    # bases before subclasses (readability; some checkers dislike fwd bases)
-    order = {c: i for i, c in enumerate(classes)}
-    classes = sorted(classes, key=lambda c: (len(c.__mro__), order[c]))
     module = importlib.import_module(module_name)
+    emitted_here = {c.__name__ for c in classes}
     imports: Dict[str, set] = {}
     bodies = []
+    needs_core = False
     for cls in classes:
-        bases, base_imports = _base_decl(cls)
+        bases, base_imports = _base_decl(cls, emitted_here)
         for mod, name in base_imports:
             imports.setdefault(mod, set()).add(name)
         lines = [f"class {cls.__name__}({bases}):"]
-        doc = inspect.getdoc(cls)
+        doc = cls.__dict__.get("__doc__")  # own docstring only, not inherited
         if doc:
-            first = doc.splitlines()[0].strip()
+            first = doc.strip().splitlines()[0].strip().replace('"""', "'''")
             if first:
                 lines.append(f'    """{first}"""')
         params = cls.params()
         for name in sorted(params):
             lines.append(f"    {name}: {param_annotation(params[name])}")
-        if params:
-            kw = ", ".join(
-                f"{n}: {param_annotation(params[n])} = ..." for n in sorted(params))
-            lines.append(
-                f"    def __init__(self, *, {kw}, **kwargs: Any) -> None: ...")
-        else:
-            lines.append("    def __init__(self, **kwargs: Any) -> None: ...")
+        lines.append(_init_stub(cls))
+        for meth, sig in _KNOWN_METHODS.items():
+            if meth in cls.__dict__:
+                lines.append(sig)
+                needs_core = True
+        # methods whose defs this stub hides resolve as Any, not as errors
+        lines.append("    def __getattr__(self, name: str) -> Any: ...")
         bodies.append("\n".join(lines))
+    if needs_core:
+        imports.setdefault("mmlspark_tpu.core.dataframe", set()).add("DataFrame")
+        for name in ("Model", "PipelineStage"):
+            if name not in emitted_here:
+                imports.setdefault("mmlspark_tpu.core.pipeline", set()).add(name)
     for fn in _public_functions(module):
         bodies.append(_fn_stub(fn))
 
@@ -178,21 +261,25 @@ def generate_module_stub(module_name: str, stages: List[type]) -> Optional[str]:
         "# generated PySpark wrappers (codegen/Wrappable.scala:68-180).",
         "from typing import Any, Dict, List, Literal, Optional",
         "",
-        "from mmlspark_tpu.core.params import Params",
     ]
+    imports.setdefault("mmlspark_tpu.core.params", set()).add("Params")
     for mod in sorted(imports):
+        if mod == module_name:
+            continue
         names = ", ".join(sorted(imports[mod]))
         header.append(f"from {mod} import {names}")
     footer = ["", "def __getattr__(name: str) -> Any: ...", ""]
     return "\n".join(header + [""] + ["\n\n".join(bodies)] + footer)
 
 
-def generate_all_stubs() -> Dict[str, str]:
+def generate_all_stubs(stages: Optional[List[type]] = None) -> Dict[str, str]:
     """{module_name: stub_text} for every module defining stages."""
-    stages = discover_stages()
+    if stages is None:
+        stages = discover_stages()
+    closure = _closure_for_stubs(stages)
     out = {}
-    for module_name in sorted({c.__module__ for c in stages}):
-        text = generate_module_stub(module_name, stages)
+    for module_name in sorted(closure):
+        text = generate_module_stub(module_name, closure[module_name])
         if text:
             out[module_name] = text
     return out
@@ -235,9 +322,10 @@ def _stage_doc(cls: type) -> str:
     return "\n".join(lines)
 
 
-def generate_docs() -> Dict[str, str]:
+def generate_docs(stages: Optional[List[type]] = None) -> Dict[str, str]:
     """{subpackage: markdown} API reference, one page per subpackage."""
-    stages = discover_stages()
+    if stages is None:
+        stages = discover_stages()
     by_pkg: Dict[str, List[type]] = {}
     for c in stages:
         pkg = c.__module__.split(".")[1]
@@ -266,7 +354,8 @@ def write_surface(repo_root: str) -> List[str]:
     import os
 
     written = []
-    for module_name, text in generate_all_stubs().items():
+    stages = discover_stages()  # one reflective scan feeds stubs and docs
+    for module_name, text in generate_all_stubs(stages).items():
         mod = importlib.import_module(module_name)
         src = inspect.getsourcefile(mod)
         path = os.path.splitext(src)[0] + ".pyi"
@@ -275,7 +364,7 @@ def write_surface(repo_root: str) -> List[str]:
         written.append(path)
     docs_dir = os.path.join(repo_root, "docs", "api")
     os.makedirs(docs_dir, exist_ok=True)
-    for page, text in generate_docs().items():
+    for page, text in generate_docs(stages).items():
         path = os.path.join(docs_dir, f"{page}.md")
         with open(path, "w") as f:
             f.write(text if text.endswith("\n") else text + "\n")

@@ -46,6 +46,17 @@ class ONNXModel(Model):
     compute_dtype = Param(str, default="float32",
                           doc="cast float inputs/params to this dtype "
                               "(bfloat16 recommended on TPU)")
+    normalize_dict = Param(dict, default={},
+                           doc="{model input: {scale, mean, std}} applied on "
+                               "device after the dtype cast — the tensor "
+                               "normalization the reference does host-side in "
+                               "ImageTransformer (ImageTransformer.scala:417+) "
+                               "fused into the XLA graph; mean/std broadcast "
+                               "over the channel axis (axis 1)")
+    transpose_dict = Param(dict, default={},
+                           doc="{model input: permutation} applied on device "
+                               "before normalization, e.g. NHWC uint8 images "
+                               "to the NCHW the graph expects: [0, 3, 1, 2]")
     pin_devices = Param(bool, default=True,
                         doc="round-robin partitions over local chips")
     external_data_dir = Param(str, default="",
@@ -88,10 +99,48 @@ class ONNXModel(Model):
         fetch = self._fetch_map(cm)
         softmax = {k: v for k, v in self.softmax_dict.items() if v in fetch}
         argmax = {k: v for k, v in self.argmax_dict.items() if v in fetch}
+        normalize = dict(self.normalize_dict)
+        transpose = dict(self.transpose_dict)
+        float_inputs = {vi.name for vi in cm.inputs
+                        if np.issubdtype(vi.numpy_dtype, np.floating)}
+        compute_dt = jnp.dtype(self.compute_dtype)
         sig = (tuple(sorted(fetch.items())), tuple(sorted(softmax.items())),
-               tuple(sorted(argmax.items())))
+               tuple(sorted(argmax.items())),
+               tuple(sorted((k, str(v)) for k, v in normalize.items())),
+               tuple(sorted((k, tuple(v)) for k, v in transpose.items())),
+               str(compute_dt))
         if self._jitted is None or self._jit_sig != sig:
+            def prep(name, x):
+                """On-device input prep: layout, dtype cast, normalization.
+
+                Feeds cross the host→device link in the column's native dtype
+                (uint8 images are 4x smaller than float32, and a host-side
+                bfloat16 cast would both burn CPU and hit the slow narrow-type
+                transfer path); all massaging happens on device where it is
+                fused into the first convolution's input.
+                """
+                perm = transpose.get(name)
+                if perm is not None:
+                    x = jnp.transpose(x, perm)
+                if name in float_inputs and x.dtype != compute_dt:
+                    x = x.astype(compute_dt)
+                spec = normalize.get(name)
+                if spec:
+                    scale = spec.get("scale")
+                    if scale is not None:
+                        x = x * jnp.asarray(scale, x.dtype)
+                    mean = spec.get("mean")
+                    if mean is not None:
+                        m = jnp.asarray(mean, x.dtype)
+                        x = x - m.reshape((1, -1) + (1,) * (x.ndim - 2))
+                    std = spec.get("std")
+                    if std is not None:
+                        s = jnp.asarray(std, x.dtype)
+                        x = x / s.reshape((1, -1) + (1,) * (x.ndim - 2))
+                return x
+
             def run(params, feeds):
+                feeds = {k: prep(k, v) for k, v in feeds.items()}
                 outs = cm(params, feeds)
                 cols = {col: outs[name] for col, name in fetch.items()}
                 for out_col, src in softmax.items():
@@ -118,15 +167,24 @@ class ONNXModel(Model):
         return {vi.name: (vi.numpy_dtype, tuple(vi.shape)) for vi in cm.outputs}
 
     # -- column coercion (parity: ONNXModel.coerceBatchedDf :564-584) -------
-    def _coerce(self, col: np.ndarray, dtype, shape) -> np.ndarray:
+    def _coerce(self, col: np.ndarray, dtype, shape,
+                device_prepped: bool = False) -> np.ndarray:
         if col.dtype == object:
             col = np.stack([np.asarray(v) for v in col])
         arr = np.asarray(col)
         want = np.dtype(dtype)
-        if want.kind == "f" and self.compute_dtype != "float32":
-            want = jnp.dtype(self.compute_dtype)
-        if arr.dtype != want:
+        if want.kind == "f":
+            # floats cross the wire as-is (except f64, halved to f32: the
+            # model can't use the precision and transfer is the bottleneck);
+            # the cast to compute_dtype happens on device in the jitted prep
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            elif arr.dtype.kind not in "fiu":
+                arr = arr.astype(np.float32)
+        elif arr.dtype != want:
             arr = arr.astype(want)
+        if device_prepped:
+            return arr  # layout handled on device; shape is not NCHW yet
         # reshape flat rows to the model's per-row shape if one is declared
         row_shape = [d for d in shape[1:] if isinstance(d, int)]
         if row_shape and list(arr.shape[1:]) != row_shape \
@@ -144,14 +202,18 @@ class ONNXModel(Model):
         with self._params_lock:
             if key not in self._device_params:
                 cm = self._ensure_converted()
-                params = cm.params
+                # transfer in f32, cast on device: narrow-dtype host buffers
+                # (bfloat16) take a slow serialization path over the link
+                params = jax.device_put(cm.params, device)
                 if self.compute_dtype != "float32":
                     dt = jnp.dtype(self.compute_dtype)
-                    params = {k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating)
-                                  else v) for k, v in params.items()}
-                self._device_params[key] = (jax.device_put(params, device)
-                                            if device is not None
-                                            else jax.device_put(params))
+                    # params are committed to `device`; jit follows operands
+                    cast = jax.jit(
+                        lambda p: {k: (v.astype(dt)
+                                       if jnp.issubdtype(v.dtype, jnp.floating)
+                                       else v) for k, v in p.items()})
+                    params = cast(params)
+                self._device_params[key] = params
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
@@ -179,11 +241,16 @@ class ONNXModel(Model):
             b = 0
             for input_name, col_name in feed.items():
                 vi = in_meta[input_name]
-                arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape)
+                arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape,
+                                   device_prepped=input_name in self.transpose_dict)
                 b = len(arr)
                 arr = pad_axis(arr, bucket_size(b))
+                # explicit async put (even unpinned): the transfer enqueues
+                # immediately and overlaps the previous batch's compute,
+                # instead of riding inside the next jit dispatch
                 feeds[input_name] = (jax.device_put(arr, device)
-                                     if device is not None else arr)
+                                     if device is not None
+                                     else jax.device_put(arr))
             pending.append((jitted(params, feeds), b))
 
         out = part

@@ -36,6 +36,36 @@ def test_stubs_fresh_and_parse():
         ast.parse(text, path)
 
 
+def test_stub_base_names_all_defined():
+    """ast.parse only checks syntax; every base class name must also be
+    defined in or imported into its stub, or type checking breaks."""
+    for module_name, text in generate_all_stubs().items():
+        tree = ast.parse(text)
+        defined = {n.name for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)}
+        imported = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ImportFrom):
+                imported |= {a.asname or a.name for a in n.names}
+        ok = defined | imported
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ClassDef):
+                for b in n.bases:
+                    assert not (isinstance(b, ast.Name) and b.id not in ok), \
+                        f"{module_name}: class {n.name} base {b.id} undefined"
+
+
+def test_stub_core_methods_redeclared():
+    """Stubs shadow their module; fit/transform must stay visible."""
+    stubs = generate_all_stubs()
+    pipeline = stubs["mmlspark_tpu.core.pipeline"]
+    assert "def transform(self, df: DataFrame" in pipeline
+    assert "def fit(self, df: DataFrame" in pipeline
+    assert "def load(cls, path: str)" in pipeline
+    onnx = stubs["mmlspark_tpu.models.onnx_model"]
+    assert "model_bytes: Any = ..." in onnx  # positional arg preserved
+
+
 def test_no_orphan_stubs():
     generated = set()
     for module_name in generate_all_stubs():

@@ -267,3 +267,66 @@ class TestONNXModelTransformer:
         assert list(ins) == ["x"]
         assert ins["x"][1] == ("N", 8)
         assert set(outs) == {"logits", "probs"}
+
+
+class TestDevicePrep:
+    """uint8 feeds with on-device layout/cast/normalization
+    (transpose_dict/normalize_dict) — the TPU-side answer to the
+    reference's host-side ImageTransformer normalization
+    (``opencv/.../ImageTransformer.scala:417+``)."""
+
+    def test_uint8_transpose_normalize_matches_host_path(self):
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        # conv graph: input NCHW float; feed NHWC uint8 instead
+        rng = np.random.default_rng(3)
+        w = rng.normal(0, 0.2, (4, 3, 3, 3)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("Conv", ["img", "w"], ["y"], pads=[1, 1, 1, 1])],
+            "c",
+            inputs=[O.make_tensor_value_info("img", np.float32,
+                                             ["N", 3, 8, 8])],
+            outputs=[O.make_tensor_value_info("y", np.float32,
+                                              ["N", 4, 8, 8])],
+            initializers={"w": w})
+        data = O.make_model(g)
+        mean, std = [0.485, 0.456, 0.406], [0.229, 0.224, 0.225]
+        m = ONNXModel(data, feed_dict={"img": "image"},
+                      fetch_dict={"y": "y"},
+                      transpose_dict={"img": [0, 3, 1, 2]},
+                      normalize_dict={"img": {"scale": 1 / 255.,
+                                              "mean": mean, "std": std}},
+                      mini_batch_size=4, pin_devices=False)
+        X8 = rng.integers(0, 256, (6, 8, 8, 3), dtype=np.uint8)
+        col = np.empty(6, dtype=object)
+        for i in range(6):
+            col[i] = X8[i]
+        out = m.transform(DataFrame({"image": col}))
+        Xf = (X8.astype(np.float32) / 255. - np.array(mean)) / np.array(std)
+        Xf = np.ascontiguousarray(Xf.transpose(0, 3, 1, 2)).astype(np.float32)
+        colf = np.empty(6, dtype=object)
+        for i in range(6):
+            colf[i] = Xf[i]
+        m2 = ONNXModel(data, feed_dict={"img": "image"},
+                       fetch_dict={"y": "y"},
+                       mini_batch_size=4, pin_devices=False)
+        ref = m2.transform(DataFrame({"image": colf}))
+        np.testing.assert_allclose(np.stack(list(out["y"])),
+                                   np.stack(list(ref["y"])),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_float_feed_transfers_in_source_dtype(self):
+        """Host path must not cast floats to compute_dtype before transfer."""
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        data, _ = mlp_model()
+        m = ONNXModel(data, feed_dict={"x": "feats"},
+                      fetch_dict={"out": "logits"},
+                      compute_dtype="bfloat16", pin_devices=False)
+        arr = m._coerce(np.zeros((4, 8), dtype=np.float32), np.float32,
+                        ("N", 8))
+        assert arr.dtype == np.float32  # cast happens on device
+        arr64 = m._coerce(np.zeros((4, 8), dtype=np.float64), np.float32,
+                          ("N", 8))
+        assert arr64.dtype == np.float32  # f64 halved for the wire
+        arr8 = m._coerce(np.zeros((4, 8), dtype=np.uint8), np.float32,
+                         ("N", 8))
+        assert arr8.dtype == np.uint8  # ints ride the wire untouched
