@@ -35,7 +35,6 @@ from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transfor
 __all__ = [
     "discover_stages",
     "param_annotation",
-    "generate_module_stub",
     "generate_all_stubs",
     "generate_docs",
     "write_surface",
@@ -89,10 +88,6 @@ def param_annotation(p: Param) -> str:
     if p.has_default and p.default is None and ann not in ("Any",):
         ann = f"Optional[{ann}]"
     return ann
-
-
-def _stage_classes_in(module_name: str, stages: List[type]) -> List[type]:
-    return [c for c in stages if c.__module__ == module_name]
 
 
 def _closure_for_stubs(stages: List[type]) -> Dict[str, List[type]]:
@@ -170,7 +165,7 @@ def _init_stub(cls: type) -> str:
     declared params not in the signature become typed keyword-only args."""
     params = cls.params()
     own_init = cls.__init__ is not Params.__init__
-    pos_parts, seen = [], set()
+    pos_parts, kw_only, seen = [], [], set()
     if own_init:
         try:
             sig = inspect.signature(cls.__init__)
@@ -187,10 +182,13 @@ def _init_stub(cls: type) -> str:
                        if p.name in params else "Any")
                 default = " = ..." if p.default is not inspect.Parameter.empty \
                     else ""
-                pos_parts.append(f"{p.name}: {ann}{default}")
+                if p.kind is inspect.Parameter.KEYWORD_ONLY:
+                    kw_only.append(f"{p.name}: {ann}{default}")
+                else:
+                    pos_parts.append(f"{p.name}: {ann}{default}")
                 seen.add(p.name)
-    kw_parts = [f"{n}: {param_annotation(params[n])} = ..."
-                for n in sorted(params) if n not in seen]
+    kw_parts = kw_only + [f"{n}: {param_annotation(params[n])} = ..."
+                          for n in sorted(params) if n not in seen]
     parts = ["self"] + pos_parts
     if kw_parts:
         if not any(p.startswith("*") for p in pos_parts):
@@ -215,7 +213,7 @@ _KNOWN_METHODS = {
 }
 
 
-def generate_module_stub(module_name: str,
+def _generate_module_stub(module_name: str,
                          classes: List[type]) -> Optional[str]:
     """Generate ``.pyi`` text for one module from its emit-closure classes
     (stages plus any base classes other stubs reference here)."""
@@ -279,7 +277,7 @@ def generate_all_stubs(stages: Optional[List[type]] = None) -> Dict[str, str]:
     closure = _closure_for_stubs(stages)
     out = {}
     for module_name in sorted(closure):
-        text = generate_module_stub(module_name, closure[module_name])
+        text = _generate_module_stub(module_name, closure[module_name])
         if text:
             out[module_name] = text
     return out

@@ -112,6 +112,20 @@ def save_value(value: Any, path: str) -> str:
             json.dump(value, f)
         return "json"
     if callable(value):
+        # module-level functions persist by import path (the way the
+        # reference persists model graphs by file reference); anything else
+        # (lambdas, closures, bound methods) stays transient
+        mod = getattr(value, "__module__", None)
+        qual = getattr(value, "__qualname__", "")
+        if mod and mod != "__main__" and "." not in qual and "<" not in qual:
+            try:
+                import importlib
+                if getattr(importlib.import_module(mod), qual, None) is value:
+                    with open(os.path.join(path, "callable_ref.json"), "w") as f:
+                        json.dump({"module": mod, "qualname": qual}, f)
+                    return "callable_ref"
+            except ImportError:
+                pass
         return "transient"
     raise TypeError(f"cannot serialize complex value of type {type(value).__name__}")
 
@@ -141,6 +155,16 @@ def load_value(tag: str, path: str) -> Any:
     if tag == "json":
         with open(os.path.join(path, "value.json")) as f:
             return json.load(f)
+    if tag == "callable_ref":
+        import importlib
+        with open(os.path.join(path, "callable_ref.json")) as f:
+            ref = json.load(f)
+        fn = getattr(importlib.import_module(ref["module"]), ref["qualname"], None)
+        if fn is None:
+            raise ImportError(
+                f"callable {ref['module']}:{ref['qualname']} saved by "
+                f"reference no longer exists")
+        return fn
     if tag == "transient":
         return None
     raise ValueError(f"unknown complex-value tag {tag!r}")

@@ -1,0 +1,190 @@
+"""TCP port forwarding for serving behind NAT/firewalls.
+
+Parity surface: the reference's ``PortForwarding``
+(``core/src/main/scala/com/microsoft/azure/synapse/ml/io/http/PortForwarding.scala``),
+which opens ssh tunnels (jsch) so a driver can reach executor-hosted serving
+ports. Redesigned for this runtime:
+
+* :class:`PortForwarder` — a dependency-free, in-process TCP relay
+  (accept → connect → two pump threads per connection) with connect retry
+  and clean shutdown. This covers the in-cluster case where a plain TCP
+  hop suffices (worker → worker, driver → worker routing).
+* :func:`forward_port_via_ssh` — the ssh-tunnel case (parity with the
+  reference's ``forwardPortToRemote``): builds/starts an ``ssh -N -L``
+  process when an ssh binary exists, with the same bind-address semantics.
+"""
+
+from __future__ import annotations
+
+import shutil
+import socket
+import subprocess
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["PortForwarder", "forward_port_via_ssh"]
+
+_BUF = 64 * 1024
+
+
+class PortForwarder:
+    """Relay ``bind_host:local_port`` → ``remote_host:remote_port``.
+
+    ``local_port=0`` picks a free port (read it from ``.local_port`` after
+    ``start()``). Backend connect failures are retried with exponential
+    backoff up to ``connect_retries`` before the client connection closes —
+    the retry ladder the reference gets from ssh reconnect policies.
+    """
+
+    def __init__(self, remote_host: str, remote_port: int,
+                 local_port: int = 0, bind_host: str = "127.0.0.1",
+                 connect_retries: int = 3, backoff_s: float = 0.2):
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self.local_port = local_port
+        self.bind_host = bind_host
+        self.connect_retries = connect_retries
+        self.backoff_s = backoff_s
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "PortForwarder":
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.bind_host, self.local_port))
+        srv.listen(32)
+        # a blocked accept() does not reliably wake on close(); poll so
+        # stop() can always reclaim the port
+        srv.settimeout(0.2)
+        self.local_port = srv.getsockname()[1]
+        self._server = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"pfwd-{self.local_port}",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def __enter__(self) -> "PortForwarder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals ----------------------------------------------------------
+    def _connect_backend(self) -> Optional[socket.socket]:
+        delay = self.backoff_s
+        for attempt in range(self.connect_retries + 1):
+            if self._stopping.is_set():
+                return None
+            try:
+                return socket.create_connection(
+                    (self.remote_host, self.remote_port), timeout=10)
+            except OSError:
+                if attempt == self.connect_retries:
+                    return None
+                time.sleep(delay)
+                delay *= 2
+        return None
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                client, _ = self._server.accept()
+                client.settimeout(None)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed by stop()
+            backend = self._connect_backend()
+            if backend is None:
+                client.close()
+                continue
+            with self._lock:
+                self._conns += [client, backend]
+            remaining = [2]  # pump directions still running
+            for src, dst in ((client, backend), (backend, client)):
+                threading.Thread(target=self._pump,
+                                 args=(src, dst, remaining),
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              remaining: List[int]) -> None:
+        try:
+            while True:
+                data = src.recv(_BUF)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            # half-close so the peer pump drains the other direction; the
+            # last pump out fully closes both and drops the registry refs
+            # (a long-lived relay must not leak one fd pair per connection)
+            for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+                try:
+                    s.shutdown(how)
+                except OSError:
+                    pass
+            with self._lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+                if last:
+                    for s in (src, dst):
+                        if s in self._conns:
+                            self._conns.remove(s)
+            if last:
+                for s in (src, dst):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+
+def forward_port_via_ssh(remote_host: str, remote_port: int,
+                         local_port: int, ssh_host: str,
+                         ssh_user: Optional[str] = None,
+                         key_file: Optional[str] = None,
+                         bind_address: str = "127.0.0.1",
+                         extra_args: Optional[List[str]] = None,
+                         start: bool = True):
+    """``ssh -N -L bind:local:remote_host:remote_port [user@]ssh_host``.
+
+    Returns ``(argv, process_or_None)``; ``process`` is None when
+    ``start=False`` or no ssh binary is on PATH (argv is still returned so
+    callers can run it elsewhere). Parity: ``PortForwarding.forwardPortToRemote``.
+    """
+    argv = ["ssh", "-N", "-o", "StrictHostKeyChecking=no",
+            "-o", "ExitOnForwardFailure=yes",
+            "-L", f"{bind_address}:{local_port}:{remote_host}:{remote_port}"]
+    if key_file:
+        argv += ["-i", key_file]
+    argv += list(extra_args or [])
+    argv.append(f"{ssh_user}@{ssh_host}" if ssh_user else ssh_host)
+    proc = None
+    if start and shutil.which("ssh"):
+        proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    return argv, proc

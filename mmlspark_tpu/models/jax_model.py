@@ -1,0 +1,172 @@
+"""JaxModel — run any jittable callable as a pipeline stage.
+
+The reference ships *two* deep-learning graph runners with one shape:
+``ONNXModel`` and ``CNTKModel`` (``deep-learning/.../cntk/CNTKModel.scala:250-330``
+— feed/fetch dict API, input coercion ``:387-434``, broadcast +
+``mapPartitions`` evaluate). This framework deliberately subsumes the CNTK
+path: legacy CNTK graphs convert to ONNX and run through :class:`ONNXModel`;
+**new** models are native JAX functions — and this stage is their runner,
+the generic non-ONNX model path.
+
+Anything of the form ``apply(params, feeds) -> outputs`` is a model here:
+a hand-written function, a flax/haiku ``Module.apply``, a zoo network. The
+stage gives it the full DataFrame treatment the reference gives CNTK graphs:
+minibatching, dtype management (bf16 on TPU), per-partition device pinning,
+pipelined async dispatch, save/load (params as an npz pytree; the callable
+by import path when it is a module-level function — the moral of
+``CNTKFunctionParam``'s model-file reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Model
+from ..ops.padding import bucket_size, pad_axis
+from ..parallel.mesh import device_for_partition
+from ..stages.batching import batch_slices
+
+__all__ = ["JaxModel"]
+
+
+class JaxModel(Model):
+    """Run ``apply_fn(params, {feed: array}) -> {name: array} | array``
+    over DataFrame columns in device minibatches."""
+
+    apply_fn = ComplexParam(default=None,
+                            doc="callable (params, feeds) -> outputs; "
+                                "module-level functions survive save/load "
+                                "by import path, closures are transient")
+    model_params = ComplexParam(default=None,
+                                doc="pytree of arrays passed as first arg")
+    feed_dict = Param(dict, default={}, doc="{feed name: dataframe column}; "
+                                            "empty = first column as 'input'")
+    fetch_dict = Param(dict, default={}, doc="{output column: output name}; "
+                                             "empty = every output under its "
+                                             "own name")
+    mini_batch_size = Param(int, default=64, doc="rows per device batch")
+    compute_dtype = Param(str, default="float32",
+                          doc="float feeds/params cast to this on device "
+                              "(bfloat16 recommended on TPU)")
+    pin_devices = Param(bool, default=True,
+                        doc="round-robin partitions over local chips")
+
+    def __init__(self, apply_fn: Optional[Callable] = None,
+                 model_params=None, **kw):
+        super().__init__(**kw)
+        if apply_fn is not None:
+            self.set(apply_fn=apply_fn)
+        if model_params is not None:
+            self.set(model_params=model_params)
+        self._jitted = None
+        self._device_params: Dict[Optional[int], object] = {}
+        self._params_lock = threading.Lock()
+
+    def set(self, **kwargs):
+        # any reconfiguration invalidates the compiled program and the
+        # cached device-resident params (mirrors ONNXModel's _jit_sig)
+        out = super().set(**kwargs)
+        if kwargs and hasattr(self, "_jitted"):
+            self._jitted = None
+            self._device_params = {}
+        return out
+
+    # -- jit ----------------------------------------------------------------
+    def _ensure_jitted(self):
+        if self._jitted is None:
+            fn = self.apply_fn
+            if fn is None:
+                raise ValueError(
+                    f"{self.uid}: apply_fn is unset (a closure param does "
+                    f"not survive save/load; re-set it after loading)")
+            compute_dt = jnp.dtype(self.compute_dtype)
+            fetch = dict(self.fetch_dict)
+
+            def run(params, feeds):
+                feeds = {k: (v.astype(compute_dt)
+                             if jnp.issubdtype(v.dtype, jnp.floating)
+                             and v.dtype != compute_dt else v)
+                         for k, v in feeds.items()}
+                out = fn(params, feeds)
+                if not isinstance(out, dict):
+                    out = {"output": out}
+                if fetch:
+                    return {col: out[name] for col, name in fetch.items()}
+                return out
+
+            self._jitted = jax.jit(run)
+        return self._jitted
+
+    def _params_for_device(self, device):
+        key = id(device) if device is not None else None
+        with self._params_lock:
+            if key not in self._device_params:
+                params = self.get_or_none("model_params")
+                # f32 over the wire, compute_dtype cast on device (narrow
+                # host buffers hit a slow transfer path; see ONNXModel)
+                params = (jax.device_put(params, device)
+                          if device is not None else jax.device_put(params))
+                if self.compute_dtype != "float32" and params is not None:
+                    dt = jnp.dtype(self.compute_dtype)
+                    cast = jax.jit(lambda p: jax.tree_util.tree_map(
+                        lambda v: (v.astype(dt)
+                                   if jnp.issubdtype(v.dtype, jnp.floating)
+                                   else v), p))
+                    params = cast(params)
+                self._device_params[key] = params
+            return self._device_params[key]
+
+    # -- execution ----------------------------------------------------------
+    def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
+        jitted = self._ensure_jitted()
+        feed = dict(self.feed_dict) or {"input": part.columns[0]}
+        device = device_for_partition(pidx) if self.pin_devices else None
+        params = self._params_for_device(device)
+
+        n = len(part)
+        pending = []
+        for sl in batch_slices(n, self.mini_batch_size):
+            feeds = {}
+            b = 0
+            for feed_name, col_name in feed.items():
+                col = part[col_name][sl]
+                if col.dtype == object:
+                    col = np.stack([np.asarray(v) for v in col])
+                arr = np.asarray(col)
+                if arr.dtype == np.float64:
+                    arr = arr.astype(np.float32)
+                b = len(arr)
+                arr = pad_axis(arr, bucket_size(b))
+                feeds[feed_name] = (jax.device_put(arr, device)
+                                    if device is not None
+                                    else jax.device_put(arr))
+            pending.append((jitted(params, feeds), b))
+
+        if not pending:
+            return part
+        out_cols = list(pending[0][0])
+        out = part
+        for col_name in out_cols:
+            chunks = [np.asarray(outs[col_name])[:b] for outs, b in pending]
+            arr = np.concatenate(chunks)
+            if arr.dtype == jnp.bfloat16:
+                arr = arr.astype(np.float32)
+            out = out.with_column(col_name, arr)
+        return out
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self._ensure_jitted()
+        return df.map_partitions(self._run_batches)
+
+    # -- persistence --------------------------------------------------------
+    def _load_extra(self, path: str) -> None:
+        self._jitted = None
+        self._device_params = {}
+        self._params_lock = threading.Lock()

@@ -103,6 +103,14 @@ class ONNXModel(Model):
         transpose = dict(self.transpose_dict)
         float_inputs = {vi.name for vi in cm.inputs
                         if np.issubdtype(vi.numpy_dtype, np.floating)}
+        bad_norm = set(normalize) - float_inputs
+        if bad_norm:
+            # normalizing an integer-typed model input would silently zero it
+            # (e.g. uint8 * 1/255 truncates); the uint8-image case is a float
+            # model input fed an int column, which is fine
+            raise ValueError(
+                f"normalize_dict targets non-float model inputs {sorted(bad_norm)}; "
+                f"normalization requires a float-typed graph input")
         compute_dt = jnp.dtype(self.compute_dtype)
         sig = (tuple(sorted(fetch.items())), tuple(sorted(softmax.items())),
                tuple(sorted(argmax.items())),

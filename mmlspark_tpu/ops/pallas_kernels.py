@@ -90,17 +90,34 @@ def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad,
             out_ref[0, :, sl] += contrib
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("n_nodes", "n_bins", "row_block",
-                                    "interpret", "combined_limit"))
 def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
-                           n_bins: int, row_block: int = 512,
+                           n_bins: int, row_block: int = 0,
                            interpret: bool = False,
                            combined_limit: int = 6 * 1024 * 1024):
     """Drop-in for the segment-sum histogram: returns (n_nodes, F, B, 3).
 
     xb (n, F) int bins; node_rel (n,) int32; g/h/w_count (n,) float32.
+    ``row_block=0`` picks the largest block that keeps the fused
+    single-matmul path inside the VMEM budget (the per-node unrolled
+    fallback is ~MXU-starved once n_nodes grows).
     """
+    if row_block == 0:
+        bpad = _round_up(max(n_bins, _LANE), _LANE)
+        fused_max = combined_limit // (n_nodes * bpad * 4)
+        row_block = max(_LANE, min(512, (fused_max // _LANE) * _LANE))
+    return _level_histogram_pallas(xb, node_rel, g, h, w_count,
+                                   n_nodes=n_nodes, n_bins=n_bins,
+                                   row_block=row_block, interpret=interpret,
+                                   combined_limit=combined_limit)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "row_block",
+                                    "interpret", "combined_limit"))
+def _level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
+                            n_bins: int, row_block: int,
+                            interpret: bool,
+                            combined_limit: int):
     from jax.experimental import pallas as pl
 
     n, F = xb.shape

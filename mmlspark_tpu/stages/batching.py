@@ -29,7 +29,7 @@ from typing import Dict, Iterable, Iterator, List, Optional
 import numpy as np
 
 from ..core.dataframe import DataFrame, concat
-from ..core.params import Param, Params
+from ..core.params import Param, Params, identity
 from ..core.pipeline import Transformer
 
 __all__ = ["FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
@@ -96,16 +96,46 @@ class DynamicMiniBatchTransformer(_MiniBatchBase):
 class TimeIntervalMiniBatchTransformer(_MiniBatchBase):
     """Reference: ``TimeIntervalMiniBatchTransformer`` (MiniBatchTransformer.scala:77).
 
-    On a materialized DataFrame the wall-clock interval degenerates to one
-    batch per partition; the interval semantics matter on streams — use
-    :class:`TimeIntervalBatcher` for those.
+    The reference's batcher groups rows by *arrival* wall-clock windows. On a
+    materialized DataFrame arrival time is gone, so windows come from an
+    event-time column instead: set ``timestamp_col`` (epoch millis, epoch
+    seconds as float, or datetime64) and each batch covers rows whose
+    timestamps fall within ``millis_to_wait`` of the batch's first row, in
+    row order. Without a ``timestamp_col`` the interval degenerates to one
+    batch per partition (the wall-clock semantics live on streams — use
+    :class:`TimeIntervalBatcher` for those).
     """
 
     millis_to_wait = Param(int, default=1000, doc="batch window in milliseconds")
     max_batch_size = Param(int, default=1 << 30, doc="upper bound on batch size")
+    timestamp_col = Param(str, default=None, converter=identity,
+                          doc="event-time column defining the windows "
+                              "(epoch millis, epoch seconds, or datetime64)")
+
+    @staticmethod
+    def _to_millis(col: np.ndarray) -> np.ndarray:
+        arr = np.asarray(col)
+        if np.issubdtype(arr.dtype, np.datetime64):
+            return arr.astype("datetime64[ms]").astype(np.int64)
+        if np.issubdtype(arr.dtype, np.floating):
+            return (arr * 1000.0).astype(np.int64)  # epoch seconds
+        return arr.astype(np.int64)                 # epoch millis
 
     def _slices(self, part: DataFrame) -> List[slice]:
-        return batch_slices(len(part), min(self.max_batch_size, max(1, len(part))))
+        cap = min(self.max_batch_size, max(1, len(part)))
+        ts_col = self.get_or_none("timestamp_col")
+        if not ts_col:
+            return batch_slices(len(part), cap)
+        ts = self._to_millis(part[ts_col])
+        window = int(self.millis_to_wait)
+        slices: List[slice] = []
+        start = 0
+        for i in range(1, len(ts) + 1):
+            if i == len(ts) or ts[i] - ts[start] >= window \
+                    or i - start >= cap:
+                slices.append(slice(start, i))
+                start = i
+        return slices
 
 
 class FlattenBatch(Transformer):

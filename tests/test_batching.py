@@ -104,3 +104,52 @@ class TestMesh:
         d0 = device_for_partition(0)
         d8 = device_for_partition(8)
         assert d0 == d8
+
+
+class TestTimeIntervalMiniBatchTransformer:
+    """Event-time windows on materialized frames (the stage-API half of
+    ``TimeIntervalMiniBatchTransformer``, MiniBatchTransformer.scala:77)."""
+
+    def _frame(self, ts):
+        import numpy as np
+        from mmlspark_tpu.core import DataFrame
+        return DataFrame({"t": np.asarray(ts),
+                          "v": np.arange(len(ts), dtype=np.float32)})
+
+    def test_event_time_windows_epoch_millis(self):
+        import numpy as np
+        from mmlspark_tpu.stages.batching import TimeIntervalMiniBatchTransformer
+        # windows of 100ms: [0,50,99] [100,180] [350]
+        df = self._frame(np.array([0, 50, 99, 100, 180, 350], dtype=np.int64))
+        t = TimeIntervalMiniBatchTransformer(millis_to_wait=100,
+                                             timestamp_col="t")
+        out = t.transform(df)
+        assert [len(c) for c in out["v"]] == [3, 2, 1]
+        np.testing.assert_array_equal(out["v"][0], [0, 1, 2])
+
+    def test_event_time_windows_datetime64(self):
+        import numpy as np
+        from mmlspark_tpu.stages.batching import TimeIntervalMiniBatchTransformer
+        base = np.datetime64("2026-01-01T00:00:00", "ms")
+        ts = base + np.array([0, 10, 2000, 2500], dtype="timedelta64[ms]")
+        t = TimeIntervalMiniBatchTransformer(millis_to_wait=1000,
+                                             timestamp_col="t")
+        out = t.transform(self._frame(ts))
+        assert [len(c) for c in out["v"]] == [2, 2]
+
+    def test_max_batch_size_caps_window(self):
+        import numpy as np
+        from mmlspark_tpu.stages.batching import TimeIntervalMiniBatchTransformer
+        df = self._frame(np.zeros(5, dtype=np.int64))  # all same instant
+        t = TimeIntervalMiniBatchTransformer(millis_to_wait=1000,
+                                             timestamp_col="t",
+                                             max_batch_size=2)
+        out = t.transform(df)
+        assert [len(c) for c in out["v"]] == [2, 2, 1]
+
+    def test_without_timestamp_col_one_batch(self):
+        import numpy as np
+        from mmlspark_tpu.stages.batching import TimeIntervalMiniBatchTransformer
+        df = self._frame(np.arange(4, dtype=np.int64))
+        out = TimeIntervalMiniBatchTransformer().transform(df)
+        assert len(out) == 1 and len(out["v"][0]) == 4

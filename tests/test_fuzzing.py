@@ -528,6 +528,11 @@ def _registry():
                 SG.AddressGeocoder, SG.ReverseAddressGeocoder,
                 SG.CheckPointInPolygon, STr.DocumentTranslator):
         R[cls] = _svc(cls)
+    # streaming speech: experiment-fuzzed against a live fake ASR server in
+    # test_speech_streaming; serialization-only here (url is ws://)
+    from mmlspark_tpu.services.speech_streaming import SpeechToTextStreaming
+    R[SpeechToTextStreaming] = lambda: TestObject(
+        SpeechToTextStreaming(url="ws://localhost:1/x"), experiment=False)
     return R
 
 

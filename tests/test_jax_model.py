@@ -1,0 +1,126 @@
+"""JaxModel — the generic non-ONNX model path (parity: CNTKModel,
+``deep-learning/.../cntk/CNTKModel.scala:250-330``, feed/fetch + coercion
+``:387-434``). The CNTK format itself is deliberately subsumed: legacy graphs
+convert to ONNX; native models are JAX callables run by this stage."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.models.jax_model import JaxModel
+
+
+def linear_apply(params, feeds):
+    """Module-level so save/load can persist it by import path."""
+    import jax.numpy as jnp
+    x = feeds["input"]
+    h = jnp.maximum(x @ params["w1"] + params["b1"], 0.0)
+    return {"logits": h @ params["w2"] + params["b2"],
+            "hidden": h}
+
+
+def _params(seed=0, din=6, dh=8, dout=3):
+    rng = np.random.default_rng(seed)
+    return {"w1": rng.normal(0, 0.5, (din, dh)).astype(np.float32),
+            "b1": np.zeros(dh, dtype=np.float32),
+            "w2": rng.normal(0, 0.5, (dh, dout)).astype(np.float32),
+            "b2": np.zeros(dout, dtype=np.float32)}
+
+
+def _df(n=11, din=6, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, din)).astype(np.float32)
+    return DataFrame({"feats": [X[i] for i in range(n)]}, npartitions=2), X
+
+
+def _ref(params, X):
+    h = np.maximum(X @ params["w1"] + params["b1"], 0)
+    return h @ params["w2"] + params["b2"]
+
+
+class TestJaxModel:
+    def test_transform_dict_outputs(self):
+        params = _params()
+        m = JaxModel(linear_apply, params, feed_dict={"input": "feats"},
+                     mini_batch_size=4, pin_devices=False)
+        df, X = _df()
+        out = m.transform(df)
+        np.testing.assert_allclose(np.stack(list(out["logits"])),
+                                   _ref(params, X), rtol=1e-5, atol=1e-5)
+        assert "hidden" in out.columns
+
+    def test_fetch_dict_selects_and_renames(self):
+        params = _params()
+        m = JaxModel(linear_apply, params, feed_dict={"input": "feats"},
+                     fetch_dict={"score": "logits"},
+                     mini_batch_size=4, pin_devices=False)
+        df, X = _df()
+        out = m.transform(df)
+        assert "score" in out.columns and "hidden" not in out.columns
+
+    def test_single_array_output(self):
+        m = JaxModel(lambda p, f: f["input"] * 2.0, None,
+                     feed_dict={"input": "x"}, pin_devices=False)
+        df = DataFrame({"x": np.arange(5, dtype=np.float32)})
+        out = m.transform(df)
+        np.testing.assert_allclose(out["output"],
+                                   np.arange(5, dtype=np.float32) * 2)
+
+    def test_bfloat16_compute(self):
+        params = _params()
+        m = JaxModel(linear_apply, params, feed_dict={"input": "feats"},
+                     compute_dtype="bfloat16", mini_batch_size=4,
+                     pin_devices=False)
+        df, X = _df()
+        out = m.transform(df)
+        got = np.stack(list(out["logits"]))
+        assert got.dtype == np.float32  # bf16 widened at the host boundary
+        np.testing.assert_allclose(got, _ref(params, X), rtol=0.05, atol=0.05)
+
+    def test_save_load_roundtrip_by_import_path(self, tmp_path):
+        params = _params()
+        m = JaxModel(linear_apply, params, feed_dict={"input": "feats"},
+                     fetch_dict={"score": "logits"}, mini_batch_size=4,
+                     pin_devices=False)
+        df, X = _df()
+        expect = np.stack(list(m.transform(df)["score"]))
+        path = str(tmp_path / "jm")
+        m.save(path)
+        m2 = PipelineStage.load(path)
+        assert m2.apply_fn is linear_apply  # resolved by import path
+        got = np.stack(list(m2.transform(df)["score"]))
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    def test_closure_is_transient_with_clear_error(self, tmp_path):
+        m = JaxModel(lambda p, f: f["input"], None,
+                     feed_dict={"input": "x"}, pin_devices=False)
+        path = str(tmp_path / "jm")
+        m.save(path)
+        m2 = PipelineStage.load(path)
+        df = DataFrame({"x": np.arange(3, dtype=np.float32)})
+        with pytest.raises(ValueError, match="apply_fn is unset"):
+            m2.transform(df)
+        m2.set(apply_fn=lambda p, f: f["input"])
+        assert len(m2.transform(df)) == 3
+
+    def test_zoo_resnet_features(self):
+        """The transfer-learning path: zoo network as a JaxModel."""
+        from mmlspark_tpu.models.zoo.resnet import (RESNET18_CFG,
+                                                    init_resnet,
+                                                    resnet_apply)
+        params = init_resnet(RESNET18_CFG, seed=0)
+
+        def apply(p, feeds):
+            return {"features": resnet_apply(p, feeds["image"], RESNET18_CFG,
+                                             features_only=True)}
+
+        rng = np.random.default_rng(0)
+        imgs = rng.normal(0, 1, (3, 32, 32, 3)).astype(np.float32)
+        df = DataFrame({"image": [imgs[i] for i in range(3)]})
+        m = JaxModel(apply, params, feed_dict={"image": "image"},
+                     mini_batch_size=2, pin_devices=False)
+        out = m.transform(df)
+        feats = np.stack(list(out["features"]))
+        assert feats.shape[0] == 3 and feats.ndim == 2
+        assert np.isfinite(feats).all()

@@ -1,0 +1,212 @@
+"""Streaming speech tests: websocket transport, audio streams, and the
+continuous-recognition session/stage against an in-process fake ASR server
+(parity: ``SpeechToTextSDK.scala:579`` + ``AudioStreams.scala:94``)."""
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.ws import (OP_BINARY, OP_CLOSE, OP_TEXT, client_connect,
+                                server_handshake)
+from mmlspark_tpu.services.audio import (AudioFormat, PullAudioStream,
+                                         PushAudioStream, parse_wav)
+from mmlspark_tpu.services.speech_streaming import (SpeechRecognitionSession,
+                                                    SpeechToTextStreaming)
+
+
+# ---------------------------------------------------------------------------
+# fake streaming ASR server: emits a hypothesis per frame and a final phrase
+# per 4 frames (and at end-of-audio)
+# ---------------------------------------------------------------------------
+
+def _fake_asr_server():
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+
+    def handle(conn_sock):
+        head = b""
+        while b"\r\n\r\n" not in head:
+            chunk = conn_sock.recv(4096)
+            if not chunk:
+                return
+            head += chunk
+        ws, _path = server_handshake(conn_sock, head)
+        frames, utt, offset = 0, 0, 0
+        cfg = None
+        while True:
+            opcode, payload = ws.recv()
+            if opcode == OP_CLOSE:
+                return
+            if opcode == OP_TEXT:
+                msg = json.loads(payload.decode())
+                if msg["type"] == "speech.config":
+                    cfg = msg["format"]
+                elif msg["type"] == "audio.end":
+                    if frames % 4:
+                        ws.send_text(json.dumps(
+                            {"type": "speech.phrase",
+                             "text": f"utterance {utt}",
+                             "offset": offset, "duration": frames % 4}))
+                    ws.send_text(json.dumps({"type": "speech.end",
+                                             "config_seen": cfg is not None}))
+                    return
+            elif opcode == OP_BINARY:
+                frames += 1
+                ws.send_text(json.dumps({"type": "speech.hypothesis",
+                                         "text": f"hyp {frames}"}))
+                if frames % 4 == 0:
+                    ws.send_text(json.dumps(
+                        {"type": "speech.phrase", "text": f"utterance {utt}",
+                         "offset": offset, "duration": 4}))
+                    utt += 1
+                    offset = frames
+
+    def loop():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(c,), daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, port
+
+
+@pytest.fixture(scope="module")
+def asr():
+    srv, port = _fake_asr_server()
+    yield f"ws://127.0.0.1:{port}/stt"
+    srv.close()
+
+
+def _wav(n_samples=32000, rate=16000):  # 2s at 16kHz → 20 100ms frames
+    pcm = (np.sin(np.linspace(0, 100, n_samples)) * 3000).astype("<i2")
+    body = pcm.tobytes()
+    fmt = struct.pack("<HHIIHH", 1, 1, rate, rate * 2, 2, 16)
+    chunks = b"fmt " + struct.pack("<I", len(fmt)) + fmt \
+        + b"data" + struct.pack("<I", len(body)) + body
+    return b"RIFF" + struct.pack("<I", 4 + len(chunks)) + b"WAVE" + chunks
+
+
+class TestAudio:
+    def test_parse_wav_roundtrip(self):
+        fmt, payload = parse_wav(_wav())
+        assert fmt == AudioFormat(16000, 16, 1)
+        assert len(payload) == 64000  # 2s of 16-bit mono
+
+    def test_parse_wav_rejects_non_pcm(self):
+        bad = _wav()
+        # codec field (2 bytes at fmt body start) → 7 (mu-law)
+        i = bad.index(b"fmt ") + 8
+        bad = bad[:i] + struct.pack("<H", 7) + bad[i + 2:]
+        with pytest.raises(ValueError, match="codec"):
+            parse_wav(bad)
+
+    def test_push_stream_blocks_until_close(self):
+        s = PushAudioStream()
+        got = []
+        t = threading.Thread(target=lambda: got.append(s.read(4, timeout=5)))
+        t.start()
+        s.write(b"abcd")
+        t.join(5)
+        assert got == [b"abcd"]
+        s.close()
+        assert s.read(4) == b""
+
+    def test_frame_bytes_sample_aligned(self):
+        fmt = AudioFormat(16000, 16, 2)  # 4 bytes per sample step
+        assert fmt.frame_bytes(100) % 4 == 0
+
+
+class TestWebSocket:
+    def test_echo_roundtrip(self, asr):
+        # large (>64KB) frame exercises the 64-bit length path
+        from urllib.parse import urlparse
+        u = urlparse(asr)
+        ws = client_connect(u.hostname, u.port, u.path)
+        ws.send_text(json.dumps({"type": "speech.config", "format": {}}))
+        ws.send_binary(b"x" * 70000)
+        op, payload = ws.recv()
+        assert op == OP_TEXT
+        assert json.loads(payload)["type"] == "speech.hypothesis"
+        ws.close()
+
+
+class TestSession:
+    def test_continuous_recognition_phrases_and_interims(self, asr):
+        fmt, payload = parse_wav(_wav())
+        interims = []
+        sess = SpeechRecognitionSession(
+            asr, frame_millis=100,
+            recognizing=lambda e: interims.append(e["text"]))
+        phrases = sess.run(PullAudioStream(payload, fmt))
+        # 2s of audio at 100ms frames = 20 frames → 5 phrases
+        assert [p["text"] for p in phrases] == [f"utterance {i}"
+                                                for i in range(5)]
+        assert len(interims) == 20
+        assert phrases[1]["offset"] == 4
+
+    def test_push_stream_live(self, asr):
+        fmt = AudioFormat()
+        stream = PushAudioStream(fmt)
+        sess = SpeechRecognitionSession(asr, frame_millis=100)
+        out = []
+        t = threading.Thread(target=lambda: out.append(sess.run(stream)))
+        t.start()
+        frame = fmt.frame_bytes(100)
+        for _ in range(8):
+            stream.write(b"\0" * frame)
+        stream.close()
+        t.join(15)
+        assert len(out) == 1 and len(out[0]) == 2  # 8 frames → 2 phrases
+
+
+class TestStage:
+    def test_transform_rows(self, asr):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        wav = _wav()
+        col = object_col([wav, None, wav])
+        df = DataFrame({"audio": col})
+        t = (SpeechToTextStreaming(url=asr, output_col="utts",
+                                   error_col="err", interim_col="hyps")
+             .set_vector_param("audio_data", "audio"))
+        out = t.transform(df)
+        assert [p["text"] for p in out["utts"][0]] == \
+            [f"utterance {i}" for i in range(5)]
+        assert out["utts"][1] is None
+        assert len(out["hyps"][2]) == 20
+        assert out["err"][0] is None
+
+    def test_transform_error_column(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        df = DataFrame({"audio": object_col([_wav()])})
+        t = (SpeechToTextStreaming(url="ws://127.0.0.1:9/none",
+                                   output_col="utts", error_col="err",
+                                   timeout=2)
+             .set_vector_param("audio_data", "audio"))
+        out = t.transform(df)
+        assert out["utts"][0] is None
+        assert "error" in out["err"][0]
+
+    def test_transform_concurrent_sessions(self, asr):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        wav = _wav()
+        df = DataFrame({"audio": object_col([wav] * 6)})
+        t = (SpeechToTextStreaming(url=asr, output_col="utts",
+                                   error_col="err", concurrency=3)
+             .set_vector_param("audio_data", "audio"))
+        out = t.transform(df)
+        for i in range(6):
+            assert [p["text"] for p in out["utts"][i]] == \
+                [f"utterance {k}" for k in range(5)]
