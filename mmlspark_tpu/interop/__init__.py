@@ -1,0 +1,136 @@
+"""Front-end interop: run fitted stages inside pandas and PySpark pipelines.
+
+The reference's user surface *is* Spark — every stage is a Spark ML
+``Transformer`` reached through generated PySpark wrappers
+(``codegen/Wrappable.scala:68-180``), so ``DataFrame.transform`` composes
+natively. This framework's pipelines are TPU-resident; interop goes the
+other way: wrap a fitted stage so host dataframe ecosystems can call it.
+
+* :func:`transform_pandas` / :func:`fit_pandas` — pandas in, pandas out.
+* :func:`make_pandas_udf_fn` — a plain ``pd.DataFrame -> pd.DataFrame``
+  closure suitable for ``pyspark.sql.functions.pandas_udf`` /
+  ``DataFrame.mapInPandas`` / ``groupBy().applyInPandas``; the stage's
+  model state is captured once and shipped to executors by closure
+  serialization (the moral of the reference's broadcast-payload pattern,
+  ``ONNXModel.scala:471-497``).
+* :func:`spark_transform` — convenience: ``spark_df.mapInPandas`` wiring
+  when pyspark is importable (gated; pyspark is not a dependency).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Transformer
+
+__all__ = ["transform_pandas", "fit_pandas", "make_pandas_udf_fn",
+           "spark_transform", "spark_schema_for"]
+
+
+def transform_pandas(stage: Transformer, pdf, npartitions: int = 1):
+    """pandas DataFrame → stage.transform → pandas DataFrame."""
+    out = stage.transform(DataFrame.from_pandas(pdf, npartitions))
+    return out.to_pandas()
+
+
+def fit_pandas(estimator: Estimator, pdf, params: Optional[dict] = None,
+               npartitions: int = 1):
+    """Fit an estimator on a pandas DataFrame; returns the fitted Model."""
+    return estimator.fit(DataFrame.from_pandas(pdf, npartitions), params)
+
+
+def make_pandas_udf_fn(stage: Transformer, output_cols=None):
+    """A ``pd.DataFrame -> pd.DataFrame`` function closing over the stage.
+
+    Works as the body of ``mapInPandas`` (iterator variant handled by
+    :func:`spark_transform`) or ``applyInPandas``. ``output_cols`` limits
+    the returned columns (Spark needs a declared schema; see
+    :func:`spark_schema_for`).
+    """
+    def apply_fn(pdf):
+        out = transform_pandas(stage, pdf)
+        return out[list(output_cols)] if output_cols else out
+
+    return apply_fn
+
+
+def _batch_iter_fn(stage: Transformer, output_cols=None):
+    def map_batches(batches: Iterable):
+        for pdf in batches:
+            yield make_pandas_udf_fn(stage, output_cols)(pdf)
+
+    return map_batches
+
+
+def spark_schema_for(stage: Transformer, sample_pdf, output_cols=None):
+    """Infer the output Spark schema by running the stage on a small pandas
+    sample (the reference reads model metadata for this,
+    ``ONNXModel.scala:606-653``; here a probe row is exact and cheap)."""
+    from pyspark.sql.types import (ArrayType, BooleanType, DoubleType,
+                                   FloatType, LongType, StringType,
+                                   StructField, StructType)
+    import numpy as np
+
+    out = transform_pandas(stage, sample_pdf)
+    if output_cols:
+        out = out[list(output_cols)]
+
+    def field_for(name, dtype, sample):
+        if np.issubdtype(dtype, np.bool_):
+            return StructField(name, BooleanType())
+        if np.issubdtype(dtype, np.integer):
+            return StructField(name, LongType())
+        if np.issubdtype(dtype, np.float32):
+            return StructField(name, FloatType())
+        if np.issubdtype(dtype, np.floating):
+            return StructField(name, DoubleType())
+        if isinstance(sample, np.ndarray):
+            elem = (FloatType() if sample.dtype == np.float32
+                    else DoubleType() if np.issubdtype(sample.dtype,
+                                                       np.floating)
+                    else LongType())
+            t = ArrayType(elem)
+            for _ in range(sample.ndim - 1):
+                t = ArrayType(t)
+            return StructField(name, t)
+        return StructField(name, StringType())
+
+    fields = []
+    for name in out.columns:
+        col = out[name]
+        sample = col.iloc[0] if len(col) else None
+        fields.append(field_for(name, col.dtype, sample))
+    return StructType(fields)
+
+
+def spark_transform(stage: Transformer, spark_df, output_cols=None,
+                    schema=None, sample_pdf=None):
+    """Run a fitted stage over a **PySpark** DataFrame via ``mapInPandas``.
+
+    ``schema`` (a StructType or DDL string) or ``sample_pdf`` (to infer it)
+    must be provided. Gated on pyspark being importable — pyspark is an
+    optional peer, not a dependency.
+    """
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "spark_transform requires pyspark on the driver; install it or "
+            "use transform_pandas/make_pandas_udf_fn directly") from e
+    if schema is None:
+        if sample_pdf is None:
+            raise ValueError("provide schema= or sample_pdf= to infer it")
+        schema = spark_schema_for(stage, sample_pdf, output_cols)
+    # ndarray cells must become lists for Spark's arrow conversion
+    base = _batch_iter_fn(stage, output_cols)
+
+    def map_batches(batches):
+        import numpy as np
+        for out in base(batches):
+            for c in out.columns:
+                if len(out) and isinstance(out[c].iloc[0], np.ndarray):
+                    out[c] = out[c].map(lambda a: a.tolist())
+            yield out
+
+    return spark_df.mapInPandas(map_batches, schema=schema)

@@ -35,10 +35,10 @@ from urllib.parse import urlparse
 
 import numpy as np
 
-from ..core.dataframe import DataFrame, object_col
+from ..core.dataframe import DataFrame
 from ..core.params import Param, identity
-from ..io.ws import OP_BINARY, OP_CLOSE, OP_TEXT, client_connect
-from .audio import AudioFormat, PullAudioStream, parse_wav
+from ..io.ws import OP_CLOSE, OP_TEXT, client_connect
+from .audio import AudioFormat, PullAudioStream
 from .base import ServiceParam, ServiceTransformer
 
 __all__ = ["SpeechRecognitionSession", "SpeechToTextStreaming"]
@@ -94,7 +94,7 @@ class SpeechRecognitionSession:
             receiver.start()
 
             frame = fmt.frame_bytes(self.frame_millis)
-            while True:
+            while not done.is_set():  # a terminal event stops the pump
                 chunk = stream.read(frame, timeout=self.timeout)
                 if not chunk:
                     break
@@ -126,8 +126,12 @@ class SpeechRecognitionSession:
                     if self.recognized:
                         self.recognized(evt)
                 elif kind == "speech.error":
+                    # terminal: stop listening so run() reports this error
+                    # instead of pumping audio into a dead session until a
+                    # timeout masks it
                     self._error = RuntimeError(
                         evt.get("message", "speech service error"))
+                    break
                 elif kind == "speech.end":
                     break
         except Exception as e:  # surfaced to run()
@@ -173,9 +177,12 @@ class SpeechToTextStreaming(ServiceTransformer):
             hyp: List[str] = []
             try:
                 raw = bytes(a)
-                try:
+                if raw[:4] == b"RIFF":
+                    # a real WAV: parse errors (non-PCM codec, truncated
+                    # chunks) must surface, not degrade into streaming the
+                    # container bytes as PCM noise
                     stream = PullAudioStream.from_wav(raw)
-                except ValueError:
+                else:
                     stream = PullAudioStream(raw)  # raw PCM, default format
                 sess = SpeechRecognitionSession(
                     url, headers=headers,

@@ -98,6 +98,8 @@ class DriverRegistry:
         self._generation = 0
         self.liveness_timeout = liveness_timeout
         self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
+        # keep-alive handler threads must not block process exit
+        self._httpd.daemon_threads = True
         self._httpd.registry = self  # type: ignore[attr-defined]
         self.host, self.port = host, self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -168,7 +170,8 @@ class DistributedWorker:
     def __init__(self, driver_url: str, worker_id: str,
                  host: str = "127.0.0.1", port: int = 0,
                  reply_timeout: float = 60.0,
-                 heartbeat_interval: float = 10.0):
+                 heartbeat_interval: float = 10.0,
+                 advertise_host: str = ""):
         self.driver_url = driver_url
         self.worker_id = worker_id
         self.server = WorkerServer(host=host, port=port,
@@ -178,9 +181,18 @@ class DistributedWorker:
         self._peers: Dict[str, str] = {}
         self._rr = 0
         self._lock = threading.Lock()
+        # the registered address must be PEER-routable: a 0.0.0.0 bind
+        # address handed to peers would make them connect to themselves
+        # (and /_forward always serves locally, so the wrong worker answers)
+        if not advertise_host and host in ("0.0.0.0", "::"):
+            import socket as _socket
+            advertise_host = _socket.gethostbyname(_socket.gethostname())
+        advertised = (f"http://{advertise_host}:{self.server.port}"
+                      if advertise_host else self.server.address.rstrip("/"))
+        self.advertised_address = advertised.rstrip("/")
         info = _http_json(driver_url + "/register",
                           {"worker_id": worker_id,
-                           "address": self.server.address.rstrip("/")})
+                           "address": self.advertised_address})
         self.generation = info["generation"]
         self.recovered = info["recovered"]
         self._peers = {w: a for w, a in info["peers"].items()

@@ -138,6 +138,8 @@ class WorkerServer:
         self._epoch = 0
         self._lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        # keep-alive handler threads must not block process exit
+        self._httpd.daemon_threads = True
         self._httpd.worker_server = self  # type: ignore[attr-defined]
         self.host = host
         self.port = self._httpd.server_address[1]

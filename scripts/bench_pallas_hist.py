@@ -17,40 +17,56 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def time_fn(fn, xb, node, gs, h, w, **kw):
-    """Loop-slope timing robust to the tunnel's async quirks.
+def time_fn(fn, xb, node, g, h, w, **kw):
+    """Dependency-chained timing robust to the tunnel's async quirks.
 
-    The remote runtime's dispatch/sync costs a large, variable constant
-    (~70ms round-trip; completion signals for fast programs are unreliable).
-    So: run the builder L times *inside one jit* with a sequential data
-    dependency (iteration i's gradients depend on iteration i-1's
-    histogram), fetch one scalar, and report the slope between two loop
-    lengths — constants cancel, elision is impossible.
+    The remote runtime's completion signals are unreliable for
+    block_until_ready (fast programs report ~0ms), and per-call sync costs
+    a ~70ms round-trip. So: dispatch L builder calls where call i+1's
+    gradients data-depend on call i's histogram (no elision, strictly
+    sequential on device), then force ONE scalar fetch that depends on the
+    last call — the fetch cannot complete before all L executions have.
     """
     import jax
     import jax.numpy as jnp
-    from functools import partial
 
-    g0 = gs[0]
+    bump = jax.jit(lambda g, hist: g + hist[0, 0, 0, 0] * 1e-30)
+    tail = jax.jit(lambda hist: jnp.sum(hist[0, 0, :2, 0]))
 
-    @partial(jax.jit, static_argnames=("length",))
-    def loop(xb, node, g0, h, w, length):
-        def body(_, carry):
-            acc, gseq = carry
-            hist = fn(xb, node, gseq, h, w, **kw)
-            bump = hist[0, 0, 0, 0] * 1e-30
-            return acc + bump, gseq + bump
-        acc, _ = jax.lax.fori_loop(0, length, body, (jnp.float32(0.0), g0))
-        return acc
+    def chain(length):
+        gc = g
+        hist = None
+        for _ in range(length):
+            hist = fn(xb, node, gc, h, w, **kw)
+            gc = bump(gc, hist)
+        return float(tail(hist))
 
-    def timed(length):
-        float(loop(xb, node, g0, h, w, length=length))  # compile
-        t0 = time.perf_counter()
-        float(loop(xb, node, g0, h, w, length=length))  # scalar fetch syncs
-        return time.perf_counter() - t0
+    L = 6
+    chain(1)  # compile everything
+    t0 = time.perf_counter()
+    chain(L)
+    total = time.perf_counter() - t0
+    return max((total - _rtt_baseline()) / L, 1e-9)
 
-    t_short, t_long = timed(2), timed(10)
-    return max((t_long - t_short) / 8, 1e-9)
+
+_RTT = [None]
+
+
+def _rtt_baseline():
+    """Dispatch+fetch cost of a trivial program — the tunnel constant to
+    subtract from loop timings."""
+    if _RTT[0] is None:
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: x + 1.0)
+        float(f(jnp.float32(0.0)))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f(jnp.float32(1.0)))
+            ts.append(time.perf_counter() - t0)
+        _RTT[0] = min(ts)
+    return _RTT[0]
 
 
 def segment_sum_hist(xb, node_rel, g, h, w, n_nodes, n_bins):
@@ -86,9 +102,7 @@ def main():
                                   (4_000_000, 28, 8, 255)]:
         xb = jnp.asarray(rng.integers(0, n_bins, (n, F), dtype=np.int32))
         node = jnp.asarray(rng.integers(0, n_nodes, n, dtype=np.int32))
-        g_host = rng.normal(size=n).astype(np.float32)
-        gs = [jnp.asarray(g_host + i * 1e-7) for i in range(4)]
-        g = gs[0]
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
         h = jnp.asarray(np.abs(rng.normal(size=n)).astype(np.float32))
         w = jnp.ones(n, dtype=jnp.float32)
 
@@ -96,7 +110,7 @@ def main():
                "n": n, "features": F, "nodes": n_nodes, "bins": n_bins,
                "platform": backend}
         try:
-            t_pal = time_fn(level_histogram_pallas, xb, node, gs, h, w,
+            t_pal = time_fn(level_histogram_pallas, xb, node, g, h, w,
                             n_nodes=n_nodes, n_bins=n_bins,
                             interpret=not on_tpu)
             rec["pallas_ms"] = round(t_pal * 1e3, 2)
@@ -104,7 +118,7 @@ def main():
             rec["pallas_error"] = str(e).splitlines()[0][:120]
             t_pal = None
         try:
-            t_seg = time_fn(seg_jit, xb, node, gs, h, w,
+            t_seg = time_fn(seg_jit, xb, node, g, h, w,
                             n_nodes=n_nodes, n_bins=n_bins)
             rec["segment_sum_ms"] = round(t_seg * 1e3, 2)
         except Exception as e:

@@ -1,0 +1,68 @@
+"""Run every ```python code block in docs/*.md and README.md.
+
+Parity: the reference tests its website code blocks with
+``website/doctest.py`` (wired via ``build.sbt:337-344``) so documentation
+cannot rot. Blocks run in one namespace per file, in order; a block marked
+with ``<!-- no-test -->`` on the preceding line is skipped.
+"""
+
+import os
+import re
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# docs examples run on CPU: deterministic, fast, no TPU claim needed
+os.environ.pop("JAX_PLATFORMS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+BLOCK_RE = re.compile(r"(<!--\s*no-test\s*-->\s*\n)?```python\n(.*?)```",
+                      re.DOTALL)
+
+
+def extract_blocks(text):
+    for m in BLOCK_RE.finditer(text):
+        yield m.group(1) is not None, m.group(2)
+
+
+def run_file(path: str) -> int:
+    with open(path) as f:
+        text = f.read()
+    ns = {"__name__": f"doctest:{os.path.basename(path)}"}
+    failures = 0
+    for i, (skip, code) in enumerate(extract_blocks(text)):
+        if skip:
+            continue
+        try:
+            exec(compile(code, f"{path}:block{i}", "exec"), ns)
+        except Exception:
+            failures += 1
+            print(f"FAIL {path} block {i}:")
+            traceback.print_exc()
+    return failures
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [os.path.join(repo, "README.md")]
+    docs = os.path.join(repo, "docs")
+    for root, _dirs, files in os.walk(docs):
+        for f in sorted(files):
+            if f.endswith(".md"):
+                targets.append(os.path.join(root, f))
+    total, failures = 0, 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n = sum(1 for s, _ in extract_blocks(open(path).read()) if not s)
+        total += n
+        failures += run_file(path)
+    print(f"doctest_docs: {total - failures}/{total} blocks passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
