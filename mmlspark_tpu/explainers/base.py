@@ -44,14 +44,18 @@ class LocalExplainer(Transformer):
         if col.dtype == object:
             vals = np.stack([np.asarray(v, dtype=np.float64).ravel()
                              for v in col])
-            bad = [t for t in targets if t >= vals.shape[1]]
-            if bad:
-                raise ValueError(
-                    f"target_classes {bad} out of range for "
-                    f"{self.get('target_col')!r} vectors of length "
-                    f"{vals.shape[1]}")
-            return vals[:, targets].sum(axis=1)
-        return col.astype(np.float64)
+        else:
+            vals = np.asarray(col, dtype=np.float64)
+            if vals.ndim == 1:
+                return vals  # already one scalar per row
+            vals = vals.reshape(len(col), -1)  # dense (n, classes) column
+        bad = [t for t in targets if t >= vals.shape[1]]
+        if bad:
+            raise ValueError(
+                f"target_classes {bad} out of range for "
+                f"{self.get('target_col')!r} vectors of length "
+                f"{vals.shape[1]}")
+        return vals[:, targets].sum(axis=1)
 
 
 def shapley_kernel_weights(masks: np.ndarray,

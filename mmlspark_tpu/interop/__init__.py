@@ -127,10 +127,16 @@ def spark_transform(stage: Transformer, spark_df, output_cols=None,
 
     def map_batches(batches):
         import numpy as np
+
+        def to_list(v):
+            return v.tolist() if isinstance(v, np.ndarray) else v
+
         for out in base(batches):
             for c in out.columns:
-                if len(out) and isinstance(out[c].iloc[0], np.ndarray):
-                    out[c] = out[c].map(lambda a: a.tolist())
+                # per-cell: a null first row must not leave later ndarray
+                # cells unconverted for arrow
+                if out[c].dtype == object:
+                    out[c] = out[c].map(to_list)
             yield out
 
     return spark_df.mapInPandas(map_batches, schema=schema)

@@ -94,16 +94,25 @@ class SpeechRecognitionSession:
             receiver.start()
 
             frame = fmt.frame_bytes(self.frame_millis)
-            while not done.is_set():  # a terminal event stops the pump
-                chunk = stream.read(frame, timeout=self.timeout)
-                if not chunk:
-                    break
-                conn.send_binary(chunk)
-            conn.send_text(json.dumps({"type": "audio.end"}))
+            send_exc = None
+            try:
+                while not done.is_set():  # a terminal event stops the pump
+                    chunk = stream.read(frame, timeout=self.timeout)
+                    if not chunk:
+                        break
+                    conn.send_binary(chunk)
+                if not done.is_set():
+                    conn.send_text(json.dumps({"type": "audio.end"}))
+            except OSError as e:
+                # a dead socket usually means the server already sent a
+                # terminal event — prefer that error over the pipe error
+                send_exc = e
             if not done.wait(self.timeout):
-                raise TimeoutError("no speech.end from server")
+                raise send_exc or TimeoutError("no speech.end from server")
             if self._error is not None:
                 raise self._error
+            if send_exc is not None:
+                raise send_exc
             return list(self.phrases)
         finally:
             conn.close()

@@ -214,7 +214,7 @@ class DistributedWorker:
                 try:
                     _http_json(self.driver_url + "/register",
                                {"worker_id": self.worker_id,
-                                "address": self.server.address.rstrip("/")})
+                                "address": self.advertised_address})
                 except Exception:
                     pass
 

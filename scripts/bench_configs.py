@@ -1,0 +1,155 @@
+"""Benches for BASELINE.json configs #2-#4: BERT embeddings, ImageFeaturizer
+transfer-learning, and explainer (repeated-inference) throughput.
+
+Each prints one JSON line. Sized by env:
+  BENCH_BERT_ROWS / BENCH_FEAT_ROWS / BENCH_SHAP_ROWS, BENCH_SCALE=small
+(small = CPU-friendly shapes for smoke tests; default = benchmark shapes).
+
+Run on the chip: ``python scripts/bench_configs.py [bert|featurizer|shap]``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMALL = os.environ.get("BENCH_SCALE", "") == "small"
+
+
+def _bench_transform(model, df, n_rows, passes=3):
+    out = model.transform(df.head(min(8, n_rows)))  # warmup/compile
+    assert len(out) > 0
+    best = float("inf")
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = model.transform(df)
+        best = min(best, time.perf_counter() - t0)
+    assert len(out) == n_rows
+    return n_rows / best
+
+
+def bench_bert():
+    """Config #3: BERT-base-shaped sentence embeddings over a token column
+    through the foreign-ONNX importer (torch-exporter-style graph)."""
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.onnx_model import ONNXModel
+    from mmlspark_tpu.models.zoo.bert_onnx import (BertOnnxConfig,
+                                                   export_bert_onnx)
+
+    if SMALL:
+        cfg = BertOnnxConfig()
+        n_rows, batch, seq = 32, 8, 64
+    else:
+        # BERT-base dimensions (vocab kept small: embedding lookup cost is
+        # row-gather, invariant to vocab beyond cache effects)
+        cfg = BertOnnxConfig(vocab=8192, layers=12, d_model=768, heads=12,
+                             d_ff=3072, max_len=128)
+        n_rows, batch, seq = 2048, 128, 128
+    n_rows = int(os.environ.get("BENCH_BERT_ROWS", n_rows))
+    rng = np.random.default_rng(0)
+    model_bytes = export_bert_onnx(cfg, seed=0)
+    m = ONNXModel(model_bytes,
+                  feed_dict={"input_ids": "ids", "attention_mask": "mask"},
+                  fetch_dict={"emb": "last_hidden_state"},
+                  mini_batch_size=batch, compute_dtype="bfloat16")
+    ids = rng.integers(0, cfg.vocab, (n_rows, seq), dtype=np.int64)
+    mask = np.ones((n_rows, seq), dtype=np.int64)
+    df = DataFrame({"ids": [r for r in ids], "mask": [r for r in mask]})
+    sps = _bench_transform(m, df, n_rows)
+    print(json.dumps({"metric": "bert_base_embeddings_seq_per_sec",
+                      "value": round(sps, 2), "unit": "sequences/sec/chip",
+                      "seq_len": seq, "layers": cfg.layers,
+                      "d_model": cfg.d_model,
+                      "platform": _platform()}), flush=True)
+
+
+def bench_featurizer():
+    """Config #4: ImageFeaturizer (ONNX backbone, cut layer) over images."""
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.featurizer import ImageFeaturizer
+    from mmlspark_tpu.models.zoo.resnet import (RESNET18_CFG, RESNET50,
+                                                export_resnet_onnx)
+
+    cfg = RESNET18_CFG if SMALL else RESNET50
+    n_rows = 16 if SMALL else 1024
+    n_rows = int(os.environ.get("BENCH_FEAT_ROWS", n_rows))
+    size = 64 if SMALL else 224
+    rng = np.random.default_rng(0)
+    feat = ImageFeaturizer(onnx_model=export_resnet_onnx(cfg, seed=0),
+                           input_col="image", output_col="features",
+                           input_size=size,
+                           mini_batch_size=(8 if SMALL else 128))
+    imgs = rng.integers(0, 256, (n_rows, size, size, 3), dtype=np.uint8)
+    df = DataFrame({"image": [i for i in imgs]})
+    ips = _bench_transform(feat, df, n_rows)
+    print(json.dumps({"metric": "image_featurizer_images_per_sec",
+                      "value": round(ips, 2), "unit": "images/sec/chip",
+                      "platform": _platform()}), flush=True)
+
+
+def bench_shap():
+    """Config #5: KernelSHAP over an ONNXModel — stresses repeated batched
+    inference (the explainer hot path, KernelSHAPBase.scala:43-94)."""
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.explainers.shap import VectorSHAP
+    from mmlspark_tpu.models.onnx_model import ONNXModel
+    from mmlspark_tpu.onnx import builder as O
+
+    d = 8
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(0, 0.5, (d, 32)).astype(np.float32)
+    w2 = rng.normal(0, 0.5, (32, 2)).astype(np.float32)
+    g = O.make_graph(
+        [O.make_node("MatMul", ["x", "w1"], ["h"]),
+         O.make_node("Relu", ["h"], ["r"]),
+         O.make_node("MatMul", ["r", "w2"], ["logits"]),
+         O.make_node("Softmax", ["logits"], ["probs"], axis=-1)],
+        "mlp",
+        inputs=[O.make_tensor_value_info("x", np.float32, ["N", d])],
+        outputs=[O.make_tensor_value_info("probs", np.float32, ["N", 2])],
+        initializers={"w1": w1, "w2": w2})
+    inner = ONNXModel(O.make_model(g), feed_dict={"x": "features"},
+                      fetch_dict={"probs": "probs"}, mini_batch_size=256,
+                      pin_devices=False)
+    n_rows = 4 if SMALL else 64
+    n_rows = int(os.environ.get("BENCH_SHAP_ROWS", n_rows))
+    X = rng.normal(0, 1, (n_rows, d)).astype(np.float32)
+    bg = rng.normal(0, 1, (16, d)).astype(np.float32)
+    shap = VectorSHAP(model=inner, input_col="features",
+                      target_col="probs", target_classes=[1],
+                      num_samples=(8 if SMALL else 128),
+                      background_data=DataFrame(
+                          {"features": [b for b in bg]}))
+    df = DataFrame({"features": [x for x in X]})
+    t0 = time.perf_counter()
+    out = shap.transform(df)
+    dt = time.perf_counter() - t0
+    assert len(out) == n_rows
+    print(json.dumps({"metric": "kernel_shap_rows_per_sec",
+                      "value": round(n_rows / dt, 2),
+                      "unit": "explained rows/sec/chip",
+                      "samples_per_row": (8 if SMALL else 128),
+                      "platform": _platform()}), flush=True)
+
+
+def _platform():
+    import jax
+    return jax.default_backend()
+
+
+ALL = {"bert": bench_bert, "featurizer": bench_featurizer,
+       "shap": bench_shap}
+
+
+def main():
+    targets = sys.argv[1:] or list(ALL)
+    for t in targets:
+        ALL[t]()
+
+
+if __name__ == "__main__":
+    main()

@@ -28,21 +28,23 @@ def extract_blocks(text):
         yield m.group(1) is not None, m.group(2)
 
 
-def run_file(path: str) -> int:
+def run_file(path: str):
+    """Returns (blocks_run, failures)."""
     with open(path) as f:
         text = f.read()
     ns = {"__name__": f"doctest:{os.path.basename(path)}"}
-    failures = 0
+    ran = failures = 0
     for i, (skip, code) in enumerate(extract_blocks(text)):
         if skip:
             continue
+        ran += 1
         try:
             exec(compile(code, f"{path}:block{i}", "exec"), ns)
         except Exception:
             failures += 1
             print(f"FAIL {path} block {i}:")
             traceback.print_exc()
-    return failures
+    return ran, failures
 
 
 def main() -> int:
@@ -57,9 +59,9 @@ def main() -> int:
     for path in targets:
         if not os.path.exists(path):
             continue
-        n = sum(1 for s, _ in extract_blocks(open(path).read()) if not s)
+        n, f = run_file(path)
         total += n
-        failures += run_file(path)
+        failures += f
     print(f"doctest_docs: {total - failures}/{total} blocks passed")
     return 1 if failures else 0
 

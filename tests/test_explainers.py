@@ -194,3 +194,31 @@ def test_explainer_with_real_model():
     out = shap.transform(df.head(4))
     phis = np.stack(list(out["explanation"]))
     assert np.abs(phis[:, 1]).mean() > np.abs(phis[:, 2]).mean()
+
+
+def test_shap_over_dense_multiclass_column():
+    """ONNXModel-style dense (n, classes) target columns must reduce to the
+    selected classes (regression: only object columns were handled)."""
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.core.pipeline import Model
+    from mmlspark_tpu.explainers.shap import VectorSHAP
+
+    class _DenseProbModel(Model):
+        def _transform(self, df):
+            X = np.stack([np.asarray(v) for v in df["features"]])
+            z = 1 / (1 + np.exp(-(2.0 * X[:, 0])))
+            probs = np.stack([1 - z, z], axis=1)  # dense (n, 2) column
+            return df.with_column("probs", probs)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (6, 4)).astype(np.float32)
+    df = DataFrame({"features": [x for x in X]})
+    shap = VectorSHAP(model=_DenseProbModel(), target_col="probs",
+                      target_classes=[1], num_samples=64)
+    out = shap.transform(df)
+    phis = np.stack(list(out["explanation"]))
+    fx = 1 / (1 + np.exp(-(2.0 * X[:, 0])))
+    np.testing.assert_allclose(phis.sum(axis=1), fx, atol=0.05)
+    # feature 0 drives everything; feature 3 is noise
+    assert np.abs(phis[:, 1]).mean() > 5 * np.abs(phis[:, 4]).mean()
