@@ -1,0 +1,150 @@
+"""Expert parallelism: mixture-of-experts FFN with all_to_all routing.
+
+Beyond-parity distributed capability (the reference has no intra-model
+sharding at all — SURVEY §2.8): a GShard-style top-1 MoE block whose experts
+are sharded over an ``ep`` mesh axis. Tokens are locally gated, packed into
+per-expert capacity slots, exchanged with ``jax.lax.all_to_all`` (which XLA
+lowers onto ICI), processed by the local experts, and returned the same way.
+
+Design notes (TPU-first):
+* dispatch/combine are einsums over one-hot masks — MXU work, no scatters;
+* static capacity ``C`` keeps every shape fixed for XLA (overflow tokens are
+  dropped, standard GShard semantics, exposed via ``aux["dropped"]``);
+* the block is written for ``shard_map`` (see :func:`moe_ffn_sharded`) so
+  the collective pattern is explicit and testable on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["init_moe_params", "moe_ffn_local", "moe_ffn_sharded",
+           "moe_shardings", "moe_capacity"]
+
+
+def moe_capacity(tokens_per_shard: int, n_experts: int,
+                 capacity_factor: float = 1.25) -> int:
+    """Static per-expert capacity per source shard."""
+    return max(1, math.ceil(tokens_per_shard / n_experts * capacity_factor))
+
+
+def init_moe_params(d_model: int, d_ff: int, n_experts: int,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_ff)
+    return {
+        "gate": (rng.normal(0, s1, (d_model, n_experts))).astype(np.float32),
+        "w1": (rng.normal(0, s1, (n_experts, d_model, d_ff))).astype(np.float32),
+        "b1": np.zeros((n_experts, d_ff), np.float32),
+        "w2": (rng.normal(0, s2, (n_experts, d_ff, d_model))).astype(np.float32),
+        "b2": np.zeros((n_experts, d_model), np.float32),
+    }
+
+
+def moe_shardings(mesh: Mesh, ep_axis: str = "ep") -> Dict:
+    """Experts sharded over the ep axis; the gate replicated."""
+    return {
+        "gate": NamedSharding(mesh, P()),
+        "w1": NamedSharding(mesh, P(ep_axis, None, None)),
+        "b1": NamedSharding(mesh, P(ep_axis, None)),
+        "w2": NamedSharding(mesh, P(ep_axis, None, None)),
+        "b2": NamedSharding(mesh, P(ep_axis, None)),
+    }
+
+
+def _gate_and_dispatch(x, gate_w, n_experts: int, capacity: int):
+    """Top-1 gating + capacity packing. x (T, D) → masks and probs."""
+    logits = x @ gate_w.astype(x.dtype)                     # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # (T,)
+    gate_prob = jnp.max(probs, axis=-1)                     # (T,)
+    onehot = jax.nn.one_hot(expert, n_experts,
+                            dtype=jnp.float32)              # (T, E)
+    # position of each token within its expert's slots, in token order
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # (T, E)
+    keep = (pos < capacity) & (onehot > 0)
+    pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * \
+        keep[..., None]                                     # (T, E, C)
+    dropped = jnp.sum(onehot) - jnp.sum(slot)
+    return slot, gate_prob, dropped
+
+
+def moe_ffn_local(x, params, n_experts: int, capacity: int):
+    """Single-device reference MoE (no collectives): x (T, D) → (T, D)."""
+    slot, gate_prob, dropped = _gate_and_dispatch(
+        x, params["gate"], n_experts, capacity)
+    expert_in = jnp.einsum("tec,td->ecd", slot,
+                           x.astype(jnp.float32))           # (E, C, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
+                    + params["b1"][:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"]) \
+        + params["b2"][:, None, :]                          # (E, C, D)
+    y = jnp.einsum("ecd,tec->td", out, slot)                # (T, D)
+    return (y * gate_prob[:, None]).astype(x.dtype), dropped
+
+
+def _moe_shard_body(x_local, gate_w, w1_local, b1_local, w2_local, b2_local,
+                    *, n_experts: int, capacity: int, ep_axis: str):
+    """Per-shard body under shard_map: local gating, all_to_all dispatch to
+    the expert owners, expert FFN, all_to_all combine back."""
+    ep = jax.lax.axis_size(ep_axis)
+    e_local = n_experts // ep
+    slot, gate_prob, dropped = _gate_and_dispatch(
+        x_local, gate_w, n_experts, capacity)
+    D = x_local.shape[-1]
+    dispatch = jnp.einsum("tec,td->ecd", slot,
+                          x_local.astype(jnp.float32))      # (E, C, D)
+    dispatch = dispatch.reshape(ep, e_local, capacity, D)
+    # symmetric exchange (split=concat=0 is its own transpose, so autodiff
+    # reuses the same collective): shard k gets its e_local experts' slots
+    # from every source shard — axis 0 becomes the source shard
+    expert_in = jax.lax.all_to_all(dispatch, ep_axis,
+                                   split_axis=0, concat_axis=0)
+    expert_in = jnp.transpose(expert_in, (1, 0, 2, 3)) \
+        .reshape(e_local, ep * capacity, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1_local)
+                    + b1_local[:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", h, w2_local) \
+        + b2_local[:, None, :]                              # (e_local, ep*C, D)
+    # inverse exchange: back to (E, C, D) on the token-owning shard
+    out = jnp.transpose(out.reshape(e_local, ep, capacity, D), (1, 0, 2, 3))
+    returned = jax.lax.all_to_all(out, ep_axis,
+                                  split_axis=0, concat_axis=0)
+    returned = returned.reshape(n_experts, capacity, D)
+    y = jnp.einsum("ecd,tec->td", returned, slot)
+    dropped = jax.lax.psum(dropped, ep_axis)
+    return (y * gate_prob[:, None]).astype(x_local.dtype), dropped
+
+
+def moe_ffn_sharded(x, params, mesh: Mesh, n_experts: int,
+                    capacity: int, ep_axis: str = "ep") -> Tuple:
+    """Expert-parallel MoE over ``mesh[ep_axis]``.
+
+    ``x`` (T, D) is sharded over tokens on the ep axis; expert weights are
+    sharded over experts on the same axis (GShard: the data and expert
+    meshes coincide). Returns (y, dropped_token_count).
+    """
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 fallback, matches vw/learners.py
+        from jax.experimental.shard_map import shard_map
+
+    assert n_experts % mesh.shape[ep_axis] == 0, \
+        f"n_experts {n_experts} not divisible by ep={mesh.shape[ep_axis]}"
+    body = partial(_moe_shard_body, n_experts=n_experts, capacity=capacity,
+                   ep_axis=ep_axis)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(ep_axis, None), P(), P(ep_axis, None, None),
+                  P(ep_axis, None), P(ep_axis, None, None), P(ep_axis, None)),
+        out_specs=(P(ep_axis, None), P()),
+    )(x, params["gate"], params["w1"], params["b1"],
+      params["w2"], params["b2"])

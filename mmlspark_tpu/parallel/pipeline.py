@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over ``ppermute``.
+
+Beyond-parity distributed capability (the reference has no intra-model
+sharding — SURVEY §2.8): layers are partitioned into ``pp`` stages, each
+stage living on one shard of the ``pp`` mesh axis; microbatches stream
+through the stages, activations hopping stage→stage with
+``jax.lax.ppermute`` (XLA lowers the hop onto ICI neighbours) inside one
+``lax.scan`` — a single compiled program, no host round-trips per tick.
+
+The schedule is the classic fill/steady/drain: with M microbatches and pp
+stages the scan runs ``M + pp - 1`` ticks; stage 0 injects microbatch t at
+tick t, stage pp-1 emits microbatch t at tick ``t + pp - 1``. Autodiff
+works through the whole schedule (``ppermute`` transposes to the reverse
+permutation), so ``jax.grad`` of a pipelined loss is pipelined backprop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params", "stage_shardings"]
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading (pp,) axis
+    (shard it with :func:`stage_shardings`)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)
+
+
+def _stage_spec(leaf, pp_axis: str) -> P:
+    """The one layout rule: leading stage axis over pp, rest replicated."""
+    return P(pp_axis, *([None] * (jnp.ndim(leaf) - 1)))
+
+
+def stage_shardings(params_stacked, mesh: Mesh, pp_axis: str = "pp"):
+    """Leading stage axis sharded over pp; everything else replicated."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(mesh, _stage_spec(leaf, pp_axis)),
+        params_stacked)
+
+
+def _pipeline_body(params_local, x_all, *, stage_fn, pp_axis: str):
+    """Per-stage body under shard_map.
+
+    params_local: this stage's params (leading (1,) stage axis, squeezed).
+    x_all (M, mb, ...): the microbatched input, replicated — only stage 0
+    reads it. Returns (M, mb, ...) outputs, replicated via psum (only the
+    last stage holds non-zero values before the reduction).
+    """
+    pp = jax.lax.axis_size(pp_axis)
+    idx = jax.lax.axis_index(pp_axis)
+    params_local = jax.tree_util.tree_map(lambda l: l[0], params_local)
+    M = x_all.shape[0]
+    mb_shape = x_all.shape[1:]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        inp = jax.lax.dynamic_index_in_dim(
+            x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        cur = jnp.where(idx == 0, inp, state)
+        y = stage_fn(params_local, cur)
+        nxt = jax.lax.ppermute(y, pp_axis, perm)
+        slot = t - (pp - 1)
+        write = (idx == pp - 1) & (slot >= 0)
+        upd = jax.lax.dynamic_update_index_in_dim(
+            outbuf, y.astype(outbuf.dtype), jnp.maximum(slot, 0), axis=0)
+        outbuf = jnp.where(write, upd, outbuf)
+        return (nxt, outbuf), None
+
+    state0 = jnp.zeros(mb_shape, x_all.dtype)
+    out0 = jnp.zeros((M,) + mb_shape, x_all.dtype)
+    (_, outbuf), _ = jax.lax.scan(tick, (state0, out0),
+                                  jnp.arange(M + pp - 1))
+    # every stage but the last holds zeros; psum replicates the result
+    return jax.lax.psum(outbuf, pp_axis)
+
+
+def pipeline_apply(params_stacked, x_microbatched, stage_fn: Callable,
+                   mesh: Mesh, pp_axis: str = "pp"):
+    """Run ``x`` (M, mb, ...) through pp stages of ``stage_fn``.
+
+    ``params_stacked``: tree whose leaves have a leading (pp,) stage axis,
+    sharded over ``pp_axis`` (see :func:`stage_shardings`).
+    ``stage_fn(stage_params, x_mb) -> y_mb`` must preserve the microbatch
+    shape (inter-stage hops reuse one buffer).
+    """
+    n_stages = mesh.shape[pp_axis]
+    leading = {int(jnp.shape(l)[0])
+               for l in jax.tree_util.tree_leaves(params_stacked)}
+    assert leading == {n_stages}, \
+        f"stage axis {leading} != mesh pp={n_stages}"
+    body = partial(_pipeline_body, stage_fn=stage_fn, pp_axis=pp_axis)
+    pspec = jax.tree_util.tree_map(
+        lambda l: _stage_spec(l, pp_axis), params_stacked)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is not None:
+        # per-stage control flow (stage-id branches) is not varying-mesh-
+        # axis-safe; disable the vma check (jax.shard_map name for check_rep)
+        return shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                         out_specs=P(), check_vma=False)(
+            params_stacked, x_microbatched)
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+    return legacy_shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                            out_specs=P(), check_rep=False)(
+        params_stacked, x_microbatched)

@@ -1,0 +1,90 @@
+"""Pipeline-parallel (GPipe over ppermute) tests on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.parallel.pipeline import (pipeline_apply,
+                                            stack_stage_params,
+                                            stage_shardings)
+
+D = 8
+
+
+def _mesh(pp):
+    return Mesh(np.array(jax.devices()[:pp]), ("pp",))
+
+
+def _stages(pp, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"w": rng.normal(0, 0.5, (D, D)).astype(np.float32),
+             "b": rng.normal(0, 0.1, D).astype(np.float32)}
+            for _ in range(pp)]
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = np.tanh(x @ p["w"] + p["b"])
+    return x
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("pp,M", [(2, 3), (4, 8)])
+    def test_matches_sequential(self, pp, M):
+        mesh = _mesh(pp)
+        stages = _stages(pp)
+        stacked = jax.device_put(stack_stage_params(stages),
+                                 stage_shardings(stack_stage_params(stages),
+                                                 mesh))
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (M, 4, D)).astype(np.float32)
+        y = jax.jit(lambda p, x: pipeline_apply(p, x, _stage_fn, mesh))(
+            stacked, jnp.asarray(x))
+        expect = np.stack([_sequential(stages, x[m]) for m in range(M)])
+        np.testing.assert_allclose(np.asarray(y), expect,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_through_pipeline(self):
+        pp, M = 4, 6
+        mesh = _mesh(pp)
+        stages = _stages(pp, seed=2)
+        stacked = stack_stage_params(stages)
+        stacked = jax.device_put(stacked, stage_shardings(stacked, mesh))
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(0, 1, (M, 4, D)).astype(np.float32))
+        tgt = jnp.asarray(rng.normal(0, 1, (M, 4, D)).astype(np.float32))
+
+        def loss(p, x):
+            y = pipeline_apply(p, x, _stage_fn, mesh)
+            return jnp.mean((y - tgt) ** 2)
+
+        g = jax.jit(jax.grad(loss))(stacked, x)
+        gw = np.asarray(g["w"])
+        assert gw.shape[0] == pp
+        assert np.isfinite(gw).all()
+        # every stage gets signal (pipelined backprop reached them all)
+        per_stage = np.abs(gw).reshape(pp, -1).sum(axis=1)
+        assert (per_stage > 0).all(), per_stage
+
+        # numerical check against the sequential loss for one leaf
+        def seq_loss(p0w):
+            ps = [dict(s) for s in stages]
+            ps[0] = {"w": p0w, "b": stages[0]["b"]}
+            y = jnp.stack([_jax_sequential(ps, x[m]) for m in range(M)])
+            return jnp.mean((y - tgt) ** 2)
+
+        g_seq = jax.grad(seq_loss)(jnp.asarray(stages[0]["w"]))
+        np.testing.assert_allclose(gw[0], np.asarray(g_seq),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def _jax_sequential(stages, x):
+    for p in stages:
+        x = jnp.tanh(x @ jnp.asarray(p["w"]) + jnp.asarray(p["b"]))
+    return x
