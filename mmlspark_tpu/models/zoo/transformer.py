@@ -36,6 +36,18 @@ class TransformerConfig(NamedTuple):
     d_ff: int = 3072
     max_len: int = 512
     dtype: Any = jnp.bfloat16
+    #: >0 turns every ``moe_every``-th FFN into a mixture-of-experts block
+    #: (experts sharded over dp — the GShard deployment; parallel/moe.py)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    #: weight of the Switch/GShard load-balance loss (keeps the router from
+    #: collapsing onto one expert, which silently drops tokens)
+    moe_aux_weight: float = 0.01
+
+    def is_moe_layer(self, i: int) -> bool:
+        return (self.moe_experts > 0 and self.moe_every > 0
+                and (i % self.moe_every) == (self.moe_every - 1))
 
 
 BERT_BASE = TransformerConfig()
@@ -58,8 +70,8 @@ def init_transformer(cfg: TransformerConfig, seed: int = 0) -> Dict:
                      "bias": np.zeros(cfg.d_model, np.float32)},
         "lm_head": {"w": dense(cfg.d_model, cfg.vocab, 0.02)},
     }
-    for _ in range(cfg.layers):
-        params["layers"].append({
+    for i in range(cfg.layers):
+        layer = {
             "ln1": {"scale": np.ones(cfg.d_model, np.float32),
                     "bias": np.zeros(cfg.d_model, np.float32)},
             "qkv": {"w": dense(cfg.d_model, 3 * cfg.d_model),
@@ -68,25 +80,41 @@ def init_transformer(cfg: TransformerConfig, seed: int = 0) -> Dict:
                     "b": np.zeros(cfg.d_model, np.float32)},
             "ln2": {"scale": np.ones(cfg.d_model, np.float32),
                     "bias": np.zeros(cfg.d_model, np.float32)},
-            "w1": {"w": dense(cfg.d_model, cfg.d_ff),
-                   "b": np.zeros(cfg.d_ff, np.float32)},
-            "w2": {"w": dense(cfg.d_ff, cfg.d_model),
-                   "b": np.zeros(cfg.d_model, np.float32)},
-        })
+        }
+        if cfg.is_moe_layer(i):
+            from ...parallel.moe import init_moe_params
+            layer["moe"] = init_moe_params(cfg.d_model, cfg.d_ff,
+                                           cfg.moe_experts,
+                                           seed=seed * 1000 + i)
+        else:
+            layer["w1"] = {"w": dense(cfg.d_model, cfg.d_ff),
+                           "b": np.zeros(cfg.d_ff, np.float32)}
+            layer["w2"] = {"w": dense(cfg.d_ff, cfg.d_model),
+                           "b": np.zeros(cfg.d_model, np.float32)}
+        params["layers"].append(layer)
     return params
 
 
 def param_shardings(mesh: Mesh) -> Dict:
     """PartitionSpec pytree matching ``init_transformer`` (Megatron layout)."""
-    def layer_spec():
-        return {
+    def layer_spec(is_moe: bool = False):
+        spec = {
             "ln1": {"scale": P(), "bias": P()},
             "qkv": {"w": P(None, "tp"), "b": P("tp")},      # column-parallel
             "out": {"w": P("tp", None), "b": P()},          # row-parallel
             "ln2": {"scale": P(), "bias": P()},
-            "w1": {"w": P(None, "tp"), "b": P("tp")},
-            "w2": {"w": P("tp", None), "b": P()},
         }
+        if is_moe:
+            # experts over dp (GShard: ep == dp), expert hidden over tp
+            spec["moe"] = {"gate": P(),
+                           "w1": P("dp", None, "tp"),
+                           "b1": P("dp", "tp"),
+                           "w2": P("dp", "tp", None),
+                           "b2": P("dp", None)}
+        else:
+            spec["w1"] = {"w": P(None, "tp"), "b": P("tp")}
+            spec["w2"] = {"w": P("tp", None), "b": P()}
+        return spec
 
     return {
         "embed": {"tok": P(None, "tp"), "pos": P(None, "tp")},
@@ -100,7 +128,8 @@ def param_shardings(mesh: Mesh) -> Dict:
 def shardings_for(params: Dict, mesh: Mesh) -> Dict:
     spec = param_shardings(mesh)
     template = spec.pop("_layer_template")
-    spec["layers"] = [template() for _ in params["layers"]]
+    spec["layers"] = [template(is_moe="moe" in lp)
+                      for lp in params["layers"]]
     return jax.tree.map(lambda p: NamedSharding(mesh, p), spec,
                         is_leaf=lambda x: isinstance(x, P))
 
@@ -114,8 +143,15 @@ def _ln(x, p, eps=1e-5):
 def transformer_apply(params: Dict, ids: jnp.ndarray,
                       cfg: TransformerConfig,
                       mesh: Optional[Mesh] = None,
-                      mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Encoder forward → final hidden states (B, S, D) in cfg.dtype."""
+                      mask: Optional[jnp.ndarray] = None,
+                      return_aux: bool = False):
+    """Encoder forward → final hidden states (B, S, D) in cfg.dtype.
+
+    ``return_aux=True`` additionally returns the accumulated MoE
+    auxiliaries {``balance``: load-balance loss the trainer must add,
+    ``dropped``: over-capacity token count} — a functional return, not an
+    out-parameter, so it survives jit (a mutated-dict argument would be a
+    trace-local copy)."""
     dt = cfg.dtype
     B, S = ids.shape
 
@@ -124,6 +160,7 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
         return x
 
+    moe_aux = {"balance": jnp.float32(0.0), "dropped": jnp.float32(0.0)}
     h = params["embed"]["tok"].astype(dt)[ids] + \
         params["embed"]["pos"].astype(dt)[:S][None, :, :]
     # sequence-parallel region: activations sharded (dp, tp) on (B, S)
@@ -159,21 +196,33 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
 
         x = _ln(h.astype(jnp.float32), lp["ln2"]).astype(dt)
         x = constrain(x, P("dp", None, None))
-        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
-        y = constrain(y, P("dp", None, "tp"))
-        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        if "moe" in lp:
+            from ...parallel.moe import moe_capacity, moe_ffn_gspmd
+            cap = moe_capacity(S, cfg.moe_experts, cfg.moe_capacity_factor)
+            y, aux = moe_ffn_gspmd(x, lp["moe"], cfg.moe_experts, cap,
+                                   mesh=mesh, ep_axis="dp",
+                                   tp_axis="tp")
+            moe_aux["balance"] = moe_aux["balance"] + aux["balance_loss"]
+            moe_aux["dropped"] = moe_aux["dropped"] + aux["dropped"]
+        else:
+            y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt)
+                            + lp["w1"]["b"].astype(dt))
+            y = constrain(y, P("dp", None, "tp"))
+            y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
         h = h + constrain(y, P("dp", "tp", None))
 
-    return _ln(h.astype(jnp.float32), params["final_ln"]).astype(dt)
+    hidden = _ln(h.astype(jnp.float32), params["final_ln"]).astype(dt)
+    return (hidden, moe_aux) if return_aux else hidden
 
 
 def loss_fn(params, ids, labels, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None):
-    hidden = transformer_apply(params, ids, cfg, mesh)
+    hidden, moe_aux = transformer_apply(params, ids, cfg, mesh,
+                                        return_aux=True)
     logits = (hidden.astype(jnp.float32) @ params["lm_head"]["w"])
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll.mean() + cfg.moe_aux_weight * moe_aux["balance"]
 
 
 def train_step(params, opt_state, ids, labels, cfg: TransformerConfig,

@@ -110,3 +110,18 @@ class MeshContext:
     def __exit__(self, *exc):
         set_default_mesh(self._prev)
         return False
+
+
+def get_shard_map():
+    """The supported shard_map entry point across jax versions (new
+    ``jax.shard_map`` with ``check_vma``, else the experimental one with
+    ``check_rep``). Returns (shard_map_fn, uncheck_kwargs) where
+    ``uncheck_kwargs`` disables the replication/vma check for bodies with
+    per-shard control flow."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, {"check_vma": False}
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy, {"check_rep": False}

@@ -26,7 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["init_moe_params", "moe_ffn_local", "moe_ffn_sharded",
-           "moe_shardings", "moe_capacity"]
+           "moe_ffn_gspmd", "moe_shardings", "moe_capacity"]
 
 
 def moe_capacity(tokens_per_shard: int, n_experts: int,
@@ -60,10 +60,13 @@ def moe_shardings(mesh: Mesh, ep_axis: str = "ep") -> Dict:
     }
 
 
-def _gate_and_dispatch(x, gate_w, n_experts: int, capacity: int):
-    """Top-1 gating + capacity packing. x (T, D) → masks and probs."""
+def _route_and_pack(x, gate_w, n_experts: int, capacity: int):
+    """Core top-1 routing + capacity packing for one token group.
+    x (T, D) → slot (T, E, C), gate_prob (T,), onehot (T, E), probs (T, E).
+    The single source of truth — every MoE variant (local / shard_map /
+    GSPMD-grouped) builds on this."""
     logits = x @ gate_w.astype(x.dtype)                     # (T, E)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # fp32 router
     expert = jnp.argmax(probs, axis=-1)                     # (T,)
     gate_prob = jnp.max(probs, axis=-1)                     # (T,)
     onehot = jax.nn.one_hot(expert, n_experts,
@@ -74,13 +77,33 @@ def _gate_and_dispatch(x, gate_w, n_experts: int, capacity: int):
     pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
     slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * \
         keep[..., None]                                     # (T, E, C)
-    dropped = jnp.sum(onehot) - jnp.sum(slot)
-    return slot, gate_prob, dropped
+    return slot, gate_prob, onehot, probs
+
+
+def _aux_from_routing(slot, onehot, probs, n_experts: int,
+                      token_axis: int = -2):
+    """Shared auxiliaries: dropped-token count and the Switch/GShard
+    load-balance loss E·Σₑ fₑ·Pₑ (fraction routed × mean router prob;
+    without it top-1 routing classically collapses onto one expert and
+    over-capacity tokens are silently zeroed)."""
+    frac_routed = jnp.mean(onehot, axis=token_axis)
+    mean_prob = jnp.mean(probs, axis=token_axis)
+    return {"dropped": jnp.sum(onehot) - jnp.sum(slot),
+            "balance_loss": n_experts * jnp.mean(
+                jnp.sum(frac_routed * mean_prob, axis=-1))}
+
+
+def _gate_and_dispatch(x, gate_w, n_experts: int, capacity: int):
+    """Top-1 gating + capacity packing. x (T, D) → slot, probs, aux."""
+    slot, gate_prob, onehot, probs = _route_and_pack(
+        x, gate_w, n_experts, capacity)
+    return slot, gate_prob, _aux_from_routing(slot, onehot, probs, n_experts)
 
 
 def moe_ffn_local(x, params, n_experts: int, capacity: int):
-    """Single-device reference MoE (no collectives): x (T, D) → (T, D)."""
-    slot, gate_prob, dropped = _gate_and_dispatch(
+    """Single-device reference MoE (no collectives): x (T, D) → (T, D).
+    Returns (y, aux) with aux = {dropped, balance_loss}."""
+    slot, gate_prob, aux = _gate_and_dispatch(
         x, params["gate"], n_experts, capacity)
     expert_in = jnp.einsum("tec,td->ecd", slot,
                            x.astype(jnp.float32))           # (E, C, D)
@@ -89,7 +112,7 @@ def moe_ffn_local(x, params, n_experts: int, capacity: int):
     out = jnp.einsum("ecf,efd->ecd", h, params["w2"]) \
         + params["b2"][:, None, :]                          # (E, C, D)
     y = jnp.einsum("ecd,tec->td", out, slot)                # (T, D)
-    return (y * gate_prob[:, None]).astype(x.dtype), dropped
+    return (y * gate_prob[:, None]).astype(x.dtype), aux
 
 
 def _moe_shard_body(x_local, gate_w, w1_local, b1_local, w2_local, b2_local,
@@ -98,7 +121,7 @@ def _moe_shard_body(x_local, gate_w, w1_local, b1_local, w2_local, b2_local,
     the expert owners, expert FFN, all_to_all combine back."""
     ep = jax.lax.axis_size(ep_axis)
     e_local = n_experts // ep
-    slot, gate_prob, dropped = _gate_and_dispatch(
+    slot, gate_prob, aux = _gate_and_dispatch(
         x_local, gate_w, n_experts, capacity)
     D = x_local.shape[-1]
     dispatch = jnp.einsum("tec,td->ecd", slot,
@@ -121,8 +144,9 @@ def _moe_shard_body(x_local, gate_w, w1_local, b1_local, w2_local, b2_local,
                                   split_axis=0, concat_axis=0)
     returned = returned.reshape(n_experts, capacity, D)
     y = jnp.einsum("ecd,tec->td", returned, slot)
-    dropped = jax.lax.psum(dropped, ep_axis)
-    return (y * gate_prob[:, None]).astype(x_local.dtype), dropped
+    aux = {"dropped": jax.lax.psum(aux["dropped"], ep_axis),
+           "balance_loss": jax.lax.pmean(aux["balance_loss"], ep_axis)}
+    return (y * gate_prob[:, None]).astype(x_local.dtype), aux
 
 
 def moe_ffn_sharded(x, params, mesh: Mesh, n_experts: int,
@@ -131,11 +155,10 @@ def moe_ffn_sharded(x, params, mesh: Mesh, n_experts: int,
 
     ``x`` (T, D) is sharded over tokens on the ep axis; expert weights are
     sharded over experts on the same axis (GShard: the data and expert
-    meshes coincide). Returns (y, dropped_token_count).
+    meshes coincide). Returns (y, aux) with aux = {dropped, balance_loss}.
     """
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # pre-0.8 fallback, matches vw/learners.py
-        from jax.experimental.shard_map import shard_map
+    from .mesh import get_shard_map
+    shard_map, _ = get_shard_map()
 
     assert n_experts % mesh.shape[ep_axis] == 0, \
         f"n_experts {n_experts} not divisible by ep={mesh.shape[ep_axis]}"
@@ -148,3 +171,59 @@ def moe_ffn_sharded(x, params, mesh: Mesh, n_experts: int,
         out_specs=(P(ep_axis, None), P()),
     )(x, params["gate"], params["w1"], params["b1"],
       params["w2"], params["b2"])
+
+
+def _group_gate_and_dispatch(t, gate_w, n_experts: int, capacity: int):
+    """Grouped gating: t (G, Tg, D) → slot (G, Tg, E, C), probs, aux.
+    vmap of the core packer over groups — capacity is per (group, expert),
+    so the cumsum stays group-local (the GShard grouping trick that keeps
+    dispatch free of cross-shard scans)."""
+    slot, gate_prob, onehot, probs = jax.vmap(
+        partial(_route_and_pack, n_experts=n_experts, capacity=capacity),
+        in_axes=(0, None))(t, gate_w)
+    return slot, gate_prob, _aux_from_routing(slot, onehot, probs, n_experts)
+
+
+def moe_ffn_gspmd(t, params, n_experts: int, capacity: int,
+                  mesh: Mesh = None, ep_axis: str = "dp",
+                  tp_axis: str = None):
+    """GSPMD-style expert parallelism: no shard_map — sharding constraints
+    express the layout changes and XLA inserts the all-to-alls over ICI.
+
+    ``t`` (G, Tg, D): groups sharded over ``ep_axis`` (in a transformer the
+    batch axis is the natural group axis, so ep coincides with dp — the
+    GShard deployment). Expert weights (E, ...) are sharded over the same
+    axis; ``tp_axis`` additionally shards each expert's hidden dim. This
+    variant composes with constraint-style models (zoo transformer); the
+    ``shard_map`` variant (:func:`moe_ffn_sharded`) is the explicit-
+    collective equivalent used where the mesh is handled manually.
+    """
+    def constrain(v, *spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(*spec)))
+        return v
+
+    t = constrain(t, ep_axis, None, None)
+    slot, gate_prob, aux = _group_gate_and_dispatch(
+        t, params["gate"], n_experts, capacity)
+    # expert compute and the cross-device dispatch run in the model dtype
+    # (bf16 halves the all-to-all bytes and rides the MXU fast path);
+    # only the router softmax above stays fp32, GShard practice
+    dt = t.dtype
+    slot_dt = slot.astype(dt)
+    dispatch = jnp.einsum("gtec,gtd->gecd", slot_dt, t)     # (G, E, C, D)
+    # groups-sharded → experts-sharded: XLA lowers this re-shard to an
+    # all-to-all over ep_axis
+    dispatch = constrain(dispatch, None, ep_axis, None, None)
+    h = jax.nn.gelu(
+        jnp.einsum("gecd,edf->gecf", dispatch, params["w1"].astype(dt))
+        + params["b1"].astype(dt)[None, :, None, :])
+    if tp_axis is not None:
+        h = constrain(h, None, ep_axis, None, tp_axis)
+    out = jnp.einsum("gecf,efd->gecd", h, params["w2"].astype(dt)) \
+        + params["b2"].astype(dt)[None, :, None, :]
+    # experts-sharded → groups-sharded: the return all-to-all
+    out = constrain(out, ep_axis, None, None, None)
+    y = jnp.einsum("gecd,gtec->gtd", out, slot_dt)
+    return y * gate_prob[..., None].astype(dt), aux

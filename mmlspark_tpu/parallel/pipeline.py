@@ -101,14 +101,11 @@ def pipeline_apply(params_stacked, x_microbatched, stage_fn: Callable,
     body = partial(_pipeline_body, stage_fn=stage_fn, pp_axis=pp_axis)
     pspec = jax.tree_util.tree_map(
         lambda l: _stage_spec(l, pp_axis), params_stacked)
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is not None:
-        # per-stage control flow (stage-id branches) is not varying-mesh-
-        # axis-safe; disable the vma check (jax.shard_map name for check_rep)
-        return shard_map(body, mesh=mesh, in_specs=(pspec, P()),
-                         out_specs=P(), check_vma=False)(
-            params_stacked, x_microbatched)
-    from jax.experimental.shard_map import shard_map as legacy_shard_map
-    return legacy_shard_map(body, mesh=mesh, in_specs=(pspec, P()),
-                            out_specs=P(), check_rep=False)(
+    from .mesh import get_shard_map
+
+    # per-stage control flow (stage-id branches) is not replication-safe,
+    # so the vma/rep check is disabled
+    shard_map, uncheck = get_shard_map()
+    return shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                     out_specs=P(), **uncheck)(
         params_stacked, x_microbatched)

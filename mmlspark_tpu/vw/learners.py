@@ -230,9 +230,9 @@ class _VWBase(Estimator, _VWParams):
 
         if mesh is not None and n_shards > 1:
             from jax.sharding import PartitionSpec as P
-            shard_map = getattr(jax, "shard_map", None)
-            if shard_map is None:
-                from jax.experimental.shard_map import shard_map
+
+            from ..parallel.mesh import get_shard_map
+            shard_map, _ = get_shard_map()
             axis = mesh.axis_names[0]
             run = _make_pass_fn(self._loss, tau, passes, B, axis)
 

@@ -37,8 +37,8 @@ class TestMoE:
         rng = np.random.default_rng(0)
         params = init_moe_params(D, F, E, seed=1)
         x = jnp.asarray(rng.normal(0, 1, (24, D)).astype(np.float32))
-        y, dropped = moe_ffn_local(x, params, E, capacity=24)
-        assert float(dropped) == 0
+        y, aux = moe_ffn_local(x, params, E, capacity=24)
+        assert float(aux["dropped"]) == 0
         np.testing.assert_allclose(np.asarray(y), _dense_reference(x, params),
                                    rtol=1e-4, atol=1e-5)
 
@@ -52,9 +52,9 @@ class TestMoE:
         params_d = jax.device_put(params, moe_shardings(mesh))
         xd = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
         cap = T // ep  # generous: every local token could hit one expert
-        y_sh, dropped = jax.jit(
+        y_sh, aux = jax.jit(
             lambda x, p: moe_ffn_sharded(x, p, mesh, E, cap))(xd, params_d)
-        assert float(dropped) == 0
+        assert float(aux["dropped"]) == 0
         y_loc, _ = moe_ffn_local(x, params, E, capacity=T)
         np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_loc),
                                    rtol=1e-4, atol=1e-5)
@@ -65,8 +65,9 @@ class TestMoE:
         params["gate"][:] = 0
         params["gate"][:, 3] = 10.0
         x = jnp.ones((10, D), jnp.float32)
-        y, dropped = moe_ffn_local(x, params, E, capacity=4)
-        assert float(dropped) == 6  # 10 routed, 4 kept
+        y, aux = moe_ffn_local(x, params, E, capacity=4)
+        assert float(aux["dropped"]) == 6  # 10 routed, 4 kept
+        assert float(aux["balance_loss"]) > 1.0  # fully collapsed router
         # every over-capacity token (4..9) contributes zero output
         assert np.abs(np.asarray(y)[4:]).sum() == 0
 
@@ -92,3 +93,42 @@ class TestMoE:
     def test_capacity_helper(self):
         assert moe_capacity(64, 8, 1.25) == 10
         assert moe_capacity(1, 8, 1.0) == 1
+
+
+class TestMoEGspmd:
+    def test_gspmd_matches_local_per_group(self):
+        """The constraint-style variant must equal the local reference
+        applied per group (no drops)."""
+        rng = np.random.default_rng(5)
+        params = init_moe_params(D, F, E, seed=6)
+        G, Tg = 4, 12
+        t = jnp.asarray(rng.normal(0, 1, (G, Tg, D)).astype(np.float32))
+        from mmlspark_tpu.parallel.moe import moe_ffn_gspmd
+        y, aux = jax.jit(
+            lambda t, p: moe_ffn_gspmd(t, p, E, capacity=Tg))(t, params)
+        assert float(aux["dropped"]) == 0
+        assert float(aux["balance_loss"]) >= 1.0  # E*sum(f*P) >= 1 always
+        for g in range(G):
+            y_ref, _ = moe_ffn_local(t[g], params, E, capacity=Tg)
+            np.testing.assert_allclose(np.asarray(y[g]), np.asarray(y_ref),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_gspmd_sharded_equals_unsharded(self):
+        """Mesh constraints change layout, not values."""
+        from mmlspark_tpu.parallel.moe import moe_ffn_gspmd
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+        rng = np.random.default_rng(7)
+        params = init_moe_params(D, F, E, seed=8)
+        t = jnp.asarray(rng.normal(0, 1, (8, 6, D)).astype(np.float32))
+        y0, _ = jax.jit(lambda t, p: moe_ffn_gspmd(t, p, E, 6))(t, params)
+        pd = jax.device_put(params, {
+            "gate": NamedSharding(mesh, P()),
+            "w1": NamedSharding(mesh, P("dp", None, "tp")),
+            "b1": NamedSharding(mesh, P("dp", "tp")),
+            "w2": NamedSharding(mesh, P("dp", "tp", None)),
+            "b2": NamedSharding(mesh, P("dp", None))})
+        td = jax.device_put(t, NamedSharding(mesh, P("dp", None, None)))
+        y1, _ = jax.jit(lambda t, p: moe_ffn_gspmd(
+            t, p, E, 6, mesh=mesh, ep_axis="dp", tp_axis="tp"))(td, pd)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-4, atol=1e-5)
