@@ -108,6 +108,9 @@ def _encode_attribute(name: str, value) -> WireWriter:
         w.bytes(4, value).varint(20, AttrType.STRING)
     elif isinstance(value, np.ndarray):
         w.message(5, _encode_tensor("", value)).varint(20, AttrType.TENSOR)
+    elif isinstance(value, WireWriter):
+        # a subgraph built by make_graph (If/Loop/Scan bodies)
+        w.message(6, value).varint(20, AttrType.GRAPH)
     elif isinstance(value, (list, tuple)):
         if not value:
             w.packed_varints(8, []).varint(20, AttrType.INTS)

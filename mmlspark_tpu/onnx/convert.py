@@ -884,6 +884,239 @@ def _np_unsqueeze(node, inputs, ctx):
     return x
 
 
+# ---------------------------------------------------------------------------
+# Control flow (subgraph attributes) and recurrent cells. These lower to the
+# XLA-native structured primitives — lax.cond / lax.scan — instead of the
+# interpreter loops an ORT-style runtime uses.
+# ---------------------------------------------------------------------------
+
+@register_op("If")
+def _if(node, inputs, ctx):
+    cond = inputs[0]
+    then_g = node.attr("then_branch")
+    else_g = node.attr("else_branch")
+    if isinstance(cond, (np.ndarray, np.generic, bool)):
+        # static predicate (common exporter pattern): evaluate one branch
+        branch = then_g if bool(np.asarray(cond).reshape(())) else else_g
+        outs = ctx.run_subgraph(branch, [])
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    pred = jnp.asarray(cond).reshape(()).astype(bool)
+    outs = lax.cond(pred,
+                    lambda: tuple(jnp.asarray(v) for v in
+                                  ctx.run_subgraph(then_g, [])),
+                    lambda: tuple(jnp.asarray(v) for v in
+                                  ctx.run_subgraph(else_g, [])))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _cond_is_passthrough(body) -> bool:
+    """True when the body's cond_out is an Identity chain back to cond_in —
+    the fixed-trip-count exporter pattern where termination never fires."""
+    producers = {}
+    for n in body.nodes:
+        for o in n.output:
+            producers[o] = n
+    name = body.outputs[0].name
+    cond_in = body.inputs[1].name if len(body.inputs) > 1 else None
+    for _ in range(len(body.nodes) + 1):
+        if name == cond_in:
+            return True
+        n = producers.get(name)
+        if n is None or n.op_type != "Identity":
+            return False
+        name = n.input[0]
+    return False
+
+
+@register_op("Loop")
+def _loop(node, inputs, ctx):
+    """ONNX Loop with a static trip count → lax.scan.
+
+    body(iter_num, cond_in, v...) -> (cond_out, v'..., scan_outputs...).
+    A body-computed termination condition is honored by masking the carry
+    once it turns False; a while-style loop WITH scan outputs would need a
+    dynamic output length and is rejected (no static shape exists)."""
+    m, cond0 = inputs[0], inputs[1]
+    v_init = [jnp.asarray(v) for v in inputs[2:]]
+    body = node.attr("body")
+    if m is None or not isinstance(m, (np.ndarray, np.generic, int)):
+        raise UnsupportedOp(
+            "Loop requires a static trip count M (data-dependent loop "
+            "termination has no static shape)")
+    trip = int(np.asarray(m).reshape(()))
+    if cond0 is not None and not isinstance(cond0,
+                                            (np.ndarray, np.generic, bool)):
+        raise UnsupportedOp("Loop with a traced initial condition is not "
+                            "supported (static trip counts only)")
+    if cond0 is not None and not bool(np.asarray(cond0).reshape(())):
+        trip = 0  # spec: initial cond False runs zero iterations
+    n_carry = len(v_init)
+    n_scan = len(body.outputs) - 1 - n_carry
+    fixed_trip = _cond_is_passthrough(body)
+    if not fixed_trip and n_scan > 0:
+        raise UnsupportedOp(
+            "Loop with data-dependent termination AND scan outputs has a "
+            "dynamic output length (no static shape)")
+
+    def step(carry, i):
+        active, vals = carry
+        outs = ctx.run_subgraph(
+            body, [jnp.asarray(i, jnp.int64), jnp.asarray(True)]
+            + list(vals))
+        cond_out = jnp.asarray(outs[0]).reshape(()).astype(bool)
+        new_vals = tuple(
+            jnp.where(active, jnp.asarray(v), old)
+            for v, old in zip(outs[1:1 + n_carry], vals))
+        scans = tuple(jnp.asarray(v) for v in outs[1 + n_carry:])
+        return (active & cond_out, new_vals), scans
+
+    (_, carry), scans = lax.scan(
+        step, (jnp.asarray(True), tuple(v_init)),
+        jnp.arange(trip, dtype=jnp.int64))
+    outs = list(carry) + [scans[k] for k in range(n_scan)]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register_op("Scan")
+def _scan(node, inputs, ctx):
+    """ONNX Scan (forward, axis-0 scans) → lax.scan."""
+    body = node.attr("body")
+    n_scan_in = int(node.attr("num_scan_inputs"))
+    if node.attr("scan_input_directions") or \
+            node.attr("scan_output_directions") or \
+            node.attr("scan_input_axes") or node.attr("scan_output_axes"):
+        raise UnsupportedOp("Scan with non-default directions/axes")
+    n_state = len(inputs) - n_scan_in
+    state = [jnp.asarray(v) for v in inputs[:n_state]]
+    xs = tuple(jnp.asarray(v) for v in inputs[n_state:])
+    n_scan_out = len(body.outputs) - n_state
+
+    def step(carry, x_slices):
+        outs = ctx.run_subgraph(body, list(carry) + list(x_slices))
+        new_state = tuple(jnp.asarray(v) for v in outs[:n_state])
+        scans = tuple(jnp.asarray(v) for v in outs[n_state:])
+        return new_state, scans
+
+    carry, scans = lax.scan(step, tuple(state), xs)
+    outs = list(carry) + [scans[k] for k in range(n_scan_out)]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def _rnn_common(node, inputs):
+    """Shared unpacking for LSTM/GRU: X (T,B,I), W/R/B per direction."""
+    X = jnp.asarray(inputs[0])
+    W = jnp.asarray(inputs[1])
+    R = jnp.asarray(inputs[2])
+    B = jnp.asarray(inputs[3]) if len(inputs) > 3 and inputs[3] is not None \
+        else None
+    if len(inputs) > 4 and inputs[4] is not None:
+        raise UnsupportedOp("sequence_lens in recurrent ops (pad/mask "
+                            "upstream instead — static shapes)")
+    # silently computing with the wrong activation would be worse than
+    # rejecting: only the ONNX defaults (Sigmoid/Tanh) are implemented
+    acts = node.attr("activations")
+    if acts and [a.lower() for a in acts] not in (
+            ["sigmoid", "tanh"], ["sigmoid", "tanh", "tanh"],
+            ["sigmoid", "tanh"] * 2, ["sigmoid", "tanh", "tanh"] * 2):
+        raise UnsupportedOp(f"RNN activations {acts} (defaults only)")
+    if node.attr("clip") is not None:
+        raise UnsupportedOp("RNN cell clipping")
+    direction = node.attr("direction", "forward")
+    if direction not in ("forward", "reverse", "bidirectional"):
+        raise UnsupportedOp(f"RNN direction {direction!r}")
+    return X, W, R, B, direction
+
+
+def _run_directions(X, W, R, B, h0s, extra0s, direction, cell):
+    """Run ``cell`` over time for each direction; returns per-direction
+    (ys (T,B,H), h_final, extra_final)."""
+    results = []
+    n_dirs = W.shape[0]
+    for d in range(n_dirs):
+        reverse = (direction == "reverse") or \
+            (direction == "bidirectional" and d == 1)
+        xs = jnp.flip(X, axis=0) if reverse else X
+        carry0 = (h0s[d],) + tuple(e[d] for e in extra0s)
+        (carry, ys) = lax.scan(
+            partial(cell, W=W[d], R=R[d], B=(B[d] if B is not None
+                                             else None)),
+            carry0, xs)
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        results.append((ys, carry))
+    return results
+
+
+@register_op("LSTM")
+def _lstm(node, inputs, ctx):
+    """ONNX LSTM → lax.scan (default activations: sigmoid, tanh, tanh;
+    gate order iofc per the ONNX spec)."""
+    X, W, R, B, direction = _rnn_common(node, inputs)
+    H = int(node.attr("hidden_size"))
+    T, Bt, _ = X.shape
+    n_dirs = W.shape[0]
+    h0 = (jnp.asarray(inputs[5]) if len(inputs) > 5 and inputs[5] is not None
+          else jnp.zeros((n_dirs, Bt, H), X.dtype))
+    c0 = (jnp.asarray(inputs[6]) if len(inputs) > 6 and inputs[6] is not None
+          else jnp.zeros((n_dirs, Bt, H), X.dtype))
+    if len(inputs) > 7 and inputs[7] is not None:
+        raise UnsupportedOp("LSTM peephole weights (input P)")
+
+    def cell(carry, x, W, R, B):
+        h, c = carry
+        gates = x @ W.T + h @ R.T
+        if B is not None:
+            gates = gates + B[:4 * H] + B[4 * H:]
+        i, o, f, g = jnp.split(gates, 4, axis=-1)   # iofc order
+        i, o, f = (jax.nn.sigmoid(v) for v in (i, o, f))
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    res = _run_directions(X, W, R, B, h0, (c0,), direction, cell)
+    Y = jnp.stack([ys for ys, _ in res], axis=1)        # (T, dirs, B, H)
+    Y_h = jnp.stack([carry[0] for _, carry in res], axis=0)
+    Y_c = jnp.stack([carry[1] for _, carry in res], axis=0)
+    return Y, Y_h, Y_c
+
+
+@register_op("GRU")
+def _gru(node, inputs, ctx):
+    """ONNX GRU → lax.scan (gate order zrh; honors linear_before_reset)."""
+    X, W, R, B, direction = _rnn_common(node, inputs)
+    H = int(node.attr("hidden_size"))
+    lbr = bool(node.attr("linear_before_reset", 0))
+    T, Bt, _ = X.shape
+    n_dirs = W.shape[0]
+    h0 = (jnp.asarray(inputs[5]) if len(inputs) > 5 and inputs[5] is not None
+          else jnp.zeros((n_dirs, Bt, H), X.dtype))
+
+    def cell(carry, x, W, R, B):
+        (h,) = carry
+        wb = B[:3 * H] if B is not None else 0.0
+        rb = B[3 * H:] if B is not None else 0.0
+        gx = x @ W.T + wb                               # (B, 3H)
+        gh = h @ R.T + rb
+        zx, rx, hx = jnp.split(gx, 3, axis=-1)
+        zh, rh, hh = jnp.split(gh, 3, axis=-1)
+        z = jax.nn.sigmoid(zx + zh)
+        r = jax.nn.sigmoid(rx + rh)
+        if lbr:
+            # reset applied AFTER the recurrent matmul (gh already has Rbh)
+            n = jnp.tanh(hx + r * hh)
+        else:
+            # ONNX default: reset applied BEFORE the recurrent matmul
+            rbh = (B[5 * H:6 * H] if B is not None else 0.0)
+            n = jnp.tanh(hx + (r * h) @ R[2 * H:].T + rbh)
+        h_new = (1 - z) * n + z * h
+        return (h_new,), h_new
+
+    res = _run_directions(X, W, R, B, h0, (), direction, cell)
+    Y = jnp.stack([ys for ys, _ in res], axis=1)
+    Y_h = jnp.stack([carry[0] for _, carry in res], axis=0)
+    return Y, Y_h
+
+
 def _np_squeeze(node, inputs, ctx):
     x = inputs[0]
     axes = ([int(a) for a in np.ravel(inputs[1])] if len(inputs) > 1
@@ -941,6 +1174,54 @@ NUMPY_OPS: Dict[str, Callable] = {
 class _Ctx:
     def __init__(self, opset: int):
         self.opset = opset
+        #: outer-scope env during evaluation — ONNX subgraphs (If/Loop/Scan
+        #: bodies) capture enclosing tensors by name
+        self.scope_env: Optional[Dict[str, object]] = None
+
+    def run_subgraph(self, graph, inputs: List) -> List:
+        """Evaluate a subgraph: child scope = outer scope + bound inputs.
+        ``inputs`` bind positionally to ``graph.inputs``."""
+        env: Dict[str, object] = dict(self.scope_env or {})
+        # initializers first: a bound input that shares an initializer's
+        # name must win (ONNX optional-input-with-default semantics, same
+        # precedence as feeds over initializers at the top level)
+        for t in graph.initializers:
+            env[t.name] = tensor_to_numpy(t)
+        for vi, val in zip(graph.inputs, inputs):
+            env[vi.name] = val
+        env[""] = None
+        _eval_nodes(graph.nodes, env, self)
+        return [env[o.name] for o in graph.outputs]
+
+
+def _eval_nodes(nodes, env: Dict[str, object], ctx: "_Ctx") -> None:
+    """Walk a node list, writing outputs into ``env`` (the single graph
+    interpreter — top-level graphs and control-flow subgraphs share it)."""
+    outer = ctx.scope_env
+    ctx.scope_env = env
+    try:
+        for node in nodes:
+            ins = [env[i] if i else None for i in node.input]
+            np_handler = NUMPY_OPS.get(node.op_type)
+            if np_handler is not None and all(
+                    v is None or isinstance(v, (np.ndarray, np.generic))
+                    for v in ins) and any(v is not None for v in ins):
+                out = np_handler(node, ins, ctx)
+            else:
+                handler = OP_HANDLERS.get(node.op_type)
+                if handler is None:
+                    raise UnsupportedOp(
+                        f"ONNX op {node.op_type!r} (node {node.name!r}) is "
+                        f"not supported; {len(OP_HANDLERS)} ops available")
+                out = handler(node, ins, ctx)
+            if isinstance(out, tuple):
+                for name, val in zip(node.output, out):
+                    if name:
+                        env[name] = val
+            else:
+                env[node.output[0]] = out
+    finally:
+        ctx.scope_env = outer
 
 
 class ConvertedModel:
@@ -979,26 +1260,7 @@ class ConvertedModel:
         for name, val in feeds.items():
             env[name] = val
         env[""] = None
-        for node in self.model.graph.nodes:
-            ins = [env[i] if i else None for i in node.input]
-            np_handler = NUMPY_OPS.get(node.op_type)
-            if np_handler is not None and all(
-                    v is None or isinstance(v, (np.ndarray, np.generic))
-                    for v in ins) and any(v is not None for v in ins):
-                out = np_handler(node, ins, self._ctx)
-            else:
-                handler = OP_HANDLERS.get(node.op_type)
-                if handler is None:
-                    raise UnsupportedOp(
-                        f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
-                        f"supported; {len(OP_HANDLERS)} ops available")
-                out = handler(node, ins, self._ctx)
-            if isinstance(out, tuple):
-                for name, val in zip(node.output, out):
-                    if name:
-                        env[name] = val
-            else:
-                env[node.output[0]] = out
+        _eval_nodes(self.model.graph.nodes, env, self._ctx)
         missing = [o for o in self.output_names if o not in env]
         if missing:
             raise ValueError(f"graph did not produce outputs {missing}")

@@ -1,0 +1,331 @@
+"""ONNX control-flow (If/Loop/Scan) and recurrent (LSTM/GRU) conversion.
+
+These lower to XLA-native structured primitives (lax.cond / lax.scan)
+instead of the interpreter loops an ORT-style runtime uses — the remaining
+op families a torch/keras exporter emits that the importer lacked
+(parity target: ONNXModel type coverage, ``ONNXModel.scala:195-245``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx as O
+
+
+def _convert(graph):
+    return O.convert_model(O.make_model(graph))
+
+
+class TestIf:
+    def _model(self):
+        then_g = O.make_graph(
+            [O.make_node("Mul", ["x", "two"], ["y"])], "then",
+            inputs=[], outputs=[O.make_tensor_value_info("y", np.float32,
+                                                         [3])],
+            initializers={"two": np.float32(2.0).reshape(())})
+        else_g = O.make_graph(
+            [O.make_node("Neg", ["x"], ["y"])], "else",
+            inputs=[], outputs=[O.make_tensor_value_info("y", np.float32,
+                                                         [3])])
+        g = O.make_graph(
+            [O.make_node("If", ["cond"], ["out"], then_branch=then_g,
+                         else_branch=else_g)],
+            "ifg",
+            inputs=[O.make_tensor_value_info("cond", np.bool_, []),
+                    O.make_tensor_value_info("x", np.float32, [3])],
+            outputs=[O.make_tensor_value_info("out", np.float32, [3])])
+        return _convert(g)
+
+    def test_static_predicate(self):
+        cm = self._model()
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        out = cm(cm.params, {"cond": np.asarray(True), "x": x})
+        np.testing.assert_allclose(np.asarray(out["out"]), x * 2)
+        out = cm(cm.params, {"cond": np.asarray(False), "x": x})
+        np.testing.assert_allclose(np.asarray(out["out"]), -x)
+
+    def test_traced_predicate_under_jit(self):
+        import jax
+        cm = self._model()
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        jitted = jax.jit(lambda c, x: cm(cm.params, {"cond": c, "x": x}))
+        np.testing.assert_allclose(
+            np.asarray(jitted(jnp.asarray(True), x)["out"]), x * 2)
+        np.testing.assert_allclose(
+            np.asarray(jitted(jnp.asarray(False), x)["out"]), -x)
+
+
+class TestLoop:
+    def test_static_trip_count_with_scan_output(self):
+        # body: (i, cond, acc) -> (cond, acc + x, acc + x)
+        body = O.make_graph(
+            [O.make_node("Add", ["acc_in", "x"], ["acc_out"]),
+             O.make_node("Identity", ["cond_in"], ["cond_out"]),
+             O.make_node("Identity", ["acc_out"], ["scan_out"])],
+            "body",
+            inputs=[O.make_tensor_value_info("iter", np.int64, []),
+                    O.make_tensor_value_info("cond_in", np.bool_, []),
+                    O.make_tensor_value_info("acc_in", np.float32, [2])],
+            outputs=[O.make_tensor_value_info("cond_out", np.bool_, []),
+                     O.make_tensor_value_info("acc_out", np.float32, [2]),
+                     O.make_tensor_value_info("scan_out", np.float32, [2])])
+        g = O.make_graph(
+            [O.make_node("Loop", ["M", "", "acc0"], ["acc_final", "trace"],
+                         body=body)],
+            "loopg",
+            inputs=[O.make_tensor_value_info("acc0", np.float32, [2]),
+                    O.make_tensor_value_info("x", np.float32, [2])],
+            outputs=[O.make_tensor_value_info("acc_final", np.float32, [2]),
+                     O.make_tensor_value_info("trace", np.float32, [4, 2])],
+            initializers={"M": np.int64(4).reshape(())})
+        cm = _convert(g)
+        x = np.array([1.0, 10.0], np.float32)
+        out = cm(cm.params, {"acc0": np.zeros(2, np.float32), "x": x})
+        np.testing.assert_allclose(np.asarray(out["acc_final"]), 4 * x)
+        np.testing.assert_allclose(np.asarray(out["trace"]),
+                                   np.stack([x, 2 * x, 3 * x, 4 * x]))
+
+    def test_dynamic_trip_count_rejected(self):
+        body = O.make_graph(
+            [O.make_node("Identity", ["cond_in"], ["cond_out"]),
+             O.make_node("Identity", ["v_in"], ["v_out"])],
+            "body",
+            inputs=[O.make_tensor_value_info("iter", np.int64, []),
+                    O.make_tensor_value_info("cond_in", np.bool_, []),
+                    O.make_tensor_value_info("v_in", np.float32, [1])],
+            outputs=[O.make_tensor_value_info("cond_out", np.bool_, []),
+                     O.make_tensor_value_info("v_out", np.float32, [1])])
+        g = O.make_graph(
+            [O.make_node("Loop", ["M", "", "v0"], ["v_final"], body=body)],
+            "loopg",
+            inputs=[O.make_tensor_value_info("M", np.int64, []),
+                    O.make_tensor_value_info("v0", np.float32, [1])],
+            outputs=[O.make_tensor_value_info("v_final", np.float32, [1])])
+        cm = _convert(g)
+        with pytest.raises(NotImplementedError, match="static trip count"):
+            import jax
+            jax.jit(lambda m, v: cm(cm.params, {"M": m, "v0": v}))(
+                jnp.asarray(3, jnp.int32), jnp.zeros(1, jnp.float32))
+
+
+class TestScan:
+    def test_cumulative_sum_scan(self):
+        body = O.make_graph(
+            [O.make_node("Add", ["s_in", "x_t"], ["s_out"]),
+             O.make_node("Identity", ["s_out"], ["y_t"])],
+            "body",
+            inputs=[O.make_tensor_value_info("s_in", np.float32, [3]),
+                    O.make_tensor_value_info("x_t", np.float32, [3])],
+            outputs=[O.make_tensor_value_info("s_out", np.float32, [3]),
+                     O.make_tensor_value_info("y_t", np.float32, [3])])
+        g = O.make_graph(
+            [O.make_node("Scan", ["s0", "xs"], ["s_final", "ys"],
+                         body=body, num_scan_inputs=1)],
+            "scang",
+            inputs=[O.make_tensor_value_info("s0", np.float32, [3]),
+                    O.make_tensor_value_info("xs", np.float32, [5, 3])],
+            outputs=[O.make_tensor_value_info("s_final", np.float32, [3]),
+                     O.make_tensor_value_info("ys", np.float32, [5, 3])])
+        cm = _convert(g)
+        rng = np.random.default_rng(0)
+        xs = rng.normal(0, 1, (5, 3)).astype(np.float32)
+        out = cm(cm.params, {"s0": np.zeros(3, np.float32), "xs": xs})
+        np.testing.assert_allclose(np.asarray(out["s_final"]),
+                                   xs.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["ys"]),
+                                   np.cumsum(xs, axis=0), rtol=1e-5)
+
+
+def _np_lstm(X, W, R, B, H):
+    """Reference forward LSTM, ONNX iofc gate order."""
+    T, Bt, _ = X.shape
+    h = np.zeros((Bt, H), np.float32)
+    c = np.zeros((Bt, H), np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ys = []
+    for t in range(T):
+        gates = X[t] @ W.T + h @ R.T + B[:4 * H] + B[4 * H:]
+        i, o, f, g = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+class TestRecurrent:
+    def _lstm_model(self, T=6, Bt=2, I=4, H=3, seed=0):
+        rng = np.random.default_rng(seed)
+        W = rng.normal(0, 0.4, (1, 4 * H, I)).astype(np.float32)
+        R = rng.normal(0, 0.4, (1, 4 * H, H)).astype(np.float32)
+        B = rng.normal(0, 0.1, (1, 8 * H)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("LSTM", ["X", "W", "R", "B"], ["Y", "Y_h", "Y_c"],
+                         hidden_size=H)],
+            "lstm",
+            inputs=[O.make_tensor_value_info("X", np.float32, [T, Bt, I])],
+            outputs=[O.make_tensor_value_info("Y", np.float32,
+                                              [T, 1, Bt, H]),
+                     O.make_tensor_value_info("Y_h", np.float32, [1, Bt, H]),
+                     O.make_tensor_value_info("Y_c", np.float32,
+                                              [1, Bt, H])],
+            initializers={"W": W, "R": R, "B": B})
+        return _convert(g), (W, R, B, H, T, Bt, I)
+
+    def test_lstm_matches_reference(self):
+        cm, (W, R, B, H, T, Bt, I) = self._lstm_model()
+        rng = np.random.default_rng(1)
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        out = cm(cm.params, {"X": X})
+        ys, h, c = _np_lstm(X, W[0], R[0], B[0], H)
+        np.testing.assert_allclose(np.asarray(out["Y"])[:, 0], ys,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["Y_h"])[0], h,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["Y_c"])[0], c,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_bidirectional_shapes(self):
+        T, Bt, I, H = 5, 2, 4, 3
+        rng = np.random.default_rng(2)
+        W = rng.normal(0, 0.4, (2, 4 * H, I)).astype(np.float32)
+        R = rng.normal(0, 0.4, (2, 4 * H, H)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("LSTM", ["X", "W", "R"], ["Y"],
+                         hidden_size=H, direction="bidirectional")],
+            "lstm",
+            inputs=[O.make_tensor_value_info("X", np.float32, [T, Bt, I])],
+            outputs=[O.make_tensor_value_info("Y", np.float32,
+                                              [T, 2, Bt, H])],
+            initializers={"W": W, "R": R})
+        cm = _convert(g)
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        out = cm(cm.params, {"X": X})
+        assert np.asarray(out["Y"]).shape == (T, 2, Bt, H)
+        # reverse direction at t=0 must differ from forward at t=0
+        y = np.asarray(out["Y"])
+        assert not np.allclose(y[0, 0], y[0, 1])
+
+    def test_gru_runs_and_gates_bound(self):
+        T, Bt, I, H = 4, 2, 3, 5
+        rng = np.random.default_rng(3)
+        W = rng.normal(0, 0.4, (1, 3 * H, I)).astype(np.float32)
+        R = rng.normal(0, 0.4, (1, 3 * H, H)).astype(np.float32)
+        B = rng.normal(0, 0.1, (1, 6 * H)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("GRU", ["X", "W", "R", "B"], ["Y", "Y_h"],
+                         hidden_size=H, linear_before_reset=1)],
+            "gru",
+            inputs=[O.make_tensor_value_info("X", np.float32, [T, Bt, I])],
+            outputs=[O.make_tensor_value_info("Y", np.float32,
+                                              [T, 1, Bt, H]),
+                     O.make_tensor_value_info("Y_h", np.float32,
+                                              [1, Bt, H])],
+            initializers={"W": W, "R": R, "B": B})
+        cm = _convert(g)
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        out = cm(cm.params, {"X": X})
+        y = np.asarray(out["Y"])
+        assert y.shape == (T, 1, Bt, H)
+        assert np.abs(y).max() <= 1.0 + 1e-5  # tanh-bounded state
+        np.testing.assert_allclose(np.asarray(out["Y_h"])[0], y[-1, 0],
+                                   rtol=1e-6)
+
+
+def _np_gru_lbr0(X, W, R, B, H):
+    """Reference GRU, ONNX zrh order, linear_before_reset=0 (default)."""
+    T, Bt, _ = X.shape
+    h = np.zeros((Bt, H), np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    wb, rb = B[:3 * H], B[3 * H:]
+    for t in range(T):
+        gx = X[t] @ W.T + wb
+        gh = h @ R.T + rb
+        z = sig(gx[:, :H] + gh[:, :H])
+        r = sig(gx[:, H:2 * H] + gh[:, H:2 * H])
+        n = np.tanh(gx[:, 2 * H:] + (r * h) @ R[2 * H:].T + rb[2 * H:])
+        h = (1 - z) * n + z * h
+    return h
+
+
+class TestRecurrentSemantics:
+    def test_gru_linear_before_reset_default_matches_reference(self):
+        T, Bt, I, H = 5, 2, 3, 4
+        rng = np.random.default_rng(9)
+        W = rng.normal(0, 0.4, (1, 3 * H, I)).astype(np.float32)
+        R = rng.normal(0, 0.4, (1, 3 * H, H)).astype(np.float32)
+        B = rng.normal(0, 0.1, (1, 6 * H)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("GRU", ["X", "W", "R", "B"], ["Y", "Y_h"],
+                         hidden_size=H)],  # lbr defaults to 0
+            "gru",
+            inputs=[O.make_tensor_value_info("X", np.float32, [T, Bt, I])],
+            outputs=[O.make_tensor_value_info("Y", np.float32,
+                                              [T, 1, Bt, H]),
+                     O.make_tensor_value_info("Y_h", np.float32,
+                                              [1, Bt, H])],
+            initializers={"W": W, "R": R, "B": B})
+        cm = _convert(g)
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        out = cm(cm.params, {"X": X})
+        np.testing.assert_allclose(np.asarray(out["Y_h"])[0],
+                                   _np_gru_lbr0(X, W[0], R[0], B[0], H),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_nondefault_activations_rejected(self):
+        g = O.make_graph(
+            [O.make_node("LSTM", ["X", "W", "R"], ["Y"], hidden_size=2,
+                         activations=["HardSigmoid", "Tanh", "Tanh"])],
+            "lstm",
+            inputs=[O.make_tensor_value_info("X", np.float32, [3, 1, 2])],
+            outputs=[O.make_tensor_value_info("Y", np.float32,
+                                              [3, 1, 1, 2])],
+            initializers={"W": np.zeros((1, 8, 2), np.float32),
+                          "R": np.zeros((1, 8, 2), np.float32)})
+        cm = _convert(g)
+        with pytest.raises(NotImplementedError, match="activations"):
+            cm(cm.params, {"X": np.zeros((3, 1, 2), np.float32)})
+
+
+class TestLoopSemantics:
+    def _counting_loop(self, M_val, with_break_at=None):
+        """Loop body: v += 1 each iteration; optionally cond_out goes False
+        once v reaches with_break_at."""
+        nodes = [O.make_node("Add", ["v_in", "one"], ["v_out"])]
+        if with_break_at is None:
+            nodes.append(O.make_node("Identity", ["cond_in"], ["cond_out"]))
+        else:
+            nodes.append(O.make_node("Less", ["v_out", "limit"],
+                                     ["cond_out"]))
+        body = O.make_graph(
+            nodes, "body",
+            inputs=[O.make_tensor_value_info("iter", np.int64, []),
+                    O.make_tensor_value_info("cond_in", np.bool_, []),
+                    O.make_tensor_value_info("v_in", np.float32, [])],
+            outputs=[O.make_tensor_value_info("cond_out", np.bool_, []),
+                     O.make_tensor_value_info("v_out", np.float32, [])],
+            initializers={"one": np.float32(1.0).reshape(()),
+                          **({"limit": np.float32(with_break_at)
+                              .reshape(())} if with_break_at else {})})
+        g = O.make_graph(
+            [O.make_node("Loop", ["M", "cond0", "v0"], ["v_final"],
+                         body=body)],
+            "loopg",
+            inputs=[O.make_tensor_value_info("cond0", np.bool_, []),
+                    O.make_tensor_value_info("v0", np.float32, [])],
+            outputs=[O.make_tensor_value_info("v_final", np.float32, [])],
+            initializers={"M": np.int64(M_val).reshape(())})
+        return _convert(g)
+
+    def test_initial_cond_false_runs_zero_iterations(self):
+        cm = self._counting_loop(10)
+        out = cm(cm.params, {"cond0": np.asarray(False),
+                             "v0": np.float32(5.0)})
+        assert float(np.asarray(out["v_final"])) == 5.0
+
+    def test_body_cond_terminates_early(self):
+        # v starts at 0, breaks when v >= 3 → final v == 3, not 10
+        cm = self._counting_loop(10, with_break_at=3.0)
+        out = cm(cm.params, {"cond0": np.asarray(True),
+                             "v0": np.float32(0.0)})
+        assert float(np.asarray(out["v_final"])) == 3.0
