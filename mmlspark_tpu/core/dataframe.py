@@ -53,7 +53,8 @@ class DataFrame:
     """An immutable-ish columnar table with logical partitions."""
 
     def __init__(self, columns: Dict[str, Union[np.ndarray, Sequence]],
-                 npartitions: int = 1, metadata: Optional[Dict[str, dict]] = None):
+                 npartitions: int = 1, metadata: Optional[Dict[str, dict]] = None,
+                 partition_sizes: Optional[Sequence[int]] = None):
         self._columns: Dict[str, np.ndarray] = {}
         self._metadata: Dict[str, dict] = dict(metadata or {})
         n = None
@@ -66,7 +67,20 @@ class DataFrame:
                     f"column {name!r} has {len(arr)} rows, expected {n}")
             self._columns[name] = arr
         self._nrows = n if n is not None else 0
-        self._npartitions = max(1, min(int(npartitions), max(1, self._nrows)))
+        # explicit (possibly uneven) partition sizes — e.g. parquet row
+        # groups — override the equal-range split
+        self._partition_sizes: Optional[List[int]] = None
+        if partition_sizes is not None:
+            sizes = [int(s) for s in partition_sizes]
+            if sum(sizes) != self._nrows or any(s < 0 for s in sizes):
+                raise ValueError(
+                    f"partition_sizes {sizes} do not sum to {self._nrows}")
+            self._partition_sizes = sizes
+            npartitions = len(sizes)
+            self._npartitions = max(1, len(sizes))
+        else:
+            self._npartitions = max(1, min(int(npartitions),
+                                           max(1, self._nrows)))
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -75,9 +89,18 @@ class DataFrame:
 
     @staticmethod
     def from_arrow(table, npartitions: int = 1) -> "DataFrame":
+        import pyarrow as pa
         cols = {}
         for name in table.column_names:
             col = table.column(name)
+            typ = col.type
+            if pa.types.is_fixed_size_list(typ):
+                # dense tensor columns round-trip as FixedSizeList; restore
+                # the (N, k) block zero-copy (inverse of to_arrow)
+                chunk = col.combine_chunks()
+                flat = chunk.values.to_numpy(zero_copy_only=False)
+                cols[name] = flat.reshape(len(chunk), typ.list_size)
+                continue
             try:
                 cols[name] = col.to_numpy(zero_copy_only=False)
             except Exception:
@@ -98,6 +121,30 @@ class DataFrame:
         # object and n-D tensor columns become per-row lists of arrays
         return pd.DataFrame({k: list(v) if (v.dtype == object or v.ndim > 1)
                              else v for k, v in self._columns.items()})
+
+    def to_arrow(self):
+        """Columnar handoff to pyarrow.
+
+        Dense 2-D tensor columns go zero-copy as FixedSizeList (restored to
+        a dense block by :meth:`from_arrow`); object columns (ragged/None/
+        higher-rank cells) fall back to per-row list values."""
+        import pyarrow as pa
+
+        arrays, names = [], []
+        for name, col in self._columns.items():
+            if col.dtype != object and col.ndim == 2:
+                flat = pa.array(np.ascontiguousarray(col).reshape(-1))
+                arrays.append(pa.FixedSizeListArray.from_arrays(
+                    flat, col.shape[1]))
+            elif col.dtype == object or col.ndim > 2:
+                vals = [None if v is None
+                        else (v.tolist() if isinstance(v, np.ndarray) else v)
+                        for v in col]
+                arrays.append(pa.array(vals))
+            else:
+                arrays.append(pa.array(col))
+            names.append(name)
+        return pa.table(dict(zip(names, arrays)))
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -147,7 +194,8 @@ class DataFrame:
     def with_column(self, name: str, values) -> "DataFrame":
         cols = dict(self._columns)
         cols[name] = _as_column(values)
-        return DataFrame(cols, self._npartitions, self._metadata)
+        return DataFrame(cols, self._npartitions, self._metadata,
+                         partition_sizes=self._partition_sizes)
 
     def with_columns(self, new: Dict[str, Union[np.ndarray, Sequence]]) -> "DataFrame":
         cols = dict(self._columns)
@@ -208,6 +256,12 @@ class DataFrame:
 
     # -- partition machinery ------------------------------------------------
     def partition_bounds(self) -> List[tuple]:
+        if self._partition_sizes is not None:
+            bounds, start = [], 0
+            for size in self._partition_sizes:
+                bounds.append((start, start + size))
+                start += size
+            return bounds
         n, p = self._nrows, self._npartitions
         base, rem = divmod(n, p)
         bounds, start = [], 0

@@ -1,0 +1,114 @@
+"""Parquet IO for the DataFrame layer.
+
+The reference reads datasets through Spark's native parquet source; this is
+the standalone-framework equivalent, built on pyarrow with a
+**row-group/file → partition** mapping so file layout drives partition
+parallelism the way Spark's splits do (partitions then pin to local chips
+in `map_partitions`, parity: `ONNXModel.scala:499-508`).
+
+pyarrow is an optional dependency (`pip install mmlspark_tpu[io]`); these
+functions raise a clear ImportError without it.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, concat
+
+__all__ = ["read_parquet", "write_parquet", "read_csv"]
+
+
+def _pa():
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet as pq
+        return pq
+    except ImportError as e:
+        raise ImportError(
+            "parquet IO requires pyarrow (pip install mmlspark_tpu[io])"
+        ) from e
+
+
+def _expand(path: Union[str, Sequence[str]]) -> List[str]:
+    if isinstance(path, (list, tuple)):
+        files: List[str] = []
+        for p in path:
+            files.extend(_expand(p))
+        return files
+    if os.path.isdir(path):
+        return sorted(_glob.glob(os.path.join(path, "*.parquet")))
+    if any(ch in path for ch in "*?["):
+        return sorted(_glob.glob(path))
+    return [path]
+
+
+def read_parquet(path: Union[str, Sequence[str]],
+                 columns: Optional[Sequence[str]] = None,
+                 partition_per: str = "row_group") -> DataFrame:
+    """Read parquet file(s)/dir/glob into a DataFrame.
+
+    ``partition_per``: ``"row_group"`` (default — each parquet row group
+    becomes one partition, the Spark split model) or ``"file"``.
+    """
+    pq = _pa()
+    if partition_per not in ("row_group", "file"):
+        raise ValueError(f"partition_per must be 'row_group' or 'file', "
+                         f"got {partition_per!r}")
+    files = _expand(path)
+    if not files:
+        raise FileNotFoundError(f"no parquet files match {path!r}")
+    parts: List[DataFrame] = []
+    for f in files:
+        pf = pq.ParquetFile(f)
+        if partition_per == "row_group" and pf.num_row_groups > 1:
+            for rg in range(pf.num_row_groups):
+                parts.append(DataFrame.from_arrow(
+                    pf.read_row_group(rg, columns=list(columns)
+                                      if columns else None)))
+        else:
+            parts.append(DataFrame.from_arrow(
+                pf.read(columns=list(columns) if columns else None)))
+    if len(parts) == 1:
+        return parts[0]
+    out = concat(parts)
+    # exact (possibly uneven) row-group/file boundaries become the
+    # partition boundaries — the documented split model
+    return DataFrame(dict(out._columns), metadata=out._metadata,
+                     partition_sizes=[len(p) for p in parts])
+
+
+def write_parquet(df: DataFrame, path: str,
+                  partitioned: bool = False) -> List[str]:
+    """Write a DataFrame to parquet. ``partitioned=True`` writes one file
+    per partition under ``path/`` (the executor-parallel layout);
+    otherwise one file at ``path``. Returns the written paths."""
+    pq = _pa()
+    if partitioned:
+        os.makedirs(path, exist_ok=True)
+        # overwrite semantics: stale part files from a previous, larger
+        # write must not survive (read_parquet would silently merge them)
+        for old in _glob.glob(os.path.join(path, "part-*.parquet")):
+            os.remove(old)
+        written = []
+        for i, part in enumerate(df.partitions()):
+            f = os.path.join(path, f"part-{i:05d}.parquet")
+            pq.write_table(part.to_arrow(), f)
+            written.append(f)
+        return written
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    pq.write_table(df.to_arrow(), path)
+    return [path]
+
+
+def read_csv(path: str, npartitions: int = 1, **pandas_kwargs) -> DataFrame:
+    """CSV via pandas (header inference, dtypes, the lot)."""
+    import pandas as pd
+
+    return DataFrame.from_pandas(pd.read_csv(path, **pandas_kwargs),
+                                 npartitions)

@@ -1,0 +1,131 @@
+"""Parquet/Arrow IO tests (parity role: Spark's native parquet source +
+the row-group → partition split model)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.io.parquet import read_csv, read_parquet, write_parquet
+
+pytest.importorskip("pyarrow")
+
+
+def _frame(n=12, npartitions=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataFrame({
+        "x": rng.normal(0, 1, n).astype(np.float32),
+        "label": rng.integers(0, 3, n).astype(np.int64),
+        "vec": [rng.normal(0, 1, 4).astype(np.float32) for _ in range(n)],
+        "name": np.array([f"row{i}" for i in range(n)], dtype=object),
+    }, npartitions=npartitions)
+
+
+class TestParquet:
+    def test_single_file_roundtrip(self, tmp_path):
+        df = _frame()
+        write_parquet(df, str(tmp_path / "t.parquet"))
+        back = read_parquet(str(tmp_path / "t.parquet"))
+        np.testing.assert_allclose(back["x"], df["x"], rtol=1e-6)
+        np.testing.assert_array_equal(back["label"], df["label"])
+        assert list(back["name"]) == list(df["name"])
+        np.testing.assert_allclose(
+            np.stack([np.asarray(v) for v in back["vec"]]),
+            np.stack(list(df["vec"])), rtol=1e-6)
+
+    def test_partitioned_write_preserves_partitioning(self, tmp_path):
+        df = _frame(npartitions=3)
+        paths = write_parquet(df, str(tmp_path / "parts"), partitioned=True)
+        assert len(paths) == 3
+        assert all(os.path.exists(p) for p in paths)
+        back = read_parquet(str(tmp_path / "parts"))
+        assert len(back) == 12 and back.npartitions == 3
+        np.testing.assert_allclose(back["x"], df["x"], rtol=1e-6)
+
+    def test_glob_and_columns(self, tmp_path):
+        df = _frame()
+        write_parquet(df, str(tmp_path / "parts"), partitioned=True)
+        back = read_parquet(str(tmp_path / "parts" / "*.parquet"),
+                            columns=["x", "label"])
+        assert set(back.columns) == {"x", "label"}
+
+    def test_row_group_partitioning(self, tmp_path):
+        import pyarrow.parquet as pq
+        df = _frame(n=20, npartitions=1)
+        pq.write_table(df.to_arrow(), str(tmp_path / "rg.parquet"),
+                       row_group_size=5)
+        back = read_parquet(str(tmp_path / "rg.parquet"))
+        assert back.npartitions == 4  # one partition per row group
+        np.testing.assert_allclose(back["x"], df["x"], rtol=1e-6)
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            read_parquet("/nonexistent/*.parquet")
+
+    def test_pipeline_from_parquet(self, tmp_path):
+        """The user path: parquet → fit → transform."""
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+        rng = np.random.default_rng(1)
+        n = 60
+        df = DataFrame({
+            "features": [rng.normal(0, 1, 5).astype(np.float32)
+                         for _ in range(n)],
+            "label": rng.integers(0, 2, n).astype(np.float64)})
+        write_parquet(df, str(tmp_path / "train.parquet"))
+        train = read_parquet(str(tmp_path / "train.parquet"))
+        model = LightGBMClassifier(num_iterations=3, num_leaves=4).fit(train)
+        out = model.transform(train)
+        assert "prediction" in out.columns
+
+
+class TestCsv:
+    def test_read_csv(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a,b\n1,x\n2,y\n")
+        df = read_csv(str(p), npartitions=2)
+        np.testing.assert_array_equal(df["a"], [1, 2])
+        assert df.npartitions == 2
+
+
+class TestArrowRoundtrip:
+    def test_to_arrow_from_arrow(self):
+        df = _frame()
+        back = DataFrame.from_arrow(df.to_arrow())
+        np.testing.assert_allclose(back["x"], df["x"], rtol=1e-6)
+        assert list(back["name"]) == list(df["name"])
+
+
+class TestReviewRegressions:
+    def test_overwrite_with_fewer_partitions_truncates(self, tmp_path):
+        d = str(tmp_path / "ds")
+        write_parquet(_frame(n=10, npartitions=5), d, partitioned=True)
+        write_parquet(_frame(n=4, npartitions=2, seed=9), d,
+                      partitioned=True)
+        back = read_parquet(d)
+        assert len(back) == 4  # stale part files removed
+
+    def test_uneven_row_groups_keep_exact_boundaries(self, tmp_path):
+        import pyarrow.parquet as pq
+        d = str(tmp_path)
+        pq.write_table(_frame(n=10, npartitions=1).to_arrow(),
+                       d + "/a.parquet")
+        pq.write_table(_frame(n=2, npartitions=1, seed=3).to_arrow(),
+                       d + "/b.parquet")
+        back = read_parquet([d + "/a.parquet", d + "/b.parquet"])
+        assert back.npartitions == 2
+        sizes = [hi - lo for lo, hi in back.partition_bounds()]
+        assert sizes == [10, 2]  # file boundaries, not equal ranges
+
+    def test_invalid_partition_per_rejected(self, tmp_path):
+        write_parquet(_frame(), str(tmp_path / "t.parquet"))
+        with pytest.raises(ValueError, match="partition_per"):
+            read_parquet(str(tmp_path / "t.parquet"),
+                         partition_per="rowgroup")
+
+    def test_dense_2d_column_roundtrips_dense(self):
+        m = np.arange(12, dtype=np.float32).reshape(6, 2)
+        df = DataFrame({"m": m})
+        back = DataFrame.from_arrow(df.to_arrow())
+        assert back["m"].dtype == np.float32 and back["m"].shape == (6, 2)
+        np.testing.assert_allclose(back["m"], m)
