@@ -76,7 +76,6 @@ class DataFrame:
                 raise ValueError(
                     f"partition_sizes {sizes} do not sum to {self._nrows}")
             self._partition_sizes = sizes
-            npartitions = len(sizes)
             self._npartitions = max(1, len(sizes))
         else:
             self._npartitions = max(1, min(int(npartitions),
@@ -176,7 +175,8 @@ class DataFrame:
     def with_column_metadata(self, name: str, meta: dict) -> "DataFrame":
         md = dict(self._metadata)
         md[name] = {**md.get(name, {}), **meta}
-        return DataFrame(self._columns, self._npartitions, md)
+        return DataFrame(self._columns, self._npartitions, md,
+                         partition_sizes=self._partition_sizes)
 
     def _meta_for(self, names) -> Dict[str, dict]:
         return {k: v for k, v in self._metadata.items() if k in names}
@@ -201,21 +201,25 @@ class DataFrame:
         cols = dict(self._columns)
         for k, v in new.items():
             cols[k] = _as_column(v)
-        return DataFrame(cols, self._npartitions, self._metadata)
+        return DataFrame(cols, self._npartitions, self._metadata,
+                         partition_sizes=self._partition_sizes)
 
     def select(self, names: Sequence[str]) -> "DataFrame":
         return DataFrame({n: self[n] for n in names}, self._npartitions,
-                         self._meta_for(names))
+                         self._meta_for(names),
+                         partition_sizes=self._partition_sizes)
 
     def drop(self, *names: str) -> "DataFrame":
         keep = [k for k in self._columns if k not in names]
         return DataFrame({k: self._columns[k] for k in keep}, self._npartitions,
-                         self._meta_for(keep))
+                         self._meta_for(keep),
+                         partition_sizes=self._partition_sizes)
 
     def rename(self, mapping: Dict[str, str]) -> "DataFrame":
         md = {mapping.get(k, k): v for k, v in self._metadata.items()}
         return DataFrame({mapping.get(k, k): v for k, v in self._columns.items()},
-                         self._npartitions, md)
+                         self._npartitions, md,
+                         partition_sizes=self._partition_sizes)
 
     def filter(self, mask: np.ndarray) -> "DataFrame":
         mask = np.asarray(mask)
@@ -300,7 +304,13 @@ class DataFrame:
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=max_workers) as ex:
                 results = list(ex.map(fn, parts, range(len(parts))))
-        return concat(results, npartitions=self._npartitions)
+        out = concat(results, npartitions=self._npartitions)
+        # per-partition result sizes become the output boundaries, so uneven
+        # splits (parquet row groups) survive a map_partitions round
+        if len(results) > 1:
+            out = DataFrame(dict(out._columns), metadata=out._metadata,
+                            partition_sizes=[len(r) for r in results])
+        return out
 
     # -- row view (for HTTP/serving paths that are row-oriented) ------------
     def iter_rows(self) -> Iterator[dict]:

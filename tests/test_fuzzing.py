@@ -126,6 +126,7 @@ def _registry():
     from mmlspark_tpu.featurize.count_selector import CountSelector
     from mmlspark_tpu.featurize.data_conversion import DataConversion
     from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.featurize.tokenizer import BertTokenizer
     from mmlspark_tpu.featurize.text import (IDF, HashingTF, MultiNGram,
                                              NGram, PageSplitter,
                                              TextFeaturizer, Tokenizer)
@@ -235,6 +236,10 @@ def _registry():
         PageSplitter: lambda: TestObject(
             PageSplitter(input_col="text", output_col="pages",
                          maximum_page_length=8), transform_df=df),
+        BertTokenizer: lambda: TestObject(
+            BertTokenizer(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                           "a", "b", "##a"],
+                          input_col="text", max_len=8), transform_df=df),
         ValueIndexer: lambda: TestObject(
             ValueIndexer(input_col="cat", output_col="idx"), fit_df=df),
         IndexToValue: lambda: TestObject(

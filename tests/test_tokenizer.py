@@ -1,0 +1,136 @@
+"""WordPiece tokenizer tests (text → dense id/mask tensors for BERT-class
+models; the text→ids step the reference delegates to upstream tooling)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.featurize.tokenizer import (PAD, UNK, BertTokenizer,
+                                              basic_tokenize,
+                                              build_wordpiece_vocab,
+                                              wordpiece)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s",
+         "un", "##believ", "##able", ",", "."]
+IDX = {t: i for i, t in enumerate(VOCAB)}
+
+
+class TestBasicTokenize:
+    def test_whitespace_punct_lowercase(self):
+        assert basic_tokenize("The quick, brown fox.") == \
+            ["the", "quick", ",", "brown", "fox", "."]
+
+    def test_no_lowercase(self):
+        assert basic_tokenize("The Fox", lowercase=False) == ["The", "Fox"]
+
+
+class TestWordPiece:
+    def test_greedy_longest_match(self):
+        assert wordpiece("jumped", IDX) == ["jump", "##ed"]
+        assert wordpiece("jumps", IDX) == ["jump", "##s"]
+        assert wordpiece("unbelievable", IDX) == ["un", "##believ", "##able"]
+
+    def test_unknown_falls_back(self):
+        assert wordpiece("zzz", IDX) == [UNK]
+
+
+class TestBertTokenizer:
+    def test_transform_shapes_and_mask(self):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=10)
+        df = DataFrame({"text": np.array(
+            ["the quick fox", "jumped", None], dtype=object)})
+        out = t.transform(df)
+        ids, mask = out["ids"], out["mask"]
+        assert ids.shape == (3, 10) and ids.dtype == np.int32
+        # [CLS] the quick fox [SEP] pad...
+        assert list(ids[0][:5]) == [IDX["[CLS]"], IDX["the"], IDX["quick"],
+                                    IDX["fox"], IDX["[SEP]"]]
+        assert list(mask[0]) == [1] * 5 + [0] * 5
+        assert list(ids[2][:2]) == [IDX["[CLS]"], IDX["[SEP]"]]  # None row
+        assert ids[0][5] == IDX[PAD]
+
+    def test_truncation(self):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=4)
+        df = DataFrame({"text": ["the quick brown fox jumped"]})
+        out = t.transform(df)
+        assert out["mask"][0].sum() == 4  # CLS + 2 body + SEP
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=8)
+        df = DataFrame({"text": ["unbelievable ."]})
+        expect = t.transform(df)["ids"]
+        t.save(str(tmp_path / "tok"))
+        t2 = PipelineStage.load(str(tmp_path / "tok"))
+        np.testing.assert_array_equal(t2.transform(df)["ids"], expect)
+
+    def test_vocab_file(self, tmp_path):
+        p = tmp_path / "vocab.txt"
+        p.write_text("\n".join(VOCAB) + "\n")
+        t = BertTokenizer(input_col="text", vocab_file=str(p), max_len=6)
+        out = t.transform(DataFrame({"text": ["fox"]}))
+        assert out["ids"][0][1] == IDX["fox"]
+
+    def test_missing_vocab_clear_error(self):
+        t = BertTokenizer(input_col="text")
+        with pytest.raises(ValueError, match="vocab"):
+            t.transform(DataFrame({"text": ["x"]}))
+
+
+class TestVocabBuilder:
+    def test_built_vocab_covers_corpus(self):
+        corpus = ["the cat sat on the mat", "the dog sat on the log",
+                  "cats and dogs"] * 5
+        vocab = build_wordpiece_vocab(corpus, size=200)
+        assert vocab[:5] == ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+        idx = {t: i for i, t in enumerate(vocab)}
+        # frequent words are whole tokens; derived words split, not UNK
+        assert "the" in idx and "sat" in idx
+        assert UNK not in wordpiece("cats", idx)
+
+    def test_tokenizer_into_bert_model(self):
+        """Full text path: tokenize → BERT-shaped ONNX graph."""
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        from mmlspark_tpu.models.zoo.bert_onnx import (BertOnnxConfig,
+                                                       export_bert_onnx)
+        corpus = ["tiny text pipeline test", "text goes in ids come out"]
+        vocab = build_wordpiece_vocab(corpus, size=128)
+        cfg = BertOnnxConfig(vocab=128, layers=1, d_model=32, heads=2,
+                             d_ff=64, max_len=16)
+        tok = BertTokenizer(vocab, input_col="text", max_len=16)
+        m = ONNXModel(export_bert_onnx(cfg, seed=0),
+                      feed_dict={"input_ids": "ids",
+                                 "attention_mask": "mask"},
+                      fetch_dict={"emb": "last_hidden_state"},
+                      mini_batch_size=4, pin_devices=False)
+        df = DataFrame({"text": corpus})
+        out = m.transform(tok.transform(df))
+        emb = np.stack(list(out["emb"]))
+        assert emb.shape[0] == 2 and np.isfinite(emb).all()
+
+
+class TestReviewRegressions:
+    def test_param_override_uses_new_vocab(self):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=6)
+        df = DataFrame({"text": ["fox"]})
+        assert t.transform(df)["ids"][0][1] == IDX["fox"]
+        vocab_b = list(VOCAB)
+        vocab_b[IDX["fox"]], vocab_b[IDX["the"]] = "the", "fox"
+        out = t.transform(df, {"vocab": vocab_b})
+        assert out["ids"][0][1] == IDX["the"]  # "fox" sits at the old "the" slot
+        # and the original stage is untouched
+        assert t.transform(df)["ids"][0][1] == IDX["fox"]
+
+    def test_set_vocab_invalidates_cache(self):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=6)
+        df = DataFrame({"text": ["fox"]})
+        t.transform(df)
+        vocab_b = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "fox"]
+        t.set(vocab=vocab_b)
+        assert t.transform(df)["ids"][0][1] == 5
+
+    def test_tiny_max_len_clear_error(self):
+        t = BertTokenizer(VOCAB, input_col="text", max_len=2)
+        with pytest.raises(ValueError, match="max_len"):
+            t.transform(DataFrame({"text": ["x"]}))
