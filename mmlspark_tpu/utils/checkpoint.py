@@ -22,7 +22,7 @@ from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
-__all__ = ["TrainingCheckpointer"]
+__all__ = ["TrainingCheckpointer", "ShardedCheckpointer"]
 
 Payload = Dict[str, Union[bytes, str, dict, np.ndarray]]
 
@@ -107,3 +107,72 @@ class TrainingCheckpointer:
     def read_json(path: str) -> dict:
         with open(path) as f:
             return json.load(f)
+
+
+class ShardedCheckpointer:
+    """Mesh-sharded training-state checkpoints via orbax.
+
+    :class:`TrainingCheckpointer` handles host-side payloads (GBDT model
+    strings, numpy state). Multi-host TPU training needs more: every host
+    writes its own shards of a distributed pytree and restore re-places
+    them onto the target mesh — orbax's job. Works identically on the
+    virtual CPU mesh (tests) and real slices.
+
+    >>> with ShardedCheckpointer(d, max_to_keep=3) as ckpt:
+    ...     ckpt.save(step, {"params": params, "opt": opt_state})
+    ...     state = ckpt.restore(target=fresh_state)  # keeps shardings
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    def save(self, step: int, state, wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, step: Optional[int] = None, target=None):
+        """Restore ``step`` (default latest). With ``target`` (the freshly
+        initialized, device_put state), restored arrays land on the
+        target leaves' shardings — values are overwritten."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if target is None:
+            return self._mgr.restore(step)
+        def leaf_struct(x):
+            arr = jax.numpy.asarray(x)  # plain int/float leaves (step ctr)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                        sharding=getattr(x, "sharding", None))
+
+        abstract = jax.tree_util.tree_map(leaf_struct, target)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "ShardedCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
