@@ -62,8 +62,8 @@ class Transformer(PipelineStage):
     def transform(self, df: DataFrame, params: Optional[dict] = None) -> DataFrame:
         stage = self.copy(params) if params else self
         t0 = time.perf_counter()
-        from ..utils.profiling import annotate
-        with annotate(f"{type(stage).__name__}.transform"):
+        from ..utils.profiling import span
+        with span(f"{type(stage).__name__}.transform"):
             out = stage._transform(df)
         _log_event(stage, "transform", rows=len(df),
                    millis=round(1e3 * (time.perf_counter() - t0), 3))
@@ -82,8 +82,8 @@ class Estimator(PipelineStage):
     def fit(self, df: DataFrame, params: Optional[dict] = None) -> "Model":
         est = self.copy(params) if params else self
         t0 = time.perf_counter()
-        from ..utils.profiling import annotate
-        with annotate(f"{type(est).__name__}.fit"):
+        from ..utils.profiling import span
+        with span(f"{type(est).__name__}.fit"):
             model = est._fit(df)
         _log_event(est, "fit", rows=len(df),
                    millis=round(1e3 * (time.perf_counter() - t0), 3))

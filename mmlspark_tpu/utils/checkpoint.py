@@ -127,7 +127,6 @@ class ShardedCheckpointer:
         import orbax.checkpoint as ocp
 
         self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(

@@ -1,21 +1,113 @@
-"""Profiling: jax.profiler traces + stage annotations.
+"""Profiling: jax.profiler traces, stage annotations, and a host-side
+span tracer exporting Chrome trace format.
 
 The reference has no tracer — only ad-hoc ``StopWatch``/``Timer`` timings
-(SURVEY.md §5). The TPU-native replacement is the XLA profiler:
-:func:`trace` captures a TensorBoard-loadable device trace and
-:func:`annotate` scopes host work so stage names appear on the timeline.
-``PipelineStage`` fit/transform calls are annotated automatically (see
-``core/pipeline.py``), giving per-stage device attribution for free.
+(SURVEY.md §5). Two TPU-native replacements:
+
+* device side — the XLA profiler: :func:`trace` captures a
+  TensorBoard-loadable device trace and :func:`annotate` scopes host work
+  so stage names appear on the timeline; ``PipelineStage`` fit/transform
+  calls are annotated automatically (``core/pipeline.py``).
+* host side — :class:`SpanTracer`: nested spans (pipeline → stage →
+  partition) recorded per thread and exported as ``chrome://tracing`` /
+  Perfetto JSON, so a whole pipeline run is inspectable without
+  TensorBoard. :func:`span` writes to the installed tracer (no-op when
+  none), so library code can annotate unconditionally.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import threading
 import time
 
-__all__ = ["trace", "annotate", "StopWatch"]
+__all__ = ["trace", "annotate", "StopWatch", "SpanTracer", "span"]
 
 from .shared import StopWatch  # re-export: the reference-style wall timer
+
+_ACTIVE = threading.local()  # per-thread install: concurrent tracers in
+#                              different threads must not cross-record
+
+
+class SpanTracer:
+    """Collect nested host-side spans; export Chrome trace JSON.
+
+    >>> with SpanTracer() as t:
+    ...     with span("fit"):
+    ...         with span("stage:LightGBMClassifier"):
+    ...             ...
+    >>> t.export("run.trace.json")   # open in chrome://tracing / Perfetto
+    """
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._tids: dict = {}  # thread ident → small sequential track id
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = self._tids[ident] = len(self._tids)
+        return tid
+
+    # -- recording ----------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            with self._lock:
+                self._events.append({
+                    "name": name, "ph": "X", "pid": 0,
+                    "tid": self._tid(),
+                    "ts": (start - self._t0) * 1e6,
+                    "dur": (end - start) * 1e6,
+                    **({"args": args} if args else {})})
+
+    # -- lifecycle ----------------------------------------------------------
+    def __enter__(self) -> "SpanTracer":
+        self._prev = getattr(_ACTIVE, "tracer", None)
+        _ACTIVE.tracer = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE.tracer = self._prev
+
+    # -- inspection / export -------------------------------------------------
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def total(self, name: str) -> float:
+        """Total seconds spent in spans with this name."""
+        return sum(e["dur"] for e in self.events
+                   if e["name"] == name) / 1e6
+
+    def export(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+def span(name: str, **args):
+    """Span on the calling thread's active :class:`SpanTracer` (plus a
+    device-timeline annotation); cheap no-op when no tracer is installed.
+    Worker threads spawned inside a traced region record through the
+    tracer's own ``span`` method (pass it in), not this accessor."""
+    tracer = getattr(_ACTIVE, "tracer", None)
+    if tracer is None:
+        return annotate(name)
+    stack = contextlib.ExitStack()
+    stack.enter_context(tracer.span(name, **args))
+    stack.enter_context(annotate(name))
+    return stack
 
 
 @contextlib.contextmanager

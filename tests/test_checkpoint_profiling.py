@@ -182,3 +182,43 @@ class TestShardedCheckpointer:
             back = ckpt.restore(target={"w": jnp.zeros(3), "step": 0})
             assert int(back["step"]) == 7
             np.testing.assert_allclose(np.asarray(back["w"]), 1.0)
+
+
+class TestSpanTracer:
+    def test_spans_nest_and_export(self, tmp_path):
+        import json
+        import time
+        from mmlspark_tpu.utils.profiling import SpanTracer, span
+        with SpanTracer() as t:
+            with span("outer"):
+                with span("inner", detail="x"):
+                    time.sleep(0.01)
+        names = [e["name"] for e in t.events]
+        assert names == ["inner", "outer"]  # completion order
+        assert t.total("inner") >= 0.01
+        assert t.total("outer") >= t.total("inner")
+        p = t.export(str(tmp_path / "run.trace.json"))
+        doc = json.load(open(p))
+        assert doc["traceEvents"][0]["ph"] == "X"
+        assert doc["traceEvents"][0]["args"] == {"detail": "x"}
+
+    def test_pipeline_stages_traced_automatically(self):
+        import numpy as np
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+        from mmlspark_tpu.utils.profiling import SpanTracer
+        rng = np.random.default_rng(0)
+        df = DataFrame({"features": [rng.normal(0, 1, 4).astype(np.float32)
+                                     for _ in range(30)],
+                        "label": rng.integers(0, 2, 30).astype(np.float64)})
+        with SpanTracer() as t:
+            model = LightGBMClassifier(num_iterations=2, num_leaves=4).fit(df)
+            model.transform(df)
+        names = {e["name"] for e in t.events}
+        assert "LightGBMClassifier.fit" in names
+        assert any(n.endswith(".transform") for n in names)
+
+    def test_span_noop_without_tracer(self):
+        from mmlspark_tpu.utils.profiling import span
+        with span("orphan"):
+            pass  # must not raise
