@@ -126,7 +126,10 @@ class ShardedCheckpointer:
     def __init__(self, directory: str, max_to_keep: int = 3):
         import orbax.checkpoint as ocp
 
-        self.directory = os.path.abspath(directory)
+        # remote URIs (gs://, s3://) pass through untouched — abspath
+        # would mangle them into bogus local paths
+        self.directory = (directory if "://" in directory
+                          else os.path.abspath(directory))
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
