@@ -82,13 +82,43 @@ def get_label_metadata(df: DataFrame, col: str) -> dict:
 
 # -- vector assembly (reference: FastVectorAssembler) ------------------------
 
-def assemble_vector(df: DataFrame, input_cols: Sequence[str]) -> np.ndarray:
-    """Stack numeric/vector columns into a dense 2-D float array (n, d)."""
+def assemble_vector(df: DataFrame, input_cols: Sequence[str],
+                    allow_none: bool = False) -> np.ndarray:
+    """Stack numeric/vector columns into a dense 2-D float array (n, d).
+
+    Object columns must be fixed-width vectors; with ``allow_none`` a None
+    row becomes NaN (the width comes from the non-None rows — an all-None
+    column is an error, never a silently-zero-width block)."""
     parts = []
     for c in input_cols:
         col = df[c]
         if col.dtype == object:
-            col = np.stack([np.asarray(v, dtype=np.float64).ravel() for v in col])
+            if allow_none and any(v is None for v in col):
+                first = next((v for v in col if v is not None), None)
+                if first is None:
+                    raise ValueError(
+                        f"column {c!r} is entirely None; its vector width "
+                        f"is undefined")
+                width = int(np.asarray(first).size)
+                block = np.full((len(col), width), np.nan)
+                for i, v in enumerate(col):
+                    if v is not None:
+                        arr = np.asarray(v, dtype=np.float64).ravel()
+                        if arr.size != width:
+                            raise ValueError(
+                                f"column {c!r} row {i}: width {arr.size} != "
+                                f"{width} (vectors must be fixed-width)")
+                        block[i] = arr
+                parts.append(block)
+                continue
+            rows = [np.asarray(v, dtype=np.float64).ravel() for v in col]
+            widths = {r.size for r in rows}
+            if len(widths) > 1:
+                raise ValueError(
+                    f"column {c!r} has mixed widths {sorted(widths)} "
+                    f"(vectors must be fixed-width)")
+            col = (np.stack(rows) if rows
+                   else np.zeros((0, 0), dtype=np.float64))
         col = np.asarray(col, dtype=np.float64)
         if col.ndim == 1:
             col = col[:, None]

@@ -15,10 +15,10 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import HasInputCols, HasOutputCol, Param
-from ..core.pipeline import Estimator, Model
+from ..core.pipeline import Estimator, Model, Transformer
 from ..core.schema import get_categorical_levels
 
-__all__ = ["Featurize", "FeaturizeModel"]
+__all__ = ["Featurize", "FeaturizeModel", "VectorAssembler"]
 
 
 def _is_numeric(col: np.ndarray) -> bool:
@@ -120,8 +120,37 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
             else:
                 raise ValueError(f"unknown plan kind {kind!r}")
             parts.append(part)
+        from ..core.dataframe import object_col
         X = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            out[i] = X[i]
-        return df.with_column(self.get("output_col"), out)
+        return df.with_column(self.get("output_col"), object_col(X))
+
+
+class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
+    """Concatenate numeric/vector columns into one feature vector per row.
+
+    Parity: the reference's ``FastVectorAssembler``
+    (``org/apache/spark/ml/feature/FastVectorAssembler.scala`` — its
+    Spark-injection rewrite of VectorAssembler that skips per-row metadata
+    work). Columnar-native here: scalars and fixed-width vector columns
+    concatenate as one dense (n, total_width) block — one allocation, no
+    per-row boxing until the object-column boundary.
+    """
+
+    handle_invalid = Param(str, default="error", choices=["error", "keep"],
+                           doc="'error' raises on NaN/None; 'keep' passes "
+                               "NaN through")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        from ..core.dataframe import object_col
+        from ..core.schema import assemble_vector
+
+        cols = self.get("input_cols")
+        if not cols:
+            raise ValueError(f"{self.uid}: input_cols is empty")
+        X = assemble_vector(df, cols, allow_none=True)
+        if self.handle_invalid == "error" and not np.isfinite(X).all():
+            bad = int(np.argwhere(~np.isfinite(X).all(axis=1)).ravel()[0])
+            raise ValueError(
+                f"non-finite values in assembled features (first bad row "
+                f"{bad}); set handle_invalid='keep' to pass NaN through")
+        return df.with_column(self.get("output_col"), object_col(X))

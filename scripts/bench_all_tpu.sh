@@ -1,0 +1,21 @@
+#!/bin/bash
+# Run every TPU benchmark in sequence, appending JSON lines to
+# ${1:-/tmp/tpu_bench_results.jsonl}. Intended for a healthy-chip window;
+# each bench degrades rather than crashes if the chip goes away mid-run.
+set -u
+OUT="${1:-/tmp/tpu_bench_results.jsonl}"
+cd "$(dirname "$0")/.."
+
+run() {
+    name="$1"; shift
+    echo "=== $name $(date -u +%H:%M:%SZ) ===" >> "$OUT"
+    timeout "${BENCH_TIMEOUT:-600}" "$@" >> "$OUT" 2>/dev/null
+    echo "(rc=$?)" >> "$OUT"
+}
+
+run headline  python bench.py
+run pallas    python scripts/bench_pallas_hist.py
+run configs   python scripts/bench_configs.py
+run gbdt_1m   python scripts/bench_gbdt_higgs.py 1000000
+run longctx   python scripts/bench_long_context.py
+echo "ALL DONE $(date -u)" >> "$OUT"

@@ -123,3 +123,72 @@ def test_featurize_roundtrip(tmp_save):
     np.testing.assert_allclose(
         np.stack(list(loaded.transform(df)["features"])),
         np.stack(list(model.transform(df)["features"])))
+
+
+class TestVectorAssembler:
+    """Parity: FastVectorAssembler (columnar concat, no per-row metadata)."""
+
+    def _df(self):
+        import numpy as np
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        return DataFrame({
+            "a": np.array([1.0, 2.0, 3.0]),
+            "v": object_col([np.array([10.0, 20.0]),
+                             np.array([30.0, 40.0]),
+                             np.array([50.0, 60.0])]),
+            "m": np.arange(6, dtype=np.float32).reshape(3, 2),
+        })
+
+    def test_concatenates_scalars_vectors_and_dense(self):
+        import numpy as np
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        out = VectorAssembler(input_cols=["a", "v", "m"],
+                              output_col="features").transform(self._df())
+        X = np.stack(list(out["features"]))
+        np.testing.assert_allclose(
+            X, [[1, 10, 20, 0, 1], [2, 30, 40, 2, 3], [3, 50, 60, 4, 5]])
+
+    def test_error_on_nan_default(self):
+        import numpy as np
+        import pytest
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        df = DataFrame({"a": np.array([1.0, np.nan])})
+        va = VectorAssembler(input_cols=["a"], output_col="f")
+        with pytest.raises(ValueError, match="non-finite"):
+            va.transform(df)
+        va.set(handle_invalid="keep")
+        out = va.transform(df)
+        assert np.isnan(out["f"][1][0])
+
+    def test_ragged_vector_rejected(self):
+        import numpy as np
+        import pytest
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        df = DataFrame({"v": object_col([np.ones(2), np.ones(3)])})
+        with pytest.raises(ValueError, match="fixed-width"):
+            VectorAssembler(input_cols=["v"], output_col="f").transform(df)
+
+    def test_all_none_column_rejected(self):
+        import numpy as np
+        import pytest
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        df = DataFrame({"v": object_col([None, None])})
+        with pytest.raises(ValueError, match="entirely None"):
+            VectorAssembler(input_cols=["v"], output_col="f",
+                            handle_invalid="keep").transform(df)
+
+    def test_none_rows_become_nan_with_keep(self):
+        import numpy as np
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.core.dataframe import object_col
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        df = DataFrame({"v": object_col([np.array([1.0, 2.0]), None])})
+        out = VectorAssembler(input_cols=["v"], output_col="f",
+                              handle_invalid="keep").transform(df)
+        assert np.isnan(out["f"][1]).all() and len(out["f"][1]) == 2

@@ -125,7 +125,7 @@ def _registry():
     from mmlspark_tpu.featurize.clean_missing import CleanMissingData
     from mmlspark_tpu.featurize.count_selector import CountSelector
     from mmlspark_tpu.featurize.data_conversion import DataConversion
-    from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.featurize.featurize import Featurize, VectorAssembler
     from mmlspark_tpu.featurize.tokenizer import BertTokenizer
     from mmlspark_tpu.featurize.text import (IDF, HashingTF, MultiNGram,
                                              NGram, PageSplitter,
@@ -242,6 +242,9 @@ def _registry():
                           input_col="text", max_len=8), transform_df=df),
         ValueIndexer: lambda: TestObject(
             ValueIndexer(input_col="cat", output_col="idx"), fit_df=df),
+        VectorAssembler: lambda: TestObject(
+            VectorAssembler(input_cols=["num", "features"],
+                            output_col="assembled"), transform_df=df),
         IndexToValue: lambda: TestObject(
             IndexToValue(input_col="idx", output_col="orig"),
             transform_df=ValueIndexer(input_col="cat", output_col="idx")
