@@ -117,8 +117,14 @@ def assemble_vector(df: DataFrame, input_cols: Sequence[str],
                 raise ValueError(
                     f"column {c!r} has mixed widths {sorted(widths)} "
                     f"(vectors must be fixed-width)")
-            col = (np.stack(rows) if rows
-                   else np.zeros((0, 0), dtype=np.float64))
+            if not rows:
+                # a 0-row frame has no width evidence — a silent (0, 0)
+                # block would change the assembled width between empty and
+                # non-empty inputs
+                raise ValueError(
+                    f"column {c!r} is empty; its vector width is undefined "
+                    f"(assemble a non-empty frame, or drop the column)")
+            col = np.stack(rows)
         col = np.asarray(col, dtype=np.float64)
         if col.ndim == 1:
             col = col[:, None]

@@ -13,7 +13,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ..core.dataframe import DataFrame
+from ..core.dataframe import DataFrame, object_col
 from ..core.params import HasInputCols, HasOutputCol, Param
 from ..core.pipeline import Estimator, Model, Transformer
 from ..core.schema import get_categorical_levels
@@ -120,7 +120,6 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
             else:
                 raise ValueError(f"unknown plan kind {kind!r}")
             parts.append(part)
-        from ..core.dataframe import object_col
         X = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
         return df.with_column(self.get("output_col"), object_col(X))
 
@@ -141,7 +140,6 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
                                "NaN through")
 
     def _transform(self, df: DataFrame) -> DataFrame:
-        from ..core.dataframe import object_col
         from ..core.schema import assemble_vector
 
         cols = self.get("input_cols")

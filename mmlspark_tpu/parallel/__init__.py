@@ -1,7 +1,13 @@
 from .mesh import (MeshContext, data_parallel_sharding, device_for_partition,
-                   get_default_mesh, local_devices, make_mesh,
+                   get_default_mesh, get_shard_map, local_devices, make_mesh,
                    replicated_sharding, set_default_mesh)
+from .moe import (init_moe_params, moe_capacity, moe_ffn_gspmd,
+                  moe_ffn_local, moe_ffn_sharded, moe_shardings)
+from .pipeline import pipeline_apply, stack_stage_params, stage_shardings
 
 __all__ = ["MeshContext", "make_mesh", "local_devices", "device_for_partition",
            "data_parallel_sharding", "replicated_sharding",
-           "get_default_mesh", "set_default_mesh"]
+           "get_default_mesh", "set_default_mesh", "get_shard_map",
+           "init_moe_params", "moe_capacity", "moe_ffn_gspmd",
+           "moe_ffn_local", "moe_ffn_sharded", "moe_shardings",
+           "pipeline_apply", "stack_stage_params", "stage_shardings"]

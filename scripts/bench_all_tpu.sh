@@ -8,9 +8,10 @@ cd "$(dirname "$0")/.."
 
 run() {
     name="$1"; shift
-    echo "=== $name $(date -u +%H:%M:%SZ) ===" >> "$OUT"
-    timeout "${BENCH_TIMEOUT:-600}" "$@" >> "$OUT" 2>/dev/null
-    echo "(rc=$?)" >> "$OUT"
+    echo "=== $name $(date -u +%H:%M:%SZ) ===" >> "$OUT.log"
+    # JSON lines to $OUT; human log (incl. stderr diagnostics) to $OUT.log
+    timeout "${BENCH_TIMEOUT:-600}" "$@" > >(tee -a "$OUT.log" | grep '^{' >> "$OUT") 2>> "$OUT.log"
+    echo "($name rc=$?)" >> "$OUT.log"
 }
 
 run headline  python bench.py

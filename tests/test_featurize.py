@@ -129,8 +129,6 @@ class TestVectorAssembler:
     """Parity: FastVectorAssembler (columnar concat, no per-row metadata)."""
 
     def _df(self):
-        import numpy as np
-        from mmlspark_tpu.core import DataFrame
         from mmlspark_tpu.core.dataframe import object_col
         return DataFrame({
             "a": np.array([1.0, 2.0, 3.0]),
@@ -141,7 +139,6 @@ class TestVectorAssembler:
         })
 
     def test_concatenates_scalars_vectors_and_dense(self):
-        import numpy as np
         from mmlspark_tpu.featurize.featurize import VectorAssembler
         out = VectorAssembler(input_cols=["a", "v", "m"],
                               output_col="features").transform(self._df())
@@ -150,9 +147,6 @@ class TestVectorAssembler:
             X, [[1, 10, 20, 0, 1], [2, 30, 40, 2, 3], [3, 50, 60, 4, 5]])
 
     def test_error_on_nan_default(self):
-        import numpy as np
-        import pytest
-        from mmlspark_tpu.core import DataFrame
         from mmlspark_tpu.featurize.featurize import VectorAssembler
         df = DataFrame({"a": np.array([1.0, np.nan])})
         va = VectorAssembler(input_cols=["a"], output_col="f")
@@ -163,9 +157,6 @@ class TestVectorAssembler:
         assert np.isnan(out["f"][1][0])
 
     def test_ragged_vector_rejected(self):
-        import numpy as np
-        import pytest
-        from mmlspark_tpu.core import DataFrame
         from mmlspark_tpu.core.dataframe import object_col
         from mmlspark_tpu.featurize.featurize import VectorAssembler
         df = DataFrame({"v": object_col([np.ones(2), np.ones(3)])})
@@ -173,9 +164,6 @@ class TestVectorAssembler:
             VectorAssembler(input_cols=["v"], output_col="f").transform(df)
 
     def test_all_none_column_rejected(self):
-        import numpy as np
-        import pytest
-        from mmlspark_tpu.core import DataFrame
         from mmlspark_tpu.core.dataframe import object_col
         from mmlspark_tpu.featurize.featurize import VectorAssembler
         df = DataFrame({"v": object_col([None, None])})
@@ -184,11 +172,18 @@ class TestVectorAssembler:
                             handle_invalid="keep").transform(df)
 
     def test_none_rows_become_nan_with_keep(self):
-        import numpy as np
-        from mmlspark_tpu.core import DataFrame
         from mmlspark_tpu.core.dataframe import object_col
         from mmlspark_tpu.featurize.featurize import VectorAssembler
         df = DataFrame({"v": object_col([np.array([1.0, 2.0]), None])})
         out = VectorAssembler(input_cols=["v"], output_col="f",
                               handle_invalid="keep").transform(df)
         assert np.isnan(out["f"][1]).all() and len(out["f"][1]) == 2
+
+    def test_empty_object_column_rejected(self):
+        """A 0-row frame has no width evidence — assembling must not change
+        output width between empty and non-empty inputs."""
+        from mmlspark_tpu.core.dataframe import object_col
+        from mmlspark_tpu.featurize.featurize import VectorAssembler
+        df = DataFrame({"v": object_col([])})
+        with pytest.raises(ValueError, match="width is undefined"):
+            VectorAssembler(input_cols=["v"], output_col="f").transform(df)
