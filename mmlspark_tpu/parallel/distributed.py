@@ -84,13 +84,16 @@ def coordinator_rendezvous(role: str, driver_host: str, driver_port: int,
         coord_port = find_open_port()
         payload = json.dumps({"coordinator": f"{driver_host}:{coord_port}",
                               "num_workers": num_workers}).encode()
+        # bind in the caller so an EADDRINUSE (port raced away between the
+        # probe and here) surfaces to the driver instead of being swallowed
+        # in a daemon thread while workers spin to timeout
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((driver_host, driver_port))
+        srv.listen(num_workers)
+        srv.settimeout(timeout_s)
 
         def serve():
-            srv = socket.socket()
-            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            srv.bind((driver_host, driver_port))
-            srv.listen(num_workers)
-            srv.settimeout(timeout_s)
             served = 0
             try:
                 while served < num_workers:
@@ -98,6 +101,8 @@ def coordinator_rendezvous(role: str, driver_host: str, driver_port: int,
                     with conn:
                         conn.sendall(payload)
                     served += 1
+            except OSError:
+                pass  # timeout or close; workers report their own timeout
             finally:
                 srv.close()
 

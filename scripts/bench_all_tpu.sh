@@ -9,9 +9,12 @@ cd "$(dirname "$0")/.."
 run() {
     name="$1"; shift
     echo "=== $name $(date -u +%H:%M:%SZ) ===" >> "$OUT.log"
-    # JSON lines to $OUT; human log (incl. stderr diagnostics) to $OUT.log
-    timeout "${BENCH_TIMEOUT:-600}" "$@" > >(tee -a "$OUT.log" | grep '^{' >> "$OUT") 2>> "$OUT.log"
-    echo "($name rc=$?)" >> "$OUT.log"
+    # JSON lines to $OUT; human log (incl. stderr diagnostics) to $OUT.log.
+    # A real pipeline (not process substitution) so bash waits for the
+    # writers before the next run's output can interleave.
+    timeout "${BENCH_TIMEOUT:-600}" "$@" 2>> "$OUT.log" \
+        | tee -a "$OUT.log" | grep '^{' >> "$OUT"
+    echo "($name rc=${PIPESTATUS[0]})" >> "$OUT.log"
 }
 
 run headline  python bench.py

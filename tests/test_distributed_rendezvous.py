@@ -1,0 +1,74 @@
+"""Coordinator rendezvous tests (parity: the reference's driver-socket
+host:port exchange, LightGBMBase.scala:399-437 / TrainUtils.scala:237-278 —
+here it only bootstraps jax.distributed, no data plane)."""
+
+import threading
+
+import pytest
+
+from mmlspark_tpu.parallel.distributed import (coordinator_rendezvous,
+                                               find_open_port)
+
+
+def test_driver_and_workers_agree_on_coordinator():
+    port = find_open_port()
+    results = {}
+
+    def worker(i):
+        results[i] = coordinator_rendezvous(
+            "worker", "127.0.0.1", port, num_workers=3, timeout_s=15)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    coord = coordinator_rendezvous("driver", "127.0.0.1", port,
+                                   num_workers=3, timeout_s=15)
+    for t in threads:
+        t.join(15)
+    assert len(results) == 3
+    assert set(results.values()) == {coord}
+    host, p = coord.rsplit(":", 1)
+    assert host == "127.0.0.1" and int(p) > 0
+
+
+def test_worker_times_out_without_driver():
+    with pytest.raises(TimeoutError, match="rendezvous"):
+        coordinator_rendezvous("worker", "127.0.0.1", find_open_port(),
+                               num_workers=1, timeout_s=1.0)
+
+
+def test_workers_can_connect_before_driver_listens():
+    """Workers retry until the driver's listener appears (task start order
+    is arbitrary under gang scheduling)."""
+    port = find_open_port()
+    results = {}
+
+    def late_worker():
+        results["w"] = coordinator_rendezvous(
+            "worker", "127.0.0.1", port, num_workers=1, timeout_s=15)
+
+    t = threading.Thread(target=late_worker)
+    t.start()
+    import time
+    time.sleep(0.5)  # worker is already retrying
+    coord = coordinator_rendezvous("driver", "127.0.0.1", port,
+                                   num_workers=1, timeout_s=15)
+    t.join(15)
+    assert results["w"] == coord
+
+
+def test_driver_bind_conflict_surfaces():
+    """A raced-away listen port must error in the driver, not strand the
+    workers (the serve loop used to swallow EADDRINUSE in a thread)."""
+    import socket
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with pytest.raises(OSError):
+            coordinator_rendezvous("driver", "127.0.0.1", port,
+                                   num_workers=1, timeout_s=2)
+    finally:
+        blocker.close()
