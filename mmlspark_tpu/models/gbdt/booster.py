@@ -60,6 +60,9 @@ class Booster:
         }
         self._pending: List[tuple] = []
         self.best_iteration = best_iteration
+        #: label-ordered categorical encoder (categorical.py); applied to
+        #: raw X before every prediction path when set
+        self.cat_encoder = None
 
     # -- bookkeeping --------------------------------------------------------
     _FIELDS = ("feats", "thr_raw", "leaf_values", "gains", "covers")
@@ -87,16 +90,18 @@ class Booster:
 
     def truncated(self, n_trees: int) -> "Booster":
         """Model truncated to the first n_trees (early-stopping cutoff)."""
-        return Booster(self.depth, self.n_features, self.objective,
-                       self.base_score, self.num_class,
-                       self.feats[:n_trees], self.thr_raw[:n_trees],
-                       self.leaf_values[:n_trees], self.gains[:n_trees],
-                       self.covers[:n_trees], best_iteration=n_trees)
+        b = Booster(self.depth, self.n_features, self.objective,
+                    self.base_score, self.num_class,
+                    self.feats[:n_trees], self.thr_raw[:n_trees],
+                    self.leaf_values[:n_trees], self.gains[:n_trees],
+                    self.covers[:n_trees], best_iteration=n_trees)
+        b.cat_encoder = self.cat_encoder  # trees split in the encoded space
+        return b
 
     def merge(self, other: "Booster") -> "Booster":
         """Concatenate trees (parity: mergeBooster for numBatches training)."""
         assert self.depth == other.depth and self.num_class == other.num_class
-        return Booster(
+        merged = Booster(
             self.depth, self.n_features, self.objective, self.base_score,
             self.num_class,
             np.concatenate([self.feats, other.feats]),
@@ -104,6 +109,8 @@ class Booster:
             np.concatenate([self.leaf_values, other.leaf_values]),
             np.concatenate([self.gains, other.gains]),
             np.concatenate([self.covers, other.covers]))
+        merged.cat_encoder = self.cat_encoder
+        return merged
 
     # -- prediction ---------------------------------------------------------
     # NOTE: thresholds and feature comparisons are float32 end-to-end (the
@@ -111,8 +118,15 @@ class Booster:
     # splits must be distinguishable in float32 (|x| < 2^23 for integer ids, so bin-midpoint
     # thresholds stay representable)
     # — a deliberate deviation from LightGBM's double-precision thresholds.
+    def _x_eff(self, X: np.ndarray) -> np.ndarray:
+        """Raw matrix → the space the trees split in (categorical columns
+        replaced by their label-ordered ranks)."""
+        if self.cat_encoder is not None:
+            X = self.cat_encoder.transform(np.asarray(X))
+        return np.asarray(X, dtype=np.float32)
+
     def raw_score(self, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, dtype=np.float32)
+        X = self._x_eff(X)
         if self.num_trees == 0:
             shape = (len(X), self.num_class) if self.num_class > 1 else (len(X),)
             return np.full(shape, self.base_score, dtype=np.float32)
@@ -129,7 +143,7 @@ class Booster:
         return np.asarray(obj.transform(raw))
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, dtype=np.float32)
+        X = self._x_eff(X)
         return np.asarray(predict_leaf_indices(self.feats, self.thr_raw, X,
                                                depth=self.depth))
 
@@ -139,7 +153,7 @@ class Booster:
         contributions plus the expected value in the last column (the layout
         LightGBM's predict_contrib emits)."""
         from .treeshap import tree_shap
-        X = np.asarray(X, dtype=np.float32)
+        X = self._x_eff(X)
         n = len(X)
         K = self.num_class if self.num_class > 1 else 1
         phi = np.zeros((K, n, self.n_features + 1), dtype=np.float64)
@@ -176,6 +190,8 @@ class Booster:
                 "num_class": self.num_class,
                 "best_iteration": self.best_iteration,
                 "arrays": base64.b64encode(buf.getvalue()).decode("ascii")}
+        if self.cat_encoder is not None:
+            meta["cat_encoder"] = self.cat_encoder.to_dict()
         return json.dumps(meta)
 
     @staticmethod
@@ -184,8 +200,12 @@ class Booster:
         buf = io.BytesIO(base64.b64decode(meta["arrays"]))
         with np.load(buf) as z:
             arrays = {k: z[k] for k in z.files}
-        return Booster(meta["depth"], meta["n_features"], meta["objective"],
-                       meta["base_score"], meta["num_class"],
-                       arrays["feats"], arrays["thr_raw"],
-                       arrays["leaf_values"], arrays["gains"],
-                       arrays["covers"], meta["best_iteration"])
+        b = Booster(meta["depth"], meta["n_features"], meta["objective"],
+                    meta["base_score"], meta["num_class"],
+                    arrays["feats"], arrays["thr_raw"],
+                    arrays["leaf_values"], arrays["gains"],
+                    arrays["covers"], meta["best_iteration"])
+        if "cat_encoder" in meta:
+            from .categorical import CategoricalEncoder
+            b.cat_encoder = CategoricalEncoder.from_dict(meta["cat_encoder"])
+        return b

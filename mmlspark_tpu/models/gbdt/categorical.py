@@ -1,0 +1,92 @@
+"""Categorical feature handling for the GBDT trainer.
+
+Parity surface: the reference detects categorical slots from SparkML
+attribute metadata and passes them to LightGBM's native
+``categorical_feature`` handling (``LightGBMBase.scala:168-199``), where
+splits are optimal category *subsets* found per node by sorting categories
+by gradient statistics (Fisher's trick).
+
+TPU-first redesign: the subset search is approximated **statically** — each
+categorical feature's values are re-indexed once per fit by their mean
+target (the same sufficient ordering LightGBM computes per node, evaluated
+globally), so ordinary threshold splits over the encoded rank correspond to
+contiguous runs of label-ordered categories. This keeps every tree kernel
+(histogram build, split scan, routing, TreeSHAP) untouched and static-
+shaped; the encoder persists inside the booster and is applied on the raw
+matrix before binning/prediction.
+
+Unseen categories at predict time encode as NaN → the missing bin.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["CategoricalEncoder"]
+
+
+class CategoricalEncoder:
+    """Label-ordered rank encoding of selected feature columns."""
+
+    def __init__(self, feature_indices: Sequence[int]):
+        self.feature_indices: List[int] = sorted(int(i)
+                                                 for i in set(feature_indices))
+        #: per feature: category values sorted ascending (lookup keys)
+        self.values: List[np.ndarray] = []
+        #: per feature: rank of each value under the label ordering
+        self.ranks: List[np.ndarray] = []
+
+    # -- fit ----------------------------------------------------------------
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "CategoricalEncoder":
+        self.values, self.ranks = [], []
+        y = np.asarray(y, dtype=np.float64)
+        for j in self.feature_indices:
+            col = np.asarray(X[:, j], dtype=np.float64)
+            ok = ~np.isnan(col)
+            uniq, inv = np.unique(col[ok], return_inverse=True)
+            sums = np.bincount(inv, weights=y[ok], minlength=len(uniq))
+            cnts = np.bincount(inv, minlength=len(uniq)).astype(np.float64)
+            mean = sums / np.maximum(cnts, 1.0)
+            order = np.argsort(mean, kind="stable")
+            rank = np.empty(len(uniq), dtype=np.float64)
+            rank[order] = np.arange(len(uniq), dtype=np.float64)
+            self.values.append(uniq)
+            self.ranks.append(rank)
+        return self
+
+    # -- transform ----------------------------------------------------------
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Return a float copy with categorical columns replaced by their
+        label-ordered ranks (unseen values / NaN → NaN → missing bin)."""
+        if not self.feature_indices:
+            return X
+        # preserve float width: ranks are small integers (exact in float32)
+        # and a HIGGS-scale float32 matrix must not silently double
+        dt = X.dtype if np.asarray(X).dtype.kind == "f" else np.float64
+        out = np.array(X, dtype=dt, copy=True)
+        for (j, vals, rank) in zip(self.feature_indices, self.values,
+                                   self.ranks):
+            col = out[:, j]
+            idx = np.searchsorted(vals, col)
+            idx_c = np.clip(idx, 0, max(len(vals) - 1, 0))
+            seen = (len(vals) > 0) & np.isfinite(col) \
+                & (vals[idx_c] == col) if len(vals) else np.zeros(len(col),
+                                                                  bool)
+            enc = np.where(seen, rank[idx_c] if len(vals) else 0.0, np.nan)
+            out[:, j] = enc
+        return out
+
+    # -- persistence --------------------------------------------------------
+    def to_dict(self) -> Dict:
+        return {"feature_indices": self.feature_indices,
+                "values": [v.tolist() for v in self.values],
+                "ranks": [r.tolist() for r in self.ranks]}
+
+    @staticmethod
+    def from_dict(d: Dict) -> "CategoricalEncoder":
+        enc = CategoricalEncoder(d["feature_indices"])
+        enc.values = [np.asarray(v, dtype=np.float64) for v in d["values"]]
+        enc.ranks = [np.asarray(r, dtype=np.float64) for r in d["ranks"]]
+        return enc

@@ -60,6 +60,11 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                            doc="directory for step-level checkpoint/resume")
     checkpoint_interval = Param(int, default=0,
                                 doc="iterations between checkpoints (0 = off)")
+    categorical_feature = Param((list, int), default=[],
+                                doc="feature-vector indices treated as "
+                                    "categorical (label-ordered rank "
+                                    "encoding; reference "
+                                    "LightGBMBase.scala:168-199)")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
@@ -72,6 +77,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")
         p["tree_learner"] = self.parallelism
+        if self.categorical_feature:
+            p["categorical_feature"] = list(self.categorical_feature)
         p.update(extra)
         return p
 

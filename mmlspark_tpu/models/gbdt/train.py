@@ -58,6 +58,7 @@ _DEFAULTS = dict(
     verbosity=-1,
     checkpoint_dir=None,            # step-level checkpoint/resume
     checkpoint_interval=0,          # iterations between checkpoints (0 = off)
+    categorical_feature=None,       # feature indices with categorical splits
 )
 
 
@@ -203,10 +204,6 @@ def train(params: Dict,
                         alpha=p["alpha"],
                         tweedie_variance_power=p["tweedie_variance_power"])
 
-    mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]))
-    xb = mapper.fit_transform(X)
-    n_bins = mapper.n_bins
-
     # step-level checkpoint/resume (beyond the reference's model-level
     # warm start): a run killed mid-training resumes from the last step
     ckpt = None
@@ -222,11 +219,39 @@ def train(params: Dict,
             init_model = Booster.from_string(
                 TrainingCheckpointer.read_text(files["booster.txt"]))
 
+    X_raw = X
+    cat_encoder = None
+    if p["categorical_feature"] or (init_model is not None
+                                    and init_model.cat_encoder is not None):
+        # label-ordered rank encoding (categorical.py): the static
+        # approximation of LightGBM's per-node category-subset search;
+        # warm starts reuse the prior booster's encoding (its trees split
+        # in that rank space)
+        from .categorical import CategoricalEncoder
+        if init_model is not None and init_model.cat_encoder is not None:
+            cat_encoder = init_model.cat_encoder
+        elif init_model is not None:
+            # the init model's trees split raw values; appending trees that
+            # split rank-encoded values would mix spaces undetectably
+            raise ValueError(
+                "categorical_feature set, but the warm-start model was "
+                "trained without categorical encoding; retrain from "
+                "scratch or drop categorical_feature")
+        else:
+            cat_encoder = CategoricalEncoder(
+                p["categorical_feature"]).fit(X, y)
+        X = cat_encoder.transform(X)
+
+    mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]))
+    xb = mapper.fit_transform(X)
+    n_bins = mapper.n_bins
+
     if init_model is not None:
         booster = init_model
         base_score = booster.base_score
+        # raw_score applies the encoder itself — feed the UN-encoded matrix
         scores = booster.raw_score(
-            X if X.dtype == np.float32 else X.astype(np.float32)
+            X_raw if X_raw.dtype == np.float32 else X_raw.astype(np.float32)
         ).astype(np.float64)
         init_trees = booster.num_trees
     else:
@@ -234,6 +259,7 @@ def train(params: Dict,
         base_score = 0.0 if (is_multi or is_rank) else obj.init_score(y, w)
         booster = Booster(depth, F, objective_name, base_score,
                           num_class if is_multi else 1)
+        booster.cat_encoder = cat_encoder
         scores = np.full((n, num_class) if is_multi else n, base_score)
 
     # device residency; shard rows when data-parallel over a mesh
@@ -311,6 +337,11 @@ def train(params: Dict,
             valid_scores = [np.full((len(vx), num_class) if is_multi else len(vx),
                                     base_score, dtype=np.float64)
                             for vx, _vy in valid_sets]
+        if cat_encoder is not None:
+            # the per-iteration eval path feeds trees directly (bypassing
+            # booster.raw_score), so hand it rank-encoded matrices once
+            valid_sets = [(cat_encoder.transform(np.asarray(vx)), vy)
+                          for vx, vy in valid_sets]
 
     for it in range(n_iter):
         # gradients

@@ -1,0 +1,143 @@
+"""GBDT categorical feature handling (parity: LightGBMBase.scala:168-199 →
+native categorical_feature; here a label-ordered rank encoding makes
+threshold splits select contiguous runs of label-sorted categories)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.models.gbdt.categorical import CategoricalEncoder
+from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+
+class TestCategoricalEncoder:
+    def test_label_ordering(self):
+        # categories 0..3 with mean targets 0.9, 0.1, 0.8, 0.2
+        X = np.array([[0], [0], [1], [1], [2], [2], [3], [3]], np.float64)
+        y = np.array([1, 0.8, 0.1, 0.1, 0.9, 0.7, 0.2, 0.2])
+        enc = CategoricalEncoder([0]).fit(X, y)
+        t = enc.transform(X)[:, 0]
+        # ranks order: 1 (lowest mean) < 3 < 2 < 0
+        assert t[2] < t[6] < t[4] and t[4] < t[0]
+
+    def test_unseen_becomes_nan(self):
+        X = np.array([[1.0], [2.0]])
+        enc = CategoricalEncoder([0]).fit(X, np.array([0.0, 1.0]))
+        out = enc.transform(np.array([[3.0], [1.0]]))
+        assert np.isnan(out[0, 0]) and out[1, 0] == 0.0
+
+    def test_roundtrip_dict(self):
+        X = np.array([[5.0], [7.0], [5.0]])
+        enc = CategoricalEncoder([0]).fit(X, np.array([1.0, 0.0, 1.0]))
+        enc2 = CategoricalEncoder.from_dict(enc.to_dict())
+        np.testing.assert_array_equal(enc2.transform(X), enc.transform(X))
+
+
+def _interleaved_problem(n=400, seed=0):
+    """y = 1 for categories {0, 2}, 0 for {1, 3} — in code order the classes
+    interleave, so ONE ordinal threshold cannot separate them; the label
+    ordering groups {0,2} | {1,3} and a single split suffices."""
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, 4, n).astype(np.float64)
+    y = np.isin(cat, [0, 2]).astype(np.float64)
+    feats = np.stack([cat, rng.normal(0, 1, n)], axis=1)
+    return DataFrame({"features": [f for f in feats], "label": y}), y
+
+
+class TestCategoricalTraining:
+    def test_single_split_separates_interleaved_categories(self):
+        df, y = _interleaved_problem()
+        # depth 1, one tree: only the categorical encoding can win here
+        cat = LightGBMClassifier(num_iterations=1, max_depth=1,
+                                 min_data_in_leaf=1,
+                                 categorical_feature=[0]).fit(df)
+        acc_cat = (np.asarray(cat.transform(df)["prediction"]) == y).mean()
+        plain = LightGBMClassifier(num_iterations=1, max_depth=1,
+                                   min_data_in_leaf=1).fit(df)
+        acc_plain = (np.asarray(plain.transform(df)["prediction"])
+                     == y).mean()
+        assert acc_cat == 1.0
+        assert acc_plain < 0.8  # a single ordinal threshold cannot do it
+
+    def test_save_load_preserves_encoding(self, tmp_path):
+        df, y = _interleaved_problem(seed=1)
+        model = LightGBMClassifier(num_iterations=3, max_depth=2,
+                                   categorical_feature=[0]).fit(df)
+        expect = np.stack([np.asarray(v) for v in
+                           model.transform(df)["probability"]])
+        model.save(str(tmp_path / "m"))
+        m2 = PipelineStage.load(str(tmp_path / "m"))
+        got = np.stack([np.asarray(v) for v in
+                        m2.transform(df)["probability"]])
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    def test_shap_and_leaf_paths_consistent(self):
+        df, y = _interleaved_problem(seed=2)
+        model = LightGBMClassifier(num_iterations=2, max_depth=2,
+                                   categorical_feature=[0],
+                                   features_shap_col="shap",
+                                   leaf_prediction_col="leaf").fit(df)
+        out = model.transform(df)
+        shap = np.stack(list(out["shap"]))
+        raw = model._booster.raw_score(
+            np.stack(list(df["features"])).astype(np.float32))
+        # SHAP efficiency: contributions + expected value sum to raw score
+        np.testing.assert_allclose(shap.sum(axis=1), raw, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_valid_set_eval_uses_encoding(self):
+        df, y = _interleaved_problem(seed=3)
+        ind = np.zeros(len(df), dtype=bool)
+        ind[300:] = True
+        df2 = df.with_column("is_valid", ind)
+        from mmlspark_tpu.models.gbdt.train import train as gbdt_train
+        X = np.stack(list(df2["features"]))
+        eval_log = []
+        gbdt_train({"objective": "binary", "num_iterations": 5,
+                    "max_depth": 1, "min_data_in_leaf": 1,
+                    "categorical_feature": [0], "metric": "auc"},
+                   X[:300], y[:300],
+                   valid_sets=[(X[300:], y[300:])], eval_log=eval_log)
+        assert eval_log and eval_log[-1]["auc"] > 0.95
+
+
+class TestReviewRegressions:
+    def test_early_stopping_keeps_encoder(self):
+        df, y = _interleaved_problem(seed=5)
+        from mmlspark_tpu.models.gbdt.train import train as gbdt_train
+        X = np.stack(list(df["features"]))
+        booster = gbdt_train(
+            {"objective": "binary", "num_iterations": 30, "max_depth": 1,
+             "min_data_in_leaf": 1, "categorical_feature": [0],
+             "early_stopping_round": 2, "metric": "auc"},
+            X[:300], y[:300], valid_sets=[(X[300:], y[300:])])
+        assert booster.cat_encoder is not None  # survives truncation
+        pred = (booster.predict(X.astype(np.float32)) > 0.5).astype(float)
+        assert (pred == y).mean() == 1.0
+
+    def test_merge_keeps_encoder(self):
+        df, y = _interleaved_problem(seed=6)
+        from mmlspark_tpu.models.gbdt.train import train as gbdt_train
+        X = np.stack(list(df["features"]))
+        params = {"objective": "binary", "num_iterations": 2, "max_depth": 1,
+                  "min_data_in_leaf": 1, "categorical_feature": [0]}
+        b = gbdt_train(params, X, y)
+        merged = b.merge(b.truncated(1))
+        assert merged.cat_encoder is not None
+
+    def test_warm_start_without_encoder_rejected(self):
+        df, y = _interleaved_problem(seed=7)
+        from mmlspark_tpu.models.gbdt.train import train as gbdt_train
+        X = np.stack(list(df["features"]))
+        plain = gbdt_train({"objective": "binary", "num_iterations": 2,
+                            "max_depth": 1}, X, y)
+        with pytest.raises(ValueError, match="warm-start"):
+            gbdt_train({"objective": "binary", "num_iterations": 2,
+                        "max_depth": 1, "categorical_feature": [0]},
+                       X, y, init_model=plain)
+
+    def test_transform_preserves_float32(self):
+        X = np.array([[1.0, 5.0], [2.0, 6.0]], dtype=np.float32)
+        enc = CategoricalEncoder([0]).fit(X, np.array([0.0, 1.0]))
+        assert enc.transform(X).dtype == np.float32
