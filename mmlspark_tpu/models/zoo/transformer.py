@@ -44,6 +44,15 @@ class TransformerConfig(NamedTuple):
     #: weight of the Switch/GShard load-balance loss (keeps the router from
     #: collapsing onto one expert, which silently drops tokens)
     moe_aux_weight: float = 0.01
+    #: route attention through the Pallas flash kernel (``ops/flash_attention``)
+    #: — O(S) memory streaming softmax instead of the (B, H, S, S) score
+    #: matrix; on a mesh it mounts per-shard via shard_map (heads on tp).
+    #: Semantics differ from the dense path only for a row whose mask is
+    #: all-False (a fully-padded sequence): dense -1e9 bias degenerates to
+    #: uniform attention (mean of v), flash yields exact zeros — the
+    #: better-defined output, but flip-sensitive if a consumer pools padded
+    #: rows without masking
+    use_flash: bool = False
 
     def is_moe_layer(self, i: int) -> bool:
         return (self.moe_experts > 0 and self.moe_every > 0
@@ -183,13 +192,22 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
             return t.reshape(B, S, cfg.heads, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) / np.sqrt(hd)
-        if bias is not None:
-            scores = scores + bias
-        attn = jax.nn.softmax(scores, axis=-1).astype(dt)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v,
-                         preferred_element_type=dt)
+        if cfg.use_flash:
+            from ...ops.flash_attention import (flash_attention,
+                                                flash_attention_sharded)
+            if mesh is not None:
+                ctx = flash_attention_sharded(q, k, v, mesh, kv_mask=mask)
+            else:
+                ctx = flash_attention(q, k, v, kv_mask=mask)
+            ctx = ctx.astype(dt)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) / np.sqrt(hd)
+            if bias is not None:
+                scores = scores + bias
+            attn = jax.nn.softmax(scores, axis=-1).astype(dt)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v,
+                             preferred_element_type=dt)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
         proj = ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
         h = h + constrain(proj, P("dp", "tp", None))  # back to sequence-parallel

@@ -1,5 +1,6 @@
+from .flash_attention import flash_attention, flash_attention_sharded
 from .padding import (PaddedBatch, bucket_size, default_buckets, pad_axis,
                       pad_batch, unpad)
 
-__all__ = ["PaddedBatch", "bucket_size", "default_buckets", "pad_axis",
-           "pad_batch", "unpad"]
+__all__ = ["PaddedBatch", "bucket_size", "default_buckets", "flash_attention",
+           "flash_attention_sharded", "pad_axis", "pad_batch", "unpad"]

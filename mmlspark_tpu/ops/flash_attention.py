@@ -1,0 +1,331 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+The reference never shards or tiles attention (its largest models run whole
+through ONNX sessions; SURVEY.md §5 "long-context: absent"), so this module
+is pure beyond-parity TPU work: the standard attention in
+``models/zoo/transformer.py`` and ``parallel/ring.py:36`` materializes the
+full ``(B, H, S, S)`` score matrix in HBM — O(S²) memory and two extra HBM
+round-trips. This kernel streams K/V blocks through VMEM keeping running
+max/denominator accumulators (the FlashAttention recurrence), so HBM traffic
+is one read of Q/K/V plus one write of O, and the score block lives only in
+VMEM where the MXU consumes it.
+
+Design notes (TPU-first):
+
+* grid = (B*H, S/block_q, S/block_k) with the K dimension innermost; the
+  output block index ignores the K step, so Pallas keeps O resident in VMEM
+  across the whole K sweep and writes it back once.
+* running ``m``/``l`` live in VMEM scratch shaped ``(block_q, LANE)`` —
+  scalars-per-row are replicated across the 128-lane axis, the natural VPU
+  layout (a ``(block_q, 1)`` buffer would fight the tiling rules).
+* masked logits use a large-negative constant, not ``-inf``: with ``-inf``
+  a fully-masked row makes ``exp(m - m)`` produce NaN; with ``-1e30`` the
+  row cleanly yields ``l == 0`` and the final divide guards it to 0.
+* the backward pass recomputes probabilities blockwise from the saved
+  ``(m, l)`` statistics in a ``lax.scan`` — O(S·block) memory, XLA-fused;
+  dq/dk/dv each come from one MXU matmul per block.
+
+For sharded use inside a dp×tp jit (where a bare ``pallas_call`` would make
+GSPMD gather the operands onto one device) use
+:func:`flash_attention_sharded`, which mounts the kernel per-shard via
+``shard_map`` — attention is batch- and head-local, so no collectives are
+needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_kernels import _LANE, _round_up
+
+__all__ = ["flash_attention", "flash_attention_sharded"]
+
+_NEG = -1e30
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *rest, scale, causal,
+               block_q, block_k, n_k, with_stats):
+    """One (bh, iq, ik) grid step of the streaming-softmax recurrence."""
+    from jax.experimental import pallas as pl
+
+    if with_stats:
+        l_ref, m_ref, macc_ref, lacc_ref, acc_ref = rest
+    else:
+        macc_ref, lacc_ref, acc_ref = rest
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        macc_ref[...] = jnp.full_like(macc_ref, _NEG)
+        lacc_ref[...] = jnp.zeros_like(lacc_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)                   # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                   # (bk, D)
+        v = v_ref[0].astype(jnp.float32)                   # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (bq, bk)
+
+        valid = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0,
+                                 (block_q, block_k))
+        if causal:
+            row = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = jnp.logical_and(valid, row >= col)
+        s = jnp.where(valid, s, _NEG)
+
+        m_prev = macc_ref[:, 0:1]                          # (bq, 1)
+        l_prev = lacc_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # `valid` (not the _NEG sentinel) zeroes masked probabilities: for a
+        # row with every key masked so far, m_new == _NEG and exp(s - m_new)
+        # would be exp(0) == 1 on the masked entries.
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)  # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)                      # <= 1
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, D)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        macc_ref[...] = jnp.broadcast_to(m_new, macc_ref.shape)
+        lacc_ref[...] = jnp.broadcast_to(l_new, lacc_ref.shape)
+
+    if causal:
+        # blocks strictly above the diagonal band contribute nothing
+        @pl.when(ik * block_k < (iq + 1) * block_q)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == n_k - 1)
+    def _fin():
+        l = lacc_ref[:, 0:1]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        if with_stats:
+            # stats stay lane-replicated, (block_q, LANE) — a (1, block_q)
+            # block would put 1 in the sublane slot, which Mosaic rejects
+            # whenever BH > 1
+            l_ref[0] = lacc_ref[...]
+            m_ref[0] = macc_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret", "heads",
+    "with_stats"))
+def _flash_fwd(q, k, v, kv_mask, *, causal, scale, block_q, block_k,
+               interpret, heads, with_stats):
+    """(BH, S, D) inputs (already padded) → o, or (o, l, m) with the softmax
+    stats lane-replicated as (BH, S, LANE) when the VJP needs residuals."""
+    from jax.experimental import pallas as pl
+
+    BH, S, D = q.shape
+    n_q, n_k = S // block_q, S // block_k
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k,
+                               with_stats=with_stats)
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
+    if with_stats:
+        out_specs += [
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((BH, S, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, _LANE), jnp.float32),
+        ]
+    outs = pl.pallas_call(
+        kernel,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            # (B, 1, S) with a (1, 1, block_k) block: the singleton in the
+            # sublane slot equals the full dim, keeping Mosaic's tiling rule
+            # satisfied for any B (a 2-D (1, block_k) block is rejected
+            # whenever B > 1)
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads, 0, j)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            # pltpu scratch constructors; resolved lazily so interpret mode
+            # keeps working on non-TPU backends
+            _vmem((block_q, _LANE), jnp.float32),
+            _vmem((block_q, _LANE), jnp.float32),
+            _vmem((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, kv_mask[:, None, :])
+    if with_stats:
+        o, l, m = outs
+        return o, l[:, :, 0], m[:, :, 0]
+    return outs[0], None, None
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+def _fa_reference_block_bwd(q, k, v, mask, o, l, m, do, *, causal, scale,
+                            block_k):
+    """Memory-efficient backward for ONE (S, D) head: lax.scan over K blocks
+    recomputing p from the saved (m, l) row statistics."""
+    S, D = q.shape
+    n_k = S // block_k
+    linv = jnp.where(l == 0.0, 0.0, 1.0 / l)               # (S,)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                               # (S,)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    rows = jnp.arange(S)
+
+    kb = k.reshape(n_k, block_k, D)
+    vb = v.reshape(n_k, block_k, D)
+    mb = mask.reshape(n_k, block_k)
+
+    def body(dq, blk):
+        j, kj, vj, mj = blk
+        s = (qf @ kj.astype(jnp.float32).T) * scale        # (S, bk)
+        valid = jnp.broadcast_to(mj[None, :] != 0, s.shape)
+        if causal:
+            col = j * block_k + jnp.arange(block_k)
+            valid = jnp.logical_and(valid, rows[:, None] >= col[None, :])
+        p = jnp.exp(jnp.where(valid, s, _NEG) - m[:, None]) * \
+            valid.astype(jnp.float32) * linv[:, None]      # (S, bk)
+        dp = dof @ vj.astype(jnp.float32).T                # (S, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        dq = dq + ds @ kj.astype(jnp.float32)
+        dkj = ds.T @ qf                                    # (bk, D)
+        dvj = p.T @ dof
+        return dq, (dkj, dvj)
+
+    dq, (dk, dv) = jax.lax.scan(
+        body, jnp.zeros((S, D), jnp.float32),
+        (jnp.arange(n_k), kb, vb, mb))
+    return (dq.astype(q.dtype), dk.reshape(S, D).astype(k.dtype),
+            dv.reshape(S, D).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret,
+           heads):
+    o, _, _ = _flash_fwd(q, k, v, kv_mask, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, heads=heads, with_stats=False)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, kv_mask, causal, scale, block_q, block_k,
+                   interpret, heads):
+    o, l, m = _flash_fwd(q, k, v, kv_mask, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, heads=heads, with_stats=True)
+    return o, (q, k, v, kv_mask, o, l, m)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, heads,
+                   res, do):
+    q, k, v, kv_mask, o, l, m = res
+    mask_bh = jnp.repeat(kv_mask, heads, axis=0)           # (BH, S)
+    bwd = functools.partial(_fa_reference_block_bwd, causal=causal,
+                            scale=scale, block_k=block_k)
+    dq, dk, dv = jax.vmap(bwd)(q, k, v, mask_bh, o, l, m, do)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    kv_mask: Optional[jnp.ndarray] = None,
+                    scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 1024,
+                    interpret: Optional[bool] = None):
+    """Streaming-softmax attention, ``(B, H, S, D)`` layout.
+
+    Differentiable (custom VJP with blockwise recompute), O(S) memory.
+    ``kv_mask`` is a ``(B, S)`` key-validity mask (True = attend), the
+    BERT-style padding mask. Sequences are padded internally to the block
+    size; padded keys are masked out and padded query rows are sliced off.
+    ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
+
+    Default blocks (512, 1024) are the v5e sweep winner: 1.5× faster than
+    the XLA dense path at S=16K (82 vs 122 ms, 12 heads, d=64, bf16) while
+    the dense path stops compiling at all past ~32K.
+    """
+    B, H, S, D = q.shape
+    if interpret is None:
+        interpret = _auto_interpret()
+    if scale is None:
+        scale = 1.0 / float(D) ** 0.5
+
+    # block sizes must be lane-aligned for the Mosaic lowering, and the
+    # padded length must divide by BOTH (the kernel grid and the backward
+    # reshape floor-divide by them), hence the LCM; one block covering a
+    # short sequence beats padding to 2+ blocks
+    block_q = min(_round_up(block_q, _LANE), _round_up(S, _LANE))
+    block_k = min(_round_up(block_k, _LANE), _round_up(S, _LANE))
+    lcm = block_q * block_k // math.gcd(block_q, block_k)
+    Sp = _round_up(S, lcm)
+
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, S), jnp.bool_)
+    mask_p = jnp.pad(kv_mask.astype(jnp.int32), ((0, 0), (0, Sp - S)))
+
+    def pad(t):
+        return jnp.pad(t, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+
+    qp = pad(q).reshape(B * H, Sp, D)
+    kp = pad(k).reshape(B * H, Sp, D)
+    vp = pad(v).reshape(B * H, Sp, D)
+
+    o = _flash(qp, kp, vp, mask_p, causal, float(scale), block_q, block_k,
+               bool(interpret), H)
+    return o.reshape(B, H, Sp, D)[:, :, :S, :]
+
+
+def flash_attention_sharded(q, k, v, mesh, *, dp_axis: str = "dp",
+                            tp_axis: str = "tp", **kwargs):
+    """Flash attention inside a dp×tp program: batch sharded over
+    ``dp_axis``, heads over ``tp_axis``, per-shard Pallas call via
+    ``shard_map`` (attention is batch/head-local — no collectives)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import get_shard_map
+
+    shard_map, unchecked = get_shard_map()
+    kv_mask = kwargs.pop("kv_mask", None)
+    spec = P(dp_axis, tp_axis, None, None)
+
+    if kv_mask is None:
+        def fn(q, k, v):
+            return flash_attention(q, k, v, **kwargs)
+        return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, **unchecked)(q, k, v)
+
+    def fn(q, k, v, m):
+        return flash_attention(q, k, v, kv_mask=m, **kwargs)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, P(dp_axis, None)),
+                     out_specs=spec, **unchecked)(q, k, v, kv_mask)

@@ -1,0 +1,182 @@
+"""Flash attention kernel vs the reference O(S²) attention.
+
+All on the CPU interpreter (`interpret=True` auto-selected off-TPU);
+numerical parity is against ``parallel/ring.local_attention`` and hand-built
+masked softmax. On-chip timing lives in ``scripts/bench_long_context.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.flash_attention import (flash_attention,
+                                              flash_attention_sharded)
+from mmlspark_tpu.parallel.ring import local_attention
+
+
+def _rand_qkv(rng, B=2, H=2, S=256, D=64, dtype=jnp.float32):
+    q = jnp.asarray(rng.normal(0, 1, (B, H, S, D)), dtype)
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)), dtype)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)), dtype)
+    return q, k, v
+
+
+def _reference(q, k, v, causal=False, kv_mask=None):
+    S = q.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(q.shape[-1])
+    neg = jnp.float32(-1e30)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, neg)
+    if causal:
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(tri[None, None], s, neg)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(q.dtype)
+
+
+def test_matches_reference_full(rng):
+    q, k, v = _rand_qkv(rng)
+    out = flash_attention(q, k, v)
+    ref = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_causal(rng):
+    q, k, v = _rand_qkv(rng, S=256)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kv_mask(rng):
+    q, k, v = _rand_qkv(rng, B=2, S=256)
+    mask = jnp.asarray(rng.random((2, 256)) > 0.3)
+    out = flash_attention(q, k, v, kv_mask=mask)
+    ref = _reference(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_unaligned_seq_pads(rng):
+    q, k, v = _rand_qkv(rng, S=200)
+    out = flash_attention(q, k, v)
+    ref = local_attention(q, k, v)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_short_seq_single_block(rng):
+    q, k, v = _rand_qkv(rng, S=48, D=32)
+    out = flash_attention(q, k, v)
+    ref = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fully_masked_rows_yield_zero_not_nan(rng):
+    q, k, v = _rand_qkv(rng, B=1, H=1, S=128)
+    mask = jnp.zeros((1, 128), bool)
+    out = np.asarray(flash_attention(q, k, v, kv_mask=mask))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_bfloat16_io(rng):
+    q, k, v = _rand_qkv(rng, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(rng, causal):
+    q, k, v = _rand_qkv(rng, B=1, H=2, S=128, D=32)
+    mask = jnp.asarray(rng.random((1, 128)) > 0.2)
+    ct = jnp.asarray(rng.normal(0, 1, q.shape), jnp.float32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       kv_mask=mask) * ct)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal=causal, kv_mask=mask) * ct)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_sharded_matches_unsharded(rng):
+    from jax.sharding import Mesh
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("dp", "tp"))
+    q, k, v = _rand_qkv(rng, B=4, H=4, S=128, D=32)
+    mask = jnp.asarray(rng.random((4, 128)) > 0.3)
+    out = flash_attention_sharded(q, k, v, mesh, kv_mask=mask)
+    ref = flash_attention(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_transformer_use_flash_matches_dense(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     init_transformer,
+                                                     transformer_apply)
+
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=64, dtype=jnp.float32)
+    params = init_transformer(cfg, seed=0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab, (2, 48)))
+    mask = jnp.asarray(rng.random((2, 48)) > 0.2)
+    dense = transformer_apply(params, ids, cfg, mask=mask)
+    flash = transformer_apply(params, ids, cfg._replace(use_flash=True),
+                              mask=mask)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_use_flash_on_mesh(rng):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     init_transformer,
+                                                     shardings_for,
+                                                     transformer_apply)
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("dp", "tp"))
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=64, dtype=jnp.float32,
+                            use_flash=True)
+    params = init_transformer(cfg, seed=0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab, (4, 64)))
+    sharded_p = jax.device_put(params, shardings_for(params, mesh))
+    sharded_ids = jax.device_put(ids, NamedSharding(mesh, P("dp", None)))
+    out = jax.jit(lambda p, i: transformer_apply(p, i, cfg, mesh))(
+        sharded_p, sharded_ids)
+    ref = transformer_apply(params, ids, cfg._replace(use_flash=False))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mismatched_block_sizes(rng):
+    # regression: Sp must be a multiple of BOTH block sizes (LCM), else
+    # trailing query rows are silently never computed
+    q, k, v = _rand_qkv(rng, B=1, H=1, S=128, D=32)
+    out = flash_attention(q, k, v, block_q=96, block_k=128)
+    ref = local_attention(q, k, v)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
