@@ -149,9 +149,36 @@ def main():
     jax.block_until_ready(jax.device_put(probe))
     h2d_gbps = round(probe.nbytes / (time.perf_counter() - t0) / 1e9, 3)
 
+    # Device-resident compute rate: what the chip sustains once inputs are
+    # on device — separates the framework from the session's tunnel, whose
+    # congestion can swing end-to-end 100x between runs. Fencing is a
+    # fetched scalar depending on the LAST dispatched call (in-order device
+    # execution fences the earlier ones; block_until_ready is unreliable
+    # behind the tunnel).
+    device_ips = None
+    try:
+        import jax.numpy as jnp
+        jitted = m._ensure_jitted()
+        params = m._params_for_device(None)
+        xdev = jax.device_put(X[:batch])
+        rows_timed = int(xdev.shape[0])     # may be < batch when BENCH_ROWS is
+        tail = jax.jit(lambda c: jnp.sum(c["logits"][0, :2]
+                                         .astype(jnp.float32)))
+        float(tail(jitted(params, {"input": xdev})))   # compile + warm
+        reps = 3 if platform == "cpu" else 20
+        t0 = time.perf_counter()
+        outs = None
+        for _ in range(reps):
+            outs = jitted(params, {"input": xdev})
+        float(tail(outs))
+        device_ips = round(rows_timed * reps / (time.perf_counter() - t0), 2)
+    except Exception:
+        pass
+
     # MFU: per-image FLOPs straight from XLA's cost model for the compiled
     # program (not a hand-waved constant), peak from the device spec.
     mfu = None
+    device_mfu = None
     try:
         import jax.numpy as jnp
         compiled = m._jitted.lower(
@@ -164,6 +191,8 @@ def main():
         peak = _peak_for(device_kind)
         if flops_per_img and peak:
             mfu = round(ips * flops_per_img / peak, 4)
+            if device_ips:
+                device_mfu = round(device_ips * flops_per_img / peak, 4)
     except Exception:
         mfu = None
 
@@ -175,6 +204,8 @@ def main():
         "platform": platform,
         "device": device_kind,
         "mfu": mfu,
+        "device_resident_ips": device_ips,
+        "device_mfu": device_mfu,
         "h2d_gbps": h2d_gbps,
     }
     if platform != "tpu":

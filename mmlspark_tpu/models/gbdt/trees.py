@@ -55,8 +55,10 @@ def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
     (``ops/pallas_kernels.py``, used on TPU) and an XLA ``segment_sum``
     fallback. Both replace LightGBM's native C++ histogram construction.
     """
-    from ...ops.pallas_kernels import histogram_enabled, level_histogram_pallas
-    if histogram_enabled():
+    from ...ops.pallas_kernels import (histogram_enabled,
+                                       level_histogram_pallas,
+                                       pallas_preferred)
+    if histogram_enabled() and pallas_preferred(xb.shape[0], n_nodes, n_bins):
         # force-on off-TPU runs the interpreter (Mosaic can't compile there)
         hist = level_histogram_pallas(xb, node_rel, g, h, w_count,
                                       n_nodes, n_bins,

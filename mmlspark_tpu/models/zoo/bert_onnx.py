@@ -242,11 +242,32 @@ def export_bert_onnx(cfg: BertOnnxConfig = BertOnnxConfig(), seed: int = 0,
     # rename final output
     g.nodes.append(make_node("Identity", [x], ["last_hidden_state"]))
 
+    # mask-weighted mean pooling → "pooled" (B, D): the sentence-embedding
+    # output (sentence-transformers' mean_pooling pattern). Fetching this
+    # instead of last_hidden_state cuts the device→host transfer by S×,
+    # which is what the BASELINE config #3 pipeline actually wants.
+    mexp = g.unsqueeze(mf, [2])                       # (B, S, 1)
+    xm = g.add("Mul", [x, mexp])
+    if opset >= 13:
+        ssum = g.add("ReduceSum", [xm, g.const(np.array([1], np.int64))],
+                     keepdims=0)
+        cnt = g.add("ReduceSum", [mexp, g.const(np.array([1], np.int64))],
+                    keepdims=0)
+    else:
+        ssum = g.add("ReduceSum", [xm], axes=[1], keepdims=0)
+        cnt = g.add("ReduceSum", [mexp], axes=[1], keepdims=0)
+    cnt = g.add("Clip", [cnt, g.const(np.array(1e-9, np.float32)),
+                         g.const(np.array(3.4e38, np.float32))])
+    pooled = g.add("Div", [ssum, cnt])
+    g.nodes.append(make_node("Identity", [pooled], ["pooled"]))
+
     graph = make_graph(
         g.nodes, "bert_encoder",
         inputs=[make_tensor_value_info(ids, np.int64, ("batch", "seq")),
                 make_tensor_value_info(mask, np.int64, ("batch", "seq"))],
         outputs=[make_tensor_value_info("last_hidden_state", np.float32,
-                                        ("batch", "seq", cfg.d_model))],
+                                        ("batch", "seq", cfg.d_model)),
+                 make_tensor_value_info("pooled", np.float32,
+                                        ("batch", cfg.d_model))],
         initializers=g.inits)
     return make_model(graph, opset=opset, producer="pytorch-style")

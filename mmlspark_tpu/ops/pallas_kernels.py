@@ -31,7 +31,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["level_histogram_pallas", "histogram_enabled"]
+__all__ = ["level_histogram_pallas", "histogram_enabled", "pallas_preferred"]
 
 _LANE = 128
 
@@ -43,6 +43,33 @@ def histogram_enabled() -> bool:
     if flag in ("0", "false", "off"):
         return False
     return jax.default_backend() == "tpu"
+
+
+def pallas_preferred(n_rows: int, n_nodes: int, n_bins: int,
+                     combined_limit: int = 6 * 1024 * 1024) -> bool:
+    """Per-level builder choice, from v5e measurements (1M×28×255 bins):
+    Pallas 231 ms vs segment_sum 488 ms at 8 nodes, but 922 vs 488 at 32 —
+    the kernel is fast exactly while its autotuned row_block stays large
+    enough to keep the single fused MXU matmul busy (≥256 rows/step).
+    segment_sum, meanwhile, stops compiling at all somewhere between 1M and
+    4M rows (a 57 GB one-hot temp), so above that Pallas is the only
+    builder regardless of depth. ``MMLSPARK_TPU_PALLAS=1`` forces the
+    kernel everywhere (tests use this to exercise it)."""
+    if os.environ.get("MMLSPARK_TPU_PALLAS", "auto").lower() in ("1", "true",
+                                                                 "on"):
+        return True
+    if n_rows > 1_500_000:
+        return True
+    return _fused_row_block(n_nodes, n_bins, combined_limit) >= 256
+
+
+def _fused_row_block(n_nodes: int, n_bins: int, combined_limit: int) -> int:
+    """Largest lane-aligned row block whose fused (node·bin) one-hot stays
+    inside the VMEM budget — shared by the kernel's autotune and the
+    builder-choice heuristic so they cannot drift apart."""
+    bpad = _round_up(max(n_bins, _LANE), _LANE)
+    fused_max = combined_limit // (n_nodes * bpad * 4)
+    return max(_LANE, min(512, (fused_max // _LANE) * _LANE))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -102,9 +129,7 @@ def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
     fallback is ~MXU-starved once n_nodes grows).
     """
     if row_block == 0:
-        bpad = _round_up(max(n_bins, _LANE), _LANE)
-        fused_max = combined_limit // (n_nodes * bpad * 4)
-        row_block = max(_LANE, min(512, (fused_max // _LANE) * _LANE))
+        row_block = _fused_row_block(n_nodes, n_bins, combined_limit)
     return _level_histogram_pallas(xb, node_rel, g, h, w_count,
                                    n_nodes=n_nodes, n_bins=n_bins,
                                    row_block=row_block, interpret=interpret,

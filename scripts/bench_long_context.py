@@ -43,6 +43,16 @@ def main():
     mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
     B, H, D = (1, 4, 16) if SMALL else (1, 12, 64)
     seqs = [256, 512] if SMALL else [4096, 16384, 65536]
+    # remote compiles at 64K take minutes each; let a driver scope a run
+    if os.environ.get("BENCH_SEQS"):
+        seqs = [int(s) for s in os.environ["BENCH_SEQS"].split(",")]
+    impls = tuple(s.strip() for s in os.environ.get(
+        "BENCH_IMPLS", "full,flash,ring,ulysses").split(",") if s.strip())
+    unknown = set(impls) - {"full", "flash", "ring", "ulysses"}
+    if unknown:
+        # an unvalidated name would silently fall through to the ulysses
+        # branch and publish a mislabeled timing
+        raise SystemExit(f"unknown BENCH_IMPLS {sorted(unknown)}")
 
     rng = np.random.default_rng(0)
     for S in seqs:
@@ -51,7 +61,7 @@ def main():
         v = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
         results = {}
         full_out = None
-        for impl in ("full", "flash", "ring", "ulysses"):
+        for impl in impls:
             try:
                 if impl == "full":
                     fn = jax.jit(local_attention)

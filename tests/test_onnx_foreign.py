@@ -169,3 +169,20 @@ def test_softmax_pre13_coercion_semantics():
     e = np.exp(flat - flat.max(-1, keepdims=True))
     want = (e / e.sum(-1, keepdims=True)).reshape(2, 3, 4)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("opset", [11, 13, 17])
+def test_bert_pooled_sentence_embedding(opset):
+    """The `pooled` output is the mask-weighted mean of last_hidden_state
+    over non-padding positions — the sentence-transformers mean_pooling
+    pattern, (B, D) instead of (B, S, D)."""
+    params = init_bert_params(CFG, seed=3)
+    cm = convert_model(export_bert_onnx(CFG, opset=opset, params=params))
+    ids, mask = _bert_io()
+    out = cm(cm.params, {"input_ids": ids, "attention_mask": mask})
+    hidden = np.asarray(out["last_hidden_state"])
+    pooled = np.asarray(out["pooled"])
+    m = mask[..., None].astype(np.float32)
+    want = (hidden * m).sum(axis=1) / m.sum(axis=1)
+    assert pooled.shape == (ids.shape[0], CFG.d_model)
+    np.testing.assert_allclose(pooled, want, rtol=2e-4, atol=2e-5)

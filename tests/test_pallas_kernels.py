@@ -88,3 +88,31 @@ def test_gbdt_training_with_pallas_interpret(rng, monkeypatch):
     out = model.transform(df)
     acc = (out["prediction"] == y).mean()
     assert acc > 0.9
+
+
+class TestPallasPreferred:
+    """Per-level builder choice (v5e-measured crossovers)."""
+
+    def test_shallow_levels_prefer_pallas(self):
+        from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
+        assert pallas_preferred(1_000_000, 8, 255)
+
+    def test_deep_levels_prefer_segment_sum(self):
+        from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
+        import os
+        prev = os.environ.pop("MMLSPARK_TPU_PALLAS", None)
+        try:
+            assert not pallas_preferred(1_000_000, 32, 255)
+        finally:
+            if prev is not None:
+                os.environ["MMLSPARK_TPU_PALLAS"] = prev
+
+    def test_huge_row_counts_always_pallas(self):
+        # segment_sum stops compiling entirely (57 GB one-hot temp)
+        from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
+        assert pallas_preferred(4_000_000, 32, 255)
+
+    def test_force_flag_wins(self, monkeypatch):
+        from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
+        monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "1")
+        assert pallas_preferred(1_000, 64, 255)
