@@ -1,6 +1,8 @@
-from .flash_attention import flash_attention, flash_attention_sharded
+from .flash_attention import (flash_attention, flash_attention_sharded,
+                              flash_attention_with_stats)
 from .padding import (PaddedBatch, bucket_size, default_buckets, pad_axis,
                       pad_batch, unpad)
 
 __all__ = ["PaddedBatch", "bucket_size", "default_buckets", "flash_attention",
-           "flash_attention_sharded", "pad_axis", "pad_batch", "unpad"]
+           "flash_attention_sharded", "flash_attention_with_stats",
+           "pad_axis", "pad_batch", "unpad"]

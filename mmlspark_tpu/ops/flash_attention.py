@@ -43,7 +43,8 @@ import jax.numpy as jnp
 
 from .pallas_kernels import _LANE, _round_up
 
-__all__ = ["flash_attention", "flash_attention_sharded"]
+__all__ = ["flash_attention", "flash_attention_sharded",
+           "flash_attention_with_stats"]
 
 _NEG = -1e30
 
@@ -303,6 +304,43 @@ def flash_attention(q, k, v, *, causal: bool = False,
     o = _flash(qp, kp, vp, mask_p, causal, float(scale), block_q, block_k,
                bool(interpret), H)
     return o.reshape(B, H, Sp, D)[:, :, :S, :]
+
+
+def flash_attention_with_stats(q, k, v, *, scale: Optional[float] = None,
+                               block_q: int = 512, block_k: int = 1024,
+                               interpret: Optional[bool] = None):
+    """Forward-only flash attention that also returns the softmax statistics
+    ``(o, l, m)`` — o ``(B, H, S, D)``, l/m fp32 ``(B, H, S)``.
+
+    The stats let a caller merge partial attention results computed over
+    disjoint key sets (log-sum-exp merge), which is exactly what ring
+    attention does as K/V blocks rotate: see ``parallel/ring.ring_attention``
+    with ``use_flash=True``. Not differentiable (no VJP through the stats)."""
+    B, H, S, D = q.shape
+    if interpret is None:
+        interpret = _auto_interpret()
+    if scale is None:
+        scale = 1.0 / float(D) ** 0.5
+    block_q = min(_round_up(block_q, _LANE), _round_up(S, _LANE))
+    block_k = min(_round_up(block_k, _LANE), _round_up(S, _LANE))
+    lcm = block_q * block_k // math.gcd(block_q, block_k)
+    Sp = _round_up(S, lcm)
+
+    mask_p = jnp.pad(jnp.ones((B, S), jnp.int32), ((0, 0), (0, Sp - S)))
+
+    def pad(t):
+        return jnp.pad(t, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+
+    o, l, m = _flash_fwd(pad(q).reshape(B * H, Sp, D),
+                         pad(k).reshape(B * H, Sp, D),
+                         pad(v).reshape(B * H, Sp, D),
+                         mask_p, causal=False, scale=float(scale),
+                         block_q=block_q, block_k=block_k,
+                         interpret=bool(interpret), heads=H,
+                         with_stats=True)
+    return (o.reshape(B, H, Sp, D)[:, :, :S, :],
+            l.reshape(B, H, Sp)[:, :, :S],
+            m.reshape(B, H, Sp)[:, :, :S])
 
 
 def flash_attention_sharded(q, k, v, mesh, *, dp_axis: str = "dp",

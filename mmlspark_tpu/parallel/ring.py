@@ -44,11 +44,17 @@ def local_attention(q, k, v, scale: Optional[float] = None):
 
 
 def ring_attention(q, k, v, axis_name: str, axis_size: int,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, use_flash: bool = False):
     """SPMD ring attention over a sequence-sharded axis.
 
     Args are local shards (B, H, S/n, D). Returns the local output shard.
     Streaming-softmax accumulators are fp32; K/V rotate ``axis_size`` hops.
+
+    ``use_flash=True`` computes each hop's local attention with the Pallas
+    streaming kernel and merges the per-hop ``(o, l, m)`` stats (log-sum-exp
+    merge) — per-chip memory drops from O(S_local²) scores to O(S_local),
+    which is the ring-attention paper's actual memory claim. Forward-only
+    (the stats path has no VJP); the default einsum body stays for training.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -61,8 +67,20 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
                   (axis_name,), to='varying')
     l = lax.pcast(jnp.zeros(q.shape[:-1], dtype=jnp.float32), (axis_name,), to='varying')
 
-    def body(i, carry):
-        o, m, l, k_cur, v_cur = carry
+    def hop_flash(o, m, l, k_cur, v_cur):
+        from ..ops.flash_attention import flash_attention_with_stats
+        o_i, l_i, m_i = flash_attention_with_stats(q, k_cur, v_cur,
+                                                   scale=scale)
+        m_new = jnp.maximum(m, m_i)
+        c_prev = jnp.exp(m - m_new)
+        c_i = jnp.exp(m_i - m_new)
+        # o_i comes normalized by l_i; un-normalize inside the merge
+        o = o * c_prev[..., None] + \
+            o_i.astype(jnp.float32) * (l_i * c_i)[..., None]
+        l = l * c_prev + l_i * c_i
+        return o, m_new, l
+
+    def hop_dense(o, m, l, k_cur, v_cur):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
                        preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -72,9 +90,16 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32)
+        return o, m_new, l
+
+    hop = hop_flash if use_flash else hop_dense
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = hop(o, m, l, k_cur, v_cur)
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return o, m_new, l, k_next, v_next
+        return o, m, l, k_next, v_next
 
     o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
     return (o / l[..., None]).astype(q.dtype)
@@ -110,12 +135,23 @@ def wrap_ring_attention(mesh: Mesh, axis_name: str = "sp",
     on ``axis_name``.
     """
     n = mesh.shape[axis_name]
-    kernel = ring_attention if impl == "ring" else ulysses_attention
+    if impl not in ("ring", "ring_flash", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
     spec = P(None, None, axis_name, None)
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    # the pallas_call inside ring_flash cannot declare its varying-axes type,
+    # so the vma check must be off for that impl (mesh.py:get_shard_map)
+    from .mesh import get_shard_map
+    shard_map, unchecked = get_shard_map()
+    kwargs = unchecked if impl == "ring_flash" else {}
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, **kwargs)
     def fn(q, k, v):
-        return kernel(q, k, v, axis_name=axis_name, axis_size=n)
+        if impl == "ulysses":
+            return ulysses_attention(q, k, v, axis_name=axis_name,
+                                     axis_size=n)
+        return ring_attention(q, k, v, axis_name=axis_name, axis_size=n,
+                              use_flash=(impl == "ring_flash"))
 
     return fn

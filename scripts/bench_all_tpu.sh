@@ -22,4 +22,5 @@ run pallas    python scripts/bench_pallas_hist.py
 run configs   python scripts/bench_configs.py
 run gbdt_1m   python scripts/bench_gbdt_higgs.py 1000000
 run longctx   python scripts/bench_long_context.py
+run serving   python scripts/bench_serving.py
 echo "ALL DONE $(date -u)" >> "$OUT"

@@ -47,8 +47,9 @@ def main():
     if os.environ.get("BENCH_SEQS"):
         seqs = [int(s) for s in os.environ["BENCH_SEQS"].split(",")]
     impls = tuple(s.strip() for s in os.environ.get(
-        "BENCH_IMPLS", "full,flash,ring,ulysses").split(",") if s.strip())
-    unknown = set(impls) - {"full", "flash", "ring", "ulysses"}
+        "BENCH_IMPLS", "full,flash,ring,ring_flash,ulysses").split(",")
+        if s.strip())
+    unknown = set(impls) - {"full", "flash", "ring", "ring_flash", "ulysses"}
     if unknown:
         # an unvalidated name would silently fall through to the ulysses
         # branch and publish a mislabeled timing

@@ -1,0 +1,124 @@
+"""Serving latency bench — the reference's only serving perf claim is
+"sub-millisecond latency" for continuous Spark Serving
+(``website/docs/features/spark_serving/about.md:18,150-153``); this measures
+the same request→pipeline→reply loop here with hard numbers.
+
+Two configs, one JSON line each:
+
+* ``echo``   — trivial transform (adds a constant column): pure serving-stack
+  latency (HTTP parse, queue, batch, route, reply), the reference's claim.
+* ``model``  — a jitted linear scorer in the loop: what a real pipeline adds.
+
+Latency is measured client-side over sequential keep-alive requests
+(p50/p99), plus a concurrent-burst throughput figure from 8 threads.
+CPU-only — the serving stack is host code; run anywhere.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# serving latency is host-side by definition; without this the jitted scorer
+# lands on the session's tunneled TPU and every request pays a ~70 ms RTT
+os.environ.pop("JAX_PLATFORMS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _post(url: str, body: bytes) -> bytes:
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read()
+
+
+def _measure(url: str, payload: dict, n: int, warmup: int = 20):
+    body = json.dumps(payload).encode()
+    for _ in range(warmup):
+        _post(url, body)
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        _post(url, body)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat = np.sort(np.array(lat))
+    return (round(float(np.percentile(lat, 50)), 3),
+            round(float(np.percentile(lat, 99)), 3))
+
+
+def _burst(url: str, payload: dict, threads: int = 8, per_thread: int = 50):
+    body = json.dumps(payload).encode()
+    done = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(per_thread):
+            _post(url, body)
+        with lock:
+            done.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert len(done) == threads
+    return round(threads * per_thread / dt, 1)
+
+
+def main():
+    from mmlspark_tpu.serving.engine import ServingEngine
+
+    n = int(os.environ.get("BENCH_SERVING_N", "300"))
+
+    # --- echo: serving-stack floor --------------------------------------
+    def echo(df):
+        out = df.with_column("reply", [{"ok": True, "x": float(x)}
+                                       for x in df["x"]])
+        return out
+
+    with ServingEngine(echo, schema={"x": float}, poll_timeout=0.001) as eng:
+        url = eng.address
+        p50, p99 = _measure(url, {"x": 1.5}, n)
+        rps = _burst(url, {"x": 1.5})
+    print(json.dumps({"metric": "serving_echo_latency_ms", "p50": p50,
+                      "p99": p99, "burst_rps_8threads": rps,
+                      "n": n}), flush=True)
+
+    # --- model: jitted scorer in the loop -------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    w = jnp.asarray(np.random.default_rng(0).normal(0, 1, (16,)), jnp.float32)
+    score = jax.jit(lambda X: jnp.tanh(X @ w))
+
+    def model(df):
+        X = jnp.asarray(np.stack([np.asarray(v, np.float32)
+                                  for v in df["features"]]))
+        y = np.asarray(score(X))
+        return df.with_column("reply", [{"score": float(s)} for s in y])
+
+    feats = [0.1] * 16
+    with ServingEngine(model, schema={"features": list},
+                       poll_timeout=0.001) as eng:
+        url = eng.address
+        _post(url, json.dumps({"features": feats}).encode())  # compile
+        p50, p99 = _measure(url, {"features": feats}, n)
+        rps = _burst(url, {"features": feats})
+    print(json.dumps({"metric": "serving_model_latency_ms", "p50": p50,
+                      "p99": p99, "burst_rps_8threads": rps,
+                      "n": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

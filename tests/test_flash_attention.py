@@ -180,3 +180,22 @@ def test_mismatched_block_sizes(rng):
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_matches_dense_ring(rng):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.parallel.ring import wrap_ring_attention
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.array(devs), ("sp",))
+    q, k, v = _rand_qkv(rng, B=1, H=2, S=256, D=32)
+    sh = NamedSharding(mesh, P(None, None, "sp", None))
+    args = [jax.device_put(x, sh) for x in (q, k, v)]
+    ref = wrap_ring_attention(mesh, "sp", impl="ring")(*args)
+    out = wrap_ring_attention(mesh, "sp", impl="ring_flash")(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    full = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
