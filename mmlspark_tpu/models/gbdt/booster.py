@@ -88,13 +88,25 @@ class Booster:
     def append_tree(self, feat, thr_raw, leaf_value, gain, cover):
         self._pending.append((feat, thr_raw, leaf_value, gain, cover))
 
+    def scale_trees(self, idx, factor: float) -> None:
+        """Multiply the leaf values of trees ``idx`` in place (DART's
+        k/(k+1) re-weighting of dropped trees)."""
+        self._materialize()
+        lv = self._base["leaf_values"]
+        lv[np.asarray(idx, dtype=np.int64)] *= np.float32(factor)
+
     def truncated(self, n_trees: int) -> "Booster":
-        """Model truncated to the first n_trees (early-stopping cutoff)."""
+        """Model truncated to the first n_trees (early-stopping cutoff).
+
+        Arrays are copied, not viewed: dart's ``scale_trees`` mutates leaf
+        values in place, and a snapshot that aliased the live stack would
+        silently drift."""
         b = Booster(self.depth, self.n_features, self.objective,
                     self.base_score, self.num_class,
-                    self.feats[:n_trees], self.thr_raw[:n_trees],
-                    self.leaf_values[:n_trees], self.gains[:n_trees],
-                    self.covers[:n_trees], best_iteration=n_trees)
+                    self.feats[:n_trees].copy(), self.thr_raw[:n_trees].copy(),
+                    self.leaf_values[:n_trees].copy(),
+                    self.gains[:n_trees].copy(),
+                    self.covers[:n_trees].copy(), best_iteration=n_trees)
         b.cat_encoder = self.cat_encoder  # trees split in the encoded space
         return b
 

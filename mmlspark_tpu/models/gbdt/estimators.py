@@ -31,6 +31,18 @@ __all__ = ["LightGBMClassifier", "LightGBMRegressor", "LightGBMRanker",
 
 
 class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
+    boosting_type = Param(str, default="gbdt",
+                          choices=["gbdt", "gbrt", "goss", "dart", "rf",
+                                   "random_forest"],
+                          doc="boosting mode (parity: LightGBMParams."
+                              "boostingType, LightGBMParams.scala:389-393)")
+    top_rate = Param(float, default=0.2, doc="goss: keep fraction by |grad|")
+    other_rate = Param(float, default=0.1,
+                       doc="goss: sampled fraction of the rest")
+    drop_rate = Param(float, default=0.1, doc="dart: tree drop probability")
+    max_drop = Param(int, default=50, doc="dart: max dropped trees per iter")
+    skip_drop = Param(float, default=0.5,
+                      doc="dart: probability of skipping the drop")
     num_iterations = Param(int, default=100, doc="boosting rounds")
     learning_rate = Param(float, default=0.1, doc="shrinkage rate")
     num_leaves = Param(int, default=31, doc="max leaves per tree")
@@ -72,7 +84,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                 "min_sum_hessian_in_leaf", "min_gain_to_split",
                 "feature_fraction", "bagging_fraction", "bagging_freq",
                 "max_bin", "early_stopping_round", "metric", "seed",
-                "checkpoint_interval"]
+                "checkpoint_interval", "boosting_type", "top_rate",
+                "other_rate", "drop_rate", "max_drop", "skip_drop"]
         p = {k: self.get(k) for k in keys}
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")

@@ -35,6 +35,12 @@ __all__ = ["train", "TrainConfig", "resolve_params"]
 
 _DEFAULTS = dict(
     objective="regression",
+    boosting="gbdt",                # gbdt | goss | dart | rf
+    top_rate=0.2,                   # goss: keep fraction by |grad|
+    other_rate=0.1,                 # goss: sample fraction of the rest
+    drop_rate=0.1,                  # dart: per-tree drop probability
+    max_drop=50,                    # dart: cap on dropped trees per iter
+    skip_drop=0.5,                  # dart: prob of skipping the drop entirely
     num_iterations=100,
     learning_rate=0.1,
     num_leaves=31,
@@ -73,6 +79,7 @@ def resolve_params(params: Dict) -> Dict:
                "min_split_gain": "min_gain_to_split",
                "random_state": "seed",
                "application": "objective", "app": "objective",
+               "boosting_type": "boosting", "boost": "boosting",
                "parallelism": "tree_learner"}
     out = dict(_DEFAULTS)
     for k, v in params.items():
@@ -198,6 +205,23 @@ def train(params: Dict,
     depth = _depth_for(p)
     num_class = int(p["num_class"])
     objective_name = p["objective"]
+    # boosting mode (parity: LightGBMParams.boostingType, LightGBMParams.scala:389-393)
+    boosting = {"gbrt": "gbdt", "random_forest": "rf"}.get(
+        str(p["boosting"]).lower(), str(p["boosting"]).lower())
+    if boosting not in ("gbdt", "goss", "dart", "rf"):
+        raise ValueError(f"boosting must be gbdt/goss/dart/rf, got {boosting!r}")
+    if boosting == "goss" and p["bagging_freq"]:
+        raise ValueError("GOSS replaces bagging; unset bagging_freq")
+    if boosting == "rf":
+        if not (p["bagging_freq"] and 0 < float(p["bagging_fraction"]) < 1):
+            raise ValueError("rf mode needs bagging_freq > 0 and "
+                             "0 < bagging_fraction < 1 (LightGBM's own rule)")
+        if p["early_stopping_round"]:
+            raise ValueError("rf averages over the full planned forest; "
+                             "early stopping would bias the average")
+        if init_model is not None:
+            raise ValueError("rf mode cannot warm-start (the 1/T average "
+                             "is defined over one forest)")
     is_multi = objective_name in ("multiclass", "softmax") and num_class > 1
     is_rank = objective_name == "lambdarank"
     obj = get_objective(objective_name, num_class=num_class,
@@ -247,7 +271,10 @@ def train(params: Dict,
     n_bins = mapper.n_bins
 
     if init_model is not None:
-        booster = init_model
+        # dart mutates leaf values in place (scale_trees) — work on a deep
+        # copy so the caller's model object is never changed under them
+        booster = (init_model.truncated(init_model.num_trees)
+                   if boosting == "dart" else init_model)
         base_score = booster.base_score
         # raw_score applies the encoder itself — feed the UN-encoded matrix
         scores = booster.raw_score(
@@ -327,6 +354,7 @@ def train(params: Dict,
         p["metric"] if p["metric"] not in ("auto", "") else "", objective_name)
     best_score = -np.inf if higher_better else np.inf
     best_iter = 0
+    best_model = None               # dart: snapshot at each new best
     patience = int(p["early_stopping_round"])
     valid_scores = None
     if valid_sets:
@@ -343,10 +371,52 @@ def train(params: Dict,
             valid_sets = [(cat_encoder.transform(np.asarray(vx)), vy)
                           for vx, vy in valid_sets]
 
+    X_f32 = (np.asarray(X, dtype=np.float32) if boosting == "dart" else None)
+    rf_scale = 1.0 / max(1, int(p["num_iterations"])) if boosting == "rf" \
+        else None
+    K_trees = num_class if is_multi else 1
+
     for it in range(n_iter):
+        # -- dart: pick an iteration subset to drop, score without it ------
+        drop_idx = None
+        drop_pred = None
+        tree_scale = 1.0
+        if boosting == "dart":
+            n_groups = booster.num_trees // K_trees
+            drop_groups = np.array([], dtype=np.int64)
+            if n_groups and rng.random() >= float(p["skip_drop"]):
+                cand = np.nonzero(rng.random(n_groups)
+                                  < float(p["drop_rate"]))[0]
+                md = int(p["max_drop"])
+                if md > 0 and len(cand) > md:
+                    cand = np.sort(rng.choice(cand, size=md, replace=False))
+                drop_groups = cand
+            if len(drop_groups):
+                from .trees import predict_trees
+                k_drop = len(drop_groups)
+                tree_scale = 1.0 / (k_drop + 1.0)   # DART-paper weights
+                drop_idx = (drop_groups[:, None] * K_trees
+                            + np.arange(K_trees)[None, :]).ravel()
+                dp = np.asarray(predict_trees(
+                    booster.feats[drop_idx], booster.thr_raw[drop_idx],
+                    booster.leaf_values[drop_idx], X_f32, depth=depth))
+                drop_pred = np.zeros_like(np.asarray(scores))
+                drop_pred[:n] = dp
+        elif boosting == "rf":
+            tree_scale = rf_scale
+
+        # trees fit gradients at: scores minus dropped trees (dart), the
+        # constant init score (rf: every tree fits the same residual and
+        # the 1/T-scaled sum is the forest average), else current scores
+        scores_for_grad = np.asarray(scores)
+        if drop_pred is not None:
+            scores_for_grad = scores_for_grad - drop_pred
+        elif boosting == "rf":
+            scores_for_grad = np.full_like(scores_for_grad, base_score)
+
         # gradients
         if is_rank:
-            g_np, h_np = _lambdarank_grad(np.asarray(scores)[:n], y, group)
+            g_np, h_np = _lambdarank_grad(scores_for_grad[:n], y, group)
             g_np, h_np = g_np * w, h_np * w
             if n_pad != n:
                 g_np = np.concatenate([g_np, np.zeros(n_pad - n)])
@@ -356,16 +426,44 @@ def train(params: Dict,
                 g_d = jax.device_put(g_d, row_sharding)
                 h_d = jax.device_put(h_d, row_sharding)
         else:
-            g_d, h_d = grad_fn(jnp.asarray(scores), y_d, w_d)
+            g_d, h_d = grad_fn(jnp.asarray(scores_for_grad), y_d, w_d)
             g_d = g_d * live_d[..., None] if is_multi else g_d * live_d
             h_d = h_d * live_d[..., None] if is_multi else h_d * live_d
 
-        # bagging / feature sampling
+        # goss / bagging / feature sampling. ``live_it`` is the 0/1 row
+        # membership (drives min_data_in_leaf counts and stored covers);
+        # ``gh_w`` additionally carries GOSS's gradient amplification —
+        # LightGBM amplifies only grad/hess, never the count channel
         live_it = live_d
-        if p["bagging_freq"] and p["bagging_fraction"] < 1.0 \
+        gh_w = live_d
+        if boosting == "goss":
+            # gradient-based one-side sampling: keep the top_rate fraction
+            # by |grad|, sample other_rate of the rest amplified by
+            # (1-a)/b so the small-gradient mass stays unbiased
+            g_host = np.asarray(g_d)[:n]
+            gabs = (np.abs(g_host).sum(axis=1) if is_multi
+                    else np.abs(g_host))
+            a, b = float(p["top_rate"]), float(p["other_rate"])
+            top_n = min(n, max(1, int(math.ceil(a * n))))
+            rest_n = max(0, int(math.ceil(b * n)))
+            order = np.argpartition(-gabs, top_n - 1)
+            sel_bin = np.zeros(n_pad)
+            sel_amp = np.zeros(n_pad)
+            sel_bin[order[:top_n]] = 1.0
+            sel_amp[order[:top_n]] = 1.0
+            rest = order[top_n:]
+            if rest_n and len(rest):
+                samp = rng.choice(rest, size=min(rest_n, len(rest)),
+                                  replace=False)
+                sel_bin[samp] = 1.0
+                sel_amp[samp] = (1.0 - a) / max(b, 1e-12)
+            live_it = live_d * jnp.asarray(sel_bin)
+            gh_w = live_d * jnp.asarray(sel_amp)
+        elif p["bagging_freq"] and p["bagging_fraction"] < 1.0 \
                 and it % int(p["bagging_freq"]) == 0:
             keep = rng.random(n_pad) < float(p["bagging_fraction"])
             live_it = live_d * jnp.asarray(keep.astype(np.float64))
+            gh_w = live_it
         fmask = None
         if float(p["feature_fraction"]) < 1.0:
             k = max(1, int(round(F * float(p["feature_fraction"]))))
@@ -375,7 +473,10 @@ def train(params: Dict,
             fmask = jnp.asarray(m)
         else:
             fmask = jnp.ones(F, dtype=bool)
-        mask_g = live_it if not is_multi else live_it[:, None]
+        mask_g = gh_w if not is_multi else gh_w[:, None]
+        # rf has no shrinkage — each tree enters at 1/T so the sum is the
+        # forest average; dart additionally scales the new tree by 1/(k+1)
+        lr_eff = (1.0 if boosting == "rf" else lr) * tree_scale
 
         if is_multi:
             def build_k(gk, hk):
@@ -388,30 +489,30 @@ def train(params: Dict,
                                  int(n_bins)) for k in range(num_class)])
             for k in range(num_class):
                 lv = np.zeros((num_class, 2 ** depth), dtype=np.float32)
-                lv[k] = np.asarray(leaf_k)[k] * lr
+                lv[k] = np.asarray(leaf_k)[k] * lr_eff
                 booster.append_tree(feats_np[k], thr_raw_k[k], lv,
                                     np.asarray(gains_k)[k],
                                     np.asarray(covers_k)[k])
             # score update via leaf assignment
             upd = np.zeros_like(np.asarray(scores))
             for k in range(num_class):
-                upd[:, k] = np.asarray(leaf_k)[k][np.asarray(node_k)[k]] * lr
+                upd[:, k] = np.asarray(leaf_k)[k][np.asarray(node_k)[k]] * lr_eff
             scores = np.asarray(scores) + upd
             new_feats = feats_np
             new_thr = thr_raw_k
             new_leaf = np.stack([
                 np.eye(num_class, dtype=np.float32)[k][:, None]
-                * (np.asarray(leaf_k)[k] * lr)[None, :]
+                * (np.asarray(leaf_k)[k] * lr_eff)[None, :]
                 for k in range(num_class)])
         else:
-            g_m = g_d * live_it
-            h_m = h_d * live_it
+            g_m = g_d * gh_w
+            h_m = h_d * gh_w
             feats, thr_bin, leaf_val, node_rel, gains, covers = build(
                 xb_d, g_m, h_m, live_it, fmask)
             feats_np = np.asarray(feats)
             thr_raw = _thr_bins_to_raw(feats_np, np.asarray(thr_bin), mapper,
                                        int(n_bins))
-            leaf_np = np.asarray(leaf_val) * lr
+            leaf_np = np.asarray(leaf_val) * lr_eff
             booster.append_tree(feats_np, thr_raw, leaf_np,
                                 np.asarray(gains), np.asarray(covers))
             scores = np.asarray(scores) + leaf_np[np.asarray(node_rel)]
@@ -419,16 +520,33 @@ def train(params: Dict,
             new_thr = thr_raw[None]
             new_leaf = leaf_np[None]
 
+        if drop_idx is not None:
+            # dart normalization: dropped trees re-enter at k/(k+1); the
+            # running scores still hold them at full weight, so pull the
+            # 1/(k+1) difference back out (grad was taken at scores - drop)
+            k_drop = len(drop_idx) // K_trees
+            booster.scale_trees(drop_idx, k_drop * tree_scale)
+            scores = np.asarray(scores) - drop_pred * tree_scale
+
         # eval + early stopping (uses this iteration's trees directly so the
         # booster's lazy tree stack is not re-materialized every round)
         if valid_sets:
             from .trees import predict_trees
             results = []
             for vi, (vx, vy) in enumerate(valid_sets):
-                delta = np.asarray(predict_trees(
-                    new_feats, new_thr, new_leaf,
-                    np.asarray(vx, dtype=np.float32), depth=depth))
-                valid_scores[vi] = valid_scores[vi] + delta
+                if drop_idx is not None:
+                    # past trees were just re-scaled (dart drop) —
+                    # incremental tracking is invalid for this round,
+                    # recompute from the full tree stack; no-drop rounds
+                    # keep the O(1)-tree incremental path
+                    valid_scores[vi] = base_score + np.asarray(predict_trees(
+                        booster.feats, booster.thr_raw, booster.leaf_values,
+                        np.asarray(vx, dtype=np.float32), depth=depth))
+                else:
+                    delta = np.asarray(predict_trees(
+                        new_feats, new_thr, new_leaf,
+                        np.asarray(vx, dtype=np.float32), depth=depth))
+                    valid_scores[vi] = valid_scores[vi] + delta
                 pred = np.asarray(obj.transform(jnp.asarray(valid_scores[vi])))
                 vw = np.ones(len(vy))
                 val = metric_fn(np.asarray(vy), pred, vw)
@@ -440,10 +558,20 @@ def train(params: Dict,
             if improved:
                 best_score = primary
                 best_iter = it + 1
+                if boosting == "dart":
+                    # later drop iterations rescale EARLIER trees in place,
+                    # so a truncation taken at patience time would not be
+                    # the model that scored best — snapshot it now
+                    # (truncated() copies arrays)
+                    best_model = booster.truncated(
+                        init_trees + best_iter * K_trees)
             elif patience and (it + 1 - best_iter) >= patience:
                 booster.best_iteration = best_iter
-                final = booster.truncated(
-                    init_trees + best_iter * (num_class if is_multi else 1))
+                final = (best_model
+                         if boosting == "dart" and best_model is not None
+                         else booster.truncated(
+                             init_trees + best_iter
+                             * (num_class if is_multi else 1)))
                 if ckpt is not None:
                     # mark the run complete (full budget) so an idempotent
                     # rerun returns this truncated booster, not a resumed one

@@ -52,18 +52,26 @@ def _split(name):
                             stratify=strat)
 
 
-@pytest.mark.parametrize("row", _rows(), ids=lambda r: r["dataset"])
+@pytest.mark.parametrize(
+    "row", _rows(),
+    ids=lambda r: f"{r['dataset']}-{r.get('boosting', 'gbdt')}")
 def test_quality_real(row):
     from sklearn.metrics import accuracy_score, r2_score, roc_auc_score
     Xtr, Xte, ytr, yte = _split(row["dataset"])
     task, metric = row["task"], row["metric"]
+    boosting = row.get("boosting", "gbdt") or "gbdt"
+    extra = {"boosting_type": boosting}
+    if boosting == "rf":
+        # LightGBM's own rule: rf mode requires bagging
+        extra.update(bagging_fraction=0.632, bagging_freq=1,
+                     feature_fraction=0.7)
     if task == "regression":
         m = LightGBMRegressor(num_iterations=200, learning_rate=0.05,
-                              num_leaves=31).fit(_df(Xtr, ytr))
+                              num_leaves=31, **extra).fit(_df(Xtr, ytr))
         got = r2_score(yte, m.transform(_df(Xte, yte))["prediction"])
     else:
         m = LightGBMClassifier(num_iterations=150, learning_rate=0.1,
-                               num_leaves=31).fit(_df(Xtr, ytr))
+                               num_leaves=31, **extra).fit(_df(Xtr, ytr))
         out = m.transform(_df(Xte, yte))
         if metric == "auc":
             prob = np.stack(list(out["probability"]))
