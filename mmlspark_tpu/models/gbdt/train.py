@@ -567,11 +567,9 @@ def train(params: Dict,
                         init_trees + best_iter * K_trees)
             elif patience and (it + 1 - best_iter) >= patience:
                 booster.best_iteration = best_iter
-                final = (best_model
-                         if boosting == "dart" and best_model is not None
+                final = (best_model if best_model is not None
                          else booster.truncated(
-                             init_trees + best_iter
-                             * (num_class if is_multi else 1)))
+                             init_trees + best_iter * K_trees))
                 if ckpt is not None:
                     # mark the run complete (full budget) so an idempotent
                     # rerun returns this truncated booster, not a resumed one
@@ -601,4 +599,10 @@ def train(params: Dict,
     else:
         booster.best_iteration = best_iter if valid_sets \
             else resumed_iters + n_iter
+    if patience and best_model is not None:
+        # dart reaching the iteration budget without the patience branch
+        # firing: later drop rounds rescaled the best iteration's trees in
+        # place, so only the snapshot reproduces best_score — a truncation
+        # of the final stack would not (unlike every other boosting mode)
+        return best_model
     return booster

@@ -113,8 +113,64 @@ for _name, _u in [
     ("Reciprocal", lambda x: 1.0 / x), ("Identity", lambda x: x),
     ("Relu", jax.nn.relu), ("Sigmoid", jax.nn.sigmoid),
     ("Softsign", jax.nn.soft_sign), ("IsNaN", jnp.isnan),
+    ("Mish", lambda x: x * jnp.tanh(jax.nn.softplus(x))),
 ]:
     OP_HANDLERS[_name] = (lambda f: lambda node, inputs, ctx: f(inputs[0]))(_u)
+
+
+@register_op("IsInf")
+def _isinf(node, inputs, ctx):
+    x = inputs[0]
+    pos = jnp.isposinf(x) if node.attr("detect_positive", 1) else \
+        jnp.zeros(x.shape, bool)
+    neg = jnp.isneginf(x) if node.attr("detect_negative", 1) else \
+        jnp.zeros(x.shape, bool)
+    return jnp.logical_or(pos, neg)
+
+
+@register_op("ThresholdedRelu")
+def _thresholded_relu(node, inputs, ctx):
+    alpha = node.attr("alpha", 1.0)
+    return jnp.where(inputs[0] > alpha, inputs[0], 0.0)
+
+
+@register_op("Shrink")
+def _shrink(node, inputs, ctx):
+    lambd = node.attr("lambd", 0.5)
+    bias = node.attr("bias", 0.0)
+    x = inputs[0]
+    return jnp.where(x < -lambd, x + bias, jnp.where(x > lambd, x - bias,
+                                                     jnp.zeros_like(x)))
+
+
+@register_op("BitShift")
+def _bitshift(node, inputs, ctx):
+    x, y = inputs
+    if node.attr("direction", "LEFT") == "LEFT":
+        return jnp.left_shift(x, y)
+    return jnp.right_shift(x, y)
+
+
+@register_op("ReverseSequence")
+def _reverse_sequence(node, inputs, ctx):
+    x, seq_lens = inputs
+    batch_axis = node.attr("batch_axis", 1)
+    time_axis = node.attr("time_axis", 0)
+    # one explicit permutation to (batch, time, *rest) — chained moveaxis
+    # shifts the other axis's index when batch_axis > time_axis
+    rest = [a for a in range(x.ndim) if a not in (batch_axis, time_axis)]
+    perm = [batch_axis, time_axis] + rest
+    xt = jnp.transpose(x, perm)
+
+    def rev_row(row, ln):
+        t = row.shape[0]
+        idx = jnp.where(jnp.arange(t) < ln,
+                        ln - 1 - jnp.arange(t), jnp.arange(t))
+        return row[idx]
+
+    out = jax.vmap(rev_row)(xt, seq_lens.astype(jnp.int32))
+    inv = np.argsort(perm)
+    return jnp.transpose(out, inv)
 
 for _name, _cmp in [("Equal", jnp.equal), ("Greater", jnp.greater),
                     ("GreaterOrEqual", jnp.greater_equal),

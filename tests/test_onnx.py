@@ -330,3 +330,65 @@ class TestDevicePrep:
         arr8 = m._coerce(np.zeros((4, 8), dtype=np.uint8), np.float32,
                          ("N", 8))
         assert arr8.dtype == np.uint8  # ints ride the wire untouched
+
+
+class TestNewElementwiseOps:
+    """Mish/IsInf/ThresholdedRelu/Shrink/BitShift/ReverseSequence vs numpy."""
+
+    def _run(self, node, feeds, out_dtype=np.float32, extra_inputs=()):
+        ins = [O.make_tensor_value_info(n, a.dtype.type, list(a.shape))
+               for n, a in feeds.items()]
+        g = O.make_graph([node], "t", ins,
+                         [O.make_tensor_value_info("y", out_dtype, [])])
+        cm = O.convert_model(O.make_model(g))
+        return np.asarray(cm(cm.params, feeds)["y"])
+
+    def test_mish(self):
+        x = np.linspace(-4, 4, 12, dtype=np.float32)
+        got = self._run(O.make_node("Mish", ["x"], ["y"]), {"x": x})
+        want = x * np.tanh(np.log1p(np.exp(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_isinf_directions(self):
+        x = np.array([1.0, np.inf, -np.inf, np.nan], dtype=np.float32)
+        got = self._run(O.make_node("IsInf", ["x"], ["y"]), {"x": x},
+                        out_dtype=np.bool_)
+        np.testing.assert_array_equal(got, [False, True, True, False])
+        pos_only = self._run(
+            O.make_node("IsInf", ["x"], ["y"], detect_negative=0), {"x": x},
+            out_dtype=np.bool_)
+        np.testing.assert_array_equal(pos_only, [False, True, False, False])
+
+    def test_thresholded_relu_and_shrink(self):
+        x = np.array([-2.0, -0.3, 0.0, 0.4, 2.0], dtype=np.float32)
+        got = self._run(O.make_node("ThresholdedRelu", ["x"], ["y"],
+                                    alpha=0.5), {"x": x})
+        np.testing.assert_allclose(got, np.where(x > 0.5, x, 0.0))
+        got = self._run(O.make_node("Shrink", ["x"], ["y"], lambd=0.5,
+                                    bias=0.1), {"x": x})
+        want = np.where(x < -0.5, x + 0.1, np.where(x > 0.5, x - 0.1, 0.0))
+        np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-6)
+
+    def test_bitshift(self):
+        x = np.array([1, 2, 8], dtype=np.uint32)
+        s = np.array([1, 2, 2], dtype=np.uint32)
+        got = self._run(O.make_node("BitShift", ["x", "s"], ["y"],
+                                    direction="LEFT"),
+                        {"x": x, "s": s}, out_dtype=np.uint32)
+        np.testing.assert_array_equal(got, [2, 8, 32])
+        got = self._run(O.make_node("BitShift", ["x", "s"], ["y"],
+                                    direction="RIGHT"),
+                        {"x": x, "s": s}, out_dtype=np.uint32)
+        np.testing.assert_array_equal(got, [0, 0, 2])
+
+    def test_reverse_sequence(self):
+        # ONNX spec example: (time=4, batch=2), reverse each batch's prefix
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        lens = np.array([4, 2], dtype=np.int64)
+        got = self._run(O.make_node("ReverseSequence", ["x", "l"], ["y"],
+                                    batch_axis=1, time_axis=0),
+                        {"x": x, "l": lens})
+        want = x.copy()
+        want[:4, 0] = x[:4, 0][::-1]
+        want[:2, 1] = x[:2, 1][::-1]
+        np.testing.assert_array_equal(got, want)
