@@ -3,7 +3,8 @@ from .ice import ICETransformer
 from .lime import ImageLIME, TabularLIME, TextLIME, VectorLIME
 from .regression import batched_lasso, batched_weighted_lstsq
 from .shap import ImageSHAP, TabularSHAP, TextSHAP, VectorSHAP
-from .superpixel import mask_image, slic_superpixels
+from .superpixel import (SuperpixelTransformer, mask_image,
+                         slic_superpixels)
 
 __all__ = [
     "LocalExplainer", "shapley_kernel_weights",
@@ -11,5 +12,5 @@ __all__ = [
     "VectorSHAP", "TabularSHAP", "TextSHAP", "ImageSHAP",
     "ICETransformer",
     "batched_lasso", "batched_weighted_lstsq",
-    "slic_superpixels", "mask_image",
+    "slic_superpixels", "mask_image", "SuperpixelTransformer",
 ]

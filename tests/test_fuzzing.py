@@ -131,6 +131,7 @@ def _registry():
                                              NGram, PageSplitter,
                                              TextFeaturizer, Tokenizer)
     from mmlspark_tpu.featurize.value_indexer import IndexToValue, ValueIndexer
+    from mmlspark_tpu.explainers.superpixel import SuperpixelTransformer
     from mmlspark_tpu.image.augment import ImageSetAugmenter
     from mmlspark_tpu.image.transforms import ImageTransformer, ResizeImage
     from mmlspark_tpu.image.unroll import (ResizeImageTransformer,
@@ -443,6 +444,9 @@ def _registry():
             transform_df=bin_img_df()),
         ImageSetAugmenter: lambda: TestObject(ImageSetAugmenter(),
                                               transform_df=img_df()),
+        SuperpixelTransformer: lambda: TestObject(
+            SuperpixelTransformer(input_col="image", cell_size=4),
+            transform_df=img_df()),
         # io/http parsers & transformers (serialization only: need a server)
         JSONInputParser: lambda: TestObject(
             JSONInputParser(url="http://localhost:1/x", input_col="num",
