@@ -60,7 +60,17 @@ def _apply_params(est: Estimator, pm: dict) -> Estimator:
 
 
 class TuneHyperparameters(Estimator, HasLabelCol):
-    """Random/grid search over an estimator's hyperparameters."""
+    """Hyperparameter search over an estimator.
+
+    ``search_strategy='full'`` (default) fits every candidate at full
+    budget — the reference's behavior (``TuneHyperparameters.scala:36-225``).
+    ``'halving'`` is successive halving (beyond the reference): all
+    candidates start at ``min_resource`` of ``resource_param``; each rung
+    keeps the top ``1/halving_factor`` and multiplies the resource by
+    ``halving_factor`` until ``max_resource`` — total compute grows with
+    log(candidates) instead of linearly, which is what makes wide sweeps
+    affordable on a single chip.
+    """
 
     model = ComplexParam(default=None, doc="estimator to tune")
     search_space = ComplexParam(default=None,
@@ -71,6 +81,16 @@ class TuneHyperparameters(Estimator, HasLabelCol):
     train_fraction = Param(float, default=0.8, doc="train/validation split")
     parallelism = Param(int, default=4, doc="concurrent trials")
     seed = Param(int, default=0, doc="split seed")
+    search_strategy = Param(str, default="full", choices=["full", "halving"],
+                            doc="full = fit every candidate at full budget; "
+                                "halving = successive halving rungs")
+    resource_param = Param(str, default="num_iterations",
+                           doc="halving: estimator param that scales cost")
+    min_resource = Param(int, default=4, doc="halving: first-rung resource")
+    max_resource = Param(int, default=64, doc="halving: final-rung resource")
+    halving_factor = Param(int, default=3,
+                           doc="halving: keep top 1/factor, grow resource "
+                               "by factor, per rung")
 
     best_metric: Optional[float] = None
     best_params: Optional[dict] = None
@@ -84,6 +104,8 @@ class TuneHyperparameters(Estimator, HasLabelCol):
             param_maps = list(space.param_maps())
         else:
             param_maps = list(space.param_maps(self.get("number_of_iterations")))
+        if not param_maps:
+            raise ValueError("empty search space")
 
         shuffled = df.shuffle(self.get("seed"))
         n_train = int(round(self.get("train_fraction") * len(df)))
@@ -94,19 +116,46 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         metric = self.get("evaluation_metric")
         maximize = metric in _MAXIMIZE
 
-        def trial(pm: dict):
-            model = _apply_params(est, pm).fit(train)
-            return _evaluate(model, valid, self.get("label_col"), metric), model, pm
+        def run_rung(maps, extra=None):
+            def trial(pm: dict):
+                eff = {**pm, **(extra or {})}
+                model = _apply_params(est, eff).fit(train)
+                return (_evaluate(model, valid, self.get("label_col"),
+                                  metric), model, pm)
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(1, self.get("parallelism"))) as ex:
+                return list(ex.map(trial, maps))
 
-        results = []
-        with concurrent.futures.ThreadPoolExecutor(
-                max_workers=max(1, self.get("parallelism"))) as ex:
-            for res in ex.map(trial, param_maps):
-                results.append(res)
-        if not results:
-            raise ValueError("empty search space")
-        best = (max if maximize else min)(results, key=lambda r: r[0])
-        self.best_metric, best_model, self.best_params = best[0], best[1], best[2]
+        if self.get("search_strategy") == "halving":
+            eta = int(self.get("halving_factor"))
+            rp = self.get("resource_param")
+            r = int(self.get("min_resource"))
+            R = int(self.get("max_resource"))
+            if eta < 2:
+                raise ValueError(f"halving_factor must be >= 2, got {eta}")
+            if not (1 <= r <= R):
+                raise ValueError(f"need 1 <= min_resource <= max_resource, "
+                                 f"got {r} > {R}")
+            if any(rp in pm for pm in param_maps):
+                # eff = {**pm, rp: r} would silently clobber the sampled
+                # value, and best_params would report a config that never ran
+                raise ValueError(
+                    f"search space samples {rp!r}, which halving controls as "
+                    f"the resource; remove it from the space or change "
+                    f"resource_param")
+            survivors = param_maps
+            while r < R and len(survivors) > 1:
+                results = run_rung(survivors, {rp: r})
+                results.sort(key=lambda t: t[0], reverse=maximize)
+                survivors = [pm for _s, _m, pm in
+                             results[:max(1, len(survivors) // eta)]]
+                r = min(R, r * eta)
+            results = run_rung(survivors, {rp: R})
+        else:
+            results = run_rung(param_maps)
+
+        best = (max if maximize else min)(results, key=lambda t: t[0])
+        self.best_metric, best_model, self.best_params = best
         return best_model
 
 

@@ -151,3 +151,56 @@ def test_find_best_model():
     metrics = dict((i, m) for i, m in result.get("all_model_metrics"))
     assert result.get("best_model") is good or metrics[1] >= metrics[0]
     assert "prediction" in result.transform(df).columns
+
+
+def test_tune_successive_halving():
+    df = _cls_df(n=80)
+    space = (HyperparamBuilder()
+             .add_hyperparam("learning_rate",
+                             RangeHyperParam(0.01, 0.5, is_log=True))
+             .build())
+    fitted_iters = []
+
+    class Spy(LogisticRegression):
+        def _fit(self, d):
+            fitted_iters.append(self.get("max_iter"))
+            return super()._fit(d)
+
+    tuner = TuneHyperparameters(
+        model=Spy(), search_space=RandomSpace(space, seed=5),
+        number_of_iterations=6, evaluation_metric="accuracy",
+        label_col="label", parallelism=2,
+        search_strategy="halving", resource_param="max_iter",
+        min_resource=5, max_resource=40, halving_factor=2)
+    best = tuner.fit(df)
+    assert tuner.best_metric is not None and tuner.best_metric > 0.6
+    # rung structure: 6 trials @5, 3 @10, 1 @40 (final rung at max budget)
+    assert fitted_iters.count(5) == 6
+    assert fitted_iters.count(10) == 3
+    assert fitted_iters.count(40) == 1
+    assert set(tuner.best_params) == {"learning_rate"}
+    assert "prediction" in best.transform(df).columns
+    # halving fits 10 models; full search at max budget would cost 6x40
+    assert len(fitted_iters) == 10
+
+
+def test_tune_halving_rejects_bad_config():
+    import pytest as _pt
+    space = (HyperparamBuilder()
+             .add_hyperparam("max_iter", DiscreteHyperParam([10, 20]))
+             .build())
+    df = _cls_df(n=40)
+    t = TuneHyperparameters(
+        model=LogisticRegression(), search_space=RandomSpace(space, seed=0),
+        number_of_iterations=2, label_col="label",
+        search_strategy="halving", resource_param="max_iter")
+    with _pt.raises(ValueError, match="halving controls"):
+        t.fit(df)
+    t2 = TuneHyperparameters(
+        model=LogisticRegression(),
+        search_space=RandomSpace((HyperparamBuilder().add_hyperparam(
+            "learning_rate", RangeHyperParam(0.01, 0.5)).build()), seed=0),
+        number_of_iterations=2, label_col="label", search_strategy="halving",
+        resource_param="max_iter", min_resource=32, max_resource=8)
+    with _pt.raises(ValueError, match="min_resource"):
+        t2.fit(df)
