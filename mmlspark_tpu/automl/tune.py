@@ -135,7 +135,7 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                 raise ValueError(f"halving_factor must be >= 2, got {eta}")
             if not (1 <= r <= R):
                 raise ValueError(f"need 1 <= min_resource <= max_resource, "
-                                 f"got {r} > {R}")
+                                 f"got min_resource={r}, max_resource={R}")
             if any(rp in pm for pm in param_maps):
                 # eff = {**pm, rp: r} would silently clobber the sampled
                 # value, and best_params would report a config that never ran

@@ -57,6 +57,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     bagging_freq = Param(int, default=0, doc="bagging every k iterations")
     max_bin = Param(int, default=255, doc="max histogram bins")
     early_stopping_round = Param(int, default=0, doc="early stopping patience")
+    top_k = Param(int, default=20,
+                  doc="voting_parallel: local feature nominations per node "
+                      "(parity: LightGBMParams.topK)")
     parallelism = Param(str, default="serial",
                         choices=["serial", "data_parallel", "voting_parallel"],
                         doc="tree learner (reference LightGBMParams.parallelism)")
@@ -85,7 +88,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                 "feature_fraction", "bagging_fraction", "bagging_freq",
                 "max_bin", "early_stopping_round", "metric", "seed",
                 "checkpoint_interval", "boosting_type", "top_rate",
-                "other_rate", "drop_rate", "max_drop", "skip_drop"]
+                "other_rate", "drop_rate", "max_drop", "skip_drop", "top_k"]
         p = {k: self.get(k) for k in keys}
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")

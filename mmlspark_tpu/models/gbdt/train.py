@@ -59,6 +59,7 @@ _DEFAULTS = dict(
     seed=0,
     metric="auto",
     tree_learner="serial",
+    top_k=20,                       # voting_parallel: local nominations/node
     alpha=0.9,                      # huber/quantile parameter
     tweedie_variance_power=1.5,
     verbosity=-1,
@@ -80,6 +81,7 @@ def resolve_params(params: Dict) -> Dict:
                "random_state": "seed",
                "application": "objective", "app": "objective",
                "boosting_type": "boosting", "boost": "boosting",
+               "topK": "top_k",
                "parallelism": "tree_learner"}
     out = dict(_DEFAULTS)
     for k, v in params.items():
@@ -320,7 +322,12 @@ def train(params: Dict,
         w_d = jnp.asarray(w_pad)
         live_d = jnp.asarray(live)
 
+    # PV-Tree voting (LightGBM tree_learner=voting_parallel, topK param —
+    # params/LightGBMParams.scala:23-30): comm per level 2k×B instead of F×B
+    voting_k = (int(p["top_k"]) if p["tree_learner"] == "voting_parallel"
+                else 0)
     build_kwargs = dict(depth=depth, n_bins=int(n_bins),
+                        voting_k=voting_k,
                         lam=float(p["lambda_l2"]) + 1e-10,
                         alpha=float(p["lambda_l1"]),
                         min_gain=float(p["min_gain_to_split"]),

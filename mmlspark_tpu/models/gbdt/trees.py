@@ -78,9 +78,12 @@ def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
     return hist
 
 
-def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
+def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
                  feature_mask):
-    """hist (nodes, F, B, 3) → best (gain, feat, bin) per node."""
+    """hist (nodes, F, B, 3) → masked split gains (nodes, F, B); invalid
+    candidates are -inf. ``feature_mask`` may be (F,) or per-node (nodes, F)
+    (the latter after a voting gather, where the column set differs per
+    node)."""
     G = hist[..., 0]
     H = hist[..., 1]
     C = hist[..., 2]
@@ -100,8 +103,56 @@ def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
              & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
              & (gain > min_gain))
     if feature_mask is not None:
-        valid = valid & feature_mask[None, :, None]
-    gain = jnp.where(valid, gain, -jnp.inf)
+        fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+        valid = valid & fm[:, :, None]
+    return jnp.where(valid, gain, -jnp.inf)
+
+
+def _voting_splits(local_hist, axis_name, k, lam, min_gain,
+                   min_child_weight, min_data_in_leaf, feature_mask):
+    """PV-Tree voting split finder over LOCAL per-shard histograms.
+
+    Every shard nominates its local top-k features per node, votes psum,
+    and only the global top-2k features' histogram columns are all-reduced
+    (the PV-Tree guarantee: the true best feature is among the top-2k with
+    high probability). Vote counts and the gathered histogram are identical
+    on every shard after psum, so split decisions stay bitwise-identical
+    across the mesh — the invariant the data-parallel path also maintains.
+    Returns (best_feat, best_bin, best_gain, level_cover).
+    """
+    n_nodes, F, _B, _ = local_hist.shape
+    kk = min(int(k), F)
+    # nominate from UNCONSTRAINED local gains: the global count/hessian
+    # thresholds don't apply to a 1/shards-sized local histogram (a node
+    # whose every shard fails them would nominate all -inf → top_k degrades
+    # to index order, a data-free vote); validity is enforced on the GLOBAL
+    # histogram below
+    lgain = _split_gains(local_hist, lam, -jnp.inf, 0.0, 0.0, feature_mask)
+    per_feat = lgain.max(axis=-1)                              # (nodes, F)
+    top_local = jax.lax.top_k(per_feat, kk)[1]                 # (nodes, kk)
+    votes = jnp.zeros((n_nodes, F), jnp.float32).at[
+        jnp.arange(n_nodes)[:, None], top_local].add(1.0)
+    votes = jax.lax.psum(votes, axis_name)
+    sel = jax.lax.top_k(votes, min(2 * kk, F))[1]              # (nodes, 2k)
+    hist_sel = jnp.take_along_axis(local_hist, sel[:, :, None, None], axis=1)
+    hist_sel = jax.lax.psum(hist_sel, axis_name)   # comm: 2k×B, not F×B
+    fm_sel = feature_mask[sel] if feature_mask is not None else None
+    bf_s, bb, bg = _find_splits(hist_sel, lam, min_gain, min_child_weight,
+                                min_data_in_leaf, fm_sel)
+    bf = jnp.where(
+        bf_s >= 0,
+        jnp.take_along_axis(sel, jnp.clip(bf_s, 0, sel.shape[1] - 1)[:, None],
+                            axis=1)[:, 0].astype(jnp.int32),
+        -1)
+    level_cover = jax.lax.psum(local_hist[:, 0, :, 2].sum(axis=-1), axis_name)
+    return bf, bb, bg, level_cover
+
+
+def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
+                 feature_mask):
+    """hist (nodes, F, B, 3) → best (gain, feat, bin) per node."""
+    gain = _split_gains(hist, lam, min_gain, min_child_weight,
+                        min_data_in_leaf, feature_mask)
     flat = gain.reshape(gain.shape[0], -1)           # (nodes, F*B)
     best = jnp.argmax(flat, axis=-1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
@@ -114,19 +165,27 @@ def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
             jnp.where(ok, best_gain, 0.0))
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name"))
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name",
+                                             "voting_k"))
 def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                sample_weight_count: jnp.ndarray,
                depth: int, n_bins: int,
                lam: float = 1e-3, alpha: float = 0.0, min_gain: float = 0.0,
                min_child_weight: float = 1e-3, min_data_in_leaf: float = 1.0,
                feature_mask: Optional[jnp.ndarray] = None,
-               axis_name: Optional[str] = None):
+               axis_name: Optional[str] = None, voting_k: int = 0):
     """Grow one depth-`depth` tree. All shapes static; jits once per config.
 
     xb: (n, F) int bins; g/h: (n,) gradients/hessians (already weighted);
     sample_weight_count: (n,) 1.0 for live rows, 0.0 for padding/bagged-out.
     Returns (feat, thr_bin, leaf_value, leaf_index_per_row).
+
+    ``voting_k > 0`` with an ``axis_name`` enables PV-Tree voting-parallel
+    (LightGBM ``tree_learner=voting_parallel``, ``topK`` —
+    ``params/LightGBMParams.scala:23-30``): each shard nominates its local
+    top-k features per node from its LOCAL histogram, the votes psum, and
+    only the global top-2k features' histograms are all-reduced — per-level
+    comm drops from F×B to 2k×B.
     """
     n, F = xb.shape
     n_internal = 2 ** depth - 1
@@ -135,16 +194,24 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     gains = jnp.zeros(n_internal, dtype=jnp.float32)
     covers = jnp.zeros(2 ** (depth + 1) - 1, dtype=jnp.float32)
     node_rel = jnp.zeros(n, dtype=jnp.int32)
+    use_voting = voting_k > 0 and axis_name is not None and 2 * voting_k < F
 
     for d in range(depth):
         n_nodes = 2 ** d
         level_off = 2 ** d - 1
-        hist = _level_histogram(xb, node_rel, g, h, sample_weight_count,
-                                n_nodes, n_bins, axis_name)
-        level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
+        if use_voting:
+            local = _level_histogram(xb, node_rel, g, h, sample_weight_count,
+                                     n_nodes, n_bins, None)
+            bf, bb, bg, level_cover = _voting_splits(
+                local, axis_name, voting_k, lam, min_gain, min_child_weight,
+                min_data_in_leaf, feature_mask)
+        else:
+            hist = _level_histogram(xb, node_rel, g, h, sample_weight_count,
+                                    n_nodes, n_bins, axis_name)
+            level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
+            bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
+                                      min_data_in_leaf, feature_mask)
         covers = jax.lax.dynamic_update_slice(covers, level_cover, (level_off,))
-        bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
-                                  min_data_in_leaf, feature_mask)
         feats = jax.lax.dynamic_update_slice(feats, bf, (level_off,))
         thrs = jax.lax.dynamic_update_slice(thrs, bb, (level_off,))
         gains = jax.lax.dynamic_update_slice(gains, bg.astype(jnp.float32),

@@ -187,3 +187,42 @@ class TestReviewRegressions:
         # the returned model must reproduce the best logged metric — not a
         # truncation of later-rescaled trees
         assert abs(got - best_logged) < 1e-9
+
+
+class TestVotingParallel:
+    """PV-Tree voting (tree_learner=voting_parallel, top_k) on the virtual
+    8-device CPU mesh."""
+
+    def _mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices("cpu")[:4]), ("data",))
+
+    def test_top_k_covering_all_features_matches_data_parallel(self, rng):
+        X, y = _binary_data(rng, n=600, f=10)
+        p = {**BASE, "num_iterations": 5}
+        mesh = self._mesh()
+        dp = train({**p, "tree_learner": "data_parallel"}, X, y, mesh=mesh)
+        # 2k >= F disables the comm saving but must reproduce data_parallel
+        # through the same code path guard
+        vp = train({**p, "tree_learner": "voting_parallel", "top_k": 10},
+                   X, y, mesh=mesh)
+        np.testing.assert_allclose(vp.predict(X), dp.predict(X), rtol=1e-6)
+
+    def test_small_top_k_quality(self, rng):
+        X, y = _binary_data(rng, n=800, f=10)
+        mesh = self._mesh()
+        vp = train({**BASE, "num_iterations": 15,
+                    "tree_learner": "voting_parallel", "top_k": 2},
+                   X, y, mesh=mesh)
+        assert _auc(y, vp.predict(X)) > 0.85
+        serial = train({**BASE, "num_iterations": 15}, X, y)
+        assert abs(_auc(y, vp.predict(X)) - _auc(y, serial.predict(X))) < 0.05
+
+    def test_voting_respects_feature_mask(self, rng):
+        # feature_fraction < 1 exercises the per-node gathered mask path
+        X, y = _binary_data(rng, n=600, f=10)
+        vp = train({**BASE, "num_iterations": 8, "feature_fraction": 0.5,
+                    "tree_learner": "voting_parallel", "top_k": 2},
+                   X, y, mesh=self._mesh())
+        assert _auc(y, vp.predict(X)) > 0.8
