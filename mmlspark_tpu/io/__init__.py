@@ -6,9 +6,10 @@ image, powerbi) — see the submodules for per-component citations.
 
 from .binary import list_binary_files, read_binary_files
 from .image_io import read_images
+from .libsvm import read_libsvm
 from .parquet import read_csv, read_parquet, write_parquet
 from .powerbi import PowerBIWriter, write_to_powerbi
 
 __all__ = ["list_binary_files", "read_binary_files", "read_images",
-           "read_parquet", "write_parquet", "read_csv",
+           "read_libsvm", "read_parquet", "write_parquet", "read_csv",
            "PowerBIWriter", "write_to_powerbi"]

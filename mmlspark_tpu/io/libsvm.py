@@ -1,0 +1,67 @@
+"""libsvm/svmlight reader — LightGBM's native text format.
+
+The reference ingests libsvm through Spark's ``libsvm`` datasource before
+handing rows to LightGBM (``LightGBMBase.scala`` consumes the assembled
+vector column); here the parser is the C++ fastpath
+(``native/fastpath.cpp:parse_libsvm``, pure-Python fallback) and the result
+is a columnar DataFrame ready for the GBDT estimators: a dense float32
+``features`` column, ``label``, and — when ``qid:`` tokens are present —
+a ``group`` column for the ranker.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..native import parse_libsvm
+
+__all__ = ["read_libsvm"]
+
+
+def read_libsvm(path: str, n_features: Optional[int] = None,
+                zero_based: Optional[bool] = None,
+                label_col: str = "label", features_col: str = "features",
+                group_col: str = "group",
+                npartitions: int = 1) -> DataFrame:
+    """Read a libsvm file into a DataFrame with dense feature rows.
+
+    ``zero_based=None`` auto-detects: files whose minimum feature index is 0
+    are taken as 0-based, else 1-based (the svmlight convention). ``qid:``
+    tokens become a ``group`` column (the ranker's query ids); rows without
+    qid omit the column entirely.
+    """
+    with open(path, "rb") as f:
+        labels, qids, indptr, indices, values = parse_libsvm(f.read())
+    n = len(labels)
+    if zero_based is None:
+        zero_based = bool(len(indices) == 0 or indices.min() == 0)
+    idx = indices if zero_based else indices - 1
+    if len(idx) and idx.min() < 0:
+        raise ValueError("libsvm: negative feature index after 1-based "
+                         "adjustment; pass zero_based=True if indices "
+                         "start at 0")
+    F = int(n_features if n_features is not None
+            else (idx.max() + 1 if len(idx) else 0))
+    if len(idx) and idx.max() >= F:
+        raise ValueError(f"libsvm: feature index {int(idx.max())} >= "
+                         f"n_features {F}")
+    dense = np.zeros((n, F), dtype=np.float32)
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    dense[rows, idx] = values
+    col = np.empty(n, dtype=object)
+    col[:] = list(dense)
+    cols = {features_col: col, label_col: labels}
+    has_qid = qids >= 0
+    if has_qid.any():
+        if not has_qid.all():
+            # a -1 run would silently become a real lambdarank query of
+            # unrelated documents
+            raise ValueError(
+                f"libsvm: {int((~has_qid).sum())} of {n} rows lack qid:; "
+                "a ranking file must tag every row (or none)")
+        cols[group_col] = qids
+    df = DataFrame(cols)
+    return df.repartition(npartitions) if npartitions > 1 else df

@@ -25,7 +25,7 @@ import sysconfig
 import numpy as np
 
 __all__ = ["available", "murmur3", "murmur3_batch", "pad_sparse",
-           "stack_rows"]
+           "parse_libsvm", "stack_rows"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastpath.cpp")
@@ -117,6 +117,38 @@ def pad_sparse(rows, K: int):
         idx[i, :k] = ri[:k].astype(np.int64)
         val[i, :k] = rv[:k]
     return idx, val
+
+
+def parse_libsvm(data: bytes):
+    """LightGBM-style libsvm text → CSR pieces:
+    (labels f64[n], qids i64[n] (-1 = absent), indptr i64[n+1],
+    indices i32[nnz], values f32[nnz])."""
+    impl = _load()
+    if impl:
+        return impl.parse_libsvm(bytes(data))
+    labels, qids, indices, values = [], [], [], []
+    indptr = [0]
+    for line in bytes(data).decode("utf-8", "replace").splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        toks = line.split()
+        labels.append(float(toks[0]))
+        qid = -1
+        for t in toks[1:]:
+            k, _, v = t.partition(":")
+            if not _:
+                raise ValueError(f"libsvm: bad feature token {t!r}")
+            if k == "qid":
+                qid = int(v)
+                continue
+            indices.append(int(k))
+            values.append(float(v))
+        qids.append(qid)
+        indptr.append(len(indices))
+    return (np.asarray(labels, np.float64), np.asarray(qids, np.int64),
+            np.asarray(indptr, np.int64), np.asarray(indices, np.int32),
+            np.asarray(values, np.float32))
 
 
 def stack_rows(rows, d: int) -> np.ndarray:

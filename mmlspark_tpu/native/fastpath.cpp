@@ -23,7 +23,9 @@
 #include <numpy/arrayobject.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <vector>
 
 static inline uint32_t rotl32(uint32_t x, int8_t r) {
   return (x << r) | (x >> (32 - r));
@@ -161,6 +163,119 @@ static PyObject* py_stack_rows(PyObject*, PyObject* args) {
   return (PyObject*)out;
 }
 
+/* parse_libsvm(data: bytes) ->
+ *   (float64 labels[n], int64 qids[n], int64 indptr[n+1],
+ *    int32 indices[nnz], float32 values[nnz])
+ * LightGBM's text format: "label [qid:Q] idx:val idx:val ... [# comment]".
+ * qid is -1 for rows without one. The input MUST be a bytes object (its
+ * buffer is NUL-terminated, which strtod/strtol parsing relies on). */
+static PyObject* py_parse_libsvm(PyObject*, PyObject* args) {
+  PyObject* bytes_obj;
+  if (!PyArg_ParseTuple(args, "S", &bytes_obj)) return nullptr;
+  const char* s = PyBytes_AS_STRING(bytes_obj);
+  const char* end = s + PyBytes_GET_SIZE(bytes_obj);
+
+  std::vector<double> labels;
+  std::vector<int64_t> qids;
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  indptr.push_back(0);
+
+  const char* p = s;
+  while (p < end) {
+    const char* eol = (const char*)memchr(p, '\n', (size_t)(end - p));
+    if (!eol) eol = end;
+    const char* hash = (const char*)memchr(p, '#', (size_t)(eol - p));
+    const char* lend = hash ? hash : eol;
+    const char* q = p;
+    while (q < lend && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+    if (q >= lend) { p = eol + 1; continue; }  /* blank / comment-only */
+
+    char* next;
+    /* PyOS_string_to_double is locale-independent (strtod reads ',' as the
+     * decimal point under e.g. de_DE, diverging from the Python fallback) */
+    double lab = PyOS_string_to_double(q, &next, nullptr);
+    if (PyErr_Occurred()) PyErr_Clear();
+    if (next == q || next > lend) {
+      PyErr_Format(PyExc_ValueError, "libsvm: bad label at byte %zd",
+                   (Py_ssize_t)(q - s));
+      return nullptr;
+    }
+    q = next;
+    int64_t qid = -1;
+    while (q < lend) {
+      while (q < lend && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+      if (q >= lend) break;
+      if (lend - q >= 4 && memcmp(q, "qid:", 4) == 0) {
+        q += 4;
+        qid = (int64_t)strtoll(q, &next, 10);
+        if (next == q) {
+          PyErr_Format(PyExc_ValueError, "libsvm: bad qid at byte %zd",
+                       (Py_ssize_t)(q - s));
+          return nullptr;
+        }
+        q = next;
+        continue;
+      }
+      long long idx = strtoll(q, &next, 10);
+      if (next == q || next >= lend || *next != ':') {
+        PyErr_Format(PyExc_ValueError,
+                     "libsvm: bad feature token at byte %zd",
+                     (Py_ssize_t)(q - s));
+        return nullptr;
+      }
+      if (idx < 0 || idx > 0x7fffffffLL) {
+        /* an unchecked (int32_t) cast would silently wrap 2^32+1 -> 1 and
+         * write the value into the wrong feature */
+        PyErr_Format(PyExc_ValueError,
+                     "libsvm: feature index %lld out of int32 range at "
+                     "byte %zd", idx, (Py_ssize_t)(q - s));
+        return nullptr;
+      }
+      q = next + 1;
+      double v = PyOS_string_to_double(q, &next, nullptr);
+      if (PyErr_Occurred()) PyErr_Clear();
+      if (next == q) {
+        PyErr_Format(PyExc_ValueError, "libsvm: bad value at byte %zd",
+                     (Py_ssize_t)(q - s));
+        return nullptr;
+      }
+      q = next;
+      indices.push_back((int32_t)idx);
+      values.push_back((float)v);
+    }
+    labels.push_back(lab);
+    qids.push_back(qid);
+    indptr.push_back((int64_t)indices.size());
+    p = eol + 1;
+  }
+
+  npy_intp n = (npy_intp)labels.size();
+  npy_intp np1 = n + 1;
+  npy_intp nnz = (npy_intp)indices.size();
+  PyArrayObject* a_lab = (PyArrayObject*)PyArray_SimpleNew(1, &n, NPY_FLOAT64);
+  PyArrayObject* a_qid = (PyArrayObject*)PyArray_SimpleNew(1, &n, NPY_INT64);
+  PyArrayObject* a_ptr = (PyArrayObject*)PyArray_SimpleNew(1, &np1, NPY_INT64);
+  PyArrayObject* a_idx = (PyArrayObject*)PyArray_SimpleNew(1, &nnz, NPY_INT32);
+  PyArrayObject* a_val = (PyArrayObject*)PyArray_SimpleNew(1, &nnz, NPY_FLOAT32);
+  if (!a_lab || !a_qid || !a_ptr || !a_idx || !a_val) {
+    Py_XDECREF(a_lab); Py_XDECREF(a_qid); Py_XDECREF(a_ptr);
+    Py_XDECREF(a_idx); Py_XDECREF(a_val);
+    return nullptr;
+  }
+  if (n) {
+    std::memcpy(PyArray_DATA(a_lab), labels.data(), (size_t)n * 8);
+    std::memcpy(PyArray_DATA(a_qid), qids.data(), (size_t)n * 8);
+  }
+  std::memcpy(PyArray_DATA(a_ptr), indptr.data(), (size_t)np1 * 8);
+  if (nnz) {
+    std::memcpy(PyArray_DATA(a_idx), indices.data(), (size_t)nnz * 4);
+    std::memcpy(PyArray_DATA(a_val), values.data(), (size_t)nnz * 4);
+  }
+  return Py_BuildValue("(NNNNN)", a_lab, a_qid, a_ptr, a_idx, a_val);
+}
+
 static PyMethodDef Methods[] = {
     {"murmur3", py_murmur3, METH_VARARGS, "murmur3(data: bytes, seed) -> uint32"},
     {"murmur3_batch", py_murmur3_batch, METH_VARARGS,
@@ -169,6 +284,8 @@ static PyMethodDef Methods[] = {
      "pad_sparse(rows, K) -> (int32[n,K], float32[n,K])"},
     {"stack_rows", py_stack_rows, METH_VARARGS,
      "stack_rows(seq, d) -> float32[n,d]"},
+    {"parse_libsvm", py_parse_libsvm, METH_VARARGS,
+     "parse_libsvm(data: bytes) -> (labels, qids, indptr, indices, values)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {

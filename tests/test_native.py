@@ -87,3 +87,114 @@ def test_pad_sparse_malformed_row_clamps_both_paths():
     np.testing.assert_array_equal(ni, fi)
     np.testing.assert_array_equal(nv, fv)
     assert nv[0, 2] == 0.0          # never reads past the values buffer
+
+
+class TestParseLibsvm:
+    DATA = (b"1 1:0.5 3:2.0 # trailing comment\n"
+            b"\n"
+            b"-1 2:1.5\n"
+            b"0 qid:7 1:1.0 4:-2.5\n"
+            b"# full-line comment\n"
+            b"2.5\n")                       # label-only row (all-zero features)
+
+    def _check(self, parse):
+        labels, qids, indptr, indices, values = parse(self.DATA)
+        np.testing.assert_allclose(labels, [1, -1, 0, 2.5])
+        np.testing.assert_array_equal(qids, [-1, -1, 7, -1])
+        np.testing.assert_array_equal(indptr, [0, 2, 3, 5, 5])
+        np.testing.assert_array_equal(indices, [1, 3, 2, 1, 4])
+        np.testing.assert_allclose(values, [0.5, 2.0, 1.5, 1.0, -2.5])
+
+    def test_python_fallback(self, monkeypatch):
+        import mmlspark_tpu.native as nat
+        monkeypatch.setattr(nat, "_impl", False)
+        self._check(nat.parse_libsvm)
+
+    def test_native_if_available(self):
+        import mmlspark_tpu.native as nat
+        if not nat.available():
+            pytest.skip("no native toolchain")
+        self._check(nat.parse_libsvm)
+
+    def test_native_matches_python(self):
+        import mmlspark_tpu.native as nat
+        if not nat.available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(0)
+        lines = []
+        for i in range(200):
+            feats = sorted(rng.choice(50, size=rng.integers(0, 8),
+                                      replace=False))
+            toks = [f"{rng.normal():.6f}"]
+            if i % 3 == 0:
+                toks.append(f"qid:{i // 10}")
+            toks += [f"{f + 1}:{rng.normal():.6f}" for f in feats]
+            lines.append(" ".join(toks))
+        data = ("\n".join(lines)).encode()
+        native = nat._load().parse_libsvm(data)
+        prev, nat._impl = nat._impl, False
+        try:
+            pure = nat.parse_libsvm(data)
+        finally:
+            nat._impl = prev
+        for a, b in zip(native, pure):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_bad_token_raises(self):
+        import mmlspark_tpu.native as nat
+        with pytest.raises(ValueError):
+            nat.parse_libsvm(b"1 nocolon\n")
+
+
+class TestReadLibsvm:
+    def test_roundtrip_to_gbdt(self, tmp_path):
+        from mmlspark_tpu.io import read_libsvm
+        from mmlspark_tpu.models.gbdt import LightGBMClassifier
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(0, 1, (200, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(int)
+        p = tmp_path / "d.svm"
+        with open(p, "w") as f:
+            for i in range(len(X)):
+                feats = " ".join(f"{j + 1}:{X[i, j]:.6f}" for j in range(6))
+                f.write(f"{y[i]} {feats}\n")
+        df = read_libsvm(str(p))
+        assert df["features"][0].shape == (6,)
+        np.testing.assert_allclose(
+            np.stack(list(df["features"])), X, rtol=1e-5, atol=1e-6)
+        m = LightGBMClassifier(num_iterations=10,
+                               min_data_in_leaf=5).fit(df)
+        acc = (np.asarray(m.transform(df)["prediction"])
+               == np.asarray(df["label"])).mean()
+        assert acc > 0.9
+
+    def test_qid_becomes_group(self, tmp_path):
+        from mmlspark_tpu.io import read_libsvm
+        p = tmp_path / "r.svm"
+        p.write_text("1 qid:1 1:0.5\n0 qid:1 1:0.1\n1 qid:2 1:0.9\n")
+        df = read_libsvm(str(p))
+        np.testing.assert_array_equal(df["group"], [1, 1, 2])
+
+    def test_zero_based_autodetect(self, tmp_path):
+        from mmlspark_tpu.io import read_libsvm
+        p = tmp_path / "z.svm"
+        p.write_text("1 0:2.0 2:3.0\n0 1:1.0\n")
+        df = read_libsvm(str(p))
+        np.testing.assert_allclose(df["features"][0], [2.0, 0.0, 3.0])
+
+
+class TestLibsvmReviewRegressions:
+    def test_out_of_range_index_errors_not_wraps(self):
+        import mmlspark_tpu.native as nat
+        if not nat.available():
+            pytest.skip("no native toolchain")
+        with pytest.raises((ValueError, OverflowError)):
+            nat._load().parse_libsvm(b"1 4294967297:2.0\n")
+
+    def test_partial_qid_coverage_rejected(self, tmp_path):
+        from mmlspark_tpu.io import read_libsvm
+        p = tmp_path / "p.svm"
+        p.write_text("1 1:0.5\n0 qid:1 1:0.1\n")
+        with pytest.raises(ValueError, match="lack qid"):
+            read_libsvm(str(p))
