@@ -59,6 +59,13 @@ class ONNXModel(Model):
                                "to the NCHW the graph expects: [0, 3, 1, 2]")
     pin_devices = Param(bool, default=True,
                         doc="round-robin partitions over local chips")
+    mesh_sharded = Param(bool, default=False,
+                         doc="SPMD inference: shard each batch's leading "
+                             "axis over the default mesh's first axis "
+                             "(params replicated) — one XLA program spans "
+                             "every chip instead of one partition per chip. "
+                             "Install a mesh with MeshContext/"
+                             "set_default_mesh; overrides pin_devices")
     external_data_dir = Param(str, default="",
                               doc="directory with sidecar files for models "
                                   "saved with external data")
@@ -200,6 +207,17 @@ class ONNXModel(Model):
             arr = arr.reshape((arr.shape[0],) + tuple(row_shape))
         return arr
 
+    def _cast_params(self, params: dict) -> dict:
+        """Float params → compute_dtype, on whatever devices hold them."""
+        if self.compute_dtype == "float32":
+            return params
+        dt = jnp.dtype(self.compute_dtype)
+        cast = jax.jit(
+            lambda p: {k: (v.astype(dt)
+                           if jnp.issubdtype(v.dtype, jnp.floating)
+                           else v) for k, v in p.items()})
+        return cast(params)
+
     def _params_for_device(self, device) -> dict:
         if device is None:
             # normalize to the concrete default device so pinned and
@@ -212,16 +230,22 @@ class ONNXModel(Model):
                 cm = self._ensure_converted()
                 # transfer in f32, cast on device: narrow-dtype host buffers
                 # (bfloat16) take a slow serialization path over the link
-                params = jax.device_put(cm.params, device)
-                if self.compute_dtype != "float32":
-                    dt = jnp.dtype(self.compute_dtype)
-                    # params are committed to `device`; jit follows operands
-                    cast = jax.jit(
-                        lambda p: {k: (v.astype(dt)
-                                       if jnp.issubdtype(v.dtype, jnp.floating)
-                                       else v) for k, v in p.items()})
-                    params = cast(params)
-                self._device_params[key] = params
+                # params are committed to `device`; the cast jit follows
+                # its operands
+                self._device_params[key] = self._cast_params(
+                    jax.device_put(cm.params, device))
+            return self._device_params[key]
+
+    def _params_for_mesh(self, mesh) -> dict:
+        """Weights replicated over the mesh (cached per mesh)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import replicated_sharding
+        key = ("mesh", mesh)
+        with self._params_lock:
+            if key not in self._device_params:
+                cm = self._ensure_converted()
+                self._device_params[key] = self._cast_params(
+                    jax.device_put(cm.params, replicated_sharding(mesh)))
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
@@ -239,8 +263,20 @@ class ONNXModel(Model):
         feed = self.feed_dict or {cm.input_names[0]: part.columns[0]}
         in_meta = {vi.name: vi for vi in cm.inputs}
 
-        device = device_for_partition(pidx) if self.pin_devices else None
-        params = self._params_for_device(device)
+        mesh = None
+        if self.get("mesh_sharded"):
+            from ..parallel.mesh import get_default_mesh
+            mesh = get_default_mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            shards = int(mesh.shape[mesh.axis_names[0]])
+            batch_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+            params = self._params_for_mesh(mesh)
+            device = None
+        else:
+            shards = 1
+            device = device_for_partition(pidx) if self.pin_devices else None
+            params = self._params_for_device(device)
 
         n = len(part)
         pending = []  # (device outputs dict, valid rows) per batch, in order
@@ -252,13 +288,20 @@ class ONNXModel(Model):
                 arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape,
                                    device_prepped=input_name in self.transpose_dict)
                 b = len(arr)
-                arr = pad_axis(arr, bucket_size(b))
+                # pad to the jit bucket AND to a multiple of the mesh's
+                # batch-axis size so the leading dim shards evenly
+                padded = bucket_size(b)
+                padded = -(-padded // shards) * shards
+                arr = pad_axis(arr, padded)
                 # explicit async put (even unpinned): the transfer enqueues
                 # immediately and overlaps the previous batch's compute,
                 # instead of riding inside the next jit dispatch
-                feeds[input_name] = (jax.device_put(arr, device)
-                                     if device is not None
-                                     else jax.device_put(arr))
+                if mesh is not None:
+                    feeds[input_name] = jax.device_put(arr, batch_sharding)
+                elif device is not None:
+                    feeds[input_name] = jax.device_put(arr, device)
+                else:
+                    feeds[input_name] = jax.device_put(arr)
             pending.append((jitted(params, feeds), b))
 
         out = part

@@ -142,7 +142,12 @@ def parse_libsvm(data: bytes):
             if k == "qid":
                 qid = int(v)
                 continue
-            indices.append(int(k))
+            ki = int(k)
+            if not (0 <= ki <= 0x7FFFFFFF):
+                # match the native parser: no silent int32 wraparound
+                raise ValueError(f"libsvm: feature index {ki} out of "
+                                 "int32 range")
+            indices.append(ki)
             values.append(float(v))
         qids.append(qid)
         indptr.append(len(indices))

@@ -210,7 +210,8 @@ static PyObject* py_parse_libsvm(PyObject*, PyObject* args) {
       if (lend - q >= 4 && memcmp(q, "qid:", 4) == 0) {
         q += 4;
         qid = (int64_t)strtoll(q, &next, 10);
-        if (next == q) {
+        if (next == q || next > lend) {  /* bound: strtoll would skip '\n'
+                                          * and eat the NEXT line's label */
           PyErr_Format(PyExc_ValueError, "libsvm: bad qid at byte %zd",
                        (Py_ssize_t)(q - s));
           return nullptr;

@@ -198,3 +198,23 @@ class TestLibsvmReviewRegressions:
         p.write_text("1 1:0.5\n0 qid:1 1:0.1\n")
         with pytest.raises(ValueError, match="lack qid"):
             read_libsvm(str(p))
+
+
+def test_libsvm_truncated_qid_errors_native():
+    import mmlspark_tpu.native as nat
+    if not nat.available():
+        pytest.skip("no native toolchain")
+    with pytest.raises(ValueError):
+        nat._load().parse_libsvm(b"1 qid:\n5 1:2.0\n")
+
+
+def test_libsvm_negative_index_rejected_both_parsers():
+    import mmlspark_tpu.native as nat
+    with pytest.raises(ValueError):
+        nat.parse_libsvm(b"1 -1:2.0\n")
+    prev, nat._impl = nat._impl, False
+    try:
+        with pytest.raises(ValueError):
+            nat.parse_libsvm(b"1 -1:2.0\n")
+    finally:
+        nat._impl = prev

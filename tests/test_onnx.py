@@ -392,3 +392,56 @@ class TestNewElementwiseOps:
         want[:4, 0] = x[:4, 0][::-1]
         want[:2, 1] = x[:2, 1][::-1]
         np.testing.assert_array_equal(got, want)
+
+
+class TestMeshShardedInference:
+    """SPMD batch-sharded ONNX inference over the default mesh."""
+
+    def _model(self):
+        rng = np.random.default_rng(7)
+        w = rng.normal(0, 0.5, (6, 4)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("MatMul", ["x", "w"], ["h"]),
+             O.make_node("Relu", ["h"], ["y"])],
+            "mlp",
+            inputs=[O.make_tensor_value_info("x", np.float32, ["N", 6])],
+            outputs=[O.make_tensor_value_info("y", np.float32, ["N", 4])],
+            initializers={"w": w})
+        return O.make_model(g), w
+
+    def test_matches_unsharded_and_pads_odd_batches(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        from mmlspark_tpu.parallel.mesh import MeshContext
+
+        mb, w = self._model()
+        rng = np.random.default_rng(0)
+        X = rng.normal(0, 1, (37, 6)).astype(np.float32)  # odd: 37 % 8 != 0
+        col = np.empty(len(X), object)
+        col[:] = list(X)
+        df = DataFrame({"x": col})
+        plain = ONNXModel(mb, feed_dict={"x": "x"}, fetch_dict={"y": "y"},
+                          mini_batch_size=16, pin_devices=False)
+        want = np.stack(list(plain.transform(df)["y"]))
+        with MeshContext({"data": 8}):
+            sharded = ONNXModel(mb, feed_dict={"x": "x"},
+                                fetch_dict={"y": "y"}, mini_batch_size=16,
+                                mesh_sharded=True)
+            got = np.stack(list(sharded.transform(df)["y"]))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(got, np.maximum(X @ w, 0), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_without_default_mesh_falls_back(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+
+        mb, w = self._model()
+        X = np.random.default_rng(1).normal(0, 1, (8, 6)).astype(np.float32)
+        col = np.empty(len(X), object)
+        col[:] = list(X)
+        m = ONNXModel(mb, feed_dict={"x": "x"}, fetch_dict={"y": "y"},
+                      mesh_sharded=True)   # no default mesh installed
+        out = np.stack(list(m.transform(DataFrame({"x": col}))["y"]))
+        np.testing.assert_allclose(out, np.maximum(X @ w, 0), rtol=1e-5,
+                                   atol=1e-5)
