@@ -30,7 +30,7 @@ from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model
 from ..ops.padding import bucket_size, pad_axis
-from ..parallel.mesh import device_for_partition
+from ..parallel.mesh import batch_placement
 from ..stages.batching import batch_slices
 
 __all__ = ["JaxModel"]
@@ -57,6 +57,10 @@ class JaxModel(Model):
                               "(bfloat16 recommended on TPU)")
     pin_devices = Param(bool, default=True,
                         doc="round-robin partitions over local chips")
+    mesh_sharded = Param(bool, default=False,
+                         doc="SPMD inference over the default mesh's first "
+                             "axis (batch sharded, params replicated); "
+                             "overrides pin_devices — see ONNXModel")
 
     def __init__(self, apply_fn: Optional[Callable] = None,
                  model_params=None, **kw):
@@ -104,6 +108,17 @@ class JaxModel(Model):
             self._jitted = jax.jit(run)
         return self._jitted
 
+    def _cast_tree(self, params):
+        """Float leaves → compute_dtype, on whatever devices hold them."""
+        if self.compute_dtype == "float32" or params is None:
+            return params
+        dt = jnp.dtype(self.compute_dtype)
+        cast = jax.jit(lambda p: jax.tree_util.tree_map(
+            lambda v: (v.astype(dt)
+                       if jnp.issubdtype(v.dtype, jnp.floating)
+                       else v), p))
+        return cast(params)
+
     def _params_for_device(self, device):
         key = id(device) if device is not None else None
         with self._params_lock:
@@ -111,24 +126,29 @@ class JaxModel(Model):
                 params = self.get_or_none("model_params")
                 # f32 over the wire, compute_dtype cast on device (narrow
                 # host buffers hit a slow transfer path; see ONNXModel)
-                params = (jax.device_put(params, device)
-                          if device is not None else jax.device_put(params))
-                if self.compute_dtype != "float32" and params is not None:
-                    dt = jnp.dtype(self.compute_dtype)
-                    cast = jax.jit(lambda p: jax.tree_util.tree_map(
-                        lambda v: (v.astype(dt)
-                                   if jnp.issubdtype(v.dtype, jnp.floating)
-                                   else v), p))
-                    params = cast(params)
-                self._device_params[key] = params
+                self._device_params[key] = self._cast_tree(
+                    jax.device_put(params, device) if device is not None
+                    else jax.device_put(params))
+            return self._device_params[key]
+
+    def _params_for_mesh(self, mesh):
+        from ..parallel.mesh import replicated_sharding
+        key = ("mesh", mesh)
+        with self._params_lock:
+            if key not in self._device_params:
+                self._device_params[key] = self._cast_tree(jax.device_put(
+                    self.get_or_none("model_params"),
+                    replicated_sharding(mesh)))
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
     def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
         jitted = self._ensure_jitted()
         feed = dict(self.feed_dict) or {"input": part.columns[0]}
-        device = device_for_partition(pidx) if self.pin_devices else None
-        params = self._params_for_device(device)
+        mesh, device, shards, put = batch_placement(
+            self.get("mesh_sharded"), pidx, self.pin_devices)
+        params = (self._params_for_mesh(mesh) if mesh is not None
+                  else self._params_for_device(device))
 
         n = len(part)
         pending = []
@@ -143,10 +163,10 @@ class JaxModel(Model):
                 if arr.dtype == np.float64:
                     arr = arr.astype(np.float32)
                 b = len(arr)
-                arr = pad_axis(arr, bucket_size(b))
-                feeds[feed_name] = (jax.device_put(arr, device)
-                                    if device is not None
-                                    else jax.device_put(arr))
+                padded = bucket_size(b)
+                padded = -(-padded // shards) * shards
+                arr = pad_axis(arr, padded)
+                feeds[feed_name] = put(arr)
             pending.append((jitted(params, feeds), b))
 
         if not pending:

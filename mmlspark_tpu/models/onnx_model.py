@@ -30,7 +30,7 @@ from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model, Transformer
 from ..onnx.convert import ConvertedModel, convert_model
 from ..ops.padding import bucket_size, pad_axis
-from ..parallel.mesh import device_for_partition, local_devices
+from ..parallel.mesh import batch_placement, local_devices
 from ..stages.batching import FixedMiniBatchTransformer, FlattenBatch, batch_slices
 
 __all__ = ["ONNXModel"]
@@ -263,20 +263,10 @@ class ONNXModel(Model):
         feed = self.feed_dict or {cm.input_names[0]: part.columns[0]}
         in_meta = {vi.name: vi for vi in cm.inputs}
 
-        mesh = None
-        if self.get("mesh_sharded"):
-            from ..parallel.mesh import get_default_mesh
-            mesh = get_default_mesh()
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            shards = int(mesh.shape[mesh.axis_names[0]])
-            batch_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-            params = self._params_for_mesh(mesh)
-            device = None
-        else:
-            shards = 1
-            device = device_for_partition(pidx) if self.pin_devices else None
-            params = self._params_for_device(device)
+        mesh, device, shards, put = batch_placement(
+            self.get("mesh_sharded"), pidx, self.pin_devices)
+        params = (self._params_for_mesh(mesh) if mesh is not None
+                  else self._params_for_device(device))
 
         n = len(part)
         pending = []  # (device outputs dict, valid rows) per batch, in order
@@ -289,19 +279,13 @@ class ONNXModel(Model):
                                    device_prepped=input_name in self.transpose_dict)
                 b = len(arr)
                 # pad to the jit bucket AND to a multiple of the mesh's
-                # batch-axis size so the leading dim shards evenly
+                # batch-axis size so the leading dim shards evenly; the
+                # explicit async put (even unpinned) enqueues the transfer
+                # immediately so it overlaps the previous batch's compute
                 padded = bucket_size(b)
                 padded = -(-padded // shards) * shards
                 arr = pad_axis(arr, padded)
-                # explicit async put (even unpinned): the transfer enqueues
-                # immediately and overlaps the previous batch's compute,
-                # instead of riding inside the next jit dispatch
-                if mesh is not None:
-                    feeds[input_name] = jax.device_put(arr, batch_sharding)
-                elif device is not None:
-                    feeds[input_name] = jax.device_put(arr, device)
-                else:
-                    feeds[input_name] = jax.device_put(arr)
+                feeds[input_name] = put(arr)
             pending.append((jitted(params, feeds), b))
 
         out = part

@@ -18,8 +18,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["local_devices", "device_for_partition", "make_mesh",
-           "data_parallel_sharding", "replicated_sharding", "MeshContext",
-           "get_default_mesh", "set_default_mesh"]
+           "batch_placement", "data_parallel_sharding", "replicated_sharding",
+           "MeshContext", "get_default_mesh", "set_default_mesh"]
 
 
 def local_devices():
@@ -51,6 +51,28 @@ def device_for_partition(partition_index: int):
     if not devs:
         return None
     return devs[partition_index % len(devs)]
+
+
+def batch_placement(use_mesh: bool, partition_index: int, pin_devices: bool):
+    """Resolve where a graph runner's host batches go — the one dispatch
+    policy shared by ONNXModel and JaxModel.
+
+    Returns ``(mesh, device, shards, put)``: when ``use_mesh`` and a default
+    mesh is installed, batches shard their leading axis over the mesh's
+    first axis (``shards`` is the multiple the batch must pad to, ``put``
+    places with that sharding, ``device`` is None). Otherwise round-robin
+    chip pinning (or default placement), with ``shards == 1``.
+    """
+    if use_mesh:
+        mesh = get_default_mesh()
+        if mesh is not None:
+            sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+            return (mesh, None, int(mesh.shape[mesh.axis_names[0]]),
+                    lambda a, _s=sh: jax.device_put(a, _s))
+    device = device_for_partition(partition_index) if pin_devices else None
+    if device is not None:
+        return None, device, 1, (lambda a, _d=device: jax.device_put(a, _d))
+    return None, None, 1, jax.device_put
 
 
 def make_mesh(axis_shapes: Optional[dict] = None,

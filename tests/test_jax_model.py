@@ -124,3 +124,28 @@ class TestJaxModel:
         feats = np.stack(list(out["features"]))
         assert feats.shape[0] == 3 and feats.ndim == 2
         assert np.isfinite(feats).all()
+
+
+def test_mesh_sharded_matches_unsharded(rng):
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.parallel.mesh import MeshContext
+    import jax.numpy as jnp
+
+    w = jnp.asarray(rng.normal(0, 0.5, (5, 3)), jnp.float32)
+
+    def apply(params, feeds):
+        return {"y": jnp.tanh(feeds["input"] @ params)}
+
+    X = rng.normal(0, 1, (21, 5)).astype(np.float32)   # 21 % 8 != 0
+    col = np.empty(len(X), object)
+    col[:] = list(X)
+    df = DataFrame({"x": col})
+    plain = JaxModel(apply, w, feed_dict={"input": "x"},
+                     mini_batch_size=16, pin_devices=False)
+    want = np.stack(list(plain.transform(df)["y"]))
+    with MeshContext({"data": 8}):
+        sharded = JaxModel(apply, w, feed_dict={"input": "x"},
+                           mini_batch_size=16, mesh_sharded=True)
+        got = np.stack(list(sharded.transform(df)["y"]))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
