@@ -21,9 +21,11 @@ Design notes (TPU-first):
 * masked logits use a large-negative constant, not ``-inf``: with ``-inf``
   a fully-masked row makes ``exp(m - m)`` produce NaN; with ``-1e30`` the
   row cleanly yields ``l == 0`` and the final divide guards it to 0.
-* the backward pass recomputes probabilities blockwise from the saved
-  ``(m, l)`` statistics in a ``lax.scan`` — O(S·block) memory, XLA-fused;
-  dq/dk/dv each come from one MXU matmul per block.
+* the backward pass is two Pallas kernels (dK/dV sweeping Q-blocks, dQ
+  sweeping K-blocks) that recompute probabilities blockwise from the saved
+  ``(m, l)`` statistics with VMEM-resident accumulators; set
+  ``MMLSPARK_TPU_FLASH_BWD=xla`` (read once at import) to fall back to an
+  equivalent ``lax.scan`` recompute.
 
 For sharded use inside a dp×tp jit (where a bare ``pallas_call`` would make
 GSPMD gather the operands onto one device) use
@@ -36,6 +38,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -47,6 +50,10 @@ __all__ = ["flash_attention", "flash_attention_sharded",
            "flash_attention_with_stats"]
 
 _NEG = -1e30
+#: backward implementation, resolved ONCE at import (the choice is traced
+#: into the jit cache, so later env changes could not take effect anyway)
+_BWD_IMPL = ("xla" if os.environ.get("MMLSPARK_TPU_FLASH_BWD", "pallas")
+             .strip().lower() in ("xla", "reference") else "pallas")
 
 
 def _auto_interpret() -> bool:
@@ -188,6 +195,179 @@ def _vmem(shape, dtype):
     return pltpu.VMEM(shape, dtype)
 
 
+def _bwd_block_recompute(q_ref, do_ref, k_ref, v_ref, mask_ref, delta_ref,
+                         m_ref, l_ref, i, j, *, scale, causal, block_q,
+                         block_k):
+    """Shared q-block×k-block recompute for both backward kernels:
+    returns (q, do, k, p, ds) in fp32."""
+    q = q_ref[0].astype(jnp.float32)                   # (bq, D)
+    do = do_ref[0].astype(jnp.float32)                 # (bq, D)
+    k = k_ref[0].astype(jnp.float32)                   # (bk, D)
+    v = v_ref[0].astype(jnp.float32)                   # (bk, D)
+    m = m_ref[0, 0][:, None]                           # (bq, 1)
+    l = l_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    linv = jnp.where(l == 0.0, 0.0, 1.0 / l)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0,
+                             (block_q, block_k))
+    if causal:
+        row = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = jnp.logical_and(valid, row >= col)
+    p = jnp.exp(jnp.where(valid, s, _NEG) - m) * \
+        valid.astype(jnp.float32) * linv                # (bq, bk)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return q, do, k, p, ds
+
+
+def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, mask_ref, delta_ref,
+                       m_ref, l_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       scale, causal, block_q, block_k, n_q):
+    """dK/dV for one K-block: sweep Q-blocks, accumulators VMEM-resident.
+    Grid (BH, n_k, n_q) — the Q sweep is innermost so dk/dv stay put."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q, do, _k, p, ds = _bwd_block_recompute(
+            q_ref, do_ref, k_ref, v_ref, mask_ref, delta_ref, m_ref, l_ref,
+            i, j, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, D)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when((i + 1) * block_q > j * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(i == n_q - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, mask_ref, delta_ref,
+                      m_ref, l_ref, dq_ref, dq_acc, *, scale, causal,
+                      block_q, block_k, n_k):
+    """dQ for one Q-block: sweep K-blocks (innermost), accumulator resident."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        _q, _do, k, _p, ds = _bwd_block_recompute(
+            q_ref, do_ref, k_ref, v_ref, mask_ref, delta_ref, m_ref, l_ref,
+            i, j, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k < (i + 1) * block_q)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == n_k - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret", "heads"))
+def _flash_bwd_pallas(q, k, v, kv_mask, o, l, m, do, *, causal, scale,
+                      block_q, block_k, interpret, heads):
+    """Pallas backward: (BH, S, D) padded operands → (dq, dk, dv)."""
+    from jax.experimental import pallas as pl
+
+    BH, S, D = q.shape
+    n_q, n_k = S // block_q, S // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                 # (BH, S)
+    # stats/delta ride as (BH, 1, S): a (1, 1, block) block keeps the
+    # sublane slot equal to the full dim (Mosaic tiling; see _flash_fwd)
+    m3, l3, d3 = m[:, None, :], l[:, None, :], delta[:, None, :]
+    mask3 = kv_mask[:, None, :]
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, y, 0))
+    kspec_j = pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, x, 0))
+    row3 = lambda b, x, y: (b, 0, y)       # (BH,1,S) per-Q-block rows
+    dkv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(BH, n_k, n_q),
+        in_specs=[
+            qspec,                                           # q by i (=y)
+            qspec,                                           # do by i
+            kspec_j,                                         # k by j (=x)
+            kspec_j,                                         # v by j
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, x, y: (b // heads, 0, x)),  # mask by j
+            pl.BlockSpec((1, 1, block_q), row3),             # delta by i
+            pl.BlockSpec((1, 1, block_q), row3),             # m by i
+            pl.BlockSpec((1, 1, block_q), row3),             # l by i
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, x, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, x, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+        scratch_shapes=[_vmem((block_k, D), jnp.float32),
+                        _vmem((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, do, k, v, mask3, d3, m3, l3)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),  # q by i
+            pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),  # do
+            pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),  # k by j
+            pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),  # v
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, x, y: (b // heads, 0, y)),          # mask
+            pl.BlockSpec((1, 1, block_q), lambda b, x, y: (b, 0, x)),  # delta
+            pl.BlockSpec((1, 1, block_q), lambda b, x, y: (b, 0, x)),  # m
+            pl.BlockSpec((1, 1, block_q), lambda b, x, y: (b, 0, x)),  # l
+        ],
+        out_specs=[pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0))],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
+        scratch_shapes=[_vmem((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, do, k, v, mask3, d3, m3, l3)[0]
+    return dq, dkv[0], dkv[1]
+
+
 def _fa_reference_block_bwd(q, k, v, mask, o, l, m, do, *, causal, scale,
                             block_k):
     """Memory-efficient backward for ONE (S, D) head: lax.scan over K blocks
@@ -248,10 +428,17 @@ def _flash_vjp_fwd(q, k, v, kv_mask, causal, scale, block_q, block_k,
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, heads,
                    res, do):
     q, k, v, kv_mask, o, l, m = res
-    mask_bh = jnp.repeat(kv_mask, heads, axis=0)           # (BH, S)
-    bwd = functools.partial(_fa_reference_block_bwd, causal=causal,
-                            scale=scale, block_k=block_k)
-    dq, dk, dv = jax.vmap(bwd)(q, k, v, mask_bh, o, l, m, do)
+    if _BWD_IMPL == "xla":
+        # escape hatch: blockwise lax.scan recompute instead of the kernels
+        mask_bh = jnp.repeat(kv_mask, heads, axis=0)       # (BH, S)
+        bwd = functools.partial(_fa_reference_block_bwd, causal=causal,
+                                scale=scale, block_k=block_k)
+        dq, dk, dv = jax.vmap(bwd)(q, k, v, mask_bh, o, l, m, do)
+        return dq, dk, dv, None
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, kv_mask, o, l, m, do,
+                                   causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret, heads=heads)
     return dq, dk, dv, None
 
 
