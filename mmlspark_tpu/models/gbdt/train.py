@@ -281,7 +281,7 @@ def train(params: Dict,
         # raw_score applies the encoder itself — feed the UN-encoded matrix
         scores = booster.raw_score(
             X_raw if X_raw.dtype == np.float32 else X_raw.astype(np.float32)
-        ).astype(np.float64)
+        ) - np.float32(base_score)
         init_trees = booster.num_trees
     else:
         init_trees = 0
@@ -289,7 +289,7 @@ def train(params: Dict,
         booster = Booster(depth, F, objective_name, base_score,
                           num_class if is_multi else 1)
         booster.cat_encoder = cat_encoder
-        scores = np.full((n, num_class) if is_multi else n, base_score)
+        scores = np.zeros((n, num_class) if is_multi else n)
 
     # device residency; shard rows when data-parallel over a mesh
     axis_name = None
@@ -311,7 +311,15 @@ def train(params: Dict,
         y_pad, w_pad = y, w
     live = np.concatenate([np.ones(n), np.zeros(n_pad - n)])
 
+    # scores live on device between iterations as the DELTA from
+    # base_score: a host round-trip of the full score vector every iteration
+    # dominates tunnel-bound training at HIGGS scale, and centering keeps
+    # f32 accumulation exact-ish (leaf deltas are small; adding them into a
+    # large absolute base like mean(y)~1e3 would round at ~6e-5 ULP each
+    # iteration). grad inputs re-add base_score on device.
+    scores = jnp.asarray(scores, jnp.float32)
     if axis_name is not None:
+        scores = jax.device_put(scores, row_sharding)
         xb_d = jax.device_put(jnp.asarray(xb), row_sharding)
         y_d = jax.device_put(jnp.asarray(y_pad), row_sharding)
         w_d = jax.device_put(jnp.asarray(w_pad), row_sharding)
@@ -404,26 +412,27 @@ def train(params: Dict,
                 tree_scale = 1.0 / (k_drop + 1.0)   # DART-paper weights
                 drop_idx = (drop_groups[:, None] * K_trees
                             + np.arange(K_trees)[None, :]).ravel()
-                dp = np.asarray(predict_trees(
+                dp = predict_trees(
                     booster.feats[drop_idx], booster.thr_raw[drop_idx],
-                    booster.leaf_values[drop_idx], X_f32, depth=depth))
-                drop_pred = np.zeros_like(np.asarray(scores))
-                drop_pred[:n] = dp
+                    booster.leaf_values[drop_idx], X_f32, depth=depth)
+                drop_pred = jnp.pad(
+                    dp, ((0, n_pad - n),) + ((0, 0),) * (dp.ndim - 1))
         elif boosting == "rf":
             tree_scale = rf_scale
 
         # trees fit gradients at: scores minus dropped trees (dart), the
         # constant init score (rf: every tree fits the same residual and
         # the 1/T-scaled sum is the forest average), else current scores
-        scores_for_grad = np.asarray(scores)
+        scores_for_grad = scores + jnp.float32(base_score)
         if drop_pred is not None:
             scores_for_grad = scores_for_grad - drop_pred
         elif boosting == "rf":
-            scores_for_grad = np.full_like(scores_for_grad, base_score)
+            scores_for_grad = jnp.full_like(scores, base_score)
 
         # gradients
         if is_rank:
-            g_np, h_np = _lambdarank_grad(scores_for_grad[:n], y, group)
+            g_np, h_np = _lambdarank_grad(
+                np.asarray(scores_for_grad[:n], dtype=np.float64), y, group)
             g_np, h_np = g_np * w, h_np * w
             if n_pad != n:
                 g_np = np.concatenate([g_np, np.zeros(n_pad - n)])
@@ -433,7 +442,7 @@ def train(params: Dict,
                 g_d = jax.device_put(g_d, row_sharding)
                 h_d = jax.device_put(h_d, row_sharding)
         else:
-            g_d, h_d = grad_fn(jnp.asarray(scores_for_grad), y_d, w_d)
+            g_d, h_d = grad_fn(scores_for_grad, y_d, w_d)
             g_d = g_d * live_d[..., None] if is_multi else g_d * live_d
             h_d = h_d * live_d[..., None] if is_multi else h_d * live_d
 
@@ -500,11 +509,9 @@ def train(params: Dict,
                 booster.append_tree(feats_np[k], thr_raw_k[k], lv,
                                     np.asarray(gains_k)[k],
                                     np.asarray(covers_k)[k])
-            # score update via leaf assignment
-            upd = np.zeros_like(np.asarray(scores))
-            for k in range(num_class):
-                upd[:, k] = np.asarray(leaf_k)[k][np.asarray(node_k)[k]] * lr_eff
-            scores = np.asarray(scores) + upd
+            # score update via leaf assignment, on device
+            upd = jax.vmap(jnp.take)(leaf_k, node_k).T * lr_eff
+            scores = scores + upd
             new_feats = feats_np
             new_thr = thr_raw_k
             new_leaf = np.stack([
@@ -522,7 +529,7 @@ def train(params: Dict,
             leaf_np = np.asarray(leaf_val) * lr_eff
             booster.append_tree(feats_np, thr_raw, leaf_np,
                                 np.asarray(gains), np.asarray(covers))
-            scores = np.asarray(scores) + leaf_np[np.asarray(node_rel)]
+            scores = scores + jnp.take(leaf_val, node_rel) * lr_eff
             new_feats = feats_np[None]
             new_thr = thr_raw[None]
             new_leaf = leaf_np[None]
@@ -533,7 +540,7 @@ def train(params: Dict,
             # 1/(k+1) difference back out (grad was taken at scores - drop)
             k_drop = len(drop_idx) // K_trees
             booster.scale_trees(drop_idx, k_drop * tree_scale)
-            scores = np.asarray(scores) - drop_pred * tree_scale
+            scores = scores - drop_pred * tree_scale
 
         # eval + early stopping (uses this iteration's trees directly so the
         # booster's lazy tree stack is not re-materialized every round)
@@ -586,8 +593,10 @@ def train(params: Dict,
                                       int(p["num_iterations"])},
                     })
                 return final
-        for cb in (callbacks or []):
-            cb(it, booster, scores)
+        if callbacks:
+            scores_np = np.asarray(scores, dtype=np.float64) + base_score
+            for cb in callbacks:
+                cb(it, booster, scores_np)
         if ckpt_iv and (it + 1) % ckpt_iv == 0:
             ckpt.save(resumed_iters + it + 1, {
                 "booster.txt": booster.to_string(),
