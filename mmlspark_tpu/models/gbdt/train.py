@@ -417,6 +417,10 @@ def train(params: Dict,
                     booster.leaf_values[drop_idx], X_f32, depth=depth)
                 drop_pred = jnp.pad(
                     dp, ((0, n_pad - n),) + ((0, 0),) * (dp.ndim - 1))
+                if axis_name is not None:
+                    # dp is committed to one device by predict_trees; the
+                    # subtraction partner is mesh-sharded
+                    drop_pred = jax.device_put(drop_pred, row_sharding)
         elif boosting == "rf":
             tree_scale = rf_scale
 

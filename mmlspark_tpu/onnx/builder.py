@@ -21,17 +21,19 @@ __all__ = ["make_node", "make_tensor", "make_external_tensor",
 
 class Node:
     def __init__(self, op_type: str, inputs: Sequence[str],
-                 outputs: Sequence[str], name: str = "", **attrs):
+                 outputs: Sequence[str], name: str = "", domain: str = "",
+                 **attrs):
         self.op_type = op_type
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.name = name or f"{op_type}_{id(self) & 0xffff:x}"
+        self.domain = domain
         self.attrs = attrs
 
 
 def make_node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
-              name: str = "", **attrs) -> Node:
-    return Node(op_type, inputs, outputs, name, **attrs)
+              name: str = "", domain: str = "", **attrs) -> Node:
+    return Node(op_type, inputs, outputs, name, domain, **attrs)
 
 
 def _encode_tensor(name: str, arr: np.ndarray) -> WireWriter:
@@ -137,6 +139,8 @@ def _encode_node(node: Node) -> WireWriter:
         w.string(2, o)
     w.string(3, node.name)
     w.string(4, node.op_type)
+    if node.domain:
+        w.string(7, node.domain)
     for k, v in node.attrs.items():
         w.message(5, _encode_attribute(k, v))
     return w

@@ -224,6 +224,161 @@ def _gelu(node, inputs, ctx):
     return jax.nn.gelu(inputs[0], approximate=approx)
 
 
+# -- com.microsoft contrib ops (ORT transformer-optimizer output) ------------
+# Real BERT-class deployments usually ship through onnxruntime's
+# transformer optimizer, which fuses subgraphs into contrib ops
+# (parity target: ONNXModel runs ORT, which executes these natively).
+# Dispatch is by op_type, domain-agnostic — same table.
+
+@register_op("FusedMatMul")
+def _fused_matmul(node, inputs, ctx):
+    a, b = inputs
+    if node.attr("transBatchA", 0) or node.attr("transBatchB", 0):
+        # batch-dim transpose is a different permutation than transA/transB;
+        # silently ignoring it would multiply the wrong operands
+        raise UnsupportedOp("FusedMatMul with transBatchA/transBatchB")
+    if node.attr("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b) * node.attr("alpha", 1.0)
+
+
+@register_op("BiasGelu")
+def _bias_gelu(node, inputs, ctx):
+    return jax.nn.gelu(inputs[0] + inputs[1], approximate=False)
+
+
+@register_op("FastGelu")
+def _fast_gelu(node, inputs, ctx):
+    x = inputs[0]
+    if len(inputs) > 1 and inputs[1] is not None:
+        x = x + inputs[1]
+    return jax.nn.gelu(x, approximate=True)
+
+
+@register_op("QuickGelu")
+def _quick_gelu(node, inputs, ctx):
+    alpha = node.attr("alpha", 1.702)
+    return inputs[0] * jax.nn.sigmoid(alpha * inputs[0])
+
+
+def _layernorm_last(x, gamma, beta, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mu) * inv * gamma
+    if beta is not None:
+        y = y + beta
+    return y.astype(x.dtype), mu, inv
+
+
+@register_op("SkipLayerNormalization")
+def _skip_layernorm(node, inputs, ctx):
+    x, skip = inputs[0], inputs[1]
+    gamma = inputs[2]
+    beta = inputs[3] if len(inputs) > 3 else None
+    bias = inputs[4] if len(inputs) > 4 else None
+    total = x + skip
+    if bias is not None:
+        total = total + bias
+    y, mu, inv = _layernorm_last(total, gamma, beta,
+                                 node.attr("epsilon", 1e-12))
+    # outputs: out, (mean), (inv_std_var), (input_skip_bias_sum)
+    return y, mu[..., 0], inv[..., 0], total
+
+
+@register_op("EmbedLayerNormalization")
+def _embed_layernorm(node, inputs, ctx):
+    (ids, seg_ids, word_emb, pos_emb) = inputs[0], inputs[1], inputs[2], inputs[3]
+    seg_emb = inputs[4] if len(inputs) > 4 else None
+    gamma = inputs[5] if len(inputs) > 5 else None
+    beta = inputs[6] if len(inputs) > 6 else None
+    mask = inputs[7] if len(inputs) > 7 else None
+    pos_ids = inputs[8] if len(inputs) > 8 else None
+    B, S = ids.shape
+    x = jnp.take(word_emb, ids.astype(jnp.int32), axis=0)
+    if pos_ids is None:
+        x = x + pos_emb[:S][None, :, :]
+    else:
+        x = x + jnp.take(pos_emb, pos_ids.astype(jnp.int32), axis=0)
+    if seg_emb is not None and seg_ids is not None:
+        x = x + jnp.take(seg_emb, seg_ids.astype(jnp.int32), axis=0)
+    y, _mu, _inv = _layernorm_last(x, gamma, beta,
+                                   node.attr("epsilon", 1e-12))
+    mask_index = (jnp.sum(mask.astype(jnp.int32), axis=1)
+                  if mask is not None
+                  else jnp.full((B,), S, jnp.int32))
+    return y, mask_index.astype(jnp.int32), x
+
+
+@register_op("Attention")
+def _msft_attention(node, inputs, ctx):
+    """ORT fused multi-head attention. Supported surface: equal q/k/v hidden
+    sizes, no past state; mask as (B, S) 0/1 or (B,) right-pad lengths;
+    ``unidirectional`` → causal. Runs the Pallas flash kernel on TPU, dense
+    XLA attention elsewhere."""
+    if node.domain != "com.microsoft":
+        # the standard ai.onnx Attention (opset 23) takes Q/K/V inputs —
+        # treating its K as a packed QKV weight matrix would be silent junk
+        raise UnsupportedOp(
+            f"Attention in domain {node.domain!r} (only the com.microsoft "
+            "fused form — input/weights/bias — is implemented)")
+    x, w, b = inputs[0], inputs[1], inputs[2]
+    mask_index = inputs[3] if len(inputs) > 3 else None
+    if len(inputs) > 4 and inputs[4] is not None:
+        raise UnsupportedOp("Attention with past state")
+    if len(inputs) > 5 and inputs[5] is not None:
+        raise UnsupportedOp("Attention with attention_bias / extra_add_qk")
+    heads = node.attr("num_heads")
+    if heads is None:
+        raise UnsupportedOp("Attention without num_heads")
+    qkv_sizes = node.attr("qkv_hidden_sizes")
+    if qkv_sizes and len(set(qkv_sizes)) != 1:
+        raise UnsupportedOp(f"Attention qkv_hidden_sizes {qkv_sizes}")
+    causal = bool(node.attr("unidirectional", 0))
+    B, S, _ = x.shape
+    hidden = w.shape[1] // 3
+    D = hidden // heads
+    qkv = jnp.matmul(x, w)                              # (B, S, 3*hidden)
+    if b is not None:                                   # bias is optional
+        qkv = qkv + b
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def split_heads(t):
+        return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    kv_mask = None
+    if mask_index is not None:
+        if mask_index.ndim == 2:                        # (B, S) 0/1
+            kv_mask = mask_index.astype(bool)
+        elif mask_index.ndim == 1 and mask_index.shape[0] == B:
+            kv_mask = (jnp.arange(S)[None, :]
+                       < mask_index.astype(jnp.int32)[:, None])
+        else:
+            raise UnsupportedOp(
+                f"Attention mask_index shape {mask_index.shape}")
+    if jax.default_backend() == "tpu":
+        from ..ops.flash_attention import flash_attention
+        ctx_out = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                                  scale=scale)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        neg = jnp.float32(-1e30)
+        if kv_mask is not None:
+            s = jnp.where(kv_mask[:, None, None, :], s, neg)
+        if causal:
+            tri = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(tri[None, None], s, neg)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        ctx_out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return ctx_out.transpose(0, 2, 1, 3).reshape(B, S, hidden)
+
+
 @register_op("PRelu")
 def _prelu(node, inputs, ctx):
     x, slope = inputs

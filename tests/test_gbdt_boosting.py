@@ -226,3 +226,16 @@ class TestVotingParallel:
                     "tree_learner": "voting_parallel", "top_k": 2},
                    X, y, mesh=self._mesh())
         assert _auc(y, vp.predict(X)) > 0.8
+
+
+def test_dart_on_data_parallel_mesh(rng):
+    import jax
+    from jax.sharding import Mesh
+
+    X, y = _binary_data(rng, n=600, f=8)
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("data",))
+    b = train({**BASE, "num_iterations": 10, "boosting": "dart",
+               "skip_drop": 0.0, "drop_rate": 0.5,
+               "tree_learner": "data_parallel"}, X, y, mesh=mesh)
+    assert b.num_trees == 10
+    assert _auc(y, b.predict(X)) > 0.85

@@ -186,3 +186,136 @@ def test_bert_pooled_sentence_embedding(opset):
     want = (hidden * m).sum(axis=1) / m.sum(axis=1)
     assert pooled.shape == (ids.shape[0], CFG.d_model)
     np.testing.assert_allclose(pooled, want, rtol=2e-4, atol=2e-5)
+
+
+class TestMicrosoftContribOps:
+    """ORT transformer-optimizer fused ops (com.microsoft domain) — what
+    real optimized BERT exports contain."""
+
+    def _run(self, nodes, feeds, inits, outs):
+        ins = [make_tensor_value_info(n, a.dtype.type, list(a.shape))
+               for n, a in feeds.items()]
+        g = make_graph(nodes, "t", ins,
+                       [make_tensor_value_info(o, np.float32, []) for o in outs],
+                       initializers=inits)
+        cm = convert_model(make_model(g))
+        r = cm(cm.params, feeds)
+        return {o: np.asarray(r[o]) for o in outs}
+
+    def test_fused_matmul_and_gelus(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 1, (3, 4)).astype(np.float32)
+        b = rng.normal(0, 1, (5, 4)).astype(np.float32)
+        bias = rng.normal(0, 1, (5,)).astype(np.float32)
+        out = self._run(
+            [make_node("FusedMatMul", ["a", "b"], ["mm"], transB=1, alpha=0.5),
+             make_node("BiasGelu", ["mm", "bias"], ["bg"]),
+             make_node("FastGelu", ["mm", "bias"], ["fg"]),
+             make_node("QuickGelu", ["mm"], ["qg"])],
+            {"a": a}, {"b": b, "bias": bias}, ["bg", "fg", "qg"])
+        import math
+        mm = 0.5 * (a @ b.T)
+        x = mm + bias
+        erf = np.vectorize(math.erf)
+        want_bg = x * 0.5 * (1.0 + erf(x / np.sqrt(2.0)))
+        np.testing.assert_allclose(out["bg"], want_bg, rtol=1e-5, atol=1e-5)
+        want_fg = 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                         * (x + 0.044715 * x ** 3)))
+        np.testing.assert_allclose(out["fg"], want_fg, rtol=1e-4, atol=1e-4)
+        want_qg = mm / (1 + np.exp(-1.702 * mm))
+        np.testing.assert_allclose(out["qg"], want_qg, rtol=1e-5, atol=1e-5)
+
+    def test_skip_layernorm(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (2, 3, 8)).astype(np.float32)
+        skip = rng.normal(0, 1, (2, 3, 8)).astype(np.float32)
+        gamma = rng.normal(1, 0.1, (8,)).astype(np.float32)
+        beta = rng.normal(0, 0.1, (8,)).astype(np.float32)
+        bias = rng.normal(0, 0.1, (8,)).astype(np.float32)
+        out = self._run(
+            [make_node("SkipLayerNormalization", ["x", "s", "g", "b", "bi"],
+                       ["y"], epsilon=1e-5)],
+            {"x": x, "s": skip}, {"g": gamma, "b": beta, "bi": bias}, ["y"])
+        t = x + skip + bias
+        mu = t.mean(-1, keepdims=True)
+        want = (t - mu) / np.sqrt(t.var(-1, keepdims=True) + 1e-5) * gamma + beta
+        np.testing.assert_allclose(out["y"], want, rtol=1e-4, atol=1e-4)
+
+    def test_embed_layernorm(self):
+        rng = np.random.default_rng(2)
+        V, P, H = 20, 10, 8
+        ids = rng.integers(0, V, (2, 6)).astype(np.int64)
+        seg = rng.integers(0, 2, (2, 6)).astype(np.int64)
+        mask = np.ones((2, 6), np.int64); mask[0, 4:] = 0
+        we = rng.normal(0, 1, (V, H)).astype(np.float32)
+        pe = rng.normal(0, 1, (P, H)).astype(np.float32)
+        se = rng.normal(0, 1, (2, H)).astype(np.float32)
+        gamma = np.ones(H, np.float32); beta = np.zeros(H, np.float32)
+        ins = [make_tensor_value_info("ids", np.int64, [2, 6]),
+               make_tensor_value_info("seg", np.int64, [2, 6]),
+               make_tensor_value_info("mask", np.int64, [2, 6])]
+        g = make_graph(
+            [make_node("EmbedLayerNormalization",
+                       ["ids", "seg", "we", "pe", "se", "g", "b", "mask"],
+                       ["y", "mi"])],
+            "t", ins,
+            [make_tensor_value_info("y", np.float32, []),
+             make_tensor_value_info("mi", np.int32, [])],
+            initializers={"we": we, "pe": pe, "se": se, "g": gamma, "b": beta})
+        cm = convert_model(make_model(g))
+        r = cm(cm.params, {"ids": ids, "seg": seg, "mask": mask})
+        emb = we[ids] + pe[:6][None] + se[seg]
+        mu = emb.mean(-1, keepdims=True)
+        want = (emb - mu) / np.sqrt(emb.var(-1, keepdims=True) + 1e-12)
+        np.testing.assert_allclose(np.asarray(r["y"]), want, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(r["mi"]), [4, 6])
+
+    def test_fused_attention_matches_reference(self):
+        rng = np.random.default_rng(3)
+        B, S, H, heads = 2, 5, 8, 2
+        x = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+        w = rng.normal(0, 0.3, (H, 3 * H)).astype(np.float32)
+        b = rng.normal(0, 0.1, (3 * H,)).astype(np.float32)
+        lens = np.array([3, 5], np.int32)   # (B,) right-pad lengths form
+        ins = [make_tensor_value_info("x", np.float32, [B, S, H]),
+               make_tensor_value_info("lens", np.int32, [B])]
+        g = make_graph(
+            [make_node("Attention", ["x", "w", "b", "lens"], ["y"],
+                       domain="com.microsoft", num_heads=heads)],
+            "t", ins, [make_tensor_value_info("y", np.float32, [])],
+            initializers={"w": w, "b": b})
+        cm = convert_model(make_model(g))
+        got = np.asarray(cm(cm.params, {"x": x, "lens": lens})["y"])
+        # numpy reference
+        qkv = x @ w + b
+        q, k, v = np.split(qkv, 3, axis=-1)
+        D = H // heads
+        def sh(t):
+            return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+        q, k, v = sh(q), sh(k), sh(v)
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        kvm = np.arange(S)[None, :] < lens[:, None]
+        s = np.where(kvm[:, None, None, :], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3).reshape(B, S, H)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_attention_rejects_past_state(self):
+        import pytest as _pt
+        from mmlspark_tpu.onnx.convert import UnsupportedOp
+        x = np.zeros((1, 2, 4), np.float32)
+        w = np.zeros((4, 12), np.float32)
+        b = np.zeros(12, np.float32)
+        past = np.zeros((2, 1, 2, 2, 2), np.float32)
+        ins = [make_tensor_value_info("x", np.float32, [1, 2, 4]),
+               make_tensor_value_info("past", np.float32, list(past.shape))]
+        g = make_graph(
+            [make_node("Attention", ["x", "w", "b", "", "past"], ["y"],
+                       domain="com.microsoft", num_heads=2)],
+            "t", ins, [make_tensor_value_info("y", np.float32, [])],
+            initializers={"w": w, "b": b})
+        cm = convert_model(make_model(g))
+        with _pt.raises(UnsupportedOp):
+            cm(cm.params, {"x": x, "past": past})
