@@ -313,6 +313,153 @@ def _embed_layernorm(node, inputs, ctx):
     return y, mask_index.astype(jnp.int32), x
 
 
+def _decode_mask_index(mask_index, B, S, op_name):
+    """ORT mask forms shared by Attention/MultiHeadAttention:
+    (B, S) 0/1 mask or (B,) right-pad lengths → (B, S) bool."""
+    if mask_index is None:
+        return None
+    if mask_index.ndim == 2:
+        return mask_index.astype(bool)
+    if mask_index.ndim == 1 and mask_index.shape[0] == B:
+        return (jnp.arange(S)[None, :]
+                < mask_index.astype(jnp.int32)[:, None])
+    raise UnsupportedOp(f"{op_name} mask_index shape {mask_index.shape}")
+
+
+def _attention_core(q, k, v, kv_mask, causal, scale):
+    """(B, H, S, D) attention shared by the fused ops: Pallas flash kernel
+    on TPU, dense XLA elsewhere."""
+    if jax.default_backend() == "tpu" and q.shape[2] == k.shape[2]:
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                               scale=scale)
+    S_q, S_k = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    neg = jnp.float32(-1e30)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, neg)
+    if causal:
+        tri = jnp.tril(jnp.ones((S_q, S_k), bool))
+        s = jnp.where(tri[None, None], s, neg)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _rms_norm(x, gamma, eps):
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * gamma).astype(x.dtype), inv
+
+
+@register_op("SimplifiedLayerNormalization")
+def _simplified_layernorm(node, inputs, ctx):
+    # RMS norm (the Llama-family normalization; ORT emits this contrib op)
+    y, _ = _rms_norm(inputs[0], inputs[1], node.attr("epsilon", 1e-6))
+    return y
+
+
+@register_op("RMSNormalization")
+def _rms_normalization(node, inputs, ctx):
+    # standard ai.onnx RMSNormalization (opset 23) — same math
+    if node.attr("axis", -1) not in (-1, inputs[0].ndim - 1):
+        raise UnsupportedOp("RMSNormalization over a non-last axis")
+    y, _ = _rms_norm(inputs[0], inputs[1], node.attr("epsilon", 1e-5))
+    return y
+
+
+@register_op("SkipSimplifiedLayerNormalization")
+def _skip_simplified_layernorm(node, inputs, ctx):
+    x, skip, gamma = inputs[0], inputs[1], inputs[2]
+    bias = inputs[3] if len(inputs) > 3 else None
+    total = x + skip
+    if bias is not None:
+        total = total + bias
+    y, inv = _rms_norm(total, gamma, node.attr("epsilon", 1e-12))
+    return y, None, inv[..., 0], total
+
+
+@register_op("RotaryEmbedding")
+def _rotary_embedding(node, inputs, ctx):
+    """com.microsoft RotaryEmbedding: (B, S, H) or (B, heads, S, D) input
+    with position_ids + cos/sin caches; ``interleaved`` pairs (x0,x1) as
+    adjacent elements, else split-half rotation."""
+    x, pos_ids, cos_cache, sin_cache = inputs[:4]
+    interleaved = bool(node.attr("interleaved", 0))
+    rot_dim = 2 * cos_cache.shape[-1]
+    orig_rank = x.ndim
+    if orig_rank == 3:
+        heads = node.attr("num_heads", 0)
+        if not heads:
+            raise UnsupportedOp("RotaryEmbedding 3-D input without num_heads")
+        B, S, H = x.shape
+        x = x.reshape(B, S, heads, H // heads).transpose(0, 2, 1, 3)
+    B, NH, S, D = x.shape
+    if pos_ids.ndim == 1 and pos_ids.shape[0] == 1:
+        # spec: shape (1) is a per-sequence OFFSET — position s rotates at
+        # pos_ids[0] + s (the decode-phase form), not a constant position
+        pos_ids = pos_ids[0] + jnp.arange(S)[None, :]
+        pos_ids = jnp.broadcast_to(pos_ids, (B, S))
+    elif pos_ids.ndim != 2:
+        raise UnsupportedOp(
+            f"RotaryEmbedding position_ids shape {pos_ids.shape}")
+    cos = jnp.take(cos_cache, pos_ids.astype(jnp.int32), axis=0)  # (B,S,rd/2)
+    sin = jnp.take(sin_cache, pos_ids.astype(jnp.int32), axis=0)
+    cos = cos[:, None, :, :]
+    sin = sin[:, None, :, :]
+    xr, xpass = x[..., :rot_dim], x[..., rot_dim:]
+    if interleaved:
+        x0, x1 = xr[..., 0::2], xr[..., 1::2]
+        r0 = x0 * cos - x1 * sin
+        r1 = x0 * sin + x1 * cos
+        rot = jnp.stack([r0, r1], axis=-1).reshape(xr.shape)
+    else:
+        half = rot_dim // 2
+        x0, x1 = xr[..., :half], xr[..., half:]
+        rot = jnp.concatenate([x0 * cos - x1 * sin,
+                               x0 * sin + x1 * cos], axis=-1)
+    out = jnp.concatenate([rot, xpass], axis=-1)
+    if orig_rank == 3:
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, NH * D)
+    return out
+
+
+@register_op("MultiHeadAttention")
+def _msft_mha(node, inputs, ctx):
+    """com.microsoft MultiHeadAttention: separate (B, S, H) q/k/v inputs.
+    Supported surface: no past/attention_bias, optional packed bias,
+    key_padding_mask as (B, S_kv) 0/1 or (B,) lengths."""
+    if node.domain != "com.microsoft":
+        raise UnsupportedOp(
+            f"MultiHeadAttention in domain {node.domain!r}")
+    q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
+    bias = inputs[3] if len(inputs) > 3 else None
+    mask_index = inputs[4] if len(inputs) > 4 else None
+    if any(i is not None for i in inputs[5:]):
+        raise UnsupportedOp("MultiHeadAttention with attention_bias/past")
+    if k_in.ndim != 3 or v_in.ndim != 3:
+        raise UnsupportedOp("MultiHeadAttention packed/5-D KV layouts")
+    heads = node.attr("num_heads")
+    if heads is None:
+        raise UnsupportedOp("MultiHeadAttention without num_heads")
+    B, Sq, H = q_in.shape
+    Sk = k_in.shape[1]
+    D = H // heads
+    if bias is not None:
+        qb, kb, vb = bias[:H], bias[H:2 * H], bias[2 * H:]
+        q_in, k_in, v_in = q_in + qb, k_in + kb, v_in + vb
+
+    def split(t, S):
+        return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+
+    q, k, v = split(q_in, Sq), split(k_in, Sk), split(v_in, Sk)
+    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    kv_mask = _decode_mask_index(mask_index, B, Sk, "MultiHeadAttention")
+    causal = bool(node.attr("unidirectional", 0))
+    out = _attention_core(q, k, v, kv_mask, causal, scale)
+    return out.transpose(0, 2, 1, 3).reshape(B, Sq, H)
+
+
 @register_op("Attention")
 def _msft_attention(node, inputs, ctx):
     """ORT fused multi-head attention. Supported surface: equal q/k/v hidden
@@ -331,6 +478,9 @@ def _msft_attention(node, inputs, ctx):
         raise UnsupportedOp("Attention with past state")
     if len(inputs) > 5 and inputs[5] is not None:
         raise UnsupportedOp("Attention with attention_bias / extra_add_qk")
+    if node.attr("do_rotary", 0):
+        raise UnsupportedOp("Attention with do_rotary (use a separate "
+                            "RotaryEmbedding node)")
     heads = node.attr("num_heads")
     if heads is None:
         raise UnsupportedOp("Attention without num_heads")
@@ -351,31 +501,8 @@ def _msft_attention(node, inputs, ctx):
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     scale = node.attr("scale", 1.0 / float(D) ** 0.5)
-    kv_mask = None
-    if mask_index is not None:
-        if mask_index.ndim == 2:                        # (B, S) 0/1
-            kv_mask = mask_index.astype(bool)
-        elif mask_index.ndim == 1 and mask_index.shape[0] == B:
-            kv_mask = (jnp.arange(S)[None, :]
-                       < mask_index.astype(jnp.int32)[:, None])
-        else:
-            raise UnsupportedOp(
-                f"Attention mask_index shape {mask_index.shape}")
-    if jax.default_backend() == "tpu":
-        from ..ops.flash_attention import flash_attention
-        ctx_out = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
-                                  scale=scale)
-    else:
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * scale
-        neg = jnp.float32(-1e30)
-        if kv_mask is not None:
-            s = jnp.where(kv_mask[:, None, None, :], s, neg)
-        if causal:
-            tri = jnp.tril(jnp.ones((S, S), bool))
-            s = jnp.where(tri[None, None], s, neg)
-        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        ctx_out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    kv_mask = _decode_mask_index(mask_index, B, S, "Attention")
+    ctx_out = _attention_core(q, k, v, kv_mask, causal, scale)
     return ctx_out.transpose(0, 2, 1, 3).reshape(B, S, hidden)
 
 

@@ -319,3 +319,142 @@ class TestMicrosoftContribOps:
         cm = convert_model(make_model(g))
         with _pt.raises(UnsupportedOp):
             cm(cm.params, {"x": x, "past": past})
+
+
+class TestLlamaEraContribOps:
+    """SimplifiedLayerNorm (RMS), RotaryEmbedding, MultiHeadAttention —
+    what ORT emits for Llama/GQA-era models."""
+
+    def _cm(self, nodes, feed_infos, inits, out_names):
+        g = make_graph(nodes, "t", feed_infos,
+                       [make_tensor_value_info(o, np.float32, [])
+                        for o in out_names],
+                       initializers=inits)
+        return convert_model(make_model(g))
+
+    def test_rms_norm_variants(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (2, 3, 8)).astype(np.float32)
+        skip = rng.normal(0, 1, (2, 3, 8)).astype(np.float32)
+        gamma = rng.normal(1, 0.1, (8,)).astype(np.float32)
+        cm = self._cm(
+            [make_node("SimplifiedLayerNormalization", ["x", "g"], ["a"],
+                       epsilon=1e-6),
+             make_node("RMSNormalization", ["x", "g"], ["b"], epsilon=1e-6),
+             make_node("SkipSimplifiedLayerNormalization",
+                       ["x", "s", "g"], ["c"], epsilon=1e-6)],
+            [make_tensor_value_info("x", np.float32, [2, 3, 8]),
+             make_tensor_value_info("s", np.float32, [2, 3, 8])],
+            {"g": gamma}, ["a", "b", "c"])
+        r = cm(cm.params, {"x": x, "s": skip})
+
+        def rms(t):
+            return t / np.sqrt((t * t).mean(-1, keepdims=True) + 1e-6) * gamma
+
+        np.testing.assert_allclose(np.asarray(r["a"]), rms(x), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(r["b"]), rms(x), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(r["c"]), rms(x + skip),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("interleaved", [0, 1])
+    def test_rotary_embedding(self, interleaved):
+        rng = np.random.default_rng(1)
+        B, NH, S, D = 1, 2, 4, 6
+        x = rng.normal(0, 1, (B, NH, S, D)).astype(np.float32)
+        pos = np.arange(S, dtype=np.int64)[None, :].repeat(B, 0)
+        inv = 1.0 / (10000 ** (np.arange(0, D, 2) / D))
+        ang = np.arange(16)[:, None] * inv[None, :]
+        cos_c = np.cos(ang).astype(np.float32)
+        sin_c = np.sin(ang).astype(np.float32)
+        cm = self._cm(
+            [make_node("RotaryEmbedding", ["x", "p", "c", "s"], ["y"],
+                       domain="com.microsoft", interleaved=interleaved)],
+            [make_tensor_value_info("x", np.float32, [B, NH, S, D]),
+             make_tensor_value_info("p", np.int64, [B, S])],
+            {"c": cos_c, "s": sin_c}, ["y"])
+        got = np.asarray(cm(cm.params, {"x": x, "p": pos})["y"])
+        cos = cos_c[pos][:, None]; sin = sin_c[pos][:, None]
+        if interleaved:
+            x0, x1 = x[..., 0::2], x[..., 1::2]
+            want = np.stack([x0 * cos - x1 * sin,
+                             x0 * sin + x1 * cos], -1).reshape(x.shape)
+        else:
+            h = D // 2
+            x0, x1 = x[..., :h], x[..., h:]
+            want = np.concatenate([x0 * cos - x1 * sin,
+                                   x0 * sin + x1 * cos], -1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_multi_head_attention(self):
+        rng = np.random.default_rng(2)
+        B, S, H, heads = 2, 5, 8, 2
+        q = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+        k = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+        v = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+        mask = np.ones((B, S), np.int32); mask[0, 3:] = 0
+        cm = self._cm(
+            [make_node("MultiHeadAttention", ["q", "k", "v", "", "m"], ["y"],
+                       domain="com.microsoft", num_heads=heads)],
+            [make_tensor_value_info("q", np.float32, [B, S, H]),
+             make_tensor_value_info("k", np.float32, [B, S, H]),
+             make_tensor_value_info("v", np.float32, [B, S, H]),
+             make_tensor_value_info("m", np.int32, [B, S])],
+            {}, ["y"])
+        got = np.asarray(cm(cm.params, {"q": q, "k": k, "v": v, "m": mask})["y"])
+        D = H // heads
+        def sh(t):
+            return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+        s = np.einsum("bhqd,bhkd->bhqk", sh(q), sh(k)) / np.sqrt(D)
+        s = np.where(mask.astype(bool)[:, None, None, :], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, sh(v)).transpose(0, 2, 1, 3).reshape(B, S, H)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_mha_unidirectional_and_rotary_offset():
+    # causal MHA (review regression) + RotaryEmbedding (1,)-offset form
+    rng = np.random.default_rng(4)
+    B, S, H, heads = 1, 4, 8, 2
+    q = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+    g = make_graph(
+        [make_node("MultiHeadAttention", ["q", "q", "q"], ["y"],
+                   domain="com.microsoft", num_heads=heads,
+                   unidirectional=1)],
+        "t", [make_tensor_value_info("q", np.float32, [B, S, H])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q})["y"])
+    D = H // heads
+    def sh(t):
+        return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+    s = np.einsum("bhqd,bhkd->bhqk", sh(q), sh(q)) / np.sqrt(D)
+    s = np.where(np.tril(np.ones((S, S), bool))[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, sh(q)).transpose(0, 2, 1, 3).reshape(B, S, H)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # rotary offset: (1,) position_ids means pos = offset + arange(S)
+    NH, D2 = 2, 6
+    x = rng.normal(0, 1, (1, NH, S, D2)).astype(np.float32)
+    inv = 1.0 / (10000 ** (np.arange(0, D2, 2) / D2))
+    ang = np.arange(16)[:, None] * inv[None, :]
+    cos_c, sin_c = np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32)
+    off = np.array([3], np.int64)
+    g2 = make_graph(
+        [make_node("RotaryEmbedding", ["x", "p", "c", "s"], ["y"],
+                   domain="com.microsoft")],
+        "t", [make_tensor_value_info("x", np.float32, [1, NH, S, D2]),
+              make_tensor_value_info("p", np.int64, [1])],
+        [make_tensor_value_info("y", np.float32, [])],
+        initializers={"c": cos_c, "s": sin_c})
+    cm2 = convert_model(make_model(g2))
+    got2 = np.asarray(cm2(cm2.params, {"x": x, "p": off})["y"])
+    pos = (3 + np.arange(S))[None, :]
+    cos = cos_c[pos][:, None]; sin = sin_c[pos][:, None]
+    h = D2 // 2
+    x0, x1 = x[..., :h], x[..., h:]
+    want2 = np.concatenate([x0 * cos - x1 * sin, x0 * sin + x1 * cos], -1)
+    np.testing.assert_allclose(got2, want2, rtol=1e-5, atol=1e-5)
