@@ -326,10 +326,12 @@ def _decode_mask_index(mask_index, B, S, op_name):
     raise UnsupportedOp(f"{op_name} mask_index shape {mask_index.shape}")
 
 
-def _attention_core(q, k, v, kv_mask, causal, scale):
+def _attention_core(q, k, v, kv_mask, causal, scale, pair_mask=None):
     """(B, H, S, D) attention shared by the fused ops: Pallas flash kernel
-    on TPU, dense XLA elsewhere."""
-    if jax.default_backend() == "tpu" and q.shape[2] == k.shape[2]:
+    on TPU, dense XLA elsewhere. ``pair_mask`` is an optional (Sq, Sk)
+    boolean mask (the ai.onnx 2-D form, trailing-dim aligned)."""
+    if (jax.default_backend() == "tpu" and q.shape[2] == k.shape[2]
+            and pair_mask is None):
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                                scale=scale)
@@ -339,8 +341,12 @@ def _attention_core(q, k, v, kv_mask, causal, scale):
     neg = jnp.float32(-1e30)
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :], s, neg)
+    if pair_mask is not None:
+        s = jnp.where(pair_mask[None, None, :, :], s, neg)
     if causal:
-        tri = jnp.tril(jnp.ones((S_q, S_k), bool))
+        # query i sees keys j <= i + (S_k - S_q): ORT's convention aligns
+        # the diagonal to the END of the key sequence when lengths differ
+        tri = jnp.tril(jnp.ones((S_q, S_k), bool), k=S_k - S_q)
         s = jnp.where(tri[None, None], s, neg)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
@@ -355,6 +361,9 @@ def _rms_norm(x, gamma, eps):
 @register_op("SimplifiedLayerNormalization")
 def _simplified_layernorm(node, inputs, ctx):
     # RMS norm (the Llama-family normalization; ORT emits this contrib op)
+    if node.attr("axis", -1) not in (-1, inputs[0].ndim - 1):
+        raise UnsupportedOp("SimplifiedLayerNormalization over a "
+                            "non-last axis")
     y, _ = _rms_norm(inputs[0], inputs[1], node.attr("epsilon", 1e-6))
     return y
 
@@ -372,6 +381,10 @@ def _rms_normalization(node, inputs, ctx):
 def _skip_simplified_layernorm(node, inputs, ctx):
     x, skip, gamma = inputs[0], inputs[1], inputs[2]
     bias = inputs[3] if len(inputs) > 3 else None
+    if len(node.output) > 1 and node.output[1]:
+        # RMS norm has no mean; a consumer of output 1 would receive None
+        raise UnsupportedOp(
+            "SkipSimplifiedLayerNormalization mean output")
     total = x + skip
     if bias is not None:
         total = total + bias
@@ -384,7 +397,15 @@ def _rotary_embedding(node, inputs, ctx):
     """com.microsoft RotaryEmbedding: (B, S, H) or (B, heads, S, D) input
     with position_ids + cos/sin caches; ``interleaved`` pairs (x0,x1) as
     adjacent elements, else split-half rotation."""
-    x, pos_ids, cos_cache, sin_cache = inputs[:4]
+    if node.domain == "com.microsoft":
+        x, pos_ids, cos_cache, sin_cache = inputs[:4]
+    else:
+        # standard ai.onnx RotaryEmbedding (opset 23) orders the caches
+        # before position_ids
+        x, cos_cache, sin_cache = inputs[:3]
+        pos_ids = inputs[3] if len(inputs) > 3 else None
+        if pos_ids is None:
+            raise UnsupportedOp("RotaryEmbedding without position_ids")
     interleaved = bool(node.attr("interleaved", 0))
     rot_dim = 2 * cos_cache.shape[-1]
     orig_rank = x.ndim
@@ -460,6 +481,79 @@ def _msft_mha(node, inputs, ctx):
     return out.transpose(0, 2, 1, 3).reshape(B, Sq, H)
 
 
+def _std_attention(node, inputs, ctx):
+    """Standard ai.onnx Attention (opset 23): Q (B, Hq, Sq, D), K/V
+    (B, Hkv, Skv, D) — 4-D form; GQA via Hq % Hkv == 0 head repetition."""
+    q, k, v = inputs[0], inputs[1], inputs[2]
+    attn_mask = inputs[3] if len(inputs) > 3 else None
+    if any(i is not None for i in inputs[4:]):
+        raise UnsupportedOp("ai.onnx Attention with past state")
+    if q.ndim != 4:
+        raise UnsupportedOp("ai.onnx Attention 3-D form (set num_heads "
+                            "layouts are not implemented)")
+    Hq, Hkv = q.shape[1], k.shape[1]
+    if Hq % Hkv:
+        raise UnsupportedOp(f"Attention q_num_heads {Hq} not a multiple of "
+                            f"kv_num_heads {Hkv}")
+    if Hkv != Hq:                      # GQA: repeat KV heads
+        k = jnp.repeat(k, Hq // Hkv, axis=1)
+        v = jnp.repeat(v, Hq // Hkv, axis=1)
+    causal = bool(node.attr("is_causal", 0))
+    scale = node.attr("scale", 1.0 / float(q.shape[-1]) ** 0.5)
+    pair_mask = None
+    if attn_mask is not None:
+        # spec: the mask broadcasts against (B, H, Sq, Skv) aligned at the
+        # TRAILING dims, so a 2-D mask is (Sq, Skv) — not a padding mask
+        if attn_mask.ndim == 2 and attn_mask.dtype == jnp.bool_ \
+                and attn_mask.shape == (q.shape[2], k.shape[2]):
+            pair_mask = attn_mask
+        else:
+            raise UnsupportedOp(
+                f"Attention mask shape {attn_mask.shape} dtype "
+                f"{attn_mask.dtype} (only boolean (q_seq, kv_seq))")
+    return _attention_core(q, k, v, None, causal, scale,
+                           pair_mask=pair_mask)
+
+
+@register_op("GroupQueryAttention")
+def _gqa(node, inputs, ctx):
+    """com.microsoft GroupQueryAttention, prefill form (no past/cache):
+    packed or separate q/k/v, kv_num_heads < num_heads via repetition."""
+    q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
+    # inputs 3/4 = past_key/past_value (kv cache), 5 = seqlens_k,
+    # 6 = total_sequence_length, 7+ = cos/sin caches; real exports always
+    # carry seqlens_k/total_sequence_length, even in prefill
+    if any(i is not None for i in inputs[3:5]) or \
+            any(i is not None for i in inputs[7:]):
+        raise UnsupportedOp("GroupQueryAttention with kv cache/rotary inputs")
+    seqlens_k = inputs[5] if len(inputs) > 5 else None
+    heads = node.attr("num_heads")
+    kv_heads = node.attr("kv_num_heads")
+    if not heads or not kv_heads:
+        raise UnsupportedOp("GroupQueryAttention without num_heads/"
+                            "kv_num_heads")
+    if k_in is None or v_in is None:
+        raise UnsupportedOp("GroupQueryAttention packed-QKV layout")
+    B, S, Hq = q_in.shape
+    D = Hq // heads
+
+    def split(t, nh):
+        return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+
+    q = split(q_in, heads)
+    k = jnp.repeat(split(k_in, kv_heads), heads // kv_heads, axis=1)
+    v = jnp.repeat(split(v_in, kv_heads), heads // kv_heads, axis=1)
+    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    kv_mask = None
+    if seqlens_k is not None:
+        # seqlens_k[b] = valid key count - 1 (ORT contrib spec)
+        kv_mask = (jnp.arange(S)[None, :]
+                   <= seqlens_k.astype(jnp.int32).reshape(-1)[:, None])
+    # GQA is causal by construction in ORT's decoder graphs
+    out = _attention_core(q, k, v, kv_mask, True, scale)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, Hq)
+
+
 @register_op("Attention")
 def _msft_attention(node, inputs, ctx):
     """ORT fused multi-head attention. Supported surface: equal q/k/v hidden
@@ -467,11 +561,8 @@ def _msft_attention(node, inputs, ctx):
     ``unidirectional`` → causal. Runs the Pallas flash kernel on TPU, dense
     XLA attention elsewhere."""
     if node.domain != "com.microsoft":
-        # the standard ai.onnx Attention (opset 23) takes Q/K/V inputs —
-        # treating its K as a packed QKV weight matrix would be silent junk
-        raise UnsupportedOp(
-            f"Attention in domain {node.domain!r} (only the com.microsoft "
-            "fused form — input/weights/bias — is implemented)")
+        # the standard ai.onnx Attention (opset 23) takes Q/K/V tensors
+        return _std_attention(node, inputs, ctx)
     x, w, b = inputs[0], inputs[1], inputs[2]
     mask_index = inputs[3] if len(inputs) > 3 else None
     if len(inputs) > 4 and inputs[4] is not None:

@@ -458,3 +458,102 @@ def test_mha_unidirectional_and_rotary_offset():
     x0, x1 = x[..., :h], x[..., h:]
     want2 = np.concatenate([x0 * cos - x1 * sin, x0 * sin + x1 * cos], -1)
     np.testing.assert_allclose(got2, want2, rtol=1e-5, atol=1e-5)
+
+
+def test_standard_attention_qkv_and_gqa():
+    rng = np.random.default_rng(5)
+    B, Hq, Hkv, S, D = 1, 4, 2, 6, 4
+    q = rng.normal(0, 1, (B, Hq, S, D)).astype(np.float32)
+    k = rng.normal(0, 1, (B, Hkv, S, D)).astype(np.float32)
+    v = rng.normal(0, 1, (B, Hkv, S, D)).astype(np.float32)
+    # standard ai.onnx Attention (domain ""), 4-D GQA form, causal
+    g = make_graph(
+        [make_node("Attention", ["q", "k", "v"], ["y"], is_causal=1)],
+        "t", [make_tensor_value_info(n, np.float32, list(t.shape))
+              for n, t in [("q", q), ("k", k), ("v", v)]],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q, "k": k, "v": v})["y"])
+    kr = np.repeat(k, Hq // Hkv, 1)
+    vr = np.repeat(v, Hq // Hkv, 1)
+    s = np.einsum("bhqd,bhkd->bhqk", q, kr) / np.sqrt(D)
+    s = np.where(np.tril(np.ones((S, S), bool))[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, vr)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # com.microsoft GroupQueryAttention, (B, S, H) packed-row form
+    q2 = rng.normal(0, 1, (B, S, Hq * D)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    g2 = make_graph(
+        [make_node("GroupQueryAttention", ["q", "k", "v"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv)],
+        "t", [make_tensor_value_info(n, np.float32, list(t.shape))
+              for n, t in [("q", q2), ("k", k2), ("v", v2)]],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm2 = convert_model(make_model(g2))
+    got2 = np.asarray(cm2(cm2.params, {"q": q2, "k": k2, "v": v2})["y"])
+    def sh(t, nh):
+        return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+    qh = sh(q2, Hq)
+    kh = np.repeat(sh(k2, Hkv), Hq // Hkv, 1)
+    vh = np.repeat(sh(v2, Hkv), Hq // Hkv, 1)
+    s2 = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    s2 = np.where(np.tril(np.ones((S, S), bool))[None, None], s2, -1e30)
+    p2 = np.exp(s2 - s2.max(-1, keepdims=True)); p2 /= p2.sum(-1, keepdims=True)
+    want2 = np.einsum("bhqk,bhkd->bhqd", p2, vh).transpose(0, 2, 1, 3).reshape(B, S, Hq * D)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_seqlens_and_std_attention_pair_mask():
+    rng = np.random.default_rng(6)
+    B, Hq, Hkv, S, D = 2, 4, 2, 6, 4
+    # GQA with the always-present seqlens_k / total_sequence_length inputs
+    q2 = rng.normal(0, 1, (B, S, Hq * D)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    seqlens = np.array([3, 5], np.int32)     # valid keys = seqlens + 1
+    total = np.array(S, np.int32)
+    g = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "k", "v", "", "", "sl", "tl"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv)],
+        "t", [make_tensor_value_info("q", np.float32, [B, S, Hq * D]),
+              make_tensor_value_info("k", np.float32, [B, S, Hkv * D]),
+              make_tensor_value_info("v", np.float32, [B, S, Hkv * D]),
+              make_tensor_value_info("sl", np.int32, [B]),
+              make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q2, "k": k2, "v": v2,
+                                    "sl": seqlens, "tl": total})["y"])
+
+    def sh(t, nh):
+        return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+    qh = sh(q2, Hq)
+    kh = np.repeat(sh(k2, Hkv), Hq // Hkv, 1)
+    vh = np.repeat(sh(v2, Hkv), Hq // Hkv, 1)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    kvm = np.arange(S)[None, :] <= seqlens[:, None]
+    s = np.where(kvm[:, None, None, :], s, -1e30)
+    s = np.where(np.tril(np.ones((S, S), bool))[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, vh).transpose(0, 2, 1, 3).reshape(B, S, Hq * D)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # standard Attention with a 2-D (Sq, Skv) pair mask (banded)
+    q4 = rng.normal(0, 1, (1, 2, S, D)).astype(np.float32)
+    band = np.abs(np.arange(S)[:, None] - np.arange(S)[None, :]) <= 2
+    g2 = make_graph(
+        [make_node("Attention", ["q", "q", "q", "m"], ["y"])],
+        "t", [make_tensor_value_info("q", np.float32, [1, 2, S, D]),
+              make_tensor_value_info("m", np.bool_, [S, S])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm2 = convert_model(make_model(g2))
+    got2 = np.asarray(cm2(cm2.params, {"q": q4, "m": band})["y"])
+    s2 = np.einsum("bhqd,bhkd->bhqk", q4, q4) / np.sqrt(D)
+    s2 = np.where(band[None, None], s2, -1e30)
+    p2 = np.exp(s2 - s2.max(-1, keepdims=True)); p2 /= p2.sum(-1, keepdims=True)
+    want2 = np.einsum("bhqk,bhkd->bhqd", p2, q4)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-4)
