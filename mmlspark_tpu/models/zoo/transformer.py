@@ -25,7 +25,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
-           "train_step", "param_shardings", "BERT_BASE", "BERT_MINI"]
+           "train_step", "param_shardings", "BERT_BASE", "BERT_MINI",
+           "DECODER_MINI"]
 
 
 class TransformerConfig(NamedTuple):
@@ -53,6 +54,13 @@ class TransformerConfig(NamedTuple):
     #: better-defined output, but flip-sensitive if a consumer pools padded
     #: rows without masking
     use_flash: bool = False
+    #: decoder (Llama-family) switches: causal attention, RMSNorm instead
+    #: of LayerNorm, rotary position embeddings instead of the learned
+    #: position table
+    causal: bool = False
+    norm: str = "layernorm"        # "layernorm" | "rmsnorm"
+    position: str = "learned"      # "learned" | "rope"
+    rope_theta: float = 10000.0
 
     def is_moe_layer(self, i: int) -> bool:
         return (self.moe_experts > 0 and self.moe_every > 0
@@ -60,6 +68,10 @@ class TransformerConfig(NamedTuple):
 
 
 BERT_BASE = TransformerConfig()
+#: Llama-style decoder shape (causal + RMSNorm + RoPE); small enough to test
+DECODER_MINI = TransformerConfig(vocab=1024, layers=4, d_model=256, heads=8,
+                                 d_ff=1024, max_len=128, causal=True,
+                                 norm="rmsnorm", position="rope")
 BERT_MINI = TransformerConfig(vocab=1024, layers=4, d_model=256, heads=8,
                               d_ff=1024, max_len=128)
 
@@ -71,24 +83,28 @@ def init_transformer(cfg: TransformerConfig, seed: int = 0) -> Dict:
         s = scale or np.sqrt(2.0 / (din + dout))
         return rng.normal(0, s, (din, dout)).astype(np.float32)
 
+    def norm_p():
+        p = {"scale": np.ones(cfg.d_model, np.float32)}
+        if cfg.norm != "rmsnorm":       # RMSNorm has no bias
+            p["bias"] = np.zeros(cfg.d_model, np.float32)
+        return p
+
     params: Dict = {
-        "embed": {"tok": dense(cfg.vocab, cfg.d_model, 0.02),
-                  "pos": dense(cfg.max_len, cfg.d_model, 0.02)},
+        "embed": {"tok": dense(cfg.vocab, cfg.d_model, 0.02)},
         "layers": [],
-        "final_ln": {"scale": np.ones(cfg.d_model, np.float32),
-                     "bias": np.zeros(cfg.d_model, np.float32)},
+        "final_ln": norm_p(),
         "lm_head": {"w": dense(cfg.d_model, cfg.vocab, 0.02)},
     }
+    if cfg.position == "learned":
+        params["embed"]["pos"] = dense(cfg.max_len, cfg.d_model, 0.02)
     for i in range(cfg.layers):
         layer = {
-            "ln1": {"scale": np.ones(cfg.d_model, np.float32),
-                    "bias": np.zeros(cfg.d_model, np.float32)},
+            "ln1": norm_p(),
             "qkv": {"w": dense(cfg.d_model, 3 * cfg.d_model),
                     "b": np.zeros(3 * cfg.d_model, np.float32)},
             "out": {"w": dense(cfg.d_model, cfg.d_model),
                     "b": np.zeros(cfg.d_model, np.float32)},
-            "ln2": {"scale": np.ones(cfg.d_model, np.float32),
-                    "bias": np.zeros(cfg.d_model, np.float32)},
+            "ln2": norm_p(),
         }
         if cfg.is_moe_layer(i):
             from ...parallel.moe import init_moe_params
@@ -106,12 +122,16 @@ def init_transformer(cfg: TransformerConfig, seed: int = 0) -> Dict:
 
 def param_shardings(mesh: Mesh) -> Dict:
     """PartitionSpec pytree matching ``init_transformer`` (Megatron layout)."""
-    def layer_spec(is_moe: bool = False):
+    def norm_spec(lp):
+        return {k: P() for k in lp}
+
+    def layer_spec(is_moe: bool = False, lp=None):
+        lp = lp or {}
         spec = {
-            "ln1": {"scale": P(), "bias": P()},
+            "ln1": norm_spec(lp.get("ln1", {"scale": 0, "bias": 0})),
             "qkv": {"w": P(None, "tp"), "b": P("tp")},      # column-parallel
             "out": {"w": P("tp", None), "b": P()},          # row-parallel
-            "ln2": {"scale": P(), "bias": P()},
+            "ln2": norm_spec(lp.get("ln2", {"scale": 0, "bias": 0})),
         }
         if is_moe:
             # experts over dp (GShard: ep == dp), expert hidden over tp
@@ -131,14 +151,18 @@ def param_shardings(mesh: Mesh) -> Dict:
         "final_ln": {"scale": P(), "bias": P()},
         "lm_head": {"w": P(None, "tp")},
         "_layer_template": layer_spec,
+        "_norm_template": norm_spec,
     }
 
 
 def shardings_for(params: Dict, mesh: Mesh) -> Dict:
     spec = param_shardings(mesh)
     template = spec.pop("_layer_template")
-    spec["layers"] = [template(is_moe="moe" in lp)
+    norm_template = spec.pop("_norm_template")
+    spec["layers"] = [template(is_moe="moe" in lp, lp=lp)
                       for lp in params["layers"]]
+    spec["embed"] = {k: spec["embed"][k] for k in params["embed"]}
+    spec["final_ln"] = norm_template(params["final_ln"])
     return jax.tree.map(lambda p: NamedSharding(mesh, p), spec,
                         is_leaf=lambda x: isinstance(x, P))
 
@@ -147,6 +171,35 @@ def _ln(x, p, eps=1e-5):
     m = jnp.mean(x, axis=-1, keepdims=True)
     v = jnp.var(x, axis=-1, keepdims=True)
     return (x - m) * jax.lax.rsqrt(v + eps) * p["scale"] + p["bias"]
+
+
+def _rms(x, p, eps=1e-6):
+    inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return x * inv * p["scale"]
+
+
+def _norm(x, p, cfg):
+    return _rms(x, p) if cfg.norm == "rmsnorm" else _ln(x, p)
+
+
+def _rope(q, k, theta: float):
+    """Rotary position embeddings on (B, H, S, D) q/k (split-half form)."""
+    D = q.shape[-1]
+    if D % 2:
+        raise ValueError(f"rotary embeddings need an even head dim, got {D} "
+                         f"(d_model/heads)")
+    half = D // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = jnp.arange(q.shape[2], dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, None].astype(q.dtype)
+    sin = jnp.sin(ang)[None, None].astype(q.dtype)
+
+    def rot(t):
+        t0, t1 = t[..., :half], t[..., half:]
+        return jnp.concatenate([t0 * cos - t1 * sin,
+                                t0 * sin + t1 * cos], axis=-1)
+
+    return rot(q), rot(k)
 
 
 def transformer_apply(params: Dict, ids: jnp.ndarray,
@@ -161,6 +214,10 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
     ``dropped``: over-capacity token count} — a functional return, not an
     out-parameter, so it survives jit (a mutated-dict argument would be a
     trace-local copy)."""
+    if cfg.norm not in ("layernorm", "rmsnorm"):
+        raise ValueError(f"cfg.norm {cfg.norm!r} (layernorm | rmsnorm)")
+    if cfg.position not in ("learned", "rope"):
+        raise ValueError(f"cfg.position {cfg.position!r} (learned | rope)")
     dt = cfg.dtype
     B, S = ids.shape
 
@@ -170,8 +227,9 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
         return x
 
     moe_aux = {"balance": jnp.float32(0.0), "dropped": jnp.float32(0.0)}
-    h = params["embed"]["tok"].astype(dt)[ids] + \
-        params["embed"]["pos"].astype(dt)[:S][None, :, :]
+    h = params["embed"]["tok"].astype(dt)[ids]
+    if cfg.position == "learned":
+        h = h + params["embed"]["pos"].astype(dt)[:S][None, :, :]
     # sequence-parallel region: activations sharded (dp, tp) on (B, S)
     h = constrain(h, P("dp", "tp", None))
 
@@ -181,7 +239,7 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
         bias = None
 
     for lp in params["layers"]:
-        x = _ln(h.astype(jnp.float32), lp["ln1"]).astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
         x = constrain(x, P("dp", None, None))  # gather sequence for attention
         qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
         qkv = constrain(qkv, P("dp", None, "tp"))
@@ -192,19 +250,27 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
             return t.reshape(B, S, cfg.heads, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if cfg.position == "rope":
+            q, k = _rope(q, k, cfg.rope_theta)
         if cfg.use_flash:
             from ...ops.flash_attention import (flash_attention,
                                                 flash_attention_sharded)
             if mesh is not None:
-                ctx = flash_attention_sharded(q, k, v, mesh, kv_mask=mask)
+                ctx = flash_attention_sharded(q, k, v, mesh, kv_mask=mask,
+                                              causal=cfg.causal)
             else:
-                ctx = flash_attention(q, k, v, kv_mask=mask)
+                ctx = flash_attention(q, k, v, kv_mask=mask,
+                                      causal=cfg.causal)
             ctx = ctx.astype(dt)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32) / np.sqrt(hd)
             if bias is not None:
                 scores = scores + bias
+            if cfg.causal:
+                tri = jnp.tril(jnp.ones((S, S), bool))
+                scores = jnp.where(tri[None, None], scores,
+                                   jnp.float32(-1e9))
             attn = jax.nn.softmax(scores, axis=-1).astype(dt)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v,
                              preferred_element_type=dt)
@@ -212,7 +278,7 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
         proj = ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
         h = h + constrain(proj, P("dp", "tp", None))  # back to sequence-parallel
 
-        x = _ln(h.astype(jnp.float32), lp["ln2"]).astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
         x = constrain(x, P("dp", None, None))
         if "moe" in lp:
             from ...parallel.moe import moe_capacity, moe_ffn_gspmd
@@ -229,7 +295,7 @@ def transformer_apply(params: Dict, ids: jnp.ndarray,
             y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
         h = h + constrain(y, P("dp", "tp", None))
 
-    hidden = _ln(h.astype(jnp.float32), params["final_ln"]).astype(dt)
+    hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
     return (hidden, moe_aux) if return_aux else hidden
 
 
