@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
            "train_step", "param_shardings", "BERT_BASE", "BERT_MINI",
-           "DECODER_MINI"]
+           "DECODER_MINI", "generate"]
 
 
 class TransformerConfig(NamedTuple):
@@ -316,3 +316,50 @@ def train_step(params, opt_state, ids, labels, cfg: TransformerConfig,
     new_m = jax.tree.map(lambda m, g: 0.9 * m + g, opt_state, grads)
     new_p = jax.tree.map(lambda p, m: p - lr * m, params, new_m)
     return new_p, new_m, loss
+
+
+def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             seed: int = 0):
+    """Autoregressive generation from a causal config (greedy when
+    ``temperature == 0``, else softmax sampling).
+
+    One jitted program: the sequence is padded to prompt+new length and the
+    whole forward runs each step — causality guarantees position ``t``'s
+    logits never see the not-yet-generated tail, so no KV-cache machinery
+    is needed for correctness (the cache is a latency optimization this
+    zoo model omits; cost is O(steps · full-forward)).
+    """
+    if not cfg.causal:
+        raise ValueError("generate() needs cfg.causal=True")
+    # numpy params indexed by a traced token array would force a tracer
+    # →numpy conversion inside the scan
+    params = jax.tree.map(jnp.asarray, params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P_len = prompt_ids.shape
+    if P_len < 1:
+        raise ValueError("generate() needs at least one prompt token "
+                         "(an empty prompt would condition on padding)")
+    L = P_len + max_new_tokens
+    if L > cfg.max_len and cfg.position == "learned":
+        raise ValueError(f"prompt+new = {L} exceeds max_len {cfg.max_len}")
+    ids0 = jnp.pad(prompt_ids, ((0, 0), (0, max_new_tokens)))
+    key0 = jax.random.PRNGKey(seed)
+
+    def step(carry, t):
+        ids, key = carry
+        hidden = transformer_apply(params, ids, cfg)
+        logits = (hidden[:, t - 1].astype(jnp.float32)
+                  @ params["lm_head"]["w"])
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        ids = jax.lax.dynamic_update_slice(
+            ids, nxt[:, None].astype(ids.dtype), (0, t))
+        return (ids, key), nxt
+
+    (ids, _), _ = jax.lax.scan(step, (ids0, key0),
+                               jnp.arange(P_len, L))
+    return ids

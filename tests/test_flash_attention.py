@@ -299,3 +299,36 @@ class TestDecoderConfig:
         step = jax.jit(functools.partial(train_step, cfg=cfg, mesh=mesh))
         _p, _o, loss = step(params, opt, ids, labels)
         assert np.isfinite(float(loss))
+
+
+def test_decoder_generate_greedy_and_sampled(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     generate,
+                                                     init_transformer,
+                                                     transformer_apply)
+
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=32, dtype=jnp.float32,
+                            causal=True, norm="rmsnorm", position="rope")
+    params = init_transformer(cfg, seed=0)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)))
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
+    # greedy consistency: token at position t is the argmax of the logits
+    # given the prefix up to t
+    hidden = transformer_apply(params, out, cfg)
+    logits = np.asarray(hidden.astype(jnp.float32) @ params["lm_head"]["w"])
+    for t in range(4, 10):
+        assert int(np.asarray(out)[0, t]) == int(logits[0, t - 1].argmax())
+    # sampling runs and differs across seeds (vocab 64, 6 steps)
+    s1 = generate(params, prompt, cfg, max_new_tokens=6, temperature=1.0,
+                  seed=1)
+    s2 = generate(params, prompt, cfg, max_new_tokens=6, temperature=1.0,
+                  seed=2)
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+    # non-causal configs and empty prompts are rejected
+    with pytest.raises(ValueError, match="causal"):
+        generate(params, prompt, cfg._replace(causal=False))
+    with pytest.raises(ValueError, match="prompt token"):
+        generate(params, prompt[:, :0], cfg)
