@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
            "train_step", "param_shardings", "BERT_BASE", "BERT_MINI",
-           "DECODER_MINI", "generate"]
+           "DECODER_MINI", "generate", "generate_cached",
+           "decode_step", "init_kv_cache"]
 
 
 class TransformerConfig(NamedTuple):
@@ -182,24 +183,31 @@ def _norm(x, p, cfg):
     return _rms(x, p) if cfg.norm == "rmsnorm" else _ln(x, p)
 
 
-def _rope(q, k, theta: float):
-    """Rotary position embeddings on (B, H, S, D) q/k (split-half form)."""
-    D = q.shape[-1]
+def _rope_tables(positions, D: int, theta: float, dtype):
+    """cos/sin tables for split-half rotation at the given positions
+    (any shape); shared by the full forward and the cached decode step."""
     if D % 2:
         raise ValueError(f"rotary embeddings need an even head dim, got {D} "
                          f"(d_model/heads)")
     half = D // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    ang = jnp.arange(q.shape[2], dtype=jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, None].astype(q.dtype)
-    sin = jnp.sin(ang)[None, None].astype(q.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
 
-    def rot(t):
-        t0, t1 = t[..., :half], t[..., half:]
-        return jnp.concatenate([t0 * cos - t1 * sin,
-                                t0 * sin + t1 * cos], axis=-1)
 
-    return rot(q), rot(k)
+def _rot_half(t, cos, sin):
+    half = t.shape[-1] // 2
+    t0, t1 = t[..., :half], t[..., half:]
+    return jnp.concatenate([t0 * cos - t1 * sin,
+                            t0 * sin + t1 * cos], axis=-1)
+
+
+def _rope(q, k, theta: float):
+    """Rotary position embeddings on (B, H, S, D) q/k (split-half form)."""
+    cos, sin = _rope_tables(jnp.arange(q.shape[2]), q.shape[-1], theta,
+                            q.dtype)
+    cos, sin = cos[None, None], sin[None, None]
+    return _rot_half(q, cos, sin), _rot_half(k, cos, sin)
 
 
 def transformer_apply(params: Dict, ids: jnp.ndarray,
@@ -346,20 +354,136 @@ def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
     ids0 = jnp.pad(prompt_ids, ((0, 0), (0, max_new_tokens)))
     key0 = jax.random.PRNGKey(seed)
 
-    def step(carry, t):
-        ids, key = carry
+    def step(ids, t):
         hidden = transformer_apply(params, ids, cfg)
         logits = (hidden[:, t - 1].astype(jnp.float32)
                   @ params["lm_head"]["w"])
         if temperature > 0:
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            # fold_in by position: the cached generator derives the same
+            # key at the same emit position, keeping the two paths
+            # seed-compatible
+            nxt = jax.random.categorical(jax.random.fold_in(key0, t),
+                                         logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         ids = jax.lax.dynamic_update_slice(
             ids, nxt[:, None].astype(ids.dtype), (0, t))
-        return (ids, key), nxt
+        return ids, nxt
 
-    (ids, _), _ = jax.lax.scan(step, (ids0, key0),
-                               jnp.arange(P_len, L))
+    ids, _ = jax.lax.scan(step, ids0, jnp.arange(P_len, L))
+    return ids
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """Per-layer (B, H, L, D) key/value buffers for incremental decoding."""
+    hd = cfg.d_model // cfg.heads
+    shape = (batch, cfg.heads, max_len, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.layers)]
+
+
+def _rope_at(t, pos, theta: float):
+    """Rotate a single-position (B, H, 1, D) tensor at (traced) ``pos``."""
+    cos, sin = _rope_tables(jnp.asarray(pos), t.shape[-1], theta, t.dtype)
+    return _rot_half(t, cos[None, None, None], sin[None, None, None])
+
+
+def decode_step(params: Dict, token: jnp.ndarray, pos, cache,
+                cfg: TransformerConfig):
+    """One incremental decode step: ``token`` (B,) int at position ``pos``
+    → (logits (B, vocab), updated cache). The KV-cache latency path of
+    :func:`generate` — O(L) attention per step instead of a full forward."""
+    if cfg.moe_experts:
+        raise ValueError("cached decoding does not support MoE layers")
+    dt = cfg.dtype
+    B = token.shape[0]
+    L = cache[0]["k"].shape[2]
+    hd = cfg.d_model // cfg.heads
+    h = params["embed"]["tok"].astype(dt)[token][:, None, :]  # (B, 1, D)
+    if cfg.position == "learned":
+        h = h + jax.lax.dynamic_slice_in_dim(
+            params["embed"]["pos"].astype(dt), pos, 1, axis=0)[None]
+    new_cache = []
+    key_mask = (jnp.arange(L) <= pos)[None, None, :]          # (1, 1, L)
+    for lp, c in zip(params["layers"], cache):
+        x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
+        qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads1(t):
+            return t.reshape(B, 1, cfg.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads1(q), heads1(k), heads1(v)
+        if cfg.position == "rope":
+            q = _rope_at(q, pos, cfg.rope_theta)
+            k = _rope_at(k, pos, cfg.rope_theta)
+        kc = jax.lax.dynamic_update_slice(c["k"], k.astype(dt),
+                                          (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(c["v"], v.astype(dt),
+                                          (0, 0, pos, 0))
+        new_cache.append({"k": kc, "v": vc})
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = jnp.where(key_mask[:, :, None, :], s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
+                         preferred_element_type=dt)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, cfg.d_model)
+        h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
+        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
+        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        h = h + y
+    # round to cfg.dtype exactly like transformer_apply, so greedy cached
+    # decoding cannot diverge from the full forward on bf16 configs
+    hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
+    logits = hidden[:, 0].astype(jnp.float32) @ params["lm_head"]["w"]
+    return logits, new_cache
+
+
+def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
+                    max_new_tokens: int = 32, temperature: float = 0.0,
+                    seed: int = 0):
+    """KV-cached :func:`generate`: O(L) attention per emitted token.
+
+    The prompt prefills the cache token-by-token through the same
+    ``decode_step`` (a zoo model: simplicity over a batched prefill)."""
+    if not cfg.causal:
+        raise ValueError("generate_cached() needs cfg.causal=True")
+    params = jax.tree.map(jnp.asarray, params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P_len = prompt_ids.shape
+    if P_len < 1:
+        raise ValueError("generate_cached() needs at least one prompt token")
+    L = P_len + max_new_tokens
+    if L > cfg.max_len and cfg.position == "learned":
+        raise ValueError(f"prompt+new = {L} exceeds max_len {cfg.max_len}")
+    cache = init_kv_cache(cfg, B, L)
+    ids0 = jnp.pad(prompt_ids, ((0, 0), (0, max_new_tokens)))
+
+    key0 = jax.random.PRNGKey(seed)
+
+    def step(carry, t):
+        ids, cache = carry
+        token = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
+        logits, cache = decode_step(params, token, t, cache, cfg)
+        if temperature > 0:
+            # keyed by EMIT position (t+1), matching generate() exactly —
+            # prefill steps consume no randomness
+            nxt = jax.random.categorical(
+                jax.random.fold_in(key0, t + 1),
+                logits.astype(jnp.float32) / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # scan covers t = 0..L-2, so t+1 is always a valid position; only
+        # write past the prompt (prompt positions keep their tokens)
+        keep = t + 1 >= P_len
+        cur = jax.lax.dynamic_slice_in_dim(ids, t + 1, 1, axis=1)[:, 0]
+        upd = jnp.where(keep, nxt.astype(ids.dtype), cur)
+        ids = jax.lax.dynamic_update_slice(ids, upd[:, None], (0, t + 1))
+        return (ids, cache), None
+
+    (ids, _), _ = jax.lax.scan(step, (ids0, cache), jnp.arange(L - 1))
+    # the final position's token comes from the last step's write; the scan
+    # covers t = 0..L-2, emitting into positions P_len..L-1
     return ids

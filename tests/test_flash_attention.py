@@ -332,3 +332,38 @@ def test_decoder_generate_greedy_and_sampled(rng):
         generate(params, prompt, cfg._replace(causal=False))
     with pytest.raises(ValueError, match="prompt token"):
         generate(params, prompt[:, :0], cfg)
+
+
+def test_generate_cached_matches_full_recompute(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     generate,
+                                                     generate_cached,
+                                                     init_transformer)
+    for position, norm in [("rope", "rmsnorm"), ("learned", "layernorm")]:
+        cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                                d_ff=128, max_len=32, dtype=jnp.float32,
+                                causal=True, norm=norm, position=position)
+        params = init_transformer(cfg, seed=3)
+        prompt = jnp.asarray(rng.integers(0, 64, (2, 5)))
+        full = np.asarray(generate(params, prompt, cfg, max_new_tokens=7))
+        cached = np.asarray(generate_cached(params, prompt, cfg,
+                                            max_new_tokens=7))
+        np.testing.assert_array_equal(cached, full,
+                                      err_msg=str((position, norm)))
+
+
+def test_generate_cached_sampling_seed_compatible(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     generate,
+                                                     generate_cached,
+                                                     init_transformer)
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=32, dtype=jnp.float32,
+                            causal=True, norm="rmsnorm", position="rope")
+    params = init_transformer(cfg, seed=4)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 4)))   # P_len > 1
+    a = np.asarray(generate(params, prompt, cfg, max_new_tokens=6,
+                            temperature=1.0, seed=9))
+    b = np.asarray(generate_cached(params, prompt, cfg, max_new_tokens=6,
+                                   temperature=1.0, seed=9))
+    np.testing.assert_array_equal(a, b)
