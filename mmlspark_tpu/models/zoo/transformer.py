@@ -326,9 +326,38 @@ def train_step(params, opt_state, ids, labels, cfg: TransformerConfig,
     return new_p, new_m, loss
 
 
+def _sample_logits(logits, key, temperature: float, top_k: int,
+                   top_p: float):
+    """Greedy (temperature 0) or filtered sampling shared by both
+    generators: optional top-k truncation then nucleus (top-p) truncation,
+    applied to (B, V) float32 logits."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    need_k = top_k > 0
+    need_p = 0.0 < top_p < 1.0
+    if need_k or need_p:
+        # ONE descending sort serves both filters (per emitted token,
+        # inside the decode scan — worth not doing twice)
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        if need_k:
+            k = min(int(top_k), logits.shape[-1])   # oversized k = no-op
+            logits = jnp.where(logits < sorted_l[:, k - 1][:, None],
+                               -jnp.inf, logits)
+        if need_p:
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix with mass >= top_p (always >= 1)
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+            cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None],
+                                         axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
              max_new_tokens: int = 32, temperature: float = 0.0,
-             seed: int = 0):
+             seed: int = 0, top_k: int = 0, top_p: float = 1.0):
     """Autoregressive generation from a causal config (greedy when
     ``temperature == 0``, else softmax sampling).
 
@@ -358,14 +387,10 @@ def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
         hidden = transformer_apply(params, ids, cfg)
         logits = (hidden[:, t - 1].astype(jnp.float32)
                   @ params["lm_head"]["w"])
-        if temperature > 0:
-            # fold_in by position: the cached generator derives the same
-            # key at the same emit position, keeping the two paths
-            # seed-compatible
-            nxt = jax.random.categorical(jax.random.fold_in(key0, t),
-                                         logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
+        # fold_in by position: the cached generator derives the same key
+        # at the same emit position, keeping the two paths seed-compatible
+        nxt = _sample_logits(logits, jax.random.fold_in(key0, t),
+                             temperature, top_k, top_p)
         ids = jax.lax.dynamic_update_slice(
             ids, nxt[:, None].astype(ids.dtype), (0, t))
         return ids, nxt
@@ -443,7 +468,7 @@ def decode_step(params: Dict, token: jnp.ndarray, pos, cache,
 
 def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
                     max_new_tokens: int = 32, temperature: float = 0.0,
-                    seed: int = 0):
+                    seed: int = 0, top_k: int = 0, top_p: float = 1.0):
     """KV-cached :func:`generate`: O(L) attention per emitted token.
 
     The prompt prefills the cache token-by-token through the same
@@ -467,14 +492,11 @@ def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
         ids, cache = carry
         token = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
         logits, cache = decode_step(params, token, t, cache, cfg)
-        if temperature > 0:
-            # keyed by EMIT position (t+1), matching generate() exactly —
-            # prefill steps consume no randomness
-            nxt = jax.random.categorical(
-                jax.random.fold_in(key0, t + 1),
-                logits.astype(jnp.float32) / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
+        # keyed by EMIT position (t+1), matching generate() exactly —
+        # prefill steps consume no randomness
+        nxt = _sample_logits(logits.astype(jnp.float32),
+                             jax.random.fold_in(key0, t + 1),
+                             temperature, top_k, top_p)
         # scan covers t = 0..L-2, so t+1 is always a valid position; only
         # write past the prompt (prompt positions keep their tokens)
         keep = t + 1 >= P_len

@@ -367,3 +367,52 @@ def test_generate_cached_sampling_seed_compatible(rng):
     b = np.asarray(generate_cached(params, prompt, cfg, max_new_tokens=6,
                                    temperature=1.0, seed=9))
     np.testing.assert_array_equal(a, b)
+
+
+def test_generate_top_k_top_p(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     generate,
+                                                     generate_cached,
+                                                     init_transformer,
+                                                     transformer_apply)
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=32, dtype=jnp.float32,
+                            causal=True, norm="rmsnorm", position="rope")
+    params = init_transformer(cfg, seed=5)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 4)))
+    # top_k=1 at any temperature is greedy
+    greedy = np.asarray(generate(params, prompt, cfg, max_new_tokens=6))
+    k1 = np.asarray(generate(params, prompt, cfg, max_new_tokens=6,
+                             temperature=1.0, top_k=1, seed=11))
+    np.testing.assert_array_equal(greedy, k1)
+    # every sampled token under top_k=3 is one of the 3 best given its prefix
+    k3 = np.asarray(generate(params, prompt, cfg, max_new_tokens=6,
+                             temperature=1.5, top_k=3, seed=7))
+    hidden = transformer_apply(params, jnp.asarray(k3), cfg)
+    logits = np.asarray(hidden.astype(jnp.float32) @ params["lm_head"]["w"])
+    for t in range(4, 10):
+        top3 = np.argsort(logits[0, t - 1])[-3:]
+        assert int(k3[0, t]) in top3, (t, k3[0, t], top3)
+    # cached path agrees with the full path under top_k/top_p sampling
+    a = np.asarray(generate(params, prompt, cfg, max_new_tokens=6,
+                            temperature=1.0, top_k=5, top_p=0.9, seed=3))
+    b = np.asarray(generate_cached(params, prompt, cfg, max_new_tokens=6,
+                                   temperature=1.0, top_k=5, top_p=0.9,
+                                   seed=3))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_generate_oversized_top_k_is_noop(rng):
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     generate,
+                                                     init_transformer)
+    cfg = TransformerConfig(vocab=64, layers=2, d_model=64, heads=2,
+                            d_ff=128, max_len=32, dtype=jnp.float32,
+                            causal=True, norm="rmsnorm", position="rope")
+    params = init_transformer(cfg, seed=6)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 3)))
+    plain = np.asarray(generate(params, prompt, cfg, max_new_tokens=5,
+                                temperature=1.0, seed=2))
+    big_k = np.asarray(generate(params, prompt, cfg, max_new_tokens=5,
+                                temperature=1.0, top_k=10_000, seed=2))
+    np.testing.assert_array_equal(plain, big_k)
