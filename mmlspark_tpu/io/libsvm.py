@@ -11,6 +11,7 @@ a ``group`` column for the ranker.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -19,6 +20,8 @@ from ..core.dataframe import DataFrame
 from ..native import parse_libsvm
 
 __all__ = ["read_libsvm"]
+
+_warned_one_based: list = []    # once-per-process latch for the 1-based nudge
 
 
 def read_libsvm(path: str, n_features: Optional[int] = None,
@@ -38,6 +41,17 @@ def read_libsvm(path: str, n_features: Optional[int] = None,
     n = len(labels)
     if zero_based is None:
         zero_based = bool(len(indices) == 0 or indices.min() == 0)
+        if not zero_based and not _warned_one_based:
+            # a genuinely 0-based file whose smallest present index is >= 1
+            # would be silently shifted down a column here; n_features does
+            # not protect (a downshift only shrinks indices, so the range
+            # check never fires). Once per process: 1-based is the format's
+            # documented convention, so repeating it would be pure noise.
+            _warned_one_based.append(True)
+            warnings.warn(
+                "libsvm: auto-detected 1-based indices (min index "
+                f"{int(indices.min())}); pass zero_based explicitly if the "
+                "file is 0-based with no feature 0 present", stacklevel=2)
     idx = indices if zero_based else indices - 1
     if len(idx) and idx.min() < 0:
         raise ValueError("libsvm: negative feature index after 1-based "

@@ -345,6 +345,14 @@ def _sample_logits(logits, key, temperature: float, top_k: int,
             logits = jnp.where(logits < sorted_l[:, k - 1][:, None],
                                -jnp.inf, logits)
         if need_p:
+            # nucleus mass comes from the top-k-FILTERED renormalized
+            # distribution (the HF convention) — mask the sorted tail
+            # before the softmax/cumsum; renormalized mass reaches top_p
+            # at an equal-or-earlier rank, so pre-filter mass would keep
+            # MORE tokens inside the top-k set than callers expect
+            if need_k:
+                pos = jnp.arange(sorted_l.shape[-1])[None, :]
+                sorted_l = jnp.where(pos >= k, -jnp.inf, sorted_l)
             probs = jax.nn.softmax(sorted_l, axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
             # keep the smallest prefix with mass >= top_p (always >= 1)

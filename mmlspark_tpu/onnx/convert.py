@@ -326,6 +326,14 @@ def _decode_mask_index(mask_index, B, S, op_name):
     raise UnsupportedOp(f"{op_name} mask_index shape {mask_index.shape}")
 
 
+def _attn_scale(node, head_size):
+    """ORT reads GetAttrOrDefault("scale", 0.0f) and substitutes
+    1/sqrt(head_size) when the stored value is 0 — so an explicitly
+    serialized scale=0.0 means "unset", not "zero the logits"."""
+    s = node.attr("scale", 0.0)
+    return float(s) if s else 1.0 / float(head_size) ** 0.5
+
+
 def _attention_core(q, k, v, kv_mask, causal, scale, pair_mask=None):
     """(B, H, S, D) attention shared by the fused ops: Pallas flash kernel
     on TPU, dense XLA elsewhere. ``pair_mask`` is an optional (Sq, Sk)
@@ -474,7 +482,7 @@ def _msft_mha(node, inputs, ctx):
         return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
 
     q, k, v = split(q_in, Sq), split(k_in, Sk), split(v_in, Sk)
-    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    scale = _attn_scale(node, D)
     kv_mask = _decode_mask_index(mask_index, B, Sk, "MultiHeadAttention")
     causal = bool(node.attr("unidirectional", 0))
     out = _attention_core(q, k, v, kv_mask, causal, scale)
@@ -499,7 +507,10 @@ def _std_attention(node, inputs, ctx):
         k = jnp.repeat(k, Hq // Hkv, axis=1)
         v = jnp.repeat(v, Hq // Hkv, axis=1)
     causal = bool(node.attr("is_causal", 0))
-    scale = node.attr("scale", 1.0 / float(q.shape[-1]) ** 0.5)
+    # standard ai.onnx Attention (unlike ORT contrib): the default applies
+    # only when the attribute is ABSENT — an explicit 0.0 is honored
+    s = node.attr("scale", None)
+    scale = float(s) if s is not None else 1.0 / float(q.shape[-1]) ** 0.5
     pair_mask = None
     if attn_mask is not None:
         # spec: the mask broadcasts against (B, H, Sq, Skv) aligned at the
@@ -543,7 +554,7 @@ def _gqa(node, inputs, ctx):
     q = split(q_in, heads)
     k = jnp.repeat(split(k_in, kv_heads), heads // kv_heads, axis=1)
     v = jnp.repeat(split(v_in, kv_heads), heads // kv_heads, axis=1)
-    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    scale = _attn_scale(node, D)
     kv_mask = None
     if seqlens_k is not None:
         # seqlens_k[b] = valid key count - 1 (ORT contrib spec)
@@ -563,7 +574,8 @@ def _msft_attention(node, inputs, ctx):
     if node.domain != "com.microsoft":
         # the standard ai.onnx Attention (opset 23) takes Q/K/V tensors
         return _std_attention(node, inputs, ctx)
-    x, w, b = inputs[0], inputs[1], inputs[2]
+    x, w = inputs[0], inputs[1]
+    b = inputs[2] if len(inputs) > 2 else None
     mask_index = inputs[3] if len(inputs) > 3 else None
     if len(inputs) > 4 and inputs[4] is not None:
         raise UnsupportedOp("Attention with past state")
@@ -591,7 +603,7 @@ def _msft_attention(node, inputs, ctx):
         return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scale = node.attr("scale", 1.0 / float(D) ** 0.5)
+    scale = _attn_scale(node, D)
     kv_mask = _decode_mask_index(mask_index, B, S, "Attention")
     ctx_out = _attention_core(q, k, v, kv_mask, causal, scale)
     return ctx_out.transpose(0, 2, 1, 3).reshape(B, S, hidden)

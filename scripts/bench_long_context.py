@@ -83,9 +83,12 @@ def main():
                 # fences all of them — device programs run in order — so a
                 # single ~70 ms round-trip amortizes over the repeats
                 reps = 5
+                # bind the output ONCE — two _f(*a) calls inside one jit
+                # would run attention twice per rep unless XLA CSE merges
+                # the inlined subgraphs, inflating ms/step up to 2x
                 timed = jax.jit(
-                    lambda *a, _f=fn: (jnp.sum(_f(*a).astype(jnp.float32)),
-                                       _f(*a)))
+                    lambda *a, _f=fn: (lambda o: (
+                        jnp.sum(o.astype(jnp.float32)), o))(_f(*a)))
                 _, out = timed(*args)   # the one compile
                 float(_)
                 t0 = time.perf_counter()

@@ -402,6 +402,20 @@ def test_generate_top_k_top_p(rng):
     np.testing.assert_array_equal(a, b)
 
 
+def test_top_p_mass_renormalized_after_top_k():
+    """Nucleus mass must come from the top-k-filtered renormalized
+    distribution (the HF convention). Discriminating case: probs
+    [.6, .25, .15], top_k=2, top_p=0.7 — full-mass cum is [.6, .85, 1.0]
+    so the pre-filter convention keeps ranks {0, 1}; top-2-renormalized
+    cum is [.706, 1.0] so the HF convention keeps ONLY the argmax."""
+    from mmlspark_tpu.models.zoo.transformer import _sample_logits
+    logits = jnp.log(jnp.asarray([[0.6, 0.25, 0.15]], jnp.float32))
+    seen = {int(_sample_logits(logits, jax.random.PRNGKey(s), 1.0,
+                               top_k=2, top_p=0.7)[0])
+            for s in range(64)}
+    assert seen == {0}, seen
+
+
 def test_generate_oversized_top_k_is_noop(rng):
     from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
                                                      generate,

@@ -302,6 +302,27 @@ class TestMicrosoftContribOps:
         want = np.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3).reshape(B, S, H)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
+    def test_attention_scale_zero_means_default(self):
+        # ORT reads GetAttrOrDefault("scale", 0.0f) and substitutes
+        # 1/sqrt(head_size) when the serialized value is 0 — a graph that
+        # explicitly stores scale=0.0 must NOT zero the logits
+        rng = np.random.default_rng(7)
+        B, S, H, heads = 1, 4, 8, 2
+        x = rng.normal(0, 1, (B, S, H)).astype(np.float32)
+        w = rng.normal(0, 0.3, (H, 3 * H)).astype(np.float32)
+        ins = [make_tensor_value_info("x", np.float32, [B, S, H])]
+
+        def run(**attrs):
+            g = make_graph(
+                [make_node("Attention", ["x", "w"], ["y"],
+                           domain="com.microsoft", num_heads=heads, **attrs)],
+                "t", ins, [make_tensor_value_info("y", np.float32, [])],
+                initializers={"w": w})
+            cm = convert_model(make_model(g))
+            return np.asarray(cm(cm.params, {"x": x})["y"])
+
+        np.testing.assert_allclose(run(scale=0.0), run(), rtol=1e-6)
+
     def test_attention_rejects_past_state(self):
         import pytest as _pt
         from mmlspark_tpu.onnx.convert import UnsupportedOp
