@@ -6,17 +6,28 @@ The reference's histogram build lives inside LightGBM's C++
 scatter-add over (node, feature, bin). The XLA fallback here is
 ``segment_sum`` (see ``models/gbdt/trees.py``); this module provides a
 hand-written Pallas equivalent that reformulates the scatter as a
-one-hot × data matmul so the accumulation rides the MXU instead of a
-serialized scatter unit:
+one-hot matmul so the accumulation rides the MXU instead of a serialized
+scatter unit.
 
-    for each (feature, row-block) grid step:
-        onehot[b, r] = 1 if bin(row r, feature) == b          (VPU compare)
-        for node in nodes:                                     (unrolled)
-            hist[node] += (data * node_mask) @ onehot^T        (MXU matmul)
+Layout (v2, "stats-as-lanes"): for each (feature, row-block) grid step
 
-The (3, nodes*bins) accumulator stays resident in VMEM across the row-block
-grid dimension, so HBM traffic is one read of the bins plus one write of the
-final histogram — the minimum possible.
+    onehot[b, r]   = 1 if bin(row r, feature) == b        (bpad, R)  VPU
+    dn[r, s*N + d] = stat_s(row r) if node(row r) == d    (R, 3*N)   VPU
+    hist[feature] += onehot @ dn                          (bpad, 3*N) MXU
+
+The first version put the 3 stats on the matmul's M dimension
+(``(3, R) @ (R, nodes*bpad)``), which capped MXU utilization at 3/128
+(~2.3%) and made both FLOPs and the VMEM-resident one-hot grow linearly
+with the node count — measured 231 ms at 8 nodes but 922 ms at 32
+(1M×28×255 on v5e) vs segment_sum's flat 488 ms. Putting bins on M and
+(stat, node) on the lane dimension instead makes utilization GROW with
+depth (3·nodes lanes: 9% at 4 nodes, 75% at 32, saturated from 43), and
+the in-kernel one-hot is (bpad, R) — independent of node count — so the
+row block no longer collapses at depth.
+
+The (bpad, 3·nodes) accumulator stays resident in VMEM across the
+row-block grid dimension, so HBM traffic is one read of the bins plus one
+write of the final histogram — the minimum possible.
 
 Selection: ``histogram_enabled()`` — env ``MMLSPARK_TPU_PALLAS`` = ``1``
 (force on, interpreted off-TPU), ``0`` (off), default ``auto`` (on when the
@@ -45,42 +56,48 @@ def histogram_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def pallas_preferred(n_rows: int, n_nodes: int, n_bins: int,
-                     combined_limit: int = 6 * 1024 * 1024) -> bool:
-    """Per-level builder choice, from v5e measurements (1M×28×255 bins):
-    Pallas 231 ms vs segment_sum 488 ms at 8 nodes, but 922 vs 488 at 32 —
-    the kernel is fast exactly while its autotuned row_block stays large
-    enough to keep the single fused MXU matmul busy (≥256 rows/step).
-    segment_sum, meanwhile, stops compiling at all somewhere between 1M and
-    4M rows (a 57 GB one-hot temp), so above that Pallas is the only
-    builder regardless of depth. ``MMLSPARK_TPU_PALLAS=1`` forces the
-    kernel everywhere (tests use this to exercise it)."""
+def pallas_preferred(n_rows: int, n_nodes: int, n_bins: int) -> bool:
+    """Per-level builder choice.
+
+    The v2 kernel's per-level cost is ~flat in node count until 3·nodes
+    fills the 128-lane dimension (43 nodes) and linear after; segment_sum
+    is flat in node count but pays a serialized scatter (488 ms at
+    1M×28×255 on v5e, every level). The cost model puts the crossover far
+    past any practical tree depth, so the kernel is preferred up to 256
+    nodes/level (= num_leaves 512, leaf-wise); segment_sum additionally
+    stops compiling at all somewhere between 1M and 4M rows (a 57 GB
+    one-hot temp), so above that the kernel is the only builder
+    regardless of depth. ``MMLSPARK_TPU_PALLAS=1`` forces the kernel
+    everywhere (tests use this to exercise it)."""
     if os.environ.get("MMLSPARK_TPU_PALLAS", "auto").lower() in ("1", "true",
                                                                  "on"):
         return True
     if n_rows > 1_500_000:
         return True
-    return _fused_row_block(n_nodes, n_bins, combined_limit) >= 256
+    # n_bins kept for call-site stability: both builders scale the same way
+    # with bin count, so the v2 decision depends only on the node count
+    return n_nodes <= 256
 
 
-def _fused_row_block(n_nodes: int, n_bins: int, combined_limit: int) -> int:
-    """Largest lane-aligned row block whose fused (node·bin) one-hot stays
-    inside the VMEM budget — shared by the kernel's autotune and the
-    builder-choice heuristic so they cannot drift apart."""
+def _auto_row_block(n_nodes: int, n_bins: int, vmem_limit: int) -> int:
+    """Largest lane-aligned row block whose in-kernel intermediates — the
+    (bpad, R) bin one-hot and the (R, 3·nodes) scattered stats (lanes
+    padded to the 128 hardware lanes) — fit the VMEM budget."""
     bpad = _round_up(max(n_bins, _LANE), _LANE)
-    fused_max = combined_limit // (n_nodes * bpad * 4)
-    return max(_LANE, min(512, (fused_max // _LANE) * _LANE))
+    lanes = _round_up(3 * n_nodes, _LANE)
+    per_row = (bpad + lanes) * 4
+    return max(_LANE, min(2048, (vmem_limit // per_row // _LANE) * _LANE))
 
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad,
-                 combined_limit):
+def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
     """One (feature, row-block) grid step. Shapes:
     bins_ref (1, 1, R) int32 | node_ref (1, R) int32 | data_ref (3, R) f32
-    out_ref (1, 3, n_nodes*bpad) f32 — resident across the row-block dim.
+    out_ref (1, bpad, 3*n_nodes) f32 — resident across the row-block dim,
+    lane col = stat*n_nodes + node (stats-major).
     """
     from jax.experimental import pallas as pl
 
@@ -94,27 +111,19 @@ def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad,
     node = node_ref[0, :]                                # (R,)
     data = data_ref[...]                                 # (3, R)
     R = b.shape[0]
-    combined_bytes = n_nodes * bpad * R * 4
-    if combined_bytes <= combined_limit:
-        # one-hot over the fused (node, bin) id → ONE big MXU matmul
-        seg = node * bpad + b                            # (R,)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes * bpad, R), 0)
-        onehot = (iota == seg[None, :]).astype(jnp.float32)
-        out_ref[0, :, :] += jnp.dot(
-            data, onehot.T, precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)          # (3, nodes*bpad)
-    else:
-        # deep levels: per-node masked matmul keeps VMEM bounded
-        iota = jax.lax.broadcasted_iota(jnp.int32, (bpad, R), 0)
-        onehot = (iota == b[None, :]).astype(jnp.float32)    # (bpad, R)
-        for nd in range(n_nodes):                        # static unroll
-            mask = (node == nd).astype(jnp.float32)      # (R,)
-            md = data * mask[None, :]                    # (3, R)
-            contrib = jnp.dot(md, onehot.T,
-                              precision=jax.lax.Precision.HIGHEST,
-                              preferred_element_type=jnp.float32)  # (3, bpad)
-            sl = pl.ds(nd * bpad, bpad)
-            out_ref[0, :, sl] += contrib
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (bpad, R), 0)
+    onehot = (iota_b == b[None, :]).astype(jnp.float32)  # (bpad, R)
+    # dn[r, st*n_nodes + nd] = data[st, r] * (node[r] == nd): built with 2-D
+    # iota arithmetic (no 3-D intermediate / minor-dim reshape for Mosaic)
+    c = jax.lax.broadcasted_iota(jnp.int32, (R, 3 * n_nodes), 1)
+    st, nd = c // n_nodes, c % n_nodes
+    sel = jnp.where(st == 0, data[0, :][:, None],
+                    jnp.where(st == 1, data[1, :][:, None],
+                              data[2, :][:, None]))
+    dn = jnp.where(nd == node[:, None], sel, 0.0)        # (R, 3*n_nodes)
+    out_ref[0, :, :] += jnp.dot(onehot, dn,
+                                precision=jax.lax.Precision.HIGHEST,
+                                preferred_element_type=jnp.float32)
 
 
 def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
@@ -124,25 +133,22 @@ def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
     """Drop-in for the segment-sum histogram: returns (n_nodes, F, B, 3).
 
     xb (n, F) int bins; node_rel (n,) int32; g/h/w_count (n,) float32.
-    ``row_block=0`` picks the largest block that keeps the fused
-    single-matmul path inside the VMEM budget (the per-node unrolled
-    fallback is ~MXU-starved once n_nodes grows).
+    ``row_block=0`` picks the largest block whose intermediates fit the
+    ``combined_limit`` VMEM budget.
     """
     if row_block == 0:
-        row_block = _fused_row_block(n_nodes, n_bins, combined_limit)
+        row_block = _auto_row_block(n_nodes, n_bins, combined_limit)
     return _level_histogram_pallas(xb, node_rel, g, h, w_count,
                                    n_nodes=n_nodes, n_bins=n_bins,
-                                   row_block=row_block, interpret=interpret,
-                                   combined_limit=combined_limit)
+                                   row_block=row_block, interpret=interpret)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_nodes", "n_bins", "row_block",
-                                    "interpret", "combined_limit"))
+                                    "interpret"))
 def _level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
                             n_bins: int, row_block: int,
-                            interpret: bool,
-                            combined_limit: int):
+                            interpret: bool):
     from jax.experimental import pallas as pl
 
     n, F = xb.shape
@@ -159,8 +165,7 @@ def _level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
     # padded rows' contributions regardless of their (0) bin/node ids
 
     nblocks = npad // row_block
-    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad,
-                               combined_limit=combined_limit)
+    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad)
     out = pl.pallas_call(
         kernel,
         grid=(F, nblocks),
@@ -169,10 +174,11 @@ def _level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
             pl.BlockSpec((1, row_block), lambda f, r: (0, r)),
             pl.BlockSpec((3, row_block), lambda f, r: (0, r)),
         ],
-        out_specs=pl.BlockSpec((1, 3, n_nodes * bpad), lambda f, r: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, 3, n_nodes * bpad), jnp.float32),
+        out_specs=pl.BlockSpec((1, bpad, 3 * n_nodes), lambda f, r: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, bpad, 3 * n_nodes), jnp.float32),
         interpret=interpret,
     )(xb_t, node, data)
 
-    hist = out.reshape(F, 3, n_nodes, bpad)[:, :, :, :n_bins]
-    return jnp.transpose(hist, (2, 0, 3, 1))            # (nodes, F, B, 3)
+    # (F, bpad, 3, n_nodes) -> (n_nodes, F, n_bins, 3)
+    hist = out.reshape(F, bpad, 3, n_nodes)[:, :n_bins]
+    return jnp.transpose(hist, (3, 0, 1, 2))

@@ -97,9 +97,18 @@ def main():
 
     rng = np.random.default_rng(0)
     results = []
-    for n, F, n_nodes, n_bins in [(1_000_000, 28, 8, 255),
-                                  (1_000_000, 28, 32, 255),
-                                  (4_000_000, 28, 8, 255)]:
+    # default: a full level sweep (levels 0-6 = 1..64 nodes) at 1M rows plus
+    # the OOM-class 4M configs; BENCH_ROWS / BENCH_NODES scope a run so it
+    # never needs to be killed mid-flight (the chip claim wedges on SIGKILL)
+    rows = [int(r) for r in os.environ.get(
+        "BENCH_ROWS", "1000000,4000000").split(",")]
+    nodes_for = {1_000_000: [1, 2, 4, 8, 16, 32, 64], 4_000_000: [8, 32]}
+    if os.environ.get("BENCH_NODES"):
+        nd = [int(x) for x in os.environ["BENCH_NODES"].split(",")]
+        nodes_for = {r: nd for r in rows}
+    configs = [(n, 28, nn, 255) for n in rows
+               for nn in nodes_for.get(n, [8, 32])]
+    for n, F, n_nodes, n_bins in configs:
         xb = jnp.asarray(rng.integers(0, n_bins, (n, F), dtype=np.int32))
         node = jnp.asarray(rng.integers(0, n_nodes, n, dtype=np.int32))
         g = jnp.asarray(rng.normal(size=n).astype(np.float32))

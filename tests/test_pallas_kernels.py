@@ -42,10 +42,11 @@ def test_pallas_histogram_matches_segment_sum(rng, n, F, n_nodes, n_bins):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
-def test_pallas_histogram_per_node_branch(rng):
-    """Force the deep-level per-node masked-matmul branch (combined one-hot
-    over VMEM budget) and check it against the reference too."""
-    n, F, n_nodes, n_bins = 400, 2, 8, 32
+def test_pallas_histogram_deep_level(rng):
+    """A 32-node level — the depth where the v1 (3, R) @ (R, nodes*bpad)
+    layout regressed — stays correct under the v2 stats-as-lanes layout,
+    including with the tight-VMEM autotuned row block."""
+    n, F, n_nodes, n_bins = 400, 2, 32, 32
     xb = rng.integers(0, n_bins, (n, F)).astype(np.int32)
     node = rng.integers(0, n_nodes, n).astype(np.int32)
     g = rng.normal(size=n).astype(np.float32)
@@ -53,8 +54,8 @@ def test_pallas_histogram_per_node_branch(rng):
     w = np.ones(n, np.float32)
     got = np.asarray(level_histogram_pallas(
         jnp.asarray(xb), jnp.asarray(node), jnp.asarray(g), jnp.asarray(h),
-        jnp.asarray(w), n_nodes, n_bins, row_block=128, interpret=True,
-        combined_limit=1))     # always take the per-node path
+        jnp.asarray(w), n_nodes, n_bins, interpret=True,
+        combined_limit=256 * 1024))    # small budget -> minimum row block
     want = _reference_hist(xb, node, g, h, w, n_nodes, n_bins)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
@@ -97,12 +98,19 @@ class TestPallasPreferred:
         from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
         assert pallas_preferred(1_000_000, 8, 255)
 
-    def test_deep_levels_prefer_segment_sum(self):
+    def test_deep_levels_prefer_pallas_since_v2(self):
+        # the v1 layout lost to segment_sum at 32 nodes (922 vs 488 ms);
+        # the v2 stats-as-lanes layout's cost is ~flat in node count until
+        # 3*nodes fills the 128 lanes, so 32-node levels now take the kernel
+        from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
+        assert pallas_preferred(1_000_000, 32, 255)
+
+    def test_extreme_depth_prefers_segment_sum(self):
         from mmlspark_tpu.ops.pallas_kernels import pallas_preferred
         import os
         prev = os.environ.pop("MMLSPARK_TPU_PALLAS", None)
         try:
-            assert not pallas_preferred(1_000_000, 32, 255)
+            assert not pallas_preferred(1_000_000, 512, 255)
         finally:
             if prev is not None:
                 os.environ["MMLSPARK_TPU_PALLAS"] = prev
