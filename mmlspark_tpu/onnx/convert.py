@@ -694,30 +694,36 @@ def _onnx_pads_to_lax(pads: Optional[Sequence[int]], rank: int,
     return [(pads[i], pads[i + rank]) for i in range(rank)]
 
 
-@register_op("Conv")
-def _conv(node, inputs, ctx):
-    x, w = inputs[0], inputs[1]
-    rank = jnp.asarray(w).ndim - 2
+def _conv_raw(node, x, w, preferred=None):
+    """Shared Conv body (attrs → lax.conv_general_dilated), without bias —
+    QLinearConv reuses it with integer operands + int32 accumulation."""
+    x, w = jnp.asarray(x), jnp.asarray(w)
+    rank = w.ndim - 2
     strides = node.attr("strides", [1] * rank)
     dilations = node.attr("dilations", [1] * rank)
     group = node.attr("group", 1)
     auto_pad = node.attr("auto_pad", "NOTSET")
-    k_shape = node.attr("kernel_shape", list(jnp.asarray(w).shape[2:]))
+    k_shape = node.attr("kernel_shape", list(w.shape[2:]))
     pads = _onnx_pads_to_lax(node.attr("pads"), rank, auto_pad,
-                             jnp.asarray(x).shape[2:], k_shape, strides, dilations)
+                             x.shape[2:], k_shape, strides, dilations)
     spatial = "DHW"[-rank:] if rank <= 3 else None
     if spatial is None:
         raise UnsupportedOp(f"Conv rank {rank}")
     dn = lax.conv_dimension_numbers(
-        jnp.asarray(x).shape, jnp.asarray(w).shape,
-        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
-    out = lax.conv_general_dilated(
+        x.shape, w.shape, (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    return lax.conv_general_dilated(
         x, w, window_strides=tuple(strides), padding=pads,
         rhs_dilation=tuple(dilations), dimension_numbers=dn,
         feature_group_count=group,
-        preferred_element_type=jnp.asarray(x).dtype)
+        preferred_element_type=preferred or x.dtype)
+
+
+@register_op("Conv")
+def _conv(node, inputs, ctx):
+    out = _conv_raw(node, inputs[0], inputs[1])
     if len(inputs) > 2 and inputs[2] is not None:
         b = inputs[2]
+        rank = jnp.asarray(inputs[1]).ndim - 2
         out = out + b.reshape((1, -1) + (1,) * rank)
     return out
 
@@ -1289,6 +1295,337 @@ def _dequantize(node, inputs, ctx):
     zp = inputs[2] if len(inputs) > 2 and inputs[2] is not None else 0
     return (jnp.asarray(x).astype(jnp.float32)
             - jnp.asarray(zp).astype(jnp.float32)) * scale
+
+
+# -- int8 compute ops (QLinear*) ---------------------------------------------
+#
+# The reference runs int8-quantized graphs through whatever ORT 1.8 executes
+# (`ONNXModel.scala:330`, `build.sbt:257-259`). TPU-native: when both zero
+# points are 0 (the symmetric-int8 case every serious quantizer emits for
+# weights), the int8 operands are fed to the MXU directly with int32
+# accumulation; otherwise the zero points are folded in int32 first.
+
+def _maybe_scalar(v, what):
+    a = np.asarray(_concrete(v, what)).ravel()
+    if a.size != 1:
+        raise UnsupportedOp(f"{what} must be per-tensor (scalar), "
+                            f"got {a.size} values")
+    return a.dtype.type(a[0])
+
+
+def _int_accum_matmul(a, a_zp, b, b_zp):
+    """(a - a_zp) @ (b - b_zp) accumulated in int32; int8 operands ride the
+    MXU directly when both zero points are zero."""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if int(a_zp) == 0 and int(b_zp) == 0:
+        return jnp.matmul(a, b, preferred_element_type=jnp.int32)
+    ai = a.astype(jnp.int32) - jnp.int32(a_zp)
+    bi = b.astype(jnp.int32) - jnp.int32(b_zp)
+    return jnp.matmul(ai, bi, preferred_element_type=jnp.int32)
+
+
+def _saturate(y_float, zp):
+    """round-half-even, + zp, saturate to zp's integer dtype — the one
+    requantization tail shared by every QLinear op."""
+    zdt = np.asarray(zp).dtype
+    info = jnp.iinfo(zdt)
+    return jnp.clip(jnp.round(y_float) + int(zp),
+                    info.min, info.max).astype(zdt)
+
+
+def _requantize(acc_i32, multiplier, y_zp):
+    return _saturate(acc_i32.astype(jnp.float32) * multiplier, y_zp)
+
+
+@register_op("QLinearConv")
+def _qlinear_conv(node, inputs, ctx):
+    (x, x_scale, x_zp, w, w_scale, w_zp) = inputs[:6]
+    y_scale, y_zp = inputs[6], inputs[7]
+    bias = inputs[8] if len(inputs) > 8 else None
+    x_zp = _maybe_scalar(x_zp, "QLinearConv x_zero_point")
+    w_zp_a = np.asarray(_concrete(w_zp, "QLinearConv w_zero_point")).ravel()
+    if (w_zp_a != w_zp_a[0]).any():
+        raise UnsupportedOp("QLinearConv per-channel w_zero_point")
+    w_zp = w_zp_a.dtype.type(w_zp_a[0])
+    rank = jnp.asarray(w).ndim - 2
+    same_dtype = jnp.asarray(x).dtype == jnp.asarray(w).dtype
+    if int(x_zp) == 0 and int(w_zp) == 0 and same_dtype:
+        # lax.conv requires identical operand dtypes (uint8 activations +
+        # int8 weights — the standard ORT post-ReLU output — must take the
+        # widened path)
+        acc = _conv_raw(node, x, w, preferred=jnp.int32)
+    else:
+        xi = jnp.asarray(x).astype(jnp.int32) - jnp.int32(x_zp)
+        wi = jnp.asarray(w).astype(jnp.int32) - jnp.int32(w_zp)
+        acc = _conv_raw(node, xi, wi, preferred=jnp.int32)
+    if bias is not None:       # int32, quantized with scale x_scale*w_scale
+        acc = acc + jnp.asarray(bias).reshape((1, -1) + (1,) * rank)
+    # w_scale may be per-output-channel: broadcast over (N, M, *spatial)
+    mult = (jnp.asarray(x_scale).astype(jnp.float32)
+            * jnp.asarray(w_scale).astype(jnp.float32).reshape(
+                (1, -1) + (1,) * rank)
+            / jnp.asarray(y_scale).astype(jnp.float32))
+    return _requantize(acc, mult, _maybe_scalar(y_zp, "QLinearConv y_zp"))
+
+
+@register_op("QLinearMatMul")
+def _qlinear_matmul(node, inputs, ctx):
+    (a, a_scale, a_zp, b, b_scale, b_zp, y_scale, y_zp) = inputs[:8]
+    acc = _int_accum_matmul(a, _maybe_scalar(a_zp, "QLinearMatMul a_zp"),
+                            b, _maybe_scalar(b_zp, "QLinearMatMul b_zp"))
+    mult = (jnp.asarray(a_scale).astype(jnp.float32)
+            * jnp.asarray(b_scale).astype(jnp.float32)
+            / jnp.asarray(y_scale).astype(jnp.float32))
+    return _requantize(acc, mult, _maybe_scalar(y_zp, "QLinearMatMul y_zp"))
+
+
+@register_op("QGemm")
+def _qgemm(node, inputs, ctx):
+    """com.microsoft QGemm: quantized Gemm with optional int32 C and
+    optional output quantization (float32 out when y_scale is absent)."""
+    a, a_scale, a_zp, b, b_scale, b_zp = inputs[:6]
+    c = inputs[6] if len(inputs) > 6 else None
+    y_scale = inputs[7] if len(inputs) > 7 else None
+    y_zp = inputs[8] if len(inputs) > 8 else None
+    alpha = node.attr("alpha", 1.0)
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if node.attr("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    acc = _int_accum_matmul(a, _maybe_scalar(a_zp, "QGemm a_zp"),
+                            b, _maybe_scalar(b_zp, "QGemm b_zp"))
+    if c is not None:          # int32, scale = alpha * a_scale * b_scale
+        acc = acc + jnp.asarray(c)
+    sab = (alpha * jnp.asarray(a_scale).astype(jnp.float32)
+           * jnp.asarray(b_scale).astype(jnp.float32))
+    if y_scale is None:
+        return acc.astype(jnp.float32) * sab
+    return _requantize(acc, sab / jnp.asarray(y_scale).astype(jnp.float32),
+                       _maybe_scalar(y_zp, "QGemm y_zp"))
+
+
+def _qlinear_eltwise(op):
+    """com.microsoft QLinearAdd/QLinearMul: dequantize, apply, requantize —
+    the pattern ORT's quantizer emits around every ResNet skip connection."""
+    def handler(node, inputs, ctx):
+        (a, a_scale, a_zp, b, b_scale, b_zp, y_scale, y_zp) = inputs[:8]
+        af = (jnp.asarray(a).astype(jnp.float32)
+              - float(_maybe_scalar(a_zp, "QLinear a_zp"))) \
+            * jnp.asarray(a_scale).astype(jnp.float32)
+        bf = (jnp.asarray(b).astype(jnp.float32)
+              - float(_maybe_scalar(b_zp, "QLinear b_zp"))) \
+            * jnp.asarray(b_scale).astype(jnp.float32)
+        y = op(af, bf) / jnp.asarray(y_scale).astype(jnp.float32)
+        return _saturate(y, _maybe_scalar(y_zp, "QLinear y_zp"))
+    return handler
+
+
+register_op("QLinearAdd")(_qlinear_eltwise(jnp.add))
+register_op("QLinearMul")(_qlinear_eltwise(jnp.multiply))
+
+
+@register_op("QLinearGlobalAveragePool")
+def _qlinear_gap(node, inputs, ctx):
+    x, x_scale, x_zp, y_scale, y_zp = inputs[:5]
+    if node.attr("channels_last", 0):
+        raise UnsupportedOp("QLinearGlobalAveragePool channels_last")
+    x = jnp.asarray(x)
+    spatial = tuple(range(2, x.ndim))
+    # exact integer mean in int32, then one requantization
+    acc = jnp.sum(x.astype(jnp.int32), axis=spatial, keepdims=True)
+    count = int(np.prod([x.shape[i] for i in spatial]))
+    mean = acc.astype(jnp.float32) / count \
+        - float(_maybe_scalar(x_zp, "QLinearGAP x_zp"))
+    y = mean * jnp.asarray(x_scale).astype(jnp.float32) \
+        / jnp.asarray(y_scale).astype(jnp.float32)
+    return _saturate(y, _maybe_scalar(y_zp, "QLinearGAP y_zp"))
+
+
+# -- detection ops -----------------------------------------------------------
+
+@register_op("NonMaxSuppression")
+def _nms(node, inputs, ctx):
+    """Exact ONNX semantics require a data-dependent output shape, so this
+    runs on concrete values (eager execution or trace-time constants) and
+    rejects tracers. The reference delegates to ORT's CPU kernel
+    (`ONNXModel.scala:330`) — also a host-side op there."""
+    boxes = np.asarray(_concrete(inputs[0], "NonMaxSuppression boxes"))
+    scores = np.asarray(_concrete(inputs[1], "NonMaxSuppression scores"))
+    max_out = (int(np.ravel(_concrete(inputs[2], "max_output"))[0])
+               if len(inputs) > 2 and inputs[2] is not None else 0)
+    iou_thr = (float(np.ravel(_concrete(inputs[3], "iou_threshold"))[0])
+               if len(inputs) > 3 and inputs[3] is not None else 0.0)
+    score_thr = (float(np.ravel(_concrete(inputs[4], "score_threshold"))[0])
+                 if len(inputs) > 4 and inputs[4] is not None else None)
+    center = bool(node.attr("center_point_box", 0))
+    if max_out <= 0:        # spec: "Default to 0, which means no output"
+        return np.zeros((0, 3), np.int64)
+    sel = []
+    for bi in range(scores.shape[0]):
+        for ci in range(scores.shape[1]):
+            s = scores[bi, ci]
+            order = np.argsort(-s, kind="stable")
+            if score_thr is not None:
+                order = order[s[order] > score_thr]
+            kept: list = []
+            for i in order:
+                if len(kept) >= max_out:
+                    break
+                if all(_iou(boxes[bi, i], boxes[bi, j], center) <= iou_thr
+                       for j in kept):
+                    kept.append(i)
+            sel.extend([bi, ci, int(i)] for i in kept)
+    return np.asarray(sel, np.int64).reshape(-1, 3)
+
+
+def _iou(a, b, center: bool) -> float:
+    if center:      # [x_center, y_center, w, h]
+        ay1, ax1 = a[1] - a[3] / 2, a[0] - a[2] / 2
+        ay2, ax2 = a[1] + a[3] / 2, a[0] + a[2] / 2
+        by1, bx1 = b[1] - b[3] / 2, b[0] - b[2] / 2
+        by2, bx2 = b[1] + b[3] / 2, b[0] + b[2] / 2
+    else:           # [y1, x1, y2, x2], either corner order allowed
+        ay1, ax1, ay2, ax2 = a
+        by1, bx1, by2, bx2 = b
+        ay1, ay2 = min(ay1, ay2), max(ay1, ay2)
+        ax1, ax2 = min(ax1, ax2), max(ax1, ax2)
+        by1, by2 = min(by1, by2), max(by1, by2)
+        bx1, bx2 = min(bx1, bx2), max(bx1, bx2)
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    inter = ih * iw
+    union = ((ay2 - ay1) * (ax2 - ax1) + (by2 - by1) * (bx2 - bx1) - inter)
+    return inter / union if union > 0 else 0.0
+
+
+@register_op("RoiAlign")
+def _roi_align(node, inputs, ctx):
+    """torchvision-semantics RoiAlign (the ONNX spec's model): bilinear
+    sampling on a fixed grid per output bin, averaged or maxed. Static
+    shapes throughout — vmapped over ROIs, gathers ride XLA."""
+    x, rois, batch_idx = inputs[0], inputs[1], inputs[2]
+    out_h = node.attr("output_height", 1)
+    out_w = node.attr("output_width", 1)
+    sr = node.attr("sampling_ratio", 0)
+    if sr <= 0:
+        # adaptive sampling counts are per-ROI data-dependent (ceil of the
+        # bin size) and cannot be a static shape; real detector exports set
+        # an explicit ratio (torchvision default 2)
+        raise UnsupportedOp("RoiAlign sampling_ratio=0 (adaptive)")
+    scale = node.attr("spatial_scale", 1.0)
+    mode = node.attr("mode", "avg")
+    half_pixel = node.attr("coordinate_transformation_mode",
+                           "half_pixel") == "half_pixel"
+    x = jnp.asarray(x)
+    N, C, H, W = x.shape
+
+    def one_roi(roi, b):
+        off = 0.5 if half_pixel else 0.0
+        x1, y1, x2, y2 = [roi[i] * scale - off for i in range(4)]
+        roi_w, roi_h = x2 - x1, y2 - y1
+        if not half_pixel:      # legacy mode clamps to min size 1
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_w, bin_h = roi_w / out_w, roi_h / out_h
+        iy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr     # (sr,)
+        ys = (y1 + (jnp.arange(out_h, dtype=jnp.float32)[:, None]
+                    + iy[None, :]) * bin_h).ravel()             # (out_h*sr,)
+        xs = (x1 + (jnp.arange(out_w, dtype=jnp.float32)[:, None]
+                    + iy[None, :]) * bin_w).ravel()             # (out_w*sr,)
+        img = x[b]                                              # (C, H, W)
+
+        def axis_weights(cs, limit):
+            valid = (cs >= -1.0) & (cs <= limit)    # torchvision zero rule
+            c = jnp.clip(cs, 0.0, limit - 1)
+            lo = jnp.floor(c).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, int(limit) - 1)
+            frac = c - lo
+            return lo, hi, frac, valid
+
+        y0, y1i, fy, vy = axis_weights(ys, float(H))
+        x0, x1i, fx, vx = axis_weights(xs, float(W))
+        # gather rows then columns: 4 corner planes (C, Sy, Sx)
+        gy0, gy1 = img[:, y0, :], img[:, y1i, :]
+        v = ((gy0[:, :, x0] * (1 - fy)[None, :, None]
+              + gy1[:, :, x0] * fy[None, :, None]) * (1 - fx)[None, None, :]
+             + (gy0[:, :, x1i] * (1 - fy)[None, :, None]
+                + gy1[:, :, x1i] * fy[None, :, None]) * fx[None, None, :])
+        v = v * (vy[None, :, None] & vx[None, None, :])
+        v = v.reshape(C, out_h, sr, out_w, sr)
+        if mode == "max":
+            return v.max(axis=(2, 4))
+        return v.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(jnp.asarray(rois),
+                             jnp.asarray(batch_idx).astype(jnp.int32))
+
+
+@register_op("GridSample")
+def _grid_sample(node, inputs, ctx):
+    x, grid = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    if x.ndim != 4:
+        raise UnsupportedOp(f"GridSample rank {x.ndim} (4-D NCHW only)")
+    mode = node.attr("mode", "linear")
+    pad = node.attr("padding_mode", "zeros")
+    align = bool(node.attr("align_corners", 0))
+    N, C, H, W = x.shape
+
+    def unnormalize(coord, size):
+        if align:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def reflect(c, size):
+        # reflect around -0.5 / size-0.5 (align_corners=False convention)
+        if align:
+            span = 2.0 * (size - 1) if size > 1 else 1.0
+            c = jnp.abs(jnp.mod(c, span))
+            return jnp.where(c > size - 1, span - c, c)
+        span = 2.0 * size
+        c = jnp.mod(c + 0.5, span)
+        c = jnp.abs(c)
+        return jnp.clip(jnp.where(c > size, span - c, c) - 0.5,
+                        0.0, size - 1)
+
+    def sample_one(img, g):                     # img (C,H,W), g (Ho,Wo,2)
+        gx = unnormalize(g[..., 0].ravel(), W)  # (P,)
+        gy = unnormalize(g[..., 1].ravel(), H)
+        if pad == "reflection":
+            gx, gy = reflect(gx, W), reflect(gy, H)
+        flat = img.reshape(C, H * W)
+
+        def fetch(yi, xi):
+            valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            idx = (jnp.clip(yi, 0, H - 1) * W
+                   + jnp.clip(xi, 0, W - 1)).astype(jnp.int32)
+            v = flat[:, idx]                     # (C, P)
+            if pad == "zeros":
+                v = v * valid[None, :]
+            return v
+
+        if mode in ("nearest",):
+            yi = jnp.round(gy).astype(jnp.int32)
+            xi = jnp.round(gx).astype(jnp.int32)
+            # fetch()'s per-corner valid mask already zeroes out-of-image
+            # samples in zeros mode; border/reflection are in-range here
+            return fetch(yi, xi).reshape(C, g.shape[0], g.shape[1])
+        if mode not in ("linear", "bilinear"):
+            raise UnsupportedOp(f"GridSample mode {mode!r}")
+        if pad == "border":
+            gx = jnp.clip(gx, 0.0, W - 1)
+            gy = jnp.clip(gy, 0.0, H - 1)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        fx, fy = gx - x0, gy - y0
+        out = (fetch(y0, x0) * ((1 - fy) * (1 - fx))[None, :]
+               + fetch(y0, x0 + 1) * ((1 - fy) * fx)[None, :]
+               + fetch(y0 + 1, x0) * (fy * (1 - fx))[None, :]
+               + fetch(y0 + 1, x0 + 1) * (fy * fx)[None, :])
+        return out.reshape(C, g.shape[0], g.shape[1])
+
+    return jax.vmap(sample_one)(x, grid.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------

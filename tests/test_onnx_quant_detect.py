@@ -1,0 +1,350 @@
+"""Quantized (QLinear*) and detection (NMS / RoiAlign / GridSample) ONNX ops.
+
+The reference runs int8-quantized and detection graphs through ORT
+(`deep-learning/.../onnx/ONNXModel.scala:330`); these exercise the
+TPU-native handlers against float dequant references, hand cases, and
+torch.nn.functional.grid_sample (torch CPU ships in-image).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mmlspark_tpu.onnx.builder import (make_graph, make_model, make_node,
+                                       make_tensor_value_info)
+from mmlspark_tpu.onnx.convert import UnsupportedOp, convert_model
+
+
+def _run(nodes, feeds, feed_infos, inits=None, out_names=("y",)):
+    g = make_graph(
+        nodes, "t", feed_infos,
+        [make_tensor_value_info(o, np.float32, []) for o in out_names],
+        initializers=inits or {})
+    cm = convert_model(make_model(g))
+    res = cm(cm.params, feeds)
+    return [np.asarray(res[o]) for o in out_names]
+
+
+def _quant(x, scale, zp, dtype):
+    info = np.iinfo(dtype)
+    return np.clip(np.round(x / scale) + zp, info.min, info.max).astype(dtype)
+
+
+class TestQLinearOps:
+    def test_qlinear_matmul_matches_dequant_reference(self, rng):
+        a_f = rng.normal(0, 1, (4, 8)).astype(np.float32)
+        b_f = rng.normal(0, 1, (8, 6)).astype(np.float32)
+        a_s, b_s, y_s = 0.02, 0.015, 0.05
+        a_q = _quant(a_f, a_s, 3, np.uint8)
+        b_q = _quant(b_f, b_s, 0, np.int8)
+        feeds = {"a": a_q}
+        ins = [make_tensor_value_info("a", np.uint8, [4, 8])]
+        inits = {"as_": np.float32(a_s), "azp": np.uint8(3),
+                 "b": b_q, "bs": np.float32(b_s), "bzp": np.int8(0),
+                 "ys": np.float32(y_s), "yzp": np.int8(0)}
+        (got,) = _run([make_node("QLinearMatMul",
+                                 ["a", "as_", "azp", "b", "bs", "bzp",
+                                  "ys", "yzp"], ["y"])],
+                      feeds, ins, inits)
+        acc = (a_q.astype(np.int32) - 3) @ b_q.astype(np.int32)
+        want = np.clip(np.round(acc * (a_s * b_s / y_s)), -128, 127)
+        np.testing.assert_array_equal(got, want.astype(np.int8))
+
+    def test_qlinear_conv_per_channel_scale_and_bias(self, rng):
+        x_f = rng.normal(0, 1, (1, 3, 8, 8)).astype(np.float32)
+        w_f = rng.normal(0, 0.3, (4, 3, 3, 3)).astype(np.float32)
+        x_s, y_s = 0.03, 0.1
+        w_s = np.asarray([0.01, 0.02, 0.015, 0.025], np.float32)
+        x_q = _quant(x_f, x_s, 128, np.uint8)
+        w_q = np.stack([_quant(w_f[i], w_s[i], 0, np.int8)
+                        for i in range(4)])
+        bias = rng.integers(-50, 50, (4,)).astype(np.int32)
+        ins = [make_tensor_value_info("x", np.uint8, [1, 3, 8, 8])]
+        inits = {"xs": np.float32(x_s), "xzp": np.uint8(128),
+                 "w": w_q, "ws": w_s, "wzp": np.int8(0),
+                 "ys": np.float32(y_s), "yzp": np.uint8(120), "b": bias}
+        (got,) = _run([make_node("QLinearConv",
+                                 ["x", "xs", "xzp", "w", "ws", "wzp",
+                                  "ys", "yzp", "b"], ["y"],
+                                 pads=[1, 1, 1, 1])],
+                      {"x": x_q}, ins, inits)
+        # float reference on the dequantized tensors, requantized at the end
+        import torch
+        import torch.nn.functional as F
+        xd = (x_q.astype(np.float32) - 128) * x_s
+        wd = w_q.astype(np.float32) * w_s[:, None, None, None]
+        ref = F.conv2d(torch.from_numpy(xd), torch.from_numpy(wd),
+                       bias=torch.from_numpy(bias.astype(np.float32) * x_s
+                                             * w_s),
+                       padding=1).numpy()
+        want = np.clip(np.round(ref / y_s) + 120, 0, 255)
+        # integer accumulation is exact; the only rounding is the final
+        # requantize, so allow off-by-one on ties
+        assert got.shape == want.shape == (1, 4, 8, 8)
+        assert np.abs(got.astype(np.int32) - want.astype(np.int32)).max() <= 1
+
+    def test_qlinear_conv_mixed_uint8_int8_zero_points(self, rng):
+        """uint8 activations + int8 weights, both zero points 0 — ORT's
+        standard post-ReLU static-quantization layout; must widen instead
+        of feeding mixed dtypes to lax.conv."""
+        x_q = rng.integers(0, 255, (1, 2, 5, 5)).astype(np.uint8)
+        w_q = rng.integers(-127, 127, (3, 2, 3, 3)).astype(np.int8)
+        ins = [make_tensor_value_info("x", np.uint8, [1, 2, 5, 5])]
+        inits = {"xs": np.float32(0.02), "xzp": np.uint8(0),
+                 "w": w_q, "ws": np.float32(0.01), "wzp": np.int8(0),
+                 "ys": np.float32(0.7), "yzp": np.uint8(0)}
+        (got,) = _run([make_node("QLinearConv",
+                                 ["x", "xs", "xzp", "w", "ws", "wzp",
+                                  "ys", "yzp"], ["y"])],
+                      {"x": x_q}, ins, inits)
+        import torch
+        import torch.nn.functional as F
+        ref = F.conv2d(torch.from_numpy(x_q.astype(np.float32) * 0.02),
+                       torch.from_numpy(w_q.astype(np.float32) * 0.01)
+                       ).numpy()
+        want = np.clip(np.round(ref / 0.7), 0, 255)
+        assert np.abs(got.astype(np.int32) - want.astype(np.int32)).max() <= 1
+
+    def test_qgemm_float_output(self, rng):
+        a_f = rng.normal(0, 1, (3, 5)).astype(np.float32)
+        b_f = rng.normal(0, 1, (4, 5)).astype(np.float32)   # transB form
+        a_s, b_s = 0.02, 0.03
+        a_q = _quant(a_f, a_s, 0, np.int8)
+        b_q = _quant(b_f, b_s, 0, np.int8)
+        ins = [make_tensor_value_info("a", np.int8, [3, 5])]
+        inits = {"as_": np.float32(a_s), "azp": np.int8(0),
+                 "b": b_q, "bs": np.float32(b_s), "bzp": np.int8(0)}
+        (got,) = _run([make_node("QGemm",
+                                 ["a", "as_", "azp", "b", "bs", "bzp"],
+                                 ["y"], domain="com.microsoft",
+                                 alpha=2.0, transB=1)],
+                      {"a": a_q}, ins, inits)
+        want = 2.0 * a_s * b_s * (a_q.astype(np.int32)
+                                  @ b_q.astype(np.int32).T)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_qlinear_add_skip_connection(self, rng):
+        a_f = rng.normal(0, 1, (2, 8)).astype(np.float32)
+        b_f = rng.normal(0, 1, (2, 8)).astype(np.float32)
+        a_q = _quant(a_f, 0.05, 10, np.int8)
+        b_q = _quant(b_f, 0.04, -5, np.int8)
+        ins = [make_tensor_value_info("a", np.int8, [2, 8])]
+        inits = {"as_": np.float32(0.05), "azp": np.int8(10),
+                 "b": b_q, "bs": np.float32(0.04), "bzp": np.int8(-5),
+                 "ys": np.float32(0.08), "yzp": np.int8(0)}
+        (got,) = _run([make_node("QLinearAdd",
+                                 ["a", "as_", "azp", "b", "bs", "bzp",
+                                  "ys", "yzp"], ["y"],
+                                 domain="com.microsoft")],
+                      {"a": a_q}, ins, inits)
+        ad = (a_q.astype(np.float32) - 10) * 0.05
+        bd = (b_q.astype(np.float32) + 5) * 0.04
+        want = np.clip(np.round((ad + bd) / 0.08), -128, 127).astype(np.int8)
+        assert np.abs(got.astype(np.int32)
+                      - want.astype(np.int32)).max() <= 1
+
+    def test_qlinear_global_average_pool(self, rng):
+        x_f = rng.normal(0, 1, (2, 3, 5, 5)).astype(np.float32)
+        x_q = _quant(x_f, 0.1, 20, np.uint8)
+        ins = [make_tensor_value_info("x", np.uint8, [2, 3, 5, 5])]
+        inits = {"xs": np.float32(0.1), "xzp": np.uint8(20),
+                 "ys": np.float32(0.12), "yzp": np.uint8(15)}
+        (got,) = _run([make_node("QLinearGlobalAveragePool",
+                                 ["x", "xs", "xzp", "ys", "yzp"], ["y"],
+                                 domain="com.microsoft")],
+                      {"x": x_q}, ins, inits)
+        mean = (x_q.astype(np.float32) - 20).mean(axis=(2, 3),
+                                                  keepdims=True) * 0.1
+        want = np.clip(np.round(mean / 0.12) + 15, 0, 255).astype(np.uint8)
+        assert got.shape == (2, 3, 1, 1)
+        assert np.abs(got.astype(np.int32)
+                      - want.astype(np.int32)).max() <= 1
+
+    def test_quantized_mlp_end_to_end(self, rng):
+        """Q/DQ boundary + two QLinear layers: the full pattern ORT's
+        static quantizer emits, run through one graph."""
+        x = rng.normal(0, 1, (4, 16)).astype(np.float32)
+        w1 = _quant(rng.normal(0, 0.5, (16, 32)).astype(np.float32),
+                    0.01, 0, np.int8)
+        w2 = _quant(rng.normal(0, 0.5, (32, 8)).astype(np.float32),
+                    0.01, 0, np.int8)
+        ins = [make_tensor_value_info("x", np.float32, [4, 16])]
+        inits = {"xs": np.float32(0.02), "xzp": np.int8(0),
+                 "w1": w1, "w1s": np.float32(0.01), "w1zp": np.int8(0),
+                 "h1s": np.float32(0.12), "h1zp": np.int8(0),
+                 "w2": w2, "w2s": np.float32(0.01), "w2zp": np.int8(0),
+                 "h2s": np.float32(0.12), "h2zp": np.int8(0)}
+        nodes = [
+            make_node("QuantizeLinear", ["x", "xs", "xzp"], ["xq"]),
+            make_node("QLinearMatMul",
+                      ["xq", "xs", "xzp", "w1", "w1s", "w1zp",
+                       "h1s", "h1zp"], ["h1"]),
+            make_node("QLinearMatMul",
+                      ["h1", "h1s", "h1zp", "w2", "w2s", "w2zp",
+                       "h2s", "h2zp"], ["h2"]),
+            make_node("DequantizeLinear", ["h2", "h2s", "h2zp"], ["y"]),
+        ]
+        (got,) = _run(nodes, {"x": x}, ins, inits)
+        # loose float check: two quantization stages, int8 resolution
+        want = (x @ (w1.astype(np.float32) * 0.01)) \
+            @ (w2.astype(np.float32) * 0.01)
+        assert got.shape == (4, 8)
+        assert np.abs(got - want).max() < 0.5
+
+
+class TestNonMaxSuppression:
+    def _nms(self, boxes, scores, max_out=10, iou=0.5, score_thr=None,
+             **attrs):
+        ins = [make_tensor_value_info("b", np.float32, list(boxes.shape)),
+               make_tensor_value_info("s", np.float32, list(scores.shape))]
+        names = ["b", "s", "m", "i"] + (["t"] if score_thr is not None else [])
+        inits = {"m": np.int64(max_out), "i": np.float32(iou)}
+        if score_thr is not None:
+            inits["t"] = np.float32(score_thr)
+        (got,) = _run([make_node("NonMaxSuppression", names, ["y"], **attrs)],
+                      {"b": boxes, "s": scores}, ins, inits)
+        return got
+
+    def test_suppresses_overlaps_keeps_disjoint(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                             [50, 50, 60, 60]]], np.float32)
+        scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+        got = self._nms(boxes, scores, iou=0.5)
+        # box 1 overlaps box 0 (IoU ~0.68) -> suppressed; box 2 disjoint
+        np.testing.assert_array_equal(got, [[0, 0, 0], [0, 0, 2]])
+
+    def test_score_threshold_and_max_out(self):
+        boxes = np.asarray([[[0, 0, 1, 1], [10, 10, 11, 11],
+                             [20, 20, 21, 21], [30, 30, 31, 31]]],
+                           np.float32)
+        scores = np.asarray([[[0.9, 0.8, 0.05, 0.7]]], np.float32)
+        got = self._nms(boxes, scores, max_out=2, iou=0.5, score_thr=0.1)
+        np.testing.assert_array_equal(got, [[0, 0, 0], [0, 0, 1]])
+
+    def test_max_out_zero_means_empty(self):
+        # spec: max_output_boxes_per_class "Default to 0, which means no
+        # output" — NOT unlimited
+        boxes = np.asarray([[[0, 0, 1, 1]]], np.float32)
+        scores = np.asarray([[[0.9]]], np.float32)
+        got = self._nms(boxes, scores, max_out=0)
+        assert got.shape == (0, 3)
+
+    def test_center_point_boxes_and_multiclass(self):
+        boxes = np.asarray([[[5, 5, 10, 10], [5.5, 5.5, 10, 10],
+                             [30, 30, 4, 4]]], np.float32)
+        scores = np.asarray([[[0.9, 0.85, 0.1], [0.2, 0.95, 0.3]]],
+                            np.float32)
+        got = self._nms(boxes, scores, iou=0.4, center_point_box=1)
+        # class 0: box 0 wins, box 1 suppressed (heavy overlap), box 2 kept
+        # class 1: box 1 wins, box 0 suppressed, box 2 kept
+        np.testing.assert_array_equal(
+            got, [[0, 0, 0], [0, 0, 2], [0, 1, 1], [0, 1, 2]])
+
+
+class TestRoiAlign:
+    def test_unit_roi_identity(self):
+        """A 2x2 ROI exactly covering a 2x2 output grid with one centered
+        sample per bin reads back the pixel values."""
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.asarray([[0.0, 0.0, 2.0, 2.0]], np.float32)
+        ins = [make_tensor_value_info("x", np.float32, [1, 1, 4, 4]),
+               make_tensor_value_info("r", np.float32, [1, 4]),
+               make_tensor_value_info("bi", np.int64, [1])]
+        (got,) = _run(
+            [make_node("RoiAlign", ["x", "r", "bi"], ["y"],
+                       output_height=2, output_width=2, sampling_ratio=1,
+                       spatial_scale=1.0,
+                       coordinate_transformation_mode="half_pixel")],
+            {"x": x, "r": rois, "bi": np.asarray([0], np.int64)}, ins)
+        # half_pixel: bin centers land at continuous (0.0, 0.0) ... (1, 1)
+        # -> bilinear at exact pixel centers 0, 1
+        np.testing.assert_allclose(
+            got[0, 0], [[x[0, 0, 0, 0], x[0, 0, 0, 1]],
+                        [x[0, 0, 1, 0], x[0, 0, 1, 1]]], atol=1e-5)
+
+    def test_avg_matches_dense_numpy_reference(self, rng):
+        x = rng.normal(0, 1, (2, 3, 16, 16)).astype(np.float32)
+        rois = np.asarray([[1.0, 2.0, 9.0, 12.0],
+                           [0.0, 0.0, 16.0, 16.0]], np.float32)
+        bi = np.asarray([1, 0], np.int64)
+        oh, ow, sr, scale = 4, 4, 2, 0.5
+        ins = [make_tensor_value_info("x", np.float32, [2, 3, 16, 16]),
+               make_tensor_value_info("r", np.float32, [2, 4]),
+               make_tensor_value_info("bi", np.int64, [2])]
+        (got,) = _run(
+            [make_node("RoiAlign", ["x", "r", "bi"], ["y"],
+                       output_height=oh, output_width=ow, sampling_ratio=sr,
+                       spatial_scale=scale,
+                       coordinate_transformation_mode="half_pixel")],
+            {"x": x, "r": rois, "bi": bi}, ins)
+
+        def bilinear(img, y, xq):
+            H, W = img.shape[-2:]
+            if y < -1 or y > H or xq < -1 or xq > W:
+                return np.zeros(img.shape[0], img.dtype)
+            y = min(max(y, 0), H - 1)
+            xq = min(max(xq, 0), W - 1)
+            y0, x0 = int(np.floor(y)), int(np.floor(xq))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            fy, fx = y - y0, xq - x0
+            return ((1 - fy) * (1 - fx) * img[:, y0, x0]
+                    + (1 - fy) * fx * img[:, y0, x1]
+                    + fy * (1 - fx) * img[:, y1, x0]
+                    + fy * fx * img[:, y1, x1])
+
+        want = np.zeros_like(got)
+        for r in range(2):
+            x1c, y1c, x2c, y2c = rois[r] * scale - 0.5
+            bh, bw = (y2c - y1c) / oh, (x2c - x1c) / ow
+            for ph in range(oh):
+                for pw in range(ow):
+                    acc = np.zeros(3, np.float32)
+                    for iy in range(sr):
+                        for ix in range(sr):
+                            yy = y1c + (ph + (iy + 0.5) / sr) * bh
+                            xx = x1c + (pw + (ix + 0.5) / sr) * bw
+                            acc += bilinear(x[bi[r]], yy, xx)
+                    want[r, :, ph, pw] = acc / (sr * sr)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_adaptive_sampling_rejected(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        ins = [make_tensor_value_info("x", np.float32, [1, 1, 4, 4]),
+               make_tensor_value_info("r", np.float32, [1, 4]),
+               make_tensor_value_info("bi", np.int64, [1])]
+        with pytest.raises(UnsupportedOp):
+            _run([make_node("RoiAlign", ["x", "r", "bi"], ["y"],
+                            output_height=2, output_width=2)],
+                 {"x": x, "r": np.zeros((1, 4), np.float32),
+                  "bi": np.zeros(1, np.int64)}, ins)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode,pad,align", [
+        ("bilinear", "zeros", 0),
+        ("bilinear", "border", 1),
+        ("nearest", "zeros", 0),
+        ("bilinear", "reflection", 0),
+    ])
+    def test_matches_torch(self, rng, mode, pad, align):
+        import torch
+        import torch.nn.functional as F
+        x = rng.normal(0, 1, (2, 3, 7, 9)).astype(np.float32)
+        grid = rng.uniform(-1.3, 1.3, (2, 5, 6, 2)).astype(np.float32)
+        ins = [make_tensor_value_info("x", np.float32, [2, 3, 7, 9]),
+               make_tensor_value_info("g", np.float32, [2, 5, 6, 2])]
+        (got,) = _run(
+            [make_node("GridSample", ["x", "g"], ["y"], mode=mode,
+                       padding_mode=pad, align_corners=align)],
+            {"x": x, "g": grid}, ins)
+        want = F.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                             mode=mode, padding_mode=pad,
+                             align_corners=bool(align)).numpy()
+        if mode == "nearest":
+            # ties round differently at exact .5 boundaries; compare the
+            # overwhelming majority and bound the tie disagreement
+            close = np.isclose(got, want, atol=1e-5)
+            assert close.mean() > 0.97, close.mean()
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
