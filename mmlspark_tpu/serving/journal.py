@@ -1,0 +1,159 @@
+"""Durable serving offsets: a write-ahead journal for epochs + requests.
+
+Parity: the reference checkpoints serving progress through Spark's offset
+machinery — ``HTTPOffset`` partition→epoch maps and the history queues that
+outlive an engine restart (``org/apache/spark/sql/execution/streaming/
+continuous/HTTPSourceV2.scala:96-113,225-258,489-506``). There the driver's
+checkpoint directory makes epochs durable; here an append-only JSONL journal
+per worker plays that role, so a worker **process** restart (not just an
+engine restart) rehydrates every routed-but-unanswered request.
+
+Records (one JSON object per line):
+    {"t": "req",   "id": ..., "epoch": N, "request": {HTTPRequestData}}
+    {"t": "rep",   "id": ...}
+    {"t": "epoch", "n": N}
+
+The write protocol is write-ahead (a request is journaled before it is
+visible to the engine), replies are journaled after routing succeeds, and
+replay tolerates a truncated final line (the SIGKILL-mid-write case).
+Fully-answered epochs are dropped at commit time by compaction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..io.http.schema import HTTPRequestData
+
+__all__ = ["ServingJournal"]
+
+
+class ServingJournal:
+    """Append-only JSONL journal with atomic-rename compaction."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._repair_torn_tail(path)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._lines_since_compact = 0
+
+    @staticmethod
+    def _repair_torn_tail(path: str) -> None:
+        """Terminate a non-newline-ended file before appending: without
+        this, the first post-restart append would glue onto the torn
+        record, corrupting an otherwise-valid line mid-file."""
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                last = fh.read(1)
+            if last != b"\n":
+                with open(path, "ab") as fh:
+                    fh.write(b"\n")
+        except FileNotFoundError:
+            pass
+
+    # -- write side ---------------------------------------------------------
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._lines_since_compact += 1
+
+    def record_request(self, request_id: str, epoch: int,
+                       request: HTTPRequestData) -> None:
+        self._append({"t": "req", "id": request_id, "epoch": epoch,
+                      "request": request.to_dict()})
+
+    def record_reply(self, request_id: str) -> None:
+        self._append({"t": "rep", "id": request_id})
+
+    def record_epoch(self, epoch: int) -> None:
+        self._append({"t": "epoch", "n": epoch})
+
+    # -- recovery side ------------------------------------------------------
+    @staticmethod
+    def _scan(path: str):
+        """Yield records, skipping corrupt lines. A SIGKILL mid-append
+        leaves at most one torn record (newline-terminated at next open by
+        ``_repair_torn_tail``); skipping — rather than stopping at — a bad
+        line preserves everything journaled after an earlier crash."""
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+        except FileNotFoundError:
+            return
+
+    def replay(self) -> Tuple[int, Dict[str, Tuple[int, HTTPRequestData]]]:
+        """Current epoch + unanswered requests ({id: (epoch, request)})."""
+        epoch = 0
+        pending: Dict[str, Tuple[int, HTTPRequestData]] = {}
+        for rec in self._scan(self.path):
+            t = rec.get("t")
+            if t == "req":
+                pending[rec["id"]] = (
+                    rec["epoch"], HTTPRequestData.from_dict(rec["request"]))
+            elif t == "rep":
+                pending.pop(rec["id"], None)
+            elif t == "epoch":
+                epoch = max(epoch, int(rec["n"]))
+        return epoch, pending
+
+    # -- compaction ---------------------------------------------------------
+    def maybe_compact(self, epoch: int, min_lines: int = 256) -> bool:
+        """Rewrite the journal down to the live set once enough dead lines
+        accumulate. Atomic: write a sibling file, fsync, rename over."""
+        with self._lock:
+            if self._lines_since_compact < min_lines:
+                return False
+            self._fh.flush()
+            # one lock span start-to-finish: an append racing between the
+            # pending snapshot and the rename would be silently dropped
+            pending = {}
+            for rec in self._scan(self.path):
+                if rec.get("t") == "req":
+                    pending[rec["id"]] = (
+                        rec["epoch"],
+                        HTTPRequestData.from_dict(rec["request"]))
+                elif rec.get("t") == "rep":
+                    pending.pop(rec["id"], None)
+            tmp = self.path + ".compact"
+            with open(tmp, "w", encoding="utf-8") as out:
+                out.write(json.dumps({"t": "epoch", "n": epoch},
+                                     separators=(",", ":")) + "\n")
+                for rid, (ep, req) in pending.items():
+                    out.write(json.dumps(
+                        {"t": "req", "id": rid, "epoch": ep,
+                         "request": req.to_dict()},
+                        separators=(",", ":")) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._lines_since_compact = 0
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except Exception:
+                pass

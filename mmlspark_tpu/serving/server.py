@@ -33,6 +33,9 @@ class CachedRequest:
     request_id: str
     epoch: int
     request: HTTPRequestData
+    #: True when rehydrated from the journal after a process restart — the
+    #: original connection is gone; the reply is journaled, not delivered
+    replayed: bool = False
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _response: Optional[HTTPResponseData] = field(default=None, repr=False)
 
@@ -126,17 +129,36 @@ class WorkerServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 60.0,
-                 max_queue: int = 10_000):
+                 max_queue: int = 10_000,
+                 journal_path: Optional[str] = None,
+                 journal_fsync: bool = True):
         self.reply_timeout = reply_timeout
         #: path prefix → fn(HTTPRequestData) -> HTTPResponseData
         self.control_routes: Dict[str, object] = {}
-        self._queue: "queue.Queue[CachedRequest]" = queue.Queue(max_queue)
         #: request_id → CachedRequest (reference: routingTable ``:689``)
         self._routing: Dict[str, CachedRequest] = {}
         #: epoch → {request_id: CachedRequest} (reference: historyQueues)
         self._history: Dict[int, Dict[str, CachedRequest]] = {}
         self._epoch = 0
         self._lock = threading.Lock()
+        #: durable epoch/request journal (the HTTPOffset role,
+        #: ``HTTPSourceV2.scala:96-113``) — survives PROCESS death
+        self._journal = None
+        pending = {}
+        if journal_path is not None:
+            from .journal import ServingJournal
+            self._journal = ServingJournal(journal_path, fsync=journal_fsync)
+            self._epoch, pending = self._journal.replay()
+        # the queue must hold every rehydrated request up front (no consumer
+        # exists yet) — a journal larger than max_queue must not deadlock
+        # the constructor
+        self._queue: "queue.Queue[CachedRequest]" = queue.Queue(
+            max(max_queue, len(pending)))
+        for rid, (epoch, request) in pending.items():
+            cached = CachedRequest(rid, epoch, request, replayed=True)
+            self._routing[rid] = cached
+            self._history.setdefault(epoch, {})[rid] = cached
+            self._queue.put_nowait(cached)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         # keep-alive handler threads must not block process exit
         self._httpd.daemon_threads = True
@@ -162,6 +184,14 @@ class WorkerServer:
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
         with self._lock:
             cached = CachedRequest(uuid.uuid4().hex, self._epoch, request)
+        # write-ahead, BEFORE the routing-table insert: a failed append
+        # (disk full, journal closed mid-shutdown) must error this request
+        # out cleanly instead of leaking a never-queued routing entry that
+        # pins its epoch's history forever
+        if self._journal is not None:
+            self._journal.record_request(cached.request_id, cached.epoch,
+                                         request)
+        with self._lock:
             self._routing[cached.request_id] = cached
             self._history.setdefault(cached.epoch, {})[cached.request_id] = cached
         self._queue.put(cached)
@@ -192,6 +222,8 @@ class WorkerServer:
                 self._history.get(cached.epoch, {}).pop(request_id, None)
         if cached is None:
             return False
+        if self._journal is not None:
+            self._journal.record_reply(request_id)
         cached.respond(response)
         return True
 
@@ -211,7 +243,11 @@ class WorkerServer:
             for e in done:
                 del self._history[e]
             self._epoch += 1
-            return self._epoch
+            epoch = self._epoch
+        if self._journal is not None:
+            self._journal.record_epoch(epoch)
+            self._journal.maybe_compact(epoch)
+        return epoch
 
     def replay_unanswered(self) -> int:
         """Re-enqueue every routed-but-unanswered request — the recovery a
@@ -238,4 +274,6 @@ class WorkerServer:
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._journal is not None:
+            self._journal.close()
         self._thread.join(timeout=5)
