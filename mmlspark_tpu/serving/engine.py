@@ -39,27 +39,38 @@ class ServingEngine:
                  reply_col: str = "reply",
                  host: str = "127.0.0.1", port: int = 0, api_path: str = "/",
                  max_batch: int = 1024, poll_timeout: float = 0.05,
-                 reply_timeout: float = 60.0):
+                 reply_timeout: float = 60.0, n_dispatchers: int = 1,
+                 journal_path: Optional[str] = None,
+                 transport: str = "threaded"):
         self.transform_fn = transform_fn
         self.schema = schema
         self.reply_col = reply_col
         self.max_batch = max_batch
         self.poll_timeout = poll_timeout
+        #: >1 overlaps batch formation/parse of one batch with the
+        #: transform of another — the single-loop engine serialized them
+        #: (the concurrency the reference gets from parallel Spark tasks)
+        self.n_dispatchers = max(1, int(n_dispatchers))
         self.server = WorkerServer(host, port, api_path,
-                                   reply_timeout=reply_timeout)
+                                   reply_timeout=reply_timeout,
+                                   journal_path=journal_path,
+                                   transport=transport)
         self.source = HTTPSource(self.server)
         self.sink = HTTPSink(self.server, reply_col=self.reply_col)
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: list = []
 
     @property
     def address(self) -> str:
         return self.server.address
 
     def start(self) -> "ServingEngine":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"serving-engine-{self.server.port}")
-        self._thread.start()
+        for i in range(self.n_dispatchers):
+            t = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"serving-engine-{self.server.port}-{i}")
+            t.start()
+            self._threads.append(t)
         return self
 
     def _loop(self) -> None:
@@ -89,8 +100,8 @@ class ServingEngine:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for t in self._threads:
+            t.join(timeout=5)
         self.server.close()
 
     def __enter__(self) -> "ServingEngine":

@@ -1,0 +1,174 @@
+"""Event-loop (asyncio) serving transport: one IO thread multiplexes all
+connections (the selector-based shape of the reference's
+``com.sun.net.httpserver``, ``HTTPSourceV2.scala:476-697``), replies cross
+from dispatcher threads via ``call_soon_threadsafe``."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu.io.http.schema import (EntityData, HTTPResponseData,
+                                         StatusLineData)
+from mmlspark_tpu.serving.engine import ServingEngine
+from mmlspark_tpu.serving.server import WorkerServer
+
+
+def _resp(payload, status=200):
+    return HTTPResponseData(entity=EntityData.from_string(json.dumps(payload)),
+                            status_line=StatusLineData(status_code=status))
+
+
+def test_async_roundtrip_keepalive():
+    """Sequential keep-alive requests on ONE connection, answered by a
+    dispatcher thread."""
+    ws = WorkerServer(transport="async", reply_timeout=10.0)
+    stop = threading.Event()
+
+    def engine():
+        while not stop.is_set():
+            for c in ws.get_batch(16, timeout=0.05):
+                body = json.loads(c.request.entity.string_content())
+                ws.reply(c.request_id, _resp({"double": body["x"] * 2}))
+
+    t = threading.Thread(target=engine, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        for i in range(5):
+            conn.request("POST", "/", json.dumps({"x": i}).encode(),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read()) == {"double": i * 2}
+        conn.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        ws.close()
+
+
+def test_async_chunked_request_body():
+    ws = WorkerServer(transport="async", reply_timeout=10.0)
+    stop = threading.Event()
+
+    def engine():
+        while not stop.is_set():
+            for c in ws.get_batch(16, timeout=0.05):
+                ws.reply(c.request_id, _resp(
+                    {"len": len(c.request.entity.content)}))
+
+    t = threading.Thread(target=engine, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        conn.putrequest("POST", "/")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        for chunk in (b"hello ", b"chunked ", b"world"):
+            conn.send(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+        conn.send(b"0\r\n\r\n")
+        r = conn.getresponse()
+        assert json.loads(r.read()) == {"len": len(b"hello chunked world")}
+        conn.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        ws.close()
+
+
+def test_async_control_route_bypasses_queue():
+    ws = WorkerServer(transport="async")
+    ws.control_routes["/ctrl"] = lambda req: _resp({"ctrl": True})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        conn.request("POST", "/ctrl/ping", b"{}")
+        assert json.loads(conn.getresponse().read()) == {"ctrl": True}
+        assert ws.pending_count() == 0      # never parked
+        conn.close()
+    finally:
+        ws.close()
+
+
+def test_async_malformed_request_gets_400():
+    import socket as _socket
+    ws = WorkerServer(transport="async")
+    try:
+        s = _socket.create_connection(("127.0.0.1", ws.port), timeout=10)
+        s.sendall(b"POST / HTTP/1.1\r\nContent-Length: abc\r\n\r\n")
+        data = s.recv(4096)
+        assert data.startswith(b"HTTP/1.1 400"), data[:60]
+        s.close()
+    finally:
+        ws.close()
+
+
+def test_async_reply_timeout_504():
+    ws = WorkerServer(transport="async", reply_timeout=0.3)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        conn.request("POST", "/", b'{"q": 1}')
+        r = conn.getresponse()
+        assert r.status == 504
+        r.read()
+        conn.close()
+    finally:
+        ws.close()
+
+
+def test_async_engine_many_connections():
+    """64 concurrent keep-alive connections through the full engine — the
+    regime where thread-per-connection convoys; must complete error-free."""
+    def transform(df):
+        return df.with_column("reply", [{"ok": True} for _ in df["x"]])
+
+    with ServingEngine(transform, schema={"x": float}, poll_timeout=0.005,
+                       n_dispatchers=2, transport="async") as eng:
+        errors, lock = [0], threading.Lock()
+
+        def client():
+            conn = http.client.HTTPConnection("127.0.0.1", eng.server.port,
+                                              timeout=30)
+            e = 0
+            for i in range(5):
+                try:
+                    conn.request("POST", "/", json.dumps({"x": i}).encode())
+                    r = conn.getresponse()
+                    r.read()
+                    if r.status != 200:
+                        e += 1
+                except Exception:
+                    e += 1
+            conn.close()
+            with lock:
+                errors[0] += e
+
+        ts = [threading.Thread(target=client) for _ in range(64)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert errors[0] == 0
+
+
+def test_async_with_journal_rehydrates(tmp_path):
+    """Async transport + durable journal compose: requests journaled by an
+    async server are rehydrated by a fresh (threaded or async) server."""
+    jp = str(tmp_path / "a.jsonl")
+    ws = WorkerServer(transport="async", journal_path=jp, reply_timeout=1.0)
+    conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+    conn.request("POST", "/", b'{"k": 9}')
+    r = conn.getresponse()      # times out -> 504; stays in journal
+    assert r.status == 504
+    r.read()
+    conn.close()
+    ws.close()
+    ws2 = WorkerServer(transport="async", journal_path=jp)
+    try:
+        batch = ws2.get_batch(4, timeout=1.0)
+        assert len(batch) == 1 and batch[0].replayed
+        assert json.loads(batch[0].request.entity.string_content()) == {"k": 9}
+    finally:
+        ws2.close()
