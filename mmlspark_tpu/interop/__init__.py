@@ -24,8 +24,11 @@ from typing import Iterable, Optional
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Estimator, Transformer
 
+from .onnx_shim import install_onnx_shim, uninstall_onnx_shim
+
 __all__ = ["transform_pandas", "fit_pandas", "make_pandas_udf_fn",
-           "spark_transform", "spark_schema_for"]
+           "spark_transform", "spark_schema_for", "install_onnx_shim",
+           "uninstall_onnx_shim"]
 
 
 def transform_pandas(stage: Transformer, pdf, npartitions: int = 1):

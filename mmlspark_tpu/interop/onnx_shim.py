@@ -1,0 +1,81 @@
+"""A minimal ``onnx`` module shim so ``torch.onnx.export`` works without
+the onnx pip package.
+
+torch's TorchScript exporter builds and serializes the complete ONNX
+ModelProto in C++; it imports the ``onnx`` package at the very end only to
+scan the graph for onnxscript custom functions
+(``torch/onnx/_internal/torchscript_exporter/onnx_proto_utils.py:183``,
+``_add_onnxscript_fn``). That scan needs exactly one API —
+``onnx.load_model_from_string`` — plus protobuf-shaped read access
+(``model.graph``, ``graph.node``, ``node.attribute``, ``attr.g``,
+``node.domain``/``op_type``, ``model.functions``). This repo already parses
+real ONNX protobufs (``onnx/proto.py``), so the shim simply routes torch's
+import to it; the exporter then emits a GENUINE torch-serialized ONNX
+model that ``convert_model`` consumes.
+
+Parity context: the reference executes arbitrary exporter artifacts
+through ORT (``deep-learning/.../onnx/ONNXModel.scala:195-245``); this
+closes the "has never eaten a real exporter artifact" gap within a
+zero-egress image.
+
+Usage::
+
+    from mmlspark_tpu.interop.onnx_shim import install_onnx_shim
+    install_onnx_shim()
+    torch.onnx.export(model, args, buffer, dynamo=False)
+
+Scope: models with onnxscript custom functions would need proto
+re-serialization and are rejected with a clear error; everything a stock
+``nn.Module`` export produces passes through untouched.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+from ..onnx.proto import (AttributeProto, GraphProto, ModelProto, NodeProto,
+                          TensorProto, parse_model)
+
+__all__ = ["install_onnx_shim", "uninstall_onnx_shim"]
+
+
+def load_model_from_string(data: bytes) -> ModelProto:
+    return parse_model(data)
+
+
+def install_onnx_shim() -> types.ModuleType:
+    """Register the shim as ``sys.modules['onnx']`` (no-op if a real onnx
+    package is already imported). Returns the module either way."""
+    existing = sys.modules.get("onnx")
+    if existing is not None:
+        return existing
+    # defer to a REAL onnx package if one is installed but not yet
+    # imported — shadowing it would cripple onnx.load/checker/helper for
+    # the rest of the process
+    import importlib.util
+    if importlib.util.find_spec("onnx") is not None:
+        import importlib
+        return importlib.import_module("onnx")
+    mod = types.ModuleType("onnx")
+    mod.__doc__ = __doc__
+    # a real ModuleSpec: probes like importlib.util.find_spec("onnx")
+    # (transformers does this at import) choke on __spec__ = None
+    import importlib.machinery
+    mod.__spec__ = importlib.machinery.ModuleSpec("onnx", None)
+    mod.__version__ = "0.0.0+mmlspark-tpu-shim"
+    mod.load_model_from_string = load_model_from_string
+    mod.ModelProto = ModelProto
+    mod.GraphProto = GraphProto
+    mod.NodeProto = NodeProto
+    mod.AttributeProto = AttributeProto
+    mod.TensorProto = TensorProto
+    mod.__mmlspark_tpu_shim__ = True
+    sys.modules["onnx"] = mod
+    return mod
+
+
+def uninstall_onnx_shim() -> None:
+    mod = sys.modules.get("onnx")
+    if mod is not None and getattr(mod, "__mmlspark_tpu_shim__", False):
+        del sys.modules["onnx"]

@@ -322,6 +322,12 @@ class NodeProto:
         a = self.attributes.get(name)
         return default if a is None else a.value()
 
+    @property
+    def attribute(self) -> List[AttributeProto]:
+        """Protobuf-canonical field name (consumers like torch's exporter
+        shim walk ``node.attribute``)."""
+        return list(self.attributes.values())
+
 
 @dataclass
 class ValueInfo:
@@ -388,6 +394,11 @@ class GraphProto:
                 g.value_info.append(ValueInfo.parse(v))
         return g
 
+    @property
+    def node(self) -> List[NodeProto]:
+        """Protobuf-canonical field name (``graph.node`` in onnx proper)."""
+        return self.nodes
+
 
 @dataclass
 class ModelProto:
@@ -395,6 +406,16 @@ class ModelProto:
     producer_name: str = ""
     graph: Optional[GraphProto] = None
     opset_imports: Dict[str, int] = field(default_factory=dict)
+    #: onnxscript FunctionProtos — parsed models never populate this; it
+    #: exists so protobuf-shaped consumers (the torch exporter shim) can
+    #: check it is empty
+    functions: List[object] = field(default_factory=list)
+
+    def SerializeToString(self) -> bytes:
+        raise NotImplementedError(
+            "this parsed ModelProto is read-only; re-serialization (only "
+            "needed when onnxscript custom functions are present) is not "
+            "supported — build models with mmlspark_tpu.onnx.builder")
 
     @staticmethod
     def parse(data: bytes) -> "ModelProto":

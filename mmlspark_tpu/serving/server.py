@@ -210,10 +210,17 @@ class _AsyncHTTPServer:
             h = await reader.readline()
             if h in (b"\r\n", b"\n", b""):
                 break
+            if len(headers) >= 100:     # http.client's own header cap
+                raise ValueError("got more than 100 headers")
             k, _, v = h.decode("latin-1").partition(":")
             k, v = k.strip(), v.strip()
             headers.append(HeaderData(k, v))
             hmap[k.lower()] = v
+        if "100-continue" in hmap.get("expect", "").lower():
+            # curl (any body > 1 KB) parks until the interim response —
+            # the threaded transport's handle_expect_100 equivalent
+            writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            await writer.drain()
         if "chunked" in hmap.get("transfer-encoding", "").lower():
             chunks = []
             while True:
@@ -352,6 +359,11 @@ class WorkerServer:
                  journal_path: Optional[str] = None,
                  journal_fsync: bool = True,
                  transport: str = "threaded"):
+        if transport not in ("threaded", "async"):
+            # validate BEFORE opening the journal: failing after would leak
+            # the journal fd and leave a half-built object
+            raise ValueError(f"unknown transport {transport!r} "
+                             "(expected 'threaded' or 'async')")
         self.reply_timeout = reply_timeout
         #: path prefix → fn(HTTPRequestData) -> HTTPResponseData
         self.control_routes: Dict[str, object] = {}
@@ -397,9 +409,6 @@ class WorkerServer:
                 target=self._httpd.serve_forever,
                 name=f"serving-{self.port}", daemon=True)
             self._thread.start()
-        else:
-            raise ValueError(f"unknown transport {transport!r} "
-                             "(expected 'threaded' or 'async')")
 
     @property
     def address(self) -> str:

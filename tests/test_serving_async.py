@@ -150,6 +150,9 @@ def test_async_engine_many_connections():
             t.start()
         for t in ts:
             t.join(timeout=60)
+        # a deadlocked transport would leave threads alive with errors
+        # still 0 — that must fail, not pass
+        assert not any(t.is_alive() for t in ts)
         assert errors[0] == 0
 
 
