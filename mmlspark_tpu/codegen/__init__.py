@@ -346,6 +346,148 @@ def generate_docs(stages: Optional[List[type]] = None) -> Dict[str, str]:
     return pages
 
 
+# ---------------------------------------------------------------------------
+# R wrapper generation — the RWrappable role (``Wrappable.scala:93``,
+# package assembly ``CodeGen.scala:66-120``). The reference's generated R
+# functions drive JVM stages through sparklyr; here they drive the Python
+# stages through reticulate, from the same Param reflection as the stubs.
+# ---------------------------------------------------------------------------
+
+def _r_fn_name(cls: type) -> str:
+    """``LightGBMClassifier`` → ``sml_light_gbm_classifier`` (the reference
+    prefixes generated R functions ``ml_``, ``Wrappable.scala:100-109``).
+    Acronym runs split before their last capital (GBMClassifier →
+    gbm_classifier)."""
+    import re
+    snake = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_",
+                   cls.__name__).lower()
+    return "sml_" + snake
+
+
+def _r_literal(v) -> Optional[str]:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return f"{v}L"
+    if isinstance(v, float):
+        return repr(v) if v == v and abs(v) != float("inf") else "NULL"
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)) and all(
+            isinstance(x, (int, float, str, bool)) for x in v):
+        items = [_r_literal(x) for x in v]
+        return "c(" + ", ".join(i for i in items if i) + ")" if items \
+            else "NULL"
+    return "NULL"       # dicts / complex values: settable but no default
+
+
+def _r_stage_fn(cls: type) -> Optional[str]:
+    if cls.__qualname__ != cls.__name__:
+        return None     # nested classes are not part of the R surface
+    params = cls.params()
+    sig_parts, conv_parts, doc_lines = [], [], []
+    for name in sorted(params):
+        p = params[name]
+        default = _r_literal(p.default) if p.has_default and not isinstance(
+            p, ComplexParam) else ("NULL" if isinstance(p, ComplexParam)
+                                   or not p.has_default else "NULL")
+        sig_parts.append(f"{name} = {default}")
+        rhs = f"as.integer({name})" if p.dtype is int else name
+        conv_parts.append(f"    {name} = if (!is.null({name})) {rhs}")
+        doc = (p.doc or "").strip().splitlines()
+        doc_lines.append(f"#' @param {name} {doc[0] if doc else ''}".rstrip())
+    path = "$".join(cls.__module__.split(".")[1:] + [cls.__name__])
+    fn = _r_fn_name(cls)
+    title = (cls.__dict__.get("__doc__") or cls.__name__).strip() \
+        .splitlines()[0].replace("\\", "\\\\")
+    body = [f"#' {title}", "#'"] + doc_lines + [
+        "#' @export",
+        f"{fn} <- function({', '.join(sig_parts)}) {{",
+        "  args <- .sml_drop_null(list(",
+        ",\n".join(conv_parts),
+        "  ))",
+        f"  do.call(.sml_module()${path}, args)",
+        "}",
+    ]
+    return "\n".join(body)
+
+
+_R_ZZZ = '''\
+# AUTO-GENERATED by `python -m mmlspark_tpu.codegen` - do not edit.
+# Runtime plumbing for the generated wrappers: the Python package is
+# reached through reticulate (the JVM/sparklyr role in the reference,
+# codegen/CodeGen.scala:66-120).
+
+.sml_env <- new.env(parent = emptyenv())
+
+.sml_module <- function() {
+  if (is.null(.sml_env$module)) {
+    .sml_env$module <- reticulate::import("mmlspark_tpu", delay_load = TRUE)
+  }
+  .sml_env$module
+}
+
+.sml_drop_null <- function(args) {
+  Filter(Negate(is.null), args)
+}
+
+#' Transform a data.frame with a fitted stage
+#' @export
+sml_transform <- function(stage, df) {
+  interop <- reticulate::import("mmlspark_tpu.interop")
+  interop$transform_pandas(stage, df)
+}
+
+#' Fit an estimator on a data.frame
+#' @export
+sml_fit <- function(estimator, df) {
+  interop <- reticulate::import("mmlspark_tpu.interop")
+  interop$fit_pandas(estimator, df)
+}
+'''
+
+
+def generate_r_wrappers(stages: Optional[List[type]] = None) -> Dict[str, str]:
+    """{relative path under r/mmlsparktpu: file text} — one R file per
+    subpackage plus DESCRIPTION/NAMESPACE/zzz.R."""
+    if stages is None:
+        stages = discover_stages()
+    by_pkg: Dict[str, List[type]] = {}
+    for c in stages:
+        by_pkg.setdefault(c.__module__.split(".")[1], []).append(c)
+    files: Dict[str, str] = {"R/zzz.R": _R_ZZZ}
+    exports = ["sml_transform", "sml_fit"]
+    for pkg in sorted(by_pkg):
+        fns = []
+        for cls in sorted(by_pkg[pkg], key=lambda c: c.__qualname__):
+            text = _r_stage_fn(cls)
+            if text is not None:
+                fns.append(text)
+                exports.append(_r_fn_name(cls))
+        if fns:
+            header = ("# AUTO-GENERATED by `python -m mmlspark_tpu.codegen`"
+                      " - do not edit.\n# R surface for mmlspark_tpu."
+                      f"{pkg} (RWrappable role, Wrappable.scala:93).\n")
+            files[f"R/{pkg}.R"] = header + "\n\n".join(fns) + "\n"
+    files["DESCRIPTION"] = (
+        "Package: mmlsparktpu\n"
+        "Type: Package\n"
+        "Title: R bindings for the mmlspark-tpu framework\n"
+        "Version: 0.1.0\n"
+        "Description: Generated wrappers driving mmlspark_tpu Python\n"
+        "    stages through reticulate; the role of the reference's\n"
+        "    generated sparklyr package.\n"
+        "Imports: reticulate\n"
+        "License: MIT\n"
+        "Encoding: UTF-8\n")
+    files["NAMESPACE"] = (
+        "# AUTO-GENERATED by `python -m mmlspark_tpu.codegen` - do not edit.\n"
+        + "".join(f"export({e})\n" for e in sorted(set(exports))))
+    return files
+
+
 def write_surface(repo_root: str) -> List[str]:
     """Write stubs next to their modules and docs under docs/api/.
     Returns the list of paths written."""
@@ -372,4 +514,11 @@ def write_surface(repo_root: str) -> List[str]:
     with open(marker, "w") as f:
         f.write("")
     written.append(marker)
+    r_root = os.path.join(repo_root, "r", "mmlsparktpu")
+    for rel, text in generate_r_wrappers(stages).items():
+        path = os.path.join(r_root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+        written.append(path)
     return sorted(written)

@@ -80,14 +80,17 @@ def spark_schema_for(stage: Transformer, sample_pdf, output_cols=None):
         out = out[list(output_cols)]
 
     def field_for(name, dtype, sample):
-        if np.issubdtype(dtype, np.bool_):
-            return StructField(name, BooleanType())
-        if np.issubdtype(dtype, np.integer):
-            return StructField(name, LongType())
-        if np.issubdtype(dtype, np.float32):
-            return StructField(name, FloatType())
-        if np.issubdtype(dtype, np.floating):
-            return StructField(name, DoubleType())
+        # pandas extension dtypes (StringDtype etc.) are not numpy dtypes
+        # and crash np.issubdtype — route them by the sample value instead
+        if isinstance(dtype, np.dtype):
+            if np.issubdtype(dtype, np.bool_):
+                return StructField(name, BooleanType())
+            if np.issubdtype(dtype, np.integer):
+                return StructField(name, LongType())
+            if np.issubdtype(dtype, np.float32):
+                return StructField(name, FloatType())
+            if np.issubdtype(dtype, np.floating):
+                return StructField(name, DoubleType())
         if isinstance(sample, np.ndarray):
             elem = (FloatType() if sample.dtype == np.float32
                     else DoubleType() if np.issubdtype(sample.dtype,
