@@ -17,6 +17,15 @@ The write protocol is write-ahead (a request is journaled before it is
 visible to the engine), replies are journaled after routing succeeds, and
 replay tolerates a truncated final line (the SIGKILL-mid-write case).
 Fully-answered epochs are dropped at commit time by compaction.
+
+Delivery semantics: replies are **at-most-once**. The reply record is
+appended after the routing-table entry is claimed but before the bytes
+reach the client socket, so a crash inside that window marks the request
+answered without the client having seen the response; replay will not
+rehydrate it. Journaling after the socket write instead would flip this to
+at-least-once (duplicate replay of already-delivered replies on restart) —
+for an HTTP server, whose client retries on a dropped connection anyway,
+at-most-once is the right edge of that trade.
 """
 
 from __future__ import annotations
@@ -62,8 +71,20 @@ class ServingJournal:
             pass
 
     # -- write side ---------------------------------------------------------
-    def _append(self, rec: dict) -> None:
+    def _append(self, rec: dict, drop_if_closed: bool = False) -> None:
         with self._lock:
+            if self._fh.closed and drop_if_closed:
+                # a dispatcher can outlive engine.stop() (join timeout) and
+                # reply after close(); losing the reply line only widens the
+                # documented at-most-once window, whereas the ValueError
+                # would kill the dispatcher thread mid-respond
+                import warnings
+                warnings.warn("ServingJournal closed; dropping record "
+                              f"t={rec.get('t')!r}", RuntimeWarning)
+                return
+            # note: a closed handle WITHOUT drop_if_closed raises — the
+            # write-ahead invariant (server._enqueue) depends on a failed
+            # request append erroring the request out before it is queued
             self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
             self._fh.flush()
             if self.fsync:
@@ -76,10 +97,10 @@ class ServingJournal:
                       "request": request.to_dict()})
 
     def record_reply(self, request_id: str) -> None:
-        self._append({"t": "rep", "id": request_id})
+        self._append({"t": "rep", "id": request_id}, drop_if_closed=True)
 
     def record_epoch(self, epoch: int) -> None:
-        self._append({"t": "epoch", "n": epoch})
+        self._append({"t": "epoch", "n": epoch}, drop_if_closed=True)
 
     # -- recovery side ------------------------------------------------------
     @staticmethod
@@ -121,7 +142,7 @@ class ServingJournal:
         """Rewrite the journal down to the live set once enough dead lines
         accumulate. Atomic: write a sibling file, fsync, rename over."""
         with self._lock:
-            if self._lines_since_compact < min_lines:
+            if self._lines_since_compact < min_lines or self._fh.closed:
                 return False
             self._fh.flush()
             # one lock span start-to-finish: an append racing between the

@@ -197,7 +197,7 @@ class _AsyncHTTPServer:
         finally:
             self._loop.close()
 
-    async def _read_request(self, reader):
+    async def _read_request(self, reader, writer):
         line = await reader.readline()
         if not line or line in (b"\r\n", b"\n"):
             return None
@@ -271,7 +271,7 @@ class _AsyncHTTPServer:
         try:
             while True:
                 try:
-                    parsed = await self._read_request(reader)
+                    parsed = await self._read_request(reader, writer)
                 except (ValueError, asyncio.LimitOverrunError):
                     # malformed framing (bad Content-Length / chunk size /
                     # oversized header) — answer 400 like the threaded
@@ -393,22 +393,29 @@ class WorkerServer:
             self._queue.put_nowait(cached)
         self.host = host
         self.api_path = api_path
-        if transport == "async":
-            self._httpd = None
-            self._aio: Optional[_AsyncHTTPServer] = _AsyncHTTPServer(
-                self, host, port)
-            self.port = self._aio.port
-        elif transport == "threaded":
-            self._aio = None
-            self._httpd = ThreadingHTTPServer((host, port), _Handler)
-            # keep-alive handler threads must not block process exit
-            self._httpd.daemon_threads = True
-            self._httpd.worker_server = self  # type: ignore[attr-defined]
-            self.port = self._httpd.server_address[1]
-            self._thread = threading.Thread(
-                target=self._httpd.serve_forever,
-                name=f"serving-{self.port}", daemon=True)
-            self._thread.start()
+        try:
+            if transport == "async":
+                self._httpd = None
+                self._aio: Optional[_AsyncHTTPServer] = _AsyncHTTPServer(
+                    self, host, port)
+                self.port = self._aio.port
+            elif transport == "threaded":
+                self._aio = None
+                self._httpd = ThreadingHTTPServer((host, port), _Handler)
+                # keep-alive handler threads must not block process exit
+                self._httpd.daemon_threads = True
+                self._httpd.worker_server = self  # type: ignore[attr-defined]
+                self.port = self._httpd.server_address[1]
+                self._thread = threading.Thread(
+                    target=self._httpd.serve_forever,
+                    name=f"serving-{self.port}", daemon=True)
+                self._thread.start()
+        except BaseException:
+            # transport startup failed (e.g. EADDRINUSE) after the journal
+            # was opened — close it so the half-built object leaks no fd
+            if self._journal is not None:
+                self._journal.close()
+            raise
 
     @property
     def address(self) -> str:

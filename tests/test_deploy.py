@@ -66,6 +66,19 @@ class TestHelmChart:
                     missing.append(fname)
         assert not missing, f"test files absent from CI matrix: {missing}"
 
+    def test_ci_matrix_is_fresh(self):
+        """pipeline.yaml is generated from tests/ — a new suite added
+        without rerunning scripts/gen_ci_matrix.py must fail here, not rot
+        silently (which is exactly how round 3 ended red)."""
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import gen_ci_matrix
+        finally:
+            sys.path.pop(0)
+        with open(os.path.join(REPO, "deploy", "ci", "pipeline.yaml")) as f:
+            assert f.read() == gen_ci_matrix.generate(), \
+                "stale CI matrix: rerun scripts/gen_ci_matrix.py"
+
     def test_dockerfile_mentions_entrypoint(self):
         with open(os.path.join(REPO, "deploy", "docker", "Dockerfile")) as f:
             text = f.read()

@@ -175,3 +175,54 @@ def test_async_with_journal_rehydrates(tmp_path):
         assert json.loads(batch[0].request.entity.string_content()) == {"k": 9}
     finally:
         ws2.close()
+
+
+def test_async_expect_100_continue():
+    """A client sending ``Expect: 100-continue`` (curl does for any body
+    over 1 KB) must get the interim response, then the real one — the bug
+    class here is the interim write crashing the connection handler."""
+    import socket as _socket
+    ws = WorkerServer(transport="async", reply_timeout=10.0)
+    stop = threading.Event()
+
+    def engine():
+        while not stop.is_set():
+            for c in ws.get_batch(16, timeout=0.05):
+                ws.reply(c.request_id, _resp(
+                    {"len": len(c.request.entity.content)}))
+
+    t = threading.Thread(target=engine, daemon=True)
+    t.start()
+    try:
+        body = b"x" * 2048
+        s = _socket.create_connection(("127.0.0.1", ws.port), timeout=10)
+        s.sendall(b"POST / HTTP/1.1\r\nHost: h\r\n"
+                  b"Content-Length: %d\r\nExpect: 100-continue\r\n\r\n"
+                  % len(body))
+        interim = s.recv(64)
+        assert b"100 Continue" in interim
+        s.sendall(body)
+        data = b""
+        while b"\r\n\r\n" not in data or not data.endswith(b"}"):
+            part = s.recv(4096)
+            if not part:
+                break
+            data += part
+        assert b"200" in data.split(b"\r\n", 1)[0]
+        assert json.loads(data.split(b"\r\n\r\n", 1)[1]) == {"len": 2048}
+        s.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        ws.close()
+
+
+def test_journal_append_after_close_is_dropped(tmp_path):
+    """A dispatcher that outlives engine.stop()'s join timeout replies into
+    a closed journal — that must warn-and-drop, not ValueError the thread."""
+    from mmlspark_tpu.serving.journal import ServingJournal
+    j = ServingJournal(str(tmp_path / "j.jsonl"))
+    j.record_epoch(1)
+    j.close()
+    with pytest.warns(RuntimeWarning):
+        j.record_reply("some-id")       # must not raise
