@@ -16,6 +16,9 @@ number.
 
 import json
 import os
+import subprocess
+import sys
+import tempfile
 import time
 
 import numpy as np
@@ -32,55 +35,125 @@ PEAK_FLOPS = {
     "v2": 45e12,
 }
 
+_PROBE_CHILD = r"""
+import os, sys, time
+out = sys.argv[1]
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((128, 128))
+val = float(jnp.sum(x @ x))          # fetched scalar = the only real fence
+assert val == 128.0 * 128.0
+tmp = out + ".tmp"
+with open(tmp, "w") as fh:
+    fh.write("%s|%s|%.1f" % (d.platform, d.device_kind, time.time() - t0))
+os.replace(tmp, out)                  # atomic: parent never sees a torn file
+"""
 
-def _probe_default_backend(timeout_s: float):
-    """Check in a subprocess that the default JAX backend initializes AND
-    answers a tiny computation within timeout. Returns (platform, kind) or
-    None. A subprocess is the only safe probe: a wedged TPU plugin can hang
-    `jax.devices()` forever while holding the backend-init lock."""
-    import subprocess
-    import sys
-    code = ("import jax; d=jax.devices()[0];"
-            "x=jax.numpy.ones((8,8));(x@x).block_until_ready();"
-            "print(d.platform+'|'+d.device_kind)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=timeout_s)
-        if r.returncode == 0 and "|" in r.stdout:
-            return tuple(r.stdout.strip().rsplit("|", 1))
-    except subprocess.TimeoutExpired:
-        pass
-    return None
+
+def _probe_default_backend(window_s: float):
+    """Probe the default backend in a child that writes a result file and
+    exits ON ITS OWN. Returns (platform, device_kind, probe_info).
+
+    The child is NEVER killed: a SIGKILLed process holding the TPU claim
+    wedges the chip for hours (BASELINE.md postmortem — the previous
+    ``subprocess.run(timeout=...)`` probe was itself a wedge mechanism).
+    On a hang the child is abandoned to finish whenever the tunnel recovers
+    and we fall back to CPU; on a crash (tunnel error) we retry over a
+    multi-minute window matched to the documented tunnel swings."""
+    info = {"attempts": 0, "window_s": window_s, "reason": None}
+    deadline = time.monotonic() + window_s
+    result_dir = tempfile.mkdtemp(prefix="bench_probe_")
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        info["attempts"] = attempt
+        out = os.path.join(result_dir, f"probe_{attempt}")
+        # stderr goes to a FILE, not a pipe: an undrained pipe can block a
+        # chatty plugin init, and an abandoned child would crash with
+        # BrokenPipeError — while holding the TPU claim — once the parent's
+        # pipe end is gc'd. A file stays writable after the parent exits.
+        errpath = out + ".stderr"
+        with open(errpath, "w") as errfh:
+            child = subprocess.Popen(
+                [sys.executable, "-c", _PROBE_CHILD, out],
+                stdout=subprocess.DEVNULL, stderr=errfh, text=True)
+        while time.monotonic() < deadline:
+            if os.path.exists(out):
+                # claim release: wait (bounded) for the child's own exit so
+                # the parent's backend init doesn't race the claim
+                for _ in range(120):
+                    if child.poll() is not None:
+                        break
+                    time.sleep(0.5)
+                with open(out) as fh:
+                    platform, kind, elapsed = fh.read().split("|")
+                info["init_s"] = float(elapsed)
+                return platform, kind, info
+            if child.poll() is not None:    # crashed — retry after a pause
+                try:
+                    with open(errpath) as fh:
+                        stderr_tail = fh.read()[-500:]
+                except OSError:
+                    stderr_tail = ""
+                info["reason"] = f"probe exited rc={child.returncode}: " \
+                                 f"{stderr_tail}"
+                time.sleep(min(30.0, 5.0 * attempt))
+                break
+            time.sleep(1.0)
+        else:
+            info["reason"] = (f"probe hung past the {window_s:.0f}s window; "
+                              "child left to exit on its own (never killed)")
+            return None, None, info
+    if info["reason"] is None:
+        info["reason"] = f"window {window_s:.0f}s exhausted"
+    return None, None, info
 
 
 def _init_backend():
-    """Return (platform, device_kind); fall back to CPU when the default
-    backend is broken or wedged. The bench must always print a number."""
-    probe = _probe_default_backend(
-        float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "180")))
-    import jax
-    if probe is None:
+    """Return (platform, device_kind, probe_info); fall back to CPU when the
+    default backend is broken or wedged. The bench must always print a
+    number, and the JSON must say WHY a fallback happened."""
+    window = float(os.environ.get(
+        "BENCH_PROBE_WINDOW",
+        os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "600")))
+    platform, kind, info = _probe_default_backend(window)
+    if platform is None:
+        # config.update (not env): setting JAX_PLATFORMS=cpu via env hangs
+        # under this image's plugin discovery
         os.environ.pop("JAX_PLATFORMS", None)
+        import jax
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
         d = jax.devices("cpu")[0]
-        return d.platform, d.device_kind
+        return d.platform, d.device_kind, info
+    import jax
     for attempt in range(3):
         try:
             d = jax.devices()[0]
-            return d.platform, d.device_kind
-        except RuntimeError:
+            return d.platform, d.device_kind, info
+        except RuntimeError as e:
+            info["reason"] = f"parent backend init failed: {e}"
             time.sleep(2.0 * (attempt + 1))
+    os.environ.pop("JAX_PLATFORMS", None)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     d = jax.devices("cpu")[0]
-    return d.platform, d.device_kind
+    return d.platform, d.device_kind, info
 
 
-def _peak_for(device_kind: str):
-    kind = device_kind.lower()
-    if "tpu" not in kind:
+def _looks_tpu(platform: str, device_kind: str) -> bool:
+    return "tpu" in platform.lower() or "tpu" in device_kind.lower()
+
+
+def _peak_for(platform: str, device_kind: str):
+    if not _looks_tpu(platform, device_kind):
         return None
+    kind = device_kind.lower()
     for key, peak in PEAK_FLOPS.items():
         if key in kind:
             return peak
@@ -88,7 +161,8 @@ def _peak_for(device_kind: str):
 
 
 def main():
-    platform, device_kind = _init_backend()
+    platform, device_kind, probe_info = _init_backend()
+    on_tpu = _looks_tpu(platform, device_kind)
 
     import jax
 
@@ -99,7 +173,7 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "512"))
     n_rows = int(os.environ.get("BENCH_ROWS", "2048"))
     passes = int(os.environ.get("BENCH_PASSES", "3"))
-    if platform == "cpu":
+    if not on_tpu:
         # degraded mode: still report a number, but keep the wall-clock sane
         batch = min(batch, 32)
         n_rows = min(n_rows, 128)
@@ -143,11 +217,30 @@ def main():
         assert len(out) == n_rows
         ips = max(ips, n_rows / elapsed)
 
-    import jax
+    # H2D link speed, fenced by a fetched scalar (block_until_ready returns
+    # early behind the tunnel — BASELINE.md); the fetch round-trip itself is
+    # measured on a 1-element array and subtracted. Both fenced programs run
+    # once untimed first so compile time cancels instead of skewing either
+    # timed leg.
+    import jax.numpy as jnp
+    small = np.ones(1, np.float32)
     probe = np.zeros((batch, 224, 224, 3), dtype=np.uint8)
+
+    def _fetch_small():
+        return float(jnp.sum(jax.device_put(small)))
+
+    def _fetch_probe():
+        return float(jnp.sum(
+            jax.device_put(probe)[:2, 0, 0, 0].astype(jnp.float32)))
+
+    _fetch_small(), _fetch_probe()      # warm compiles (+ first transfer)
     t0 = time.perf_counter()
-    jax.block_until_ready(jax.device_put(probe))
-    h2d_gbps = round(probe.nbytes / (time.perf_counter() - t0) / 1e9, 3)
+    _fetch_small()
+    rtt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _fetch_probe()
+    h2d_s = max(time.perf_counter() - t0 - rtt, 1e-9)
+    h2d_gbps = round(probe.nbytes / h2d_s / 1e9, 3)
 
     # Device-resident compute rate: what the chip sustains once inputs are
     # on device — separates the framework from the session's tunnel, whose
@@ -165,7 +258,7 @@ def main():
         tail = jax.jit(lambda c: jnp.sum(c["logits"][0, :2]
                                          .astype(jnp.float32)))
         float(tail(jitted(params, {"input": xdev})))   # compile + warm
-        reps = 3 if platform == "cpu" else 20
+        reps = 20 if on_tpu else 3
         t0 = time.perf_counter()
         outs = None
         for _ in range(reps):
@@ -188,7 +281,7 @@ def main():
         if isinstance(cost, list):
             cost = cost[0]
         flops_per_img = float(cost.get("flops", 0.0)) / batch
-        peak = _peak_for(device_kind)
+        peak = _peak_for(platform, device_kind)
         if flops_per_img and peak:
             mfu = round(ips * flops_per_img / peak, 4)
             if device_ips:
@@ -201,17 +294,21 @@ def main():
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / TARGET_IMG_PER_SEC, 4),
-        "platform": platform,
+        # "tpu"/"cpu" label via substring check; raw plugin strings recorded
+        # below so a mislabeled run is visible in the artifact itself
+        "platform": "tpu" if on_tpu else "cpu",
+        "platform_raw": platform,
         "device": device_kind,
         "mfu": mfu,
         "device_resident_ips": device_ips,
         "device_mfu": device_mfu,
         "h2d_gbps": h2d_gbps,
+        "backend_probe": probe_info,
     }
-    if platform != "tpu":
+    if not on_tpu:
         record["note"] = ("degraded CPU fallback (TPU backend unavailable "
-                          "at run time); measured TPU numbers incl. "
-                          "device-resident 11.6K img/s are in BASELINE.md")
+                          "at run time; see backend_probe.reason); measured "
+                          "TPU numbers are in BASELINE.md")
     print(json.dumps(record))
 
 

@@ -1,0 +1,228 @@
+"""Portable model artifacts: an mlflow-compatible save/log/load round-trip.
+
+Parity surface: the reference's generated PyTest fuzzing saves every fitted
+model through mlflow and loads it back as a generic pyfunc
+(``core/src/test/scala/com/microsoft/azure/synapse/ml/core/test/fuzzing/
+Fuzzing.scala:135-140`` — ``mlflow.spark.save_model`` /
+``mlflow.pyfunc.load_model`` → ``loaded.predict(df)``). The capability that
+proves is a *self-describing, externally-loadable* model directory with a
+generic predict entry — independent of the class that produced it.
+
+Layout (mlflow's own on-disk format, so a genuine mlflow install can load
+these artifacts via its pyfunc flavor without this package being mlflow-aware
+at save time):
+
+    <path>/MLmodel            YAML descriptor: flavors, uuid, signature
+    <path>/stage/             the stage tree (core.serialize format)
+    <path>/requirements.txt   pip requirements of the loader
+    <path>/input_example.json optional sampled input
+
+The ``python_function`` flavor points ``loader_module`` at THIS module, whose
+:func:`_load_pyfunc` is the exact hook ``mlflow.pyfunc.load_model`` calls; the
+``mmlspark_tpu`` flavor records the stage class for direct
+:func:`load_model` loading without mlflow installed (this image has none).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as _uuid
+from typing import Optional
+
+import numpy as np
+
+from .core import DataFrame
+from .core.pipeline import PipelineStage
+from .core.serialize import load_stage, save_stage
+
+__all__ = ["save_model", "log_model", "load_model", "PyFuncModel",
+           "infer_signature"]
+
+_FLAVOR = "mmlspark_tpu"
+
+
+def _col_spec(name, values):
+    arr = values if isinstance(values, np.ndarray) else np.asarray(values)
+    if arr.dtype == object and len(arr):
+        inner = np.asarray(arr[0])
+        kind = (f"array<{inner.dtype.name}>"
+                if inner.dtype != object else "object")
+        return {"name": name, "type": kind}
+    return {"name": name, "type": arr.dtype.name}
+
+
+def infer_signature(inputs: DataFrame, outputs: Optional[DataFrame] = None):
+    """Column name/dtype schema of inputs (and outputs) — the role of
+    ``mlflow.models.infer_signature``."""
+    sig = {"inputs": [_col_spec(c, inputs[c]) for c in inputs.columns]}
+    if outputs is not None:
+        sig["outputs"] = [_col_spec(c, outputs[c]) for c in outputs.columns]
+    return sig
+
+
+def _yaml_dump(obj, indent=0) -> str:
+    """Minimal YAML emitter (mappings/lists/scalars) — avoids a hard yaml
+    dependency in the library (tests use PyYAML to parse these back)."""
+    pad = "  " * indent
+    out = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)) and v:
+                out.append(f"{pad}{k}:")
+                out.append(_yaml_dump(v, indent + 1))
+            else:
+                out.append(f"{pad}{k}: {_yaml_scalar(v)}")
+    elif isinstance(obj, list):
+        for v in obj:
+            if isinstance(v, (dict, list)) and v:
+                first, *rest = _yaml_dump(v, indent + 1).splitlines()
+                out.append(f"{pad}- {first.strip()}")
+                out.extend(rest)
+            else:
+                out.append(f"{pad}- {_yaml_scalar(v)}")
+    else:
+        out.append(f"{pad}{_yaml_scalar(obj)}")
+    return "\n".join(out)
+
+
+def _yaml_scalar(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v)
+    if s == "" or any(ch in s for ch in ":#{}[]\n'\"") or s.strip() != s:
+        return json.dumps(s)
+    return s
+
+
+def save_model(model: PipelineStage, path: str,
+               input_example: Optional[DataFrame] = None,
+               signature: Optional[dict] = None) -> None:
+    """Write ``model`` (any Transformer/fitted Model/PipelineModel) as a
+    self-describing artifact directory at ``path``."""
+    if signature is None and input_example is not None:
+        try:
+            signature = infer_signature(input_example,
+                                        model.transform(input_example))
+        except Exception:
+            signature = infer_signature(input_example)
+    os.makedirs(path, exist_ok=True)
+    save_stage(model, os.path.join(path, "stage"))
+    mlmodel = {
+        "artifact_path": os.path.basename(path),
+        "flavors": {
+            "python_function": {
+                "loader_module": "mmlspark_tpu.mlflow",
+                "data": "stage",
+                "env": "requirements.txt",
+            },
+            _FLAVOR: {
+                "stage_class": f"{type(model).__module__}:"
+                               f"{type(model).__qualname__}",
+                "format_version": 1,
+                "data": "stage",
+            },
+        },
+        "model_uuid": _uuid.uuid4().hex,
+    }
+    if signature is not None:
+        # mlflow stores signature columns as json-encoded strings
+        mlmodel["signature"] = {
+            k: json.dumps(v) for k, v in signature.items()}
+    with open(os.path.join(path, "MLmodel"), "w", encoding="utf-8") as fh:
+        fh.write(_yaml_dump(mlmodel) + "\n")
+    with open(os.path.join(path, "requirements.txt"), "w",
+              encoding="utf-8") as fh:
+        fh.write("mmlspark-tpu\njax\nnumpy\n")
+    if input_example is not None:
+        ex = {c: np.asarray(input_example[c][:5]).tolist()
+              for c in input_example.columns
+              if np.asarray(input_example[c][:1]).dtype != object}
+        with open(os.path.join(path, "input_example.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(ex, fh)
+
+
+def log_model(model: PipelineStage, artifact_path: str,
+              tracking_dir: Optional[str] = None,
+              input_example: Optional[DataFrame] = None) -> str:
+    """File-store ``log_model``: saves under
+    ``<tracking_dir>/<run_id>/artifacts/<artifact_path>`` (mlflow's local
+    ``mlruns`` layout) and returns that path. ``tracking_dir`` defaults to
+    ``$MLFLOW_TRACKING_DIR`` or ``./mlruns/0``."""
+    tracking_dir = tracking_dir or os.environ.get(
+        "MLFLOW_TRACKING_DIR", os.path.join(".", "mlruns", "0"))
+    run_id = _uuid.uuid4().hex
+    dest = os.path.join(tracking_dir, run_id, "artifacts", artifact_path)
+    save_model(model, dest, input_example=input_example)
+    return dest
+
+
+class PyFuncModel:
+    """Generic predict entry over a loaded artifact — the shape of
+    ``mlflow.pyfunc.PyFuncModel``: ``load_model(path).predict(data)``."""
+
+    def __init__(self, stage: PipelineStage, metadata: dict):
+        self.stage = stage
+        self.metadata = metadata
+
+    def predict(self, data) -> DataFrame:
+        df = data if isinstance(data, DataFrame) else DataFrame(data)
+        return self.stage.transform(df)
+
+    def __repr__(self):
+        flavor = self.metadata.get("flavors", {}).get(_FLAVOR, {})
+        return (f"PyFuncModel(stage={flavor.get('stage_class', '?')}, "
+                f"uuid={self.metadata.get('model_uuid', '?')[:8]})")
+
+
+def _read_mlmodel(path: str) -> dict:
+    """Parse the MLmodel descriptor. Uses PyYAML when available (genuine
+    mlflow artifacts may use flow style); falls back to a line parser that
+    handles exactly what :func:`_yaml_dump` emits."""
+    text = open(os.path.join(path, "MLmodel"), encoding="utf-8").read()
+    try:
+        import yaml
+        return yaml.safe_load(text)
+    except ImportError:
+        pass
+    root: dict = {}
+    stack = [(root, -1)]
+    for line in text.splitlines():
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, val = line.strip().partition(":")
+        while stack and indent <= stack[-1][1]:
+            stack.pop()
+        cur = stack[-1][0]
+        if val.strip():
+            v = val.strip()
+            cur[key] = json.loads(v) if v.startswith('"') else v
+        else:
+            cur[key] = {}
+            stack.append((cur[key], indent))
+    return root
+
+
+def load_model(path: str) -> PyFuncModel:
+    """Load an artifact directory saved by :func:`save_model` (or by genuine
+    mlflow with this package's flavor) into a generic :class:`PyFuncModel`."""
+    meta = _read_mlmodel(path)
+    flavors = meta.get("flavors", {})
+    data = (flavors.get(_FLAVOR) or flavors.get("python_function")
+            or {}).get("data", "stage")
+    stage = load_stage(os.path.join(path, data))
+    return PyFuncModel(stage, meta)
+
+
+def _load_pyfunc(data_path: str) -> PyFuncModel:
+    """The ``mlflow.pyfunc`` loader hook: mlflow calls
+    ``loader_module._load_pyfunc(<artifact>/<data>)`` and wraps the returned
+    object's ``predict``. ``data_path`` points at the stage tree itself."""
+    stage = load_stage(data_path)
+    return PyFuncModel(stage, {"flavors": {_FLAVOR: {}}})

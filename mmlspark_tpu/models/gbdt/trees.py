@@ -59,10 +59,11 @@ def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
                                        level_histogram_pallas,
                                        pallas_preferred)
     if histogram_enabled() and pallas_preferred(xb.shape[0], n_nodes, n_bins):
+        from ...utils.device import is_tpu
         # force-on off-TPU runs the interpreter (Mosaic can't compile there)
         hist = level_histogram_pallas(xb, node_rel, g, h, w_count,
                                       n_nodes, n_bins,
-                                      interpret=jax.default_backend() != "tpu")
+                                      interpret=not is_tpu())
     else:
         data = jnp.stack([g, h, w_count], axis=-1)  # (n, 3)
 

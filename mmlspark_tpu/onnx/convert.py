@@ -338,8 +338,8 @@ def _attention_core(q, k, v, kv_mask, causal, scale, pair_mask=None):
     """(B, H, S, D) attention shared by the fused ops: Pallas flash kernel
     on TPU, dense XLA elsewhere. ``pair_mask`` is an optional (Sq, Sk)
     boolean mask (the ai.onnx 2-D form, trailing-dim aligned)."""
-    if (jax.default_backend() == "tpu" and q.shape[2] == k.shape[2]
-            and pair_mask is None):
+    from ..utils.device import is_tpu
+    if is_tpu() and q.shape[2] == k.shape[2] and pair_mask is None:
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                                scale=scale)

@@ -57,7 +57,8 @@ _BWD_IMPL = ("xla" if os.environ.get("MMLSPARK_TPU_FLASH_BWD", "pallas")
 
 
 def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..utils.device import is_tpu
+    return not is_tpu()
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *rest, scale, causal,

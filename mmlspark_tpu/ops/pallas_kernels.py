@@ -53,7 +53,8 @@ def histogram_enabled() -> bool:
         return True
     if flag in ("0", "false", "off"):
         return False
-    return jax.default_backend() == "tpu"
+    from ..utils.device import is_tpu
+    return is_tpu()
 
 
 def pallas_preferred(n_rows: int, n_nodes: int, n_bins: int) -> bool:

@@ -1,0 +1,70 @@
+"""Centralized accelerator detection.
+
+Parity role: the reference picks its execution provider by probing device
+strings in one place (``deep-learning/src/main/scala/com/microsoft/azure/
+synapse/ml/onnx/ONNXModel.scala:293-303`` — CUDA vs CPU EP selection).
+Here every TPU gate (Pallas interpret mode, kernel autotuning, bench
+labeling) funnels through :func:`is_tpu` so a PJRT plugin that reports an
+unexpected platform string (this session's chip arrives through a plugin
+named ``axon``) is handled — and misdetection is visible — in exactly one
+place.
+
+``jax.default_backend() == "tpu"`` scattered across modules is the failure
+mode this replaces: if the plugin reports any other string, flash-attention
+silently drops to interpret mode and the bench mislabels a real TPU run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+__all__ = ["device_info", "is_tpu", "tpu_generation"]
+
+_CACHE: Optional[Tuple[str, str]] = None
+
+
+def device_info() -> Tuple[str, str]:
+    """(platform, device_kind) of the default backend's first device, raw
+    strings as the plugin reports them. Cached after first success — the
+    default backend cannot change within a process."""
+    global _CACHE
+    if _CACHE is None:
+        import jax
+        d = jax.devices()[0]
+        _CACHE = (str(d.platform or ""), str(d.device_kind or ""))
+    return _CACHE
+
+
+def is_tpu() -> bool:
+    """True when the default backend is a TPU, however the plugin spells it.
+
+    Checks, in order: the ``MMLSPARK_TPU_FORCE_PLATFORM`` env override
+    (``tpu``/``cpu``, for tests), ``jax.default_backend()``, and the first
+    device's platform/device_kind substrings — public TPU PJRT plugins
+    always put "tpu" or "TPU" in at least one of the three, whatever the
+    plugin's own name (e.g. a tunneled plugin registered as ``axon``).
+    """
+    forced = os.environ.get("MMLSPARK_TPU_FORCE_PLATFORM")
+    if forced:
+        return forced.lower() == "tpu"
+    try:
+        import jax
+        if jax.default_backend().lower() == "tpu":
+            return True
+        platform, kind = device_info()
+        return "tpu" in platform.lower() or "tpu" in kind.lower()
+    except Exception:
+        return False
+
+
+def tpu_generation() -> Optional[str]:
+    """Generation key ("v6" / "v5p" / "v5" / "v4" / ...) parsed from
+    device_kind, or None off-TPU — the lookup key for peak-FLOPs tables."""
+    if not is_tpu():
+        return None
+    kind = device_info()[1].lower()
+    for key in ("v6", "v5p", "v5", "v4", "v3", "v2"):
+        if key in kind:
+            return key
+    return None

@@ -134,6 +134,15 @@ def serialization_fuzz(obj: TestObject, tmp_path):
     assert_frames_equal(ref_out, model2.transform(t_df),
                         ignore=obj.ignore_cols)
 
+    # 3. portable-artifact round-trip: the mlflow leg of the reference's
+    # generated fuzzing (Fuzzing.scala:135-140) — every fitted model must
+    # reload through the generic save_model/load_model.predict entry
+    from mmlspark_tpu.mlflow import load_model, save_model
+    p3 = os.path.join(str(tmp_path), "artifact")
+    save_model(model, p3)
+    assert_frames_equal(ref_out, load_model(p3).predict(t_df),
+                        ignore=obj.ignore_cols)
+
 
 def _assert_params_match(a: PipelineStage, b: PipelineStage):
     from mmlspark_tpu.core.params import ComplexParam

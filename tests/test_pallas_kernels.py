@@ -66,7 +66,8 @@ def test_histogram_enabled_env(monkeypatch):
     monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "0")
     assert not histogram_enabled()
     monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "auto")
-    assert histogram_enabled() == (jax.default_backend() == "tpu")
+    from mmlspark_tpu.utils.device import is_tpu
+    assert histogram_enabled() == is_tpu()
 
 
 def test_gbdt_training_with_pallas_interpret(rng, monkeypatch):
