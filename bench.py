@@ -89,8 +89,13 @@ def _probe_default_backend(window_s: float):
                 with open(out) as fh:
                     platform, kind, elapsed = fh.read().split("|")
                 info["init_s"] = float(elapsed)
-                return platform, kind, info
-            if child.poll() is not None:    # crashed — retry after a pause
+                info["reason"] = None       # earlier failed attempts don't
+                return platform, kind, info  # make a successful probe look
+                #                              degraded in the artifact
+            if child.poll() is not None:
+                if os.path.exists(out):
+                    continue    # wrote-then-exited between the two checks
+                # crashed — retry after a pause
                 try:
                     with open(errpath) as fh:
                         stderr_tail = fh.read()[-500:]
@@ -147,17 +152,16 @@ def _init_backend():
 
 
 def _looks_tpu(platform: str, device_kind: str) -> bool:
-    return "tpu" in platform.lower() or "tpu" in device_kind.lower()
+    # pure-string helper from the library (no backend init in this process)
+    from mmlspark_tpu.utils.device import looks_tpu
+    return looks_tpu(platform, device_kind)
 
 
 def _peak_for(platform: str, device_kind: str):
+    from mmlspark_tpu.utils.device import generation_from_kind
     if not _looks_tpu(platform, device_kind):
         return None
-    kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return None
+    return PEAK_FLOPS.get(generation_from_kind(device_kind))
 
 
 def main():

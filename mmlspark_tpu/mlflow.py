@@ -101,9 +101,22 @@ def _yaml_scalar(v) -> str:
 
 def save_model(model: PipelineStage, path: str,
                input_example: Optional[DataFrame] = None,
-               signature: Optional[dict] = None) -> None:
+               signature: Optional[dict] = None,
+               overwrite: bool = False) -> None:
     """Write ``model`` (any Transformer/fitted Model/PipelineModel) as a
-    self-describing artifact directory at ``path``."""
+    self-describing artifact directory at ``path``.
+
+    An existing non-empty ``path`` is refused (genuine mlflow does the
+    same) unless ``overwrite=True`` — re-saving into a populated directory
+    would leave stale files (an old input_example.json, say) pairing with
+    the new model."""
+    if os.path.isdir(path) and os.listdir(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"refusing to save into non-empty {path!r}; pass "
+                "overwrite=True to replace it")
+        import shutil
+        shutil.rmtree(path)
     if signature is None and input_example is not None:
         try:
             signature = infer_signature(input_example,

@@ -19,9 +19,29 @@ from __future__ import annotations
 import os
 from typing import Optional, Tuple
 
-__all__ = ["device_info", "is_tpu", "tpu_generation"]
+__all__ = ["device_info", "is_tpu", "tpu_generation", "looks_tpu",
+           "generation_from_kind"]
 
 _CACHE: Optional[Tuple[str, str]] = None
+
+#: ordered (longest-match-first) generation keys — v5p before v5
+_GENERATIONS = ("v6", "v5p", "v5", "v4", "v3", "v2")
+
+
+def looks_tpu(platform: str, device_kind: str) -> bool:
+    """Pure-string TPU check over raw (platform, device_kind) — for callers
+    (like bench.py) that probed the strings in a child process and must not
+    initialize a backend in their own."""
+    return "tpu" in platform.lower() or "tpu" in device_kind.lower()
+
+
+def generation_from_kind(device_kind: str) -> Optional[str]:
+    """Pure-string generation key from a raw device_kind, or None."""
+    kind = device_kind.lower()
+    for key in _GENERATIONS:
+        if key in kind:
+            return key
+    return None
 
 
 def device_info() -> Tuple[str, str]:
@@ -63,8 +83,4 @@ def tpu_generation() -> Optional[str]:
     device_kind, or None off-TPU — the lookup key for peak-FLOPs tables."""
     if not is_tpu():
         return None
-    kind = device_info()[1].lower()
-    for key in ("v6", "v5p", "v5", "v4", "v3", "v2"):
-        if key in kind:
-            return key
-    return None
+    return generation_from_kind(device_info()[1])

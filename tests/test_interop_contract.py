@@ -8,7 +8,11 @@ The contract being pinned (pyspark's documented semantics):
 - the declared schema must match what the reference's generated wrappers
   would declare (``ONNXModel.scala:606-653`` reads model metadata; here a
   probe row infers it);
-- arrow serialization rejects ndarray cells — they must cross as lists.
+- every yielded batch crosses GENUINE pyarrow IPC bytes under the declared
+  schema (the ArrowStreamPandasUDFSerializer step) — ndarray cells, dtype
+  mismatches, and missing columns fail exactly where a real cluster would;
+- execution is lazy (the udf runs at toPandas()/collect()) and udf errors
+  surface as the PythonException shape: message + worker traceback.
 """
 
 import sys
@@ -84,9 +88,43 @@ class StructType:
     fields: List = field(default_factory=list)
 
 
+def _arrow_type(t):
+    """Declared Spark SQL type → the arrow type Spark's serializer maps it
+    to (`pyspark/sql/pandas/types.py::to_arrow_type` semantics)."""
+    import pyarrow as pa
+    if isinstance(t, BooleanType):
+        return pa.bool_()
+    if isinstance(t, LongType):
+        return pa.int64()
+    if isinstance(t, FloatType):
+        return pa.float32()
+    if isinstance(t, DoubleType):
+        return pa.float64()
+    if isinstance(t, StringType):
+        return pa.string()
+    if isinstance(t, ArrayType):
+        return pa.list_(_arrow_type(t.elementType))
+    raise TypeError(f"no arrow mapping for {t!r}")
+
+
+class FakeSparkException(Exception):
+    """Stands in for pyspark's PythonException: carries the worker-side
+    traceback text the way Spark surfaces udf failures on collect()."""
+
+    def __init__(self, cause: BaseException, tb_text: str):
+        super().__init__(f"{type(cause).__name__}: {cause}")
+        self.cause = cause
+        self.tb_text = tb_text
+
+
 class FakeSparkDataFrame:
     """The mapInPandas half of the contract: slice into an ITERATOR of
-    pandas batches, feed the user fn, demand an iterator back, concat."""
+    pandas batches, feed the user fn, demand an iterator back, and push
+    every yielded batch through GENUINE arrow IPC against the declared
+    schema — the exact wire step Spark's ArrowStreamPandasUDFSerializer
+    performs, so a schema/data mismatch fails here like it would on a real
+    cluster. Errors raised inside the udf surface as FakeSparkException
+    with the worker traceback (pyspark's PythonException shape)."""
 
     def __init__(self, pdf: pd.DataFrame, batch_size: int = 2):
         self.pdf = pdf
@@ -95,25 +133,65 @@ class FakeSparkDataFrame:
 
     def mapInPandas(self, fn, schema):
         self.declared_schema = schema
+        return _FakeLazyResult(self, fn, schema)
+
+    def _execute(self, fn, schema):
+        import io
+        import traceback
+
+        import pyarrow as pa
+
+        arrow_schema = pa.schema(
+            [(f.name, _arrow_type(f.dataType)) for f in schema.fields])
 
         def batches():
             for i in range(0, len(self.pdf), self.batch_size):
                 yield self.pdf.iloc[i:i + self.batch_size].reset_index(
                     drop=True)
 
-        out_iter = fn(batches())
-        assert hasattr(out_iter, "__next__") or hasattr(out_iter, "__iter__")
-        parts = list(out_iter)
-        assert all(isinstance(p, pd.DataFrame) for p in parts)
-        # arrow's rule: object cells must be plain python (lists), never
-        # ndarrays — enforce it like the real serializer would
-        for p in parts:
-            for c in p.columns:
-                if p[c].dtype == object:
-                    for v in p[c]:
-                        assert not isinstance(v, np.ndarray), \
-                            f"ndarray cell leaked to arrow in column {c!r}"
-        return pd.concat(parts, ignore_index=True)
+        try:
+            out_iter = fn(batches())
+            assert hasattr(out_iter, "__next__") \
+                or hasattr(out_iter, "__iter__")
+            buf = io.BytesIO()
+            writer = None
+            n_parts = 0
+            for p in out_iter:
+                assert isinstance(p, pd.DataFrame)
+                n_parts += 1
+                # THE serialization step: pandas → arrow RecordBatch under
+                # the declared schema (raises on ndarray cells, wrong
+                # dtypes, missing columns), then actual IPC bytes
+                rb = pa.RecordBatch.from_pandas(
+                    p, schema=arrow_schema, preserve_index=False)
+                if writer is None:
+                    writer = pa.ipc.new_stream(buf, arrow_schema)
+                writer.write_batch(rb)
+        except Exception as e:      # noqa: BLE001 — udf errors become
+            raise FakeSparkException(e, traceback.format_exc()) from e
+        if n_parts == 0:
+            # real Spark returns an arrow-typed empty frame (float32 for
+            # FloatType etc.), never object columns
+            return pa.Table.from_batches([], schema=arrow_schema).to_pandas()
+        writer.close()
+        buf.seek(0)
+        table = pa.ipc.open_stream(buf).read_all()
+        return table.to_pandas()
+
+
+class _FakeLazyResult:
+    """Spark is lazy: mapInPandas returns a plan; the udf only runs at an
+    action. collect()/toPandas() triggers execution here the same way."""
+
+    def __init__(self, src, fn, schema):
+        self._src, self._fn, self._schema = src, fn, schema
+
+    def toPandas(self) -> pd.DataFrame:
+        return self._src._execute(self._fn, self._schema)
+
+    def collect(self):
+        pdf = self.toPandas()
+        return list(pdf.itertuples(index=False))
 
 
 @pytest.fixture()
@@ -164,12 +242,15 @@ def test_iterator_of_batches_protocol(pyspark_stub):
     iterator out, multiple batches, ndarray→list conversion, row order."""
     pdf = _pdf(7)
     sdf = FakeSparkDataFrame(pdf, batch_size=3)    # 3 uneven batches
-    out = spark_transform(_Scorer(), sdf, sample_pdf=pdf.head(2))
+    out = spark_transform(_Scorer(), sdf, sample_pdf=pdf.head(2)).toPandas()
     assert len(out) == 7
     want = [float(np.sum(v)) for v in pdf["features"]]
     np.testing.assert_allclose(out["score"].to_numpy(), want, rtol=1e-6)
     assert list(out["idx"]) == list(range(7))      # order preserved
-    assert isinstance(out["vec"][0], list)         # arrow-safe cells
+    # cells surviving genuine arrow IPC proves they were arrow-safe
+    s0 = float(np.sum(pdf["features"][0]))
+    np.testing.assert_allclose(np.asarray(out["vec"][0]), [s0, -s0],
+                               rtol=1e-6)
     assert sdf.declared_schema is not None
 
 
@@ -203,8 +284,9 @@ def test_explicit_schema_skips_inference(pyspark_stub):
     sdf = FakeSparkDataFrame(pdf, batch_size=2)
     schema = StructType([StructField("score", FloatType())])
     out = spark_transform(_Scorer(), sdf, output_cols=["score"],
-                          schema=schema)
+                          schema=schema).toPandas()
     assert list(out.columns) == ["score"]
+    assert out["score"].dtype == np.float32    # FloatType held through IPC
     assert sdf.declared_schema is schema
 
 
@@ -234,3 +316,58 @@ def test_udf_fn_is_reusable_across_batches(pyspark_stub):
     a = fn(_pdf(2))
     b = fn(_pdf(3))
     assert list(a.columns) == ["score"] and len(a) == 2 and len(b) == 3
+
+
+def test_udf_error_propagates_with_worker_traceback(pyspark_stub):
+    """Errors inside the udf must surface at the ACTION as the pyspark
+    PythonException shape — message plus worker traceback — not vanish
+    into the iterator."""
+    class _Boom(Transformer):
+        def _transform(self, df):
+            raise RuntimeError("bad rows in partition")
+
+    sdf = FakeSparkDataFrame(_pdf(4), batch_size=2)
+    schema = StructType([StructField("score", FloatType())])
+    plan = spark_transform(_Boom(), sdf, schema=schema)
+    with pytest.raises(FakeSparkException,
+                       match="bad rows in partition") as ei:
+        plan.toPandas()
+    assert "RuntimeError" in ei.value.tb_text
+    assert "_transform" in ei.value.tb_text      # worker frames included
+
+
+def test_arrow_rejects_wrong_schema_declaration(pyspark_stub):
+    """Declaring a schema the data cannot serialize under must fail at the
+    arrow step (as on a real cluster), not silently coerce."""
+    sdf = FakeSparkDataFrame(_pdf(4), batch_size=2)
+    schema = StructType([StructField("score", ArrayType(FloatType()))])
+    with pytest.raises(FakeSparkException):
+        spark_transform(_Scorer(), sdf, output_cols=["score"],
+                        schema=schema).toPandas()
+
+
+def test_lazy_until_action(pyspark_stub):
+    """mapInPandas returns a plan; the udf runs only at collect()."""
+    calls = []
+
+    class _Count(Transformer):
+        def _transform(self, df):
+            calls.append(1)
+            return df
+
+    sdf = FakeSparkDataFrame(pd.DataFrame({"x": np.array([1.0, 2.0])}),
+                             batch_size=1)
+    schema = StructType([StructField("x", DoubleType())])
+    plan = spark_transform(_Count(), sdf, schema=schema)
+    assert calls == []                 # nothing ran yet
+    rows = plan.collect()
+    assert len(rows) == 2 and calls    # executed at the action
+
+
+def test_empty_input_yields_empty_frame_with_schema(pyspark_stub):
+    sdf = FakeSparkDataFrame(_pdf(0), batch_size=2)
+    schema = StructType([StructField("score", FloatType())])
+    out = spark_transform(_Scorer(), sdf, output_cols=["score"],
+                          schema=schema).toPandas()
+    assert len(out) == 0 and list(out.columns) == ["score"]
+    assert out["score"].dtype == np.float32    # arrow-typed, not object

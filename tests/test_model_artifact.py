@@ -136,3 +136,22 @@ class TestSignature:
         out = load_model(p).predict(df)
         np.testing.assert_array_equal(np.asarray(out["idx"]),
                                       np.asarray(model.transform(df)["idx"]))
+
+
+class TestOverwrite:
+    def test_refuses_non_empty_path(self, tmp_path):
+        model, df = _fitted_model_and_df()
+        p = str(tmp_path / "artifact")
+        save_model(model, p)
+        with pytest.raises(FileExistsError, match="overwrite"):
+            save_model(model, p)
+        save_model(model, p, overwrite=True)    # replaces cleanly
+        assert "prediction" in load_model(p).predict(df).columns
+
+    def test_overwrite_clears_stale_files(self, tmp_path):
+        model, df = _fitted_model_and_df()
+        p = str(tmp_path / "artifact")
+        save_model(model, p, input_example=df)
+        assert os.path.exists(os.path.join(p, "input_example.json"))
+        save_model(model, p, overwrite=True)    # no example this time
+        assert not os.path.exists(os.path.join(p, "input_example.json"))
