@@ -78,23 +78,29 @@ def _probe_default_backend(window_s: float):
             child = subprocess.Popen(
                 [sys.executable, "-c", _PROBE_CHILD, out],
                 stdout=subprocess.DEVNULL, stderr=errfh, text=True)
+        def _success():
+            # claim release: wait (bounded) for the child's own exit so
+            # the parent's backend init doesn't race the claim
+            for _ in range(120):
+                if child.poll() is not None:
+                    break
+                time.sleep(0.5)
+            with open(out) as fh:
+                platform, kind, elapsed = fh.read().split("|")
+            info["init_s"] = float(elapsed)
+            info["reason"] = None   # earlier failed attempts don't make a
+            #                         successful probe look degraded
+            return platform, kind, info
+
         while time.monotonic() < deadline:
             if os.path.exists(out):
-                # claim release: wait (bounded) for the child's own exit so
-                # the parent's backend init doesn't race the claim
-                for _ in range(120):
-                    if child.poll() is not None:
-                        break
-                    time.sleep(0.5)
-                with open(out) as fh:
-                    platform, kind, elapsed = fh.read().split("|")
-                info["init_s"] = float(elapsed)
-                info["reason"] = None       # earlier failed attempts don't
-                return platform, kind, info  # make a successful probe look
-                #                              degraded in the artifact
+                return _success()
             if child.poll() is not None:
                 if os.path.exists(out):
-                    continue    # wrote-then-exited between the two checks
+                    # wrote-then-exited between the two checks — handle
+                    # inline: re-entering the loop could hit an expired
+                    # deadline and misreport the success as a hang
+                    return _success()
                 # crashed — retry after a pause
                 try:
                     with open(errpath) as fh:

@@ -109,24 +109,45 @@ def save_model(model: PipelineStage, path: str,
     An existing non-empty ``path`` is refused (genuine mlflow does the
     same) unless ``overwrite=True`` — re-saving into a populated directory
     would leave stale files (an old input_example.json, say) pairing with
-    the new model."""
-    if os.path.isdir(path) and os.listdir(path):
-        if not overwrite:
-            raise FileExistsError(
-                f"refusing to save into non-empty {path!r}; pass "
-                "overwrite=True to replace it")
-        import shutil
-        shutil.rmtree(path)
+    the new model. Overwrite is atomic: the new artifact is built in a
+    sibling temp dir and swapped in, so a mid-save failure cannot destroy
+    the previous good artifact."""
+    existing = os.path.isdir(path) and bool(os.listdir(path))
+    if existing and not overwrite:
+        raise FileExistsError(
+            f"refusing to save into non-empty {path!r}; pass "
+            "overwrite=True to replace it")
     if signature is None and input_example is not None:
         try:
             signature = infer_signature(input_example,
                                         model.transform(input_example))
         except Exception:
             signature = infer_signature(input_example)
+    if existing:
+        import shutil
+        import tempfile
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        tmp = tempfile.mkdtemp(prefix=".mlartifact_", dir=parent)
+        try:
+            _write_artifact(model, tmp, input_example, signature,
+                            name=os.path.basename(path))
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        shutil.rmtree(path)
+        os.replace(tmp, path)
+    else:
+        _write_artifact(model, path, input_example, signature,
+                        name=os.path.basename(path))
+
+
+def _write_artifact(model: PipelineStage, path: str,
+                    input_example: Optional[DataFrame],
+                    signature: Optional[dict], name: str) -> None:
     os.makedirs(path, exist_ok=True)
     save_stage(model, os.path.join(path, "stage"))
     mlmodel = {
-        "artifact_path": os.path.basename(path),
+        "artifact_path": name,
         "flavors": {
             "python_function": {
                 "loader_module": "mmlspark_tpu.mlflow",
