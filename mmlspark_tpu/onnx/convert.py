@@ -437,17 +437,8 @@ def _rotary_embedding(node, inputs, ctx):
     cos = cos[:, None, :, :]
     sin = sin[:, None, :, :]
     xr, xpass = x[..., :rot_dim], x[..., rot_dim:]
-    if interleaved:
-        x0, x1 = xr[..., 0::2], xr[..., 1::2]
-        r0 = x0 * cos - x1 * sin
-        r1 = x0 * sin + x1 * cos
-        rot = jnp.stack([r0, r1], axis=-1).reshape(xr.shape)
-    else:
-        half = rot_dim // 2
-        x0, x1 = xr[..., :half], xr[..., half:]
-        rot = jnp.concatenate([x0 * cos - x1 * sin,
-                               x0 * sin + x1 * cos], axis=-1)
-    out = jnp.concatenate([rot, xpass], axis=-1)
+    out = jnp.concatenate(
+        [_rope_rotate(xr, cos, sin, interleaved), xpass], axis=-1)
     if orig_rank == 3:
         out = out.transpose(0, 2, 1, 3).reshape(B, S, NH * D)
     return out
@@ -491,14 +482,33 @@ def _msft_mha(node, inputs, ctx):
 
 def _std_attention(node, inputs, ctx):
     """Standard ai.onnx Attention (opset 23): Q (B, Hq, Sq, D), K/V
-    (B, Hkv, Skv, D) — 4-D form; GQA via Hq % Hkv == 0 head repetition."""
+    (B, Hkv, Skv, D) — 4-D form, or 3-D (B, S, H·D) with the
+    q_num_heads/kv_num_heads attributes; GQA via Hq % Hkv == 0 head
+    repetition; optional past_key/past_value concatenated per the spec
+    (present outputs carry the grown cache)."""
     q, k, v = inputs[0], inputs[1], inputs[2]
     attn_mask = inputs[3] if len(inputs) > 3 else None
-    if any(i is not None for i in inputs[4:]):
-        raise UnsupportedOp("ai.onnx Attention with past state")
-    if q.ndim != 4:
-        raise UnsupportedOp("ai.onnx Attention 3-D form (set num_heads "
-                            "layouts are not implemented)")
+    past_k = inputs[4] if len(inputs) > 4 else None
+    past_v = inputs[5] if len(inputs) > 5 else None
+    three_d = q.ndim == 3
+    if three_d:
+        qnh = node.attr("q_num_heads", 0)
+        kvnh = node.attr("kv_num_heads", 0)
+        if not qnh or not kvnh:
+            raise UnsupportedOp("ai.onnx Attention 3-D form without "
+                                "q_num_heads/kv_num_heads")
+        B, Sq, HD = q.shape
+        D = HD // qnh
+        q = q.reshape(B, Sq, qnh, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, k.shape[1], kvnh, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, v.shape[1], kvnh, D).transpose(0, 2, 1, 3)
+    elif q.ndim != 4:
+        raise UnsupportedOp(f"ai.onnx Attention rank-{q.ndim} inputs")
+    if past_k is not None:
+        # spec: present = concat(past, current) along the sequence axis
+        k = jnp.concatenate([past_k, k], axis=2)
+        v = jnp.concatenate([past_v, v], axis=2)
+    present_k, present_v = k, v
     Hq, Hkv = q.shape[1], k.shape[1]
     if Hq % Hkv:
         raise UnsupportedOp(f"Attention q_num_heads {Hq} not a multiple of "
@@ -507,10 +517,15 @@ def _std_attention(node, inputs, ctx):
         k = jnp.repeat(k, Hq // Hkv, axis=1)
         v = jnp.repeat(v, Hq // Hkv, axis=1)
     causal = bool(node.attr("is_causal", 0))
+    if len(node.output) > 3 and node.output[3]:
+        raise UnsupportedOp("ai.onnx Attention qk_matmul_output")
+    if node.attr("qk_matmul_output_mode", 0):
+        raise UnsupportedOp("ai.onnx Attention qk_matmul_output_mode != 0")
     # standard ai.onnx Attention (unlike ORT contrib): the default applies
     # only when the attribute is ABSENT — an explicit 0.0 is honored
     s = node.attr("scale", None)
     scale = float(s) if s is not None else 1.0 / float(q.shape[-1]) ** 0.5
+    softcap = float(node.attr("softcap", 0.0))
     pair_mask = None
     if attn_mask is not None:
         # spec: the mask broadcasts against (B, H, Sq, Skv) aligned at the
@@ -522,47 +537,166 @@ def _std_attention(node, inputs, ctx):
             raise UnsupportedOp(
                 f"Attention mask shape {attn_mask.shape} dtype "
                 f"{attn_mask.dtype} (only boolean (q_seq, kv_seq))")
-    return _attention_core(q, k, v, None, causal, scale,
-                           pair_mask=pair_mask)
+    if softcap:
+        Sq, Sk = q.shape[2], k.shape[2]
+        mask = jnp.ones((Sq, Sk), bool)
+        if pair_mask is not None:
+            mask = mask & pair_mask
+        if causal:
+            mask = mask & jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        out = _dense_masked_attn(q, k, v, mask[None, None], scale, softcap)
+    else:
+        out = _attention_core(q, k, v, None, causal, scale,
+                              pair_mask=pair_mask)
+    if three_d:
+        B, _, Sq, D = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(B, Sq, Hq * D)
+    if len(node.output) > 1:
+        return out, present_k, present_v
+    return out
+
+
+def _rope_rotate(xr, cos, sin, interleaved):
+    """The rotation core shared by RotaryEmbedding and fused-attention
+    rotary: ``xr`` (..., rot_dim) with broadcastable half-dim cos/sin."""
+    if interleaved:
+        x0, x1 = xr[..., 0::2], xr[..., 1::2]
+        r0 = x0 * cos - x1 * sin
+        r1 = x0 * sin + x1 * cos
+        return jnp.stack([r0, r1], axis=-1).reshape(xr.shape)
+    half = xr.shape[-1] // 2
+    x0, x1 = xr[..., :half], xr[..., half:]
+    return jnp.concatenate([x0 * cos - x1 * sin,
+                            x0 * sin + x1 * cos], axis=-1)
+
+
+def _apply_rope4(x, pos, cos_cache, sin_cache, interleaved):
+    """Rotate a (B, nh, S, D) tensor at absolute positions ``pos`` (B, S)
+    using half-dim cos/sin caches (max_pos, rot_dim/2)."""
+    rot_dim = 2 * cos_cache.shape[-1]
+    cos = jnp.take(cos_cache, pos.astype(jnp.int32), axis=0)[:, None]
+    sin = jnp.take(sin_cache, pos.astype(jnp.int32), axis=0)[:, None]
+    xr, xpass = x[..., :rot_dim], x[..., rot_dim:]
+    return jnp.concatenate(
+        [_rope_rotate(xr, cos, sin, interleaved), xpass], axis=-1)
+
+
+def _dense_masked_attn(q, k, v, mask, scale, softcap=0.0,
+                       smooth_softmax=False):
+    """(B, H, Sq, D) × (B, H, Sk, D) attention with a (B, 1|H, Sq, Sk)
+    boolean mask, optional logit softcapping, and optional ORT
+    smooth-softmax (an implicit extra zero logit in the denominator) —
+    the decode-phase path where Sq is tiny and flash brings nothing."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)
+    s = jnp.where(mask, s, jnp.float32(-1e30))
+    if smooth_softmax:
+        # softmax_i = exp(s_i) / (1 + Σ exp(s_j)): stabilize against
+        # m = max(s, 0) so the implicit zero logit is included
+        m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), 0.0)
+        e = jnp.exp(s - m)
+        p = (e / (jnp.exp(-m) + jnp.sum(e, axis=-1, keepdims=True))) \
+            .astype(v.dtype)
+    else:
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 @register_op("GroupQueryAttention")
 def _gqa(node, inputs, ctx):
-    """com.microsoft GroupQueryAttention, prefill form (no past/cache):
-    packed or separate q/k/v, kv_num_heads < num_heads via repetition."""
+    """com.microsoft GroupQueryAttention — prefill AND decode (kv-cache)
+    forms, packed or separate QKV, optional fused rotary embedding.
+
+    The decode design is TPU-first: the past_key/past_value buffers keep
+    their STATIC (B, kv_heads, S_max, D) shape and the new K/V chunk is
+    written in place with ``lax.dynamic_update_slice`` per batch row — no
+    concat-and-grow dynamic shapes, which is exactly the cache layout a
+    jitted decode loop wants (XLA donates the buffer and updates in place).
+    Parity anchor: onnxruntime contrib GroupQueryAttention, the op the
+    reference's ONNXModel path executes via ORT CUDA
+    (``deep-learning/.../onnx/ONNXModel.scala:173-193``)."""
     q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
-    # inputs 3/4 = past_key/past_value (kv cache), 5 = seqlens_k,
-    # 6 = total_sequence_length, 7+ = cos/sin caches; real exports always
-    # carry seqlens_k/total_sequence_length, even in prefill
-    if any(i is not None for i in inputs[3:5]) or \
-            any(i is not None for i in inputs[7:]):
-        raise UnsupportedOp("GroupQueryAttention with kv cache/rotary inputs")
+    past_k = inputs[3] if len(inputs) > 3 else None
+    past_v = inputs[4] if len(inputs) > 4 else None
     seqlens_k = inputs[5] if len(inputs) > 5 else None
+    cos_cache = inputs[7] if len(inputs) > 7 else None
+    sin_cache = inputs[8] if len(inputs) > 8 else None
     heads = node.attr("num_heads")
     kv_heads = node.attr("kv_num_heads")
     if not heads or not kv_heads:
         raise UnsupportedOp("GroupQueryAttention without num_heads/"
                             "kv_num_heads")
+    if node.attr("local_window_size", -1) != -1:
+        raise UnsupportedOp("GroupQueryAttention local_window_size")
+    softcap = float(node.attr("softcap", 0.0))
+    smooth = bool(node.attr("smooth_softmax", 0))
+    do_rotary = bool(node.attr("do_rotary", 0))
+    interleaved = bool(node.attr("rotary_interleaved", 0))
+    if do_rotary and (cos_cache is None or sin_cache is None):
+        raise UnsupportedOp("GroupQueryAttention do_rotary without "
+                            "cos/sin caches")
+    B, S = q_in.shape[0], q_in.shape[1]
     if k_in is None or v_in is None:
-        raise UnsupportedOp("GroupQueryAttention packed-QKV layout")
-    B, S, Hq = q_in.shape
-    D = Hq // heads
+        # packed layout: query carries (heads + 2*kv_heads)·D lanes
+        D = q_in.shape[2] // (heads + 2 * kv_heads)
+        q_in, k_in, v_in = jnp.split(
+            q_in, [heads * D, (heads + kv_heads) * D], axis=2)
+    D = q_in.shape[2] // heads
 
     def split(t, nh):
         return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
 
-    q = split(q_in, heads)
-    k = jnp.repeat(split(k_in, kv_heads), heads // kv_heads, axis=1)
-    v = jnp.repeat(split(v_in, kv_heads), heads // kv_heads, axis=1)
+    q, k_new, v_new = split(q_in, heads), split(k_in, kv_heads), \
+        split(v_in, kv_heads)
     scale = _attn_scale(node, D)
-    kv_mask = None
+    rep = heads // kv_heads
     if seqlens_k is not None:
-        # seqlens_k[b] = valid key count - 1 (ORT contrib spec)
-        kv_mask = (jnp.arange(S)[None, :]
-                   <= seqlens_k.astype(jnp.int32).reshape(-1)[:, None])
-    # GQA is causal by construction in ORT's decoder graphs
-    out = _attention_core(q, k, v, kv_mask, True, scale)
-    return out.transpose(0, 2, 1, 3).reshape(B, S, Hq)
+        # seqlens_k[b] = total valid key count (past + new) - 1
+        last = seqlens_k.astype(jnp.int32).reshape(-1)      # (B,)
+    else:
+        last = jnp.full((B,), S - 1, jnp.int32)
+    past_len = last + 1 - S                                  # (B,)
+    if do_rotary:
+        pos = past_len[:, None] + jnp.arange(S)[None, :]     # (B, S)
+        q = _apply_rope4(q, pos, cos_cache, sin_cache, interleaved)
+        k_new = _apply_rope4(k_new, pos, cos_cache, sin_cache, interleaved)
+
+    if past_k is not None:
+        # decode: write the new chunk into the static cache buffer
+        S_max = past_k.shape[2]
+
+        def write(buf, chunk, start):
+            return jax.lax.dynamic_update_slice(buf, chunk, (0, start, 0))
+
+        present_k = jax.vmap(write)(past_k, k_new, past_len)
+        present_v = jax.vmap(write)(past_v, v_new, past_len)
+        k = jnp.repeat(present_k, rep, axis=1)
+        v = jnp.repeat(present_v, rep, axis=1)
+        # query i (absolute position past_len+i) sees keys j <= past_len+i
+        mask = (jnp.arange(S_max)[None, None, None, :]
+                <= (past_len[:, None, None, None]
+                    + jnp.arange(S)[None, None, :, None]))
+        out = _dense_masked_attn(q, k, v, mask, scale, softcap, smooth)
+    else:
+        present_k, present_v = k_new, v_new
+        k = jnp.repeat(k_new, rep, axis=1)
+        v = jnp.repeat(v_new, rep, axis=1)
+        if softcap or smooth:
+            mask = ((jnp.arange(S)[None, None, None, :]
+                     <= last[:, None, None, None])
+                    & (jnp.arange(S)[None, None, :, None]
+                       >= jnp.arange(S)[None, None, None, :]))
+            out = _dense_masked_attn(q, k, v, mask, scale, softcap, smooth)
+        else:
+            kv_mask = jnp.arange(S)[None, :] <= last[:, None]
+            # GQA is causal by construction in ORT's decoder graphs
+            out = _attention_core(q, k, v, kv_mask, True, scale)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, heads * D)
+    if len(node.output) > 1:
+        return out, present_k, present_v
+    return out
 
 
 @register_op("Attention")

@@ -578,3 +578,299 @@ def test_gqa_seqlens_and_std_attention_pair_mask():
     p2 = np.exp(s2 - s2.max(-1, keepdims=True)); p2 /= p2.sum(-1, keepdims=True)
     want2 = np.einsum("bhqk,bhkd->bhqd", p2, q4)
     np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-4)
+
+
+def _np_gqa_full(q2, k2, v2, Hq, Hkv, valid_last):
+    """Dense numpy GQA over the full sequence with per-batch valid length
+    (keys j <= valid_last[b]) and causal masking — the oracle."""
+    B, S, _ = q2.shape
+    D = q2.shape[2] // Hq
+
+    def sh(t, nh):
+        return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+
+    qh = sh(q2, Hq)
+    kh = np.repeat(sh(k2, Hkv), Hq // Hkv, 1)
+    vh = np.repeat(sh(v2, Hkv), Hq // Hkv, 1)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    kvm = np.arange(S)[None, :] <= np.asarray(valid_last)[:, None]
+    s = np.where(kvm[:, None, None, :], s, -1e30)
+    s = np.where(np.tril(np.ones((S, S), bool))[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, Hq * D)
+
+
+def test_gqa_kv_cache_decode_matches_full_prefill():
+    """Decode form: one new token + static past buffers must reproduce the
+    last row of full-sequence attention, and the present outputs must carry
+    the updated cache."""
+    rng = np.random.default_rng(7)
+    B, Hq, Hkv, D, S_max = 2, 4, 2, 4, 8
+    S_past, S_new = 5, 1
+    S_tot = S_past + S_new
+    q_all = rng.normal(0, 1, (B, S_tot, Hq * D)).astype(np.float32)
+    k_all = rng.normal(0, 1, (B, S_tot, Hkv * D)).astype(np.float32)
+    v_all = rng.normal(0, 1, (B, S_tot, Hkv * D)).astype(np.float32)
+    want_full = _np_gqa_full(q_all, k_all, v_all, Hq, Hkv,
+                             [S_tot - 1] * B)
+
+    def heads(t, nh):
+        return t.reshape(B, S_tot, nh, D).transpose(0, 2, 1, 3)
+
+    # static cache buffers: valid rows 0..S_past-1, garbage beyond
+    past_k = np.full((B, Hkv, S_max, D), 1e3, np.float32)
+    past_v = np.full((B, Hkv, S_max, D), -1e3, np.float32)
+    past_k[:, :, :S_past] = heads(k_all, Hkv)[:, :, :S_past]
+    past_v[:, :, :S_past] = heads(v_all, Hkv)[:, :, :S_past]
+    seqlens = np.full(B, S_tot - 1, np.int32)   # total valid - 1
+    total = np.array(S_tot, np.int32)
+
+    g = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "k", "v", "pk", "pv", "sl", "tl"],
+                   ["y", "ok", "ov"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv)],
+        "t",
+        [make_tensor_value_info("q", np.float32, [B, S_new, Hq * D]),
+         make_tensor_value_info("k", np.float32, [B, S_new, Hkv * D]),
+         make_tensor_value_info("v", np.float32, [B, S_new, Hkv * D]),
+         make_tensor_value_info("pk", np.float32, [B, Hkv, S_max, D]),
+         make_tensor_value_info("pv", np.float32, [B, Hkv, S_max, D]),
+         make_tensor_value_info("sl", np.int32, [B]),
+         make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, []),
+         make_tensor_value_info("ok", np.float32, []),
+         make_tensor_value_info("ov", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = cm(cm.params, {
+        "q": q_all[:, S_past:], "k": k_all[:, S_past:],
+        "v": v_all[:, S_past:], "pk": past_k, "pv": past_v,
+        "sl": seqlens, "tl": total})
+    np.testing.assert_allclose(np.asarray(got["y"])[:, 0],
+                               want_full[:, S_past], rtol=1e-4, atol=1e-4)
+    # present caches: new row written in place at position S_past,
+    # earlier rows untouched, buffer shape static
+    ok = np.asarray(got["ok"])
+    assert ok.shape == (B, Hkv, S_max, D)
+    np.testing.assert_allclose(ok[:, :, :S_past], past_k[:, :, :S_past])
+    np.testing.assert_allclose(
+        ok[:, :, S_past],
+        heads(k_all, Hkv)[:, :, S_past], rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_packed_qkv_and_softcap():
+    rng = np.random.default_rng(8)
+    B, Hq, Hkv, D, S = 2, 4, 2, 4, 6
+    packed = rng.normal(0, 1, (B, S, (Hq + 2 * Hkv) * D)).astype(np.float32)
+    seqlens = np.full(B, S - 1, np.int32)
+    g = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "", "", "", "", "sl", "tl"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv,
+                   softcap=30.0)],
+        "t", [make_tensor_value_info("q", np.float32, list(packed.shape)),
+              make_tensor_value_info("sl", np.int32, [B]),
+              make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": packed, "sl": seqlens,
+                                    "tl": np.array(S, np.int32)})["y"])
+    q2 = packed[:, :, :Hq * D]
+    k2 = packed[:, :, Hq * D:(Hq + Hkv) * D]
+    v2 = packed[:, :, (Hq + Hkv) * D:]
+    # exact capped oracle: a deliberately small cap (value 2.0 below would
+    # be wrong for a real model but makes an uncapped implementation fail
+    # this assert by a wide margin)
+    want = _np_gqa_capped(q2, k2, v2, Hq, Hkv, softcap=30.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    assert got.shape == (B, S, Hq * D)
+
+    # tight cap: uncapped vs capped differ grossly, pinning the tanh math
+    g3 = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "", "", "", "", "sl", "tl"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv,
+                   softcap=0.5)],
+        "t", [make_tensor_value_info("q", np.float32, list(packed.shape)),
+              make_tensor_value_info("sl", np.int32, [B]),
+              make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm3 = convert_model(make_model(g3))
+    got3 = np.asarray(cm3(cm3.params, {"q": packed, "sl": seqlens,
+                                       "tl": np.array(S, np.int32)})["y"])
+    want3 = _np_gqa_capped(q2, k2, v2, Hq, Hkv, softcap=0.5)
+    np.testing.assert_allclose(got3, want3, rtol=1e-4, atol=1e-4)
+    uncapped = _np_gqa_full(q2, k2, v2, Hq, Hkv, [S - 1] * B)
+    assert np.abs(got3 - uncapped).max() > 1e-3   # the cap actually bites
+
+
+def _np_gqa_capped(q2, k2, v2, Hq, Hkv, softcap, smooth=False):
+    B, S, _ = q2.shape
+    D = q2.shape[2] // Hq
+
+    def sh(t, nh):
+        return t.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+
+    qh = sh(q2, Hq)
+    kh = np.repeat(sh(k2, Hkv), Hq // Hkv, 1)
+    vh = np.repeat(sh(v2, Hkv), Hq // Hkv, 1)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    if softcap:
+        s = softcap * np.tanh(s / softcap)
+    s = np.where(np.tril(np.ones((S, S), bool))[None, None], s, -1e30)
+    e = np.exp(s)
+    denom = e.sum(-1, keepdims=True) + (1.0 if smooth else 0.0)
+    p = e / denom
+    out = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, Hq * D)
+
+
+def test_gqa_smooth_softmax():
+    """smooth_softmax=1: ORT's implicit extra zero logit in the softmax
+    denominator (Phi-3-style graphs)."""
+    rng = np.random.default_rng(11)
+    B, Hq, Hkv, D, S = 2, 2, 1, 4, 5
+    q2 = rng.normal(0, 1, (B, S, Hq * D)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    seqlens = np.full(B, S - 1, np.int32)
+    g = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "k", "v", "", "", "sl", "tl"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv,
+                   smooth_softmax=1)],
+        "t", [make_tensor_value_info("q", np.float32, list(q2.shape)),
+              make_tensor_value_info("k", np.float32, list(k2.shape)),
+              make_tensor_value_info("v", np.float32, list(v2.shape)),
+              make_tensor_value_info("sl", np.int32, [B]),
+              make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q2, "k": k2, "v": v2,
+                                    "sl": seqlens,
+                                    "tl": np.array(S, np.int32)})["y"])
+    want = _np_gqa_capped(q2, k2, v2, Hq, Hkv, softcap=0.0, smooth=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    plain = _np_gqa_capped(q2, k2, v2, Hq, Hkv, softcap=0.0, smooth=False)
+    assert np.abs(got - plain).max() > 1e-3
+
+
+def test_std_attention_softcap():
+    rng = np.random.default_rng(12)
+    B, H, S, D = 1, 2, 5, 4
+    q = rng.normal(0, 2, (B, H, S, D)).astype(np.float32)
+    g = make_graph(
+        [make_node("Attention", ["q", "q", "q"], ["y"], softcap=0.7)],
+        "t", [make_tensor_value_info("q", np.float32, list(q.shape))],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q})["y"])
+    s = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(D)
+    s = 0.7 * np.tanh(s / 0.7)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, q)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_rotary_fused():
+    """do_rotary=1: q/k rotate at absolute positions before attention —
+    must equal a separate RotaryEmbedding + plain GQA pipeline."""
+    rng = np.random.default_rng(9)
+    B, Hq, Hkv, D, S = 1, 2, 1, 8, 5
+    q2 = rng.normal(0, 1, (B, S, Hq * D)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    max_pos, half = 16, D // 2
+    inv = 1.0 / (10000.0 ** (np.arange(half) / half))
+    ang = np.arange(max_pos)[:, None] * inv[None, :]
+    cos_c = np.cos(ang).astype(np.float32)
+    sin_c = np.sin(ang).astype(np.float32)
+    seqlens = np.full(B, S - 1, np.int32)
+    g = make_graph(
+        [make_node("GroupQueryAttention",
+                   ["q", "k", "v", "", "", "sl", "tl", "cc", "sc"], ["y"],
+                   domain="com.microsoft", num_heads=Hq, kv_num_heads=Hkv,
+                   do_rotary=1)],
+        "t", [make_tensor_value_info("q", np.float32, list(q2.shape)),
+              make_tensor_value_info("k", np.float32, list(k2.shape)),
+              make_tensor_value_info("v", np.float32, list(v2.shape)),
+              make_tensor_value_info("sl", np.int32, [B]),
+              make_tensor_value_info("tl", np.int32, [])],
+        [make_tensor_value_info("y", np.float32, [])],
+        initializers={"cc": cos_c, "sc": sin_c})
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q2, "k": k2, "v": v2,
+                                    "sl": seqlens,
+                                    "tl": np.array(S, np.int32)})["y"])
+
+    def rope(t2, nh):
+        t = t2.reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+        cos = cos_c[np.arange(S)][None, None]
+        sin = sin_c[np.arange(S)][None, None]
+        x0, x1 = t[..., :half], t[..., half:]
+        return np.concatenate([x0 * cos - x1 * sin,
+                               x0 * sin + x1 * cos], -1) \
+            .transpose(0, 2, 1, 3).reshape(B, S, nh * D)
+
+    want = _np_gqa_full(rope(q2, Hq), rope(k2, Hkv), v2, Hq, Hkv,
+                        [S - 1] * B)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_std_attention_3d_layout_and_past():
+    rng = np.random.default_rng(10)
+    B, H, D, S, Sp = 2, 2, 4, 3, 4
+    # 3-D layout with q_num_heads/kv_num_heads attributes
+    q3 = rng.normal(0, 1, (B, S, H * D)).astype(np.float32)
+    k3 = rng.normal(0, 1, (B, S, H * D)).astype(np.float32)
+    v3 = rng.normal(0, 1, (B, S, H * D)).astype(np.float32)
+    g = make_graph(
+        [make_node("Attention", ["q", "k", "v"], ["y"],
+                   q_num_heads=H, kv_num_heads=H)],
+        "t", [make_tensor_value_info(n, np.float32, list(t.shape))
+              for n, t in [("q", q3), ("k", k3), ("v", v3)]],
+        [make_tensor_value_info("y", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"q": q3, "k": k3, "v": v3})["y"])
+    assert got.shape == (B, S, H * D)
+
+    def sh(t):
+        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    s = np.einsum("bhqd,bhkd->bhqk", sh(q3), sh(k3)) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, sh(v3)) \
+        .transpose(0, 2, 1, 3).reshape(B, S, H * D)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # 4-D with past_key/past_value: present = concat(past, current)
+    q4 = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
+    k4 = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
+    v4 = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
+    pk = rng.normal(0, 1, (B, H, Sp, D)).astype(np.float32)
+    pv = rng.normal(0, 1, (B, H, Sp, D)).astype(np.float32)
+    g2 = make_graph(
+        [make_node("Attention", ["q", "k", "v", "", "pk", "pv"],
+                   ["y", "ck", "cv"])],
+        "t", [make_tensor_value_info(n, np.float32, list(t.shape))
+              for n, t in [("q", q4), ("k", k4), ("v", v4),
+                           ("pk", pk), ("pv", pv)]],
+        [make_tensor_value_info("y", np.float32, []),
+         make_tensor_value_info("ck", np.float32, []),
+         make_tensor_value_info("cv", np.float32, [])])
+    cm2 = convert_model(make_model(g2))
+    got2 = cm2(cm2.params, {"q": q4, "k": k4, "v": v4, "pk": pk, "pv": pv})
+    kc = np.concatenate([pk, k4], axis=2)
+    vc = np.concatenate([pv, v4], axis=2)
+    s2 = np.einsum("bhqd,bhkd->bhqk", q4, kc) / np.sqrt(D)
+    p2 = np.exp(s2 - s2.max(-1, keepdims=True))
+    p2 /= p2.sum(-1, keepdims=True)
+    want2 = np.einsum("bhqk,bhkd->bhqd", p2, vc)
+    np.testing.assert_allclose(np.asarray(got2["y"]), want2,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got2["ck"]), kc, rtol=1e-6,
+                               atol=1e-6)
