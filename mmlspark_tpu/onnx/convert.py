@@ -499,9 +499,10 @@ def _std_attention(node, inputs, ctx):
                                 "q_num_heads/kv_num_heads")
         B, Sq, HD = q.shape
         D = HD // qnh
+        Dv = v.shape[2] // kvnh      # spec allows v_head_size != head_size
         q = q.reshape(B, Sq, qnh, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, k.shape[1], kvnh, D).transpose(0, 2, 1, 3)
-        v = v.reshape(B, v.shape[1], kvnh, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, v.shape[1], kvnh, Dv).transpose(0, 2, 1, 3)
     elif q.ndim != 4:
         raise UnsupportedOp(f"ai.onnx Attention rank-{q.ndim} inputs")
     if past_k is not None:
@@ -549,8 +550,8 @@ def _std_attention(node, inputs, ctx):
         out = _attention_core(q, k, v, None, causal, scale,
                               pair_mask=pair_mask)
     if three_d:
-        B, _, Sq, D = out.shape
-        out = out.transpose(0, 2, 1, 3).reshape(B, Sq, Hq * D)
+        B, _, Sq, Do = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(B, Sq, Hq * Do)
     if len(node.output) > 1:
         return out, present_k, present_v
     return out
@@ -583,14 +584,24 @@ def _apply_rope4(x, pos, cos_cache, sin_cache, interleaved):
 
 def _dense_masked_attn(q, k, v, mask, scale, softcap=0.0,
                        smooth_softmax=False):
-    """(B, H, Sq, D) × (B, H, Sk, D) attention with a (B, 1|H, Sq, Sk)
+    """(B, Hq, Sq, D) × (B, Hkv, Sk, D) attention with a (B, 1|H, Sq, Sk)
     boolean mask, optional logit softcapping, and optional ORT
     smooth-softmax (an implicit extra zero logit in the denominator) —
-    the decode-phase path where Sq is tiny and flash brings nothing."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    the decode-phase path where Sq is tiny and flash brings nothing.
+
+    GQA (Hkv < Hq) runs as a GROUPED einsum over (group, rep) head axes —
+    the KV cache is never materialized ``rep`` times, which is the whole
+    point of an in-place static cache on the decode hot path."""
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    rep = Hq // Hkv
+    qg = q.reshape(B, Hkv, rep, Sq, D)
+    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
     if softcap:
         s = softcap * jnp.tanh(s / softcap)
+    if mask.ndim == 4:
+        mask = mask[:, :, None]          # (B, 1|Hkv, 1, Sq, Sk)
     s = jnp.where(mask, s, jnp.float32(-1e30))
     if smooth_softmax:
         # softmax_i = exp(s_i) / (1 + Σ exp(s_j)): stabilize against
@@ -601,7 +612,8 @@ def _dense_masked_attn(q, k, v, mask, scale, softcap=0.0,
             .astype(v.dtype)
     else:
         p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    out = jnp.einsum("bgrqk,bgkd->bgrqd", p, v)
+    return out.reshape(B, Hq, Sq, v.shape[-1])
 
 
 @register_op("GroupQueryAttention")
@@ -657,7 +669,10 @@ def _gqa(node, inputs, ctx):
         last = seqlens_k.astype(jnp.int32).reshape(-1)      # (B,)
     else:
         last = jnp.full((B,), S - 1, jnp.int32)
-    past_len = last + 1 - S                                  # (B,)
+    # clamped at 0: a right-padded prefill row (valid < S) has its new
+    # tokens at positions 0..valid-1 with the tail masked by `last`, NOT at
+    # negative positions — matching ORT's slot-i-is-position-i prefill
+    past_len = jnp.maximum(last + 1 - S, 0)                  # (B,)
     if do_rotary:
         pos = past_len[:, None] + jnp.arange(S)[None, :]     # (B, S)
         q = _apply_rope4(q, pos, cos_cache, sin_cache, interleaved)
@@ -672,24 +687,25 @@ def _gqa(node, inputs, ctx):
 
         present_k = jax.vmap(write)(past_k, k_new, past_len)
         present_v = jax.vmap(write)(past_v, v_new, past_len)
-        k = jnp.repeat(present_k, rep, axis=1)
-        v = jnp.repeat(present_v, rep, axis=1)
         # query i (absolute position past_len+i) sees keys j <= past_len+i
+        # (grouped attention: the cache is NOT repeated across q heads)
         mask = (jnp.arange(S_max)[None, None, None, :]
                 <= (past_len[:, None, None, None]
                     + jnp.arange(S)[None, None, :, None]))
-        out = _dense_masked_attn(q, k, v, mask, scale, softcap, smooth)
+        out = _dense_masked_attn(q, present_k, present_v, mask, scale,
+                                 softcap, smooth)
     else:
         present_k, present_v = k_new, v_new
-        k = jnp.repeat(k_new, rep, axis=1)
-        v = jnp.repeat(v_new, rep, axis=1)
         if softcap or smooth:
             mask = ((jnp.arange(S)[None, None, None, :]
                      <= last[:, None, None, None])
                     & (jnp.arange(S)[None, None, :, None]
                        >= jnp.arange(S)[None, None, None, :]))
-            out = _dense_masked_attn(q, k, v, mask, scale, softcap, smooth)
+            out = _dense_masked_attn(q, k_new, v_new, mask, scale,
+                                     softcap, smooth)
         else:
+            k = jnp.repeat(k_new, rep, axis=1)
+            v = jnp.repeat(v_new, rep, axis=1)
             kv_mask = jnp.arange(S)[None, :] <= last[:, None]
             # GQA is causal by construction in ORT's decoder graphs
             out = _attention_core(q, k, v, kv_mask, True, scale)

@@ -112,6 +112,59 @@ def main():
                           "sp": int(mesh.shape["sp"]), **results,
                           "platform": jax.default_backend()}), flush=True)
 
+        # --- backward: the flash bwd kernels vs XLA-differentiated dense.
+        # (round-3 verdict: the bwd kernels had only ever run in interpret
+        # mode; this times them on whatever backend is live.)
+        if os.environ.get("BENCH_GRADS", "1") != "1":
+            continue
+        bwd, full_grads = {}, None
+        for impl in impls:
+            if impl not in ("full", "flash"):
+                continue
+            try:
+                base = (local_attention if impl == "full"
+                        else (lambda a, b, c: flash_attention(a, b, c)))
+
+                def loss(a, b, c, _f=base):
+                    return jnp.sum(_f(a, b, c).astype(jnp.float32))
+
+                gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                args = [jax.device_put(x) for x in (q, k, v)]
+                gs = gfn(*args)                      # the one compile
+                float(jnp.sum(gs[0][0, 0, 0, :2].astype(jnp.float32)))
+                reps = 3
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    gs = gfn(*args)
+                # fetched scalar depending on the LAST dispatch fences all
+                float(jnp.sum(gs[2][0, 0, -1, :2].astype(jnp.float32)))
+                bwd[impl] = round(
+                    (time.perf_counter() - t0) / reps * 1e3, 2)
+                if impl == "full":
+                    full_grads = [np.asarray(g) for g in gs]
+                elif full_grads is not None:
+                    # accuracy is a SEPARATE verdict: a tolerance miss must
+                    # not clobber a valid hardware timing with an "error:"
+                    # string indistinguishable from a crash
+                    try:
+                        for g, fg in zip(gs, full_grads):
+                            np.testing.assert_allclose(
+                                np.asarray(g), fg, rtol=5e-3, atol=5e-3)
+                        bwd[f"{impl}_grad_match"] = True
+                    except AssertionError as e:
+                        bwd[f"{impl}_grad_match"] = False
+                        bwd[f"{impl}_grad_diff"] = \
+                            (str(e).splitlines() or [""])[0][:80]
+            except Exception as e:
+                msg = (str(e).splitlines() or [repr(e)])[0][:80]
+                bwd[impl] = f"error: {msg}"
+        if bwd:
+            print(json.dumps({"metric": "long_context_attention_bwd_ms",
+                              "seq_len": S, "heads": H, "head_dim": D,
+                              **bwd,
+                              "platform": jax.default_backend()}),
+                  flush=True)
+
 
 if __name__ == "__main__":
     main()

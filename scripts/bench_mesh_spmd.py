@@ -1,0 +1,80 @@
+"""On-chip SPMD check: ONNXModel ``mesh_sharded`` mode vs plain mode.
+
+Round-3 verdict item 8: the mesh-mode SPMD path had only ever executed on
+the virtual 8-CPU mesh; running it on a 1-device mesh on the REAL chip
+retires its compile risk (GSPMD partitioning + sharding annotations compile
+for the TPU target even when the mesh is trivial). Multi-device correctness
+stays pinned by the CPU-mesh tests; this records mesh-mode img/s ≈
+non-mesh img/s on hardware. One JSON line.
+
+Parity anchor: the reference's per-partition ORT session placement
+(``deep-learning/.../onnx/ONNXModel.scala:293-303``); here placement is a
+``jax.sharding`` annotation over a Mesh instead of a device id.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.onnx_model import ONNXModel
+    from mmlspark_tpu.models.zoo.resnet import ResNetConfig, \
+        export_resnet_onnx
+    from mmlspark_tpu.parallel.mesh import MeshContext
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    rng = np.random.default_rng(0)
+    cfg = ResNetConfig([2, 2, 2, 2], num_classes=200)
+    model_bytes = export_resnet_onnx(cfg, seed=0)
+
+    X = rng.integers(0, 256, (batch * 2, 64, 64, 3), dtype=np.uint8)
+    col = np.empty(len(X), dtype=object)
+    for i in range(len(X)):
+        col[i] = X[i]
+    df = DataFrame({"image": col})
+
+    def build(mesh_sharded):
+        return ONNXModel(model_bytes,
+                         feed_dict={"input": "image"},
+                         fetch_dict={"logits": "logits"},
+                         argmax_dict={"pred": "logits"},
+                         transpose_dict={"input": [0, 3, 1, 2]},
+                         mini_batch_size=batch,
+                         compute_dtype="bfloat16",
+                         mesh_sharded=mesh_sharded)
+
+    def timed_ips(m, ctx):
+        with ctx:
+            m.transform(df.head(batch))        # compile + first transfer
+            t0 = time.perf_counter()
+            out = m.transform(df)
+            # DataFrame.transform materializes host-side numpy — the
+            # fetch IS the fence
+            assert len(out) == len(X)
+            return round(len(X) / (time.perf_counter() - t0), 2)
+
+    import contextlib
+    plain_ips = timed_ips(build(False), contextlib.nullcontext())
+    mesh_ips = timed_ips(build(True), MeshContext({"data": -1}))
+
+    d = jax.devices()[0]
+    print(json.dumps({
+        "metric": "onnx_mesh_spmd_images_per_sec",
+        "plain_ips": plain_ips,
+        "mesh_ips": mesh_ips,
+        "ratio": round(mesh_ips / plain_ips, 3) if plain_ips else None,
+        "n_devices": len(jax.devices()),
+        "platform": d.platform, "device": d.device_kind}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -874,3 +874,44 @@ def test_std_attention_3d_layout_and_past():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(got2["ck"]), kc, rtol=1e-6,
                                atol=1e-6)
+
+
+def test_gqa_right_padded_prefill_positions():
+    """Right-padded prefill (valid < S): new tokens sit at positions
+    0..valid-1 with the tail masked — rope positions must NOT go negative
+    and the padded row must match a shorter unpadded run."""
+    rng = np.random.default_rng(13)
+    B, Hq, Hkv, D, S, valid = 1, 2, 1, 8, 6, 4
+    q2 = rng.normal(0, 1, (B, S, Hq * D)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, Hkv * D)).astype(np.float32)
+    max_pos, half = 16, D // 2
+    inv = 1.0 / (10000.0 ** (np.arange(half) / half))
+    ang = np.arange(max_pos)[:, None] * inv[None, :]
+    cos_c = np.cos(ang).astype(np.float32)
+    sin_c = np.sin(ang).astype(np.float32)
+
+    def run(S_in, q_, k_, v_, valid_):
+        g = make_graph(
+            [make_node("GroupQueryAttention",
+                       ["q", "k", "v", "", "", "sl", "tl", "cc", "sc"],
+                       ["y"], domain="com.microsoft", num_heads=Hq,
+                       kv_num_heads=Hkv, do_rotary=1)],
+            "t", [make_tensor_value_info("q", np.float32, list(q_.shape)),
+                  make_tensor_value_info("k", np.float32, list(k_.shape)),
+                  make_tensor_value_info("v", np.float32, list(v_.shape)),
+                  make_tensor_value_info("sl", np.int32, [B]),
+                  make_tensor_value_info("tl", np.int32, [])],
+            [make_tensor_value_info("y", np.float32, [])],
+            initializers={"cc": cos_c, "sc": sin_c})
+        cm = convert_model(make_model(g))
+        return np.asarray(cm(cm.params, {
+            "q": q_, "k": k_, "v": v_,
+            "sl": np.full(B, valid_ - 1, np.int32),
+            "tl": np.array(S_in, np.int32)})["y"])
+
+    padded = run(S, q2, k2, v2, valid)
+    short = run(valid, q2[:, :valid], k2[:, :valid], v2[:, :valid], valid)
+    # the first `valid` rows of the padded run == the unpadded short run
+    np.testing.assert_allclose(padded[:, :valid], short, rtol=1e-4,
+                               atol=1e-4)
