@@ -447,16 +447,19 @@ def _rotary_embedding(node, inputs, ctx):
 @register_op("MultiHeadAttention")
 def _msft_mha(node, inputs, ctx):
     """com.microsoft MultiHeadAttention: separate (B, S, H) q/k/v inputs.
-    Supported surface: no past/attention_bias, optional packed bias,
-    key_padding_mask as (B, S_kv) 0/1 or (B,) lengths."""
+    Supported surface: optional packed bias, key_padding_mask as (B, S_kv)
+    0/1 or (B,) lengths, additive attention_bias, and past_key/past_value
+    concatenated along the sequence axis (present outputs carry the grown
+    cache — MHA's spec is concat-grow, unlike GQA's static buffers)."""
     if node.domain != "com.microsoft":
         raise UnsupportedOp(
             f"MultiHeadAttention in domain {node.domain!r}")
     q_in, k_in, v_in = inputs[0], inputs[1], inputs[2]
     bias = inputs[3] if len(inputs) > 3 else None
     mask_index = inputs[4] if len(inputs) > 4 else None
-    if any(i is not None for i in inputs[5:]):
-        raise UnsupportedOp("MultiHeadAttention with attention_bias/past")
+    attn_bias = inputs[5] if len(inputs) > 5 else None
+    past_k = inputs[6] if len(inputs) > 6 else None
+    past_v = inputs[7] if len(inputs) > 7 else None
     if k_in.ndim != 3 or v_in.ndim != 3:
         raise UnsupportedOp("MultiHeadAttention packed/5-D KV layouts")
     heads = node.attr("num_heads")
@@ -473,11 +476,24 @@ def _msft_mha(node, inputs, ctx):
         return t.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
 
     q, k, v = split(q_in, Sq), split(k_in, Sk), split(v_in, Sk)
+    if past_k is not None:
+        k = jnp.concatenate([past_k, k], axis=2)
+        v = jnp.concatenate([past_v, v], axis=2)
+        Sk = k.shape[2]
+    present_k, present_v = k, v
     scale = _attn_scale(node, D)
     kv_mask = _decode_mask_index(mask_index, B, Sk, "MultiHeadAttention")
     causal = bool(node.attr("unidirectional", 0))
-    out = _attention_core(q, k, v, kv_mask, causal, scale)
-    return out.transpose(0, 2, 1, 3).reshape(B, Sq, H)
+    if attn_bias is not None:
+        out = _dense_masked_attn(q, k, v, _qk_valid_mask(Sq, Sk, kv_mask,
+                                                         causal),
+                                 scale, bias=attn_bias)
+    else:
+        out = _attention_core(q, k, v, kv_mask, causal, scale)
+    out = out.transpose(0, 2, 1, 3).reshape(B, Sq, H)
+    if len(node.output) > 1:
+        return out, present_k, present_v
+    return out
 
 
 def _std_attention(node, inputs, ctx):
@@ -557,6 +573,20 @@ def _std_attention(node, inputs, ctx):
     return out
 
 
+def _qk_valid_mask(Sq, Sk, kv_mask, causal):
+    """(1|B, 1, Sq, Sk) boolean validity mask from the shared ORT
+    conventions: optional (B, Sk) key-padding mask, causal diagonal
+    end-aligned to the key sequence (same convention as
+    :func:`_attention_core`)."""
+    mask = jnp.ones((1, 1, Sq, Sk), bool)
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, :]
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((Sq, Sk), bool),
+                               k=Sk - Sq)[None, None]
+    return mask
+
+
 def _rope_rotate(xr, cos, sin, interleaved):
     """The rotation core shared by RotaryEmbedding and fused-attention
     rotary: ``xr`` (..., rot_dim) with broadcastable half-dim cos/sin."""
@@ -583,7 +613,7 @@ def _apply_rope4(x, pos, cos_cache, sin_cache, interleaved):
 
 
 def _dense_masked_attn(q, k, v, mask, scale, softcap=0.0,
-                       smooth_softmax=False):
+                       smooth_softmax=False, bias=None):
     """(B, Hq, Sq, D) × (B, Hkv, Sk, D) attention with a (B, 1|H, Sq, Sk)
     boolean mask, optional logit softcapping, and optional ORT
     smooth-softmax (an implicit extra zero logit in the denominator) —
@@ -598,6 +628,12 @@ def _dense_masked_attn(q, k, v, mask, scale, softcap=0.0,
     qg = q.reshape(B, Hkv, rep, Sq, D)
     s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        # additive attention_bias (B|1, H|1, Sq, Sk), ORT semantics: added
+        # to the scaled scores before masking/softmax
+        bb = jnp.broadcast_to(bias, (bias.shape[0], Hq, Sq, s.shape[-1]))
+        s = s + bb.reshape(bias.shape[0], Hkv, rep, Sq, s.shape[-1]) \
+            .astype(jnp.float32)
     if softcap:
         s = softcap * jnp.tanh(s / softcap)
     if mask.ndim == 4:
@@ -729,8 +765,7 @@ def _msft_attention(node, inputs, ctx):
     mask_index = inputs[3] if len(inputs) > 3 else None
     if len(inputs) > 4 and inputs[4] is not None:
         raise UnsupportedOp("Attention with past state")
-    if len(inputs) > 5 and inputs[5] is not None:
-        raise UnsupportedOp("Attention with attention_bias / extra_add_qk")
+    attn_bias = inputs[5] if len(inputs) > 5 else None
     if node.attr("do_rotary", 0):
         raise UnsupportedOp("Attention with do_rotary (use a separate "
                             "RotaryEmbedding node)")
@@ -755,7 +790,13 @@ def _msft_attention(node, inputs, ctx):
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     scale = _attn_scale(node, D)
     kv_mask = _decode_mask_index(mask_index, B, S, "Attention")
-    ctx_out = _attention_core(q, k, v, kv_mask, causal, scale)
+    if attn_bias is not None:
+        # additive attention_bias / extra_add_qk (B|1, H|1, S, S)
+        ctx_out = _dense_masked_attn(q, k, v, _qk_valid_mask(S, S, kv_mask,
+                                                             causal),
+                                     scale, bias=attn_bias)
+    else:
+        ctx_out = _attention_core(q, k, v, kv_mask, causal, scale)
     return ctx_out.transpose(0, 2, 1, 3).reshape(B, S, hidden)
 
 

@@ -915,3 +915,78 @@ def test_gqa_right_padded_prefill_positions():
     # the first `valid` rows of the padded run == the unpadded short run
     np.testing.assert_allclose(padded[:, :valid], short, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_mha_attention_bias_and_past():
+    """com.microsoft MultiHeadAttention: additive attention_bias plus
+    concat-grow past_key/past_value with present outputs."""
+    rng = np.random.default_rng(14)
+    B, H, D, S, Sp = 2, 2, 4, 3, 2
+    hid = H * D
+    q2 = rng.normal(0, 1, (B, S, hid)).astype(np.float32)
+    k2 = rng.normal(0, 1, (B, S, hid)).astype(np.float32)
+    v2 = rng.normal(0, 1, (B, S, hid)).astype(np.float32)
+    ab = rng.normal(0, 1, (1, H, S, Sp + S)).astype(np.float32)
+    pk = rng.normal(0, 1, (B, H, Sp, D)).astype(np.float32)
+    pv = rng.normal(0, 1, (B, H, Sp, D)).astype(np.float32)
+    g = make_graph(
+        [make_node("MultiHeadAttention",
+                   ["q", "k", "v", "", "", "ab", "pk", "pv"],
+                   ["y", "ok", "ov"],
+                   domain="com.microsoft", num_heads=H)],
+        "t", [make_tensor_value_info(n, np.float32, list(t.shape))
+              for n, t in [("q", q2), ("k", k2), ("v", v2), ("ab", ab),
+                           ("pk", pk), ("pv", pv)]],
+        [make_tensor_value_info("y", np.float32, []),
+         make_tensor_value_info("ok", np.float32, []),
+         make_tensor_value_info("ov", np.float32, [])])
+    cm = convert_model(make_model(g))
+    got = cm(cm.params, {"q": q2, "k": k2, "v": v2, "ab": ab,
+                         "pk": pk, "pv": pv})
+
+    def sh(t):
+        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    kc = np.concatenate([pk, sh(k2)], axis=2)
+    vc = np.concatenate([pv, sh(v2)], axis=2)
+    s = np.einsum("bhqd,bhkd->bhqk", sh(q2), kc) / np.sqrt(D) + ab
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, vc) \
+        .transpose(0, 2, 1, 3).reshape(B, S, hid)
+    np.testing.assert_allclose(np.asarray(got["y"]), want, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got["ok"]), kc, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_attention_extra_add_qk():
+    """ORT fused Attention with the additive attention_bias (extra_add_qk)
+    input — relative-position-bias graphs (T5-style exports)."""
+    rng = np.random.default_rng(15)
+    B, H, D, S = 1, 2, 4, 5
+    hid = H * D
+    x = rng.normal(0, 1, (B, S, hid)).astype(np.float32)
+    w = rng.normal(0, 0.3, (hid, 3 * hid)).astype(np.float32)
+    ab = rng.normal(0, 1, (1, H, S, S)).astype(np.float32)
+    g = make_graph(
+        [make_node("Attention", ["x", "w", "", "", "", "ab"], ["y"],
+                   domain="com.microsoft", num_heads=H)],
+        "t", [make_tensor_value_info("x", np.float32, list(x.shape)),
+              make_tensor_value_info("ab", np.float32, list(ab.shape))],
+        [make_tensor_value_info("y", np.float32, [])],
+        initializers={"w": w})
+    cm = convert_model(make_model(g))
+    got = np.asarray(cm(cm.params, {"x": x, "ab": ab})["y"])
+    qkv = x @ w
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def sh(t):
+        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    s = np.einsum("bhqd,bhkd->bhqk", sh(q), sh(k)) / np.sqrt(D) + ab
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, sh(v)) \
+        .transpose(0, 2, 1, 3).reshape(B, S, hid)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
