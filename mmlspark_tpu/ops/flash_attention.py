@@ -503,7 +503,9 @@ def flash_attention_with_stats(q, k, v, *, scale: Optional[float] = None,
     The stats let a caller merge partial attention results computed over
     disjoint key sets (log-sum-exp merge), which is exactly what ring
     attention does as K/V blocks rotate: see ``parallel/ring.ring_attention``
-    with ``use_flash=True``. Not differentiable (no VJP through the stats)."""
+    with ``use_flash=True``. This function itself has no VJP through the
+    stats — differentiate the MERGED result instead (ring_attention's
+    ring-level custom VJP does exactly that)."""
     B, H, S, D = q.shape
     if interpret is None:
         interpret = _auto_interpret()

@@ -43,21 +43,11 @@ def local_attention(q, k, v, scale: Optional[float] = None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v, preferred_element_type=v.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str, axis_size: int,
-                   scale: Optional[float] = None, use_flash: bool = False):
-    """SPMD ring attention over a sequence-sharded axis.
-
-    Args are local shards (B, H, S/n, D). Returns the local output shard.
-    Streaming-softmax accumulators are fp32; K/V rotate ``axis_size`` hops.
-
-    ``use_flash=True`` computes each hop's local attention with the Pallas
-    streaming kernel and merges the per-hop ``(o, l, m)`` stats (log-sum-exp
-    merge) — per-chip memory drops from O(S_local²) scores to O(S_local),
-    which is the ring-attention paper's actual memory claim. Forward-only
-    (the stats path has no VJP); the default einsum body stays for training.
-    """
-    d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+def _ring_fwd_impl(q, k, v, axis_name: str, axis_size: int, scale: float,
+                   use_flash: bool):
+    """The forward ring: returns (o_normalized, L) where L = m + log(l) is
+    the per-query GLOBAL logsumexp across every hop's keys — the residual
+    the backward pass needs to re-normalize per-hop probabilities."""
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     # accumulators must carry the same "varying over axis_name" type as the
@@ -102,7 +92,107 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
         return o, m, l, k_next, v_next
 
     o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
-    return (o / l[..., None]).astype(q.dtype)
+    return (o / l[..., None]).astype(q.dtype), m + jnp.log(l)
+
+
+def _pick_block(S: int, cap: int = 1024) -> int:
+    """Largest divisor of S not above cap (the bwd recompute block size)."""
+    b = min(cap, S)
+    while S % b:
+        b -= 1
+    return b
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring(q, k, v, axis_name, axis_size, scale, use_flash):
+    return _ring_fwd_impl(q, k, v, axis_name, axis_size, scale, use_flash)[0]
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, axis_size, scale, use_flash):
+    o, L = _ring_fwd_impl(q, k, v, axis_name, axis_size, scale, use_flash)
+    return o, (q, k, v, o, L)
+
+
+def _ring_vjp_bwd(axis_name, axis_size, scale, use_flash, res, do):
+    """Ring backward: a SECOND ring pass. Per hop, the per-chip gradient
+    contribution is recovered by the flash blockwise-recompute backward with
+    the GLOBAL stats substituted (m ← L, l ← 1, so p = exp(s·scale − L) is
+    already globally normalized); the dk/dv accumulators TRAVEL WITH their
+    K/V blocks, so after ``axis_size`` hops every block arrives home
+    carrying the sum of contributions from every query shard. This is the
+    ring-attention paper's backward schedule — O(S_local·block) transients,
+    never an S×S matrix."""
+    from ..ops.flash_attention import _fa_reference_block_bwd
+
+    q, k, v, o, L = res
+    B, H, S, D = q.shape
+    BH = B * H
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    # fp32 INPUTS to the hop backward: it casts its outputs back to the
+    # input dtype, so bf16 inputs would quantize every hop's contribution
+    # before the fp32 accumulation — growing error with ring size
+    qf = q.reshape(BH, S, D).astype(jnp.float32)
+    of = o.reshape(BH, S, D).astype(jnp.float32)
+    dof = do.reshape(BH, S, D).astype(jnp.float32)
+    Lf = L.reshape(BH, S)
+    ones_l = jnp.ones((BH, S), jnp.float32)
+    mask = jnp.ones((BH, S), jnp.int32)
+    hop_bwd = jax.vmap(functools.partial(
+        _fa_reference_block_bwd, causal=False, scale=scale,
+        block_k=_pick_block(S)))
+
+    var = lambda t: lax.pcast(t, (axis_name,), to='varying')
+    dq0 = var(jnp.zeros((BH, S, D), jnp.float32))
+    dk0 = var(jnp.zeros((BH, S, D), jnp.float32))
+    dv0 = var(jnp.zeros((BH, S, D), jnp.float32))
+
+    def body(i, carry):
+        dq, dk_acc, dv_acc, k_cur, v_cur = carry
+        # K/V rotate in their storage dtype (comm bandwidth); cast at use
+        dqh, dkh, dvh = hop_bwd(
+            qf, k_cur.reshape(BH, S, D).astype(jnp.float32),
+            v_cur.reshape(BH, S, D).astype(jnp.float32), mask, of, ones_l,
+            Lf, dof)
+        dq = dq + dqh.astype(jnp.float32)
+        dk_acc = dk_acc + dkh.astype(jnp.float32)
+        dv_acc = dv_acc + dvh.astype(jnp.float32)
+        # the accumulators rotate WITH the blocks they belong to
+        rot = lambda t: lax.ppermute(t, axis_name, perm)
+        return dq, rot(dk_acc), rot(dv_acc), rot(k_cur), rot(v_cur)
+
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, axis_size, body, (dq0, dk0, dv0, k, v))
+    shape = (B, H, S, D)
+    return (dq.reshape(shape).astype(q.dtype),
+            dk.reshape(shape).astype(k.dtype),
+            dv.reshape(shape).astype(v.dtype))
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str, axis_size: int,
+                   scale: Optional[float] = None, use_flash: bool = False):
+    """SPMD ring attention over a sequence-sharded axis.
+
+    Args are local shards (B, H, S/n, D). Returns the local output shard.
+    Streaming-softmax accumulators are fp32; K/V rotate ``axis_size`` hops.
+
+    ``use_flash=True`` computes each hop's local attention with the Pallas
+    streaming kernel and merges the per-hop ``(o, l, m)`` stats (log-sum-exp
+    merge) — per-chip memory drops from O(S_local²) scores to O(S_local),
+    which is the ring-attention paper's actual memory claim.
+
+    Differentiable: a ring-level custom VJP runs a second ring pass whose
+    per-hop gradients come from the flash blockwise recompute with global
+    (L = m + log l) statistics, with dk/dv accumulators traveling alongside
+    their K/V blocks. (Before this VJP, autodiff through the flash-inner
+    merge produced silently WRONG gradients — the stats path had no VJP.)
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    return _ring(q, k, v, axis_name, axis_size, float(scale),
+                 bool(use_flash))
 
 
 def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
@@ -139,11 +229,13 @@ def wrap_ring_attention(mesh: Mesh, axis_name: str = "sp",
         raise ValueError(f"unknown sequence-parallel impl {impl!r}")
     spec = P(None, None, axis_name, None)
 
-    # the pallas_call inside ring_flash cannot declare its varying-axes type,
-    # so the vma check must be off for that impl (mesh.py:get_shard_map)
+    # the vma/replication check must be off for the ring impls: the
+    # pallas_call inside ring_flash cannot declare its varying-axes type,
+    # and the ring VJP's blockwise-recompute scan initializes its carry
+    # unvarying (mesh.py:get_shard_map)
     from .mesh import get_shard_map
     shard_map, unchecked = get_shard_map()
-    kwargs = unchecked if impl == "ring_flash" else {}
+    kwargs = unchecked if impl in ("ring", "ring_flash") else {}
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, **kwargs)

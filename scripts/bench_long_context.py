@@ -55,6 +55,20 @@ def main():
         # branch and publish a mislabeled timing
         raise SystemExit(f"unknown BENCH_IMPLS {sorted(unknown)}")
 
+    def impl_fn_args(impl, q, k, v):
+        """(fn, device args) per impl — ONE dispatch shared by the forward
+        and backward timing loops so specs cannot drift between them."""
+        if impl == "full":
+            return local_attention, [jax.device_put(x) for x in (q, k, v)]
+        if impl == "flash":
+            # single-device Pallas streaming-softmax kernel: the O(S)
+            # alternative when the score matrix no longer fits
+            return (lambda a, b, c: flash_attention(a, b, c),
+                    [jax.device_put(x) for x in (q, k, v)])
+        sh = NamedSharding(mesh, P(None, None, "sp", None))
+        return (wrap_ring_attention(mesh, "sp", impl=impl),
+                [jax.device_put(x, sh) for x in (q, k, v)])
+
     rng = np.random.default_rng(0)
     for S in seqs:
         q = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
@@ -64,18 +78,8 @@ def main():
         full_out = None
         for impl in impls:
             try:
-                if impl == "full":
-                    fn = jax.jit(local_attention)
-                    args = [jax.device_put(x) for x in (q, k, v)]
-                elif impl == "flash":
-                    # single-device Pallas streaming-softmax kernel: the
-                    # O(S) alternative when the score matrix no longer fits
-                    fn = jax.jit(lambda a, b, c: flash_attention(a, b, c))
-                    args = [jax.device_put(x) for x in (q, k, v)]
-                else:
-                    fn = jax.jit(wrap_ring_attention(mesh, "sp", impl=impl))
-                    sh = NamedSharding(mesh, P(None, None, "sp", None))
-                    args = [jax.device_put(x, sh) for x in (q, k, v)]
+                base_fn, args = impl_fn_args(impl, q, k, v)
+                fn = jax.jit(base_fn)
                 # a fetched scalar is the only reliable completion fence
                 # behind the axon tunnel (block_until_ready can return
                 # before the device finishes, reporting ~0 ms for 100-ms
@@ -119,17 +123,14 @@ def main():
             continue
         bwd, full_grads = {}, None
         for impl in impls:
-            if impl not in ("full", "flash"):
-                continue
             try:
-                base = (local_attention if impl == "full"
-                        else (lambda a, b, c: flash_attention(a, b, c)))
+                # the sequence-parallel impls train too (ring-level VJP)
+                base, args = impl_fn_args(impl, q, k, v)
 
                 def loss(a, b, c, _f=base):
                     return jnp.sum(_f(a, b, c).astype(jnp.float32))
 
                 gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-                args = [jax.device_put(x) for x in (q, k, v)]
                 gs = gfn(*args)                      # the one compile
                 float(jnp.sum(gs[0][0, 0, 0, :2].astype(jnp.float32)))
                 reps = 3
