@@ -188,7 +188,10 @@ def make_graph(nodes: Sequence[Node], name: str,
 
 
 def make_model(graph: WireWriter, opset: int = 17,
-               producer: str = "mmlspark_tpu") -> bytes:
+               producer: str = "mmlspark_tpu",
+               extra_opsets: Optional[dict] = None) -> bytes:
+    """``extra_opsets``: additional domain→version imports (e.g.
+    ``{"ai.onnx.ml": 3}`` for TreeEnsemble graphs)."""
     w = WireWriter()
     w.varint(1, 8)  # ir_version
     w.string(2, producer)
@@ -197,4 +200,9 @@ def make_model(graph: WireWriter, opset: int = 17,
     opset_w.string(1, "")
     opset_w.varint(2, opset)
     w.message(8, opset_w)
+    for domain, version in (extra_opsets or {}).items():
+        ow = WireWriter()
+        ow.string(1, domain)
+        ow.varint(2, version)
+        w.message(8, ow)
     return w.to_bytes()

@@ -86,10 +86,18 @@ def _onnx_pow(a, b):
 
 for _name, _fn in [
     ("Add", jnp.add), ("Sub", jnp.subtract), ("Mul", jnp.multiply),
-    ("Div", _onnx_div), ("Pow", _onnx_pow), ("Mod", jnp.mod),
+    ("Div", _onnx_div), ("Pow", _onnx_pow),
     ("And", jnp.logical_and), ("Or", jnp.logical_or), ("Xor", jnp.logical_xor),
 ]:
     OP_HANDLERS[_name] = _variadic(_fn)
+
+
+@register_op("Mod")
+def _onnx_mod(node, inputs, ctx):
+    # fmod=1 truncates toward zero (C fmod); default follows the divisor's
+    # sign (python %)
+    fn = jnp.fmod if node.attr("fmod", 0) else jnp.mod
+    return fn(inputs[0], inputs[1])
 
 OP_HANDLERS["Min"] = _variadic(jnp.minimum)
 OP_HANDLERS["Max"] = _variadic(jnp.maximum)
@@ -2099,7 +2107,8 @@ NUMPY_OPS: Dict[str, Callable] = {
     "Mul": lambda n, i, c: i[0] * i[1],
     "Div": lambda n, i, c: (np.trunc(i[0] / i[1]).astype(i[0].dtype)
                             if i[0].dtype.kind in "iu" else i[0] / i[1]),
-    "Mod": lambda n, i, c: np.mod(i[0], i[1]),
+    "Mod": lambda n, i, c: (np.fmod(i[0], i[1]) if n.attr("fmod", 0)
+                            else np.mod(i[0], i[1])),
     "Neg": lambda n, i, c: -i[0],
     "Abs": lambda n, i, c: np.abs(i[0]),
     "Min": lambda n, i, c: np.minimum.reduce(i),
@@ -2245,3 +2254,8 @@ def convert_model(model_bytes: bytes,
     """``external_data_dir``: directory holding sidecar files for models
     saved with external data (torch's ``save_as_external_data``)."""
     return ConvertedModel(parse_model(model_bytes), external_data_dir)
+
+
+# ai.onnx.ml domain handlers (tree ensembles, linear models, preprocessing)
+# register themselves on import; placed at module end so register_op exists
+from . import ml_ops  # noqa: E402,F401

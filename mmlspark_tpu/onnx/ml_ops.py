@@ -1,0 +1,399 @@
+"""ai.onnx.ml domain: the sklearn/LightGBM interchange operators.
+
+Parity surface: the reference's flagship ONNX story converts a trained
+LightGBM booster to ONNX (``TreeEnsembleClassifier``) and serves it through
+``ONNXModel`` on onnxruntime (``website/docs/features/onnx/about.md``,
+``deep-learning/.../onnx/ONNXModel.scala:173-193``). skl2onnx emits the same
+family for sklearn models (Scaler/Imputer/Normalizer/LinearClassifier/...).
+
+The tree walk is TPU-first: node tables are padded to flat ``(T, max_nodes)``
+arrays at CONVERT time (attributes are static numpy), and evaluation is a
+fixed-depth vectorized descent — every (row, tree) pair advances through one
+gather per level with leaves self-looping, so the whole forest costs
+``max_depth`` batched gathers instead of onnxruntime's per-row pointer
+chase. No data-dependent control flow; jit-stable shapes throughout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .convert import UnsupportedOp, register_op
+
+_ML = "ai.onnx.ml"
+
+_MODES = {"BRANCH_LEQ": 0, "BRANCH_LT": 1, "BRANCH_GTE": 2, "BRANCH_GT": 3,
+          "BRANCH_EQ": 4, "BRANCH_NEQ": 5, "LEAF": 6}
+
+
+def _require_ml(node):
+    if node.domain not in (_ML,):
+        raise UnsupportedOp(f"{node.op_type} in domain {node.domain!r}")
+
+
+# -- tree ensembles ----------------------------------------------------------
+
+def _parse_tree_tables(node):
+    """Static node attributes → padded (T, M) numpy tables + max depth."""
+    tids = np.asarray(node.attr("nodes_treeids"), np.int64)
+    nids = np.asarray(node.attr("nodes_nodeids"), np.int64)
+    feats = np.asarray(node.attr("nodes_featureids"), np.int64)
+    vals = np.asarray(node.attr("nodes_values"), np.float32)
+    modes = np.asarray([_MODES[m] for m in node.attr("nodes_modes")],
+                       np.int32)
+    trues = np.asarray(node.attr("nodes_truenodeids"), np.int64)
+    falses = np.asarray(node.attr("nodes_falsenodeids"), np.int64)
+    miss = node.attr("nodes_missing_value_tracks_true")
+    miss = (np.asarray(miss, np.int32) if miss
+            else np.zeros(len(nids), np.int32))
+
+    trees = sorted(set(int(t) for t in tids))
+    tree_index = {t: i for i, t in enumerate(trees)}
+    T = len(trees)
+    M = int(nids.max()) + 1 if len(nids) else 1
+
+    feat = np.zeros((T, M), np.int32)
+    val = np.zeros((T, M), np.float32)
+    mode = np.full((T, M), _MODES["LEAF"], np.int32)
+    tnext = np.tile(np.arange(M, dtype=np.int32), (T, 1))
+    fnext = np.tile(np.arange(M, dtype=np.int32), (T, 1))
+    mtrue = np.zeros((T, M), np.int32)
+    for i in range(len(nids)):
+        t, n = tree_index[int(tids[i])], int(nids[i])
+        feat[t, n] = feats[i]
+        val[t, n] = vals[i]
+        mode[t, n] = modes[i]
+        mtrue[t, n] = miss[i]
+        if modes[i] != _MODES["LEAF"]:
+            tnext[t, n] = trues[i]
+            fnext[t, n] = falses[i]
+        # leaves keep the self-loop defaults
+
+    # longest root→leaf path (BFS per tree); cycle-guarded by the node count
+    max_depth = 1
+    for t in range(T):
+        depth = np.full(M, -1, np.int64)
+        depth[0] = 0
+        frontier = [0]
+        steps = 0
+        while frontier and steps <= M:
+            steps += 1
+            nxt = []
+            for n in frontier:
+                if mode[t, n] == _MODES["LEAF"]:
+                    continue
+                for c in (int(tnext[t, n]), int(fnext[t, n])):
+                    if depth[c] == -1:
+                        depth[c] = depth[n] + 1
+                        nxt.append(c)
+            frontier = nxt
+        max_depth = max(max_depth, int(depth.max()) + 1)
+    return tree_index, (feat, val, mode, tnext, fnext, mtrue), max_depth
+
+
+def _walk_trees(X, tables, max_depth):
+    """(N, F) rows × (T, M) node tables → (N, T) leaf node indices."""
+    feat, val, mode, tnext, fnext, mtrue = (jnp.asarray(a) for a in tables)
+    N, F = X.shape
+    T, M = feat.shape
+    tr = jnp.arange(T)[None, :]                       # (1, T)
+
+    def level(_, idx):
+        nf = feat[tr, idx]                            # (N, T)
+        nv = val[tr, idx]
+        nm = mode[tr, idx]
+        x = jnp.take_along_axis(X, jnp.clip(nf, 0, F - 1), axis=1)
+        cond = jnp.select(
+            [nm == 0, nm == 1, nm == 2, nm == 3, nm == 4],
+            [x <= nv, x < nv, x >= nv, x > nv, x == nv],
+            x != nv)
+        cond = jnp.where(jnp.isnan(x), mtrue[tr, idx] > 0, cond)
+        return jnp.where(cond, tnext[tr, idx], fnext[tr, idx])
+
+    idx = jnp.zeros((N, T), jnp.int32)
+    return jax.lax.fori_loop(0, max_depth, level, idx)
+
+
+def _leaf_weight_table(node, tree_index, M, n_out, prefix,
+                       collapse_ids=False):
+    """(T, M, n_out) dense weights from the class_*/target_* attributes.
+    ``collapse_ids``: binary single-class form — every entry scores the one
+    output column regardless of its class id."""
+    tids = np.asarray(node.attr(f"{prefix}_treeids"), np.int64)
+    nids = np.asarray(node.attr(f"{prefix}_nodeids"), np.int64)
+    outs = np.asarray(node.attr(f"{prefix}_ids"), np.int64)
+    ws = np.asarray(node.attr(f"{prefix}_weights"), np.float32)
+    W = np.zeros((len(tree_index), M, n_out), np.float32)
+    for i in range(len(tids)):
+        col = 0 if collapse_ids else int(outs[i])
+        # += not =: a leaf may carry several entries for the same output
+        W[tree_index[int(tids[i])], int(nids[i]), col] += ws[i]
+    return W
+
+
+def _post_transform(scores, kind):
+    if kind in (None, "", "NONE"):
+        return scores
+    if kind == "SOFTMAX":
+        return jax.nn.softmax(scores, axis=-1)
+    if kind == "LOGISTIC":
+        return jax.nn.sigmoid(scores)
+    if kind == "SOFTMAX_ZERO":
+        # softmax over the nonzero entries only (spec): zero logits keep
+        # probability zero
+        nz = scores != 0.0
+        e = jnp.where(nz, jnp.exp(scores - jnp.max(
+            jnp.where(nz, scores, -jnp.inf), axis=-1, keepdims=True)), 0.0)
+        return e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    if kind == "PROBIT":
+        return 0.5 * (1.0 + jax.lax.erf(scores / np.sqrt(2.0)))
+    raise UnsupportedOp(f"post_transform {kind!r}")
+
+
+@register_op("TreeEnsembleClassifier")
+def _tree_classifier(node, inputs, ctx):
+    _require_ml(node)
+    labels = node.attr("classlabels_int64s")
+    if labels is None:
+        raise UnsupportedOp("TreeEnsembleClassifier with string class "
+                            "labels (int64 labels only under jit)")
+    labels = np.asarray(labels, np.int64)
+    C = len(labels)
+    tree_index, tables, max_depth = _parse_tree_tables(node)
+    class_ids = set(int(c) for c in node.attr("class_ids"))
+    binary_single = C == 2 and len(class_ids) == 1
+    n_out = 1 if binary_single else C
+    W = _leaf_weight_table(node, tree_index, tables[0].shape[1], n_out,
+                           "class", collapse_ids=binary_single)
+    base = np.asarray(node.attr("base_values") or [0.0] * n_out, np.float32)
+    post = node.attr("post_transform", "NONE")
+
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    leaf = _walk_trees(X, tables, max_depth)           # (N, T)
+    contrib = jnp.asarray(W)[jnp.arange(W.shape[0])[None, :], leaf]
+    scores = jnp.sum(contrib, axis=1) + jnp.asarray(base)   # (N, n_out)
+    if binary_single:
+        s = scores[:, 0]
+        if post == "LOGISTIC":
+            p1 = jax.nn.sigmoid(s)
+            scores = jnp.stack([1.0 - p1, p1], axis=-1)
+        elif post in (None, "", "NONE"):
+            # sklearn forest exports carry leaf PROBABILITIES for class 1
+            scores = jnp.stack([1.0 - s, s], axis=-1)
+        else:
+            raise UnsupportedOp(
+                f"binary single-class TreeEnsemble with {post}")
+    else:
+        scores = _post_transform(scores, post)
+    pred = jnp.take(jnp.asarray(labels), jnp.argmax(scores, axis=-1))
+    return pred, scores
+
+
+@register_op("TreeEnsembleRegressor")
+def _tree_regressor(node, inputs, ctx):
+    _require_ml(node)
+    n_out = int(node.attr("n_targets", 1))
+    tree_index, tables, max_depth = _parse_tree_tables(node)
+    W = _leaf_weight_table(node, tree_index, tables[0].shape[1], n_out,
+                           "target")
+    base = np.asarray(node.attr("base_values") or [0.0] * n_out, np.float32)
+    agg = node.attr("aggregate_function", "SUM")
+
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    leaf = _walk_trees(X, tables, max_depth)
+    contrib = jnp.asarray(W)[jnp.arange(W.shape[0])[None, :], leaf]
+    if agg == "SUM":
+        scores = jnp.sum(contrib, axis=1)
+    elif agg == "AVERAGE":
+        scores = jnp.mean(contrib, axis=1)
+    elif agg == "MIN":
+        scores = jnp.min(contrib, axis=1)
+    elif agg == "MAX":
+        scores = jnp.max(contrib, axis=1)
+    else:
+        raise UnsupportedOp(f"aggregate_function {agg!r}")
+    scores = scores + jnp.asarray(base)
+    return _post_transform(scores, node.attr("post_transform", "NONE"))
+
+
+# -- linear / preprocessing --------------------------------------------------
+
+@register_op("LinearClassifier")
+def _linear_classifier(node, inputs, ctx):
+    _require_ml(node)
+    labels = node.attr("classlabels_ints")
+    if labels is None:
+        raise UnsupportedOp("LinearClassifier with string class labels")
+    labels = np.asarray(labels, np.int64)
+    C = len(labels)
+    coef = np.asarray(node.attr("coefficients"), np.float32)
+    # row count comes from the intercepts (skl2onnx emits one per score
+    # row); a single row with two labels is the binary-one form
+    inter = np.asarray(node.attr("intercepts") or [0.0], np.float32)
+    rows = len(inter)
+    coef = coef.reshape(rows, -1)
+    post = node.attr("post_transform", "NONE")
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    s = X @ jnp.asarray(coef).T + jnp.asarray(inter)
+    if rows == 1 and C == 2:
+        p1 = jax.nn.sigmoid(s[:, 0]) if post == "LOGISTIC" else s[:, 0]
+        scores = jnp.stack([1.0 - p1, p1], axis=-1)
+    else:
+        scores = _post_transform(s, post)
+    pred = jnp.take(jnp.asarray(labels), jnp.argmax(scores, axis=-1))
+    return pred, scores
+
+
+@register_op("LinearRegressor")
+def _linear_regressor(node, inputs, ctx):
+    _require_ml(node)
+    n = int(node.attr("targets", 1))
+    coef = np.asarray(node.attr("coefficients"), np.float32).reshape(n, -1)
+    inter = np.asarray(node.attr("intercepts") or [0.0] * n, np.float32)
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    return _post_transform(X @ jnp.asarray(coef).T + jnp.asarray(inter),
+                           node.attr("post_transform", "NONE"))
+
+
+@register_op("Scaler")
+def _scaler(node, inputs, ctx):
+    _require_ml(node)
+    off = np.asarray(node.attr("offset") or [0.0], np.float32)
+    sc = np.asarray(node.attr("scale") or [1.0], np.float32)
+    return (inputs[0].astype(jnp.float32) - off) * sc
+
+
+@register_op("Normalizer")
+def _normalizer(node, inputs, ctx):
+    _require_ml(node)
+    norm = node.attr("norm", "MAX")
+    x = inputs[0].astype(jnp.float32)
+    if norm == "MAX":
+        d = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    elif norm == "L1":
+        d = jnp.sum(jnp.abs(x), axis=-1, keepdims=True)
+    elif norm == "L2":
+        d = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    else:
+        raise UnsupportedOp(f"Normalizer norm {norm!r}")
+    return x / jnp.maximum(d, 1e-30)
+
+
+@register_op("Imputer")
+def _imputer(node, inputs, ctx):
+    _require_ml(node)
+    x = inputs[0]
+    if np.issubdtype(np.dtype(x.dtype), np.floating):
+        fill = np.asarray(node.attr("imputed_value_floats"), np.float32)
+        missing = node.attr("replaced_value_float", float("nan"))
+        hit = (jnp.isnan(x) if np.isnan(missing)
+               else x == jnp.float32(missing))
+    else:
+        fill = np.asarray(node.attr("imputed_value_int64s"), np.int64)
+        hit = x == node.attr("replaced_value_int64", 0)
+    fill = jnp.asarray(fill if fill.size > 1 else fill.reshape(()))
+    return jnp.where(hit, fill, x)
+
+
+@register_op("Binarizer")
+def _binarizer(node, inputs, ctx):
+    _require_ml(node)
+    thr = node.attr("threshold", 0.0)
+    x = inputs[0]
+    return (x > jnp.asarray(thr, x.dtype)).astype(x.dtype)
+
+
+@register_op("ArrayFeatureExtractor")
+def _array_feature_extractor(node, inputs, ctx):
+    _require_ml(node)
+    x, idx = inputs
+    return jnp.take(x, idx.astype(jnp.int32).reshape(-1), axis=-1)
+
+
+@register_op("FeatureVectorizer")
+def _feature_vectorizer(node, inputs, ctx):
+    _require_ml(node)
+    cols = [x.astype(jnp.float32) for x in inputs if x is not None]
+    cols = [c[:, None] if c.ndim == 1 else c for c in cols]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@register_op("LabelEncoder")
+def _label_encoder(node, inputs, ctx):
+    _require_ml(node)
+    x = inputs[0]
+    for kk, vk in (("keys_int64s", "values_int64s"),
+                   ("keys_int64s", "values_floats"),
+                   ("keys_floats", "values_int64s"),
+                   ("keys_floats", "values_floats")):
+        keys, vals = node.attr(kk), node.attr(vk)
+        if keys is not None and vals is not None:
+            break
+    else:
+        raise UnsupportedOp("LabelEncoder with string keys/values "
+                            "(jit-incompatible)")
+    keys = np.asarray(keys)
+    vals = np.asarray(vals)
+    default = node.attr(
+        "default_int64" if vals.dtype.kind == "i" else "default_float",
+        -1 if vals.dtype.kind == "i" else -0.0)
+    hit = x[..., None] == jnp.asarray(keys)                # (..., K)
+    found = jnp.any(hit, axis=-1)
+    picked = jnp.einsum("...k,k->...", hit.astype(vals.dtype),
+                        jnp.asarray(vals))
+    return jnp.where(found, picked, jnp.asarray(default, picked.dtype))
+
+
+@register_op("ZipMap")
+def _zipmap(node, inputs, ctx):
+    # ZipMap decorates probabilities into per-row dicts for python callers;
+    # under jit the tensor IS the useful output — pass it through (the
+    # label keys live in the node attrs for any host-side consumer)
+    _require_ml(node)
+    return inputs[0]
+
+
+# -- core-domain stragglers commonly found next to ml graphs -----------------
+# (Mod lives in convert.py's core table — fmod handled there; Mish too.)
+
+@register_op("Hardmax")
+def _hardmax(node, inputs, ctx):
+    x = inputs[0]
+    axis = node.attr("axis", -1 if ctx.opset >= 13 else 1)
+    oh = jax.nn.one_hot(jnp.argmax(x, axis=axis), x.shape[axis],
+                        axis=axis if axis >= 0 else x.ndim + axis,
+                        dtype=x.dtype)
+    return oh
+
+
+@register_op("ScatterElements")
+def _scatter_elements(node, inputs, ctx):
+    data, indices, updates = (jnp.asarray(t) for t in inputs)
+    axis = node.attr("axis", 0)
+    reduction = node.attr("reduction", "none")
+    idx = indices.astype(jnp.int64)
+    idx = jnp.where(idx < 0, idx + data.shape[axis], idx)
+    # jnp's put_along_axis-free formulation: build full index grids
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                         indexing="ij")
+    grids[axis if axis >= 0 else data.ndim + axis] = idx
+    coords = tuple(g.reshape(-1) for g in grids)
+    upd = updates.reshape(-1)
+    if reduction == "none":
+        return data.at[coords].set(upd)
+    if reduction == "add":
+        return data.at[coords].add(upd)
+    if reduction in ("mul", "max", "min"):
+        return getattr(data.at[coords], reduction)(upd)
+    raise UnsupportedOp(f"ScatterElements reduction {reduction!r}")

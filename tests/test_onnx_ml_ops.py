@@ -1,0 +1,272 @@
+"""ai.onnx.ml domain: tree ensembles, linear models, preprocessing — the
+sklearn/LightGBM interchange surface, plus the booster→ONNX exporter.
+
+Parity anchor: the reference's flagship ONNX demo converts a trained
+LightGBM model to ONNX (TreeEnsembleClassifier) and serves it via
+ONNXModel (``website/docs/features/onnx/about.md``). The round-trip tests
+here close the same loop natively: train GBDT → export ONNX → run through
+the converter / ONNXModel → predictions match the booster."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.models.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.models.gbdt.onnx_export import booster_to_onnx
+from mmlspark_tpu.models.onnx_model import ONNXModel
+from mmlspark_tpu.onnx.builder import (make_graph, make_model, make_node,
+                                       make_tensor_value_info)
+from mmlspark_tpu.onnx.convert import convert_model
+
+
+def _df(X, y=None):
+    col = np.empty(len(X), dtype=object)
+    for i, r in enumerate(X):
+        col[i] = r.astype(np.float32)
+    d = {"features": col}
+    if y is not None:
+        d["label"] = y.astype(np.float64)
+    return DataFrame(d)
+
+
+class TestBoosterRoundTrip:
+    def test_binary_classifier(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(0, 1, (300, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+        m = LightGBMClassifier(num_iterations=12, num_leaves=8,
+                               learning_rate=0.2).fit(_df(X, y))
+        booster = m.booster
+        cm = convert_model(booster_to_onnx(booster))
+        Xq = rng.normal(0, 1, (64, 6)).astype(np.float32)
+        out = cm(cm.params, {"features": Xq})
+        probs = np.asarray(out["probabilities"])
+        want_p1 = booster.predict(Xq)          # sigmoid(raw) for binary
+        np.testing.assert_allclose(probs[:, 1], want_p1, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out["label"]),
+                                      (want_p1 > 0.5).astype(np.int64))
+
+    def test_multiclass_classifier(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(0, 1, (300, 5))
+        y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(300, 3)), axis=1)
+        m = LightGBMClassifier(num_iterations=8, num_leaves=6,
+                               learning_rate=0.3).fit(_df(X, y))
+        booster = m.booster
+        cm = convert_model(booster_to_onnx(booster))
+        Xq = rng.normal(0, 1, (50, 5)).astype(np.float32)
+        probs = np.asarray(cm(cm.params, {"features": Xq})["probabilities"])
+        want = booster.predict(Xq)             # softmax rows
+        np.testing.assert_allclose(probs, want, rtol=1e-4, atol=1e-5)
+
+    def test_regressor(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(0, 1, (300, 4))
+        y = X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=300)
+        m = LightGBMRegressor(num_iterations=10, num_leaves=8).fit(_df(X, y))
+        booster = m.booster
+        cm = convert_model(booster_to_onnx(booster))
+        Xq = rng.normal(0, 1, (40, 4)).astype(np.float32)
+        got = np.asarray(cm(cm.params, {"features": Xq})["variable"])[:, 0]
+        np.testing.assert_allclose(got, booster.predict(Xq), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_nan_routing_matches_booster(self):
+        """NaN features go left in the trainer; the exported graph must
+        route them identically (missing_value_tracks_true)."""
+        rng = np.random.default_rng(3)
+        X = rng.normal(0, 1, (200, 4))
+        y = (X[:, 0] > 0).astype(np.int64)
+        booster = LightGBMClassifier(num_iterations=6, num_leaves=6) \
+            .fit(_df(X, y)).booster
+        cm = convert_model(booster_to_onnx(booster))
+        Xq = rng.normal(0, 1, (30, 4)).astype(np.float32)
+        Xq[::3, 0] = np.nan
+        got = np.asarray(cm(cm.params, {"features": Xq})["probabilities"])
+        np.testing.assert_allclose(got[:, 1], booster.predict(Xq),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_through_onnx_model_stage(self):
+        """Full user path: exported booster served by ONNXModel over a
+        DataFrame — the reference's LightGBM→ONNX demo, natively."""
+        rng = np.random.default_rng(4)
+        X = rng.normal(0, 1, (200, 5))
+        y = (X[:, 0] - X[:, 2] > 0).astype(np.int64)
+        booster = LightGBMClassifier(num_iterations=8, num_leaves=8) \
+            .fit(_df(X, y)).booster
+        stage = ONNXModel(booster_to_onnx(booster),
+                          feed_dict={"features": "features"},
+                          fetch_dict={"proba": "probabilities",
+                                      "pred": "label"},
+                          mini_batch_size=64, pin_devices=False)
+        Xq = rng.normal(0, 1, (48, 5)).astype(np.float32)
+        out = stage.transform(_df(Xq))
+        p1 = np.stack(list(out["proba"]))[:, 1]
+        np.testing.assert_allclose(p1, booster.predict(Xq), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_cat_encoder_refused(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(0, 1, (100, 3))
+        y = (X[:, 0] > 0).astype(np.int64)
+        booster = LightGBMClassifier(num_iterations=3, num_leaves=4) \
+            .fit(_df(X, y)).booster
+        booster.cat_encoder = object()          # any non-None sentinel
+        with pytest.raises(ValueError, match="categorical"):
+            booster_to_onnx(booster)
+
+
+class TestHandBuiltEnsembles:
+    def test_ragged_trees_branch_modes_and_average(self):
+        """Non-complete trees, mixed branch modes, AVERAGE aggregation —
+        checked against a per-row python oracle."""
+        # tree 0: root(f0 < 1.5) -> leaf1 / node2(f1 >= 0) -> leaf3/leaf4
+        # tree 1: root(f0 > -1)  -> leaf1 / leaf2
+        attrs = dict(
+            nodes_treeids=[0, 0, 0, 0, 0, 1, 1, 1],
+            nodes_nodeids=[0, 1, 2, 3, 4, 0, 1, 2],
+            nodes_featureids=[0, 0, 1, 0, 0, 0, 0, 0],
+            nodes_values=[1.5, 0, 0.0, 0, 0, -1.0, 0, 0],
+            nodes_modes=["BRANCH_LT", "LEAF", "BRANCH_GTE", "LEAF", "LEAF",
+                         "BRANCH_GT", "LEAF", "LEAF"],
+            nodes_truenodeids=[1, 0, 3, 0, 0, 1, 0, 0],
+            nodes_falsenodeids=[2, 0, 4, 0, 0, 2, 0, 0],
+            nodes_missing_value_tracks_true=[1, 0, 0, 0, 0, 0, 0, 0],
+            target_treeids=[0, 0, 0, 1, 1],
+            target_nodeids=[1, 3, 4, 1, 2],
+            target_ids=[0, 0, 0, 0, 0],
+            target_weights=[10.0, 20.0, 30.0, 1.0, 2.0],
+            n_targets=1, aggregate_function="AVERAGE")
+        g = make_graph(
+            [make_node("TreeEnsembleRegressor", ["x"], ["y"],
+                       domain="ai.onnx.ml", **attrs)],
+            "t", [make_tensor_value_info("x", np.float32, ["N", 2])],
+            [make_tensor_value_info("y", np.float32, ["N", 1])])
+        cm = convert_model(make_model(g, extra_opsets={"ai.onnx.ml": 3}))
+
+        def oracle(row):
+            # tree 0
+            if np.isnan(row[0]) or row[0] < 1.5:
+                t0 = 10.0
+            else:
+                t0 = 20.0 if row[1] >= 0 else 30.0
+            t1 = 1.0 if row[0] > -1 else 2.0
+            return (t0 + t1) / 2.0
+
+        X = np.array([[0.0, 5.0], [2.0, 1.0], [2.0, -1.0],
+                      [-3.0, 0.0], [np.nan, -2.0]], np.float32)
+        got = np.asarray(cm(cm.params, {"x": X})["y"])[:, 0]
+        want = np.array([oracle(r) for r in X], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestPreprocessingOps:
+    def _run(self, op, inputs, outputs=1, **attrs):
+        names = [f"i{k}" for k in range(len(inputs))]
+        onames = [f"o{k}" for k in range(outputs)]
+        g = make_graph(
+            [make_node(op, names, onames, domain="ai.onnx.ml", **attrs)],
+            "t", [make_tensor_value_info(n, np.asarray(v).dtype,
+                                         list(np.asarray(v).shape))
+                  for n, v in zip(names, inputs)],
+            [make_tensor_value_info(o, np.float32, []) for o in onames])
+        cm = convert_model(make_model(g, extra_opsets={"ai.onnx.ml": 3}))
+        out = cm(cm.params, dict(zip(names, inputs)))
+        return [np.asarray(out[o]) for o in onames]
+
+    def test_scaler(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        got, = self._run("Scaler", [x], offset=[1.0, 2.0], scale=[2.0, 0.5])
+        np.testing.assert_allclose(got, [[0, 0], [4, 1]])
+
+    def test_normalizer_l2(self):
+        x = np.array([[3.0, 4.0]], np.float32)
+        got, = self._run("Normalizer", [x], norm="L2")
+        np.testing.assert_allclose(got, [[0.6, 0.8]], rtol=1e-6)
+
+    def test_imputer_nan(self):
+        x = np.array([[1.0, np.nan], [np.nan, 4.0]], np.float32)
+        got, = self._run("Imputer", [x], imputed_value_floats=[9.0, 7.0])
+        np.testing.assert_allclose(got, [[1, 7], [9, 4]])
+
+    def test_binarizer(self):
+        x = np.array([[0.2, 0.8]], np.float32)
+        got, = self._run("Binarizer", [x], threshold=0.5)
+        np.testing.assert_allclose(got, [[0.0, 1.0]])
+
+    def test_array_feature_extractor(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([3, 1], np.int64)
+        got, = self._run("ArrayFeatureExtractor", [x, idx])
+        np.testing.assert_allclose(got, x[:, [3, 1]])
+
+    def test_feature_vectorizer(self):
+        a = np.array([[1.0], [2.0]], np.float32)
+        b = np.array([[3.0, 4.0], [5.0, 6.0]], np.float32)
+        got, = self._run("FeatureVectorizer", [a, b],
+                         inputdimensions=[1, 2])
+        np.testing.assert_allclose(got, [[1, 3, 4], [2, 5, 6]])
+
+    def test_label_encoder_int_to_float(self):
+        x = np.array([5, 7, 9], np.int64)
+        got, = self._run("LabelEncoder", [x], keys_int64s=[5, 7],
+                         values_floats=[0.5, 0.7], default_float=-1.0)
+        np.testing.assert_allclose(got, [0.5, 0.7, -1.0])
+
+    def test_linear_classifier_binary(self):
+        x = np.array([[1.0, 0.0], [-1.0, 0.0]], np.float32)
+        labels, scores = self._run(
+            "LinearClassifier", [x], outputs=2,
+            coefficients=[2.0, 0.0], intercepts=[0.0],
+            classlabels_ints=[0, 1], post_transform="LOGISTIC")
+        p1 = 1 / (1 + np.exp(-np.array([2.0, -2.0])))
+        np.testing.assert_allclose(scores[:, 1], p1, rtol=1e-5)
+        np.testing.assert_array_equal(labels, [1, 0])
+
+    def test_linear_regressor(self):
+        x = np.array([[1.0, 2.0]], np.float32)
+        got, = self._run("LinearRegressor", [x],
+                         coefficients=[3.0, -1.0], intercepts=[0.5],
+                         targets=1)
+        np.testing.assert_allclose(got, [[1.5]])
+
+
+class TestCoreStragglers:
+    def _run(self, op, inputs, **attrs):
+        names = [f"i{k}" for k in range(len(inputs))]
+        g = make_graph(
+            [make_node(op, names, ["o"], **attrs)],
+            "t", [make_tensor_value_info(n, np.asarray(v).dtype,
+                                         list(np.asarray(v).shape))
+                  for n, v in zip(names, inputs)],
+            [make_tensor_value_info("o", np.float32, [])])
+        cm = convert_model(make_model(g))
+        return np.asarray(cm(cm.params, dict(zip(names, inputs)))["o"])
+
+    def test_mod(self):
+        a = np.array([5, -5], np.int64)
+        b = np.array([3, 3], np.int64)
+        np.testing.assert_array_equal(self._run("Mod", [a, b]), [2, 1])
+        np.testing.assert_array_equal(
+            self._run("Mod", [a, b], fmod=1), [2, -2])
+
+    def test_hardmax(self):
+        x = np.array([[1.0, 3.0, 2.0]], np.float32)
+        np.testing.assert_allclose(self._run("Hardmax", [x]),
+                                   [[0.0, 1.0, 0.0]])
+
+    def test_mish(self):
+        x = np.array([0.0, 1.0], np.float32)
+        want = x * np.tanh(np.log1p(np.exp(x)))
+        np.testing.assert_allclose(self._run("Mish", [x]), want, rtol=1e-6)
+
+    def test_scatter_elements_add(self):
+        data = np.zeros((3, 3), np.float32)
+        idx = np.array([[0, 1], [1, 2]], np.int64)
+        upd = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        got = self._run("ScatterElements", [data, idx, upd], axis=1,
+                        reduction="add")
+        want = np.zeros((3, 3), np.float32)
+        want[0, 0] += 1; want[0, 1] += 2; want[1, 1] += 3; want[1, 2] += 4
+        np.testing.assert_allclose(got, want)
