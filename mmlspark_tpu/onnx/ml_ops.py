@@ -364,6 +364,104 @@ def _zipmap(node, inputs, ctx):
     return inputs[0]
 
 
+# -- SVMs (skl2onnx SVC/SVR) -------------------------------------------------
+
+def _svm_kernel(X, SV, kind, params):
+    """(N, F) × (M, F) kernel matrix. ``params`` = [gamma, coef0, degree]
+    (the attribute order skl2onnx emits)."""
+    gamma, coef0, degree = (list(params) + [0.0, 0.0, 3.0])[:3]
+    if kind == "LINEAR":
+        return X @ SV.T
+    if kind == "POLY":
+        return (gamma * (X @ SV.T) + coef0) ** int(degree)
+    if kind == "RBF":
+        d2 = (jnp.sum(X * X, axis=1)[:, None]
+              - 2.0 * (X @ SV.T) + jnp.sum(SV * SV, axis=1)[None, :])
+        return jnp.exp(-gamma * d2)
+    if kind == "SIGMOID":
+        return jnp.tanh(gamma * (X @ SV.T) + coef0)
+    raise UnsupportedOp(f"SVM kernel {kind!r}")
+
+
+@register_op("SVMRegressor")
+def _svm_regressor(node, inputs, ctx):
+    _require_ml(node)
+    if node.attr("one_class", 0):
+        raise UnsupportedOp("SVMRegressor one_class (OneClassSVM ±1 "
+                            "labeling semantics)")
+    coefs = np.asarray(node.attr("coefficients"), np.float32)
+    sv = np.asarray(node.attr("support_vectors"), np.float32)
+    rho = np.asarray(node.attr("rho") or [0.0], np.float32)
+    kind = node.attr("kernel_type", "LINEAR")
+    params = node.attr("kernel_params") or []
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    M = len(coefs)
+    SV = jnp.asarray(sv.reshape(M, -1))
+    K = _svm_kernel(X, SV, kind, params)                   # (N, M)
+    out = K @ jnp.asarray(coefs) + rho[0]
+    return _post_transform(out[:, None],
+                           node.attr("post_transform", "NONE"))
+
+
+@register_op("SVMClassifier")
+def _svm_classifier(node, inputs, ctx):
+    """libsvm-style one-vs-one voting (the skl2onnx SVC export). Decision
+    values for each class pair come from the dual coefficients; labels by
+    majority vote with decision-sum tiebreak — matching onnxruntime when no
+    probability calibration (prob_a/prob_b) is present."""
+    _require_ml(node)
+    if node.attr("prob_a"):
+        raise UnsupportedOp("SVMClassifier with Platt scaling (prob_a/b)")
+    labels = node.attr("classlabels_ints")
+    if labels is None:
+        raise UnsupportedOp("SVMClassifier with string class labels")
+    labels = np.asarray(labels, np.int64)
+    C = len(labels)
+    vpc = np.asarray(node.attr("vectors_per_class"), np.int64)
+    sv = np.asarray(node.attr("support_vectors"), np.float32)
+    coefs = np.asarray(node.attr("coefficients"), np.float32)
+    rho = np.asarray(node.attr("rho"), np.float32)
+    kind = node.attr("kernel_type", "LINEAR")
+    params = node.attr("kernel_params") or []
+    M = int(vpc.sum())
+    SV = jnp.asarray(sv.reshape(M, -1))
+    A = jnp.asarray(coefs.reshape(C - 1, M))   # dual coefs, libsvm layout
+    starts = np.r_[0, np.cumsum(vpc)]
+
+    X = inputs[0].astype(jnp.float32)
+    if X.ndim == 1:
+        X = X[None, :]
+    K = _svm_kernel(X, SV, kind, params)                   # (N, M)
+
+    votes = jnp.zeros((X.shape[0], C), jnp.float32)
+    sums = jnp.zeros((X.shape[0], C), jnp.float32)
+    decisions = []
+    p = 0
+    for i in range(C):
+        for j in range(i + 1, C):
+            si, sj = slice(starts[i], starts[i + 1]), \
+                slice(starts[j], starts[j + 1])
+            # + rho: skl2onnx stores sklearn's intercept_ in rho (decision
+            # = dual sum + intercept), same sign as SVMRegressor above
+            dec = (K[:, si] @ A[j - 1, si] + K[:, sj] @ A[i, sj]
+                   + rho[p])
+            decisions.append(dec)
+            win_i = dec > 0
+            votes = votes.at[:, i].add(win_i.astype(jnp.float32))
+            votes = votes.at[:, j].add((~win_i).astype(jnp.float32))
+            sums = sums.at[:, i].add(dec)
+            sums = sums.at[:, j].add(-dec)
+            p += 1
+    scores = jnp.stack(decisions, axis=1) if decisions else sums
+    # majority vote, ties broken by accumulated decision sums
+    rank = votes + jax.nn.sigmoid(sums) * 0.5
+    pred = jnp.take(jnp.asarray(labels), jnp.argmax(rank, axis=-1))
+    return pred, _post_transform(scores,
+                                 node.attr("post_transform", "NONE"))
+
+
 # -- core-domain stragglers commonly found next to ml graphs -----------------
 # (Mod lives in convert.py's core table — fmod handled there; Mish too.)
 

@@ -1,0 +1,89 @@
+"""Evaluation plots: confusion matrix and ROC over DataFrame columns.
+
+Parity surface: ``synapse.ml.plot`` (reference
+``core/src/main/python/synapse/ml/plot/plot.py:17-62``) — ``confusionMatrix``
+and ``roc`` helpers that render directly from prediction columns. Here the
+statistics come from our own metrics (no sklearn dependency), matplotlib is
+imported lazily, and each helper RETURNS the computed arrays so headless
+callers (CI, notebooks exporting JSON) can use the numbers without a
+display.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["confusion_matrix", "roc"]
+
+
+def _columns(df, *names):
+    return [np.asarray(df[n]) for n in names]
+
+
+def confusion_matrix(df, y_col: str, y_hat_col: str,
+                     labels: Optional[Sequence] = None, ax=None,
+                     render: bool = True) -> np.ndarray:
+    """Confusion matrix of ``y_hat_col`` vs ``y_col``; renders onto
+    matplotlib (row-normalized heat map with counts and accuracy, the
+    reference's layout) when ``render`` and returns the raw count matrix."""
+    y, y_hat = _columns(df, y_col, y_hat_col)
+    if labels is None:
+        # numeric order for numbers, type-grouped otherwise — the repo's
+        # label-ordering convention (train/metrics.py)
+        labels = sorted(set(np.unique(y)) | set(np.unique(y_hat)),
+                        key=lambda v: (str(type(v)), v))
+    index = {v: i for i, v in enumerate(labels)}
+    n = len(labels)
+    from .train.metrics import confusion_matrix as _cm
+    yt = np.asarray([index[v] for v in y], np.int64)
+    yp = np.asarray([index[v] for v in y_hat], np.int64)
+    cm = _cm(yt, yp, n)
+    if not render:
+        return cm
+    import matplotlib.pyplot as plt
+    ax = ax or plt.gca()
+    accuracy = float(np.mean(y == y_hat))
+    cmn = cm.astype(float) / np.maximum(cm.sum(axis=1)[:, None], 1)
+    ax.text(-.3, -.55, f"$Accuracy$ $=$ ${round(accuracy * 100, 1)}\\%$",
+            fontsize=18)
+    ticks = np.arange(n)
+    ax.set_xticks(ticks, [str(v) for v in labels])
+    ax.set_yticks(ticks, [str(v) for v in labels])
+    ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
+    for i, j in itertools.product(range(n), range(n)):
+        ax.text(j, i, str(cm[i, j]), horizontalalignment="center",
+                fontsize=18, color="white" if cmn[i, j] > .1 else "black")
+    ax.set_xlabel("Predicted Label", fontsize=18)
+    ax.set_ylabel("True Label", fontsize=18)
+    return cm
+
+
+def roc(df, y_col: str, y_hat_col: str, thresh: float = .5, ax=None,
+        render: bool = True):
+    """ROC curve of score column ``y_hat_col`` against binarized
+    ``y_col`` (> ``thresh``). Returns ``(fpr, tpr, thresholds)`` and plots
+    the curve when ``render``."""
+    y, scores = _columns(df, y_col, y_hat_col)
+    y = (y > thresh).astype(np.int64)
+    order = np.argsort(-scores, kind="stable")
+    ys = y[order]
+    ss = scores[order]
+    tp = np.cumsum(ys)
+    fp = np.cumsum(1 - ys)
+    # one curve point per distinct score (the sklearn roc_curve convention)
+    last = np.r_[np.nonzero(np.diff(ss))[0], len(ss) - 1]
+    tpr = tp[last] / max(tp[-1], 1)
+    fpr = fp[last] / max(fp[-1], 1)
+    tpr = np.r_[0.0, tpr]
+    fpr = np.r_[0.0, fpr]
+    thresholds = np.r_[np.inf, ss[last]]
+    if render:
+        import matplotlib.pyplot as plt
+        ax = ax or plt.gca()
+        ax.plot(fpr, tpr)
+        ax.set_xlabel("False Positive Rate", fontsize=20)
+        ax.set_ylabel("True Positive Rate", fontsize=20)
+    return fpr, tpr, thresholds

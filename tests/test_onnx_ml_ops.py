@@ -270,3 +270,51 @@ class TestCoreStragglers:
         want = np.zeros((3, 3), np.float32)
         want[0, 0] += 1; want[0, 1] += 2; want[1, 1] += 3; want[1, 2] += 4
         np.testing.assert_allclose(got, want)
+
+
+class TestSVM:
+    def _run(self, op, x, outputs, **attrs):
+        g = make_graph(
+            [make_node(op, ["x"], [f"o{k}" for k in range(outputs)],
+                       domain="ai.onnx.ml", **attrs)],
+            "t", [make_tensor_value_info("x", np.float32, list(x.shape))],
+            [make_tensor_value_info(f"o{k}", np.float32, [])
+             for k in range(outputs)])
+        cm = convert_model(make_model(g, extra_opsets={"ai.onnx.ml": 3}))
+        out = cm(cm.params, {"x": x})
+        return [np.asarray(out[f"o{k}"]) for k in range(outputs)]
+
+    def test_svm_regressor_rbf(self):
+        rng = np.random.default_rng(20)
+        SV = rng.normal(0, 1, (3, 2)).astype(np.float32)
+        coef = np.array([0.5, -1.0, 0.25], np.float32)
+        gamma = 0.7
+        X = rng.normal(0, 1, (5, 2)).astype(np.float32)
+        got, = self._run("SVMRegressor", X, 1,
+                         coefficients=coef.tolist(),
+                         support_vectors=SV.reshape(-1).tolist(),
+                         rho=[0.3], kernel_type="RBF",
+                         kernel_params=[gamma, 0.0, 3.0])
+        d2 = ((X[:, None] - SV[None]) ** 2).sum(-1)
+        want = np.exp(-gamma * d2) @ coef + 0.3
+        np.testing.assert_allclose(got[:, 0], want, rtol=1e-5, atol=1e-5)
+
+    def test_svm_classifier_binary_linear(self):
+        """Binary libsvm SVC: decision = K[:,sv1]@a + K[:,sv0]@a' - rho;
+        label by the decision's sign."""
+        SV = np.array([[1.0, 0.0], [-1.0, 0.0]], np.float32)  # class0, class1
+        # dual coefs (C-1=1, M=2): y_i * alpha_i
+        coefs = np.array([[1.0, -1.0]], np.float32)
+        X = np.array([[2.0, 0.0], [-2.0, 0.0]], np.float32)
+        labels, scores = self._run(
+            "SVMClassifier", X, 2,
+            classlabels_ints=[0, 1], vectors_per_class=[1, 1],
+            support_vectors=SV.reshape(-1).tolist(),
+            coefficients=coefs.reshape(-1).tolist(), rho=[0.5],
+            kernel_type="LINEAR")
+        # dec = K[:,sv_i]@A[j-1,si] + K[:,sv_j]@A[i,sj] + rho
+        #     = (x@[1,0])*1 + (x@[-1,0])*(-1) + 0.5 = 2*x0 + 0.5
+        # (rho holds sklearn's intercept_, ADDED — nonzero here to pin
+        # the sign convention)
+        np.testing.assert_allclose(scores[:, 0], [4.5, -3.5], rtol=1e-6)
+        np.testing.assert_array_equal(labels, [0, 1])  # dec>0 → class i=0

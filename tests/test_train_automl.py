@@ -204,3 +204,42 @@ def test_tune_halving_rejects_bad_config():
         resource_param="max_iter", min_resource=32, max_resource=8)
     with _pt.raises(ValueError, match="min_resource"):
         t2.fit(df)
+
+
+class TestPlot:
+    """synapse.ml.plot parity (reference plot.py:17-62): confusion matrix
+    and ROC computed from DataFrame columns, rendering optional."""
+
+    def test_confusion_matrix_counts(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.plot import confusion_matrix
+        df = DataFrame({"y":    np.array([0, 0, 1, 1, 1]),
+                        "yhat": np.array([0, 1, 1, 1, 0])})
+        cm = confusion_matrix(df, "y", "yhat", render=False)
+        np.testing.assert_array_equal(cm, [[1, 1], [1, 2]])
+
+    def test_roc_matches_known_curve(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.plot import roc
+        df = DataFrame({"y": np.array([0.0, 0.0, 1.0, 1.0]),
+                        "score": np.array([0.1, 0.4, 0.35, 0.8])})
+        fpr, tpr, thr = roc(df, "y", "score", render=False)
+        # sklearn.roc_curve on the same data: fpr [0,0,.5,.5,1], tpr [0,.5,.5,1,1]
+        np.testing.assert_allclose(fpr, [0, 0, 0.5, 0.5, 1.0])
+        np.testing.assert_allclose(tpr, [0, 0.5, 0.5, 1.0, 1.0])
+        auc = np.trapezoid(tpr, fpr)
+        assert abs(auc - 0.75) < 1e-9
+
+    def test_render_against_matplotlib(self):
+        mpl = pytest.importorskip("matplotlib")
+        mpl.use("Agg")
+        import matplotlib.pyplot as plt
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.plot import confusion_matrix, roc
+        df = DataFrame({"y": np.array([0, 1, 1]),
+                        "s": np.array([0.2, 0.7, 0.9]),
+                        "yhat": np.array([0, 1, 0])})
+        fig, ax = plt.subplots()
+        confusion_matrix(df, "y", "yhat", ax=ax)
+        roc(df, "y", "s", ax=ax)
+        plt.close(fig)
