@@ -9,7 +9,10 @@ histogram building + split finding over n x F x bins.
 Prints one JSON line per size with bin time and sec/iter.
 
 Usage: python scripts/bench_gbdt_higgs.py [sizes...]  (default 1e6 2e6 4e6)
-Env: HIGGS_ITERS (default 10), HIGGS_LEAVES (31), HIGGS_BIN (255)
+Env: HIGGS_ITERS (default 10), HIGGS_LEAVES (31), HIGGS_BIN (255);
+HIGGS_SKLEARN=1 additionally times sklearn HistGradientBoosting (a
+LightGBM-class CPU implementation) on the identical matrix — the external
+wall-clock yardstick next to the quality yardstick the test suite pins.
 """
 
 import json
@@ -64,6 +67,24 @@ def main():
             "train_auc": round(float(auc_in), 4),
             "platform": platform,
         }), flush=True)
+        if os.environ.get("HIGGS_SKLEARN", "0") == "1":
+            from sklearn.ensemble import HistGradientBoostingClassifier
+            clf = HistGradientBoostingClassifier(
+                max_iter=iters, max_leaf_nodes=leaves,
+                max_bins=min(max_bin, 255),     # sklearn's hard cap
+                learning_rate=0.1, early_stopping=False,
+                min_samples_leaf=20)
+            t0 = time.perf_counter()
+            clf.fit(X, y)
+            sk_total = time.perf_counter() - t0
+            sk_auc = _auc(y, clf.predict_proba(X)[:, 1])
+            print(json.dumps({
+                "metric": "gbdt_higgs_sklearn_hgb_sec_per_iter",
+                "n_rows": n, "value": round(sk_total / iters, 4),
+                "unit": "sec/iter", "train_auc": round(float(sk_auc), 4),
+                "platform": "cpu"}), flush=True)
+            del clf     # the binned copy must not survive into the next,
+            #             larger size's allocation
         del X, y, booster
 
 
