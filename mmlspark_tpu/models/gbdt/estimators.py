@@ -159,6 +159,15 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
                 self.get("booster_string").decode())
         return self._booster
 
+    def to_onnx(self) -> bytes:
+        """Serialize the fitted booster as a spec-compliant ONNX
+        TreeEnsemble graph — the native counterpart of the reference's
+        onnxmltools LightGBM conversion (``website/docs/features/onnx/
+        about.md``); consumable by this framework's ONNXModel or any
+        other ONNX runtime."""
+        from .onnx_export import booster_to_onnx
+        return booster_to_onnx(self.booster)
+
     def _load_extra(self, path):
         self._booster = None
 

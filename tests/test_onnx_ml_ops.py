@@ -318,3 +318,17 @@ class TestSVM:
         # the sign convention)
         np.testing.assert_allclose(scores[:, 0], [4.5, -3.5], rtol=1e-6)
         np.testing.assert_array_equal(labels, [0, 1])  # dec>0 → class i=0
+
+
+def test_model_to_onnx_method():
+    """The fitted model's to_onnx() convenience — the onnxmltools-flow
+    entry point users of the reference expect."""
+    rng = np.random.default_rng(6)
+    X = rng.normal(0, 1, (150, 4))
+    y = (X[:, 1] > 0).astype(np.int64)
+    model = LightGBMClassifier(num_iterations=5, num_leaves=4).fit(_df(X, y))
+    cm = convert_model(model.to_onnx())
+    Xq = rng.normal(0, 1, (20, 4)).astype(np.float32)
+    p1 = np.asarray(cm(cm.params, {"features": Xq})["probabilities"])[:, 1]
+    np.testing.assert_allclose(p1, model.booster.predict(Xq), rtol=1e-4,
+                               atol=1e-5)
