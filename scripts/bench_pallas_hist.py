@@ -90,8 +90,9 @@ def main():
 
     from mmlspark_tpu.ops.pallas_kernels import level_histogram_pallas
 
+    from mmlspark_tpu.utils.device import is_tpu
     backend = jax.default_backend()
-    on_tpu = backend == "tpu"
+    on_tpu = is_tpu()
     seg_jit = jax.jit(segment_sum_hist,
                       static_argnames=("n_nodes", "n_bins"))
 
