@@ -84,3 +84,31 @@ def test_quality_real(row):
         f"{row['dataset']} {metric} regressed: {got:.4f} < {pinned} - {tol}"
     assert got >= yardstick - 0.05, \
         f"{row['dataset']} {metric} {got:.4f} trails sklearn HGB {yardstick}"
+
+
+def test_onnx_roundtrip_quality_breast_cancer():
+    """Real-dataset end-to-end through the EXPORTED artifact: GBDT trained
+    on breast_cancer, serialized to ONNX TreeEnsemble, served by ONNXModel
+    — held-out AUC must match the native model to float tolerance, plus a
+    coarse absolute floor as a gross-regression tripwire. (The exact
+    CSV-pinned value covers the NATIVE path in test_quality_real; the
+    equality assert here transfers that pin to the exported path.)"""
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.models.onnx_model import ONNXModel
+
+    Xtr, Xte, ytr, yte = _split("breast_cancer")
+    m = LightGBMClassifier(num_iterations=100, learning_rate=0.1,
+                           num_leaves=31).fit(_df(Xtr, ytr))
+    native_p1 = m.booster.predict(Xte.astype(np.float32))
+
+    stage = ONNXModel(m.to_onnx(),
+                      feed_dict={"features": "features"},
+                      fetch_dict={"proba": "probabilities"},
+                      mini_batch_size=128, pin_devices=False)
+    out = stage.transform(DataFrame({"features": _vec(Xte)}))
+    onnx_p1 = np.stack(list(out["proba"]))[:, 1]
+
+    np.testing.assert_allclose(onnx_p1, native_p1, rtol=1e-4, atol=1e-5)
+    auc = roc_auc_score(yte, onnx_p1)
+    assert auc > 0.98, auc       # native path pins 0.9971 ± tolerance
