@@ -65,8 +65,8 @@ def _probe_default_backend(window_s: float):
     deadline = time.monotonic() + window_s
     result_dir = tempfile.mkdtemp(prefix="bench_probe_")
     attempt = 0
-    while time.monotonic() < deadline:
-        attempt += 1
+    while deadline - time.monotonic() > 2.0:    # no point spawning an
+        attempt += 1                            # attempt with no time left
         info["attempts"] = attempt
         out = os.path.join(result_dir, f"probe_{attempt}")
         # stderr goes to a FILE, not a pipe: an undrained pipe can block a
@@ -92,6 +92,13 @@ def _probe_default_backend(window_s: float):
             #                         successful probe look degraded
             return platform, kind, info
 
+        def _stderr_tail():
+            try:
+                with open(errpath) as fh:
+                    return fh.read()[-500:]
+            except OSError:
+                return ""
+
         while time.monotonic() < deadline:
             if os.path.exists(out):
                 return _success()
@@ -102,19 +109,27 @@ def _probe_default_backend(window_s: float):
                     # deadline and misreport the success as a hang
                     return _success()
                 # crashed — retry after a pause
-                try:
-                    with open(errpath) as fh:
-                        stderr_tail = fh.read()[-500:]
-                except OSError:
-                    stderr_tail = ""
                 info["reason"] = f"probe exited rc={child.returncode}: " \
-                                 f"{stderr_tail}"
+                                 f"{_stderr_tail()}"
                 time.sleep(min(30.0, 5.0 * attempt))
                 break
             time.sleep(1.0)
         else:
-            info["reason"] = (f"probe hung past the {window_s:.0f}s window; "
-                              "child left to exit on its own (never killed)")
+            # window expired mid-attempt: one last poll so a crash that
+            # raced the deadline keeps its diagnostic instead of being
+            # mislabeled as a hang (exists re-checked after poll — the
+            # wrote-then-exited race, same as the inner loop)
+            if os.path.exists(out):
+                return _success()
+            if child.poll() is not None:
+                if os.path.exists(out):
+                    return _success()
+                info["reason"] = (f"probe exited rc={child.returncode} at "
+                                  f"window end: {_stderr_tail()}")
+            else:
+                info["reason"] = (
+                    f"probe hung past the {window_s:.0f}s window; "
+                    "child left to exit on its own (never killed)")
             return None, None, info
     if info["reason"] is None:
         info["reason"] = f"window {window_s:.0f}s exhausted"
