@@ -204,9 +204,14 @@ class PyFuncModel:
         self.stage = stage
         self.metadata = metadata
 
-    def predict(self, data) -> DataFrame:
-        df = data if isinstance(data, DataFrame) else DataFrame(data)
-        return self.stage.transform(df)
+    def predict(self, data):
+        if isinstance(data, DataFrame):
+            return self.stage.transform(data)
+        if hasattr(data, "to_dict") and hasattr(data, "columns"):
+            # pandas in → pandas out, the mlflow.pyfunc contract
+            from .interop import transform_pandas
+            return transform_pandas(self.stage, data)
+        return self.stage.transform(DataFrame(data))
 
     def __repr__(self):
         flavor = self.metadata.get("flavors", {}).get(_FLAVOR, {})

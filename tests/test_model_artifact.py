@@ -55,6 +55,19 @@ class TestSaveLoad:
             {c: np.asarray(df[c]) for c in df.columns})
         assert "prediction" in out.columns
 
+    def test_predict_pandas_in_pandas_out(self, tmp_path):
+        """mlflow.pyfunc contract: pandas in → pandas out."""
+        import pandas as pd
+        model, df = _fitted_model_and_df()
+        p = str(tmp_path / "artifact")
+        save_model(model, p)
+        pdf = pd.DataFrame({c: np.asarray(df[c]) for c in df.columns})
+        out = load_model(p).predict(pdf)
+        assert isinstance(out, pd.DataFrame)
+        np.testing.assert_array_equal(
+            out["prediction"].to_numpy(),
+            np.asarray(model.transform(df)["prediction"]))
+
     def test_mlmodel_descriptor_is_valid_yaml_with_pyfunc_flavor(
             self, tmp_path):
         yaml = pytest.importorskip("yaml")
@@ -155,3 +168,4 @@ class TestOverwrite:
         assert os.path.exists(os.path.join(p, "input_example.json"))
         save_model(model, p, overwrite=True)    # no example this time
         assert not os.path.exists(os.path.join(p, "input_example.json"))
+
