@@ -81,6 +81,26 @@ def test_hang_abandons_without_killing(child):
     # parent did not wait for, nor terminate, the child.
 
 
+def test_real_probe_child_succeeds_on_cpu(tmp_path, monkeypatch):
+    """Execute the REAL _PROBE_CHILD source (no swap) on the CPU backend.
+
+    Round-4 regression: the child's self-check asserted
+    ``sum(ones @ ones) == 128**2`` instead of 128**3, so the probe crashed
+    on every HEALTHY backend — and the suite never noticed because each
+    test above replaces the child's code. The chip being wedged all round
+    masked it further (the probe always hung before reaching the assert).
+    Run in-process (the 1-core host makes subprocess timing flaky); the
+    spawn/retry machinery is covered by the other tests.
+    """
+    out = str(tmp_path / "probe_result")
+    monkeypatch.setattr(sys, "argv", ["probe", out])
+    exec(compile(bench._PROBE_CHILD, "<probe_child>", "exec"), {})
+    with open(out) as fh:
+        platform, kind, elapsed = fh.read().split("|")
+    assert platform == "cpu"
+    assert float(elapsed) >= 0.0
+
+
 def test_crash_then_success_clears_failure_reason(child, monkeypatch):
     """A retry that succeeds must not leave the earlier attempt's failure
     text in the artifact (code-review finding, round 4)."""
