@@ -12,6 +12,19 @@ import os
 # setting JAX_PLATFORMS=cpu via env hangs platform init under it — so drop the
 # var entirely and select cpu through jax.config before any backend spins up.
 os.environ.pop("JAX_PLATFORMS", None)
+
+# jax.config does NOT propagate to subprocesses: a test-spawned child that
+# imports jax does default plugin discovery, and with the TPU plugin's
+# sitecustomize dir on PYTHONPATH it will CLAIM THE REAL CHIP (the claim is
+# exclusive, and a hung/killed claimant wedges it for everyone — BASELINE.md
+# postmortem). Strip plugin dirs from the inherited PYTHONPATH so every child
+# of every test is CPU-only by construction.
+_pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+       if p and "axon" not in p]
+if _pp:
+    os.environ["PYTHONPATH"] = os.pathsep.join(_pp)
+else:
+    os.environ.pop("PYTHONPATH", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
