@@ -415,29 +415,59 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
             for _ in range(cfg.layers)]
 
 
-def _rope_at(t, pos, theta: float):
-    """Rotate a single-position (B, H, 1, D) tensor at (traced) ``pos``."""
-    cos, sin = _rope_tables(jnp.asarray(pos), t.shape[-1], theta, t.dtype)
-    return _rot_half(t, cos[None, None, None], sin[None, None, None])
-
-
 def decode_step(params: Dict, token: jnp.ndarray, pos, cache,
                 cfg: TransformerConfig):
     """One incremental decode step: ``token`` (B,) int at position ``pos``
     → (logits (B, vocab), updated cache). The KV-cache latency path of
-    :func:`generate` — O(L) attention per step instead of a full forward."""
+    :func:`generate` — O(L) attention per step instead of a full forward.
+
+    The shared-``pos`` special case of :func:`decode_step_ragged` (one
+    layer-loop implementation keeps the two bit-identical — the continuous
+    batching engine's parity invariant depends on it)."""
+    B = token.shape[0]
+    return decode_step_ragged(
+        params, token, jnp.full((B,), pos, jnp.int32), cache, cfg)
+
+
+def decode_step_ragged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
+                       cache, cfg: TransformerConfig,
+                       active: Optional[jnp.ndarray] = None):
+    """:func:`decode_step` with PER-ROW positions — the continuous-batching
+    step (``serving/continuous.py``): each cache slot advances at its own
+    position, so requests at different depths share one compiled program.
+
+    ``tokens`` (B,) int, ``pos`` (B,) int32 per-row write positions,
+    ``active`` (B,) bool (inactive rows keep their cache untouched and
+    their logits are don't-care) → (logits (B, vocab), updated cache).
+
+    Same math as :func:`decode_step` per row; the only structural deltas
+    are per-row RoPE/learned-position gathers, a vmapped per-row cache
+    scatter, and the per-row key mask ``arange(L) <= pos[:, None]``.
+    """
     if cfg.moe_experts:
         raise ValueError("cached decoding does not support MoE layers")
     dt = cfg.dtype
-    B = token.shape[0]
+    B = tokens.shape[0]
     L = cache[0]["k"].shape[2]
     hd = cfg.d_model // cfg.heads
-    h = params["embed"]["tok"].astype(dt)[token][:, None, :]  # (B, 1, D)
+    pos = pos.astype(jnp.int32)
+    h = params["embed"]["tok"].astype(dt)[tokens][:, None, :]   # (B, 1, D)
     if cfg.position == "learned":
-        h = h + jax.lax.dynamic_slice_in_dim(
-            params["embed"]["pos"].astype(dt), pos, 1, axis=0)[None]
+        h = h + params["embed"]["pos"].astype(dt)[pos][:, None, :]
+    if cfg.position == "rope":
+        cos, sin = _rope_tables(pos, hd, cfg.rope_theta, dt)    # (B, hd/2)
+        cos, sin = cos[:, None, None], sin[:, None, None]       # (B,1,1,·)
+
+    def scatter_row(buf, val, p):
+        # (H, L, hd) ← (H, 1, hd) at key-position p; vmapped over rows
+        return jax.lax.dynamic_update_slice(buf, val, (0, p, 0))
+
+    row_scatter = jax.vmap(scatter_row)
+    # decode_step's shared-pos path passes active=None: skip the masking
+    # entirely so the delegation costs nothing
+    keep = None if active is None else active[:, None, None, None]
+    key_mask = (jnp.arange(L)[None] <= pos[:, None])[:, None, None]  # B,1,1,L
     new_cache = []
-    key_mask = (jnp.arange(L) <= pos)[None, None, :]          # (1, 1, L)
     for lp, c in zip(params["layers"], cache):
         x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
         qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
@@ -448,16 +478,17 @@ def decode_step(params: Dict, token: jnp.ndarray, pos, cache,
 
         q, k, v = heads1(q), heads1(k), heads1(v)
         if cfg.position == "rope":
-            q = _rope_at(q, pos, cfg.rope_theta)
-            k = _rope_at(k, pos, cfg.rope_theta)
-        kc = jax.lax.dynamic_update_slice(c["k"], k.astype(dt),
-                                          (0, 0, pos, 0))
-        vc = jax.lax.dynamic_update_slice(c["v"], v.astype(dt),
-                                          (0, 0, pos, 0))
+            q = _rot_half(q, cos, sin)
+            k = _rot_half(k, cos, sin)
+        kc = row_scatter(c["k"], k.astype(dt), pos)
+        vc = row_scatter(c["v"], v.astype(dt), pos)
+        if keep is not None:
+            kc = jnp.where(keep, kc, c["k"])
+            vc = jnp.where(keep, vc, c["v"])
         new_cache.append({"k": kc, "v": vc})
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
                        preferred_element_type=jnp.float32) / np.sqrt(hd)
-        s = jnp.where(key_mask[:, :, None, :], s, jnp.float32(-1e30))
+        s = jnp.where(key_mask, s, jnp.float32(-1e30))
         p = jax.nn.softmax(s, axis=-1).astype(dt)
         ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
                          preferred_element_type=dt)
@@ -467,11 +498,69 @@ def decode_step(params: Dict, token: jnp.ndarray, pos, cache,
         y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
         y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
         h = h + y
-    # round to cfg.dtype exactly like transformer_apply, so greedy cached
-    # decoding cannot diverge from the full forward on bf16 configs
     hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
     logits = hidden[:, 0].astype(jnp.float32) @ params["lm_head"]["w"]
     return logits, new_cache
+
+
+def prefill_cache(params: Dict, ids: jnp.ndarray, length,
+                  cfg: TransformerConfig, max_len: int):
+    """Batched prompt prefill for continuous batching: ONE causal forward
+    over the (padded) prompt, capturing every layer's K/V into ``max_len``
+    cache buffers, plus the logits at the last real token.
+
+    ``ids`` (B, P) right-padded prompts, ``length`` (B,) real lengths
+    (1 ≤ length ≤ P) → (logits (B, vocab), cache list of (B, H, max_len,
+    hd) k/v). O(P) attention per token instead of :func:`generate_cached`'s
+    token-by-token prefill — the standard serving split (prefill batched,
+    decode incremental).
+    """
+    if cfg.moe_experts:
+        raise ValueError("cached decoding does not support MoE layers")
+    dt = cfg.dtype
+    B, P = ids.shape
+    if P > max_len:
+        raise ValueError(f"prompt {P} exceeds cache max_len {max_len}")
+    hd = cfg.d_model // cfg.heads
+    length = length.astype(jnp.int32)
+    valid = jnp.arange(P)[None] < length[:, None]               # (B, P)
+    h = params["embed"]["tok"].astype(dt)[ids]
+    if cfg.position == "learned":
+        h = h + params["embed"]["pos"].astype(dt)[:P][None]
+    tri = jnp.tril(jnp.ones((P, P), bool))
+    # causal AND key-valid: padded key columns never attend anywhere
+    attn_ok = tri[None, None] & valid[:, None, None, :]
+    cache = []
+    for lp in params["layers"]:
+        x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
+        qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, P, cfg.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.position == "rope":
+            q, k = _rope(q, k, cfg.rope_theta)
+        kc = jnp.pad(k.astype(dt), ((0, 0), (0, 0), (0, max_len - P), (0, 0)))
+        vc = jnp.pad(v.astype(dt), ((0, 0), (0, 0), (0, max_len - P), (0, 0)))
+        cache.append({"k": kc, "v": vc})
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = jnp.where(attn_ok, s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                         preferred_element_type=dt)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, P, cfg.d_model)
+        h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
+        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
+        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        h = h + y
+    hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
+    last = jnp.take_along_axis(hidden, (length - 1)[:, None, None], axis=1)
+    logits = last[:, 0].astype(jnp.float32) @ params["lm_head"]["w"]
+    return logits, cache
 
 
 def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,

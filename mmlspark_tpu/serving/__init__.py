@@ -23,6 +23,8 @@ row-at-a-time inference.
 from .server import CachedRequest, WorkerServer
 from .source import HTTPSource, parse_request, make_reply, HTTPSink
 from .engine import ServingEngine
+from .continuous import ContinuousDecoder
 
 __all__ = ["CachedRequest", "WorkerServer", "HTTPSource", "HTTPSink",
-           "parse_request", "make_reply", "ServingEngine"]
+           "parse_request", "make_reply", "ServingEngine",
+           "ContinuousDecoder"]

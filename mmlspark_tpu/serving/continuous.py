@@ -1,0 +1,241 @@
+"""Continuous batching for autoregressive decoding — LLM serving on TPU.
+
+Beyond reference parity: SynapseML's serving answers one request with one
+stateless transform (``HTTPSourceV2.scala:476-697``); an autoregressive
+model needs *stateful* multi-step service, and naive request-at-a-time
+decoding leaves the chip >90% idle at batch 1. The standard fix
+(Orca/vLLM-style continuous batching) is rebuilt here the TPU way:
+
+* a **static slot pool** — the KV-cache is a fixed (slots, heads, max_len,
+  head_dim) buffer per layer, so XLA compiles exactly TWO programs (batched
+  prefill + one ragged decode step) no matter how requests arrive;
+* **per-slot positions** (``decode_step_ragged``) — every occupied slot
+  advances at its own depth in the same compiled step, so new requests
+  join mid-flight without draining the batch ("iteration-level
+  scheduling");
+* **prefill/decode split** (``prefill_cache``) — prompts run as ONE causal
+  forward (MXU-friendly O(P) attention), then drop into a slot and decode
+  incrementally;
+* host-side bookkeeping only touches (slots,) vectors per tick — the
+  device→host traffic per emitted token is a few hundred bytes, which is
+  what the tunnel-dominated profile (BASELINE.md) wants.
+
+No paging: a zoo-scale engine favors the dense static cache (paged KV adds
+a gather per step and matters once max_len × slots outgrows HBM, which a
+single-chip zoo model never approaches).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.zoo.transformer import (TransformerConfig, decode_step_ragged,
+                                      prefill_cache)
+from ..ops.padding import bucket_size
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "max_new", "tokens", "done", "event",
+                 "submitted_at", "first_token_at", "finished_at")
+
+    def __init__(self, rid, prompt, max_new):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.tokens: List[int] = []
+        self.done = False
+        self.event = threading.Event()
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+
+class ContinuousDecoder:
+    """Slot-pool continuous-batching engine over the zoo decoder.
+
+    ``submit()`` is thread-safe and returns a ticket; ``step()`` runs one
+    engine tick (admit waiting prompts into free slots, one ragged decode
+    step over ALL occupied slots, retire finished rows). Call ``step()``
+    from a driver loop — or ``serve_forever()`` on a background thread.
+
+    Greedy decoding (the parity-testable mode): each request's output is
+    bit-identical to running :func:`generate_cached` on its prompt alone —
+    continuous batching changes THROUGHPUT, never results.
+    """
+
+    def __init__(self, params: Dict, cfg: TransformerConfig, *,
+                 max_slots: int = 4, max_len: int = 256,
+                 eos_id: Optional[int] = None):
+        if cfg.moe_experts:
+            raise ValueError("continuous decoding does not support MoE")
+        if not cfg.causal:
+            raise ValueError("ContinuousDecoder needs cfg.causal=True")
+        if cfg.position == "learned" and max_len > cfg.max_len:
+            # positions beyond the learned table would CLAMP (JAX gather
+            # semantics) and silently diverge from generate_cached
+            raise ValueError(
+                f"max_len {max_len} exceeds the learned position table "
+                f"cfg.max_len {cfg.max_len}")
+        self._cfg = cfg
+        self._S = int(max_slots)
+        self._L = int(max_len)
+        self._eos = eos_id
+        self._params = jax.device_put(jax.tree.map(jnp.asarray, params))
+        hd = cfg.d_model // cfg.heads
+        shape = (self._S, cfg.heads, self._L, hd)
+        self._cache = [{"k": jnp.zeros(shape, cfg.dtype),
+                        "v": jnp.zeros(shape, cfg.dtype)}
+                       for _ in range(cfg.layers)]
+        self._tok = jnp.zeros((self._S,), jnp.int32)
+        self._pos = jnp.zeros((self._S,), jnp.int32)
+        self._active = jnp.zeros((self._S,), bool)
+        self._slot_req: List[Optional[_Request]] = [None] * self._S
+        self._waiting: List[_Request] = []
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._stop = threading.Event()
+
+        # ---- the two compiled programs ----
+        # donate the KV cache (and the small state vectors) so XLA updates
+        # it in place — without donation every tick copies the full
+        # (slots, heads, max_len, hd) × layers × {k,v} buffer set, doubling
+        # peak cache HBM and its bandwidth on the hot path. CPU (the test
+        # backend) doesn't implement donation; gate to keep tests quiet.
+        donate = jax.default_backend() != "cpu"
+
+        def _tick(params, tok, pos, active, cache):
+            logits, cache = decode_step_ragged(params, tok, pos, cache,
+                                               cfg, active)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, pos + 1, pos)
+            return nxt, pos, cache
+
+        # active (arg 3) is NOT donated: _tick doesn't return it, and the
+        # engine keeps its binding across ticks
+        self._tick = jax.jit(
+            _tick, donate_argnums=(1, 2, 4) if donate else ())
+
+        # one compiled prefill per padded prompt bucket
+        def _prefill(params, ids, length):
+            return prefill_cache(params, ids, length, cfg, self._L)
+
+        self._prefill = jax.jit(_prefill)
+
+        def _insert(cache, slot, row_cache, tok, pos, active,
+                    first_tok, length):
+            for c, rc in zip(cache, row_cache):
+                for kk in ("k", "v"):
+                    c[kk] = jax.lax.dynamic_update_slice(
+                        c[kk], rc[kk], (slot, 0, 0, 0))
+            tok = tok.at[slot].set(first_tok)
+            pos = pos.at[slot].set(length)
+            active = active.at[slot].set(True)
+            return cache, tok, pos, active
+
+        self._insert = jax.jit(
+            _insert, donate_argnums=(0, 2, 3, 4, 5) if donate else ())
+
+    # ---- client surface ----
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> _Request:
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "itself emits the first token)")
+        if prompt.size + max_new_tokens > self._L:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new_tokens} exceeds "
+                f"cache max_len {self._L}")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(rid, prompt, int(max_new_tokens))
+            self._waiting.append(req)
+        return req
+
+    def result(self, req: _Request, timeout: Optional[float] = None):
+        if not req.event.wait(timeout):
+            raise TimeoutError(f"request {req.rid} not finished")
+        return list(req.tokens)
+
+    # ---- engine ----
+    def _admit(self):
+        """Move waiting requests into free slots (prefill + insert)."""
+        while True:
+            with self._lock:
+                free = [i for i in range(self._S)
+                        if self._slot_req[i] is None]
+                if not free or not self._waiting:
+                    return
+                slot = free[0]
+                req = self._waiting.pop(0)
+                self._slot_req[slot] = req
+            P = req.prompt.size
+            # cap the pad bucket at max_len: a 40-token prompt in a 48-len
+            # cache must not inflate to a 64-wide prefill
+            padded = min(self._L, max(8, bucket_size(P)))
+            ids = np.zeros((1, padded), np.int32)
+            ids[0, :P] = req.prompt
+            logits, row_cache = self._prefill(
+                self._params, jnp.asarray(ids),
+                jnp.asarray([P], jnp.int32))
+            first = jnp.argmax(logits[0]).astype(jnp.int32)
+            self._cache, self._tok, self._pos, self._active = self._insert(
+                self._cache, slot, row_cache, self._tok, self._pos,
+                self._active, first, jnp.int32(P))
+            # the prefill itself emitted the first new token
+            self._note_token(req, int(first))
+            if req.done:
+                self._release(slot)
+
+    def _note_token(self, req: _Request, tok: int):
+        now = time.perf_counter()
+        if req.first_token_at is None:
+            req.first_token_at = now
+        req.tokens.append(tok)
+        if ((self._eos is not None and tok == self._eos)
+                or len(req.tokens) >= req.max_new):
+            req.done = True
+            req.finished_at = now
+            req.event.set()
+
+    def _release(self, slot: int):
+        self._slot_req[slot] = None
+        self._active = self._active.at[slot].set(False)
+
+    def step(self) -> int:
+        """One engine tick; returns the number of live slots stepped."""
+        self._admit()
+        live = [i for i in range(self._S) if self._slot_req[i] is not None]
+        if not live:
+            return 0
+        self._tok, self._pos, self._cache = self._tick(
+            self._params, self._tok, self._pos, self._active, self._cache)
+        toks = np.asarray(self._tok)            # (S,) int32 — tiny fetch
+        for i in live:
+            req = self._slot_req[i]
+            self._note_token(req, int(toks[i]))
+            if req.done:
+                self._release(i)
+        return len(live)
+
+    def serve_forever(self, idle_sleep: float = 0.002):
+        while not self._stop.is_set():
+            if self.step() == 0:
+                self._stop.wait(idle_sleep)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True,
+                             name="continuous-decoder")
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()

@@ -33,5 +33,6 @@ run longctx   python scripts/bench_long_context.py
 run pallas    python scripts/bench_pallas_hist.py
 run mesh_spmd python scripts/bench_mesh_spmd.py
 run configs   python scripts/bench_configs.py
+run decode    python scripts/bench_decode.py
 run serving_tpu env BENCH_SERVING_TPU=1 python scripts/bench_serving.py
 echo "ALL DONE $(date -u)" >> "$OUT"

@@ -1,0 +1,151 @@
+"""Decoder (LLM) serving throughput: prefill and KV-cached decode.
+
+Beyond reference parity — SynapseML has no autoregressive serving story at
+all (its deep-learning module is batch ONNX inference,
+``deep-learning/.../onnx/ONNXModel.scala:305-355``). A TPU-native framework
+needs one: this bench measures the two phases every LLM-serving stack is
+judged on, on the native zoo decoder (``models/zoo/transformer.py``):
+
+* **prefill** — one batched causal forward over the prompt,
+  ``transformer_apply``; compute-bound, rides the MXU.
+* **decode** — ``lax.scan`` over ``decode_step`` with the static-shape
+  KV-cache updated in place via ``dynamic_update_slice``; one compiled
+  program serves the whole loop (no per-token dispatch), the TPU answer to
+  ORT's GroupQueryAttention decode loop.
+
+Prints one JSON line per phase. Sized by env: BENCH_DECODE_B (batch),
+BENCH_DECODE_P (prompt len), BENCH_DECODE_T (new tokens),
+BENCH_SCALE=small for CPU-friendly shapes. All timings fenced by fetched
+scalars (block_until_ready lies behind the tunnel — BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMALL = os.environ.get("BENCH_SCALE", "") == "small"
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.zoo.transformer import (
+        TransformerConfig, decode_step, init_kv_cache, init_transformer,
+        transformer_apply)
+    from mmlspark_tpu.utils.device import is_tpu
+
+    if SMALL or not is_tpu():
+        cfg = TransformerConfig(vocab=1024, layers=4, d_model=256, heads=8,
+                                d_ff=1024, max_len=256, causal=True,
+                                norm="rmsnorm", position="rope")
+        B, P, T = 4, 32, 32
+    else:
+        # GPT-2-small-class decoder (Llama-style: RMSNorm + RoPE), bf16
+        cfg = TransformerConfig(vocab=32000, layers=12, d_model=768,
+                                heads=12, d_ff=3072, max_len=2048,
+                                causal=True, norm="rmsnorm",
+                                position="rope")
+        B, P, T = 32, 128, 128
+    B = _env_int("BENCH_DECODE_B", B)
+    P = _env_int("BENCH_DECODE_P", P)
+    T = _env_int("BENCH_DECODE_T", T)
+
+    params = init_transformer(cfg, seed=0)
+    params = jax.device_put(jax.tree.map(jnp.asarray, params))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (B, P), dtype=np.int32))
+
+    # ---- prefill: one causal forward over the prompt ----
+    @jax.jit
+    def prefill(params, ids):
+        h = transformer_apply(params, ids, cfg)
+        return h[:, -1].astype(jnp.float32) @ params["lm_head"]["w"]
+
+    logits = prefill(params, prompt)                       # compile
+    float(jnp.sum(logits))                                 # fence
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jnp.sum(prefill(params, prompt)))
+        best = min(best, time.perf_counter() - t0)
+    prefill_tps = B * P / best
+    print(json.dumps({
+        "metric": "decoder_prefill_tokens_per_sec",
+        "value": round(prefill_tps, 1), "unit": "tokens/sec/chip",
+        "batch": B, "prompt_len": P, "params_m": round(n_params / 1e6, 1),
+        "ms": round(best * 1e3, 2),
+        "platform": jax.default_backend()}), flush=True)
+
+    # ---- decode: whole loop as ONE compiled scan over decode_step ----
+    L = P + T
+    cache0 = init_kv_cache(cfg, B, L)
+
+    @jax.jit
+    def decode(params, first_tok, cache):
+        def step(carry, t):
+            tok, cache = carry
+            logits, cache = decode_step(params, tok, P + t, cache, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, cache), None
+
+        (tok, cache), _ = jax.lax.scan(step, (first_tok, cache),
+                                       jnp.arange(T))
+        return tok
+
+    first = prompt[:, -1]
+    tok = decode(params, first, cache0)                    # compile
+    float(jnp.sum(tok))                                    # fence
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jnp.sum(decode(params, first, cache0)))
+        best = min(best, time.perf_counter() - t0)
+    decode_tps = B * T / best
+    print(json.dumps({
+        "metric": "decoder_cached_decode_tokens_per_sec",
+        "value": round(decode_tps, 1), "unit": "tokens/sec/chip",
+        "batch": B, "new_tokens": T, "kv_len": L,
+        "params_m": round(n_params / 1e6, 1),
+        "ms_per_token": round(best * 1e3 / T, 3),
+        "platform": jax.default_backend()}), flush=True)
+
+    # ---- continuous batching: staggered requests through the slot pool ----
+    from mmlspark_tpu.serving.continuous import ContinuousDecoder
+
+    n_req = _env_int("BENCH_DECODE_REQS", 2 * B)
+    eng = ContinuousDecoder(params, cfg, max_slots=B, max_len=P + T + 1)
+    rng2 = np.random.default_rng(1)
+    # warm both compiled programs (one prefill bucket + the ragged tick)
+    w = eng.submit(rng2.integers(0, cfg.vocab, P), max_new_tokens=2)
+    while not w.done:
+        eng.step()
+    reqs = [eng.submit(rng2.integers(0, cfg.vocab, P), max_new_tokens=T)
+            for _ in range(n_req)]
+    t0 = time.perf_counter()
+    while not all(r.done for r in reqs):
+        eng.step()
+    dt = time.perf_counter() - t0
+    total_toks = sum(len(r.tokens) for r in reqs)
+    ttft = [r.first_token_at - r.submitted_at for r in reqs]
+    print(json.dumps({
+        "metric": "decoder_continuous_batching_tokens_per_sec",
+        "value": round(total_toks / dt, 1), "unit": "tokens/sec/chip",
+        "slots": B, "requests": n_req, "prompt_len": P, "new_tokens": T,
+        "ttft_p50_ms": round(1e3 * sorted(ttft)[len(ttft) // 2], 1),
+        "ttft_max_ms": round(1e3 * max(ttft), 1),
+        "platform": jax.default_backend()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,272 @@
+"""Continuous-batching decoder serving (``serving/continuous.py``).
+
+The invariant everything here pins: continuous batching changes THROUGHPUT,
+never results — every request's greedy output must equal running
+``generate_cached`` on its prompt alone, no matter how requests are
+staggered, how slots are contended, or where prompts land in the pad
+bucket. (The reference has no autoregressive serving; the stateless
+analogue is replay determinism, ``HTTPSourceV2.scala:489-506``.)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo.transformer import (
+    TransformerConfig, decode_step, decode_step_ragged, generate_cached,
+    init_kv_cache, init_transformer, prefill_cache)
+from mmlspark_tpu.serving.continuous import ContinuousDecoder
+
+CFG = TransformerConfig(vocab=128, layers=2, d_model=64, heads=4, d_ff=128,
+                        max_len=64, causal=True, norm="rmsnorm",
+                        position="rope", dtype=jnp.float32)
+CFG_LEARNED = CFG._replace(position="learned", norm="layernorm")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(CFG, seed=0)
+
+
+class TestDecodeStepRagged:
+    @pytest.mark.parametrize("cfg_name", ["rope", "learned"])
+    def test_uniform_pos_matches_decode_step(self, cfg_name, params):
+        cfg = CFG if cfg_name == "rope" else CFG_LEARNED
+        p = params if cfg_name == "rope" else init_transformer(cfg, seed=0)
+        B, L, pos = 3, 16, 5
+        cache = init_kv_cache(cfg, B, L)
+        rng = np.random.default_rng(0)
+        # warm the cache at positions 0..4 so the step attends over history
+        for t in range(pos):
+            tok = jnp.asarray(rng.integers(0, cfg.vocab, B))
+            _, cache = decode_step(p, tok, t, cache, cfg)
+        tok = jnp.asarray(rng.integers(0, cfg.vocab, B))
+        want_logits, want_cache = decode_step(p, tok, pos, cache, cfg)
+        got_logits, got_cache = decode_step_ragged(
+            p, tok, jnp.full((B,), pos, jnp.int32), cache, cfg)
+        np.testing.assert_allclose(np.asarray(got_logits),
+                                   np.asarray(want_logits),
+                                   rtol=1e-5, atol=1e-5)
+        for gc, wc in zip(got_cache, want_cache):
+            np.testing.assert_allclose(np.asarray(gc["k"]),
+                                       np.asarray(wc["k"]),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_mixed_pos_matches_per_row_decode(self, params):
+        """Rows at DIFFERENT depths in one ragged step == each row stepped
+        alone at its own depth (the continuous-batching soundness core)."""
+        B, L = 3, 32
+        positions = [2, 7, 13]
+        rng = np.random.default_rng(1)
+        rows = []
+        for pos in positions:
+            cache1 = init_kv_cache(CFG, 1, L)
+            hist = rng.integers(0, CFG.vocab, pos + 1)
+            for t in range(pos):
+                _, cache1 = decode_step(params, jnp.asarray(hist[t:t + 1]),
+                                        t, cache1, CFG)
+            rows.append((hist, cache1))
+        # assemble the batch: per-row histories in one (B, …) cache
+        cache = [{kk: jnp.concatenate([r[1][i][kk] for r in rows])
+                  for kk in ("k", "v")} for i in range(CFG.layers)]
+        toks = jnp.asarray([r[0][-1] for r in rows])
+        got_logits, got_cache = decode_step_ragged(
+            params, toks, jnp.asarray(positions, jnp.int32), cache, CFG)
+        for b, pos in enumerate(positions):
+            want_logits, want_cache = decode_step(
+                params, toks[b:b + 1], pos, [
+                    {kk: c[kk][b:b + 1] for kk in ("k", "v")}
+                    for c in cache], CFG)
+            np.testing.assert_allclose(np.asarray(got_logits[b]),
+                                       np.asarray(want_logits[0]),
+                                       rtol=1e-5, atol=1e-5)
+            for gc, wc in zip(got_cache, want_cache):
+                np.testing.assert_allclose(np.asarray(gc["k"][b]),
+                                           np.asarray(wc["k"][0]),
+                                           rtol=1e-5, atol=1e-5)
+
+    def test_inactive_rows_keep_cache_and_position(self, params):
+        B, L = 2, 16
+        cache = init_kv_cache(CFG, B, L)
+        rng = np.random.default_rng(2)
+        for t in range(3):
+            _, cache = decode_step(params, jnp.asarray(
+                rng.integers(0, CFG.vocab, B)), t, cache, CFG)
+        tok = jnp.asarray(rng.integers(0, CFG.vocab, B))
+        active = jnp.asarray([True, False])
+        _, new_cache = decode_step_ragged(
+            params, tok, jnp.asarray([3, 3], jnp.int32), cache, CFG,
+            active)
+        # row 1 untouched everywhere, row 0 updated at position 3
+        for nc, c in zip(new_cache, cache):
+            np.testing.assert_array_equal(np.asarray(nc["k"][1]),
+                                          np.asarray(c["k"][1]))
+            assert not np.array_equal(np.asarray(nc["k"][0, :, 3]),
+                                      np.asarray(c["k"][0, :, 3]))
+
+
+class TestPrefillCache:
+    @pytest.mark.parametrize("cfg_name", ["rope", "learned"])
+    def test_matches_token_by_token_prefill(self, cfg_name):
+        cfg = CFG if cfg_name == "rope" else CFG_LEARNED
+        p = init_transformer(cfg, seed=3)
+        P, L = 6, 24
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, cfg.vocab, (1, P))
+        logits, cache = prefill_cache(p, jnp.asarray(prompt),
+                                      jnp.asarray([P]), cfg, L)
+        want_cache = init_kv_cache(cfg, 1, L)
+        for t in range(P):
+            want_logits, want_cache = decode_step(
+                p, jnp.asarray(prompt[:, t]), t, want_cache, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(want_logits),
+                                   rtol=1e-4, atol=1e-4)
+        for gc, wc in zip(cache, want_cache):
+            np.testing.assert_allclose(np.asarray(gc["k"][:, :, :P]),
+                                       np.asarray(wc["k"][:, :, :P]),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_right_padding_does_not_change_result(self):
+        p = init_transformer(CFG, seed=4)
+        P, pad_to, L = 5, 12, 24
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, CFG.vocab, (1, P))
+        padded = np.zeros((1, pad_to), np.int64)
+        padded[0, :P] = prompt
+        a, cache_a = prefill_cache(p, jnp.asarray(prompt),
+                                   jnp.asarray([P]), CFG, L)
+        b, cache_b = prefill_cache(p, jnp.asarray(padded),
+                                   jnp.asarray([P]), CFG, L)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+        # the REAL region of the cache is pad-invariant (positions >= P
+        # hold pad garbage that the ragged step's key mask never exposes
+        # before it is overwritten)
+        np.testing.assert_allclose(np.asarray(cache_a[0]["k"][:, :, :P]),
+                                   np.asarray(cache_b[0]["k"][:, :, :P]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def _reference_tokens(params, prompt, max_new):
+    ids = generate_cached(params, np.asarray(prompt)[None], CFG,
+                          max_new_tokens=max_new)
+    return list(np.asarray(ids)[0, len(prompt):])
+
+
+class TestContinuousDecoder:
+    def test_single_request_matches_generate_cached(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, CFG.vocab, 7)
+        req = eng.submit(prompt, max_new_tokens=9)
+        while not req.done:
+            eng.step()
+        assert eng.result(req) == _reference_tokens(params, prompt, 9)
+
+    def test_staggered_requests_all_match(self, params):
+        """Requests of different lengths admitted at different ticks, with
+        slot contention (3 requests, 2 slots), all greedy-exact."""
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, CFG.vocab, n) for n in (3, 9, 5)]
+        max_new = [6, 4, 8]
+        reqs = [eng.submit(prompts[0], max_new[0])]
+        eng.step()
+        reqs.append(eng.submit(prompts[1], max_new[1]))
+        eng.step()
+        reqs.append(eng.submit(prompts[2], max_new[2]))
+        for _ in range(80):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for prompt, mn, req in zip(prompts, max_new, reqs):
+            assert req.done
+            assert eng.result(req) == _reference_tokens(params, prompt, mn)
+
+    def test_eos_stops_early_and_frees_slot(self, params):
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, CFG.vocab, 4)
+        full = _reference_tokens(params, prompt, 10)
+        eos = full[3]                      # force a stop after 4 tokens
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                eos_id=eos)
+        req = eng.submit(prompt, max_new_tokens=10)
+        while not req.done:
+            eng.step()
+        got = eng.result(req)
+        assert got == full[:4]
+        assert eng._slot_req == [None]     # slot released
+
+    def test_more_requests_than_slots_queue_and_finish(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, CFG.vocab, 2 + i) for i in range(5)]
+        reqs = [eng.submit(p, 5) for p in prompts]
+        for _ in range(200):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for p, r in zip(prompts, reqs):
+            assert eng.result(r) == _reference_tokens(params, p, 5)
+
+    def test_background_thread_and_timing_fields(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        t = eng.start()
+        try:
+            rng = np.random.default_rng(9)
+            prompt = rng.integers(0, CFG.vocab, 6)
+            req = eng.submit(prompt, 5)
+            got = eng.result(req, timeout=60)
+            assert got == _reference_tokens(params, prompt, 5)
+            assert req.first_token_at is not None
+            assert req.finished_at >= req.first_token_at
+        finally:
+            eng.stop()
+            t.join(timeout=10)
+            assert not t.is_alive()
+
+    def test_submit_validation(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=16)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit([])
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(np.arange(10), max_new_tokens=10)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(np.arange(4), max_new_tokens=0)
+
+    def test_prompt_near_max_len_does_not_overflow_pad_bucket(self, params):
+        """Code-review regression: a 40-token prompt in a 48-len cache must
+        not inflate to a 64-wide prefill (bucket capped at max_len)."""
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48)
+        rng = np.random.default_rng(10)
+        prompt = rng.integers(0, CFG.vocab, 40)
+        req = eng.submit(prompt, max_new_tokens=8)
+        for _ in range(20):
+            if req.done:
+                break
+            eng.step()
+        assert eng.result(req) == _reference_tokens(params, prompt, 8)
+
+    def test_learned_positions_guard_max_len(self):
+        """A cache longer than the learned position table would CLAMP
+        gathers past the table and silently diverge — rejected up front."""
+        p = init_transformer(CFG_LEARNED, seed=0)
+        with pytest.raises(ValueError, match="position table"):
+            ContinuousDecoder(p, CFG_LEARNED, max_slots=1,
+                              max_len=CFG_LEARNED.max_len + 1)
+        # at the limit it works
+        eng = ContinuousDecoder(p, CFG_LEARNED, max_slots=1,
+                                max_len=CFG_LEARNED.max_len)
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(0, CFG_LEARNED.vocab, 5)
+        req = eng.submit(prompt, max_new_tokens=4)
+        for _ in range(10):
+            if req.done:
+                break
+            eng.step()
+        ids = generate_cached(p, np.asarray(prompt)[None], CFG_LEARNED,
+                              max_new_tokens=4)
+        assert eng.result(req) == list(np.asarray(ids)[0, 5:])
