@@ -24,7 +24,8 @@ from .server import CachedRequest, WorkerServer
 from .source import HTTPSource, parse_request, make_reply, HTTPSink
 from .engine import ServingEngine
 from .continuous import ContinuousDecoder
+from .generation import GenerationEngine
 
 __all__ = ["CachedRequest", "WorkerServer", "HTTPSource", "HTTPSink",
            "parse_request", "make_reply", "ServingEngine",
-           "ContinuousDecoder"]
+           "ContinuousDecoder", "GenerationEngine"]

@@ -226,6 +226,30 @@ class ContinuousDecoder:
                 self._release(i)
         return len(live)
 
+    def cancel_all(self):
+        """Fail every waiting and in-flight request (device-error recovery:
+        the owner calls this when :meth:`step` raises persistently, so the
+        slot pool can't stay occupied by requests nothing will ever
+        retire). Returns the cancelled requests; their ``tokens`` hold
+        whatever was emitted before the cancel and ``done`` is set."""
+        with self._lock:
+            waiting, self._waiting = self._waiting, []
+        cancelled = list(waiting)
+        for i in range(self._S):
+            req = self._slot_req[i]
+            if req is not None:
+                self._slot_req[i] = None
+                cancelled.append(req)
+        # fresh mask rather than .at[] updates — the device buffers may be
+        # the very thing that's broken
+        self._active = jnp.zeros((self._S,), bool)
+        now = time.perf_counter()
+        for req in cancelled:
+            req.done = True
+            req.finished_at = now
+            req.event.set()
+        return cancelled
+
     def serve_forever(self, idle_sleep: float = 0.002):
         while not self._stop.is_set():
             if self.step() == 0:

@@ -1,0 +1,139 @@
+"""HTTP generation endpoint over the continuous-batching decoder.
+
+Completes the LLM-serving story (``serving/continuous.py``): clients POST
+``{"tokens": [...], "max_new": N}`` and get ``{"tokens": [...]}`` back,
+with every in-flight request sharing the slot-pool decoder. The HTTP
+plumbing is the same WorkerServer the stateless engine uses
+(parity anchor: ``HTTPSourceV2.scala:476-697``); what's new is the
+lifecycle — a request parks across MANY engine ticks instead of one
+transform, so the loop interleaves (admit → tick → reply-finished) rather
+than (drain → transform → reply).
+
+One driver thread owns the decoder (submissions ride the decoder's own
+lock); replies route back through the server's request cache exactly like
+batch replies, so journaling/replay semantics are untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import traceback
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .continuous import ContinuousDecoder
+from .server import WorkerServer
+
+__all__ = ["GenerationEngine"]
+
+_log = logging.getLogger("mmlspark_tpu.serving")
+
+
+class GenerationEngine:
+    """Serve ``{"tokens": [...], "max_new": N}`` → ``{"tokens": [...]}``
+    over a :class:`ContinuousDecoder` slot pool."""
+
+    def __init__(self, params, cfg, *, max_slots: int = 4,
+                 max_len: int = 256, eos_id: Optional[int] = None,
+                 default_max_new: int = 32,
+                 host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/generate",
+                 reply_timeout: float = 120.0,
+                 transport: str = "threaded"):
+        self.decoder = ContinuousDecoder(params, cfg, max_slots=max_slots,
+                                         max_len=max_len, eos_id=eos_id)
+        self.default_max_new = int(default_max_new)
+        self.server = WorkerServer(host, port, api_path,
+                                   reply_timeout=reply_timeout,
+                                   transport=transport)
+        #: decoder rid -> (server request id, decoder ticket) — ONE source
+        #: of truth for in-flight work, mutated at one site per transition
+        self._inflight: Dict[int, Tuple[str, object]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return self.server.address.rstrip("/") + "/"
+
+    def start(self) -> "GenerationEngine":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"generation-engine-{self.server.port}")
+        self._thread.start()
+        return self
+
+    def _admit_one(self, cached) -> None:
+        """Parse + submit ONE request; any failure 400s only that request
+        (a malformed field must not poison the batch or the in-flight set —
+        the same isolation ServingEngine gets from its per-batch try)."""
+        rid = cached.request_id
+        try:
+            ent = cached.request.entity
+            body = json.loads(ent.string_content()) if ent else {}
+            toks = body.get("tokens")
+            if not toks:
+                raise ValueError("missing or empty 'tokens'")
+            mn = int(body.get("max_new", self.default_max_new))
+            ticket = self.decoder.submit(np.asarray(toks, np.int32), mn)
+        except Exception as e:
+            self.server.reply_json(rid, {"error": str(e)}, status=400)
+            return
+        self._inflight[ticket.rid] = (rid, ticket)
+
+    def _admit_http(self, idle: bool) -> None:
+        # mid-stream (live slots) the drain is non-blocking: a blocking
+        # poll here would add its timeout to EVERY emitted token's latency;
+        # only an idle engine waits for work
+        for cached in self.server.get_batch(64, timeout=0.002 if idle else 0):
+            self._admit_one(cached)
+
+    def _reply_finished(self) -> None:
+        done = [drid for drid, (_, t) in self._inflight.items() if t.done]
+        for drid in done:
+            rid, ticket = self._inflight.pop(drid)
+            self.server.reply_json(rid, {"tokens": ticket.tokens})
+        if done:
+            self.server.commit_epoch()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._admit_http(idle=not self._inflight)
+                stepped = self.decoder.step()
+                self._reply_finished()
+                if stepped == 0 and not self._inflight:
+                    self._stop.wait(0.005)
+            except Exception:
+                _log.error("generation engine tick failed:\n%s",
+                           traceback.format_exc())
+                # fail every in-flight request rather than hang clients,
+                # and free the slot pool (nothing will retire those slots
+                # if step() keeps raising)
+                for rid, _ in self._inflight.values():
+                    self.server.reply_json(
+                        rid, {"error": "internal error"}, status=500)
+                self._inflight.clear()
+                try:
+                    self.decoder.cancel_all()
+                except Exception:
+                    _log.error("decoder cancel_all failed:\n%s",
+                               traceback.format_exc())
+                # backoff: a persistent failure must not busy-spin the host
+                self._stop.wait(0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.decoder.stop()
+        self.server.close()
+
+    def __enter__(self) -> "GenerationEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

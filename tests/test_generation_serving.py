@@ -1,0 +1,159 @@
+"""HTTP generation endpoint (``serving/generation.py``): the continuous
+batching decoder behind the WorkerServer. Pins the lifecycle delta vs the
+stateless engine — a request parks across many ticks — plus the usual
+serving contracts (errors as 4xx JSON, concurrent clients, clean stop)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                 generate_cached,
+                                                 init_transformer)
+from mmlspark_tpu.serving.generation import GenerationEngine
+
+CFG = TransformerConfig(vocab=128, layers=2, d_model=64, heads=4, d_ff=128,
+                        max_len=64, causal=True, norm="rmsnorm",
+                        position="rope", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(CFG, seed=0)
+
+
+def _post(url, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _want(params, prompt, max_new):
+    ids = generate_cached(params, np.asarray(prompt)[None], CFG,
+                          max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(ids)[0, len(prompt):]]
+
+
+def test_single_request_roundtrip(params):
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48) as eng:
+        prompt = [5, 17, 9, 80]
+        status, body = _post(eng.address, {"tokens": prompt, "max_new": 6})
+        assert status == 200
+        assert body["tokens"] == _want(params, prompt, 6)
+
+
+def test_concurrent_clients_share_the_slot_pool(params):
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(0, CFG.vocab, 3 + i)]
+               for i in range(5)]
+    results = {}
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48) as eng:
+        def client(i):
+            results[i] = _post(eng.address,
+                               {"tokens": prompts[i], "max_new": 5})
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    for i, prompt in enumerate(prompts):
+        status, body = results[i]
+        assert status == 200
+        assert body["tokens"] == _want(params, prompt, 5), f"client {i}"
+
+
+def test_default_max_new_and_eos(params):
+    prompt = [3, 44, 7, 91]
+    full = _want(params, prompt, 10)
+    # pick an eos whose FIRST occurrence is mid-stream (random-init models
+    # repeat tokens, so full[j] may appear earlier than j)
+    j = next(j for j in range(1, len(full)) if full[j] not in full[:j])
+    eos = full[j]
+    eng = GenerationEngine(params, CFG, max_slots=1, max_len=48,
+                           eos_id=eos, default_max_new=10)
+    with eng:
+        status, body = _post(eng.address, {"tokens": prompt})  # no max_new
+        assert status == 200
+        assert body["tokens"] == full[:j + 1]   # stopped at eos, inclusive
+
+
+def test_bad_requests_get_400(params):
+    with GenerationEngine(params, CFG, max_slots=1, max_len=16) as eng:
+        for payload in ({"tokens": []},                 # empty
+                        {"max_new": 4},                 # missing tokens
+                        {"tokens": list(range(15)),     # over max_len
+                         "max_new": 8}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(eng.address, payload)
+            assert ei.value.code == 400
+            assert "error" in json.loads(ei.value.read())
+        # the engine still serves good requests afterwards
+        status, body = _post(eng.address, {"tokens": [1, 2], "max_new": 3})
+        assert status == 200
+        assert body["tokens"] == _want(params, [1, 2], 3)
+
+
+def test_malformed_request_does_not_poison_inflight(params):
+    """Code-review regression: one bad field must 400 only ITS request —
+    concurrent healthy requests still complete correctly."""
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48) as eng:
+        good_result = {}
+
+        def good_client():
+            good_result["r"] = _post(
+                eng.address, {"tokens": [9, 2, 77], "max_new": 8})
+        t = threading.Thread(target=good_client)
+        t.start()
+        for payload in ({"tokens": [1, 2], "max_new": "ten"},   # bad int
+                        {"tokens": "nope"},                      # bad list
+                        {"tokens": [[1], [2, 3]]}):              # ragged
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(eng.address, payload)
+            assert ei.value.code == 400
+        t.join(timeout=120)
+        status, body = good_result["r"]
+        assert status == 200
+        assert body["tokens"] == _want(params, [9, 2, 77], 8)
+
+
+def test_step_failure_fails_inflight_and_frees_pool(params):
+    """Code-review regression: a raising decoder.step must 500 in-flight
+    clients and release their slots, not hang them / leak the pool."""
+    eng = GenerationEngine(params, CFG, max_slots=1, max_len=48).start()
+    try:
+        real_step = eng.decoder.step
+        fail = threading.Event()
+
+        def flaky_step():
+            if fail.is_set():
+                raise RuntimeError("injected device error")
+            return real_step()
+        eng.decoder.step = flaky_step
+        fail.set()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(eng.address, {"tokens": [4, 5, 6], "max_new": 5})
+        assert ei.value.code == 500
+        fail.clear()
+        # pool must be free again: a fresh request succeeds
+        status, body = _post(eng.address, {"tokens": [4, 5, 6], "max_new": 5})
+        assert status == 200
+        assert body["tokens"] == _want(params, [4, 5, 6], 5)
+    finally:
+        eng.stop()
+
+
+def test_stop_is_clean(params):
+    eng = GenerationEngine(params, CFG, max_slots=1, max_len=32).start()
+    status, _ = _post(eng.address, {"tokens": [1, 2, 3], "max_new": 2})
+    assert status == 200
+    eng.stop()
+    assert not eng._thread.is_alive()
