@@ -34,25 +34,60 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.zoo.transformer import (TransformerConfig, decode_step_ragged,
-                                      prefill_cache)
+from ..models.zoo.transformer import (TransformerConfig, _sample_logits,
+                                      decode_step_ragged, prefill_cache)
 from ..ops.padding import bucket_size
 
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "tokens", "done", "event",
-                 "submitted_at", "first_token_at", "finished_at")
+                 "submitted_at", "first_token_at", "finished_at",
+                 "temperature", "top_k", "top_p", "seed")
 
-    def __init__(self, rid, prompt, max_new):
+    def __init__(self, rid, prompt, max_new, temperature=0.0, top_k=0,
+                 top_p=1.0, seed=0):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
         self.tokens: List[int] = []
         self.done = False
         self.event = threading.Event()
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+
+
+def _sample_rows(logits, temp, top_k, top_p, keys):
+    """Per-ROW-parameter version of ``transformer._sample_logits``: each of
+    the (S, V) rows carries its own temperature/top_k/top_p and PRNG key
+    (requests in one slot pool sample independently). Row-for-row equal to
+    ``_sample_logits`` run on that row alone with scalar params — the
+    neutral values (top_k=0 → k=V, top_p≥1 → cutoff at the sorted tail)
+    reduce every filter to a no-op, exactly like its ``need_k``/``need_p``
+    short-circuits."""
+    S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)          # (S,)
+    kth = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus mass over the k-filtered renormalized distribution (the HF
+    # convention _sample_logits follows)
+    posn = jnp.arange(V)[None]
+    sorted_f = jnp.where(posn >= k[:, None], -jnp.inf, sorted_l)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    eff_p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
+    cutoff_idx = jnp.sum(cum < eff_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
+    filtered = jnp.where(filtered < cutoff, -jnp.inf, filtered)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
 class ContinuousDecoder:
@@ -94,9 +129,16 @@ class ContinuousDecoder:
         self._tok = jnp.zeros((self._S,), jnp.int32)
         self._pos = jnp.zeros((self._S,), jnp.int32)
         self._active = jnp.zeros((self._S,), bool)
+        # per-slot sampling state (all-greedy pools never touch it: step()
+        # dispatches the cheaper greedy tick when no slot samples)
+        self._temp = jnp.zeros((self._S,), jnp.float32)
+        self._topk = jnp.zeros((self._S,), jnp.int32)
+        self._topp = jnp.ones((self._S,), jnp.float32)
+        self._key = jnp.zeros((self._S, 2), jnp.uint32)
         self._slot_req: List[Optional[_Request]] = [None] * self._S
         self._waiting: List[_Request] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()           # guards _waiting/_next_rid
+        self._engine_lock = threading.Lock()    # serializes step/cancel_all
         self._next_rid = 0
         self._stop = threading.Event()
 
@@ -121,6 +163,23 @@ class ContinuousDecoder:
         self._tick = jax.jit(
             _tick, donate_argnums=(1, 2, 4) if donate else ())
 
+        def _tick_sampled(params, tok, pos, active, cache,
+                          temp, topk, topp, key):
+            logits, cache = decode_step_ragged(params, tok, pos, cache,
+                                               cfg, active)
+            # emit position is pos+1 — generate_cached's key schedule
+            # (fold_in by absolute emit position), so sampled outputs are
+            # request-for-request identical to the offline generator
+            folded = jax.vmap(jax.random.fold_in)(key, pos + 1)
+            nxt = _sample_rows(logits.astype(jnp.float32),
+                               temp, topk, topp, folded)
+            nxt = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, pos + 1, pos)
+            return nxt, pos, cache
+
+        self._tick_sampled = jax.jit(
+            _tick_sampled, donate_argnums=(1, 2, 4) if donate else ())
+
         # one compiled prefill per padded prompt bucket
         def _prefill(params, ids, length):
             return prefill_cache(params, ids, length, cfg, self._L)
@@ -128,7 +187,7 @@ class ContinuousDecoder:
         self._prefill = jax.jit(_prefill)
 
         def _insert(cache, slot, row_cache, tok, pos, active,
-                    first_tok, length):
+                    first_tok, length, sample_state, sample_row):
             for c, rc in zip(cache, row_cache):
                 for kk in ("k", "v"):
                     c[kk] = jax.lax.dynamic_update_slice(
@@ -136,16 +195,28 @@ class ContinuousDecoder:
             tok = tok.at[slot].set(first_tok)
             pos = pos.at[slot].set(length)
             active = active.at[slot].set(True)
-            return cache, tok, pos, active
+            temp, topk, topp, key = sample_state
+            rt, rk, rp, rkey = sample_row
+            sample_state = (temp.at[slot].set(rt), topk.at[slot].set(rk),
+                            topp.at[slot].set(rp), key.at[slot].set(rkey))
+            return cache, tok, pos, active, sample_state
 
         self._insert = jax.jit(
-            _insert, donate_argnums=(0, 2, 3, 4, 5) if donate else ())
+            _insert, donate_argnums=(0, 2, 3, 4, 5, 8) if donate else ())
 
     # ---- client surface ----
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> _Request:
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> _Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
+        if prompt.min() < 0 or prompt.max() >= self._cfg.vocab:
+            # a traced gather would CLAMP out-of-range ids and generate
+            # from a silently different prompt
+            raise ValueError(
+                f"token ids must be in [0, {self._cfg.vocab}); got range "
+                f"[{prompt.min()}, {prompt.max()}]")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself emits the first token)")
@@ -153,10 +224,16 @@ class ContinuousDecoder:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new_tokens} exceeds "
                 f"cache max_len {self._L}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0 or temperature < 0.0:
+            raise ValueError("top_k and temperature must be >= 0")
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            req = _Request(rid, prompt, int(max_new_tokens))
+            req = _Request(rid, prompt, int(max_new_tokens),
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
             self._waiting.append(req)
         return req
 
@@ -186,10 +263,27 @@ class ContinuousDecoder:
             logits, row_cache = self._prefill(
                 self._params, jnp.asarray(ids),
                 jnp.asarray([P], jnp.int32))
-            first = jnp.argmax(logits[0]).astype(jnp.int32)
-            self._cache, self._tok, self._pos, self._active = self._insert(
+            base_key = jax.random.PRNGKey(req.seed)
+            if req.temperature > 0.0:
+                # exact generate_cached schedule: the token at position P
+                # is sampled with fold_in(key0, P)
+                first = _sample_logits(
+                    logits.astype(jnp.float32),
+                    jax.random.fold_in(base_key, P),
+                    req.temperature, req.top_k, req.top_p)[0]
+                first = first.astype(jnp.int32)
+            else:
+                first = jnp.argmax(logits[0]).astype(jnp.int32)
+            sample_state = (self._temp, self._topk, self._topp, self._key)
+            sample_row = (jnp.float32(req.temperature),
+                          jnp.int32(req.top_k), jnp.float32(req.top_p),
+                          base_key.astype(jnp.uint32))
+            (self._cache, self._tok, self._pos, self._active,
+             sample_state) = self._insert(
                 self._cache, slot, row_cache, self._tok, self._pos,
-                self._active, first, jnp.int32(P))
+                self._active, first, jnp.int32(P), sample_state,
+                sample_row)
+            self._temp, self._topk, self._topp, self._key = sample_state
             # the prefill itself emitted the first new token
             self._note_token(req, int(first))
             if req.done:
@@ -211,13 +305,25 @@ class ContinuousDecoder:
         self._active = self._active.at[slot].set(False)
 
     def step(self) -> int:
-        """One engine tick; returns the number of live slots stepped."""
+        """One engine tick; returns the number of live slots stepped.
+        Serialized against :meth:`cancel_all` (the only other slot-table
+        mutator callable from another thread)."""
+        with self._engine_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> int:
         self._admit()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
         if not live:
             return 0
-        self._tok, self._pos, self._cache = self._tick(
-            self._params, self._tok, self._pos, self._active, self._cache)
+        if any(self._slot_req[i].temperature > 0.0 for i in live):
+            self._tok, self._pos, self._cache = self._tick_sampled(
+                self._params, self._tok, self._pos, self._active,
+                self._cache, self._temp, self._topk, self._topp, self._key)
+        else:
+            self._tok, self._pos, self._cache = self._tick(
+                self._params, self._tok, self._pos, self._active,
+                self._cache)
         toks = np.asarray(self._tok)            # (S,) int32 — tiny fetch
         for i in live:
             req = self._slot_req[i]
@@ -231,18 +337,37 @@ class ContinuousDecoder:
         the owner calls this when :meth:`step` raises persistently, so the
         slot pool can't stay occupied by requests nothing will ever
         retire). Returns the cancelled requests; their ``tokens`` hold
-        whatever was emitted before the cancel and ``done`` is set."""
-        with self._lock:
-            waiting, self._waiting = self._waiting, []
-        cancelled = list(waiting)
-        for i in range(self._S):
-            req = self._slot_req[i]
-            if req is not None:
-                self._slot_req[i] = None
-                cancelled.append(req)
-        # fresh mask rather than .at[] updates — the device buffers may be
-        # the very thing that's broken
-        self._active = jnp.zeros((self._S,), bool)
+        whatever was emitted before the cancel and ``done`` is set.
+
+        Rebuilds EVERY device-state buffer, not just the active mask: with
+        donation on, a tick that raised after dispatch leaves _tok/_pos/
+        _cache (and the sampling vectors) referencing donated buffers XLA
+        has already deleted — reusing any of them would fail every
+        subsequent tick forever. All slots are being freed anyway, so
+        fresh zeros are exactly the post-cancel state."""
+        # taken by a non-driver thread while serve_forever is mid-step:
+        # without this lock the slot sweep races step()'s retire loop
+        with self._engine_lock:
+            with self._lock:
+                waiting, self._waiting = self._waiting, []
+            cancelled = list(waiting)
+            for i in range(self._S):
+                req = self._slot_req[i]
+                if req is not None:
+                    self._slot_req[i] = None
+                    cancelled.append(req)
+            cfg, hd = self._cfg, self._cfg.d_model // self._cfg.heads
+            shape = (self._S, cfg.heads, self._L, hd)
+            self._cache = [{"k": jnp.zeros(shape, cfg.dtype),
+                            "v": jnp.zeros(shape, cfg.dtype)}
+                           for _ in range(cfg.layers)]
+            self._tok = jnp.zeros((self._S,), jnp.int32)
+            self._pos = jnp.zeros((self._S,), jnp.int32)
+            self._active = jnp.zeros((self._S,), bool)
+            self._temp = jnp.zeros((self._S,), jnp.float32)
+            self._topk = jnp.zeros((self._S,), jnp.int32)
+            self._topp = jnp.ones((self._S,), jnp.float32)
+            self._key = jnp.zeros((self._S, 2), jnp.uint32)
         now = time.perf_counter()
         for req in cancelled:
             req.done = True

@@ -78,7 +78,12 @@ class GenerationEngine:
             if not toks:
                 raise ValueError("missing or empty 'tokens'")
             mn = int(body.get("max_new", self.default_max_new))
-            ticket = self.decoder.submit(np.asarray(toks, np.int32), mn)
+            ticket = self.decoder.submit(
+                np.asarray(toks, np.int32), mn,
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                seed=int(body.get("seed", 0)))
         except Exception as e:
             self.server.reply_json(rid, {"error": str(e)}, status=400)
             return
@@ -129,6 +134,13 @@ class GenerationEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # fail in-flight clients NOW instead of leaving their connections
+        # parked until reply_timeout's 504
+        for rid, _ in self._inflight.values():
+            self.server.reply_json(
+                rid, {"error": "server shutting down"}, status=503)
+        self._inflight.clear()
+        self.decoder.cancel_all()
         self.decoder.stop()
         self.server.close()
 

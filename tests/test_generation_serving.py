@@ -90,6 +90,8 @@ def test_bad_requests_get_400(params):
     with GenerationEngine(params, CFG, max_slots=1, max_len=16) as eng:
         for payload in ({"tokens": []},                 # empty
                         {"max_new": 4},                 # missing tokens
+                        {"tokens": [1, CFG.vocab]},     # out-of-vocab id
+                        {"tokens": [1, -3]},            # negative id
                         {"tokens": list(range(15)),     # over max_len
                          "max_new": 8}):
             with pytest.raises(urllib.error.HTTPError) as ei:
@@ -151,9 +153,56 @@ def test_step_failure_fails_inflight_and_frees_pool(params):
         eng.stop()
 
 
+def test_sampling_fields_round_trip(params):
+    """temperature/top_k/top_p/seed ride the JSON body; the reply matches
+    the offline generator with the same sampling config."""
+    prompt = [8, 3, 120, 44]
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48) as eng:
+        status, body = _post(eng.address, {
+            "tokens": prompt, "max_new": 6, "temperature": 0.9,
+            "top_k": 10, "seed": 42})
+        assert status == 200
+        ids = generate_cached(params, np.asarray(prompt)[None], CFG,
+                              max_new_tokens=6, temperature=0.9, top_k=10,
+                              seed=42)
+        assert body["tokens"] == [int(t) for t in np.asarray(ids)[0, 4:]]
+        # invalid sampling params are a 400, not an engine failure
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(eng.address, {"tokens": prompt, "top_p": 0.0})
+        assert ei.value.code == 400
+
+
 def test_stop_is_clean(params):
     eng = GenerationEngine(params, CFG, max_slots=1, max_len=32).start()
     status, _ = _post(eng.address, {"tokens": [1, 2, 3], "max_new": 2})
     assert status == 200
     eng.stop()
     assert not eng._thread.is_alive()
+
+
+def test_stop_fails_inflight_fast_with_503(params):
+    """Code-review regression: stop() must answer parked clients now, not
+    leave them hanging until reply_timeout."""
+    import time
+    eng = GenerationEngine(params, CFG, max_slots=1, max_len=48,
+                           reply_timeout=60.0).start()
+    result = {}
+
+    def client():
+        try:
+            result["r"] = _post(eng.address,
+                                {"tokens": [5, 6], "max_new": 40})
+        except urllib.error.HTTPError as e:
+            result["code"] = e.code
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.3)                       # let it admit and start decoding
+    t0 = time.perf_counter()
+    eng.stop()
+    t.join(timeout=30)
+    took = time.perf_counter() - t0
+    assert not t.is_alive()
+    # either it finished before stop() (rare on CPU: 40 ticks) or it was
+    # failed fast with 503 — never parked until the 60 s timeout
+    assert took < 20
+    assert result.get("code") == 503 or "r" in result
