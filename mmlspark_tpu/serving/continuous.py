@@ -33,9 +33,11 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.zoo.transformer import (TransformerConfig, _sample_logits,
-                                      decode_step_ragged, prefill_cache)
+                                      decode_step_ragged, prefill_cache,
+                                      shardings_for)
 from ..ops.padding import bucket_size
 
 
@@ -105,7 +107,8 @@ class ContinuousDecoder:
 
     def __init__(self, params: Dict, cfg: TransformerConfig, *,
                  max_slots: int = 4, max_len: int = 256,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 mesh: Optional[Mesh] = None):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -120,21 +123,46 @@ class ContinuousDecoder:
         self._S = int(max_slots)
         self._L = int(max_len)
         self._eos = eos_id
-        self._params = jax.device_put(jax.tree.map(jnp.asarray, params))
+        self._mesh = mesh
+        params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
         shape = (self._S, cfg.heads, self._L, hd)
-        self._cache = [{"k": jnp.zeros(shape, cfg.dtype),
-                        "v": jnp.zeros(shape, cfg.dtype)}
-                       for _ in range(cfg.layers)]
-        self._tok = jnp.zeros((self._S,), jnp.int32)
-        self._pos = jnp.zeros((self._S,), jnp.int32)
-        self._active = jnp.zeros((self._S,), bool)
-        # per-slot sampling state (all-greedy pools never touch it: step()
-        # dispatches the cheaper greedy tick when no slot samples)
-        self._temp = jnp.zeros((self._S,), jnp.float32)
-        self._topk = jnp.zeros((self._S,), jnp.int32)
-        self._topp = jnp.ones((self._S,), jnp.float32)
-        self._key = jnp.zeros((self._S, 2), jnp.uint32)
+        if mesh is None:
+            self._params = jax.device_put(params)
+            cache_sharding = state_sharding = None
+        else:
+            # tensor-parallel serving: Megatron layout on the params
+            # (shardings_for), KV heads over "tp", slots over "dp" when
+            # present and divisible — GSPMD propagates through the ragged
+            # step exactly as it does through transformer_apply
+            tp = mesh.shape.get("tp", 1)
+            if cfg.heads % tp:
+                raise ValueError(
+                    f"heads {cfg.heads} not divisible by mesh tp={tp}")
+            dp = mesh.shape.get("dp", 1)
+            slot_axis = "dp" if (dp > 1 and self._S % dp == 0) else None
+            # a dp-only mesh is legal (request data parallelism without
+            # tensor parallelism) — only name axes the mesh actually has
+            head_axis = "tp" if "tp" in mesh.axis_names else None
+            cache_sharding = NamedSharding(
+                mesh, P(slot_axis, head_axis, None, None))
+            state_sharding = NamedSharding(mesh, P())
+            # dp-only mesh: replicate params (shardings_for names "tp")
+            self._params = jax.device_put(
+                params, shardings_for(params, mesh)
+                if head_axis else state_sharding)
+
+        def _zeros(shape_, dtype, sharded=False, fill=None):
+            z = (jnp.zeros(shape_, dtype) if fill is None
+                 else jnp.full(shape_, fill, dtype))
+            if mesh is None:
+                return z
+            return jax.device_put(
+                z, cache_sharding if sharded else state_sharding)
+
+        self._zeros = _zeros
+        self._cache_shape = shape
+        self._reset_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self._S
         self._waiting: List[_Request] = []
         self._lock = threading.Lock()           # guards _waiting/_next_rid
@@ -203,6 +231,25 @@ class ContinuousDecoder:
 
         self._insert = jax.jit(
             _insert, donate_argnums=(0, 2, 3, 4, 5, 8) if donate else ())
+
+    def _reset_device_state(self):
+        """(Re)build every slot-pool device buffer — at construction and in
+        :meth:`cancel_all` (post-failure the old, possibly-donated buffers
+        must never be reused). Mesh shardings are re-applied here so a
+        cancel on a tensor-parallel pool stays tensor-parallel."""
+        cfg, shape = self._cfg, self._cache_shape
+        self._cache = [{"k": self._zeros(shape, cfg.dtype, sharded=True),
+                        "v": self._zeros(shape, cfg.dtype, sharded=True)}
+                       for _ in range(cfg.layers)]
+        self._tok = self._zeros((self._S,), jnp.int32)
+        self._pos = self._zeros((self._S,), jnp.int32)
+        self._active = self._zeros((self._S,), bool)
+        # per-slot sampling state (all-greedy pools never touch it: step()
+        # dispatches the cheaper greedy tick when no slot samples)
+        self._temp = self._zeros((self._S,), jnp.float32)
+        self._topk = self._zeros((self._S,), jnp.int32)
+        self._topp = self._zeros((self._S,), jnp.float32, fill=1.0)
+        self._key = self._zeros((self._S, 2), jnp.uint32)
 
     # ---- client surface ----
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
@@ -356,18 +403,7 @@ class ContinuousDecoder:
                 if req is not None:
                     self._slot_req[i] = None
                     cancelled.append(req)
-            cfg, hd = self._cfg, self._cfg.d_model // self._cfg.heads
-            shape = (self._S, cfg.heads, self._L, hd)
-            self._cache = [{"k": jnp.zeros(shape, cfg.dtype),
-                            "v": jnp.zeros(shape, cfg.dtype)}
-                           for _ in range(cfg.layers)]
-            self._tok = jnp.zeros((self._S,), jnp.int32)
-            self._pos = jnp.zeros((self._S,), jnp.int32)
-            self._active = jnp.zeros((self._S,), bool)
-            self._temp = jnp.zeros((self._S,), jnp.float32)
-            self._topk = jnp.zeros((self._S,), jnp.int32)
-            self._topp = jnp.ones((self._S,), jnp.float32)
-            self._key = jnp.zeros((self._S, 2), jnp.uint32)
+            self._reset_device_state()
         now = time.perf_counter()
         for req in cancelled:
             req.done = True

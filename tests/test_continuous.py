@@ -13,6 +13,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from mmlspark_tpu.models.zoo.transformer import (
     TransformerConfig, decode_step, decode_step_ragged, generate_cached,
@@ -289,6 +290,72 @@ class TestContinuousDecoder:
                                   seed=seed)
             assert eng.result(req) == list(
                 np.asarray(ids)[0, len(prompt):])
+
+    def test_tensor_parallel_mesh_matches_unsharded(self, params):
+        """Continuous decoding over a tp mesh (Megatron params, KV heads
+        sharded) is token-for-token the single-device engine — GSPMD
+        propagation through the ragged step, greedy AND sampled."""
+        mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                mesh=mesh)
+        rng = np.random.default_rng(16)
+        p1 = rng.integers(0, CFG.vocab, 5)
+        p2 = rng.integers(0, CFG.vocab, 8)
+        r1 = eng.submit(p1, 6)
+        r2 = eng.submit(p2, 6, temperature=0.9, top_k=8, seed=5)
+        for _ in range(30):
+            if r1.done and r2.done:
+                break
+            eng.step()
+        assert eng.result(r1) == _reference_tokens(params, p1, 6)
+        ids = generate_cached(params, np.asarray(p2)[None], CFG,
+                              max_new_tokens=6, temperature=0.9, top_k=8,
+                              seed=5)
+        assert eng.result(r2) == list(np.asarray(ids)[0, 8:])
+
+    def test_dp_tp_mesh_with_sharded_slots(self, params):
+        """dp×tp mesh: slots shard over dp (request data parallelism),
+        heads over tp; cancel_all keeps the shardings."""
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "tp"))
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                mesh=mesh)
+        rng = np.random.default_rng(17)
+        prompt = rng.integers(0, CFG.vocab, 6)
+        req = eng.submit(prompt, 5)
+        for _ in range(10):
+            if req.done:
+                break
+            eng.step()
+        assert eng.result(req) == _reference_tokens(params, prompt, 5)
+        eng.cancel_all()                       # must keep mesh shardings
+        req2 = eng.submit(prompt, 5)
+        for _ in range(10):
+            if req2.done:
+                break
+            eng.step()
+        assert eng.result(req2) == _reference_tokens(params, prompt, 5)
+
+    def test_dp_only_mesh_replicates_params(self, params):
+        """Code-review regression: a mesh without a tp axis (pure request
+        data parallelism) must work, not die in NamedSharding."""
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                mesh=mesh)
+        rng = np.random.default_rng(18)
+        prompt = rng.integers(0, CFG.vocab, 5)
+        req = eng.submit(prompt, 5)
+        for _ in range(10):
+            if req.done:
+                break
+            eng.step()
+        assert eng.result(req) == _reference_tokens(params, prompt, 5)
+
+    def test_mesh_heads_divisibility_rejected(self, params):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("tp",))
+        with pytest.raises(ValueError, match="divisible"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              mesh=mesh)          # heads=4, tp=8
 
     def test_submit_validation(self, params):
         eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=16)
