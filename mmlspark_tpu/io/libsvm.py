@@ -28,13 +28,20 @@ def read_libsvm(path: str, n_features: Optional[int] = None,
                 zero_based: Optional[bool] = None,
                 label_col: str = "label", features_col: str = "features",
                 group_col: str = "group",
-                npartitions: int = 1) -> DataFrame:
+                npartitions: int = 1, sparse: bool = False) -> DataFrame:
     """Read a libsvm file into a DataFrame with dense feature rows.
 
     ``zero_based=None`` auto-detects: files whose minimum feature index is 0
     are taken as 0-based, else 1-based (the svmlight convention). ``qid:``
     tokens become a ``group`` column (the ranker's query ids); rows without
     qid omit the column entirely.
+
+    ``sparse=True`` keeps the parsed CSR structure: the features column
+    holds scipy CSR row vectors that ``assemble_features`` re-stacks into
+    one CSR matrix, so a wide sparse file (text hashes, one-hot ids)
+    reaches the GBDT binning layer without ever densifying — the
+    ingestion shape of the reference's sparse path
+    (``DatasetAggregator.scala:127-183``).
     """
     with open(path, "rb") as f:
         labels, qids, indptr, indices, values = parse_libsvm(f.read())
@@ -62,11 +69,31 @@ def read_libsvm(path: str, n_features: Optional[int] = None,
     if len(idx) and idx.max() >= F:
         raise ValueError(f"libsvm: feature index {int(idx.max())} >= "
                          f"n_features {F}")
-    dense = np.zeros((n, F), dtype=np.float32)
-    rows = np.repeat(np.arange(n), np.diff(indptr))
-    dense[rows, idx] = values
     col = np.empty(n, dtype=object)
-    col[:] = list(dense)
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    if sparse:
+        import scipy.sparse as sp
+        # duplicate indices in a row: keep the LAST occurrence — the same
+        # semantics as the dense path's scatter below (CSR construction
+        # would otherwise SUM duplicates, silently diverging from dense)
+        keys = rows.astype(np.int64) * max(F, 1) + idx
+        _, last_rev = np.unique(keys[::-1], return_index=True)
+        keep = np.sort(len(keys) - 1 - last_rev)
+        vals32 = values[keep].astype(np.float32)
+        idx_k = idx[keep]
+        counts = np.bincount(rows[keep], minlength=n)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        for i in range(n):
+            lo, hi = offs[i], offs[i + 1]
+            # built straight from array views — per-row csr slicing of a
+            # big matrix costs a binary search per row
+            col[i] = sp.csr_matrix(
+                (vals32[lo:hi], idx_k[lo:hi], np.array([0, hi - lo])),
+                shape=(1, F))
+    else:
+        dense = np.zeros((n, F), dtype=np.float32)
+        dense[rows, idx] = values
+        col[:] = list(dense)
     cols = {features_col: col, label_col: labels}
     has_qid = qids >= 0
     if has_qid.any():

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .binning import BinMapper
-from .trees import predict_leaf_indices, predict_trees
+from .trees import predict_leaf_indices, predict_trees, predict_trees_any
 
 __all__ = ["Booster"]
 
@@ -130,9 +130,17 @@ class Booster:
     # splits must be distinguishable in float32 (|x| < 2^23 for integer ids, so bin-midpoint
     # thresholds stay representable)
     # — a deliberate deviation from LightGBM's double-precision thresholds.
-    def _x_eff(self, X: np.ndarray) -> np.ndarray:
+    def _x_eff(self, X: np.ndarray):
         """Raw matrix → the space the trees split in (categorical columns
-        replaced by their label-ordered ranks)."""
+        replaced by their label-ordered ranks). scipy-sparse X passes
+        through untouched (predict densifies it in bounded chunks)."""
+        from .binning import is_sparse
+        if is_sparse(X):
+            if self.cat_encoder is not None:
+                raise ValueError("categorical encoding and sparse features "
+                                 "cannot combine (rank-encode before "
+                                 "sparsifying, or pass dense input)")
+            return X
         if self.cat_encoder is not None:
             X = self.cat_encoder.transform(np.asarray(X))
         return np.asarray(X, dtype=np.float32)
@@ -140,10 +148,11 @@ class Booster:
     def raw_score(self, X: np.ndarray) -> np.ndarray:
         X = self._x_eff(X)
         if self.num_trees == 0:
-            shape = (len(X), self.num_class) if self.num_class > 1 else (len(X),)
+            shape = (X.shape[0], self.num_class) if self.num_class > 1 \
+                else (X.shape[0],)
             return np.full(shape, self.base_score, dtype=np.float32)
-        out = predict_trees(self.feats, self.thr_raw, self.leaf_values,
-                            X, depth=self.depth)
+        out = predict_trees_any(self.feats, self.thr_raw, self.leaf_values,
+                                X, depth=self.depth)
         return np.asarray(out) + self.base_score
 
     def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
@@ -156,8 +165,11 @@ class Booster:
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         X = self._x_eff(X)
-        return np.asarray(predict_leaf_indices(self.feats, self.thr_raw, X,
-                                               depth=self.depth))
+        from .trees import apply_chunked_dense
+        return apply_chunked_dense(
+            lambda xd: predict_leaf_indices(self.feats, self.thr_raw, xd,
+                                            depth=self.depth),
+            X, empty_shape=(0, self.num_trees), empty_dtype=np.int32)
 
     # -- TreeSHAP -----------------------------------------------------------
     def shap_values(self, X: np.ndarray) -> np.ndarray:
@@ -165,7 +177,17 @@ class Booster:
         contributions plus the expected value in the last column (the layout
         LightGBM's predict_contrib emits)."""
         from .treeshap import tree_shap
+        from .binning import is_sparse
         X = self._x_eff(X)
+        if is_sparse(X):
+            # the SHAP recursion walks every tree per row anyway — densify
+            # in chunks so peak memory stays O(chunk × F)
+            from .trees import apply_chunked_dense
+            width = (self.num_class, 0, self.n_features + 1) \
+                if self.num_class > 1 else (0, self.n_features + 1)
+            return apply_chunked_dense(self.shap_values, X,
+                                       empty_shape=width, chunk=1 << 14,
+                                       concat_axis=-2)
         n = len(X)
         K = self.num_class if self.num_class > 1 else 1
         phi = np.zeros((K, n, self.n_features + 1), dtype=np.float64)

@@ -20,7 +20,8 @@ from ...core.dataframe import DataFrame
 from ...core.params import (ComplexParam, Param, HasFeaturesCol, HasLabelCol,
                             HasPredictionCol, HasProbabilityCol, HasWeightCol)
 from ...core.pipeline import Estimator, Model
-from ...core.schema import assemble_vector, get_label_metadata, set_label_metadata
+from ...core.schema import (assemble_features, get_label_metadata,
+                            set_label_metadata)
 from ...parallel.mesh import get_default_mesh
 from .booster import Booster
 from .train import resolve_params, train
@@ -108,14 +109,14 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     def _fit_core(self, df: DataFrame, extra_params: dict,
                   group_col: Optional[str] = None) -> Booster:
         train_df, valid_df = self._split_valid(df)
-        X = assemble_vector(train_df, [self.features_col])
+        X = assemble_features(train_df, [self.features_col])
         y = np.asarray(train_df[self.label_col], dtype=np.float64)
         w = (np.asarray(train_df[self.weight_col], dtype=np.float64)
              if self.get_or_none("weight_col") and self.weight_col in train_df
              else None)
         valid_sets = None
         if valid_df is not None and len(valid_df):
-            valid_sets = [(assemble_vector(valid_df, [self.features_col]),
+            valid_sets = [(assemble_features(valid_df, [self.features_col]),
                            np.asarray(valid_df[self.label_col], dtype=np.float64))]
         group = None
         if group_col is not None:
@@ -172,7 +173,7 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
         self._booster = None
 
     def _features(self, df: DataFrame) -> np.ndarray:
-        return assemble_vector(df, [self.features_col]).astype(np.float32)
+        return assemble_features(df, [self.features_col]).astype(np.float32)
 
     def _add_aux_cols(self, df: DataFrame, X: np.ndarray) -> DataFrame:
         lcol = self.get_or_none("leaf_prediction_col")

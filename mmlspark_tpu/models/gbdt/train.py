@@ -196,10 +196,24 @@ def train(params: Dict,
     # keep X in its incoming float width — a HIGGS-scale float32 matrix must
     # not be silently doubled to float64 (binning only ever copies a sample
     # and per-column temporaries); integers upcast to float64 so large ids
-    # (> 2^24) stay distinct
-    X = np.asarray(X)
-    if X.dtype.kind != "f":
-        X = X.astype(np.float64)
+    # (> 2^24) stay distinct. scipy-sparse X stays sparse end-to-end: the
+    # binned uint8 matrix is the only dense artifact (parity:
+    # LGBM_DatasetCreateFromCSR, DatasetAggregator.scala:441-465)
+    from .binning import is_sparse
+    sparse_X = is_sparse(X)
+    if sparse_X:
+        X = X.tocsr()
+        if X.dtype.kind != "f":
+            X = X.astype(np.float64)
+        if p["categorical_feature"]:
+            raise ValueError(
+                "categorical_feature is not supported with sparse input "
+                "(rank-encode the categorical columns before sparsifying, "
+                "or pass a dense matrix)")
+    else:
+        X = np.asarray(X)
+        if X.dtype.kind != "f":
+            X = X.astype(np.float64)
     y = np.asarray(y, dtype=np.float64)
     n, F = X.shape
     w = (np.asarray(sample_weight, dtype=np.float64) if sample_weight is not None
@@ -254,6 +268,10 @@ def train(params: Dict,
         # warm starts reuse the prior booster's encoding (its trees split
         # in that rank space)
         from .categorical import CategoricalEncoder
+        if sparse_X:
+            raise ValueError("categorical encoding and sparse input cannot "
+                             "combine (the warm-start model was trained "
+                             "with categorical_feature)")
         if init_model is not None and init_model.cat_encoder is not None:
             cat_encoder = init_model.cat_encoder
         elif init_model is not None:
@@ -279,6 +297,7 @@ def train(params: Dict,
                    if boosting == "dart" else init_model)
         base_score = booster.base_score
         # raw_score applies the encoder itself — feed the UN-encoded matrix
+        # (sparse passes through; raw_score densifies in bounded chunks)
         scores = booster.raw_score(
             X_raw if X_raw.dtype == np.float32 else X_raw.astype(np.float32)
         ) - np.float32(base_score)
@@ -373,20 +392,28 @@ def train(params: Dict,
     patience = int(p["early_stopping_round"])
     valid_scores = None
     if valid_sets:
+        valid_sets = [(vx if is_sparse(vx) else np.asarray(vx), vy)
+                      for vx, vy in valid_sets]
         if init_trees:
-            valid_scores = [booster.raw_score(np.asarray(vx, dtype=np.float32))
-                            .astype(np.float64) for vx, _vy in valid_sets]
+            valid_scores = [booster.raw_score(
+                vx if is_sparse(vx) else np.asarray(vx, dtype=np.float32))
+                .astype(np.float64) for vx, _vy in valid_sets]
         else:
-            valid_scores = [np.full((len(vx), num_class) if is_multi else len(vx),
-                                    base_score, dtype=np.float64)
-                            for vx, _vy in valid_sets]
+            valid_scores = [np.full(
+                (vx.shape[0], num_class) if is_multi else vx.shape[0],
+                base_score, dtype=np.float64) for vx, _vy in valid_sets]
         if cat_encoder is not None:
             # the per-iteration eval path feeds trees directly (bypassing
             # booster.raw_score), so hand it rank-encoded matrices once
+            if any(is_sparse(vx) for vx, _ in valid_sets):
+                raise ValueError("sparse validation sets cannot combine "
+                                 "with categorical_feature")
             valid_sets = [(cat_encoder.transform(np.asarray(vx)), vy)
                           for vx, vy in valid_sets]
 
-    X_f32 = (np.asarray(X, dtype=np.float32) if boosting == "dart" else None)
+    X_f32 = ((X.astype(np.float32) if sparse_X
+              else np.asarray(X, dtype=np.float32))
+             if boosting == "dart" else None)
     rf_scale = 1.0 / max(1, int(p["num_iterations"])) if boosting == "rf" \
         else None
     K_trees = num_class if is_multi else 1
@@ -407,12 +434,12 @@ def train(params: Dict,
                     cand = np.sort(rng.choice(cand, size=md, replace=False))
                 drop_groups = cand
             if len(drop_groups):
-                from .trees import predict_trees
+                from .trees import predict_trees_any
                 k_drop = len(drop_groups)
                 tree_scale = 1.0 / (k_drop + 1.0)   # DART-paper weights
                 drop_idx = (drop_groups[:, None] * K_trees
                             + np.arange(K_trees)[None, :]).ravel()
-                dp = predict_trees(
+                dp = predict_trees_any(
                     booster.feats[drop_idx], booster.thr_raw[drop_idx],
                     booster.leaf_values[drop_idx], X_f32, depth=depth)
                 drop_pred = jnp.pad(
@@ -549,7 +576,7 @@ def train(params: Dict,
         # eval + early stopping (uses this iteration's trees directly so the
         # booster's lazy tree stack is not re-materialized every round)
         if valid_sets:
-            from .trees import predict_trees
+            from .trees import predict_trees_any
             results = []
             for vi, (vx, vy) in enumerate(valid_sets):
                 if drop_idx is not None:
@@ -557,13 +584,12 @@ def train(params: Dict,
                     # incremental tracking is invalid for this round,
                     # recompute from the full tree stack; no-drop rounds
                     # keep the O(1)-tree incremental path
-                    valid_scores[vi] = base_score + np.asarray(predict_trees(
+                    valid_scores[vi] = base_score + predict_trees_any(
                         booster.feats, booster.thr_raw, booster.leaf_values,
-                        np.asarray(vx, dtype=np.float32), depth=depth))
+                        vx, depth=depth)
                 else:
-                    delta = np.asarray(predict_trees(
-                        new_feats, new_thr, new_leaf,
-                        np.asarray(vx, dtype=np.float32), depth=depth))
+                    delta = predict_trees_any(
+                        new_feats, new_thr, new_leaf, vx, depth=depth)
                     valid_scores[vi] = valid_scores[vi] + delta
                 pred = np.asarray(obj.transform(jnp.asarray(valid_scores[vi])))
                 vw = np.ones(len(vy))

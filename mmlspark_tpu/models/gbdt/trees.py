@@ -293,3 +293,40 @@ def predict_leaf_indices(feats, thr_raw, X, depth: int):
 
     _, leaves = jax.lax.scan(one_tree, None, (feats, thr_raw))
     return leaves.T  # (n, T)
+
+
+def apply_chunked_dense(fn, X, empty_shape, chunk: int = 1 << 16,
+                        concat_axis: int = 0,
+                        empty_dtype=np.float32) -> np.ndarray:
+    """Run ``fn(dense_f32_rows) -> np.ndarray`` over X in bounded row
+    chunks, densifying scipy-sparse input one chunk at a time so peak host
+    memory is O(chunk × F) rather than the full dense matrix. Dense input
+    passes through in one call. ``empty_shape`` is the result shape for a
+    0-row X (shape evidence a concatenation of zero parts cannot supply).
+    """
+    from .binning import is_sparse
+    if not is_sparse(X):
+        return np.asarray(fn(np.asarray(X, np.float32)))
+    X = X.tocsr()
+    chunk = max(1, chunk)
+    parts = [np.asarray(fn(X[lo:lo + chunk].toarray().astype(np.float32)))
+             for lo in range(0, X.shape[0], chunk)]
+    if not parts:
+        return np.zeros(empty_shape, empty_dtype)
+    return np.concatenate(parts, axis=concat_axis)
+
+
+def predict_trees_any(feats, thr_raw, leaf_values, X, depth: int,
+                      chunk: int = 1 << 16) -> np.ndarray:
+    """``predict_trees`` accepting dense OR scipy-sparse X.
+
+    The tree-descent gather needs row-major dense features on device
+    either way (parity note: LightGBM predicts sparse via per-row CSR
+    pointer chases, ``LightGBMBooster.scala:510-527``; batched dense
+    chunks are the TPU-shaped equivalent).
+    """
+    k_dim = leaf_values.shape[1] if leaf_values.ndim == 3 else None
+    return apply_chunked_dense(
+        lambda xd: predict_trees(feats, thr_raw, leaf_values, xd,
+                                 depth=depth),
+        X, empty_shape=(0, k_dim) if k_dim else (0,), chunk=chunk)

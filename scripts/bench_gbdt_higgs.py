@@ -22,6 +22,8 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def make_higgs_like(n: int, f: int = 28, seed: int = 0) -> tuple:
     rng = np.random.default_rng(seed)
