@@ -81,6 +81,14 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                                     "categorical (label-ordered rank "
                                     "encoding; reference "
                                     "LightGBMBase.scala:168-199)")
+    enable_bundle = Param(bool, default=True,
+                          doc="EFB: bundle mutually-exclusive sparse "
+                              "features into shared histogram columns "
+                              "(LightGBM enable_bundle; active on sparse "
+                              "features columns)")
+    max_conflict_rate = Param(float, default=0.0,
+                              doc="EFB conflict budget as a fraction of "
+                                  "rows (0 = lossless bundling)")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
@@ -89,7 +97,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                 "feature_fraction", "bagging_fraction", "bagging_freq",
                 "max_bin", "early_stopping_round", "metric", "seed",
                 "checkpoint_interval", "boosting_type", "top_rate",
-                "other_rate", "drop_rate", "max_drop", "skip_drop", "top_k"]
+                "other_rate", "drop_rate", "max_drop", "skip_drop", "top_k",
+                "enable_bundle", "max_conflict_rate"]
         p = {k: self.get(k) for k in keys}
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")

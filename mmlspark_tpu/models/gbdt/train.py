@@ -66,6 +66,9 @@ _DEFAULTS = dict(
     checkpoint_dir=None,            # step-level checkpoint/resume
     checkpoint_interval=0,          # iterations between checkpoints (0 = off)
     categorical_feature=None,       # feature indices with categorical splits
+    enable_bundle=True,             # EFB on sparse input (LightGBM name)
+    max_conflict_rate=0.0,          # EFB conflict budget as a row fraction
+    max_bundle_bins=4096,           # cap on one bundle's bin span
 )
 
 
@@ -287,7 +290,31 @@ def train(params: Dict,
         X = cat_encoder.transform(X)
 
     mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]))
-    xb = mapper.fit_transform(X)
+    bundle_tables = None
+    n_bundle_bins = 0
+    if sparse_X and p["enable_bundle"]:
+        # EFB: mutually-exclusive sparse features share histogram columns
+        # (LightGBM enable_bundle/max_conflict_rate); per-level histogram
+        # work and the data-parallel psum shrink from F to n_bundles
+        from .bundling import FeatureBundler
+        from .trees import BundleTables
+        mapper.fit(X)
+        bundler = FeatureBundler(
+            max_conflict_rate=float(p["max_conflict_rate"]),
+            max_bundle_bins=int(p["max_bundle_bins"])).fit(X, mapper)
+        if bundler.worthwhile(F):
+            xb = bundler.transform(X, mapper)
+            bundle_tables = BundleTables(
+                jnp.asarray(bundler.bundle_of),
+                jnp.asarray(bundler.offset_of),
+                jnp.asarray(bundler.width_of),
+                jnp.asarray(bundler.zero_bin))
+            n_bundle_bins = bundler.n_bundle_bins
+        else:
+            xb = mapper.transform(X)
+    else:
+        mapper.fit(X)
+        xb = mapper.transform(X)
     n_bins = mapper.n_bins
 
     if init_model is not None:
@@ -321,7 +348,8 @@ def train(params: Dict,
         row_sharding = NamedSharding(mesh, P("data"))
     if n_pad != n:
         pad = n_pad - n
-        xb = np.concatenate([xb, np.zeros((pad, F), dtype=xb.dtype)])
+        xb = np.concatenate([xb, np.zeros((pad, xb.shape[1]),
+                                          dtype=xb.dtype)])
         y_pad = np.concatenate([y, np.zeros(pad)])
         w_pad = np.concatenate([w, np.zeros(pad)])
         scores = np.concatenate(
@@ -359,7 +387,9 @@ def train(params: Dict,
                         alpha=float(p["lambda_l1"]),
                         min_gain=float(p["min_gain_to_split"]),
                         min_child_weight=float(p["min_sum_hessian_in_leaf"]),
-                        min_data_in_leaf=float(p["min_data_in_leaf"]))
+                        min_data_in_leaf=float(p["min_data_in_leaf"]),
+                        bundles=bundle_tables,
+                        n_bundle_bins=int(n_bundle_bins))
 
     if axis_name is None:
         def build(xb_, g_, h_, live_, fmask):

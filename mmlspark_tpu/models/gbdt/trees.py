@@ -31,7 +31,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["TreeArrays", "build_tree", "predict_trees", "predict_leaf_indices"]
+__all__ = ["TreeArrays", "BundleTables", "build_tree", "predict_trees",
+           "predict_leaf_indices"]
+
+
+class BundleTables(NamedTuple):
+    """EFB decode tables (``bundling.FeatureBundler``), all (F,) int32:
+    feature → its bundle, slot offset inside the bundle, bin count, and
+    default (zero-value) bin."""
+    bundle_of: jnp.ndarray
+    offset_of: jnp.ndarray
+    width_of: jnp.ndarray
+    zero_bin: jnp.ndarray
 
 
 class TreeArrays(NamedTuple):
@@ -77,6 +88,32 @@ def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist
+
+
+def _debundle(hist_b, bundles: "BundleTables", n_bins: int):
+    """Bundled histogram (nodes, n_bundles, B_bundle, 3) → exact
+    per-feature histogram (nodes, F, n_bins, 3).
+
+    Non-default bins are a static gather (feature f's slot range); the
+    default bin is reconstructed by subtraction — node totals (the sum of
+    any one bundle's bins: every row lands in exactly one bin per bundle)
+    minus f's non-default stats. Exact for conflict-free bundles; a
+    conflict row is counted at the losing feature's default bin, the EFB
+    approximation.
+    """
+    F = bundles.bundle_of.shape[0]
+    pos = bundles.offset_of[:, None] + jnp.arange(n_bins)[None, :]   # (F, B)
+    pos = jnp.clip(pos, 0, hist_b.shape[2] - 1)
+    gathered = hist_b[:, bundles.bundle_of[:, None], pos, :]  # (nodes,F,B,3)
+    validpos = (jnp.arange(n_bins)[None, :]
+                < bundles.width_of[:, None])                  # (F, B)
+    gathered = gathered * validpos[None, :, :, None]
+    total = hist_b[:, 0, :, :].sum(axis=1)                    # (nodes, 3)
+    default = total[:, None, :] - gathered.sum(axis=2)        # (nodes, F, 3)
+    zslot = (jnp.arange(n_bins)[None, :]
+             == bundles.zero_bin[:, None])                    # (F, B)
+    return jnp.where(zslot[None, :, :, None], default[:, :, None, :],
+                     gathered)
 
 
 def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
@@ -167,17 +204,22 @@ def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name",
-                                             "voting_k"))
+                                             "voting_k", "n_bundle_bins"))
 def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                sample_weight_count: jnp.ndarray,
                depth: int, n_bins: int,
                lam: float = 1e-3, alpha: float = 0.0, min_gain: float = 0.0,
                min_child_weight: float = 1e-3, min_data_in_leaf: float = 1.0,
                feature_mask: Optional[jnp.ndarray] = None,
-               axis_name: Optional[str] = None, voting_k: int = 0):
+               axis_name: Optional[str] = None, voting_k: int = 0,
+               bundles: Optional[BundleTables] = None,
+               n_bundle_bins: int = 0):
     """Grow one depth-`depth` tree. All shapes static; jits once per config.
 
-    xb: (n, F) int bins; g/h: (n,) gradients/hessians (already weighted);
+    xb: (n, F) int bins — or, with ``bundles``, the (n, n_bundles) EFB
+    matrix whose histogram is debundled back to per-feature space before
+    split finding (splits, masks, voting, and thresholds always speak
+    original features); g/h: (n,) gradients/hessians (already weighted);
     sample_weight_count: (n,) 1.0 for live rows, 0.0 for padding/bagged-out.
     Returns (feat, thr_bin, leaf_value, leaf_index_per_row).
 
@@ -188,7 +230,8 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     only the global top-2k features' histograms are all-reduced — per-level
     comm drops from F×B to 2k×B.
     """
-    n, F = xb.shape
+    n = xb.shape[0]
+    F = bundles.bundle_of.shape[0] if bundles is not None else xb.shape[1]
     n_internal = 2 ** depth - 1
     feats = jnp.full(n_internal, -1, dtype=jnp.int32)
     thrs = jnp.full(n_internal, n_bins, dtype=jnp.int32)
@@ -197,18 +240,26 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     node_rel = jnp.zeros(n, dtype=jnp.int32)
     use_voting = voting_k > 0 and axis_name is not None and 2 * voting_k < F
 
+    def level_hist(n_nodes, psum_axis):
+        if bundles is None:
+            return _level_histogram(xb, node_rel, g, h, sample_weight_count,
+                                    n_nodes, n_bins, psum_axis)
+        # bundled scatter-add (and, data-parallel, the psum) run in the
+        # narrow bundle space; the exact per-feature view is a gather
+        hist_b = _level_histogram(xb, node_rel, g, h, sample_weight_count,
+                                  n_nodes, n_bundle_bins, psum_axis)
+        return _debundle(hist_b, bundles, n_bins)
+
     for d in range(depth):
         n_nodes = 2 ** d
         level_off = 2 ** d - 1
         if use_voting:
-            local = _level_histogram(xb, node_rel, g, h, sample_weight_count,
-                                     n_nodes, n_bins, None)
+            local = level_hist(n_nodes, None)
             bf, bb, bg, level_cover = _voting_splits(
                 local, axis_name, voting_k, lam, min_gain, min_child_weight,
                 min_data_in_leaf, feature_mask)
         else:
-            hist = _level_histogram(xb, node_rel, g, h, sample_weight_count,
-                                    n_nodes, n_bins, axis_name)
+            hist = level_hist(n_nodes, axis_name)
             level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
             bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
                                       min_data_in_leaf, feature_mask)
@@ -219,9 +270,21 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                                              (level_off,))
         # route rows: bin <= thr → left. Stub splits have thr = n_bins → left.
         row_feat = jnp.clip(bf[node_rel], 0, F - 1)
-        row_bin = jnp.take_along_axis(xb, row_feat[:, None].astype(jnp.int32),
-                                      axis=1)[:, 0]
-        go_right = row_bin.astype(jnp.int32) > bb[node_rel]
+        if bundles is None:
+            row_bin = jnp.take_along_axis(
+                xb, row_feat[:, None].astype(jnp.int32), axis=1)[:, 0] \
+                .astype(jnp.int32)
+        else:
+            # decode the split feature's bin from its bundle column: in
+            # the feature's slot range → offset-shifted bin, else default
+            bcol = jnp.take_along_axis(
+                xb, bundles.bundle_of[row_feat][:, None], axis=1)[:, 0] \
+                .astype(jnp.int32)
+            rel = bcol - bundles.offset_of[row_feat]
+            row_bin = jnp.where(
+                (rel >= 0) & (rel < bundles.width_of[row_feat]),
+                rel, bundles.zero_bin[row_feat])
+        go_right = row_bin > bb[node_rel]
         node_rel = node_rel * 2 + go_right.astype(jnp.int32)
 
     # leaf values from bottom-level stats

@@ -26,13 +26,31 @@ run() {
     echo "($name rc=${PIPESTATUS[0]} $(date -u +%H:%M:%SZ))" >> "$OUT.log"
 }
 
+pre_lines=$(wc -l < "$OUT" 2>/dev/null || echo 0)
 run headline  python bench.py
-# shellcheck disable=SC2086 — word-splitting of HIGGS_SIZES is intended
-run gbdt      python scripts/bench_gbdt_higgs.py ${HIGGS_SIZES:-1000000 4000000 11000000}
-run longctx   python scripts/bench_long_context.py
-run pallas    python scripts/bench_pallas_hist.py
-run mesh_spmd python scripts/bench_mesh_spmd.py
-run configs   python scripts/bench_configs.py
-run decode    python scripts/bench_decode.py
-run serving_tpu env BENCH_SERVING_TPU=1 python scripts/bench_serving.py
-echo "ALL DONE $(date -u)" >> "$OUT"
+# Gate the TPU-only stages on the headline's outcome: when the chip claim
+# is wedged each of these would otherwise wait ~25-50 min inside backend
+# init and then die — serially, for hours. A degraded headline means
+# skip-and-let-the-caller-retry (chip_campaign_loop.sh), not grind.
+# Three conditions: the headline actually APPENDED a line (a stale tpu
+# record from a previous attempt must not pass), it labeled itself tpu,
+# and it carried no midrun_error (a mid-run backend loss predicts the
+# same death for every following stage).
+post_lines=$(wc -l < "$OUT" 2>/dev/null || echo 0)
+last=$(tail -1 "$OUT" 2>/dev/null)
+if [ "$post_lines" -gt "$pre_lines" ] \
+        && echo "$last" | grep -q '"platform": "tpu"' \
+        && ! echo "$last" | grep -q 'midrun_error'; then
+    # shellcheck disable=SC2086 — word-splitting of HIGGS_SIZES is intended
+    run gbdt      python scripts/bench_gbdt_higgs.py ${HIGGS_SIZES:-1000000 4000000 11000000}
+    run longctx   python scripts/bench_long_context.py
+    run pallas    python scripts/bench_pallas_hist.py
+    run mesh_spmd python scripts/bench_mesh_spmd.py
+    run configs   python scripts/bench_configs.py
+    run decode    python scripts/bench_decode.py
+    run serving_tpu env BENCH_SERVING_TPU=1 python scripts/bench_serving.py
+    echo "ALL DONE $(date -u)" >> "$OUT"
+else
+    echo "CHIP DEGRADED $(date -u) — TPU-only stages skipped" >> "$OUT.log"
+    exit 3
+fi
