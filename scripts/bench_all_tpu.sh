@@ -6,7 +6,8 @@
 # NO `timeout` wrappers: a killed TPU-holding process wedges the chip claim
 # for hours (BASELINE.md postmortem). Runs are sized by env knobs instead —
 # set them BEFORE invoking if a shorter window is needed:
-#   BENCH_ROWS/BENCH_BATCH (headline), HIGGS_ITERS (gbdt),
+#   BENCH_ROWS/BENCH_BATCH (headline), HIGGS_ITERS/HIGGS_SIZES (gbdt),
+#   SPARSE_ROWS/SPARSE_ITERS (gbdt_efb),
 #   BENCH_SEQS/BENCH_IMPLS/BENCH_GRADS (long context),
 #   BENCH_SERVING_N/BENCH_SERVING_DURATION (serving).
 # Order follows the round-4 verdict: headline first (the artifact of
@@ -43,6 +44,9 @@ if [ "$post_lines" -gt "$pre_lines" ] \
         && ! echo "$last" | grep -q 'midrun_error'; then
     # shellcheck disable=SC2086 — word-splitting of HIGGS_SIZES is intended
     run gbdt      python scripts/bench_gbdt_higgs.py ${HIGGS_SIZES:-1000000 4000000 11000000}
+    # same group shape as the BASELINE.md CPU row (50 groups × 8) so the
+    # TPU cell fills from a comparable problem, larger only in rows
+    run gbdt_efb  python scripts/bench_gbdt_sparse.py ${SPARSE_ROWS:-1000000} 50 8
     run longctx   python scripts/bench_long_context.py
     run pallas    python scripts/bench_pallas_hist.py
     run mesh_spmd python scripts/bench_mesh_spmd.py
