@@ -77,15 +77,32 @@ class MultiNGram(Transformer, HasInputCol, HasOutputCol):
 
 
 class HashingTF(Transformer, HasInputCol, HasOutputCol):
-    # vectors are dense here (they feed device matmuls), so the default hash
-    # space is far below the reference's sparse 2^18
+    # dense vectors by default (they feed device matmuls), so the default
+    # hash space is far below the reference's sparse 2^18; ``sparse=True``
+    # emits scipy CSR row vectors (Spark HashingTF's SparseVector shape),
+    # which lets num_features grow to the reference's 2^18+ and feeds the
+    # sparse GBDT / EFB path without densifying
     num_features = Param(int, default=1 << 12, doc="hash space size")
     binary = Param(bool, default=False, doc="presence instead of counts")
+    sparse = Param(bool, default=False,
+                   doc="emit scipy CSR row vectors instead of dense")
 
     def _transform(self, df: DataFrame) -> DataFrame:
         n = self.get("num_features")
+        use_sparse = self.get("sparse")
+        if use_sparse:
+            import scipy.sparse as sp
         out = np.empty(len(df), dtype=object)
         for i, toks in enumerate(df[self.get("input_col")]):
+            if use_sparse:
+                hashed = np.fromiter((_fnv1a(t, n) for t in toks),
+                                     dtype=np.int64, count=len(toks))
+                idx, counts = np.unique(hashed, return_counts=True)
+                vals = (np.ones(len(idx), np.float32) if self.get("binary")
+                        else counts.astype(np.float32))
+                out[i] = sp.csr_matrix(
+                    (vals, idx, np.array([0, len(idx)])), shape=(1, n))
+                continue
             vec = np.zeros(n, dtype=np.float32)
             for tok in toks:
                 vec[_fnv1a(tok, n)] += 1.0
@@ -99,10 +116,22 @@ class IDF(Estimator, HasInputCol, HasOutputCol):
     min_doc_freq = Param(int, default=0, doc="zero out rare terms")
 
     def _fit(self, df: DataFrame) -> "IDFModel":
+        try:
+            import scipy.sparse as sp
+        except Exception:               # pragma: no cover
+            sp = None
         col = df[self.get("input_col")]
         # incremental docfreq: never materialize the (n_docs, n_features) stack
         docfreq = None
         for v in col:
+            if sp is not None and sp.issparse(v):
+                v = v.tocsr()
+                if docfreq is None:
+                    docfreq = np.zeros(v.shape[1], dtype=np.int64)
+                # unique: a non-canonical CSR with a repeated index must
+                # count once per document (dense presence semantics)
+                np.add.at(docfreq, np.unique(v.indices[v.data > 0]), 1)
+                continue
             row = np.asarray(v) > 0
             docfreq = row.astype(np.int64) if docfreq is None else docfreq + row
         n = len(col)
@@ -121,11 +150,20 @@ class IDFModel(Model, HasInputCol, HasOutputCol):
     idf = _CP(default=None, doc="per-slot idf weights")
 
     def _transform(self, df: DataFrame) -> DataFrame:
+        try:
+            import scipy.sparse as sp
+        except Exception:               # pragma: no cover
+            sp = None
         idf = np.asarray(self.get("idf"))
         col = df[self.get("input_col")]
         out = np.empty(len(col), dtype=object)
         for i, v in enumerate(col):
-            out[i] = (np.asarray(v, dtype=np.float32) * idf)
+            if sp is not None and sp.issparse(v):
+                r = v.tocsr().astype(np.float32)
+                r.data = r.data * idf[r.indices].astype(np.float32)
+                out[i] = r
+            else:
+                out[i] = (np.asarray(v, dtype=np.float32) * idf)
         return df.with_column(self.get("output_col"), out)
 
 
@@ -142,6 +180,9 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     binary = Param(bool, default=False, doc="binary term counts")
     use_idf = Param(bool, default=True, doc="apply inverse document frequency")
     min_doc_freq = Param(int, default=1, doc="IDF min document frequency")
+    sparse = Param(bool, default=False,
+                   doc="emit scipy CSR row vectors (enables reference-scale "
+                       "2^18 hash spaces; feeds the sparse GBDT/EFB path)")
 
     def _fit(self, df: DataFrame) -> "TextFeaturizerModel":
         from ..core.pipeline import Pipeline
@@ -160,7 +201,8 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
         tf_out = "_tf_counts" if self.get("use_idf") else outp
         stages.append(HashingTF(input_col=cur, output_col=tf_out,
                                 num_features=self.get("num_features"),
-                                binary=self.get("binary")))
+                                binary=self.get("binary"),
+                                sparse=self.get("sparse")))
         if self.get("use_idf"):
             stages.append(IDF(input_col=tf_out, output_col=outp,
                               min_doc_freq=self.get("min_doc_freq")))

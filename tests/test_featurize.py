@@ -89,6 +89,76 @@ def test_text_featurizer_end_to_end(tmp_save):
                                out["features"][1])
 
 
+def test_hashing_tf_sparse_matches_dense():
+    import scipy.sparse as sp
+    df = DataFrame({"text": ["the cat sat on the mat", "a dog", ""]})
+    toks = Tokenizer(input_col="text", output_col="toks").transform(df)
+    dense = HashingTF(input_col="toks", output_col="tf",
+                      num_features=64).transform(toks)
+    sparse = HashingTF(input_col="toks", output_col="tf",
+                       num_features=64, sparse=True).transform(toks)
+    for i in range(len(df)):
+        assert sp.issparse(sparse["tf"][i])
+        np.testing.assert_allclose(
+            np.asarray(sparse["tf"][i].todense()).ravel(), dense["tf"][i])
+    # binary mode too
+    db = HashingTF(input_col="toks", output_col="tf", num_features=64,
+                   binary=True).transform(toks)
+    sb = HashingTF(input_col="toks", output_col="tf", num_features=64,
+                   binary=True, sparse=True).transform(toks)
+    np.testing.assert_allclose(
+        np.asarray(sb["tf"][0].todense()).ravel(), db["tf"][0])
+
+
+def test_idf_sparse_matches_dense():
+    import scipy.sparse as sp
+    df = DataFrame({"text": ["good movie great plot", "bad film poor plot",
+                             "great film good acting"]})
+    toks = Tokenizer(input_col="text", output_col="toks").transform(df)
+    tf_d = HashingTF(input_col="toks", output_col="tf",
+                     num_features=128).transform(toks)
+    tf_s = HashingTF(input_col="toks", output_col="tf", num_features=128,
+                     sparse=True).transform(toks)
+    m_d = IDF(input_col="tf", output_col="tfidf").fit(tf_d)
+    m_s = IDF(input_col="tf", output_col="tfidf").fit(tf_s)
+    np.testing.assert_allclose(np.asarray(m_s.get("idf")),
+                               np.asarray(m_d.get("idf")))
+    out_s = m_s.transform(tf_s)
+    out_d = m_d.transform(tf_d)
+    for i in range(len(df)):
+        assert sp.issparse(out_s["tfidf"][i])
+        np.testing.assert_allclose(
+            np.asarray(out_s["tfidf"][i].todense()).ravel(),
+            out_d["tfidf"][i], rtol=1e-6)
+
+
+def test_text_featurizer_sparse_to_gbdt():
+    # the end-to-end story the sparse path exists for: text → hashed
+    # sparse features (reference-scale hash space) → GBDT with EFB
+    import scipy.sparse as sp
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    rng = np.random.default_rng(0)
+    pos = ["great amazing wonderful", "superb brilliant fine",
+           "great fine acting", "wonderful superb plot"]
+    neg = ["bad awful terrible", "poor dreadful plot",
+           "terrible poor acting", "awful dreadful film"]
+    texts = []
+    for i in range(120):
+        words = (pos if i % 2 == 0 else neg)[rng.integers(0, 4)].split()
+        texts.append(" ".join(rng.permutation(words)))
+    y = np.array([1.0 if i % 2 == 0 else 0.0 for i in range(120)])
+    df = DataFrame({"text": np.array(texts, dtype=object), "label": y})
+    feats = TextFeaturizer(input_col="text", output_col="features",
+                           num_features=1 << 15, sparse=True).fit(df) \
+        .transform(df)
+    assert sp.issparse(feats["features"][0])
+    assert feats["features"][0].shape == (1, 1 << 15)
+    m = LightGBMClassifier(num_iterations=20, num_leaves=7,
+                           min_data_in_leaf=5).fit(feats)
+    pred = np.asarray(m.transform(feats)["prediction"], dtype=np.float64)
+    assert (pred == y).mean() > 0.9
+
+
 def test_page_splitter():
     df = DataFrame({"doc": ["word " * 100]})
     out = PageSplitter(input_col="doc", output_col="pages",
