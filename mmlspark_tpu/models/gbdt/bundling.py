@@ -16,8 +16,11 @@ TPU-first reformulation (no ragged structures, no per-row pointer chases):
   matrix is the ONLY per-row artifact — (n, n_bundles) uint16 instead of
   (n, F) uint8.
 * **histogram**: one scatter-add over bundle bins per level (the existing
-  kernel, just narrower), psum'd over the mesh in bundled form — the
-  data-parallel collective shrinks by the same factor as the compute.
+  kernel, just narrower). The win is in the per-column passes and the
+  bin-matrix traffic (HBM reads and host→device transfer shrink from
+  n×F to n×n_bundles bytes); the psum payload is ≈ conserved — total
+  bins are invariant (n_bundles × span ≈ F × B) — so data-parallel comm
+  neither shrinks nor grows beyond span padding.
 * **debundle**: per-feature histograms are reconstructed EXACTLY by a
   static gather plus the default-bin subtraction trick (default-bin
   stats = node totals − the feature's non-default stats), so split

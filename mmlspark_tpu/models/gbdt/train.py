@@ -295,7 +295,8 @@ def train(params: Dict,
     if sparse_X and p["enable_bundle"]:
         # EFB: mutually-exclusive sparse features share histogram columns
         # (LightGBM enable_bundle/max_conflict_rate); per-level histogram
-        # work and the data-parallel psum shrink from F to n_bundles
+        # passes and bin-matrix bytes shrink from F to n_bundles columns
+        # (total bins — and the psum payload — stay ≈ constant)
         from .bundling import FeatureBundler
         from .trees import BundleTables
         mapper.fit(X)
