@@ -11,17 +11,51 @@
 # Usage: bash scripts/chip_campaign_loop.sh [results.jsonl] [max_attempts]
 set -u
 OUT="${1:-/tmp/tpu_campaign.jsonl}"
-MAX="${2:-40}"
+MAX="${2:-120}"       # real probe attempts; at ~2+2 min each ≈ 8 h patience
 cd "$(dirname "$0")/.."
-for i in $(seq 1 "$MAX"); do
-    echo "--- campaign attempt $i/$MAX $(date -u) ---" >> "$OUT.log"
-    bash scripts/bench_all_tpu.sh "$OUT"
-    rc=$?
-    if [ "$rc" -ne 3 ]; then
-        echo "--- campaign finished rc=$rc attempt $i $(date -u) ---" >> "$OUT.log"
-        exit "$rc"
+attempt=0
+while [ "$attempt" -lt "$MAX" ]; do
+    # one claimant at a time (BASELINE.md discipline): while an abandoned
+    # probe child from an earlier attempt is still stuck inside backend
+    # init, spawning another can neither succeed nor be killed safely —
+    # wait for it to die on its own. Waiting does NOT consume an attempt.
+    # The pattern matches the probe child's own cmdline (its -c code plus
+    # the result path), not merely any process mentioning the temp dir
+    # (a tail/less on a probe file must not stall the loop).
+    if pgrep -f 'import jax.*bench_probe_' > /dev/null 2>&1; then
+        echo "--- prior probe child still pending $(date -u) ---" >> "$OUT.log"
+        sleep "${CHIP_RETRY_SLEEP:-120}"
+        continue
     fi
-    sleep "${CHIP_RETRY_SLEEP:-240}"
+    attempt=$((attempt + 1))
+    # cheap gate first: one non-wedging probe child (bench.py's machinery —
+    # atomic result file, never killed). A wedged claim costs ~2 min here
+    # vs ~10 min of degraded bench.py, so the loop samples the chip ~3x
+    # more often and a short healthy window is less likely to be missed.
+    # BENCH_PROBE_WINDOW (bench.py's documented knob) is honored;
+    # CHIP_PROBE_WINDOW overrides just the gate.
+    probe=$(python - 2>> "$OUT.log" <<'PY'
+import os
+import bench
+window = float(os.environ.get("CHIP_PROBE_WINDOW",
+                              os.environ.get("BENCH_PROBE_WINDOW", "120")))
+platform, kind, info = bench._probe_default_backend(window)
+import sys
+print(f"gate probe: platform={platform} kind={kind} "
+      f"reason={info.get('reason')!r}", file=sys.stderr)
+print(platform or "none")
+PY
+    ) || probe=error
+    echo "--- attempt $attempt/$MAX probe=$probe $(date -u) ---" >> "$OUT.log"
+    if [ "$probe" = "tpu" ]; then
+        bash scripts/bench_all_tpu.sh "$OUT"
+        rc=$?
+        if [ "$rc" -ne 3 ]; then
+            echo "--- campaign finished rc=$rc attempt $attempt $(date -u) ---" >> "$OUT.log"
+            exit "$rc"
+        fi
+    fi
+    sleep "${CHIP_RETRY_SLEEP:-120}"
 done
 echo "--- campaign gave up after $MAX degraded attempts $(date -u) ---" >> "$OUT.log"
 exit 3
