@@ -1979,8 +1979,18 @@ def _scan(node, inputs, ctx):
     return tuple(outs) if len(outs) > 1 else outs[0]
 
 
-def _rnn_common(node, inputs):
-    """Shared unpacking for LSTM/GRU: X (T,B,I), W/R/B per direction."""
+_SIGMOID_TANH_ACTS = (
+    ["sigmoid", "tanh"], ["sigmoid", "tanh", "tanh"],
+    ["sigmoid", "tanh"] * 2, ["sigmoid", "tanh", "tanh"] * 2)
+
+
+def _rnn_common(node, inputs, allowed_acts=_SIGMOID_TANH_ACTS):
+    """Shared unpacking for RNN/LSTM/GRU: X (T,B,I), W/R/B per direction.
+
+    ``allowed_acts``: the activation lists this op may carry — each op
+    passes its own spec defaults (vanilla RNN is Tanh-only; LSTM/GRU are
+    Sigmoid-gated) so a nonstandard activation is rejected, never silently
+    computed with the wrong function."""
     X = jnp.asarray(inputs[0])
     W = jnp.asarray(inputs[1])
     R = jnp.asarray(inputs[2])
@@ -1992,10 +2002,9 @@ def _rnn_common(node, inputs):
     # silently computing with the wrong activation would be worse than
     # rejecting: only the ONNX defaults (Sigmoid/Tanh) are implemented
     acts = node.attr("activations")
-    if acts and [a.lower() for a in acts] not in (
-            ["sigmoid", "tanh"], ["sigmoid", "tanh", "tanh"],
-            ["sigmoid", "tanh"] * 2, ["sigmoid", "tanh", "tanh"] * 2):
-        raise UnsupportedOp(f"RNN activations {acts} (defaults only)")
+    if acts and [a.lower() for a in acts] not in allowed_acts:
+        raise UnsupportedOp(f"{node.op_type} activations {acts} "
+                            "(spec defaults only)")
     if node.attr("clip") is not None:
         raise UnsupportedOp("RNN cell clipping")
     direction = node.attr("direction", "forward")
@@ -2259,3 +2268,5 @@ def convert_model(model_bytes: bytes,
 # ai.onnx.ml domain handlers (tree ensembles, linear models, preprocessing)
 # register themselves on import; placed at module end so register_op exists
 from . import ml_ops  # noqa: E402,F401
+# long-tail standard ops (audio/DSP, integer-quantized, RNN, losses, ...)
+from . import extra_ops  # noqa: E402,F401

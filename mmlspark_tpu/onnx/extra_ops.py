@@ -1,0 +1,427 @@
+"""Long-tail ONNX standard ops: audio/DSP, integer-quantized, recurrent,
+loss, pooling, and bitwise families.
+
+Registered into :mod:`convert`'s ``OP_HANDLERS`` on import (same pattern
+as ``ml_ops``). Parity anchor: the reference executes these through
+onnxruntime's full opset (``deep-learning/.../onnx/ONNXModel.scala:330``);
+here each lowers to XLA with static shapes — size-like inputs must be
+trace-time constants (the importer's standing rule), which is exactly how
+real exporters emit them.
+
+The audio family (HannWindow/HammingWindow/BlackmanWindow/DFT/STFT/
+MelWeightMatrix, opset 17) covers Whisper-style ASR preprocessing graphs —
+the speech-service modality the reference reaches via its cognitive
+SpeechToText stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .convert import (OP_HANDLERS, UnsupportedOp, _concrete, _conv_raw,
+                      _pool, _reduce, _rnn_common, _run_directions,
+                      register_op)
+from .proto import ONNX_TO_NUMPY
+
+# -- reduce stragglers -------------------------------------------------------
+
+OP_HANDLERS["ReduceLogSum"] = _reduce(
+    lambda x, axis, keepdims: jnp.log(jnp.sum(x, axis=axis,
+                                              keepdims=keepdims)), 18)
+
+
+# -- bitwise (opset 18) ------------------------------------------------------
+
+for _name, _fn in [("BitwiseAnd", jnp.bitwise_and),
+                   ("BitwiseOr", jnp.bitwise_or),
+                   ("BitwiseXor", jnp.bitwise_xor)]:
+    OP_HANDLERS[_name] = (lambda f: lambda n, i, c: f(i[0], i[1]))(_fn)
+OP_HANDLERS["BitwiseNot"] = lambda n, i, c: jnp.bitwise_not(i[0])
+
+
+# -- normalization / pooling -------------------------------------------------
+
+@register_op("LRN")
+def _lrn(node, inputs, ctx):
+    """Local response normalization (AlexNet-era): windowed square-sum over
+    the channel axis via reduce_window."""
+    x = jnp.asarray(inputs[0])
+    size = int(node.attr("size"))
+    alpha = node.attr("alpha", 1e-4)
+    beta = node.attr("beta", 0.75)
+    bias = node.attr("bias", 1.0)
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    window = (1, size) + (1,) * (x.ndim - 2)
+    pads = [(0, 0), (lo, hi)] + [(0, 0)] * (x.ndim - 2)
+    sq = lax.reduce_window(x * x, 0.0, lax.add, window,
+                           (1,) * x.ndim, pads)
+    return x / jnp.power(bias + (alpha / size) * sq, beta)
+
+
+@register_op("MeanVarianceNormalization")
+def _mvn(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    axes = tuple(node.attr("axes", [0, 2, 3]))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + 1e-9)
+
+
+def _lp_reduce(x, p, axes):
+    if p == 2:
+        return jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+    ab = jnp.abs(x)
+    return jnp.power(jnp.sum(jnp.power(ab, p), axis=axes, keepdims=True),
+                     1.0 / p)
+
+
+@register_op("GlobalLpPool")
+def _global_lp_pool(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    return _lp_reduce(x, int(node.attr("p", 2)), tuple(range(2, x.ndim)))
+
+
+@register_op("LpPool")
+def _lp_pool(node, inputs, ctx):
+    p = int(node.attr("p", 2))
+    x = jnp.asarray(inputs[0])
+    powed = jnp.abs(x) ** p
+    summed = _pool(node, [powed], ctx, lax.add, 0.0)
+    return jnp.power(summed, 1.0 / p)
+
+
+@register_op("MaxUnpool")
+def _max_unpool(node, inputs, ctx):
+    """Scatter pooled values back to the indices MaxPool recorded (global
+    row-major flat indices, the ORT layout)."""
+    x = jnp.asarray(inputs[0])
+    idx = jnp.asarray(inputs[1]).astype(jnp.int32)
+    if len(inputs) > 2 and inputs[2] is not None:
+        out_shape = tuple(int(v) for v in
+                          _concrete(inputs[2], "MaxUnpool output_shape"))
+    else:
+        k = node.attr("kernel_shape")
+        strides = node.attr("strides", [1] * len(k))
+        pads = node.attr("pads", [0] * 2 * len(k))
+        spatial = tuple(
+            (x.shape[2 + i] - 1) * strides[i] + k[i]
+            - pads[i] - pads[len(k) + i] for i in range(len(k)))
+        out_shape = x.shape[:2] + spatial
+    flat = jnp.zeros(int(np.prod(out_shape)), x.dtype)
+    flat = flat.at[idx.ravel()].set(x.ravel())
+    return flat.reshape(out_shape)
+
+
+# -- integer-quantized (the pre-QLinear wire ops) ----------------------------
+
+def _sub_zp(t, zp, what):
+    t = jnp.asarray(t).astype(jnp.int32)
+    if zp is None:
+        return t
+    zp = jnp.asarray(zp).astype(jnp.int32)
+    if zp.ndim == 0 or zp.size == 1:
+        return t - zp.reshape(())
+    if zp.ndim == 1:
+        # per-row for A (second-to-last axis), per-column for B (last axis)
+        shape = ([1] * (t.ndim - 2) + [-1, 1]) if what == "a" \
+            else ([1] * (t.ndim - 2) + [1, -1])
+        return t - zp.reshape(shape)
+    raise UnsupportedOp(f"MatMulInteger {what}_zero_point rank {zp.ndim}")
+
+
+@register_op("MatMulInteger")
+def _matmul_integer(node, inputs, ctx):
+    a = _sub_zp(inputs[0], inputs[2] if len(inputs) > 2 else None, "a")
+    b = _sub_zp(inputs[1], inputs[3] if len(inputs) > 3 else None, "b")
+    return jnp.matmul(a, b)
+
+
+@register_op("ConvInteger")
+def _conv_integer(node, inputs, ctx):
+    x = jnp.asarray(inputs[0]).astype(jnp.int32)
+    w = jnp.asarray(inputs[1]).astype(jnp.int32)
+    if len(inputs) > 2 and inputs[2] is not None:
+        x = x - jnp.asarray(inputs[2]).astype(jnp.int32).reshape(())
+    if len(inputs) > 3 and inputs[3] is not None:
+        wz = np.asarray(_concrete(inputs[3], "ConvInteger w_zero_point"))
+        if wz.size != 1:
+            raise UnsupportedOp("ConvInteger per-channel w_zero_point")
+        w = w - jnp.int32(wz.ravel()[0])
+    return _conv_raw(node, x, w, preferred=jnp.int32)
+
+
+@register_op("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(node, inputs, ctx):
+    x = jnp.asarray(inputs[0]).astype(jnp.float32)
+    x_min = jnp.minimum(jnp.min(x), 0.0)
+    x_max = jnp.maximum(jnp.max(x), 0.0)
+    scale = (x_max - x_min) / 255.0
+    scale = jnp.where(scale == 0, jnp.float32(1.0), scale)
+    zp = jnp.clip(jnp.round(0.0 - x_min / scale), 0, 255)
+    y = jnp.clip(jnp.round(x / scale) + zp, 0, 255).astype(jnp.uint8)
+    return y, scale.astype(jnp.float32), zp.astype(jnp.uint8)
+
+
+# -- vanilla RNN (completes the LSTM/GRU trio) -------------------------------
+
+@register_op("RNN")
+def _rnn(node, inputs, ctx):
+    """ONNX vanilla RNN → lax.scan (default activation Tanh)."""
+    X, W, R, B, direction = _rnn_common(
+        node, inputs, allowed_acts=(["tanh"], ["tanh"] * 2))
+    H = int(node.attr("hidden_size"))
+    T, Bt, _ = X.shape
+    n_dirs = W.shape[0]
+    h0 = (jnp.asarray(inputs[5]) if len(inputs) > 5 and inputs[5] is not None
+          else jnp.zeros((n_dirs, Bt, H), X.dtype))
+
+    def cell(carry, x, W, R, B):
+        (h,) = carry
+        wb = B[:H] if B is not None else 0.0
+        rb = B[H:] if B is not None else 0.0
+        h_new = jnp.tanh(x @ W.T + wb + h @ R.T + rb)
+        return (h_new,), h_new
+
+    res = _run_directions(X, W, R, B, h0, (), direction, cell)
+    Y = jnp.stack([ys for ys, _ in res], axis=1)
+    Y_h = jnp.stack([carry[0] for _, carry in res], axis=0)
+    return Y, Y_h
+
+
+# -- losses (training-capable graphs) ----------------------------------------
+
+def _nll_core(log_prob, target, weight, ignore_index, reduction):
+    # log_prob (N, C, d...); target (N, d...) int
+    C = log_prob.shape[1]
+    tgt = jnp.asarray(target).astype(jnp.int32)
+    valid = jnp.ones(tgt.shape, jnp.float32) if ignore_index is None else \
+        (tgt != ignore_index).astype(jnp.float32)
+    tgt_safe = jnp.clip(tgt, 0, C - 1)
+    gathered = jnp.take_along_axis(
+        log_prob, tgt_safe[:, None], axis=1)[:, 0]        # (N, d...)
+    w = (jnp.asarray(weight)[tgt_safe].astype(jnp.float32)
+         if weight is not None else jnp.ones(tgt.shape, jnp.float32))
+    w = w * valid
+    loss = -gathered * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)   # mean
+
+
+@register_op("NegativeLogLikelihoodLoss")
+def _nll_loss(node, inputs, ctx):
+    weight = inputs[2] if len(inputs) > 2 else None
+    return _nll_core(jnp.asarray(inputs[0]), inputs[1], weight,
+                     node.attr("ignore_index"),
+                     node.attr("reduction", "mean"))
+
+
+@register_op("SoftmaxCrossEntropyLoss")
+def _sce_loss(node, inputs, ctx):
+    scores = jnp.asarray(inputs[0])
+    log_prob = jax.nn.log_softmax(scores, axis=1)
+    weight = inputs[2] if len(inputs) > 2 else None
+    loss = _nll_core(log_prob, inputs[1], weight,
+                     node.attr("ignore_index"),
+                     node.attr("reduction", "mean"))
+    if len(node.output) > 1:
+        return loss, log_prob
+    return loss
+
+
+# -- misc --------------------------------------------------------------------
+
+@register_op("Det")
+def _det(node, inputs, ctx):
+    return jnp.linalg.det(jnp.asarray(inputs[0]))
+
+
+def _random(node, shape, dtype_default, normal):
+    dt = ONNX_TO_NUMPY.get(node.attr("dtype"), dtype_default)
+    # ONNX: seed is optional and behavior without it is implementation-
+    # defined; a fixed derivation keeps the compiled graph pure and runs
+    # reproducible (the same stance as jax itself)
+    import zlib
+    seed = node.attr("seed")
+    key = jax.random.PRNGKey(np.int64(seed if seed is not None else 0))
+    # stable per-node stream (hash() is salted per process — it would make
+    # the compiled graph differ between runs)
+    key = jax.random.fold_in(key, zlib.crc32(node.output[0].encode()))
+    if normal:
+        mean = node.attr("mean", 0.0)
+        scale = node.attr("scale", 1.0)
+        return (mean + scale
+                * jax.random.normal(key, shape)).astype(dt)
+    low = node.attr("low", 0.0)
+    high = node.attr("high", 1.0)
+    return jax.random.uniform(key, shape, minval=low, maxval=high).astype(dt)
+
+
+@register_op("RandomNormal")
+def _random_normal(node, inputs, ctx):
+    return _random(node, tuple(node.attr("shape")), np.float32, True)
+
+
+@register_op("RandomUniform")
+def _random_uniform(node, inputs, ctx):
+    return _random(node, tuple(node.attr("shape")), np.float32, False)
+
+
+@register_op("RandomNormalLike")
+def _random_normal_like(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    return _random(node, x.shape, x.dtype, True)
+
+
+@register_op("RandomUniformLike")
+def _random_uniform_like(node, inputs, ctx):
+    x = jnp.asarray(inputs[0])
+    return _random(node, x.shape, x.dtype, False)
+
+
+# -- audio / DSP family (opset 17) -------------------------------------------
+
+def _cosine_window(node, inputs, coeffs):
+    size = int(_concrete(inputs[0], "window size").ravel()[0])
+    periodic = int(node.attr("periodic", 1))
+    dt = ONNX_TO_NUMPY.get(node.attr("output_datatype"), np.float32)
+    N = size if periodic else size - 1
+    n = jnp.arange(size, dtype=jnp.float32)
+    w = jnp.zeros(size, jnp.float32)
+    for k, a in enumerate(coeffs):
+        w = w + ((-1.0) ** k) * a * jnp.cos(2.0 * np.pi * k * n
+                                            / max(N, 1))
+    return w.astype(dt)
+
+
+@register_op("HannWindow")
+def _hann_window(node, inputs, ctx):
+    return _cosine_window(node, inputs, [0.5, 0.5])
+
+
+@register_op("HammingWindow")
+def _hamming_window(node, inputs, ctx):
+    return _cosine_window(node, inputs, [25.0 / 46.0, 21.0 / 46.0])
+
+
+@register_op("BlackmanWindow")
+def _blackman_window(node, inputs, ctx):
+    return _cosine_window(node, inputs, [0.42, 0.5, 0.08])
+
+
+def _as_complex(x, what):
+    """[..., 1] real or [..., 2] interleaved → complex."""
+    x = jnp.asarray(x)
+    if x.shape[-1] == 1:
+        return x[..., 0].astype(jnp.complex64)
+    if x.shape[-1] == 2:
+        return (x[..., 0] + 1j * x[..., 1]).astype(jnp.complex64)
+    raise UnsupportedOp(f"{what}: last dim must be 1 (real) or 2 (complex), "
+                        f"got {x.shape[-1]}")
+
+
+def _stack_complex(z):
+    return jnp.stack([jnp.real(z), jnp.imag(z)], axis=-1).astype(jnp.float32)
+
+
+@register_op("DFT")
+def _dft(node, inputs, ctx):
+    inverse = int(node.attr("inverse", 0))
+    onesided = int(node.attr("onesided", 0))
+    if inverse and onesided:
+        raise UnsupportedOp("DFT inverse+onesided")
+    # axis: opset-20 input 2, else attr (default 1 = the signal dim of
+    # [batch, n, 1|2])
+    if len(inputs) > 2 and inputs[2] is not None:
+        axis = int(_concrete(inputs[2], "DFT axis").ravel()[0])
+    else:
+        axis = int(node.attr("axis", 1))
+    z = _as_complex(inputs[0], "DFT")
+    if axis < 0:
+        # the spec counts axes on the FULL input rank (incl. the trailing
+        # real/imag component dim that _as_complex just dropped)
+        axis += z.ndim + 1
+    n = None
+    if len(inputs) > 1 and inputs[1] is not None:
+        n = int(_concrete(inputs[1], "DFT dft_length").ravel()[0])
+    if inverse:
+        out = jnp.fft.ifft(z, n=n, axis=axis)
+    elif onesided:
+        sig = jnp.asarray(inputs[0])
+        if sig.shape[-1] == 1:
+            out = jnp.fft.rfft(sig[..., 0].astype(jnp.float32),
+                               n=n, axis=axis)
+        else:
+            full = jnp.fft.fft(z, n=n, axis=axis)
+            keep = (n if n is not None else z.shape[axis]) // 2 + 1
+            out = lax.slice_in_dim(full, 0, keep, axis=axis)
+    else:
+        out = jnp.fft.fft(z, n=n, axis=axis)
+    return _stack_complex(out)
+
+
+@register_op("STFT")
+def _stft(node, inputs, ctx):
+    """[batch, n, 1|2] signal → [batch, frames, dft_bins, 2]."""
+    onesided = int(node.attr("onesided", 1))
+    signal = jnp.asarray(inputs[0])
+    step = int(_concrete(inputs[1], "STFT frame_step").ravel()[0])
+    window = (jnp.asarray(inputs[2]).astype(jnp.float32)
+              if len(inputs) > 2 and inputs[2] is not None else None)
+    if len(inputs) > 3 and inputs[3] is not None:
+        frame_length = int(_concrete(inputs[3],
+                                     "STFT frame_length").ravel()[0])
+    elif window is not None:
+        frame_length = int(window.shape[0])
+    else:
+        raise UnsupportedOp("STFT needs window or frame_length")
+    if signal.shape[-1] == 2 and onesided:
+        raise UnsupportedOp("STFT onesided over a complex signal")
+    z = _as_complex(signal, "STFT")               # (B, N)
+    B, N = z.shape
+    n_frames = 1 + (N - frame_length) // step
+    starts = jnp.arange(n_frames) * step
+    gather = starts[:, None] + jnp.arange(frame_length)[None, :]
+    frames = z[:, gather]                          # (B, frames, frame_len)
+    if window is not None:
+        frames = frames * window[None, None, :]
+    if onesided:
+        out = jnp.fft.rfft(jnp.real(frames).astype(jnp.float32), axis=-1)
+    else:
+        out = jnp.fft.fft(frames, axis=-1)
+    return _stack_complex(out)
+
+
+@register_op("MelWeightMatrix")
+def _mel_weight_matrix(node, inputs, ctx):
+    """[dft//2+1, mel_bins] triangular filterbank (HTK mel scale) — the
+    tf.signal.linear_to_mel_weight_matrix layout the ONNX spec adopts."""
+    nm = int(_concrete(inputs[0], "num_mel_bins").ravel()[0])
+    dft = int(_concrete(inputs[1], "dft_length").ravel()[0])
+    sr = float(_concrete(inputs[2], "sample_rate").ravel()[0])
+    lo = float(_concrete(inputs[3], "lower_edge_hertz").ravel()[0])
+    hi = float(_concrete(inputs[4], "upper_edge_hertz").ravel()[0])
+    dt = ONNX_TO_NUMPY.get(node.attr("output_datatype"), np.float32)
+    n_spec = dft // 2 + 1
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f, np.float64) / 700.0)
+
+    mel_edges = np.linspace(hz_to_mel(lo), hz_to_mel(hi), nm + 2)
+    spec_hz = np.arange(n_spec) * sr / dft
+    spec_mel = hz_to_mel(spec_hz)
+    lower = mel_edges[:-2][None, :]               # (1, nm)
+    center = mel_edges[1:-1][None, :]
+    upper = mel_edges[2:][None, :]
+    s = spec_mel[:, None]                         # (n_spec, 1)
+    up = (s - lower) / np.maximum(center - lower, 1e-12)
+    down = (upper - s) / np.maximum(upper - center, 1e-12)
+    w = np.maximum(0.0, np.minimum(up, down))
+    return jnp.asarray(w.astype(dt))

@@ -1,0 +1,408 @@
+"""Long-tail ONNX ops (onnx/extra_ops.py): audio/DSP, integer-quantized,
+vanilla RNN, losses, LRN/Lp pooling, bitwise.
+
+References: numpy/scipy math written independently of the handlers, and
+torch.nn.functional for the loss ops (real torch is in the image — the
+strongest available oracle). Parity anchor: ORT's full standard opset
+behind ``ONNXModel.scala:330``.
+"""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx as O
+from mmlspark_tpu.onnx.convert import convert_model
+
+
+def run(nodes, feeds, out_names, initializers=None):
+    inputs = [O.make_tensor_value_info(k, v.dtype, list(v.shape))
+              for k, v in feeds.items()]
+    outs = [O.make_tensor_value_info(o, np.float32, ["?"])
+            for o in out_names]
+    g = O.make_graph(nodes, "g", inputs, outs,
+                     initializers=initializers or {})
+    cm = convert_model(O.make_model(g))
+    res = cm(cm.params, {k: np.asarray(v) for k, v in feeds.items()})
+    return [np.asarray(res[o]) for o in out_names]
+
+
+class TestSmallOps:
+    def test_reduce_log_sum(self):
+        x = np.abs(np.random.default_rng(0).normal(1, 1, (3, 4))) \
+            .astype(np.float32)
+        (y,) = run([O.make_node("ReduceLogSum", ["x"], ["y"], axes=[1])],
+                   {"x": x}, ["y"])
+        np.testing.assert_allclose(y, np.log(x.sum(1, keepdims=True)),
+                                   rtol=1e-5)
+
+    def test_bitwise(self):
+        a = np.array([0b1100, 0b1010], np.int32)
+        b = np.array([0b1010, 0b0110], np.int32)
+        for op, ref in [("BitwiseAnd", a & b), ("BitwiseOr", a | b),
+                        ("BitwiseXor", a ^ b)]:
+            (y,) = run([O.make_node(op, ["a", "b"], ["y"])],
+                       {"a": a, "b": b}, ["y"])
+            np.testing.assert_array_equal(y, ref)
+        (y,) = run([O.make_node("BitwiseNot", ["a"], ["y"])], {"a": a}, ["y"])
+        np.testing.assert_array_equal(y, ~a)
+
+    def test_det(self):
+        x = np.random.default_rng(1).normal(0, 1, (4, 3, 3)) \
+            .astype(np.float32)
+        (y,) = run([O.make_node("Det", ["x"], ["y"])], {"x": x}, ["y"])
+        np.testing.assert_allclose(y, np.linalg.det(x), rtol=2e-4)
+
+    def test_mvn(self):
+        x = np.random.default_rng(2).normal(3, 2, (2, 3, 4, 5)) \
+            .astype(np.float32)
+        (y,) = run([O.make_node("MeanVarianceNormalization", ["x"], ["y"])],
+                   {"x": x}, ["y"])
+        mean = x.mean(axis=(0, 2, 3), keepdims=True)
+        std = x.std(axis=(0, 2, 3), keepdims=True)
+        np.testing.assert_allclose(y, (x - mean) / (std + 1e-7),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_lrn_matches_reference_loop(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(0, 1, (2, 7, 4, 4)).astype(np.float32)
+        size, alpha, beta, bias = 3, 2e-4, 0.6, 1.5
+        (y,) = run([O.make_node("LRN", ["x"], ["y"], size=size, alpha=alpha,
+                                beta=beta, bias=bias)], {"x": x}, ["y"])
+        C = x.shape[1]
+        ref = np.empty_like(x)
+        lo = (size - 1) // 2
+        hi = size - 1 - lo
+        for c in range(C):
+            s = x[:, max(0, c - lo):min(C, c + hi + 1)] ** 2
+            denom = (bias + (alpha / size) * s.sum(axis=1)) ** beta
+            ref[:, c] = x[:, c] / denom
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_lp_pool_and_global(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(0, 1, (1, 2, 6)).astype(np.float32)
+        (y,) = run([O.make_node("LpPool", ["x"], ["y"], kernel_shape=[2],
+                                strides=[2], p=2)], {"x": x}, ["y"])
+        ref = np.sqrt((x.reshape(1, 2, 3, 2) ** 2).sum(-1))
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+        (g,) = run([O.make_node("GlobalLpPool", ["x"], ["g"], p=1)],
+                   {"x": x}, ["g"])
+        np.testing.assert_allclose(
+            g, np.abs(x).sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_max_unpool(self):
+        # 1x1x4 input pooled with k=2,s=2 -> values [5, 8] at flat idx 1, 3
+        x = np.array([[[5.0, 8.0]]], np.float32)
+        idx = np.array([[[1, 3]]], np.int64)
+        (y,) = run([O.make_node("MaxUnpool", ["x", "i"], ["y"],
+                                kernel_shape=[2], strides=[2])],
+                   {"x": x, "i": idx}, ["y"])
+        np.testing.assert_allclose(y, [[[0, 5, 0, 8]]])
+
+
+class TestIntegerQuant:
+    def test_matmul_integer(self):
+        rng = np.random.default_rng(5)
+        a = rng.integers(0, 255, (3, 4)).astype(np.uint8)
+        b = rng.integers(-127, 127, (4, 2)).astype(np.int8)
+        azp = np.uint8(128)
+        bzp = np.int8(3)
+        (y,) = run([O.make_node("MatMulInteger", ["a", "b", "azp", "bzp"],
+                                ["y"])],
+                   {"a": a, "b": b}, ["y"],
+                   initializers={"azp": azp.reshape(()),
+                                 "bzp": bzp.reshape(())})
+        ref = (a.astype(np.int32) - 128) @ (b.astype(np.int32) - 3)
+        np.testing.assert_array_equal(y, ref)
+
+    def test_conv_integer(self):
+        rng = np.random.default_rng(6)
+        x = rng.integers(0, 20, (1, 1, 5, 5)).astype(np.uint8)
+        w = rng.integers(-5, 5, (1, 1, 3, 3)).astype(np.int8)
+        (y,) = run([O.make_node("ConvInteger", ["x", "w", "xzp"], ["y"])],
+                   {"x": x}, ["y"],
+                   initializers={"w": w, "xzp": np.uint8(2).reshape(())})
+        import torch
+        import torch.nn.functional as F
+        ref = F.conv2d(torch.tensor(x.astype(np.float32) - 2),
+                       torch.tensor(w.astype(np.float32))).numpy()
+        np.testing.assert_array_equal(y, ref.astype(np.int32))
+
+    def test_dynamic_quantize_linear(self):
+        x = np.array([0.0, 2.0, -3.0, 1.5], np.float32)
+        y, scale, zp = run(
+            [O.make_node("DynamicQuantizeLinear", ["x"], ["y", "s", "z"])],
+            {"x": x}, ["y", "s", "z"])
+        assert y.dtype == np.uint8 and zp.dtype == np.uint8
+        np.testing.assert_allclose(scale, 5.0 / 255.0, rtol=1e-6)
+        # dequantized round-trips within one quantum
+        deq = (y.astype(np.float32) - zp.astype(np.float32)) * scale
+        np.testing.assert_allclose(deq, x, atol=float(scale) * 0.51)
+
+
+class TestRNN:
+    def _ref(self, X, W, R, B, h0, reverse=False):
+        T, Bt, _ = X.shape
+        H = W.shape[0]
+        h = h0.copy()
+        ys = []
+        ts = range(T - 1, -1, -1) if reverse else range(T)
+        for t in ts:
+            h = np.tanh(X[t] @ W.T + B[:H] + h @ R.T + B[H:])
+            ys.append(h)
+        if reverse:
+            ys = ys[::-1]
+        return np.stack(ys), h
+
+    def test_forward(self):
+        rng = np.random.default_rng(7)
+        T, Bt, I, H = 5, 2, 3, 4
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        W = rng.normal(0, 0.5, (1, H, I)).astype(np.float32)
+        R = rng.normal(0, 0.5, (1, H, H)).astype(np.float32)
+        B = rng.normal(0, 0.1, (1, 2 * H)).astype(np.float32)
+        Y, Yh = run([O.make_node("RNN", ["x", "w", "r", "b"], ["Y", "Yh"],
+                                 hidden_size=H)],
+                    {"x": X}, ["Y", "Yh"],
+                    initializers={"w": W, "r": R, "b": B})
+        ys, h = self._ref(X, W[0], R[0], B[0], np.zeros((Bt, H), np.float32))
+        np.testing.assert_allclose(Y[:, 0], ys, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(Yh[0], h, rtol=1e-5, atol=1e-6)
+
+    def test_bidirectional_with_h0(self):
+        rng = np.random.default_rng(8)
+        T, Bt, I, H = 4, 3, 2, 3
+        X = rng.normal(0, 1, (T, Bt, I)).astype(np.float32)
+        W = rng.normal(0, 0.5, (2, H, I)).astype(np.float32)
+        R = rng.normal(0, 0.5, (2, H, H)).astype(np.float32)
+        B = rng.normal(0, 0.1, (2, 2 * H)).astype(np.float32)
+        h0 = rng.normal(0, 1, (2, Bt, H)).astype(np.float32)
+        Y, Yh = run([O.make_node("RNN", ["x", "w", "r", "b", "", "h0"],
+                                 ["Y", "Yh"], hidden_size=H,
+                                 direction="bidirectional")],
+                    {"x": X}, ["Y", "Yh"],
+                    initializers={"w": W, "r": R, "b": B, "h0": h0})
+        fy, fh = self._ref(X, W[0], R[0], B[0], h0[0])
+        ry, rh = self._ref(X, W[1], R[1], B[1], h0[1], reverse=True)
+        np.testing.assert_allclose(Y[:, 0], fy, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(Y[:, 1], ry, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(Yh[1], rh, rtol=1e-5, atol=1e-6)
+
+
+class TestActivationValidation:
+    def test_gru_tanh_gates_rejected(self):
+        # a GRU whose GATE activation is Tanh must be rejected, not
+        # silently computed with sigmoid gates (regression: widening the
+        # shared whitelist for RNN let this through)
+        from mmlspark_tpu.onnx.convert import UnsupportedOp
+        rng = np.random.default_rng(20)
+        H, I = 3, 2
+        X = rng.normal(0, 1, (4, 1, I)).astype(np.float32)
+        W = rng.normal(0, 0.5, (1, 3 * H, I)).astype(np.float32)
+        R = rng.normal(0, 0.5, (1, 3 * H, H)).astype(np.float32)
+        with pytest.raises(UnsupportedOp, match="activations"):
+            run([O.make_node("GRU", ["x", "w", "r"], ["Y", "Yh"],
+                             hidden_size=H,
+                             activations=["Tanh", "Tanh"])],
+                {"x": X}, ["Y"], initializers={"w": W, "r": R})
+
+    def test_rnn_sigmoid_rejected(self):
+        from mmlspark_tpu.onnx.convert import UnsupportedOp
+        rng = np.random.default_rng(21)
+        X = rng.normal(0, 1, (4, 1, 2)).astype(np.float32)
+        W = rng.normal(0, 0.5, (1, 3, 2)).astype(np.float32)
+        R = rng.normal(0, 0.5, (1, 3, 3)).astype(np.float32)
+        with pytest.raises(UnsupportedOp, match="activations"):
+            run([O.make_node("RNN", ["x", "w", "r"], ["Y", "Yh"],
+                             hidden_size=3, activations=["Sigmoid"])],
+                {"x": X}, ["Y"], initializers={"w": W, "r": R})
+
+
+class TestLosses:
+    def test_nll_loss_vs_torch(self):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.default_rng(9)
+        logp = np.log(rng.dirichlet(np.ones(5), size=6)).astype(np.float32)
+        tgt = rng.integers(0, 5, 6).astype(np.int64)
+        w = rng.random(5).astype(np.float32)
+        for reduction in ("mean", "sum", "none"):
+            (y,) = run([O.make_node("NegativeLogLikelihoodLoss",
+                                    ["x", "t", "w"], ["y"],
+                                    reduction=reduction)],
+                       {"x": logp, "t": tgt}, ["y"],
+                       initializers={"w": w})
+            ref = F.nll_loss(torch.tensor(logp), torch.tensor(tgt),
+                             torch.tensor(w), reduction=reduction).numpy()
+            np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_nll_ignore_index(self):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.default_rng(10)
+        logp = np.log(rng.dirichlet(np.ones(4), size=5)).astype(np.float32)
+        tgt = np.array([0, 1, 2, 3, 2], np.int64)
+        (y,) = run([O.make_node("NegativeLogLikelihoodLoss", ["x", "t"],
+                                ["y"], reduction="mean", ignore_index=2)],
+                   {"x": logp, "t": tgt}, ["y"])
+        ref = F.nll_loss(torch.tensor(logp), torch.tensor(tgt),
+                         ignore_index=2).numpy()
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+    def test_softmax_cross_entropy_vs_torch(self):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.default_rng(11)
+        scores = rng.normal(0, 2, (7, 4)).astype(np.float32)
+        tgt = rng.integers(0, 4, 7).astype(np.int64)
+        y, logp = run([O.make_node("SoftmaxCrossEntropyLoss", ["x", "t"],
+                                   ["y", "lp"])],
+                      {"x": scores, "t": tgt}, ["y", "lp"])
+        ref = F.cross_entropy(torch.tensor(scores),
+                              torch.tensor(tgt)).numpy()
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+        np.testing.assert_allclose(
+            logp, F.log_softmax(torch.tensor(scores), dim=1).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestRandom:
+    def test_random_normal_stats_and_determinism(self):
+        node = O.make_node("RandomNormal", [], ["y"], shape=[2000],
+                           mean=1.0, scale=2.0, seed=7.0)
+        (a,) = run([node], {"dummy": np.zeros(1, np.float32)}, ["y"])
+        (b,) = run([node], {"dummy": np.zeros(1, np.float32)}, ["y"])
+        np.testing.assert_array_equal(a, b)        # fixed seed → fixed draw
+        assert abs(a.mean() - 1.0) < 0.2 and abs(a.std() - 2.0) < 0.2
+
+    def test_random_uniform_like(self):
+        x = np.zeros((500,), np.float32)
+        (y,) = run([O.make_node("RandomUniformLike", ["x"], ["y"],
+                                low=2.0, high=3.0)], {"x": x}, ["y"])
+        assert y.shape == x.shape
+        assert (y >= 2.0).all() and (y < 3.0).all()
+
+
+class TestAudio:
+    def test_windows_formulas(self):
+        size = np.array(16, np.int64)
+        for op, coeffs in [("HannWindow", [0.5, 0.5]),
+                           ("HammingWindow", [25 / 46, 21 / 46]),
+                           ("BlackmanWindow", [0.42, 0.5, 0.08])]:
+            (w,) = run([O.make_node(op, ["n"], ["w"])], {"n": size}, ["w"])
+            n = np.arange(16)
+            ref = sum(((-1.0) ** k) * a * np.cos(2 * np.pi * k * n / 16)
+                      for k, a in enumerate(coeffs))
+            np.testing.assert_allclose(w, ref, rtol=1e-5, atol=1e-6)
+            # symmetric variant uses N-1 in the denominator
+            (ws,) = run([O.make_node(op, ["n"], ["w"], periodic=0)],
+                        {"n": size}, ["w"])
+            refs = sum(((-1.0) ** k) * a * np.cos(2 * np.pi * k * n / 15)
+                       for k, a in enumerate(coeffs))
+            np.testing.assert_allclose(ws, refs, rtol=1e-5, atol=1e-6)
+
+    def test_dft_matches_numpy(self):
+        rng = np.random.default_rng(12)
+        x = rng.normal(0, 1, (2, 16, 1)).astype(np.float32)
+        (y,) = run([O.make_node("DFT", ["x"], ["y"])], {"x": x}, ["y"])
+        ref = np.fft.fft(x[..., 0], axis=1)
+        np.testing.assert_allclose(y[..., 0], ref.real, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(y[..., 1], ref.imag, rtol=1e-4,
+                                   atol=1e-4)
+        # onesided real input
+        (h,) = run([O.make_node("DFT", ["x"], ["y"], onesided=1)],
+                   {"x": x}, ["y"])
+        rref = np.fft.rfft(x[..., 0], axis=1)
+        np.testing.assert_allclose(h[..., 0], rref.real, rtol=1e-4,
+                                   atol=1e-4)
+        # inverse round-trip
+        (inv,) = run([O.make_node("DFT", ["y"], ["z"], inverse=1)],
+                     {"y": np.stack([ref.real, ref.imag], -1)
+                      .astype(np.float32)}, ["z"])
+        np.testing.assert_allclose(inv[..., 0], x[..., 0], atol=1e-4)
+        # negative axis counts on the FULL input rank: -2 on [B, N, 1] is
+        # the signal axis (regression: was normalized against the complex
+        # view's rank, off by one)
+        (yn,) = run([O.make_node("DFT", ["x"], ["y"], axis=-2)],
+                    {"x": x}, ["y"])
+        np.testing.assert_allclose(yn[..., 0], ref.real, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_stft_matches_manual_framing(self):
+        rng = np.random.default_rng(13)
+        x = rng.normal(0, 1, (1, 32, 1)).astype(np.float32)
+        win = np.hanning(8).astype(np.float32)
+        (y,) = run([O.make_node("STFT", ["x", "step", "w"], ["y"],
+                                onesided=1)],
+                   {"x": x}, ["y"],
+                   initializers={"step": np.array(4, np.int64), "w": win})
+        n_frames = 1 + (32 - 8) // 4
+        assert y.shape == (1, n_frames, 8 // 2 + 1, 2)
+        for f in range(n_frames):
+            seg = x[0, f * 4:f * 4 + 8, 0] * win
+            ref = np.fft.rfft(seg)
+            np.testing.assert_allclose(y[0, f, :, 0], ref.real, rtol=1e-4,
+                                       atol=1e-4)
+            np.testing.assert_allclose(y[0, f, :, 1], ref.imag, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_mel_weight_matrix(self):
+        feeds = {"nm": np.array(8, np.int64)}
+        (w,) = run([O.make_node("MelWeightMatrix",
+                                ["nm", "dft", "sr", "lo", "hi"], ["w"])],
+                   feeds, ["w"],
+                   initializers={"dft": np.array(64, np.int64),
+                                 "sr": np.array(8000, np.int64),
+                                 "lo": np.array(0.0, np.float32),
+                                 "hi": np.array(4000.0, np.float32)})
+        assert w.shape == (33, 8)
+        assert (w >= 0).all() and w.max() <= 1.0 + 1e-6
+        # every mel bin has support, triangles peak once
+        assert (w.sum(axis=0) > 0).all()
+        # independently-computed triangle for one bin
+        def mel(f):
+            return 2595 * np.log10(1 + f / 700)
+        edges = np.linspace(mel(0), mel(4000), 10)
+        spec_mel = mel(np.arange(33) * 8000 / 64)
+        j = 3
+        up = (spec_mel - edges[j]) / (edges[j + 1] - edges[j])
+        down = (edges[j + 2] - spec_mel) / (edges[j + 2] - edges[j + 1])
+        ref = np.maximum(0, np.minimum(up, down))
+        np.testing.assert_allclose(w[:, j], ref, rtol=1e-4, atol=1e-5)
+
+
+class TestAsrPreprocessGraph:
+    def test_log_mel_pipeline(self):
+        """Whisper-style preprocessing as ONE graph: STFT → |.|² → mel
+        projection → log — the audio front-end the reference reaches via
+        its speech services."""
+        rng = np.random.default_rng(14)
+        sr, n = 8000, 512
+        t = np.arange(n) / sr
+        sig = (np.sin(2 * np.pi * 440 * t)
+               + 0.5 * rng.normal(0, 0.1, n)).astype(np.float32)
+        x = sig.reshape(1, n, 1)
+        win = np.hanning(64).astype(np.float32)
+        nodes = [
+            O.make_node("STFT", ["x", "step", "w"], ["spec"], onesided=1),
+            O.make_node("ReduceSumSquare", ["spec"], ["power"], axes=[-1],
+                        keepdims=0),
+            O.make_node("MelWeightMatrix",
+                        ["nmel", "dft", "sr", "lo", "hi"], ["mel_w"]),
+            O.make_node("MatMul", ["power", "mel_w"], ["mel"]),
+            O.make_node("Add", ["mel", "eps"], ["mel_e"]),
+            O.make_node("Log", ["mel_e"], ["logmel"]),
+        ]
+        (lm,) = run(nodes, {"x": x}, ["logmel"], initializers={
+            "step": np.array(32, np.int64), "w": win,
+            "nmel": np.array(10, np.int64), "dft": np.array(64, np.int64),
+            "sr": np.array(sr, np.int64), "lo": np.array(20.0, np.float32),
+            "hi": np.array(4000.0, np.float32),
+            "eps": np.array(1e-6, np.float32)})
+        n_frames = 1 + (n - 64) // 32
+        assert lm.shape == (1, n_frames, 10)
+        assert np.isfinite(lm).all()
+        # the 440 Hz tone concentrates energy in one mel band
+        band = lm[0].mean(axis=0)
+        assert band.argmax() in range(1, 5)
