@@ -21,7 +21,20 @@ from ..core.dataframe import DataFrame, concat
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Transformer
 
-__all__ = ["LocalExplainer", "shapley_kernel_weights"]
+__all__ = ["LocalExplainer", "shapley_kernel_weights", "dense_row"]
+
+
+def dense_row(v) -> np.ndarray:
+    """One features-column row → flat float64 vector; scipy sparse rows
+    densify here (explainers perturb in dense space — a row's worth at a
+    time, so this never materializes the full sparse matrix)."""
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(v):
+            return np.asarray(v.todense(), dtype=np.float64).ravel()
+    except ImportError:         # pragma: no cover - scipy is in the image
+        pass
+    return np.asarray(v, dtype=np.float64).ravel()
 
 
 class LocalExplainer(Transformer):

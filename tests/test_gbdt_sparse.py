@@ -213,6 +213,33 @@ class TestSparseDataFrameAPI:
         assert 1 - np.var(y - pred) / max(np.var(y), 1e-9) > 0.5
 
 
+class TestSparseExplainers:
+    def test_vector_shap_over_sparse_model(self):
+        # the follow-on a sparse-GBDT user reaches for next: KernelSHAP on
+        # a sparse features column (rows densify one at a time)
+        from mmlspark_tpu.explainers import VectorSHAP
+        dense, csr = make_sparse(n=120, f=5, seed=12)
+        y = target_for(dense, seed=12)
+        col = np.empty(csr.shape[0], dtype=object)
+        for i in range(csr.shape[0]):
+            col[i] = csr[i]
+        df = DataFrame({"features": col, "label": y})
+        model = LightGBMClassifier(num_iterations=10, num_leaves=7,
+                                   min_data_in_leaf=5).fit(df)
+        shap = VectorSHAP(model=model, target_col="probability",
+                          input_col="features", output_col="shap",
+                          num_samples=32, seed=0)
+        out = shap.transform(df.head(4))
+        svals = np.stack([np.asarray(v) for v in out["shap"]])
+        assert svals.shape[0] == 4 and np.isfinite(svals).all()
+        # same explanation as the dense representation of the same rows
+        dcol = np.empty(4, dtype=object)
+        dcol[:] = list(dense[:4].astype(np.float64))
+        out_d = shap.transform(DataFrame({"features": dcol}))
+        dvals = np.stack([np.asarray(v) for v in out_d["shap"]])
+        np.testing.assert_allclose(svals, dvals, rtol=1e-6, atol=1e-8)
+
+
 class TestLibsvmSparse:
     def test_read_sparse_matches_dense(self, tmp_path):
         from mmlspark_tpu.io.libsvm import read_libsvm
