@@ -23,17 +23,18 @@ from ..core.pipeline import Transformer
 
 __all__ = ["LocalExplainer", "shapley_kernel_weights", "dense_row"]
 
+try:                            # guarded like models/gbdt/binning.py
+    import scipy.sparse as _sp
+except Exception:               # pragma: no cover - scipy is in the image
+    _sp = None
+
 
 def dense_row(v) -> np.ndarray:
     """One features-column row → flat float64 vector; scipy sparse rows
     densify here (explainers perturb in dense space — a row's worth at a
     time, so this never materializes the full sparse matrix)."""
-    try:
-        import scipy.sparse as sp
-        if sp.issparse(v):
-            return np.asarray(v.todense(), dtype=np.float64).ravel()
-    except ImportError:         # pragma: no cover - scipy is in the image
-        pass
+    if _sp is not None and _sp.issparse(v):
+        return v.toarray().astype(np.float64).ravel()
     return np.asarray(v, dtype=np.float64).ravel()
 
 

@@ -1,0 +1,121 @@
+"""Training on imported ONNX graphs — inference artifacts become tunable.
+
+The reference's ONNX path is inference-only by construction: ORT executes
+a frozen session (``deep-learning/.../onnx/ONNXModel.scala:330``) and
+fine-tuning means going back to the exporting framework. Here an imported
+graph IS a pure JAX function over an explicit ``params`` dict
+(``ConvertedModel.__call__(params, feeds)``), so ``jax.grad`` flows
+through every differentiable handler and any ONNX model — including a
+genuine ``torch.onnx.export`` artifact — fine-tunes on TPU without torch
+in the loop. With the standard loss ops (SoftmaxCrossEntropyLoss /
+NegativeLogLikelihoodLoss) a graph can even carry its own training
+objective.
+
+Two entry points:
+
+* :func:`value_and_grad` — differentiate a scalar derived from the
+  graph's outputs w.r.t. its params.
+* :func:`make_train_step` — one jitted optax update step; scan it or loop
+  it, params stay on device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .convert import ConvertedModel
+
+__all__ = ["value_and_grad", "make_train_step", "fine_tune"]
+
+
+def _scalar_loss(model: ConvertedModel, loss_fn, output: Optional[str]):
+    """Build loss(params, feeds) -> scalar from either a graph output that
+    is already a loss, or a callable over the outputs dict."""
+    if loss_fn is None:
+        if output is None:
+            if len(model.output_names) != 1:
+                raise ValueError(
+                    "graph has several outputs; pass output= (a scalar "
+                    "loss output) or loss_fn=(outputs, feeds) -> scalar")
+            output = model.output_names[0]
+
+        def loss(params, feeds):
+            return jnp.sum(model(params, feeds)[output])
+    else:
+        def loss(params, feeds):
+            return loss_fn(model(params, feeds), feeds)
+    return loss
+
+
+def value_and_grad(model: ConvertedModel,
+                   loss_fn: Optional[Callable] = None,
+                   output: Optional[str] = None):
+    """``(params, feeds) -> (scalar, grads)`` for an imported graph.
+
+    ``output`` names a graph output that already IS the loss (e.g. a
+    SoftmaxCrossEntropyLoss node with reduction='mean'); alternatively
+    ``loss_fn(outputs_dict, feeds) -> scalar`` computes one.
+    """
+    return jax.value_and_grad(_scalar_loss(model, loss_fn, output))
+
+
+def make_train_step(model: ConvertedModel, optimizer,
+                    loss_fn: Optional[Callable] = None,
+                    output: Optional[str] = None,
+                    trainable: Optional[Callable[[str], bool]] = None):
+    """One jitted optimizer step over the graph's params.
+
+    ``optimizer`` is any optax GradientTransformation. ``trainable`` is an
+    optional name predicate — params it rejects get zero updates (the
+    cut-layer / frozen-backbone pattern ImageFeaturizer uses). Returns
+    ``(step, init_state)`` where
+    ``step(params, opt_state, feeds) -> (params, opt_state, loss)``.
+    """
+    loss = _scalar_loss(model, loss_fn, output)
+
+    @jax.jit
+    def step(params, opt_state, feeds):
+        val, grads = jax.value_and_grad(loss)(params, feeds)
+        if trainable is not None:
+            grads = {k: (g if trainable(k) else jnp.zeros_like(g))
+                     for k, g in grads.items()}
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = {k: params[k] + updates[k] for k in params}
+        return params, opt_state, val
+
+    def init(params):
+        return optimizer.init(
+            {k: jnp.asarray(v) for k, v in params.items()})
+
+    return step, init
+
+
+def fine_tune(model: ConvertedModel, feeds_iter, optimizer=None,
+              loss_fn: Optional[Callable] = None,
+              output: Optional[str] = None,
+              steps: Optional[int] = None,
+              trainable: Optional[Callable[[str], bool]] = None,
+              params: Optional[Dict] = None):
+    """Convenience loop: iterate ``feeds_iter`` (dicts of graph inputs),
+    one optimizer step each; returns (params, losses). ``params`` defaults
+    to the graph's own initializers — the imported weights are the warm
+    start."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.adam(1e-3)
+    step, init = make_train_step(model, optimizer, loss_fn=loss_fn,
+                                 output=output, trainable=trainable)
+    params = {k: jnp.asarray(v)
+              for k, v in (params if params is not None
+                           else model.params).items()}
+    opt_state = init(params)
+    losses = []
+    for i, feeds in enumerate(feeds_iter):
+        if steps is not None and i >= steps:
+            break
+        params, opt_state, val = step(params, opt_state, feeds)
+        losses.append(float(val))
+    return params, losses

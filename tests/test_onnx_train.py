@@ -1,0 +1,155 @@
+"""Training on imported ONNX graphs (onnx/train.py).
+
+What the reference structurally cannot do: its ONNX path is a frozen ORT
+session (``ONNXModel.scala:330``); here imported graphs are pure JAX over
+an explicit params dict, so jax.grad + optax fine-tune them — including
+genuine ``torch.onnx.export`` artifacts, with torch out of the loop.
+"""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx as O
+from mmlspark_tpu.onnx.convert import convert_model
+from mmlspark_tpu.onnx.train import fine_tune, make_train_step, value_and_grad
+
+
+def mlp_with_loss(din=6, dhid=8, dout=3, seed=0):
+    """MLP whose graph carries its OWN SoftmaxCrossEntropyLoss objective."""
+    rng = np.random.default_rng(seed)
+    w1 = rng.normal(0, 0.5, (din, dhid)).astype(np.float32)
+    b1 = np.zeros(dhid, np.float32)
+    w2 = rng.normal(0, 0.5, (dhid, dout)).astype(np.float32)
+    b2 = np.zeros(dout, np.float32)
+    nodes = [
+        O.make_node("MatMul", ["x", "w1"], ["h0"]),
+        O.make_node("Add", ["h0", "b1"], ["h1"]),
+        O.make_node("Relu", ["h1"], ["h2"]),
+        O.make_node("MatMul", ["h2", "w2"], ["l0"]),
+        O.make_node("Add", ["l0", "b2"], ["logits"]),
+        O.make_node("SoftmaxCrossEntropyLoss", ["logits", "labels"],
+                    ["loss"]),
+    ]
+    g = O.make_graph(
+        nodes, "mlp_train",
+        inputs=[O.make_tensor_value_info("x", np.float32, ["N", din]),
+                O.make_tensor_value_info("labels", np.int64, ["N"])],
+        outputs=[O.make_tensor_value_info("loss", np.float32, []),
+                 O.make_tensor_value_info("logits", np.float32,
+                                          ["N", dout])],
+        initializers={"w1": w1, "b1": b1, "w2": w2, "b2": b2})
+    return O.make_model(g)
+
+
+def toy_data(n=256, din=6, dout=3, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, din)).astype(np.float32)
+    y = (X[:, :dout].argmax(axis=1)).astype(np.int64)
+    return X, y
+
+
+class TestValueAndGrad:
+    def test_grads_flow_to_all_params(self):
+        cm = convert_model(mlp_with_loss())
+        X, y = toy_data(32)
+        vg = value_and_grad(cm, output="loss")
+        val, grads = vg(cm.params, {"x": X, "labels": y})
+        assert np.isfinite(float(val))
+        assert set(grads) == set(cm.params)
+        for k, g in grads.items():
+            assert np.asarray(g).shape == np.asarray(cm.params[k]).shape
+            assert np.abs(np.asarray(g)).sum() > 0, f"zero grad for {k}"
+
+    def test_loss_fn_form(self):
+        cm = convert_model(mlp_with_loss())
+        X, y = toy_data(16)
+
+        def loss_fn(outputs, feeds):
+            import jax.numpy as jnp
+            onehot = jnp.eye(3)[feeds["labels"]]
+            p = jnp.exp(outputs["logits"])
+            p = p / p.sum(-1, keepdims=True)
+            return jnp.mean(((p - onehot) ** 2))
+        val, grads = value_and_grad(cm, loss_fn=loss_fn)(
+            cm.params, {"x": X, "labels": y})
+        assert np.isfinite(float(val))
+
+
+class TestFineTune:
+    def test_loss_decreases_and_accuracy_improves(self):
+        import optax
+        cm = convert_model(mlp_with_loss())
+        X, y = toy_data(256)
+
+        def batches():
+            while True:
+                yield {"x": X, "labels": y}
+
+        params, losses = fine_tune(cm, batches(),
+                                   optimizer=optax.adam(5e-2),
+                                   output="loss", steps=60)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        logits0 = np.asarray(cm(cm.params, {"x": X, "labels": y})["logits"])
+        logits1 = np.asarray(cm(params, {"x": X, "labels": y})["logits"])
+        acc0 = (logits0.argmax(1) == y).mean()
+        acc1 = (logits1.argmax(1) == y).mean()
+        assert acc1 > acc0 and acc1 > 0.85, (acc0, acc1)
+
+    def test_frozen_backbone(self):
+        import optax
+        cm = convert_model(mlp_with_loss())
+        X, y = toy_data(64)
+        step, init = make_train_step(
+            cm, optax.sgd(0.1), output="loss",
+            trainable=lambda name: name in ("w2", "b2"))
+        params = {k: np.asarray(v) for k, v in cm.params.items()}
+        opt_state = init(params)
+        new_params, _, _ = step(params, opt_state, {"x": X, "labels": y})
+        np.testing.assert_array_equal(np.asarray(new_params["w1"]),
+                                      params["w1"])   # frozen
+        assert np.abs(np.asarray(new_params["w2"])
+                      - params["w2"]).max() > 0       # trained
+
+    def test_torch_exported_model_fine_tunes(self):
+        torch = pytest.importorskip("torch")
+        import io
+        import optax
+        from mmlspark_tpu.interop.onnx_shim import install_onnx_shim
+        install_onnx_shim()
+
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = torch.nn.Linear(6, 10)
+                self.fc2 = torch.nn.Linear(10, 3)
+
+            def forward(self, x):
+                return self.fc2(torch.relu(self.fc1(x)))
+
+        net = Net().eval()
+        buf = io.BytesIO()
+        torch.onnx.export(net, (torch.zeros(4, 6),), buf,
+                          input_names=["x"], output_names=["logits"],
+                          dynamo=False,
+                          dynamic_axes={"x": {0: "N"},
+                                        "logits": {0: "N"}})
+        cm = convert_model(buf.getvalue())
+        X, y = toy_data(256, seed=3)
+
+        def loss_fn(outputs, feeds):
+            import jax
+            import jax.numpy as jnp
+            lp = jax.nn.log_softmax(outputs["logits"], axis=-1)
+            return -jnp.take_along_axis(
+                lp, feeds["labels"][:, None], axis=1).mean()
+
+        def batches():
+            while True:
+                yield {"x": X, "labels": y}
+
+        params, losses = fine_tune(cm, batches(),
+                                   optimizer=optax.adam(5e-2),
+                                   loss_fn=loss_fn, steps=50)
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+        logits = np.asarray(cm(params, {"x": X})["logits"])
+        assert (logits.argmax(1) == y).mean() > 0.8
