@@ -1,0 +1,192 @@
+"""ONNXEstimator — fine-tune an ONNX graph as a pipeline Estimator.
+
+Completes the DataFrame-level story for :mod:`mmlspark_tpu.onnx.train`:
+``fit(df)`` runs optax steps over the imported graph's params and returns
+a fitted :class:`ONNXModel` whose ``weights_override`` carries the tuned
+weights (the original model bytes stay untouched, so the artifact remains
+a standard ONNX file plus a weight delta).
+
+The reference has no counterpart — its ONNX stage wraps a frozen ORT
+session (``deep-learning/.../onnx/ONNXModel.scala:173-193``) and
+fine-tuning means returning to the exporting framework. Two objectives:
+
+* the graph carries its own loss output (e.g. a SoftmaxCrossEntropyLoss
+  node): set ``loss_output`` and ``label_input``;
+* or compute one here: ``objective='softmax_cross_entropy' | 'mse'`` over
+  ``target_output`` against ``label_col``.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Estimator
+from .onnx_model import ONNXModel
+
+__all__ = ["ONNXEstimator"]
+
+_INFERENCE_KEYS = ["feed_dict", "fetch_dict", "mini_batch_size",
+                   "softmax_dict", "argmax_dict", "compute_dtype",
+                   "normalize_dict", "transpose_dict", "pin_devices",
+                   "mesh_sharded", "external_data_dir"]
+
+
+class ONNXEstimator(Estimator):
+    model_bytes = ComplexParam(doc="serialized ONNX ModelProto")
+    feed_dict = Param(dict, default={},
+                      doc="{model input name: dataframe column}")
+    fetch_dict = Param(dict, default={},
+                       doc="{output column: model output name} for the "
+                           "fitted model")
+    label_col = Param(str, default="label", doc="label column")
+    label_input = Param(str, default=None,
+                        doc="graph input the labels feed (graph-carried "
+                            "loss mode)")
+    loss_output = Param(str, default=None,
+                        doc="graph output that IS the scalar loss")
+    objective = Param(str, default="softmax_cross_entropy",
+                      choices=["softmax_cross_entropy", "mse"],
+                      doc="loss computed here when the graph has none")
+    target_output = Param(str, default=None,
+                          doc="graph output the objective scores "
+                              "(default: the graph's only output)")
+    optimizer = Param(str, default="adam", choices=["adam", "sgd"],
+                      doc="optax optimizer")
+    learning_rate = Param(float, default=1e-3, doc="step size")
+    epochs = Param(int, default=1, doc="passes over the frame")
+    batch_size = Param(int, default=64, doc="rows per training step")
+    shuffle = Param(bool, default=True, doc="reshuffle rows every epoch")
+    seed = Param(int, default=0, doc="shuffle seed")
+    trainable_prefix = Param((list, str), default=[],
+                             doc="train only params whose name starts "
+                                 "with one of these (empty = all); the "
+                                 "frozen-backbone cut-layer pattern")
+    mini_batch_size = Param(int, default=64,
+                            doc="fitted model's inference batch size")
+    softmax_dict = Param(dict, default={}, doc="fitted model passthrough")
+    argmax_dict = Param(dict, default={}, doc="fitted model passthrough")
+    compute_dtype = Param(str, default="float32",
+                          doc="fitted model passthrough")
+    normalize_dict = Param(dict, default={}, doc="fitted model passthrough")
+    transpose_dict = Param(dict, default={}, doc="fitted model passthrough")
+    pin_devices = Param(bool, default=True, doc="fitted model passthrough")
+    mesh_sharded = Param(bool, default=False, doc="fitted model passthrough")
+    external_data_dir = Param(str, default="", doc="fitted model passthrough")
+
+    def __init__(self, model_bytes: Optional[bytes] = None,
+                 eval_log: Optional[list] = None, **kw):
+        super().__init__(**kw)
+        if model_bytes is not None:
+            self.set(model_bytes=model_bytes)
+        #: live list per-step losses append to during fit (a plain
+        #: attribute, not a Param — params are serialized values, and this
+        #: is a mutable channel back to the caller)
+        self._eval_log = eval_log
+
+    # -- batching ------------------------------------------------------------
+    def _column_feed(self, df: DataFrame, col: str) -> np.ndarray:
+        c = df[col]
+        if c.dtype == object:
+            return np.stack([np.asarray(v) for v in c])
+        return np.asarray(c)
+
+    def _loss_fn(self, output_names):
+        obj = self.get("objective")
+        target = self.get_or_none("target_output")
+        if target is None:
+            if len(output_names) != 1:
+                raise ValueError(
+                    f"graph has outputs {list(output_names)}; pass "
+                    "target_output to pick the one the objective scores")
+            target = output_names[0]
+
+        def loss_fn(outputs, feeds):
+            out = outputs[target]
+            y = feeds["__labels__"]
+            if obj == "mse":
+                # (N, 1) regression heads vs (N,) labels would broadcast
+                # to an (N, N) outer-difference matrix — align first
+                if out.shape != y.shape:
+                    out = out.reshape(y.shape)
+                return jnp.mean((out - y) ** 2)
+            lp = jax.nn.log_softmax(out, axis=-1)
+            return -jnp.take_along_axis(
+                lp, y[..., None].astype(jnp.int32), axis=-1).mean()
+        return loss_fn
+
+    def _fit(self, df: DataFrame) -> ONNXModel:
+        import optax
+        from ..onnx.convert import convert_model
+        from ..onnx.train import make_train_step
+
+        cm = convert_model(self.get("model_bytes"),
+                           external_data_dir=self.external_data_dir or None)
+        feeds_cols: Dict[str, np.ndarray] = {
+            inp: self._column_feed(df, col)
+            for inp, col in self.feed_dict.items()}
+        y = np.asarray(df[self.label_col])
+        n = len(df)
+
+        loss_output = self.get_or_none("loss_output")
+        label_input = self.get_or_none("label_input")
+        if loss_output is not None:
+            if label_input is None:
+                raise ValueError("loss_output mode needs label_input (the "
+                                 "graph input the labels feed)")
+            loss_fn = None
+        else:
+            loss_fn = self._loss_fn(cm.output_names)
+
+        opt = (optax.adam if self.optimizer == "adam" else optax.sgd)(
+            float(self.learning_rate))
+        prefixes = ([self.trainable_prefix]
+                    if isinstance(self.trainable_prefix, str)
+                    else list(self.trainable_prefix))
+        trainable = (None if not prefixes else
+                     (lambda name: any(name.startswith(p)
+                                       for p in prefixes)))
+        step, init = make_train_step(cm, opt, loss_fn=loss_fn,
+                                     output=loss_output,
+                                     trainable=trainable)
+        params = {k: jnp.asarray(v) for k, v in cm.params.items()}
+        opt_state = init(params)
+
+        bs = int(self.batch_size)
+        rng = np.random.default_rng(int(self.seed))
+        log = getattr(self, "_eval_log", None)
+        for ep in range(int(self.epochs)):
+            # full batches only: each distinct batch shape is its own XLA
+            # compile. Shuffled epochs fold the trailing remainder into the
+            # next permutation; unshuffled epochs rotate the start offset so
+            # no fixed tail of the frame is permanently excluded.
+            if self.shuffle:
+                order = rng.permutation(n)
+            else:
+                order = np.roll(np.arange(n), -(ep * bs) % max(n, 1))
+            for lo in range(0, n - bs + 1, bs):
+                sel = order[lo:lo + bs]
+                feeds = {k: v[sel] for k, v in feeds_cols.items()}
+                if loss_output is not None:
+                    feeds[label_input] = y[sel]
+                else:
+                    feeds["__labels__"] = y[sel]
+                params, opt_state, val = step(params, opt_state, feeds)
+                if log is not None:
+                    log.append(float(val))
+        if n < bs:
+            raise ValueError(
+                f"fewer rows ({n}) than batch_size ({bs}); no step ran")
+
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in params.items()})
+        m = ONNXModel(self.get("model_bytes"),
+                      **{k: self.get(k) for k in _INFERENCE_KEYS})
+        m.set(weights_override=buf.getvalue())
+        return m

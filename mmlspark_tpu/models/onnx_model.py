@@ -69,6 +69,12 @@ class ONNXModel(Model):
     external_data_dir = Param(str, default="",
                               doc="directory with sidecar files for models "
                                   "saved with external data")
+    weights_override = ComplexParam(default=None,
+                                    doc="npz payload of fine-tuned params "
+                                        "layered over the graph's own "
+                                        "initializers (ONNXEstimator.fit "
+                                        "sets this; the original model "
+                                        "bytes stay untouched)")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -125,6 +131,17 @@ class ONNXModel(Model):
                tuple(sorted((k, tuple(v)) for k, v in transpose.items())),
                str(compute_dt))
         if self._jitted is None or self._jit_sig != sig:
+            if set(fetch.values()) != set(cm.output_names):
+                # dead-node elimination from the requested outputs: a
+                # training graph (loss output + labels input) serves
+                # inference on just its prediction outputs with the loss
+                # subtree pruned away (no dummy label feeds at serving
+                # time), and fetching an internal tensor name works too —
+                # the cut-layer read ImageFeaturizer's reference does by
+                # re-exporting a truncated model. Inside the jit-miss
+                # branch: the ancestor walk is trace-time work, not
+                # per-partition overhead.
+                cm = cm.pruned(sorted(set(fetch.values())))
             def prep(name, x):
                 """On-device input prep: layout, dtype cast, normalization.
 
@@ -218,6 +235,34 @@ class ONNXModel(Model):
                            else v) for k, v in p.items()})
         return cast(params)
 
+    def _effective_params(self, cm: ConvertedModel) -> dict:
+        """Graph initializers with any fine-tuned override layered on top
+        (``weights_override`` npz — set by ONNXEstimator.fit)."""
+        ov = self.get_or_none("weights_override")
+        if not ov:
+            return cm.params
+        import io
+        with np.load(io.BytesIO(ov)) as z:
+            override = {k: z[k] for k in z.files}
+        unknown = sorted(set(override) - set(cm.params))
+        if unknown:
+            raise ValueError(
+                f"weights_override names unknown params {unknown[:5]} "
+                "(the override must come from this graph's fine-tune)")
+        return {**cm.params, **override}
+
+    def set(self, **kwargs):
+        if "weights_override" in kwargs \
+                and getattr(self, "_device_params", None):
+            # cached device params embed the previous override — drop them
+            # (an id()-keyed cache would risk stale hits after the old
+            # payload's address is reused). getattr: Params.__init__ may
+            # route constructor kwargs through set() before __init__ has
+            # built the cache attributes.
+            with self._params_lock:
+                self._device_params.clear()
+        return super().set(**kwargs)
+
     def _params_for_device(self, device) -> dict:
         if device is None:
             # normalize to the concrete default device so pinned and
@@ -233,7 +278,7 @@ class ONNXModel(Model):
                 # params are committed to `device`; the cast jit follows
                 # its operands
                 self._device_params[key] = self._cast_params(
-                    jax.device_put(cm.params, device))
+                    jax.device_put(self._effective_params(cm), device))
             return self._device_params[key]
 
     def _params_for_mesh(self, mesh) -> dict:
@@ -245,7 +290,8 @@ class ONNXModel(Model):
             if key not in self._device_params:
                 cm = self._ensure_converted()
                 self._device_params[key] = self._cast_params(
-                    jax.device_put(cm.params, replicated_sharding(mesh)))
+                    jax.device_put(self._effective_params(cm),
+                                   replicated_sharding(mesh)))
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------

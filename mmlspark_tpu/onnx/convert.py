@@ -2257,6 +2257,72 @@ class ConvertedModel:
         return jax.jit(self.__call__,
                        donate_argnums=(0,) if donate_params else ())
 
+    def pruned(self, outputs: List[str]) -> "ConvertedModel":
+        """Dead-node-eliminated view computing only ``outputs``.
+
+        A training graph (e.g. one carrying a SoftmaxCrossEntropyLoss
+        output and a labels input) serves inference by requesting just the
+        prediction outputs: the loss node becomes dead, and with it the
+        labels input disappears from ``input_names`` — no dummy labels at
+        serving time. Ancestor walk covers control-flow subgraph captures
+        (If/Loop/Scan bodies read outer-scope names).
+        """
+        unknown = [o for o in outputs if o not in
+                   {n for node in self.model.graph.nodes for n in node.output}
+                   | set(self.input_names) | set(self.const_params)
+                   | set(self.params)]
+        if unknown:
+            raise ValueError(f"pruned(): unknown outputs {unknown}")
+
+        def node_reads(node) -> set:
+            names = {i for i in node.input if i}
+            for a in node.attributes.values():
+                for sub in ([a.g] if a.g is not None else []) + list(a.graphs):
+                    produced = {n for sn in sub.nodes for n in sn.output}
+                    produced |= {vi.name for vi in sub.inputs}
+                    produced |= {t.name for t in sub.initializers}
+                    for sn in sub.nodes:
+                        names |= node_reads(sn) - produced
+            return names
+
+        producer = {}
+        for node in self.model.graph.nodes:
+            for out in node.output:
+                if out:
+                    producer[out] = node
+        needed_nodes: list = []
+        seen_ids: set = set()
+        stack = list(outputs)
+        visited_names: set = set()
+        while stack:
+            name = stack.pop()
+            if name in visited_names:
+                continue
+            visited_names.add(name)
+            node = producer.get(name)
+            if node is None or id(node) in seen_ids:
+                continue
+            seen_ids.add(id(node))
+            needed_nodes.append(node)
+            stack.extend(node_reads(node))
+
+        import copy
+        clone = copy.copy(self)
+        clone.model = copy.copy(self.model)
+        clone.model.graph = copy.copy(self.model.graph)
+        clone.model.graph.nodes = [n for n in self.model.graph.nodes
+                                   if id(n) in seen_ids]   # original order
+        clone.outputs = [vi for vi in self.outputs if vi.name in outputs]
+        clone.output_names = list(outputs)
+        used = visited_names
+        clone.inputs = [vi for vi in self.inputs if vi.name in used]
+        clone.input_names = [vi.name for vi in clone.inputs]
+        clone.const_params = {k: v for k, v in self.const_params.items()
+                              if k in used}
+        clone.params = {k: v for k, v in self.params.items() if k in used}
+        clone._ctx = _Ctx(self.model.opset)
+        return clone
+
 
 def convert_model(model_bytes: bytes,
                   external_data_dir=None) -> ConvertedModel:

@@ -78,13 +78,13 @@ def make_train_step(model: ConvertedModel, optimizer,
 
     @jax.jit
     def step(params, opt_state, feeds):
+        import optax
         val, grads = jax.value_and_grad(loss)(params, feeds)
         if trainable is not None:
             grads = {k: (g if trainable(k) else jnp.zeros_like(g))
                      for k, g in grads.items()}
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = {k: params[k] + updates[k] for k in params}
-        return params, opt_state, val
+        return optax.apply_updates(params, updates), opt_state, val
 
     def init(params):
         return optimizer.init(

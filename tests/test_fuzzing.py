@@ -169,7 +169,32 @@ def _registry():
                                  VowpalWabbitInteractions,
                                  VowpalWabbitRegressor)
 
+    from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+
     df = tab_df()
+
+    def _tiny_onnx_mlp():
+        import mmlspark_tpu.onnx as O
+        rng = np.random.default_rng(5)
+        w = rng.normal(0, 0.5, (3, 2)).astype(np.float32)
+        g = O.make_graph(
+            [O.make_node("MatMul", ["x", "w"], ["logits"]),
+             O.make_node("SoftmaxCrossEntropyLoss", ["logits", "labels"],
+                         ["loss"])],
+            "tiny",
+            inputs=[O.make_tensor_value_info("x", np.float32, ["N", 3]),
+                    O.make_tensor_value_info("labels", np.int64, ["N"])],
+            outputs=[O.make_tensor_value_info("loss", np.float32, []),
+                     O.make_tensor_value_info("logits", np.float32,
+                                              ["N", 2])],
+            initializers={"w": w})
+        return O.make_model(g)
+
+    def onnx_train_df():
+        rng = np.random.default_rng(6)
+        X = rng.normal(0, 1, (24, 3)).astype(np.float32)
+        return DataFrame({"features": _vec_col(X),
+                          "label": (X[:, 0] > 0).astype(np.int64)})
 
     def gbdt_rank_df():
         rng = np.random.default_rng(8)
@@ -346,6 +371,13 @@ def _registry():
         LightGBMRanker: lambda: TestObject(
             LightGBMRanker(num_iterations=3, num_leaves=4,
                            min_data_in_leaf=2), fit_df=gbdt_rank_df()),
+        ONNXEstimator: lambda: TestObject(
+            ONNXEstimator(_tiny_onnx_mlp(),
+                          feed_dict={"x": "features"},
+                          fetch_dict={"out": "logits"},
+                          loss_output="loss", label_input="labels",
+                          epochs=2, batch_size=8, learning_rate=0.05),
+            fit_df=onnx_train_df()),
         # vw
         VowpalWabbitFeaturizer: lambda: TestObject(
             VowpalWabbitFeaturizer(input_cols=["text", "num"],

@@ -110,6 +110,98 @@ class TestFineTune:
         assert np.abs(np.asarray(new_params["w2"])
                       - params["w2"]).max() > 0       # trained
 
+    def test_onnx_estimator_pipeline(self, tmp_path):
+        """DataFrame-level: ONNXEstimator.fit → fitted ONNXModel whose
+        weights_override carries the tuned weights; the training graph's
+        loss subtree prunes away at inference (no labels fed)."""
+        from mmlspark_tpu.core import DataFrame, PipelineStage
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        X, y = toy_data(128, seed=5)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y})
+        log = []
+        est = ONNXEstimator(mlp_with_loss(),
+                            feed_dict={"x": "features"},
+                            fetch_dict={"logits": "logits"},
+                            argmax_dict={"pred": "logits"},
+                            loss_output="loss", label_input="labels",
+                            epochs=25, batch_size=32, learning_rate=5e-2,
+                            eval_log=log)
+        model = est.fit(df)
+        assert log[-1] < log[0] * 0.5, (log[0], log[-1])
+        out = model.transform(df)          # no labels needed at inference
+        acc = (np.asarray(out["pred"], dtype=np.int64) == y).mean()
+        assert acc > 0.85, acc
+        # save/load round-trips the override
+        model.save(str(tmp_path / "m"))
+        loaded = PipelineStage.load(str(tmp_path / "m"))
+        out2 = loaded.transform(df)
+        np.testing.assert_array_equal(np.asarray(out["pred"]),
+                                      np.asarray(out2["pred"]))
+        # and the tuned model differs from the untuned weights
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        raw = ONNXModel(mlp_with_loss(),
+                        feed_dict={"x": "features"},
+                        fetch_dict={"logits": "logits"},
+                        argmax_dict={"pred": "logits"})
+        acc_raw = (np.asarray(raw.transform(df)["pred"], dtype=np.int64)
+                   == y).mean()
+        assert acc > acc_raw
+
+    def test_estimator_objective_mode_and_frozen_prefix(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        X, y = toy_data(96, seed=6)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y})
+        # graph WITHOUT a loss node: objective computed outside
+        import mmlspark_tpu.onnx as O
+        rng = np.random.default_rng(7)
+        g = O.make_graph(
+            [O.make_node("MatMul", ["x", "w1"], ["h"]),
+             O.make_node("Relu", ["h"], ["hr"]),
+             O.make_node("MatMul", ["hr", "w2"], ["logits"])],
+            "plain",
+            inputs=[O.make_tensor_value_info("x", np.float32, ["N", 6])],
+            outputs=[O.make_tensor_value_info("logits", np.float32,
+                                              ["N", 3])],
+            initializers={
+                "w1": rng.normal(0, 0.5, (6, 8)).astype(np.float32),
+                "w2": rng.normal(0, 0.5, (8, 3)).astype(np.float32)})
+        log = []
+        est = ONNXEstimator(O.make_model(g),
+                            feed_dict={"x": "features"},
+                            fetch_dict={"logits": "logits"},
+                            objective="softmax_cross_entropy",
+                            target_output="logits",
+                            trainable_prefix=["w2"],
+                            epochs=10, batch_size=32, learning_rate=5e-2,
+                            eval_log=log)
+        model = est.fit(df)
+        assert log[-1] < log[0]
+        # frozen w1: the override equals the original for w1 only
+        import io
+        with np.load(io.BytesIO(model.get("weights_override"))) as z:
+            ov = {k: z[k] for k in z.files}
+        cm = convert_model(est.get("model_bytes"))
+        np.testing.assert_array_equal(ov["w1"], cm.params["w1"])
+        assert np.abs(ov["w2"] - cm.params["w2"]).max() > 0
+
+    def test_pruned_intermediate_fetch(self):
+        # fetching an internal tensor = reference's cut-layer featurization
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+        X, _ = toy_data(8)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        m = ONNXModel(mlp_with_loss(),
+                      feed_dict={"x": "features"},
+                      fetch_dict={"hidden": "h2"})
+        out = m.transform(DataFrame({"features": col}))
+        assert np.asarray(out["hidden"][0]).shape == (8,)
+
     def test_torch_exported_model_fine_tunes(self):
         torch = pytest.importorskip("torch")
         import io
