@@ -82,6 +82,9 @@ class ONNXEstimator(Estimator):
 
     def __init__(self, model_bytes: Optional[bytes] = None,
                  eval_log: Optional[list] = None, **kw):
+        if isinstance(kw.get("trainable_prefix"), str):
+            # a single prefix as a bare string is the natural spelling
+            kw["trainable_prefix"] = [kw["trainable_prefix"]]
         super().__init__(**kw)
         if model_bytes is not None:
             self.set(model_bytes=model_bytes)
@@ -133,6 +136,10 @@ class ONNXEstimator(Estimator):
             for inp, col in self.feed_dict.items()}
         y = np.asarray(df[self.label_col])
         n = len(df)
+        if n < int(self.batch_size):
+            raise ValueError(
+                f"fewer rows ({n}) than batch_size ({self.batch_size}); "
+                "no training step would run")
 
         loss_output = self.get_or_none("loss_output")
         label_input = self.get_or_none("label_input")
@@ -146,9 +153,7 @@ class ONNXEstimator(Estimator):
 
         opt = (optax.adam if self.optimizer == "adam" else optax.sgd)(
             float(self.learning_rate))
-        prefixes = ([self.trainable_prefix]
-                    if isinstance(self.trainable_prefix, str)
-                    else list(self.trainable_prefix))
+        prefixes = list(self.trainable_prefix)
         trainable = (None if not prefixes else
                      (lambda name: any(name.startswith(p)
                                        for p in prefixes)))
@@ -180,13 +185,15 @@ class ONNXEstimator(Estimator):
                 params, opt_state, val = step(params, opt_state, feeds)
                 if log is not None:
                     log.append(float(val))
-        if n < bs:
-            raise ValueError(
-                f"fewer rows ({n}) than batch_size ({bs}); no step ran")
 
         buf = io.BytesIO()
         np.savez(buf, **{k: np.asarray(v) for k, v in params.items()})
-        m = ONNXModel(self.get("model_bytes"),
-                      **{k: self.get(k) for k in _INFERENCE_KEYS})
+        inference = {k: self.get(k) for k in _INFERENCE_KEYS}
+        if loss_output is not None and not inference["fetch_dict"]:
+            # default fetch would include the loss output, whose labels
+            # input is never fed at inference — serve the non-loss outputs
+            inference["fetch_dict"] = {o: o for o in cm.output_names
+                                       if o != loss_output}
+        m = ONNXModel(self.get("model_bytes"), **inference)
         m.set(weights_override=buf.getvalue())
         return m

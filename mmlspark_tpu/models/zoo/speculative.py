@@ -1,0 +1,245 @@
+"""Speculative decoding: draft-then-verify generation.
+
+A small draft model proposes ``gamma`` greedy tokens per round; the
+target model scores the whole proposal in ONE cached window forward
+(:func:`transformer.decode_window`) and accepts the longest prefix that
+matches its own greedy choices, emitting one bonus token on top — so each
+round costs one target forward for 1..gamma+1 emitted tokens. The
+guarantee (and the test invariant): greedy speculative output is
+token-for-token IDENTICAL to decoding the target alone; the draft only
+changes how fast, never what.
+
+Serving context: the reference's model serving replays one ORT session
+per request (no notion of drafting); this is the latency optimization the
+continuous-batching decoder stack picks up for free because every piece
+— prefill, window verify, stale-cache masking — is already a jitted
+static-shape program. Stale entries past an accepted prefix need no
+rollback: attention masks keys by position, and later windows overwrite
+them.
+
+No sampling mode here by design: temperature>0 speculative decoding
+needs the rejection-sampling correction from the speculative-sampling
+literature to keep the output distribution exact, which is a different
+contract than this zoo reference implements (greedy-exactness, simply
+verifiable).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (TransformerConfig, decode_step, decode_window,
+                          init_kv_cache, prefill_cache)
+
+__all__ = ["generate_speculative", "generate_speculative_fused"]
+
+
+def generate_speculative_fused(t_params: Dict, d_params: Dict,
+                               prompt_ids, t_cfg: TransformerConfig,
+                               d_cfg: TransformerConfig,
+                               max_new_tokens: int = 32,
+                               gamma: int = 4) -> Tuple[jnp.ndarray, dict]:
+    """:func:`generate_speculative` as ONE compiled program.
+
+    The whole draft→verify→accept loop runs inside ``lax.while_loop`` —
+    no host round-trips between rounds (the python-loop variant pays one
+    dispatch per round, which behind a network-attached TPU costs more
+    than the compute it saves). Dynamic acceptance under static shapes:
+    each round optimistically writes all gamma+1 window emissions into the
+    ids buffer and advances by the accepted length only — later rounds
+    overwrite the rejected tail. The draft consumes its own last proposal
+    (one extra step per round) so its cache never holds a hole regardless
+    of how much was accepted.
+
+    Output is token-for-token identical to the python-loop variant and to
+    target-only greedy decoding.
+    """
+    if t_cfg.vocab != d_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    t_params = jax.tree.map(jnp.asarray, t_params)
+    d_params = jax.tree.map(jnp.asarray, d_params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P = prompt_ids.shape
+    L = P + max_new_tokens + gamma + 1
+    lengths = jnp.full((B,), P, jnp.int32)
+
+    @jax.jit
+    def run(t_params, d_params, prompt_ids):
+        t_logits, t_cache = prefill_cache(t_params, prompt_ids, lengths,
+                                          t_cfg, L)
+        _, d_cache = prefill_cache(d_params, prompt_ids, lengths, d_cfg, L)
+        pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt_ids.dtype)
+        ids0 = jnp.zeros((B, L), prompt_ids.dtype)
+        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+        ids0 = jax.lax.dynamic_update_slice(ids0, pending0[:, None], (0, P))
+        # carry: ids, m (position of pending), pending, caches, stats
+        stats0 = jnp.zeros((3,), jnp.int32)    # forwards, rounds, accepted
+
+        def emitted(m):
+            return m - P + 1
+
+        def cond(carry):
+            ids, m, pending, t_cache, d_cache, stats = carry
+            return emitted(m) < max_new_tokens
+
+        def body(carry):
+            ids, m, pending, t_cache, d_cache, stats = carry
+
+            # draft proposes gamma tokens, then consumes its own last
+            # proposal so the cache stays hole-free at full acceptance
+            def draft_scan(cache, pending, m):
+                def step(c, i):
+                    cache, tok = c
+                    logits, cache = decode_step(d_params, tok, m + i,
+                                                cache, d_cfg)
+                    nxt = jnp.argmax(logits, -1).astype(pending.dtype)
+                    return (cache, nxt), nxt
+                (cache, _), drafts = jax.lax.scan(
+                    step, (cache, pending), jnp.arange(gamma + 1))
+                return cache, jnp.moveaxis(drafts[:gamma], 0, 1)
+
+            d_cache, drafts = draft_scan(d_cache, pending, m)
+            wtoks = jnp.concatenate([pending[:, None], drafts], axis=1)
+            w_logits, t_cache = decode_window(t_params, wtoks, m, t_cache,
+                                              t_cfg)
+            greedy = jnp.argmax(w_logits, -1).astype(pending.dtype)
+            match = greedy[:, :gamma] == drafts
+            accept = jnp.min(jnp.sum(jnp.cumprod(
+                match.astype(jnp.int32), -1), -1))
+            k = jnp.minimum(accept,
+                            max_new_tokens - emitted(m) - 1).astype(jnp.int32)
+            # optimistic emission: positions m+1..m+gamma+1 get the drafts
+            # up to k and the bonus at k (later slots are garbage a future
+            # round overwrites; only ids[:, :m+k+2] is ever final)
+            bonus = jnp.take_along_axis(greedy, k[None, None].repeat(B, 0),
+                                        axis=1)[:, 0]
+            idxs = jnp.arange(gamma + 1)
+            emit = jnp.where(idxs[None, :] < k,
+                             jnp.concatenate(
+                                 [drafts, drafts[:, -1:]], axis=1),
+                             bonus[:, None])
+            ids = jax.lax.dynamic_update_slice(ids, emit, (0, m + 1))
+            stats = stats + jnp.array([1, 1, 0], jnp.int32) \
+                + jnp.array([0, 0, 1], jnp.int32) * k
+            return (ids, m + k + 1, bonus, t_cache, d_cache, stats)
+
+        ids, m, pending, _, _, stats = jax.lax.while_loop(
+            cond, body, (ids0, jnp.asarray(P, jnp.int32), pending0,
+                         t_cache, d_cache, stats0))
+        return ids[:, :P + max_new_tokens], stats
+
+    ids, stats = run(t_params, d_params, prompt_ids)
+    s = np.asarray(stats)
+    return ids, {"target_forwards": int(s[0]) + 1, "rounds": int(s[1]),
+                 "accepted_drafts": int(s[2]),
+                 "draft_steps": int(s[1]) * (gamma + 1)}
+
+
+def generate_speculative(t_params: Dict, d_params: Dict,
+                         prompt_ids, t_cfg: TransformerConfig,
+                         d_cfg: TransformerConfig,
+                         max_new_tokens: int = 32,
+                         gamma: int = 4) -> Tuple[jnp.ndarray, dict]:
+    """Greedy generation from the TARGET model, accelerated by the draft.
+
+    Returns ``(ids (B, P+max_new), stats)`` — ids exactly equal to
+    ``generate_cached(t_params, ..., temperature=0)``; stats counts
+    target forwards and accepted drafts (the speedup evidence).
+    B>1 works; rounds advance by the batch's MINIMUM acceptance so all
+    rows stay position-aligned (per-row raggedness is the continuous
+    decoder's job, not this reference loop's).
+    """
+    if t_cfg.vocab != d_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    t_params = jax.tree.map(jnp.asarray, t_params)
+    d_params = jax.tree.map(jnp.asarray, d_params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P = prompt_ids.shape
+    L = P + max_new_tokens + gamma + 1          # slack: windows overshoot
+    t_cache = init_kv_cache(t_cfg, B, L)
+    d_cache = init_kv_cache(d_cfg, B, L)
+    lengths = jnp.full((B,), P, jnp.int32)
+
+    @jax.jit
+    def draft_propose(tail, pending, pos, cache):
+        """Consume ``tail`` (B, T — already-emitted tokens the draft cache
+        is missing; T is 0 or 1) then ``pending`` at the following
+        position, continuing greedily until gamma proposals exist."""
+        for i in range(tail.shape[1]):
+            _, cache = decode_step(d_params, tail[:, i], pos + i, cache,
+                                   d_cfg)
+        start = pos + tail.shape[1]
+
+        def step(carry, _):
+            tok, p, cache = carry
+            logits, cache = decode_step(d_params, tok, p, cache, d_cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+            return (nxt, p + 1, cache), nxt
+
+        (_, _, cache), drafts = jax.lax.scan(
+            step, (pending, start, cache), None, length=gamma)
+        return jnp.moveaxis(drafts, 0, 1), cache       # (B, gamma)
+
+    @jax.jit
+    def verify(wtoks, pos, cache):
+        logits, cache = decode_window(t_params, wtoks, pos, cache, t_cfg)
+        greedy = jnp.argmax(logits, axis=-1)           # (B, gamma+1)
+        match = greedy[:, :-1] == wtoks[:, 1:].astype(greedy.dtype)
+        accept = jnp.min(jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), axis=-1), axis=-1))
+        return greedy, accept, cache
+
+    # prompt prefill on both models; the target's last-token logits give
+    # the first pending token (its greedy continuation of the prompt)
+    t_logits, t_cache = prefill_cache(t_params, prompt_ids, lengths,
+                                      t_cfg, L)
+    _, d_cache = prefill_cache(d_params, prompt_ids, lengths, d_cfg, L)
+    pending = jnp.argmax(t_logits, axis=-1).astype(prompt_ids.dtype)  # (B,)
+
+    ids = np.zeros((B, P + max_new_tokens), np.asarray(prompt_ids).dtype)
+    ids[:, :P] = np.asarray(prompt_ids)
+    out = [np.asarray(pending)[:, None]]          # pending IS emitted
+    emitted = 1
+    m = P                                         # caches valid thru m-1
+    tail = jnp.zeros((B, 0), prompt_ids.dtype)    # draft-cache catch-up
+    stats = {"target_forwards": 1, "draft_steps": 0, "accepted_drafts": 0,
+             "rounds": 0}
+
+    while emitted < max_new_tokens:
+        drafts, d_cache = draft_propose(tail, pending, m - tail.shape[1],
+                                        d_cache)
+        stats["draft_steps"] += gamma
+        # verify window [pending, d_1..d_gamma] at positions m..m+gamma:
+        # greedy[:, i] is the target's choice after wtoks[:, :i+1], so
+        # drafts[:, i] must equal greedy[:, i] to be accepted
+        wtoks = jnp.concatenate([pending[:, None], drafts], axis=1)
+        greedy, accept, t_cache = verify(wtoks, m, t_cache)
+        stats["target_forwards"] += 1
+        stats["rounds"] += 1
+        k = min(int(accept), max_new_tokens - emitted - 1)
+        stats["accepted_drafts"] += k
+        if k > 0:
+            out.append(np.asarray(drafts[:, :k]))
+            emitted += k
+        bonus = greedy[:, k].astype(prompt_ids.dtype)
+        out.append(np.asarray(bonus)[:, None])
+        emitted += 1
+        # k == gamma: the draft never consumed d_gamma (it only proposed
+        # it), so its cache misses position m+gamma — hand it back as the
+        # next round's tail
+        tail = drafts[:, gamma - 1:gamma] if k == gamma \
+            else jnp.zeros((B, 0), prompt_ids.dtype)
+        pending = bonus
+        m = m + k + 1
+
+    new = np.concatenate(out, axis=1)
+    ids[:, P:] = new[:, :max_new_tokens]
+    return jnp.asarray(ids), stats

@@ -563,6 +563,70 @@ def prefill_cache(params: Dict, ids: jnp.ndarray, length,
     return logits, cache
 
 
+def decode_window(params: Dict, tokens: jnp.ndarray, pos, cache,
+                  cfg: TransformerConfig):
+    """Cached forward over a WINDOW of W tokens at positions
+    ``pos..pos+W-1``: the chunk-sized middle ground between
+    :func:`decode_step` (W=1) and :func:`prefill_cache` (fresh cache).
+
+    ``tokens`` (B, W) int, ``pos`` scalar start (traced ok) →
+    (logits (B, W, vocab), cache with the window's K/V written). Queries
+    attend causally within the window and to everything cached before it —
+    the verify primitive of speculative decoding, and a chunked-prefill
+    building block.
+    """
+    if cfg.moe_experts:
+        raise ValueError("cached decoding does not support MoE layers")
+    dt = cfg.dtype
+    B, W = tokens.shape
+    L = cache[0]["k"].shape[2]
+    hd = cfg.d_model // cfg.heads
+    pos = jnp.asarray(pos, jnp.int32)
+    wpos = pos + jnp.arange(W, dtype=jnp.int32)                # (W,)
+    h = params["embed"]["tok"].astype(dt)[tokens]              # (B, W, D)
+    if cfg.position == "learned":
+        h = h + params["embed"]["pos"].astype(dt)[wpos][None]
+    if cfg.position == "rope":
+        cos, sin = _rope_tables(wpos, hd, cfg.rope_theta, dt)  # (W, hd/2)
+        cos, sin = cos[None, None], sin[None, None]            # (1,1,W,·)
+    # query at window row i sees keys at positions <= pos + i
+    key_ok = (jnp.arange(L)[None, :]
+              <= wpos[:, None])[None, None]                    # (1,1,W,L)
+    new_cache = []
+    for lp, c in zip(params["layers"], cache):
+        x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
+        qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, W, cfg.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.position == "rope":
+            q = _rot_half(q, cos, sin)
+            k = _rot_half(k, cos, sin)
+        kc = jax.lax.dynamic_update_slice(c["k"], k.astype(dt),
+                                          (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(c["v"], v.astype(dt),
+                                          (0, 0, pos, 0))
+        new_cache.append({"k": kc, "v": vc})
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = jnp.where(key_ok, s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
+                         preferred_element_type=dt)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, W, cfg.d_model)
+        h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
+        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
+        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        h = h + y
+    hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
+    logits = hidden.astype(jnp.float32) @ params["lm_head"]["w"]
+    return logits, new_cache
+
+
 def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
                     max_new_tokens: int = 32, temperature: float = 0.0,
                     seed: int = 0, top_k: int = 0, top_p: float = 1.0):

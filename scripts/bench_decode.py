@@ -146,6 +146,53 @@ def main():
         "ttft_max_ms": round(1e3 * max(ttft), 1),
         "platform": jax.default_backend()}), flush=True)
 
+    # -- speculative decoding: draft-then-verify vs plain cached greedy --
+    from mmlspark_tpu.models.zoo.speculative import generate_speculative_fused as generate_speculative
+    from mmlspark_tpu.models.zoo.transformer import generate_cached
+    d_cfg = cfg._replace(layers=max(1, cfg.layers // 4),
+                         d_model=cfg.d_model // 2, heads=cfg.heads // 2,
+                         d_ff=cfg.d_ff // 2)
+    d_params = init_transformer(d_cfg, seed=1)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab, (1, P)))
+    gamma = _env_int("BENCH_SPEC_GAMMA", 4)
+    # warm + check output parity (exact in fp32; under bf16 near-tie
+    # argmaxes can flip between the window and step compositions, so the
+    # fraction is reported rather than asserted)
+    ref = generate_cached(params, prompt, cfg, max_new_tokens=T,
+                          temperature=0.0)
+    spec, stats = generate_speculative(params, d_params, prompt, cfg,
+                                       d_cfg, max_new_tokens=T, gamma=gamma)
+    match_frac = float((np.asarray(ref) == np.asarray(spec)).mean())
+    t0 = time.perf_counter()
+    generate_cached(params, prompt, cfg, max_new_tokens=T, temperature=0.0)
+    plain_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _, stats = generate_speculative(params, d_params, prompt, cfg, d_cfg,
+                                    max_new_tokens=T, gamma=gamma)
+    spec_s = time.perf_counter() - t0
+    # perfect-draft upper bound: draft == target, acceptance == gamma —
+    # what the machinery delivers when the draft is good
+    generate_speculative(params, params, prompt, cfg, cfg,
+                         max_new_tokens=T, gamma=gamma)       # warm
+    t0 = time.perf_counter()
+    _, ub = generate_speculative(params, params, prompt, cfg, cfg,
+                                 max_new_tokens=T, gamma=gamma)
+    ub_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "decoder_speculative_tokens_per_sec",
+        "value": round(T / spec_s, 1), "unit": "tokens/sec/chip",
+        "plain_tokens_per_sec": round(T / plain_s, 1),
+        "speedup_random_draft": round(plain_s / spec_s, 2),
+        "speedup_perfect_draft": round(plain_s / ub_s, 2),
+        "gamma": gamma,
+        "acceptance_per_round": round(
+            stats["accepted_drafts"] / max(stats["rounds"], 1), 2),
+        "target_forwards": stats["target_forwards"],
+        "perfect_draft_target_forwards": ub["target_forwards"],
+        "greedy_match_frac": round(match_frac, 4),
+        "platform": jax.default_backend()}), flush=True)
+
 
 if __name__ == "__main__":
     main()

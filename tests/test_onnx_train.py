@@ -189,6 +189,38 @@ class TestFineTune:
         np.testing.assert_array_equal(ov["w1"], cm.params["w1"])
         assert np.abs(ov["w2"] - cm.params["w2"]).max() > 0
 
+    def test_estimator_default_fetch_excludes_loss(self):
+        # empty fetch_dict + graph-carried loss: the fitted model must
+        # serve the non-loss outputs, not crash on the unfed labels input
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        X, y = toy_data(64, seed=8)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y})
+        model = ONNXEstimator(mlp_with_loss(),
+                              feed_dict={"x": "features"},
+                              loss_output="loss", label_input="labels",
+                              epochs=2, batch_size=32).fit(df)
+        out = model.transform(df)
+        assert np.asarray(out["logits"][0]).shape == (3,)
+        assert "loss" not in out.columns
+
+    def test_estimator_string_prefix_and_small_frame(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        X, y = toy_data(64, seed=9)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y})
+        est = ONNXEstimator(mlp_with_loss(), feed_dict={"x": "features"},
+                            loss_output="loss", label_input="labels",
+                            trainable_prefix="w2",      # bare string ok
+                            epochs=1, batch_size=32)
+        assert est.fit(df) is not None
+        with pytest.raises(ValueError, match="fewer rows"):
+            est.fit(df.head(8))
+
     def test_pruned_intermediate_fetch(self):
         # fetching an internal tensor = reference's cut-layer featurization
         from mmlspark_tpu.core import DataFrame

@@ -1,0 +1,158 @@
+"""Speculative decoding (zoo/speculative.py) and the decode_window
+primitive.
+
+The load-bearing invariant: greedy speculative output is token-for-token
+identical to the target model decoding alone — the draft changes cost,
+never content.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                 decode_step, decode_window,
+                                                 generate_cached,
+                                                 init_kv_cache, init_transformer,
+                                                 prefill_cache)
+from mmlspark_tpu.models.zoo.speculative import (generate_speculative,
+                                                 generate_speculative_fused)
+
+
+def cfg_pair(position="rope", vocab=64):
+    import jax.numpy as jnp
+    target = TransformerConfig(vocab=vocab, d_model=32, heads=4, layers=3,
+                               d_ff=64, max_len=128, causal=True,
+                               position=position, dtype=jnp.float32)
+    draft = TransformerConfig(vocab=vocab, d_model=16, heads=2, layers=1,
+                              d_ff=32, max_len=128, causal=True,
+                              position=position, dtype=jnp.float32)
+    return target, draft
+
+
+def make_models(position="rope", seed=0):
+    t_cfg, d_cfg = cfg_pair(position)
+    t_params = init_transformer(t_cfg, seed=seed)
+    d_params = init_transformer(d_cfg, seed=seed + 100)
+    return t_params, d_params, t_cfg, d_cfg
+
+
+class TestDecodeWindow:
+    def test_matches_stepwise_decode(self):
+        t_params, _, t_cfg, _ = make_models()
+        rng = np.random.default_rng(0)
+        B, P, W, L = 2, 5, 4, 32
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (B, P)))
+        win = jnp.asarray(rng.integers(0, t_cfg.vocab, (B, W)))
+        lengths = jnp.full((B,), P, jnp.int32)
+        _, cache0 = prefill_cache(t_params, prompt, lengths, t_cfg, L)
+        # window forward
+        wl, wcache = decode_window(t_params, win, P, cache0, t_cfg)
+        # step-by-step
+        cache = cache0
+        step_logits = []
+        for i in range(W):
+            lg, cache = decode_step(t_params, win[:, i], P + i, cache,
+                                    t_cfg)
+            step_logits.append(lg)
+        np.testing.assert_allclose(np.asarray(wl),
+                                   np.stack(step_logits, axis=1),
+                                   rtol=2e-4, atol=2e-4)
+        for cw, cs in zip(wcache, cache):
+            np.testing.assert_allclose(np.asarray(cw["k"]),
+                                       np.asarray(cs["k"]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_learned_positions(self):
+        t_params, _, t_cfg, _ = make_models(position="learned")
+        rng = np.random.default_rng(1)
+        B, P, W, L = 1, 3, 3, 24
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (B, P)))
+        win = jnp.asarray(rng.integers(0, t_cfg.vocab, (B, W)))
+        _, cache0 = prefill_cache(t_params, prompt,
+                                  jnp.full((B,), P, jnp.int32), t_cfg, L)
+        wl, _ = decode_window(t_params, win, P, cache0, t_cfg)
+        cache = cache0
+        for i in range(W):
+            lg, cache = decode_step(t_params, win[:, i], P + i, cache,
+                                    t_cfg)
+            np.testing.assert_allclose(np.asarray(wl[:, i]), np.asarray(lg),
+                                       rtol=2e-4, atol=2e-4)
+
+
+class TestSpeculative:
+    @pytest.mark.parametrize("position", ["rope", "learned"])
+    @pytest.mark.parametrize("gamma", [1, 3, 5])
+    def test_exact_match_with_target_greedy(self, position, gamma):
+        t_params, d_params, t_cfg, d_cfg = make_models(position)
+        rng = np.random.default_rng(2)
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (2, 6)))
+        ref = generate_cached(t_params, prompt, t_cfg, max_new_tokens=20,
+                              temperature=0.0)
+        spec, stats = generate_speculative(t_params, d_params, prompt,
+                                           t_cfg, d_cfg,
+                                           max_new_tokens=20, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+        assert stats["rounds"] >= 1
+
+    def test_perfect_draft_accepts_everything(self):
+        # draft == target: every proposal matches, so target forwards
+        # collapse to ~max_new/(gamma+1)
+        t_params, _, t_cfg, _ = make_models()
+        rng = np.random.default_rng(3)
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (1, 4)))
+        max_new, gamma = 24, 3
+        spec, stats = generate_speculative(t_params, t_params, prompt,
+                                           t_cfg, t_cfg,
+                                           max_new_tokens=max_new,
+                                           gamma=gamma)
+        ref = generate_cached(t_params, prompt, t_cfg,
+                              max_new_tokens=max_new, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+        per_round = stats["accepted_drafts"] / max(stats["rounds"], 1)
+        assert per_round > gamma - 0.5, stats     # near-total acceptance
+        # 1 prefill + ceil((max_new-1)/(gamma+1)) verify rounds, give or
+        # take the final-round cap
+        assert stats["target_forwards"] <= 2 + (max_new - 1) // (gamma + 1) + 1, \
+            stats
+
+    @pytest.mark.parametrize("gamma", [1, 3, 5])
+    def test_fused_matches_loop_and_target(self, gamma):
+        t_params, d_params, t_cfg, d_cfg = make_models()
+        rng = np.random.default_rng(4)
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (2, 5)))
+        ref = generate_cached(t_params, prompt, t_cfg, max_new_tokens=17,
+                              temperature=0.0)
+        fused, fstats = generate_speculative_fused(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            max_new_tokens=17, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+        loop, lstats = generate_speculative(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            max_new_tokens=17, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(loop))
+        assert fstats["rounds"] >= 1
+
+    def test_fused_perfect_draft_forward_count(self):
+        t_params, _, t_cfg, _ = make_models()
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (1, 4)))
+        max_new, gamma = 24, 3
+        fused, stats = generate_speculative_fused(
+            t_params, t_params, prompt, t_cfg, t_cfg,
+            max_new_tokens=max_new, gamma=gamma)
+        ref = generate_cached(t_params, prompt, t_cfg,
+                              max_new_tokens=max_new, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+        assert stats["target_forwards"] <= 2 + (max_new - 1) // (gamma + 1) + 1, \
+            stats
+
+    def test_vocab_mismatch_rejected(self):
+        t_params, d_params, t_cfg, d_cfg = make_models()
+        d_cfg = d_cfg._replace(vocab=t_cfg.vocab + 1)
+        with pytest.raises(ValueError, match="vocab"):
+            generate_speculative(t_params, d_params,
+                                 jnp.zeros((1, 2), jnp.int32),
+                                 t_cfg, d_cfg)
