@@ -44,7 +44,8 @@ from ..ops.padding import bucket_size
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "tokens", "done", "event",
                  "submitted_at", "first_token_at", "finished_at",
-                 "temperature", "top_k", "top_p", "seed")
+                 "temperature", "top_k", "top_p", "seed",
+                 "prefix_key", "prefix_len", "error")
 
     def __init__(self, rid, prompt, max_new, temperature=0.0, top_k=0,
                  top_p=1.0, seed=0):
@@ -55,6 +56,9 @@ class _Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        self.prefix_key: Optional[str] = None
+        self.prefix_len: Optional[int] = None
+        self.error: Optional[Exception] = None
         self.tokens: List[int] = []
         self.done = False
         self.event = threading.Event()
@@ -108,7 +112,8 @@ class ContinuousDecoder:
     def __init__(self, params: Dict, cfg: TransformerConfig, *,
                  max_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 prefix_cache_size: int = 8):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -214,6 +219,20 @@ class ContinuousDecoder:
 
         self._prefill = jax.jit(_prefill)
 
+        # prefix-cache suffix extension: continue a stored prefix cache
+        # over the request's remaining tokens (one window forward)
+        def _extend(params, ids, start, row_cache):
+            from ..models.zoo.transformer import decode_window
+            return decode_window(params, ids, start, row_cache, cfg)
+
+        self._extend = jax.jit(_extend)
+        #: key → (prefix token array, row cache snapshot, prefix length);
+        #: LRU — hits re-insert, eviction pops the coldest entry
+        self._prefix_store: Dict[str, tuple] = {}
+        self._prefix_store_cap = int(prefix_cache_size)
+        #: observability: prefill vs prefix-hit counts (tests + ops)
+        self.stats = {"prefills": 0, "prefix_hits": 0}
+
         def _insert(cache, slot, row_cache, tok, pos, active,
                     first_tok, length, sample_state, sample_row):
             for c, rc in zip(cache, row_cache):
@@ -254,7 +273,16 @@ class ContinuousDecoder:
     # ---- client surface ----
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> _Request:
+               top_p: float = 1.0, seed: int = 0,
+               prefix_key: Optional[str] = None,
+               prefix_len: Optional[int] = None) -> _Request:
+        """``prefix_key`` enables prefix caching (the shared-system-prompt
+        pattern): the first request carrying a key prefills normally and
+        snapshots its prompt's first ``prefix_len`` positions (default:
+        the whole prompt); later requests with the same key — whose
+        prompts MUST start with the stored tokens — skip recomputing the
+        prefix and run one window forward over just the suffix. Greedy
+        outputs are unchanged; only prefill cost drops."""
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -275,18 +303,29 @@ class ContinuousDecoder:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0 or temperature < 0.0:
             raise ValueError("top_k and temperature must be >= 0")
+        if prefix_len is not None:
+            if prefix_key is None:
+                raise ValueError("prefix_len without prefix_key")
+            if not 0 < prefix_len <= prompt.size:
+                raise ValueError(
+                    f"prefix_len {prefix_len} out of range for a "
+                    f"{prompt.size}-token prompt")
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
             req = _Request(rid, prompt, int(max_new_tokens),
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed)
+            req.prefix_key = prefix_key
+            req.prefix_len = prefix_len
             self._waiting.append(req)
         return req
 
     def result(self, req: _Request, timeout: Optional[float] = None):
         if not req.event.wait(timeout):
             raise TimeoutError(f"request {req.rid} not finished")
+        if req.error is not None:
+            raise req.error
         return list(req.tokens)
 
     # ---- engine ----
@@ -302,14 +341,21 @@ class ContinuousDecoder:
                 req = self._waiting.pop(0)
                 self._slot_req[slot] = req
             P = req.prompt.size
-            # cap the pad bucket at max_len: a 40-token prompt in a 48-len
-            # cache must not inflate to a 64-wide prefill
-            padded = min(self._L, max(8, bucket_size(P)))
-            ids = np.zeros((1, padded), np.int32)
-            ids[0, :P] = req.prompt
-            logits, row_cache = self._prefill(
-                self._params, jnp.asarray(ids),
-                jnp.asarray([P], jnp.int32))
+            try:
+                logits, row_cache = self._prompt_cache_for(req, P)
+            except ValueError as e:
+                # request-level validation (e.g. prefix mismatch) fails
+                # ALONE: slot freed, waiter woken with the error, engine
+                # keeps serving (generation.py's 'malformed field must not
+                # poison the batch' contract). Runtime/device errors are
+                # NOT caught — they propagate to the driver loop's
+                # recovery path (500 in-flight, cancel_all, back off).
+                req.error = e
+                req.done = True
+                req.finished_at = time.perf_counter()
+                req.event.set()
+                self._release(slot)
+                continue
             base_key = jax.random.PRNGKey(req.seed)
             if req.temperature > 0.0:
                 # exact generate_cached schedule: the token at position P
@@ -335,6 +381,76 @@ class ContinuousDecoder:
             self._note_token(req, int(first))
             if req.done:
                 self._release(slot)
+
+    def _padded_ids(self, tokens: np.ndarray, cap: int) -> np.ndarray:
+        """(1, bucketed) right-padded id row — one bucketing policy for
+        the prefill and suffix-window paths."""
+        padded = min(cap, max(8, bucket_size(tokens.size)))
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :tokens.size] = tokens
+        return ids
+
+    def _prompt_cache_for(self, req: _Request, P: int):
+        """Last-prompt-token logits + a (1, H, L, hd) row cache for the
+        request's prompt — via full prefill, or a stored prefix plus one
+        suffix window when ``prefix_key`` hits."""
+        hit = (self._prefix_store.get(req.prefix_key)
+               if req.prefix_key is not None else None)
+        if hit is not None:
+            stored_toks, stored_cache, plen = hit
+            # a caller-declared prefix_len shorter than the stored prefix
+            # is honored: reuse just that much (the window rewrites the
+            # rest), so one stored key serves nested prefixes
+            if req.prefix_len is not None:
+                plen = min(plen, req.prefix_len)
+            if P < plen or not np.array_equal(req.prompt[:plen],
+                                              stored_toks[:plen]):
+                raise ValueError(
+                    f"prefix_key {req.prefix_key!r}: prompt does not "
+                    f"start with the stored {plen}-token prefix")
+            self.stats["prefix_hits"] += 1
+            # LRU promotion: the hit entry becomes the newest
+            self._prefix_store[req.prefix_key] = \
+                self._prefix_store.pop(req.prefix_key)
+            # suffix window (whole-prompt hits re-run the last prefix
+            # token — one row — to recover its logits). Bucketed pad: the
+            # garbage K/V a padded row writes sits at positions the
+            # engine overwrites before any mask ever exposes them.
+            # The snapshot passes to _extend as-is: the jit has no
+            # donation, so its inputs are never consumed — _insert later
+            # donates _extend's OUTPUT, not the snapshot.
+            start = plen if P > plen else plen - 1
+            suffix = req.prompt[start:]
+            S = suffix.size
+            ids = self._padded_ids(suffix, self._L - start)
+            # snapshots store only the prefix region; rebuild the
+            # full-length rows (everything past plen is garbage the
+            # window/decode overwrites before any mask exposes it)
+            full = [{k: jnp.pad(c[k], ((0, 0), (0, 0),
+                                       (0, self._L - c[k].shape[2]),
+                                       (0, 0)))
+                     for k in ("k", "v")} for c in stored_cache]
+            w_logits, row_cache = self._extend(
+                self._params, jnp.asarray(ids), jnp.int32(start), full)
+            return w_logits[:, S - 1], row_cache
+        # full prefill; cap the pad bucket at max_len: a 40-token prompt
+        # in a 48-len cache must not inflate to a 64-wide prefill
+        ids = self._padded_ids(req.prompt, self._L)
+        logits, row_cache = self._prefill(
+            self._params, jnp.asarray(ids), jnp.asarray([P], jnp.int32))
+        self.stats["prefills"] += 1
+        if req.prefix_key is not None and self._prefix_store_cap > 0:
+            # store-on-miss: snapshot ONLY the prefix region (a copy —
+            # the live row cache is donated into the slot pool right
+            # after; full-length copies would hold max_len KV per entry)
+            plen = req.prefix_len if req.prefix_len is not None else P
+            snap = [{k: jnp.array(c[k][:, :, :plen]) for k in ("k", "v")}
+                    for c in row_cache]
+            if len(self._prefix_store) >= self._prefix_store_cap:
+                self._prefix_store.pop(next(iter(self._prefix_store)))
+            self._prefix_store[req.prefix_key] = (
+                req.prompt[:plen].copy(), snap, plen)
+        return logits, row_cache
 
     def _note_token(self, req: _Request, tok: int):
         now = time.perf_counter()

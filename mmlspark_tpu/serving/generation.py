@@ -78,12 +78,15 @@ class GenerationEngine:
             if not toks:
                 raise ValueError("missing or empty 'tokens'")
             mn = int(body.get("max_new", self.default_max_new))
+            pl = body.get("prefix_len")
             ticket = self.decoder.submit(
                 np.asarray(toks, np.int32), mn,
                 temperature=float(body.get("temperature", 0.0)),
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
-                seed=int(body.get("seed", 0)))
+                seed=int(body.get("seed", 0)),
+                prefix_key=body.get("prefix_key"),
+                prefix_len=int(pl) if pl is not None else None)
         except Exception as e:
             self.server.reply_json(rid, {"error": str(e)}, status=400)
             return
@@ -100,7 +103,13 @@ class GenerationEngine:
         done = [drid for drid, (_, t) in self._inflight.items() if t.done]
         for drid in done:
             rid, ticket = self._inflight.pop(drid)
-            self.server.reply_json(rid, {"tokens": ticket.tokens})
+            if getattr(ticket, "error", None) is not None:
+                # per-request admit failure (e.g. prefix mismatch): 400s
+                # this client alone, the batch keeps decoding
+                self.server.reply_json(rid, {"error": str(ticket.error)},
+                                       status=400)
+            else:
+                self.server.reply_json(rid, {"tokens": ticket.tokens})
         if done:
             self.server.commit_epoch()
 

@@ -433,3 +433,108 @@ class TestContinuousDecoder:
         ids = generate_cached(p, np.asarray(prompt)[None], CFG_LEARNED,
                               max_new_tokens=4)
         assert eng.result(req) == list(np.asarray(ids)[0, 5:])
+
+
+class TestPrefixCaching:
+    def _run(self, eng, prompt, n=6, **kw):
+        req = eng.submit(prompt, max_new_tokens=n, **kw)
+        while not req.done:
+            eng.step()
+        return eng.result(req)
+
+    def test_prefix_hit_matches_uncached(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(20)
+        sys_prompt = rng.integers(0, CFG.vocab, 9)
+        suffixes = [rng.integers(0, CFG.vocab, n) for n in (4, 7, 1)]
+        plain = [self._run(eng, np.concatenate([sys_prompt, s]))
+                 for s in suffixes]
+        assert eng.stats["prefix_hits"] == 0
+        cached = [self._run(eng, np.concatenate([sys_prompt, s]),
+                            prefix_key="sys", prefix_len=len(sys_prompt))
+                  for s in suffixes]
+        assert cached == plain              # greedy outputs unchanged
+        assert eng.stats["prefix_hits"] == len(suffixes) - 1
+
+    def test_whole_prompt_hit(self, params):
+        # a later request whose ENTIRE prompt is the stored prefix
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(21)
+        prompt = rng.integers(0, CFG.vocab, 8)
+        a = self._run(eng, prompt, prefix_key="p")
+        b = self._run(eng, prompt, prefix_key="p")
+        assert a == b == _reference_tokens(params, prompt, 6)
+        assert eng.stats == {"prefills": 1, "prefix_hits": 1}
+
+    def test_mismatched_prefix_fails_alone(self, params):
+        # a bad request must not poison the engine: it fails with its own
+        # error while concurrent requests keep decoding correctly
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(22)
+        prompt = rng.integers(0, CFG.vocab, 8)
+        self._run(eng, prompt, prefix_key="k")
+        other = (prompt + 1) % CFG.vocab
+        bad = eng.submit(other, max_new_tokens=4, prefix_key="k")
+        good = eng.submit(prompt, max_new_tokens=4)
+        while not (bad.done and good.done):
+            eng.step()
+        with pytest.raises(ValueError, match="stored"):
+            eng.result(bad)
+        assert eng.result(good) == _reference_tokens(params, prompt, 4)
+
+    def test_shorter_declared_prefix_len_on_hit(self, params):
+        # stored key covers the whole first prompt; a later caller reuses
+        # only its declared (shorter) shared prefix
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(25)
+        first = rng.integers(0, CFG.vocab, 12)
+        a = self._run(eng, first, prefix_key="sys")   # stores plen=12
+        second = np.concatenate([first[:6],
+                                 rng.integers(0, CFG.vocab, 4)])
+        b = self._run(eng, second, prefix_key="sys", prefix_len=6)
+        assert b == _reference_tokens(params, second, 6)
+        assert eng.stats["prefix_hits"] == 1
+
+    def test_prefix_len_validation(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        prompt = np.arange(5) % CFG.vocab
+        with pytest.raises(ValueError, match="prefix_len without"):
+            eng.submit(prompt, max_new_tokens=2, prefix_len=3)
+        with pytest.raises(ValueError, match="out of range"):
+            eng.submit(prompt, max_new_tokens=2, prefix_key="x",
+                       prefix_len=9)
+
+    def test_store_eviction(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                prefix_cache_size=2)
+        rng = np.random.default_rng(23)
+        prompts = {f"k{i}": rng.integers(0, CFG.vocab, 6)
+                   for i in range(3)}
+        for key, p in prompts.items():
+            self._run(eng, p, prefix_key=key, n=2)
+        assert len(eng._prefix_store) == 2
+        assert "k0" not in eng._prefix_store   # FIFO evicted
+
+    def test_sampled_requests_with_prefix(self, params):
+        # sampling composes with prefix reuse (same seed → same tokens)
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        rng = np.random.default_rng(24)
+        sys_prompt = rng.integers(0, CFG.vocab, 6)
+        prompt = np.concatenate([sys_prompt, rng.integers(0, CFG.vocab, 3)])
+        a = self._run(eng, prompt, temperature=0.8, seed=11)
+        b = self._run(eng, prompt, temperature=0.8, seed=11,
+                      prefix_key="s", prefix_len=len(sys_prompt))
+        c = self._run(eng, prompt, temperature=0.8, seed=11,
+                      prefix_key="s", prefix_len=len(sys_prompt))
+        assert a == b == c
+        assert eng.stats["prefix_hits"] == 1
+
+    def test_prefix_cache_disabled_by_cap_zero(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                prefix_cache_size=0)
+        rng = np.random.default_rng(26)
+        prompt = rng.integers(0, CFG.vocab, 7)
+        a = self._run(eng, prompt, prefix_key="k")   # store disabled, no crash
+        b = self._run(eng, prompt, prefix_key="k")
+        assert a == b == _reference_tokens(params, prompt, 6)
+        assert eng.stats == {"prefills": 2, "prefix_hits": 0}
