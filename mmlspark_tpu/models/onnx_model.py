@@ -75,6 +75,12 @@ class ONNXModel(Model):
                                         "initializers (ONNXEstimator.fit "
                                         "sets this; the original model "
                                         "bytes stay untouched)")
+    quantize = Param(str, default="", choices=["", "int8"],
+                     doc="weight-only quantization: 2-D float weights live "
+                         "in HBM as symmetric per-column int8 + scale and "
+                         "dequantize on device (XLA fuses the multiply "
+                         "into the consumer matmul) — 4x less weight "
+                         "bandwidth, activations stay in compute_dtype")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -129,7 +135,7 @@ class ONNXModel(Model):
                tuple(sorted(argmax.items())),
                tuple(sorted((k, str(v)) for k, v in normalize.items())),
                tuple(sorted((k, tuple(v)) for k, v in transpose.items())),
-               str(compute_dt))
+               str(compute_dt), self.quantize)
         if self._jitted is None or self._jit_sig != sig:
             if set(fetch.values()) != set(cm.output_names):
                 # dead-node elimination from the requested outputs: a
@@ -173,6 +179,7 @@ class ONNXModel(Model):
 
             def run(params, feeds):
                 feeds = {k: prep(k, v) for k, v in feeds.items()}
+                params = self._unpack_params(params, compute_dt)
                 outs = cm(params, feeds)
                 cols = {col: outs[name] for col, name in fetch.items()}
                 for out_col, src in softmax.items():
@@ -235,6 +242,43 @@ class ONNXModel(Model):
                            else v) for k, v in p.items()})
         return cast(params)
 
+    # -- int8 weight-only quantization --------------------------------------
+    _QUANT_MIN_DIM = 16
+
+    def _quantizable(self, v) -> bool:
+        """2-D float weights (the matmul bulk of transformer/MLP graphs);
+        conv kernels (4-D) and vectors stay full precision."""
+        return (v.ndim == 2 and jnp.issubdtype(v.dtype, jnp.floating)
+                and min(v.shape) >= self._QUANT_MIN_DIM)
+
+    def _pack_params(self, params: dict) -> dict:
+        """Symmetric per-column int8 packing: HBM holds q (int8) + a
+        per-column scale; the jitted run dequantizes on device, where XLA
+        fuses the multiply into the consumer matmul — weight reads cost
+        1/4 the bandwidth (weight-ONLY quantization: activations and
+        accumulation stay in compute_dtype)."""
+        @jax.jit
+        def pack(p):
+            out = {}
+            for k, v in p.items():
+                if self._quantizable(v):
+                    v32 = v.astype(jnp.float32)
+                    s = jnp.max(jnp.abs(v32), axis=0, keepdims=True) / 127.0
+                    s = jnp.where(s == 0, jnp.float32(1.0), s)
+                    q = jnp.clip(jnp.round(v32 / s), -127, 127) \
+                        .astype(jnp.int8)
+                    out[k] = {"q": q, "s": s}
+                else:
+                    out[k] = v
+            return out
+        return pack(params)
+
+    @staticmethod
+    def _unpack_params(params: dict, dt) -> dict:
+        return {k: ((v["q"].astype(dt) * v["s"].astype(dt))
+                    if isinstance(v, dict) else v)
+                for k, v in params.items()}
+
     def _effective_params(self, cm: ConvertedModel) -> dict:
         """Graph initializers with any fine-tuned override layered on top
         (``weights_override`` npz — set by ONNXEstimator.fit)."""
@@ -252,13 +296,13 @@ class ONNXModel(Model):
         return {**cm.params, **override}
 
     def set(self, **kwargs):
-        if "weights_override" in kwargs \
+        if ("weights_override" in kwargs or "quantize" in kwargs) \
                 and getattr(self, "_device_params", None):
-            # cached device params embed the previous override — drop them
-            # (an id()-keyed cache would risk stale hits after the old
-            # payload's address is reused). getattr: Params.__init__ may
-            # route constructor kwargs through set() before __init__ has
-            # built the cache attributes.
+            # cached device params embed the previous override/packing —
+            # drop them so the change takes effect (an id()-keyed cache
+            # would risk stale hits after the old payload's address is
+            # reused). getattr: Params.__init__ may route constructor
+            # kwargs through set() before __init__ has built the caches.
             with self._params_lock:
                 self._device_params.clear()
         return super().set(**kwargs)
@@ -277,8 +321,11 @@ class ONNXModel(Model):
                 # (bfloat16) take a slow serialization path over the link
                 # params are committed to `device`; the cast jit follows
                 # its operands
-                self._device_params[key] = self._cast_params(
+                p = self._cast_params(
                     jax.device_put(self._effective_params(cm), device))
+                if self.quantize == "int8":
+                    p = self._pack_params(p)
+                self._device_params[key] = p
             return self._device_params[key]
 
     def _params_for_mesh(self, mesh) -> dict:
@@ -289,9 +336,12 @@ class ONNXModel(Model):
         with self._params_lock:
             if key not in self._device_params:
                 cm = self._ensure_converted()
-                self._device_params[key] = self._cast_params(
+                p = self._cast_params(
                     jax.device_put(self._effective_params(cm),
                                    replicated_sharding(mesh)))
+                if self.quantize == "int8":
+                    p = self._pack_params(p)
+                self._device_params[key] = p
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------

@@ -220,12 +220,15 @@ class ContinuousDecoder:
         self._prefill = jax.jit(_prefill)
 
         # prefix-cache suffix extension: continue a stored prefix cache
-        # over the request's remaining tokens (one window forward)
+        # over the request's remaining tokens (one window forward). The
+        # cache arg is donated (off-CPU): it is always the freshly-padded
+        # temporary, never the stored snapshot itself.
         def _extend(params, ids, start, row_cache):
             from ..models.zoo.transformer import decode_window
             return decode_window(params, ids, start, row_cache, cfg)
 
-        self._extend = jax.jit(_extend)
+        self._extend = jax.jit(
+            _extend, donate_argnums=(3,) if donate else ())
         #: key → (prefix token array, row cache snapshot, prefix length);
         #: LRU — hits re-insert, eviction pops the coldest entry
         self._prefix_store: Dict[str, tuple] = {}
@@ -303,6 +306,11 @@ class ContinuousDecoder:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0 or temperature < 0.0:
             raise ValueError("top_k and temperature must be >= 0")
+        if prefix_key is not None and not isinstance(prefix_key, str):
+            # an unhashable key would TypeError inside the engine thread,
+            # poisoning the batch instead of 400-ing this request
+            raise ValueError(
+                f"prefix_key must be a string, got {type(prefix_key).__name__}")
         if prefix_len is not None:
             if prefix_key is None:
                 raise ValueError("prefix_len without prefix_key")

@@ -538,3 +538,9 @@ class TestPrefixCaching:
         b = self._run(eng, prompt, prefix_key="k")
         assert a == b == _reference_tokens(params, prompt, 6)
         assert eng.stats == {"prefills": 2, "prefix_hits": 0}
+
+    def test_unhashable_prefix_key_rejected_at_submit(self, params):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48)
+        with pytest.raises(ValueError, match="must be a string"):
+            eng.submit(np.arange(4) % CFG.vocab, max_new_tokens=2,
+                       prefix_key=["a"])
