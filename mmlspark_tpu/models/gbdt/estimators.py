@@ -89,6 +89,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     max_conflict_rate = Param(float, default=0.0,
                               doc="EFB conflict budget as a fraction of "
                                   "rows (0 = lossless bundling)")
+    monotone_constraints = Param((list, int), default=[],
+                                 doc="per-feature -1/0/+1 directions the "
+                                     "model's predictions must respect "
+                                     "(LightGBM monotone_constraints)")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
@@ -105,6 +109,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         p["tree_learner"] = self.parallelism
         if self.categorical_feature:
             p["categorical_feature"] = list(self.categorical_feature)
+        if self.monotone_constraints:
+            p["monotone_constraints"] = list(self.monotone_constraints)
         p.update(extra)
         return p
 

@@ -69,6 +69,7 @@ _DEFAULTS = dict(
     enable_bundle=True,             # EFB on sparse input (LightGBM name)
     max_conflict_rate=0.0,          # EFB conflict budget as a row fraction
     max_bundle_bins=4096,           # cap on one bundle's bin span
+    monotone_constraints=None,      # per-feature -1/0/+1 (LightGBM name)
 )
 
 
@@ -391,6 +392,27 @@ def train(params: Dict,
                         min_data_in_leaf=float(p["min_data_in_leaf"]),
                         bundles=bundle_tables,
                         n_bundle_bins=int(n_bundle_bins))
+    if p["monotone_constraints"]:        # None or [] both mean "none"
+        mono = np.asarray(p["monotone_constraints"], dtype=np.int32)
+        if mono.shape != (F,):
+            raise ValueError(
+                f"monotone_constraints needs one entry per feature "
+                f"({F}), got shape {mono.shape}")
+        if not np.isin(mono, (-1, 0, 1)).all():
+            raise ValueError("monotone_constraints entries must be "
+                             "-1, 0, or +1")
+        if cat_encoder is not None:
+            cat_idx = [int(i) for i in np.nonzero(mono)[0]
+                       if int(i) in set(cat_encoder.feature_indices)]
+            if cat_idx:
+                # the encoder rewrites these columns to label-ordered
+                # ranks; a "monotone in the raw value" promise would be
+                # silently vacuous (LightGBM rejects this combination too)
+                raise ValueError(
+                    f"monotone_constraints on categorical features "
+                    f"{cat_idx} are not supported")
+        if mono.any():
+            build_kwargs["monotone"] = jnp.asarray(mono)
 
     if axis_name is None:
         def build(xb_, g_, h_, live_, fmask):

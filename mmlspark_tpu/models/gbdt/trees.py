@@ -117,11 +117,13 @@ def _debundle(hist_b, bundles: "BundleTables", n_bins: int):
 
 
 def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
-                 feature_mask):
+                 feature_mask, monotone=None, bounds=None):
     """hist (nodes, F, B, 3) → masked split gains (nodes, F, B); invalid
     candidates are -inf. ``feature_mask`` may be (F,) or per-node (nodes, F)
     (the latter after a voting gather, where the column set differs per
-    node)."""
+    node). ``monotone`` (F,) in {-1, 0, +1} with ``bounds`` (lo, hi) each
+    (nodes,) masks candidates whose (bound-clamped) child values violate
+    the feature's direction — LightGBM monotone_constraints semantics."""
     G = hist[..., 0]
     H = hist[..., 1]
     C = hist[..., 2]
@@ -143,6 +145,12 @@ def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
     if feature_mask is not None:
         fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
         valid = valid & fm[:, :, None]
+    if monotone is not None:
+        lo, hi = bounds                              # (nodes,)
+        vL = jnp.clip(-GL / (HL + lam), lo[:, None, None], hi[:, None, None])
+        vR = jnp.clip(-GR / (HR + lam), lo[:, None, None], hi[:, None, None])
+        m = monotone[None, :, None]                  # (1, F, 1)
+        valid = valid & (m.astype(jnp.float32) * (vR - vL) >= 0)
     return jnp.where(valid, gain, -jnp.inf)
 
 
@@ -186,11 +194,29 @@ def _voting_splits(local_hist, axis_name, k, lam, min_gain,
     return bf, bb, bg, level_cover
 
 
+def _chosen_child_values(hist, bf, bb, lam, lo, hi):
+    """Clamped left/right child values at each node's chosen (feat, bin).
+    hist (nodes, F, B, 3); bf/bb (nodes,); lo/hi (nodes,) → (vL, vR, mid)."""
+    nodes, F, B, _ = hist.shape
+    f = jnp.clip(bf, 0, F - 1)
+    sel = jnp.take_along_axis(hist, f[:, None, None, None], axis=1)[:, 0]
+    G = jnp.cumsum(sel[..., 0], axis=-1)              # (nodes, B)
+    H = jnp.cumsum(sel[..., 1], axis=-1)
+    b = jnp.clip(bb, 0, B - 1)
+    GL = jnp.take_along_axis(G, b[:, None], axis=1)[:, 0]
+    HL = jnp.take_along_axis(H, b[:, None], axis=1)[:, 0]
+    GR, HR = G[:, -1] - GL, H[:, -1] - HL
+    vL = jnp.clip(-GL / (HL + lam), lo, hi)
+    vR = jnp.clip(-GR / (HR + lam), lo, hi)
+    return vL, vR, 0.5 * (vL + vR)
+
+
 def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
-                 feature_mask):
+                 feature_mask, monotone=None, bounds=None):
     """hist (nodes, F, B, 3) → best (gain, feat, bin) per node."""
     gain = _split_gains(hist, lam, min_gain, min_child_weight,
-                        min_data_in_leaf, feature_mask)
+                        min_data_in_leaf, feature_mask,
+                        monotone=monotone, bounds=bounds)
     flat = gain.reshape(gain.shape[0], -1)           # (nodes, F*B)
     best = jnp.argmax(flat, axis=-1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
@@ -213,7 +239,8 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                feature_mask: Optional[jnp.ndarray] = None,
                axis_name: Optional[str] = None, voting_k: int = 0,
                bundles: Optional[BundleTables] = None,
-               n_bundle_bins: int = 0):
+               n_bundle_bins: int = 0,
+               monotone: Optional[jnp.ndarray] = None):
     """Grow one depth-`depth` tree. All shapes static; jits once per config.
 
     xb: (n, F) int bins — or, with ``bundles``, the (n, n_bundles) EFB
@@ -239,6 +266,16 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     covers = jnp.zeros(2 ** (depth + 1) - 1, dtype=jnp.float32)
     node_rel = jnp.zeros(n, dtype=jnp.int32)
     use_voting = voting_k > 0 and axis_name is not None and 2 * voting_k < F
+    if monotone is not None and use_voting:
+        raise ValueError("monotone_constraints + voting_parallel is not "
+                         "supported (constraint masking needs the full "
+                         "histogram; use data_parallel)")
+    # per-node value bounds inherited down the tree (LightGBM
+    # monotone_constraints): candidates violating a feature's direction
+    # are masked in the gain search, children tighten around the split's
+    # mid value, leaf values clamp into their node's interval
+    lo = jnp.full((1,), -jnp.inf) if monotone is not None else None
+    hi = jnp.full((1,), jnp.inf) if monotone is not None else None
 
     def level_hist(n_nodes, psum_axis):
         if bundles is None:
@@ -262,7 +299,10 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             hist = level_hist(n_nodes, axis_name)
             level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
             bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
-                                      min_data_in_leaf, feature_mask)
+                                      min_data_in_leaf, feature_mask,
+                                      monotone=monotone,
+                                      bounds=(lo, hi)
+                                      if monotone is not None else None)
         covers = jax.lax.dynamic_update_slice(covers, level_cover, (level_off,))
         feats = jax.lax.dynamic_update_slice(feats, bf, (level_off,))
         thrs = jax.lax.dynamic_update_slice(thrs, bb, (level_off,))
@@ -286,6 +326,16 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                 rel, bundles.zero_bin[row_feat])
         go_right = row_bin > bb[node_rel]
         node_rel = node_rel * 2 + go_right.astype(jnp.int32)
+        if monotone is not None:
+            vL, vR, mid = _chosen_child_values(hist, bf, bb, lam, lo, hi)
+            m_node = jnp.where(bf >= 0,
+                               monotone[jnp.clip(bf, 0, F - 1)], 0)
+            left_lo = jnp.where(m_node < 0, jnp.maximum(lo, mid), lo)
+            left_hi = jnp.where(m_node > 0, jnp.minimum(hi, mid), hi)
+            right_lo = jnp.where(m_node > 0, jnp.maximum(lo, mid), lo)
+            right_hi = jnp.where(m_node < 0, jnp.minimum(hi, mid), hi)
+            lo = jnp.stack([left_lo, right_lo], axis=1).reshape(-1)
+            hi = jnp.stack([left_hi, right_hi], axis=1).reshape(-1)
 
     # leaf values from bottom-level stats
     n_leaves = 2 ** depth
@@ -297,6 +347,10 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     G_reg = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)  # L1 shrink
     leaf_value = -G_reg / (sums[:, 1] + lam)
     leaf_value = jnp.where(jnp.abs(sums[:, 1]) > 0, leaf_value, 0.0)
+    if monotone is not None:
+        # inherited interval per leaf; empty leaves clamp too (their 0.0
+        # may sit outside the bounds of a constrained subtree)
+        leaf_value = jnp.clip(leaf_value, lo, hi)
     leaf_counts = jax.ops.segment_sum(sample_weight_count, node_rel,
                                       num_segments=n_leaves)
     if axis_name is not None:

@@ -1,0 +1,140 @@
+"""Monotone constraints (LightGBM ``monotone_constraints``).
+
+Per-node value bounds propagate down the static depth-wise tree
+(``trees.build_tree``): violating split candidates are masked in the gain
+search, children tighten around the chosen split's mid value, and leaf
+values clamp into their node's interval — so every tree (and any
+positively-weighted sum of trees, i.e. the boosted model under every
+boosting mode) is monotone in the constrained features.
+
+The empirical check: sweep a constrained feature over a grid with all
+other features held fixed; predictions must be non-decreasing (+1) /
+non-increasing (-1) for every background row.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.models.gbdt import LightGBMRegressor, train
+
+
+def make_data(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 4))
+    # y increases with x0, decreases with x1 — but with enough noise that
+    # an unconstrained fit wiggles locally
+    y = (1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.8 * np.sin(4 * X[:, 2])
+         + rng.normal(0, 0.4, n))
+    return X, y
+
+
+def sweep(booster, feature, lo=-2.5, hi=2.5, n_bg=12, n_grid=40, seed=1):
+    rng = np.random.default_rng(seed)
+    bg = rng.normal(0, 1, (n_bg, 4))
+    grid = np.linspace(lo, hi, n_grid)
+    deltas = []
+    for row in bg:
+        pts = np.tile(row, (n_grid, 1))
+        pts[:, feature] = grid
+        pred = booster.predict(pts.astype(np.float32), raw_score=True)
+        deltas.append(np.diff(pred))
+    return np.concatenate(deltas)
+
+
+PARAMS = {"objective": "regression", "num_iterations": 40,
+          "num_leaves": 15, "min_data_in_leaf": 5, "learning_rate": 0.15}
+
+
+class TestMonotone:
+    def test_unconstrained_wiggles(self):
+        X, y = make_data()
+        b = train(dict(PARAMS), X, y)
+        d0 = sweep(b, 0)
+        assert (d0 < -1e-9).any()      # the fit is locally non-monotone
+
+    def test_increasing_and_decreasing(self):
+        X, y = make_data()
+        b = train(dict(PARAMS, monotone_constraints=[1, -1, 0, 0]), X, y)
+        assert (sweep(b, 0) >= -1e-6).all()     # non-decreasing in x0
+        assert (sweep(b, 1) <= 1e-6).all()      # non-increasing in x1
+        # unconstrained feature keeps its wiggle room
+        assert (sweep(b, 2) < -1e-9).any()
+
+    def test_quality_preserved(self):
+        X, y = make_data()
+        b_free = train(dict(PARAMS), X, y)
+        b_mono = train(dict(PARAMS, monotone_constraints=[1, -1, 0, 0]),
+                       X, y)
+        r2 = lambda p: 1 - np.var(y - p) / np.var(y)      # noqa: E731
+        assert r2(b_mono.predict(X)) > 0.9 * r2(b_free.predict(X))
+
+    @pytest.mark.parametrize("boosting", ["goss", "dart"])
+    def test_monotone_under_boosting_modes(self, boosting):
+        X, y = make_data(seed=2)
+        b = train(dict(PARAMS, boosting=boosting, seed=3,
+                       monotone_constraints=[1, 0, 0, 0]), X, y)
+        assert (sweep(b, 0) >= -1e-6).all()
+
+    def test_monotone_with_sparse_and_bundling(self):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(4)
+        dense = np.where(rng.random((600, 4)) < 0.4,
+                         rng.normal(0, 1, (600, 4)), 0.0)
+        y = 2 * dense[:, 0] - dense[:, 1] + rng.normal(0, 0.2, 600)
+        b = train(dict(PARAMS, monotone_constraints=[1, 0, 0, 0]),
+                  sp.csr_matrix(dense), y)
+        assert (sweep(b, 0) >= -1e-6).all()
+
+    def test_data_parallel_monotone(self):
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        X, y = make_data(seed=5)
+        b = train(dict(PARAMS, num_iterations=15,
+                       monotone_constraints=[1, 0, 0, 0],
+                       tree_learner="data_parallel"), X, y, mesh=mesh)
+        assert (sweep(b, 0) >= -1e-6).all()
+
+    def test_validation_errors(self):
+        X, y = make_data(n=100)
+        with pytest.raises(ValueError, match="one entry per feature"):
+            train(dict(PARAMS, num_iterations=2,
+                       monotone_constraints=[1, 0]), X, y)
+        with pytest.raises(ValueError, match="-1, 0, or"):
+            train(dict(PARAMS, num_iterations=2,
+                       monotone_constraints=[2, 0, 0, 0]), X, y)
+        with pytest.raises(ValueError, match="voting"):
+            import jax
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+            train(dict(PARAMS, num_iterations=2,
+                       monotone_constraints=[1, 0, 0, 0],
+                       tree_learner="voting_parallel", top_k=1), X, y,
+                  mesh=mesh)
+
+    def test_empty_list_means_no_constraints(self):
+        X, y = make_data(n=100, seed=7)
+        b = train(dict(PARAMS, num_iterations=2,
+                       monotone_constraints=[]), X, y)
+        assert b.num_trees == 2
+
+    def test_categorical_monotone_rejected(self):
+        rng = np.random.default_rng(8)
+        X = np.column_stack([rng.integers(0, 5, 200).astype(np.float64),
+                             rng.normal(0, 1, 200)])
+        y = rng.normal(0, 1, 200)
+        with pytest.raises(ValueError, match="categorical"):
+            train(dict(PARAMS, num_iterations=2,
+                       categorical_feature=[0],
+                       monotone_constraints=[1, 0]), X, y)
+
+    def test_estimator_surface(self):
+        X, y = make_data(n=300, seed=6)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X.astype(np.float32))
+        df = DataFrame({"features": col, "label": y})
+        m = LightGBMRegressor(num_iterations=20, num_leaves=15,
+                              min_data_in_leaf=5,
+                              monotone_constraints=[1, -1, 0, 0]).fit(df)
+        assert (sweep(m.booster, 0) >= -1e-6).all()
