@@ -21,7 +21,8 @@ from ..core.dataframe import DataFrame, concat
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Transformer
 
-__all__ = ["LocalExplainer", "shapley_kernel_weights", "dense_row"]
+__all__ = ["LocalExplainer", "shapley_kernel_weights", "dense_row",
+           "dense_matrix"]
 
 try:                            # guarded like models/gbdt/binning.py
     import scipy.sparse as _sp
@@ -36,6 +37,11 @@ def dense_row(v) -> np.ndarray:
     if _sp is not None and _sp.issparse(v):
         return v.toarray().astype(np.float64).ravel()
     return np.asarray(v, dtype=np.float64).ravel()
+
+
+def dense_matrix(col) -> np.ndarray:
+    """A features column (dense or sparse rows) → (n, d) float64 matrix."""
+    return np.stack([dense_row(v) for v in col])
 
 
 class LocalExplainer(Transformer):

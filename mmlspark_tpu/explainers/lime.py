@@ -14,7 +14,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCol, HasInputCols, Param
-from .base import dense_row, LocalExplainer
+from .base import dense_matrix, dense_row, LocalExplainer
 from .regression import batched_lasso
 from .superpixel import mask_image, slic_superpixels
 
@@ -47,11 +47,9 @@ class VectorLIME(_LIMEParams, HasInputCol):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         col = self.get("input_col")
-        X = np.stack([dense_row(v)
-                      for v in df[col]])
+        X = dense_matrix(df[col])
         bg = self.get("background_data")
-        bgX = X if bg is None else np.stack(
-            [dense_row(v) for v in bg[col]])
+        bgX = X if bg is None else dense_matrix(bg[col])
         sigma = bgX.std(axis=0) + 1e-12
         n, d = X.shape
         m = self.get("num_samples")

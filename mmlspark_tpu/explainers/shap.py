@@ -17,7 +17,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCol, HasInputCols, Param
-from .base import dense_row, LocalExplainer, shapley_kernel_weights
+from .base import dense_matrix, dense_row, LocalExplainer, shapley_kernel_weights
 from .regression import batched_weighted_lstsq
 from .superpixel import mask_image, slic_superpixels
 
@@ -67,11 +67,9 @@ class VectorSHAP(_SHAPParams, HasInputCol):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         col = self.get("input_col")
-        X = np.stack([dense_row(v)
-                      for v in df[col]])
+        X = dense_matrix(df[col])
         bg = self.get("background_data")
-        bgX = X if bg is None else np.stack(
-            [dense_row(v) for v in bg[col]])
+        bgX = X if bg is None else dense_matrix(bg[col])
         base = bgX.mean(axis=0)
         n, d = X.shape
         m = self.get("num_samples")

@@ -15,6 +15,11 @@ from typing import List
 
 import numpy as np
 
+try:                            # guarded like models/gbdt/binning.py
+    import scipy.sparse as _sp
+except Exception:               # pragma: no cover - scipy is in the image
+    _sp = None
+
 from ..core.dataframe import DataFrame
 from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Estimator, Model, Transformer
@@ -90,9 +95,9 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol):
     def _transform(self, df: DataFrame) -> DataFrame:
         n = self.get("num_features")
         use_sparse = self.get("sparse")
-        if use_sparse:
-            import scipy.sparse as sp
         out = np.empty(len(df), dtype=object)
+        if use_sparse and _sp is None:     # pragma: no cover
+            raise ImportError("HashingTF(sparse=True) requires scipy")
         for i, toks in enumerate(df[self.get("input_col")]):
             if use_sparse:
                 hashed = np.fromiter((_fnv1a(t, n) for t in toks),
@@ -100,7 +105,7 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol):
                 idx, counts = np.unique(hashed, return_counts=True)
                 vals = (np.ones(len(idx), np.float32) if self.get("binary")
                         else counts.astype(np.float32))
-                out[i] = sp.csr_matrix(
+                out[i] = _sp.csr_matrix(
                     (vals, idx, np.array([0, len(idx)])), shape=(1, n))
                 continue
             vec = np.zeros(n, dtype=np.float32)
@@ -116,15 +121,11 @@ class IDF(Estimator, HasInputCol, HasOutputCol):
     min_doc_freq = Param(int, default=0, doc="zero out rare terms")
 
     def _fit(self, df: DataFrame) -> "IDFModel":
-        try:
-            import scipy.sparse as sp
-        except Exception:               # pragma: no cover
-            sp = None
         col = df[self.get("input_col")]
         # incremental docfreq: never materialize the (n_docs, n_features) stack
         docfreq = None
         for v in col:
-            if sp is not None and sp.issparse(v):
+            if _sp is not None and _sp.issparse(v):
                 v = v.tocsr()
                 if docfreq is None:
                     docfreq = np.zeros(v.shape[1], dtype=np.int64)
@@ -150,15 +151,11 @@ class IDFModel(Model, HasInputCol, HasOutputCol):
     idf = _CP(default=None, doc="per-slot idf weights")
 
     def _transform(self, df: DataFrame) -> DataFrame:
-        try:
-            import scipy.sparse as sp
-        except Exception:               # pragma: no cover
-            sp = None
         idf = np.asarray(self.get("idf"))
         col = df[self.get("input_col")]
         out = np.empty(len(col), dtype=object)
         for i, v in enumerate(col):
-            if sp is not None and sp.issparse(v):
+            if _sp is not None and _sp.issparse(v):
                 r = v.tocsr().astype(np.float32)
                 r.data = r.data * idf[r.indices].astype(np.float32)
                 out[i] = r

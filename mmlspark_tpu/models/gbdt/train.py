@@ -392,18 +392,23 @@ def train(params: Dict,
                         min_data_in_leaf=float(p["min_data_in_leaf"]),
                         bundles=bundle_tables,
                         n_bundle_bins=int(n_bundle_bins))
-    if p["monotone_constraints"]:        # None or [] both mean "none"
-        mono = np.asarray(p["monotone_constraints"], dtype=np.int32)
-        if mono.shape != (F,):
+    mono_raw = p["monotone_constraints"]
+    if mono_raw is not None and np.asarray(mono_raw).size:
+        # validate RAW values before the int cast (int32 would silently
+        # zero fractional entries — a vacuous constraint, not an error)
+        raw = np.asarray(mono_raw)
+        if raw.shape != (F,):
             raise ValueError(
                 f"monotone_constraints needs one entry per feature "
-                f"({F}), got shape {mono.shape}")
-        if not np.isin(mono, (-1, 0, 1)).all():
+                f"({F}), got shape {raw.shape}")
+        if not np.isin(raw, (-1, 0, 1)).all():
             raise ValueError("monotone_constraints entries must be "
                              "-1, 0, or +1")
+        mono = raw.astype(np.int32)
         if cat_encoder is not None:
+            cat_set = set(cat_encoder.feature_indices)
             cat_idx = [int(i) for i in np.nonzero(mono)[0]
-                       if int(i) in set(cat_encoder.feature_indices)]
+                       if int(i) in cat_set]
             if cat_idx:
                 # the encoder rewrites these columns to label-ordered
                 # ranks; a "monotone in the raw value" promise would be
