@@ -14,7 +14,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCol, HasInputCols, Param
-from .base import dense_matrix, dense_row, LocalExplainer
+from .base import dense_matrix, LocalExplainer
 from .regression import batched_lasso
 from .superpixel import mask_image, slic_superpixels
 

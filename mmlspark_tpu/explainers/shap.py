@@ -17,7 +17,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, HasInputCol, HasInputCols, Param
-from .base import dense_matrix, dense_row, LocalExplainer, shapley_kernel_weights
+from .base import dense_matrix, LocalExplainer, shapley_kernel_weights
 from .regression import batched_weighted_lstsq
 from .superpixel import mask_image, slic_superpixels
 

@@ -21,6 +21,8 @@ import io
 import json
 from typing import Dict, List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .binning import BinMapper
@@ -63,6 +65,9 @@ class Booster:
         #: label-ordered categorical encoder (categorical.py); applied to
         #: raw X before every prediction path when set
         self.cat_encoder = None
+        #: training hyperparams refit() needs on the same scale
+        #: (learning_rate, lambda_l2); stamped by train(), serialized
+        self.fit_params = None
 
     # -- bookkeeping --------------------------------------------------------
     _FIELDS = ("feats", "thr_raw", "leaf_values", "gains", "covers")
@@ -108,6 +113,7 @@ class Booster:
                     self.gains[:n_trees].copy(),
                     self.covers[:n_trees].copy(), best_iteration=n_trees)
         b.cat_encoder = self.cat_encoder  # trees split in the encoded space
+        b.fit_params = self.fit_params
         return b
 
     def merge(self, other: "Booster") -> "Booster":
@@ -122,6 +128,7 @@ class Booster:
             np.concatenate([self.gains, other.gains]),
             np.concatenate([self.covers, other.covers]))
         merged.cat_encoder = self.cat_encoder
+        merged.fit_params = self.fit_params
         return merged
 
     # -- prediction ---------------------------------------------------------
@@ -201,6 +208,73 @@ class Booster:
         out = phi if self.num_class > 1 else phi[0]
         return out.astype(np.float32)
 
+    # -- refit (parity: LightGBM Booster.refit) -----------------------------
+    def refit(self, X, y, decay_rate: float = 0.9,
+              learning_rate: Optional[float] = None,
+              lam: Optional[float] = None,
+              sample_weight=None) -> "Booster":
+        """Adapt the model to NEW data without changing tree structures:
+        every tree keeps its splits, leaf values are re-estimated on
+        ``(X, y)`` and blended ``decay*old + (1-decay)*new`` — LightGBM's
+        ``Booster.refit(decay_rate=0.9)``, the cheap domain-shift
+        adaptation between full retrains.
+
+        Trees refit sequentially in boosting order (each tree's gradients
+        are taken at the running scores of the already-refitted prefix),
+        matching the additive-model semantics of the original fit.
+        ``learning_rate``/``lam`` default to the TRAINING values stamped on
+        the booster (LightGBM reuses the model's own shrinkage; estimates
+        on a different scale would drift toward base_score).
+        """
+        if self.num_class > 1:
+            raise NotImplementedError("refit for multiclass boosters")
+        if not 0.0 <= decay_rate <= 1.0:
+            raise ValueError(f"decay_rate must be in [0, 1], got {decay_rate}")
+        fp = getattr(self, "fit_params", None) or {}
+        if learning_rate is None:
+            learning_rate = float(fp.get("learning_rate", 0.1))
+        if lam is None:
+            lam = float(fp.get("lambda_l2", 0.0)) + 1e-10  # train's lam
+        from .objectives import get_objective
+        y = np.asarray(y, dtype=np.float64)
+        w = (np.asarray(sample_weight, dtype=np.float64)
+             if sample_weight is not None else np.ones(len(y)))
+        obj = get_objective(self.objective, num_class=2)
+        # leaf index per (row, tree) in one pass (predict_leaf applies the
+        # categorical encoding itself); per-tree leaf sums after
+        leaves = np.asarray(self.predict_leaf(X))              # (n, T)
+        n_leaf = 2 ** self.depth
+        new_lv = np.array(self.leaf_values, dtype=np.float32, copy=True)
+        scores = jnp.full(len(y), self.base_score, jnp.float32)
+        if obj.grad_hess is None:
+            raise NotImplementedError(
+                f"refit needs analytic gradients for {self.objective!r}")
+        grad_fn = jax.jit(obj.grad_hess)
+        yd, wd = jnp.asarray(y), jnp.asarray(w)
+        for t in range(self.num_trees):
+            g, h = grad_fn(scores, yd, wd)
+            g = np.asarray(g, dtype=np.float64)
+            h = np.asarray(h, dtype=np.float64)
+            li = leaves[:, t]
+            Gs = np.bincount(li, weights=g, minlength=n_leaf)
+            Hs = np.bincount(li, weights=h, minlength=n_leaf)
+            opt = np.where(Hs > 0,
+                           -Gs / (Hs + lam) * learning_rate, 0.0)
+            blended = (decay_rate * new_lv[t]
+                       + (1.0 - decay_rate) * opt).astype(np.float32)
+            # empty leaves keep their trained value (no evidence to move)
+            blended = np.where(Hs > 0, blended, new_lv[t])
+            new_lv[t] = blended
+            scores = scores + jnp.asarray(blended, jnp.float32)[li]
+        out = Booster(self.depth, self.n_features, self.objective,
+                      self.base_score, self.num_class,
+                      self.feats.copy(), self.thr_raw.copy(), new_lv,
+                      self.gains.copy(), self.covers.copy(),
+                      best_iteration=self.best_iteration)
+        out.cat_encoder = self.cat_encoder
+        out.fit_params = self.fit_params
+        return out
+
     # -- importances --------------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         imp = np.zeros(self.n_features)
@@ -226,6 +300,8 @@ class Booster:
                 "arrays": base64.b64encode(buf.getvalue()).decode("ascii")}
         if self.cat_encoder is not None:
             meta["cat_encoder"] = self.cat_encoder.to_dict()
+        if self.fit_params is not None:
+            meta["fit_params"] = self.fit_params
         return json.dumps(meta)
 
     @staticmethod
@@ -242,4 +318,5 @@ class Booster:
         if "cat_encoder" in meta:
             from .categorical import CategoricalEncoder
             b.cat_encoder = CategoricalEncoder.from_dict(meta["cat_encoder"])
+        b.fit_params = meta.get("fit_params")
         return b

@@ -435,6 +435,8 @@ def train(params: Dict,
             return build_tree(xb_, g_, h_, live_, feature_mask=fmask,
                               axis_name=axis_name, **build_kwargs)
 
+    booster.fit_params = {"learning_rate": float(p["learning_rate"]),
+                          "lambda_l2": float(p["lambda_l2"])}
     grad_fn = jax.jit(obj.grad_hess) if obj.grad_hess is not None else None
     lr = float(p["learning_rate"])
     rng = np.random.default_rng(int(p["seed"]))

@@ -264,3 +264,56 @@ class TestEstimators:
                                  min_data_in_leaf=2)
         model = clf.fit(df)
         assert model.booster.num_trees < 200
+
+
+class TestRefit:
+    """LightGBM ``Booster.refit``: keep structures, re-estimate leaves on
+    new data with decay blending — the cheap domain-shift adaptation."""
+
+    def _fit(self, X, y, **kw):
+        return train({"objective": "regression", "num_iterations": 25,
+                      "num_leaves": 15, "min_data_in_leaf": 5,
+                      "learning_rate": 0.1, **kw}, X, y)
+
+    def test_decay_one_is_identity(self):
+        rng = np.random.default_rng(30)
+        X = rng.normal(0, 1, (400, 4))
+        y = 2 * X[:, 0] + rng.normal(0, 0.2, 400)
+        b = self._fit(X, y)
+        r = b.refit(X, y, decay_rate=1.0)
+        np.testing.assert_allclose(r.predict(X), b.predict(X), rtol=1e-6)
+        np.testing.assert_array_equal(r.feats, b.feats)
+
+    def test_adapts_to_shifted_target(self):
+        rng = np.random.default_rng(31)
+        X = rng.normal(0, 1, (600, 4))
+        y_old = 2 * X[:, 0] + rng.normal(0, 0.2, 600)
+        y_new = y_old + 3.0                  # constant domain shift
+        b = self._fit(X, y_old)
+        r = b.refit(X, y_new, decay_rate=0.1, learning_rate=0.1)
+        mse_before = np.mean((b.predict(X) - y_new) ** 2)
+        mse_after = np.mean((r.predict(X) - y_new) ** 2)
+        assert mse_after < 0.5 * mse_before, (mse_before, mse_after)
+        # structures untouched, only leaf values moved
+        np.testing.assert_array_equal(r.feats, b.feats)
+        np.testing.assert_array_equal(r.thr_raw, b.thr_raw)
+        assert np.abs(r.leaf_values - b.leaf_values).max() > 0
+
+    def test_binary_objective_refit(self):
+        rng = np.random.default_rng(32)
+        X = rng.normal(0, 1, (400, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        b = train({"objective": "binary", "num_iterations": 15,
+                   "num_leaves": 7, "min_data_in_leaf": 5}, X, y)
+        y_flip = 1.0 - y                     # adversarial shift
+        r = b.refit(X, y_flip, decay_rate=0.0)
+        acc = ((r.predict(X) > 0.5) == y_flip).mean()
+        assert acc > 0.8, acc
+
+    def test_validation(self):
+        rng = np.random.default_rng(33)
+        X = rng.normal(0, 1, (100, 3))
+        y = X[:, 0]
+        b = self._fit(X, y, num_iterations=3)
+        with pytest.raises(ValueError, match="decay_rate"):
+            b.refit(X, y, decay_rate=1.5)
