@@ -93,6 +93,15 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                                  doc="per-feature -1/0/+1 directions the "
                                      "model's predictions must respect "
                                      "(LightGBM monotone_constraints)")
+    scale_pos_weight = Param(float, default=1.0,
+                             doc="binary: positive-class weight multiplier "
+                                 "(LightGBM scale_pos_weight)")
+    is_unbalance = Param(bool, default=False,
+                         doc="binary: auto-set scale_pos_weight to "
+                             "neg/pos (LightGBM is_unbalance)")
+    init_score_col = Param(str, default=None,
+                           doc="per-row starting margin column (LightGBM "
+                               "initScoreCol); predictions exclude it")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
@@ -102,7 +111,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                 "max_bin", "early_stopping_round", "metric", "seed",
                 "checkpoint_interval", "boosting_type", "top_rate",
                 "other_rate", "drop_rate", "max_drop", "skip_drop", "top_k",
-                "enable_bundle", "max_conflict_rate"]
+                "enable_bundle", "max_conflict_rate", "scale_pos_weight",
+                "is_unbalance"]
         p = {k: self.get(k) for k in keys}
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")
@@ -151,10 +161,19 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         ms = self.get_or_none("model_string")
         if ms:
             init_model = Booster.from_string(ms)
+        iscol = self.get_or_none("init_score_col")
+        init_score = (np.asarray(train_df[iscol], dtype=np.float64)
+                      if iscol and iscol in train_df else None)
+        valid_init_scores = None
+        if init_score is not None and valid_sets is not None:
+            # the validation split carries its own margin column rows
+            valid_init_scores = [np.asarray(valid_df[iscol],
+                                            dtype=np.float64)]
         mesh = get_default_mesh() if self.parallelism != "serial" else None
         return train(self._train_params(extra_params), X, y, sample_weight=w,
                      group=group, valid_sets=valid_sets, init_model=init_model,
-                     mesh=mesh)
+                     mesh=mesh, init_score=init_score,
+                     valid_init_scores=valid_init_scores)
 
 
 class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):

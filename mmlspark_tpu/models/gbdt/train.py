@@ -70,6 +70,8 @@ _DEFAULTS = dict(
     max_conflict_rate=0.0,          # EFB conflict budget as a row fraction
     max_bundle_bins=4096,           # cap on one bundle's bin span
     monotone_constraints=None,      # per-feature -1/0/+1 (LightGBM name)
+    scale_pos_weight=1.0,           # binary: positive-class weight multiplier
+    is_unbalance=False,             # binary: auto scale_pos_weight = neg/pos
 )
 
 
@@ -194,8 +196,18 @@ def train(params: Dict,
           init_model: Optional[Booster] = None,
           mesh: Optional[Mesh] = None,
           callbacks: Optional[List[Callable]] = None,
-          eval_log: Optional[List] = None) -> Booster:
-    """Fit a GBDT. ``params`` uses LightGBM names (aliases accepted)."""
+          eval_log: Optional[List] = None,
+          init_score: Optional[np.ndarray] = None,
+          valid_init_scores: Optional[List[np.ndarray]] = None) -> Booster:
+    """Fit a GBDT. ``params`` uses LightGBM names (aliases accepted).
+
+    ``init_score``: per-row starting margin (LightGBM ``init_score``) —
+    boosting fits residuals on top of it, and, as in LightGBM, the fitted
+    model's predictions do NOT include it (the caller re-adds their margin
+    at scoring time). With ``valid_sets``, matching per-set margins must
+    come in ``valid_init_scores`` (each Dataset carries its own
+    init_score in LightGBM too) so eval metrics are computed at the right
+    margin."""
     p = resolve_params(params)
     # keep X in its incoming float width — a HIGGS-scale float32 matrix must
     # not be silently doubled to float64 (binning only ever copies a sample
@@ -248,10 +260,36 @@ def train(params: Dict,
                         alpha=p["alpha"],
                         tweedie_variance_power=p["tweedie_variance_power"])
 
+    # class-imbalance reweighting (LightGBM scale_pos_weight/is_unbalance):
+    # folded into the sample weights so gradients, hessians, and
+    # boost-from-average all see it consistently
+    spw = float(p["scale_pos_weight"])
+    if p["is_unbalance"] or spw != 1.0:
+        if objective_name != "binary":
+            raise ValueError("scale_pos_weight/is_unbalance apply to the "
+                             "binary objective only")
+        if p["is_unbalance"]:
+            if spw != 1.0:
+                raise ValueError("set either is_unbalance or "
+                                 "scale_pos_weight, not both (LightGBM's "
+                                 "own rule)")
+            pos = float(np.sum(w * (y == 1)))
+            neg = float(np.sum(w * (y != 1)))
+            if pos <= 0.0:
+                raise ValueError(
+                    "is_unbalance: no positive examples (or zero positive "
+                    "weight) — the auto ratio would be unbounded")
+            spw = neg / pos
+        w = w * np.where(y == 1, spw, 1.0)
+
     # step-level checkpoint/resume (beyond the reference's model-level
     # warm start): a run killed mid-training resumes from the last step
     ckpt = None
     resumed_iters = 0
+    if p["checkpoint_dir"] and init_score is not None:
+        # checkpoints persist only the booster; a resume could not
+        # reconstruct the margin-adjusted score state
+        raise ValueError("init_score cannot combine with step checkpoints")
     if p["checkpoint_dir"]:
         from ...utils.checkpoint import TrainingCheckpointer
         ckpt = TrainingCheckpointer(str(p["checkpoint_dir"]))
@@ -319,6 +357,9 @@ def train(params: Dict,
         xb = mapper.transform(X)
     n_bins = mapper.n_bins
 
+    if init_model is not None and init_score is not None:
+        raise ValueError("init_score cannot combine with a warm-start "
+                         "model (the model already defines the margin)")
     if init_model is not None:
         # dart mutates leaf values in place (scale_trees) — work on a deep
         # copy so the caller's model object is never changed under them
@@ -331,13 +372,27 @@ def train(params: Dict,
             X_raw if X_raw.dtype == np.float32 else X_raw.astype(np.float32)
         ) - np.float32(base_score)
         init_trees = booster.num_trees
+        init_arr = None
     else:
         init_trees = 0
-        base_score = 0.0 if (is_multi or is_rank) else obj.init_score(y, w)
+        if init_score is not None:
+            # per-row starting margin: boost-from-average is skipped
+            # (LightGBM semantics) and predictions exclude the margin
+            init_arr = np.asarray(init_score, dtype=np.float64)
+            want = (n, num_class) if is_multi else (n,)
+            if init_arr.shape != want:
+                raise ValueError(f"init_score shape {init_arr.shape} != "
+                                 f"{want}")
+            base_score = 0.0
+            scores = init_arr.copy()
+        else:
+            init_arr = None
+            base_score = 0.0 if (is_multi or is_rank) \
+                else obj.init_score(y, w)
+            scores = np.zeros((n, num_class) if is_multi else n)
         booster = Booster(depth, F, objective_name, base_score,
                           num_class if is_multi else 1)
         booster.cat_encoder = cat_encoder
-        scores = np.zeros((n, num_class) if is_multi else n)
 
     # device residency; shard rows when data-parallel over a mesh
     axis_name = None
@@ -366,6 +421,14 @@ def train(params: Dict,
     # f32 accumulation exact-ish (leaf deltas are small; adding them into a
     # large absolute base like mean(y)~1e3 would round at ~6e-5 ULP each
     # iteration). grad inputs re-add base_score on device.
+    init_pad = None
+    if init_arr is not None:
+        ip = (np.concatenate([init_arr,
+                              np.zeros((n_pad - n,) + init_arr.shape[1:])])
+              if n_pad != n else init_arr)
+        init_pad = jnp.asarray(ip, jnp.float32)
+        if axis_name is not None:
+            init_pad = jax.device_put(init_pad, row_sharding)
     scores = jnp.asarray(scores, jnp.float32)
     if axis_name is not None:
         scores = jax.device_put(scores, row_sharding)
@@ -454,6 +517,11 @@ def train(params: Dict,
     if valid_sets:
         valid_sets = [(vx if is_sparse(vx) else np.asarray(vx), vy)
                       for vx, vy in valid_sets]
+        if init_score is not None and valid_init_scores is None:
+            raise ValueError(
+                "init_score with valid_sets needs valid_init_scores "
+                "(one margin array per validation set) — eval at margin "
+                "zero would select a wrong best_iteration")
         if init_trees:
             valid_scores = [booster.raw_score(
                 vx if is_sparse(vx) else np.asarray(vx, dtype=np.float32))
@@ -462,6 +530,21 @@ def train(params: Dict,
             valid_scores = [np.full(
                 (vx.shape[0], num_class) if is_multi else vx.shape[0],
                 base_score, dtype=np.float64) for vx, _vy in valid_sets]
+        valid_margins = None
+        if valid_init_scores is not None:
+            if len(valid_init_scores) != len(valid_sets):
+                raise ValueError(
+                    f"valid_init_scores has {len(valid_init_scores)} "
+                    f"entries for {len(valid_sets)} valid_sets")
+            valid_margins = []
+            for vi, vis in enumerate(valid_init_scores):
+                vis = np.asarray(vis, dtype=np.float64)
+                if vis.shape != valid_scores[vi].shape:
+                    raise ValueError(
+                        f"valid_init_scores[{vi}] shape {vis.shape} != "
+                        f"{valid_scores[vi].shape}")
+                valid_margins.append(vis)
+                valid_scores[vi] = valid_scores[vi] + vis
         if cat_encoder is not None:
             # the per-iteration eval path feeds trees directly (bypassing
             # booster.raw_score), so hand it rank-encoded matrices once
@@ -518,7 +601,10 @@ def train(params: Dict,
         if drop_pred is not None:
             scores_for_grad = scores_for_grad - drop_pred
         elif boosting == "rf":
-            scores_for_grad = jnp.full_like(scores, base_score)
+            # rf: every tree fits the same residual — at the per-row margin
+            # when init_score was given, else at the constant init score
+            scores_for_grad = (init_pad if init_pad is not None
+                               else jnp.full_like(scores, base_score))
 
         # gradients
         if is_rank:
@@ -647,6 +733,9 @@ def train(params: Dict,
                     valid_scores[vi] = base_score + predict_trees_any(
                         booster.feats, booster.thr_raw, booster.leaf_values,
                         vx, depth=depth)
+                    if valid_margins is not None:
+                        valid_scores[vi] = valid_scores[vi] \
+                            + valid_margins[vi]
                 else:
                     delta = predict_trees_any(
                         new_feats, new_thr, new_leaf, vx, depth=depth)

@@ -317,3 +317,120 @@ class TestRefit:
         b = self._fit(X, y, num_iterations=3)
         with pytest.raises(ValueError, match="decay_rate"):
             b.refit(X, y, decay_rate=1.5)
+
+
+class TestImbalanceAndInitScore:
+    """LightGBM scale_pos_weight / is_unbalance / init_score parity."""
+
+    def _imbalanced(self, n=600, pos_frac=0.1, seed=40):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(0, 1, (n, 4))
+        logit = X[:, 0] * 2 - 2.2          # rare positives
+        y = (logit + rng.normal(0, 0.5, n) > 0).astype(np.float64)
+        return X, y
+
+    def test_scale_pos_weight_raises_recall(self):
+        X, y = self._imbalanced()
+        base = {"objective": "binary", "num_iterations": 30,
+                "num_leaves": 7, "min_data_in_leaf": 5}
+        b0 = train(dict(base), X, y)
+        b1 = train(dict(base, scale_pos_weight=8.0), X, y)
+        rec0 = ((b0.predict(X) > 0.5) & (y == 1)).sum() / max(y.sum(), 1)
+        rec1 = ((b1.predict(X) > 0.5) & (y == 1)).sum() / max(y.sum(), 1)
+        assert rec1 > rec0
+
+    def test_is_unbalance_matches_explicit_ratio(self):
+        X, y = self._imbalanced()
+        spw = float((y != 1).sum()) / float((y == 1).sum())
+        base = {"objective": "binary", "num_iterations": 10,
+                "num_leaves": 7, "min_data_in_leaf": 5}
+        b_auto = train(dict(base, is_unbalance=True), X, y)
+        b_spw = train(dict(base, scale_pos_weight=spw), X, y)
+        np.testing.assert_allclose(b_auto.predict(X), b_spw.predict(X),
+                                   rtol=1e-6)
+
+    def test_imbalance_validation(self):
+        X, y = self._imbalanced(n=100)
+        with pytest.raises(ValueError, match="not both"):
+            train({"objective": "binary", "num_iterations": 2,
+                   "is_unbalance": True, "scale_pos_weight": 2.0}, X, y)
+        with pytest.raises(ValueError, match="binary objective"):
+            train({"objective": "regression", "num_iterations": 2,
+                   "scale_pos_weight": 2.0}, X, y)
+
+    def test_init_score_residual_fit(self):
+        # a strong external margin: the booster only needs the residual,
+        # and its raw predictions EXCLUDE the margin (LightGBM semantics)
+        rng = np.random.default_rng(41)
+        X = rng.normal(0, 1, (500, 4))
+        margin = 3.0 * X[:, 0]
+        y = margin + np.sin(2 * X[:, 1]) + rng.normal(0, 0.1, 500)
+        b = train({"objective": "regression", "num_iterations": 40,
+                   "num_leaves": 15, "min_data_in_leaf": 5},
+                  X, y, init_score=margin)
+        resid_pred = b.predict(X, raw_score=True)
+        # model learned the residual, not the margin
+        r2_resid = 1 - np.var((y - margin) - resid_pred) \
+            / np.var(y - margin)
+        assert r2_resid > 0.8, r2_resid
+        full = margin + resid_pred
+        assert 1 - np.var(y - full) / np.var(y) > 0.95
+
+    def test_init_score_validation(self):
+        rng = np.random.default_rng(42)
+        X = rng.normal(0, 1, (100, 3))
+        y = X[:, 0]
+        with pytest.raises(ValueError, match="init_score shape"):
+            train({"objective": "regression", "num_iterations": 2}, X, y,
+                  init_score=np.zeros(50))
+        b = train({"objective": "regression", "num_iterations": 2}, X, y)
+        with pytest.raises(ValueError, match="warm-start"):
+            train({"objective": "regression", "num_iterations": 2}, X, y,
+                  init_model=b, init_score=np.zeros(100))
+
+    def test_init_score_with_valid_sets(self):
+        rng = np.random.default_rng(44)
+        X = rng.normal(0, 1, (500, 4))
+        margin = 2.0 * X[:, 0]
+        y = margin + np.sin(2 * X[:, 1]) + rng.normal(0, 0.1, 500)
+        with pytest.raises(ValueError, match="valid_init_scores"):
+            train({"objective": "regression", "num_iterations": 4},
+                  X[:400], y[:400], init_score=margin[:400],
+                  valid_sets=[(X[400:], y[400:])])
+        log = []
+        b = train({"objective": "regression", "num_iterations": 40,
+                   "num_leaves": 15, "min_data_in_leaf": 5,
+                   "early_stopping_round": 8},
+                  X[:400], y[:400], init_score=margin[:400],
+                  valid_sets=[(X[400:], y[400:])],
+                  valid_init_scores=[margin[400:]], eval_log=log)
+        # eval at the proper margin: the final validation loss is small
+        assert log[-1]["l2"] < 0.1, log[-1]
+        with pytest.raises(ValueError, match="checkpoints"):
+            train({"objective": "regression", "num_iterations": 2,
+                   "checkpoint_dir": "/tmp/nope"}, X, y, init_score=margin)
+
+    def test_is_unbalance_no_positives_rejected(self):
+        rng = np.random.default_rng(45)
+        X = rng.normal(0, 1, (100, 3))
+        y = np.zeros(100)
+        with pytest.raises(ValueError, match="no positive"):
+            train({"objective": "binary", "num_iterations": 2,
+                   "is_unbalance": True}, X, y)
+
+    def test_estimator_init_score_col(self):
+        from mmlspark_tpu.core import DataFrame
+        rng = np.random.default_rng(43)
+        X = rng.normal(0, 1, (300, 3)).astype(np.float32)
+        margin = 2.0 * X[:, 0].astype(np.float64)
+        y = margin + X[:, 1]
+        col = np.empty(300, dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y, "margin": margin})
+        from mmlspark_tpu.models.gbdt import LightGBMRegressor
+        m = LightGBMRegressor(num_iterations=25, num_leaves=15,
+                              min_data_in_leaf=5,
+                              init_score_col="margin").fit(df)
+        resid = np.asarray(m.transform(df)["prediction"], dtype=np.float64)
+        r2 = 1 - np.var((y - margin) - resid) / max(np.var(y - margin), 1e-9)
+        assert r2 > 0.7, r2
