@@ -275,6 +275,55 @@ class Booster:
         out.fit_params = self.fit_params
         return out
 
+    # -- introspection (parity: LightGBM Booster.trees_to_dataframe) --------
+    def trees_to_dataframe(self):
+        """Flatten the model into a row-per-node DataFrame: tree_index,
+        node_index (heap order), node_type (split/stub/leaf),
+        split_feature (-1 for stubs/leaves), threshold, split_gain,
+        count (training cover), class_index (-1 for structure rows),
+        value (per-class leaf outputs — multiclass emits one leaf row per
+        class). The debugging/analysis surface LightGBM exposes under the
+        same name; fully vectorized (a large model flattens in ms)."""
+        from ...core.dataframe import DataFrame
+        T = self.num_trees
+        n_leaf = 2 ** self.depth
+        n_int = n_leaf - 1
+        K = self.num_class if self.num_class > 1 else 1
+        feats = np.asarray(self.feats).reshape(T, n_int)
+        stub = feats.ravel() < 0
+        nan_if = lambda a: np.where(stub, np.nan, a)        # noqa: E731
+        internal = {
+            "tree_index": np.repeat(np.arange(T), n_int),
+            "node_index": np.tile(np.arange(n_int), T),
+            "node_type": np.where(stub, "stub", "split"),
+            "split_feature": feats.ravel(),
+            "threshold": nan_if(np.asarray(self.thr_raw).ravel()
+                                .astype(np.float64)),
+            "split_gain": nan_if(np.asarray(self.gains).ravel()
+                                 .astype(np.float64)),
+            "count": np.asarray(self.covers)[:, :n_int].ravel()
+                     .astype(np.float64),
+            "class_index": np.full(T * n_int, -1),
+            "value": np.full(T * n_int, np.nan),
+        }
+        lv = np.asarray(self.leaf_values).reshape(T, K, n_leaf)
+        leaf_cov = np.asarray(self.covers)[:, n_int:].astype(np.float64)
+        leaf = {
+            "tree_index": np.repeat(np.arange(T), K * n_leaf),
+            "node_index": np.tile(np.arange(n_int, n_int + n_leaf), T * K),
+            "node_type": np.full(T * K * n_leaf, "leaf"),
+            "split_feature": np.full(T * K * n_leaf, -1),
+            "threshold": np.full(T * K * n_leaf, np.nan),
+            "split_gain": np.full(T * K * n_leaf, np.nan),
+            "count": np.repeat(leaf_cov[:, None, :], K, axis=1).ravel(),
+            "class_index": np.tile(np.repeat(np.arange(K), n_leaf), T)
+                           if K > 1 else np.zeros(T * n_leaf, np.int64),
+            "value": lv.astype(np.float64).ravel(),
+        }
+        return DataFrame({k: np.concatenate([internal[k],
+                                             np.asarray(leaf[k])])
+                          for k in internal})
+
     # -- importances --------------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         imp = np.zeros(self.n_features)

@@ -434,3 +434,44 @@ class TestImbalanceAndInitScore:
         resid = np.asarray(m.transform(df)["prediction"], dtype=np.float64)
         r2 = 1 - np.var((y - margin) - resid) / max(np.var(y - margin), 1e-9)
         assert r2 > 0.7, r2
+
+
+def test_trees_to_dataframe():
+    rng = np.random.default_rng(50)
+    X = rng.normal(0, 1, (300, 4))
+    y = 2 * X[:, 0] + rng.normal(0, 0.2, 300)
+    b = train({"objective": "regression", "num_iterations": 3,
+               "num_leaves": 7, "min_data_in_leaf": 5}, X, y)
+    df = b.trees_to_dataframe()
+    n_int, n_leaf = b.feats.shape[1], 2 ** b.depth
+    assert len(df) == 3 * (n_int + n_leaf)
+    t0 = df.filter(np.asarray(df["tree_index"]) == 0)
+    # split rows carry real features/gains; stubs are NaN like leaves
+    splits = np.asarray(t0["node_type"]) == "split"
+    stubs = np.asarray(t0["node_type"]) == "stub"
+    leaves = np.asarray(t0["node_type"]) == "leaf"
+    assert splits.sum() >= 1 and leaves.sum() == n_leaf
+    assert (np.asarray(t0["split_feature"])[splits] >= 0).all()
+    thr = np.asarray(t0["threshold"], dtype=np.float64)
+    assert np.isfinite(thr[splits]).all()
+    if stubs.any():
+        assert np.isnan(thr[stubs]).all()
+    assert np.isfinite(np.asarray(t0["value"], dtype=np.float64)[leaves]).all()
+    # root cover counts every training row
+    assert float(np.asarray(t0["count"])[0]) == 300.0
+
+
+def test_trees_to_dataframe_multiclass():
+    rng = np.random.default_rng(51)
+    X = rng.normal(0, 1, (300, 4))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    b = train({"objective": "multiclass", "num_class": 3,
+               "num_iterations": 2, "num_leaves": 7,
+               "min_data_in_leaf": 5}, X, y)
+    df = b.trees_to_dataframe()
+    leaves = np.asarray(df["node_type"]) == "leaf"
+    classes = np.asarray(df["class_index"])[leaves]
+    # one leaf row per class, per-class values preserved (no cross-class sum)
+    assert set(classes.tolist()) == {0, 1, 2}
+    n_leaf = 2 ** b.depth
+    assert leaves.sum() == b.num_trees * 3 * n_leaf
