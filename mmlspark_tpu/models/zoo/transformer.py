@@ -365,9 +365,12 @@ def _sample_logits(logits, key, temperature: float, top_k: int,
 
 def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
              max_new_tokens: int = 32, temperature: float = 0.0,
-             seed: int = 0, top_k: int = 0, top_p: float = 1.0):
+             seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+             eos_id: Optional[int] = None):
     """Autoregressive generation from a causal config (greedy when
-    ``temperature == 0``, else softmax sampling).
+    ``temperature == 0``, else softmax sampling). ``eos_id``: rows that
+    emit it keep repeating it (static shapes — the convention the
+    continuous engine's per-request truncation builds on).
 
     One jitted program: the sequence is padded to prompt+new length and the
     whole forward runs each step — causality guarantees position ``t``'s
@@ -391,7 +394,8 @@ def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
     ids0 = jnp.pad(prompt_ids, ((0, 0), (0, max_new_tokens)))
     key0 = jax.random.PRNGKey(seed)
 
-    def step(ids, t):
+    def step(carry, t):
+        ids, done = carry
         hidden = transformer_apply(params, ids, cfg)
         logits = (hidden[:, t - 1].astype(jnp.float32)
                   @ params["lm_head"]["w"])
@@ -399,11 +403,15 @@ def generate(params: Dict, prompt_ids, cfg: TransformerConfig,
         # at the same emit position, keeping the two paths seed-compatible
         nxt = _sample_logits(logits, jax.random.fold_in(key0, t),
                              temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.full_like(nxt, eos_id), nxt)
+            done = done | (nxt == eos_id)
         ids = jax.lax.dynamic_update_slice(
             ids, nxt[:, None].astype(ids.dtype), (0, t))
-        return ids, nxt
+        return (ids, done), nxt
 
-    ids, _ = jax.lax.scan(step, ids0, jnp.arange(P_len, L))
+    (ids, _), _ = jax.lax.scan(step, (ids0, jnp.zeros(B, bool)),
+                               jnp.arange(P_len, L))
     return ids
 
 
@@ -629,11 +637,14 @@ def decode_window(params: Dict, tokens: jnp.ndarray, pos, cache,
 
 def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
                     max_new_tokens: int = 32, temperature: float = 0.0,
-                    seed: int = 0, top_k: int = 0, top_p: float = 1.0):
+                    seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                    eos_id: Optional[int] = None):
     """KV-cached :func:`generate`: O(L) attention per emitted token.
 
     The prompt prefills the cache token-by-token through the same
-    ``decode_step`` (a zoo model: simplicity over a batched prefill)."""
+    ``decode_step`` (a zoo model: simplicity over a batched prefill).
+    ``eos_id`` repeats after firing, token-compatible with
+    :func:`generate` (the key schedule is consumed identically)."""
     if not cfg.causal:
         raise ValueError("generate_cached() needs cfg.causal=True")
     params = jax.tree.map(jnp.asarray, params)
@@ -650,7 +661,7 @@ def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
     key0 = jax.random.PRNGKey(seed)
 
     def step(carry, t):
-        ids, cache = carry
+        ids, cache, done = carry
         token = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
         logits, cache = decode_step(params, token, t, cache, cfg)
         # keyed by EMIT position (t+1), matching generate() exactly —
@@ -659,14 +670,20 @@ def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
                              jax.random.fold_in(key0, t + 1),
                              temperature, top_k, top_p)
         # scan covers t = 0..L-2, so t+1 is always a valid position; only
-        # write past the prompt (prompt positions keep their tokens)
+        # emit past the prompt (prompt positions keep their tokens)
         keep = t + 1 >= P_len
+        if eos_id is not None:
+            # post-sampling override keeps the key schedule identical to
+            # the no-eos run (and to generate())
+            nxt = jnp.where(done & keep, jnp.full_like(nxt, eos_id), nxt)
+            done = done | (keep & (nxt == eos_id))
         cur = jax.lax.dynamic_slice_in_dim(ids, t + 1, 1, axis=1)[:, 0]
         upd = jnp.where(keep, nxt.astype(ids.dtype), cur)
         ids = jax.lax.dynamic_update_slice(ids, upd[:, None], (0, t + 1))
-        return (ids, cache), None
+        return (ids, cache, done), None
 
-    (ids, _), _ = jax.lax.scan(step, (ids0, cache), jnp.arange(L - 1))
+    (ids, _, _), _ = jax.lax.scan(step, (ids0, cache, jnp.zeros(B, bool)),
+                                  jnp.arange(L - 1))
     # the final position's token comes from the last step's write; the scan
     # covers t = 0..L-2, emitting into positions P_len..L-1
     return ids

@@ -544,3 +544,32 @@ class TestPrefixCaching:
         with pytest.raises(ValueError, match="must be a string"):
             eng.submit(np.arange(4) % CFG.vocab, max_new_tokens=2,
                        prefix_key=["a"])
+
+
+class TestGenerateEos:
+    def test_eos_repeats_and_paths_agree(self, params):
+        from mmlspark_tpu.models.zoo.transformer import generate
+        rng = np.random.default_rng(60)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 5)))
+        # pick the greedy first token of row 0 as the eos: it must fire
+        base = np.asarray(generate_cached(params, prompt, CFG,
+                                          max_new_tokens=8))
+        eos = int(base[0, 5])
+        a = np.asarray(generate(params, prompt, CFG, max_new_tokens=8,
+                                eos_id=eos))
+        b = np.asarray(generate_cached(params, prompt, CFG,
+                                       max_new_tokens=8, eos_id=eos))
+        np.testing.assert_array_equal(a, b)      # paths stay compatible
+        assert (a[0, 5:] == eos).all()           # fired at first emit
+        # rows that never hit eos match the unconstrained run
+        if not (base[1, 5:] == eos).any():
+            np.testing.assert_array_equal(a[1], base[1])
+
+    def test_eos_none_unchanged(self, params):
+        rng = np.random.default_rng(61)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (1, 4)))
+        a = np.asarray(generate_cached(params, prompt, CFG,
+                                       max_new_tokens=6))
+        b = np.asarray(generate_cached(params, prompt, CFG,
+                                       max_new_tokens=6, eos_id=None))
+        np.testing.assert_array_equal(a, b)
