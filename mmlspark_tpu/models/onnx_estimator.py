@@ -64,6 +64,13 @@ class ONNXEstimator(Estimator):
     batch_size = Param(int, default=64, doc="rows per training step")
     shuffle = Param(bool, default=True, doc="reshuffle rows every epoch")
     seed = Param(int, default=0, doc="shuffle seed")
+    validation_indicator_col = Param(str, default=None,
+                                     doc="bool column marking validation "
+                                         "rows (enables early stopping)")
+    early_stopping_epochs = Param(int, default=0,
+                                  doc="stop after this many epochs without "
+                                      "validation-loss improvement (0 = "
+                                      "off); the best epoch's params win")
     trainable_prefix = Param((list, str), default=[],
                              doc="train only params whose name starts "
                                  "with one of these (empty = all); the "
@@ -134,8 +141,25 @@ class ONNXEstimator(Estimator):
         feeds_cols: Dict[str, np.ndarray] = {
             inp: self._column_feed(df, col)
             for inp, col in self.feed_dict.items()}
+        vcol = self.get_or_none("validation_indicator_col")
+        val_feeds = None
+        y_val = None
+        if vcol and vcol not in df:
+            # silent fallthrough would TRAIN on the intended holdout rows
+            raise ValueError(f"validation_indicator_col {vcol!r} not in "
+                             f"the frame (columns: {list(df.columns)})")
+        if vcol:
+            mask = np.asarray(df[vcol], dtype=bool)
+            val_feeds = {k: v[mask] for k, v in feeds_cols.items()}
+            y_val = np.asarray(df[self.label_col])[mask]
+            feeds_cols = {k: v[~mask] for k, v in feeds_cols.items()}
+            df = df.filter(~mask)
         y = np.asarray(df[self.label_col])
         n = len(df)
+        patience = int(self.early_stopping_epochs)
+        if patience and val_feeds is None:
+            raise ValueError("early_stopping_epochs needs "
+                             "validation_indicator_col rows")
         if n < int(self.batch_size):
             raise ValueError(
                 f"fewer rows ({n}) than batch_size ({self.batch_size}); "
@@ -163,9 +187,28 @@ class ONNXEstimator(Estimator):
         params = {k: jnp.asarray(v) for k, v in cm.params.items()}
         opt_state = init(params)
 
+        val_loss_fn = None
+        if val_feeds is not None:
+            # whole-validation loss in one jitted call per epoch; the
+            # validation data travels as jit ARGUMENTS (a closure would
+            # bake it into the compiled program as constants)
+            @jax.jit
+            def _val_loss(params, feeds):
+                if loss_output is not None:
+                    return cm(params, feeds)[loss_output]
+                return loss_fn(cm(params, feeds), feeds)
+
+            _vf = dict(val_feeds)
+            _vf[label_input if loss_output is not None
+                else "__labels__"] = y_val
+            val_loss_fn = lambda params: _val_loss(params, _vf)  # noqa: E731
+
         bs = int(self.batch_size)
         rng = np.random.default_rng(int(self.seed))
         log = getattr(self, "_eval_log", None)
+        best_val = np.inf
+        best_params = None
+        since_best = 0
         for ep in range(int(self.epochs)):
             # full batches only: each distinct batch shape is its own XLA
             # compile. Shuffled epochs fold the trailing remainder into the
@@ -185,6 +228,22 @@ class ONNXEstimator(Estimator):
                 params, opt_state, val = step(params, opt_state, feeds)
                 if log is not None:
                     log.append(float(val))
+            if val_feeds is not None:
+                vl = float(val_loss_fn(params))
+                if log is not None:
+                    log.append({"epoch": ep, "val_loss": vl})
+                if vl < best_val - 1e-12:
+                    best_val = vl
+                    since_best = 0
+                    if patience:
+                        best_params = {k: np.asarray(v)
+                                       for k, v in params.items()}
+                else:
+                    since_best += 1
+                    if patience and since_best >= patience:
+                        break
+        if best_params is not None:
+            params = best_params
 
         buf = io.BytesIO()
         np.savez(buf, **{k: np.asarray(v) for k, v in params.items()})

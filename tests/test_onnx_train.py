@@ -277,3 +277,45 @@ class TestFineTune:
         assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
         logits = np.asarray(cm(params, {"x": X})["logits"])
         assert (logits.argmax(1) == y).mean() > 0.8
+
+
+class TestEstimatorEarlyStopping:
+    def _df(self, n=200, seed=20, val_frac=0.25):
+        from mmlspark_tpu.core import DataFrame
+        X, y = toy_data(n, seed=seed)
+        col = np.empty(n, dtype=object)
+        col[:] = list(X)
+        val = np.zeros(n, bool)
+        val[int(n * (1 - val_frac)):] = True
+        return DataFrame({"features": col, "label": y, "val": val}), X, y, val
+
+    def test_early_stop_uses_best_epoch(self):
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        df, X, y, val = self._df()
+        log = []
+        est = ONNXEstimator(mlp_with_loss(),
+                            feed_dict={"x": "features"},
+                            loss_output="loss", label_input="labels",
+                            validation_indicator_col="val",
+                            early_stopping_epochs=3,
+                            epochs=200, batch_size=32,
+                            learning_rate=0.1, eval_log=log)
+        model = est.fit(df)
+        epochs = [e for e in log if isinstance(e, dict)]
+        assert 0 < len(epochs) < 200          # stopped early
+        # the fitted model scores the holdout at (near) the best val loss
+        out = model.transform(df.filter(val))
+        logits = np.stack([np.asarray(v) for v in out["logits"]])
+        acc = (logits.argmax(1) == y[val]).mean()
+        assert acc > 0.8, acc
+
+    def test_patience_without_val_col_rejected(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        df, *_ = self._df()
+        df = df.drop("val")
+        with pytest.raises(ValueError, match="validation_indicator_col"):
+            ONNXEstimator(mlp_with_loss(), feed_dict={"x": "features"},
+                          loss_output="loss", label_input="labels",
+                          early_stopping_epochs=2, epochs=3,
+                          batch_size=32).fit(df)
