@@ -17,8 +17,32 @@ set -u
 OUT="${1:-/tmp/tpu_bench_results.jsonl}"
 cd "$(dirname "$0")/.."
 
+# $OUT is APPEND-ONLY across retries: a mid-campaign abort (exit 3) makes
+# chip_campaign_loop.sh re-run the whole campaign in the next healthy
+# window, so stages that already succeeded get a second JSON line —
+# consumers read the last (or best tpu-labeled) record per metric.
+GATED_ONCE=0
 run() {
     name="$1"; shift
+    # re-gate before every stage: the chip can wedge MID-campaign (it did
+    # at 03:43 on 2026-07-31), and each wedged stage would hang ~25-50 min
+    # inside backend init before dying. Between stages the claim is free,
+    # so a cheap non-wedging probe (scripts/probe_chip.py — shared with
+    # chip_campaign_loop.sh) is accurate; a failed gate aborts the
+    # remaining stages and hands control back to the loop. The stage right
+    # after the headline skips the gate — the headline's own three-
+    # condition check just proved the chip.
+    if [ "${CAMPAIGN_GATES:-1}" = "1" ] && [ "$name" != "headline" ]; then
+        if [ "$GATED_ONCE" = "0" ]; then
+            GATED_ONCE=1
+        else
+            gate=$(python scripts/probe_chip.py 2>> "$OUT.log") || gate=error
+            if [ "$gate" != "tpu" ]; then
+                echo "(gate before $name: probe=$gate — aborting campaign $(date -u +%H:%M:%SZ))" >> "$OUT.log"
+                exit 3
+            fi
+        fi
+    fi
     echo "=== $name $(date -u +%H:%M:%SZ) ===" >> "$OUT.log"
     # JSON lines to $OUT; human log (incl. stderr diagnostics) to $OUT.log.
     # A real pipeline (not process substitution) so bash waits for the

@@ -32,20 +32,9 @@ while [ "$attempt" -lt "$MAX" ]; do
     # atomic result file, never killed). A wedged claim costs ~2 min here
     # vs ~10 min of degraded bench.py, so the loop samples the chip ~3x
     # more often and a short healthy window is less likely to be missed.
-    # BENCH_PROBE_WINDOW (bench.py's documented knob) is honored;
-    # CHIP_PROBE_WINDOW overrides just the gate.
-    probe=$(python - 2>> "$OUT.log" <<'PY'
-import os
-import bench
-window = float(os.environ.get("CHIP_PROBE_WINDOW",
-                              os.environ.get("BENCH_PROBE_WINDOW", "120")))
-platform, kind, info = bench._probe_default_backend(window)
-import sys
-print(f"gate probe: platform={platform} kind={kind} "
-      f"reason={info.get('reason')!r}", file=sys.stderr)
-print(platform or "none")
-PY
-    ) || probe=error
+    # Window chain (CHIP_PROBE_WINDOW → BENCH_PROBE_WINDOW → 120) and
+    # diagnostics live in the shared scripts/probe_chip.py.
+    probe=$(python scripts/probe_chip.py 2>> "$OUT.log") || probe=error
     echo "--- attempt $attempt/$MAX probe=$probe $(date -u) ---" >> "$OUT.log"
     if [ "$probe" = "tpu" ]; then
         bash scripts/bench_all_tpu.sh "$OUT"

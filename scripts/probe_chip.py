@@ -1,0 +1,36 @@
+"""One non-wedging chip probe, shared by every gate.
+
+Prints the platform string ("tpu" / "cpu" / "none") on stdout and the
+probe diagnostics (hang vs crash reason) on stderr, so callers can log
+both. Window resolution: CHIP_PROBE_WINDOW → BENCH_PROBE_WINDOW → 120 s
+— the one chain every gate honors (divergent hand-rolled copies of this
+snippet previously ignored the documented knob).
+
+Exit code is always 0; the caller branches on stdout (a crash in HERE
+must read as an environment error, not as a wedged chip).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    try:
+        import bench
+        window = float(os.environ.get(
+            "CHIP_PROBE_WINDOW",
+            os.environ.get("BENCH_PROBE_WINDOW", "120")))
+        platform, kind, info = bench._probe_default_backend(window)
+        print(f"probe: platform={platform} kind={kind} "
+              f"reason={info.get('reason')!r}", file=sys.stderr)
+        print(platform or "none")
+    except Exception as e:              # noqa: BLE001
+        print(f"probe harness error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        print("error")
+
+
+if __name__ == "__main__":
+    main()
