@@ -338,63 +338,114 @@ class ContinuousDecoder:
 
     # ---- engine ----
     def _admit(self):
-        """Move waiting requests into free slots (prefill + insert)."""
+        """Move waiting requests into free slots.
+
+        Plain requests admitted in the same tick BATCH their prefill:
+        same-bucket prompts run as one multi-row ``prefill_cache`` call
+        instead of one call per request — outputs are unchanged because
+        prefill rows are independent. The row dimension pads to a power
+        of two so a pool of S slots compiles at most log2(S)+1 prefill
+        programs per prompt bucket (a per-group-size shape would compile
+        on every distinct burst size). Prefix-cache requests keep the
+        individual path (their suffix windows and store bookkeeping are
+        per-request)."""
         while True:
             with self._lock:
                 free = [i for i in range(self._S)
                         if self._slot_req[i] is None]
-                if not free or not self._waiting:
-                    return
-                slot = free[0]
-                req = self._waiting.pop(0)
-                self._slot_req[slot] = req
-            P = req.prompt.size
-            try:
-                logits, row_cache = self._prompt_cache_for(req, P)
-            except ValueError as e:
-                # request-level validation (e.g. prefix mismatch) fails
-                # ALONE: slot freed, waiter woken with the error, engine
-                # keeps serving (generation.py's 'malformed field must not
-                # poison the batch' contract). Runtime/device errors are
-                # NOT caught — they propagate to the driver loop's
-                # recovery path (500 in-flight, cancel_all, back off).
-                req.error = e
-                req.done = True
-                req.finished_at = time.perf_counter()
-                req.event.set()
-                self._release(slot)
-                continue
-            base_key = jax.random.PRNGKey(req.seed)
-            if req.temperature > 0.0:
-                # exact generate_cached schedule: the token at position P
-                # is sampled with fold_in(key0, P)
-                first = _sample_logits(
-                    logits.astype(jnp.float32),
-                    jax.random.fold_in(base_key, P),
-                    req.temperature, req.top_k, req.top_p)[0]
-                first = first.astype(jnp.int32)
-            else:
-                first = jnp.argmax(logits[0]).astype(jnp.int32)
-            sample_state = (self._temp, self._topk, self._topp, self._key)
-            sample_row = (jnp.float32(req.temperature),
-                          jnp.int32(req.top_k), jnp.float32(req.top_p),
-                          base_key.astype(jnp.uint32))
-            (self._cache, self._tok, self._pos, self._active,
-             sample_state) = self._insert(
-                self._cache, slot, row_cache, self._tok, self._pos,
-                self._active, first, jnp.int32(P), sample_state,
-                sample_row)
-            self._temp, self._topk, self._topp, self._key = sample_state
-            # the prefill itself emitted the first new token
-            self._note_token(req, int(first))
-            if req.done:
-                self._release(slot)
+                batch = []
+                while free and self._waiting:
+                    slot = free.pop(0)
+                    req = self._waiting.pop(0)
+                    self._slot_req[slot] = req
+                    batch.append((slot, req))
+            if not batch:
+                return
+            plain = [(s, r) for s, r in batch if r.prefix_key is None]
+            prefixed = [(s, r) for s, r in batch
+                        if r.prefix_key is not None]
+
+            # grouped plain prefill, one call per pad bucket
+            by_bucket: Dict[int, list] = {}
+            for s, r in plain:
+                by_bucket.setdefault(self._bucket(r.prompt.size),
+                                     []).append((s, r))
+            for padded, group in by_bucket.items():
+                k = 1 << (len(group) - 1).bit_length()   # row pad: 2^m
+                ids = np.zeros((k, padded), np.int32)
+                lengths = np.ones(k, np.int32)           # pad rows: len 1
+                for i, (_, r) in enumerate(group):
+                    ids[i, :r.prompt.size] = r.prompt
+                    lengths[i] = r.prompt.size
+                logits, row_cache = self._prefill(
+                    self._params, jnp.asarray(ids), jnp.asarray(lengths))
+                self.stats["prefills"] += 1
+                # slice every row BEFORE inserting: _insert donates its
+                # row cache, and slices of a donated parent are invalid
+                rows = [[{kk: c[kk][i:i + 1] for kk in ("k", "v")}
+                         for c in row_cache] for i in range(len(group))]
+                for i, (slot, req) in enumerate(group):
+                    self._insert_row(slot, req, logits[i:i + 1], rows[i])
+
+            for slot, req in prefixed:
+                try:
+                    logits, row_cache = self._prompt_cache_for(
+                        req, req.prompt.size)
+                except ValueError as e:
+                    # request-level validation (e.g. prefix mismatch)
+                    # fails ALONE: slot freed, waiter woken with the
+                    # error, engine keeps serving (generation.py's
+                    # 'malformed field must not poison the batch'
+                    # contract). Runtime/device errors are NOT caught —
+                    # they propagate to the driver loop's recovery path.
+                    req.error = e
+                    req.done = True
+                    req.finished_at = time.perf_counter()
+                    req.event.set()
+                    self._release(slot)
+                    continue
+                self._insert_row(slot, req, logits, row_cache)
+            # loop: slots may have freed (eos/max_new on the first token)
+            # while waiters remain — constant stack, unlike recursion
+
+    def _insert_row(self, slot: int, req: _Request, logits, row_cache):
+        """First-token sampling + slot insertion for one admitted row."""
+        P = req.prompt.size
+        base_key = jax.random.PRNGKey(req.seed)
+        if req.temperature > 0.0:
+            # exact generate_cached schedule: the token at position P
+            # is sampled with fold_in(key0, P)
+            first = _sample_logits(
+                logits.astype(jnp.float32),
+                jax.random.fold_in(base_key, P),
+                req.temperature, req.top_k, req.top_p)[0]
+            first = first.astype(jnp.int32)
+        else:
+            first = jnp.argmax(logits[0]).astype(jnp.int32)
+        sample_state = (self._temp, self._topk, self._topp, self._key)
+        sample_row = (jnp.float32(req.temperature),
+                      jnp.int32(req.top_k), jnp.float32(req.top_p),
+                      base_key.astype(jnp.uint32))
+        (self._cache, self._tok, self._pos, self._active,
+         sample_state) = self._insert(
+            self._cache, slot, row_cache, self._tok, self._pos,
+            self._active, first, jnp.int32(P), sample_state,
+            sample_row)
+        self._temp, self._topk, self._topp, self._key = sample_state
+        # the prefill itself emitted the first new token
+        self._note_token(req, int(first))
+        if req.done:
+            self._release(slot)
+
+    def _bucket(self, n: int, cap: Optional[int] = None) -> int:
+        """THE pad-bucket policy (batched admission, prefix suffix
+        windows, and single prefills all share it)."""
+        return min(cap if cap is not None else self._L,
+                   max(8, bucket_size(n)))
 
     def _padded_ids(self, tokens: np.ndarray, cap: int) -> np.ndarray:
-        """(1, bucketed) right-padded id row — one bucketing policy for
-        the prefill and suffix-window paths."""
-        padded = min(cap, max(8, bucket_size(tokens.size)))
-        ids = np.zeros((1, padded), np.int32)
+        """(1, bucketed) right-padded id row."""
+        ids = np.zeros((1, self._bucket(tokens.size, cap)), np.int32)
         ids[0, :tokens.size] = tokens
         return ids
 
