@@ -152,18 +152,40 @@ class Booster:
             X = self.cat_encoder.transform(np.asarray(X))
         return np.asarray(X, dtype=np.float32)
 
-    def raw_score(self, X: np.ndarray) -> np.ndarray:
+    def _tree_cap(self, num_iteration: Optional[int]) -> int:
+        """Trees used for a ``num_iteration`` predict cap (LightGBM
+        semantics: None = the early-stopped ``best_iteration`` when one
+        exists, else all; <= 0 = all; multiclass counts ITERATIONS, each
+        num_class trees). ``best_iteration`` is ABSOLUTE (warm-start init
+        iterations included)."""
+        if num_iteration is None:
+            it = self.best_iteration if self.best_iteration > 0 else 0
+        elif num_iteration <= 0:
+            it = 0
+        else:
+            it = int(num_iteration)
+        if not it:
+            return self.num_trees
+        k = self.num_class if self.num_class > 1 else 1
+        return min(self.num_trees, it * k)
+
+    def raw_score(self, X: np.ndarray,
+                  num_iteration: Optional[int] = None) -> np.ndarray:
         X = self._x_eff(X)
-        if self.num_trees == 0:
+        T = self._tree_cap(num_iteration)
+        if T == 0:
             shape = (X.shape[0], self.num_class) if self.num_class > 1 \
                 else (X.shape[0],)
             return np.full(shape, self.base_score, dtype=np.float32)
-        out = predict_trees_any(self.feats, self.thr_raw, self.leaf_values,
-                                X, depth=self.depth)
+        out = predict_trees_any(self.feats[:T], self.thr_raw[:T],
+                                self.leaf_values[:T], X, depth=self.depth)
         return np.asarray(out) + self.base_score
 
-    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
-        raw = self.raw_score(X)
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                num_iteration: Optional[int] = None) -> np.ndarray:
+        """``num_iteration``: predict with the first k iterations only
+        (LightGBM's knob; -1 = the early-stopped best_iteration)."""
+        raw = self.raw_score(X, num_iteration=num_iteration)
         if raw_score:
             return raw
         from .objectives import get_objective

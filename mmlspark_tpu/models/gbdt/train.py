@@ -792,8 +792,11 @@ def train(params: Dict,
         # never executed, so keep the restored booster's best_iteration
         pass
     else:
-        booster.best_iteration = best_iter if valid_sets \
-            else resumed_iters + n_iter
+        # ABSOLUTE iterations (warm-start init included): predict's
+        # num_iteration cap slices the whole-model tree prefix
+        booster.best_iteration = (init_trees // K_trees + best_iter
+                                  if valid_sets
+                                  else resumed_iters + n_iter)
     if patience and best_model is not None:
         # dart reaching the iteration budget without the patience branch
         # firing: later drop rounds rescaled the best iteration's trees in

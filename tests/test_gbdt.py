@@ -475,3 +475,29 @@ def test_trees_to_dataframe_multiclass():
     assert set(classes.tolist()) == {0, 1, 2}
     n_leaf = 2 ** b.depth
     assert leaves.sum() == b.num_trees * 3 * n_leaf
+
+
+def test_predict_num_iteration_cap():
+    rng = np.random.default_rng(52)
+    X = rng.normal(0, 1, (300, 4))
+    y = 2 * X[:, 0] + rng.normal(0, 0.2, 300)
+    b = train({"objective": "regression", "num_iterations": 20,
+               "num_leaves": 7, "min_data_in_leaf": 5}, X, y)
+    full = b.predict(X)
+    k5 = b.predict(X, num_iteration=5)
+    np.testing.assert_allclose(k5, b.truncated(5).predict(X), rtol=1e-6)
+    assert np.abs(full - k5).max() > 0
+    np.testing.assert_allclose(b.predict(X, num_iteration=0), full)
+    np.testing.assert_allclose(b.predict(X, num_iteration=-1), full)
+    # LightGBM semantics: None uses best_iteration when one exists
+    b.best_iteration = 7
+    np.testing.assert_allclose(b.predict(X),
+                               b.truncated(7).predict(X), rtol=1e-6)
+    np.testing.assert_allclose(b.predict(X, num_iteration=0), full)
+    # multiclass counts iterations, not trees
+    ym = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    bm = train({"objective": "multiclass", "num_class": 3,
+                "num_iterations": 6, "num_leaves": 7,
+                "min_data_in_leaf": 5}, X, ym)
+    np.testing.assert_allclose(bm.predict(X, num_iteration=2),
+                               bm.truncated(6).predict(X), rtol=1e-6)
