@@ -68,6 +68,13 @@ class Booster:
         #: training hyperparams refit() needs on the same scale
         #: (learning_rate, lambda_l2); stamped by train(), serialized
         self.fit_params = None
+        #: linear trees (LightGBM linear_tree): per-leaf ridge coefficients
+        #: over the leaf's path features — {"coefs": (T, 2^D, D+1),
+        #: "pf": (T, 2^D, D)} or None for constant-leaf models. When set,
+        #: prediction evaluates the leaf's linear model; leaf_values hold
+        #: the constant fallback (the coefs' bias) for introspection only.
+        self._lin_base = None
+        self._lin_pending: List[tuple] = []
 
     # -- bookkeeping --------------------------------------------------------
     _FIELDS = ("feats", "thr_raw", "leaf_values", "gains", "covers")
@@ -79,6 +86,19 @@ class Booster:
                     [self._base[name]] + [np.asarray(p[i])[None]
                                           for p in self._pending])
             self._pending = []
+        if self._lin_pending:
+            parts = {
+                "coefs": [np.asarray(p[0])[None] for p in self._lin_pending],
+                "pf": [np.asarray(p[1])[None] for p in self._lin_pending],
+            }
+            if self._lin_base is None:
+                self._lin_base = {k: np.concatenate(v)
+                                  for k, v in parts.items()}
+            else:
+                self._lin_base = {
+                    k: np.concatenate([self._lin_base[k]] + parts[k])
+                    for k in parts}
+            self._lin_pending = []
 
     def __getattr__(self, name):
         if name in Booster._FIELDS:
@@ -87,18 +107,39 @@ class Booster:
         raise AttributeError(name)
 
     @property
+    def is_linear(self) -> bool:
+        """True for linear-leaf models (LightGBM ``linear_tree``)."""
+        return self._lin_base is not None or bool(self._lin_pending)
+
+    @property
+    def linear(self) -> Optional[Dict]:
+        self._materialize()
+        return self._lin_base
+
+    @property
     def num_trees(self) -> int:
         return len(self._base["feats"]) + len(self._pending)
 
-    def append_tree(self, feat, thr_raw, leaf_value, gain, cover):
+    def append_tree(self, feat, thr_raw, leaf_value, gain, cover,
+                    coefs=None, pf=None):
+        if (coefs is None) != (pf is None) \
+                or ((coefs is None) and self.is_linear) \
+                or (coefs is not None and self.num_trees and not self.is_linear):
+            raise ValueError("a booster is linear for all trees or none")
         self._pending.append((feat, thr_raw, leaf_value, gain, cover))
+        if coefs is not None:
+            self._lin_pending.append((coefs, pf))
 
     def scale_trees(self, idx, factor: float) -> None:
-        """Multiply the leaf values of trees ``idx`` in place (DART's
-        k/(k+1) re-weighting of dropped trees)."""
+        """Multiply the leaf outputs of trees ``idx`` in place (DART's
+        k/(k+1) re-weighting of dropped trees). Linear leaves scale their
+        whole coefficient vector — the output is linear in it."""
         self._materialize()
         lv = self._base["leaf_values"]
         lv[np.asarray(idx, dtype=np.int64)] *= np.float32(factor)
+        if self._lin_base is not None:
+            self._lin_base["coefs"][np.asarray(idx, dtype=np.int64)] *= \
+                np.float32(factor)
 
     def truncated(self, n_trees: int) -> "Booster":
         """Model truncated to the first n_trees (early-stopping cutoff).
@@ -114,11 +155,17 @@ class Booster:
                     self.covers[:n_trees].copy(), best_iteration=n_trees)
         b.cat_encoder = self.cat_encoder  # trees split in the encoded space
         b.fit_params = self.fit_params
+        if self.is_linear:
+            lin = self.linear
+            b._lin_base = {k: lin[k][:n_trees].copy() for k in lin}
         return b
 
     def merge(self, other: "Booster") -> "Booster":
         """Concatenate trees (parity: mergeBooster for numBatches training)."""
         assert self.depth == other.depth and self.num_class == other.num_class
+        if self.is_linear != other.is_linear:
+            raise ValueError("cannot merge a linear-tree booster with a "
+                             "constant-leaf booster")
         merged = Booster(
             self.depth, self.n_features, self.objective, self.base_score,
             self.num_class,
@@ -129,6 +176,9 @@ class Booster:
             np.concatenate([self.covers, other.covers]))
         merged.cat_encoder = self.cat_encoder
         merged.fit_params = self.fit_params
+        if self.is_linear:
+            a, b = self.linear, other.linear
+            merged._lin_base = {k: np.concatenate([a[k], b[k]]) for k in a}
         return merged
 
     # -- prediction ---------------------------------------------------------
@@ -177,8 +227,15 @@ class Booster:
             shape = (X.shape[0], self.num_class) if self.num_class > 1 \
                 else (X.shape[0],)
             return np.full(shape, self.base_score, dtype=np.float32)
-        out = predict_trees_any(self.feats[:T], self.thr_raw[:T],
-                                self.leaf_values[:T], X, depth=self.depth)
+        if self.is_linear:
+            from .trees import predict_trees_linear_any
+            lin = self.linear
+            out = predict_trees_linear_any(
+                self.feats[:T], self.thr_raw[:T], lin["coefs"][:T],
+                lin["pf"][:T], X, depth=self.depth)
+        else:
+            out = predict_trees_any(self.feats[:T], self.thr_raw[:T],
+                                    self.leaf_values[:T], X, depth=self.depth)
         return np.asarray(out) + self.base_score
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
@@ -207,6 +264,10 @@ class Booster:
         LightGBM's predict_contrib emits)."""
         from .treeshap import tree_shap
         from .binning import is_sparse
+        if self.is_linear:
+            raise NotImplementedError(
+                "TreeSHAP over linear leaves is not defined (LightGBM "
+                "rejects predict_contrib for linear_tree models too)")
         X = self._x_eff(X)
         if is_sparse(X):
             # the SHAP recursion walks every tree per row anyway — densify
@@ -250,6 +311,10 @@ class Booster:
         """
         if self.num_class > 1:
             raise NotImplementedError("refit for multiclass boosters")
+        if self.is_linear:
+            raise NotImplementedError(
+                "refit re-estimates constant leaf values; linear leaves "
+                "need a full linear refit (retrain instead)")
         if not 0.0 <= decay_rate <= 1.0:
             raise ValueError(f"decay_rate must be in [0, 1], got {decay_rate}")
         fp = getattr(self, "fit_params", None) or {}
@@ -361,9 +426,13 @@ class Booster:
     # -- persistence (parity: saveToString / loadFromString) ----------------
     def to_string(self) -> str:
         buf = io.BytesIO()
-        np.savez_compressed(buf, feats=self.feats, thr_raw=self.thr_raw,
-                            leaf_values=self.leaf_values, gains=self.gains,
-                            covers=self.covers)
+        arrays = dict(feats=self.feats, thr_raw=self.thr_raw,
+                      leaf_values=self.leaf_values, gains=self.gains,
+                      covers=self.covers)
+        if self.is_linear:
+            arrays["lin_coefs"] = self.linear["coefs"]
+            arrays["lin_pf"] = self.linear["pf"]
+        np.savez_compressed(buf, **arrays)
         meta = {"depth": self.depth, "n_features": self.n_features,
                 "objective": self.objective, "base_score": self.base_score,
                 "num_class": self.num_class,
@@ -386,6 +455,9 @@ class Booster:
                     arrays["feats"], arrays["thr_raw"],
                     arrays["leaf_values"], arrays["gains"],
                     arrays["covers"], meta["best_iteration"])
+        if "lin_coefs" in arrays:
+            b._lin_base = {"coefs": arrays["lin_coefs"],
+                           "pf": arrays["lin_pf"]}
         if "cat_encoder" in meta:
             from .categorical import CategoricalEncoder
             b.cat_encoder = CategoricalEncoder.from_dict(meta["cat_encoder"])

@@ -28,11 +28,30 @@ __all__ = ["CategoricalEncoder"]
 
 
 class CategoricalEncoder:
-    """Label-ordered rank encoding of selected feature columns."""
+    """Label-ordered rank encoding of selected feature columns.
 
-    def __init__(self, feature_indices: Sequence[int]):
+    Regularization knobs mirror LightGBM's categorical parameters in this
+    static setting: ``cat_smooth`` smooths each category's target mean
+    toward the global mean with that many pseudo-counts (rare categories'
+    noisy means stop dominating the ordering), and ``min_data_per_group``
+    pools categories rarer than the threshold into one shared rank — a
+    threshold split can then never isolate them, the analog of LightGBM
+    refusing per-category treatment below its group-size floor. Because
+    this pooling is GLOBAL (LightGBM's is per-node, far weaker), it
+    defaults to off here — a deliberate deviation from LightGBM's 100.
+    Pooling also skips when every category is rare (nothing to pool into).
+    """
+
+    def __init__(self, feature_indices: Sequence[int],
+                 cat_smooth: float = 10.0, min_data_per_group: int = 0):
         self.feature_indices: List[int] = sorted(int(i)
                                                  for i in set(feature_indices))
+        if cat_smooth < 0:
+            raise ValueError("cat_smooth must be >= 0")
+        if min_data_per_group < 0:
+            raise ValueError("min_data_per_group must be >= 0")
+        self.cat_smooth = float(cat_smooth)
+        self.min_data_per_group = int(min_data_per_group)
         #: per feature: category values sorted ascending (lookup keys)
         self.values: List[np.ndarray] = []
         #: per feature: rank of each value under the label ordering
@@ -48,12 +67,20 @@ class CategoricalEncoder:
             uniq, inv = np.unique(col[ok], return_inverse=True)
             sums = np.bincount(inv, weights=y[ok], minlength=len(uniq))
             cnts = np.bincount(inv, minlength=len(uniq)).astype(np.float64)
-            mean = sums / np.maximum(cnts, 1.0)
-            order = np.argsort(mean, kind="stable")
-            rank = np.empty(len(uniq), dtype=np.float64)
-            rank[order] = np.arange(len(uniq), dtype=np.float64)
+            gmean = float(y[ok].mean()) if ok.any() else 0.0
+            mean = ((sums + self.cat_smooth * gmean)
+                    / np.maximum(cnts + self.cat_smooth, 1e-12))
+            if self.min_data_per_group > 0:
+                rare = cnts < self.min_data_per_group
+                if rare.any() and not rare.all():
+                    pooled = ((sums[rare].sum() + self.cat_smooth * gmean)
+                              / (cnts[rare].sum() + self.cat_smooth))
+                    mean[rare] = pooled
+            # equal means share one rank (pooled/tied categories become
+            # inseparable by any threshold split)
+            _, rank = np.unique(mean, return_inverse=True)
             self.values.append(uniq)
-            self.ranks.append(rank)
+            self.ranks.append(rank.astype(np.float64))
         return self
 
     # -- transform ----------------------------------------------------------

@@ -102,6 +102,40 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     init_score_col = Param(str, default=None,
                            doc="per-row starting margin column (LightGBM "
                                "initScoreCol); predictions exclude it")
+    extra_trees = Param(bool, default=False,
+                        doc="extremely randomized trees: one random "
+                            "threshold candidate per node x feature "
+                            "(LightGBM extra_trees)")
+    feature_fraction_bynode = Param(float, default=1.0,
+                                    doc="feature subsample drawn per NODE "
+                                        "(LightGBM feature_fraction_bynode)")
+    path_smooth = Param(float, default=0.0,
+                        doc="smooth node outputs toward the parent's with "
+                            "this many pseudo-counts (LightGBM path_smooth)")
+    boost_from_average = Param(bool, default=True,
+                               doc="start boosting from the objective's "
+                                   "optimal constant (LightGBM "
+                                   "boost_from_average)")
+    interaction_constraints = Param((list, list), default=[],
+                                    doc="allowed feature groups; a branch "
+                                        "only combines features sharing a "
+                                        "group (LightGBM "
+                                        "interaction_constraints)")
+    cat_smooth = Param(float, default=10.0,
+                       doc="categorical: target-mean smoothing "
+                           "pseudo-count (LightGBM cat_smooth)")
+    min_data_per_group = Param(int, default=0,
+                               doc="categorical: pool categories rarer "
+                                   "than this into one shared rank "
+                                   "(LightGBM min_data_per_group; off by "
+                                   "default — global pooling is stronger "
+                                   "than LightGBM's per-node grouping)")
+    linear_tree = Param(bool, default=False,
+                        doc="fit a ridge model per leaf over the leaf's "
+                            "path features (LightGBM linear_tree)")
+    linear_lambda = Param(float, default=0.0,
+                          doc="L2 on linear-leaf weights (LightGBM "
+                              "linear_lambda)")
 
     def _train_params(self, extra: dict) -> dict:
         keys = ["num_iterations", "learning_rate", "num_leaves", "max_depth",
@@ -112,7 +146,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                 "checkpoint_interval", "boosting_type", "top_rate",
                 "other_rate", "drop_rate", "max_drop", "skip_drop", "top_k",
                 "enable_bundle", "max_conflict_rate", "scale_pos_weight",
-                "is_unbalance"]
+                "is_unbalance", "extra_trees", "feature_fraction_bynode",
+                "path_smooth", "boost_from_average", "cat_smooth",
+                "min_data_per_group", "linear_tree", "linear_lambda"]
         p = {k: self.get(k) for k in keys}
         if self.get_or_none("checkpoint_dir"):
             p["checkpoint_dir"] = self.get("checkpoint_dir")
@@ -121,6 +157,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             p["categorical_feature"] = list(self.categorical_feature)
         if self.monotone_constraints:
             p["monotone_constraints"] = list(self.monotone_constraints)
+        if self.interaction_constraints:
+            p["interaction_constraints"] = [list(g) for g in
+                                            self.interaction_constraints]
         p.update(extra)
         return p
 

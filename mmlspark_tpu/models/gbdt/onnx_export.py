@@ -76,6 +76,10 @@ def booster_to_onnx(booster, n_features: int = None) -> bytes:
             "booster splits in a label-encoded categorical space; ONNX "
             "TreeEnsemble consumers would see raw features. Export only "
             "supports numeric-feature boosters.")
+    if getattr(booster, "is_linear", False):
+        raise ValueError(
+            "ONNX TreeEnsemble has no linear-leaf representation "
+            "(onnxmltools rejects LightGBM linear_tree models too)")
     depth = booster.depth
     n_int = 2 ** depth - 1
     n_leaf = 2 ** depth

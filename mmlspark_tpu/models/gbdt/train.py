@@ -72,6 +72,15 @@ _DEFAULTS = dict(
     monotone_constraints=None,      # per-feature -1/0/+1 (LightGBM name)
     scale_pos_weight=1.0,           # binary: positive-class weight multiplier
     is_unbalance=False,             # binary: auto scale_pos_weight = neg/pos
+    extra_trees=False,              # one random threshold per node×feature
+    feature_fraction_bynode=1.0,    # feature subsample per NODE (not tree)
+    path_smooth=0.0,                # smooth node outputs toward the parent
+    boost_from_average=True,        # start from the objective's optimal const
+    interaction_constraints=None,   # list of allowed feature groups
+    cat_smooth=10.0,                # categorical: mean smoothing pseudo-count
+    min_data_per_group=0,           # categorical: pool rarer categories
+    linear_tree=False,              # ridge model per leaf over path features
+    linear_lambda=0.0,              # L2 on linear-leaf weights (not bias)
 )
 
 
@@ -256,6 +265,37 @@ def train(params: Dict,
                              "is defined over one forest)")
     is_multi = objective_name in ("multiclass", "softmax") and num_class > 1
     is_rank = objective_name == "lambdarank"
+    linear_tree = bool(p["linear_tree"])
+    if linear_tree:
+        # LightGBM linear_tree restrictions apply here too: leaf models
+        # regress on raw numerical features only
+        if is_multi:
+            raise NotImplementedError("linear_tree with multiclass")
+        if sparse_X:
+            raise ValueError("linear_tree needs dense input (the leaf "
+                             "models regress on raw feature values)")
+        if p["categorical_feature"]:
+            raise ValueError("linear_tree regresses on numerical features "
+                             "only; drop categorical_feature")
+        if p["tree_learner"] == "voting_parallel":
+            raise ValueError("linear_tree + voting_parallel is not "
+                             "supported; use data_parallel")
+        mc = p["monotone_constraints"]
+        if mc is not None and np.asarray(mc).size and np.asarray(mc).any():
+            # the split search could mask on constant child values, but the
+            # fitted leaf ridge models are unclamped — predictions would
+            # silently violate the declared direction (LightGBM refuses
+            # this combination too)
+            raise ValueError("linear_tree cannot honor "
+                             "monotone_constraints; drop one of them")
+        if float(p["lambda_l1"]) != 0.0:
+            raise ValueError("lambda_l1 applies to constant leaf values "
+                             "only; linear_tree leaves are L2-regularized "
+                             "via linear_lambda (set lambda_l1=0)")
+        if float(p["path_smooth"]) != 0.0:
+            raise ValueError("path_smooth smooths constant leaf outputs; "
+                             "it has no linear-leaf counterpart here "
+                             "(set path_smooth=0)")
     obj = get_objective(objective_name, num_class=num_class,
                         alpha=p["alpha"],
                         tweedie_variance_power=p["tweedie_variance_power"])
@@ -325,7 +365,9 @@ def train(params: Dict,
                 "scratch or drop categorical_feature")
         else:
             cat_encoder = CategoricalEncoder(
-                p["categorical_feature"]).fit(X, y)
+                p["categorical_feature"],
+                cat_smooth=float(p["cat_smooth"]),
+                min_data_per_group=int(p["min_data_per_group"])).fit(X, y)
         X = cat_encoder.transform(X)
 
     mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]))
@@ -360,6 +402,10 @@ def train(params: Dict,
     if init_model is not None and init_score is not None:
         raise ValueError("init_score cannot combine with a warm-start "
                          "model (the model already defines the margin)")
+    if init_model is not None \
+            and getattr(init_model, "is_linear", False) != linear_tree:
+        raise ValueError("warm start must keep the leaf model family: "
+                         "set linear_tree to match the init model")
     if init_model is not None:
         # dart mutates leaf values in place (scale_trees) — work on a deep
         # copy so the caller's model object is never changed under them
@@ -387,7 +433,10 @@ def train(params: Dict,
             scores = init_arr.copy()
         else:
             init_arr = None
-            base_score = 0.0 if (is_multi or is_rank) \
+            # LightGBM boost_from_average: the first margin is the
+            # objective's optimal constant; off → boosting starts at 0
+            base_score = 0.0 if (is_multi or is_rank
+                                 or not p["boost_from_average"]) \
                 else obj.init_score(y, w)
             scores = np.zeros((n, num_class) if is_multi else n)
         booster = Booster(depth, F, objective_name, base_score,
@@ -442,10 +491,28 @@ def train(params: Dict,
         w_d = jnp.asarray(w_pad)
         live_d = jnp.asarray(live)
 
+    X_lin = None
+    if linear_tree:
+        # linear leaves regress on RAW values — the binned matrix loses
+        # them, so the float32 feature matrix also lives on device
+        xf = np.asarray(X, dtype=np.float32)
+        if n_pad != n:
+            xf = np.concatenate(
+                [xf, np.zeros((n_pad - n, F), np.float32)])
+        X_lin = jnp.asarray(xf)
+        if axis_name is not None:
+            X_lin = jax.device_put(X_lin, row_sharding)
+
     # PV-Tree voting (LightGBM tree_learner=voting_parallel, topK param —
     # params/LightGBMParams.scala:23-30): comm per level 2k×B instead of F×B
     voting_k = (int(p["top_k"]) if p["tree_learner"] == "voting_parallel"
                 else 0)
+    ffbn = float(p["feature_fraction_bynode"])
+    if not 0.0 < ffbn <= 1.0:
+        raise ValueError(f"feature_fraction_bynode must be in (0, 1], "
+                         f"got {ffbn}")
+    if float(p["path_smooth"]) < 0.0:
+        raise ValueError("path_smooth must be >= 0")
     build_kwargs = dict(depth=depth, n_bins=int(n_bins),
                         voting_k=voting_k,
                         lam=float(p["lambda_l2"]) + 1e-10,
@@ -454,7 +521,33 @@ def train(params: Dict,
                         min_child_weight=float(p["min_sum_hessian_in_leaf"]),
                         min_data_in_leaf=float(p["min_data_in_leaf"]),
                         bundles=bundle_tables,
-                        n_bundle_bins=int(n_bundle_bins))
+                        n_bundle_bins=int(n_bundle_bins),
+                        extra_trees=bool(p["extra_trees"]),
+                        ff_bynode=ffbn,
+                        path_smooth=float(p["path_smooth"]))
+    if p["extra_trees"]:
+        # per-feature populated bin counts (incl. missing bin 0): the
+        # random-threshold draw samples each feature's own range
+        build_kwargs["feat_bins"] = jnp.asarray(
+            [len(b) + 1 for b in mapper.upper_bounds], jnp.int32)
+    ic_raw = p["interaction_constraints"]
+    if ic_raw:
+        # list of allowed feature groups; a branch may only combine
+        # features that share at least one group, and features in no
+        # group are unusable (LightGBM interaction_constraints semantics)
+        groups = np.zeros((len(ic_raw), F), dtype=bool)
+        for gi, grp in enumerate(ic_raw):
+            idx = np.asarray(list(grp), dtype=np.int64)
+            if idx.size == 0:
+                raise ValueError("interaction_constraints groups must be "
+                                 "non-empty")
+            if idx.min() < 0 or idx.max() >= F:
+                raise ValueError(
+                    f"interaction_constraints[{gi}] has feature indices "
+                    f"outside [0, {F})")
+            groups[gi, idx] = True
+        build_kwargs["ic_groups"] = jnp.asarray(groups)
+
     mono_raw = p["monotone_constraints"]
     if mono_raw is not None and np.asarray(mono_raw).size:
         # validate RAW values before the int cast (int32 would silently
@@ -483,26 +576,62 @@ def train(params: Dict,
             build_kwargs["monotone"] = jnp.asarray(mono)
 
     if axis_name is None:
-        def build(xb_, g_, h_, live_, fmask):
+        def build(xb_, g_, h_, live_, fmask, key):
             return build_tree(xb_, g_, h_, live_, feature_mask=fmask,
-                              **build_kwargs)
+                              rng=key, **build_kwargs)
     else:
         n_int = 2 ** depth - 1
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(P("data", None), P("data"), P("data"), P("data"), P(None)),
+            in_specs=(P("data", None), P("data"), P("data"), P("data"),
+                      P(None), P(None)),
             out_specs=(P(None), P(None), P(None), P("data"), P(None), P(None)),
             check_vma=False)
-        def build(xb_, g_, h_, live_, fmask):
+        def build(xb_, g_, h_, live_, fmask, key):
+            # key replicated: every shard draws identical random masks, so
+            # extra_trees/by-node sampling stays bitwise-deterministic
+            # across the mesh (same invariant as the psum'd histogram)
             return build_tree(xb_, g_, h_, live_, feature_mask=fmask,
-                              axis_name=axis_name, **build_kwargs)
+                              rng=key, axis_name=axis_name, **build_kwargs)
+
+    lin_fit = None
+    if linear_tree:
+        from .trees import fit_linear_leaves
+        lin_kwargs = dict(n_leaf=2 ** depth,
+                          lam_lin=float(p["linear_lambda"]),
+                          lam=float(p["lambda_l2"]) + 1e-10)
+        if axis_name is None:
+            def lin_fit(Xr, li, g_, h_, live_, pf):
+                return fit_linear_leaves(Xr, li, g_, h_, live_, pf,
+                                         **lin_kwargs)
+        else:
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P("data", None), P("data"), P("data"), P("data"),
+                          P("data"), P(None)),
+                out_specs=(P(None), P("data")), check_vma=False)
+            def lin_fit(Xr, li, g_, h_, live_, pf):
+                # normal equations psum inside, so coefficients are
+                # identical on every shard (bitwise-deterministic like
+                # the histogram path)
+                return fit_linear_leaves(Xr, li, g_, h_, live_, pf,
+                                         axis_name=axis_name, **lin_kwargs)
+
+    def _pred_stack(feats_a, thr_a, leaf_a, Xq, coefs_a=None, pf_a=None):
+        """Tree-stack prediction, constant or linear leaves."""
+        from .trees import predict_trees_any, predict_trees_linear_any
+        if linear_tree:
+            return predict_trees_linear_any(feats_a, thr_a, coefs_a, pf_a,
+                                            Xq, depth=depth)
+        return predict_trees_any(feats_a, thr_a, leaf_a, Xq, depth=depth)
 
     booster.fit_params = {"learning_rate": float(p["learning_rate"]),
                           "lambda_l2": float(p["lambda_l2"])}
     grad_fn = jax.jit(obj.grad_hess) if obj.grad_hess is not None else None
     lr = float(p["learning_rate"])
     rng = np.random.default_rng(int(p["seed"]))
+    base_key = jax.random.PRNGKey(int(p["seed"]))
     n_iter = max(0, int(p["num_iterations"]) - resumed_iters)
     ckpt_iv = int(p["checkpoint_interval"]) if ckpt is not None else 0
 
@@ -577,14 +706,16 @@ def train(params: Dict,
                     cand = np.sort(rng.choice(cand, size=md, replace=False))
                 drop_groups = cand
             if len(drop_groups):
-                from .trees import predict_trees_any
                 k_drop = len(drop_groups)
                 tree_scale = 1.0 / (k_drop + 1.0)   # DART-paper weights
                 drop_idx = (drop_groups[:, None] * K_trees
                             + np.arange(K_trees)[None, :]).ravel()
-                dp = predict_trees_any(
+                lin = booster.linear if linear_tree else None
+                dp = _pred_stack(
                     booster.feats[drop_idx], booster.thr_raw[drop_idx],
-                    booster.leaf_values[drop_idx], X_f32, depth=depth)
+                    booster.leaf_values[drop_idx], X_f32,
+                    coefs_a=lin["coefs"][drop_idx] if lin else None,
+                    pf_a=lin["pf"][drop_idx] if lin else None)
                 drop_pred = jnp.pad(
                     dp, ((0, n_pad - n),) + ((0, 0),) * (dp.ndim - 1))
                 if axis_name is not None:
@@ -671,11 +802,15 @@ def train(params: Dict,
         # forest average; dart additionally scales the new tree by 1/(k+1)
         lr_eff = (1.0 if boosting == "rf" else lr) * tree_scale
 
+        it_key = jax.random.fold_in(base_key, resumed_iters + it)
+        new_coefs = new_pf = None
         if is_multi:
-            def build_k(gk, hk):
-                return build(xb_d, gk, hk, live_it, fmask)
+            def build_k(gk, hk, kk):
+                return build(xb_d, gk, hk, live_it, fmask, kk)
             feats_k, thr_k, leaf_k, node_k, gains_k, covers_k = jax.vmap(
-                build_k, in_axes=(1, 1))(g_d * mask_g, h_d * mask_g)
+                build_k, in_axes=(1, 1, 0))(
+                    g_d * mask_g, h_d * mask_g,
+                    jax.random.split(it_key, num_class))
             feats_np = np.asarray(feats_k)      # (K, n_int)
             thr_raw_k = np.stack([
                 _thr_bins_to_raw(feats_np[k], np.asarray(thr_k)[k], mapper,
@@ -699,14 +834,29 @@ def train(params: Dict,
             g_m = g_d * gh_w
             h_m = h_d * gh_w
             feats, thr_bin, leaf_val, node_rel, gains, covers = build(
-                xb_d, g_m, h_m, live_it, fmask)
+                xb_d, g_m, h_m, live_it, fmask, it_key)
             feats_np = np.asarray(feats)
             thr_raw = _thr_bins_to_raw(feats_np, np.asarray(thr_bin), mapper,
                                        int(n_bins))
-            leaf_np = np.asarray(leaf_val) * lr_eff
-            booster.append_tree(feats_np, thr_raw, leaf_np,
-                                np.asarray(gains), np.asarray(covers))
-            scores = scores + jnp.take(leaf_val, node_rel) * lr_eff
+            if linear_tree:
+                from .trees import path_features
+                pf_np = path_features(feats_np, depth)
+                beta, contrib = lin_fit(X_lin, node_rel, g_m, h_m, live_it,
+                                        jnp.asarray(pf_np))
+                coefs_np = np.asarray(beta, np.float32) * np.float32(lr_eff)
+                # leaf_values keep the bias (the constant-fallback view)
+                leaf_np = coefs_np[:, -1].copy()
+                booster.append_tree(feats_np, thr_raw, leaf_np,
+                                    np.asarray(gains), np.asarray(covers),
+                                    coefs=coefs_np, pf=pf_np)
+                scores = scores + contrib * lr_eff
+                new_coefs = coefs_np[None]
+                new_pf = pf_np[None]
+            else:
+                leaf_np = np.asarray(leaf_val) * lr_eff
+                booster.append_tree(feats_np, thr_raw, leaf_np,
+                                    np.asarray(gains), np.asarray(covers))
+                scores = scores + jnp.take(leaf_val, node_rel) * lr_eff
             new_feats = feats_np[None]
             new_thr = thr_raw[None]
             new_leaf = leaf_np[None]
@@ -722,7 +872,6 @@ def train(params: Dict,
         # eval + early stopping (uses this iteration's trees directly so the
         # booster's lazy tree stack is not re-materialized every round)
         if valid_sets:
-            from .trees import predict_trees_any
             results = []
             for vi, (vx, vy) in enumerate(valid_sets):
                 if drop_idx is not None:
@@ -730,15 +879,17 @@ def train(params: Dict,
                     # incremental tracking is invalid for this round,
                     # recompute from the full tree stack; no-drop rounds
                     # keep the O(1)-tree incremental path
-                    valid_scores[vi] = base_score + predict_trees_any(
+                    lin = booster.linear if linear_tree else None
+                    valid_scores[vi] = base_score + _pred_stack(
                         booster.feats, booster.thr_raw, booster.leaf_values,
-                        vx, depth=depth)
+                        vx, coefs_a=lin["coefs"] if lin else None,
+                        pf_a=lin["pf"] if lin else None)
                     if valid_margins is not None:
                         valid_scores[vi] = valid_scores[vi] \
                             + valid_margins[vi]
                 else:
-                    delta = predict_trees_any(
-                        new_feats, new_thr, new_leaf, vx, depth=depth)
+                    delta = _pred_stack(new_feats, new_thr, new_leaf, vx,
+                                        coefs_a=new_coefs, pf_a=new_pf)
                     valid_scores[vi] = valid_scores[vi] + delta
                 pred = np.asarray(obj.transform(jnp.asarray(valid_scores[vi])))
                 vw = np.ones(len(vy))

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TreeArrays", "BundleTables", "build_tree", "predict_trees",
-           "predict_leaf_indices"]
+           "predict_leaf_indices", "path_features", "fit_linear_leaves",
+           "predict_trees_linear", "predict_trees_linear_any"]
 
 
 class BundleTables(NamedTuple):
@@ -116,14 +117,27 @@ def _debundle(hist_b, bundles: "BundleTables", n_bins: int):
                      gathered)
 
 
+def _smooth(raw, cnt, parent, path_smooth):
+    """LightGBM path smoothing: pull a node's output toward its parent's
+    with ``path_smooth`` pseudo-counts (root smooths toward 0)."""
+    t = cnt / jnp.maximum(cnt + path_smooth, 1e-12)
+    return t * raw + (1.0 - t) * parent
+
+
 def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
-                 feature_mask, monotone=None, bounds=None):
+                 feature_mask, monotone=None, bounds=None,
+                 cand_mask=None, path_smooth=0.0, parent_value=None):
     """hist (nodes, F, B, 3) → masked split gains (nodes, F, B); invalid
     candidates are -inf. ``feature_mask`` may be (F,) or per-node (nodes, F)
     (the latter after a voting gather, where the column set differs per
     node). ``monotone`` (F,) in {-1, 0, +1} with ``bounds`` (lo, hi) each
     (nodes,) masks candidates whose (bound-clamped) child values violate
-    the feature's direction — LightGBM monotone_constraints semantics."""
+    the feature's direction — LightGBM monotone_constraints semantics.
+    ``cand_mask`` (nodes, F, B) restricts the threshold candidates
+    (extra_trees samples one random bin per node×feature). With
+    ``path_smooth > 0`` gains are computed at the SMOOTHED child outputs
+    (``parent_value`` (nodes,) = each node's own smoothed output, so
+    children smooth toward it) — at 0 this reduces to the closed form."""
     G = hist[..., 0]
     H = hist[..., 1]
     C = hist[..., 2]
@@ -138,13 +152,28 @@ def _split_gains(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
     def score(g, h):
         return (g * g) / (h + lam)
 
-    gain = 0.5 * (score(GL, HL) + score(GR, HR) - score(Gt, Ht))
+    if path_smooth > 0.0:
+        # gain at the smoothed outputs: lg(g,h,w) = -(g·w + ½(h+λ)w²);
+        # with w = -g/(h+λ) (no smoothing) this is ½·g²/(h+λ), the
+        # closed form below
+        pv = parent_value[:, None, None]
+        wL = _smooth(-GL / (HL + lam), CL, pv, path_smooth)
+        wR = _smooth(-GR / (HR + lam), CR, pv, path_smooth)
+
+        def lg(g, h, w):
+            return -(g * w + 0.5 * (h + lam) * w * w)
+
+        gain = lg(GL, HL, wL) + lg(GR, HR, wR) - lg(Gt, Ht, pv)
+    else:
+        gain = 0.5 * (score(GL, HL) + score(GR, HR) - score(Gt, Ht))
     valid = ((HL >= min_child_weight) & (HR >= min_child_weight)
              & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
              & (gain > min_gain))
     if feature_mask is not None:
         fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
         valid = valid & fm[:, :, None]
+    if cand_mask is not None:
+        valid = valid & cand_mask
     if monotone is not None:
         lo, hi = bounds                              # (nodes,)
         vL = jnp.clip(-GL / (HL + lam), lo[:, None, None], hi[:, None, None])
@@ -212,11 +241,14 @@ def _chosen_child_values(hist, bf, bb, lam, lo, hi):
 
 
 def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
-                 feature_mask, monotone=None, bounds=None):
+                 feature_mask, monotone=None, bounds=None,
+                 cand_mask=None, path_smooth=0.0, parent_value=None):
     """hist (nodes, F, B, 3) → best (gain, feat, bin) per node."""
     gain = _split_gains(hist, lam, min_gain, min_child_weight,
                         min_data_in_leaf, feature_mask,
-                        monotone=monotone, bounds=bounds)
+                        monotone=monotone, bounds=bounds,
+                        cand_mask=cand_mask, path_smooth=path_smooth,
+                        parent_value=parent_value)
     flat = gain.reshape(gain.shape[0], -1)           # (nodes, F*B)
     best = jnp.argmax(flat, axis=-1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
@@ -230,7 +262,9 @@ def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name",
-                                             "voting_k", "n_bundle_bins"))
+                                             "voting_k", "n_bundle_bins",
+                                             "extra_trees", "ff_bynode",
+                                             "path_smooth"))
 def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                sample_weight_count: jnp.ndarray,
                depth: int, n_bins: int,
@@ -240,7 +274,12 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                axis_name: Optional[str] = None, voting_k: int = 0,
                bundles: Optional[BundleTables] = None,
                n_bundle_bins: int = 0,
-               monotone: Optional[jnp.ndarray] = None):
+               monotone: Optional[jnp.ndarray] = None,
+               rng: Optional[jnp.ndarray] = None,
+               extra_trees: bool = False, ff_bynode: float = 1.0,
+               path_smooth: float = 0.0,
+               ic_groups: Optional[jnp.ndarray] = None,
+               feat_bins: Optional[jnp.ndarray] = None):
     """Grow one depth-`depth` tree. All shapes static; jits once per config.
 
     xb: (n, F) int bins — or, with ``bundles``, the (n, n_bundles) EFB
@@ -270,12 +309,27 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         raise ValueError("monotone_constraints + voting_parallel is not "
                          "supported (constraint masking needs the full "
                          "histogram; use data_parallel)")
+    if use_voting and (extra_trees or ff_bynode < 1.0 or path_smooth > 0.0
+                       or ic_groups is not None):
+        raise ValueError("extra_trees/feature_fraction_bynode/path_smooth/"
+                         "interaction_constraints need per-node candidate "
+                         "masking over the full histogram; use "
+                         "tree_learner=data_parallel")
     # per-node value bounds inherited down the tree (LightGBM
     # monotone_constraints): candidates violating a feature's direction
     # are masked in the gain search, children tighten around the split's
     # mid value, leaf values clamp into their node's interval
     lo = jnp.full((1,), -jnp.inf) if monotone is not None else None
     hi = jnp.full((1,), jnp.inf) if monotone is not None else None
+    # path smoothing carries each node's PARENT's smoothed output down the
+    # tree (root's parent output is 0 — LightGBM path_smooth semantics)
+    pp = jnp.zeros((1,)) if path_smooth > 0.0 else None
+    # interaction constraints carry the set of still-compatible groups per
+    # node (a group stays compatible iff it contains every feature used on
+    # the path); allowed features = union of compatible groups, so features
+    # in no group are never usable — LightGBM interaction_constraints
+    compat = (jnp.ones((1, ic_groups.shape[0]), dtype=bool)
+              if ic_groups is not None else None)
 
     def level_hist(n_nodes, psum_axis):
         if bundles is None:
@@ -290,6 +344,42 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     for d in range(depth):
         n_nodes = 2 ** d
         level_off = 2 ** d - 1
+        # per-level randomized masks (extra_trees thresholds, by-node
+        # feature draws) — keys fold in the level so every level redraws
+        cand = None
+        if extra_trees:
+            # sample each feature's candidate within ITS populated bin
+            # range (feat_bins (F,) = per-feature bin count incl. the
+            # missing bin) — a global [0, n_bins) draw would leave
+            # low-cardinality features with an almost-always-empty right
+            # child (LightGBM samples per-feature ranges too)
+            u = jax.random.uniform(jax.random.fold_in(rng, 2 * d),
+                                   (n_nodes, F))
+            hi = (jnp.maximum(feat_bins - 1, 1)[None, :]
+                  if feat_bins is not None
+                  else jnp.full((1, F), max(n_bins - 1, 1)))
+            r = jnp.minimum((u * hi).astype(jnp.int32), hi - 1)
+            cand = jnp.arange(n_bins)[None, None, :] == r[:, :, None]
+        fm_level = feature_mask
+        if ic_groups is not None:
+            allowed = (compat[:, :, None] & ic_groups[None, :, :]).any(axis=1)
+            if fm_level is not None:
+                fm_b = (fm_level if fm_level.ndim == 2 else fm_level[None, :])
+                allowed = allowed & fm_b
+            fm_level = allowed                           # (n_nodes, F)
+        if ff_bynode < 1.0:
+            kk = max(1, int(round(F * ff_bynode)))
+            u = jax.random.uniform(jax.random.fold_in(rng, 2 * d + 1),
+                                   (n_nodes, F))
+            if fm_level is not None:
+                fm_b = (fm_level if fm_level.ndim == 2
+                        else fm_level[None, :])
+                u = jnp.where(fm_b, u, -1.0)     # draw from survivors only
+            kth = jax.lax.top_k(u, kk)[0][:, -1:]
+            node_mask = u >= kth
+            if fm_level is not None:
+                node_mask = node_mask & fm_b
+            fm_level = node_mask
         if use_voting:
             local = level_hist(n_nodes, None)
             bf, bb, bg, level_cover = _voting_splits(
@@ -298,11 +388,23 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         else:
             hist = level_hist(n_nodes, axis_name)
             level_cover = hist[:, 0, :, 2].sum(axis=-1)  # counts per node
+            node_val = None
+            if path_smooth > 0.0:
+                # each node's own smoothed output: raw optimum over its
+                # totals (feature 0's bins partition the node's rows),
+                # smoothed toward the carried parent output
+                Gt = hist[:, 0, :, 0].sum(axis=-1)
+                Ht = hist[:, 0, :, 1].sum(axis=-1)
+                node_val = _smooth(-Gt / (Ht + lam), level_cover, pp,
+                                   path_smooth)
             bf, bb, bg = _find_splits(hist, lam, min_gain, min_child_weight,
-                                      min_data_in_leaf, feature_mask,
+                                      min_data_in_leaf, fm_level,
                                       monotone=monotone,
                                       bounds=(lo, hi)
-                                      if monotone is not None else None)
+                                      if monotone is not None else None,
+                                      cand_mask=cand,
+                                      path_smooth=path_smooth,
+                                      parent_value=node_val)
         covers = jax.lax.dynamic_update_slice(covers, level_cover, (level_off,))
         feats = jax.lax.dynamic_update_slice(feats, bf, (level_off,))
         thrs = jax.lax.dynamic_update_slice(thrs, bb, (level_off,))
@@ -336,6 +438,15 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             right_hi = jnp.where(m_node < 0, jnp.minimum(hi, mid), hi)
             lo = jnp.stack([left_lo, right_lo], axis=1).reshape(-1)
             hi = jnp.stack([left_hi, right_hi], axis=1).reshape(-1)
+        if path_smooth > 0.0:
+            # both children smooth toward THIS node's output next level
+            pp = jnp.repeat(node_val, 2)
+        if ic_groups is not None:
+            # children keep only groups containing the chosen feature;
+            # stub nodes (no split) pass their set through unchanged
+            contains = ic_groups[:, jnp.clip(bf, 0, F - 1)].T   # (nodes, G)
+            child = jnp.where((bf >= 0)[:, None], compat & contains, compat)
+            compat = jnp.repeat(child, 2, axis=0)
 
     # leaf values from bottom-level stats
     n_leaves = 2 ** depth
@@ -347,14 +458,18 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     G_reg = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)  # L1 shrink
     leaf_value = -G_reg / (sums[:, 1] + lam)
     leaf_value = jnp.where(jnp.abs(sums[:, 1]) > 0, leaf_value, 0.0)
-    if monotone is not None:
-        # inherited interval per leaf; empty leaves clamp too (their 0.0
-        # may sit outside the bounds of a constrained subtree)
-        leaf_value = jnp.clip(leaf_value, lo, hi)
     leaf_counts = jax.ops.segment_sum(sample_weight_count, node_rel,
                                       num_segments=n_leaves)
     if axis_name is not None:
         leaf_counts = jax.lax.psum(leaf_counts, axis_name)
+    if path_smooth > 0.0:
+        # empty leaves (count 0) land exactly on the parent's output —
+        # a better imputation than 0.0 for rows routed there at predict
+        leaf_value = _smooth(leaf_value, leaf_counts, pp, path_smooth)
+    if monotone is not None:
+        # inherited interval per leaf; empty leaves clamp too (their
+        # imputed value may sit outside the bounds of a constrained subtree)
+        leaf_value = jnp.clip(leaf_value, lo, hi)
     covers = jax.lax.dynamic_update_slice(covers, leaf_counts,
                                           (2 ** depth - 1,))
     return feats, thrs, leaf_value.astype(jnp.float32), node_rel, gains, covers
@@ -410,6 +525,125 @@ def predict_leaf_indices(feats, thr_raw, X, depth: int):
 
     _, leaves = jax.lax.scan(one_tree, None, (feats, thr_raw))
     return leaves.T  # (n, T)
+
+
+def path_features(feats_np: np.ndarray, depth: int) -> np.ndarray:
+    """(2^D - 1,) split features → (2^D, D) features on each leaf's path.
+
+    Used by linear trees (LightGBM ``linear_tree``): leaf l's linear model
+    regresses on the features its root→leaf path split on. Duplicate
+    features on a path keep their FIRST slot only (later occurrences → -1)
+    so the per-leaf design matrix never carries collinear copies; stub
+    levels contribute -1 (masked column).
+    """
+    n_leaf = 2 ** depth
+    pf = np.full((n_leaf, depth), -1, dtype=np.int32)
+    for leaf in range(n_leaf):
+        idx = 0
+        seen = set()
+        for d in range(depth):
+            f = int(feats_np[idx])
+            if f >= 0 and f not in seen:
+                pf[leaf, d] = f
+                seen.add(f)
+            bit = (leaf >> (depth - 1 - d)) & 1
+            idx = 2 * idx + 1 + bit
+    return pf
+
+
+def _leaf_design(X, leaf_idx, pf):
+    """Per-row linear-leaf design matrix [x_path-features, 1] — (n, D+1).
+    Masked slots (pf = -1) and missing values contribute 0."""
+    pfl = pf[leaf_idx]                                        # (n, D)
+    xsel = jnp.take_along_axis(
+        X, jnp.clip(pfl, 0, X.shape[1] - 1).astype(jnp.int32), axis=1)
+    xsel = jnp.where((pfl >= 0) & ~jnp.isnan(xsel), xsel, 0.0)
+    return jnp.concatenate([xsel, jnp.ones((X.shape[0], 1), X.dtype)],
+                           axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("n_leaf", "axis_name"))
+def fit_linear_leaves(X, leaf_idx, g, h, live, pf,
+                      n_leaf: int, lam_lin: float, lam: float,
+                      axis_name=None):
+    """Fit one hessian-weighted ridge model per leaf (LightGBM
+    ``linear_tree``), TPU-shaped: every leaf's normal equations accumulate
+    with one ``segment_sum`` of (D+1)×(D+1) outer products and solve in a
+    single batched ``jnp.linalg.solve`` — no per-leaf control flow.
+
+    Minimizes Σ_i g_i·(β·a_i) + ½ h_i (β·a_i)² + ½ lam_lin |w|² + ½ lam b²
+    per leaf (a_i = [x_path, 1], β = [w, b]) — the second-order boosting
+    objective, so a leaf whose features carry no signal recovers exactly
+    the constant leaf value -G/(H+lam). Data-parallel: M, v, and counts
+    psum over ``axis_name`` before the solve, so every shard computes
+    identical coefficients (the builder's bitwise-determinism invariant).
+
+    Degenerate leaves (fewer weighted rows than D+2, or a non-finite
+    solve) fall back to that constant. Returns (beta (n_leaf, D+1),
+    per-row contribution (n,)).
+    """
+    D = pf.shape[1]
+    A = _leaf_design(X, leaf_idx, pf)                         # (n, D+1)
+    M = jax.ops.segment_sum(A[:, :, None] * A[:, None, :]
+                            * h[:, None, None], leaf_idx,
+                            num_segments=n_leaf)              # (L, D+1, D+1)
+    v = jax.ops.segment_sum(A * g[:, None], leaf_idx,
+                            num_segments=n_leaf)              # (L, D+1)
+    cnt = jax.ops.segment_sum(live, leaf_idx, num_segments=n_leaf)
+    if axis_name is not None:
+        M = jax.lax.psum(M, axis_name)
+        v = jax.lax.psum(v, axis_name)
+        cnt = jax.lax.psum(cnt, axis_name)
+    reg = jnp.diag(jnp.concatenate(
+        [jnp.full((D,), lam_lin + 1e-6), jnp.full((1,), lam)]))
+    beta = jnp.linalg.solve(M + reg[None],
+                            -v[..., None]).squeeze(-1)        # (L, D+1)
+    const = -v[:, D] / (M[:, D, D] + lam)      # bias-only = constant leaf
+    const = jnp.where(M[:, D, D] > 0, const, 0.0)
+    bad = (cnt < D + 2) | ~jnp.isfinite(beta).all(axis=1)
+    fallback = jnp.concatenate(
+        [jnp.zeros((n_leaf, D)), const[:, None]], axis=1)
+    beta = jnp.where(bad[:, None], fallback, beta)
+    contrib = (A * beta[leaf_idx]).sum(axis=1)
+    return beta, contrib
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def predict_trees_linear(feats, thr_raw, coefs, pf, X, depth: int):
+    """Sum of linear-tree outputs: route each row by the usual descent,
+    then evaluate its leaf's linear model on the path features.
+
+    feats/thr_raw (T, 2^D-1); coefs (T, 2^D, D+1); pf (T, 2^D, D);
+    X (n, F) float → (n,).
+    """
+    n = X.shape[0]
+
+    def one_tree(carry, tree):
+        f, t, cf, p = tree
+        idx = jnp.zeros(n, dtype=jnp.int32)
+        for _ in range(depth):
+            nf = f[idx]
+            nt = t[idx]
+            x = jnp.take_along_axis(X, jnp.clip(nf, 0, X.shape[1] - 1)[:, None],
+                                    axis=1)[:, 0]
+            go_left = (nf < 0) | (x <= nt) | jnp.isnan(x)
+            idx = 2 * idx + 1 + (1 - go_left.astype(jnp.int32))
+        leaf = idx - (2 ** depth - 1)
+        A = _leaf_design(X, leaf, p)
+        return carry + (A * cf[leaf]).sum(axis=1), None
+
+    out, _ = jax.lax.scan(one_tree, jnp.zeros(n, jnp.float32),
+                          (feats, thr_raw, coefs, pf))
+    return out
+
+
+def predict_trees_linear_any(feats, thr_raw, coefs, pf, X, depth: int,
+                             chunk: int = 1 << 16) -> np.ndarray:
+    """``predict_trees_linear`` accepting dense OR scipy-sparse X."""
+    return apply_chunked_dense(
+        lambda xd: predict_trees_linear(feats, thr_raw, coefs, pf, xd,
+                                        depth=depth),
+        X, empty_shape=(0,), chunk=chunk)
 
 
 def apply_chunked_dense(fn, X, empty_shape, chunk: int = 1 << 16,

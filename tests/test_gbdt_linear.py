@@ -1,0 +1,182 @@
+"""Linear trees (LightGBM ``linear_tree``): a hessian-weighted ridge model
+per leaf over the leaf's path features.
+
+TPU-first formulation (``trees.fit_linear_leaves``): every leaf's normal
+equations accumulate via one ``segment_sum`` of (D+1)x(D+1) outer products
+and solve in a single batched ``jnp.linalg.solve`` — no per-leaf control
+flow, and the data-parallel learner psums M/v so coefficients stay
+bitwise-identical across shards. Parity anchor: LightGBM's linear_tree
+param (the reference surfaces LightGBM params wholesale through
+``params/LightGBMParams.scala``).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt.booster import Booster
+from mmlspark_tpu.models.gbdt.train import train
+from mmlspark_tpu.models.gbdt.trees import path_features
+
+BASE = {"objective": "regression", "num_iterations": 25, "num_leaves": 7,
+        "learning_rate": 0.2, "seed": 3}
+
+
+def _piecewise_linear(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1.0, -1.5 * X[:, 2]) \
+        + 0.05 * rng.normal(size=n)
+    return X, y
+
+
+class TestPathFeatures:
+    def test_dedup_and_stubs(self):
+        # depth 2: root splits f0; left child f1, right child is a stub
+        feats = np.array([0, 1, -1], np.int32)
+        pf = path_features(feats, 2)
+        np.testing.assert_array_equal(pf[0], [0, 1])   # leaf 0: root->left
+        np.testing.assert_array_equal(pf[2], [0, -1])  # leaf 2: stub level
+        # duplicate feature on a path keeps the first slot only
+        feats2 = np.array([0, 0, 0], np.int32)
+        pf2 = path_features(feats2, 2)
+        np.testing.assert_array_equal(pf2[0], [0, -1])
+
+
+class TestLinearTreeTraining:
+    def test_beats_constant_on_piecewise_linear(self):
+        X, y = _piecewise_linear()
+        const = train(BASE, X, y)
+        lin = train(dict(BASE, linear_tree=True), X, y)
+        assert lin.is_linear and not const.is_linear
+        mc = float(np.mean((const.predict(X) - y) ** 2))
+        ml = float(np.mean((lin.predict(X) - y) ** 2))
+        assert ml < 0.5 * mc
+
+    def test_deterministic(self):
+        X, y = _piecewise_linear(n=600)
+        a = train(dict(BASE, linear_tree=True), X, y)
+        b = train(dict(BASE, linear_tree=True), X, y)
+        assert np.array_equal(a.predict(X), b.predict(X))
+
+    def test_binary_objective(self):
+        X, y = _piecewise_linear(n=1200)
+        yb = (y > 0).astype(np.float64)
+        m = train(dict(BASE, objective="binary", linear_tree=True), X, yb)
+        p = m.predict(X)
+        acc = float(((p > 0.5) == yb).mean())
+        assert acc > 0.95
+
+    def test_linear_lambda_shrinks_weights(self):
+        X, y = _piecewise_linear(n=800)
+        small = train(dict(BASE, linear_tree=True, linear_lambda=0.0), X, y)
+        big = train(dict(BASE, linear_tree=True, linear_lambda=1e4), X, y)
+        wn = lambda b: float(np.abs(b.linear["coefs"][..., :-1]).mean())  # noqa: E731
+        assert wn(big) < 0.1 * wn(small)
+
+    def test_nan_features_contribute_zero(self):
+        X, y = _piecewise_linear(n=800)
+        m = train(dict(BASE, linear_tree=True), X, y)
+        Xq = X[:10].copy()
+        p_clean = m.predict(Xq)
+        Xq2 = Xq.copy()
+        Xq2[:, 4] = np.nan        # f4 is noise: routing unchanged, term -> 0
+        p_nan = m.predict(Xq2)
+        assert np.isfinite(p_nan).all()
+        assert np.abs(p_nan - p_clean).max() < 1.0
+
+    def test_goss_and_rf_compose(self):
+        X, y = _piecewise_linear(n=1500)
+        g = train(dict(BASE, linear_tree=True, boosting="goss"), X, y)
+        r = train(dict(BASE, linear_tree=True, boosting="rf",
+                       bagging_fraction=0.6, bagging_freq=1), X, y)
+        for m in (g, r):
+            assert m.is_linear
+            assert float(np.mean((m.predict(X) - y) ** 2)) < float(np.var(y))
+
+    def test_dart_composes(self):
+        X, y = _piecewise_linear(n=1000)
+        m = train(dict(BASE, linear_tree=True, boosting="dart",
+                       drop_rate=0.3, skip_drop=0.0), X, y)
+        assert m.is_linear
+        assert float(np.mean((m.predict(X) - y) ** 2)) < float(np.var(y))
+
+    def test_early_stopping_truncates_linear_arrays(self):
+        X, y = _piecewise_linear()
+        m = train(dict(BASE, num_iterations=60, linear_tree=True,
+                       early_stopping_round=5),
+                  X[:1500], y[:1500], valid_sets=[(X[1500:], y[1500:])])
+        assert m.best_iteration > 0
+        assert m.linear["coefs"].shape[0] == m.num_trees
+
+    def test_warm_start_family_must_match(self):
+        X, y = _piecewise_linear(n=400)
+        lin = train(dict(BASE, num_iterations=5, linear_tree=True), X, y)
+        with pytest.raises(ValueError, match="leaf model family"):
+            train(dict(BASE, num_iterations=5), X, y, init_model=lin)
+        cont = train(dict(BASE, num_iterations=5, linear_tree=True), X, y,
+                     init_model=lin)
+        assert cont.num_trees == 10 and cont.is_linear
+
+
+class TestLinearBooster:
+    def test_roundtrip_string(self):
+        X, y = _piecewise_linear(n=600)
+        m = train(dict(BASE, linear_tree=True), X, y)
+        m2 = Booster.from_string(m.to_string())
+        assert m2.is_linear
+        np.testing.assert_array_equal(m.predict(X), m2.predict(X))
+
+    def test_num_iteration_cap(self):
+        X, y = _piecewise_linear(n=600)
+        m = train(dict(BASE, linear_tree=True), X, y)
+        p5 = m.predict(X, num_iteration=5)
+        t5 = m.truncated(5)
+        np.testing.assert_array_equal(p5, t5.predict(X))
+
+    def test_unsupported_paths_raise(self):
+        X, y = _piecewise_linear(n=400)
+        m = train(dict(BASE, num_iterations=3, linear_tree=True), X, y)
+        with pytest.raises(NotImplementedError):
+            m.shap_values(X[:5])
+        with pytest.raises(NotImplementedError):
+            m.refit(X, y)
+        from mmlspark_tpu.models.gbdt.onnx_export import booster_to_onnx
+        with pytest.raises(ValueError, match="linear"):
+            booster_to_onnx(m)
+
+    def test_validation_rejections(self):
+        X, y = _piecewise_linear(n=300)
+        with pytest.raises(ValueError, match="dense"):
+            import scipy.sparse as sp
+            train(dict(BASE, linear_tree=True), sp.csr_matrix(X), y)
+        with pytest.raises(ValueError, match="numerical"):
+            train(dict(BASE, linear_tree=True, categorical_feature=[0]),
+                  X, y)
+        with pytest.raises(NotImplementedError):
+            train(dict(BASE, objective="multiclass", num_class=3,
+                       linear_tree=True), X, (y > 0).astype(int) + 1)
+        # leaf-level regularizers with no linear counterpart are rejected,
+        # not silently ignored
+        with pytest.raises(ValueError, match="monotone"):
+            train(dict(BASE, linear_tree=True,
+                       monotone_constraints=[1, 0, 0, 0, 0]), X, y)
+        with pytest.raises(ValueError, match="lambda_l1"):
+            train(dict(BASE, linear_tree=True, lambda_l1=0.5), X, y)
+        with pytest.raises(ValueError, match="path_smooth"):
+            train(dict(BASE, linear_tree=True, path_smooth=2.0), X, y)
+
+
+class TestLinearMeshParity:
+    def test_data_parallel_matches_serial(self):
+        import jax
+        from jax.sharding import Mesh
+
+        X, y = _piecewise_linear(n=512)
+        params = dict(BASE, num_iterations=8, linear_tree=True)
+        serial = train(params, X, y)
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, ("data",))
+        dp = train(dict(params, tree_learner="data_parallel"), X, y,
+                   mesh=mesh)
+        np.testing.assert_allclose(serial.predict(X), dp.predict(X),
+                                   rtol=2e-3, atol=2e-4)
