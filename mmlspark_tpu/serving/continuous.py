@@ -113,7 +113,8 @@ class ContinuousDecoder:
                  max_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
                  mesh: Optional[Mesh] = None,
-                 prefix_cache_size: int = 8):
+                 prefix_cache_size: int = 8,
+                 steps_per_dispatch: int = 1):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -129,6 +130,16 @@ class ContinuousDecoder:
         self._L = int(max_len)
         self._eos = eos_id
         self._mesh = mesh
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        #: decode steps fused into one device dispatch (lax.scan). Behind a
+        #: network-attached chip every dispatch pays ~RTT, so the
+        #: single-step engine emits ~1/RTT tokens/s no matter how fast the
+        #: chip is; k steps per dispatch cut the host syncs k-fold.
+        #: Per-slot retirement (eos / max_new) moves INSIDE the scan so
+        #: outputs stay token-identical; admission granularity coarsens to
+        #: one dispatch (a freed slot re-fills at the next host tick).
+        self._k = int(steps_per_dispatch)
         params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
         shape = (self._S, cfg.heads, self._L, hd)
@@ -175,7 +186,7 @@ class ContinuousDecoder:
         self._next_rid = 0
         self._stop = threading.Event()
 
-        # ---- the two compiled programs ----
+        # ---- the compiled programs ----
         # donate the KV cache (and the small state vectors) so XLA updates
         # it in place — without donation every tick copies the full
         # (slots, heads, max_len, hd) × layers × {k,v} buffer set, doubling
@@ -183,35 +194,50 @@ class ContinuousDecoder:
         # backend) doesn't implement donation; gate to keep tests quiet.
         donate = jax.default_backend() != "cpu"
 
-        def _tick(params, tok, pos, active, cache):
-            logits, cache = decode_step_ragged(params, tok, pos, cache,
-                                               cfg, active)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            nxt = jnp.where(active, nxt, tok)
-            pos = jnp.where(active, pos + 1, pos)
-            return nxt, pos, cache
+        # ---- the decode tick: k ragged steps fused in one lax.scan ----
+        # (k = steps_per_dispatch; k=1 is the same program with a length-1
+        # scan). Per-slot retirement — the remaining counter and eos —
+        # runs INSIDE the scan, mirroring ``_note_token`` exactly, so a
+        # slot that finishes mid-scan stops advancing and the emitted
+        # streams are identical to k single-step ticks; the host reads the
+        # whole (k, S) token block in one fetch. One body serves greedy
+        # and sampled (the only difference is how ``nxt`` is chosen).
+        eos_const = None if self._eos is None else jnp.int32(self._eos)
 
-        # active (arg 3) is NOT donated: _tick doesn't return it, and the
-        # engine keeps its binding across ticks
-        self._tick = jax.jit(
-            _tick, donate_argnums=(1, 2, 4) if donate else ())
+        def _make_tick(sample: bool):
+            def tick(params, tok, pos, active, cache, remaining,
+                     temp=None, topk=None, topp=None, key=None):
+                def body(carry, _):
+                    tok, pos, active, cache, remaining = carry
+                    logits, cache = decode_step_ragged(
+                        params, tok, pos, cache, cfg, active)
+                    if sample:
+                        # emit position is pos+1 — generate_cached's key
+                        # schedule (fold_in by absolute emit position), so
+                        # sampled outputs are request-for-request
+                        # identical to the offline generator
+                        folded = jax.vmap(jax.random.fold_in)(key, pos + 1)
+                        nxt = _sample_rows(logits.astype(jnp.float32),
+                                           temp, topk, topp, folded)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(active, nxt, tok)
+                    pos = jnp.where(active, pos + 1, pos)
+                    remaining = jnp.where(active, remaining - 1, remaining)
+                    fin = remaining <= 0
+                    if eos_const is not None:
+                        fin = fin | (nxt == eos_const)
+                    active = active & ~fin
+                    return (nxt, pos, active, cache, remaining), nxt
+                carry, toks = jax.lax.scan(
+                    body, (tok, pos, active, cache, remaining), None,
+                    length=self._k)
+                return (*carry, toks)
+            return jax.jit(tick,
+                           donate_argnums=(1, 2, 3, 4, 5) if donate else ())
 
-        def _tick_sampled(params, tok, pos, active, cache,
-                          temp, topk, topp, key):
-            logits, cache = decode_step_ragged(params, tok, pos, cache,
-                                               cfg, active)
-            # emit position is pos+1 — generate_cached's key schedule
-            # (fold_in by absolute emit position), so sampled outputs are
-            # request-for-request identical to the offline generator
-            folded = jax.vmap(jax.random.fold_in)(key, pos + 1)
-            nxt = _sample_rows(logits.astype(jnp.float32),
-                               temp, topk, topp, folded)
-            nxt = jnp.where(active, nxt, tok)
-            pos = jnp.where(active, pos + 1, pos)
-            return nxt, pos, cache
-
-        self._tick_sampled = jax.jit(
-            _tick_sampled, donate_argnums=(1, 2, 4) if donate else ())
+        self._tick = _make_tick(sample=False)
+        self._tick_sampled = _make_tick(sample=True)
 
         # one compiled prefill per padded prompt bucket
         def _prefill(params, ids, length):
@@ -237,7 +263,8 @@ class ContinuousDecoder:
         self.stats = {"prefills": 0, "prefix_hits": 0}
 
         def _insert(cache, slot, row_cache, tok, pos, active,
-                    first_tok, length, sample_state, sample_row):
+                    first_tok, length, remaining, rem_val,
+                    sample_state, sample_row):
             for c, rc in zip(cache, row_cache):
                 for kk in ("k", "v"):
                     c[kk] = jax.lax.dynamic_update_slice(
@@ -245,14 +272,15 @@ class ContinuousDecoder:
             tok = tok.at[slot].set(first_tok)
             pos = pos.at[slot].set(length)
             active = active.at[slot].set(True)
+            remaining = remaining.at[slot].set(rem_val)
             temp, topk, topp, key = sample_state
             rt, rk, rp, rkey = sample_row
             sample_state = (temp.at[slot].set(rt), topk.at[slot].set(rk),
                             topp.at[slot].set(rp), key.at[slot].set(rkey))
-            return cache, tok, pos, active, sample_state
+            return cache, tok, pos, active, remaining, sample_state
 
         self._insert = jax.jit(
-            _insert, donate_argnums=(0, 2, 3, 4, 5, 8) if donate else ())
+            _insert, donate_argnums=(0, 2, 3, 4, 5, 8, 10) if donate else ())
 
     def _reset_device_state(self):
         """(Re)build every slot-pool device buffer — at construction and in
@@ -266,6 +294,9 @@ class ContinuousDecoder:
         self._tok = self._zeros((self._S,), jnp.int32)
         self._pos = self._zeros((self._S,), jnp.int32)
         self._active = self._zeros((self._S,), bool)
+        #: tokens each slot may still emit (drives in-scan retirement for
+        #: steps_per_dispatch > 1; maintained for k = 1 too)
+        self._remaining = self._zeros((self._S,), jnp.int32)
         # per-slot sampling state (all-greedy pools never touch it: step()
         # dispatches the cheaper greedy tick when no slot samples)
         self._temp = self._zeros((self._S,), jnp.float32)
@@ -426,11 +457,11 @@ class ContinuousDecoder:
         sample_row = (jnp.float32(req.temperature),
                       jnp.int32(req.top_k), jnp.float32(req.top_p),
                       base_key.astype(jnp.uint32))
-        (self._cache, self._tok, self._pos, self._active,
+        (self._cache, self._tok, self._pos, self._active, self._remaining,
          sample_state) = self._insert(
             self._cache, slot, row_cache, self._tok, self._pos,
-            self._active, first, jnp.int32(P), sample_state,
-            sample_row)
+            self._active, first, jnp.int32(P), self._remaining,
+            jnp.int32(req.max_new - 1), sample_state, sample_row)
         self._temp, self._topk, self._topp, self._key = sample_state
         # the prefill itself emitted the first new token
         self._note_token(req, int(first))
@@ -539,18 +570,30 @@ class ContinuousDecoder:
         if not live:
             return 0
         if any(self._slot_req[i].temperature > 0.0 for i in live):
-            self._tok, self._pos, self._cache = self._tick_sampled(
+            (self._tok, self._pos, self._active, self._cache,
+             self._remaining, toks) = self._tick_sampled(
                 self._params, self._tok, self._pos, self._active,
-                self._cache, self._temp, self._topk, self._topp, self._key)
+                self._cache, self._remaining,
+                self._temp, self._topk, self._topp, self._key)
         else:
-            self._tok, self._pos, self._cache = self._tick(
+            (self._tok, self._pos, self._active, self._cache,
+             self._remaining, toks) = self._tick(
                 self._params, self._tok, self._pos, self._active,
-                self._cache)
-        toks = np.asarray(self._tok)            # (S,) int32 — tiny fetch
+                self._cache, self._remaining)
+        # ONE fetch per dispatch: the (k, S) token block. Whether a slot
+        # emitted at scan step s needs no device mask — device retirement
+        # mirrors _note_token exactly, so a slot emits at s iff its
+        # request is not yet done host-side when s is replayed in order.
+        toks = np.asarray(toks)
+        for s in range(toks.shape[0]):
+            for i in live:
+                req = self._slot_req[i]
+                if req is None or req.done:
+                    continue
+                self._note_token(req, int(toks[s, i]))
         for i in live:
             req = self._slot_req[i]
-            self._note_token(req, int(toks[i]))
-            if req.done:
+            if req is not None and req.done:
                 self._release(i)
         return len(live)
 

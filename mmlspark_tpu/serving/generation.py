@@ -42,9 +42,11 @@ class GenerationEngine:
                  host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/generate",
                  reply_timeout: float = 120.0,
-                 transport: str = "threaded"):
-        self.decoder = ContinuousDecoder(params, cfg, max_slots=max_slots,
-                                         max_len=max_len, eos_id=eos_id)
+                 transport: str = "threaded",
+                 steps_per_dispatch: int = 1):
+        self.decoder = ContinuousDecoder(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            eos_id=eos_id, steps_per_dispatch=steps_per_dispatch)
         self.default_max_new = int(default_max_new)
         self.server = WorkerServer(host, port, api_path,
                                    reply_timeout=reply_timeout,

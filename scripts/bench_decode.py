@@ -124,7 +124,12 @@ def main():
     from mmlspark_tpu.serving.continuous import ContinuousDecoder
 
     n_req = _env_int("BENCH_DECODE_REQS", 2 * B)
-    eng = ContinuousDecoder(params, cfg, max_slots=B, max_len=P + T + 1)
+    # k decode steps per dispatch: behind the network-attached chip every
+    # dispatch pays ~RTT, which the r4 campaign showed dominating this
+    # bench (231 tok/s with the chip mostly idle)
+    k_steps = _env_int("BENCH_CB_STEPS", 8)
+    eng = ContinuousDecoder(params, cfg, max_slots=B, max_len=P + T + 1,
+                            steps_per_dispatch=k_steps)
     rng2 = np.random.default_rng(1)
     # warm both compiled programs (one prefill bucket + the ragged tick)
     w = eng.submit(rng2.integers(0, cfg.vocab, P), max_new_tokens=2)
@@ -142,6 +147,7 @@ def main():
         "metric": "decoder_continuous_batching_tokens_per_sec",
         "value": round(total_toks / dt, 1), "unit": "tokens/sec/chip",
         "slots": B, "requests": n_req, "prompt_len": P, "new_tokens": T,
+        "steps_per_dispatch": k_steps,
         "ttft_p50_ms": round(1e3 * sorted(ttft)[len(ttft) // 2], 1),
         "ttft_max_ms": round(1e3 * max(ttft), 1),
         "platform": jax.default_backend()}), flush=True)

@@ -63,8 +63,19 @@ def main():
             return round(len(X) / (time.perf_counter() - t0), 2)
 
     import contextlib
-    plain_ips = timed_ips(build(False), contextlib.nullcontext())
-    mesh_ips = timed_ips(build(True), MeshContext({"data": -1}))
+
+    # interleave the two modes and keep per-mode bests: behind the tunnel
+    # h2d bandwidth swings several-fold over minutes (BASELINE.md), so two
+    # back-to-back single runs measure the LINK drift, not the mesh-mode
+    # overhead (r4 campaign recorded 0.61x that way). Models build once;
+    # each round re-times the same transforms.
+    rounds = int(os.environ.get("BENCH_MESH_ROUNDS", "3"))
+    m_plain, m_mesh = build(False), build(True)
+    plain_runs, mesh_runs = [], []
+    for _ in range(rounds):
+        plain_runs.append(timed_ips(m_plain, contextlib.nullcontext()))
+        mesh_runs.append(timed_ips(m_mesh, MeshContext({"data": -1})))
+    plain_ips, mesh_ips = max(plain_runs), max(mesh_runs)
 
     d = jax.devices()[0]
     print(json.dumps({
@@ -72,6 +83,7 @@ def main():
         "plain_ips": plain_ips,
         "mesh_ips": mesh_ips,
         "ratio": round(mesh_ips / plain_ips, 3) if plain_ips else None,
+        "plain_runs": plain_runs, "mesh_runs": mesh_runs,
         "n_devices": len(jax.devices()),
         "platform": d.platform, "device": d.device_kind}), flush=True)
 

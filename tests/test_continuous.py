@@ -613,3 +613,81 @@ class TestBatchedAdmission:
         while not all(r.done for r in reqs):
             eng.step()
         assert all(len(r.tokens) == 1 for r in reqs)
+
+
+class TestMultiStepDispatch:
+    """``steps_per_dispatch=k``: k ragged decode steps fused into ONE
+    device dispatch (lax.scan) — behind a network-attached chip every
+    dispatch pays ~RTT, so the single-step engine is RTT-bound regardless
+    of chip speed. Token streams must be identical to k single-step
+    ticks: retirement (remaining counter + eos) happens inside the scan."""
+
+    def _run(self, params, k, prompts, maxnews, eos=None, **subkw):
+        eng = ContinuousDecoder(params, CFG, max_slots=3, max_len=48,
+                                steps_per_dispatch=k, eos_id=eos)
+        reqs = [eng.submit(p, max_new_tokens=m, **subkw)
+                for p, m in zip(prompts, maxnews)]
+        for _ in range(300):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        return [eng.result(r, timeout=5) for r in reqs]
+
+    def _workload(self, seed=0):
+        rng = np.random.default_rng(seed)
+        prompts = [rng.integers(0, CFG.vocab, int(rng.integers(3, 10)))
+                   for _ in range(7)]
+        return prompts, [5, 1, 9, 3, 12, 7, 2]
+
+    def test_greedy_identical_across_k(self, params):
+        prompts, maxnews = self._workload()
+        a = self._run(params, 1, prompts, maxnews)
+        assert self._run(params, 4, prompts, maxnews) == a
+        assert self._run(params, 7, prompts, maxnews) == a
+        # and each stream matches the offline generator
+        for p, m, got in zip(prompts, maxnews, a):
+            assert got == _reference_tokens(params, p, m)
+
+    def test_eos_retires_mid_scan(self, params):
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, CFG.vocab, 4)
+        full = _reference_tokens(params, prompt, 12)
+        # an eos whose FIRST occurrence is mid-scan for k=4 (index != 3)
+        stop = next(j for j in range(1, 12)
+                    if full[j] not in full[:j] and j % 4 != 3)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                steps_per_dispatch=4, eos_id=full[stop])
+        req = eng.submit(prompt, max_new_tokens=12)
+        while not req.done:
+            eng.step()
+        assert eng.result(req) == full[:stop + 1]
+        assert eng._slot_req == [None]
+
+    def test_sampled_identical_across_k(self, params):
+        prompts, maxnews = self._workload(seed=3)
+        a = self._run(params, 1, prompts, maxnews,
+                      temperature=0.8, top_k=10, seed=11)
+        b = self._run(params, 4, prompts, maxnews,
+                      temperature=0.8, top_k=10, seed=11)
+        assert a == b
+
+    def test_slot_turnover_with_queueing(self, params):
+        # more requests than slots: freed slots re-admit at dispatch
+        # granularity, results still exact
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, CFG.vocab, 3 + i % 5) for i in range(9)]
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                steps_per_dispatch=5)
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(300):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for p, r in zip(prompts, reqs):
+            assert eng.result(r) == _reference_tokens(params, p, 6)
+
+    def test_validation(self, params):
+        import pytest
+        with pytest.raises(ValueError, match="steps_per_dispatch"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              steps_per_dispatch=0)
