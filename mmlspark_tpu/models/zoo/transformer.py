@@ -687,3 +687,132 @@ def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
     # the final position's token comes from the last step's write; the scan
     # covers t = 0..L-2, emitting into positions P_len..L-1
     return ids
+
+
+def generate_beam(params: Dict, prompt_ids, cfg: TransformerConfig,
+                  max_new_tokens: int = 32, num_beams: int = 4,
+                  length_penalty: float = 1.0,
+                  eos_id: Optional[int] = None):
+    """Beam search over the cached decoder — one jitted program.
+
+    Standard HF-convention semantics with fully static shapes: the
+    prompt prefills once (:func:`prefill_cache`), beams fold into the
+    batch axis (B·W cache rows), and every step is (1) one ragged-free
+    ``decode_step``, (2) a (B, W·V) top-2W candidate scan — 2W because at
+    most W of them can be eos-extensions, so W live beams always survive
+    (the HF rationale) — and (3) a per-layer cache row gather to reorder
+    beams. Finished hypotheses bank into a static (B, W) pool scored by
+    ``sum_logprob / len**length_penalty``; the final answer is the best
+    of banked + still-live beams. With ``num_beams=1`` and no eos this
+    reduces exactly to greedy :func:`generate_cached`.
+
+    Returns ``(ids (B, P+max_new), scores (B,))`` — the best hypothesis
+    per batch row, prompt included, padded with ``eos_id`` (or the last
+    token) past each hypothesis' end.
+    """
+    if not cfg.causal:
+        raise ValueError("generate_beam() needs cfg.causal=True")
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    if num_beams > cfg.vocab:
+        raise ValueError(f"num_beams {num_beams} exceeds vocab {cfg.vocab} "
+                         "(only vocab distinct first tokens exist)")
+    params = jax.tree.map(jnp.asarray, params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P_len = prompt_ids.shape
+    if P_len < 1:
+        raise ValueError("generate_beam() needs at least one prompt token")
+    W, V, M = int(num_beams), cfg.vocab, int(max_new_tokens)
+    L = P_len + M
+    if L > cfg.max_len and cfg.position == "learned":
+        raise ValueError(f"prompt+new = {L} exceeds max_len {cfg.max_len}")
+
+    def penalize(score, length):
+        return score / (length.astype(jnp.float32) ** jnp.float32(
+            length_penalty))
+
+    # prefill once per batch row, then replicate every cache row W times
+    logits0, cache = prefill_cache(
+        params, prompt_ids, jnp.full((B,), P_len, jnp.int32), cfg, L)
+    cache = [{k: jnp.repeat(c[k], W, axis=0) for k in ("k", "v")}
+             for c in cache]
+    logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
+    batch_ix = jnp.arange(B)[:, None]                       # (B, 1)
+    # first step follows the same top-2W discipline as the loop: an eos
+    # among the top-W banks AND its live slot refills from the next-best
+    # non-eos token (taking only top-W here would let a first-step eos
+    # permanently narrow the beam). k0 caps at V; when W == V and eos
+    # ranks, one live slot legitimately dies (-inf) — V-1 non-eos first
+    # tokens exist.
+    k0 = min(2 * W, V)
+    c_scores, c_tok = jax.lax.top_k(logp0, k0)              # (B, k0)
+    c_seqs = jnp.zeros((B, k0, M), jnp.int32).at[:, :, 0].set(c_tok)
+    fin_scores = jnp.full((B, W), -jnp.inf)
+    fin_seqs = jnp.zeros((B, W, M), jnp.int32)
+    if eos_id is not None:
+        c_eos = c_tok == eos_id
+        bank = jnp.where(c_eos, penalize(c_scores, jnp.int32(1)), -jnp.inf)
+        fin_scores, keep = jax.lax.top_k(bank, W)           # W <= k0 always
+        fin_seqs = c_seqs[batch_ix, keep]
+        live_key0 = jnp.where(c_eos, -jnp.inf, c_scores)
+    else:
+        live_key0 = c_scores
+    scores, pick0 = jax.lax.top_k(live_key0, W)             # W <= k0
+    tok0 = c_tok[batch_ix, pick0]
+    seqs = c_seqs[batch_ix, pick0]
+    tok = tok0.reshape(B * W)
+
+    def step(carry, t):
+        seqs, scores, fin_scores, fin_seqs, tok, cache = carry
+        logits, cache = decode_step(params, tok, P_len + t - 1, cache, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        cand = scores[:, :, None] + logp.reshape(B, W, V)   # (B, W, V)
+        c_scores, c_idx = jax.lax.top_k(cand.reshape(B, W * V), 2 * W)
+        c_parent = c_idx // V                               # (B, 2W)
+        c_tok = (c_idx % V).astype(jnp.int32)
+        c_seqs = seqs[batch_ix, c_parent]                   # (B, 2W, M)
+        c_seqs = jnp.where(jnp.arange(M)[None, None] == t,
+                           c_tok[:, :, None], c_seqs)
+        if eos_id is not None:
+            c_eos = c_tok == eos_id
+            # bank eos candidates (penalized), keep the best W of old+new
+            pool_s = jnp.concatenate(
+                [fin_scores,
+                 jnp.where(c_eos, penalize(c_scores, t + 1), -jnp.inf)],
+                axis=1)                                     # (B, 3W)
+            pool_q = jnp.concatenate([fin_seqs, c_seqs], axis=1)
+            fin_scores, keep = jax.lax.top_k(pool_s, W)
+            fin_seqs = pool_q[batch_ix, keep]
+            live_key = jnp.where(c_eos, -jnp.inf, c_scores)
+        else:
+            live_key = c_scores
+        # top-W live (non-eos) continuations — ≥ W exist among the 2W
+        scores, pick = jax.lax.top_k(live_key, W)
+        parent = c_parent[batch_ix, pick]                   # (B, W)
+        seqs = c_seqs[batch_ix, pick]
+        tok = c_tok[batch_ix, pick].reshape(B * W)
+        # reorder the cache rows onto the surviving beams
+        rows = (jnp.arange(B)[:, None] * W + parent).reshape(B * W)
+        cache = [{k: c[k][rows] for k in ("k", "v")} for c in cache]
+        return (seqs, scores, fin_scores, fin_seqs, tok, cache), None
+
+    if M > 1:
+        (seqs, scores, fin_scores, fin_seqs, tok, cache), _ = jax.lax.scan(
+            step, (seqs, scores, fin_scores, fin_seqs, tok, cache),
+            jnp.arange(1, M))
+
+    # final pool: banked hypotheses + live beams at full length
+    all_s = jnp.concatenate(
+        [fin_scores, penalize(scores, jnp.int32(M))], axis=1)  # (B, 2W)
+    all_q = jnp.concatenate([fin_seqs, seqs], axis=1)
+    best = jnp.argmax(all_s, axis=1)
+    best_seq = all_q[jnp.arange(B), best]                   # (B, M)
+    best_score = all_s[jnp.arange(B), best]
+    if eos_id is not None:
+        # pad past each hypothesis' eos with eos (generate()'s convention)
+        hit = jnp.cumsum(
+            (best_seq == eos_id).astype(jnp.int32), axis=1) > 0
+        after = jnp.pad(hit, ((0, 0), (1, 0)))[:, :-1]      # strictly after
+        best_seq = jnp.where(after, eos_id, best_seq)
+    ids = jnp.concatenate([prompt_ids.astype(jnp.int32), best_seq], axis=1)
+    return ids, best_score

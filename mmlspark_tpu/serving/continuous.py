@@ -35,9 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.zoo.transformer import (TransformerConfig, _sample_logits,
-                                      decode_step_ragged, prefill_cache,
-                                      shardings_for)
+from ..models.zoo.transformer import (TransformerConfig, decode_step_ragged,
+                                      prefill_cache, shardings_for)
 from ..ops.padding import bucket_size
 
 
@@ -262,25 +261,45 @@ class ContinuousDecoder:
         #: observability: prefill vs prefix-hit counts (tests + ops)
         self.stats = {"prefills": 0, "prefix_hits": 0}
 
-        def _insert(cache, slot, row_cache, tok, pos, active,
-                    first_tok, length, remaining, rem_val,
-                    sample_state, sample_row):
+        # group insert: ALL rows admitted from one prefill land in one
+        # compiled call (slots is a (g,) vector, g gets its own tiny
+        # program — bounded by max_slots), and their first tokens compute
+        # on device in the same batch, so admission costs ONE dispatch +
+        # ONE fetch instead of one sync per request (each ~RTT behind the
+        # tunnel). row_cache is NOT donated: rows arrive as slices of the
+        # prefill output and a copy of g rows is cheaper than the sync.
+        def _insert_group(cache, slots, row_cache, tok, pos, active,
+                          remaining, firsts, lengths, rems,
+                          sample_state, sample_rows):
+            g = slots.shape[0]
             for c, rc in zip(cache, row_cache):
                 for kk in ("k", "v"):
-                    c[kk] = jax.lax.dynamic_update_slice(
-                        c[kk], rc[kk], (slot, 0, 0, 0))
-            tok = tok.at[slot].set(first_tok)
-            pos = pos.at[slot].set(length)
-            active = active.at[slot].set(True)
-            remaining = remaining.at[slot].set(rem_val)
+                    for i in range(g):            # g static: unrolled
+                        c[kk] = jax.lax.dynamic_update_slice(
+                            c[kk], rc[kk][i:i + 1], (slots[i], 0, 0, 0))
+            tok = tok.at[slots].set(firsts)
+            pos = pos.at[slots].set(lengths)
+            active = active.at[slots].set(True)
+            remaining = remaining.at[slots].set(rems)
             temp, topk, topp, key = sample_state
-            rt, rk, rp, rkey = sample_row
-            sample_state = (temp.at[slot].set(rt), topk.at[slot].set(rk),
-                            topp.at[slot].set(rp), key.at[slot].set(rkey))
+            rt, rk, rp, rkey = sample_rows
+            sample_state = (temp.at[slots].set(rt), topk.at[slots].set(rk),
+                            topp.at[slots].set(rp), key.at[slots].set(rkey))
             return cache, tok, pos, active, remaining, sample_state
 
-        self._insert = jax.jit(
-            _insert, donate_argnums=(0, 2, 3, 4, 5, 8, 10) if donate else ())
+        self._insert_group_j = jax.jit(
+            _insert_group,
+            donate_argnums=(0, 3, 4, 5, 6, 10) if donate else ())
+
+        # first emitted token for every prefilled row, on device: position
+        # P_i sampled with fold_in(key_i, P_i) — generate_cached's exact
+        # schedule (temp <= 0 rows reduce to argmax inside _sample_rows)
+        def _first_tokens(logits, temps, topks, topps, keys, lengths):
+            folded = jax.vmap(jax.random.fold_in)(keys, lengths)
+            return _sample_rows(logits.astype(jnp.float32),
+                                temps, topks, topps, folded)
+
+        self._first_tokens = jax.jit(_first_tokens)
 
     def _reset_device_state(self):
         """(Re)build every slot-pool device buffer — at construction and in
@@ -411,12 +430,7 @@ class ContinuousDecoder:
                 logits, row_cache = self._prefill(
                     self._params, jnp.asarray(ids), jnp.asarray(lengths))
                 self.stats["prefills"] += 1
-                # slice every row BEFORE inserting: _insert donates its
-                # row cache, and slices of a donated parent are invalid
-                rows = [[{kk: c[kk][i:i + 1] for kk in ("k", "v")}
-                         for c in row_cache] for i in range(len(group))]
-                for i, (slot, req) in enumerate(group):
-                    self._insert_row(slot, req, logits[i:i + 1], rows[i])
+                self._insert_rows(group, logits, row_cache)
 
             for slot, req in prefixed:
                 try:
@@ -435,38 +449,42 @@ class ContinuousDecoder:
                     req.event.set()
                     self._release(slot)
                     continue
-                self._insert_row(slot, req, logits, row_cache)
+                self._insert_rows([(slot, req)], logits, row_cache)
             # loop: slots may have freed (eos/max_new on the first token)
             # while waiters remain — constant stack, unlike recursion
 
-    def _insert_row(self, slot: int, req: _Request, logits, row_cache):
-        """First-token sampling + slot insertion for one admitted row."""
-        P = req.prompt.size
-        base_key = jax.random.PRNGKey(req.seed)
-        if req.temperature > 0.0:
-            # exact generate_cached schedule: the token at position P
-            # is sampled with fold_in(key0, P)
-            first = _sample_logits(
-                logits.astype(jnp.float32),
-                jax.random.fold_in(base_key, P),
-                req.temperature, req.top_k, req.top_p)[0]
-            first = first.astype(jnp.int32)
-        else:
-            first = jnp.argmax(logits[0]).astype(jnp.int32)
+    def _insert_rows(self, group, logits, row_cache):
+        """Slot insertion + first-token emission for an admitted group.
+
+        One device dispatch (``_insert_group_j``) and ONE host fetch for
+        the whole group — admission used to sync once per request, which
+        over the tunnel cost ~RTT each. ``logits``/``row_cache`` may carry
+        pad rows past ``len(group)``; only the first g rows are used."""
+        g = len(group)
+        slots_v = jnp.asarray([s for s, _ in group], jnp.int32)
+        lens_v = jnp.asarray([r.prompt.size for _, r in group], jnp.int32)
+        rems_v = jnp.asarray([r.max_new - 1 for _, r in group], jnp.int32)
+        temps_v = jnp.asarray([r.temperature for _, r in group], jnp.float32)
+        topks_v = jnp.asarray([r.top_k for _, r in group], jnp.int32)
+        topps_v = jnp.asarray([r.top_p for _, r in group], jnp.float32)
+        keys_v = jnp.stack([jax.random.PRNGKey(r.seed)
+                            for _, r in group]).astype(jnp.uint32)
+        firsts = self._first_tokens(logits[:g], temps_v, topks_v, topps_v,
+                                    keys_v, lens_v)
+        rows = [{kk: c[kk][:g] for kk in ("k", "v")} for c in row_cache]
         sample_state = (self._temp, self._topk, self._topp, self._key)
-        sample_row = (jnp.float32(req.temperature),
-                      jnp.int32(req.top_k), jnp.float32(req.top_p),
-                      base_key.astype(jnp.uint32))
         (self._cache, self._tok, self._pos, self._active, self._remaining,
-         sample_state) = self._insert(
-            self._cache, slot, row_cache, self._tok, self._pos,
-            self._active, first, jnp.int32(P), self._remaining,
-            jnp.int32(req.max_new - 1), sample_state, sample_row)
+         sample_state) = self._insert_group_j(
+            self._cache, slots_v, rows, self._tok, self._pos,
+            self._active, self._remaining, firsts, lens_v, rems_v,
+            sample_state, (temps_v, topks_v, topps_v, keys_v))
         self._temp, self._topk, self._topp, self._key = sample_state
-        # the prefill itself emitted the first new token
-        self._note_token(req, int(first))
-        if req.done:
-            self._release(slot)
+        firsts = np.asarray(firsts)              # the group's ONE fetch
+        for i, (slot, req) in enumerate(group):
+            # the prefill itself emitted the first new token
+            self._note_token(req, int(firsts[i]))
+            if req.done:
+                self._release(slot)
 
     def _bucket(self, n: int, cap: Optional[int] = None) -> int:
         """THE pad-bucket policy (batched admission, prefix suffix
@@ -507,8 +525,9 @@ class ContinuousDecoder:
             # garbage K/V a padded row writes sits at positions the
             # engine overwrites before any mask ever exposes them.
             # The snapshot passes to _extend as-is: the jit has no
-            # donation, so its inputs are never consumed — _insert later
-            # donates _extend's OUTPUT, not the snapshot.
+            # donation, so its inputs are never consumed (and the group
+            # insert does not donate its row_cache arg either — rows are
+            # copied into the slot pool).
             start = plen if P > plen else plen - 1
             suffix = req.prompt[start:]
             S = suffix.size
