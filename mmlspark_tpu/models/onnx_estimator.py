@@ -75,6 +75,14 @@ class ONNXEstimator(Estimator):
                              doc="train only params whose name starts "
                                  "with one of these (empty = all); the "
                                  "frozen-backbone cut-layer pattern")
+    lora_rank = Param(int, default=0,
+                      doc="LoRA: train rank-r adapters over the 2-D "
+                          "weights instead of the weights themselves "
+                          "(0 = full fine-tuning); merged deltas serve "
+                          "through weights_override like any fine-tune")
+    lora_alpha = Param(float, default=0.0,
+                       doc="LoRA delta scale alpha (alpha/rank * a@b); "
+                           "0 = rank (scale 1)")
     mini_batch_size = Param(int, default=64,
                             doc="fitted model's inference batch size")
     softmax_dict = Param(dict, default={}, doc="fitted model passthrough")
@@ -181,11 +189,41 @@ class ONNXEstimator(Estimator):
         trainable = (None if not prefixes else
                      (lambda name: any(name.startswith(p)
                                        for p in prefixes)))
-        step, init = make_train_step(cm, opt, loss_fn=loss_fn,
-                                     output=loss_output,
-                                     trainable=trainable)
-        params = {k: jnp.asarray(v) for k, v in cm.params.items()}
-        opt_state = init(params)
+        lora_rank = int(self.lora_rank)
+        lora_names = None
+        if lora_rank > 0:
+            # adapters train instead of the weights; trainable_prefix
+            # narrows WHICH matrices get adapters
+            from ..onnx.train import (init_lora, lora_merge,
+                                      lora_targets, make_lora_train_step)
+            lora_names = lora_targets(cm, lora_rank, trainable)
+            state = init_lora(cm, lora_rank, targets=lora_names,
+                              seed=int(self.seed))
+            alpha = float(self.lora_alpha) or float(lora_rank)
+            l_step, l_init = make_lora_train_step(
+                cm, opt, alpha=alpha, loss_fn=loss_fn, output=loss_output)
+            base = {k: jnp.asarray(v) for k, v in cm.params.items()}
+            opt_state = l_init(state)
+            # base travels as a jit ARGUMENT (a closure would bake every
+            # frozen matrix into the executable as constants — doubling
+            # base memory in exactly the large-model regime LoRA targets)
+            merged = jax.jit(lambda b, lo: lora_merge(b, lo, alpha))
+
+            def do_step(state, opt_state, feeds):
+                return l_step(base, state, opt_state, feeds)
+
+            def params_of(state):
+                return merged(base, state)
+        else:
+            step, init = make_train_step(cm, opt, loss_fn=loss_fn,
+                                         output=loss_output,
+                                         trainable=trainable)
+            state = {k: jnp.asarray(v) for k, v in cm.params.items()}
+            opt_state = init(state)
+            do_step = step
+
+            def params_of(state):
+                return state
 
         val_loss_fn = None
         if val_feeds is not None:
@@ -225,25 +263,30 @@ class ONNXEstimator(Estimator):
                     feeds[label_input] = y[sel]
                 else:
                     feeds["__labels__"] = y[sel]
-                params, opt_state, val = step(params, opt_state, feeds)
+                state, opt_state, val = do_step(state, opt_state, feeds)
                 if log is not None:
                     log.append(float(val))
             if val_feeds is not None:
-                vl = float(val_loss_fn(params))
+                vl = float(val_loss_fn(params_of(state)))
                 if log is not None:
                     log.append({"epoch": ep, "val_loss": vl})
                 if vl < best_val - 1e-12:
                     best_val = vl
                     since_best = 0
                     if patience:
-                        best_params = {k: np.asarray(v)
-                                       for k, v in params.items()}
+                        # LoRA snapshots are the tiny adapter tree
+                        best_params = jax.tree.map(np.asarray, state)
                 else:
                     since_best += 1
                     if patience and since_best >= patience:
                         break
         if best_params is not None:
-            params = best_params
+            state = best_params
+        params = params_of(state)
+        if lora_names is not None:
+            # the override only needs the adapted matrices; everything
+            # else layers from the graph's own initializers
+            params = {k: params[k] for k in lora_names}
 
         buf = io.BytesIO()
         np.savez(buf, **{k: np.asarray(v) for k, v in params.items()})

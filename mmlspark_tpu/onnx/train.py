@@ -28,7 +28,9 @@ import jax.numpy as jnp
 
 from .convert import ConvertedModel
 
-__all__ = ["value_and_grad", "make_train_step", "fine_tune"]
+__all__ = ["value_and_grad", "make_train_step", "fine_tune",
+           "lora_targets", "init_lora", "lora_merge",
+           "make_lora_train_step", "lora_fine_tune"]
 
 
 def _scalar_loss(model: ConvertedModel, loss_fn, output: Optional[str]):
@@ -119,3 +121,131 @@ def fine_tune(model: ConvertedModel, feeds_iter, optimizer=None,
         params, opt_state, val = step(params, opt_state, feeds)
         losses.append(float(val))
     return params, losses
+
+
+# ---- LoRA: low-rank adapters over imported graphs -------------------------
+# Full fine-tuning updates every n×m weight and carries an optimizer state
+# of the same size; a LoRA adapter trains rank·(n+m) parameters per matrix
+# instead — on TPU that shrinks the optimizer state and per-step update
+# traffic by orders of magnitude, and the frozen base composes with
+# serving-side weight-only int8 (merge first, then quantize). The merged
+# deltas serve through ONNXModel's existing ``weights_override`` layering,
+# so inference needs no adapter-aware code path.
+
+
+def lora_targets(model: ConvertedModel, rank: int,
+                 trainable: Optional[Callable[[str], bool]] = None):
+    """Params eligible for adaptation: 2-D float weights with both dims
+    larger than ``rank`` (a low-rank delta on anything smaller would cost
+    more than the dense update), filtered by ``trainable``."""
+    import numpy as np
+    out = []
+    for k, v in model.params.items():
+        a = np.asarray(v)
+        if (a.ndim == 2 and a.dtype.kind == "f" and min(a.shape) > rank
+                and (trainable is None or trainable(k))):
+            out.append(k)
+    return sorted(out)
+
+
+def init_lora(model: ConvertedModel, rank: int,
+              targets=None, seed: int = 0) -> Dict:
+    """Fresh adapters {name: {"a": (n, r), "b": (r, m)}}: ``a`` fan-in
+    gaussian, ``b`` zeros, so the initial delta is exactly zero and the
+    first forward equals the imported graph."""
+    if rank < 1:
+        raise ValueError("rank must be >= 1")
+    targets = (lora_targets(model, rank) if targets is None
+               else sorted(targets))
+    if not targets:
+        raise ValueError(
+            f"no 2-D params wider than rank {rank} to adapt (an explicit "
+            "targets= / trainable filter may have excluded every matrix)")
+    unknown = [t for t in targets if t not in model.params]
+    if unknown:
+        raise ValueError(f"unknown target params {unknown[:5]}")
+    import numpy as _np
+    bad = [t for t in targets if _np.asarray(model.params[t]).ndim != 2]
+    if bad:
+        raise ValueError(f"LoRA targets must be 2-D weights; {bad[:5]} "
+                         "are not")
+    key = jax.random.PRNGKey(seed)
+    lora = {}
+    for i, k in enumerate(targets):
+        n, m = model.params[k].shape
+        lora[k] = {
+            "a": (jax.random.normal(jax.random.fold_in(key, i), (n, rank),
+                                    jnp.float32) / jnp.sqrt(n)),
+            "b": jnp.zeros((rank, m), jnp.float32),
+        }
+    return lora
+
+
+def lora_merge(params: Dict, lora: Dict, alpha: float) -> Dict:
+    """Base params with every adapter's ``(alpha/rank)·a@b`` delta folded
+    in — the artifact that serves (and quantizes) like any fine-tune."""
+    out = dict(params)
+    for k, ab in lora.items():
+        r = ab["a"].shape[1]
+        delta = (jnp.float32(alpha / r)
+                 * (ab["a"] @ ab["b"])).astype(out[k].dtype)
+        out[k] = out[k] + delta
+    return out
+
+
+def make_lora_train_step(model: ConvertedModel, optimizer,
+                         alpha: Optional[float] = None,
+                         loss_fn: Optional[Callable] = None,
+                         output: Optional[str] = None):
+    """One jitted LoRA step: gradients flow ONLY into the adapters
+    (``base`` is a frozen argument, never updated, so its optimizer state
+    is never allocated). ``alpha`` defaults to the adapters' rank (scale
+    1). Returns ``(step, init)`` with
+    ``step(base, lora, opt_state, feeds) -> (lora, opt_state, loss)``.
+    """
+    loss = _scalar_loss(model, loss_fn, output)
+
+    @jax.jit
+    def step(base, lora, opt_state, feeds):
+        import optax
+        rank = next(iter(lora.values()))["a"].shape[1]
+        scale = rank if alpha is None else alpha
+
+        def lora_loss(lora_):
+            return loss(lora_merge(base, lora_, scale), feeds)
+
+        val, grads = jax.value_and_grad(lora_loss)(lora)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        return optax.apply_updates(lora, updates), opt_state, val
+
+    def init(lora):
+        return optimizer.init(jax.tree.map(jnp.asarray, lora))
+
+    return step, init
+
+
+def lora_fine_tune(model: ConvertedModel, feeds_iter, rank: int = 8,
+                   optimizer=None, alpha: Optional[float] = None,
+                   loss_fn: Optional[Callable] = None,
+                   output: Optional[str] = None,
+                   targets=None, seed: int = 0,
+                   steps: Optional[int] = None):
+    """Convenience loop mirroring :func:`fine_tune`; returns
+    ``(merged_params, lora, losses)`` — serve ``merged_params`` (or just
+    the adapted names) via ``ONNXModel.weights_override``."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.adam(1e-3)
+    lora = init_lora(model, rank, targets=targets, seed=seed)
+    step, init = make_lora_train_step(model, optimizer, alpha=alpha,
+                                      loss_fn=loss_fn, output=output)
+    base = {k: jnp.asarray(v) for k, v in model.params.items()}
+    opt_state = init(lora)
+    losses = []
+    for i, feeds in enumerate(feeds_iter):
+        if steps is not None and i >= steps:
+            break
+        lora, opt_state, val = step(base, lora, opt_state, feeds)
+        losses.append(float(val))
+    scale = (rank if alpha is None else alpha)
+    return lora_merge(base, lora, scale), lora, losses

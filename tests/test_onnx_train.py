@@ -319,3 +319,97 @@ class TestEstimatorEarlyStopping:
                           loss_output="loss", label_input="labels",
                           early_stopping_epochs=2, epochs=3,
                           batch_size=32).fit(df)
+
+
+class TestLoRA:
+    """Low-rank adapters over imported graphs (``onnx.train.lora_*``).
+
+    The base stays frozen (bit-identical before/after), only rank·(n+m)
+    adapter params train, and the merged deltas serve through the same
+    ``weights_override`` layering full fine-tuning uses."""
+
+    def test_zero_init_is_identity(self):
+        from mmlspark_tpu.onnx.train import init_lora, lora_merge
+        cm = convert_model(mlp_with_loss())
+        lora = init_lora(cm, rank=2)
+        merged = lora_merge({k: np.asarray(v) for k, v in cm.params.items()},
+                            lora, alpha=2.0)
+        for k in cm.params:
+            np.testing.assert_array_equal(np.asarray(merged[k]),
+                                          np.asarray(cm.params[k]))
+
+    def test_targets_are_2d_and_wide_enough(self):
+        from mmlspark_tpu.onnx.train import lora_targets
+        cm = convert_model(mlp_with_loss())     # w1 (6,8), w2 (8,3), biases
+        assert lora_targets(cm, 2) == ["w1", "w2"]
+        assert lora_targets(cm, 4) == ["w1"]    # w2's min dim is 3
+        assert lora_targets(cm, 2, lambda n: n == "w2") == ["w2"]
+
+    def test_lora_learns_and_base_stays_frozen(self):
+        from mmlspark_tpu.onnx.train import lora_fine_tune
+        cm = convert_model(mlp_with_loss())
+        X, y = toy_data(256, seed=2)
+        base_before = {k: np.asarray(v).copy() for k, v in cm.params.items()}
+
+        def batches():
+            rng = np.random.default_rng(0)
+            for _ in range(60):
+                sel = rng.choice(len(X), 64, replace=False)
+                yield {"x": X[sel], "labels": y[sel]}
+
+        import optax
+        merged, lora, losses = lora_fine_tune(cm, batches(), rank=3,
+                                              optimizer=optax.adam(5e-2),
+                                              output="loss")
+        assert losses[-1] < 0.5 * losses[0]
+        for k, v in cm.params.items():          # base untouched
+            np.testing.assert_array_equal(np.asarray(v), base_before[k])
+        # adapters only touch the 2-D targets; biases are bit-identical
+        np.testing.assert_array_equal(np.asarray(merged["b1"]),
+                                      base_before["b1"])
+        assert not np.array_equal(np.asarray(merged["w1"]),
+                                  base_before["w1"])
+        # adapter param count is rank*(n+m) per target, way under full
+        n_adapter = sum(int(np.prod(ab["a"].shape))
+                        + int(np.prod(ab["b"].shape))
+                        for ab in lora.values())
+        n_full = sum(int(np.prod(np.asarray(v).shape))
+                     for k, v in cm.params.items() if k in ("w1", "w2"))
+        assert n_adapter < n_full
+
+    def test_estimator_lora_mode(self):
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.onnx_estimator import ONNXEstimator
+        X, y = toy_data(128, seed=7)
+        col = np.empty(len(X), dtype=object)
+        col[:] = list(X)
+        df = DataFrame({"features": col, "label": y})
+        log = []
+        est = ONNXEstimator(mlp_with_loss(),
+                            feed_dict={"x": "features"},
+                            fetch_dict={"logits": "logits"},
+                            argmax_dict={"pred": "logits"},
+                            loss_output="loss", label_input="labels",
+                            epochs=60, batch_size=32, learning_rate=1e-1,
+                            lora_rank=2, eval_log=log)
+        model = est.fit(df)
+        assert log[-1] < log[0] * 0.6, (log[0], log[-1])
+        acc = (np.asarray(model.transform(df)["pred"], dtype=np.int64)
+               == y).mean()
+        assert acc > 0.8, acc
+        # the override carries ONLY the adapted matrices
+        import io as _io
+        with np.load(_io.BytesIO(model.get("weights_override"))) as z:
+            assert sorted(z.files) == ["w1", "w2"]
+
+    def test_validation(self):
+        from mmlspark_tpu.onnx.train import init_lora
+        cm = convert_model(mlp_with_loss())
+        with pytest.raises(ValueError, match="rank"):
+            init_lora(cm, rank=0)
+        with pytest.raises(ValueError, match="unknown"):
+            init_lora(cm, rank=2, targets=["nope"])
+        with pytest.raises(ValueError, match="no 2-D"):
+            init_lora(cm, rank=100)
+        with pytest.raises(ValueError, match="2-D"):
+            init_lora(cm, rank=2, targets=["b1"])   # 1-D bias
