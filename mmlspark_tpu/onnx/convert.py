@@ -927,6 +927,77 @@ def _conv(node, inputs, ctx):
     return out
 
 
+@register_op("FusedConv")
+def _fused_conv(node, inputs, ctx):
+    """ORT contrib ``com.microsoft.FusedConv``: Conv (+ optional residual
+    ``Z`` input) with the activation folded in by ORT's CNN graph
+    optimizer — optimized CNN exports carry these instead of Conv+Relu
+    pairs. XLA fuses the activation anyway; the handler exists so such
+    graphs load at all."""
+    out = _conv(node, inputs[:3], ctx)
+    if len(inputs) > 3 and inputs[3] is not None:
+        out = out + inputs[3]
+    act = node.attr("activation", "")
+    if isinstance(act, bytes):
+        act = act.decode()
+    p = [float(v) for v in node.attr("activation_params", [])]
+    if not act:
+        return out
+    if act == "Relu":
+        return jnp.maximum(out, 0)
+    if act == "Tanh":
+        return jnp.tanh(out)
+    if act == "Sigmoid":
+        return jax.nn.sigmoid(out)
+    if act == "LeakyRelu":
+        alpha = p[0] if p else 0.01
+        return jnp.where(out < 0, alpha * out, out)
+    if act == "Clip":
+        return jnp.clip(out, p[0], p[1])
+    if act == "HardSigmoid":
+        a = p[0] if len(p) > 0 else 0.2
+        b = p[1] if len(p) > 1 else 0.5
+        return jnp.clip(a * out + b, 0.0, 1.0)
+    raise UnsupportedOp(f"FusedConv activation {act!r}")
+
+
+@register_op("RelativePositionBias")
+def _relative_position_bias(node, inputs, ctx):
+    """ORT contrib ``com.microsoft.RelativePositionBias`` — T5's bucketed
+    relative attention bias as one op (T5 exports through ORT's
+    transformer optimizer carry it). Output (1, num_heads, q_len, k_len)
+    gathered from the (num_buckets, num_heads) bias table with the T5
+    log-bucketing: near offsets get exact buckets, far offsets share
+    logarithmically-spaced ones up to ``max_distance``."""
+    table = jnp.asarray(inputs[0])               # (num_buckets, num_heads)
+    q_len = int(np.asarray(_concrete(inputs[1], "RelativePositionBias "
+                                     "query_length")).ravel()[0])
+    k_len = int(np.asarray(_concrete(inputs[2], "RelativePositionBias "
+                                     "key_length")).ravel()[0])
+    num_buckets = int(table.shape[0])
+    max_distance = int(node.attr("max_distance", 128))
+    bidirectional = bool(node.attr("is_bidirectional", 0))
+    context = jnp.arange(q_len)[:, None]
+    memory = jnp.arange(k_len)[None, :]
+    n = context - memory                         # = -(memory - context)
+    ret = jnp.zeros((q_len, k_len), jnp.int32)
+    nb = num_buckets
+    if bidirectional:
+        nb = num_buckets // 2
+        ret = ret + (n < 0).astype(jnp.int32) * nb
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = nb // 2
+    large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (nb - max_exact)).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    bucket = ret + jnp.where(n < max_exact, n, large)
+    return table[bucket].transpose(2, 0, 1)[None]    # (1, H, q, k)
+
+
 @register_op("ConvTranspose")
 def _conv_transpose(node, inputs, ctx):
     x, w = inputs[0], inputs[1]
@@ -2336,3 +2407,4 @@ def convert_model(model_bytes: bytes,
 from . import ml_ops  # noqa: E402,F401
 # long-tail standard ops (audio/DSP, integer-quantized, RNN, losses, ...)
 from . import extra_ops  # noqa: E402,F401
+from . import generation_ops  # noqa: E402,F401

@@ -1,0 +1,335 @@
+"""ORT generation meta-ops: ``com.microsoft.GreedySearch`` / ``BeamSearch``.
+
+onnxruntime's ``convert_generation`` tool wraps a GPT-style decoder
+subgraph in a single node that runs the whole autoregressive loop inside
+the session — the reference executes such models opaquely through ORT
+(``deep-learning/.../onnx/ONNXModel.scala:330``). Here the loop lowers to
+``lax.scan`` over the converted subgraph with STATIC shapes throughout:
+
+* the KV ``past_*`` state lives in fixed (2, B, H, max_length, hd)
+  buffers; each step traces the subgraph once at a padded past length and
+  the ``attention_mask`` input hides the unwritten tail, so the compiled
+  program count is 2 (prefill + step) regardless of sequence length —
+  the same padded-cache discipline the zoo's continuous engine uses;
+* the step's fresh K/V arrive as the LAST row of the subgraph's
+  ``present_*`` outputs and scatter into the buffers at the true length;
+* beams fold into the batch axis with per-layer row gathers on reorder
+  (the ``zoo.transformer.generate_beam`` formulation applied to an
+  imported subgraph).
+
+Subgraph contract (``model_type = 0``, the GPT one): inputs
+``input_ids (B, S) · position_ids (B, S) · attention_mask (B, total)``
+then ``past_0..past_{L-1}`` each (2, B, H, past_len, hd); outputs
+``logits (B, S, V)`` then ``present_0..`` each (2, B, H, past_len+S, hd).
+The mask must gate attention scores (ORT's exported subgraphs do), which
+is exactly what makes padded pasts sound.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .convert import UnsupportedOp, _concrete, register_op
+from .proto import ONNX_TO_NUMPY
+
+__all__ = []
+
+
+def _static_int(v, what, default=None):
+    if v is None:
+        if default is None:
+            raise UnsupportedOp(f"{what} is required")
+        return int(default)
+    return int(np.asarray(_concrete(v, what)).ravel()[0])
+
+
+def _static_float(v, what, default):
+    if v is None:
+        return float(default)
+    return float(np.asarray(_concrete(v, what)).ravel()[0])
+
+
+class _Decoder:
+    """The converted GPT-style subgraph plus everything derived from its
+    declared signature (layer count, head/geometry, mask dtype)."""
+
+    def __init__(self, node, ctx, max_length: int):
+        graph = node.attr("decoder")
+        if graph is None:
+            raise UnsupportedOp("GreedySearch/BeamSearch needs a decoder "
+                               "subgraph attribute")
+        if int(node.attr("model_type", 0)) != 0:
+            raise UnsupportedOp("only model_type=0 (GPT, decoder-only) is "
+                               "supported")
+        if int(node.attr("no_repeat_ngram_size", 0)):
+            raise UnsupportedOp("no_repeat_ngram_size")
+        self.graph = graph
+        self.ctx = ctx
+        self.L = len(graph.inputs) - 3
+        if self.L < 1:
+            raise UnsupportedOp("decoder subgraph declares no past_* "
+                               "inputs")
+        past_vi = graph.inputs[3]
+        dims = list(past_vi.shape)
+        if len(dims) != 5:
+            raise UnsupportedOp(f"past input rank {len(dims)} != 5")
+        self.H, self.hd = dims[2], dims[4]
+        if not (isinstance(self.H, int) and isinstance(self.hd, int)):
+            raise UnsupportedOp(
+                "decoder past inputs need numeric head-count and head-dim "
+                f"dims (got {dims})")
+        self.mask_np = ONNX_TO_NUMPY.get(graph.inputs[2].elem_type,
+                                         np.float32)
+        self.max_length = int(max_length)
+
+    def empty_past(self, rows: int):
+        return [jnp.zeros((2, rows, self.H, 0, self.hd), jnp.float32)
+                for _ in range(self.L)]
+
+    def padded_past(self, rows: int):
+        return [jnp.zeros((2, rows, self.H, self.max_length, self.hd),
+                          jnp.float32) for _ in range(self.L)]
+
+    def __call__(self, ids, pos, mask, past):
+        outs = self.ctx.run_subgraph(
+            self.graph, [jnp.asarray(ids, jnp.int32),
+                         jnp.asarray(pos, jnp.int32),
+                         jnp.asarray(mask).astype(self.mask_np)]
+            + list(past))
+        return (jnp.asarray(outs[0], jnp.float32),
+                [jnp.asarray(p, jnp.float32) for p in outs[1:]])
+
+    # -- the two compiled phases -------------------------------------------
+    def prefill(self, input_ids, prompt_mask):
+        """(B, P) prompt → (last-token logits (B, V), padded past, seen
+        (B, V) token mask). Left-padded prompts follow ORT's convention:
+        position_ids = cumsum(mask) - 1."""
+        B, P = input_ids.shape
+        pos = jnp.maximum(jnp.cumsum(prompt_mask, axis=1) - 1, 0)
+        logits, present = self(input_ids, pos, prompt_mask,
+                               self.empty_past(B))
+        past = self.padded_past(B)
+        past = [lax.dynamic_update_slice(buf, pr, (0, 0, 0, 0, 0))
+                for buf, pr in zip(past, present)]
+        # duplicate (row, token) scatter targets resolve with max: a pad
+        # slot's False must not clobber a real occurrence's True
+        seen = jnp.zeros((B, self.vocab(logits)), bool).at[
+            jnp.arange(B)[:, None], input_ids].max(
+                prompt_mask.astype(bool))
+        return logits[:, -1], past, seen
+
+    @staticmethod
+    def vocab(logits):
+        return logits.shape[-1]
+
+    def step(self, tok, cur_len, past, prompt_mask, P):
+        """One decode step at padded past length. The mask exposes the
+        REAL prompt slots (``prompt_mask`` — left-padded rows keep their
+        pad K/V hidden, ORT's batching convention), every generated slot
+        in [P, cur_len), and the fresh token's slot at the very end; the
+        new K/V (the last ``present`` row) scatters back at cur_len.
+        Per-row positions continue the prefill's cumsum: generated token
+        number k sits at position (real prompt length + k)."""
+        B = tok.shape[0]
+        cols = jnp.arange(self.max_length)[None, :]
+        pm_full = jnp.pad(jnp.asarray(prompt_mask, jnp.int32),
+                          ((0, 0), (0, self.max_length - P)))
+        past_ok = jnp.where(cols < P, pm_full,
+                            (cols < cur_len).astype(jnp.int32))
+        mask = jnp.concatenate([past_ok, jnp.ones((B, 1), jnp.int32)],
+                               axis=1)
+        plen = jnp.sum(jnp.asarray(prompt_mask, jnp.int32), axis=1,
+                       keepdims=True)                       # (B, 1)
+        pos = plen + (cur_len - P)
+        logits, present = self(tok[:, None], pos, mask, past)
+        new = [pr[:, :, :, self.max_length:, :] for pr in present]
+        past = [lax.dynamic_update_slice(buf, nv, (0, 0, 0, cur_len, 0))
+                for buf, nv in zip(past, new)]
+        return logits[:, -1], past
+
+
+def _adjust_logits(logits, seen, total_len, min_length, eos_id,
+                   rep_penalty, vocab_mask):
+    """Shared logit processors (HF conventions, which ORT follows):
+    min-length eos ban, repetition penalty over seen tokens, vocab mask."""
+    if rep_penalty != 1.0:
+        pen = jnp.where(logits > 0, logits / rep_penalty,
+                        logits * rep_penalty)
+        logits = jnp.where(seen, pen, logits)
+    if vocab_mask is not None:
+        logits = jnp.where(jnp.asarray(vocab_mask, bool)[None, :],
+                           logits, -jnp.inf)
+    if min_length > 0:
+        banned = total_len < min_length
+        logits = logits.at[:, eos_id].set(
+            jnp.where(banned, -jnp.inf, logits[:, eos_id]))
+    return logits
+
+
+def _common_setup(node, inputs, ctx):
+    input_ids = jnp.asarray(inputs[0], jnp.int32)
+    max_length = _static_int(inputs[1], "max_length")
+    B, P = input_ids.shape
+    if P >= max_length:
+        raise UnsupportedOp(f"prompt length {P} >= max_length {max_length}")
+    dec = _Decoder(node, ctx, max_length)
+    eos = int(node.attr("eos_token_id", -1))
+    pad = int(node.attr("pad_token_id", -1))
+    if eos < 0 or pad < 0:
+        raise UnsupportedOp("eos_token_id and pad_token_id attributes are "
+                           "required")
+    return input_ids, max_length, dec, eos, pad
+
+
+@register_op("GreedySearch")
+def _greedy_search(node, inputs, ctx):
+    input_ids, max_length, dec, eos, pad = _common_setup(node, inputs, ctx)
+    B, P = input_ids.shape
+    min_length = _static_int(inputs[2] if len(inputs) > 2 else None,
+                             "min_length", default=0)
+    rep = _static_float(inputs[3] if len(inputs) > 3 else None,
+                        "repetition_penalty", 1.0)
+    vocab_mask = inputs[4] if len(inputs) > 4 else None
+    if len(inputs) > 5 and inputs[5] is not None:
+        raise UnsupportedOp("prefix_vocab_mask")
+    attn = (jnp.asarray(inputs[6], jnp.int32) if len(inputs) > 6
+            and inputs[6] is not None else jnp.ones((B, P), jnp.int32))
+
+    # prefill emits buffer position P; the scan emits P+1 .. max_length-1
+    # (one step per position: feed the token at index t, cur_len = t,
+    # collect the token for index t+1). eos appears in the output and
+    # everything after it is pad_token_id — ORT's layout.
+    # min_length follows ORT/HF: eos is banned while the length BEFORE
+    # appending the new token is < min_length
+    logits0, past, seen = dec.prefill(input_ids, attn)
+    logits0 = _adjust_logits(logits0, seen, P, min_length, eos, rep,
+                             vocab_mask)
+    tok = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+    done = tok == eos
+
+    def body(carry, t):
+        tok, done, past, seen = carry
+        seen = seen.at[jnp.arange(B), tok].set(True)
+        logits, past = dec.step(tok, t, past, attn, P)
+        logits = _adjust_logits(logits, seen, t + 1, min_length, eos, rep,
+                                vocab_mask)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, pad, nxt)
+        return (nxt, done | (nxt == eos), past, seen), nxt
+
+    buf = jnp.zeros((B, max_length), jnp.int32).at[:, :P].set(input_ids)
+    buf = buf.at[:, P].set(tok)
+    if max_length - 1 > P:
+        _, toks = lax.scan(body, (tok, done, past, seen),
+                           jnp.arange(P, max_length - 1, dtype=jnp.int32))
+        buf = buf.at[:, P + 1:].set(toks.T)
+    return buf
+
+
+@register_op("BeamSearch")
+def _beam_search(node, inputs, ctx):
+    input_ids, max_length, dec, eos, pad = _common_setup(node, inputs, ctx)
+    B, P = input_ids.shape
+    min_length = _static_int(inputs[2] if len(inputs) > 2 else None,
+                             "min_length", default=0)
+    W = _static_int(inputs[3] if len(inputs) > 3 else None, "num_beams")
+    R = _static_int(inputs[4] if len(inputs) > 4 else None,
+                    "num_return_sequences", default=1)
+    lp = _static_float(inputs[5] if len(inputs) > 5 else None,
+                       "length_penalty", 1.0)
+    rep = _static_float(inputs[6] if len(inputs) > 6 else None,
+                        "repetition_penalty", 1.0)
+    vocab_mask = inputs[7] if len(inputs) > 7 else None
+    if len(inputs) > 8 and inputs[8] is not None:
+        raise UnsupportedOp("prefix_vocab_mask")
+    attn = (jnp.asarray(inputs[9], jnp.int32) if len(inputs) > 9
+            and inputs[9] is not None else jnp.ones((B, P), jnp.int32))
+    if W < 1 or R < 1 or R > W:
+        raise UnsupportedOp(f"need 1 <= num_return_sequences ({R}) <= "
+                           f"num_beams ({W})")
+    # scores follow the zoo's convention: cumulative log-prob over the
+    # GENERATED tokens, length-penalized as sum / len**length_penalty at
+    # banking time (early_stopping attr is accepted; the loop always runs
+    # to max_length, i.e. early_stopping=False semantics — hypotheses can
+    # only improve)
+
+    def penalize(score, length):
+        return score / (jnp.asarray(length, jnp.float32) ** jnp.float32(lp))
+
+    logits0, past, seen = dec.prefill(input_ids, attn)
+    V = logits0.shape[-1]
+    if W > V:
+        raise UnsupportedOp(f"num_beams {W} exceeds vocab {V}")
+    logits0 = _adjust_logits(logits0, seen, P, min_length, eos, rep,
+                             vocab_mask)
+    logp0 = jax.nn.log_softmax(logits0, axis=-1)
+    batch_ix = jnp.arange(B)[:, None]
+    k0 = min(2 * W, V)
+    c_scores, c_tok = lax.top_k(logp0, k0)                  # (B, k0)
+    M = max_length
+    c_seqs = (jnp.zeros((B, k0, M), jnp.int32)
+              .at[:, :, :P].set(input_ids[:, None, :])
+              .at[:, :, P].set(c_tok))
+    c_eos = c_tok == eos
+    bank0 = jnp.where(c_eos, penalize(c_scores, 1), -jnp.inf)
+    fin_scores, keep = lax.top_k(bank0, W)
+    fin_seqs = c_seqs[batch_ix, keep]
+    scores, pick = lax.top_k(jnp.where(c_eos, -jnp.inf, c_scores), W)
+    seqs = c_seqs[batch_ix, pick]
+    tok = c_tok[batch_ix, pick].reshape(B * W)
+    # fold beams into the batch axis of every stateful buffer
+    past = [jnp.repeat(buf, W, axis=1) for buf in past]
+    seen = jnp.repeat(seen, W, axis=0)                      # (B*W, V)
+    attn_w = jnp.repeat(attn, W, axis=0)
+
+    def body(carry, t):
+        seqs, scores, fin_scores, fin_seqs, tok, past, seen = carry
+        seen = seen.at[jnp.arange(B * W), tok].set(True)
+        logits, past = dec.step(tok, t, past, attn_w, P)
+        logits = _adjust_logits(logits, seen, t + 1, min_length, eos, rep,
+                                vocab_mask)
+        logp = jax.nn.log_softmax(logits, axis=-1)          # (B*W, V)
+        cand = scores[:, :, None] + logp.reshape(B, W, V)
+        c_scores, c_idx = lax.top_k(cand.reshape(B, W * V), 2 * W)
+        c_parent = c_idx // V
+        c_tok = (c_idx % V).astype(jnp.int32)
+        c_seqs = seqs[batch_ix, c_parent]
+        c_seqs = jnp.where(jnp.arange(M)[None, None] == t + 1,
+                           c_tok[:, :, None], c_seqs)
+        c_eos = c_tok == eos
+        gen_len = t + 2 - P                    # generated tokens incl. eos
+        pool_s = jnp.concatenate(
+            [fin_scores, jnp.where(c_eos, penalize(c_scores, gen_len),
+                                   -jnp.inf)], axis=1)
+        pool_q = jnp.concatenate([fin_seqs, c_seqs], axis=1)
+        fin_scores, keep = lax.top_k(pool_s, W)
+        fin_seqs = pool_q[batch_ix, keep]
+        scores, pick = lax.top_k(jnp.where(c_eos, -jnp.inf, c_scores), W)
+        parent = c_parent[batch_ix, pick]
+        seqs = c_seqs[batch_ix, pick]
+        tok = c_tok[batch_ix, pick].reshape(B * W)
+        rows = (jnp.arange(B)[:, None] * W + parent).reshape(B * W)
+        past = [buf[:, rows] for buf in past]
+        seen = seen[rows]
+        return (seqs, scores, fin_scores, fin_seqs, tok, past, seen), None
+
+    if M - 1 > P:
+        (seqs, scores, fin_scores, fin_seqs, tok, past, seen), _ = lax.scan(
+            body, (seqs, scores, fin_scores, fin_seqs, tok, past, seen),
+            jnp.arange(P, M - 1, dtype=jnp.int32))
+
+    all_s = jnp.concatenate([fin_scores, penalize(scores, M - P)], axis=1)
+    all_q = jnp.concatenate([fin_seqs, seqs], axis=1)       # (B, 2W, M)
+    top_s, top_i = lax.top_k(all_s, R)
+    out = all_q[batch_ix, top_i]                            # (B, R, M)
+    # pad strictly after the first eos PAST the prompt (a prompt token
+    # equal to eos must not trigger padding)
+    gen_eos = (out == eos) & (jnp.arange(M)[None, None, :] >= P)
+    after = jnp.pad(jnp.cumsum(gen_eos.astype(jnp.int32), axis=-1) > 0,
+                    ((0, 0), (0, 0), (1, 0)))[:, :, :-1]
+    out = jnp.where(after, pad, out)
+    return out, top_s

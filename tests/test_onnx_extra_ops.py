@@ -406,3 +406,80 @@ class TestAsrPreprocessGraph:
         # the 440 Hz tone concentrates energy in one mel band
         band = lm[0].mean(axis=0)
         assert band.argmax() in range(1, 5)
+
+
+class TestFusedConv:
+    """ORT contrib FusedConv: Conv + folded activation (+ residual Z)."""
+
+    def _x_w(self, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(0, 1, (1, 3, 8, 8)).astype(np.float32)
+        w = rng.normal(0, 0.3, (4, 3, 3, 3)).astype(np.float32)
+        b = rng.normal(0, 0.1, 4).astype(np.float32)
+        return x, w, b
+
+    def test_matches_conv_plus_activation(self):
+        x, w, b = self._x_w()
+        (fused,) = run([O.make_node("FusedConv", ["x", "w", "b"], ["y"],
+                                    domain="com.microsoft",
+                                    activation="Relu")],
+                       {"x": x}, ["y"], initializers={"w": w, "b": b})
+        (plain,) = run([O.make_node("Conv", ["x", "w", "b"], ["c"]),
+                        O.make_node("Relu", ["c"], ["y"])],
+                       {"x": x}, ["y"], initializers={"w": w, "b": b})
+        np.testing.assert_allclose(fused, plain, rtol=1e-6)
+
+    def test_residual_and_param_activations(self):
+        x, w, b = self._x_w(1)
+        z = np.random.default_rng(2).normal(
+            0, 1, (1, 4, 6, 6)).astype(np.float32)
+        (y,) = run([O.make_node("FusedConv", ["x", "w", "b", "z"], ["y"],
+                                domain="com.microsoft",
+                                activation="LeakyRelu",
+                                activation_params=[0.2])],
+                   {"x": x}, ["y"], initializers={"w": w, "b": b, "z": z})
+        (c,) = run([O.make_node("Conv", ["x", "w", "b"], ["c"])],
+                   {"x": x}, ["c"], initializers={"w": w, "b": b})
+        want = c + z
+        want = np.where(want < 0, 0.2 * want, want)
+        np.testing.assert_allclose(y, want, rtol=1e-5)
+
+    def test_unknown_activation_rejected(self):
+        x, w, b = self._x_w(3)
+        with pytest.raises(Exception, match="activation"):
+            run([O.make_node("FusedConv", ["x", "w", "b"], ["y"],
+                             domain="com.microsoft", activation="Swoosh")],
+                {"x": x}, ["y"], initializers={"w": w, "b": b})
+
+
+class TestRelativePositionBias:
+    """ORT contrib RelativePositionBias vs Hugging Face T5's own bucketing
+    (the real torch implementation in this image is the oracle)."""
+
+    @pytest.mark.parametrize("bidirectional", [True, False])
+    def test_matches_t5_bucketing(self, bidirectional):
+        import torch
+        from transformers.models.t5.modeling_t5 import T5Attention
+
+        num_buckets, heads, max_dist = 32, 4, 64
+        q_len, k_len = 7, 11
+        rng = np.random.default_rng(0)
+        table = rng.normal(0, 1, (num_buckets, heads)).astype(np.float32)
+
+        (got,) = run([O.make_node("RelativePositionBias",
+                                  ["table", "ql", "kl"], ["bias"],
+                                  domain="com.microsoft",
+                                  max_distance=max_dist,
+                                  is_bidirectional=int(bidirectional))],
+                     {"table": table}, ["bias"],
+                     initializers={"ql": np.array(q_len, np.int64),
+                                   "kl": np.array(k_len, np.int64)})
+
+        ctx = torch.arange(q_len)[:, None]
+        mem = torch.arange(k_len)[None, :]
+        buckets = T5Attention._relative_position_bucket(
+            mem - ctx, bidirectional=bidirectional,
+            num_buckets=num_buckets, max_distance=max_dist)
+        want = table[buckets.numpy()].transpose(2, 0, 1)[None]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert got.shape == (1, heads, q_len, k_len)

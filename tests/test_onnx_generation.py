@@ -1,0 +1,315 @@
+"""ORT generation meta-ops (com.microsoft GreedySearch / BeamSearch).
+
+The decoder subgraph here is a real causal single-layer GPT built from
+standard ONNX ops (embeddings, fused-QKV attention with past/present
+concat, causal + padding masks, tied unembedding). The oracle is the
+SAME subgraph converted standalone and re-run from scratch each step
+(full recompute, empty past) — for a causal decoder that equals cached
+decoding, so the meta-op's padded-past machinery must reproduce it
+token-for-token.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.onnx as O
+from mmlspark_tpu.onnx.convert import convert_model
+
+V, D, H = 8, 8, 2
+HD = D // H
+MAXP = 16
+
+
+def _decoder_graph(seed=0):
+    rng = np.random.default_rng(seed)
+    init = {
+        "tok_table": rng.normal(0, 0.7, (V, D)).astype(np.float32),
+        "pos_table": rng.normal(0, 0.3, (MAXP, D)).astype(np.float32),
+        "w_qkv": rng.normal(0, 0.5, (D, 3 * D)).astype(np.float32),
+        "w_out": rng.normal(0, 0.5, (D, D)).astype(np.float32),
+        "unembed": rng.normal(0, 0.7, (D, V)).astype(np.float32),
+        "scale": np.array(1.0 / np.sqrt(HD), np.float32),
+        "one_f": np.array([1.0], np.float32),
+        "big_neg": np.array(-1e9, np.float32),
+        "i1": np.array(1, np.int64),
+        "perm_shape": np.array([0, 0, H, HD], np.int64),
+        "merge_shape": np.array([0, 0, D], np.int64),
+    }
+    n = [
+        # h = tok_emb + pos_emb
+        O.make_node("Gather", ["tok_table", "input_ids"], ["te"]),
+        O.make_node("Gather", ["pos_table", "position_ids"], ["pe"]),
+        O.make_node("Add", ["te", "pe"], ["h"]),
+        # fused qkv -> (B, S, H, hd) heads
+        O.make_node("MatMul", ["h", "w_qkv"], ["qkv"]),
+        O.make_node("Split", ["qkv"], ["q0", "k0", "v0"], axis=-1,
+                    num_outputs=3),
+        O.make_node("Reshape", ["q0", "perm_shape"], ["q1"]),
+        O.make_node("Reshape", ["k0", "perm_shape"], ["k1"]),
+        O.make_node("Reshape", ["v0", "perm_shape"], ["v1"]),
+        O.make_node("Transpose", ["q1"], ["q"], perm=[0, 2, 1, 3]),
+        O.make_node("Transpose", ["k1"], ["kn"], perm=[0, 2, 1, 3]),
+        O.make_node("Transpose", ["v1"], ["vn"], perm=[0, 2, 1, 3]),
+        # past (2, B, H, P, hd) -> concat on the sequence axis
+        O.make_node("Gather", ["past_0", "i0_idx"], ["kp"], axis=0),
+        O.make_node("Gather", ["past_0", "i1_idx"], ["vp"], axis=0),
+        O.make_node("Concat", ["kp", "kn"], ["K"], axis=2),
+        O.make_node("Concat", ["vp", "vn"], ["Vv"], axis=2),
+        # scores + causal & padding masks
+        O.make_node("Transpose", ["K"], ["Kt"], perm=[0, 1, 3, 2]),
+        O.make_node("MatMul", ["q", "Kt"], ["s0"]),
+        O.make_node("Mul", ["s0", "scale"], ["s1"]),
+        O.make_node("Shape", ["input_ids"], ["ids_shape"]),
+        O.make_node("Gather", ["ids_shape", "i1"], ["S_"], axis=0),
+        O.make_node("Shape", ["attention_mask"], ["m_shape"]),
+        O.make_node("Gather", ["m_shape", "i1"], ["T_"], axis=0),
+        O.make_node("Sub", ["T_", "S_"], ["Ppast"]),
+        O.make_node("Unsqueeze", ["S_"], ["S_u"], axes=[0]),
+        O.make_node("Unsqueeze", ["T_"], ["T_u"], axes=[0]),
+        O.make_node("Concat", ["S_u", "T_u"], ["st"], axis=0),
+        O.make_node("Expand", ["one_f", "st"], ["ones_st"]),
+        O.make_node("Trilu", ["ones_st", "Ppast"], ["tril"], upper=0),
+        O.make_node("Sub", ["tril", "one_f"], ["tril0"]),
+        O.make_node("Mul", ["tril0", "big_neg"], ["causal_neg"]),  # (S,T)
+        O.make_node("Sub", ["one_f", "attention_mask"], ["padm"]),
+        O.make_node("Mul", ["padm", "big_neg"], ["pad_neg"]),      # (B,T)
+        O.make_node("Unsqueeze", ["pad_neg"], ["pad_neg4"],
+                    axes=[1, 2]),                                  # B,1,1,T
+        O.make_node("Add", ["s1", "causal_neg"], ["s2"]),
+        O.make_node("Add", ["s2", "pad_neg4"], ["s3"]),
+        O.make_node("Softmax", ["s3"], ["p"], axis=-1),
+        O.make_node("MatMul", ["p", "Vv"], ["ctx"]),
+        O.make_node("Transpose", ["ctx"], ["ctx1"], perm=[0, 2, 1, 3]),
+        O.make_node("Reshape", ["ctx1", "merge_shape"], ["ctx2"]),
+        O.make_node("MatMul", ["ctx2", "w_out"], ["ho"]),
+        O.make_node("Add", ["h", "ho"], ["hf"]),
+        O.make_node("MatMul", ["hf", "unembed"], ["logits"]),
+        # present (2, B, H, T, hd)
+        O.make_node("Unsqueeze", ["K"], ["K5"], axes=[0]),
+        O.make_node("Unsqueeze", ["Vv"], ["V5"], axes=[0]),
+        O.make_node("Concat", ["K5", "V5"], ["present_0"], axis=0),
+    ]
+    init["i0_idx"] = np.array(0, np.int64)
+    init["i1_idx"] = np.array(1, np.int64)
+    return O.make_graph(
+        n, "gpt_step",
+        inputs=[O.make_tensor_value_info("input_ids", np.int32,
+                                         ["B", "S"]),
+                O.make_tensor_value_info("position_ids", np.int32,
+                                         ["B", "S"]),
+                O.make_tensor_value_info("attention_mask", np.float32,
+                                         ["B", "T"]),
+                O.make_tensor_value_info("past_0", np.float32,
+                                         [2, "B", H, "P", HD])],
+        outputs=[O.make_tensor_value_info("logits", np.float32,
+                                          ["B", "S", V]),
+                 O.make_tensor_value_info("present_0", np.float32,
+                                          [2, "B", H, "T", HD])],
+        initializers=init)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """Standalone converted decoder + full-recompute greedy/logprob."""
+    cm = convert_model(O.make_model(_decoder_graph()))
+
+    def logits_for(ids_2d):
+        ids = np.asarray(ids_2d, np.int32)
+        B, S = ids.shape
+        feeds = {"input_ids": ids,
+                 "position_ids": np.tile(np.arange(S, dtype=np.int32),
+                                         (B, 1)),
+                 "attention_mask": np.ones((B, S), np.float32),
+                 "past_0": np.zeros((2, B, H, 0, HD), np.float32)}
+        return np.asarray(cm(cm.params, feeds)["logits"])
+
+    def greedy(prompt_row, max_length):
+        ids = list(map(int, prompt_row))
+        while len(ids) < max_length:
+            lg = logits_for([ids])[0, -1]
+            ids.append(int(lg.argmax()))
+        return ids
+
+    def seq_logprob(prompt_row, gen):
+        from scipy.special import logsumexp
+        ids = list(map(int, prompt_row))
+        lp = 0.0
+        for t in gen:
+            row = logits_for([ids])[0, -1]
+            lp += row[t] - logsumexp(row)
+            ids.append(int(t))
+        return lp
+
+    return logits_for, greedy, seq_logprob
+
+
+def _greedy_model(**extra_inputs):
+    ins = [O.make_tensor_value_info("input_ids", np.int32, ["B", "P"])]
+    names = ["input_ids", "max_length"] + list(extra_inputs)
+    node = O.make_node("GreedySearch", names, ["sequences"],
+                       domain="com.microsoft", decoder=_decoder_graph(),
+                       eos_token_id=V - 1, pad_token_id=0, model_type=0)
+    g = O.make_graph(
+        [node], "gen",
+        inputs=ins,
+        outputs=[O.make_tensor_value_info("sequences", np.int32,
+                                          ["B", "L"])],
+        initializers={"max_length": np.array(9, np.int64), **extra_inputs})
+    return convert_model(O.make_model(g))
+
+
+class TestGreedySearch:
+    def test_matches_full_recompute_oracle(self, oracle):
+        _, greedy, _ = oracle
+        cm = _greedy_model()
+        prompts = np.array([[1, 2, 3], [4, 0, 6]], np.int32)
+        out = np.asarray(cm(cm.params, {"input_ids": prompts})["sequences"])
+        assert out.shape == (2, 9)
+        for r in range(2):
+            want = greedy(prompts[r], 9)
+            got = list(out[r])
+            # compare up to the first eos; after it the op pads
+            if V - 1 in want[3:]:
+                stop = want.index(V - 1, 3)
+                assert got[:stop + 1] == want[:stop + 1]
+                assert all(t == 0 for t in got[stop + 1:])
+            else:
+                assert got == want
+
+    def test_left_padded_batch_matches_per_row(self, oracle):
+        """ORT's batching convention: shorter prompts left-pad and the
+        attention_mask hides the pad K/V in BOTH prefill and decode
+        steps; per-row positions continue the cumsum. Each padded row
+        must generate exactly what it generates alone, unpadded."""
+        _, greedy, _ = oracle
+        ins = [O.make_tensor_value_info("input_ids", np.int32, ["B", "P"]),
+               O.make_tensor_value_info("attention_mask", np.float32,
+                                        ["B", "P"])]
+        node = O.make_node(
+            "GreedySearch",
+            ["input_ids", "max_length", "", "", "", "", "attention_mask"],
+            ["sequences"], domain="com.microsoft",
+            decoder=_decoder_graph(), eos_token_id=V - 1, pad_token_id=0,
+            model_type=0)
+        g = O.make_graph(
+            [node], "gen", inputs=ins,
+            outputs=[O.make_tensor_value_info("sequences", np.int32,
+                                              ["B", "L"])],
+            initializers={"max_length": np.array(8, np.int64)})
+        cm = convert_model(O.make_model(g))
+        # row 0: 4 real tokens; row 1: 2 real tokens, left-padded by 2
+        prompts = np.array([[1, 2, 3, 4], [0, 0, 5, 6]], np.int32)
+        mask = np.array([[1, 1, 1, 1], [0, 0, 1, 1]], np.float32)
+        out = np.asarray(cm(cm.params, {"input_ids": prompts,
+                                        "attention_mask": mask})
+                         ["sequences"])
+        for r, real in enumerate([[1, 2, 3, 4], [5, 6]]):
+            want = greedy(np.array(real, np.int32), len(real) + 4)
+            got = [int(t) for t in out[r, 4:]]
+            gen = want[len(real):]
+            if V - 1 in gen:
+                stop = gen.index(V - 1)
+                assert got[:stop + 1] == gen[:stop + 1]
+            else:
+                assert got == gen
+
+    def test_repetition_penalty_changes_output(self, oracle):
+        cm = _greedy_model(repetition_penalty=np.array(9.0, np.float32),
+                           min_length=np.array(0, np.int64))
+        plain = _greedy_model()
+        prompts = np.array([[1, 2, 3]], np.int32)
+        a = np.asarray(cm(cm.params, {"input_ids": prompts})["sequences"])
+        b = np.asarray(plain(plain.params,
+                             {"input_ids": prompts})["sequences"])
+        # a strong penalty forbids immediate repeats of seen tokens
+        assert not np.array_equal(a, b) or len(set(b[0].tolist())) == 9
+
+
+class TestBeamSearch:
+    def _model(self, max_length, num_beams, num_return=1, extra=None):
+        ins = [O.make_tensor_value_info("input_ids", np.int32,
+                                        ["B", "P"])]
+        extra = extra or {}
+        names = (["input_ids", "max_length", "", "num_beams",
+                  "num_return_sequences", "length_penalty"]
+                 + list(extra))
+        node = O.make_node("BeamSearch", names,
+                           ["sequences", "sequences_scores"],
+                           domain="com.microsoft",
+                           decoder=_decoder_graph(),
+                           eos_token_id=V - 1, pad_token_id=0,
+                           model_type=0)
+        g = O.make_graph(
+            [node], "gen",
+            inputs=ins,
+            outputs=[O.make_tensor_value_info("sequences", np.int32,
+                                              ["B", "R", "L"]),
+                     O.make_tensor_value_info("sequences_scores",
+                                              np.float32, ["B", "R"])],
+            initializers={"max_length": np.array(max_length, np.int64),
+                          "num_beams": np.array(num_beams, np.int64),
+                          "num_return_sequences": np.array(num_return,
+                                                           np.int64),
+                          "length_penalty": np.array(1.0, np.float32),
+                          **extra})
+        return convert_model(O.make_model(g))
+
+    def test_beam1_equals_greedy(self, oracle):
+        _, greedy, _ = oracle
+        cm = self._model(9, 1)
+        prompts = np.array([[1, 2, 3]], np.int32)
+        res = cm(cm.params, {"input_ids": prompts})
+        got = list(np.asarray(res["sequences"])[0, 0])
+        want = greedy(prompts[0], 9)
+        if V - 1 in want[3:]:
+            stop = want.index(V - 1, 3)
+            assert got[:stop + 1] == want[:stop + 1]
+        else:
+            assert got == want
+
+    def test_full_width_is_exhaustive(self, oracle):
+        _, _, seq_logprob = oracle
+        # W = V keeps every 1-token prefix: with 2 generated tokens the
+        # best hypothesis equals brute force over all V^2 continuations
+        # (no eos interference: compare against non-eos-ending winners
+        # plus eos-banked ones — the op's answer must score >= every
+        # enumerated sequence under the same penalty)
+        cm = self._model(5, V)
+        prompts = np.array([[1, 2, 3]], np.int32)
+        res = cm(cm.params, {"input_ids": prompts})
+        got = np.asarray(res["sequences"])[0, 0]
+        score = float(np.asarray(res["sequences_scores"])[0, 0])
+
+        def pen_score(gen):
+            # mirror the op: cumulative logprob / generated length; an
+            # eos-terminated prefix banks at its own length
+            return seq_logprob(prompts[0], gen) / len(gen)
+
+        best = -np.inf
+        for cand in itertools.product(range(V), repeat=2):
+            if cand[0] == V - 1:
+                best = max(best, pen_score([cand[0]]))
+            else:
+                best = max(best, pen_score(list(cand)))
+        assert score == pytest.approx(best, rel=1e-4)
+        # and the returned tokens reproduce that score
+        gen = [int(t) for t in got[3:] if True]
+        if V - 1 in gen:
+            gen = gen[:gen.index(V - 1) + 1]
+        assert pen_score(gen) == pytest.approx(best, rel=1e-4)
+
+    def test_num_return_sequences_sorted(self):
+        cm = self._model(6, 4, num_return=3)
+        prompts = np.array([[1, 2], [3, 4]], np.int32)
+        res = cm(cm.params, {"input_ids": prompts})
+        seqs = np.asarray(res["sequences"])
+        scores = np.asarray(res["sequences_scores"])
+        assert seqs.shape == (2, 3, 6)
+        assert (np.diff(scores, axis=1) <= 1e-6).all()   # descending
+
+    def test_validation(self):
+        cm = self._model(6, 2, num_return=3)
+        with pytest.raises(Exception, match="num_return_sequences"):
+            cm(cm.params, {"input_ids": np.array([[1, 2]], np.int32)})
