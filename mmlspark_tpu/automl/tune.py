@@ -76,14 +76,23 @@ class TuneHyperparameters(Estimator, HasLabelCol):
     search_space = ComplexParam(default=None,
                                 doc="GridSpace or RandomSpace instance")
     number_of_iterations = Param(int, default=10,
-                                 doc="trials (random search only)")
+                                 doc="trial budget (random and tpe "
+                                     "strategies; grids enumerate fully)")
     evaluation_metric = Param(str, default="accuracy", doc="selection metric")
     train_fraction = Param(float, default=0.8, doc="train/validation split")
     parallelism = Param(int, default=4, doc="concurrent trials")
     seed = Param(int, default=0, doc="split seed")
-    search_strategy = Param(str, default="full", choices=["full", "halving"],
+    search_strategy = Param(str, default="full",
+                            choices=["full", "halving", "tpe"],
                             doc="full = fit every candidate at full budget; "
-                                "halving = successive halving rungs")
+                                "halving = successive halving rungs; "
+                                "tpe = adaptive Parzen-estimator proposals "
+                                "(needs a dict/RandomSpace search space)")
+    tpe_startup_trials = Param(int, default=5,
+                               doc="tpe: random trials before the model "
+                                   "starts proposing")
+    tpe_gamma = Param(float, default=0.25,
+                      doc="tpe: top fraction of trials modeled as 'good'")
     resource_param = Param(str, default="num_iterations",
                            doc="halving: estimator param that scales cost")
     min_resource = Param(int, default=4, doc="halving: first-rung resource")
@@ -100,11 +109,24 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         space = self.get("search_space")
         if isinstance(space, dict):
             space = RandomSpace(space, seed=self.get("seed"))
-        if isinstance(space, GridSpace):
+        tpe = self.get("search_strategy") == "tpe"
+        if tpe:
+            # validated BEFORE any candidate materialization: a large grid
+            # would enumerate its whole Cartesian product just to be
+            # rejected, and a RandomSpace would draw maps tpe never uses
+            if isinstance(space, GridSpace):
+                raise ValueError("tpe needs a dict/RandomSpace search "
+                                 "space (it proposes NEW points; a grid "
+                                 "is a fixed candidate list)")
+            if int(self.get("number_of_iterations")) < 1:
+                raise ValueError("tpe needs number_of_iterations >= 1 "
+                                 "(its total trial budget)")
+            param_maps = None
+        elif isinstance(space, GridSpace):
             param_maps = list(space.param_maps())
         else:
             param_maps = list(space.param_maps(self.get("number_of_iterations")))
-        if not param_maps:
+        if not tpe and not param_maps:
             raise ValueError("empty search space")
 
         shuffled = df.shuffle(self.get("seed"))
@@ -126,7 +148,22 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                     max_workers=max(1, self.get("parallelism"))) as ex:
                 return list(ex.map(trial, maps))
 
-        if self.get("search_strategy") == "halving":
+        if tpe:
+            from .tpe import TPESampler
+            sampler = TPESampler(space.space, seed=self.get("seed"),
+                                 gamma=float(self.get("tpe_gamma")),
+                                 n_startup=int(self.get(
+                                     "tpe_startup_trials")),
+                                 maximize=maximize)
+            budget = int(self.get("number_of_iterations"))
+            batch = max(1, int(self.get("parallelism")))
+            results = []
+            while len(results) < budget:
+                maps = sampler.propose(min(batch, budget - len(results)))
+                for score, model, pm in run_rung(maps):
+                    sampler.tell(pm, score)
+                    results.append((score, model, pm))
+        elif self.get("search_strategy") == "halving":
             eta = int(self.get("halving_factor"))
             rp = self.get("resource_param")
             r = int(self.get("min_resource"))

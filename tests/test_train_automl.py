@@ -243,3 +243,71 @@ class TestPlot:
         confusion_matrix(df, "y", "yhat", ax=ax)
         roc(df, "y", "s", ax=ax)
         plt.close(fig)
+
+
+class TestTPE:
+    """search_strategy='tpe': Parzen-estimator proposals concentrate
+    trials near what already scores well (beyond the reference's
+    random/grid search)."""
+
+    def test_sampler_concentrates_on_optimum(self):
+        # pure sampler test on a known quadratic: after warmup, proposals
+        # must cluster far closer to the optimum than the random phase
+        from mmlspark_tpu.automl.hyperparam import RangeHyperParam
+        from mmlspark_tpu.automl.tpe import TPESampler
+        space = {"x": RangeHyperParam(0.0, 1.0)}
+        s = TPESampler(space, seed=0, n_startup=8, maximize=False)
+        early, late = [], []
+        for i in range(60):
+            (pm,) = s.propose(1)
+            s.tell(pm, (pm["x"] - 0.3) ** 2)
+            (early if i < 10 else late if i >= 50 else []).append(pm["x"])
+        d = lambda xs: float(np.mean(np.abs(np.asarray(xs) - 0.3)))  # noqa: E731
+        assert d(late) < 0.5 * d(early), (d(early), d(late))
+
+    def test_categorical_and_log_dims(self):
+        from mmlspark_tpu.automl.hyperparam import (DiscreteHyperParam,
+                                                    RangeHyperParam)
+        from mmlspark_tpu.automl.tpe import TPESampler
+        space = {"lr": RangeHyperParam(1e-4, 1.0, is_log=True),
+                 "kind": DiscreteHyperParam(["a", "b", "c"]),
+                 "k": RangeHyperParam(1, 32, is_int=True)}
+        s = TPESampler(space, seed=1, n_startup=6, maximize=True)
+        # objective favors kind == "b" and lr near 1e-2
+        for _ in range(40):
+            (pm,) = s.propose(1)
+            score = -abs(np.log10(pm["lr"]) + 2) + (1.0 if pm["kind"] == "b"
+                                                    else 0.0)
+            s.tell(pm, score)
+        tail = s.propose(10)
+        kinds = [p["kind"] for p in tail]
+        assert kinds.count("b") >= 5
+        assert all(isinstance(p["k"], int) and 1 <= p["k"] <= 32
+                   for p in tail)
+        assert all(1e-4 <= p["lr"] <= 1.0 for p in tail)
+
+    def test_tune_hyperparameters_tpe_end_to_end(self):
+        df = _cls_df(n=60)
+        space = (HyperparamBuilder()
+                 .add_hyperparam("learning_rate",
+                                 RangeHyperParam(0.001, 0.5, is_log=True))
+                 .add_hyperparam("max_iter", DiscreteHyperParam([50, 150]))
+                 .build())
+        tuner = TuneHyperparameters(
+            model=LogisticRegression(), search_space=space,
+            search_strategy="tpe", number_of_iterations=8,
+            tpe_startup_trials=4, evaluation_metric="accuracy",
+            label_col="label", parallelism=2, seed=5)
+        best = tuner.fit(df)
+        assert tuner.best_metric is not None and tuner.best_metric > 0.6
+        assert set(tuner.best_params) == {"learning_rate", "max_iter"}
+        assert "prediction" in best.transform(df).columns
+
+    def test_tpe_rejects_grid_space(self):
+        import pytest
+        space = (HyperparamBuilder()
+                 .add_hyperparam("a", DiscreteHyperParam([1, 2])).build())
+        with pytest.raises(ValueError, match="tpe"):
+            TuneHyperparameters(
+                model=LogisticRegression(), search_space=GridSpace(space),
+                search_strategy="tpe", label_col="label").fit(_cls_df(30))
