@@ -20,16 +20,28 @@ import json
 import logging
 import threading
 import traceback
-from typing import Dict, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, Optional
 
 import numpy as np
 
 from .continuous import ContinuousDecoder
-from .server import WorkerServer
+from .server import StreamingReply, WorkerServer
 
 __all__ = ["GenerationEngine"]
 
 _log = logging.getLogger("mmlspark_tpu.serving")
+
+
+@dataclass
+class _InFlight:
+    """One parked generation: the server request, the decoder ticket, an
+    open SSE stream when the client asked for one, and how many tokens
+    that stream has already been sent."""
+    rid: str
+    ticket: object
+    stream: Optional[StreamingReply] = None
+    sent: int = 0
 
 
 class GenerationEngine:
@@ -51,9 +63,9 @@ class GenerationEngine:
         self.server = WorkerServer(host, port, api_path,
                                    reply_timeout=reply_timeout,
                                    transport=transport)
-        #: decoder rid -> (server request id, decoder ticket) — ONE source
-        #: of truth for in-flight work, mutated at one site per transition
-        self._inflight: Dict[int, Tuple[str, object]] = {}
+        #: decoder rid -> _InFlight — ONE source of truth for in-flight
+        #: work, mutated at one site per transition
+        self._inflight: Dict[int, _InFlight] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -71,7 +83,10 @@ class GenerationEngine:
     def _admit_one(self, cached) -> None:
         """Parse + submit ONE request; any failure 400s only that request
         (a malformed field must not poison the batch or the in-flight set —
-        the same isolation ServingEngine gets from its per-batch try)."""
+        the same isolation ServingEngine gets from its per-batch try).
+        ``"stream": true`` opens a Server-Sent-Events reply instead: each
+        engine tick pushes the newly emitted tokens as a ``data:`` event,
+        and the final event carries ``done`` plus the full sequence."""
         rid = cached.request_id
         try:
             ent = cached.request.entity
@@ -81,6 +96,7 @@ class GenerationEngine:
                 raise ValueError("missing or empty 'tokens'")
             mn = int(body.get("max_new", self.default_max_new))
             pl = body.get("prefix_len")
+            stream = bool(body.get("stream", False))
             ticket = self.decoder.submit(
                 np.asarray(toks, np.int32), mn,
                 temperature=float(body.get("temperature", 0.0)),
@@ -92,7 +108,8 @@ class GenerationEngine:
         except Exception as e:
             self.server.reply_json(rid, {"error": str(e)}, status=400)
             return
-        self._inflight[ticket.rid] = (rid, ticket)
+        handle = self.server.reply_stream(rid) if stream else None
+        self._inflight[ticket.rid] = _InFlight(rid, ticket, handle)
 
     def _admit_http(self, idle: bool) -> None:
         # mid-stream (live slots) the drain is non-blocking: a blocking
@@ -101,14 +118,34 @@ class GenerationEngine:
         for cached in self.server.get_batch(64, timeout=0.002 if idle else 0):
             self._admit_one(cached)
 
+    def _pump_streams(self) -> None:
+        """Push newly emitted tokens on every streaming reply."""
+        for f in self._inflight.values():
+            if f.stream is None:
+                continue
+            fresh = f.ticket.tokens[f.sent:]
+            if fresh:
+                f.stream.send_event({"tokens": list(fresh)})
+                f.sent += len(fresh)
+
     def _reply_finished(self) -> None:
-        done = [drid for drid, (_, t) in self._inflight.items() if t.done]
+        done = [drid for drid, f in self._inflight.items()
+                if f.ticket.done]
         for drid in done:
-            rid, ticket = self._inflight.pop(drid)
-            if getattr(ticket, "error", None) is not None:
+            f = self._inflight.pop(drid)
+            rid, ticket, handle = f.rid, f.ticket, f.stream
+            err = getattr(ticket, "error", None)
+            if handle is not None:
+                if err is not None:
+                    handle.send_event({"error": str(err)})
+                else:
+                    handle.send_event({"done": True,
+                                       "tokens": list(ticket.tokens)})
+                handle.close()
+            elif err is not None:
                 # per-request admit failure (e.g. prefix mismatch): 400s
                 # this client alone, the batch keeps decoding
-                self.server.reply_json(rid, {"error": str(ticket.error)},
+                self.server.reply_json(rid, {"error": str(err)},
                                        status=400)
             else:
                 self.server.reply_json(rid, {"tokens": ticket.tokens})
@@ -120,6 +157,7 @@ class GenerationEngine:
             try:
                 self._admit_http(idle=not self._inflight)
                 stepped = self.decoder.step()
+                self._pump_streams()
                 self._reply_finished()
                 if stepped == 0 and not self._inflight:
                     self._stop.wait(0.005)
@@ -129,10 +167,7 @@ class GenerationEngine:
                 # fail every in-flight request rather than hang clients,
                 # and free the slot pool (nothing will retire those slots
                 # if step() keeps raising)
-                for rid, _ in self._inflight.values():
-                    self.server.reply_json(
-                        rid, {"error": "internal error"}, status=500)
-                self._inflight.clear()
+                self._fail_inflight("internal error", 500)
                 try:
                     self.decoder.cancel_all()
                 except Exception:
@@ -141,16 +176,25 @@ class GenerationEngine:
                 # backoff: a persistent failure must not busy-spin the host
                 self._stop.wait(0.2)
 
+    def _fail_inflight(self, message: str, status: int) -> None:
+        """Answer every in-flight request with an error — streaming
+        clients get a final error event and a closed stream."""
+        for f in self._inflight.values():
+            if f.stream is not None:
+                f.stream.send_event({"error": message})
+                f.stream.close()
+            else:
+                self.server.reply_json(f.rid, {"error": message},
+                                       status=status)
+        self._inflight.clear()
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
         # fail in-flight clients NOW instead of leaving their connections
         # parked until reply_timeout's 504
-        for rid, _ in self._inflight.values():
-            self.server.reply_json(
-                rid, {"error": "server shutting down"}, status=503)
-        self._inflight.clear()
+        self._fail_inflight("server shutting down", 503)
         self.decoder.cancel_all()
         self.decoder.stop()
         self.server.close()

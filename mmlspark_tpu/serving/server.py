@@ -29,6 +29,84 @@ from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
 __all__ = ["CachedRequest", "WorkerServer"]
 
 
+_STREAM_TIMEOUT_EVENT = b'data: {"error": "stream reply timeout"}\n\n'
+
+
+class StreamingReply:
+    """A reply delivered incrementally (Server-Sent Events by default).
+
+    Returned by :meth:`WorkerServer.reply_stream`; the owning transport
+    writes ``200`` + ``Content-Type: text/event-stream`` +
+    ``Connection: close`` (no content length — the stream ends when the
+    server closes it), then drains chunks as they arrive. ``send`` and
+    ``close`` are callable from any thread; sends after ``close`` are
+    dropped. Stream CONTENT is not journaled — the reply record marks the
+    request answered when the stream opens (the documented at-most-once
+    reply window applies to the whole stream).
+    """
+
+    _CLOSE = object()
+
+    def __init__(self, content_type: str = "text/event-stream"):
+        self.content_type = content_type
+        self._q: "queue.Queue" = queue.Queue()
+        self._notify = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def send(self, data) -> None:
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        with self._lock:
+            if self._closed:
+                return
+            self._q.put(bytes(data))
+            notify = self._notify
+        if notify is not None:
+            notify()
+
+    def send_event(self, payload) -> None:
+        """One SSE ``data:`` event carrying a JSON payload."""
+        import json as _json
+        self.send(f"data: {_json.dumps(payload)}\n\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(StreamingReply._CLOSE)
+            notify = self._notify
+        if notify is not None:
+            notify()
+
+    # -- transport side -----------------------------------------------------
+    def _register(self, notify) -> None:
+        """Async transport: fire ``notify()`` (thread-safe) whenever a
+        chunk lands; fires immediately if chunks are already queued."""
+        with self._lock:
+            self._notify = notify
+            pending = not self._q.empty()
+        if pending:
+            notify()
+
+    def _get(self, timeout: Optional[float]):
+        """Blocking chunk fetch (threaded transport): bytes, the close
+        sentinel, or None on timeout."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _drain_nowait(self):
+        out = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+
 @dataclass
 class CachedRequest:
     """Parity: ``CachedRequest`` — a parked exchange + its id."""
@@ -131,6 +209,33 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(504, "serving reply timeout")
             self.send_header("Content-Length", "0")
             self.end_headers()
+            return
+        if isinstance(resp, StreamingReply):
+            # incremental reply: preamble now, chunks until close(); the
+            # connection ends with the stream (no content length exists)
+            self.send_response(200)
+            self.send_header("Content-Type", resp.content_type)
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            while True:
+                chunk = resp._get(ws.reply_timeout)
+                if chunk is StreamingReply._CLOSE:
+                    break
+                if chunk is None:
+                    # per-chunk timeout: a silently truncated 200 would
+                    # read as a short successful stream — emit an explicit
+                    # final error event and stop accepting sends
+                    resp.close()
+                    chunk = _STREAM_TIMEOUT_EVENT
+                try:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                except (ConnectionError, BrokenPipeError):
+                    break
+                if chunk is _STREAM_TIMEOUT_EVENT:
+                    break
             return
         payload = resp.entity.content if resp.entity else b""
         self.send_response(resp.status_line.status_code,
@@ -324,6 +429,40 @@ class _AsyncHTTPServer:
                         resp = HTTPResponseData(status_line=StatusLineData(
                             status_code=504,
                             reason_phrase="serving reply timeout"))
+                if isinstance(resp, StreamingReply):
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: "
+                        + resp.content_type.encode("ascii")
+                        + b"\r\nCache-Control: no-store\r\n"
+                        b"Connection: close\r\n\r\n")
+                    await writer.drain()
+                    # chunks cross from dispatcher threads via a
+                    # call_soon_threadsafe-set event; the IO thread never
+                    # blocks on the stream
+                    ev = asyncio.Event()
+                    resp._register(lambda: self._loop.call_soon_threadsafe(
+                        ev.set))
+                    ended = False
+                    while not ended:
+                        try:
+                            await asyncio.wait_for(ev.wait(),
+                                                   ws.reply_timeout)
+                        except asyncio.TimeoutError:
+                            # explicit final error event — a silently
+                            # truncated 200 would read as success
+                            resp.close()
+                            writer.write(_STREAM_TIMEOUT_EVENT)
+                            await writer.drain()
+                            break
+                        ev.clear()
+                        for chunk in resp._drain_nowait():
+                            if chunk is StreamingReply._CLOSE:
+                                ended = True
+                                break
+                            writer.write(chunk)
+                        await writer.drain()
+                    break                      # stream ends the connection
                 writer.write(self._render(resp))
                 await writer.drain()
                 if close:
@@ -460,17 +599,24 @@ class WorkerServer:
                 break
         return out
 
-    def reply(self, request_id: str, response: HTTPResponseData) -> bool:
-        """Route a response to the parked connection
-        (parity: ``replyTo`` ``:536-554``)."""
+    def _take_answered(self, request_id: str) -> Optional[CachedRequest]:
+        """Pop a parked request and mark it answered (routing table,
+        epoch history, journal reply record) — THE bookkeeping sequence
+        for every reply shape, one-shot or streaming."""
         with self._lock:
             cached = self._routing.pop(request_id, None)
             if cached is not None:
                 self._history.get(cached.epoch, {}).pop(request_id, None)
+        if cached is not None and self._journal is not None:
+            self._journal.record_reply(request_id)
+        return cached
+
+    def reply(self, request_id: str, response: HTTPResponseData) -> bool:
+        """Route a response to the parked connection
+        (parity: ``replyTo`` ``:536-554``)."""
+        cached = self._take_answered(request_id)
         if cached is None:
             return False
-        if self._journal is not None:
-            self._journal.record_reply(request_id)
         cached.respond(response)
         return True
 
@@ -479,6 +625,21 @@ class WorkerServer:
         ent = EntityData.from_string(_json.dumps(payload))
         return self.reply(request_id, HTTPResponseData(
             entity=ent, status_line=StatusLineData(status_code=status)))
+
+    def reply_stream(self, request_id: str,
+                     content_type: str = "text/event-stream"
+                     ) -> Optional[StreamingReply]:
+        """Open an incremental (SSE) reply for a parked request; returns
+        the handle to ``send``/``send_event``/``close`` on, or None when
+        the request is unknown/already answered. The request is marked
+        answered when the stream OPENS (stream content is not journaled —
+        at-most-once, like the reply record itself)."""
+        cached = self._take_answered(request_id)
+        if cached is None:
+            return None
+        stream = StreamingReply(content_type)
+        cached.respond(stream)
+        return stream
 
     # -- epoch / replay -----------------------------------------------------
     def commit_epoch(self) -> int:

@@ -206,3 +206,81 @@ def test_stop_fails_inflight_fast_with_503(params):
     # failed fast with 503 — never parked until the 60 s timeout
     assert took < 20
     assert result.get("code") == 503 or "r" in result
+
+
+def _post_stream(url, payload, timeout=120.0):
+    """POST with stream:true, parse SSE events incrementally; returns the
+    (events list, content_type)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        buf = b""
+        while True:
+            chunk = r.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                if raw.startswith(b"data: "):
+                    events.append(json.loads(raw[6:]))
+    return events, ctype
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_streaming_sse_tokens(params, transport):
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48,
+                          transport=transport,
+                          steps_per_dispatch=3) as eng:
+        prompt = [5, 17, 9, 80]
+        events, ctype = _post_stream(eng.address,
+                                     {"tokens": prompt, "max_new": 8})
+        assert ctype.startswith("text/event-stream")
+        assert events and events[-1].get("done") is True
+        # incremental chunks concatenate to the final sequence, which
+        # matches the offline generator exactly
+        streamed = [t for e in events[:-1] for t in e.get("tokens", [])]
+        assert streamed == events[-1]["tokens"]
+        assert streamed == _want(params, prompt, 8)
+        # more than one incremental event actually arrived (streaming,
+        # not one blob at the end)
+        assert len(events) >= 3
+
+
+def test_streaming_and_plain_share_the_pool(params):
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48) as eng:
+        prompt_a = [5, 17, 9]
+        prompt_b = [80, 3, 41, 7]
+        out = {}
+
+        def stream_client():
+            out["s"] = _post_stream(eng.address,
+                                    {"tokens": prompt_a, "max_new": 6})[0]
+
+        def plain_client():
+            out["p"] = _post(eng.address,
+                             {"tokens": prompt_b, "max_new": 6})[1]
+
+        ts = [threading.Thread(target=stream_client),
+              threading.Thread(target=plain_client)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert out["s"][-1]["tokens"] == _want(params, prompt_a, 6)
+        assert out["p"]["tokens"] == _want(params, prompt_b, 6)
+
+
+def test_streaming_bad_request_is_json_400(params):
+    # a malformed STREAMING request still fails as a plain 400 (the
+    # stream never opens: validation happens before reply_stream)
+    with GenerationEngine(params, CFG, max_slots=1, max_len=48) as eng:
+        req = urllib.request.Request(
+            eng.address, data=json.dumps({"stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
