@@ -54,10 +54,18 @@ def _gen_resnet18() -> bytes:
     return export_resnet_onnx(RESNET18_CFG, seed=0)
 
 
+def _gen_vit_b16() -> bytes:
+    from .vit import ViTConfig, export_vit_onnx
+    return export_vit_onnx(ViTConfig(image_size=224, patch=16, d_model=768,
+                                     heads=12, layers=12, d_ff=3072,
+                                     num_classes=1000), seed=0)
+
+
 BUILTIN_MODELS: Dict[str, tuple] = {
     # name → (schema, generator)
     "ResNet50": (ModelSchema("ResNet50"), _gen_resnet50),
     "ResNet18": (ModelSchema("ResNet18"), _gen_resnet18),
+    "ViT-B-16": (ModelSchema("ViT-B-16"), _gen_vit_b16),
 }
 
 
