@@ -1,0 +1,35 @@
+#!/bin/bash
+# Full test suite, one pytest process per test file, with one automatic
+# retry when a shard dies on the environment's XLA-CPU-compiler SEGFAULT
+# (see VERDICT_RESPONSE.md: nondeterministic native crashes in
+# backend_compile_and_load on an otherwise idle host; not repo code — a
+# monolithic run loses ~an hour per crash, a shard loses one file).
+#
+# Usage: bash scripts/run_suite_sharded.sh [results_file]
+set -u
+OUT="${1:-/tmp/sharded_results.txt}"
+cd "$(dirname "$0")/.."
+: > "$OUT"
+pass=0; fail=0; failed_files=""
+for f in tests/test_*.py; do
+    rc=1
+    for attempt in 1 2; do
+        python -m pytest "$f" -q --tb=line > /tmp/shard_out.$$ 2>&1
+        rc=$?
+        [ $rc -eq 0 ] && break
+        # rc=139 is the reliable SIGSEGV signal (bash's own "Segmentation
+        # fault" notice never lands in the redirected file; faulthandler's
+        # text only appears when it managed to flush)
+        if [ $rc -ne 139 ] && ! grep -q "Segmentation fault" /tmp/shard_out.$$; then
+            break
+        fi
+        echo "RETRY(segv) $f" >> "$OUT"
+    done
+    line=$(grep -E "passed|failed|error" /tmp/shard_out.$$ | tail -1)
+    echo "$f rc=$rc :: $line" >> "$OUT"
+    if [ $rc -eq 0 ]; then pass=$((pass+1));
+    else fail=$((fail+1)); failed_files="$failed_files $f"; fi
+done
+rm -f /tmp/shard_out.$$
+echo "SHARDED DONE: $pass files ok, $fail files failed:$failed_files" >> "$OUT"
+[ $fail -eq 0 ]
