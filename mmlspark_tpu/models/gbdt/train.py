@@ -207,7 +207,8 @@ def train(params: Dict,
           callbacks: Optional[List[Callable]] = None,
           eval_log: Optional[List] = None,
           init_score: Optional[np.ndarray] = None,
-          valid_init_scores: Optional[List[np.ndarray]] = None) -> Booster:
+          valid_init_scores: Optional[List[np.ndarray]] = None,
+          valid_weights: Optional[List[np.ndarray]] = None) -> Booster:
     """Fit a GBDT. ``params`` uses LightGBM names (aliases accepted).
 
     ``init_score``: per-row starting margin (LightGBM ``init_score``) —
@@ -216,7 +217,8 @@ def train(params: Dict,
     at scoring time). With ``valid_sets``, matching per-set margins must
     come in ``valid_init_scores`` (each Dataset carries its own
     init_score in LightGBM too) so eval metrics are computed at the right
-    margin."""
+    margin. ``valid_weights``: per-set sample weights for eval metrics
+    (LightGBM's Dataset weights apply to its eval too)."""
     p = resolve_params(params)
     # keep X in its incoming float width — a HIGGS-scale float32 matrix must
     # not be silently doubled to float64 (binning only ever copies a sample
@@ -635,9 +637,19 @@ def train(params: Dict,
     n_iter = max(0, int(p["num_iterations"]) - resumed_iters)
     ckpt_iv = int(p["checkpoint_interval"]) if ckpt is not None else 0
 
-    # eval bookkeeping
-    metric_name, (metric_fn, higher_better) = get_metric(
-        p["metric"] if p["metric"] not in ("auto", "") else "", objective_name)
+    # eval bookkeeping. LightGBM accepts a METRIC LIST: every metric is
+    # computed and logged per iteration; early stopping follows the FIRST
+    # (LightGBM's first_metric_only=True discipline — the stable subset of
+    # its any-metric default, which couples the stop decision to list
+    # order anyway)
+    m_raw = p["metric"]
+    metric_list = (list(m_raw) if isinstance(m_raw, (list, tuple))
+                   else [m_raw])
+    if not metric_list:
+        metric_list = ["auto"]      # empty list = objective default (LGBM)
+    resolved = [get_metric(m if m not in ("auto", "") else "",
+                           objective_name) for m in metric_list]
+    metric_name, (metric_fn, higher_better) = resolved[0]
     best_score = -np.inf if higher_better else np.inf
     best_iter = 0
     best_model = None               # dart: snapshot at each new best
@@ -659,6 +671,19 @@ def train(params: Dict,
             valid_scores = [np.full(
                 (vx.shape[0], num_class) if is_multi else vx.shape[0],
                 base_score, dtype=np.float64) for vx, _vy in valid_sets]
+        if valid_weights is not None:
+            if len(valid_weights) != len(valid_sets):
+                raise ValueError(
+                    f"valid_weights has {len(valid_weights)} entries for "
+                    f"{len(valid_sets)} valid_sets")
+            valid_weights = [np.asarray(w, dtype=np.float64)
+                             for w in valid_weights]
+            for vi, (w_, (vx_, _vy)) in enumerate(
+                    zip(valid_weights, valid_sets)):
+                if len(w_) != vx_.shape[0]:
+                    raise ValueError(
+                        f"valid_weights[{vi}] has {len(w_)} rows for a "
+                        f"{vx_.shape[0]}-row validation set")
         valid_margins = None
         if valid_init_scores is not None:
             if len(valid_init_scores) != len(valid_sets):
@@ -892,9 +917,17 @@ def train(params: Dict,
                                         coefs_a=new_coefs, pf_a=new_pf)
                     valid_scores[vi] = valid_scores[vi] + delta
                 pred = np.asarray(obj.transform(jnp.asarray(valid_scores[vi])))
-                vw = np.ones(len(vy))
-                val = metric_fn(np.asarray(vy), pred, vw)
-                results.append(val)
+                vw = (valid_weights[vi] if valid_weights is not None
+                      else np.ones(len(vy)))
+                vy_arr = np.asarray(vy)
+                vals = {mname: mfn(vy_arr, pred, vw)
+                        for mname, (mfn, _hb) in resolved}
+                results.append(vals[metric_name])
+                if eval_log is not None and (len(resolved) > 1
+                                             or len(valid_sets) > 1):
+                    for mname, mv in vals.items():
+                        eval_log.append({"iteration": it, "valid_set": vi,
+                                         mname: mv})
             primary = results[0]
             if eval_log is not None:
                 eval_log.append({"iteration": it, metric_name: primary})

@@ -258,3 +258,57 @@ class TestMeshParity:
         with pytest.raises(ValueError, match="data_parallel"):
             train(dict(BASE, extra_trees=True, top_k=3,
                        tree_learner="voting_parallel"), X, y, mesh=mesh)
+
+
+class TestEvalParity:
+    """Eval-side LightGBM parity: per-set eval weights and metric lists."""
+
+    def test_valid_weights_drive_the_logged_metric(self):
+        X, y = _data(n=600)
+        Xv, yv = _data(n=200, seed=9)
+        w = np.where(np.arange(200) < 100, 10.0, 0.1)
+        log = []
+        train(dict(BASE, metric="l2"), X, y, valid_sets=[(Xv, yv)],
+              valid_weights=[w], eval_log=log)
+        m = train(dict(BASE, metric="l2"), X, y, valid_sets=[(Xv, yv)],
+                  eval_log=[])
+        pred = m.predict(Xv)
+        # the last logged l2 equals the weighted mean squared error of the
+        # final model, not the unweighted one
+        want = float(np.sum(w * (pred - yv) ** 2) / np.sum(w))
+        got = log[-1]["l2"]
+        assert got == pytest.approx(want, rel=1e-5)
+        plain = float(np.mean((pred - yv) ** 2))
+        assert abs(got - plain) > 1e-9       # the weights actually matter
+
+    def test_valid_weights_validation(self):
+        X, y = _data(n=100)
+        Xv, yv = _data(n=50, seed=1)
+        with pytest.raises(ValueError, match="valid_weights"):
+            train(BASE, X, y, valid_sets=[(Xv, yv)],
+                  valid_weights=[np.ones(3), np.ones(50)])
+        with pytest.raises(ValueError, match="rows"):
+            train(BASE, X, y, valid_sets=[(Xv, yv)],
+                  valid_weights=[np.ones(7)])
+
+    def test_metric_list_logs_every_metric(self):
+        X, y = _data(n=500)
+        yb = (y > np.median(y)).astype(np.float64)
+        Xv, yv = _data(n=150, seed=3)
+        yvb = (yv > np.median(yv)).astype(np.float64)
+        log = []
+        m = train(dict(BASE, objective="binary",
+                       metric=["auc", "binary_logloss"],
+                       early_stopping_round=0),
+                  X, yb, valid_sets=[(Xv, yvb)], eval_log=log)
+        per_set = [e for e in log if "valid_set" in e]
+        assert any("auc" in e for e in per_set)
+        assert any("binary_logloss" in e for e in per_set)
+        # early stopping / best tracking follows the FIRST metric
+        assert m.num_trees == BASE["num_iterations"]
+
+    def test_unknown_metric_in_list_rejected(self):
+        X, y = _data(n=100)
+        with pytest.raises(ValueError, match="unknown metric"):
+            train(dict(BASE, metric=["l2", "nope"]), X, y,
+                  valid_sets=[(X, y)])
