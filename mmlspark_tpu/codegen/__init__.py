@@ -83,6 +83,11 @@ def param_annotation(p: Param) -> str:
         ann = f"Literal[{inner}]"
     elif isinstance(p.dtype, tuple) and len(p.dtype) == 2 and p.dtype[0] is list:
         ann = f"List[{_BASIC.get(p.dtype[1], 'Any')}]"
+    elif isinstance(p.dtype, tuple) and len(p.dtype) == 2 \
+            and p.dtype[1] is list:
+        # (X, list) = scalar-or-list-of-X (e.g. one metric name or several)
+        base = _BASIC.get(p.dtype[0], 'Any')
+        ann = f"Union[{base}, List[{base}]]"
     else:
         ann = _BASIC.get(p.dtype, "Any")
     if p.has_default and p.default is None and ann not in ("Any",):
@@ -257,7 +262,7 @@ def _generate_module_stub(module_name: str,
         "# AUTO-GENERATED by `python -m mmlspark_tpu.codegen` — do not edit.",
         "# Typed surface for the Param system; parity role of the reference's",
         "# generated PySpark wrappers (codegen/Wrappable.scala:68-180).",
-        "from typing import Any, Dict, List, Literal, Optional",
+        "from typing import Any, Dict, List, Literal, Optional, Union",
         "",
     ]
     imports.setdefault("mmlspark_tpu.core.params", set()).add("Params")

@@ -31,6 +31,17 @@ __all__ = ["LightGBMClassifier", "LightGBMRegressor", "LightGBMRanker",
            "LightGBMRankerModel"]
 
 
+def _str_or_str_list(v):
+    """One metric name, or a list/tuple of them — anything else (ints,
+    dicts, sets) is a typed error, not a silent iteration."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [str(m) for m in v]
+    raise TypeError(f"expected str or list of str, got "
+                    f"{type(v).__name__}: {v!r}")
+
+
 class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     boosting_type = Param(str, default="gbdt",
                           choices=["gbdt", "gbrt", "goss", "dart", "rf",
@@ -64,7 +75,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     parallelism = Param(str, default="serial",
                         choices=["serial", "data_parallel", "voting_parallel"],
                         doc="tree learner (reference LightGBMParams.parallelism)")
-    metric = Param(str, default="auto", doc="eval metric name")
+    metric = Param((str, list), default="auto",
+                   converter=_str_or_str_list,
+                   doc="eval metric name, or a LIST of names (all logged; "
+                       "early stopping follows the first)")
     seed = Param(int, default=0, doc="random seed")
     validation_indicator_col = Param(str, default=None,
                                      doc="bool column marking validation rows")
@@ -179,9 +193,15 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
              if self.get_or_none("weight_col") and self.weight_col in train_df
              else None)
         valid_sets = None
+        valid_weights = None
         if valid_df is not None and len(valid_df):
             valid_sets = [(assemble_features(valid_df, [self.features_col]),
                            np.asarray(valid_df[self.label_col], dtype=np.float64))]
+            if w is not None and self.weight_col in valid_df:
+                # LightGBM's Dataset weights apply to its eval metrics:
+                # the validation split's weight rows drive early stopping
+                valid_weights = [np.asarray(valid_df[self.weight_col],
+                                            dtype=np.float64)]
         group = None
         if group_col is not None:
             gcol = np.asarray(train_df[group_col])
@@ -212,7 +232,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         return train(self._train_params(extra_params), X, y, sample_weight=w,
                      group=group, valid_sets=valid_sets, init_model=init_model,
                      mesh=mesh, init_score=init_score,
-                     valid_init_scores=valid_init_scores)
+                     valid_init_scores=valid_init_scores,
+                     valid_weights=valid_weights)
 
 
 class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):

@@ -920,11 +920,16 @@ def train(params: Dict,
                 vw = (valid_weights[vi] if valid_weights is not None
                       else np.ones(len(vy)))
                 vy_arr = np.asarray(vy)
+                per_set_log = (eval_log is not None
+                               and (len(resolved) > 1
+                                    or len(valid_sets) > 1))
+                # non-primary metrics only cost compute when something
+                # consumes them (the per-set log)
+                use = resolved if per_set_log else resolved[:1]
                 vals = {mname: mfn(vy_arr, pred, vw)
-                        for mname, (mfn, _hb) in resolved}
+                        for mname, (mfn, _hb) in use}
                 results.append(vals[metric_name])
-                if eval_log is not None and (len(resolved) > 1
-                                             or len(valid_sets) > 1):
+                if per_set_log:
                     for mname, mv in vals.items():
                         eval_log.append({"iteration": it, "valid_set": vi,
                                          mname: mv})

@@ -312,3 +312,43 @@ class TestEvalParity:
         with pytest.raises(ValueError, match="unknown metric"):
             train(dict(BASE, metric=["l2", "nope"]), X, y,
                   valid_sets=[(X, y)])
+
+
+class TestEstimatorEvalPlumbing:
+    def test_weight_col_reaches_validation_eval(self, monkeypatch):
+        """The estimator forwards the validation split's weight rows as
+        valid_weights (LightGBM Dataset-weight eval semantics)."""
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.models.gbdt import estimators as E
+
+        captured = {}
+        real_train = E.train
+
+        def spy(params, X, y, **kw):
+            captured.update(kw)
+            return real_train(params, X, y, **kw)
+
+        monkeypatch.setattr(E, "train", spy)
+        rng = np.random.default_rng(0)
+        n = 120
+        Xr = rng.normal(size=(n, 4))
+        feats = np.empty(n, object)
+        feats[:] = list(Xr)
+        df = DataFrame({"features": feats,
+                        "label": (Xr[:, 0] > 0).astype(np.float64),
+                        "w": rng.uniform(0.5, 2.0, n),
+                        "is_val": np.arange(n) >= 90})
+        E.LightGBMClassifier(num_iterations=3, weight_col="w",
+                             validation_indicator_col="is_val").fit(df)
+        vw = captured["valid_weights"]
+        assert vw is not None and len(vw) == 1 and len(vw[0]) == 30
+        np.testing.assert_allclose(vw[0], np.asarray(df["w"])[90:])
+
+    def test_metric_param_rejects_scalars_and_dicts(self):
+        from mmlspark_tpu.models.gbdt import LightGBMRegressor
+        with pytest.raises(TypeError, match="str or list"):
+            LightGBMRegressor(metric=5)
+        with pytest.raises(TypeError, match="str or list"):
+            LightGBMRegressor(metric={"l2": True})
+        m = LightGBMRegressor(metric=["l2", "l1"])
+        assert m.metric == ["l2", "l1"]
