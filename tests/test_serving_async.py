@@ -226,3 +226,64 @@ def test_journal_append_after_close_is_dropped(tmp_path):
     j.close()
     with pytest.warns(RuntimeWarning):
         j.record_reply("some-id")       # must not raise
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_stream_roundtrip_and_timeout(transport):
+    """reply_stream on both transports: the first chunk is observable on
+    the wire BEFORE the stream closes (incremental delivery, not one
+    flush at close), a closed stream ends the response, and a stream
+    that goes SILENT past reply_timeout gets an explicit final error
+    event (never a silently truncated 200 that reads as success)."""
+    ws = WorkerServer(transport=transport, reply_timeout=30.0)
+    try:
+        may_close = threading.Event()
+
+        def answer():
+            (cached,) = ws.get_batch(1, timeout=5.0)
+            h = ws.reply_stream(cached.request_id)
+            h.send_event({"tokens": [1, 2]})
+            may_close.wait(10)              # close only after the client
+            h.send_event({"tokens": [3]})   # has SEEN the first event
+            h.close()
+
+        t = threading.Thread(target=answer)
+        t.start()
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        conn.request("POST", "/", b"{}")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        first = b""
+        while b"\n\n" not in first:        # incremental: close not called
+            first += r.read1(256)
+        assert json.loads(first.split(b"\n\n")[0][6:]) == {"tokens": [1, 2]}
+        may_close.set()
+        rest = r.read().decode()
+        t.join(timeout=5)
+        events = [json.loads(b[6:]) for b in rest.split("\n\n")
+                  if b.startswith("data: ")]
+        assert events == [{"tokens": [3]}]
+        conn.close()
+    finally:
+        ws.close()
+
+    # timeout path on its OWN server: the stream opens and goes silent
+    ws2 = WorkerServer(transport=transport, reply_timeout=0.5)
+    try:
+        def answer_silent():
+            (cached,) = ws2.get_batch(1, timeout=5.0)
+            ws2.reply_stream(cached.request_id)      # never sends
+        t2 = threading.Thread(target=answer_silent)
+        t2.start()
+        conn2 = http.client.HTTPConnection("127.0.0.1", ws2.port, timeout=10)
+        conn2.request("POST", "/", b"{}")
+        r2 = conn2.getresponse()
+        body2 = r2.read().decode()
+        t2.join(timeout=5)
+        events2 = [json.loads(b[6:]) for b in body2.split("\n\n")
+                   if b.startswith("data: ")]
+        assert events2 and events2[-1] == {"error": "stream reply timeout"}
+        conn2.close()
+    finally:
+        ws2.close()
