@@ -898,6 +898,8 @@ def train(params: Dict,
         # booster's lazy tree stack is not re-materialized every round)
         if valid_sets:
             results = []
+            per_set_log = (eval_log is not None
+                           and (len(resolved) > 1 or len(valid_sets) > 1))
             for vi, (vx, vy) in enumerate(valid_sets):
                 if drop_idx is not None:
                     # past trees were just re-scaled (dart drop) —
@@ -920,9 +922,6 @@ def train(params: Dict,
                 vw = (valid_weights[vi] if valid_weights is not None
                       else np.ones(len(vy)))
                 vy_arr = np.asarray(vy)
-                per_set_log = (eval_log is not None
-                               and (len(resolved) > 1
-                                    or len(valid_sets) > 1))
                 # non-primary metrics only cost compute when something
                 # consumes them (the per-set log)
                 use = resolved if per_set_log else resolved[:1]
@@ -935,7 +934,13 @@ def train(params: Dict,
                                          mname: mv})
             primary = results[0]
             if eval_log is not None:
-                eval_log.append({"iteration": it, metric_name: primary})
+                # tagged so consumers can tell the early-stopping summary
+                # from the self-describing per-set entries (which repeat
+                # this value for set 0 when per_set_log is on)
+                entry = {"iteration": it, metric_name: primary}
+                if per_set_log:
+                    entry["primary"] = True
+                eval_log.append(entry)
             improved = primary > best_score if higher_better else primary < best_score
             if improved:
                 best_score = primary

@@ -355,10 +355,10 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             # child (LightGBM samples per-feature ranges too)
             u = jax.random.uniform(jax.random.fold_in(rng, 2 * d),
                                    (n_nodes, F))
-            hi = (jnp.maximum(feat_bins - 1, 1)[None, :]
-                  if feat_bins is not None
-                  else jnp.full((1, F), max(n_bins - 1, 1)))
-            r = jnp.minimum((u * hi).astype(jnp.int32), hi - 1)
+            bin_hi = (jnp.maximum(feat_bins - 1, 1)[None, :]
+                      if feat_bins is not None
+                      else jnp.full((1, F), max(n_bins - 1, 1)))
+            r = jnp.minimum((u * bin_hi).astype(jnp.int32), bin_hi - 1)
             cand = jnp.arange(n_bins)[None, None, :] == r[:, :, None]
         fm_level = feature_mask
         if ic_groups is not None:

@@ -549,9 +549,9 @@ class ContinuousDecoder:
             self._params, jnp.asarray(ids), jnp.asarray([P], jnp.int32))
         self.stats["prefills"] += 1
         if req.prefix_key is not None and self._prefix_store_cap > 0:
-            # store-on-miss: snapshot ONLY the prefix region (a copy —
-            # the live row cache is donated into the slot pool right
-            # after; full-length copies would hold max_len KV per entry)
+            # store-on-miss: snapshot ONLY the prefix region (a copy,
+            # bounding snapshot size to the prefix — full-length rows
+            # would hold max_len KV per entry)
             plen = req.prefix_len if req.prefix_len is not None else P
             snap = [{k: jnp.array(c[k][:, :, :plen]) for k in ("k", "v")}
                     for c in row_cache]

@@ -113,6 +113,15 @@ class TestMonotone:
                        tree_learner="voting_parallel", top_k=1), X, y,
                   mesh=mesh)
 
+    def test_monotone_with_extra_trees(self):
+        # regression: the extra_trees random-threshold draw used to shadow
+        # the monotone upper-bound vector (`hi`), breaking the combination
+        X, y = make_data(seed=9)
+        b = train(dict(PARAMS, extra_trees=True, seed=11,
+                       monotone_constraints=[1, -1, 0, 0]), X, y)
+        assert (sweep(b, 0) >= -1e-6).all()
+        assert (sweep(b, 1) <= 1e-6).all()
+
     def test_empty_list_means_no_constraints(self):
         X, y = make_data(n=100, seed=7)
         b = train(dict(PARAMS, num_iterations=2,

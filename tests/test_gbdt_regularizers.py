@@ -304,6 +304,12 @@ class TestEvalParity:
         per_set = [e for e in log if "valid_set" in e]
         assert any("auc" in e for e in per_set)
         assert any("binary_logloss" in e for e in per_set)
+        # every per-set (set, metric) pair is self-describing; the
+        # early-stopping summary entry is distinctly tagged so consumers
+        # counting entries don't conflate it with the per-set series
+        summaries = [e for e in log if "valid_set" not in e]
+        assert summaries and all(e.get("primary") for e in summaries)
+        assert all("auc" in e for e in summaries)
         # early stopping / best tracking follows the FIRST metric
         assert m.num_trees == BASE["num_iterations"]
 
