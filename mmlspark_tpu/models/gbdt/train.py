@@ -18,7 +18,11 @@ maintains), rows never move. Multiclass trains K trees per iteration via
 from __future__ import annotations
 
 import functools
+import json
 import math
+import os
+import sys
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -81,6 +85,8 @@ _DEFAULTS = dict(
     min_data_per_group=0,           # categorical: pool rarer categories
     linear_tree=False,              # ridge model per leaf over path features
     linear_lambda=0.0,              # L2 on linear-leaf weights (not bias)
+    use_quantized_grad=False,       # bf16 histogram stats on the MXU
+    #                                 (LightGBM's quantized-gradient analog)
 )
 
 
@@ -195,6 +201,39 @@ class TrainConfig:
         self.p = resolve_params(params)
         self.depth = _depth_for(self.p)
         self.n_features = n_features
+
+
+class _PhaseProf:
+    """Opt-in wall-clock phase breakdown (``MMLSPARK_TPU_GBDT_PROF=1``).
+
+    ``mark`` blocks on the given arrays before reading the clock, so each
+    phase's time includes its device work — profiling deliberately defeats
+    async dispatch; production runs leave it off and pipeline.
+    """
+
+    def __init__(self):
+        self.enabled = os.environ.get("MMLSPARK_TPU_GBDT_PROF", "0") == "1"
+        self.t: Dict[str, float] = {}
+        self._last = time.perf_counter()
+
+    def mark(self, name: str, *sync):
+        if not self.enabled:
+            return
+        for a in sync:
+            jax.block_until_ready(a)
+        now = time.perf_counter()
+        self.t[name] = self.t.get(name, 0.0) + (now - self._last)
+        self._last = now
+
+    def reset(self):
+        if self.enabled:
+            self._last = time.perf_counter()
+
+    def report(self, n_iter: int):
+        if self.enabled:
+            print(json.dumps({"gbdt_phase_seconds":
+                              {k: round(v, 3) for k, v in self.t.items()},
+                              "n_iter": n_iter}), file=sys.stderr, flush=True)
 
 
 def train(params: Dict,
@@ -372,6 +411,8 @@ def train(params: Dict,
                 min_data_per_group=int(p["min_data_per_group"])).fit(X, y)
         X = cat_encoder.transform(X)
 
+    prof = _PhaseProf()
+    prof.reset()
     mapper = BinMapper(max_bin=int(p["max_bin"]), seed=int(p["seed"]))
     bundle_tables = None
     n_bundle_bins = 0
@@ -398,7 +439,25 @@ def train(params: Dict,
             xb = mapper.transform(X)
     else:
         mapper.fit(X)
-        xb = mapper.transform(X)
+        prof.mark("bin_fit")
+        will_shard = (mesh is not None
+                      and p["tree_learner"] in ("data_parallel",
+                                                "voting_parallel"))
+        if not will_shard and not sparse_X and n >= (1 << 21):
+            # chunked bin→upload pipeline: while chunk i transfers (async
+            # device_put), chunk i+1 bins on the host — at HIGGS scale this
+            # hides most of the h2d time behind the native binning loop,
+            # and the full host-side binned matrix never materializes
+            CHR = 1 << 21
+            parts = [jax.device_put(mapper.transform(X[lo:lo + CHR]))
+                     for lo in range(0, n, CHR)]
+            xb_dev_early = (jnp.concatenate(parts, axis=0)
+                            if len(parts) > 1 else parts[0])
+            xb = None
+            prof.mark("bin_upload_overlap", xb_dev_early)
+        else:
+            xb = mapper.transform(X)
+            prof.mark("bin_transform")
     n_bins = mapper.n_bins
 
     if init_model is not None and init_score is not None:
@@ -488,10 +547,28 @@ def train(params: Dict,
         w_d = jax.device_put(jnp.asarray(w_pad), row_sharding)
         live_d = jax.device_put(jnp.asarray(live), row_sharding)
     else:
-        xb_d = jnp.asarray(xb)
+        xb_d = xb_dev_early if xb is None else jnp.asarray(xb)
         y_d = jnp.asarray(y_pad)
         w_d = jnp.asarray(w_pad)
         live_d = jnp.asarray(live)
+    prof.mark("upload", xb_d, y_d, w_d, live_d, scores)
+
+    # kernel lane layout, once per RUN (the per-level transpose it replaces
+    # cost a full read+write of the bin matrix each level of each tree)
+    xb_lanes_d = None
+    if axis_name is None:
+        from ...ops.pallas_kernels import (histogram_enabled,
+                                           pallas_preferred,
+                                           prepare_bins_lanes,
+                                           tree_row_block)
+        kbins = int(n_bundle_bins) if n_bundle_bins else int(n_bins)
+        if histogram_enabled() and pallas_preferred(
+                n_pad, 2 ** max(depth - 1, 0), kbins):
+            # row block must match build_tree's tree_row_block choice (the
+            # kernel validates npad divisibility against it)
+            xb_lanes_d = prepare_bins_lanes(
+                xb_d, row_block=tree_row_block(2 ** max(depth - 1, 0),
+                                               kbins))
 
     X_lin = None
     if linear_tree:
@@ -526,7 +603,9 @@ def train(params: Dict,
                         n_bundle_bins=int(n_bundle_bins),
                         extra_trees=bool(p["extra_trees"]),
                         ff_bynode=ffbn,
-                        path_smooth=float(p["path_smooth"]))
+                        path_smooth=float(p["path_smooth"]),
+                        hist_dtype=("bfloat16" if p["use_quantized_grad"]
+                                    else None))
     if p["extra_trees"]:
         # per-feature populated bin counts (incl. missing bin 0): the
         # random-threshold draw samples each feature's own range
@@ -578,9 +657,12 @@ def train(params: Dict,
             build_kwargs["monotone"] = jnp.asarray(mono)
 
     if axis_name is None:
-        def build(xb_, g_, h_, live_, fmask, key):
+        def build(xb_, g_, h_, live_, fmask, key, lanes=None):
+            # lanes passed as an ARG (not closed over): a closure-captured
+            # device array would be baked into the jitted program as a
+            # constant
             return build_tree(xb_, g_, h_, live_, feature_mask=fmask,
-                              rng=key, **build_kwargs)
+                              rng=key, xb_lanes=lanes, **build_kwargs)
     else:
         n_int = 2 ** depth - 1
 
@@ -590,12 +672,17 @@ def train(params: Dict,
                       P(None), P(None)),
             out_specs=(P(None), P(None), P(None), P("data"), P(None), P(None)),
             check_vma=False)
-        def build(xb_, g_, h_, live_, fmask, key):
+        def _build_sharded(xb_, g_, h_, live_, fmask, key):
             # key replicated: every shard draws identical random masks, so
             # extra_trees/by-node sampling stays bitwise-deterministic
             # across the mesh (same invariant as the psum'd histogram)
             return build_tree(xb_, g_, h_, live_, feature_mask=fmask,
                               rng=key, axis_name=axis_name, **build_kwargs)
+
+        def build(xb_, g_, h_, live_, fmask, key, lanes=None):
+            # per-shard lane layouts are prepared inside build_tree (once
+            # per tree); a replicated global layout is ignored here
+            return _build_sharded(xb_, g_, h_, live_, fmask, key)
 
     lin_fit = None
     if linear_tree:
@@ -715,7 +802,77 @@ def train(params: Dict,
         else None
     K_trees = num_class if is_multi else 1
 
+    # -- fused/deferred fast path -------------------------------------------
+    # For the plain-gbdt configuration (the HIGGS north-star shape) the whole
+    # iteration — gradients, masking, tree build, score update — is ONE
+    # jitted dispatch, and the fitted tree arrays stay on device until after
+    # the loop. The Python loop then never blocks: iterations pipeline
+    # back-to-back on the chip and per-dispatch/transfer round-trips (70 ms
+    # each over a tunneled link) amortize away, where the materializing path
+    # paid ~5 of them per iteration. Excluded modes keep the general path:
+    # goss (host top-k), dart (host drop bookkeeping), rf (constant-margin
+    # grads), lambdarank (host pairwise grads), multiclass (vmap build),
+    # linear_tree (host path_features), eval/callback/checkpoint consumers
+    # (need the booster per iteration).
+    defer = (boosting == "gbdt" and not is_rank and not is_multi
+             and not linear_tree and not valid_sets and not callbacks
+             and ckpt is None and grad_fn is not None)
+    fused_step = None
+    if defer:
+        lr_fast = lr     # gbdt: tree_scale == 1.0 always
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fused_step(scores_, xb_, y_, w_, gh_w_, live_it_, fmask_, key_,
+                       lanes_):
+            g_, h_ = obj.grad_hess(scores_ + jnp.float32(base_score),
+                                   y_, w_)
+            # gh_w always carries the live-row factor (it is live_d or a
+            # bagged subset of it), so one multiply applies both masks
+            g_ = g_ * gh_w_
+            h_ = h_ * gh_w_
+            feats_, thr_, leaf_, node_, gains_, covers_ = build(
+                xb_, g_, h_, live_it_, fmask_, key_, lanes_)
+            scores2 = scores_ + jnp.take(leaf_, node_) * lr_fast
+            return scores2, feats_, thr_, leaf_, gains_, covers_
+
+    pending: List[Tuple] = []
+    fmask_all = jnp.ones(F, dtype=bool)     # hoisted: constant across iters
+
+    def _bagging_masks(it):
+        """(live_it, gh_w) for this iteration. Shared by the fused and the
+        general loop paths so the rng stream stays in lockstep — a given
+        seed must yield identical row subsets either way."""
+        if p["bagging_freq"] and p["bagging_fraction"] < 1.0 \
+                and it % int(p["bagging_freq"]) == 0:
+            keep = rng.random(n_pad) < float(p["bagging_fraction"])
+            live_it = live_d * jnp.asarray(keep.astype(np.float64))
+            return live_it, live_it
+        return live_d, live_d
+
+    def _feature_mask():
+        """Per-tree feature subsample mask (same rng-lockstep contract)."""
+        if float(p["feature_fraction"]) < 1.0:
+            k = max(1, int(round(F * float(p["feature_fraction"]))))
+            sel = rng.choice(F, size=k, replace=False)
+            m = np.zeros(F, dtype=bool)
+            m[sel] = True
+            return jnp.asarray(m)
+        return fmask_all
+
     for it in range(n_iter):
+        prof.reset()
+        if defer:
+            # one fused dispatch; tree arrays stay on device (materialized
+            # in one batch after the loop)
+            live_it, gh_w = _bagging_masks(it)
+            fmask = _feature_mask()
+            it_key = jax.random.fold_in(base_key, resumed_iters + it)
+            scores, feats, thr_bin, leaf_val, gains, covers = fused_step(
+                scores, xb_d, y_d, w_d, gh_w, live_it, fmask, it_key,
+                xb_lanes_d)
+            pending.append((feats, thr_bin, leaf_val, gains, covers))
+            prof.mark("fused_step", scores)
+            continue
         # -- dart: pick an iteration subset to drop, score without it ------
         drop_idx = None
         drop_pred = None
@@ -778,13 +935,12 @@ def train(params: Dict,
             g_d, h_d = grad_fn(scores_for_grad, y_d, w_d)
             g_d = g_d * live_d[..., None] if is_multi else g_d * live_d
             h_d = h_d * live_d[..., None] if is_multi else h_d * live_d
+        prof.mark("grad", g_d, h_d)
 
         # goss / bagging / feature sampling. ``live_it`` is the 0/1 row
         # membership (drives min_data_in_leaf counts and stored covers);
         # ``gh_w`` additionally carries GOSS's gradient amplification —
         # LightGBM amplifies only grad/hess, never the count channel
-        live_it = live_d
-        gh_w = live_d
         if boosting == "goss":
             # gradient-based one-side sampling: keep the top_rate fraction
             # by |grad|, sample other_rate of the rest amplified by
@@ -808,20 +964,9 @@ def train(params: Dict,
                 sel_amp[samp] = (1.0 - a) / max(b, 1e-12)
             live_it = live_d * jnp.asarray(sel_bin)
             gh_w = live_d * jnp.asarray(sel_amp)
-        elif p["bagging_freq"] and p["bagging_fraction"] < 1.0 \
-                and it % int(p["bagging_freq"]) == 0:
-            keep = rng.random(n_pad) < float(p["bagging_fraction"])
-            live_it = live_d * jnp.asarray(keep.astype(np.float64))
-            gh_w = live_it
-        fmask = None
-        if float(p["feature_fraction"]) < 1.0:
-            k = max(1, int(round(F * float(p["feature_fraction"]))))
-            sel = rng.choice(F, size=k, replace=False)
-            m = np.zeros(F, dtype=bool)
-            m[sel] = True
-            fmask = jnp.asarray(m)
         else:
-            fmask = jnp.ones(F, dtype=bool)
+            live_it, gh_w = _bagging_masks(it)
+        fmask = _feature_mask()
         mask_g = gh_w if not is_multi else gh_w[:, None]
         # rf has no shrinkage — each tree enters at 1/T so the sum is the
         # forest average; dart additionally scales the new tree by 1/(k+1)
@@ -859,7 +1004,8 @@ def train(params: Dict,
             g_m = g_d * gh_w
             h_m = h_d * gh_w
             feats, thr_bin, leaf_val, node_rel, gains, covers = build(
-                xb_d, g_m, h_m, live_it, fmask, it_key)
+                xb_d, g_m, h_m, live_it, fmask, it_key, xb_lanes_d)
+            prof.mark("build", feats, leaf_val, node_rel)
             feats_np = np.asarray(feats)
             thr_raw = _thr_bins_to_raw(feats_np, np.asarray(thr_bin), mapper,
                                        int(n_bins))
@@ -881,7 +1027,9 @@ def train(params: Dict,
                 leaf_np = np.asarray(leaf_val) * lr_eff
                 booster.append_tree(feats_np, thr_raw, leaf_np,
                                     np.asarray(gains), np.asarray(covers))
+                prof.mark("host_tree")
                 scores = scores + jnp.take(leaf_val, node_rel) * lr_eff
+                prof.mark("score_update", scores)
             new_feats = feats_np[None]
             new_thr = thr_raw[None]
             new_leaf = leaf_np[None]
@@ -976,11 +1124,32 @@ def train(params: Dict,
                 "meta.json": {"completed_iterations": resumed_iters + it + 1},
             })
 
+    if pending:
+        # materialize the deferred device-side tree stack: stack in chunks
+        # (bounding trace size), one host transfer per chunk instead of ~5
+        # per iteration, then one vectorized bin→raw threshold conversion
+        CH = 64
+        cols = [[], [], [], [], []]
+        for lo in range(0, len(pending), CH):
+            grp = pending[lo:lo + CH]
+            for i in range(5):
+                cols[i].append(np.asarray(jnp.stack([t[i] for t in grp])))
+        feats_all, thr_all, leaf_all, gains_all, covers_all = (
+            np.concatenate(c) for c in cols)
+        thr_raw_all = _thr_bins_to_raw(feats_all, thr_all, mapper,
+                                       int(n_bins))
+        leaf_all = leaf_all.astype(np.float32) * np.float32(lr)
+        for t in range(feats_all.shape[0]):
+            booster.append_tree(feats_all[t], thr_raw_all[t], leaf_all[t],
+                                gains_all[t], covers_all[t])
+        prof.mark("materialize")
+
     if ckpt is not None and n_iter > 0:
         ckpt.save(resumed_iters + n_iter, {
             "booster.txt": booster.to_string(),
             "meta.json": {"completed_iterations": resumed_iters + n_iter},
         })
+    prof.report(n_iter)
     if valid_sets and n_iter == 0:
         # fully-completed checkpointed run rerun idempotently: the eval loop
         # never executed, so keep the restored booster's best_iteration

@@ -60,12 +60,15 @@ class TreeArrays(NamedTuple):
     leaf_value: jnp.ndarray
 
 
-def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
+def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name,
+                     bins_lanes=None, stats_dtype=None, row_block=0):
     """(n,F) bins × per-row (g,h,count) → (n_nodes, F, B, 3) histogram.
 
     Two interchangeable builders: the Pallas MXU kernel
     (``ops/pallas_kernels.py``, used on TPU) and an XLA ``segment_sum``
     fallback. Both replace LightGBM's native C++ histogram construction.
+    ``bins_lanes`` is the kernel's precomputed (F, 1, npad) layout;
+    ``stats_dtype`` bfloat16 runs the kernel matmul at native MXU rate.
     """
     from ...ops.pallas_kernels import (histogram_enabled,
                                        level_histogram_pallas,
@@ -75,7 +78,10 @@ def _level_histogram(xb, node_rel, g, h, w_count, n_nodes, n_bins, axis_name):
         # force-on off-TPU runs the interpreter (Mosaic can't compile there)
         hist = level_histogram_pallas(xb, node_rel, g, h, w_count,
                                       n_nodes, n_bins,
-                                      interpret=not is_tpu())
+                                      interpret=not is_tpu(),
+                                      bins_lanes=bins_lanes,
+                                      stats_dtype=stats_dtype,
+                                      row_block=row_block)
     else:
         data = jnp.stack([g, h, w_count], axis=-1)  # (n, 3)
 
@@ -264,7 +270,7 @@ def _find_splits(hist, lam, min_gain, min_child_weight, min_data_in_leaf,
 @functools.partial(jax.jit, static_argnames=("depth", "n_bins", "axis_name",
                                              "voting_k", "n_bundle_bins",
                                              "extra_trees", "ff_bynode",
-                                             "path_smooth"))
+                                             "path_smooth", "hist_dtype"))
 def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                sample_weight_count: jnp.ndarray,
                depth: int, n_bins: int,
@@ -279,7 +285,9 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                extra_trees: bool = False, ff_bynode: float = 1.0,
                path_smooth: float = 0.0,
                ic_groups: Optional[jnp.ndarray] = None,
-               feat_bins: Optional[jnp.ndarray] = None):
+               feat_bins: Optional[jnp.ndarray] = None,
+               xb_lanes: Optional[jnp.ndarray] = None,
+               hist_dtype: Optional[str] = None):
     """Grow one depth-`depth` tree. All shapes static; jits once per config.
 
     xb: (n, F) int bins — or, with ``bundles``, the (n, n_bundles) EFB
@@ -331,16 +339,34 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     compat = (jnp.ones((1, ic_groups.shape[0]), dtype=bool)
               if ic_groups is not None else None)
 
+    # one lane-layout transpose per TREE (not per level); callers that hold
+    # the bin matrix across iterations pass ``xb_lanes`` precomputed so the
+    # cost is paid once per RUN. The row block is sized for the deepest
+    # level (``tree_row_block``) so one layout serves every level within
+    # the kernel's VMEM budget.
+    from ...ops.pallas_kernels import (histogram_enabled, pallas_preferred,
+                                       prepare_bins_lanes, tree_row_block)
+    kbins = n_bundle_bins if bundles is not None else n_bins
+    rb = tree_row_block(2 ** max(depth - 1, 0), kbins)
+    if (xb_lanes is None and histogram_enabled()
+            and pallas_preferred(n, 2 ** max(depth - 1, 0), kbins)):
+        xb_lanes = prepare_bins_lanes(xb, row_block=rb)
+
     def level_hist(n_nodes, psum_axis):
         if bundles is None:
             return _level_histogram(xb, node_rel, g, h, sample_weight_count,
-                                    n_nodes, n_bins, psum_axis)
+                                    n_nodes, n_bins, psum_axis,
+                                    bins_lanes=xb_lanes,
+                                    stats_dtype=hist_dtype, row_block=rb)
         # bundled scatter-add (and, data-parallel, the psum) run in the
         # narrow bundle space; the exact per-feature view is a gather
         hist_b = _level_histogram(xb, node_rel, g, h, sample_weight_count,
-                                  n_nodes, n_bundle_bins, psum_axis)
+                                  n_nodes, n_bundle_bins, psum_axis,
+                                  bins_lanes=xb_lanes,
+                                  stats_dtype=hist_dtype, row_block=rb)
         return _debundle(hist_b, bundles, n_bins)
 
+    leaf_stats = None           # (2^depth, 3) [G, H, count] when derivable
     for d in range(depth):
         n_nodes = 2 ** d
         level_off = 2 ** d - 1
@@ -405,6 +431,23 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                                       cand_mask=cand,
                                       path_smooth=path_smooth,
                                       parent_value=node_val)
+        if d == depth - 1 and not use_voting:
+            # bottom-level leaf stats fall out of the last level's histogram
+            # and chosen splits — left child = cumsum at the split bin,
+            # right = node total minus left (LightGBM's parent-minus-sibling
+            # identity) — replacing two O(n) segment-sum scatters with
+            # (nodes, B) arithmetic. Stub nodes route all rows left via the
+            # thr = n_bins sentinel (clipped to the last bin: left = total).
+            f_sel = jnp.clip(bf, 0, F - 1)
+            sel = jnp.take_along_axis(
+                hist, f_sel[:, None, None, None], axis=1)[:, 0]  # (n, B, 3)
+            cs = jnp.cumsum(sel, axis=1)
+            b_sel = jnp.clip(bb, 0, cs.shape[1] - 1)
+            left = jnp.take_along_axis(cs, b_sel[:, None, None],
+                                       axis=1)[:, 0]             # (n, 3)
+            right = cs[:, -1] - left
+            leaf_stats = jnp.stack([left, right], axis=1) \
+                .reshape(2 * n_nodes, 3)
         covers = jax.lax.dynamic_update_slice(covers, level_cover, (level_off,))
         feats = jax.lax.dynamic_update_slice(feats, bf, (level_off,))
         thrs = jax.lax.dynamic_update_slice(thrs, bb, (level_off,))
@@ -450,18 +493,26 @@ def build_tree(xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     # leaf values from bottom-level stats
     n_leaves = 2 ** depth
-    data = jnp.stack([g, h], axis=-1)
-    sums = jax.ops.segment_sum(data, node_rel, num_segments=n_leaves)
-    if axis_name is not None:
-        sums = jax.lax.psum(sums, axis_name)
-    G = sums[:, 0]
+    if leaf_stats is not None:
+        # derived from the (already psum'd) last-level histogram: no extra
+        # O(n) pass, globally identical on every shard
+        G = leaf_stats[:, 0]
+        H = leaf_stats[:, 1]
+        leaf_counts = leaf_stats[:, 2]
+    else:
+        data = jnp.stack([g, h], axis=-1)
+        sums = jax.ops.segment_sum(data, node_rel, num_segments=n_leaves)
+        if axis_name is not None:
+            sums = jax.lax.psum(sums, axis_name)
+        G = sums[:, 0]
+        H = sums[:, 1]
+        leaf_counts = jax.ops.segment_sum(sample_weight_count, node_rel,
+                                          num_segments=n_leaves)
+        if axis_name is not None:
+            leaf_counts = jax.lax.psum(leaf_counts, axis_name)
     G_reg = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)  # L1 shrink
-    leaf_value = -G_reg / (sums[:, 1] + lam)
-    leaf_value = jnp.where(jnp.abs(sums[:, 1]) > 0, leaf_value, 0.0)
-    leaf_counts = jax.ops.segment_sum(sample_weight_count, node_rel,
-                                      num_segments=n_leaves)
-    if axis_name is not None:
-        leaf_counts = jax.lax.psum(leaf_counts, axis_name)
+    leaf_value = -G_reg / (H + lam)
+    leaf_value = jnp.where(jnp.abs(H) > 0, leaf_value, 0.0)
     if path_smooth > 0.0:
         # empty leaves (count 0) land exactly on the parent's output —
         # a better imputation than 0.0 for rows routed there at predict

@@ -13,6 +13,7 @@ API:
     murmur3_batch(seq_of_bytes, seed, mask) -> np.uint32[n]
     pad_sparse(rows, K) -> (np.int32[n,K], np.float32[n,K])
     stack_rows(seq_of_float_vectors, d) -> np.float32[n,d]
+    bin_columns(X, bounds, lengths, want_u16) -> np.uint8/uint16[n,F]
 """
 
 from __future__ import annotations
@@ -24,8 +25,8 @@ import sysconfig
 
 import numpy as np
 
-__all__ = ["available", "murmur3", "murmur3_batch", "pad_sparse",
-           "parse_libsvm", "stack_rows"]
+__all__ = ["available", "bin_columns", "murmur3", "murmur3_batch",
+           "pad_sparse", "parse_libsvm", "stack_rows"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastpath.cpp")
@@ -154,6 +155,31 @@ def parse_libsvm(data: bytes):
     return (np.asarray(labels, np.float64), np.asarray(qids, np.int64),
             np.asarray(indptr, np.int64), np.asarray(indices, np.int32),
             np.asarray(values, np.float32))
+
+
+def bin_columns(X: np.ndarray, bounds: np.ndarray, lengths: np.ndarray,
+                want_u16: bool) -> np.ndarray:
+    """Quantile-bin a float matrix: ``searchsorted(bounds_j, x, "left") + 1``
+    per element with NaN → bin 0. ``bounds`` is the (F, L) padded table,
+    ``lengths`` the per-feature bound counts. The native loop replaces 28
+    per-column ``np.searchsorted`` passes — the dataset-construction cost
+    LightGBM pays in C++ (``LGBM_DatasetCreateFromMat``)."""
+    impl = _load()
+    if impl:
+        return impl.bin_columns(np.ascontiguousarray(X), bounds, lengths,
+                                int(bool(want_u16)))
+    n, f = X.shape
+    dtype = np.uint16 if want_u16 else np.uint8
+    out = np.zeros((n, f), dtype=dtype)
+    is_float = X.dtype.kind == "f"
+    for j in range(f):
+        col = X[:, j]
+        binned = np.searchsorted(bounds[j, :lengths[j]], col,
+                                 side="left") + 1
+        if is_float:
+            binned = np.where(np.isnan(col), 0, binned)
+        out[:, j] = binned.astype(dtype)
+    return out
 
 
 def stack_rows(rows, d: int) -> np.ndarray:

@@ -42,7 +42,10 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["level_histogram_pallas", "histogram_enabled", "pallas_preferred"]
+__all__ = ["level_histogram_pallas", "histogram_enabled", "pallas_preferred",
+           "prepare_bins_lanes", "tree_row_block", "DEFAULT_ROW_BLOCK"]
+
+DEFAULT_ROW_BLOCK = 2048
 
 _LANE = 128
 
@@ -94,7 +97,32 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
+def tree_row_block(max_nodes: int, n_bins: int,
+                   combined_limit: int = 6 * 1024 * 1024) -> int:
+    """One row block for a whole tree: sized for the DEEPEST level's node
+    count so every level's in-kernel intermediates respect the VMEM budget
+    (a fixed 2048 block would blow past it from ~256 nodes/level up).
+    Callers pass the same value to ``prepare_bins_lanes`` and every
+    ``level_histogram_pallas`` call of that tree."""
+    return _auto_row_block(max_nodes, n_bins, combined_limit)
+
+
+@functools.partial(jax.jit, static_argnames=("row_block",))
+def prepare_bins_lanes(xb, row_block: int = DEFAULT_ROW_BLOCK):
+    """One-time (F, 1, npad) int32 lane layout for the histogram kernel.
+
+    The kernel wants bins feature-major with rows on lanes; doing this
+    transpose+pad per level cost a full read+write of the bin matrix per
+    level — at HIGGS-11M that is ~1.2 GB of HBM traffic × levels × trees.
+    Callers prepare once per training run and pass ``bins_lanes`` down.
+    """
+    n = xb.shape[0]
+    npad = _round_up(max(n, row_block), row_block)
+    return jnp.pad(xb.astype(jnp.int32).T, ((0, 0), (0, npad - n)))[:, None, :]
+
+
+def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad,
+                 use_bf16):
     """One (feature, row-block) grid step. Shapes:
     bins_ref (1, 1, R) int32 | node_ref (1, R) int32 | data_ref (3, R) f32
     out_ref (1, bpad, 3*n_nodes) f32 — resident across the row-block dim,
@@ -110,63 +138,98 @@ def _hist_kernel(bins_ref, node_ref, data_ref, out_ref, *, n_nodes, bpad):
 
     b = bins_ref[0, 0, :]                                # (R,)
     node = node_ref[0, :]                                # (R,)
-    data = data_ref[...]                                 # (3, R)
+    data = data_ref[...]                                 # (3, R) f32
     R = b.shape[0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (bpad, R), 0)
-    onehot = (iota_b == b[None, :]).astype(jnp.float32)  # (bpad, R)
     # dn[r, st*n_nodes + nd] = data[st, r] * (node[r] == nd): built with 2-D
-    # iota arithmetic (no 3-D intermediate / minor-dim reshape for Mosaic)
+    # iota arithmetic in f32 (no 3-D intermediate / minor-dim reshape for
+    # Mosaic; 16-bit minor-dim insertion is unsupported, so bf16 happens
+    # only at the final cast below)
     c = jax.lax.broadcasted_iota(jnp.int32, (R, 3 * n_nodes), 1)
     st, nd = c // n_nodes, c % n_nodes
     sel = jnp.where(st == 0, data[0, :][:, None],
                     jnp.where(st == 1, data[1, :][:, None],
                               data[2, :][:, None]))
     dn = jnp.where(nd == node[:, None], sel, 0.0)        # (R, 3*n_nodes)
-    out_ref[0, :, :] += jnp.dot(onehot, dn,
-                                precision=jax.lax.Precision.HIGHEST,
+    if use_bf16:
+        # bf16 operands ride the MXU at native rate; accumulation stays
+        # f32 via preferred_element_type (the one-hot is exact in bf16)
+        onehot = (iota_b == b[None, :]).astype(jnp.bfloat16)
+        dn = dn.astype(jnp.bfloat16)
+        prec = jax.lax.Precision.DEFAULT
+    else:
+        onehot = (iota_b == b[None, :]).astype(jnp.float32)
+        prec = jax.lax.Precision.HIGHEST
+    out_ref[0, :, :] += jnp.dot(onehot, dn, precision=prec,
                                 preferred_element_type=jnp.float32)
 
 
 def level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
                            n_bins: int, row_block: int = 0,
                            interpret: bool = False,
-                           combined_limit: int = 6 * 1024 * 1024):
+                           combined_limit: int = 6 * 1024 * 1024,
+                           bins_lanes=None, stats_dtype=None):
     """Drop-in for the segment-sum histogram: returns (n_nodes, F, B, 3).
 
     xb (n, F) int bins; node_rel (n,) int32; g/h/w_count (n,) float32.
     ``row_block=0`` picks the largest block whose intermediates fit the
-    ``combined_limit`` VMEM budget.
+    ``combined_limit`` VMEM budget. ``bins_lanes`` (from
+    ``prepare_bins_lanes``) supplies the kernel's (F, 1, npad) layout
+    precomputed once per run, skipping a per-level transpose of the whole
+    bin matrix; it must have been built with the same ``row_block``
+    (callers pass ``DEFAULT_ROW_BLOCK`` for both). ``stats_dtype``
+    ``jnp.bfloat16`` runs the one-hot matmul at native MXU rate
+    (accumulation stays f32) — LightGBM's quantized-gradient analog.
     """
-    if row_block == 0:
+    if bins_lanes is not None:
+        row_block = row_block or DEFAULT_ROW_BLOCK
+        if bins_lanes.shape[2] % row_block:
+            raise ValueError(
+                f"bins_lanes npad {bins_lanes.shape[2]} is not a multiple "
+                f"of row_block {row_block}")
+    elif row_block == 0:
         row_block = _auto_row_block(n_nodes, n_bins, combined_limit)
-    return _level_histogram_pallas(xb, node_rel, g, h, w_count,
+    return _level_histogram_pallas(xb, node_rel, g, h, w_count, bins_lanes,
                                    n_nodes=n_nodes, n_bins=n_bins,
-                                   row_block=row_block, interpret=interpret)
+                                   row_block=row_block, interpret=interpret,
+                                   stats_dtype=(jnp.dtype(stats_dtype).name
+                                                if stats_dtype else None))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_nodes", "n_bins", "row_block",
-                                    "interpret"))
-def _level_histogram_pallas(xb, node_rel, g, h, w_count, n_nodes: int,
-                            n_bins: int, row_block: int,
-                            interpret: bool):
+                                    "interpret", "stats_dtype"))
+def _level_histogram_pallas(xb, node_rel, g, h, w_count, bins_lanes,
+                            n_nodes: int, n_bins: int, row_block: int,
+                            interpret: bool, stats_dtype):
     from jax.experimental import pallas as pl
 
     n, F = xb.shape
     bpad = _round_up(max(n_bins, _LANE), _LANE)
-    npad = _round_up(max(n, row_block), row_block)
+    if bins_lanes is not None:
+        npad = bins_lanes.shape[2]
+        xb_t = bins_lanes
+    else:
+        npad = _round_up(max(n, row_block), row_block)
+        # (F, 1, npad): the singleton keeps the block's last-two dims legal
+        # ((1, R) with 1 == full dim) for the TPU lowering's tiling rules
+        xb_t = jnp.pad(xb.astype(jnp.int32).T,
+                       ((0, 0), (0, npad - n)))[:, None, :]
     pad = npad - n
-
-    # (F, 1, npad): the singleton keeps the block's last-two dims legal
-    # ((1, R) with 1 == full dim) for the TPU lowering's tiling rules
-    xb_t = jnp.pad(xb.astype(jnp.int32).T, ((0, 0), (0, pad)))[:, None, :]
+    use_bf16 = stats_dtype == "bfloat16"
     node = jnp.pad(node_rel.astype(jnp.int32), (0, pad))[None, :]   # (1, npad)
+    # bf16 stats round HERE (outside the kernel) so the quantization is
+    # well-defined; the kernel re-reads them as f32 refs and casts at the
+    # dot (Mosaic can't insert minor dims on 16-bit vectors)
     data = jnp.stack([g, h, w_count]).astype(jnp.float32)           # (3, n)
+    if use_bf16:
+        data = data.astype(jnp.bfloat16).astype(jnp.float32)
     data = jnp.pad(data, ((0, 0), (0, pad)))                        # zeros kill
     # padded rows' contributions regardless of their (0) bin/node ids
 
     nblocks = npad // row_block
-    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad)
+    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, bpad=bpad,
+                               use_bf16=use_bf16)
     out = pl.pallas_call(
         kernel,
         grid=(F, nblocks),
