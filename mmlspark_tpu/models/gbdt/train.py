@@ -285,6 +285,11 @@ def train(params: Dict,
     w = (np.asarray(sample_weight, dtype=np.float64) if sample_weight is not None
          else np.ones(n))
     depth = _depth_for(p)
+    # single source of truth for "rows shard over a mesh" — consulted by
+    # both the chunked-upload gate and the sharding setup below
+    will_shard = (mesh is not None
+                  and p["tree_learner"] in ("data_parallel",
+                                            "voting_parallel"))
     num_class = int(p["num_class"])
     objective_name = p["objective"]
     # boosting mode (parity: LightGBMParams.boostingType, LightGBMParams.scala:389-393)
@@ -440,9 +445,6 @@ def train(params: Dict,
     else:
         mapper.fit(X)
         prof.mark("bin_fit")
-        will_shard = (mesh is not None
-                      and p["tree_learner"] in ("data_parallel",
-                                                "voting_parallel"))
         if not will_shard and not sparse_X and n >= (1 << 21):
             # chunked bin→upload pipeline: while chunk i transfers (async
             # device_put), chunk i+1 bins on the host — at HIGGS scale this
@@ -507,8 +509,7 @@ def train(params: Dict,
     # device residency; shard rows when data-parallel over a mesh
     axis_name = None
     n_pad = n
-    if mesh is not None and p["tree_learner"] in ("data_parallel",
-                                                  "voting_parallel"):
+    if will_shard:
         axis_name = "data"
         shards = mesh.shape[axis_name]
         n_pad = ((n + shards - 1) // shards) * shards

@@ -220,10 +220,13 @@ struct BinLut {
   }
 
   inline int64_t find(const double* b, int64_t lj, double v) const {
+    /* clamp in double space BEFORE the cast: (int64_t)inf is UB (x86
+     * yields INT64_MIN, sending +inf values to bucket 0) */
     double t = (v - lo) * scale;
-    int64_t bk = (int64_t)t;
-    if (bk < 0) bk = 0;
-    if (bk > kBuckets - 1) bk = kBuckets - 1;
+    int64_t bk;
+    if (!(t > 0.0)) bk = 0;
+    else if (t >= (double)(kBuckets - 1)) bk = kBuckets - 1;
+    else bk = (int64_t)t;
     int64_t s = lut[bk > 0 ? bk - 1 : 0];
     int64_t e = lut[bk + 2 <= kBuckets ? bk + 2 : kBuckets];
     return s + lb_branchless(b + s, e - s, v);

@@ -41,6 +41,7 @@ def main():
     iters = int(os.environ.get("HIGGS_ITERS", "10"))
     leaves = int(os.environ.get("HIGGS_LEAVES", "31"))
     max_bin = int(os.environ.get("HIGGS_BIN", "255"))
+    quant = os.environ.get("HIGGS_QUANT", "0") == "1"
 
     import importlib
 
@@ -52,7 +53,8 @@ def main():
         X, y = make_higgs_like(n)
         params = {"objective": "binary", "num_iterations": iters,
                   "num_leaves": leaves, "max_bin": max_bin,
-                  "learning_rate": 0.1, "min_data_in_leaf": 20}
+                  "learning_rate": 0.1, "min_data_in_leaf": 20,
+                  "use_quantized_grad": quant}
         # warmup run compiles the tree builder for this shape
         t0 = time.perf_counter()
         gtrain.train({**params, "num_iterations": 1}, X, y)
@@ -67,6 +69,7 @@ def main():
             "value": round(total / iters, 4), "unit": "sec/iter",
             "warmup_sec": round(warm, 2),
             "train_auc": round(float(auc_in), 4),
+            "quantized": quant,
             "platform": platform,
         }), flush=True)
         if os.environ.get("HIGGS_SKLEARN", "0") == "1":

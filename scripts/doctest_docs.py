@@ -11,7 +11,17 @@ import re
 import sys
 import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.environ.get("DOCTEST_INSTALLED", "0") != "1":
+    sys.path.insert(0,
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+else:
+    # packaging test (scripts/test_packaging.sh): blocks must import the
+    # INSTALLED wheel, so the repo checkout stays off sys.path
+    import importlib.util
+    spec = importlib.util.find_spec("mmlspark_tpu")
+    if spec is None or "site-packages" not in (spec.origin or ""):
+        sys.exit("DOCTEST_INSTALLED=1 but mmlspark_tpu does not resolve "
+                 f"to an installed wheel (found {spec and spec.origin})")
 
 # docs examples run on CPU: deterministic, fast, no TPU claim needed
 os.environ.pop("JAX_PLATFORMS", None)
@@ -49,12 +59,15 @@ def run_file(path: str):
 
 def main() -> int:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    targets = [os.path.join(repo, "README.md")]
-    docs = os.path.join(repo, "docs")
-    for root, _dirs, files in os.walk(docs):
-        for f in sorted(files):
-            if f.endswith(".md"):
-                targets.append(os.path.join(root, f))
+    if len(sys.argv) > 1:
+        targets = [os.path.abspath(a) for a in sys.argv[1:]]
+    else:
+        targets = [os.path.join(repo, "README.md")]
+        docs = os.path.join(repo, "docs")
+        for root, _dirs, files in os.walk(docs):
+            for f in sorted(files):
+                if f.endswith(".md"):
+                    targets.append(os.path.join(root, f))
     total, failures = 0, 0
     for path in targets:
         if not os.path.exists(path):

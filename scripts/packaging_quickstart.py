@@ -1,0 +1,53 @@
+"""Quickstart executed against the INSTALLED wheel (scripts/test_packaging.sh).
+
+Asserts the import resolves from site-packages (not a repo checkout), then
+runs the canonical first-user pipeline: DataFrame → estimator fit →
+transform → save → reload → identical predictions. Mirrors the reference's
+generated PyTestFuzzing smoke surface (core/src/test/.../codegen/TestGen.scala)
+in the one slice executable without pyspark.
+"""
+
+import os
+import sys
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mmlspark_tpu  # noqa: E402
+
+pkg_dir = os.path.dirname(os.path.abspath(mmlspark_tpu.__file__))
+if "site-packages" not in pkg_dir:
+    sys.exit(f"FAIL: mmlspark_tpu imported from {pkg_dir}, "
+             "not the installed wheel")
+
+from mmlspark_tpu.core import DataFrame                     # noqa: E402
+from mmlspark_tpu.core.pipeline import PipelineStage        # noqa: E402
+from mmlspark_tpu.models.gbdt import LightGBMClassifier     # noqa: E402
+
+rng = np.random.default_rng(0)
+n = 1200
+X = rng.normal(0, 1, (n, 6)).astype(np.float32)
+y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(float)
+col = np.empty(n, dtype=object)
+col[:] = list(X)
+df = DataFrame({"features": col, "label": y})
+
+model = LightGBMClassifier(num_iterations=15, num_leaves=15).fit(df)
+pred = np.asarray(list(model.transform(df)["prediction"]), dtype=float)
+acc = float((pred == y).mean())
+assert acc > 0.85, f"quickstart accuracy {acc}"
+
+model.save("model_out")
+pred2 = np.asarray(list(PipelineStage.load("model_out").transform(df)
+                        ["prediction"]), dtype=float)
+assert np.array_equal(pred, pred2), "reloaded model diverges"
+
+# the native fast path must be usable (or cleanly absent) from the wheel
+from mmlspark_tpu import native                             # noqa: E402
+
+print(f"quickstart OK from {pkg_dir} (acc={acc:.3f}, "
+      f"native={native.available()})")

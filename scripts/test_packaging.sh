@@ -1,0 +1,42 @@
+#!/bin/bash
+# Packaging execution test: build the wheel, install it into a CLEAN venv,
+# and run the quickstart + one doctest file AGAINST THE INSTALLED PACKAGE
+# (not the repo checkout). This is the executable slice of the reference's
+# packagePython/testPython discipline (project/CodegenPlugin.scala:55-67)
+# that needs no pyspark/R in the image.
+#
+# Zero-egress rules: the venv reuses the image's site-packages for deps
+# (--system-site-packages) and pip runs --no-index --no-deps — the wheel
+# itself is the only thing installed, which is exactly what this test is
+# about: does the PACKAGED artifact work, files and all.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+WORK="${PACKAGING_WORKDIR:-$(mktemp -d /tmp/pkgtest.XXXXXX)}"
+echo "workdir: $WORK"
+
+# 1. build the wheel (no build isolation: setuptools is baked in, no net)
+rm -rf "$WORK/dist"
+python -m pip wheel . --no-deps --no-build-isolation -w "$WORK/dist" -q
+WHEEL=$(ls "$WORK"/dist/mmlspark_tpu-*.whl)
+echo "wheel: $WHEEL"
+
+# 2. clean venv. Deps (numpy/jax/...) come from the OUTER environment's
+# site-packages via a .pth link — the image's python is itself a venv, so
+# --system-site-packages would point past it at the bare base install.
+python -m venv "$WORK/venv"
+OUTER_SP=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])")
+VENV_SP=$("$WORK/venv/bin/python" -c "import sysconfig; print(sysconfig.get_paths()['purelib'])")
+echo "$OUTER_SP" > "$VENV_SP/outer-deps.pth"
+"$WORK/venv/bin/pip" install --no-index --no-deps -q "$WHEEL"
+
+# 3. quickstart from a scratch dir: the repo must NOT be importable
+cp "$REPO/scripts/packaging_quickstart.py" "$WORK/quickstart.py"
+cd "$WORK"
+"$WORK/venv/bin/python" "$WORK/quickstart.py"
+
+# 4. one doctest file executed against the installed package
+DOCTEST_INSTALLED=1 "$WORK/venv/bin/python" \
+    "$REPO/scripts/doctest_docs.py" "$REPO/docs/guide.md"
+
+echo "PACKAGING OK"

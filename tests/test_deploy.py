@@ -79,6 +79,22 @@ class TestHelmChart:
             assert f.read() == gen_ci_matrix.generate(), \
                 "stale CI matrix: rerun scripts/gen_ci_matrix.py"
 
+    def test_ci_has_packaging_stage(self):
+        """The wheel-install-quickstart stage must stay in CI: it is the
+        executable slice of the reference's packagePython/testPython
+        discipline (CodegenPlugin.scala:55-67) and the only place the
+        installed artifact (not the checkout) is exercised."""
+        with open(os.path.join(REPO, "deploy", "ci", "pipeline.yaml")) as f:
+            ci = yaml.safe_load(f)
+        stage = next((s for s in ci["stages"] if s["name"] == "package"),
+                     None)
+        assert stage is not None, "CI lost its 'package' stage"
+        assert "test_packaging.sh" in stage["script"]
+        assert os.path.exists(os.path.join(REPO, "scripts",
+                                           "test_packaging.sh"))
+        assert os.path.exists(os.path.join(REPO, "scripts",
+                                           "packaging_quickstart.py"))
+
     def test_dockerfile_mentions_entrypoint(self):
         with open(os.path.join(REPO, "deploy", "docker", "Dockerfile")) as f:
             text = f.read()

@@ -15,6 +15,79 @@ def test_native_builds():
     assert native.available(), "g++ toolchain present; extension must build"
 
 
+class TestBinColumns:
+    """Native quantile binning == searchsorted(bounds, x, 'left') + 1 with
+    NaN -> 0 (the GBDT dataset-construction hot loop, LightGBM's
+    LGBM_DatasetCreateFromMat role)."""
+
+    @staticmethod
+    def _ref(X, bounds_list):
+        n, f = X.shape
+        out = np.zeros((n, f), np.int64)
+        for j in range(f):
+            col = X[:, j]
+            b = np.searchsorted(bounds_list[j], col, side="left") + 1
+            out[:, j] = np.where(np.isnan(col), 0, b)
+        return out
+
+    @staticmethod
+    def _table(bounds_list):
+        lengths = np.array([len(b) for b in bounds_list], np.int64)
+        table = np.full((len(bounds_list), lengths.max()), np.inf)
+        for j, b in enumerate(bounds_list):
+            table[j, :len(b)] = b
+        return table, lengths
+
+    @pytest.mark.parametrize("gen", ["gauss", "cauchy", "const", "inf"])
+    def test_matches_searchsorted(self, gen):
+        # fixed seeds: hash(str) varies per process (PYTHONHASHSEED), which
+        # would make a boundary failure unreproducible
+        rng = np.random.default_rng(
+            {"gauss": 11, "cauchy": 22, "const": 33, "inf": 44}[gen])
+        n, f = 40_000, 5
+        X = {"gauss": lambda: rng.normal(0, 1, (n, f)),
+             "cauchy": lambda: rng.standard_cauchy((n, f)),
+             "const": lambda: np.full((n, f), 2.5),
+             "inf": lambda: np.where(rng.random((n, f)) < 0.05,
+                                     np.inf * rng.choice([-1, 1], (n, f)),
+                                     rng.normal(0, 1, (n, f)))}[gen]() \
+            .astype(np.float32)
+        X[rng.random((n, f)) < 0.03] = np.nan
+        bounds = []
+        for j in range(f):
+            col = X[:, j]
+            col = col[np.isfinite(col)]
+            qs = (np.unique(np.quantile(col, np.linspace(0, 1, 100)))
+                  if col.size else np.array([]))
+            bounds.append(np.append(qs, np.inf))
+        table, lengths = self._table(bounds)
+        got = native.bin_columns(X, table, lengths, False)
+        assert got.dtype == np.uint8
+        assert np.array_equal(got.astype(np.int64), self._ref(X, bounds))
+
+    def test_uint16_and_float64(self):
+        rng = np.random.default_rng(7)
+        X = rng.normal(0, 1, (5_000, 3)).astype(np.float64)
+        bounds = [np.append(np.sort(rng.normal(0, 1, 500)), np.inf)
+                  for _ in range(3)]
+        table, lengths = self._table(bounds)
+        got = native.bin_columns(X, table, lengths, True)
+        assert got.dtype == np.uint16
+        assert np.array_equal(got.astype(np.int64), self._ref(X, bounds))
+
+    def test_fallback_matches_native(self, monkeypatch):
+        rng = np.random.default_rng(9)
+        X = rng.normal(0, 1, (2_000, 4)).astype(np.float32)
+        bounds = [np.append(np.sort(rng.normal(0, 1, 30)), np.inf)
+                  for _ in range(4)]
+        table, lengths = self._table(bounds)
+        a = native.bin_columns(X, table, lengths, False)
+        monkeypatch.setattr(native, "_impl", False)
+        b = native.bin_columns(X, table, lengths, False)
+        monkeypatch.setattr(native, "_impl", None)
+        assert np.array_equal(a, b)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 0xDEADBEEF])
 def test_murmur3_matches_reference(seed):
     for v in VECTORS:

@@ -60,6 +60,40 @@ def test_pallas_histogram_deep_level(rng):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
+def test_bf16_stats_close_to_f32(rng):
+    """stats_dtype=bfloat16 (use_quantized_grad): sums accumulate in f32,
+    so the histogram matches the exact one to bf16 input-rounding error,
+    and the 0/1 count channel stays EXACT (bf16 represents 0/1 exactly)."""
+    n, F, n_nodes, n_bins = 900, 4, 4, 32
+    xb = rng.integers(0, n_bins, (n, F)).astype(np.int32)
+    node = rng.integers(0, n_nodes, n).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32)
+    w = (rng.random(n) > 0.1).astype(np.float32)
+    got = np.asarray(level_histogram_pallas(
+        jnp.asarray(xb), jnp.asarray(node), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(w), n_nodes, n_bins, row_block=256, interpret=True,
+        stats_dtype="bfloat16"))
+    want = _reference_hist(xb, node, g, h, w, n_nodes, n_bins)
+    np.testing.assert_array_equal(got[..., 2], want[..., 2])   # counts exact
+    np.testing.assert_allclose(got[..., :2], want[..., :2],
+                               rtol=2e-2, atol=2e-2)           # bf16 rounding
+
+
+def test_gbdt_quantized_grad_trains(rng, monkeypatch):
+    """use_quantized_grad end-to-end under forced Pallas interpret: the
+    bf16 path must keep learning (guards the f32-accumulation contract)."""
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "1")
+    from mmlspark_tpu.models.gbdt.train import train
+    n = 600
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    b = train({"objective": "binary", "num_iterations": 10, "num_leaves": 8,
+               "max_bin": 32, "use_quantized_grad": True}, X, y)
+    acc = ((b.predict(X) > 0.5) == y).mean()
+    assert acc > 0.9
+
+
 def test_histogram_enabled_env(monkeypatch):
     monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "1")
     assert histogram_enabled()
