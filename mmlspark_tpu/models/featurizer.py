@@ -60,7 +60,14 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         return m
 
     def _prep_cell(self, cell) -> Optional[np.ndarray]:
-        """image struct / bytes / array → CHW float32 model tensor."""
+        """image struct / bytes / array → HWC uint8.
+
+        Host work stops at decode/resize/channel-order; the float scale,
+        mean/std normalization, and HWC→CHW layout run ON DEVICE fused into
+        the graph (the inner ONNXModel's transpose/normalize prep) — a
+        uint8 image crosses the host→device link at 1/4 the bytes of the
+        float32 tensor this method used to build, and the link is the
+        bottleneck (BASELINE.md: config #4 was transfer-bound)."""
         if cell is None:
             return None
         if isinstance(cell, (bytes, bytearray)):
@@ -80,12 +87,7 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
             img = np.repeat(img, 3, axis=-1)
         if self.get("channel_order") == "rgb" and img.shape[-1] >= 3:
             img = img[:, :, [2, 1, 0] + list(range(3, img.shape[-1]))]
-        x = img.astype(np.float32) * np.float32(self.get("scale"))
-        if self.get_or_none("mean") is not None:
-            x = x - np.asarray(self.get("mean"), np.float32)
-        if self.get_or_none("std") is not None:
-            x = x / np.asarray(self.get("std"), np.float32)
-        return np.ascontiguousarray(np.transpose(x, (2, 0, 1)))
+        return np.ascontiguousarray(img)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         inner = self._inner()
@@ -105,8 +107,17 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         out_name = (self.get("feature_output") if self.get("cut_output_layers") >= 1
                     else self.get("logits_output"))
         staged = cur.with_column(tensor_col, object_col(tensors))
+        norm = {"scale": float(self.get("scale"))}
+        if self.get_or_none("mean") is not None:
+            norm["mean"] = [float(v) for v in np.atleast_1d(self.get("mean"))]
+        if self.get_or_none("std") is not None:
+            norm["std"] = [float(v) for v in np.atleast_1d(self.get("std"))]
         inner = inner.copy({"feed_dict": {feed_name: tensor_col},
                             "fetch_dict": {self.get("output_col"): out_name},
-                            "mini_batch_size": self.get("mini_batch_size")})
+                            "mini_batch_size": self.get("mini_batch_size"),
+                            # uint8 HWC over the link; layout + normalize
+                            # fuse into the graph on device
+                            "transpose_dict": {feed_name: [0, 3, 1, 2]},
+                            "normalize_dict": {feed_name: norm}})
         out = inner.transform(staged)
         return out.drop(tensor_col)

@@ -26,6 +26,7 @@ verifiable).
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax
@@ -65,80 +66,88 @@ def generate_speculative_fused(t_params: Dict, d_params: Dict,
     t_params = jax.tree.map(jnp.asarray, t_params)
     d_params = jax.tree.map(jnp.asarray, d_params)
     prompt_ids = jnp.asarray(prompt_ids)
-    B, P = prompt_ids.shape
-    L = P + max_new_tokens + gamma + 1
-    lengths = jnp.full((B,), P, jnp.int32)
-
-    @jax.jit
-    def run(t_params, d_params, prompt_ids):
-        t_logits, t_cache = prefill_cache(t_params, prompt_ids, lengths,
-                                          t_cfg, L)
-        _, d_cache = prefill_cache(d_params, prompt_ids, lengths, d_cfg, L)
-        pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt_ids.dtype)
-        ids0 = jnp.zeros((B, L), prompt_ids.dtype)
-        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
-        ids0 = jax.lax.dynamic_update_slice(ids0, pending0[:, None], (0, P))
-        # carry: ids, m (position of pending), pending, caches, stats
-        stats0 = jnp.zeros((3,), jnp.int32)    # forwards, rounds, accepted
-
-        def emitted(m):
-            return m - P + 1
-
-        def cond(carry):
-            ids, m, pending, t_cache, d_cache, stats = carry
-            return emitted(m) < max_new_tokens
-
-        def body(carry):
-            ids, m, pending, t_cache, d_cache, stats = carry
-
-            # draft proposes gamma tokens, then consumes its own last
-            # proposal so the cache stays hole-free at full acceptance
-            def draft_scan(cache, pending, m):
-                def step(c, i):
-                    cache, tok = c
-                    logits, cache = decode_step(d_params, tok, m + i,
-                                                cache, d_cfg)
-                    nxt = jnp.argmax(logits, -1).astype(pending.dtype)
-                    return (cache, nxt), nxt
-                (cache, _), drafts = jax.lax.scan(
-                    step, (cache, pending), jnp.arange(gamma + 1))
-                return cache, jnp.moveaxis(drafts[:gamma], 0, 1)
-
-            d_cache, drafts = draft_scan(d_cache, pending, m)
-            wtoks = jnp.concatenate([pending[:, None], drafts], axis=1)
-            w_logits, t_cache = decode_window(t_params, wtoks, m, t_cache,
-                                              t_cfg)
-            greedy = jnp.argmax(w_logits, -1).astype(pending.dtype)
-            match = greedy[:, :gamma] == drafts
-            accept = jnp.min(jnp.sum(jnp.cumprod(
-                match.astype(jnp.int32), -1), -1))
-            k = jnp.minimum(accept,
-                            max_new_tokens - emitted(m) - 1).astype(jnp.int32)
-            # optimistic emission: positions m+1..m+gamma+1 get the drafts
-            # up to k and the bonus at k (later slots are garbage a future
-            # round overwrites; only ids[:, :m+k+2] is ever final)
-            bonus = jnp.take_along_axis(greedy, k[None, None].repeat(B, 0),
-                                        axis=1)[:, 0]
-            idxs = jnp.arange(gamma + 1)
-            emit = jnp.where(idxs[None, :] < k,
-                             jnp.concatenate(
-                                 [drafts, drafts[:, -1:]], axis=1),
-                             bonus[:, None])
-            ids = jax.lax.dynamic_update_slice(ids, emit, (0, m + 1))
-            stats = stats + jnp.array([1, 1, 0], jnp.int32) \
-                + jnp.array([0, 0, 1], jnp.int32) * k
-            return (ids, m + k + 1, bonus, t_cache, d_cache, stats)
-
-        ids, m, pending, _, _, stats = jax.lax.while_loop(
-            cond, body, (ids0, jnp.asarray(P, jnp.int32), pending0,
-                         t_cache, d_cache, stats0))
-        return ids[:, :P + max_new_tokens], stats
-
-    ids, stats = run(t_params, d_params, prompt_ids)
+    # module-level cached jit: a per-call `@jax.jit` closure re-traced and
+    # remote-recompiled the whole loop on EVERY generation — the r4
+    # "speculative is slower" verdict measured compiles, not decoding
+    ids, stats = _speculative_impl(t_params, d_params, prompt_ids,
+                                   t_cfg=t_cfg, d_cfg=d_cfg,
+                                   max_new_tokens=int(max_new_tokens),
+                                   gamma=int(gamma))
     s = np.asarray(stats)
     return ids, {"target_forwards": int(s[0]) + 1, "rounds": int(s[1]),
                  "accepted_drafts": int(s[2]),
                  "draft_steps": int(s[1]) * (gamma + 1)}
+
+
+@functools.partial(jax.jit, static_argnames=("t_cfg", "d_cfg",
+                                             "max_new_tokens", "gamma"))
+def _speculative_impl(t_params, d_params, prompt_ids, t_cfg, d_cfg,
+                      max_new_tokens, gamma):
+    B, P = prompt_ids.shape
+    L = P + max_new_tokens + gamma + 1
+    lengths = jnp.full((B,), P, jnp.int32)
+    t_logits, t_cache = prefill_cache(t_params, prompt_ids, lengths,
+                                      t_cfg, L)
+    _, d_cache = prefill_cache(d_params, prompt_ids, lengths, d_cfg, L)
+    pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt_ids.dtype)
+    ids0 = jnp.zeros((B, L), prompt_ids.dtype)
+    ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+    ids0 = jax.lax.dynamic_update_slice(ids0, pending0[:, None], (0, P))
+    # carry: ids, m (position of pending), pending, caches, stats
+    stats0 = jnp.zeros((3,), jnp.int32)    # forwards, rounds, accepted
+
+    def emitted(m):
+        return m - P + 1
+
+    def cond(carry):
+        ids, m, pending, t_cache, d_cache, stats = carry
+        return emitted(m) < max_new_tokens
+
+    def body(carry):
+        ids, m, pending, t_cache, d_cache, stats = carry
+
+        # draft proposes gamma tokens, then consumes its own last
+        # proposal so the cache stays hole-free at full acceptance
+        def draft_scan(cache, pending, m):
+            def step(c, i):
+                cache, tok = c
+                logits, cache = decode_step(d_params, tok, m + i,
+                                            cache, d_cfg)
+                nxt = jnp.argmax(logits, -1).astype(pending.dtype)
+                return (cache, nxt), nxt
+            (cache, _), drafts = jax.lax.scan(
+                step, (cache, pending), jnp.arange(gamma + 1))
+            return cache, jnp.moveaxis(drafts[:gamma], 0, 1)
+
+        d_cache, drafts = draft_scan(d_cache, pending, m)
+        wtoks = jnp.concatenate([pending[:, None], drafts], axis=1)
+        w_logits, t_cache = decode_window(t_params, wtoks, m, t_cache,
+                                          t_cfg)
+        greedy = jnp.argmax(w_logits, -1).astype(pending.dtype)
+        match = greedy[:, :gamma] == drafts
+        accept = jnp.min(jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), -1), -1))
+        k = jnp.minimum(accept,
+                        max_new_tokens - emitted(m) - 1).astype(jnp.int32)
+        # optimistic emission: positions m+1..m+gamma+1 get the drafts
+        # up to k and the bonus at k (later slots are garbage a future
+        # round overwrites; only ids[:, :m+k+2] is ever final)
+        bonus = jnp.take_along_axis(greedy, k[None, None].repeat(B, 0),
+                                    axis=1)[:, 0]
+        idxs = jnp.arange(gamma + 1)
+        emit = jnp.where(idxs[None, :] < k,
+                         jnp.concatenate(
+                             [drafts, drafts[:, -1:]], axis=1),
+                         bonus[:, None])
+        ids = jax.lax.dynamic_update_slice(ids, emit, (0, m + 1))
+        stats = stats + jnp.array([1, 1, 0], jnp.int32) \
+            + jnp.array([0, 0, 1], jnp.int32) * k
+        return (ids, m + k + 1, bonus, t_cache, d_cache, stats)
+
+    ids, m, pending, _, _, stats = jax.lax.while_loop(
+        cond, body, (ids0, jnp.asarray(P, jnp.int32), pending0,
+                     t_cache, d_cache, stats0))
+    return ids[:, :P + max_new_tokens], stats
 
 
 def generate_speculative(t_params: Dict, d_params: Dict,

@@ -17,6 +17,7 @@ and activations are laid out over a ``Mesh(('dp','tp'))``:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -655,10 +656,27 @@ def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,
     L = P_len + max_new_tokens
     if L > cfg.max_len and cfg.position == "learned":
         raise ValueError(f"prompt+new = {L} exceeds max_len {cfg.max_len}")
+    key0 = jax.random.PRNGKey(seed)
+    # module-level cached jit: a per-call closure would RETRACE (and,
+    # behind a tunneled chip, remote-RECOMPILE) the whole scan on every
+    # generation — seconds per call that r4/r5 benches mistook for decode
+    # cost
+    return _generate_cached_impl(params, prompt_ids, key0, cfg=cfg,
+                                 max_new_tokens=int(max_new_tokens),
+                                 temperature=float(temperature),
+                                 top_k=int(top_k), top_p=float(top_p),
+                                 eos_id=eos_id)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "temperature",
+                                    "top_k", "top_p", "eos_id"))
+def _generate_cached_impl(params, prompt_ids, key0, cfg, max_new_tokens,
+                          temperature, top_k, top_p, eos_id):
+    B, P_len = prompt_ids.shape
+    L = P_len + max_new_tokens
     cache = init_kv_cache(cfg, B, L)
     ids0 = jnp.pad(prompt_ids, ((0, 0), (0, max_new_tokens)))
-
-    key0 = jax.random.PRNGKey(seed)
 
     def step(carry, t):
         ids, cache, done = carry

@@ -20,7 +20,9 @@ from .base import (HasServiceParams, ServiceParam, ServiceTransformer,
                    HasAsyncReply)
 from .text import (EntityDetector, KeyPhraseExtractor, LanguageDetector,
                    NER, TextSentiment)
-from .vision import AnalyzeImage, DescribeImage, OCR, TagImage
+from .vision import (AnalyzeImage, DescribeImage, GenerateThumbnails, OCR,
+                     ReadImage, RecognizeDomainSpecificContent,
+                     RecognizeText, TagImage, flatten_ocr, flatten_read)
 from .anomaly import DetectAnomalies, DetectLastAnomaly, SimpleDetectAnomalies
 from .translate import (BreakSentence, DetectLanguage, DocumentTranslator,
                         Translate, Transliterate)
@@ -37,6 +39,8 @@ __all__ = [
     "ServiceParam", "HasServiceParams", "ServiceTransformer", "HasAsyncReply",
     "TextSentiment", "LanguageDetector", "EntityDetector", "NER",
     "KeyPhraseExtractor", "AnalyzeImage", "OCR", "DescribeImage", "TagImage",
+    "RecognizeText", "ReadImage", "GenerateThumbnails",
+    "RecognizeDomainSpecificContent", "flatten_ocr", "flatten_read",
     "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
     "Translate", "Transliterate", "DetectLanguage", "BreakSentence",
     "DetectFace", "VerifyFaces", "GroupFaces", "IdentifyFaces",

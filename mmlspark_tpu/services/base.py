@@ -125,8 +125,9 @@ class HasAsyncReply(Params):
     polling_delay_ms = Param(int, default=300, doc="delay between polls")
     max_polling_retries = Param(int, default=100, doc="max poll attempts")
 
-    def _poll(self, session, initial: HTTPResponseData, headers: List[HeaderData],
-              timeout: float) -> HTTPResponseData:
+    def _poll(self, session, initial: HTTPResponseData,
+              request: HTTPRequestData, timeout: float) -> HTTPResponseData:
+        headers = request.headers
         if initial.status_code != 202:
             return initial
         loc = next((h.value for h in initial.headers
@@ -220,25 +221,46 @@ class ServiceTransformer(Transformer, HasServiceParams, HasOutputCol,
     def _parse(self, body):
         return body
 
+    def _parse_response(self, resp: HTTPResponseData):
+        """Full-response hook; default = parse the JSON body. Binary
+        endpoints (thumbnails) override this to return entity bytes —
+        the reference swaps in a ``CustomOutputParser`` for the same
+        purpose (``ComputerVision.scala:446-449``)."""
+        return self._parse(resp.json_content())
+
     def _handle(self, session, request: HTTPRequestData
                 ) -> Optional[HTTPResponseData]:
         resp = _send(session, request, self.get("timeout"))
         if resp is not None and isinstance(self, HasAsyncReply):
-            resp = self._poll(session, resp, request.headers, self.get("timeout"))
+            resp = self._poll(session, resp, request, self.get("timeout"))
         return resp
 
     # -- execution -----------------------------------------------------------
     def _transform(self, df: DataFrame) -> DataFrame:
         rows = list(df.iter_rows())
-        requests_ = [self._build_request(r) for r in rows]
+        # per-row build failures (e.g. a column-bound param holding an
+        # invalid value) land in the ERROR COLUMN like every other per-row
+        # failure — one malformed row must not abort the other 999
+        requests_: List[Optional[HTTPRequestData]] = []
+        build_errs: List[Optional[dict]] = []
+        for r in rows:
+            try:
+                requests_.append(self._build_request(r))
+                build_errs.append(None)
+            except ValueError as e:
+                requests_.append(None)
+                build_errs.append({"statusCode": 400,
+                                   "reasonPhrase":
+                                       f"request build failed: {e}"})
         c = self.get("concurrency")
         client = (AsyncHTTPClient(c, handler=self._handle) if c > 1
                   else SingleThreadedHTTPClient(handler=self._handle))
         outs, errs = [], []
-        for req, resp in zip(requests_, client.send(iter(requests_))):
-            if req is None:  # skipped row (null required param): null out+err
+        for i, (req, resp) in enumerate(zip(requests_,
+                                            client.send(iter(requests_)))):
+            if req is None:  # skipped (null required param) or build error
                 outs.append(None)
-                errs.append(None)
+                errs.append(build_errs[i])
                 continue
             ok, err = ErrorUtils.split(resp)
             if ok is None:
@@ -246,7 +268,7 @@ class ServiceTransformer(Transformer, HasServiceParams, HasOutputCol,
                 errs.append(err)
                 continue
             try:
-                outs.append(self._parse(ok.json_content()))
+                outs.append(self._parse_response(ok))
                 errs.append(None)
             except Exception as e:
                 # a 200 with an unparseable body must be distinguishable

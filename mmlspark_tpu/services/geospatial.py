@@ -10,9 +10,51 @@ expects (``AzureMapsTraits.scala``).
 
 from __future__ import annotations
 
-from .base import ServiceParam, ServiceTransformer
+from .base import HasAsyncReply, ServiceParam, ServiceTransformer
 
-__all__ = ["AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon"]
+__all__ = ["AddressGeocoder", "ReverseAddressGeocoder",
+           "CheckPointInPolygon", "MapsAsyncReply"]
+
+
+class MapsAsyncReply(HasAsyncReply):
+    """Azure-Maps async convention (``AzureMapsTraits.scala:90-130``):
+    a batch POST answers 202 with a ``Location`` header (NOT
+    Operation-Location), and polling is done when the status flips to
+    200 — there is no JSON ``status`` field to inspect."""
+
+    def _poll(self, session, initial, request, timeout):
+        import time as _time
+        from urllib.parse import parse_qs, urlparse
+
+        from ..io.http.schema import HTTPRequestData, StatusLineData
+        from .base import _send
+        if initial.status_code != 202:
+            return initial
+        loc = next((h.value for h in initial.headers
+                    if h.name.lower() == "location"), None)
+        if loc is None:
+            return initial
+        # the poll GET must authenticate like the initial POST did — Maps
+        # carries the key as a query param, and the service's Location URL
+        # does not include it (an unauthenticated poll 401s forever)
+        key = parse_qs(urlparse(request.url).query).get(
+            "subscription-key", [None])[0]
+        if key and "subscription-key=" not in loc:
+            from urllib.parse import quote
+            sep = "&" if "?" in loc else "?"
+            loc = f"{loc}{sep}subscription-key={quote(key)}"
+        for _ in range(self.get("max_polling_retries")):
+            _time.sleep(self.get("polling_delay_ms") / 1000.0)
+            resp = _send(session, HTTPRequestData(url=loc, method="GET",
+                                                  headers=list(request.headers)),
+                         timeout)
+            if resp is None or resp.status_code == 202:
+                continue
+            return resp                 # 200 = done; errors surface as-is
+        from ..io.http.schema import HTTPResponseData
+        return HTTPResponseData(
+            status_line=StatusLineData(status_code=504,
+                                       reason_phrase="async polling timed out"))
 
 
 class _MapsBase(ServiceTransformer):
@@ -32,8 +74,10 @@ class _MapsBase(ServiceTransformer):
         return url
 
 
-class AddressGeocoder(_MapsBase):
-    """Batch forward geocoding: address strings → candidate coordinates."""
+class AddressGeocoder(_MapsBase, MapsAsyncReply):
+    """Batch forward geocoding: address strings → candidate coordinates.
+    Async per the Maps batch convention (``Geocoders.scala:30-75`` with
+    ``MapsAsyncReply``)."""
 
     address = ServiceParam(list, is_required=True,
                            doc="list of address strings per row (a batch)")
@@ -48,8 +92,9 @@ class AddressGeocoder(_MapsBase):
         return body
 
 
-class ReverseAddressGeocoder(_MapsBase):
-    """Batch reverse geocoding: (lat, lon) pairs → addresses."""
+class ReverseAddressGeocoder(_MapsBase, MapsAsyncReply):
+    """Batch reverse geocoding: (lat, lon) pairs → addresses. Async per
+    the Maps batch convention (``Geocoders.scala:79-130``)."""
 
     coordinates = ServiceParam(list, is_required=True,
                                doc="list of [lat, lon] pairs per row")

@@ -113,7 +113,8 @@ class ContinuousDecoder:
                  eos_id: Optional[int] = None,
                  mesh: Optional[Mesh] = None,
                  prefix_cache_size: int = 8,
-                 steps_per_dispatch: int = 1):
+                 steps_per_dispatch: int = 1,
+                 pipeline_depth: int = 2):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -139,6 +140,22 @@ class ContinuousDecoder:
         #: outputs stay token-identical; admission granularity coarsens to
         #: one dispatch (a freed slot re-fills at the next host tick).
         self._k = int(steps_per_dispatch)
+        #: dispatches allowed in flight before the oldest token block is
+        #: fetched. The fetch is the only host↔device sync on the decode
+        #: path; at depth 0 every tick blocks ~RTT + device time (the r4
+        #: ceiling: ~10 ticks/s over the tunnel no matter how fast the
+        #: chip). With depth d the device runs ticks back-to-back while
+        #: the host drains blocks d dispatches behind — outputs are
+        #: token-identical, only admission of a freed slot lags by ≤ d
+        #: ticks. Device-side retirement (in-scan remaining/eos) is what
+        #: makes the lag safe: a done slot stays inactive on device no
+        #: matter how far the host view trails.
+        if pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0")
+        self._depth = int(pipeline_depth)
+        #: (device token block, {slot: request at dispatch time}) per
+        #: outstanding tick, oldest first
+        self._pending: List[tuple] = []
         params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
         shape = (self._S, cfg.heads, self._L, hd)
@@ -456,10 +473,26 @@ class ContinuousDecoder:
     def _insert_rows(self, group, logits, row_cache):
         """Slot insertion + first-token emission for an admitted group.
 
-        One device dispatch (``_insert_group_j``) and ONE host fetch for
-        the whole group — admission used to sync once per request, which
-        over the tunnel cost ~RTT each. ``logits``/``row_cache`` may carry
-        pad rows past ``len(group)``; only the first g rows are used."""
+        One device dispatch (``_insert_group_j``) and ONE host fetch per
+        POWER-OF-TWO CHUNK of the group — admission used to sync once per
+        request (~RTT each over the tunnel), and an arbitrary group size g
+        used to compile a fresh insert program per distinct g (a staggered
+        second wave admits in sizes 1, 2, 3, 5, ... — each a multi-second
+        remote compile that lands in the serving hot path; the r5 campaign
+        measured a 23 s first-token stall from exactly this). Chunking to
+        descending powers of two caps the program count at log2(S)+1.
+        ``logits``/``row_cache`` may carry pad rows past ``len(group)``;
+        only the first g rows are used."""
+        off = 0
+        while off < len(group):
+            size = 1 << ((len(group) - off).bit_length() - 1)
+            self._insert_chunk(group[off:off + size],
+                               logits[off:off + size],
+                               [{kk: c[kk][off:off + size]
+                                 for kk in ("k", "v")} for c in row_cache])
+            off += size
+
+    def _insert_chunk(self, group, logits, row_cache):
         g = len(group)
         slots_v = jnp.asarray([s for s, _ in group], jnp.int32)
         lens_v = jnp.asarray([r.prompt.size for _, r in group], jnp.int32)
@@ -587,6 +620,11 @@ class ContinuousDecoder:
         self._admit()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
         if not live:
+            # nothing host-side to step — but outstanding blocks may still
+            # hold tokens (and retire slots whose waiters are blocked)
+            if self._pending:
+                self._drain_one()
+                return 1
             return 0
         if any(self._slot_req[i].temperature > 0.0 for i in live):
             (self._tok, self._pos, self._active, self._cache,
@@ -599,22 +637,40 @@ class ContinuousDecoder:
              self._remaining, toks) = self._tick(
                 self._params, self._tok, self._pos, self._active,
                 self._cache, self._remaining)
-        # ONE fetch per dispatch: the (k, S) token block. Whether a slot
-        # emitted at scan step s needs no device mask — device retirement
-        # mirrors _note_token exactly, so a slot emits at s iff its
-        # request is not yet done host-side when s is replayed in order.
-        toks = np.asarray(toks)
+        # snapshot slot→REQUEST (not indices): by the time this block is
+        # drained, a slot may have been freed and re-admitted; tokens must
+        # go to the request that occupied the slot at DISPATCH time (its
+        # done guard discards the inactive-slot repeats)
+        self._pending.append((toks, {i: self._slot_req[i] for i in live}))
+        # the ONLY host↔device sync on the decode path: fetch the oldest
+        # block once `depth` newer dispatches are already queued on device
+        while len(self._pending) > self._depth:
+            self._drain_one()
+        return len(live)
+
+    def _drain_one(self):
+        """Fetch + process the oldest outstanding (k, S) token block.
+        Device retirement mirrors ``_note_token`` exactly, so a slot emits
+        at scan step s iff its request is not yet done host-side when s is
+        replayed in order — no device mask needed."""
+        toks_dev, snapshot = self._pending.pop(0)
+        toks = np.asarray(toks_dev)
         for s in range(toks.shape[0]):
-            for i in live:
-                req = self._slot_req[i]
-                if req is None or req.done:
+            for i, req in snapshot.items():
+                if req.done:
                     continue
                 self._note_token(req, int(toks[s, i]))
-        for i in live:
-            req = self._slot_req[i]
-            if req is not None and req.done:
+        for i, req in snapshot.items():
+            if req.done and self._slot_req[i] is req:
                 self._release(i)
-        return len(live)
+
+    def flush(self):
+        """Drain every outstanding dispatch (bounded: the pending queue
+        only shrinks here). Public so owners handing out tickets can
+        guarantee all tokens emitted so far are visible."""
+        with self._engine_lock:
+            while self._pending:
+                self._drain_one()
 
     def cancel_all(self):
         """Fail every waiting and in-flight request (device-error recovery:
@@ -635,6 +691,10 @@ class ContinuousDecoder:
             with self._lock:
                 waiting, self._waiting = self._waiting, []
             cancelled = list(waiting)
+            # outstanding blocks may reference donated/deleted buffers
+            # after a failed tick — drop them; cancel semantics already
+            # promise only "whatever was emitted before the cancel"
+            self._pending.clear()
             for i in range(self._S):
                 req = self._slot_req[i]
                 if req is not None:

@@ -21,15 +21,43 @@ SMALL = os.environ.get("BENCH_SCALE", "") == "small"
 
 
 def _bench_transform(model, df, n_rows, passes=3):
+    """Best-of-N e2e rate + spread fields (every campaign row carries them:
+    a single tunnel-window artifact must be visible in the row itself)."""
     out = model.transform(df.head(min(8, n_rows)))  # warmup/compile
     assert len(out) > 0
-    best = float("inf")
+    rates = []
     for _ in range(passes):
         t0 = time.perf_counter()
         out = model.transform(df)
-        best = min(best, time.perf_counter() - t0)
+        rates.append(n_rows / (time.perf_counter() - t0))
     assert len(out) == n_rows
-    return n_rows / best
+    return {"value": round(max(rates), 2), "best_of": len(rates),
+            "pass_spread": round((max(rates) - min(rates)) / max(rates), 3)}
+
+
+def _device_resident_rate(onnx_model, feeds_np, reps=10):
+    """Rows/sec once inputs are already on device — separates the chip from
+    the tunnel (same convention as the headline bench's
+    ``device_resident_ips``). Fencing via a fetched scalar on the LAST
+    dispatch (in-order execution fences the earlier ones)."""
+    import jax
+    import jax.numpy as jnp
+    jitted = onnx_model._ensure_jitted()
+    params = onnx_model._params_for_device(None)
+    devs = {k: jax.device_put(v) for k, v in feeds_np.items()}
+    n = next(iter(feeds_np.values())).shape[0]
+
+    def tail(outs):
+        leaf = jax.tree_util.tree_leaves(outs)[0]
+        return float(jnp.sum(leaf.reshape(-1)[:2].astype(jnp.float32)))
+
+    tail(jitted(params, devs))          # compile + warm
+    t0 = time.perf_counter()
+    outs = None
+    for _ in range(reps):
+        outs = jitted(params, devs)
+    tail(outs)
+    return round(n * reps / (time.perf_counter() - t0), 2)
 
 
 def bench_bert():
@@ -64,9 +92,12 @@ def bench_bert():
     ids = rng.integers(0, cfg.vocab, (n_rows, seq), dtype=np.int64)
     mask = np.ones((n_rows, seq), dtype=np.int64)
     df = DataFrame({"ids": [r for r in ids], "mask": [r for r in mask]})
-    sps = _bench_transform(m, df, n_rows)
+    res = _bench_transform(m, df, n_rows)
+    dev = _device_resident_rate(
+        m, {"input_ids": ids[:batch], "attention_mask": mask[:batch]})
     print(json.dumps({"metric": "bert_base_embeddings_seq_per_sec",
-                      "value": round(sps, 2), "unit": "sequences/sec/chip",
+                      **res, "unit": "sequences/sec/chip",
+                      "device_resident_sps": dev,
                       "seq_len": seq, "layers": cfg.layers,
                       "d_model": cfg.d_model,
                       "platform": _platform()}), flush=True)
@@ -90,9 +121,21 @@ def bench_featurizer():
                            mini_batch_size=(8 if SMALL else 128))
     imgs = rng.integers(0, 256, (n_rows, size, size, 3), dtype=np.uint8)
     df = DataFrame({"image": [i for i in imgs]})
-    ips = _bench_transform(feat, df, n_rows)
+    res = _bench_transform(feat, df, n_rows)
+    # device-resident: the inner backbone on a pre-staged uint8 batch with
+    # the same on-device transpose+normalize prep the e2e path uses
+    inner = feat._inner()
+    feed_name = list(inner.model_inputs())[0]
+    inner_cfg = inner.copy({
+        "feed_dict": {feed_name: "image"},
+        "fetch_dict": {"features": feat.get("feature_output")},
+        "transpose_dict": {feed_name: [0, 3, 1, 2]},
+        "normalize_dict": {feed_name: {"scale": float(feat.get("scale"))}}})
+    dev = _device_resident_rate(
+        inner_cfg, {feed_name: imgs[:min(128, n_rows)]})
     print(json.dumps({"metric": "image_featurizer_images_per_sec",
-                      "value": round(ips, 2), "unit": "images/sec/chip",
+                      **res, "unit": "images/sec/chip",
+                      "device_resident_ips": dev,
                       "platform": _platform()}), flush=True)
 
 
@@ -117,27 +160,36 @@ def bench_shap():
         inputs=[O.make_tensor_value_info("x", np.float32, ["N", d])],
         outputs=[O.make_tensor_value_info("probs", np.float32, ["N", 2])],
         initializers={"w1": w1, "w2": w2})
-    inner = ONNXModel(O.make_model(g), feed_dict={"x": "features"},
-                      fetch_dict={"probs": "probs"}, mini_batch_size=256,
-                      pin_devices=False)
+    # one jitted dispatch scores THOUSANDS of coalition rows: the explainer
+    # already batches all rows x samples through one _score_frame pass, so
+    # the inner batch size should match that scale — 256-row batches made
+    # the leg dispatch-count-bound (32 tiny dispatches per explain pass)
+    m_samples = 8 if SMALL else 128
     n_rows = 4 if SMALL else 64
     n_rows = int(os.environ.get("BENCH_SHAP_ROWS", n_rows))
+    inner = ONNXModel(O.make_model(g), feed_dict={"x": "features"},
+                      fetch_dict={"probs": "probs"},
+                      mini_batch_size=max(256, n_rows * m_samples),
+                      pin_devices=False)
     X = rng.normal(0, 1, (n_rows, d)).astype(np.float32)
     bg = rng.normal(0, 1, (16, d)).astype(np.float32)
     shap = VectorSHAP(model=inner, input_col="features",
                       target_col="probs", target_classes=[1],
-                      num_samples=(8 if SMALL else 128),
+                      num_samples=m_samples,
                       background_data=DataFrame(
                           {"features": [b for b in bg]}))
     df = DataFrame({"features": [x for x in X]})
-    t0 = time.perf_counter()
-    out = shap.transform(df)
-    dt = time.perf_counter() - t0
-    assert len(out) == n_rows
+    res = _bench_transform(shap, df, n_rows)
+    # device-resident: the coalition-scoring dispatch on a pre-staged
+    # (n*m, d) matrix, divided back to explained-rows/sec
+    flat = rng.normal(0, 1, (n_rows * m_samples, d)).astype(np.float32)
+    dev_score = _device_resident_rate(inner, {"x": flat})
     print(json.dumps({"metric": "kernel_shap_rows_per_sec",
-                      "value": round(n_rows / dt, 2),
+                      **res,
                       "unit": "explained rows/sec/chip",
-                      "samples_per_row": (8 if SMALL else 128),
+                      "device_resident_rows_per_sec":
+                          round(dev_score / m_samples, 2),
+                      "samples_per_row": m_samples,
                       "platform": _platform()}), flush=True)
 
 

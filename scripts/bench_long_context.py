@@ -114,6 +114,9 @@ def main():
         print(json.dumps({"metric": "long_context_attention_ms",
                           "seq_len": S, "heads": H, "head_dim": D,
                           "sp": int(mesh.shape["sp"]), **results,
+                          # amortized-fence design: one window, mean of
+                          # reps (per-rep fences would add ~RTT each)
+                          "reps": 5, "timing": "mean-of-reps-single-fence",
                           "platform": jax.default_backend()}), flush=True)
 
         # --- backward: the flash bwd kernels vs XLA-differentiated dense.

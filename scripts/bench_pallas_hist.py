@@ -118,6 +118,11 @@ def main():
 
         rec = {"metric": "gbdt_level_histogram_ms",
                "n": n, "features": F, "nodes": n_nodes, "bins": n_bins,
+               # per-op cost from a dependency-chained mean inside ONE
+               # window (per-rep fences would cost ~RTT each); a window
+               # artifact shows up as disagreement with the neighboring
+               # rows of the same sweep
+               "timing": "dependency-chain-mean",
                "platform": backend}
         try:
             t_pal = time_fn(level_histogram_pallas, xb, node, g, h, w,

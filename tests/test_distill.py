@@ -1,0 +1,78 @@
+"""Draft distillation for speculative decoding (models/zoo/distill.py).
+
+The invariant chain that makes speculative decoding worth having:
+train_lm makes the target confident on a structured language →
+distill_draft makes a smaller model agree with the target's greedy
+choices → speculative acceptance jumps while outputs stay EXACTLY the
+target's (the greedy-exactness contract of speculative.py).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.zoo.distill import (distill_draft, markov_sampler,
+                                             train_lm)
+from mmlspark_tpu.models.zoo.speculative import generate_speculative_fused
+from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                 generate_cached,
+                                                 init_transformer)
+
+T_CFG = TransformerConfig(vocab=64, layers=2, d_model=64, heads=4,
+                          d_ff=128, max_len=128, causal=True,
+                          norm="rmsnorm", position="rope")
+D_CFG = TransformerConfig(vocab=64, layers=1, d_model=32, heads=2,
+                          d_ff=64, max_len=128, causal=True,
+                          norm="rmsnorm", position="rope")
+
+
+@pytest.fixture(scope="module")
+def trained():
+    batch_fn = markov_sampler(T_CFG.vocab, batch=16, seq=32, seed=3)
+    t0 = init_transformer(T_CFG, seed=0)
+    t_params, hist = train_lm(t0, T_CFG, batch_fn, steps=80,
+                              learning_rate=1e-3, log_every=40)
+    d_params, d_hist = distill_draft(t_params, T_CFG, D_CFG, batch_fn,
+                                     steps=80, learning_rate=2e-3)
+    return batch_fn, t_params, d_params, hist, d_hist
+
+
+class TestDistill:
+    def test_lm_loss_decreases(self, trained):
+        _, _, _, hist, _ = trained
+        assert hist[-1] < hist[0]
+
+    def test_kl_decreases(self, trained):
+        _, _, _, _, d_hist = trained
+        assert d_hist[-1] < 0.5 * d_hist[0]
+
+    def test_distilled_draft_lifts_acceptance(self, trained):
+        batch_fn, t_params, d_params, _, _ = trained
+        prompt = batch_fn(999)[:1, :16]
+        random_draft = init_transformer(D_CFG, seed=7)
+        _, s_rand = generate_speculative_fused(
+            t_params, random_draft, prompt, T_CFG, D_CFG,
+            max_new_tokens=24, gamma=4)
+        _, s_dist = generate_speculative_fused(
+            t_params, d_params, prompt, T_CFG, D_CFG,
+            max_new_tokens=24, gamma=4)
+        acc_rand = s_rand["accepted_drafts"] / max(s_rand["rounds"], 1)
+        acc_dist = s_dist["accepted_drafts"] / max(s_dist["rounds"], 1)
+        assert acc_dist > acc_rand + 1.0          # > one extra token/round
+        assert s_dist["target_forwards"] < s_rand["target_forwards"]
+
+    def test_output_stays_target_exact(self, trained):
+        batch_fn, t_params, d_params, _, _ = trained
+        prompt = batch_fn(1234)[:1, :12]
+        ref = generate_cached(t_params, prompt, T_CFG, max_new_tokens=20,
+                              temperature=0.0)
+        spec, _ = generate_speculative_fused(
+            t_params, d_params, prompt, T_CFG, D_CFG,
+            max_new_tokens=20, gamma=4)
+        assert np.array_equal(np.asarray(ref), np.asarray(spec))
+
+    def test_vocab_mismatch_rejected(self):
+        bad = TransformerConfig(vocab=32, layers=1, d_model=32, heads=2,
+                                d_ff=64, max_len=64, causal=True)
+        with pytest.raises(ValueError, match="vocabulary"):
+            distill_draft(init_transformer(T_CFG, 0), T_CFG, bad,
+                          markov_sampler(64, 2, 8), steps=1)

@@ -560,6 +560,8 @@ def _registry():
     for cls in (ST.TextSentiment, ST.LanguageDetector, ST.EntityDetector,
                 ST.KeyPhraseExtractor, ST.NER,
                 SV.AnalyzeImage, SV.DescribeImage, SV.OCR, SV.TagImage,
+                SV.RecognizeText, SV.ReadImage,
+                SV.RecognizeDomainSpecificContent,
                 SF.DetectFace, SF.GroupFaces, SF.IdentifyFaces,
                 SF.VerifyFaces,
                 SFo.AnalyzeInvoices, SFo.AnalyzeLayout, SFo.AnalyzeReceipts,
@@ -572,6 +574,8 @@ def _registry():
                 SG.AddressGeocoder, SG.ReverseAddressGeocoder,
                 SG.CheckPointInPolygon, STr.DocumentTranslator):
         R[cls] = _svc(cls)
+    R[SV.GenerateThumbnails] = _svc(SV.GenerateThumbnails, width=32,
+                                    height=32)
     # streaming speech: experiment-fuzzed against a live fake ASR server in
     # test_speech_streaming; serialization-only here (url is ws://)
     from mmlspark_tpu.services.speech_streaming import SpeechToTextStreaming

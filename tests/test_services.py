@@ -52,6 +52,14 @@ class _MockService(BaseHTTPRequestHandler):
         elif path.path == "/images/search":
             self._reply({"value": [{"contentUrl": "http://x/img.png",
                                     "name": q["q"][0]}]})
+        elif path.path.startswith("/maps/batch/"):
+            op = path.path.rsplit("/", 1)[1]
+            n = _state["ops"].get(op, 0)
+            _state["ops"][op] = n + 1
+            if n < 2:                      # still running: 202, no body
+                self._reply({}, 202)
+            else:
+                self._reply(_state[f"result_{op}"])
         else:
             self._reply({"error": "not found"}, 404)
 
@@ -89,6 +97,43 @@ class _MockService(BaseHTTPRequestHandler):
             self._reply({}, status=202,
                         headers=[("Operation-Location",
                                   f"http://{host}/operations/{op}")])
+        elif path.path == "/vision/read":
+            assert q.get("language", ["en"])[0] in ("en", "de")
+            _state["op_counter"] += 1
+            op = f"op{_state['op_counter']}"
+            _state["ops"][op] = 0
+            host = self.headers["Host"]
+            self._reply({}, status=202,
+                        headers=[("Operation-Location",
+                                  f"http://{host}/operations/{op}")])
+        elif path.path == "/vision/thumb":
+            assert q["width"][0] == "40" and q["height"][0] == "30"
+            png = b"\x89PNG-fake-thumb"
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(png)))
+            self.end_headers()
+            self.wfile.write(png)
+        elif path.path.startswith("/vision/models/"):
+            # /vision/models/{model}/analyze — the per-row URL segment
+            model = path.path.split("/")[3]
+            self._reply({"result": {"celebrities": [
+                {"name": f"famous-{model}", "confidence": 0.99}]}})
+        elif path.path == "/maps/geocode":
+            # Azure-Maps batch convention: 202 + Location header, poll
+            # until the result flips to 200 (no JSON status field)
+            _state["op_counter"] += 1
+            op = f"maps{_state['op_counter']}"
+            _state["ops"][op] = 0
+            host = self.headers["Host"]
+            items = [{"response": {"results": [
+                {"position": {"lat": 47.6, "lon": -122.1},
+                 "query": it["query"]}]}}
+                for it in body.get("batchItems", [])]
+            _state[f"result_{op}"] = {"batchItems": items}
+            self._reply({}, status=202,
+                        headers=[("Location",
+                                  f"http://{host}/maps/batch/{op}")])
         elif path.path == "/anomaly/entire":
             series = body["series"]
             vals = [p["value"] for p in series]
@@ -159,6 +204,87 @@ def test_ocr_async_polling(svc):
     out = t.transform(df)
     assert out["ocr"][0]["status"] == "succeeded"
     assert out["ocr"][0]["analyzeResult"]["lines"] == ["hello world"]
+
+
+def test_read_image_async_and_flatten(svc):
+    from mmlspark_tpu.services import ReadImage, flatten_read
+    t = ReadImage(url=svc + "/vision/read", output_col="read",
+                  polling_delay_ms=20, language="de")
+    df = DataFrame({"image_url": ["http://x/a.png"]})
+    t.set_vector_param("image_url", "image_url")
+    out = t.transform(df)
+    assert out["read"][0]["status"] == "succeeded"
+    # flatten on a canned Read v3 payload shape
+    payload = {"analyzeResult": {"readResults": [
+        {"lines": [{"text": "hello"}, {"text": "world"}]}]}}
+    assert flatten_read(np.asarray([payload, None], dtype=object))[0] \
+        == "hello world"
+
+
+def test_read_image_language_validated(svc):
+    # an invalid per-row param value is a PER-ROW failure: it lands in the
+    # error column and the other rows still succeed
+    from mmlspark_tpu.services import ReadImage
+    t = ReadImage(url=svc + "/vision/read", output_col="o",
+                  polling_delay_ms=20)
+    t.set_vector_param("image_url", "u")
+    t.set_vector_param("language", "lang")
+    out = t.transform(DataFrame({"u": ["http://x/a.png", "http://x/b.png"],
+                                 "lang": ["xx", "de"]}))
+    assert out["o"][0] is None
+    assert "language" in out[t.get("error_col")][0]["reasonPhrase"]
+    assert out["o"][1]["status"] == "succeeded"
+
+
+def test_recognize_text_mode_validated(svc):
+    from mmlspark_tpu.services import RecognizeText
+    t = RecognizeText(url=svc + "/vision/ocr", output_col="o",
+                      polling_delay_ms=20, mode="Handwritten")
+    t.set_vector_param("image_url", "u")
+    out = t.transform(DataFrame({"u": ["http://x/a.png"]}))
+    assert out["o"][0]["status"] == "succeeded"
+    bad = RecognizeText(url=svc + "/vision/ocr", output_col="o",
+                        mode="Cursive")
+    bad.set_vector_param("image_url", "u")
+    out = bad.transform(DataFrame({"u": ["http://x/a.png"]}))
+    assert out["o"][0] is None
+    assert "mode" in out[bad.get("error_col")][0]["reasonPhrase"]
+
+
+def test_generate_thumbnails_binary_output(svc):
+    from mmlspark_tpu.services import GenerateThumbnails
+    t = GenerateThumbnails(url=svc + "/vision/thumb", output_col="thumb",
+                           width=40, height=30, smart_cropping=True)
+    t.set_vector_param("image_url", "u")
+    out = t.transform(DataFrame({"u": ["http://x/a.png"]}))
+    assert out["thumb"][0] == b"\x89PNG-fake-thumb"     # raw bytes, not JSON
+
+
+def test_domain_specific_content_url_per_row(svc):
+    from mmlspark_tpu.services import RecognizeDomainSpecificContent
+    t = RecognizeDomainSpecificContent(url=svc + "/vision",
+                                       output_col="celebs")
+    t.set_vector_param("image_url", "u")
+    t.set_vector_param("model", "m")
+    out = t.transform(DataFrame({"u": ["http://x/a.png", "http://x/b.png"],
+                                 "m": ["celebrities", "landmarks"]}))
+    assert out["celebs"][0]["result"]["celebrities"][0]["name"] \
+        == "famous-celebrities"
+    assert out["celebs"][1]["result"]["celebrities"][0]["name"] \
+        == "famous-landmarks"
+
+
+def test_maps_geocoder_batch_async(svc):
+    from mmlspark_tpu.services.geospatial import AddressGeocoder
+    t = AddressGeocoder(url=svc + "/maps/geocode", output_col="geo",
+                        polling_delay_ms=20, subscription_key="mk")
+    col = np.empty(1, dtype=object)
+    col[0] = ["1 Main St", "2 Side Ave"]
+    t.set_vector_param("address", "addrs")
+    out = t.transform(DataFrame({"addrs": col}))
+    items = out["geo"][0]
+    assert len(items) == 2
+    assert items[0]["response"]["results"][0]["position"]["lat"] == 47.6
 
 
 def test_detect_anomalies_service(svc):
