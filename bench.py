@@ -278,9 +278,16 @@ def main():
     h2d_samples = []
     midrun_error = None
     for i in range(max(1, passes)):
-        try:
-            if i > 0:
+        if i > 0:
+            # interleaved link probe in its OWN try: a probe failure must
+            # neither abort the remaining e2e passes nor masquerade as a
+            # pass failure (round-4 postmortem: an optional leg's crash
+            # discarded a full TPU measurement)
+            try:
                 h2d_samples.append(_h2d_streaming_gbps())
+            except Exception:                       # noqa: BLE001
+                pass
+        try:
             t0 = time.perf_counter()
             out = m.transform(df)
             elapsed = time.perf_counter() - t0

@@ -120,34 +120,52 @@ class HasServiceParams(Params):
 
 
 class HasAsyncReply(Params):
-    """202 + Operation-Location polling (``ComputerVision.scala:290-330``)."""
+    """202 + long-poll replies (``ComputerVision.scala:290-330``).
+
+    The skeleton (202 check → location header → sleep/poll loop →
+    synthesized 504 on exhaustion) is shared; service conventions differ
+    only in the three hooks below — the cognitive default polls
+    ``Operation-Location`` until a JSON ``status`` field completes, the
+    Azure-Maps variant (``geospatial.MapsAsyncReply``) polls ``Location``
+    until the HTTP status flips from 202.
+    """
 
     polling_delay_ms = Param(int, default=300, doc="delay between polls")
     max_polling_retries = Param(int, default=100, doc="max poll attempts")
 
+    #: response header carrying the poll URL
+    _poll_location_header = "operation-location"
+
+    def _poll_url(self, loc: str, request: HTTPRequestData) -> str:
+        """Hook: decorate the poll URL (e.g. re-attach query auth)."""
+        return loc
+
+    def _poll_done(self, resp: HTTPResponseData) -> bool:
+        """Hook: is this poll response terminal?"""
+        import json as _json
+        try:
+            status = str(resp.json_content().get("status", "")).lower()
+        except (_json.JSONDecodeError, ValueError):
+            return False
+        return status in ("succeeded", "failed", "partiallycompleted")
+
     def _poll(self, session, initial: HTTPResponseData,
               request: HTTPRequestData, timeout: float) -> HTTPResponseData:
-        headers = request.headers
         if initial.status_code != 202:
             return initial
         loc = next((h.value for h in initial.headers
-                    if h.name.lower() == "operation-location"), None)
+                    if h.name.lower() == self._poll_location_header), None)
         if loc is None:
             return initial
-        import json as _json
-
+        loc = self._poll_url(loc, request)
         for _ in range(self.get("max_polling_retries")):
             time.sleep(self.get("polling_delay_ms") / 1000.0)
             resp = _send(session, HTTPRequestData(url=loc, method="GET",
-                                                  headers=list(headers)),
+                                                  headers=list(request.headers)),
                          timeout)
             if resp is None:
                 continue
-            try:
-                status = str(resp.json_content().get("status", "")).lower()
-            except (_json.JSONDecodeError, ValueError):
-                continue
-            if status in ("succeeded", "failed", "partiallycompleted"):
+            if self._poll_done(resp):
                 return resp
         # polling exhausted: surface a timeout error instead of returning the
         # bare 202 (202 counts as OK downstream and would read as success)
@@ -237,6 +255,11 @@ class ServiceTransformer(Transformer, HasServiceParams, HasOutputCol,
 
     # -- execution -----------------------------------------------------------
     def _transform(self, df: DataFrame) -> DataFrame:
+        # stage-level misconfiguration fails LOUDLY before any row work —
+        # the per-row catch below must not demote "url never set" to a
+        # silently all-errored batch
+        if self.get("url") is None:
+            raise ValueError(f"{type(self).__name__}: url must be set")
         rows = list(df.iter_rows())
         # per-row build failures (e.g. a column-bound param holding an
         # invalid value) land in the ERROR COLUMN like every other per-row

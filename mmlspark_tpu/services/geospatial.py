@@ -17,44 +17,26 @@ __all__ = ["AddressGeocoder", "ReverseAddressGeocoder",
 
 
 class MapsAsyncReply(HasAsyncReply):
-    """Azure-Maps async convention (``AzureMapsTraits.scala:90-130``):
-    a batch POST answers 202 with a ``Location`` header (NOT
-    Operation-Location), and polling is done when the status flips to
-    200 — there is no JSON ``status`` field to inspect."""
+    """Azure-Maps async convention (``AzureMapsTraits.scala:90-130``),
+    expressed as the three ``HasAsyncReply`` hooks: the poll URL comes
+    from the ``Location`` header (NOT Operation-Location), it must carry
+    the subscription key the initial POST used as a query param (an
+    unauthenticated poll 401s forever), and completion is the HTTP
+    status flipping from 202 — there is no JSON ``status`` field."""
 
-    def _poll(self, session, initial, request, timeout):
-        import time as _time
-        from urllib.parse import parse_qs, urlparse
+    _poll_location_header = "location"
 
-        from ..io.http.schema import HTTPRequestData, StatusLineData
-        from .base import _send
-        if initial.status_code != 202:
-            return initial
-        loc = next((h.value for h in initial.headers
-                    if h.name.lower() == "location"), None)
-        if loc is None:
-            return initial
-        # the poll GET must authenticate like the initial POST did — Maps
-        # carries the key as a query param, and the service's Location URL
-        # does not include it (an unauthenticated poll 401s forever)
+    def _poll_url(self, loc: str, request) -> str:
+        from urllib.parse import parse_qs, quote, urlparse
         key = parse_qs(urlparse(request.url).query).get(
             "subscription-key", [None])[0]
         if key and "subscription-key=" not in loc:
-            from urllib.parse import quote
             sep = "&" if "?" in loc else "?"
             loc = f"{loc}{sep}subscription-key={quote(key)}"
-        for _ in range(self.get("max_polling_retries")):
-            _time.sleep(self.get("polling_delay_ms") / 1000.0)
-            resp = _send(session, HTTPRequestData(url=loc, method="GET",
-                                                  headers=list(request.headers)),
-                         timeout)
-            if resp is None or resp.status_code == 202:
-                continue
-            return resp                 # 200 = done; errors surface as-is
-        from ..io.http.schema import HTTPResponseData
-        return HTTPResponseData(
-            status_line=StatusLineData(status_code=504,
-                                       reason_phrase="async polling timed out"))
+        return loc
+
+    def _poll_done(self, resp) -> bool:
+        return resp.status_code != 202  # 200 = done; errors surface as-is
 
 
 class _MapsBase(ServiceTransformer):
