@@ -9,8 +9,16 @@ over a device mesh, explainers, featurization, serving, and HTTP transformers.
 
 __version__ = "0.1.0"
 
+import os as _os
+
 from .core import (DataFrame, Estimator, Model, Pipeline, PipelineModel,
                    PipelineStage, Transformer, concat)
+
+if _os.environ.get("MMLSPARK_TPU_COMPILE_CACHE"):
+    # opt-in persistent compilation cache: compiled executables survive
+    # across processes (repeat jobs skip the multi-second XLA warmup)
+    from .utils.jit_cache import enable_persistent_cache as _epc
+    _epc()
 
 __all__ = ["DataFrame", "concat", "PipelineStage", "Transformer", "Estimator",
            "Model", "Pipeline", "PipelineModel", "__version__"]

@@ -1,17 +1,44 @@
-"""Process-wide cache of jitted functions.
+"""Process-wide cache of jitted functions + persistent compilation cache.
 
 Per-call ``@jax.jit`` closures create a fresh function object every
 invocation, so jax's jit cache never hits and every transform recompiles.
 Stages register their kernels here once, keyed by a stable name.
+
+``enable_persistent_cache()`` additionally turns on JAX's on-disk
+compilation cache so compiled executables survive ACROSS PROCESSES —
+the measured GBDT warmup at HIGGS-11M is ~29 s of mostly compilation
+per fresh process (was 98 s in r4), which repeat jobs should not re-pay.
+Enabled automatically at package import when
+``MMLSPARK_TPU_COMPILE_CACHE`` names a directory (unset = off: the
+cache writes to disk, which a library must not do unasked).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Optional, Tuple
 
 _CACHE: Dict[str, Callable] = {}
 
-__all__ = ["jitted"]
+__all__ = ["jitted", "enable_persistent_cache"]
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
+    """Point JAX's compilation cache at ``cache_dir`` (default: the
+    ``MMLSPARK_TPU_COMPILE_CACHE`` env var). Returns whether it is on —
+    derived from ``jax.config`` itself, the single source of truth (a
+    separate flag could desync across reloads or external config edits).
+    Safe to call repeatedly; a missing directory is created."""
+    import jax
+    cache_dir = cache_dir or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE")
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # cache everything: the default min-size/min-time gates skip
+        # exactly the many small programs a pipeline framework dispatches
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return bool(jax.config.jax_compilation_cache_dir)
 
 
 def jitted(name: str, fn: Callable,

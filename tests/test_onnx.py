@@ -445,3 +445,46 @@ class TestMeshShardedInference:
         out = np.stack(list(m.transform(DataFrame({"x": col}))["y"]))
         np.testing.assert_allclose(out, np.maximum(X @ w, 0), rtol=1e-5,
                                    atol=1e-5)
+
+
+class TestConvNHWCMode:
+    """MMLSPARK_TPU_CONV_NHWC=1 (the on-TPU default) must be numerically
+    identical to the NCHW lowering — CI runs on CPU where 'auto' is off,
+    so this forces the branch."""
+
+    @pytest.mark.parametrize("case", [
+        dict(x=(2, 3, 16, 16), w=(8, 3, 3, 3), strides=[1, 1], group=1),
+        dict(x=(2, 4, 15, 15), w=(6, 4, 5, 5), strides=[2, 2], group=1),
+        dict(x=(1, 8, 9, 9), w=(8, 4, 3, 3), strides=[1, 1], group=2),
+        dict(x=(2, 3, 14, 14), w=(4, 3, 3, 3), strides=[2, 2], group=1,
+             auto_pad="SAME_UPPER"),
+        dict(x=(1, 2, 12, 12), w=(3, 2, 3, 3), strides=[1, 1], group=1,
+             dilations=[2, 2]),
+    ])
+    def test_matches_nchw(self, case, monkeypatch):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, case["x"]).astype(np.float32)
+        w = rng.normal(0, 1, case["w"]).astype(np.float32)
+        b = rng.normal(0, 1, (case["w"][0],)).astype(np.float32)
+        attrs = {"strides": case["strides"], "group": case["group"]}
+        if "auto_pad" in case:
+            attrs["auto_pad"] = case["auto_pad"]
+        if "dilations" in case:
+            attrs["dilations"] = case["dilations"]
+        g = O.make_graph(
+            [O.make_node("Conv", ["x", "w", "b"], ["y"], **attrs)],
+            "conv_layouts",
+            inputs=[O.make_tensor_value_info(
+                "x", np.float32, list(case["x"]))],
+            outputs=[O.make_tensor_value_info(
+                "y", np.float32, ["N", "C", "H", "W"])],
+            initializers={"w": w, "b": b})
+        model = O.make_model(g)
+
+        monkeypatch.setenv("MMLSPARK_TPU_CONV_NHWC", "0")
+        cm0 = O.convert_model(model)
+        ref = np.asarray(cm0(cm0.params, {"x": x})["y"])
+        monkeypatch.setenv("MMLSPARK_TPU_CONV_NHWC", "1")
+        cm1 = O.convert_model(model)
+        got = np.asarray(cm1(cm1.params, {"x": x})["y"])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

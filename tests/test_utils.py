@@ -106,3 +106,68 @@ class TestDeviceDetection:
         monkeypatch.setenv("MMLSPARK_TPU_FORCE_PLATFORM", "cpu")
         assert _auto_interpret() is True
         assert pallas_kernels.histogram_enabled() is False
+
+
+class TestPersistentCompileCache:
+    @pytest.fixture(autouse=True)
+    def _restore_jax_cache_config(self):
+        # these are PROCESS-GLOBAL jax settings: leak one test's tmp_path
+        # cache dir and every later compile in this process writes there
+        import jax
+        saved = (jax.config.jax_compilation_cache_dir,
+                 jax.config.jax_persistent_cache_min_entry_size_bytes,
+                 jax.config.jax_persistent_cache_min_compile_time_secs)
+        yield
+        jax.config.update("jax_compilation_cache_dir", saved[0])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          saved[1])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          saved[2])
+
+    def test_enable_sets_jax_config(self, tmp_path):
+        import jax
+
+        from mmlspark_tpu.utils.jit_cache import enable_persistent_cache
+        d = tmp_path / "xla-cache"
+        assert enable_persistent_cache(str(d)) is True
+        assert jax.config.jax_compilation_cache_dir == str(d)
+        assert d.is_dir()
+
+    def test_off_by_default_without_env(self, monkeypatch):
+        import jax
+        monkeypatch.delenv("MMLSPARK_TPU_COMPILE_CACHE", raising=False)
+        jax.config.update("jax_compilation_cache_dir", None)
+        from mmlspark_tpu.utils.jit_cache import enable_persistent_cache
+        # no dir given and no env: reports current state, flips nothing on
+        assert enable_persistent_cache() is False
+
+    def test_cross_process_warmup_drops(self, tmp_path):
+        """The point of the knob: a second process re-running the same
+        jitted program must start measurably faster (executables are
+        reloaded from disk instead of recompiled)."""
+        import os
+        import subprocess
+        import sys
+
+        child = (
+            "import os, time\n"
+            "os.environ.pop('JAX_PLATFORMS', None)\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import mmlspark_tpu\n"
+            "import jax.numpy as jnp\n"
+            "t0 = time.perf_counter()\n"
+            "f = jax.jit(lambda x: (x @ x.T).sum())\n"
+            "float(f(jnp.arange(256*64, dtype=jnp.float32)"
+            ".reshape(256, 64)))\n"
+            "print('compile_s=%.3f' % (time.perf_counter() - t0))\n")
+        env = {**os.environ,
+               "MMLSPARK_TPU_COMPILE_CACHE": str(tmp_path / "cc")}
+        env.pop("JAX_PLATFORMS", None)
+        times = []
+        for _ in range(2):
+            r = subprocess.run([sys.executable, "-c", child], env=env,
+                               capture_output=True, text=True, timeout=300)
+            assert r.returncode == 0, r.stderr[-500:]
+            times.append(float(r.stdout.strip().split("compile_s=")[1]))
+        assert times[1] < times[0]
