@@ -691,3 +691,58 @@ class TestMultiStepDispatch:
         with pytest.raises(ValueError, match="steps_per_dispatch"):
             ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
                               steps_per_dispatch=0)
+
+
+class TestPipelinedDispatch:
+    """``pipeline_depth=d``: up to d token blocks stay in flight while the
+    host drains the oldest — the fetch was the only sync on the decode
+    path and serialized every tick at ~RTT. Outputs must be identical at
+    every depth (device-side retirement makes the host's lagged view
+    safe), and ``flush()`` must surface all emitted tokens."""
+
+    def _run(self, params, depth, prompts, maxnews, k=3, eos=None):
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                steps_per_dispatch=k, eos_id=eos,
+                                pipeline_depth=depth)
+        reqs = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, maxnews)]
+        for _ in range(400):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        return [eng.result(r, timeout=5) for r in reqs]
+
+    def test_identical_across_depths(self, params):
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(0, CFG.vocab, int(rng.integers(3, 9)))
+                   for _ in range(6)]
+        maxnews = [6, 2, 9, 4, 11, 7]
+        a = self._run(params, 0, prompts, maxnews)     # fully synchronous
+        assert self._run(params, 2, prompts, maxnews) == a
+        assert self._run(params, 4, prompts, maxnews) == a
+        for p, m, got in zip(prompts, maxnews, a):
+            assert got == _reference_tokens(params, p, m)
+
+    def test_flush_drains_outstanding_blocks(self, params):
+        rng = np.random.default_rng(22)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                steps_per_dispatch=2, pipeline_depth=3)
+        req = eng.submit(rng.integers(0, CFG.vocab, 4), max_new_tokens=8)
+        # step a few times WITHOUT letting the drain catch up fully
+        for _ in range(3):
+            eng.step()
+        pending_before = len(eng._pending)
+        eng.flush()
+        assert not eng._pending
+        # prefill emits 1 + 2 tokens per drained tick block
+        assert len(req.tokens) >= min(8, 1 + 2 * pending_before)
+        while not req.done:
+            eng.step()
+        assert eng.result(req) == _reference_tokens(
+            params, np.asarray(req.prompt), 8)
+
+    def test_negative_depth_rejected(self, params):
+        import pytest
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              pipeline_depth=-1)
