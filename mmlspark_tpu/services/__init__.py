@@ -18,19 +18,28 @@ server, as the reference tests do with recorded replies.
 
 from .base import (HasServiceParams, ServiceParam, ServiceTransformer,
                    HasAsyncReply)
-from .text import (EntityDetector, KeyPhraseExtractor, LanguageDetector,
-                   NER, TextSentiment)
+from .text import (EntityDetector, EntityDetectorSDK, Healthcare,
+                   HealthcareSDK, KeyPhraseExtractor, KeyPhraseExtractorSDK,
+                   LanguageDetector, LanguageDetectorSDK, NER, NERSDK, PII,
+                   PIISDK, TextAnalyze, TextSentiment, TextSentimentSDK)
 from .vision import (AnalyzeImage, DescribeImage, GenerateThumbnails, OCR,
                      ReadImage, RecognizeDomainSpecificContent,
                      RecognizeText, TagImage, flatten_ocr, flatten_read)
 from .anomaly import DetectAnomalies, DetectLastAnomaly, SimpleDetectAnomalies
-from .translate import (BreakSentence, DetectLanguage, DocumentTranslator,
-                        Translate, Transliterate)
-from .face import DetectFace, GroupFaces, IdentifyFaces, VerifyFaces
-from .form import (AnalyzeLayout, AnalyzeInvoices, AnalyzeReceipts,
-                   FormOntologyLearner, FormOntologyTransformer)
-from .search import AzureSearchWriter, BingImageSearch
-from .speech import SpeechToText, SpeechToTextSDK, TextToSpeech
+from .translate import (BreakSentence, DetectLanguage, DictionaryExamples,
+                        DictionaryLookup, DocumentTranslator, Translate,
+                        Transliterate)
+from .face import (DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces,
+                   VerifyFaces)
+from .form import (AnalyzeBusinessCards, AnalyzeCustomModel,
+                   AnalyzeIDDocuments, AnalyzeInvoices, AnalyzeLayout,
+                   AnalyzeReceipts, FormOntologyLearner,
+                   FormOntologyTransformer, GetCustomModel, ListCustomModels,
+                   flatten_document_results, flatten_model_list,
+                   flatten_page_results, flatten_read_results)
+from .search import AddDocuments, AzureSearchWriter, BingImageSearch
+from .speech import (ConversationTranscription, SpeechToText,
+                     SpeechToTextSDK, TextToSpeech)
 from .mvad import DetectMultivariateAnomaly, FitMultivariateAnomaly
 from .geospatial import (AddressGeocoder, CheckPointInPolygon,
                          ReverseAddressGeocoder)
@@ -38,16 +47,25 @@ from .geospatial import (AddressGeocoder, CheckPointInPolygon,
 __all__ = [
     "ServiceParam", "HasServiceParams", "ServiceTransformer", "HasAsyncReply",
     "TextSentiment", "LanguageDetector", "EntityDetector", "NER",
-    "KeyPhraseExtractor", "AnalyzeImage", "OCR", "DescribeImage", "TagImage",
+    "KeyPhraseExtractor", "PII", "TextAnalyze", "Healthcare",
+    "TextSentimentSDK", "LanguageDetectorSDK", "EntityDetectorSDK", "NERSDK",
+    "KeyPhraseExtractorSDK", "PIISDK", "HealthcareSDK",
+    "AnalyzeImage", "OCR", "DescribeImage", "TagImage",
     "RecognizeText", "ReadImage", "GenerateThumbnails",
     "RecognizeDomainSpecificContent", "flatten_ocr", "flatten_read",
     "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
     "Translate", "Transliterate", "DetectLanguage", "BreakSentence",
-    "DetectFace", "VerifyFaces", "GroupFaces", "IdentifyFaces",
+    "DictionaryLookup", "DictionaryExamples",
+    "DetectFace", "FindSimilarFace", "VerifyFaces", "GroupFaces",
+    "IdentifyFaces",
     "AnalyzeLayout", "AnalyzeInvoices", "AnalyzeReceipts",
-    "AzureSearchWriter", "BingImageSearch",
+    "AnalyzeBusinessCards", "AnalyzeIDDocuments", "ListCustomModels",
+    "GetCustomModel", "AnalyzeCustomModel", "flatten_read_results",
+    "flatten_page_results", "flatten_document_results", "flatten_model_list",
+    "AddDocuments", "AzureSearchWriter", "BingImageSearch",
     "DocumentTranslator", "FormOntologyLearner", "FormOntologyTransformer",
-    "SpeechToText", "SpeechToTextSDK", "TextToSpeech",
+    "SpeechToText", "SpeechToTextSDK", "ConversationTranscription",
+    "TextToSpeech",
     "FitMultivariateAnomaly", "DetectMultivariateAnomaly",
     "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
 ]

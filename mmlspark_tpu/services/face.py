@@ -1,14 +1,15 @@
 """Face service transformers.
 
-Parity: ``cognitive/.../Face.scala`` (351 LoC): ``DetectFace``,
-``VerifyFaces``, ``GroupFaces``, ``IdentifyFaces``.
+Parity: ``cognitive/.../Face.scala`` (351 LoC) op-for-op: ``DetectFace``,
+``FindSimilarFace``, ``VerifyFaces``, ``GroupFaces``, ``IdentifyFaces``.
 """
 
 from __future__ import annotations
 
 from .base import ServiceParam, ServiceTransformer
 
-__all__ = ["DetectFace", "VerifyFaces", "GroupFaces", "IdentifyFaces"]
+__all__ = ["DetectFace", "FindSimilarFace", "VerifyFaces", "GroupFaces",
+           "IdentifyFaces"]
 
 
 class DetectFace(ServiceTransformer):
@@ -23,6 +24,42 @@ class DetectFace(ServiceTransformer):
     return_face_attributes = ServiceParam(str, is_url_param=True,
                                           payload_name="returnFaceAttributes",
                                           doc="comma-joined attribute list")
+
+
+class FindSimilarFace(ServiceTransformer):
+    """Parity: ``FindSimilarFace`` (``Face.scala:96-182``) — similar-face
+    search for one query face against exactly one of ``faceListId`` /
+    ``largeFaceListId`` / ``faceIds``; ``mode`` is matchPerson (default)
+    or matchFace."""
+
+    face_id = ServiceParam(str, is_required=True, payload_name="faceId",
+                           doc="query face id from DetectFace")
+    face_list_id = ServiceParam(str, payload_name="faceListId",
+                                doc="face list to search")
+    large_face_list_id = ServiceParam(str, payload_name="largeFaceListId",
+                                      doc="large face list to search")
+    face_ids = ServiceParam(list, payload_name="faceIds",
+                            doc="candidate face id array (max 1000)")
+    max_candidates = ServiceParam(int,
+                                  payload_name="maxNumOfCandidatesReturned",
+                                  doc="max candidates returned (1-1000)")
+    mode = ServiceParam(str, doc="matchPerson or matchFace")
+
+    def _build_request(self, row):
+        if self.should_skip(row):  # null required params skip, not 400
+            return None
+        m = self.get_value_opt(row, "mode")
+        if m is not None and m not in ("matchPerson", "matchFace"):
+            raise ValueError(
+                f"mode must be matchPerson or matchFace, got {m!r}")
+        targets = [self.get_value_opt(row, n) is not None
+                   for n in ("face_list_id", "large_face_list_id",
+                             "face_ids")]
+        if sum(targets) != 1:
+            raise ValueError(
+                "exactly one of face_list_id, large_face_list_id, face_ids "
+                "must be set")
+        return super()._build_request(row)
 
 
 class VerifyFaces(ServiceTransformer):

@@ -558,19 +558,27 @@ def _registry():
         SM.FitMultivariateAnomaly(url="http://localhost:1/x"),
         experiment=False)
     for cls in (ST.TextSentiment, ST.LanguageDetector, ST.EntityDetector,
-                ST.KeyPhraseExtractor, ST.NER,
+                ST.KeyPhraseExtractor, ST.NER, ST.PII, ST.TextAnalyze,
+                ST.Healthcare, ST.TextSentimentSDK, ST.LanguageDetectorSDK,
+                ST.EntityDetectorSDK, ST.NERSDK, ST.KeyPhraseExtractorSDK,
+                ST.PIISDK, ST.HealthcareSDK,
                 SV.AnalyzeImage, SV.DescribeImage, SV.OCR, SV.TagImage,
                 SV.RecognizeText, SV.ReadImage,
                 SV.RecognizeDomainSpecificContent,
                 SF.DetectFace, SF.GroupFaces, SF.IdentifyFaces,
-                SF.VerifyFaces,
+                SF.VerifyFaces, SF.FindSimilarFace,
                 SFo.AnalyzeInvoices, SFo.AnalyzeLayout, SFo.AnalyzeReceipts,
+                SFo.AnalyzeBusinessCards, SFo.AnalyzeIDDocuments,
+                SFo.ListCustomModels, SFo.GetCustomModel,
+                SFo.AnalyzeCustomModel,
                 STr.Translate, STr.Transliterate, STr.BreakSentence,
-                STr.DetectLanguage,
+                STr.DetectLanguage, STr.DictionaryLookup,
+                STr.DictionaryExamples,
                 SSe.BingImageSearch,
                 SA.DetectAnomalies, SA.DetectLastAnomaly,
                 SA.SimpleDetectAnomalies,
                 SSp.SpeechToText, SSp.SpeechToTextSDK, SSp.TextToSpeech,
+                SSp.ConversationTranscription, SSe.AddDocuments,
                 SG.AddressGeocoder, SG.ReverseAddressGeocoder,
                 SG.CheckPointInPolygon, STr.DocumentTranslator):
         R[cls] = _svc(cls)

@@ -13,7 +13,8 @@ import pytest
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.core.dataframe import object_col
 from mmlspark_tpu.services import (AnalyzeImage, BingImageSearch,
-                                   DetectAnomalies, LanguageDetector, OCR,
+                                   DetectAnomalies, DictionaryLookup,
+                                   LanguageDetector, OCR,
                                    SimpleDetectAnomalies, TextSentiment,
                                    Translate)
 from mmlspark_tpu.services.search import AzureSearchWriter
@@ -84,7 +85,20 @@ class _MockService(BaseHTTPRequestHandler):
         elif path.path == "/translate":
             to = q["to"][0]
             self._reply([{"translations":
-                          [{"text": f"<{to}>{body[0]['Text']}", "to": to}]}])
+                          [{"text": f"<{to}>{d['Text']}", "to": to}]}
+                         for d in body])
+        elif path.path == "/dictionary/lookup":
+            assert q["from"][0] == "en" and q["to"][0] == "es"
+            self._reply([{"normalizedSource": d["Text"].lower(),
+                          "translations": [{"normalizedTarget": "volar"}]}
+                         for d in body])
+        elif path.path == "/dictionary/examples":
+            assert q["from"][0] == "en" and q["to"][0] == "es"
+            self._reply([{"normalizedSource": d["Text"],
+                          "normalizedTarget": d["Translation"],
+                          "examples": [{"sourceTerm": d["Text"],
+                                        "targetTerm": d["Translation"]}]}
+                         for d in body])
         elif path.path == "/vision/analyze":
             assert "visualFeatures" in q
             self._reply({"categories": [{"name": "outdoor", "score": 0.9}],
@@ -185,6 +199,49 @@ def test_translate_url_params(svc):
     t.set_scalar_param("to_language", "de")
     out = t.transform(df)
     assert out["tr"][0][0]["text"] == "<de>hello"
+
+
+def test_translate_multi_target_and_text_batch(svc):
+    """A list-valued text is one request with positional results; a
+    to_language list joins with commas (reference toValueString)."""
+    df = DataFrame({"texts": object_col([["hello", "bye"]])})
+    t = Translate(url=svc + "/translate", output_col="tr")
+    t.set_vector_param("text", "texts")
+    t.set_scalar_param("to_language", ["de", "it"])
+    out = t.transform(df)
+    # mock echoes the first 'to'; both texts come back positionally
+    assert [r[0]["text"] for r in out["tr"][0]] == ["<de,it>hello",
+                                                    "<de,it>bye"]
+
+
+def test_dictionary_lookup(svc):
+    df = DataFrame({"w": object_col(["Fly"])})
+    t = DictionaryLookup(url=svc + "/dictionary/lookup", output_col="out")
+    t.set_vector_param("text", "w")
+    t.set_scalar_param("from_language", "en")
+    t.set_scalar_param("to_language", "es")
+    out = t.transform(df)
+    assert out["out"][0]["normalizedSource"] == "fly"
+    assert out["out"][0]["translations"][0]["normalizedTarget"] == "volar"
+
+
+def test_dictionary_examples_pairs(svc):
+    from mmlspark_tpu.services import DictionaryExamples
+    df = DataFrame({"pair": object_col([("fly", "volar")])})
+    t = DictionaryExamples(url=svc + "/dictionary/examples",
+                           output_col="out")
+    t.set_vector_param("text_and_translation", "pair")
+    t.set_scalar_param("from_language", "en")
+    t.set_scalar_param("to_language", "es")
+    out = t.transform(df)
+    # single pair → single result object
+    assert out["out"][0]["examples"][0]["targetTerm"] == "volar"
+    # list of pairs → positional array
+    df2 = DataFrame({"pair": object_col(
+        [[("fly", "volar"), ("run", "correr")]])})
+    out2 = t.transform(df2)
+    assert [r["normalizedTarget"] for r in out2["out"][0]] \
+        == ["volar", "correr"]
 
 
 def test_analyze_image(svc):
@@ -397,3 +454,38 @@ def test_bool_url_params_lowercase(svc):
     out2 = t2.transform(df2)
     assert out2["err"][0] is None
     assert out2["out"][0]["query"]["returnFaceId"] == ["false"]
+
+
+def test_find_similar_face_target_validation(svc):
+    """FindSimilarFace requires exactly one candidate source (reference
+    Face.scala:96-182); violations land in the error column per row."""
+    from mmlspark_tpu.services import FindSimilarFace
+
+    df = DataFrame({"fid": object_col(["f-1"])})
+    t = FindSimilarFace(url=svc + "/echo_query", output_col="out",
+                        error_col="err", method="POST")
+    t.set_vector_param("face_id", "fid")
+    out = t.transform(df)            # no candidate source at all
+    assert out["out"][0] is None
+    assert "exactly one" in out["err"][0]["reasonPhrase"]
+
+    t.set_scalar_param("face_list_id", "fl")
+    t.set_scalar_param("face_ids", ["a", "b"])
+    out = t.transform(df)            # two candidate sources
+    assert "exactly one" in out["err"][0]["reasonPhrase"]
+
+    ok = FindSimilarFace(url=svc + "/echo_query", output_col="out",
+                         error_col="err")
+    ok.set_vector_param("face_id", "fid")
+    ok.set_scalar_param("face_list_id", "fl")
+    ok.set_scalar_param("mode", "matchFace")
+    res = ok.transform(df)
+    assert res["err"][0] is None
+
+    bad = FindSimilarFace(url=svc + "/echo_query", output_col="out",
+                          error_col="err")
+    bad.set_vector_param("face_id", "fid")
+    bad.set_scalar_param("face_list_id", "fl")
+    bad.set_scalar_param("mode", "bestMatch")
+    res = bad.transform(df)
+    assert "matchPerson" in res["err"][0]["reasonPhrase"]

@@ -89,6 +89,19 @@ class _Mock(BaseHTTPRequestHandler):
             items = [{"response": {"ok": True, "q": it["query"]}}
                      for it in body["batchItems"]]
             self._reply({"batchItems": items})
+        elif path.path == "/search/index/docs":
+            body = json.loads(raw)
+            assert self.headers.get("api-key") == "sk"
+            self._reply({"value": [
+                {"key": str(i), "status": True,
+                 "action_seen": d.get("@search.action"),
+                 "fields_seen": sorted(d.keys())}
+                for i, d in enumerate(body["value"])]})
+        elif path.path == "/transcribe":
+            assert q.get("participants", [""])[0].startswith("[")
+            text = f"speaker0 said {len(raw)} bytes"
+            self._reply({"RecognitionStatus": "Success",
+                         "DisplayText": text, "SpeakerId": "guest-0"})
         elif path.path == "/docbatches":
             body = json.loads(raw)
             assert body["inputs"][0]["targets"][0]["language"] == "fr"
@@ -249,3 +262,156 @@ def test_stt_sdk_column_bound_language(svc):
     results = out["out"][0]
     assert len(results) == 2
     assert results[0]["DisplayText"].endswith("de-DE")
+
+
+def test_custom_model_urls_and_flatteners():
+    """Custom-model trio builds /{modelId} URLs per row (reference
+    prepareUrl, FormRecognizer.scala:284-360); flatteners mirror
+    FormsFlatteners (:84-166)."""
+    from mmlspark_tpu.core.dataframe import object_col
+    from mmlspark_tpu.services import (AnalyzeCustomModel, GetCustomModel,
+                                       flatten_document_results,
+                                       flatten_model_list,
+                                       flatten_page_results,
+                                       flatten_read_results)
+
+    a = AnalyzeCustomModel(url="http://h/custom/models")
+    a.set_scalar_param("model_id", "m-1")
+    a.set_scalar_param("include_text_details", True)
+    assert a._full_url({}) == \
+        "http://h/custom/models/m-1/analyze?includeTextDetails=true"
+
+    g = GetCustomModel(url="http://h/custom/models")
+    g.set_scalar_param("model_id", "m-2")
+    g.set_scalar_param("include_keys", True)
+    assert g._full_url({}) == "http://h/custom/models/m-2?includeKeys=true"
+    assert g.get("method") == "GET"
+
+    resp = {"analyzeResult": {
+        "readResults": [{"lines": [{"text": "Total"}, {"text": "42"}]}],
+        "pageResults": [{"keyValuePairs": [
+            {"key": {"text": "Total"}, "value": {"text": "42"}}],
+            "tables": [{"cells": [{"text": "a"}, {"text": "b"}]}]}],
+        "documentResults": [{"fields": {
+            "Total": {"type": "number", "valueNumber": 42.0}}}]}}
+    col = object_col([resp, None])
+    assert flatten_read_results(col)[0] == "Total 42"
+    assert flatten_read_results(col)[1] is None
+    pages = flatten_page_results(col)[0]
+    assert "key: Total value: 42" in pages and "a | b" in pages
+    docs = flatten_document_results(col)[0]
+    assert '"valueNumber": 42.0' in docs
+    models = object_col([{"modelList": [{"modelId": "m1"},
+                                        {"modelId": "m2"}]}])
+    assert flatten_model_list(models)[0] == "m1 m2"
+
+
+def test_add_documents_batches_and_actions(svc):
+    """AddDocuments uploads {"value": [...]} batches with api-key auth and
+    every row of a batch receives the batch's indexing response
+    (reference AzureSearch.scala AddDocuments)."""
+    from mmlspark_tpu.services import AddDocuments
+
+    df = DataFrame({"id": object_col(["a", "b", "c"]),
+                    "@search.action": object_col(
+                        ["upload", "merge", "upload"])})
+    t = AddDocuments(url=svc + "/search/index/docs", output_col="out",
+                     error_col="err", batch_size=2)
+    t.set_scalar_param("subscription_key", "sk")
+    out = t.transform(df)
+    # batch 1 = rows 0,1; batch 2 = row 2 — actions echo per doc
+    assert out["out"][0]["value"][1]["action_seen"] == "merge"
+    assert out["out"][0] == out["out"][1]          # same batch response
+    assert len(out["out"][2]["value"]) == 1
+    assert all(e is None for e in out["err"])
+
+
+def test_conversation_transcription_chunks_with_participants(svc):
+    """ConversationTranscription streams chunks like SpeechToTextSDK and
+    forwards the validated participants declaration on each request."""
+    from mmlspark_tpu.services import ConversationTranscription
+
+    wav = bytes(range(256)) * 300          # 76,800 bytes → 3 chunks @32768
+    df = DataFrame({"audio": object_col([wav])})
+    t = ConversationTranscription(url=svc + "/transcribe",
+                                  output_col="out", error_col="err")
+    t.set_vector_param("audio_data", "audio")
+    t.set_scalar_param(
+        "participants_json",
+        '[{"name": "ana", "preferredLanguage": "en-US"}]')
+    out = t.transform(df)
+    assert out["err"][0] is None
+    assert len(out["out"][0]) == 3
+    assert all(r["SpeakerId"] == "guest-0" for r in out["out"][0])
+
+    bad = ConversationTranscription(url=svc + "/transcribe",
+                                    output_col="out", error_col="err")
+    bad.set_vector_param("audio_data", "audio")
+    bad.set_scalar_param("participants_json", "{not json")
+    res = bad.transform(df)
+    assert "not valid JSON" in res["err"][0]["reasonPhrase"]
+
+
+def test_add_documents_excludes_column_bound_key(svc):
+    """A column-bound API key must never be uploaded into the index."""
+    from mmlspark_tpu.services import AddDocuments
+
+    df = DataFrame({"id": object_col(["a"]),
+                    "keycol": object_col(["sk"])})
+    t = AddDocuments(url=svc + "/search/index/docs", output_col="out",
+                     error_col="err")
+    t.set_vector_param("subscription_key", "keycol")
+    out = t.transform(df)
+    assert out["err"][0] is None
+    # the doc carries id + defaulted action, NOT the key column
+    assert out["out"][0]["value"][0]["fields_seen"] == ["@search.action",
+                                                        "id"]
+    # and the search convention header is the class default
+    assert AddDocuments(url="http://x/").get("key_header") == "api-key"
+
+
+def test_dictionary_examples_malformed_row_lands_in_error_col(svc):
+    """A non-pair value errors its own row instead of aborting the batch
+    (the framework's one-malformed-row invariant)."""
+    from mmlspark_tpu.services import DictionaryExamples
+
+    df = DataFrame({"pair": object_col([5, ("fly", "volar")])})
+    t = DictionaryExamples(url=svc + "/dictionary-unused",
+                           output_col="out", error_col="err")
+    t.set_vector_param("text_and_translation", "pair")
+    t.set_scalar_param("from_language", "en")
+    t.set_scalar_param("to_language", "es")
+    out = t.transform(df)
+    assert out["out"][0] is None
+    assert "pair" in out["err"][0]["reasonPhrase"]
+    # row 1 proceeded to a real request (404 from the fake path, not a crash)
+    assert out["err"][1] is not None
+
+
+def test_find_similar_face_null_required_param_skips():
+    """Null face_id is a skip (null/null), not a validation 400."""
+    from mmlspark_tpu.services import FindSimilarFace
+
+    df = DataFrame({"fid": object_col([None])})
+    t = FindSimilarFace(url="http://localhost:1/x", output_col="out",
+                        error_col="err")
+    t.set_vector_param("face_id", "fid")
+    out = t.transform(df)
+    assert out["out"][0] is None and out["err"][0] is None
+
+
+def test_model_url_escapes_and_merges_query():
+    from mmlspark_tpu.services.form import _model_url
+
+    assert _model_url("http://h/models?api-version=2.1", "m 1/x",
+                      {"includeKeys": "true"}, suffix="/analyze") == \
+        "http://h/models/m%201%2Fx/analyze?api-version=2.1&includeKeys=true"
+
+
+def test_flatten_page_results_tolerates_null_key():
+    from mmlspark_tpu.services import flatten_page_results
+
+    col = object_col([{"analyzeResult": {"pageResults": [
+        {"keyValuePairs": [{"key": None, "value": {"text": "x"}}]}]}}])
+    out = flatten_page_results(col)[0]
+    assert "value: x" in out

@@ -1,0 +1,281 @@
+"""Widened text-analytics family: document batching, PII, the async
+multi-task TextAnalyze, Healthcare, and the SDK aliases — against a local
+mock server (the reference tests these with recorded replies the same way).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.dataframe import object_col
+from mmlspark_tpu.services import (Healthcare, LanguageDetectorSDK, PII,
+                                   TextAnalyze, TextSentiment)
+
+_state = {"requests": [], "ops": {}, "op_counter": 0, "poll_queries": []}
+
+
+class _TextMock(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, status=200, headers=()):
+        out = json.dumps(obj).encode()
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def _start_op(self, kind):
+        _state["op_counter"] += 1
+        op = f"{kind}{_state['op_counter']}"
+        _state["ops"][op] = 0
+        host = self.headers["Host"]
+        self._reply({}, status=202,
+                    headers=[("Operation-Location",
+                              f"http://{host}/poll/{op}")])
+
+    def do_GET(self):
+        path = urlparse(self.path)
+        _state["poll_queries"].append(path.query)
+        if path.path.startswith("/poll/"):
+            op = path.path.rsplit("/", 1)[1]
+            n = _state["ops"].get(op, 0)
+            _state["ops"][op] = n + 1
+            if n < 1:
+                self._reply({"status": "running"})
+            elif op.startswith("analyze"):
+                docs = _state[f"docs_{op}"]
+                self._reply({"status": "succeeded", "tasks": {
+                    "entityRecognitionTasks": [{"state": "succeeded",
+                        "results": {
+                            "documents": [{"id": d["id"], "entities": [
+                                {"text": d["text"], "category": "Noun"}]}
+                                for d in docs],
+                            "errors": []}}],
+                    "sentimentAnalysisTasks": [{"state": "succeeded",
+                        "results": {
+                            "documents": [{"id": d["id"],
+                                           "sentiment": "neutral"}
+                                          for d in docs[:-1]],
+                            "errors": [{"id": docs[-1]["id"],
+                                        "error": {"code": "boom"}}]
+                            if docs else []}}],
+                }})
+            else:  # health job
+                docs = _state[f"docs_{op}"]
+                self._reply({"status": "succeeded", "results": {
+                    "documents": [{"id": d["id"],
+                                   "entities": [{"text": "ibuprofen",
+                                                 "category": "Drug"}],
+                                   "relations": []} for d in docs],
+                    "errors": []}})
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(n))
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        _state["requests"].append({"path": path.path, "query": q,
+                                   "body": body})
+        if path.path == "/sentiment":
+            docs, errs = [], []
+            for d in body["documents"]:
+                if d["text"] == "ERR":
+                    errs.append({"id": d["id"],
+                                 "error": {"code": "InvalidDocument"}})
+                else:
+                    docs.append({"id": d["id"],
+                                 "sentiment": "positive" if "good"
+                                 in d["text"] else "negative",
+                                 "confidenceScores": {"positive": 0.8}})
+            self._reply({"documents": docs, "errors": errs})
+        elif path.path == "/languages":
+            self._reply({"documents": [
+                {"id": d["id"], "detectedLanguage":
+                    {"iso6391Name": (d.get("language") or "xx")[:2]}}
+                for d in body["documents"]]})
+        elif path.path == "/pii":
+            self._reply({"documents": [
+                {"id": d["id"], "redactedText": "*" * len(d["text"]),
+                 "entities": [{"category": "Email"}]}
+                for d in body["documents"]]})
+        elif path.path == "/analyze":
+            _state["op_counter"] += 1
+            op = f"analyze{_state['op_counter']}"
+            _state["ops"][op] = 0
+            _state[f"docs_{op}"] = body["analysisInput"]["documents"]
+            host = self.headers["Host"]
+            self._reply({}, status=202,
+                        headers=[("Operation-Location",
+                                  f"http://{host}/poll/{op}")])
+        elif path.path == "/health/jobs":
+            _state["op_counter"] += 1
+            op = f"health{_state['op_counter']}"
+            _state["ops"][op] = 0
+            _state[f"docs_{op}"] = body["documents"]
+            host = self.headers["Host"]
+            self._reply({}, status=202,
+                        headers=[("Operation-Location",
+                                  f"http://{host}/poll/{op}")])
+        else:
+            self._reply({"error": "not found"}, 404)
+
+
+@pytest.fixture(scope="module")
+def svc():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _TextMock)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _sent_requests(path):
+    return [r for r in _state["requests"] if r["path"] == path]
+
+
+def test_auto_batching_groups_rows_and_scatters_results(svc):
+    """batch_size groups scalar rows into one request; per-doc results and
+    doc-level errors scatter back to the originating rows."""
+    before = len(_sent_requests("/sentiment"))
+    df = DataFrame({"txt": object_col(
+        ["good a", "bad b", "ERR", "good c", "bad d"])})
+    t = TextSentiment(url=svc + "/sentiment", output_col="out",
+                      error_col="err", batch_size=2)
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    sent = _sent_requests("/sentiment")[before:]
+    assert [len(r["body"]["documents"]) for r in sent] == [2, 2, 1]
+    assert out["out"][0]["sentiment"] == "positive"
+    assert out["out"][1]["sentiment"] == "negative"
+    # the doc-level error hits exactly its own row
+    assert out["out"][2] is None
+    assert out["err"][2]["error"]["code"] == "InvalidDocument"
+    assert out["out"][3]["sentiment"] == "positive"
+    assert out["out"][4]["sentiment"] == "negative"
+    assert out["err"][0] is None and out["err"][4] is None
+
+
+def test_user_batching_list_text_gives_array_output(svc):
+    """A list-typed text value is one request; output is the per-doc array
+    with errored docs in their slots (reference unpackBatchUDF order)."""
+    df = DataFrame({"docs": object_col([["good x", "ERR", "bad y"]])})
+    t = TextSentiment(url=svc + "/sentiment", output_col="out",
+                      error_col="err")
+    t.set_vector_param("text", "docs")
+    out = t.transform(df)
+    res = out["out"][0]
+    assert len(res) == 3
+    assert res[0]["sentiment"] == "positive"
+    assert res[1] == {"error": {"code": "InvalidDocument"}}
+    assert res[2]["sentiment"] == "negative"
+
+
+def test_language_broadcast_single_hint_fills_batch(svc):
+    """One language hint broadcasts across a user-batched document list
+    (reference: Seq.fill when one language for N texts)."""
+    df = DataFrame({"docs": object_col([["salut", "merci"]])})
+    t = LanguageDetectorSDK(url=svc + "/languages", output_col="out")
+    t.set_vector_param("text", "docs")
+    t.set_scalar_param("language", "fr")
+    out = t.transform(df)
+    assert [d["iso6391Name"] for d in out["out"][0]] == ["fr", "fr"]
+
+
+def test_sdk_alias_batch_default_is_five(svc):
+    assert LanguageDetectorSDK(url="http://x/").get("batch_size") == 5
+    assert TextSentiment(url="http://x/").get("batch_size") == 1
+
+
+def test_pii_url_params_and_domain_validation(svc):
+    before = len(_sent_requests("/pii"))
+    df = DataFrame({"txt": object_col(["mail me at a@b.c"])})
+    t = PII(url=svc + "/pii", output_col="out", error_col="err")
+    t.set_vector_param("text", "txt")
+    t.set_scalar_param("domain", "PHI")
+    t.set_scalar_param("pii_categories", ["Email", "Address"])
+    out = t.transform(df)
+    req = _sent_requests("/pii")[before]
+    assert req["query"]["domain"] == ["PHI"]
+    assert req["query"]["piiCategories"] == ["Email,Address"]
+    assert out["out"][0]["entities"][0]["category"] == "Email"
+    assert out["out"][0]["redactedText"].startswith("*")
+    # invalid domain → per-row build error, not an exception
+    bad = PII(url=svc + "/pii", output_col="out", error_col="err")
+    bad.set_vector_param("text", "txt")
+    bad.set_scalar_param("domain", "everything")
+    res = bad.transform(df)
+    assert res["out"][0] is None
+    assert "domain" in res["err"][0]["reasonPhrase"]
+
+
+def test_text_analyze_multitask_async(svc):
+    """TextAnalyze: one async job per batch, $top=25 forced onto the poll
+    URL, per-document TAAnalyzeResult unpacking across task families."""
+    df = DataFrame({"txt": object_col(["alpha", "beta"])})
+    t = TextAnalyze(url=svc + "/analyze", output_col="out", error_col="err",
+                    batch_size=25, polling_delay_ms=10,
+                    entity_recognition_tasks=[
+                        {"parameters": {"model-version": "latest"}}],
+                    sentiment_analysis_tasks=[{"parameters": {}}])
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    # $top=25 prefixes the poll query (reference modifyPollingURI)
+    assert any(pq.startswith("$top=25") for pq in _state["poll_queries"])
+    r0 = out["out"][0]
+    assert r0["entityRecognition"][0]["result"]["entities"][0]["text"] \
+        == "alpha"
+    assert r0["sentimentAnalysis"][0]["result"]["sentiment"] == "neutral"
+    # last doc's sentiment task errored server-side → error in its slot
+    r1 = out["out"][1]
+    assert r1["sentimentAnalysis"][0]["result"] is None
+    assert r1["sentimentAnalysis"][0]["error"]["code"] == "boom"
+    assert r1["entityRecognition"][0]["result"]["entities"][0]["text"] \
+        == "beta"
+
+
+def test_text_analyze_task_shape_validated(svc):
+    df = DataFrame({"txt": object_col(["x"])})
+    t = TextAnalyze(url=svc + "/analyze", output_col="out", error_col="err",
+                    entity_recognition_tasks=[{"nope": 1}])
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    assert out["out"][0] is None
+    assert "parameters" in out["err"][0]["reasonPhrase"]
+
+
+def test_healthcare_async_entities(svc):
+    df = DataFrame({"txt": object_col(["took 200mg ibuprofen"])})
+    t = Healthcare(url=svc + "/health/jobs", output_col="out",
+                   error_col="err", polling_delay_ms=10)
+    t.set_vector_param("text", "txt")
+    out = t.transform(df)
+    assert out["out"][0]["entities"][0]["category"] == "Drug"
+    assert out["out"][0]["relations"] == []
+
+
+def test_model_version_and_show_stats_ride_as_url_params(svc):
+    before = len(_sent_requests("/sentiment"))
+    df = DataFrame({"txt": object_col(["good z"])})
+    t = TextSentiment(url=svc + "/sentiment", output_col="out")
+    t.set_vector_param("text", "txt")
+    t.set_scalar_param("model_version", "2022-01-01")
+    t.set_scalar_param("show_stats", True)
+    t.set_scalar_param("opinion_mining", True)
+    t.transform(df)
+    q = _sent_requests("/sentiment")[before]["query"]
+    assert q["model-version"] == ["2022-01-01"]
+    assert q["showStats"] == ["true"]
+    assert q["opinionMining"] == ["true"]
