@@ -17,6 +17,15 @@ set -u
 OUT="${1:-/tmp/tpu_bench_results.jsonl}"
 cd "$(dirname "$0")/.."
 
+# Persistent XLA compile cache across stages AND campaign retries: a leg
+# that compiled once never waits on (or 500s in) the remote-compile
+# service again. Timed regions are post-warmup so steady-state numbers
+# are unaffected; compile-INCLUSIVE fields do change — GBDT warmup_s
+# reflects what repeat jobs see (BASELINE.md: 98 s cold → 29 s cached),
+# and bench.py's warm_ips last-resort fallback (reported only when every
+# timed pass died) is faster on a retry than on a cold first attempt.
+export MMLSPARK_TPU_COMPILE_CACHE="${MMLSPARK_TPU_COMPILE_CACHE:-/tmp/mmlspark_xla_cache}"
+
 # $OUT is APPEND-ONLY across retries: a mid-campaign abort (exit 3) makes
 # chip_campaign_loop.sh re-run the whole campaign in the next healthy
 # window, so stages that already succeeded get a second JSON line —

@@ -66,59 +66,65 @@ def main():
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, cfg.vocab, (B, P), dtype=np.int32))
 
-    # ---- prefill: one causal forward over the prompt ----
-    @jax.jit
-    def prefill(params, ids):
-        h = transformer_apply(params, ids, cfg)
-        return h[:, -1].astype(jnp.float32) @ params["lm_head"]["w"]
+    # sweep mode: skip straight to the continuous-batching row (each
+    # skipped section is an extra remote compile per sweep point)
+    cb_only = os.environ.get("BENCH_CB_ONLY", "0") == "1"
 
-    logits = prefill(params, prompt)                       # compile
-    float(jnp.sum(logits))                                 # fence
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(jnp.sum(prefill(params, prompt)))
-        best = min(best, time.perf_counter() - t0)
-    prefill_tps = B * P / best
-    print(json.dumps({
-        "metric": "decoder_prefill_tokens_per_sec",
-        "value": round(prefill_tps, 1), "unit": "tokens/sec/chip",
-        "batch": B, "prompt_len": P, "params_m": round(n_params / 1e6, 1),
-        "ms": round(best * 1e3, 2),
-        "platform": jax.default_backend()}), flush=True)
+    if not cb_only:
+        # ---- prefill: one causal forward over the prompt ----
+        @jax.jit
+        def prefill(params, ids):
+            h = transformer_apply(params, ids, cfg)
+            return h[:, -1].astype(jnp.float32) @ params["lm_head"]["w"]
 
-    # ---- decode: whole loop as ONE compiled scan over decode_step ----
-    L = P + T
-    cache0 = init_kv_cache(cfg, B, L)
+        logits = prefill(params, prompt)                   # compile
+        float(jnp.sum(logits))                             # fence
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(jnp.sum(prefill(params, prompt)))
+            best = min(best, time.perf_counter() - t0)
+        prefill_tps = B * P / best
+        print(json.dumps({
+            "metric": "decoder_prefill_tokens_per_sec",
+            "value": round(prefill_tps, 1), "unit": "tokens/sec/chip",
+            "batch": B, "prompt_len": P,
+            "params_m": round(n_params / 1e6, 1),
+            "ms": round(best * 1e3, 2),
+            "platform": jax.default_backend()}), flush=True)
 
-    @jax.jit
-    def decode(params, first_tok, cache):
-        def step(carry, t):
-            tok, cache = carry
-            logits, cache = decode_step(params, tok, P + t, cache, cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (nxt, cache), None
+        # ---- decode: whole loop as ONE compiled scan over decode_step ----
+        L = P + T
+        cache0 = init_kv_cache(cfg, B, L)
 
-        (tok, cache), _ = jax.lax.scan(step, (first_tok, cache),
-                                       jnp.arange(T))
-        return tok
+        @jax.jit
+        def decode(params, first_tok, cache):
+            def step(carry, t):
+                tok, cache = carry
+                logits, cache = decode_step(params, tok, P + t, cache, cfg)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, cache), None
 
-    first = prompt[:, -1]
-    tok = decode(params, first, cache0)                    # compile
-    float(jnp.sum(tok))                                    # fence
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(jnp.sum(decode(params, first, cache0)))
-        best = min(best, time.perf_counter() - t0)
-    decode_tps = B * T / best
-    print(json.dumps({
-        "metric": "decoder_cached_decode_tokens_per_sec",
-        "value": round(decode_tps, 1), "unit": "tokens/sec/chip",
-        "batch": B, "new_tokens": T, "kv_len": L,
-        "params_m": round(n_params / 1e6, 1),
-        "ms_per_token": round(best * 1e3 / T, 3),
-        "platform": jax.default_backend()}), flush=True)
+            (tok, cache), _ = jax.lax.scan(step, (first_tok, cache),
+                                           jnp.arange(T))
+            return tok
+
+        first = prompt[:, -1]
+        tok = decode(params, first, cache0)                # compile
+        float(jnp.sum(tok))                                # fence
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(jnp.sum(decode(params, first, cache0)))
+            best = min(best, time.perf_counter() - t0)
+        decode_tps = B * T / best
+        print(json.dumps({
+            "metric": "decoder_cached_decode_tokens_per_sec",
+            "value": round(decode_tps, 1), "unit": "tokens/sec/chip",
+            "batch": B, "new_tokens": T, "kv_len": L,
+            "params_m": round(n_params / 1e6, 1),
+            "ms_per_token": round(best * 1e3 / T, 3),
+            "platform": jax.default_backend()}), flush=True)
 
     # ---- continuous batching: staggered requests through the slot pool ----
     from mmlspark_tpu.serving.continuous import ContinuousDecoder
@@ -127,9 +133,16 @@ def main():
     # k decode steps per dispatch: behind the network-attached chip every
     # dispatch pays ~RTT, which the r4 campaign showed dominating this
     # bench (231 tok/s with the chip mostly idle)
-    k_steps = _env_int("BENCH_CB_STEPS", 8)
+    # defaults from the r5 on-chip sweep (record: BASELINE.md §round-5
+    # continuation): k=16 ≈ 1.5× k=8 at every measured depth (best 4,265
+    # vs 2,888 tok/s) and k=32 bought nothing more; at k=8 depth is
+    # monotone harmful (retirement lag), while the k=16 d=1-vs-d=2
+    # ordering is within-window noise — d=2 kept as the engine default.
+    k_steps = _env_int("BENCH_CB_STEPS", 16)
+    cb_depth = _env_int("BENCH_CB_DEPTH", 2)
     eng = ContinuousDecoder(params, cfg, max_slots=B, max_len=P + T + 1,
-                            steps_per_dispatch=k_steps)
+                            steps_per_dispatch=k_steps,
+                            pipeline_depth=cb_depth)
     rng2 = np.random.default_rng(1)
     # warm the steady-state program set: a full-pool burst compiles the
     # max-size prefill bucket, the power-of-two insert chunks, and the
@@ -152,10 +165,13 @@ def main():
         "metric": "decoder_continuous_batching_tokens_per_sec",
         "value": round(total_toks / dt, 1), "unit": "tokens/sec/chip",
         "slots": B, "requests": n_req, "prompt_len": P, "new_tokens": T,
-        "steps_per_dispatch": k_steps,
+        "steps_per_dispatch": k_steps, "pipeline_depth": cb_depth,
         "ttft_p50_ms": round(1e3 * sorted(ttft)[len(ttft) // 2], 1),
         "ttft_max_ms": round(1e3 * max(ttft), 1),
         "platform": jax.default_backend()}), flush=True)
+
+    if cb_only:
+        return  # sweep mode: just the continuous-batching row
 
     # -- speculative decoding: draft-then-verify vs plain cached greedy --
     from mmlspark_tpu.models.zoo.speculative import generate_speculative_fused as generate_speculative
