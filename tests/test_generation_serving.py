@@ -284,3 +284,16 @@ def test_streaming_bad_request_is_json_400(params):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req, timeout=30)
         assert ei.value.code == 400
+
+
+def test_k_step_pipelined_engine_is_token_identical(params):
+    # the sweep-tuned serving operating point (steps_per_dispatch > 1 with
+    # dispatch pipelining) must not change outputs — same invariance the
+    # decoder-level suite pins, here through the HTTP engine lifecycle
+    prompt = [5, 17, 9, 80]
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48,
+                          steps_per_dispatch=4,
+                          pipeline_depth=2) as eng:
+        status, body = _post(eng.address, {"tokens": prompt, "max_new": 6})
+        assert status == 200
+        assert body["tokens"] == _want(params, prompt, 6)
