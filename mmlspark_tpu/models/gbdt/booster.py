@@ -228,11 +228,18 @@ class Booster:
                 else (X.shape[0],)
             return np.full(shape, self.base_score, dtype=np.float32)
         if self.is_linear:
-            from .trees import predict_trees_linear_any
             lin = self.linear
-            out = predict_trees_linear_any(
-                self.feats[:T], self.thr_raw[:T], lin["coefs"][:T],
-                lin["pf"][:T], X, depth=self.depth)
+            if self.num_class > 1:
+                from .trees import predict_trees_linear_multi_any
+                out = predict_trees_linear_multi_any(
+                    self.feats[:T], self.thr_raw[:T], lin["coefs"][:T],
+                    lin["pf"][:T], X, depth=self.depth,
+                    num_class=self.num_class)
+            else:
+                from .trees import predict_trees_linear_any
+                out = predict_trees_linear_any(
+                    self.feats[:T], self.thr_raw[:T], lin["coefs"][:T],
+                    lin["pf"][:T], X, depth=self.depth)
         else:
             out = predict_trees_any(self.feats[:T], self.thr_raw[:T],
                                     self.leaf_values[:T], X, depth=self.depth)
@@ -309,8 +316,6 @@ class Booster:
         the booster (LightGBM reuses the model's own shrinkage; estimates
         on a different scale would drift toward base_score).
         """
-        if self.num_class > 1:
-            raise NotImplementedError("refit for multiclass boosters")
         if self.is_linear:
             raise NotImplementedError(
                 "refit re-estimates constant leaf values; linear leaves "
@@ -326,13 +331,17 @@ class Booster:
         y = np.asarray(y, dtype=np.float64)
         w = (np.asarray(sample_weight, dtype=np.float64)
              if sample_weight is not None else np.ones(len(y)))
-        obj = get_objective(self.objective, num_class=2)
+        K = self.num_class if self.num_class > 1 else 1
+        obj = get_objective(self.objective, num_class=max(K, 2))
         # leaf index per (row, tree) in one pass (predict_leaf applies the
-        # categorical encoding itself); per-tree leaf sums after
+        # categorical encoding itself); per-tree leaf sums after. Multiclass
+        # trees share one structure with K leaf-value sets, so the same
+        # (n, T) index table serves every class.
         leaves = np.asarray(self.predict_leaf(X))              # (n, T)
         n_leaf = 2 ** self.depth
         new_lv = np.array(self.leaf_values, dtype=np.float32, copy=True)
-        scores = jnp.full(len(y), self.base_score, jnp.float32)
+        scores = jnp.full((len(y), K) if K > 1 else len(y),
+                          self.base_score, jnp.float32)
         if obj.grad_hess is None:
             raise NotImplementedError(
                 f"refit needs analytic gradients for {self.objective!r}")
@@ -340,19 +349,36 @@ class Booster:
         yd, wd = jnp.asarray(y), jnp.asarray(w)
         for t in range(self.num_trees):
             g, h = grad_fn(scores, yd, wd)
-            g = np.asarray(g, dtype=np.float64)
+            g = np.asarray(g, dtype=np.float64)    # (n,) or (n, K)
             h = np.asarray(h, dtype=np.float64)
             li = leaves[:, t]
-            Gs = np.bincount(li, weights=g, minlength=n_leaf)
-            Hs = np.bincount(li, weights=h, minlength=n_leaf)
-            opt = np.where(Hs > 0,
-                           -Gs / (Hs + lam) * learning_rate, 0.0)
-            blended = (decay_rate * new_lv[t]
-                       + (1.0 - decay_rate) * opt).astype(np.float32)
-            # empty leaves keep their trained value (no evidence to move)
-            blended = np.where(Hs > 0, blended, new_lv[t])
-            new_lv[t] = blended
-            scores = scores + jnp.asarray(blended, jnp.float32)[li]
+            if K > 1:
+                # tree t was trained for class t % K only (class-major
+                # append order, the same invariant prediction routes by);
+                # re-estimating the other class rows would blend toward
+                # zeros that were never trained estimates and give every
+                # tree K times its trained per-class influence
+                k = t % K
+                Gs = np.bincount(li, weights=g[:, k], minlength=n_leaf)
+                Hs = np.bincount(li, weights=h[:, k], minlength=n_leaf)
+                opt = np.where(Hs > 0,
+                               -Gs / (Hs + lam) * learning_rate, 0.0)
+                blended = (decay_rate * new_lv[t, k]
+                           + (1.0 - decay_rate) * opt).astype(np.float32)
+                # empty leaves keep their trained value
+                new_lv[t, k] = np.where(Hs > 0, blended, new_lv[t, k])
+                scores = scores + jnp.asarray(new_lv[t].T, jnp.float32)[li]
+            else:
+                Gs = np.bincount(li, weights=g, minlength=n_leaf)
+                Hs = np.bincount(li, weights=h, minlength=n_leaf)
+                opt = np.where(Hs > 0,
+                               -Gs / (Hs + lam) * learning_rate, 0.0)
+                blended = (decay_rate * new_lv[t]
+                           + (1.0 - decay_rate) * opt).astype(np.float32)
+                # empty leaves keep their trained value (no evidence to move)
+                blended = np.where(Hs > 0, blended, new_lv[t])
+                new_lv[t] = blended
+                scores = scores + jnp.asarray(blended, jnp.float32)[li]
         out = Booster(self.depth, self.n_features, self.objective,
                       self.base_score, self.num_class,
                       self.feats.copy(), self.thr_raw.copy(), new_lv,

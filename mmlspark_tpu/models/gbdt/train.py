@@ -315,8 +315,6 @@ def train(params: Dict,
     if linear_tree:
         # LightGBM linear_tree restrictions apply here too: leaf models
         # regress on raw numerical features only
-        if is_multi:
-            raise NotImplementedError("linear_tree with multiclass")
         if sparse_X:
             raise ValueError("linear_tree needs dense input (the leaf "
                              "models regress on raw feature values)")
@@ -710,8 +708,16 @@ def train(params: Dict,
 
     def _pred_stack(feats_a, thr_a, leaf_a, Xq, coefs_a=None, pf_a=None):
         """Tree-stack prediction, constant or linear leaves."""
-        from .trees import predict_trees_any, predict_trees_linear_any
+        from .trees import (predict_trees_any, predict_trees_linear_any,
+                            predict_trees_linear_multi_any)
         if linear_tree:
+            if is_multi:
+                # class-major tree order (t % K) holds for every stack
+                # this sees: full prefixes, one-iteration groups, dart's
+                # whole-group drops
+                return predict_trees_linear_multi_any(
+                    feats_a, thr_a, coefs_a, pf_a, Xq, depth=depth,
+                    num_class=num_class)
             return predict_trees_linear_any(feats_a, thr_a, coefs_a, pf_a,
                                             Xq, depth=depth)
         return predict_trees_any(feats_a, thr_a, leaf_a, Xq, depth=depth)
@@ -976,31 +982,59 @@ def train(params: Dict,
         it_key = jax.random.fold_in(base_key, resumed_iters + it)
         new_coefs = new_pf = None
         if is_multi:
+            g_mk = g_d * mask_g
+            h_mk = h_d * mask_g
+
             def build_k(gk, hk, kk):
                 return build(xb_d, gk, hk, live_it, fmask, kk)
             feats_k, thr_k, leaf_k, node_k, gains_k, covers_k = jax.vmap(
                 build_k, in_axes=(1, 1, 0))(
-                    g_d * mask_g, h_d * mask_g,
-                    jax.random.split(it_key, num_class))
+                    g_mk, h_mk, jax.random.split(it_key, num_class))
             feats_np = np.asarray(feats_k)      # (K, n_int)
             thr_raw_k = np.stack([
                 _thr_bins_to_raw(feats_np[k], np.asarray(thr_k)[k], mapper,
                                  int(n_bins)) for k in range(num_class)])
+            if linear_tree:
+                # per-class linear leaves: each class's tree fits its own
+                # leaf ridge models on that class's gradients; trees stay
+                # class-major so t % K routes predictions (trees.py
+                # predict_trees_linear_multi_any)
+                from .trees import path_features
+                pf_k = np.stack([path_features(feats_np[k], depth)
+                                 for k in range(num_class)])
+                coefs_list, contrib_cols = [], []
+                for k in range(num_class):
+                    beta, contrib = lin_fit(X_lin, node_k[k], g_mk[:, k],
+                                            h_mk[:, k], live_it,
+                                            jnp.asarray(pf_k[k]))
+                    coefs_list.append(
+                        np.asarray(beta, np.float32) * np.float32(lr_eff))
+                    contrib_cols.append(contrib)
+                coefs_k = np.stack(coefs_list)       # (K, n_leaf, D+1)
+                # per-class leaf value view: the coefs' bias (constant
+                # fallback) for linear leaves
+                vals_k = coefs_k[:, :, -1]
+                scores = scores + jnp.stack(contrib_cols, axis=1) * lr_eff
+                new_coefs = coefs_k
+                new_pf = pf_k
+            else:
+                vals_k = np.asarray(leaf_k) * lr_eff
+                # score update via leaf assignment, on device
+                upd = jax.vmap(jnp.take)(leaf_k, node_k).T * lr_eff
+                scores = scores + upd
             for k in range(num_class):
                 lv = np.zeros((num_class, 2 ** depth), dtype=np.float32)
-                lv[k] = np.asarray(leaf_k)[k] * lr_eff
-                booster.append_tree(feats_np[k], thr_raw_k[k], lv,
-                                    np.asarray(gains_k)[k],
-                                    np.asarray(covers_k)[k])
-            # score update via leaf assignment, on device
-            upd = jax.vmap(jnp.take)(leaf_k, node_k).T * lr_eff
-            scores = scores + upd
+                lv[k] = vals_k[k]
+                booster.append_tree(
+                    feats_np[k], thr_raw_k[k], lv,
+                    np.asarray(gains_k)[k], np.asarray(covers_k)[k],
+                    **(dict(coefs=coefs_k[k], pf=pf_k[k])
+                       if linear_tree else {}))
             new_feats = feats_np
             new_thr = thr_raw_k
             new_leaf = np.stack([
                 np.eye(num_class, dtype=np.float32)[k][:, None]
-                * (np.asarray(leaf_k)[k] * lr_eff)[None, :]
-                for k in range(num_class)])
+                * np.asarray(vals_k[k])[None, :] for k in range(num_class)])
         else:
             g_m = g_d * gh_w
             h_m = h_d * gh_w

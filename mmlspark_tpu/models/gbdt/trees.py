@@ -33,7 +33,8 @@ import numpy as np
 
 __all__ = ["TreeArrays", "BundleTables", "build_tree", "predict_trees",
            "predict_leaf_indices", "path_features", "fit_linear_leaves",
-           "predict_trees_linear", "predict_trees_linear_any"]
+           "predict_trees_linear", "predict_trees_linear_any",
+           "predict_trees_linear_multi_any"]
 
 
 class BundleTables(NamedTuple):
@@ -695,6 +696,25 @@ def predict_trees_linear_any(feats, thr_raw, coefs, pf, X, depth: int,
         lambda xd: predict_trees_linear(feats, thr_raw, coefs, pf, xd,
                                         depth=depth),
         X, empty_shape=(0,), chunk=chunk)
+
+
+def predict_trees_linear_multi_any(feats, thr_raw, coefs, pf, X,
+                                   depth: int, num_class: int,
+                                   chunk: int = 1 << 16) -> np.ndarray:
+    """Multiclass linear-tree prediction, dense OR scipy-sparse X: each
+    tree's linear-leaf output lands in that tree's class column. Trees
+    append class-major within every boosting iteration (train.py
+    multiclass loop), so tree t belongs to class ``t % num_class`` — an
+    invariant every caller's slice preserves (full prefixes,
+    one-iteration groups, and dart's whole-group drops all keep the
+    class-major period). Delegates per class to ``predict_trees_linear``
+    over the ``k::K`` stride, so the descent/NaN routing lives in ONE
+    place. Returns (n, num_class)."""
+    cols = [predict_trees_linear_any(
+        feats[k::num_class], thr_raw[k::num_class], coefs[k::num_class],
+        pf[k::num_class], X, depth=depth, chunk=chunk)
+        for k in range(num_class)]
+    return np.stack(cols, axis=1)
 
 
 def apply_chunked_dense(fn, X, empty_shape, chunk: int = 1 << 16,

@@ -318,6 +318,33 @@ class TestRefit:
         with pytest.raises(ValueError, match="decay_rate"):
             b.refit(X, y, decay_rate=1.5)
 
+    def test_multiclass_decay_one_is_identity(self):
+        rng = np.random.default_rng(34)
+        X = rng.normal(0, 1, (400, 4))
+        y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        b = train({"objective": "multiclass", "num_class": 3,
+                   "num_iterations": 10, "num_leaves": 7,
+                   "min_data_in_leaf": 5}, X, y)
+        r = b.refit(X, y, decay_rate=1.0)
+        np.testing.assert_allclose(r.predict(X), b.predict(X), rtol=1e-6)
+        np.testing.assert_array_equal(r.feats, b.feats)
+
+    def test_multiclass_adapts_to_relabeled_classes(self):
+        # cyclic label permutation: structures must survive, per-class leaf
+        # values must re-estimate (LightGBM Booster.refit on multiclass)
+        rng = np.random.default_rng(35)
+        X = rng.normal(0, 1, (600, 4))
+        y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        b = train({"objective": "multiclass", "num_class": 3,
+                   "num_iterations": 15, "num_leaves": 7,
+                   "min_data_in_leaf": 5, "learning_rate": 0.2}, X, y)
+        y_new = (y + 1) % 3
+        r = b.refit(X, y_new, decay_rate=0.0)
+        acc_before = (np.argmax(b.predict(X), -1) == y_new).mean()
+        acc_after = (np.argmax(r.predict(X), -1) == y_new).mean()
+        assert acc_after > 0.8 > acc_before, (acc_before, acc_after)
+        np.testing.assert_array_equal(r.thr_raw, b.thr_raw)
+
 
 class TestImbalanceAndInitScore:
     """LightGBM scale_pos_weight / is_unbalance / init_score parity."""

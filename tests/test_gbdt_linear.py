@@ -152,9 +152,6 @@ class TestLinearBooster:
         with pytest.raises(ValueError, match="numerical"):
             train(dict(BASE, linear_tree=True, categorical_feature=[0]),
                   X, y)
-        with pytest.raises(NotImplementedError):
-            train(dict(BASE, objective="multiclass", num_class=3,
-                       linear_tree=True), X, (y > 0).astype(int) + 1)
         # leaf-level regularizers with no linear counterpart are rejected,
         # not silently ignored
         with pytest.raises(ValueError, match="monotone"):
@@ -180,3 +177,67 @@ class TestLinearMeshParity:
                    mesh=mesh)
         np.testing.assert_allclose(serial.predict(X), dp.predict(X),
                                    rtol=2e-3, atol=2e-4)
+
+
+def _piecewise_linear_multi(n=1500, seed=7):
+    """3-class argmax of linear score functions: linear leaves can model
+    the within-region slopes constant leaves must staircase."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    z = np.stack([2.0 * X[:, 1] + 1.0,
+                  -1.5 * X[:, 2],
+                  X[:, 3] - X[:, 1]], axis=1)
+    y = np.argmax(z + 0.05 * rng.normal(size=z.shape), axis=1)
+    return X, y
+
+
+class TestMulticlassLinear:
+    """linear_tree + multiclass (LightGBM supports the combination): one
+    structure per class per iteration, per-class leaf ridge models, tree
+    t routed to class t % K at prediction."""
+
+    PARAMS = dict(BASE, objective="multiclass", num_class=3,
+                  num_iterations=20, linear_tree=True)
+
+    def test_trains_and_predicts(self):
+        X, y = _piecewise_linear_multi()
+        b = train(self.PARAMS, X, y)
+        p = b.predict(X)
+        assert p.shape == (len(X), 3)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+        acc = (np.argmax(p, axis=1) == y).mean()
+        assert acc > 0.85, acc
+        assert b.is_linear and b.num_class == 3
+
+    def test_beats_constant_leaves_on_linear_signal(self):
+        X, y = _piecewise_linear_multi()
+        lin = train(self.PARAMS, X, y)
+        const = train(dict(self.PARAMS, linear_tree=False), X, y)
+        acc_lin = (np.argmax(lin.predict(X), 1) == y).mean()
+        acc_const = (np.argmax(const.predict(X), 1) == y).mean()
+        assert acc_lin >= acc_const - 0.02, (acc_lin, acc_const)
+
+    def test_save_load_roundtrip(self):
+        X, y = _piecewise_linear_multi(n=400)
+        b = train(dict(self.PARAMS, num_iterations=6), X, y)
+        r = Booster.from_string(b.to_string())
+        np.testing.assert_allclose(r.predict(X), b.predict(X), rtol=1e-6)
+        assert r.is_linear and r.num_class == 3
+
+    def test_num_iteration_cap_counts_iterations(self):
+        X, y = _piecewise_linear_multi(n=400)
+        b = train(dict(self.PARAMS, num_iterations=8), X, y)
+        # 8 iterations x 3 classes = 24 trees; cap at 2 iterations = 6 trees
+        assert b.num_trees == 24
+        p2 = b.predict(X, num_iteration=2)
+        assert p2.shape == (len(X), 3)
+        assert np.abs(p2 - b.predict(X)).max() > 0
+
+    def test_early_stopping_valid_path(self):
+        X, y = _piecewise_linear_multi(n=900)
+        b = train(dict(self.PARAMS, num_iterations=40,
+                       early_stopping_round=5),
+                  X[:600], y[:600], valid_sets=[(X[600:], y[600:])])
+        p = b.predict(X[600:])
+        acc = (np.argmax(p, 1) == y[600:]).mean()
+        assert acc > 0.8, acc
