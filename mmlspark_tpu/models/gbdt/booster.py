@@ -347,38 +347,31 @@ class Booster:
                 f"refit needs analytic gradients for {self.objective!r}")
         grad_fn = jax.jit(obj.grad_hess)
         yd, wd = jnp.asarray(y), jnp.asarray(w)
+        # one code path for both arities: view leaf values as (T, K, L)
+        # (contiguous copy, so the reshape is a writable view) and
+        # gradients as (n, K); binary is the K == 1 degenerate case
+        lvv = new_lv.reshape(self.num_trees, K, n_leaf)
         for t in range(self.num_trees):
             g, h = grad_fn(scores, yd, wd)
-            g = np.asarray(g, dtype=np.float64)    # (n,) or (n, K)
-            h = np.asarray(h, dtype=np.float64)
+            g = np.asarray(g, dtype=np.float64).reshape(len(y), K)
+            h = np.asarray(h, dtype=np.float64).reshape(len(y), K)
             li = leaves[:, t]
-            if K > 1:
-                # tree t was trained for class t % K only (class-major
-                # append order, the same invariant prediction routes by);
-                # re-estimating the other class rows would blend toward
-                # zeros that were never trained estimates and give every
-                # tree K times its trained per-class influence
-                k = t % K
-                Gs = np.bincount(li, weights=g[:, k], minlength=n_leaf)
-                Hs = np.bincount(li, weights=h[:, k], minlength=n_leaf)
-                opt = np.where(Hs > 0,
-                               -Gs / (Hs + lam) * learning_rate, 0.0)
-                blended = (decay_rate * new_lv[t, k]
-                           + (1.0 - decay_rate) * opt).astype(np.float32)
-                # empty leaves keep their trained value
-                new_lv[t, k] = np.where(Hs > 0, blended, new_lv[t, k])
-                scores = scores + jnp.asarray(new_lv[t].T, jnp.float32)[li]
-            else:
-                Gs = np.bincount(li, weights=g, minlength=n_leaf)
-                Hs = np.bincount(li, weights=h, minlength=n_leaf)
-                opt = np.where(Hs > 0,
-                               -Gs / (Hs + lam) * learning_rate, 0.0)
-                blended = (decay_rate * new_lv[t]
-                           + (1.0 - decay_rate) * opt).astype(np.float32)
-                # empty leaves keep their trained value (no evidence to move)
-                blended = np.where(Hs > 0, blended, new_lv[t])
-                new_lv[t] = blended
-                scores = scores + jnp.asarray(blended, jnp.float32)[li]
+            # tree t was trained for class t % K only (class-major append
+            # order, the same invariant prediction routes by);
+            # re-estimating the other class rows would blend toward zeros
+            # that were never trained estimates and give every tree K
+            # times its trained per-class influence
+            k = t % K
+            Gs = np.bincount(li, weights=g[:, k], minlength=n_leaf)
+            Hs = np.bincount(li, weights=h[:, k], minlength=n_leaf)
+            opt = np.where(Hs > 0,
+                           -Gs / (Hs + lam) * learning_rate, 0.0)
+            blended = (decay_rate * lvv[t, k]
+                       + (1.0 - decay_rate) * opt).astype(np.float32)
+            # empty leaves keep their trained value (no evidence to move)
+            lvv[t, k] = np.where(Hs > 0, blended, lvv[t, k])
+            upd = jnp.asarray(lvv[t].T, jnp.float32)[li]   # (n, K)
+            scores = scores + (upd if K > 1 else upd[:, 0])
         out = Booster(self.depth, self.n_features, self.objective,
                       self.base_score, self.num_class,
                       self.feats.copy(), self.thr_raw.copy(), new_lv,

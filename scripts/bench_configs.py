@@ -250,7 +250,8 @@ def bench_shap():
                       "device_resident_rows_per_sec":
                           round(dev_score / m_samples, 2),
                       "device_resident_rows_per_sec_fused":
-                          round(dev_score_fused / m_samples, 2),
+                          (round(dev_score_fused / m_samples, 2)
+                           if dev_score_fused is not None else None),
                       "samples_per_row": m_samples,
                       "platform": _platform()}), flush=True)
 

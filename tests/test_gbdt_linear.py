@@ -241,3 +241,28 @@ class TestMulticlassLinear:
         p = b.predict(X[600:])
         acc = (np.argmax(p, 1) == y[600:]).mean()
         assert acc > 0.8, acc
+
+
+def test_estimator_multiclass_linear_pipeline():
+    # the user-facing path: LightGBMClassifier auto-detects 3 classes and
+    # composes linear_tree through fit/transform/save/load
+    import tempfile
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+
+    X, y = _piecewise_linear_multi(n=600)
+    col = np.empty(len(X), object)
+    for i, r in enumerate(X):
+        col[i] = r
+    df = DataFrame({"features": col, "label": y.astype(np.float64)})
+    m = LightGBMClassifier(num_iterations=10, num_leaves=7,
+                           learning_rate=0.2, linear_tree=True).fit(df)
+    assert m.booster.is_linear and m.booster.num_class == 3
+    pred = np.asarray(m.transform(df)["prediction"])
+    assert (pred == y).mean() > 0.85
+    with tempfile.TemporaryDirectory() as d:
+        m.save(d + "/m")
+        from mmlspark_tpu.core import PipelineStage
+        r = PipelineStage.load(d + "/m")
+        np.testing.assert_array_equal(
+            np.asarray(r.transform(df)["prediction"]), pred)
