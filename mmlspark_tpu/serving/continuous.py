@@ -617,6 +617,21 @@ class ContinuousDecoder:
             return self._step_locked()
 
     def _step_locked(self) -> int:
+        # adaptive drain under saturation: when requests are queued and
+        # every slot is occupied, the only way a slot frees is through a
+        # drained block's retirement — running `depth` ahead would keep
+        # finished slots occupied k·depth more steps and starve admission
+        # (the r5 sweep's depth-is-monotone-harmful-at-k=8 mechanism).
+        # Drain the MINIMUM outstanding blocks needed to free a slot; an
+        # unsaturated pool keeps full pipelining.
+        with self._lock:
+            backlog = bool(self._waiting)
+        if backlog:
+            while (self._pending
+                   and all(self._slot_req[i] is not None
+                           for i in range(self._S))
+                   and self._retirement_in_flight()):
+                self._drain_one()
         self._admit()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
         if not live:
@@ -647,6 +662,20 @@ class ContinuousDecoder:
         while len(self._pending) > self._depth:
             self._drain_one()
         return len(live)
+
+    def _retirement_in_flight(self) -> bool:
+        """True iff some occupied slot's request could finish inside the
+        outstanding blocks (host-visible tokens plus k per in-flight
+        block) — draining when nothing can retire would serialize host
+        and device for the whole saturated mid-generation window. With
+        eos enabled any block may end a request early, so be
+        conservative and allow the drain."""
+        if self._eos is not None:
+            return True
+        horizon = self._k * len(self._pending)
+        return any(req is not None
+                   and req.max_new - len(req.tokens) <= horizon
+                   for req in self._slot_req)
 
     def _drain_one(self):
         """Fetch + process the oldest outstanding (k, S) token block.
