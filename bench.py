@@ -244,6 +244,7 @@ def main():
             "platform": "tpu" if on_tpu else "cpu",
             "platform_raw": platform, "device": device_kind,
             "mfu": None, "device_resident_ips": None, "device_mfu": None,
+            "device_resident_ips_fused": None, "device_mfu_fused": None,
             "h2d_gbps": None, "backend_probe": probe_info,
             "midrun_error":
                 f"warmup failed: {type(e).__name__}: {e}"[:300]}))
