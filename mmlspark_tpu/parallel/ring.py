@@ -30,7 +30,76 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ring_attention", "ulysses_attention", "wrap_ring_attention",
-           "local_attention"]
+           "local_attention", "attention_transient_bytes",
+           "plan_attention_impl"]
+
+
+def attention_transient_bytes(impl: str, direction: str, B: int, H: int,
+                              S: int, sp: int = 1) -> int:
+    """Dominant per-chip transient footprint (bytes) of an attention impl.
+
+    The O(S²) score buffers — not the O(S·D) operands — decide whether a
+    long-context config compiles at all, so this is the planning number.
+    The model is calibrated against the r4/r5 on-chip campaigns, where it
+    predicts every success/failure at 4k/16k/64k on a 16 GB v5e:
+
+    * ``full`` fwd keeps ONE live f32 (B, H, S, S) score buffer (XLA fuses
+      the softmax into the PV matmul); XLA-autodiff bwd keeps ~3 (saved
+      probabilities + dS + the recompute).
+    * ``ring`` (dense hops) materializes per-hop (S/sp, S/sp) scores in
+      BOTH directions — the custom-VJP forward recompute re-runs the dense
+      forward ring (:func:`_ring_vjp_fwd`), while the backward itself is
+      blockwise O(S·block).
+    * ``ulysses`` is ``full`` with H/sp heads over the full S.
+    * ``flash`` / ``ring_flash`` stream: O(S·block) — returned as 0, they
+      never hit the quadratic wall.
+
+    ``direction`` is ``"fwd"`` or ``"bwd"``. The head dim does not appear:
+    the O(S·D) operand/output buffers are negligible next to the scores at
+    every planning-relevant scale.
+    """
+    if impl in ("flash", "ring_flash"):
+        return 0
+    bwd_factor = 1 if direction == "fwd" else 3
+    if impl == "full":
+        return 4 * B * H * S * S * bwd_factor
+    if impl == "ring":
+        s_local = S // sp
+        return 4 * B * H * s_local * s_local  # vjp-fwd recompute dominates
+    if impl == "ulysses":
+        return 4 * B * max(H // sp, 1) * S * S * bwd_factor
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def plan_attention_impl(impl: str, direction: str, B: int, H: int, S: int,
+                        sp: int = 1,
+                        hbm_bytes: Optional[float] = None) -> dict:
+    """Feasibility verdict for an attention impl on a given chip budget.
+
+    Returns ``{"feasible": bool, "transient_bytes": int, "min_sp": ...}``.
+    ``min_sp`` is the smallest sequence-parallel degree at which the impl
+    fits (None when no sp helps: ``full`` never shards, and ulysses' bwd
+    keeps full-S buffers once H/sp bottoms out). Infeasible configs fail
+    at COMPILE time (XLA buffer assignment), which a remote-compile tunnel
+    surfaces as an opaque HTTP 500 — callers should consult this planner
+    first and route to flash/ring_flash instead.
+    """
+    if hbm_bytes is None:
+        hbm_bytes = 16e9  # TPU v5e
+    need = attention_transient_bytes(impl, direction, B, H, S, sp)
+    feasible = need <= hbm_bytes
+    min_sp = None
+    if not feasible:
+        for cand in (2, 4, 8, 16, 32, 64, 128):
+            if impl == "ring" and S % cand:
+                continue
+            if impl == "ulysses" and H % cand:
+                continue  # all_to_all splits the head axis exactly
+            if attention_transient_bytes(
+                    impl, direction, B, H, S, cand) <= hbm_bytes:
+                min_sp = cand
+                break
+    return {"feasible": feasible, "transient_bytes": need, "min_sp": min_sp}
 
 
 def local_attention(q, k, v, scale: Optional[float] = None):

@@ -37,6 +37,7 @@ def main():
 
     from mmlspark_tpu.ops.flash_attention import flash_attention
     from mmlspark_tpu.parallel.ring import (local_attention,
+                                            plan_attention_impl,
                                             wrap_ring_attention)
 
     sp = 4 if SMALL else min(4, len(jax.devices()))
@@ -54,6 +55,38 @@ def main():
         # an unvalidated name would silently fall through to the ulysses
         # branch and publish a mislabeled timing
         raise SystemExit(f"unknown BENCH_IMPLS {sorted(unknown)}")
+
+    # HBM budget for the feasibility gate (0 disables). The O(S²) legs at
+    # 16k-bwd/64k fail at COMPILE time on one chip — the r4/r5 campaigns
+    # recorded those as opaque remote-compile HTTP 500s and re-paid the
+    # doomed multi-minute compile every window. The planner (calibrated
+    # against exactly those campaign outcomes) now classifies them up
+    # front; the row says WHY and what would fit instead.
+    if os.environ.get("BENCH_HBM_BYTES"):
+        hbm = float(os.environ["BENCH_HBM_BYTES"])
+    elif SMALL:
+        hbm = 0.0
+    else:
+        try:  # the real per-device budget when the runtime exposes it
+            hbm = float(jax.devices()[0].memory_stats()["bytes_limit"])
+        except Exception:
+            hbm = 16e9  # TPU v5e; axon tunnels often hide memory_stats
+
+    def infeasible_verdict(impl, direction, S, sp):
+        # hbm == 0 in SMALL mode unless BENCH_HBM_BYTES is set explicitly
+        # (the explicit knob always wins — it is how the gate is driven
+        # and CPU-tested without a chip)
+        if not hbm:
+            return None
+        plan = plan_attention_impl(impl, direction, B, H, S,
+                                   sp=sp, hbm_bytes=hbm)
+        if plan["feasible"]:
+            return None
+        gb = plan["transient_bytes"] / 1e9
+        fix = (f"feasible at sp>={plan['min_sp']}" if plan["min_sp"]
+               else "no sp helps")
+        return (f"infeasible: ~{gb:.3g} GB f32 scores > {hbm/1e9:.3g} GB "
+                f"HBM at sp={sp} ({fix}; O(S) impls: flash/ring_flash)")
 
     def impl_fn_args(impl, q, k, v):
         """(fn, device args) per impl — ONE dispatch shared by the forward
@@ -77,6 +110,11 @@ def main():
         results = {}
         full_out = None
         for impl in impls:
+            verdict = infeasible_verdict(impl, "fwd", S,
+                                         int(mesh.shape["sp"]))
+            if verdict:
+                results[impl] = verdict
+                continue
             try:
                 base_fn, args = impl_fn_args(impl, q, k, v)
                 fn = jax.jit(base_fn)
@@ -126,6 +164,11 @@ def main():
             continue
         bwd, full_grads = {}, None
         for impl in impls:
+            verdict = infeasible_verdict(impl, "bwd", S,
+                                         int(mesh.shape["sp"]))
+            if verdict:
+                bwd[impl] = verdict
+                continue
             try:
                 # the sequence-parallel impls train too (ring-level VJP)
                 base, args = impl_fn_args(impl, q, k, v)

@@ -430,3 +430,49 @@ def test_generate_oversized_top_k_is_noop(rng):
     big_k = np.asarray(generate(params, prompt, cfg, max_new_tokens=5,
                                 temperature=1.0, top_k=10_000, seed=2))
     np.testing.assert_array_equal(plain, big_k)
+
+
+class TestAttentionMemoryPlanner:
+    """plan_attention_impl is calibrated against the r4/r5 on-chip
+    campaigns: every feasibility verdict below matches an observed
+    success (timed row) or failure (compile-time abort surfaced as a
+    remote-compile 500) at B=1, H=12, D=64 on a 16 GB v5e."""
+
+    HBM = 16e9
+
+    def plan(self, impl, direction, S, sp=1):
+        from mmlspark_tpu.parallel.ring import plan_attention_impl
+        return plan_attention_impl(impl, direction, 1, 12, S,
+                                   sp=sp, hbm_bytes=self.HBM)
+
+    def test_observed_successes(self):
+        # (impl, direction, S) legs that produced timed campaign rows
+        for impl, direction, S in [
+                ("full", "fwd", 4096), ("full", "bwd", 4096),
+                ("full", "fwd", 16384),
+                ("ring", "fwd", 16384), ("ring", "bwd", 16384),
+                ("ulysses", "fwd", 16384),
+                ("flash", "bwd", 65536), ("ring_flash", "bwd", 65536)]:
+            assert self.plan(impl, direction, S)["feasible"], \
+                (impl, direction, S)
+
+    def test_observed_compile_failures(self):
+        for impl, direction, S in [
+                ("full", "bwd", 16384), ("ulysses", "bwd", 16384),
+                ("full", "fwd", 65536), ("ring", "fwd", 65536),
+                ("ulysses", "fwd", 65536), ("full", "bwd", 65536),
+                ("ring", "bwd", 65536), ("ulysses", "bwd", 65536)]:
+            assert not self.plan(impl, direction, S)["feasible"], \
+                (impl, direction, S)
+
+    def test_ring_min_sp_at_64k(self):
+        # a 4-chip ring makes the dense 64k hops fit (12.9 GB/chip)
+        assert self.plan("ring", "fwd", 65536)["min_sp"] == 4
+
+    def test_full_never_shards(self):
+        assert self.plan("full", "fwd", 65536)["min_sp"] is None
+
+    def test_flash_is_linear_memory(self):
+        from mmlspark_tpu.parallel.ring import attention_transient_bytes
+        assert attention_transient_bytes(
+            "ring_flash", "bwd", 1, 12, 1 << 20) == 0
