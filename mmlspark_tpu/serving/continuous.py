@@ -18,7 +18,12 @@ decoding leaves the chip >90% idle at batch 1. The standard fix
   incrementally;
 * host-side bookkeeping only touches (slots,) vectors per tick — the
   device→host traffic per emitted token is a few hundred bytes, which is
-  what the tunnel-dominated profile (BASELINE.md) wants.
+  what the tunnel-dominated profile (BASELINE.md) wants;
+* **prefill-ahead** (``prefill_ahead=N``) — while every slot is occupied,
+  waiting prompts prefill in the background and park their KV rows on
+  device, so a retiring wave re-fills with one insert dispatch instead of
+  paying prefill + a first-token round-trip on the admission critical
+  path (first tokens ride the drain pipeline like decode blocks).
 
 No paging: a zoo-scale engine favors the dense static cache (paged KV adds
 a gather per step and matters once max_len × slots outgrows HBM, which a
@@ -114,7 +119,8 @@ class ContinuousDecoder:
                  mesh: Optional[Mesh] = None,
                  prefix_cache_size: int = 8,
                  steps_per_dispatch: int = 1,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 prefill_ahead: int = 0):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -153,9 +159,23 @@ class ContinuousDecoder:
         if pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 0")
         self._depth = int(pipeline_depth)
-        #: (device token block, {slot: request at dispatch time}) per
-        #: outstanding tick, oldest first
+        #: (device token block (rows, cols), {col: (slot, request)} at
+        #: dispatch time) per outstanding dispatch, oldest first. Tick
+        #: blocks are (k, S) with col == slot; admission first-token
+        #: blocks are (1, g) with col == position-in-group.
         self._pending: List[tuple] = []
+        #: prefill-ahead staging budget in ROWS (0 disables). While every
+        #: slot is occupied, waiting prompts prefill in the background and
+        #: their (logits, KV rows) park on device, so a retiring wave
+        #: re-fills with ONE insert dispatch instead of paying the
+        #: prefill on the admission critical path. Each staged row holds a
+        #: full (heads, max_len, head_dim) KV row per layer — budget is
+        #: HBM, spend deliberately.
+        if prefill_ahead < 0:
+            raise ValueError("prefill_ahead must be >= 0")
+        self._stage_cap = int(prefill_ahead)
+        #: staged units: [requests, logits, row_cache, next-offset]
+        self._staged: List[list] = []
         params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
         shape = (self._S, cfg.heads, self._L, hd)
@@ -417,6 +437,29 @@ class ContinuousDecoder:
         individual path (their suffix windows and store bookkeeping are
         per-request)."""
         while True:
+            # staged units first (their prefill already ran in the
+            # background): insertion is one dispatch + one queued fetch
+            staged_any = False
+            while self._staged:
+                with self._lock:
+                    free = [i for i in range(self._S)
+                            if self._slot_req[i] is None]
+                    if not free:
+                        break
+                    unit = self._staged[0]
+                    reqs, logits, rows, off = unit
+                    m = min(len(free), len(reqs) - off)
+                    group = [(free[i], reqs[off + i]) for i in range(m)]
+                    for slot, req in group:
+                        self._slot_req[slot] = req
+                self._insert_rows(
+                    group, logits[off:off + m],
+                    [{kk: c[kk][off:off + m] for kk in ("k", "v")}
+                     for c in rows])
+                unit[3] += m
+                if unit[3] >= len(unit[0]):
+                    self._staged.pop(0)
+                staged_any = True
             with self._lock:
                 free = [i for i in range(self._S)
                         if self._slot_req[i] is None]
@@ -427,6 +470,8 @@ class ContinuousDecoder:
                     self._slot_req[slot] = req
                     batch.append((slot, req))
             if not batch:
+                if staged_any:
+                    continue  # insertions may have freed slots (max_new=1)
                 return
             plain = [(s, r) for s, r in batch if r.prefix_key is None]
             prefixed = [(s, r) for s, r in batch
@@ -437,16 +482,9 @@ class ContinuousDecoder:
             for s, r in plain:
                 by_bucket.setdefault(self._bucket(r.prompt.size),
                                      []).append((s, r))
-            for padded, group in by_bucket.items():
-                k = 1 << (len(group) - 1).bit_length()   # row pad: 2^m
-                ids = np.zeros((k, padded), np.int32)
-                lengths = np.ones(k, np.int32)           # pad rows: len 1
-                for i, (_, r) in enumerate(group):
-                    ids[i, :r.prompt.size] = r.prompt
-                    lengths[i] = r.prompt.size
-                logits, row_cache = self._prefill(
-                    self._params, jnp.asarray(ids), jnp.asarray(lengths))
-                self.stats["prefills"] += 1
+            for group in by_bucket.values():
+                logits, row_cache = self._prefill_group(
+                    [r for _, r in group])
                 self._insert_rows(group, logits, row_cache)
 
             for slot, req in prefixed:
@@ -469,6 +507,75 @@ class ContinuousDecoder:
                 self._insert_rows([(slot, req)], logits, row_cache)
             # loop: slots may have freed (eos/max_new on the first token)
             # while waiters remain — constant stack, unlike recursion
+
+    def _prefill_group(self, reqs):
+        """ONE batched prefill over same-bucket requests: zero-padded ids,
+        power-of-two row pad, pad rows length 1 — THE policy for both
+        admitted and staged prefills (the compiled-program-count cap,
+        log2(S)+1 per bucket, depends on the two paths staying
+        identical). Returns (logits, row_cache); rows past ``len(reqs)``
+        are pad garbage."""
+        padded = self._bucket(max(r.prompt.size for r in reqs))
+        k = 1 << (len(reqs) - 1).bit_length()
+        ids = np.zeros((k, padded), np.int32)
+        lengths = np.ones(k, np.int32)
+        for i, r in enumerate(reqs):
+            ids[i, :r.prompt.size] = r.prompt
+            lengths[i] = r.prompt.size
+        logits, row_cache = self._prefill(
+            self._params, jnp.asarray(ids), jnp.asarray(lengths))
+        self.stats["prefills"] += 1
+        return logits, row_cache
+
+    @staticmethod
+    def _padded_rows(n: int) -> int:
+        """Device rows a staged n-request unit actually holds (the row
+        pad), which is what the ``prefill_ahead`` budget must charge."""
+        return 1 << (n - 1).bit_length()
+
+    def _stage_prefills(self):
+        """Prefill-ahead: run waiting prompts' prefills while every slot
+        is still occupied, parking (logits, KV rows) on device for
+        :meth:`_admit` to insert the moment slots retire.
+
+        Takes only the LEADING run of plain same-bucket requests —
+        prefix-cache requests keep their per-request suffix path, and a
+        bucket change ends the take (cross-bucket grouping would admit a
+        later-bucket request before an earlier one across waves; the next
+        bucket stages on a later tick, so FIFO holds). The budget charges
+        the unit's PADDED row count for its whole lifetime — that is the
+        HBM a unit holds until it fully drains. No host sync happens
+        here; first tokens are computed and fetched at insertion."""
+        with self._lock:
+            budget = self._stage_cap - sum(
+                self._padded_rows(len(u[0])) for u in self._staged)
+            take = []
+            bucket = None
+            while self._waiting and self._waiting[0].prefix_key is None:
+                b = self._bucket(self._waiting[0].prompt.size)
+                if bucket is None:
+                    bucket = b
+                elif b != bucket:
+                    break
+                if self._padded_rows(len(take) + 1) > budget:
+                    break
+                take.append(self._waiting.pop(0))
+        if not take:
+            return
+        try:
+            logits, row_cache = self._prefill_group(take)
+        except BaseException:
+            # a failed background prefill must not strand its requests in
+            # limbo (neither _waiting nor _staged nor a slot —
+            # unreachable by cancel_all, waiters hang forever): restore
+            # them at the FRONT, order intact, then let the error reach
+            # the driver loop's recovery path like any device error
+            with self._lock:
+                self._waiting[:0] = take
+            raise
+        self.stats["staged_prefills"] = (
+            self.stats.get("staged_prefills", 0) + 1)
+        self._staged.append([take, logits, row_cache, 0])
 
     def _insert_rows(self, group, logits, row_cache):
         """Slot insertion + first-token emission for an admitted group.
@@ -512,12 +619,17 @@ class ContinuousDecoder:
             self._active, self._remaining, firsts, lens_v, rems_v,
             sample_state, (temps_v, topks_v, topps_v, keys_v))
         self._temp, self._topk, self._topp, self._key = sample_state
-        firsts = np.asarray(firsts)              # the group's ONE fetch
-        for i, (slot, req) in enumerate(group):
-            # the prefill itself emitted the first new token
-            self._note_token(req, int(firsts[i]))
-            if req.done:
-                self._release(slot)
+        # the first tokens ride the drain pipeline as a (1, g) block
+        # instead of a synchronous fetch here (~RTT on the admission
+        # critical path). Queued BEFORE any subsequent tick block, so
+        # drain order replays emission order exactly; an idle engine
+        # (nothing else outstanding) drains immediately — same latency
+        # as the old synchronous fetch.
+        self._pending.append((firsts.reshape(1, -1),
+                              {i: (slot, req)
+                               for i, (slot, req) in enumerate(group)}))
+        if len(self._pending) == 1:
+            self._drain_one()
 
     def _bucket(self, n: int, cap: Optional[int] = None) -> int:
         """THE pad-bucket policy (batched admission, prefix suffix
@@ -625,7 +737,10 @@ class ContinuousDecoder:
         # Drain the MINIMUM outstanding blocks needed to free a slot; an
         # unsaturated pool keeps full pipelining.
         with self._lock:
-            backlog = bool(self._waiting)
+            # staged units are backlog too: once the whole queue is
+            # staged, _waiting is empty but retiring slots still need the
+            # eager drain to admit the parked replacements promptly
+            backlog = bool(self._waiting or self._staged)
         if backlog:
             while (self._pending
                    and all(self._slot_req[i] is not None
@@ -656,7 +771,12 @@ class ContinuousDecoder:
         # drained, a slot may have been freed and re-admitted; tokens must
         # go to the request that occupied the slot at DISPATCH time (its
         # done guard discards the inactive-slot repeats)
-        self._pending.append((toks, {i: self._slot_req[i] for i in live}))
+        self._pending.append((toks, {i: (i, self._slot_req[i])
+                                     for i in live}))
+        # prefill-ahead: with the decode block dispatched (device busy for
+        # k steps), background-prefill waiting prompts into the stage
+        if self._stage_cap:
+            self._stage_prefills()
         # the ONLY host↔device sync on the decode path: fetch the oldest
         # block once `depth` newer dispatches are already queued on device
         while len(self._pending) > self._depth:
@@ -685,13 +805,13 @@ class ContinuousDecoder:
         toks_dev, snapshot = self._pending.pop(0)
         toks = np.asarray(toks_dev)
         for s in range(toks.shape[0]):
-            for i, req in snapshot.items():
+            for col, (_, req) in snapshot.items():
                 if req.done:
                     continue
-                self._note_token(req, int(toks[s, i]))
-        for i, req in snapshot.items():
-            if req.done and self._slot_req[i] is req:
-                self._release(i)
+                self._note_token(req, int(toks[s, col]))
+        for _, (slot, req) in snapshot.items():
+            if req.done and self._slot_req[slot] is req:
+                self._release(slot)
 
     def flush(self):
         """Drain every outstanding dispatch (bounded: the pending queue
@@ -720,6 +840,11 @@ class ContinuousDecoder:
             with self._lock:
                 waiting, self._waiting = self._waiting, []
             cancelled = list(waiting)
+            # staged requests left _waiting but never reached a slot;
+            # their parked device buffers are dropped with the units
+            for unit in self._staged:
+                cancelled.extend(unit[0][unit[3]:])
+            self._staged.clear()
             # outstanding blocks may reference donated/deleted buffers
             # after a failed tick — drop them; cancel semantics already
             # promise only "whatever was emitted before the cancel"

@@ -56,11 +56,12 @@ class GenerationEngine:
                  reply_timeout: float = 120.0,
                  transport: str = "threaded",
                  steps_per_dispatch: int = 1,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 prefill_ahead: int = 0):
         self.decoder = ContinuousDecoder(
             params, cfg, max_slots=max_slots, max_len=max_len,
             eos_id=eos_id, steps_per_dispatch=steps_per_dispatch,
-            pipeline_depth=pipeline_depth)
+            pipeline_depth=pipeline_depth, prefill_ahead=prefill_ahead)
         self.default_max_new = int(default_max_new)
         self.server = WorkerServer(host, port, api_path,
                                    reply_timeout=reply_timeout,

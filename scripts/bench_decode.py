@@ -36,7 +36,13 @@ def _env_int(name, default):
 
 
 def main():
+    if SMALL:
+        # CPU smoke must not request the axon plugin (absent whenever
+        # PYTHONPATH overrides the site dir — see bench_long_context)
+        os.environ.pop("JAX_PLATFORMS", None)
     import jax
+    if SMALL:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from mmlspark_tpu.models.zoo.transformer import (
@@ -140,9 +146,14 @@ def main():
     # ordering is within-window noise — d=2 kept as the engine default.
     k_steps = _env_int("BENCH_CB_STEPS", 16)
     cb_depth = _env_int("BENCH_CB_DEPTH", 2)
+    # prefill-ahead: stage the next wave's prefills while the pool is
+    # full, so wave boundaries pay one insert dispatch instead of
+    # prefill + a first-token round-trip (default: one full wave)
+    cb_ahead = _env_int("BENCH_CB_AHEAD", B)
     eng = ContinuousDecoder(params, cfg, max_slots=B, max_len=P + T + 1,
                             steps_per_dispatch=k_steps,
-                            pipeline_depth=cb_depth)
+                            pipeline_depth=cb_depth,
+                            prefill_ahead=cb_ahead)
     rng2 = np.random.default_rng(1)
     # warm the steady-state program set: a full-pool burst compiles the
     # max-size prefill bucket, the power-of-two insert chunks, and the
@@ -166,6 +177,8 @@ def main():
         "value": round(total_toks / dt, 1), "unit": "tokens/sec/chip",
         "slots": B, "requests": n_req, "prompt_len": P, "new_tokens": T,
         "steps_per_dispatch": k_steps, "pipeline_depth": cb_depth,
+        "prefill_ahead": cb_ahead,
+        "staged_prefills": eng.stats.get("staged_prefills", 0),
         "ttft_p50_ms": round(1e3 * sorted(ttft)[len(ttft) // 2], 1),
         "ttft_max_ms": round(1e3 * max(ttft), 1),
         "platform": jax.default_backend()}), flush=True)

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Wait for a healthy chip, then run ONE command once. The single-stage
+# sibling of chip_campaign_loop.sh, with the same claim discipline
+# (BASELINE.md): one probe child at a time, nothing ever killed, a pause
+# between attempts. Use when a specific bench leg needs a healthy window
+# and a full campaign re-run would waste it.
+#
+# Usage: bash scripts/chip_stage_loop.sh <log> <max_attempts> cmd [args...]
+set -u
+LOG="${1:?log file}"; MAX="${2:?max attempts}"; shift 2
+cd "$(dirname "$0")/.."
+attempt=0
+while [ "$attempt" -lt "$MAX" ]; do
+    if pgrep -f 'import jax.*bench_probe_' > /dev/null 2>&1; then
+        echo "--- prior probe child still pending $(date -u) ---" >> "$LOG"
+        sleep "${CHIP_RETRY_SLEEP:-120}"
+        continue
+    fi
+    attempt=$((attempt + 1))
+    probe=$(python scripts/probe_chip.py 2>> "$LOG") || probe=error
+    echo "--- attempt $attempt/$MAX probe=$probe $(date -u) ---" >> "$LOG"
+    if [ "$probe" = "tpu" ]; then
+        echo "--- stage start: $* $(date -u) ---" >> "$LOG"
+        "$@"
+        rc=$?
+        echo "--- stage done rc=$rc $(date -u) ---" >> "$LOG"
+        exit "$rc"
+    fi
+    sleep "${CHIP_RETRY_SLEEP:-120}"
+done
+echo "--- gave up after $MAX attempts $(date -u) ---" >> "$LOG"
+exit 3

@@ -779,3 +779,178 @@ class TestPipelinedDispatch:
         # WITHOUT the eager drain every request would pay it:
         # ~len(prompts) * (depth + 1) steps ≈ 15 here
         assert n4 <= n0 + 4 + 1, (n4, n0)
+
+
+class TestPrefillAhead:
+    """``prefill_ahead=N``: waiting prompts prefill while every slot is
+    occupied and park on device; a retiring wave re-fills with one insert
+    dispatch and first tokens ride the drain pipeline. THE invariant:
+    outputs are identical to the unstaged engine for every request."""
+
+    def _run(self, params, ahead, prompts, maxnews, *, slots=2, k=3,
+             depth=2, eos=None, sampling=None):
+        eng = ContinuousDecoder(params, CFG, max_slots=slots, max_len=48,
+                                steps_per_dispatch=k, pipeline_depth=depth,
+                                eos_id=eos, prefill_ahead=ahead)
+        reqs = []
+        for i, (p, m) in enumerate(zip(prompts, maxnews)):
+            kw = dict(sampling or {})
+            if sampling:
+                kw["seed"] = i
+            reqs.append(eng.submit(p, max_new_tokens=m, **kw))
+        for _ in range(600):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        return [eng.result(r, timeout=5) for r in reqs], eng
+
+    def test_greedy_identical_with_and_without_staging(self, params):
+        rng = np.random.default_rng(31)
+        prompts = [rng.integers(0, CFG.vocab, int(rng.integers(3, 10)))
+                   for _ in range(7)]
+        maxnews = [5, 9, 2, 7, 4, 11, 6]
+        base, _ = self._run(params, 0, prompts, maxnews)
+        staged, eng = self._run(params, 6, prompts, maxnews)
+        assert staged == base
+        assert eng.stats.get("staged_prefills", 0) > 0  # path exercised
+        for p, m, got in zip(prompts, maxnews, base):
+            assert got == _reference_tokens(params, p, m)
+
+    def test_partial_unit_insertion_across_waves(self, params):
+        """A staged unit larger than the freed-slot count inserts across
+        several admissions (slots=2, 5 one-bucket prompts, budget 4)."""
+        rng = np.random.default_rng(32)
+        prompts = [rng.integers(0, CFG.vocab, 5) for _ in range(5)]
+        maxnews = [3, 3, 4, 4, 5]
+        staged, eng = self._run(params, 4, prompts, maxnews)
+        assert not eng._staged                      # fully consumed
+        for p, m, got in zip(prompts, maxnews, staged):
+            assert got == _reference_tokens(params, p, m)
+
+    def test_sampled_requests_identical_with_staging(self, params):
+        rng = np.random.default_rng(33)
+        prompts = [rng.integers(0, CFG.vocab, 6) for _ in range(5)]
+        maxnews = [6, 5, 7, 4, 6]
+        sampling = dict(temperature=0.9, top_k=8)
+        base, _ = self._run(params, 0, prompts, maxnews, sampling=sampling)
+        staged, _ = self._run(params, 5, prompts, maxnews,
+                              sampling=sampling)
+        assert staged == base
+
+    def test_eos_retirement_with_staging(self, params):
+        rng = np.random.default_rng(34)
+        prompts = [rng.integers(0, CFG.vocab, 4) for _ in range(4)]
+        full = [_reference_tokens(params, p, 10) for p in prompts]
+        eos = full[0][2]
+        base, _ = self._run(params, 0, prompts, [10] * 4, slots=1,
+                            eos=eos)
+        staged, _ = self._run(params, 4, prompts, [10] * 4, slots=1,
+                              eos=eos)
+        assert staged == base
+
+    def test_cancel_all_fails_staged_requests(self, params):
+        rng = np.random.default_rng(35)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                prefill_ahead=4)
+        reqs = [eng.submit(rng.integers(0, CFG.vocab, 4), 8)
+                for _ in range(4)]
+        eng.step()                      # admit one, stage the rest
+        assert eng._staged
+        cancelled = eng.cancel_all()
+        assert set(map(id, cancelled)) == set(map(id, reqs))
+        assert all(r.done for r in reqs)
+        assert not eng._staged
+
+    def test_prefix_requests_not_staged_and_fifo_holds(self, params):
+        """Staging stops at the first prefix-cache request so FIFO order
+        (and the per-request suffix path) is preserved; everything still
+        matches the reference."""
+        rng = np.random.default_rng(36)
+        pre = rng.integers(0, CFG.vocab, 6)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                prefill_ahead=4)
+        plain = [rng.integers(0, CFG.vocab, 4) for _ in range(2)]
+        r0 = eng.submit(plain[0], 4)
+        rp = eng.submit(pre, 4, prefix_key="sys")
+        r1 = eng.submit(plain[1], 4)
+        for _ in range(200):
+            if all(r.done for r in (r0, rp, r1)):
+                break
+            eng.step()
+        assert eng.result(r0) == _reference_tokens(params, plain[0], 4)
+        assert eng.result(rp) == _reference_tokens(params, pre, 4)
+        assert eng.result(r1) == _reference_tokens(params, plain[1], 4)
+
+    def test_negative_budget_rejected(self, params):
+        import pytest
+        with pytest.raises(ValueError, match="prefill_ahead"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              prefill_ahead=-1)
+
+    def test_mixed_bucket_fifo_order_preserved(self, params):
+        """Staging stops at a pad-bucket change, so a later-bucket prompt
+        can never be admitted before an earlier-submitted one (first-token
+        timestamps must follow submission order with slots=1)."""
+        rng = np.random.default_rng(37)
+        lengths = [5, 20, 5, 20]          # alternating pad buckets
+        prompts = [rng.integers(0, CFG.vocab, n) for n in lengths]
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                prefill_ahead=8)
+        reqs = [eng.submit(p, 4) for p in prompts]
+        for _ in range(400):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        stamps = [r.first_token_at for r in reqs]
+        assert stamps == sorted(stamps)
+        for p, r in zip(prompts, reqs):
+            assert eng.result(r) == _reference_tokens(params, p, 4)
+
+    def test_budget_charges_padded_rows(self, params):
+        """A staged unit holds its power-of-two padded row buffer until it
+        fully drains, so the budget charges padded rows: 5 same-bucket
+        prompts under prefill_ahead=5 stage 4 (padded 4 <= 5; a fifth
+        would repad to 8)."""
+        rng = np.random.default_rng(38)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                prefill_ahead=5)
+        reqs = [eng.submit(rng.integers(0, CFG.vocab, 5), 6)
+                for _ in range(6)]
+        eng.step()          # admit 1st; stage from the remaining 5
+        assert sum(len(u[0]) for u in eng._staged) == 4
+        for _ in range(400):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for r in reqs:
+            assert eng.result(r) == _reference_tokens(
+                params, np.asarray(r.prompt), 6)
+
+    def test_failed_staged_prefill_restores_waiting(self, params):
+        """A background prefill that raises must put its requests back at
+        the head of _waiting (order intact) so cancel_all can reach them
+        — not strand them outside every queue."""
+        rng = np.random.default_rng(39)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=48,
+                                prefill_ahead=4)
+        reqs = [eng.submit(rng.integers(0, CFG.vocab, 4), 6)
+                for _ in range(3)]
+        boom = RuntimeError("device fell over")
+        real = eng._prefill
+        calls = {"n": 0}
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:           # the background staging call
+                raise boom
+            return real(*a, **kw)
+
+        eng._prefill = flaky
+        import pytest
+        with pytest.raises(RuntimeError, match="fell over"):
+            eng.step()
+        waiting_ids = [r.rid for r in eng._waiting]
+        assert waiting_ids == [reqs[1].rid, reqs[2].rid]
+        cancelled = eng.cancel_all()
+        assert all(r.done for r in reqs)
+        assert {r.rid for r in cancelled} == {r.rid for r in reqs}
