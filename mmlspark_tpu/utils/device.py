@@ -20,7 +20,35 @@ import os
 from typing import Optional, Tuple
 
 __all__ = ["device_info", "is_tpu", "tpu_generation", "looks_tpu",
-           "generation_from_kind"]
+           "generation_from_kind", "force_cpu"]
+
+
+def force_cpu(virtual_devices: Optional[int] = None):
+    """Pin this process to the XLA CPU backend; returns the jax module.
+
+    THE one copy of the CPU-smoke workaround every bench/doctest script
+    needs (it used to live inline in six of them): under this image,
+    ``JAX_PLATFORMS=axon`` may be set while the axon plugin resolves via a
+    site dir that a ``PYTHONPATH`` override drops — first backend use then
+    hard-crashes; and forcing ``JAX_PLATFORMS=cpu`` via the environment
+    HANGS. So: pop the env var, then pin the platform through
+    ``jax.config``. Must be called before anything initializes a backend
+    (importing jax is fine; running a computation is not).
+
+    ``virtual_devices=N`` also requests an N-device virtual CPU topology
+    (``--xla_force_host_platform_device_count``) for mesh smoke tests —
+    honored only if no backend is live and the flag isn't already set.
+    """
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{int(virtual_devices)}").strip()
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return jax
 
 _CACHE: Optional[Tuple[str, str]] = None
 

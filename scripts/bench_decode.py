@@ -37,12 +37,10 @@ def _env_int(name, default):
 
 def main():
     if SMALL:
-        # CPU smoke must not request the axon plugin (absent whenever
-        # PYTHONPATH overrides the site dir — see bench_long_context)
-        os.environ.pop("JAX_PLATFORMS", None)
-    import jax
-    if SMALL:
-        jax.config.update("jax_platforms", "cpu")
+        from mmlspark_tpu.utils.device import force_cpu
+        jax = force_cpu()
+    else:
+        import jax
     import jax.numpy as jnp
 
     from mmlspark_tpu.models.zoo.transformer import (

@@ -24,14 +24,10 @@ SMALL = os.environ.get("BENCH_SCALE", "") == "small"
 
 def main():
     if SMALL:
-        os.environ.pop("JAX_PLATFORMS", None)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "--xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-    import jax
-    if SMALL:
-        jax.config.update("jax_platforms", "cpu")
+        from mmlspark_tpu.utils.device import force_cpu
+        jax = force_cpu(virtual_devices=8)
+    else:
+        import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

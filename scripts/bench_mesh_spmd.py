@@ -21,9 +21,15 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+SMALL = os.environ.get("BENCH_SCALE", "") == "small"
+
 
 def main():
-    import jax
+    if SMALL:
+        from mmlspark_tpu.utils.device import force_cpu
+        jax = force_cpu()
+    else:
+        import jax
 
     from mmlspark_tpu.core import DataFrame
     from mmlspark_tpu.models.onnx_model import ONNXModel
@@ -31,7 +37,7 @@ def main():
         export_resnet_onnx
     from mmlspark_tpu.parallel.mesh import MeshContext
 
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "16" if SMALL else "256"))
     rng = np.random.default_rng(0)
     cfg = ResNetConfig([2, 2, 2, 2], num_classes=200)
     model_bytes = export_resnet_onnx(cfg, seed=0)
@@ -76,13 +82,24 @@ def main():
         plain_runs.append(timed_ips(m_plain, contextlib.nullcontext()))
         mesh_runs.append(timed_ips(m_mesh, MeshContext({"data": -1})))
     plain_ips, mesh_ips = max(plain_runs), max(mesh_runs)
+    # the headline ratio uses per-mode MEDIANS: a single lucky link
+    # window on one mode's best makes a best-vs-best ratio read as mode
+    # overhead (the r5 campaign row's 0.653 was exactly that — medians of
+    # the same runs said 0.96); best-of values stay for continuity.
+    # statistics.median averages the middle pair — an upper-middle pick
+    # would degenerate back to best-of at BENCH_MESH_ROUNDS=2
+    from statistics import median
+    ratio_med = (round(median(mesh_runs) / median(plain_runs), 3)
+                 if median(plain_runs) else None)
 
     d = jax.devices()[0]
     print(json.dumps({
         "metric": "onnx_mesh_spmd_images_per_sec",
         "plain_ips": plain_ips,
         "mesh_ips": mesh_ips,
-        "ratio": round(mesh_ips / plain_ips, 3) if plain_ips else None,
+        "ratio": ratio_med,
+        "ratio_best_of": round(mesh_ips / plain_ips, 3)
+        if plain_ips else None,
         "plain_runs": plain_runs, "mesh_runs": mesh_runs,
         "n_devices": len(jax.devices()),
         "platform": d.platform, "device": d.device_kind}), flush=True)

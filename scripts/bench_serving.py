@@ -39,10 +39,8 @@ if not TPU_MODE:
     # serving latency is host-side by definition; without this the jitted
     # scorer lands on the session's tunneled TPU and every request pays a
     # ~70 ms RTT
-    os.environ.pop("JAX_PLATFORMS", None)
-    import jax  # noqa: E402
-
-    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.utils.device import force_cpu  # noqa: E402
+    force_cpu()
 
 
 def _post(url: str, body: bytes) -> bytes:

@@ -24,10 +24,9 @@ else:
                  f"to an installed wheel (found {spec and spec.origin})")
 
 # docs examples run on CPU: deterministic, fast, no TPU claim needed
-os.environ.pop("JAX_PLATFORMS", None)
-import jax  # noqa: E402
+from mmlspark_tpu.utils.device import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu()
 
 BLOCK_RE = re.compile(r"(<!--\s*no-test\s*-->\s*\n)?```python\n(.*?)```",
                       re.DOTALL)

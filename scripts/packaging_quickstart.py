@@ -10,10 +10,9 @@ in the one slice executable without pyspark.
 import os
 import sys
 
-os.environ.pop("JAX_PLATFORMS", None)
-import jax  # noqa: E402
+from mmlspark_tpu.utils.device import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu()
 
 import numpy as np  # noqa: E402
 
