@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
            "train_step", "param_shardings", "BERT_BASE", "BERT_MINI",
            "DECODER_MINI", "generate", "generate_cached",
-           "decode_step", "init_kv_cache"]
+           "decode_step", "init_kv_cache", "decode_window_ragged"]
 
 
 class TransformerConfig(NamedTuple):
@@ -583,6 +583,32 @@ def decode_window(params: Dict, tokens: jnp.ndarray, pos, cache,
     attend causally within the window and to everything cached before it —
     the verify primitive of speculative decoding, and a chunked-prefill
     building block.
+
+    Delegates to :func:`decode_window_ragged` with a uniform position
+    vector — one layer-loop implementation keeps the scalar and per-row
+    paths bit-identical (the decode_step / decode_step_ragged pattern;
+    the speculative-verify parity invariant depends on it).
+    """
+    B = tokens.shape[0]
+    pos = jnp.full((B,), pos, jnp.int32)
+    return decode_window_ragged(params, tokens, pos, cache, cfg)
+
+
+def decode_window_ragged(params: Dict, tokens: jnp.ndarray,
+                         pos: jnp.ndarray, cache, cfg: TransformerConfig,
+                         active: Optional[jnp.ndarray] = None):
+    """:func:`decode_window` with PER-ROW start positions — the verify
+    primitive for speculative decoding inside the continuous-batching slot
+    pool (``serving/continuous.py``): every slot scores its own gamma+1
+    proposal window at its own depth in ONE compiled forward.
+
+    ``tokens`` (B, W) int, ``pos`` (B,) int32 per-row window starts,
+    ``active`` (B,) bool (inactive rows keep their cache untouched,
+    logits are don't-care) → (logits (B, W, vocab), updated cache).
+    Row b's query at window index j sits at absolute position
+    ``pos[b] + j``, attends cached keys ``<= pos[b] + j``, and the
+    window's K/V land at ``pos[b]..pos[b]+W-1`` in that row's cache —
+    exactly :func:`decode_window` per row with a scalar start.
     """
     if cfg.moe_experts:
         raise ValueError("cached decoding does not support MoE layers")
@@ -590,17 +616,24 @@ def decode_window(params: Dict, tokens: jnp.ndarray, pos, cache,
     B, W = tokens.shape
     L = cache[0]["k"].shape[2]
     hd = cfg.d_model // cfg.heads
-    pos = jnp.asarray(pos, jnp.int32)
-    wpos = pos + jnp.arange(W, dtype=jnp.int32)                # (W,)
+    pos = pos.astype(jnp.int32)
+    wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)       # (B, W)
     h = params["embed"]["tok"].astype(dt)[tokens]              # (B, W, D)
     if cfg.position == "learned":
-        h = h + params["embed"]["pos"].astype(dt)[wpos][None]
+        h = h + params["embed"]["pos"].astype(dt)[wpos]
     if cfg.position == "rope":
-        cos, sin = _rope_tables(wpos, hd, cfg.rope_theta, dt)  # (W, hd/2)
-        cos, sin = cos[None, None], sin[None, None]            # (1,1,W,·)
-    # query at window row i sees keys at positions <= pos + i
-    key_ok = (jnp.arange(L)[None, :]
-              <= wpos[:, None])[None, None]                    # (1,1,W,L)
+        cos, sin = _rope_tables(wpos, hd, cfg.rope_theta, dt)  # (B, W, h/2)
+        cos, sin = cos[:, None], sin[:, None]                  # (B,1,W,·)
+    # row b, query j sees cached keys at positions <= pos[b] + j
+    key_ok = (jnp.arange(L)[None, None, :]
+              <= wpos[:, :, None])[:, None]                    # (B,1,W,L)
+    keep = None if active is None else active[:, None, None, None]
+
+    def scatter_row(buf, val, p):
+        # (H, L, hd) ← (H, W, hd) at key-position p; vmapped over rows
+        return jax.lax.dynamic_update_slice(buf, val, (0, p, 0))
+
+    row_scatter = jax.vmap(scatter_row)
     new_cache = []
     for lp, c in zip(params["layers"], cache):
         x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
@@ -614,10 +647,11 @@ def decode_window(params: Dict, tokens: jnp.ndarray, pos, cache,
         if cfg.position == "rope":
             q = _rot_half(q, cos, sin)
             k = _rot_half(k, cos, sin)
-        kc = jax.lax.dynamic_update_slice(c["k"], k.astype(dt),
-                                          (0, 0, pos, 0))
-        vc = jax.lax.dynamic_update_slice(c["v"], v.astype(dt),
-                                          (0, 0, pos, 0))
+        kc = row_scatter(c["k"], k.astype(dt), pos)
+        vc = row_scatter(c["v"], v.astype(dt), pos)
+        if keep is not None:
+            kc = jnp.where(keep, kc, c["k"])
+            vc = jnp.where(keep, vc, c["v"])
         new_cache.append({"k": kc, "v": vc})
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
                        preferred_element_type=jnp.float32) / np.sqrt(hd)

@@ -120,11 +120,34 @@ class ContinuousDecoder:
                  prefix_cache_size: int = 8,
                  steps_per_dispatch: int = 1,
                  pipeline_depth: int = 2,
-                 prefill_ahead: int = 0):
+                 prefill_ahead: int = 0,
+                 draft_params: Optional[Dict] = None,
+                 draft_cfg: Optional[TransformerConfig] = None,
+                 gamma: int = 4):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
             raise ValueError("ContinuousDecoder needs cfg.causal=True")
+        #: speculative mode: a draft model proposes gamma greedy tokens per
+        #: round PER SLOT; the target verifies all slots' windows in one
+        #: ragged forward and each slot advances by its own accepted
+        #: prefix + bonus — 1..gamma+1 tokens per round for ~one target
+        #: step's cost. Greedy outputs stay request-identical to the plain
+        #: engine (accepted tokens ARE the target's greedy choices).
+        self._spec = draft_params is not None
+        if self._spec:
+            if draft_cfg is None:
+                raise ValueError("draft_params without draft_cfg")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocabulary")
+            if not draft_cfg.causal or draft_cfg.moe_experts:
+                raise ValueError("draft must be causal and dense")
+        if gamma < 1:
+            # validated even without a draft: a stored bad value would
+            # otherwise only explode when a draft is added later
+            raise ValueError("gamma must be >= 1")
+        self._gamma = int(gamma)
+        self._d_cfg = draft_cfg
         if cfg.position == "learned" and max_len > cfg.max_len:
             # positions beyond the learned table would CLAMP (JAX gather
             # semantics) and silently diverge from generate_cached
@@ -178,7 +201,13 @@ class ContinuousDecoder:
         self._staged: List[list] = []
         params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
-        shape = (self._S, cfg.heads, self._L, hd)
+        # speculative headroom: a verify window optimistically WRITES all
+        # gamma+1 positions even when fewer remain before max_new; the
+        # pool rows carry gamma+1 spare positions so the tail write never
+        # clamps onto live entries. Prefill rows stay _L long — their
+        # missing tail is zeros the key mask never exposes.
+        self._Lc = self._L + (self._gamma + 1 if self._spec else 0)
+        shape = (self._S, cfg.heads, self._Lc, hd)
         if mesh is None:
             self._params = jax.device_put(params)
             cache_sharding = state_sharding = None
@@ -203,6 +232,15 @@ class ContinuousDecoder:
             self._params = jax.device_put(
                 params, shardings_for(params, mesh)
                 if head_axis else state_sharding)
+        if self._spec:
+            d_params = jax.tree.map(jnp.asarray, draft_params)
+            # the draft is small by construction: replicate it on a mesh
+            # rather than constraining its head count to tp
+            self._d_params = (jax.device_put(d_params) if mesh is None
+                              else jax.device_put(
+                                  d_params, NamedSharding(mesh, P())))
+            d_hd = draft_cfg.d_model // draft_cfg.heads
+            self._d_cache_shape = (self._S, draft_cfg.heads, self._Lc, d_hd)
 
         def _zeros(shape_, dtype, sharded=False, fill=None):
             z = (jnp.zeros(shape_, dtype) if fill is None
@@ -274,12 +312,104 @@ class ContinuousDecoder:
 
         self._tick = _make_tick(sample=False)
         self._tick_sampled = _make_tick(sample=True)
+        #: most tokens one dispatch can emit per slot (the retirement
+        #: horizon unit): k plain steps, or k rounds × (gamma+1) spec
+        self._max_per_dispatch = (self._k * (self._gamma + 1)
+                                  if self._spec else self._k)
+
+        # ---- the speculative tick: k draft→verify rounds in one scan ----
+        # Per round, the draft proposes gamma greedy tokens per slot
+        # (gamma+1 ragged steps — the extra step writes the last
+        # proposal's K/V so the draft cache is hole-free under full
+        # acceptance); the target scores every slot's (pending + drafts)
+        # window in ONE ragged forward; each slot accepts its own longest
+        # matching prefix plus the target's bonus token. Accepted tokens
+        # ARE the target's greedy choices, so outputs are
+        # request-identical to the plain greedy engine; a draft mismatch
+        # only shrinks acceptance. Rejected-tail cache entries are stale
+        # by position and overwritten before any accepted query can see
+        # them (the zoo speculative scheme, per-slot instead of
+        # batch-synchronized). Emission: a (k*(gamma+1), S) block where
+        # -1 marks unemitted lanes — the host drain skips negatives.
+        if self._spec:
+            d_cfg, gamma = self._d_cfg, self._gamma
+            from ..models.zoo.transformer import decode_window_ragged
+
+            def spec_tick(params, d_params, tok, pos, active, t_cache,
+                          d_cache, remaining):
+                idx = jnp.arange(gamma + 1)
+
+                def round_body(carry, _):
+                    tok, pos, active, t_cache, d_cache, remaining = carry
+
+                    def dstep(c, i):
+                        dc, t = c
+                        lg, dc = decode_step_ragged(d_params, t, pos + i,
+                                                    dc, d_cfg, active)
+                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                        return (dc, jnp.where(active, nxt, t)), nxt
+
+                    (d_cache, _), props = jax.lax.scan(
+                        dstep, (d_cache, tok), jnp.arange(gamma + 1))
+                    drafts = jnp.moveaxis(props[:gamma], 0, 1)  # (S, g)
+                    wtoks = jnp.concatenate([tok[:, None], drafts], 1)
+                    w_logits, t_cache = decode_window_ragged(
+                        params, wtoks, pos, t_cache, cfg, active)
+                    greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
+                    match = greedy[:, :gamma] == drafts
+                    k = jnp.sum(jnp.cumprod(match.astype(jnp.int32), -1),
+                                -1)                             # (S,)
+                    bonus = jnp.take_along_axis(greedy, k[:, None],
+                                                1)[:, 0]
+                    cand = jnp.where(
+                        idx[None] < k[:, None],
+                        jnp.concatenate([drafts, drafts[:, -1:]], 1),
+                        bonus[:, None])
+                    cnt = jnp.minimum(k + 1, remaining)
+                    if eos_const is not None:
+                        # truncate at the first emitted eos, inclusive —
+                        # the sequential-emission semantics exactly
+                        is_eos = ((cand == eos_const)
+                                  & (idx[None] < cnt[:, None]))
+                        cnt = jnp.where(jnp.any(is_eos, -1),
+                                        jnp.argmax(is_eos, -1) + 1, cnt)
+                    cnt = jnp.where(active, cnt, 0)
+                    emit = jnp.where(idx[None] < cnt[:, None], cand, -1)
+                    pos = pos + cnt
+                    remaining = remaining - cnt
+                    fin = remaining <= 0
+                    if eos_const is not None:
+                        fin = fin | jnp.any(emit == eos_const, -1)
+                    active = active & ~fin
+                    last = jnp.take_along_axis(
+                        cand, jnp.maximum(cnt - 1, 0)[:, None], 1)[:, 0]
+                    tok = jnp.where(cnt > 0, last, tok)
+                    return ((tok, pos, active, t_cache, d_cache,
+                             remaining), emit.T)
+
+                carry, emits = jax.lax.scan(
+                    round_body,
+                    (tok, pos, active, t_cache, d_cache, remaining),
+                    None, length=self._k)
+                return (*carry, emits.reshape(-1, emits.shape[-1]))
+
+            self._spec_tick = jax.jit(
+                spec_tick,
+                donate_argnums=(2, 3, 4, 5, 6, 7) if donate else ())
 
         # one compiled prefill per padded prompt bucket
         def _prefill(params, ids, length):
             return prefill_cache(params, ids, length, cfg, self._L)
 
         self._prefill = jax.jit(_prefill)
+        if self._spec:
+            # the draft pool prefills the same prompts (its cache must
+            # hold the prompt K/V before it can propose)
+            def _d_prefill(d_params, ids, length):
+                return prefill_cache(d_params, ids, length, self._d_cfg,
+                                     self._L)
+
+            self._d_prefill = jax.jit(_d_prefill)
 
         # prefix-cache suffix extension: continue a stored prefix cache
         # over the request's remaining tokens (one window forward). The
@@ -347,6 +477,11 @@ class ContinuousDecoder:
         self._cache = [{"k": self._zeros(shape, cfg.dtype, sharded=True),
                         "v": self._zeros(shape, cfg.dtype, sharded=True)}
                        for _ in range(cfg.layers)]
+        if self._spec:
+            dshape, dcfg = self._d_cache_shape, self._d_cfg
+            self._d_cache = [{"k": self._zeros(dshape, dcfg.dtype),
+                              "v": self._zeros(dshape, dcfg.dtype)}
+                             for _ in range(dcfg.layers)]
         self._tok = self._zeros((self._S,), jnp.int32)
         self._pos = self._zeros((self._S,), jnp.int32)
         self._active = self._zeros((self._S,), bool)
@@ -393,6 +528,16 @@ class ContinuousDecoder:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0 or temperature < 0.0:
             raise ValueError("top_k and temperature must be >= 0")
+        if self._spec and temperature > 0.0:
+            # exact sampled speculative decoding needs the
+            # rejection-sampling correction (same contract note as
+            # models/zoo/speculative.py) — refuse rather than emit a
+            # silently different distribution
+            raise ValueError("speculative engine is greedy-only; "
+                             "submit with temperature=0")
+        if self._spec and prefix_key is not None:
+            raise ValueError("speculative engine does not support "
+                             "prefix caching yet")
         if prefix_key is not None and not isinstance(prefix_key, str):
             # an unhashable key would TypeError inside the engine thread,
             # poisoning the batch instead of 400-ing this request
@@ -522,8 +667,13 @@ class ContinuousDecoder:
         for i, r in enumerate(reqs):
             ids[i, :r.prompt.size] = r.prompt
             lengths[i] = r.prompt.size
-        logits, row_cache = self._prefill(
-            self._params, jnp.asarray(ids), jnp.asarray(lengths))
+        ids_d, lengths_d = jnp.asarray(ids), jnp.asarray(lengths)
+        logits, row_cache = self._prefill(self._params, ids_d, lengths_d)
+        if self._spec:
+            # draft rows ride the same generic row-cache list; insertion
+            # zips them against self._cache + self._d_cache
+            _, d_rows = self._d_prefill(self._d_params, ids_d, lengths_d)
+            row_cache = list(row_cache) + list(d_rows)
         self.stats["prefills"] += 1
         return logits, row_cache
 
@@ -613,11 +763,21 @@ class ContinuousDecoder:
                                     keys_v, lens_v)
         rows = [{kk: c[kk][:g] for kk in ("k", "v")} for c in row_cache]
         sample_state = (self._temp, self._topk, self._topp, self._key)
-        (self._cache, self._tok, self._pos, self._active, self._remaining,
+        # in spec mode the row list carries target + draft rows; the
+        # insert zips them against the concatenated pools and the result
+        # splits back at the target layer count
+        pool = (self._cache + self._d_cache if self._spec
+                else self._cache)
+        (pool, self._tok, self._pos, self._active, self._remaining,
          sample_state) = self._insert_group_j(
-            self._cache, slots_v, rows, self._tok, self._pos,
+            pool, slots_v, rows, self._tok, self._pos,
             self._active, self._remaining, firsts, lens_v, rems_v,
             sample_state, (temps_v, topks_v, topps_v, keys_v))
+        if self._spec:
+            n_t = self._cfg.layers
+            self._cache, self._d_cache = pool[:n_t], pool[n_t:]
+        else:
+            self._cache = pool
         self._temp, self._topk, self._topp, self._key = sample_state
         # the first tokens ride the drain pipeline as a (1, g) block
         # instead of a synchronous fetch here (~RTT on the admission
@@ -756,7 +916,16 @@ class ContinuousDecoder:
                 self._drain_one()
                 return 1
             return 0
-        if any(self._slot_req[i].temperature > 0.0 for i in live):
+        if self._spec:
+            (self._tok, self._pos, self._active, self._cache,
+             self._d_cache, self._remaining, toks) = self._spec_tick(
+                self._params, self._d_params, self._tok, self._pos,
+                self._active, self._cache, self._d_cache,
+                self._remaining)
+            self.stats["spec_round_slots"] = (
+                self.stats.get("spec_round_slots", 0)
+                + self._k * len(live))
+        elif any(self._slot_req[i].temperature > 0.0 for i in live):
             (self._tok, self._pos, self._active, self._cache,
              self._remaining, toks) = self._tick_sampled(
                 self._params, self._tok, self._pos, self._active,
@@ -792,7 +961,7 @@ class ContinuousDecoder:
         conservative and allow the drain."""
         if self._eos is not None:
             return True
-        horizon = self._k * len(self._pending)
+        horizon = self._max_per_dispatch * len(self._pending)
         return any(req is not None
                    and req.max_new - len(req.tokens) <= horizon
                    for req in self._slot_req)
@@ -804,11 +973,20 @@ class ContinuousDecoder:
         replayed in order — no device mask needed."""
         toks_dev, snapshot = self._pending.pop(0)
         toks = np.asarray(toks_dev)
+        if self._spec and toks.shape[0] > 1:
+            # spec blocks mark unemitted lanes -1; count real emissions
+            # against dispatched round-slots for the acceptance stat
+            self.stats["spec_emitted"] = (
+                self.stats.get("spec_emitted", 0)
+                + int((toks >= 0).sum()))
         for s in range(toks.shape[0]):
             for col, (_, req) in snapshot.items():
                 if req.done:
                     continue
-                self._note_token(req, int(toks[s, col]))
+                tk = int(toks[s, col])
+                if tk < 0:
+                    continue        # spec lane beyond the accepted count
+                self._note_token(req, tk)
         for _, (slot, req) in snapshot.items():
             if req.done and self._slot_req[slot] is req:
                 self._release(slot)

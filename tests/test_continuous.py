@@ -16,8 +16,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from mmlspark_tpu.models.zoo.transformer import (
-    TransformerConfig, decode_step, decode_step_ragged, generate_cached,
-    init_kv_cache, init_transformer, prefill_cache)
+    TransformerConfig, decode_step, decode_step_ragged,
+    decode_window_ragged, generate_cached, init_kv_cache,
+    init_transformer, prefill_cache)
 from mmlspark_tpu.serving.continuous import ContinuousDecoder
 
 CFG = TransformerConfig(vocab=128, layers=2, d_model=64, heads=4, d_ff=128,
@@ -149,6 +150,84 @@ class TestPrefillCache:
         np.testing.assert_allclose(np.asarray(cache_a[0]["k"][:, :, :P]),
                                    np.asarray(cache_b[0]["k"][:, :, :P]),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestDecodeWindowRagged:
+    """decode_window_ragged == decode_window per row at that row's scalar
+    start == W sequential ragged steps — the speculative-verify soundness
+    core for the slot pool."""
+
+    @pytest.mark.parametrize("cfg_name", ["rope", "learned"])
+    def test_matches_per_row_scalar_window(self, cfg_name, params):
+        from mmlspark_tpu.models.zoo.transformer import decode_window
+        cfg = CFG if cfg_name == "rope" else CFG_LEARNED
+        p = params if cfg_name == "rope" else init_transformer(cfg, seed=0)
+        B, W, L = 3, 4, 32
+        starts = [5, 2, 9]
+        rng = np.random.default_rng(11)
+        # warm each row's cache to its own depth with its own history
+        cache = init_kv_cache(cfg, B, L)
+        for t in range(max(starts)):
+            tok = jnp.asarray(rng.integers(0, cfg.vocab, B))
+            stepped = jnp.asarray([t < s for s in starts])
+            _, cache = decode_step_ragged(
+                p, tok, jnp.full((B,), t, jnp.int32), cache, cfg, stepped)
+        wtoks = jnp.asarray(rng.integers(0, cfg.vocab, (B, W)))
+        got, got_cache = decode_window_ragged(
+            p, wtoks, jnp.asarray(starts, jnp.int32), cache, cfg)
+        for b in range(B):
+            row_cache = [{kk: c[kk][b:b + 1] for kk in ("k", "v")}
+                         for c in cache]
+            want, want_cache = decode_window(
+                p, wtoks[b:b + 1], starts[b], row_cache, cfg)
+            np.testing.assert_allclose(np.asarray(got[b]),
+                                       np.asarray(want[0]),
+                                       rtol=2e-4, atol=2e-4)
+            lo, hi = starts[b], starts[b] + W
+            np.testing.assert_allclose(
+                np.asarray(got_cache[0]["k"][b, :, lo:hi]),
+                np.asarray(want_cache[0]["k"][0, :, lo:hi]),
+                rtol=2e-4, atol=2e-4)
+
+    def test_matches_sequential_ragged_steps(self, params):
+        B, W, L = 2, 3, 32
+        starts = jnp.asarray([4, 7], jnp.int32)
+        rng = np.random.default_rng(12)
+        cache = init_kv_cache(CFG, B, L)
+        for t in range(7):
+            tok = jnp.asarray(rng.integers(0, CFG.vocab, B))
+            stepped = starts > t
+            _, cache = decode_step_ragged(
+                params, tok, jnp.full((B,), t, jnp.int32), cache, CFG,
+                stepped)
+        wtoks = jnp.asarray(rng.integers(0, CFG.vocab, (B, W)))
+        got, _ = decode_window_ragged(params, wtoks, starts, cache, CFG)
+        ref_cache = cache
+        for j in range(W):
+            want_j, ref_cache = decode_step_ragged(
+                params, wtoks[:, j], starts + j, ref_cache, CFG)
+            np.testing.assert_allclose(np.asarray(got[:, j]),
+                                       np.asarray(want_j),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_inactive_rows_keep_cache(self, params):
+        B, W, L = 2, 3, 32
+        starts = jnp.asarray([4, 6], jnp.int32)
+        rng = np.random.default_rng(13)
+        cache = init_kv_cache(CFG, B, L)
+        for t in range(6):
+            tok = jnp.asarray(rng.integers(0, CFG.vocab, B))
+            _, cache = decode_step_ragged(
+                params, tok, jnp.full((B,), t, jnp.int32), cache, CFG,
+                starts > t)
+        wtoks = jnp.asarray(rng.integers(0, CFG.vocab, (B, W)))
+        active = jnp.asarray([True, False])
+        _, got_cache = decode_window_ragged(params, wtoks, starts, cache,
+                                            CFG, active)
+        np.testing.assert_array_equal(np.asarray(got_cache[0]["k"][1]),
+                                      np.asarray(cache[0]["k"][1]))
+        assert not np.array_equal(np.asarray(got_cache[0]["k"][0]),
+                                  np.asarray(cache[0]["k"][0]))
 
 
 def _reference_tokens(params, prompt, max_new):
@@ -954,3 +1033,120 @@ class TestPrefillAhead:
         cancelled = eng.cancel_all()
         assert all(r.done for r in reqs)
         assert {r.rid for r in cancelled} == {r.rid for r in reqs}
+
+
+class TestSpeculativePool:
+    """Speculative decoding inside the slot pool: per-slot draft→verify
+    rounds. THE invariant: greedy outputs are request-identical to the
+    plain engine (accepted tokens are the target's own greedy choices) —
+    for a perfect draft, a garbage draft, and anything between; the draft
+    only changes throughput."""
+
+    D_CFG = TransformerConfig(vocab=128, layers=1, d_model=32, heads=2,
+                              d_ff=64, max_len=64, causal=True,
+                              norm="rmsnorm", position="rope",
+                              dtype=jnp.float32)
+
+    def _run(self, params, draft, prompts, maxnews, *, slots=2, k=2,
+             gamma=3, depth=2, ahead=0, eos=None, d_cfg=None):
+        eng = ContinuousDecoder(params, CFG, max_slots=slots, max_len=48,
+                                steps_per_dispatch=k, pipeline_depth=depth,
+                                prefill_ahead=ahead, eos_id=eos,
+                                draft_params=draft,
+                                draft_cfg=d_cfg or self.D_CFG,
+                                gamma=gamma)
+        reqs = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, maxnews)]
+        for _ in range(600):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        return [eng.result(r, timeout=5) for r in reqs], eng
+
+    def test_perfect_draft_identical_and_accepts(self, params):
+        """Draft == target: full acceptance, outputs still reference."""
+        rng = np.random.default_rng(41)
+        prompts = [rng.integers(0, CFG.vocab, int(rng.integers(3, 9)))
+                   for _ in range(5)]
+        maxnews = [7, 3, 9, 5, 8]
+        got, eng = self._run(params, params, prompts, maxnews,
+                             d_cfg=CFG)
+        for p, m, g in zip(prompts, maxnews, got):
+            assert g == _reference_tokens(params, p, m)
+        # perfect draft: every round advances gamma+1 per live slot
+        acc = (eng.stats["spec_emitted"]
+               / max(eng.stats["spec_round_slots"], 1))
+        assert acc > 1.5, eng.stats    # well beyond 1 token/round
+
+    def test_weak_draft_identical(self, params):
+        """A differently-initialized 1-layer draft: low acceptance, but
+        outputs must not change by a single token."""
+        rng = np.random.default_rng(42)
+        draft = init_transformer(self.D_CFG, seed=99)
+        prompts = [rng.integers(0, CFG.vocab, int(rng.integers(3, 10)))
+                   for _ in range(6)]
+        maxnews = [6, 2, 9, 4, 1, 7]
+        got, _ = self._run(params, draft, prompts, maxnews)
+        for p, m, g in zip(prompts, maxnews, got):
+            assert g == _reference_tokens(params, p, m)
+
+    def test_staggered_and_contended(self, params):
+        rng = np.random.default_rng(43)
+        draft = init_transformer(self.D_CFG, seed=7)
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                steps_per_dispatch=2, gamma=3,
+                                draft_params=draft, draft_cfg=self.D_CFG)
+        prompts = [rng.integers(0, CFG.vocab, n) for n in (3, 9, 5, 7)]
+        maxnews = [6, 4, 8, 5]
+        reqs = [eng.submit(prompts[0], maxnews[0])]
+        eng.step()
+        reqs += [eng.submit(p, m)
+                 for p, m in zip(prompts[1:], maxnews[1:])]
+        for _ in range(400):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for p, m, r in zip(prompts, maxnews, reqs):
+            assert eng.result(r, timeout=5) == _reference_tokens(
+                params, p, m)
+
+    def test_eos_truncates_inside_accepted_prefix(self, params):
+        rng = np.random.default_rng(44)
+        prompts = [rng.integers(0, CFG.vocab, 4) for _ in range(3)]
+        full = [_reference_tokens(params, p, 12) for p in prompts]
+        eos = full[0][2]
+        # perfect draft maximizes the chance the eos lands mid-window
+        got, _ = self._run(params, params, prompts, [12] * 3, slots=2,
+                           gamma=4, eos=eos, d_cfg=CFG)
+        for p, g in zip(prompts, got):
+            want = _reference_tokens(params, p, 12)
+            stop = want.index(eos) + 1 if eos in want else 12
+            assert g == want[:stop]
+
+    def test_prefill_ahead_composes(self, params):
+        rng = np.random.default_rng(45)
+        draft = init_transformer(self.D_CFG, seed=3)
+        prompts = [rng.integers(0, CFG.vocab, 5) for _ in range(6)]
+        maxnews = [5, 7, 4, 6, 8, 3]
+        base, _ = self._run(params, draft, prompts, maxnews)
+        staged, eng = self._run(params, draft, prompts, maxnews, ahead=4)
+        assert staged == base
+        assert eng.stats.get("staged_prefills", 0) > 0
+
+    def test_validation(self, params):
+        import pytest
+        draft = init_transformer(self.D_CFG, seed=1)
+        with pytest.raises(ValueError, match="draft_cfg"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              draft_params=draft)
+        bad = self.D_CFG._replace(vocab=64)
+        with pytest.raises(ValueError, match="vocab"):
+            ContinuousDecoder(params, CFG, max_slots=1, max_len=16,
+                              draft_params=init_transformer(bad, seed=1),
+                              draft_cfg=bad)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=32,
+                                draft_params=draft, draft_cfg=self.D_CFG)
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.5)
+        with pytest.raises(ValueError, match="prefix"):
+            eng.submit(np.asarray([1, 2, 3]), 4, prefix_key="sys")
