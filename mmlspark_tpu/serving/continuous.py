@@ -535,9 +535,6 @@ class ContinuousDecoder:
             # silently different distribution
             raise ValueError("speculative engine is greedy-only; "
                              "submit with temperature=0")
-        if self._spec and prefix_key is not None:
-            raise ValueError("speculative engine does not support "
-                             "prefix caching yet")
         if prefix_key is not None and not isinstance(prefix_key, str):
             # an unhashable key would TypeError inside the engine thread,
             # poisoning the batch instead of 400-ing this request
@@ -846,7 +843,8 @@ class ContinuousDecoder:
                      for k in ("k", "v")} for c in stored_cache]
             w_logits, row_cache = self._extend(
                 self._params, jnp.asarray(ids), jnp.int32(start), full)
-            return w_logits[:, S - 1], row_cache
+            return self._with_draft_rows(req, w_logits[:, S - 1],
+                                         row_cache)
         # full prefill; cap the pad bucket at max_len: a 40-token prompt
         # in a 48-len cache must not inflate to a 64-wide prefill
         ids = self._padded_ids(req.prompt, self._L)
@@ -864,7 +862,22 @@ class ContinuousDecoder:
                 self._prefix_store.pop(next(iter(self._prefix_store)))
             self._prefix_store[req.prefix_key] = (
                 req.prompt[:plen].copy(), snap, plen)
-        return logits, row_cache
+        return self._with_draft_rows(req, logits, row_cache)
+
+    def _with_draft_rows(self, req: _Request, logits, row_cache):
+        """Spec mode: append the draft's full-prompt prefill rows — ONE
+        enforcement point for the row-list convention (target layers then
+        draft layers) that ``_insert_chunk``'s pool zip expects. The
+        draft always re-prefills the whole prompt (a draft is cheap by
+        construction); the prefix store never holds draft rows — its
+        store-on-miss snapshot runs before this append."""
+        if not self._spec:
+            return logits, row_cache
+        ids = jnp.asarray(self._padded_ids(req.prompt, self._L))
+        _, d_rows = self._d_prefill(
+            self._d_params, ids,
+            jnp.asarray([req.prompt.size], np.int32))
+        return logits, list(row_cache) + list(d_rows)
 
     def _note_token(self, req: _Request, tok: int):
         now = time.perf_counter()

@@ -1148,5 +1148,27 @@ class TestSpeculativePool:
                                 draft_params=draft, draft_cfg=self.D_CFG)
         with pytest.raises(ValueError, match="greedy-only"):
             eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.5)
-        with pytest.raises(ValueError, match="prefix"):
-            eng.submit(np.asarray([1, 2, 3]), 4, prefix_key="sys")
+
+    def test_prefix_caching_composes(self, params):
+        """Prefix-cache requests work in spec mode: the target reuses the
+        stored prefix (prefix_hits increments), the draft re-prefills the
+        whole prompt, outputs stay reference-exact."""
+        rng = np.random.default_rng(46)
+        draft = init_transformer(self.D_CFG, seed=5)
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                steps_per_dispatch=2, gamma=3,
+                                draft_params=draft, draft_cfg=self.D_CFG)
+        sys_prefix = rng.integers(0, CFG.vocab, 6)
+        prompts = [np.concatenate([sys_prefix,
+                                   rng.integers(0, CFG.vocab, 3)])
+                   for _ in range(3)]
+        reqs = [eng.submit(p, 5, prefix_key="sys", prefix_len=6)
+                for p in prompts]
+        for _ in range(300):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        for p, r in zip(prompts, reqs):
+            assert eng.result(r, timeout=5) == _reference_tokens(
+                params, p, 5)
+        assert eng.stats["prefix_hits"] == 2   # req 1 stores; 2 and 3 hit
