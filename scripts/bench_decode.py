@@ -306,17 +306,22 @@ def main():
         if os.environ.get("BENCH_CB_SPEC", "1") == "1":
             spec_k = _env_int("BENCH_CB_SPEC_STEPS", 4 if SMALL else 8)
             n_req2 = _env_int("BENCH_DECODE_REQS", 2 * B)
+            # one trained target + distilled draft serve every swept
+            # gamma — a per-gamma retrain would cost ~80 s of window each
+            gammas = [int(g) for g in os.environ.get(
+                "BENCH_CB_SPEC_GAMMAS", str(gamma)).split(",")
+                if g.strip()] or [gamma]
             prompts2 = [np.asarray(batch_fn(1000 + i)[0, :P], np.int32)
                         for i in range(n_req2)]
 
-            def run_cb(with_draft):
+            def run_cb(with_draft, g=gamma):
                 eng = ContinuousDecoder(
                     t_trained, cfg, max_slots=B, max_len=P + T + 1,
                     steps_per_dispatch=spec_k if with_draft else k_steps,
                     pipeline_depth=cb_depth, prefill_ahead=cb_ahead,
                     draft_params=dd_params if with_draft else None,
                     draft_cfg=dd_cfg if with_draft else None,
-                    gamma=gamma)
+                    gamma=g)
                 warm2 = [eng.submit(p, max_new_tokens=2)
                          for p in prompts2[:B]]
                 while not all(w.done for w in warm2):
@@ -331,24 +336,27 @@ def main():
                         [tuple(r.tokens) for r in reqs2], eng.stats)
 
             plain_tps, plain_out, _ = run_cb(False)
-            spec_tps, spec_out, st = run_cb(True)
-            assert spec_out == plain_out, \
-                "speculative pool diverged from the plain engine"
-            acc = (st.get("spec_emitted", 0)
-                   / max(st.get("spec_round_slots", 1), 1))
-            print(json.dumps({
-                "metric":
-                    "decoder_continuous_batching_spec_tokens_per_sec",
-                "value": round(spec_tps, 1), "unit": "tokens/sec/chip",
-                "plain_tokens_per_sec": round(plain_tps, 1),
-                "speedup": round(spec_tps / plain_tps, 2),
-                "outputs_match": spec_out == plain_out,
-                "slots": B, "requests": n_req2, "prompt_len": P,
-                "new_tokens": T, "gamma": gamma,
-                "rounds_per_dispatch": spec_k,
-                "tokens_per_round_slot": round(acc, 2),
-                "pipeline_depth": cb_depth, "prefill_ahead": cb_ahead,
-                "platform": jax.default_backend()}), flush=True)
+            for g in gammas:
+                spec_tps, spec_out, st = run_cb(True, g)
+                assert spec_out == plain_out, \
+                    "speculative pool diverged from the plain engine"
+                acc = (st.get("spec_emitted", 0)
+                       / max(st.get("spec_round_slots", 1), 1))
+                print(json.dumps({
+                    "metric":
+                        "decoder_continuous_batching_spec_tokens_per_sec",
+                    "value": round(spec_tps, 1),
+                    "unit": "tokens/sec/chip",
+                    "plain_tokens_per_sec": round(plain_tps, 1),
+                    "speedup": round(spec_tps / plain_tps, 2),
+                    "outputs_match": spec_out == plain_out,
+                    "slots": B, "requests": n_req2, "prompt_len": P,
+                    "new_tokens": T, "gamma": g,
+                    "rounds_per_dispatch": spec_k,
+                    "tokens_per_round_slot": round(acc, 2),
+                    "pipeline_depth": cb_depth,
+                    "prefill_ahead": cb_ahead,
+                    "platform": jax.default_backend()}), flush=True)
 
 
 if __name__ == "__main__":
