@@ -156,3 +156,120 @@ class TestSpeculative:
             generate_speculative(t_params, d_params,
                                  jnp.zeros((1, 2), jnp.int32),
                                  t_cfg, d_cfg)
+
+
+class TestSpeculativeSampled:
+    """Speculative SAMPLING (rejection-correction): the emitted sequence
+    must be exactly target-distributed. Verified against ANALYTIC
+    marginals — for the tiny vocab we can enumerate p(tok1) from the
+    prefill logits and p(tok2) = Σ_t1 p(t1)·p(t2|t1) exactly, then
+    check the empirical frequencies from thousands of independent rows.
+    Deterministic (fixed seed), so the tolerances are not flaky."""
+
+    TEMP = 1.3
+
+    def _setup(self, vocab=32):
+        t_cfg, d_cfg = cfg_pair(vocab=vocab)
+        t_params = init_transformer(t_cfg, seed=1)
+        d_params = init_transformer(d_cfg, seed=7)   # a DIFFERENT model
+        prompt = np.asarray([[3, 11, 4, 17]], np.int32)
+        return t_params, d_params, t_cfg, d_cfg, prompt
+
+    def _exact_marginals(self, t_params, t_cfg, prompt):
+        """(p(tok1), p(tok2)) by enumeration at temperature TEMP."""
+        V = t_cfg.vocab
+        P = prompt.shape[1]
+        L = P + 4
+        lengths = jnp.asarray([P], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt),
+                                      lengths, t_cfg, L)
+        p1 = np.asarray(jax.nn.softmax(
+            logits.astype(jnp.float32) / self.TEMP, -1))[0]      # (V,)
+        # p(tok2 | tok1=v): batch all V candidates through one step
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            P, cacheV, t_cfg)
+        p2_given = np.asarray(jax.nn.softmax(
+            l2.astype(jnp.float32) / self.TEMP, -1))             # (V, V)
+        return p1, p1 @ p2_given
+
+    def test_marginals_match_target_exactly(self):
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        N = 4096
+        prompts = np.repeat(prompt, N, axis=0)
+        ids, stats = generate_speculative_sampled(
+            t_params, d_params, prompts, t_cfg, d_cfg,
+            max_new_tokens=3, gamma=2, temperature=self.TEMP, seed=11)
+        toks = np.asarray(ids)[:, prompt.shape[1]:]              # (N, 3)
+        p1, p2 = self._exact_marginals(t_params, t_cfg, prompt)
+        V = t_cfg.vocab
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        # ~4 sigma for the largest bins at N=4096 is ~0.03
+        assert np.abs(emp1 - p1).max() < 0.035, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.035, np.abs(emp2 - p2).max()
+        # batch-min acceptance over 4096 independent rows is ~always 0,
+        # so the per-round advance stays 1 — but both emission branches
+        # (accepted-at-cut and rejected-resample) run per row inside;
+        # the marginal checks above are what verify them
+
+    def test_perfect_draft_high_acceptance_and_exact(self):
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, _, t_cfg, _, prompt = self._setup()
+        N = 2048
+        ids, stats = generate_speculative_sampled(
+            t_params, t_params, np.repeat(prompt, N, axis=0), t_cfg,
+            t_cfg, max_new_tokens=6, gamma=2, temperature=self.TEMP,
+            seed=3)
+        toks = np.asarray(ids)[:, prompt.shape[1]:]
+        p1, p2 = self._exact_marginals(t_params, t_cfg, prompt)
+        V = t_cfg.vocab
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.045
+        assert np.abs(emp2 - p2).max() < 0.045
+        # identical models: ratio = 1, acceptance ~always (batch-min over
+        # 2048 rows still accepts when every row does)
+        assert stats["accepted_drafts"] >= stats["rounds"]
+
+    def test_rows_are_independent_streams(self):
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        ids, _ = generate_speculative_sampled(
+            t_params, d_params, np.repeat(prompt, 64, axis=0), t_cfg,
+            d_cfg, max_new_tokens=4, gamma=2, temperature=self.TEMP,
+            seed=5)
+        toks = np.asarray(ids)[:, prompt.shape[1]:]
+        assert len({tuple(r) for r in toks}) > 16   # not all identical
+
+    def test_fresh_seeds_do_not_recompile(self):
+        """Per-request seeds/temperatures are traced args — the r4
+        verdict's per-call-recompile failure mode must not return."""
+        from mmlspark_tpu.models.zoo import speculative as spec_mod
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        kw = dict(max_new_tokens=2, gamma=2)
+        spec_mod.generate_speculative_sampled(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            temperature=0.9, seed=1, **kw)
+        before = spec_mod._speculative_sampled_impl._cache_size()
+        spec_mod.generate_speculative_sampled(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            temperature=1.1, seed=2, **kw)
+        assert spec_mod._speculative_sampled_impl._cache_size() == before
+
+    def test_validation(self):
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        with pytest.raises(ValueError, match="temperature"):
+            generate_speculative_sampled(t_params, d_params, prompt,
+                                         t_cfg, d_cfg, temperature=0.0)
+        with pytest.raises(ValueError, match="vocab"):
+            generate_speculative_sampled(
+                t_params, d_params, prompt, t_cfg,
+                d_cfg._replace(vocab=t_cfg.vocab + 1))
