@@ -30,6 +30,7 @@ a gather per step and matters once max_len × slots outgrows HBM, which a
 single-chip zoo model never approaches).
 """
 
+import functools
 import threading
 import time
 from typing import Dict, List, Optional
@@ -318,84 +319,170 @@ class ContinuousDecoder:
                                   if self._spec else self._k)
 
         # ---- the speculative tick: k draft→verify rounds in one scan ----
-        # Per round, the draft proposes gamma greedy tokens per slot
-        # (gamma+1 ragged steps — the extra step writes the last
-        # proposal's K/V so the draft cache is hole-free under full
-        # acceptance); the target scores every slot's (pending + drafts)
-        # window in ONE ragged forward; each slot accepts its own longest
-        # matching prefix plus the target's bonus token. Accepted tokens
-        # ARE the target's greedy choices, so outputs are
-        # request-identical to the plain greedy engine; a draft mismatch
-        # only shrinks acceptance. Rejected-tail cache entries are stale
-        # by position and overwritten before any accepted query can see
-        # them (the zoo speculative scheme, per-slot instead of
-        # batch-synchronized). Emission: a (k*(gamma+1), S) block where
-        # -1 marks unemitted lanes — the host drain skips negatives.
+        # Per round, the draft proposes gamma tokens per slot (gamma+1
+        # ragged steps — the extra step writes the last proposal's K/V so
+        # the draft cache is hole-free under full acceptance); the target
+        # scores every slot's (pending + drafts) window in ONE ragged
+        # forward; each slot accepts its own longest valid prefix plus a
+        # final token. Greedy slots: proposals are draft argmaxes,
+        # acceptance is target-argmax match, the final token is the
+        # target's greedy choice — outputs request-identical to the plain
+        # greedy engine. Sampled slots (sample=True tick): proposals are
+        # draft SAMPLES, token x accepted with prob min(1, p_t(x)/p_d(x)),
+        # a rejection resamples from the normalized residual
+        # max(p_t − p_d, 0) — the speculative-sampling correction, so the
+        # output DISTRIBUTION exactly equals sampling from the target
+        # (bit-identity to the plain sampled engine is impossible: the
+        # procedures consume randomness differently; the per-slot contract
+        # is distributional). Per-slot acceptance means no batch-min
+        # truncation, so the zoo impl's accepted-at-cut case cannot arise:
+        # k IS each slot's true rejection point, and a rejected token can
+        # never be re-emitted (its residual mass is zero). Randomness is
+        # keyed by (request key, absolute emit position, purpose) —
+        # discarded tail draws never influence emitted state, so replays
+        # are never of identical inputs. Rejected-tail cache entries are
+        # stale by position and overwritten before any valid query sees
+        # them. Emission: a (k*(gamma+1), S) block where -1 marks
+        # unemitted lanes — the host drain skips negatives.
         if self._spec:
             d_cfg, gamma = self._d_cfg, self._gamma
             from ..models.zoo.transformer import decode_window_ragged
 
-            def spec_tick(params, d_params, tok, pos, active, t_cache,
-                          d_cache, remaining):
-                idx = jnp.arange(gamma + 1)
+            def _make_spec_tick(sample: bool):
+                def spec_tick(params, d_params, tok, pos, active, t_cache,
+                              d_cache, remaining, temp=None, key=None):
+                    idx = jnp.arange(gamma + 1)
 
-                def round_body(carry, _):
-                    tok, pos, active, t_cache, d_cache, remaining = carry
+                    def keys_at(qpos, purpose):
+                        # (S,) keys at absolute emit positions qpos
+                        k1 = jax.vmap(jax.random.fold_in)(key, qpos)
+                        return jax.vmap(jax.random.fold_in, (0, None))(
+                            k1, purpose)
 
-                    def dstep(c, i):
-                        dc, t = c
-                        lg, dc = decode_step_ragged(d_params, t, pos + i,
-                                                    dc, d_cfg, active)
-                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-                        return (dc, jnp.where(active, nxt, t)), nxt
+                    def warm_logp(lg):
+                        # temp is (S,); lg is (S, V) or (S, W, V)
+                        t = jnp.maximum(temp, 1e-6).reshape(
+                            (lg.shape[0],) + (1,) * (lg.ndim - 1))
+                        return jax.nn.log_softmax(
+                            lg.astype(jnp.float32) / t, -1)
 
-                    (d_cache, _), props = jax.lax.scan(
-                        dstep, (d_cache, tok), jnp.arange(gamma + 1))
-                    drafts = jnp.moveaxis(props[:gamma], 0, 1)  # (S, g)
-                    wtoks = jnp.concatenate([tok[:, None], drafts], 1)
-                    w_logits, t_cache = decode_window_ragged(
-                        params, wtoks, pos, t_cache, cfg, active)
-                    greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
-                    match = greedy[:, :gamma] == drafts
-                    k = jnp.sum(jnp.cumprod(match.astype(jnp.int32), -1),
-                                -1)                             # (S,)
-                    bonus = jnp.take_along_axis(greedy, k[:, None],
-                                                1)[:, 0]
-                    cand = jnp.where(
-                        idx[None] < k[:, None],
-                        jnp.concatenate([drafts, drafts[:, -1:]], 1),
-                        bonus[:, None])
-                    cnt = jnp.minimum(k + 1, remaining)
-                    if eos_const is not None:
-                        # truncate at the first emitted eos, inclusive —
-                        # the sequential-emission semantics exactly
-                        is_eos = ((cand == eos_const)
-                                  & (idx[None] < cnt[:, None]))
-                        cnt = jnp.where(jnp.any(is_eos, -1),
-                                        jnp.argmax(is_eos, -1) + 1, cnt)
-                    cnt = jnp.where(active, cnt, 0)
-                    emit = jnp.where(idx[None] < cnt[:, None], cand, -1)
-                    pos = pos + cnt
-                    remaining = remaining - cnt
-                    fin = remaining <= 0
-                    if eos_const is not None:
-                        fin = fin | jnp.any(emit == eos_const, -1)
-                    active = active & ~fin
-                    last = jnp.take_along_axis(
-                        cand, jnp.maximum(cnt - 1, 0)[:, None], 1)[:, 0]
-                    tok = jnp.where(cnt > 0, last, tok)
-                    return ((tok, pos, active, t_cache, d_cache,
-                             remaining), emit.T)
+                    def round_body(carry, _):
+                        (tok, pos, active, t_cache, d_cache,
+                         remaining) = carry
 
-                carry, emits = jax.lax.scan(
-                    round_body,
-                    (tok, pos, active, t_cache, d_cache, remaining),
-                    None, length=self._k)
-                return (*carry, emits.reshape(-1, emits.shape[-1]))
+                        def dstep(c, i):
+                            dc, t = c
+                            lg, dc = decode_step_ragged(
+                                d_params, t, pos + i, dc, d_cfg, active)
+                            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                            if sample:
+                                logp = warm_logp(lg)        # (S, V)
+                                samp = jax.vmap(jax.random.categorical)(
+                                    keys_at(pos + i + 1, 1), logp)
+                                nxt = jnp.where(temp > 0.0,
+                                                samp.astype(jnp.int32),
+                                                nxt)
+                            else:
+                                logp = jnp.zeros((lg.shape[0], 1),
+                                                 jnp.float32)
+                            return ((dc, jnp.where(active, nxt, t)),
+                                    (nxt, logp))
 
-            self._spec_tick = jax.jit(
-                spec_tick,
-                donate_argnums=(2, 3, 4, 5, 6, 7) if donate else ())
+                        (d_cache, _), (props, d_logps) = jax.lax.scan(
+                            dstep, (d_cache, tok), jnp.arange(gamma + 1))
+                        drafts = jnp.moveaxis(props[:gamma], 0, 1)
+                        wtoks = jnp.concatenate([tok[:, None], drafts], 1)
+                        w_logits, t_cache = decode_window_ragged(
+                            params, wtoks, pos, t_cache, cfg, active)
+                        greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
+                        match = greedy[:, :gamma] == drafts
+                        if sample:
+                            t_logp = warm_logp(w_logits)    # (S, g+1, V)
+                            d_logp = jnp.moveaxis(d_logps[:gamma], 0, 1)
+                            lp_t = jnp.take_along_axis(
+                                t_logp[:, :gamma], drafts[..., None],
+                                -1)[..., 0]
+                            lp_d = jnp.take_along_axis(
+                                d_logp, drafts[..., None], -1)[..., 0]
+                            us = jnp.stack(
+                                [jax.vmap(jax.random.uniform)(
+                                    keys_at(pos + j + 1, 2))
+                                 for j in range(gamma)], axis=1)
+                            acc_s = (jnp.log(jnp.maximum(us, 1e-38))
+                                     < lp_t - lp_d)
+                            accepts = jnp.where(temp[:, None] > 0.0,
+                                                acc_s, match)
+                        else:
+                            accepts = match
+                        k = jnp.sum(jnp.cumprod(
+                            accepts.astype(jnp.int32), -1), -1)   # (S,)
+                        final = jnp.take_along_axis(greedy, k[:, None],
+                                                    1)[:, 0]
+                        if sample:
+                            p_t_k = jnp.take_along_axis(
+                                jnp.exp(t_logp),
+                                k[:, None, None].repeat(
+                                    t_logp.shape[-1], 2)[:, :1], 1)[:, 0]
+                            d_logp_pad = jnp.concatenate(
+                                [d_logp,
+                                 jnp.full((d_logp.shape[0], 1,
+                                           d_logp.shape[-1]),
+                                          -jnp.inf, jnp.float32)], 1)
+                            p_d_k = jnp.take_along_axis(
+                                jnp.exp(d_logp_pad),
+                                k[:, None, None].repeat(
+                                    d_logp.shape[-1], 2)[:, :1], 1)[:, 0]
+                            resid = jnp.maximum(p_t_k - p_d_k, 0.0)
+                            tot = jnp.sum(resid, -1, keepdims=True)
+                            resid = jnp.where(tot > 1e-30, resid / tot,
+                                              p_t_k)
+                            resampled = jax.vmap(jax.random.categorical)(
+                                keys_at(pos + k + 1, 3),
+                                jnp.log(jnp.maximum(resid, 1e-38)))
+                            final = jnp.where(temp > 0.0,
+                                              resampled.astype(jnp.int32),
+                                              final)
+                        pad_drafts = jnp.concatenate(
+                            [drafts, drafts[:, -1:]], 1)
+                        cand = jnp.where(idx[None] < k[:, None],
+                                         pad_drafts, final[:, None])
+                        cnt = jnp.minimum(k + 1, remaining)
+                        if eos_const is not None:
+                            # truncate at the first emitted eos,
+                            # inclusive — sequential-emission semantics
+                            is_eos = ((cand == eos_const)
+                                      & (idx[None] < cnt[:, None]))
+                            cnt = jnp.where(jnp.any(is_eos, -1),
+                                            jnp.argmax(is_eos, -1) + 1,
+                                            cnt)
+                        cnt = jnp.where(active, cnt, 0)
+                        emit = jnp.where(idx[None] < cnt[:, None],
+                                         cand, -1)
+                        pos = pos + cnt
+                        remaining = remaining - cnt
+                        fin = remaining <= 0
+                        if eos_const is not None:
+                            fin = fin | jnp.any(emit == eos_const, -1)
+                        active = active & ~fin
+                        last = jnp.take_along_axis(
+                            cand, jnp.maximum(cnt - 1, 0)[:, None],
+                            1)[:, 0]
+                        tok = jnp.where(cnt > 0, last, tok)
+                        return ((tok, pos, active, t_cache, d_cache,
+                                 remaining), emit.T)
+
+                    carry, emits = jax.lax.scan(
+                        round_body,
+                        (tok, pos, active, t_cache, d_cache, remaining),
+                        None, length=self._k)
+                    return (*carry, emits.reshape(-1, emits.shape[-1]))
+
+                return jax.jit(
+                    spec_tick,
+                    donate_argnums=(2, 3, 4, 5, 6, 7) if donate else ())
+
+            self._spec_tick = _make_spec_tick(sample=False)
+            self._spec_tick_sampled = _make_spec_tick(sample=True)
 
         # one compiled prefill per padded prompt bucket
         def _prefill(params, ids, length):
@@ -528,13 +615,13 @@ class ContinuousDecoder:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0 or temperature < 0.0:
             raise ValueError("top_k and temperature must be >= 0")
-        if self._spec and temperature > 0.0:
-            # exact sampled speculative decoding needs the
-            # rejection-sampling correction (same contract note as
-            # models/zoo/speculative.py) — refuse rather than emit a
-            # silently different distribution
-            raise ValueError("speculative engine is greedy-only; "
-                             "submit with temperature=0")
+        if self._spec and temperature > 0.0 and (top_k > 0 or top_p < 1.0):
+            # the rejection correction stays exact only if the SAME
+            # warping is applied to both distributions before the ratio
+            # test; top-k/top-p warping is not implemented there yet —
+            # refuse rather than emit a silently different distribution
+            raise ValueError("speculative sampling supports temperature "
+                             "only; submit with top_k=0, top_p=1")
         if prefix_key is not None and not isinstance(prefix_key, str):
             # an unhashable key would TypeError inside the engine thread,
             # poisoning the batch instead of 400-ing this request
@@ -930,8 +1017,13 @@ class ContinuousDecoder:
                 return 1
             return 0
         if self._spec:
+            if any(self._slot_req[i].temperature > 0.0 for i in live):
+                tick = functools.partial(self._spec_tick_sampled,
+                                         temp=self._temp, key=self._key)
+            else:
+                tick = self._spec_tick
             (self._tok, self._pos, self._active, self._cache,
-             self._d_cache, self._remaining, toks) = self._spec_tick(
+             self._d_cache, self._remaining, toks) = tick(
                 self._params, self._d_params, self._tok, self._pos,
                 self._active, self._cache, self._d_cache,
                 self._remaining)

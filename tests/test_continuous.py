@@ -1146,8 +1146,9 @@ class TestSpeculativePool:
                               draft_cfg=bad)
         eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=32,
                                 draft_params=draft, draft_cfg=self.D_CFG)
-        with pytest.raises(ValueError, match="greedy-only"):
-            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.5)
+        # sampled submits are allowed (per-slot rejection correction);
+        # only the unsupported top-k/top-p warps are rejected
+        eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.5)
 
     def test_prefix_caching_composes(self, params):
         """Prefix-cache requests work in spec mode: the target reuses the
@@ -1172,3 +1173,108 @@ class TestSpeculativePool:
             assert eng.result(r, timeout=5) == _reference_tokens(
                 params, p, 5)
         assert eng.stats["prefix_hits"] == 2   # req 1 stores; 2 and 3 hit
+
+
+class TestSpeculativePoolSampled:
+    """Sampled requests in the speculative pool: per-slot rejection
+    correction. Contract is DISTRIBUTIONAL (exactly target-distributed;
+    bit-identity to the plain engine is impossible), so the test checks
+    empirical marginals against enumerated target probabilities; greedy
+    requests in the same pool stay bit-exact."""
+
+    V_CFG = TransformerConfig(vocab=32, layers=2, d_model=32, heads=4,
+                              d_ff=64, max_len=64, causal=True,
+                              norm="rmsnorm", position="rope",
+                              dtype=jnp.float32)
+    D32 = V_CFG._replace(layers=1, d_model=16, heads=2, d_ff=32)
+    TEMP = 1.3
+
+    def test_sampled_marginals_match_target(self):
+        from mmlspark_tpu.models.zoo.transformer import prefill_cache
+        t_params = init_transformer(self.V_CFG, seed=1)
+        d_params = init_transformer(self.D32, seed=7)
+        prompt = np.asarray([3, 11, 4, 17], np.int32)
+        N, V = 512, self.V_CFG.vocab
+        eng = ContinuousDecoder(t_params, self.V_CFG, max_slots=16,
+                                max_len=32, steps_per_dispatch=2,
+                                draft_params=d_params, draft_cfg=self.D32,
+                                gamma=2)
+        reqs = [eng.submit(prompt, 2, temperature=self.TEMP, seed=i)
+                for i in range(N)]
+        for _ in range(4000):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        toks = np.asarray([r.tokens for r in reqs])          # (N, 2)
+        # exact marginals by enumeration (same recipe as the zoo test)
+        lengths = jnp.asarray([4], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt[None]),
+                                      lengths, self.V_CFG, 8)
+        p1 = np.asarray(jax.nn.softmax(
+            logits.astype(jnp.float32) / self.TEMP, -1))[0]
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            4, cacheV, self.V_CFG)
+        p2_given = np.asarray(jax.nn.softmax(
+            l2.astype(jnp.float32) / self.TEMP, -1))
+        p2 = p1 @ p2_given
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.055, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.055, np.abs(emp2 - p2).max()
+
+    def test_mixed_pool_keeps_greedy_bit_exact(self, params):
+        draft = init_transformer(
+            CFG._replace(layers=1, d_model=32, heads=2, d_ff=64), seed=5)
+        eng = ContinuousDecoder(
+            params, CFG, max_slots=2, max_len=48, steps_per_dispatch=2,
+            draft_params=draft,
+            draft_cfg=CFG._replace(layers=1, d_model=32, heads=2,
+                                   d_ff=64), gamma=3)
+        rng = np.random.default_rng(51)
+        g_prompt = rng.integers(0, CFG.vocab, 5)
+        s_prompt = rng.integers(0, CFG.vocab, 6)
+        g = eng.submit(g_prompt, 7)                       # greedy
+        s = eng.submit(s_prompt, 7, temperature=0.9, seed=4)  # sampled
+        for _ in range(200):
+            if g.done and s.done:
+                break
+            eng.step()
+        assert eng.result(g, timeout=5) == _reference_tokens(
+            params, g_prompt, 7)
+        assert len(eng.result(s, timeout=5)) == 7
+        assert all(0 <= t < CFG.vocab for t in s.tokens)
+
+    def test_eos_with_sampled_spec(self, params):
+        draft = init_transformer(
+            CFG._replace(layers=1, d_model=32, heads=2, d_ff=64), seed=5)
+        eng = ContinuousDecoder(
+            params, CFG, max_slots=1, max_len=48, steps_per_dispatch=2,
+            eos_id=7, draft_params=draft,
+            draft_cfg=CFG._replace(layers=1, d_model=32, heads=2,
+                                   d_ff=64), gamma=2)
+        rng = np.random.default_rng(52)
+        req = eng.submit(rng.integers(0, CFG.vocab, 4), 20,
+                         temperature=1.5, seed=9)
+        for _ in range(200):
+            if req.done:
+                break
+            eng.step()
+        got = eng.result(req, timeout=5)
+        assert 1 <= len(got) <= 20
+        assert 7 not in got[:-1]          # eos only ever terminal
+
+    def test_topk_topp_rejected_in_spec_mode(self, params):
+        import pytest
+        draft_cfg = CFG._replace(layers=1, d_model=32, heads=2, d_ff=64)
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=32,
+                                draft_params=init_transformer(draft_cfg,
+                                                              seed=1),
+                                draft_cfg=draft_cfg)
+        with pytest.raises(ValueError, match="temperature only"):
+            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8, top_k=5)
+        with pytest.raises(ValueError, match="temperature only"):
+            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8,
+                       top_p=0.9)
+        eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8)  # ok now
