@@ -72,23 +72,20 @@ class _Request:
         self.finished_at: Optional[float] = None
 
 
-def _sample_rows(logits, temp, top_k, top_p, keys):
-    """Per-ROW-parameter version of ``transformer._sample_logits``: each of
-    the (S, V) rows carries its own temperature/top_k/top_p and PRNG key
-    (requests in one slot pool sample independently). Row-for-row equal to
-    ``_sample_logits`` run on that row alone with scalar params — the
-    neutral values (top_k=0 → k=V, top_p≥1 → cutoff at the sorted tail)
-    reduce every filter to a no-op, exactly like its ``need_k``/``need_p``
-    short-circuits."""
-    S, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+def _warp_scaled_rows(scaled, top_k, top_p):
+    """Top-k then nucleus filtering on temperature-scaled (S, V) logit
+    rows with PER-ROW parameters (-inf outside the kept set) — the HF
+    convention ``transformer._sample_logits`` follows. Neutral values
+    (top_k=0 → k=V, top_p≥1 → cutoff at the sorted tail) reduce every
+    filter to a no-op. Shared by plain sampling (:func:`_sample_rows`)
+    and the speculative ratio test, which must warp the TARGET and the
+    DRAFT with the same function to stay distribution-exact."""
+    S, V = scaled.shape
     sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
     k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)          # (S,)
     kth = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
     filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
-    # nucleus mass over the k-filtered renormalized distribution (the HF
-    # convention _sample_logits follows)
+    # nucleus mass over the k-filtered renormalized distribution
     posn = jnp.arange(V)[None]
     sorted_f = jnp.where(posn >= k[:, None], -jnp.inf, sorted_l)
     probs = jax.nn.softmax(sorted_f, axis=-1)
@@ -96,7 +93,17 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
     eff_p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
     cutoff_idx = jnp.sum(cum < eff_p[:, None], axis=-1)
     cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
-    filtered = jnp.where(filtered < cutoff, -jnp.inf, filtered)
+    return jnp.where(filtered < cutoff, -jnp.inf, filtered)
+
+
+def _sample_rows(logits, temp, top_k, top_p, keys):
+    """Per-ROW-parameter version of ``transformer._sample_logits``: each of
+    the (S, V) rows carries its own temperature/top_k/top_p and PRNG key
+    (requests in one slot pool sample independently). Row-for-row equal to
+    ``_sample_logits`` run on that row alone with scalar params."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    filtered = _warp_scaled_rows(scaled, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, filtered)
     return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
 
@@ -348,9 +355,10 @@ class ContinuousDecoder:
             d_cfg, gamma = self._d_cfg, self._gamma
             from ..models.zoo.transformer import decode_window_ragged
 
-            def _make_spec_tick(sample: bool):
+            def _make_spec_tick(sample: bool, warp: bool = False):
                 def spec_tick(params, d_params, tok, pos, active, t_cache,
-                              d_cache, remaining, temp=None, key=None):
+                              d_cache, remaining, temp=None, key=None,
+                              topk=None, topp=None):
                     idx = jnp.arange(gamma + 1)
 
                     def keys_at(qpos, purpose):
@@ -360,11 +368,30 @@ class ContinuousDecoder:
                             k1, purpose)
 
                     def warm_logp(lg):
-                        # temp is (S,); lg is (S, V) or (S, W, V)
+                        # temp is (S,); lg is (S, V) or (S, W, V). The
+                        # top-k/top-p warp applies to TARGET and DRAFT
+                        # alike (rejection stays exact only under a
+                        # shared warp). Greedy rows may carry non-neutral
+                        # top_k/top_p values — harmless only because the
+                        # temp>0 masks discard every warped quantity for
+                        # them. The warp=False variant skips the
+                        # sort-based filter entirely — the host picks it
+                        # whenever no live row warps, keeping the
+                        # temperature-only hot path at one log_softmax.
                         t = jnp.maximum(temp, 1e-6).reshape(
                             (lg.shape[0],) + (1,) * (lg.ndim - 1))
-                        return jax.nn.log_softmax(
-                            lg.astype(jnp.float32) / t, -1)
+                        scaled = lg.astype(jnp.float32) / t
+                        if not warp:
+                            return jax.nn.log_softmax(scaled, -1)
+                        if lg.ndim == 2:
+                            warped = _warp_scaled_rows(scaled, topk, topp)
+                        else:
+                            s_, w_, v_ = scaled.shape
+                            warped = _warp_scaled_rows(
+                                scaled.reshape(s_ * w_, v_),
+                                jnp.repeat(topk, w_),
+                                jnp.repeat(topp, w_)).reshape(s_, w_, v_)
+                        return jax.nn.log_softmax(warped, -1)
 
                     def round_body(carry, _):
                         (tok, pos, active, t_cache, d_cache,
@@ -483,6 +510,8 @@ class ContinuousDecoder:
 
             self._spec_tick = _make_spec_tick(sample=False)
             self._spec_tick_sampled = _make_spec_tick(sample=True)
+            self._spec_tick_warped = _make_spec_tick(sample=True,
+                                                     warp=True)
 
         # one compiled prefill per padded prompt bucket
         def _prefill(params, ids, length):
@@ -615,13 +644,6 @@ class ContinuousDecoder:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0 or temperature < 0.0:
             raise ValueError("top_k and temperature must be >= 0")
-        if self._spec and temperature > 0.0 and (top_k > 0 or top_p < 1.0):
-            # the rejection correction stays exact only if the SAME
-            # warping is applied to both distributions before the ratio
-            # test; top-k/top-p warping is not implemented there yet —
-            # refuse rather than emit a silently different distribution
-            raise ValueError("speculative sampling supports temperature "
-                             "only; submit with top_k=0, top_p=1")
         if prefix_key is not None and not isinstance(prefix_key, str):
             # an unhashable key would TypeError inside the engine thread,
             # poisoning the batch instead of 400-ing this request
@@ -1018,8 +1040,15 @@ class ContinuousDecoder:
             return 0
         if self._spec:
             if any(self._slot_req[i].temperature > 0.0 for i in live):
-                tick = functools.partial(self._spec_tick_sampled,
-                                         temp=self._temp, key=self._key)
+                warps = any(self._slot_req[i].temperature > 0.0
+                            and (self._slot_req[i].top_k > 0
+                                 or self._slot_req[i].top_p < 1.0)
+                            for i in live)
+                tick = functools.partial(
+                    self._spec_tick_warped if warps
+                    else self._spec_tick_sampled,
+                    temp=self._temp, key=self._key,
+                    topk=self._topk, topp=self._topp)
             else:
                 tick = self._spec_tick
             (self._tok, self._pos, self._active, self._cache,

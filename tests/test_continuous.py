@@ -1265,16 +1265,101 @@ class TestSpeculativePoolSampled:
         assert 1 <= len(got) <= 20
         assert 7 not in got[:-1]          # eos only ever terminal
 
-    def test_topk_topp_rejected_in_spec_mode(self, params):
-        import pytest
-        draft_cfg = CFG._replace(layers=1, d_model=32, heads=2, d_ff=64)
-        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=32,
-                                draft_params=init_transformer(draft_cfg,
-                                                              seed=1),
-                                draft_cfg=draft_cfg)
-        with pytest.raises(ValueError, match="temperature only"):
-            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8, top_k=5)
-        with pytest.raises(ValueError, match="temperature only"):
-            eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8,
-                       top_p=0.9)
-        eng.submit(np.asarray([1, 2, 3]), 4, temperature=0.8)  # ok now
+    def test_topk_marginals_match_warped_target(self):
+        """top-k sampling under speculation: the warp applies to BOTH
+        distributions before the ratio test, so outputs are exactly
+        top-k-warped-target distributed — checked against enumerated
+        warped marginals for the second token (the first goes through
+        the plain admission sampler)."""
+        from mmlspark_tpu.models.zoo.transformer import prefill_cache
+        t_params = init_transformer(self.V_CFG, seed=1)
+        d_params = init_transformer(self.D32, seed=7)
+        prompt = np.asarray([3, 11, 4, 17], np.int32)
+        N, V, TOPK = 512, self.V_CFG.vocab, 3
+        eng = ContinuousDecoder(t_params, self.V_CFG, max_slots=16,
+                                max_len=32, steps_per_dispatch=2,
+                                draft_params=d_params, draft_cfg=self.D32,
+                                gamma=2)
+        reqs = [eng.submit(prompt, 2, temperature=self.TEMP, top_k=TOPK,
+                           seed=i) for i in range(N)]
+        for _ in range(4000):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        toks = np.asarray([r.tokens for r in reqs])
+
+        def warp(logits_row):
+            scaled = logits_row / self.TEMP
+            kth = np.sort(scaled)[::-1][TOPK - 1]
+            keep = scaled >= kth
+            e = np.where(keep, np.exp(scaled - scaled.max()), 0.0)
+            return e / e.sum()
+
+        lengths = jnp.asarray([4], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt[None]),
+                                      lengths, self.V_CFG, 8)
+        p1 = warp(np.asarray(logits, np.float64)[0])
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            4, cacheV, self.V_CFG)
+        p2_given = np.stack([warp(row)
+                             for row in np.asarray(l2, np.float64)])
+        p2 = p1 @ p2_given
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.06, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.06, np.abs(emp2 - p2).max()
+        # the warp is real: nothing outside the reachable top-k sets
+        assert set(np.unique(toks[:, 0])) <= set(np.nonzero(p1)[0])
+        assert set(np.unique(toks[:, 1])) <= set(np.nonzero(p2)[0])
+
+    def test_topp_marginals_match_warped_target(self):
+        """Nucleus (top-p) sampling under speculation: exact
+        warped-target marginals, HF convention (cutoff over the sorted
+        renormalized mass, keep through the crossing token)."""
+        from mmlspark_tpu.models.zoo.transformer import prefill_cache
+        t_params = init_transformer(self.V_CFG, seed=1)
+        d_params = init_transformer(self.D32, seed=7)
+        prompt = np.asarray([3, 11, 4, 17], np.int32)
+        N, V, TOPP = 512, self.V_CFG.vocab, 0.6
+        eng = ContinuousDecoder(t_params, self.V_CFG, max_slots=16,
+                                max_len=32, steps_per_dispatch=2,
+                                draft_params=d_params, draft_cfg=self.D32,
+                                gamma=2)
+        reqs = [eng.submit(prompt, 2, temperature=self.TEMP, top_p=TOPP,
+                           seed=i) for i in range(N)]
+        for _ in range(4000):
+            if all(r.done for r in reqs):
+                break
+            eng.step()
+        toks = np.asarray([r.tokens for r in reqs])
+
+        def warp(logits_row):
+            scaled = np.asarray(logits_row, np.float64) / self.TEMP
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            order = np.argsort(-scaled)
+            cum = np.cumsum(probs[order])
+            keep_n = int(np.sum(cum < TOPP)) + 1   # through the crossing
+            kept = order[:keep_n]
+            out = np.zeros_like(probs)
+            out[kept] = probs[kept] / probs[kept].sum()
+            return out
+
+        lengths = jnp.asarray([4], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt[None]),
+                                      lengths, self.V_CFG, 8)
+        p1 = warp(np.asarray(logits)[0])
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            4, cacheV, self.V_CFG)
+        p2_given = np.stack([warp(row) for row in np.asarray(l2)])
+        p2 = p1 @ p2_given
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.06, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.06, np.abs(emp2 - p2).max()
+        assert set(np.unique(toks[:, 0])) <= set(np.nonzero(p1)[0])
+        assert set(np.unique(toks[:, 1])) <= set(np.nonzero(p2)[0])
