@@ -41,8 +41,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import (TransformerConfig, decode_step, decode_window,
-                          init_kv_cache, prefill_cache)
+from .transformer import (TransformerConfig, _warp_scaled_rows,
+                          decode_step, decode_window, init_kv_cache,
+                          prefill_cache)
 
 __all__ = ["generate_speculative", "generate_speculative_fused",
            "generate_speculative_sampled"]
@@ -54,6 +55,7 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
                                  max_new_tokens: int = 32,
                                  gamma: int = 4,
                                  temperature: float = 1.0,
+                                 top_k: int = 0, top_p: float = 1.0,
                                  seed: int = 0) -> Tuple[jnp.ndarray, dict]:
     """Speculative SAMPLING: temperature>0 generation whose output
     distribution exactly equals sampling from the target alone.
@@ -73,9 +75,10 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
     itself exactly target-distributed; discarded randomness is never
     reused).
 
-    Top-k/top-p warping is not implemented here (it must be applied to
-    BOTH distributions before the ratio test to stay exact) — pass 0/1.
-    Returns ``(ids (B, P+max_new), stats)``.
+    Top-k/top-p warping composes: the SAME warp (HF convention,
+    ``transformer._warp_scaled_rows``) is applied to the target and the
+    draft before the ratio test, so the output is exactly
+    warped-target distributed. Returns ``(ids (B, P+max_new), stats)``.
     """
     if t_cfg.vocab != d_cfg.vocab:
         raise ValueError("draft and target must share a vocabulary")
@@ -84,6 +87,8 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
     if not temperature > 0.0:
         raise ValueError("temperature must be > 0 — use "
                          "generate_speculative_fused for greedy")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError("top_k must be >= 0 and top_p in (0, 1]")
     t_params = jax.tree.map(jnp.asarray, t_params)
     d_params = jax.tree.map(jnp.asarray, d_params)
     prompt_ids = jnp.asarray(prompt_ids)
@@ -92,7 +97,8 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
     ids, stats = _speculative_sampled_impl(
         t_params, d_params, prompt_ids, jax.random.PRNGKey(int(seed)),
         jnp.float32(temperature), t_cfg=t_cfg, d_cfg=d_cfg,
-        max_new_tokens=int(max_new_tokens), gamma=int(gamma))
+        max_new_tokens=int(max_new_tokens), gamma=int(gamma),
+        top_k=int(top_k), top_p=float(top_p))
     s = np.asarray(stats)
     return ids, {"target_forwards": int(s[0]) + 1, "rounds": int(s[1]),
                  "accepted_drafts": int(s[2]),
@@ -100,10 +106,11 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
 
 
 @functools.partial(jax.jit, static_argnames=("t_cfg", "d_cfg",
-                                             "max_new_tokens", "gamma"))
+                                             "max_new_tokens", "gamma",
+                                             "top_k", "top_p"))
 def _speculative_sampled_impl(t_params, d_params, prompt_ids, key,
                               temperature, t_cfg, d_cfg, max_new_tokens,
-                              gamma):
+                              gamma, top_k=0, top_p=1.0):
     B, P = prompt_ids.shape
     L = P + max_new_tokens + gamma + 1
     V = t_cfg.vocab
@@ -116,8 +123,17 @@ def _speculative_sampled_impl(t_params, d_params, prompt_ids, key,
                         (None, 0))(key, jnp.arange(B, dtype=jnp.uint32))
 
     def warm_logp(logits):
-        return jax.nn.log_softmax(
-            logits.astype(jnp.float32) / temperature, axis=-1)
+        """Temperature scale + (static) top-k/top-p warp, 2D or 3D —
+        shared by draft and target so the ratio test stays exact."""
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k > 0 or top_p < 1.0:
+            flat = scaled.reshape(-1, scaled.shape[-1])
+            n = flat.shape[0]
+            flat = _warp_scaled_rows(
+                flat, jnp.full((n,), top_k, jnp.int32),
+                jnp.full((n,), top_p, jnp.float32))
+            scaled = flat.reshape(scaled.shape)
+        return jax.nn.log_softmax(scaled, axis=-1)
 
     def sample_rows(keys, logp):
         return jax.vmap(jax.random.categorical)(keys, logp).astype(

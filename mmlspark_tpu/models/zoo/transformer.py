@@ -327,6 +327,32 @@ def train_step(params, opt_state, ids, labels, cfg: TransformerConfig,
     return new_p, new_m, loss
 
 
+def _warp_scaled_rows(scaled, top_k, top_p):
+    """Top-k then nucleus filtering on temperature-scaled (S, V) logit
+    rows with PER-ROW parameters (-inf outside the kept set) — the HF
+    convention ``transformer._sample_logits`` follows. Neutral values
+    (top_k=0 → k=V, top_p≥1 → cutoff at the sorted tail) reduce every
+    filter to a no-op. Shared by the continuous
+    engine's per-slot sampler and both speculative-sampling ratio
+    tests (zoo + pool), which must warp the TARGET and the DRAFT
+    with the same function to stay distribution-exact."""
+    S, V = scaled.shape
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)          # (S,)
+    kth = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus mass over the k-filtered renormalized distribution
+    posn = jnp.arange(V)[None]
+    sorted_f = jnp.where(posn >= k[:, None], -jnp.inf, sorted_l)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    eff_p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
+    cutoff_idx = jnp.sum(cum < eff_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
+    return jnp.where(filtered < cutoff, -jnp.inf, filtered)
+
+
+
 def _sample_logits(logits, key, temperature: float, top_k: int,
                    top_p: float):
     """Greedy (temperature 0) or filtered sampling shared by both

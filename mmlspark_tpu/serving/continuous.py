@@ -41,7 +41,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.zoo.transformer import (TransformerConfig, decode_step_ragged,
+from ..models.zoo.transformer import (TransformerConfig,
+                                      _warp_scaled_rows,
+                                      decode_step_ragged,
                                       prefill_cache, shardings_for)
 from ..ops.padding import bucket_size
 
@@ -70,30 +72,6 @@ class _Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
-
-
-def _warp_scaled_rows(scaled, top_k, top_p):
-    """Top-k then nucleus filtering on temperature-scaled (S, V) logit
-    rows with PER-ROW parameters (-inf outside the kept set) — the HF
-    convention ``transformer._sample_logits`` follows. Neutral values
-    (top_k=0 → k=V, top_p≥1 → cutoff at the sorted tail) reduce every
-    filter to a no-op. Shared by plain sampling (:func:`_sample_rows`)
-    and the speculative ratio test, which must warp the TARGET and the
-    DRAFT with the same function to stay distribution-exact."""
-    S, V = scaled.shape
-    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)          # (S,)
-    kth = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
-    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
-    # nucleus mass over the k-filtered renormalized distribution
-    posn = jnp.arange(V)[None]
-    sorted_f = jnp.where(posn >= k[:, None], -jnp.inf, sorted_l)
-    probs = jax.nn.softmax(sorted_f, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    eff_p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
-    cutoff_idx = jnp.sum(cum < eff_p[:, None], axis=-1)
-    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
-    return jnp.where(filtered < cutoff, -jnp.inf, filtered)
 
 
 def _sample_rows(logits, temp, top_k, top_p, keys):

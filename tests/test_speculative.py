@@ -273,3 +273,79 @@ class TestSpeculativeSampled:
             generate_speculative_sampled(
                 t_params, d_params, prompt, t_cfg,
                 d_cfg._replace(vocab=t_cfg.vocab + 1))
+
+    def test_topk_marginals_match_warped_target(self):
+        """top-k under speculative sampling: both distributions get the
+        same warp, so marginals match the enumerated TOP-K-WARPED target
+        exactly (and nothing outside the reachable support appears)."""
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        N, V, TOPK = 2048, t_cfg.vocab, 3
+        ids, _ = generate_speculative_sampled(
+            t_params, d_params, np.repeat(prompt, N, axis=0), t_cfg,
+            d_cfg, max_new_tokens=3, gamma=2, temperature=self.TEMP,
+            top_k=TOPK, seed=13)
+        toks = np.asarray(ids)[:, prompt.shape[1]:]
+
+        def warp(row):
+            scaled = np.asarray(row, np.float64) / self.TEMP
+            kth = np.sort(scaled)[::-1][TOPK - 1]
+            e = np.where(scaled >= kth, np.exp(scaled - scaled.max()), 0.0)
+            return e / e.sum()
+
+        lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt),
+                                      lengths, t_cfg, prompt.shape[1] + 4)
+        p1 = warp(np.asarray(logits)[0])
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            prompt.shape[1], cacheV, t_cfg)
+        p2 = p1 @ np.stack([warp(r) for r in np.asarray(l2)])
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.045, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.045, np.abs(emp2 - p2).max()
+        assert set(np.unique(toks[:, 0])) <= set(np.nonzero(p1)[0])
+        assert set(np.unique(toks[:, 1])) <= set(np.nonzero(p2)[0])
+
+    def test_topp_marginals_match_warped_target(self):
+        """Nucleus warp through the zoo sampled path (top_k=0 keeps that
+        half neutral, isolating the top_p plumbing)."""
+        from mmlspark_tpu.models.zoo.speculative import \
+            generate_speculative_sampled
+        t_params, d_params, t_cfg, d_cfg, prompt = self._setup()
+        N, V, TOPP = 2048, t_cfg.vocab, 0.55
+        ids, _ = generate_speculative_sampled(
+            t_params, d_params, np.repeat(prompt, N, axis=0), t_cfg,
+            d_cfg, max_new_tokens=3, gamma=2, temperature=self.TEMP,
+            top_p=TOPP, seed=17)
+        toks = np.asarray(ids)[:, prompt.shape[1]:]
+
+        def warp(row):
+            scaled = np.asarray(row, np.float64) / self.TEMP
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            order = np.argsort(-scaled)
+            keep_n = int(np.sum(np.cumsum(probs[order]) < TOPP)) + 1
+            kept = order[:keep_n]
+            out = np.zeros_like(probs)
+            out[kept] = probs[kept] / probs[kept].sum()
+            return out
+
+        lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+        logits, cache = prefill_cache(t_params, jnp.asarray(prompt),
+                                      lengths, t_cfg, prompt.shape[1] + 4)
+        p1 = warp(np.asarray(logits)[0])
+        cacheV = [{k: jnp.repeat(c[k], V, axis=0) for k in ("k", "v")}
+                  for c in cache]
+        l2, _ = decode_step(t_params, jnp.arange(V, dtype=jnp.int32),
+                            prompt.shape[1], cacheV, t_cfg)
+        p2 = p1 @ np.stack([warp(r) for r in np.asarray(l2)])
+        emp1 = np.bincount(toks[:, 0], minlength=V) / N
+        emp2 = np.bincount(toks[:, 1], minlength=V) / N
+        assert np.abs(emp1 - p1).max() < 0.045, np.abs(emp1 - p1).max()
+        assert np.abs(emp2 - p2).max() < 0.045, np.abs(emp2 - p2).max()
+        assert set(np.unique(toks[:, 0])) <= set(np.nonzero(p1)[0])
+        assert set(np.unique(toks[:, 1])) <= set(np.nonzero(p2)[0])
