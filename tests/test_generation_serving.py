@@ -297,3 +297,27 @@ def test_k_step_pipelined_engine_is_token_identical(params):
         status, body = _post(eng.address, {"tokens": prompt, "max_new": 6})
         assert status == 200
         assert body["tokens"] == _want(params, prompt, 6)
+
+
+def test_speculative_engine_through_http(params):
+    """Draft plumbing through GenerationEngine: greedy replies stay
+    bit-exact under speculation, sampled replies serve full length and
+    vary by seed — the whole feature matrix reachable over HTTP."""
+    d_cfg = CFG._replace(layers=1, d_model=32, heads=2, d_ff=64)
+    draft = init_transformer(d_cfg, seed=11)
+    prompt = [5, 17, 9, 80]
+    with GenerationEngine(params, CFG, max_slots=2, max_len=48,
+                          steps_per_dispatch=2, prefill_ahead=2,
+                          draft_params=draft, draft_cfg=d_cfg,
+                          gamma=3) as eng:
+        status, body = _post(eng.address, {"tokens": prompt, "max_new": 6})
+        assert status == 200
+        assert body["tokens"] == _want(params, prompt, 6)
+        _, a = _post(eng.address, {"tokens": prompt, "max_new": 6,
+                                   "temperature": 1.1, "top_k": 8,
+                                   "seed": 1})
+        _, b = _post(eng.address, {"tokens": prompt, "max_new": 6,
+                                   "temperature": 1.1, "top_k": 8,
+                                   "seed": 2})
+        assert len(a["tokens"]) == 6 and len(b["tokens"]) == 6
+        assert a["tokens"] != b["tokens"]
