@@ -73,7 +73,11 @@ def generate_speculative_sampled(t_params: Dict, d_params: Dict,
     truncated positions redraw next round with FRESH keys, which keeps
     the restart unbiased (a prefix of a speculative-sampling emission is
     itself exactly target-distributed; discarded randomness is never
-    reused).
+    reused). The subtle branch at the cut position: a row whose own
+    acceptance ran PAST the batch-min/capacity cut emits its accepted
+    draft token there (already target-distributed), never a residual
+    resample — conflating the two biases the output, and the
+    distributional test catches it at ~19% absolute marginal error.
 
     Top-k/top-p warping composes: the SAME warp (HF convention,
     ``transformer._warp_scaled_rows``) is applied to the target and the
