@@ -14,7 +14,8 @@ import os as _os
 from .core import (DataFrame, Estimator, Model, Pipeline, PipelineModel,
                    PipelineStage, Transformer, concat)
 
-if _os.environ.get("MMLSPARK_TPU_COMPILE_CACHE"):
+if _os.environ.get("MMLSPARK_TPU_COMPILE_CACHE") \
+        or _os.environ.get("MMLSPARK_TPU_COMPILE_CACHE_DIR"):
     # opt-in persistent compilation cache: compiled executables survive
     # across processes (repeat jobs skip the multi-second XLA warmup)
     from .utils.jit_cache import enable_persistent_cache as _epc

@@ -19,11 +19,34 @@ per-partition device pinning the reference gets from Spark ``mapPartitions``
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
 __all__ = ["DataFrame", "concat", "object_col"]
+
+
+# Shared partition-mapping pools, keyed by worker count. A serving loop calls
+# `transform` per request batch, and a fresh ThreadPoolExecutor per call put
+# thread spawn/teardown on every one of them — the pool now amortizes to
+# zero per call. Keyed (not single) so an explicit `max_workers` bound still
+# bounds concurrency; never shut down (Python's atexit hook joins the idle
+# workers at interpreter exit).
+_POOLS: Dict[int, "object"] = {}
+_POOLS_LOCK = threading.Lock()
+_IN_POOL = threading.local()
+
+
+def _shared_pool(max_workers: int):
+    from concurrent.futures import ThreadPoolExecutor
+    with _POOLS_LOCK:
+        ex = _POOLS.get(max_workers)
+        if ex is None:
+            ex = _POOLS[max_workers] = ThreadPoolExecutor(
+                max_workers=max_workers,
+                thread_name_prefix="mmlspark-partitions")
+        return ex
 
 
 def object_col(values) -> np.ndarray:
@@ -292,18 +315,29 @@ class DataFrame:
         actually keeps k local chips busy. Results preserve partition order;
         the first exception propagates. ``max_workers=1`` forces the
         sequential path; env ``MMLSPARK_TPU_PARTITION_THREADS`` overrides
-        the default pool size.
+        the default pool size. Pools are module-level and reused across
+        calls (serving loops invoke ``transform`` per request batch, and a
+        per-call executor made every one pay thread spawn/teardown); a
+        ``map_partitions`` issued from inside a pool worker runs
+        sequentially instead of queueing on its own pool, which could
+        deadlock.
         """
         parts = list(self.partitions())
         if max_workers is None:
             max_workers = int(os.environ.get("MMLSPARK_TPU_PARTITION_THREADS", "0")) \
                 or min(len(parts), 8)
-        if len(parts) <= 1 or max_workers <= 1:
+        if len(parts) <= 1 or max_workers <= 1 \
+                or getattr(_IN_POOL, "active", False):
             results = [fn(p, i) for i, p in enumerate(parts)]
         else:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=max_workers) as ex:
-                results = list(ex.map(fn, parts, range(len(parts))))
+            def wrapped(p, i):
+                _IN_POOL.active = True
+                try:
+                    return fn(p, i)
+                finally:
+                    _IN_POOL.active = False
+            ex = _shared_pool(max_workers)
+            results = list(ex.map(wrapped, parts, range(len(parts))))
         out = concat(results, npartitions=self._npartitions)
         # per-partition result sizes become the output boundaries, so uneven
         # splits (parquet row groups) survive a map_partitions round

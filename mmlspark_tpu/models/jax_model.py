@@ -29,9 +29,9 @@ import numpy as np
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model
-from ..ops.padding import bucket_size, pad_axis
-from ..parallel.mesh import batch_placement
-from ..stages.batching import batch_slices
+from ..ops.compile_cache import StageCounters, warm_up_model
+from ..parallel.mesh import feed_placement
+from .runner import BatchRunner
 
 __all__ = ["JaxModel"]
 
@@ -61,6 +61,12 @@ class JaxModel(Model):
                          doc="SPMD inference over the default mesh's first "
                              "axis (batch sharded, params replicated); "
                              "overrides pin_devices — see ONNXModel")
+    prefetch_depth = Param(int, default=2,
+                           doc="prepared batches coerced/padded ahead on a "
+                               "background worker while the current batch "
+                               "dispatches; bounds host memory at that many "
+                               "padded batches. 0 = prepare inline on the "
+                               "dispatch thread")
 
     def __init__(self, apply_fn: Optional[Callable] = None,
                  model_params=None, **kw):
@@ -72,6 +78,13 @@ class JaxModel(Model):
         self._jitted = None
         self._device_params: Dict[Optional[int], object] = {}
         self._params_lock = threading.Lock()
+        self._counters = StageCounters()
+
+    @property
+    def stage_counters(self) -> StageCounters:
+        """coerce/pad/h2d/compile/dispatch/d2h instrumentation, cumulative
+        over every transform/warm_up on this instance."""
+        return self._counters
 
     def set(self, **kwargs):
         # any reconfiguration invalidates the compiled program and the
@@ -142,44 +155,72 @@ class JaxModel(Model):
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
+    @staticmethod
+    def _coerce_col(col: np.ndarray) -> np.ndarray:
+        if col.dtype == object:
+            col = np.stack([np.asarray(v) for v in col])
+        arr = np.asarray(col)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        return arr
+
+    def _placement_params(self, pidx: int):
+        placement = feed_placement(
+            self.get("mesh_sharded"), pidx, self.pin_devices)
+        params = (self._params_for_mesh(placement.mesh)
+                  if placement.mesh is not None
+                  else self._params_for_device(placement.device))
+        return placement, params
+
     def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
+        """One partition through the shared feed/drain pipeline (see
+        :class:`~mmlspark_tpu.models.runner.BatchRunner` — prefetch, async
+        h2d, overlapped d2h drain; the same machinery as ONNXModel)."""
         jitted = self._ensure_jitted()
         feed = dict(self.feed_dict) or {"input": part.columns[0]}
-        mesh, device, shards, put = batch_placement(
-            self.get("mesh_sharded"), pidx, self.pin_devices)
-        params = (self._params_for_mesh(mesh) if mesh is not None
-                  else self._params_for_device(device))
+        placement, params = self._placement_params(pidx)
 
-        n = len(part)
-        pending = []
-        for sl in batch_slices(n, self.mini_batch_size):
-            feeds = {}
-            b = 0
-            for feed_name, col_name in feed.items():
-                col = part[col_name][sl]
-                if col.dtype == object:
-                    col = np.stack([np.asarray(v) for v in col])
-                arr = np.asarray(col)
-                if arr.dtype == np.float64:
-                    arr = arr.astype(np.float32)
-                b = len(arr)
-                padded = bucket_size(b)
-                padded = -(-padded // shards) * shards
-                arr = pad_axis(arr, padded)
-                feeds[feed_name] = put(arr)
-            pending.append((jitted(params, feeds), b))
+        def coerce(sl: slice) -> Dict[str, np.ndarray]:
+            return {feed_name: self._coerce_col(part[col_name][sl])
+                    for feed_name, col_name in feed.items()}
+
+        runner = BatchRunner(jitted, params, coerce, placement.put,
+                             shards=placement.shards,
+                             mini_batch_size=self.mini_batch_size,
+                             prefetch_depth=self.prefetch_depth,
+                             counters=self._counters)
+        pending = runner.run_and_drain(len(part))
 
         if not pending:
             return part
         out_cols = list(pending[0][0])
         out = part
         for col_name in out_cols:
-            chunks = [np.asarray(outs[col_name])[:b] for outs, b in pending]
+            chunks = [outs[col_name][:b] for outs, b in pending]
             arr = np.concatenate(chunks)
             if arr.dtype == jnp.bfloat16:
                 arr = arr.astype(np.float32)
             out = out.with_column(col_name, arr)
         return out
+
+    # -- AOT warm-up ---------------------------------------------------------
+    def warm_up(self, input_specs: Dict[str, tuple],
+                batch_sizes: Optional[List[int]] = None,
+                background: bool = False):
+        """Compile every padding-bucket shape ahead of first traffic.
+
+        ``apply_fn`` is opaque (no graph metadata to introspect), so
+        ``input_specs`` is required: {feed name: (dtype, per-row shape)}.
+        Otherwise identical to :meth:`ONNXModel.warm_up` — one zero batch
+        per bucket per placement, populating the jit cache (and the
+        persistent compilation cache when enabled).
+        """
+        jitted = self._ensure_jitted()
+        specs = {name: (np.dtype(dt), tuple(shape))
+                 for name, (dt, shape) in input_specs.items()}
+        sizes = [int(b) for b in (batch_sizes or [self.mini_batch_size])]
+        return warm_up_model(self, jitted, specs, sizes,
+                             background=background)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self._ensure_jitted()
@@ -190,3 +231,4 @@ class JaxModel(Model):
         self._jitted = None
         self._device_params = {}
         self._params_lock = threading.Lock()
+        self._counters = StageCounters()

@@ -29,9 +29,10 @@ from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model, Transformer
 from ..onnx.convert import ConvertedModel, convert_model
-from ..ops.padding import bucket_size, pad_axis
-from ..parallel.mesh import batch_placement, local_devices
-from ..stages.batching import FixedMiniBatchTransformer, FlattenBatch, batch_slices
+from ..ops.compile_cache import (StageCounters, resolve_input_specs,
+                                 warm_up_model)
+from ..parallel.mesh import feed_placement, local_devices
+from .runner import BatchRunner
 
 __all__ = ["ONNXModel"]
 
@@ -81,6 +82,12 @@ class ONNXModel(Model):
                          "dequantize on device (XLA fuses the multiply "
                          "into the consumer matmul) — 4x less weight "
                          "bandwidth, activations stay in compute_dtype")
+    prefetch_depth = Param(int, default=2,
+                           doc="prepared batches coerced/padded ahead on a "
+                               "background worker while the current batch "
+                               "dispatches; bounds host memory at that many "
+                               "padded batches. 0 = prepare inline on the "
+                               "dispatch thread")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -94,6 +101,13 @@ class ONNXModel(Model):
         self._out_col_names: List[str] = []
         self._device_params: Dict[Optional[int], dict] = {}
         self._params_lock = threading.Lock()
+        self._counters = StageCounters()
+
+    @property
+    def stage_counters(self) -> StageCounters:
+        """coerce/pad/h2d/compile/dispatch/d2h instrumentation, cumulative
+        over every transform/warm_up on this instance."""
+        return self._counters
 
     # -- metadata (proto-only, no session) ----------------------------------
     def _ensure_converted(self) -> ConvertedModel:
@@ -295,14 +309,18 @@ class ONNXModel(Model):
                 "(the override must come from this graph's fine-tune)")
         return {**cm.params, **override}
 
+    _PARAM_CACHE_KEYS = ("weights_override", "quantize", "compute_dtype")
+
     def set(self, **kwargs):
-        if ("weights_override" in kwargs or "quantize" in kwargs) \
+        if any(k in kwargs for k in self._PARAM_CACHE_KEYS) \
                 and getattr(self, "_device_params", None):
-            # cached device params embed the previous override/packing —
-            # drop them so the change takes effect (an id()-keyed cache
-            # would risk stale hits after the old payload's address is
-            # reused). getattr: Params.__init__ may route constructor
-            # kwargs through set() before __init__ has built the caches.
+            # cached device params embed the previous override/packing/dtype
+            # cast — drop them so the change takes effect (an id()-keyed
+            # cache would risk stale hits after the old payload's address is
+            # reused; a compute_dtype change used to leave bf16-cast params
+            # serving a float32 run). getattr: Params.__init__ may route
+            # constructor kwargs through set() before __init__ has built
+            # the caches.
             with self._params_lock:
                 self._device_params.clear()
         return super().set(**kwargs)
@@ -345,48 +363,48 @@ class ONNXModel(Model):
             return self._device_params[key]
 
     # -- execution ----------------------------------------------------------
-    def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
-        """Dispatch every minibatch asynchronously, drain once at the end.
+    def _placement_params(self, pidx: int):
+        placement = feed_placement(
+            self.get("mesh_sharded"), pidx, self.pin_devices)
+        params = (self._params_for_mesh(placement.mesh)
+                  if placement.mesh is not None
+                  else self._params_for_device(placement.device))
+        return placement, params
 
-        JAX dispatch returns futures, so host coerce/pad of batch k+1
-        overlaps device compute of batch k; outputs stay on device until the
-        partition finishes (the reference's per-batch ``session.run`` +
-        NIO-buffer marshalling, ``ONNXModel.scala:305-402``, is fully
-        synchronous — this pipelining is the TPU-side throughput win).
+    def _run_batches(self, part: DataFrame, pidx: int) -> DataFrame:
+        """One partition through the shared feed/drain pipeline.
+
+        :class:`BatchRunner` overlaps all three host boundaries: coerce/pad
+        of batch k+1 on a prefetch worker, async host→device puts at
+        dispatch, ``copy_to_host_async`` per batch with ONE batched
+        ``jax.device_get`` at partition end (the reference's per-batch
+        ``session.run`` + NIO-buffer marshalling, ``ONNXModel.scala:305-402``,
+        is fully synchronous — this pipelining is the TPU-side throughput
+        win).
         """
         cm = self._ensure_converted()
         jitted = self._ensure_jitted()
         feed = self.feed_dict or {cm.input_names[0]: part.columns[0]}
         in_meta = {vi.name: vi for vi in cm.inputs}
+        placement, params = self._placement_params(pidx)
 
-        mesh, device, shards, put = batch_placement(
-            self.get("mesh_sharded"), pidx, self.pin_devices)
-        params = (self._params_for_mesh(mesh) if mesh is not None
-                  else self._params_for_device(device))
+        def coerce(sl: slice) -> Dict[str, np.ndarray]:
+            return {input_name: self._coerce(
+                        part[col_name][sl], in_meta[input_name].numpy_dtype,
+                        in_meta[input_name].shape,
+                        device_prepped=input_name in self.transpose_dict)
+                    for input_name, col_name in feed.items()}
 
-        n = len(part)
-        pending = []  # (device outputs dict, valid rows) per batch, in order
-        for sl in batch_slices(n, self.mini_batch_size):
-            feeds = {}
-            b = 0
-            for input_name, col_name in feed.items():
-                vi = in_meta[input_name]
-                arr = self._coerce(part[col_name][sl], vi.numpy_dtype, vi.shape,
-                                   device_prepped=input_name in self.transpose_dict)
-                b = len(arr)
-                # pad to the jit bucket AND to a multiple of the mesh's
-                # batch-axis size so the leading dim shards evenly; the
-                # explicit async put (even unpinned) enqueues the transfer
-                # immediately so it overlaps the previous batch's compute
-                padded = bucket_size(b)
-                padded = -(-padded // shards) * shards
-                arr = pad_axis(arr, padded)
-                feeds[input_name] = put(arr)
-            pending.append((jitted(params, feeds), b))
+        runner = BatchRunner(jitted, params, coerce, placement.put,
+                             shards=placement.shards,
+                             mini_batch_size=self.mini_batch_size,
+                             prefetch_depth=self.prefetch_depth,
+                             counters=self._counters)
+        pending = runner.run_and_drain(len(part))
 
         out = part
         for col_name in self._out_col_names:
-            chunks = [np.asarray(outs[col_name])[:b] for outs, b in pending]
+            chunks = [outs[col_name][:b] for outs, b in pending]
             arr = np.concatenate(chunks) if chunks \
                 else np.zeros((0,), dtype=np.float32)
             if arr.dtype == jnp.bfloat16:
@@ -395,6 +413,38 @@ class ONNXModel(Model):
                 arr = arr.astype(np.int64)
             out = out.with_column(col_name, arr)
         return out
+
+    # -- AOT warm-up ---------------------------------------------------------
+    def warm_up(self, batch_sizes: Optional[List[int]] = None,
+                input_specs: Optional[Dict[str, tuple]] = None,
+                background: bool = False):
+        """Compile every padding-bucket shape ahead of first traffic.
+
+        Runs one zero-filled batch per bucket through the jitted program on
+        every placement real traffic can hit (each pinned chip, or the
+        default mesh), so neither bench nor serving eats a compile stall
+        mid-stream — and, with the persistent compilation cache enabled
+        (``MMLSPARK_TPU_COMPILE_CACHE_DIR``), neither does the *next*
+        process.
+
+        ``batch_sizes`` defaults to ``[mini_batch_size]``; pass the expected
+        ragged sizes too to pre-warm their buckets. ``input_specs`` maps a
+        model input to its fed ``(dtype, per-row shape)`` and is required
+        when a column feeds a different dtype/layout than the graph declares
+        (e.g. uint8 HWC images into a float NCHW input via
+        ``transpose_dict``) or when the declared shape is symbolic.
+        ``background=True`` warms on a daemon thread and returns it;
+        otherwise returns ``{"buckets", "compiles", "seconds",
+        "placements"}``.
+        """
+        cm = self._ensure_converted()
+        jitted = self._ensure_jitted()
+        fed = dict(self.feed_dict) or {cm.input_names[0]: None}
+        specs = resolve_input_specs(cm.inputs, fed, self.transpose_dict,
+                                    overrides=input_specs)
+        sizes = [int(b) for b in (batch_sizes or [self.mini_batch_size])]
+        return warm_up_model(self, jitted, specs, sizes,
+                             background=background)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self._ensure_converted()
@@ -422,6 +472,7 @@ class ONNXModel(Model):
         self._out_col_names = []
         self._device_params = {}
         self._params_lock = threading.Lock()
+        self._counters = StageCounters()
 
 
 def _host_softmax(col: np.ndarray) -> np.ndarray:

@@ -1,0 +1,135 @@
+"""BatchRunner — the shared device feed/drain pipeline of the graph runners.
+
+``ONNXModel`` and ``JaxModel`` used to each carry their own copy of the
+partition loop, and both copies had the same three stalls: the first batch of
+every padding bucket paid a full XLA compile inline, outputs drained at
+partition end through serialized per-batch per-column ``np.asarray`` host
+copies, and all coerce/pad host work ran on the dispatch thread. This module
+is the one implementation both models now share, with the stalls engineered
+out:
+
+* **prefetch** — coerce/pad of batch k+1 runs on a background worker
+  (:class:`~mmlspark_tpu.stages.batching.PrefetchIterator`, the
+  ``DynamicBufferedBatcher`` producer machinery), bounded by
+  ``prefetch_depth`` prepared batches of host memory;
+* **async feed** — host→device transfers enqueue immediately at dispatch
+  time, overlapping the previous batch's compute;
+* **overlapped drain** — ``copy_to_host_async()`` is issued per output the
+  moment a batch is dispatched, so device→host transfers overlap compute,
+  and the partition-end drain is ONE batched ``jax.device_get`` over every
+  pending output instead of a per-batch-per-column ``np.asarray`` loop.
+
+Every stage is instrumented through :class:`~mmlspark_tpu.ops.compile_cache.
+StageCounters` (coerce / pad / h2d / compile / dispatch / d2h), cheap enough
+to stay on in production and surfaced by ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.compile_cache import StageCounters, jit_cache_size
+from ..ops.padding import bucket_size, pad_axis
+from ..stages.batching import PrefetchIterator, batch_slices
+
+__all__ = ["BatchRunner"]
+
+
+class BatchRunner:
+    """Run one partition's rows through a jitted program in padded batches.
+
+    ``coerce(sl) -> {feed name: host ndarray}`` is the model-specific part
+    (column lookup, dtype coercion, reshape); everything downstream —
+    padding, placement, dispatch, drain, instrumentation — is shared.
+    """
+
+    def __init__(self, jitted, params,
+                 coerce: Callable[[slice], Dict[str, np.ndarray]],
+                 put: Callable, shards: int = 1, mini_batch_size: int = 64,
+                 prefetch_depth: int = 2,
+                 counters: Optional[StageCounters] = None):
+        self.jitted = jitted
+        self.params = params
+        self.coerce = coerce
+        self.put = put
+        self.shards = max(1, int(shards))
+        self.mini_batch_size = max(1, int(mini_batch_size))
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.counters = counters if counters is not None else StageCounters()
+
+    # -- host side: coerce + pad (runs on the prefetch worker) ---------------
+    def _prepare(self, sl: slice) -> Tuple[Dict[str, np.ndarray], int]:
+        c = self.counters
+        with c.timer("coerce"):
+            feeds = self.coerce(sl)
+        b = 0
+        with c.timer("pad"):
+            padded_feeds = {}
+            for name, arr in feeds.items():
+                b = len(arr)
+                padded = bucket_size(b)
+                padded = -(-padded // self.shards) * self.shards
+                padded_feeds[name] = pad_axis(arr, padded)
+        return padded_feeds, b
+
+    def _prepared_batches(self, n_rows: int):
+        slices = batch_slices(n_rows, self.mini_batch_size)
+        gen = (self._prepare(sl) for sl in slices)
+        if self.prefetch_depth > 0 and len(slices) > 1:
+            # batch k+1's coerce/pad overlaps batch k's h2d + dispatch; the
+            # depth bound caps host memory at that many prepared batches
+            return PrefetchIterator(gen, depth=self.prefetch_depth)
+        return gen
+
+    # -- device side: feed, dispatch, overlapped drain -----------------------
+    def run(self, n_rows: int) -> List[Tuple[dict, int]]:
+        """Dispatch every minibatch; returns [(device outputs, valid rows)].
+
+        JAX dispatch returns futures, so the loop never blocks on compute;
+        each batch's outputs start their device→host copy immediately
+        (``copy_to_host_async``) instead of at partition end.
+        """
+        c = self.counters
+        pending: List[Tuple[dict, int]] = []
+        for feeds_host, b in self._prepared_batches(n_rows):
+            nbytes = sum(a.nbytes for a in feeds_host.values())
+            with c.timer("h2d", nbytes):
+                feeds = {k: self.put(v) for k, v in feeds_host.items()}
+            before = jit_cache_size(self.jitted)
+            t0 = time.perf_counter()
+            outs = self.jitted(self.params, feeds)
+            elapsed = time.perf_counter() - t0
+            after = jit_cache_size(self.jitted)
+            if before is not None and after is not None and after > before:
+                # the dispatch call blocked on trace+compile — a bucket the
+                # warm-up vocabulary missed; attribute the stall honestly
+                c.add("compile", elapsed, count=after - before)
+            else:
+                c.add("dispatch", elapsed)
+            for v in outs.values():
+                try:
+                    v.copy_to_host_async()
+                except Exception:
+                    break  # backend without async copy; drain still works
+            pending.append((outs, b))
+        return pending
+
+    def drain(self, pending: List[Tuple[dict, int]]
+              ) -> List[Tuple[Dict[str, np.ndarray], int]]:
+        """One batched device→host fetch over every pending output."""
+        if not pending:
+            return []
+        t0 = time.perf_counter()
+        host = jax.device_get([outs for outs, _ in pending])
+        elapsed = time.perf_counter() - t0
+        nbytes = sum(a.nbytes for outs in host for a in outs.values())
+        self.counters.add("d2h", elapsed, nbytes)
+        return [(outs, b) for outs, (_, b) in zip(host, pending)]
+
+    def run_and_drain(self, n_rows: int
+                      ) -> List[Tuple[Dict[str, np.ndarray], int]]:
+        return self.drain(self.run(n_rows))

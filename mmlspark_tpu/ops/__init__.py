@@ -1,8 +1,13 @@
+from .compile_cache import (StageCounters, enable_persistent_cache,
+                            jit_cache_size, persistent_cache_dir,
+                            warm_up_jitted)
 from .flash_attention import (flash_attention, flash_attention_sharded,
                               flash_attention_with_stats)
 from .padding import (PaddedBatch, bucket_size, default_buckets, pad_axis,
                       pad_batch, unpad)
 
-__all__ = ["PaddedBatch", "bucket_size", "default_buckets", "flash_attention",
+__all__ = ["PaddedBatch", "StageCounters", "bucket_size", "default_buckets",
+           "enable_persistent_cache", "flash_attention",
            "flash_attention_sharded", "flash_attention_with_stats",
-           "pad_axis", "pad_batch", "unpad"]
+           "jit_cache_size", "pad_axis", "pad_batch",
+           "persistent_cache_dir", "unpad", "warm_up_jitted"]

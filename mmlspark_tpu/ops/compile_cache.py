@@ -1,0 +1,291 @@
+"""Compilation-cache management and AOT bucket warm-up.
+
+XLA compiles one executable per (program, input shapes, placement) triple, and
+that compile lands — multi-second for real graphs — on whatever request is
+unlucky enough to arrive first in each padding bucket. This module removes the
+stall from both ends:
+
+* **Persistent compilation cache** — :func:`enable_persistent_cache` wires
+  JAX's on-disk executable cache (env ``MMLSPARK_TPU_COMPILE_CACHE_DIR``), so
+  a process restart deserializes yesterday's executables instead of
+  recompiling them. TVM (arxiv 1802.04799) and ONNX-MLIR (arxiv 2008.08272)
+  both land on the same conclusion: once the graph is static, inference
+  performance is decided at the compile-cache and host↔device boundary.
+* **AOT warm-up** — :func:`warm_up_jitted` drives a jitted program through
+  every padding-bucket shape in the expected vocabulary *before* first
+  traffic, populating the in-process jit cache (and, when enabled, the
+  persistent cache). ``ONNXModel.warm_up`` / ``JaxModel.warm_up`` and the
+  ``ServingEngine`` pre-serve hook are thin wrappers over this.
+* **Stage counters** — :class:`StageCounters` instruments the feed/drain
+  pipeline (coerce / pad / h2d / compile / dispatch / d2h) with near-zero
+  overhead so ``bench.py`` can report where partition wall-clock actually
+  goes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .padding import bucket_size
+
+__all__ = ["enable_persistent_cache", "persistent_cache_dir", "StageCounters",
+           "jit_cache_size", "warm_up_jitted", "warm_up_model",
+           "resolve_input_specs"]
+
+#: environment variable naming the persistent compilation cache directory
+CACHE_DIR_ENV = "MMLSPARK_TPU_COMPILE_CACHE_DIR"
+
+_cache_lock = threading.Lock()
+_cache_dir: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument → ``MMLSPARK_TPU_COMPILE_CACHE_DIR``
+    → legacy ``MMLSPARK_TPU_COMPILE_CACHE`` (the package-import knob in
+    :mod:`mmlspark_tpu.utils.jit_cache`, which now delegates here) →
+    ``JAX_COMPILATION_CACHE_DIR`` (which JAX honors on its own; we only
+    record it). Returns the active directory, or ``None`` when no directory
+    is configured anywhere. Idempotent and thread-safe; the min-compile-time
+    and min-entry-size gates are zeroed so small graphs (unit-test MLPs,
+    per-bucket variants of one model) are cached too — the default 1 s gate
+    would silently skip exactly the programs serving warm-up cares about.
+    """
+    global _cache_dir
+    with _cache_lock:
+        path = (cache_dir or os.environ.get(CACHE_DIR_ENV)
+                or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE")
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+        if not path:
+            return None
+        if _cache_dir == path:
+            return _cache_dir
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, val in [("jax_persistent_cache_min_compile_time_secs", 0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes", 0)]:
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass  # knob renamed/absent on this jax version
+        _cache_dir = path
+        return _cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory wired by :func:`enable_persistent_cache`, if any."""
+    return _cache_dir
+
+
+def jit_cache_size(jitted) -> Optional[int]:
+    """Entries in a jitted callable's in-process executable cache.
+
+    ``None`` when the introspection hook is unavailable (older/newer jax) —
+    callers must treat that as "unknown", not zero.
+    """
+    try:
+        return int(jitted._cache_size())
+    except Exception:
+        return None
+
+
+class StageCounters:
+    """Lightweight per-stage timing/byte counters for the feed/drain pipeline.
+
+    Stages are free-form strings; the runner uses ``coerce``, ``pad``,
+    ``h2d``, ``compile``, ``dispatch``, ``d2h``. Thread-safe (partitions run
+    concurrently); ~100 ns per ``add``, so it stays on in production. The
+    compile/dispatch split is attributed by observing jit-cache growth
+    around each dispatch, so under concurrent partitions a compile may be
+    double-attributed — counters are diagnostics, not an audit log.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages: Dict[str, Dict[str, float]] = {}
+
+    def add(self, stage: str, seconds: float, nbytes: int = 0,
+            count: int = 1) -> None:
+        with self._lock:
+            s = self._stages.setdefault(
+                stage, {"calls": 0, "seconds": 0.0, "bytes": 0})
+            s["calls"] += count
+            s["seconds"] += seconds
+            s["bytes"] += nbytes
+
+    class _Timer:
+        __slots__ = ("_c", "_stage", "_nbytes", "_t0")
+
+        def __init__(self, counters, stage, nbytes):
+            self._c, self._stage, self._nbytes = counters, stage, nbytes
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._c.add(self._stage, time.perf_counter() - self._t0,
+                        self._nbytes)
+            return False
+
+    def timer(self, stage: str, nbytes: int = 0) -> "StageCounters._Timer":
+        return self._Timer(self, stage, nbytes)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: {"calls": int(v["calls"]),
+                        "seconds": round(float(v["seconds"]), 6),
+                        "bytes": int(v["bytes"])}
+                    for k, v in sorted(self._stages.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+
+    def total_seconds(self, stage: str) -> float:
+        with self._lock:
+            s = self._stages.get(stage)
+            return float(s["seconds"]) if s else 0.0
+
+
+def resolve_input_specs(inputs: Iterable, feed: Dict[str, str],
+                        transpose: Dict[str, Sequence[int]],
+                        overrides: Optional[Dict[str, tuple]] = None
+                        ) -> Dict[str, Tuple[np.dtype, tuple]]:
+    """Per-row (dtype, shape) of each *fed* model input, for warm-up zeros.
+
+    ``inputs`` are converted-model value infos (``.name``, ``.numpy_dtype``,
+    ``.shape``). Inputs routed through ``transpose_dict`` are fed in the
+    column's layout, so the declared (post-transpose) shape is run backwards
+    through the permutation. ``overrides`` ({name: (dtype, row_shape)}) wins
+    outright — required when the declared shape is symbolic, or when the
+    column's dtype differs from the graph's (uint8 images into a float
+    input).
+    """
+    overrides = dict(overrides or {})
+    specs: Dict[str, Tuple[np.dtype, tuple]] = {}
+    for vi in inputs:
+        if vi.name not in feed:
+            continue
+        if vi.name in overrides:
+            dt, shape = overrides[vi.name]
+            specs[vi.name] = (np.dtype(dt), tuple(shape))
+            continue
+        declared = list(vi.shape)
+        perm = transpose.get(vi.name)
+        if perm is not None:
+            if len(perm) != len(declared):
+                raise ValueError(
+                    f"transpose_dict[{vi.name!r}] permutes {len(perm)} axes "
+                    f"but the input declares {len(declared)}")
+            fed = [None] * len(declared)
+            for i, p in enumerate(perm):
+                fed[p] = declared[i]
+            declared = fed
+        row_shape = declared[1:]
+        if any(not isinstance(d, int) for d in row_shape):
+            raise ValueError(
+                f"input {vi.name!r} has symbolic per-row shape {row_shape}; "
+                f"pass input_specs={{{vi.name!r}: (dtype, row_shape)}} to "
+                f"warm_up")
+        specs[vi.name] = (np.dtype(vi.numpy_dtype), tuple(row_shape))
+    return specs
+
+
+def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
+                   batch_sizes: Sequence[int], shards: int = 1,
+                   put: Optional[Callable] = None,
+                   counters: Optional[StageCounters] = None) -> dict:
+    """Compile (and prime the caches for) every padding-bucket shape.
+
+    For each requested batch size the *padded* feed size is derived exactly
+    as the runner derives it (``bucket_size`` then rounded up to a multiple
+    of ``shards``), zero-filled feeds are placed with ``put`` and run through
+    ``jitted`` once, blocking on the result. That single throwaway execution
+    is what populates jax's in-process jit cache — a bare
+    ``lower().compile()`` produces an executable but leaves the cache cold,
+    so the first real batch would still pay tracing + compile. With
+    :func:`enable_persistent_cache` active the compile also lands on disk
+    for the next process.
+
+    Returns ``{"buckets": [padded sizes], "compiles": n, "seconds": s}``.
+    ``compiles`` is ``None`` when the jit cache is not introspectable.
+    """
+    import jax
+
+    enable_persistent_cache()
+    if put is None:
+        put = jax.device_put
+    buckets = sorted({-(-bucket_size(int(b)) // max(1, shards))
+                      * max(1, shards) for b in batch_sizes if int(b) > 0})
+    before = jit_cache_size(jitted)
+    t_start = time.perf_counter()
+    for size in buckets:
+        feeds = {name: put(np.zeros((size,) + shape, dtype=dt))
+                 for name, (dt, shape) in specs.items()}
+        outs = jitted(params, feeds)
+        jax.block_until_ready(outs)
+    elapsed = time.perf_counter() - t_start
+    after = jit_cache_size(jitted)
+    compiles = (after - before) if (after is not None and before is not None) \
+        else None
+    if counters is not None and buckets:
+        counters.add("compile", elapsed, count=compiles or len(buckets))
+    return {"buckets": buckets, "compiles": compiles,
+            "seconds": round(elapsed, 4)}
+
+
+def warm_up_model(model, jitted, specs, batch_sizes,
+                  background: bool = False):
+    """Warm every placement a model's traffic can hit (shared by
+    ``ONNXModel.warm_up`` / ``JaxModel.warm_up``).
+
+    With round-robin chip pinning the jit cache keys on the committed
+    device, so every local chip gets its own warm pass; with a default mesh
+    (or unpinned default placement) one pass suffices. ``model`` supplies
+    ``_placement_params(pidx)``, ``mesh_sharded``/``pin_devices`` and its
+    ``stage_counters``. ``background=True`` runs on a daemon thread and
+    returns it; otherwise returns aggregated
+    ``{"buckets", "compiles", "seconds", "placements"}``.
+    """
+    from ..parallel.mesh import get_default_mesh, local_devices
+
+    def _warm():
+        n_placements = 1
+        if not (model.get("mesh_sharded") and get_default_mesh()
+                is not None) and model.pin_devices:
+            n_placements = max(1, len(local_devices()))
+        stats = {"buckets": [], "compiles": 0, "seconds": 0.0,
+                 "placements": 0}
+        seen = set()
+        for pidx in range(n_placements):
+            placement, params = model._placement_params(pidx)
+            if placement.key in seen:
+                continue
+            seen.add(placement.key)
+            s = warm_up_jitted(jitted, params, specs, batch_sizes,
+                               shards=placement.shards, put=placement.put,
+                               counters=model.stage_counters)
+            stats["buckets"] = sorted(set(stats["buckets"])
+                                      | set(s["buckets"]))
+            if s["compiles"] is None:
+                stats["compiles"] = None
+            elif stats["compiles"] is not None:
+                stats["compiles"] += s["compiles"]
+            stats["seconds"] = round(stats["seconds"] + s["seconds"], 4)
+            stats["placements"] += 1
+        return stats
+
+    if background:
+        t = threading.Thread(target=_warm, daemon=True,
+                             name=f"warmup-{model.uid}")
+        t.start()
+        return t
+    return _warm()

@@ -11,14 +11,15 @@ over the mesh with XLA collectives riding ICI.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["local_devices", "device_for_partition", "make_mesh",
-           "batch_placement", "data_parallel_sharding", "replicated_sharding",
+           "batch_placement", "feed_placement", "Placement",
+           "data_parallel_sharding", "replicated_sharding",
            "MeshContext", "get_default_mesh", "set_default_mesh"]
 
 
@@ -53,26 +54,54 @@ def device_for_partition(partition_index: int):
     return devs[partition_index % len(devs)]
 
 
-def batch_placement(use_mesh: bool, partition_index: int, pin_devices: bool):
+class Placement(NamedTuple):
+    """Where one partition's device feeds go, as one resolved policy.
+
+    ``mesh`` is set for SPMD dispatch (``device`` None), ``device`` for
+    chip-pinned dispatch (``mesh`` None), both None for default placement.
+    ``shards`` is the multiple the batch's leading dim must pad to; ``put``
+    places a host array accordingly. ``key`` is hashable and identifies the
+    placement for caching — params caches and warm-up bookkeeping key on it,
+    so "warmed for this placement" and "params live on this placement" can
+    never disagree about identity.
+    """
+
+    mesh: Optional[Mesh]
+    device: Optional[object]
+    shards: int
+    put: object
+    key: tuple
+
+
+def feed_placement(use_mesh: bool, partition_index: int,
+                   pin_devices: bool) -> Placement:
     """Resolve where a graph runner's host batches go — the one dispatch
     policy shared by ONNXModel and JaxModel.
 
-    Returns ``(mesh, device, shards, put)``: when ``use_mesh`` and a default
-    mesh is installed, batches shard their leading axis over the mesh's
-    first axis (``shards`` is the multiple the batch must pad to, ``put``
-    places with that sharding, ``device`` is None). Otherwise round-robin
-    chip pinning (or default placement), with ``shards == 1``.
+    When ``use_mesh`` and a default mesh is installed, batches shard their
+    leading axis over the mesh's first axis. Otherwise round-robin chip
+    pinning (or default placement), with ``shards == 1``.
     """
     if use_mesh:
         mesh = get_default_mesh()
         if mesh is not None:
             sh = NamedSharding(mesh, P(mesh.axis_names[0]))
-            return (mesh, None, int(mesh.shape[mesh.axis_names[0]]),
-                    lambda a, _s=sh: jax.device_put(a, _s))
+            return Placement(mesh, None,
+                             int(mesh.shape[mesh.axis_names[0]]),
+                             lambda a, _s=sh: jax.device_put(a, _s),
+                             ("mesh", mesh))
     device = device_for_partition(partition_index) if pin_devices else None
     if device is not None:
-        return None, device, 1, (lambda a, _d=device: jax.device_put(a, _d))
-    return None, None, 1, jax.device_put
+        return Placement(None, device, 1,
+                         lambda a, _d=device: jax.device_put(a, _d),
+                         ("device", id(device)))
+    return Placement(None, None, 1, jax.device_put, ("default",))
+
+
+def batch_placement(use_mesh: bool, partition_index: int, pin_devices: bool):
+    """Back-compat 4-tuple view of :func:`feed_placement`."""
+    p = feed_placement(use_mesh, partition_index, pin_devices)
+    return p.mesh, p.device, p.shards, p.put
 
 
 def make_mesh(axis_shapes: Optional[dict] = None,

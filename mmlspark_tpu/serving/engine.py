@@ -32,6 +32,13 @@ class ServingEngine:
 
     ``schema`` maps JSON body fields to column types; ``reply_col`` names the
     column whose values are JSON-encoded back to the caller.
+
+    ``warm_up`` is the pre-serve compile hook: a zero-arg callable (typically
+    ``model.warm_up`` or a ``functools.partial`` over it) invoked in
+    :meth:`start` before any dispatcher thread begins draining requests, so
+    the first request of each padding bucket never eats an XLA compile stall.
+    A warm-up failure is logged, not fatal — serving starts cold rather than
+    not at all.
     """
 
     def __init__(self, transform_fn: Callable[[DataFrame], DataFrame],
@@ -41,8 +48,10 @@ class ServingEngine:
                  max_batch: int = 1024, poll_timeout: float = 0.05,
                  reply_timeout: float = 60.0, n_dispatchers: int = 1,
                  journal_path: Optional[str] = None,
-                 transport: str = "threaded"):
+                 transport: str = "threaded",
+                 warm_up: Optional[Callable[[], object]] = None):
         self.transform_fn = transform_fn
+        self.warm_up = warm_up
         self.schema = schema
         self.reply_col = reply_col
         self.max_batch = max_batch
@@ -65,6 +74,12 @@ class ServingEngine:
         return self.server.address
 
     def start(self) -> "ServingEngine":
+        if self.warm_up is not None:
+            try:
+                self.warm_up()
+            except Exception:
+                _log.error("pre-serve warm-up failed (serving starts cold):"
+                           "\n%s", traceback.format_exc())
         for i in range(self.n_dispatchers):
             t = threading.Thread(
                 target=self._loop, daemon=True,

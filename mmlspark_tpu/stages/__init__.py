@@ -1,6 +1,7 @@
 from .batching import (DynamicBufferedBatcher, DynamicMiniBatchTransformer,
                        FixedMiniBatchTransformer, FlattenBatch, HasMiniBatcher,
-                       TimeIntervalBatcher, TimeIntervalMiniBatchTransformer)
+                       PrefetchIterator, TimeIntervalBatcher,
+                       TimeIntervalMiniBatchTransformer)
 from .misc import (Cacher, ClassBalancer, ClassBalancerModel, DropColumns,
                    EnsembleByKey, Explode, Lambda, MultiColumnAdapter,
                    PartitionConsolidator, RenameColumn, Repartition,
@@ -11,7 +12,7 @@ from .misc import (Cacher, ClassBalancer, ClassBalancerModel, DropColumns,
 __all__ = [
     "FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
     "TimeIntervalMiniBatchTransformer", "FlattenBatch", "HasMiniBatcher",
-    "DynamicBufferedBatcher", "TimeIntervalBatcher",
+    "DynamicBufferedBatcher", "TimeIntervalBatcher", "PrefetchIterator",
     "Cacher", "DropColumns", "SelectColumns", "RenameColumn", "Repartition",
     "Explode", "Lambda", "UDFTransformer", "MultiColumnAdapter",
     "ClassBalancer", "ClassBalancerModel", "EnsembleByKey",

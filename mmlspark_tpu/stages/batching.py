@@ -34,7 +34,8 @@ from ..core.pipeline import Transformer
 
 __all__ = ["FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
            "TimeIntervalMiniBatchTransformer", "FlattenBatch", "HasMiniBatcher",
-           "DynamicBufferedBatcher", "TimeIntervalBatcher", "batch_slices"]
+           "DynamicBufferedBatcher", "TimeIntervalBatcher", "PrefetchIterator",
+           "batch_slices"]
 
 
 def _stack_cell(col: np.ndarray) -> object:
@@ -181,6 +182,64 @@ class HasMiniBatcher(Params):
 # Streaming batchers (serving / iterator paths)
 # ---------------------------------------------------------------------------
 
+class _QueueProducer:
+    """A daemon thread draining ``it`` into a bounded queue.
+
+    The shared producer half of every streaming batcher here (reference
+    ``DynamicBufferedBatcher``, Batchers.scala:12-56): items flow into
+    ``self.queue`` capped at ``max_buffer_size`` (this bound is what keeps
+    host memory finite when the producer outruns the consumer), a sentinel
+    marks exhaustion, and a producer-side exception is parked for the
+    consumer to re-raise.
+    """
+
+    SENTINEL = object()
+
+    def __init__(self, it: Iterable, max_buffer_size: int):
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
+        self._error: List[BaseException] = []
+
+        def produce():
+            try:
+                for item in it:
+                    self.queue.put(item)
+            except BaseException as e:  # surfaced on the consumer side
+                self._error.append(e)
+            finally:
+                self.queue.put(self.SENTINEL)
+
+        self.thread = threading.Thread(target=produce, daemon=True)
+        self.thread.start()
+
+    def raise_pending(self) -> None:
+        if self._error:
+            raise self._error[0]
+
+
+class PrefetchIterator:
+    """Bounded in-order background prefetch over any iterator.
+
+    ``depth`` items are computed ahead on the producer thread while the
+    consumer works on the current one — the host-side half of the device
+    pipeline (coerce/pad of batch k+1 overlapping dispatch of batch k), with
+    the queue bound capping host memory at ``depth`` prepared batches. Unlike
+    :class:`DynamicBufferedBatcher`, items come out one at a time and in
+    order: device feeds must stay aligned with their row slices.
+    """
+
+    def __init__(self, it: Iterable, depth: int = 2):
+        self._producer = _QueueProducer(it, max_buffer_size=max(1, int(depth)))
+
+    def __iter__(self) -> Iterator:
+        q = self._producer.queue
+        while True:
+            item = q.get()
+            if item is _QueueProducer.SENTINEL:
+                break
+            yield item
+        self._producer.raise_pending()
+
+
 class DynamicBufferedBatcher:
     """Background-thread prefetching batcher over a row iterator.
 
@@ -189,44 +248,29 @@ class DynamicBufferedBatcher:
     currently available* into one batch.
     """
 
-    _SENTINEL = object()
-
     def __init__(self, it: Iterable, max_buffer_size: int = 1024):
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
-        self._error: List[BaseException] = []
-
-        def produce():
-            try:
-                for row in it:
-                    self._queue.put(row)
-            except BaseException as e:  # surfaced on the consumer side
-                self._error.append(e)
-            finally:
-                self._queue.put(self._SENTINEL)
-
-        self._thread = threading.Thread(target=produce, daemon=True)
-        self._thread.start()
+        self._producer = _QueueProducer(it, max_buffer_size)
         self._done = False
 
     def __iter__(self) -> Iterator[List]:
+        q = self._producer.queue
         while not self._done:
-            first = self._queue.get()
-            if first is self._SENTINEL:
+            first = q.get()
+            if first is _QueueProducer.SENTINEL:
                 self._done = True
                 break
             batch = [first]
             while True:
                 try:
-                    nxt = self._queue.get_nowait()
+                    nxt = q.get_nowait()
                 except queue.Empty:
                     break
-                if nxt is self._SENTINEL:
+                if nxt is _QueueProducer.SENTINEL:
                     self._done = True
                     break
                 batch.append(nxt)
             yield batch
-        if self._error:
-            raise self._error[0]
+        self._producer.raise_pending()
 
 
 class TimeIntervalBatcher:
@@ -237,27 +281,14 @@ class TimeIntervalBatcher:
     flushed when the window elapses even if the source stream stalls.
     """
 
-    _SENTINEL = object()
-
     def __init__(self, it: Iterable, millis: int = 1000,
                  max_batch_size: int = 1 << 30, max_buffer_size: int = 1024):
         self._millis = millis
         self._max_batch = max_batch_size
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
-        self._error: List[BaseException] = []
-
-        def produce():
-            try:
-                for row in it:
-                    self._queue.put(row)
-            except BaseException as e:
-                self._error.append(e)
-            finally:
-                self._queue.put(self._SENTINEL)
-
-        threading.Thread(target=produce, daemon=True).start()
+        self._producer = _QueueProducer(it, max_buffer_size)
 
     def __iter__(self) -> Iterator[List]:
+        q = self._producer.queue
         pending: List = []
         window = self._millis / 1e3
         deadline = time.monotonic() + window
@@ -265,8 +296,8 @@ class TimeIntervalBatcher:
         while not done:
             timeout = max(0.0, deadline - time.monotonic())
             try:
-                item = self._queue.get(timeout=timeout)
-                if item is self._SENTINEL:
+                item = q.get(timeout=timeout)
+                if item is _QueueProducer.SENTINEL:
                     done = True
                 else:
                     pending.append(item)
@@ -281,5 +312,4 @@ class TimeIntervalBatcher:
                 yield pending
                 pending = []
                 deadline = now + window
-        if self._error:
-            raise self._error[0]
+        self._producer.raise_pending()

@@ -28,16 +28,17 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
     ``MMLSPARK_TPU_COMPILE_CACHE`` env var). Returns whether it is on —
     derived from ``jax.config`` itself, the single source of truth (a
     separate flag could desync across reloads or external config edits).
-    Safe to call repeatedly; a missing directory is created."""
+    Safe to call repeatedly; a missing directory is created.
+
+    The wiring itself lives in :mod:`mmlspark_tpu.ops.compile_cache` (one
+    implementation for this knob, the serving warm-up path, and
+    ``JAX_COMPILATION_CACHE_DIR``); this wrapper keeps the historical
+    bool-returning API.
+    """
     import jax
-    cache_dir = cache_dir or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE")
-    if cache_dir:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        # cache everything: the default min-size/min-time gates skip
-        # exactly the many small programs a pipeline framework dispatches
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from ..ops.compile_cache import enable_persistent_cache as _enable
+    _enable(cache_dir or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE"))
     return bool(jax.config.jax_compilation_cache_dir)
 
 

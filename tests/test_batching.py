@@ -153,3 +153,51 @@ class TestTimeIntervalMiniBatchTransformer:
         df = self._frame(np.arange(4, dtype=np.int64))
         out = TimeIntervalMiniBatchTransformer().transform(df)
         assert len(out) == 1 and len(out["v"][0]) == 4
+
+
+class TestPrefetchIterator:
+    def test_order_preserved(self):
+        from mmlspark_tpu.stages.batching import PrefetchIterator
+        assert list(PrefetchIterator(iter(range(50)), depth=3)) \
+            == list(range(50))
+
+    def test_empty_source(self):
+        from mmlspark_tpu.stages.batching import PrefetchIterator
+        assert list(PrefetchIterator(iter([]), depth=2)) == []
+
+    def test_producer_error_surfaces_on_consumer(self):
+        from mmlspark_tpu.stages.batching import PrefetchIterator
+
+        def gen():
+            yield 1
+            yield 2
+            raise ValueError("producer died")
+
+        it = iter(PrefetchIterator(gen(), depth=2))
+        got = []
+        with pytest.raises(ValueError, match="producer died"):
+            for x in it:
+                got.append(x)
+        assert got == [1, 2]   # items before the error still arrive in order
+
+    def test_depth_bounds_readahead(self):
+        import threading
+        from mmlspark_tpu.stages.batching import PrefetchIterator
+        produced = []
+        release = threading.Event()
+
+        def gen():
+            for i in range(100):
+                produced.append(i)
+                yield i
+
+        it = iter(PrefetchIterator(gen(), depth=2))
+        first = next(it)
+        assert first == 0
+        # give the producer time to run ahead as far as the queue allows:
+        # at most depth queued + one in flight + the one consumed
+        deadline = __import__("time").monotonic() + 2.0
+        while len(produced) < 4 and __import__("time").monotonic() < deadline:
+            __import__("time").sleep(0.01)
+        assert 1 <= len(produced) <= 4
+        assert list(it) == list(range(1, 100))

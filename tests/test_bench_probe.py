@@ -129,3 +129,44 @@ os.replace(out + ".tmp", out)
     finally:
         if os.path.exists(flag):
             os.remove(flag)
+
+
+class TestOneShotReport:
+    """The wall-clock-budget contract: exactly one JSON line, no matter
+    which thread (main path or watchdog) reaches the deadline first."""
+
+    def test_emits_once(self, capsys):
+        rec = {"value": 1}
+        rep = bench._OneShotReport(rec)
+        assert rep.emit() is True
+        assert rep.emit() is False          # second caller loses the race
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        import json
+        assert json.loads(out[0]) == {"value": 1}
+
+    def test_in_place_mutation_is_visible(self, capsys):
+        # main() must update the shared dict in place (never rebind it):
+        # the watchdog holds a reference to the original object
+        rec = {"value": 0}
+        rep = bench._OneShotReport(rec)
+        rec["value"] = 42
+        rec["stage_counters"] = {"h2d": {"calls": 1}}
+        rep.emit()
+        import json
+        got = json.loads(capsys.readouterr().out)
+        assert got["value"] == 42
+        assert got["stage_counters"]["h2d"]["calls"] == 1
+
+    def test_concurrent_emit_single_line(self, capsys):
+        import threading
+        rep = bench._OneShotReport({"x": 1})
+        wins = []
+        ts = [threading.Thread(target=lambda: wins.append(rep.emit()))
+              for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sum(wins) == 1
+        assert len(capsys.readouterr().out.strip().splitlines()) == 1

@@ -221,3 +221,43 @@ def test_string_array_dtype_roundtrip(tmp_path):
     back = load_value(tag, p)
     assert back["labels"].dtype == arr.dtype
     assert list(back["labels"]) == list(arr)
+
+
+class TestSharedPartitionPool:
+    def test_pool_reused_across_calls(self):
+        from mmlspark_tpu.core import dataframe as dfmod
+        a = dfmod._shared_pool(4)
+        b = dfmod._shared_pool(4)
+        assert a is b
+        assert dfmod._shared_pool(2) is not a
+
+    def test_map_partitions_unchanged_semantics(self):
+        df = DataFrame({"x": np.arange(20)}, npartitions=4)
+        out = df.map_partitions(
+            lambda p, i: p.with_column("y", p["x"] * 2))
+        np.testing.assert_array_equal(out["y"], np.arange(20) * 2)
+        np.testing.assert_array_equal(out["x"], np.arange(20))
+
+    def test_nested_map_partitions_does_not_deadlock(self):
+        # inner call from a pool worker must take the sequential path
+        # rather than queue on the same (possibly saturated) executor
+        df = DataFrame({"x": np.arange(16)}, npartitions=4)
+
+        def outer(p, i):
+            inner = DataFrame({"x": np.asarray(p["x"])}, npartitions=2)
+            return inner.map_partitions(
+                lambda q, j: q.with_column("y", q["x"] + 1))
+
+        out = df.map_partitions(outer)
+        np.testing.assert_array_equal(out["y"], np.arange(16) + 1)
+
+    def test_exception_still_propagates(self):
+        df = DataFrame({"x": np.arange(8)}, npartitions=4)
+
+        def boom(p, i):
+            if i == 2:
+                raise RuntimeError("partition 2 failed")
+            return p
+
+        with pytest.raises(RuntimeError, match="partition 2"):
+            df.map_partitions(boom)

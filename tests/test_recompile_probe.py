@@ -1,0 +1,74 @@
+"""Micro-bench recompile probe (tier-1-safe, CPU).
+
+The steady-state contract ``bench.py`` relies on, asserted as a fast test:
+after the first batch of a padding bucket is served, further same-bucket
+batches must be pure cache hits — zero XLA recompiles, no compile-stage
+counter growth. A regression here (a jit signature that keys on batch
+identity, a cache invalidated between calls, a pad size that drifts) would
+silently turn every production batch into a multi-second compile.
+"""
+
+import numpy as np
+
+import mmlspark_tpu.onnx as O
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.models.onnx_model import ONNXModel
+from mmlspark_tpu.ops.compile_cache import jit_cache_size
+
+
+def _model(din=8, dout=3):
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.5, (din, dout)).astype(np.float32)
+    b = np.zeros(dout, dtype=np.float32)
+    graph = O.make_graph(
+        [O.make_node("MatMul", ["x", "w"], ["h"]),
+         O.make_node("Add", ["h", "b"], ["y"])],
+        "probe",
+        inputs=[O.make_tensor_value_info("x", np.float32, ["N", din])],
+        outputs=[O.make_tensor_value_info("y", np.float32, ["N", dout])],
+        initializers={"w": w, "b": b})
+    return ONNXModel(O.make_model(graph), feed_dict={"x": "feats"},
+                     fetch_dict={"y": "y"}, mini_batch_size=8,
+                     pin_devices=False), (w, b)
+
+
+def _df(n, din=8, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, din)).astype(np.float32)
+    return DataFrame({"feats": [X[i] for i in range(n)]}), X
+
+
+def test_second_same_bucket_batch_is_compile_free():
+    m, (w, b) = _model()
+    df1, X1 = _df(8, seed=1)
+    df2, X2 = _df(8, seed=2)
+
+    out1 = m.transform(df1)            # first batch pays the compile
+    jitted = m._ensure_jitted()
+    cache_after_first = jit_cache_size(jitted)
+    assert cache_after_first is not None and cache_after_first >= 1
+    compile_calls_after_first = \
+        m.stage_counters.snapshot().get("compile", {}).get("calls", 0)
+
+    out2 = m.transform(df2)            # same bucket → must be a cache hit
+    snap = m.stage_counters.snapshot()
+    assert jit_cache_size(jitted) == cache_after_first
+    assert snap.get("compile", {}).get("calls", 0) \
+        == compile_calls_after_first
+    assert snap["dispatch"]["calls"] >= 1
+
+    # and both batches computed the right thing
+    np.testing.assert_allclose(np.stack(list(out1["y"])), X1 @ w + b,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.stack(list(out2["y"])), X2 @ w + b,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_warmed_model_first_batch_is_compile_free():
+    m, _ = _model()
+    m.warm_up(batch_sizes=[8])
+    jitted = m._ensure_jitted()
+    size = jit_cache_size(jitted)
+    df, _ = _df(8)
+    m.transform(df)
+    assert jit_cache_size(jitted) == size
