@@ -13,11 +13,9 @@ solve (``regression.py``).
 
 from __future__ import annotations
 
-from typing import List, Optional
-
 import numpy as np
 
-from ..core.dataframe import DataFrame, concat
+from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Transformer
 

@@ -9,7 +9,7 @@ with that feature replaced for every instance, and emit per-instance curves
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -8,7 +8,7 @@ variants ``TabularLIME.scala:160``, ``VectorLIME``, ``TextLIME.scala:88``,
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

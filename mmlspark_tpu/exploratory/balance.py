@@ -10,7 +10,7 @@ inequality indices.
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List
+from typing import Dict
 
 import numpy as np
 

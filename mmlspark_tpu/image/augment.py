@@ -7,8 +7,6 @@ dataset for training).
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..core.dataframe import DataFrame, concat
 from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer

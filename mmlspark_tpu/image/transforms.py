@@ -12,7 +12,7 @@ matches the main class at ``ImageTransformer.scala:417+``.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -28,7 +28,6 @@ __all__ = ["ImageTransformer", "ResizeImage", "CropImage", "CenterCropImage",
 def _cv2():
     import cv2
     return cv2
-
 
 # -- op implementations (image: HWC uint8 ndarray → ndarray) -----------------
 

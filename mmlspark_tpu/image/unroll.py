@@ -8,14 +8,12 @@ flat CHW double vector, with ``roll`` inverse), ``UnrollBinaryImage:187``
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..core.dataframe import DataFrame, object_col
 from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
-from .schema import ImageSchema, decode_image, make_image
+from .schema import decode_image, make_image
 
 __all__ = ["unroll", "roll", "UnrollImage", "UnrollBinaryImage",
            "ResizeImageTransformer"]

@@ -14,7 +14,7 @@ from ...core.dataframe import DataFrame, object_col
 from ...core.params import ComplexParam, HasInputCol, HasOutputCol, Param
 from ...core.serialize import to_jsonable
 from ...core.pipeline import Transformer
-from .schema import HeaderData, HTTPRequestData, HTTPResponseData
+from .schema import HeaderData, HTTPRequestData
 
 __all__ = ["HTTPInputParser", "JSONInputParser", "CustomInputParser",
            "HTTPOutputParser", "JSONOutputParser", "StringOutputParser",

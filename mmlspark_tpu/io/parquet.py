@@ -16,8 +16,6 @@ import glob as _glob
 import os
 from typing import List, Optional, Sequence, Union
 
-import numpy as np
-
 from ..core.dataframe import DataFrame, concat
 
 __all__ = ["read_parquet", "write_parquet", "read_csv"]

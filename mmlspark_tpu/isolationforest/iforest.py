@@ -10,7 +10,7 @@ tree in lockstep numpy steps.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -25,8 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .binning import BinMapper
-from .trees import predict_leaf_indices, predict_trees, predict_trees_any
+from .trees import predict_leaf_indices, predict_trees_any
 
 __all__ = ["Booster"]
 

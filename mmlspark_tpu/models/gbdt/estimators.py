@@ -20,11 +20,10 @@ from ...core.dataframe import DataFrame
 from ...core.params import (ComplexParam, Param, HasFeaturesCol, HasLabelCol,
                             HasPredictionCol, HasProbabilityCol, HasWeightCol)
 from ...core.pipeline import Estimator, Model
-from ...core.schema import (assemble_features, get_label_metadata,
-                            set_label_metadata)
+from ...core.schema import assemble_features, set_label_metadata
 from ...parallel.mesh import get_default_mesh
 from .booster import Booster
-from .train import resolve_params, train
+from .train import train
 
 __all__ = ["LightGBMClassifier", "LightGBMRegressor", "LightGBMRanker",
            "LightGBMClassificationModel", "LightGBMRegressionModel",

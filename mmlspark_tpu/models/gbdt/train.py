@@ -220,6 +220,8 @@ class _PhaseProf:
         if not self.enabled:
             return
         for a in sync:
+            # tpulint: disable=TPU001 — opt-in profiler: the fence IS the
+            # measurement (off unless MMLSPARK_TPU_GBDT_PROF=1)
             jax.block_until_ready(a)
         now = time.perf_counter()
         self.t[name] = self.t.get(name, 0.0) + (now - self._last)

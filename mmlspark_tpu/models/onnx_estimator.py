@@ -19,7 +19,7 @@ fine-tuning means returning to the exporting framework. Two objectives:
 from __future__ import annotations
 
 import io
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

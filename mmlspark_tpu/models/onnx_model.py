@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
-from ..core.pipeline import Model, Transformer
+from ..core.pipeline import Model
 from ..onnx.convert import ConvertedModel, convert_model
 from ..ops.compile_cache import (StageCounters, resolve_input_specs,
                                  warm_up_model)
@@ -348,7 +348,6 @@ class ONNXModel(Model):
 
     def _params_for_mesh(self, mesh) -> dict:
         """Weights replicated over the mesh (cached per mesh)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel.mesh import replicated_sharding
         key = ("mesh", mesh)
         with self._params_lock:

@@ -1,0 +1,29 @@
+# Hand-written stub (runner.py defines no PipelineStage, so codegen skips
+# it); kept in sync by tpulint rule TPU006 (stub-drift).
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.ops.compile_cache import StageCounters
+
+class BatchRunner:
+    jitted: Any
+    params: Any
+    coerce: Callable[[slice], Dict[str, np.ndarray]]
+    put: Callable[..., Any]
+    shards: int
+    mini_batch_size: int
+    prefetch_depth: int
+    counters: StageCounters
+    def __init__(self, jitted: Any, params: Any,
+                 coerce: Callable[[slice], Dict[str, np.ndarray]],
+                 put: Callable[..., Any], shards: int = ...,
+                 mini_batch_size: int = ..., prefetch_depth: int = ...,
+                 counters: Optional[StageCounters] = ...) -> None: ...
+    def run(self, n_rows: int) -> List[Tuple[dict, int]]: ...
+    def drain(self, pending: List[Tuple[dict, int]]
+              ) -> List[Tuple[Dict[str, np.ndarray], int]]: ...
+    def run_and_drain(self, n_rows: int
+                      ) -> List[Tuple[Dict[str, np.ndarray], int]]: ...
+
+def __getattr__(name: str) -> Any: ...

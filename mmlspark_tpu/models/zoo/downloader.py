@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["ModelSchema", "ModelDownloader", "LocalRepo", "BUILTIN_MODELS"]
 
@@ -59,7 +59,6 @@ def _gen_vit_b16() -> bytes:
     return export_vit_onnx(ViTConfig(image_size=224, patch=16, d_model=768,
                                      heads=12, layers=12, d_ff=3072,
                                      num_classes=1000), seed=0)
-
 
 BUILTIN_MODELS: Dict[str, tuple] = {
     # name → (schema, generator)

@@ -16,7 +16,7 @@ Reference parity: the reference runs ResNet-class models through
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,10 +34,8 @@ class ResNetConfig:
         self.width = width
         self.dtype = dtype
 
-
 RESNET50 = ResNetConfig([3, 4, 6, 3])
 RESNET18_CFG = ResNetConfig([2, 2, 2, 2])
-
 
 # -- native NHWC implementation ---------------------------------------------
 

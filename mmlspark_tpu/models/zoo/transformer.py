@@ -18,7 +18,7 @@ and activations are laid out over a ``Mesh(('dp','tp'))``:
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

@@ -12,6 +12,11 @@ ConditionalKNN case (per-query label filters) the reference runs on the JVM.
 
 from __future__ import annotations
 
+# tpulint: disable-file=TPU004 — deliberate host-side float64: the tree is
+# exact branch-and-bound geometry on the host (reference-parity with the
+# JVM BallTree); nothing here feeds a device, the bulk path in knn.py
+# casts to float32 before device_put.
+
 import heapq
 from typing import Dict, List, Optional, Sequence
 

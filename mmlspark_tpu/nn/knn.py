@@ -13,8 +13,6 @@ Conditional queries (per-row label filters) use the host ball tree.
 
 from __future__ import annotations
 
-from typing import List, Optional
-
 import numpy as np
 
 from ..core.dataframe import DataFrame
@@ -26,12 +24,15 @@ __all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
 
 
 def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
+    # deliberate host-side float64 (exact distances for tie-stable top-k);
+    # the device path in brute_force_knn casts to float32 at jnp.asarray
     vals = df[col]
     if vals.dtype == object:
+        # tpulint: disable=TPU004 — host-exact f64, cast f32 before device
         return np.stack([np.asarray(v, dtype=np.float64).ravel()
                          for v in vals])
+    # tpulint: disable=TPU004 — host-exact f64, cast f32 before device
     return np.asarray(vals, dtype=np.float64).reshape(len(df), -1)
-
 
 _BRUTE_KNN = None
 
@@ -65,6 +66,7 @@ def brute_force_knn(corpus: np.ndarray, queries: np.ndarray, k: int):
     run = _brute_knn_jitted()
     idx, dist = run(jnp.asarray(corpus, jnp.float32),
                     jnp.asarray(queries, jnp.float32), int(k))
+    # tpulint: disable=TPU004 — dtype-preserving drain of device outputs
     return np.asarray(idx), np.asarray(dist)
 
 
@@ -101,6 +103,8 @@ class KNNModel(Model, _KNNParams):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         Q = _features_matrix(df, self.get("features_col"))
+        # tpulint: disable=TPU004 — corpus is the f64 host matrix from fit;
+        # brute_force_knn casts to f32 before device_put
         corpus = np.asarray(self.get("corpus"))
         k = min(self.get("k"), len(corpus))
         idx, dist = brute_force_knn(corpus, Q, k)

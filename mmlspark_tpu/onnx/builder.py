@@ -8,7 +8,7 @@ mirrors the public ``onnx.helper`` so snippets translate directly:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, Optional, Sequence, Union
 
 import numpy as np
 

@@ -13,7 +13,7 @@ from typing import Dict, List, Optional, Union
 
 import numpy as np
 
-from .wire import decode_zigzag, iter_fields, read_varint
+from .wire import iter_fields, read_varint
 
 __all__ = ["TensorProto", "AttributeProto", "NodeProto", "GraphProto",
            "ModelProto", "ValueInfo", "DataType", "tensor_to_numpy",
@@ -37,7 +37,6 @@ class DataType:
     COMPLEX64 = 14
     COMPLEX128 = 15
     BFLOAT16 = 16
-
 
 ONNX_TO_NUMPY = {
     DataType.FLOAT: np.float32,

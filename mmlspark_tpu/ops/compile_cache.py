@@ -231,6 +231,8 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
         feeds = {name: put(np.zeros((size,) + shape, dtype=dt))
                  for name, (dt, shape) in specs.items()}
         outs = jitted(params, feeds)
+        # tpulint: disable=TPU001 — warm-up MUST fence each bucket so the
+        # timed window covers the compile, not later steady-state batches
         jax.block_until_ready(outs)
     elapsed = time.perf_counter() - t_start
     after = jit_cache_size(jitted)

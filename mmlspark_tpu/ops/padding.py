@@ -14,7 +14,7 @@ shape vocabulary:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -71,6 +71,18 @@ def pad_axis(arr: np.ndarray, size: int, axis: int = 0,
     return np.pad(arr, widths, mode="constant", constant_values=fill)
 
 
+def _coerce_host(v) -> np.ndarray:
+    """Host coercion with the same dtype policy as the model feed paths:
+    a Python float payload lands as float64, which TPUs have no ALU for —
+    every such batch would carry a fresh jit signature and 2x the transfer
+    bytes, so normalize f64→f32 here (ints and exotic dtypes pass through).
+    """
+    arr = np.asarray(v)  # tpulint: disable=TPU004 — dtype normalized below
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
 def pad_batch(arrays: Dict[str, np.ndarray],
               buckets: Optional[Sequence[int]] = None,
               pad_to: Optional[int] = None) -> PaddedBatch:
@@ -81,11 +93,13 @@ def pad_batch(arrays: Dict[str, np.ndarray],
         raise ValueError(f"inconsistent batch sizes: {sizes}")
     n = ns.pop() if ns else 0
     target = pad_to if pad_to is not None else bucket_size(n, buckets)
-    padded = {k: pad_axis(np.asarray(v), target) for k, v in arrays.items()}
+    padded = {k: pad_axis(_coerce_host(v), target) for k, v in arrays.items()}
     mask = np.zeros(target, dtype=bool)
     mask[:n] = True
     return PaddedBatch(padded, mask, n)
 
 
 def unpad(arr: np.ndarray, n_valid: int) -> np.ndarray:
-    return np.asarray(arr)[:n_valid]
+    # dtype-preserving: the input is already an ndarray/device array, so
+    # asarray only materializes on host — it cannot introduce float64
+    return np.asarray(arr)[:n_valid]  # tpulint: disable=TPU004

@@ -11,7 +11,7 @@ over the mesh with XLA collectives riding ICI.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -121,6 +121,7 @@ def make_mesh(axis_shapes: Optional[dict] = None,
     if total > n:
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
                          f"have {n}")
+    # tpulint: disable=TPU004 — object array of Device handles, not numerics
     arr = np.array(devices[:total]).reshape(sizes)
     return Mesh(arr, tuple(names))
 

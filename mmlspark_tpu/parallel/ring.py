@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention", "ulysses_attention", "wrap_ring_attention",
            "local_attention", "attention_transient_bytes",

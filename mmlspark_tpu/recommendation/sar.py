@@ -11,8 +11,6 @@ similarity products are single MXU matmuls under ``jit``.
 
 from __future__ import annotations
 
-from typing import List, Optional
-
 import numpy as np
 
 from ..core.dataframe import DataFrame

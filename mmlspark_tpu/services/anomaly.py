@@ -12,8 +12,6 @@ median/MAD z-score detector — same output shape, no service required.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..core.dataframe import DataFrame, object_col

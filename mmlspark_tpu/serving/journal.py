@@ -33,7 +33,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from ..io.http.schema import HTTPRequestData
 

@@ -28,7 +28,7 @@ from typing import Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
-from ..core.dataframe import DataFrame, concat
+from ..core.dataframe import DataFrame
 from ..core.params import Param, Params, identity
 from ..core.pipeline import Transformer
 

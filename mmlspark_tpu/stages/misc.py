@@ -18,9 +18,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core.dataframe import DataFrame, concat
-from ..core.params import (ComplexParam, HasInputCol, HasInputCols,
-                           HasLabelCol, HasOutputCol, HasSeed, Param)
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, HasInputCol, HasLabelCol, HasOutputCol, HasSeed, Param
 from ..core.pipeline import Estimator, Model, Transformer
 
 __all__ = [

@@ -9,8 +9,6 @@ per-row losses). Metric math runs as vectorized array ops.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..core.dataframe import DataFrame

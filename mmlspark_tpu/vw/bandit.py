@@ -22,7 +22,7 @@ from typing import List, Sequence
 import numpy as np
 
 from ..core.dataframe import DataFrame
-from ..core.params import ComplexParam, HasFeaturesCol, HasLabelCol, Param
+from ..core.params import ComplexParam, HasLabelCol, Param
 from ..core.pipeline import Estimator, Model
 from .featurizer import NUM_BITS_KEY, sparse_column
 from .learners import VowpalWabbitRegressor, pad_sparse

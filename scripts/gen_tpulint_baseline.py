@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Regenerate tools/tpulint/baseline.json from the current tree.
+
+Run after fixing a baselined finding (shrinks the baseline) or after
+deliberately accepting a new one (grows it — prefer an inline
+``# tpulint: disable=RULE`` with a justification for point exceptions).
+
+Usage:
+    python scripts/gen_tpulint_baseline.py            # scan mmlspark_tpu
+    python scripts/gen_tpulint_baseline.py pkg other  # custom paths
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.chdir(REPO_ROOT)  # fingerprints are repo-relative; pin the root
+
+from tools.tpulint.cli import main  # noqa: E402
+
+BASELINE = os.path.join(REPO_ROOT, "tools", "tpulint", "baseline.json")
+
+
+if __name__ == "__main__":
+    paths = sys.argv[1:] or ["mmlspark_tpu"]
+    sys.exit(main(paths + ["--write-baseline", BASELINE]))

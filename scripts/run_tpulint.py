@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""CI entry point for tpulint: baseline-diff mode against the shipped tree.
+
+Usage:
+    python scripts/run_tpulint.py mmlspark_tpu            # CI gate
+    python scripts/run_tpulint.py --format json mmlspark_tpu
+    python scripts/run_tpulint.py --no-baseline mmlspark_tpu  # raw findings
+
+Exits 0 when the tree is clean modulo the checked-in baseline
+(tools/tpulint/baseline.json); exits 1 on any new finding at or above the
+``--fail-on`` threshold (default: warning). Regenerate the baseline with
+scripts/gen_tpulint_baseline.py after fixing or deliberately accepting
+findings.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.chdir(REPO_ROOT)  # fingerprints are repo-relative; pin the root
+
+from tools.tpulint.cli import main  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "tpulint",
+                                "baseline.json")
+
+
+def run(argv):
+    argv = list(argv)
+    if "--no-baseline" in argv:
+        argv.remove("--no-baseline")
+    elif "--baseline" not in argv and "--write-baseline" not in argv \
+            and "--list-rules" not in argv \
+            and os.path.exists(DEFAULT_BASELINE):
+        argv += ["--baseline", DEFAULT_BASELINE]
+    return main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -17,14 +17,13 @@ root-module ``FuzzingTest`` that reflectively fails on unregistered stages).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
 from mmlspark_tpu.core import DataFrame
-from mmlspark_tpu.core.pipeline import (Estimator, Model, Pipeline,
-                                        PipelineStage, Transformer)
+from mmlspark_tpu.core.pipeline import Estimator, Pipeline, PipelineStage
 
 
 @dataclass

@@ -76,7 +76,6 @@ def test_gbdt_checkpoint_resume_matches_uninterrupted(tmp_path):
 
 
 def test_gbdt_checkpoint_noop_when_complete(tmp_path):
-    from mmlspark_tpu.models.gbdt.booster import Booster
     from mmlspark_tpu.models.gbdt.train import train
 
     rng = np.random.default_rng(1)

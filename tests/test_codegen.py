@@ -75,6 +75,11 @@ def test_no_orphan_stubs():
                glob.glob(os.path.join(REPO, "mmlspark_tpu/**/*.pyi"),
                          recursive=True)}
     orphans = on_disk - {os.path.abspath(p) for p in generated}
+    # stubs that declare themselves hand-written are allowed: codegen only
+    # covers PipelineStage modules, and tpulint rule TPU006 (stub-drift)
+    # keeps the hand-written ones in sync with their modules
+    orphans = {p for p in orphans
+               if "hand-written" not in open(p).readline().lower()}
     assert not orphans, f"stubs with no generating module: {sorted(orphans)}"
 
 

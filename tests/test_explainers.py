@@ -1,11 +1,9 @@
 """Tests for LIME/SHAP/ICE explainers (reference: explainers test split1-3)."""
 
 import numpy as np
-import pytest
 
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.core.pipeline import Transformer
-from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.explainers import (ICETransformer, ImageLIME, ImageSHAP,
                                      TabularLIME, TabularSHAP, TextLIME,
                                      TextSHAP, VectorLIME, VectorSHAP,

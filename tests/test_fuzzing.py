@@ -9,7 +9,6 @@ serialization fuzzer (save/load round-trips) from ``fuzzing.py``.
 """
 
 import importlib
-import inspect
 import pkgutil
 
 import numpy as np
@@ -18,8 +17,7 @@ import pytest
 import mmlspark_tpu
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.core.dataframe import object_col
-from mmlspark_tpu.core.pipeline import (Estimator, Model, Pipeline,
-                                        PipelineStage, Transformer)
+from mmlspark_tpu.core.pipeline import Model, PipelineStage
 
 from fuzzing import TestObject, experiment_fuzz, serialization_fuzz
 

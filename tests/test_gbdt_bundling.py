@@ -18,7 +18,6 @@ parity where ULP noise may flip near-tie splits at deep nodes.
 """
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 from mmlspark_tpu.models.gbdt import train

@@ -6,7 +6,6 @@ import os
 import zipfile
 
 import numpy as np
-import pytest
 
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.core.dataframe import object_col

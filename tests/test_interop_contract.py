@@ -26,9 +26,7 @@ import pytest
 
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.core.pipeline import Transformer
-from mmlspark_tpu.interop import (make_pandas_udf_fn, spark_schema_for,
-                                  spark_transform, transform_pandas)
-
+from mmlspark_tpu.interop import make_pandas_udf_fn, spark_schema_for, spark_transform
 
 # -- pyspark stub ------------------------------------------------------------
 

@@ -10,13 +10,15 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.core import DataFrame
-from mmlspark_tpu.io.http import (AsyncHTTPClient, CustomOutputParser,
-                                  HTTPRequestData, HTTPTransformer,
-                                  JSONInputParser, JSONOutputParser,
-                                  SimpleHTTPTransformer, StringOutputParser,
+from mmlspark_tpu.io.http import (CustomOutputParser,
+                                  HTTPRequestData,
+                                  HTTPTransformer,
+                                  JSONInputParser,
+                                  JSONOutputParser,
+                                  SimpleHTTPTransformer,
+                                  StringOutputParser,
                                   send_with_retries)
 from mmlspark_tpu.io.http.clients import shared_session
-from mmlspark_tpu.io.http.schema import HTTPResponseData
 from mmlspark_tpu.serving import ServingEngine, WorkerServer
 
 

@@ -2,7 +2,6 @@
 balance (reference: nn/, recommendation/, isolationforest/, exploratory/)."""
 
 import numpy as np
-import pytest
 
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.exploratory import (AggregateBalanceMeasure,

@@ -9,8 +9,6 @@ torch.nn.functional.grid_sample (torch CPU ships in-image).
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 from mmlspark_tpu.onnx.builder import (make_graph, make_model, make_node,
                                        make_tensor_value_info)
 from mmlspark_tpu.onnx.convert import UnsupportedOp, convert_model

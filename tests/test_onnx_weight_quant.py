@@ -10,7 +10,6 @@ TPU-shaped serving variant (HBM bandwidth, not int8 matmul units).
 """
 
 import numpy as np
-import pytest
 
 import mmlspark_tpu.onnx as O
 from mmlspark_tpu.core import DataFrame, PipelineStage

@@ -6,7 +6,6 @@ from dispatcher threads via ``call_soon_threadsafe``."""
 import http.client
 import json
 import threading
-import time
 
 import pytest
 

@@ -7,8 +7,6 @@ import threading
 import time
 import urllib.request
 
-import pytest
-
 from mmlspark_tpu.serving.distributed import (DistributedWorker,
                                               DriverRegistry, ServingCluster)
 

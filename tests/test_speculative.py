@@ -13,9 +13,10 @@ import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
-                                                 decode_step, decode_window,
+                                                 decode_step,
+                                                 decode_window,
                                                  generate_cached,
-                                                 init_kv_cache, init_transformer,
+                                                 init_transformer,
                                                  prefill_cache)
 from mmlspark_tpu.models.zoo.speculative import (generate_speculative,
                                                  generate_speculative_fused)

@@ -14,8 +14,6 @@ import warnings
 import numpy as np
 import pytest
 
-import jax
-
 from mmlspark_tpu.interop.onnx_shim import install_onnx_shim
 from mmlspark_tpu.onnx.convert import convert_model
 

@@ -1,0 +1,641 @@
+"""tpulint: per-rule positive/negative fixtures, suppression, baseline,
+CLI exit codes, and a self-scan of the shipped tree.
+
+Each rule gets at least one fixture that MUST fire and one that MUST stay
+quiet — the quiet ones encode the false-positive fixes (static_argnames,
+.shape reads, non-device dirs) so a regression re-introducing the noise
+fails here, not in CI triage.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.tpulint import baseline as baseline_mod
+from tools.tpulint.cli import main as cli_main
+from tools.tpulint.core import (analyze_project, analyze_source, fingerprint,
+                                load_project)
+
+
+def codes(findings):
+    return [f.rule for f in findings]
+
+
+def run_fixture(source, relpath="pkg/mod.py", keep_suppressed=False):
+    findings, suppressed = analyze_source(
+        textwrap.dedent(source), relpath, keep_suppressed=keep_suppressed)
+    return findings, suppressed
+
+
+# ---------------------------------------------------------------------------
+# TPU001 host-sync-in-jit
+
+
+def test_tpu001_device_get_in_jit_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)
+        """)
+    assert "TPU001" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU001"]
+    assert f.severity == "error" and f.line == 5
+
+
+def test_tpu001_float_of_tracer_fires_but_shape_read_does_not():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+        """)
+    assert "TPU001" in codes(findings)
+
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            scale = float(x.shape[0])
+            return x * scale
+        """)
+    assert "TPU001" not in codes(findings)
+
+
+def test_tpu001_static_argname_is_not_a_tracer():
+    # the trees.py ff_bynode false positive: int(round(...)) over a static
+    findings, _ = run_fixture("""\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            kk = max(1, int(round(k * 0.5)))
+            return x[:kk]
+        """)
+    assert "TPU001" not in codes(findings)
+
+
+def test_tpu001_per_iteration_fence_warns():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def run(fn, xs):
+            outs = []
+            for x in xs:
+                out = fn(x)
+                jax.block_until_ready(out)
+                outs.append(out)
+            return outs
+        """)
+    hits = [f for f in findings if f.rule == "TPU001"]
+    assert hits and hits[0].severity == "warning"
+
+
+def test_tpu001_fence_outside_loop_is_quiet():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def run(fn, xs):
+            outs = [fn(x) for x in xs]
+            jax.block_until_ready(outs)
+            return outs
+        """)
+    assert "TPU001" not in codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU002 jit-in-loop
+
+
+def test_tpu002_jit_inside_loop_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def run(fns, x):
+            for fn in fns:
+                jf = jax.jit(fn)
+                x = jf(x)
+            return x
+        """)
+    assert "TPU002" in codes(findings)
+
+
+def test_tpu002_jit_before_loop_is_quiet():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def run(fn, xs):
+            jf = jax.jit(fn)
+            out = [jf(x) for x in xs]
+            return out
+        """)
+    assert "TPU002" not in codes(findings)
+
+
+def test_tpu002_loop_header_does_not_count_as_body():
+    # the jit call produces the iterable ONCE; only the body repeats
+    findings, _ = run_fixture("""\
+        import jax
+
+        def run(fn, x):
+            for y in jax.jit(fn)(x):
+                print(y)
+        """)
+    assert "TPU002" not in codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU003 tracer-branch
+
+
+def test_tpu003_tracer_if_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """)
+    assert "TPU003" in codes(findings)
+
+
+def test_tpu003_shape_branch_is_quiet():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.ndim > 1:
+                return x.sum(axis=-1)
+            return x
+        """)
+    assert "TPU003" not in codes(findings)
+
+
+def test_tpu003_static_argnames_via_name_wrap():
+    # the linear.py pattern: statics declared at the jax.jit(...) wrap site
+    findings, _ = run_fixture("""\
+        import jax
+
+        def _run(x, kind):
+            if kind == "logistic":
+                return x * 2
+            return x
+
+        run = jax.jit(_run, static_argnames=("kind",))
+        """)
+    assert "TPU003" not in codes(findings)
+
+
+def test_tpu003_while_tracer_test_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            while x > 0:
+                x = x - 1
+            return x
+        """)
+    assert "TPU003" in codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU004 dtype-leak (device dirs only)
+
+
+def test_tpu004_f64_in_ops_dir_fires():
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        def pad(v):
+            return np.asarray(v, dtype=np.float64)
+        """, relpath="pkg/ops/pad.py")
+    assert "TPU004" in codes(findings)
+
+
+def test_tpu004_same_source_outside_device_dirs_is_quiet():
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        def pad(v):
+            return np.asarray(v, dtype=np.float64)
+        """, relpath="pkg/metrics/pad.py")
+    assert "TPU004" not in codes(findings)
+
+
+def test_tpu004_dtypeless_asarray_in_device_dir_fires():
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        def coerce(v):
+            return np.asarray(v)
+        """, relpath="pkg/nn/x.py")
+    assert "TPU004" in codes(findings)
+
+
+def test_tpu004_dtype_comparison_is_quiet():
+    # `arr.dtype == np.float64` is a CHECK, not a leak
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        def coerce(arr):
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            return arr
+        """, relpath="pkg/ops/x.py")
+    assert "TPU004" not in codes(findings)
+
+
+def test_tpu004_sci_literal_in_jit_is_info():
+    findings, _ = run_fixture("""\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.maximum(x, 1e-38)
+        """, relpath="pkg/ops/x.py")
+    hits = [f for f in findings if f.rule == "TPU004"]
+    assert hits and all(f.severity == "info" for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# TPU005 op-registry drift (project scope, tmp packages)
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+CONVERT_SRC = """\
+    OP_HANDLERS = {}
+
+    def register_op(name):
+        def deco(fn):
+            OP_HANDLERS[name] = fn
+            return fn
+        return deco
+
+    @register_op("Add")
+    def _add(node, inputs, ctx):
+        return inputs
+
+    from . import extra
+    """
+
+
+def _scan_pkg(root):
+    project = load_project([os.path.join(root, "pkg")], root)
+    from tools.tpulint.core import all_rules
+    return analyze_project(project, rules=all_rules(["TPU005"]))[0]
+
+
+def test_tpu005_duplicate_registration_fires(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "convert.py": CONVERT_SRC,
+        "extra.py": """\
+            from .convert import register_op
+
+            @register_op("Add")
+            def _add2(node, inputs, ctx):
+                return inputs
+            """,
+    })
+    findings = _scan_pkg(root)
+    assert any(f.rule == "TPU005" and "Add" in f.message for f in findings)
+
+
+def test_tpu005_distinct_ops_are_quiet(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "convert.py": CONVERT_SRC,
+        "extra.py": """\
+            from .convert import register_op
+
+            @register_op("Mul")
+            def _mul(node, inputs, ctx):
+                return inputs
+            """,
+    })
+    assert not _scan_pkg(root)
+
+
+def test_tpu005_dangling_handler_name_fires(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "convert.py": CONVERT_SRC.replace(
+            "from . import extra",
+            'OP_HANDLERS["Mul"] = _missing_handler\n    from . import extra'),
+        "extra.py": "from .convert import register_op\n",
+    })
+    findings = _scan_pkg(root)
+    assert any(f.rule == "TPU005" and "_missing_handler" in f.message
+               for f in findings)
+
+
+def test_tpu005_unimported_registering_module_fires(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "convert.py": CONVERT_SRC.replace("from . import extra\n", ""),
+        "extra.py": """\
+            from .convert import register_op
+
+            @register_op("Mul")
+            def _mul(node, inputs, ctx):
+                return inputs
+            """,
+    })
+    findings = _scan_pkg(root)
+    assert any(f.rule == "TPU005" and f.path.endswith("extra.py")
+               and "never imported" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU006 stub drift (project scope, module + .pyi pair)
+
+
+def _scan_stub(tmp_path, mod_src, stub_src):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(mod_src))
+    (tmp_path / "mod.pyi").write_text(textwrap.dedent(stub_src))
+    project = load_project([str(tmp_path)], str(tmp_path))
+    from tools.tpulint.core import all_rules
+    return analyze_project(project, rules=all_rules(["TPU006"]))[0]
+
+
+def test_tpu006_stub_only_name_fires(tmp_path):
+    findings = _scan_stub(
+        tmp_path,
+        "def foo():\n    return 1\n",
+        "def foo() -> int: ...\ndef bar() -> int: ...\n")
+    assert any(f.rule == "TPU006" and "bar" in f.message for f in findings)
+
+
+def test_tpu006_stub_subset_is_quiet(tmp_path):
+    findings = _scan_stub(
+        tmp_path,
+        "def foo():\n    return 1\n\ndef extra():\n    return 2\n",
+        "def foo() -> int: ...\n")
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# Suppression
+
+
+def test_inline_suppression():
+    findings, suppressed = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)  # tpulint: disable=TPU001
+        """, keep_suppressed=True)
+    assert "TPU001" not in codes(findings)
+    assert "TPU001" in codes(suppressed)
+
+
+def test_comment_block_suppression_spans_multiple_lines():
+    findings, suppressed = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            # tpulint: disable=TPU001 — the fence IS the measurement
+            # in this opt-in profiling path
+            return jax.device_get(x)
+        """, keep_suppressed=True)
+    assert "TPU001" not in codes(findings)
+    assert "TPU001" in codes(suppressed)
+
+
+def test_file_level_suppression():
+    findings, suppressed = run_fixture("""\
+        # tpulint: disable-file=TPU004 — host-side exact math by design
+        import numpy as np
+
+        def a(v):
+            return np.asarray(v, dtype=np.float64)
+
+        def b(v):
+            return np.asarray(v)
+        """, relpath="pkg/ops/x.py", keep_suppressed=True)
+    assert "TPU004" not in codes(findings)
+    assert codes(suppressed).count("TPU004") >= 2
+
+
+def test_suppression_is_rule_specific():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)  # tpulint: disable=TPU002
+        """)
+    assert "TPU001" in codes(findings)  # wrong code: does not suppress
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+
+def _one_finding():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)
+        """)
+    return [f for f in findings if f.rule == "TPU001"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _one_finding()
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.dump(findings, path)
+    known = baseline_mod.load(path)
+    assert known == {fingerprint(findings[0]): 1}
+    new, old, stale = baseline_mod.apply(findings, known)
+    assert not new and old == findings and not stale
+
+
+def test_baseline_is_line_number_free():
+    # shifting the finding down a line must not invalidate the baseline
+    f = _one_finding()[0]
+    assert str(f.line) not in fingerprint(f).split("::")[0]
+    shifted = run_fixture("""\
+        import jax
+
+
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)
+        """)[0]
+    shifted = [x for x in shifted if x.rule == "TPU001"]
+    assert fingerprint(shifted[0]) == fingerprint(f)
+
+
+def test_baseline_count_budget_and_stale(tmp_path):
+    findings = _one_finding()
+    known = dict(baseline_mod.counts(findings))
+    known["gone.py::TPU001::x"] = 2
+    # duplicate the finding: budget of 1 covers only one occurrence
+    new, old, stale = baseline_mod.apply(findings * 2, known)
+    assert len(new) == 1 and len(old) == 1
+    assert "gone.py::TPU001::x" in stale
+
+
+def test_baseline_load_rejects_bad_version(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"version": 99, "fingerprints": {}}))
+    try:
+        baseline_mod.load(str(path))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError on unknown version")
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+
+
+def _cli(args):
+    out = io.StringIO()
+    rc = cli_main(args, stdout=out)
+    return rc, out.getvalue()
+
+
+def test_cli_clean_file_exits_zero(tmp_path):
+    p = tmp_path / "clean.py"
+    p.write_text("def f(x):\n    return x\n")
+    rc, out = _cli([str(p)])
+    assert rc == 0 and "no findings" in out
+
+
+def test_cli_positive_fixtures_exit_nonzero(tmp_path):
+    # one gating fixture per line-scope rule
+    fixtures = {
+        "TPU001": "import jax\n\n@jax.jit\ndef f(x):\n"
+                  "    return jax.device_get(x)\n",
+        "TPU002": "import jax\n\ndef r(fns, x):\n    for fn in fns:\n"
+                  "        x = jax.jit(fn)(x)\n    return x\n",
+        "TPU003": "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n"
+                  "        return x\n    return -x\n",
+    }
+    for rule, src in fixtures.items():
+        p = tmp_path / f"{rule.lower()}.py"
+        p.write_text(src)
+        rc, out = _cli([str(p)])
+        assert rc == 1 and rule in out, (rule, out)
+
+
+def test_cli_tpu005_duplicate_exits_nonzero(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "convert.py": CONVERT_SRC,
+        "extra.py": """\
+            from .convert import register_op
+
+            @register_op("Add")
+            def _add2(node, inputs, ctx):
+                return inputs
+            """,
+    })
+    rc, out = _cli([os.path.join(root, "pkg")])
+    assert rc == 1 and "TPU005" in out
+
+
+def test_cli_tpu006_stub_drift_exits_nonzero(tmp_path):
+    (tmp_path / "mod.py").write_text("def foo():\n    return 1\n")
+    (tmp_path / "mod.pyi").write_text(
+        "def foo() -> int: ...\ndef gone() -> int: ...\n")
+    rc, out = _cli([str(tmp_path)])
+    assert rc == 1 and "TPU006" in out and "gone" in out
+
+
+def test_cli_tpu004_warning_gates_but_info_does_not(tmp_path):
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    p = ops / "x.py"
+    p.write_text("import numpy as np\n\ndef f(v):\n"
+                 "    return np.asarray(v, dtype=np.float64)\n")
+    rc, out = _cli([str(p)])
+    assert rc == 1 and "TPU004" in out
+
+    p.write_text("import jax\nimport jax.numpy as jnp\n\n@jax.jit\n"
+                 "def f(x):\n    return jnp.maximum(x, 1e-38)\n")
+    rc, out = _cli([str(p)])
+    assert rc == 0 and "TPU004" in out  # reported, not gating
+
+
+def test_cli_unknown_rule_exits_two(tmp_path):
+    p = tmp_path / "x.py"
+    p.write_text("x = 1\n")
+    rc, _ = _cli([str(p), "--rules", "NOPE"])
+    assert rc == 2
+
+
+def test_cli_no_paths_exits_two():
+    rc, _ = _cli([])
+    assert rc == 2
+
+
+def test_cli_parse_error_exits_one(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("def f(:\n")
+    rc, out = _cli([str(p)])
+    assert rc == 1 and "parse" in out.lower()
+
+
+def test_cli_baseline_swallows_known_findings(tmp_path):
+    p = tmp_path / "x.py"
+    p.write_text("import jax\n\n@jax.jit\ndef f(x):\n"
+                 "    return jax.device_get(x)\n")
+    bl = str(tmp_path / "baseline.json")
+    rc, _ = _cli([str(p), "--write-baseline", bl])
+    assert rc == 0
+    rc, out = _cli([str(p), "--baseline", bl])
+    assert rc == 0 and "baselined" in out
+
+
+def test_cli_json_format(tmp_path):
+    p = tmp_path / "x.py"
+    p.write_text("import jax\n\n@jax.jit\ndef f(x):\n"
+                 "    return jax.device_get(x)\n")
+    rc, out = _cli([str(p), "--format", "json"])
+    doc = json.loads(out)
+    assert rc == 1 and doc["findings"][0]["rule"] == "TPU001"
+
+
+def test_cli_list_rules():
+    rc, out = _cli(["--list-rules"])
+    assert rc == 0
+    for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006"):
+        assert code in out
+
+
+# ---------------------------------------------------------------------------
+# Self-scan: the shipped tree is clean modulo the checked-in baseline
+
+
+def test_self_scan_shipped_tree_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "mmlspark_tpu"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -5,10 +5,15 @@ import time
 
 import pytest
 
-from mmlspark_tpu.utils import (SharedSingleton, SharedVariable, StopWatch,
-                                buffered_await, device_for_partition,
-                                global_devices, local_devices, map_buffered,
-                                num_tasks, retry_with_backoff,
+from mmlspark_tpu.utils import (SharedSingleton,
+                                SharedVariable,
+                                StopWatch,
+                                device_for_partition,
+                                global_devices,
+                                local_devices,
+                                map_buffered,
+                                num_tasks,
+                                retry_with_backoff,
                                 retry_with_timeout)
 
 

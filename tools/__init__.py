@@ -1,0 +1,1 @@
+# repo-local tooling namespace (not shipped in the wheel)

@@ -1,0 +1,44 @@
+"""tpulint — AST-based TPU-hazard analyzer for the mmlspark_tpu codebase.
+
+The JNI/socket failure modes of the reference became, after the jax.jit
+rebuild, *compile-time-invisible Python patterns*: a host sync buried in a
+per-batch loop, a ``jax.jit`` constructed in steady state, a Python branch
+on a tracer, a float64 literal silently widening a jitted program, or an
+ONNX op handler that never lands in the dispatch table. Every one of them
+is mechanically detectable from the AST before anything executes — this
+package is that detector.
+
+Rules
+-----
+- **TPU001** host-sync-in-jit: ``jax.device_get`` / ``np.asarray`` /
+  ``float()`` / ``.item()`` inside jitted functions, and per-iteration
+  ``device_get``/``block_until_ready`` in batch loops.
+- **TPU002** jit-in-loop: ``jax.jit(...)`` constructed inside a loop body —
+  a fresh cache per iteration, i.e. steady-state recompiles.
+- **TPU003** tracer-branch: Python ``if``/``while`` on traced parameters of
+  jitted functions instead of ``lax.cond`` / ``lax.while_loop``.
+- **TPU004** dtype-leak: ``np.float64`` references, dtype-less
+  ``np.asarray``/``np.array`` in device-feed modules, and bare float
+  literals in jitted code.
+- **TPU005** op-registry-drift: the ONNX ``OP_HANDLERS`` dispatch table
+  cross-checked against the handler modules (duplicates, dangling
+  registrations, unregistered handlers, unreachable registry modules).
+- **TPU006** stub-drift: ``.pyi`` stubs naming things their module no
+  longer defines.
+
+Entry points: ``scripts/run_tpulint.py`` (CI gate, baseline-diff mode) and
+``scripts/gen_tpulint_baseline.py`` (baseline regeneration). See
+``docs/static_analysis.md`` for the rule catalog and workflow.
+"""
+
+from .core import (Finding, ModuleInfo, Project, Rule, all_rules,
+                   analyze_project, analyze_source, fingerprint,
+                   register_rule)
+from . import rules as _rules            # noqa: F401  (registers TPU001-004)
+from . import project_rules as _prules   # noqa: F401  (registers TPU005-006)
+
+__version__ = "0.1.0"
+
+__all__ = ["Finding", "ModuleInfo", "Project", "Rule", "all_rules",
+           "analyze_project", "analyze_source", "fingerprint",
+           "register_rule", "__version__"]

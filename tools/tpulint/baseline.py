@@ -1,0 +1,67 @@
+"""Baseline file: accepted pre-existing findings, by fingerprint count.
+
+Format (JSON, sorted keys, stable for diffs):
+
+    {"version": 1,
+     "fingerprints": {"mmlspark_tpu/ops/x.py::TPU004::np.asarray(v)": 2}}
+
+Fingerprints carry no line numbers (see :func:`tpulint.core.fingerprint`),
+so edits elsewhere in a file do not churn the baseline; counts let the same
+hazardous line appear N times without masking an N+1th copy.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding, fingerprint
+
+VERSION = 1
+
+
+def counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    return dict(Counter(fingerprint(f) for f in findings))
+
+
+def dump(findings: Sequence[Finding], path: str) -> None:
+    payload = {"version": VERSION,
+               "fingerprints": dict(sorted(counts(findings).items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version") != VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{payload.get('version')!r}")
+    fps = payload.get("fingerprints", {})
+    if not all(isinstance(v, int) and v > 0 for v in fps.values()):
+        raise ValueError(f"malformed baseline counts in {path}")
+    return dict(fps)
+
+
+def apply(findings: Sequence[Finding], baseline: Dict[str, int],
+          ) -> Tuple[List[Finding], List[Finding], Dict[str, int]]:
+    """Split findings into (new, baselined) and report stale entries.
+
+    Occurrences of a fingerprint beyond its baselined count are *new*;
+    baseline entries with no surviving occurrences are *stale* (the hazard
+    was fixed — regenerate the baseline to shrink it).
+    """
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:          # findings arrive location-sorted
+        fp = fingerprint(f)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in budget.items() if n > 0}
+    return new, old, stale

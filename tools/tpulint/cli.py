@@ -1,0 +1,99 @@
+"""tpulint command line: ``python -m tools.tpulint <paths>``.
+
+Exit codes: 0 clean (modulo baseline and ``--fail-on`` threshold),
+1 new findings at or above the threshold (or parse errors), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .core import all_rules, analyze_project, load_project
+from .reporters import REPORTERS, rule_catalog
+
+SEVERITY_RANK = {"error": 0, "warning": 1, "info": 2}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="AST-based TPU-hazard analyzer (recompile, host-sync, "
+                    "dtype-leak, op-registry drift).")
+    p.add_argument("paths", nargs="*", help="files or directories to scan")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline JSON; matching findings don't fail the run")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write current findings as the new baseline and exit")
+    p.add_argument("--format", choices=sorted(REPORTERS), default="text")
+    p.add_argument("--rules", metavar="CODES", default=None,
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--fail-on", choices=["error", "warning", "info"],
+                   default="warning",
+                   help="lowest severity that fails the run (default: "
+                        "warning — info findings report but never gate)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also report inline-suppressed findings (never fail)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None,
+         stdout=None) -> int:
+    stdout = stdout or sys.stdout
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        rule_catalog(stdout)
+        return 0
+    if not args.paths:
+        build_parser().print_usage(sys.stderr)
+        print("tpulint: error: no paths given", file=sys.stderr)
+        return 2
+    codes = [c.strip() for c in args.rules.split(",")] if args.rules else None
+    try:
+        rules = all_rules(codes)
+    except ValueError as e:
+        print(f"tpulint: error: {e}", file=sys.stderr)
+        return 2
+
+    project = load_project(args.paths)
+    findings, suppressed = analyze_project(
+        project, rules=rules, keep_suppressed=args.show_suppressed)
+
+    if args.write_baseline:
+        baseline_mod.dump(findings, args.write_baseline)
+        stdout.write(f"tpulint: wrote {len(findings)} finding(s) "
+                     f"({len(baseline_mod.counts(findings))} fingerprints) "
+                     f"to {args.write_baseline}\n")
+        return 0
+
+    baselined, stale = [], {}
+    if args.baseline:
+        try:
+            known = baseline_mod.load(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"tpulint: error: cannot read baseline: {e}",
+                  file=sys.stderr)
+            return 2
+        findings, baselined, stale = baseline_mod.apply(findings, known)
+
+    REPORTERS[args.format](findings, stdout, baselined=baselined,
+                           stale=stale, parse_errors=project.parse_errors)
+    if args.show_suppressed and suppressed:
+        stdout.write(f"tpulint: {len(suppressed)} suppressed finding(s):\n")
+        for f in suppressed:
+            stdout.write(f"    {f.location()}: {f.rule}: {f.message}\n")
+
+    threshold = SEVERITY_RANK[args.fail_on]
+    gating = [f for f in findings
+              if SEVERITY_RANK[f.severity] <= threshold]
+    if project.parse_errors or gating:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,372 @@
+"""tpulint core: findings, rule registry, module model, analysis driver.
+
+Stdlib-only (``ast`` + ``tokenize``-free line scanning): the analyzer must
+run in the CI image with zero extra dependencies, and import none of the
+code it inspects — a module with a hazard at import time still gets linted.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning", "info")
+
+#: ``# tpulint: disable=TPU001`` / ``disable=TPU001,TPU004`` / ``disable=all``
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+#: ``# tpulint: disable-file=TPU004`` — whole-module suppression, for host
+#: modules that live in a device-feed directory (justify in the comment)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*tpulint:\s*disable-file=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a rule firing at a source location."""
+
+    rule: str                  # "TPU001"
+    path: str                  # repo-relative path of the offending file
+    line: int                  # 1-based
+    col: int                   # 0-based
+    severity: str              # error | warning | info
+    message: str
+    snippet: str = ""          # stripped source line (fingerprint material)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+
+def fingerprint(f: Finding) -> str:
+    """Line-number-free identity for baseline matching.
+
+    Keyed on (path, rule, snippet) so unrelated edits that shift line
+    numbers do not churn the baseline; duplicate identical lines in one
+    file collapse into a count (the baseline stores occurrence counts).
+    """
+    return f"{f.path}::{f.rule}::{f.snippet}"
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """Base class: subclass, set ``code``/``name``/``severity``/``doc``,
+    implement :meth:`check` (per module) or :meth:`check_project`."""
+
+    code: str = ""
+    name: str = ""
+    severity: str = "warning"
+    doc: str = ""
+    #: project-scope rules see every module at once (cross-file checks)
+    project_scope: bool = False
+
+    def check(self, module: "ModuleInfo") -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project: "Project") -> Iterator[Finding]:
+        return iter(())
+
+    def finding(self, module: "ModuleInfo", node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=self.code, path=module.relpath, line=line,
+                       col=getattr(node, "col_offset", 0),
+                       severity=severity or self.severity, message=message,
+                       snippet=module.line(line))
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_rule(cls: type) -> type:
+    if not cls.code:
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules(codes: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Instantiate the registered rules (optionally a subset by code)."""
+    wanted = set(codes) if codes is not None else None
+    unknown = (wanted or set()) - set(_REGISTRY)
+    if unknown:
+        raise ValueError(f"unknown rule codes: {sorted(unknown)}")
+    return [cls() for code, cls in sorted(_REGISTRY.items())
+            if wanted is None or code in wanted]
+
+
+# ---------------------------------------------------------------------------
+# module model
+# ---------------------------------------------------------------------------
+
+class ModuleInfo:
+    """One parsed source file plus the precomputed context rules share:
+    import alias map, per-line suppressions, names jitted by call."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.aliases = _import_aliases(self.tree)
+        self.suppressions = _parse_suppressions(self.lines)
+        self.file_suppressions = _parse_file_suppressions(self.lines)
+        #: {function name: wrapping jit Call} for names wrapped by a jit
+        #: call somewhere in the module (``self._jitted = jax.jit(run)``
+        #: marks ``run`` as jitted, keeping its static_argnames reachable)
+        self.jit_wrapped_names = _jit_wrapped_names(self)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # -- name canonicalization ---------------------------------------------
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, with the module's
+        import aliases resolved (``jnp.asarray`` → ``jax.numpy.asarray``)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def is_suppressed(self, f: Finding) -> bool:
+        if "all" in self.file_suppressions \
+                or f.rule in self.file_suppressions:
+            return True
+
+        def matches(lineno: int) -> bool:
+            rules = self.suppressions.get(lineno, ())
+            return "all" in rules or f.rule in rules
+
+        if matches(f.line):
+            return True
+        # a pragma anywhere in the standalone-comment block immediately
+        # above the finding line applies (multi-line justifications);
+        # a trailing pragma on a previous CODE line does not spill down
+        lineno = f.line - 1
+        while lineno >= 1 and self.line(lineno).startswith("#"):
+            if matches(lineno):
+                return True
+            lineno -= 1
+        return False
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """{local name: canonical dotted prefix} from the module's imports."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            # relative imports keep the tail (``from .convert import
+            # register_op`` → ``convert.register_op``) — enough for
+            # suffix-matched names like OP_HANDLERS/register_op
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                prefix = f"{node.module}." if node.module else ""
+                out[a.asname or a.name] = f"{prefix}{a.name}"
+    return out
+
+
+def _parse_file_suppressions(lines: Sequence[str]) -> Set[str]:
+    out: Set[str] = set()
+    for text in lines:
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            spec = m.group(1).strip()
+            out |= ({"all"} if spec == "all"
+                    else {s.strip() for s in spec.split(",") if s.strip()})
+    return out
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            spec = m.group(1).strip()
+            out[i] = ({"all"} if spec == "all"
+                      else {s.strip() for s in spec.split(",") if s.strip()})
+    return out
+
+
+# -- jit detection shared by the rules --------------------------------------
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+             "pjit.pjit", "jit", "pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def jit_call_target(module: ModuleInfo, call: ast.Call) -> Optional[ast.Call]:
+    """If ``call`` constructs a jitted callable — ``jax.jit(...)`` or
+    ``functools.partial(jax.jit, ...)`` — return the inner jit Call-like
+    node carrying the keywords, else None."""
+    name = module.dotted(call.func)
+    if name in JIT_NAMES:
+        return call
+    if name in PARTIAL_NAMES and call.args \
+            and module.dotted(call.args[0]) in JIT_NAMES:
+        return call
+    return None
+
+
+def _jit_wrapped_names(module: ModuleInfo) -> Dict[str, ast.Call]:
+    out: Dict[str, ast.Call] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and jit_call_target(module, node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out[arg.id] = node
+    return out
+
+
+def jit_decoration(module: ModuleInfo, fn: ast.AST) -> Optional[Set[str]]:
+    """If ``fn`` (FunctionDef) is jit-decorated or jit-wrapped by name,
+    return its set of STATIC parameter names (empty set when none are
+    declared); None when the function is not jitted."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            inner = jit_call_target(module, dec)
+            if inner is not None:
+                return _static_param_names(fn, inner)
+        elif module.dotted(dec) in JIT_NAMES:
+            return set()
+    wrap = module.jit_wrapped_names.get(fn.name)
+    if wrap is not None:
+        return _static_param_names(fn, wrap)
+    return None
+
+
+def _static_param_names(fn, jit_call: ast.Call) -> Set[str]:
+    """static_argnames / static_argnums keywords → parameter-name set."""
+    static: Set[str] = set()
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for v in _const_elements(kw.value):
+                if isinstance(v, str):
+                    static.add(v)
+        elif kw.arg == "static_argnums":
+            for v in _const_elements(kw.value):
+                if isinstance(v, int) and 0 <= v < len(pos):
+                    static.add(pos[v])
+    return static
+
+
+def _const_elements(node: ast.AST) -> List[object]:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# project model + driver
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Project:
+    """Everything the analyzer saw: parsed modules plus sibling stubs."""
+
+    root: str
+    modules: List[ModuleInfo] = field(default_factory=list)
+    #: {module relpath: stub relpath} for modules with a sibling ``.pyi``
+    stubs: Dict[str, str] = field(default_factory=dict)
+    #: files that failed to parse, as (relpath, error) — reported, not fatal
+    parse_errors: List[tuple] = field(default_factory=list)
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+
+def load_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
+    """Parse every ``*.py`` under ``paths`` (files or directories)."""
+    root = os.path.abspath(root or os.getcwd())
+    project = Project(root=root)
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+    for path in sorted(set(files)):
+        relpath = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            module = ModuleInfo(relpath, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            project.parse_errors.append((relpath, str(e)))
+            continue
+        project.modules.append(module)
+        stub = os.path.splitext(path)[0] + ".pyi"
+        if os.path.exists(stub):
+            project.stubs[relpath] = os.path.relpath(stub, root)
+    return project
+
+
+def analyze_project(project: Project,
+                    rules: Optional[Sequence[Rule]] = None,
+                    keep_suppressed: bool = False):
+    """Run the rules; returns (findings, suppressed) sorted by location."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_relpath = {m.relpath: m for m in project.modules}
+    for rule in rules:
+        raw: List[Finding] = []
+        if rule.project_scope:
+            raw.extend(rule.check_project(project))
+        else:
+            for module in project.modules:
+                raw.extend(rule.check(module))
+        for f in raw:
+            module = by_relpath.get(f.path)
+            if module is not None and module.is_suppressed(f):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    key = lambda f: (f.path, f.line, f.col, f.rule)   # noqa: E731
+    findings.sort(key=key)
+    suppressed.sort(key=key)
+    return (findings, suppressed) if keep_suppressed else (findings, [])
+
+
+def analyze_source(source: str, relpath: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   keep_suppressed: bool = False):
+    """Analyze one in-memory snippet (the test-fixture entry point).
+    Project-scope rules see a single-module project."""
+    module = ModuleInfo(relpath, source)
+    project = Project(root=os.getcwd(), modules=[module])
+    return analyze_project(project, rules=rules,
+                           keep_suppressed=keep_suppressed)

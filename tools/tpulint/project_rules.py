@@ -1,0 +1,313 @@
+"""Project-scope rules: cross-file checks over the whole parsed tree.
+
+TPU005 cross-checks the ONNX ``OP_HANDLERS`` dispatch table against every
+module that registers into it; TPU006 cross-checks ``.pyi`` stubs against
+the modules they describe.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from .core import Finding, ModuleInfo, Project, Rule, register_rule
+
+REGISTRY_NAME = "OP_HANDLERS"
+DECORATOR_NAME = "register_op"
+
+
+class Registration(NamedTuple):
+    op: str                 # ONNX op name ("Add")
+    module: ModuleInfo
+    node: ast.AST           # the registering statement / decorator
+    value: Optional[ast.AST]  # RHS expression when known (None for loops)
+
+
+def _registry_subscript(module: ModuleInfo, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Subscript)
+            and (module.dotted(node.value) or "").split(".")[-1]
+            == REGISTRY_NAME)
+
+
+def _top_level_names(tree: ast.AST) -> Set[str]:
+    """Names bound at module top level (defs, classes, assigns, imports,
+    for-loop targets — loop registrations bind ``_name``/``_fn``)."""
+    out: Set[str] = set()
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                if a.name != "*":
+                    out.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, (ast.For, ast.While, ast.If, ast.Try,
+                               ast.With)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Store):
+                    out.add(sub.id)
+                elif isinstance(sub, (ast.FunctionDef, ast.ClassDef)):
+                    out.add(sub.name)
+    return out
+
+
+def _collect_registrations(module: ModuleInfo) -> List[Registration]:
+    regs: List[Registration] = []
+    for node in ast.walk(module.tree):
+        # @register_op("X") decorating a handler
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and (module.dotted(dec.func) or "").split(".")[-1] \
+                        == DECORATOR_NAME \
+                        and dec.args \
+                        and isinstance(dec.args[0], ast.Constant) \
+                        and isinstance(dec.args[0].value, str):
+                    regs.append(Registration(dec.args[0].value, module,
+                                             dec, None))
+        # register_op("X")(handler) called directly (not as a decorator)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Call) \
+                and (module.dotted(node.func.func) or "").split(".")[-1] \
+                == DECORATOR_NAME \
+                and node.func.args \
+                and isinstance(node.func.args[0], ast.Constant) \
+                and isinstance(node.func.args[0].value, str):
+            regs.append(Registration(node.func.args[0].value, module,
+                                     node, node.args[0] if node.args
+                                     else None))
+        # OP_HANDLERS["X"] = handler
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and _registry_subscript(module, node.targets[0]):
+            sub = node.targets[0]
+            key = sub.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                regs.append(Registration(key.value, module, node,
+                                         node.value))
+        # for _name, _fn in [("Add", jnp.add), ...]: OP_HANDLERS[_name] = ...
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, (ast.List, ast.Tuple)):
+            loop_keys: List[Tuple[str, ast.AST]] = []
+            for elt in node.iter.elts:
+                if isinstance(elt, (ast.Tuple, ast.List)) and elt.elts \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    loop_keys.append((elt.elts[0].value, elt))
+            if not loop_keys:
+                continue
+            writes_registry = any(
+                isinstance(stmt, ast.Assign)
+                and any(_registry_subscript(module, t)
+                        and isinstance(t.slice, ast.Name)
+                        for t in stmt.targets)
+                for stmt in node.body)
+            if writes_registry:
+                for op, elt in loop_keys:
+                    regs.append(Registration(op, module, elt, None))
+    return regs
+
+
+def _registers_ops(module: ModuleInfo) -> bool:
+    """Does this module import (or define) the registry machinery?"""
+    names = set(module.aliases)
+    return REGISTRY_NAME in names or DECORATOR_NAME in names \
+        or REGISTRY_NAME in _top_level_names(module.tree)
+
+
+@register_rule
+class OpRegistryDrift(Rule):
+    code = "TPU005"
+    name = "op-registry-drift"
+    severity = "error"
+    project_scope = True
+    doc = ("The ONNX dispatch table (``OP_HANDLERS`` in onnx/convert.py) "
+           "cross-checked against every module registering into it: "
+           "duplicate/shadowed op names (second registration silently "
+           "wins), dangling registrations (RHS name not defined in the "
+           "module), handler-shaped functions never registered nor "
+           "referenced (dead ops), and registering modules the defining "
+           "module never imports (their ops never land in the table).")
+
+    def check_project(self, project: Project):
+        findings: List[Finding] = []
+        defining: Optional[ModuleInfo] = None
+        registering: List[ModuleInfo] = []
+        for m in project.modules:
+            has_def = any(
+                isinstance(n, (ast.Assign, ast.AnnAssign))
+                and any((m.dotted(t) or "") == REGISTRY_NAME
+                        for t in (n.targets if isinstance(n, ast.Assign)
+                                  else [n.target]))
+                for n in m.tree.body)
+            if has_def:
+                defining = m
+            if has_def or _registers_ops(m):
+                registering.append(m)
+        if not registering:
+            return iter(())
+
+        # 1. duplicate / shadowed op names -- the later write silently wins
+        seen: Dict[str, Registration] = {}
+        for m in registering:
+            for reg in _collect_registrations(m):
+                first = seen.get(reg.op)
+                if first is not None:
+                    findings.append(self.finding(
+                        m, reg.node,
+                        f"op '{reg.op}' registered twice (first at "
+                        f"{first.module.relpath}:{first.node.lineno}); the "
+                        f"later registration silently shadows the first"))
+                else:
+                    seen[reg.op] = reg
+
+        # 2. dangling registrations -- bare-Name RHS not bound in module
+        for m in registering:
+            bound = _top_level_names(m.tree)
+            for reg in _collect_registrations(m):
+                if isinstance(reg.value, ast.Name) \
+                        and reg.value.id not in bound:
+                    findings.append(self.finding(
+                        m, reg.node,
+                        f"op '{reg.op}' registered to undefined name "
+                        f"'{reg.value.id}' — dangling registration"))
+
+        # 3. handler-shaped functions never registered nor referenced
+        registered_ids: Set[int] = set()
+        for m in registering:
+            referenced = {n.id for n in ast.walk(m.tree)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)}
+            decorated_or_assigned: Set[str] = set()
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.decorator_list:
+                    decorated_or_assigned.add(node.name)
+            for node in m.tree.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                args = node.args
+                params = [a.arg for a in args.posonlyargs + args.args]
+                handler_shaped = (len(params) == 3
+                                  and not args.vararg and not args.kwarg
+                                  and params[0] in ("node", "n"))
+                if not handler_shaped:
+                    continue
+                if node.name in decorated_or_assigned \
+                        or node.name in referenced:
+                    continue
+                findings.append(self.finding(
+                    m, node,
+                    f"handler-shaped function '{node.name}(node, inputs, "
+                    f"ctx)' is neither registered via {DECORATOR_NAME} nor "
+                    f"referenced — the op it implements is unreachable",
+                    severity="warning"))
+        del registered_ids
+
+        # 4. registering modules the defining module never imports
+        if defining is not None:
+            pkg_dir = os.path.dirname(defining.relpath)
+            reachable: Set[str] = set()
+            importers = [defining]
+            init = project.module(os.path.join(pkg_dir, "__init__.py")
+                                  if pkg_dir else "__init__.py")
+            if init is not None:
+                importers.append(init)
+            for imp in importers:
+                for node in ast.walk(imp.tree):
+                    if isinstance(node, ast.ImportFrom):
+                        for a in node.names:
+                            reachable.add(a.name)
+                        if node.module:
+                            reachable.add(node.module.split(".")[-1])
+                    elif isinstance(node, ast.Import):
+                        for a in node.names:
+                            reachable.add(a.name.split(".")[-1])
+            for m in registering:
+                if m is defining:
+                    continue
+                if os.path.dirname(m.relpath) != pkg_dir:
+                    continue
+                basename = os.path.splitext(
+                    os.path.basename(m.relpath))[0]
+                if basename not in reachable and _collect_registrations(m):
+                    findings.append(self.finding(
+                        m, m.tree.body[0] if m.tree.body else m.tree,
+                        f"module registers ops but is never imported by "
+                        f"{defining.relpath} (or the package __init__) — "
+                        f"its registrations never land in the dispatch "
+                        f"table"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU006 — stub drift
+# ---------------------------------------------------------------------------
+
+@register_rule
+class StubDrift(Rule):
+    code = "TPU006"
+    name = "stub-drift"
+    severity = "warning"
+    project_scope = True
+    doc = ("A sibling ``.pyi`` stub naming top-level classes/functions its "
+           "module no longer defines. One-directional on purpose: the "
+           "generated stubs end in a module ``__getattr__`` catch-all, so "
+           "module names missing from a stub are fine — stub names missing "
+           "from the module are lies.")
+
+    def check_project(self, project: Project):
+        findings: List[Finding] = []
+        for mod_rel, stub_rel in sorted(project.stubs.items()):
+            module = project.module(mod_rel)
+            if module is None:
+                continue
+            stub_path = os.path.join(project.root, stub_rel)
+            try:
+                with open(stub_path, encoding="utf-8") as fh:
+                    stub_source = fh.read()
+                stub_tree = ast.parse(stub_source, filename=stub_rel)
+                stub_lines = stub_source.splitlines()
+            except (OSError, SyntaxError) as e:
+                findings.append(Finding(
+                    rule=self.code, path=stub_rel, line=1, col=0,
+                    severity="error",
+                    message=f"stub failed to parse: {e}", snippet=""))
+                continue
+            module_names = _top_level_names(module.tree)
+            for node in stub_tree.body:
+                names: List[Tuple[str, ast.AST]] = []
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    names.append((node.name, node))
+                elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.append((t.id, node))
+                for name, at in names:
+                    if name.startswith("__"):
+                        continue  # __getattr__, __all__, __version__ ...
+                    if name not in module_names:
+                        lineno = getattr(at, "lineno", 1)
+                        snippet = stub_lines[lineno - 1].strip() \
+                            if 1 <= lineno <= len(stub_lines) else ""
+                        findings.append(Finding(
+                            rule=self.code, path=stub_rel,
+                            line=lineno,
+                            col=getattr(at, "col_offset", 0),
+                            severity=self.severity,
+                            message=(f"stub declares '{name}' but "
+                                     f"{mod_rel} no longer defines it"),
+                            snippet=snippet))
+        return iter(findings)

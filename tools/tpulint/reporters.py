@@ -1,0 +1,64 @@
+"""Text and JSON reporters for tpulint findings."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Optional, Sequence, TextIO
+
+from .core import Finding, all_rules
+
+def text_report(findings: Sequence[Finding], stream: TextIO,
+                baselined: Sequence[Finding] = (),
+                stale: Optional[Dict[str, int]] = None,
+                parse_errors: Sequence[tuple] = ()) -> None:
+    for rel, err in parse_errors:
+        stream.write(f"{rel}:1:1: PARSE error: {err}\n")
+    for f in findings:
+        stream.write(f"{f.location()}: {f.rule} {f.severity}: {f.message}\n")
+        if f.snippet:
+            stream.write(f"    {f.snippet}\n")
+    by_sev = Counter(f.severity for f in findings)
+    summary = ", ".join(f"{n} {sev}" for sev, n in sorted(by_sev.items())) \
+        or "no findings"
+    stream.write(f"tpulint: {summary}")
+    if baselined:
+        stream.write(f" ({len(baselined)} baselined)")
+    stream.write("\n")
+    if stale:
+        stream.write(f"tpulint: {sum(stale.values())} stale baseline "
+                     f"entr{'y' if sum(stale.values()) == 1 else 'ies'} "
+                     f"(fixed findings) — regenerate with "
+                     f"scripts/gen_tpulint_baseline.py:\n")
+        for fp in sorted(stale):
+            stream.write(f"    {fp} x{stale[fp]}\n")
+
+def json_report(findings: Sequence[Finding], stream: TextIO,
+                baselined: Sequence[Finding] = (),
+                stale: Optional[Dict[str, int]] = None,
+                parse_errors: Sequence[tuple] = ()) -> None:
+    def row(f: Finding) -> dict:
+        return {"rule": f.rule, "path": f.path, "line": f.line,
+                "col": f.col + 1, "severity": f.severity,
+                "message": f.message, "snippet": f.snippet}
+
+    payload = {
+        "findings": [row(f) for f in findings],
+        "baselined": [row(f) for f in baselined],
+        "stale_baseline": dict(sorted((stale or {}).items())),
+        "parse_errors": [{"path": p, "error": e} for p, e in parse_errors],
+        "summary": dict(Counter(f.severity for f in findings)),
+    }
+    json.dump(payload, stream, indent=2)
+    stream.write("\n")
+
+def rule_catalog(stream: TextIO) -> None:
+    """``--list-rules``: the registry, one rule per stanza."""
+    for rule in all_rules():
+        scope = "project" if rule.project_scope else "module"
+        stream.write(f"{rule.code} {rule.name} "
+                     f"[{rule.severity}, {scope}-scope]\n")
+        for line in rule.doc.splitlines():
+            stream.write(f"    {line.strip()}\n")
+
+REPORTERS = {"text": text_report, "json": json_report}

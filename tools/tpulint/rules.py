@@ -1,0 +1,432 @@
+"""Per-module rules TPU001-TPU004: the jit-boundary hazards.
+
+Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
+walks a module — the innermost *jit context* (entered through a
+``@jax.jit`` decoration, a ``functools.partial(jax.jit, ...)`` decoration,
+a name later wrapped as ``jax.jit(fn)``, or a ``jax.jit(lambda ...)``
+argument) and the *loop depth* (reset at function boundaries: work inside a
+nested ``def`` is not per-iteration work of the enclosing loop).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from .core import (Finding, ModuleInfo, Rule, jit_call_target,
+                   jit_decoration, register_rule)
+
+#: attribute reads that are static under tracing (safe to branch on)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type"}
+
+#: calls whose result is trace-time static even on tracer arguments
+SAFE_TEST_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable",
+                   "jax.core.is_concrete"}
+
+_SCI_RE = re.compile(r"\d[eE][-+]?\d")
+
+
+class _JitCtx:
+    __slots__ = ("tracer_params", "static_params")
+
+    def __init__(self, tracer_params: Set[str], static_params: Set[str]):
+        self.tracer_params = tracer_params
+        self.static_params = static_params
+
+
+class _ContextVisitor(ast.NodeVisitor):
+    """Shared walk: maintains jit-context and loop-depth stacks."""
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.findings: List[Finding] = []
+        self._jit_stack: List[_JitCtx] = []
+        self._loop_stack: List[int] = [0]   # per-function loop depth
+
+    # -- context accessors ---------------------------------------------------
+    @property
+    def jit_ctx(self) -> Optional[_JitCtx]:
+        return self._jit_stack[-1] if self._jit_stack else None
+
+    @property
+    def loop_depth(self) -> int:
+        return self._loop_stack[-1]
+
+    # -- stack maintenance ---------------------------------------------------
+    def _function_params(self, fn, static: Set[str]) -> Set[str]:
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        tracers = {n for n in names if n not in static
+                   and n not in ("self", "cls")}
+        return tracers
+
+    def visit_FunctionDef(self, node):
+        static = jit_decoration(self.module, node)
+        entered_jit = False
+        if static is not None:
+            self._jit_stack.append(
+                _JitCtx(self._function_params(node, static), static))
+            entered_jit = True
+        self._loop_stack.append(0)
+        self.enter_function(node, entered_jit)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+        if entered_jit:
+            self._jit_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def enter_function(self, node, entered_jit: bool) -> None:
+        """Hook for rules that care about function entry."""
+
+    def visit_Lambda(self, node):
+        self._loop_stack.append(0)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+
+    def _visit_loop(self, node):
+        self.handle_loop(node)
+        # the loop header (iter/test) is NOT per-iteration host work at the
+        # same rank as the body; only the body/orelse run per iteration
+        for header in ("target", "iter", "test"):
+            child = getattr(node, header, None)
+            if child is not None:
+                self.visit(child)
+        self._loop_stack[-1] += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._loop_stack[-1] -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def handle_loop(self, node) -> None:
+        """Hook for rules that care about loop statements themselves."""
+
+    # -- jitted lambdas ------------------------------------------------------
+    def visit_Call(self, node):
+        inner = jit_call_target(self.module, node)
+        handled = False
+        if inner is not None:
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    params = {a.arg for a in arg.args.posonlyargs
+                              + arg.args.args + arg.args.kwonlyargs}
+                    self._jit_stack.append(_JitCtx(params, set()))
+                    self._loop_stack.append(0)
+                    self.generic_visit(arg)
+                    self._loop_stack.pop()
+                    self._jit_stack.pop()
+                    handled = True
+        self.handle_call(node)
+        if not handled:
+            self.generic_visit(node)
+        else:
+            # non-lambda children (keywords, func expr) still get walked
+            self.visit(node.func)
+            for arg in node.args:
+                if not isinstance(arg, ast.Lambda):
+                    self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw.value)
+
+    def handle_call(self, node: ast.Call) -> None:
+        """Hook for rules that care about calls."""
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host sync inside jitted code or per-batch loops
+# ---------------------------------------------------------------------------
+
+#: calls that force a device→host round-trip (or concretize a tracer)
+HOST_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready",
+                   "numpy.asarray", "numpy.array",
+                   "numpy.ascontiguousarray", "numpy.copy"}
+#: method names that concretize/serialize when hit on a traced/device array
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host",
+                     "__array__"}
+#: the loop-context subset: per-iteration syncs that serialize the pipeline
+LOOP_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+BUILTIN_CASTS = {"float", "int", "bool", "complex"}
+
+
+@register_rule
+class HostSyncInJit(Rule):
+    code = "TPU001"
+    name = "host-sync-in-jit"
+    severity = "error"
+    doc = ("jax.device_get / np.asarray / float() / .item() on arrays "
+           "inside jitted functions (concretization error or silent "
+           "constant-folding), and per-iteration device_get / "
+           "block_until_ready inside batch loops (serializes the feed/drain "
+           "pipeline the runner pipelines; drain once at the end instead).")
+
+    def check(self, module: ModuleInfo):
+        visitor = _TPU001(module, self)
+        visitor.visit(module.tree)
+        return iter(visitor.findings)
+
+
+class _TPU001(_ContextVisitor):
+    def __init__(self, module, rule):
+        super().__init__(module)
+        self.rule = rule
+
+    def handle_call(self, node: ast.Call):
+        name = self.module.dotted(node.func)
+        if self.jit_ctx is not None:
+            if name in HOST_SYNC_CALLS:
+                self.findings.append(self.rule.finding(
+                    self.module, node,
+                    f"{name}() inside jitted code forces a host sync / "
+                    f"concretization at trace time; keep data on device "
+                    f"(jnp.*) or hoist the host read out of the jit"))
+                return
+            if name in BUILTIN_CASTS and node.args \
+                    and _tracer_reads(node.args[0],
+                                      self.jit_ctx.tracer_params,
+                                      self.module):
+                self.findings.append(self.rule.finding(
+                    self.module, node,
+                    f"{name}() on a traced value concretizes it "
+                    f"(ConcretizationTypeError at best, a baked-in "
+                    f"constant at worst); use jnp casts or mark the "
+                    f"argument static"))
+                return
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in HOST_SYNC_METHODS:
+                self.findings.append(self.rule.finding(
+                    self.module, node,
+                    f".{node.func.attr}() inside jitted code pulls the "
+                    f"value to host; jit output should stay a device "
+                    f"array"))
+                return
+        if self.loop_depth > 0:
+            per_iter = (name in LOOP_SYNC_CALLS
+                        or (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "block_until_ready"))
+            if per_iter:
+                self.findings.append(self.rule.finding(
+                    self.module, node,
+                    "per-iteration host sync "
+                    f"({name or node.func.attr}) serializes host and "
+                    "device; batch the drain after the loop "
+                    "(copy_to_host_async + one device_get), "
+                    "severity=warning", severity="warning"))
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — jax.jit constructed inside a loop body
+# ---------------------------------------------------------------------------
+
+@register_rule
+class JitInLoop(Rule):
+    code = "TPU002"
+    name = "jit-in-loop"
+    severity = "error"
+    doc = ("jax.jit(...) (or functools.partial(jax.jit, ...)) constructed "
+           "inside a loop body: every iteration builds a fresh callable "
+           "with an empty executable cache, so steady state recompiles "
+           "forever — exactly what tests/test_recompile_probe.py probes "
+           "dynamically. Hoist the jit out of the loop or cache it.")
+
+    def check(self, module: ModuleInfo):
+        visitor = _TPU002(module, self)
+        visitor.visit(module.tree)
+        return iter(visitor.findings)
+
+
+class _TPU002(_ContextVisitor):
+    def __init__(self, module, rule):
+        super().__init__(module)
+        self.rule = rule
+
+    def handle_call(self, node: ast.Call):
+        if self.loop_depth > 0 and jit_call_target(self.module, node):
+            self.findings.append(self.rule.finding(
+                self.module, node,
+                "jax.jit constructed inside a loop body — a fresh jit "
+                "cache per iteration means a recompile per iteration; "
+                "hoist the jitted callable out of the loop"))
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — Python control flow on traced parameters
+# ---------------------------------------------------------------------------
+
+@register_rule
+class TracerBranch(Rule):
+    code = "TPU003"
+    name = "tracer-branch"
+    severity = "error"
+    doc = ("Python if/while on a traced parameter of a jitted function: "
+           "the branch either raises ConcretizationTypeError or silently "
+           "bakes one path into the executable. Branch on static metadata "
+           "(.shape/.dtype/static_argnames) or use lax.cond / "
+           "lax.while_loop / jnp.where.")
+
+    def check(self, module: ModuleInfo):
+        visitor = _TPU003(module, self)
+        visitor.visit(module.tree)
+        return iter(visitor.findings)
+
+
+class _TPU003(_ContextVisitor):
+    def __init__(self, module, rule):
+        super().__init__(module)
+        self.rule = rule
+
+    def visit_If(self, node):
+        self._check_test(node, node.test, "if")
+        self.generic_visit(node)
+
+    def handle_loop(self, node):
+        test = getattr(node, "test", None)
+        if test is not None:
+            self._check_test(node, test, "while")
+
+    def _check_test(self, stmt, test: ast.AST, kind: str):
+        ctx = self.jit_ctx
+        if ctx is None or not ctx.tracer_params:
+            return
+        hits = sorted(_tracer_reads(test, ctx.tracer_params, self.module))
+        if hits:
+            self.findings.append(self.rule.finding(
+                self.module, stmt,
+                f"`{kind}` on traced parameter(s) {', '.join(hits)} inside "
+                f"jitted code; use lax.cond/lax.while_loop/jnp.where, or "
+                f"declare the argument in static_argnames if it is truly "
+                f"host-static"))
+
+
+def _tracer_reads(node: ast.AST, tracers: Set[str],
+                  module: ModuleInfo) -> Set[str]:
+    """Names of tracer params read *as values* in a test expression.
+
+    Reads under trace-time-static contexts do not count: ``x.shape[0]``,
+    ``x.dtype == ...``, ``len(x)``, ``isinstance(x, ...)``, ``x is None``.
+    """
+    out: Set[str] = set()
+
+    def walk(n: ast.AST, safe: bool):
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            walk(n.value, True)
+            return
+        if isinstance(n, ast.Call):
+            fname = module.dotted(n.func)
+            child_safe = safe or fname in SAFE_TEST_CALLS
+            walk(n.func, safe)
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                walk(a, child_safe)
+            return
+        if isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            # `x is None` tests pytree STRUCTURE, which is trace-static
+            for child in ast.iter_child_nodes(n):
+                walk(child, True)
+            return
+        if isinstance(n, ast.Name) and not safe and n.id in tracers:
+            out.add(n.id)
+            return
+        for child in ast.iter_child_nodes(n):
+            walk(child, safe)
+
+    walk(node, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU004 — float64 / python-float dtype leaks toward device code
+# ---------------------------------------------------------------------------
+
+#: directories whose modules feed devices directly — dtype-less host
+#: coercions here leak float64 into the transfer path
+DEVICE_DIRS = {"ops", "nn", "parallel"}
+
+F64_NAMES = {"numpy.float64", "jax.numpy.float64", "float64"}
+COERCE_CALLS = {"numpy.asarray", "numpy.array"}
+
+
+@register_rule
+class DtypeLeak(Rule):
+    code = "TPU004"
+    name = "dtype-leak"
+    severity = "warning"
+    doc = ("float64 creeping toward jitted code: explicit np.float64 / "
+           "'float64' dtypes, dtype-less np.asarray/np.array in "
+           "device-feed modules (a Python float list silently becomes "
+           "float64 — a new jit signature and a 2x transfer), and bare "
+           "scientific-notation float literals inside jitted functions "
+           "(weak-typed; under jax_enable_x64 they widen the program).")
+
+    def check(self, module: ModuleInfo):
+        visitor = _TPU004(module, self)
+        visitor.visit(module.tree)
+        return iter(visitor.findings)
+
+
+class _TPU004(_ContextVisitor):
+    def __init__(self, module, rule):
+        super().__init__(module)
+        self.rule = rule
+        parts = set(module.relpath.replace("\\", "/").split("/"))
+        self.device_dir = bool(parts & DEVICE_DIRS)
+        self._flagged = set()   # node ids, so nested calls don't double-report
+
+    def handle_call(self, node: ast.Call):
+        name = self.module.dotted(node.func)
+        # float64 constructed or passed as a dtype in device-feed modules;
+        # comparisons like ``arr.dtype == np.float64`` are checks, not
+        # leaks, so only call-argument/constructor position counts
+        if self.device_dir:
+            f64_uses = [node.func] if name in F64_NAMES else []
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(sub):
+                    if isinstance(n, (ast.Attribute, ast.Name)) \
+                            and self.module.dotted(n) in F64_NAMES:
+                        f64_uses.append(n)
+            for n in f64_uses:
+                if id(n) in self._flagged:
+                    continue
+                self._flagged.add(id(n))
+                self.findings.append(self.rule.finding(
+                    self.module, n,
+                    "explicit float64 on the device-feed path; TPUs have "
+                    "no f64 ALU — use float32 (or bfloat16) unless this "
+                    "is deliberate host-side math"))
+        # astype("float64") / dtype="float64" string spellings
+        for sub in (list(node.args) + [kw.value for kw in node.keywords]
+                    if self.device_dir else []):
+            if isinstance(sub, ast.Constant) and sub.value == "float64":
+                self.findings.append(self.rule.finding(
+                    self.module, sub,
+                    "'float64' dtype string on the device-feed path; use "
+                    "float32/bfloat16"))
+        if self.device_dir and name in COERCE_CALLS:
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords) \
+                or len(node.args) > 1
+            if not has_dtype:
+                self.findings.append(self.rule.finding(
+                    self.module, node,
+                    f"dtype-less {name}() in a device-feed module: a "
+                    f"Python float payload becomes float64 — a fresh jit "
+                    f"signature and double transfer bytes; pass an "
+                    f"explicit dtype or normalize f64→f32"))
+        # bare scientific literals in jitted code (1e-6-style epsilons)
+        if self.jit_ctx is not None and name is not None \
+                and name.split(".")[0] in ("jax", "lax"):
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, float):
+                    seg = ast.get_source_segment(self.module.source, sub)
+                    if seg and _SCI_RE.search(seg):
+                        self.findings.append(self.rule.finding(
+                            self.module, sub,
+                            f"bare float literal {seg} in jitted code "
+                            f"relies on weak-type promotion; under "
+                            f"jax_enable_x64 it widens the program — pin "
+                            f"it with a dtype-matched constant",
+                            severity="info"))
