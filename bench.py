@@ -239,6 +239,13 @@ def main():
     # carries the stage counters measured so far
     counter_sources = []
 
+    def _telemetry():
+        # stdlib-only registry snapshot: compile-cache hits/misses/
+        # steady_state_recompiles plus aggregate stage counters, so the
+        # perf trajectory carries observability data (docs/observability.md)
+        from mmlspark_tpu.observability import snapshot
+        return snapshot()
+
     def _watchdog():
         time.sleep(max(1.0, budget))
         record["budget_truncated"] = True
@@ -248,6 +255,7 @@ def main():
         try:
             for snap in counter_sources:
                 record["stage_counters"] = snap()
+            record["telemetry"] = _telemetry()
         except Exception:                   # noqa: BLE001
             pass
         if report.emit():
@@ -337,6 +345,7 @@ def main():
         record["midrun_error"] = \
             f"warmup failed: {type(e).__name__}: {e}"[:300]
         record["stage_counters"] = m.stage_counters.snapshot()
+        record["telemetry"] = _telemetry()
         report.emit()
         return
 
@@ -539,6 +548,7 @@ def main():
                            / max(pass_ips), 3)
                      if pass_ips else None),
         stage_counters=m.stage_counters.snapshot(),
+        telemetry=_telemetry(),
         wall_s=round(time.monotonic() - t_start, 2),
     )
     if midrun_error is not None:

@@ -30,10 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...observability import histogram as _metric_histogram
 from .binning import BinMapper
 from .booster import Booster
 from .objectives import get_metric, get_objective
 from .trees import build_tree
+
+_M_GBDT_PHASE = _metric_histogram(
+    "mmlspark_gbdt_phase_seconds",
+    "Per-iteration GBDT training phase wall-clock (populated only when "
+    "MMLSPARK_TPU_GBDT_PROF=1, like the _PhaseProf stderr report)",
+    ("phase",))
 
 __all__ = ["train", "TrainConfig", "resolve_params"]
 
@@ -225,6 +232,7 @@ class _PhaseProf:
             jax.block_until_ready(a)
         now = time.perf_counter()
         self.t[name] = self.t.get(name, 0.0) + (now - self._last)
+        _M_GBDT_PHASE.observe(now - self._last, phase=name)
         self._last = now
 
     def reset(self):

@@ -32,7 +32,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ..ops.compile_cache import StageCounters, jit_cache_size
+from ..ops.compile_cache import (M_CACHE_HITS, M_CACHE_MISSES,
+                                 M_STEADY_RECOMPILES, StageCounters,
+                                 jit_cache_size)
 from ..ops.padding import bucket_size, pad_axis
 from ..stages.batching import PrefetchIterator, batch_slices
 
@@ -108,8 +110,11 @@ class BatchRunner:
                 # the dispatch call blocked on trace+compile — a bucket the
                 # warm-up vocabulary missed; attribute the stall honestly
                 c.add("compile", elapsed, count=after - before)
+                M_CACHE_MISSES.inc(after - before)
+                M_STEADY_RECOMPILES.inc(after - before)
             else:
                 c.add("dispatch", elapsed)
+                M_CACHE_HITS.inc()
             for v in outs.values():
                 try:
                     v.copy_to_host_async()

@@ -1,0 +1,33 @@
+"""Unified telemetry: metrics registry, Prometheus exposition, event log.
+
+Pure stdlib (importable before jax), process-global, default-on. See
+docs/observability.md for the metric catalog and label conventions; the
+serving plane scrapes the global registry at ``GET /metrics``.
+"""
+
+from .events import EventLog, LOGGER_NAME, get_event_log, log_event
+from .exposition import CONTENT_TYPE, render_prometheus
+from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, counter, gauge, get_registry,
+                       histogram, render, reset_all, snapshot)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS",
+    "counter",
+    "gauge",
+    "histogram",
+    "get_registry",
+    "snapshot",
+    "render",
+    "reset_all",
+    "CONTENT_TYPE",
+    "render_prometheus",
+    "EventLog",
+    "LOGGER_NAME",
+    "get_event_log",
+    "log_event",
+]

@@ -31,6 +31,7 @@ from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import counter as _metric_counter
 from .padding import bucket_size
 
 __all__ = ["enable_persistent_cache", "persistent_cache_dir", "StageCounters",
@@ -39,6 +40,38 @@ __all__ = ["enable_persistent_cache", "persistent_cache_dir", "StageCounters",
 
 #: environment variable naming the persistent compilation cache directory
 CACHE_DIR_ENV = "MMLSPARK_TPU_COMPILE_CACHE_DIR"
+
+# Registry mirrors (docs/observability.md has the catalog). Stage counters
+# stay per-model objects for snapshot parity with the reference; every
+# StageCounters.add also feeds the process-global labeled counters below so
+# GET /metrics sees aggregate pipeline time without any plumbing. The
+# cache-outcome counters are shared with models/runner.py, which owns the
+# per-dispatch attribution.
+M_STAGE_SECONDS = _metric_counter(
+    "mmlspark_runner_stage_seconds_total",
+    "Cumulative feed/drain pipeline wall-clock by stage", ("stage",))
+M_STAGE_CALLS = _metric_counter(
+    "mmlspark_runner_stage_calls_total",
+    "Feed/drain pipeline stage invocations", ("stage",))
+M_STAGE_BYTES = _metric_counter(
+    "mmlspark_runner_stage_bytes_total",
+    "Bytes crossing the host<->device boundary by stage", ("stage",))
+M_CACHE_HITS = _metric_counter(
+    "mmlspark_compile_cache_hits_total",
+    "Dispatches served by an already-compiled executable")
+M_CACHE_MISSES = _metric_counter(
+    "mmlspark_compile_cache_misses_total",
+    "Dispatches that paid an inline XLA trace+compile")
+M_STEADY_RECOMPILES = _metric_counter(
+    "mmlspark_compile_cache_steady_state_recompiles_total",
+    "Compiles observed by the dispatch loop, i.e. outside warm-up — "
+    "nonzero means a bucket is missing from the warm_up vocabulary")
+M_WARMUP_BUCKETS = _metric_counter(
+    "mmlspark_compile_cache_warmup_buckets_total",
+    "Padding buckets executed ahead of traffic by warm_up")
+M_WARMUP_SECONDS = _metric_counter(
+    "mmlspark_compile_cache_warmup_seconds_total",
+    "Wall-clock spent in AOT warm-up")
 
 _cache_lock = threading.Lock()
 _cache_dir: Optional[str] = None
@@ -119,6 +152,11 @@ class StageCounters:
             s["calls"] += count
             s["seconds"] += seconds
             s["bytes"] += nbytes
+        # mirror into the process-global registry (aggregated over models)
+        M_STAGE_SECONDS.inc(seconds, stage=stage)
+        M_STAGE_CALLS.inc(count, stage=stage)
+        if nbytes:
+            M_STAGE_BYTES.inc(nbytes, stage=stage)
 
     class _Timer:
         __slots__ = ("_c", "_stage", "_nbytes", "_t0")
@@ -240,6 +278,9 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
         else None
     if counters is not None and buckets:
         counters.add("compile", elapsed, count=compiles or len(buckets))
+    if buckets:
+        M_WARMUP_BUCKETS.inc(len(buckets))
+        M_WARMUP_SECONDS.inc(elapsed)
     return {"buckets": buckets, "compiles": compiles,
             "seconds": round(elapsed, 4)}
 

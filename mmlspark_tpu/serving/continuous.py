@@ -41,11 +41,28 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import (counter as _metric_counter,
+                             gauge as _metric_gauge,
+                             histogram as _metric_histogram)
 from ..models.zoo.transformer import (TransformerConfig,
                                       _warp_scaled_rows,
                                       decode_step_ragged,
                                       prefill_cache, shardings_for)
 from ..ops.padding import bucket_size
+
+_M_DRAIN_SECONDS = _metric_histogram(
+    "mmlspark_continuous_drain_seconds",
+    "Host fetch latency of one outstanding (k, S) token block — the only "
+    "host<->device sync on the decode path")
+_M_LIVE_SLOTS = _metric_gauge(
+    "mmlspark_continuous_live_slots",
+    "Occupied decode slots at the latest step (batch size on device)")
+_M_PREFILLS = _metric_counter(
+    "mmlspark_continuous_prefills_total",
+    "Full prompt prefills executed (grouped prefills count once)")
+_M_PREFIX_HITS = _metric_counter(
+    "mmlspark_continuous_prefix_hits_total",
+    "Prompts served from the prefix cache via a suffix window")
 
 
 class _Request:
@@ -759,6 +776,7 @@ class ContinuousDecoder:
             _, d_rows = self._d_prefill(self._d_params, ids_d, lengths_d)
             row_cache = list(row_cache) + list(d_rows)
         self.stats["prefills"] += 1
+        _M_PREFILLS.inc()
         return logits, row_cache
 
     @staticmethod
@@ -906,6 +924,7 @@ class ContinuousDecoder:
                     f"prefix_key {req.prefix_key!r}: prompt does not "
                     f"start with the stored {plen}-token prefix")
             self.stats["prefix_hits"] += 1
+            _M_PREFIX_HITS.inc()
             # LRU promotion: the hit entry becomes the newest
             self._prefix_store[req.prefix_key] = \
                 self._prefix_store.pop(req.prefix_key)
@@ -938,6 +957,7 @@ class ContinuousDecoder:
         logits, row_cache = self._prefill(
             self._params, jnp.asarray(ids), jnp.asarray([P], jnp.int32))
         self.stats["prefills"] += 1
+        _M_PREFILLS.inc()
         if req.prefix_key is not None and self._prefix_store_cap > 0:
             # store-on-miss: snapshot ONLY the prefix region (a copy,
             # bounding snapshot size to the prefix — full-length rows
@@ -1009,6 +1029,7 @@ class ContinuousDecoder:
                 self._drain_one()
         self._admit()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
+        _M_LIVE_SLOTS.set(len(live))
         if not live:
             # nothing host-side to step — but outstanding blocks may still
             # hold tokens (and retire slots whose waiters are blocked)
@@ -1084,7 +1105,8 @@ class ContinuousDecoder:
         at scan step s iff its request is not yet done host-side when s is
         replayed in order — no device mask needed."""
         toks_dev, snapshot = self._pending.pop(0)
-        toks = np.asarray(toks_dev)
+        with _M_DRAIN_SECONDS.time():
+            toks = np.asarray(toks_dev)
         if self._spec and toks.shape[0] > 1:
             # spec blocks mark unemitted lanes -1; count real emissions
             # against dispatched round-slots for the acceptance stat

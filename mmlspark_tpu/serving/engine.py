@@ -14,16 +14,30 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import traceback
 from typing import Callable, Dict, Optional
 
 from ..core.dataframe import DataFrame
+from ..observability import (counter as _metric_counter,
+                             histogram as _metric_histogram)
 from .server import WorkerServer
 from .source import HTTPSink, HTTPSource, parse_request
 
 __all__ = ["ServingEngine"]
 
 _log = logging.getLogger("mmlspark_tpu.serving")
+
+_M_BATCH_ROWS = _metric_histogram(
+    "mmlspark_serving_batch_rows",
+    "Rows per drained serving batch (how well traffic coalesces)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+_M_BATCH_SECONDS = _metric_histogram(
+    "mmlspark_serving_batch_seconds",
+    "Wall-clock per drained batch: parse + transform + reply routing")
+_M_BATCH_ERRORS = _metric_counter(
+    "mmlspark_serving_batch_errors_total",
+    "Serving batches whose transform raised (every row answered 500)")
 
 
 class ServingEngine:
@@ -94,6 +108,8 @@ class ServingEngine:
             if len(df) == 0:
                 continue
             ids = df["id"]
+            _M_BATCH_ROWS.observe(len(df))
+            t0 = time.perf_counter()
             try:
                 parsed = parse_request(df, self.schema)
                 out = self.transform_fn(parsed)
@@ -107,10 +123,12 @@ class ServingEngine:
                             rid, {"error": "row dropped by pipeline"},
                             status=400)
             except Exception:
+                _M_BATCH_ERRORS.inc()
                 _log.error("serving batch failed:\n%s", traceback.format_exc())
                 for rid in ids:
                     self.server.reply_json(
                         rid, {"error": "internal error"}, status=500)
+            _M_BATCH_SECONDS.observe(time.perf_counter() - t0)
             self.server.commit_epoch()
 
     def stop(self) -> None:

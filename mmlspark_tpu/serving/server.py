@@ -15,9 +15,11 @@ of the reference's ``com.sun.net.httpserver`` + blocked ``HttpExchange``.
 from __future__ import annotations
 
 import asyncio
+import logging
 import queue
 import socket
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -25,8 +27,32 @@ from typing import Dict, Optional
 
 from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
                               HTTPResponseData, StatusLineData)
+from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
+                             counter as _metric_counter,
+                             gauge as _metric_gauge,
+                             histogram as _metric_histogram,
+                             log_event as _log_event,
+                             render as _render_metrics)
 
 __all__ = ["CachedRequest", "WorkerServer"]
+
+# serving-plane metrics (docs/observability.md) — scraped at GET /metrics,
+# which every WorkerServer answers as a built-in control route
+_M_REQUESTS = _metric_counter(
+    "mmlspark_serving_requests_total",
+    "HTTP requests answered by the worker server",
+    ("transport", "method", "code"))
+_M_REQ_LATENCY = _metric_histogram(
+    "mmlspark_serving_request_seconds",
+    "End-to-end request latency: body read to reply written (streaming "
+    "replies are observed at stream open)", ("transport",))
+_M_QUEUE_DEPTH = _metric_gauge(
+    "mmlspark_serving_queue_depth",
+    "Requests parked in the epoch queue awaiting a dispatcher", ("port",))
+_M_INFLIGHT = _metric_gauge(
+    "mmlspark_serving_inflight_requests",
+    "Requests accepted but not yet answered (routing-table size)",
+    ("port",))
 
 
 _STREAM_TIMEOUT_EVENT = b'data: {"error": "stream reply timeout"}\n\n'
@@ -156,8 +182,17 @@ class _Handler(BaseHTTPRequestHandler):
     # keep-alive request — the difference between 23 and 750 req/s/conn
     disable_nagle_algorithm = True
 
-    def log_message(self, fmt, *args):  # quiet
-        pass
+    def log_message(self, fmt, *args):
+        # quiet on stderr, but not dropped: access lines (and the parse
+        # errors BaseHTTPRequestHandler reports through log_error) become
+        # structured DEBUG events — raise the mmlspark_tpu.events logger
+        # level to see them, no code edit required
+        try:
+            line = fmt % args
+        except Exception:
+            line = fmt
+        _log_event("http_access", level=logging.DEBUG,
+                   client=self.client_address[0], line=line)
 
     def _read_body(self) -> bytes:
         te = (self.headers.get("Transfer-Encoding") or "").lower()
@@ -180,6 +215,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle(self):
         ws: "WorkerServer" = self.server.worker_server  # type: ignore[attr-defined]
+        t0 = time.perf_counter()
         try:
             body = self._read_body()
         except (ValueError, ConnectionError):
@@ -187,6 +223,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             self.close_connection = True
+            ws._observe_request("threaded", self.command, 400,
+                                time.perf_counter() - t0)
             return
         req = HTTPRequestData(
             url=self.path, method=self.command,
@@ -209,10 +247,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(504, "serving reply timeout")
             self.send_header("Content-Length", "0")
             self.end_headers()
+            ws._observe_request("threaded", self.command, 504,
+                                time.perf_counter() - t0)
             return
         if isinstance(resp, StreamingReply):
             # incremental reply: preamble now, chunks until close(); the
             # connection ends with the stream (no content length exists)
+            ws._observe_request("threaded", self.command, 200,
+                                time.perf_counter() - t0)
             self.send_response(200)
             self.send_header("Content-Type", resp.content_type)
             self.send_header("Cache-Control", "no-store")
@@ -238,6 +280,9 @@ class _Handler(BaseHTTPRequestHandler):
                     break
             return
         payload = resp.entity.content if resp.entity else b""
+        ws._observe_request("threaded", self.command,
+                            resp.status_line.status_code,
+                            time.perf_counter() - t0)
         self.send_response(resp.status_line.status_code,
                            resp.status_line.reason_phrase or None)
         sent = {h.name.lower() for h in resp.headers}
@@ -386,10 +431,14 @@ class _AsyncHTTPServer:
                             status_code=400,
                             reason_phrase="bad request body"))))
                     await writer.drain()
+                    # no parsed request line — count it, skip the latency
+                    # observation (t0 would include keep-alive idle time)
+                    ws._observe_request("async", "?", 400, None)
                     break
                 if parsed is None:
                     break
                 req, close = parsed
+                t0 = time.perf_counter()
                 ctrl = ws._control_route(req.url)
                 if ctrl is not None:
                     # control routes may block on cross-worker HTTP — keep
@@ -430,6 +479,8 @@ class _AsyncHTTPServer:
                             status_code=504,
                             reason_phrase="serving reply timeout"))
                 if isinstance(resp, StreamingReply):
+                    ws._observe_request("async", req.method, 200,
+                                        time.perf_counter() - t0)
                     writer.write(
                         b"HTTP/1.1 200 OK\r\n"
                         b"Content-Type: "
@@ -463,6 +514,9 @@ class _AsyncHTTPServer:
                             writer.write(chunk)
                         await writer.drain()
                     break                      # stream ends the connection
+                ws._observe_request("async", req.method,
+                                    resp.status_line.status_code,
+                                    time.perf_counter() - t0)
                 writer.write(self._render(resp))
                 await writer.drain()
                 if close:
@@ -504,8 +558,14 @@ class WorkerServer:
             raise ValueError(f"unknown transport {transport!r} "
                              "(expected 'threaded' or 'async')")
         self.reply_timeout = reply_timeout
-        #: path prefix → fn(HTTPRequestData) -> HTTPResponseData
-        self.control_routes: Dict[str, object] = {}
+        #: path prefix → fn(HTTPRequestData) -> HTTPResponseData. The
+        #: telemetry endpoints are registered FIRST: _control_route matches
+        #: prefixes in insertion order, so a later catch-all (e.g. the
+        #: distributed forwarder's "/") cannot shadow /metrics or /healthz
+        self.control_routes: Dict[str, object] = {
+            "/healthz": self._healthz_route,
+            "/metrics": self._metrics_route,
+        }
         #: request_id → CachedRequest (reference: routingTable ``:689``)
         self._routing: Dict[str, CachedRequest] = {}
         #: epoch → {request_id: CachedRequest} (reference: historyQueues)
@@ -555,6 +615,11 @@ class WorkerServer:
             if self._journal is not None:
                 self._journal.close()
             raise
+        # callback gauges, sampled at scrape/snapshot time (zero hot-path
+        # cost); labeled by port so concurrent servers don't collide —
+        # close() drops the series
+        _M_QUEUE_DEPTH.set_function(self._queue.qsize, port=str(self.port))
+        _M_INFLIGHT.set_function(self.pending_count, port=str(self.port))
 
     @property
     def address(self) -> str:
@@ -565,6 +630,39 @@ class WorkerServer:
             if path.startswith(prefix):
                 return fn
         return None
+
+    # -- telemetry ----------------------------------------------------------
+    def _observe_request(self, transport: str, method: Optional[str],
+                         code: int, seconds: Optional[float]) -> None:
+        _M_REQUESTS.inc(transport=transport, method=method or "?",
+                        code=str(code))
+        if seconds is not None:
+            _M_REQ_LATENCY.observe(seconds, transport=transport)
+
+    def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
+        import json as _json
+        with self._lock:
+            pending = len(self._routing)
+            epoch = self._epoch
+        body = {"status": "ok",
+                "transport": "async" if self._aio is not None else "threaded",
+                "port": self.port,
+                "queued": self._queue.qsize(),
+                "pending": pending,
+                "epoch": epoch}
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", "application/json")],
+            entity=EntityData.from_string(_json.dumps(body)),
+            status_line=StatusLineData(status_code=200))
+
+    def _metrics_route(self, request: HTTPRequestData) -> HTTPResponseData:
+        # Content-Type must ride in resp.headers — the transports render
+        # those, not the entity's content_type field
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", _PROM_CONTENT_TYPE)],
+            entity=EntityData.from_string(_render_metrics(),
+                                          content_type=_PROM_CONTENT_TYPE),
+            status_line=StatusLineData(status_code=200))
 
     # -- ingest -------------------------------------------------------------
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
@@ -680,6 +778,8 @@ class WorkerServer:
             return len(self._routing)
 
     def close(self) -> None:
+        _M_QUEUE_DEPTH.remove(port=str(self.port))
+        _M_INFLIGHT.remove(port=str(self.port))
         if self._aio is not None:
             self._aio.close()
         if self._httpd is not None:

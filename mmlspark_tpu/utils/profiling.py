@@ -24,7 +24,13 @@ import time
 
 __all__ = ["trace", "annotate", "StopWatch", "SpanTracer", "span"]
 
+from ..observability import histogram as _metric_histogram
 from .shared import StopWatch  # re-export: the reference-style wall timer
+
+_M_SPANS = _metric_histogram(
+    "mmlspark_span_seconds",
+    "Closed SpanTracer spans, mirrored from the Chrome-trace view when the "
+    "tracer is built with mirror_metrics=True", ("name",))
 
 _ACTIVE = threading.local()  # per-thread install: concurrent tracers in
 #                              different threads must not cross-record
@@ -38,13 +44,18 @@ class SpanTracer:
     ...         with span("stage:LightGBMClassifier"):
     ...             ...
     >>> t.export("run.trace.json")   # open in chrome://tracing / Perfetto
+
+    ``mirror_metrics=True`` additionally observes every closed span into
+    the ``mmlspark_span_seconds{name=...}`` histogram, so the Chrome-trace
+    and Prometheus views of a run agree.
     """
 
-    def __init__(self):
+    def __init__(self, mirror_metrics: bool = False):
         self._events = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._tids: dict = {}  # thread ident → small sequential track id
+        self._mirror = bool(mirror_metrics)
 
     def _tid(self) -> int:
         ident = threading.get_ident()
@@ -68,6 +79,8 @@ class SpanTracer:
                     "ts": (start - self._t0) * 1e6,
                     "dur": (end - start) * 1e6,
                     **({"args": args} if args else {})})
+            if self._mirror:
+                _M_SPANS.observe(end - start, name=name)
 
     # -- lifecycle ----------------------------------------------------------
     def __enter__(self) -> "SpanTracer":

@@ -76,6 +76,11 @@ class StopWatch:
     def stop(self) -> None:
         import time
         if self._start is not None:
+            # tpulint: disable=TPU007 — reference-parity wall timer:
+            # VW's TrainingStats consumes elapsed_ns directly (per
+            # partition, reported through the model's own stats surface);
+            # callers needing fleet visibility time at their own call
+            # sites via mmlspark_tpu.observability
             self.elapsed_ns += time.perf_counter_ns() - self._start
             self._start = None
 

@@ -53,18 +53,23 @@ class TestHelmChart:
             assert all(d and "kind" in d for d in docs), name
 
     def test_ci_pipeline_parses_and_covers_suites(self):
+        # every suite must be executed SOMEWHERE in the pipeline — most in
+        # the generated test-matrix, but some run in other stages
+        # (test_deploy.py in the docs job, test_observability.py in
+        # static-analysis), so collect scripts from every stage and job
         with open(os.path.join(REPO, "deploy", "ci", "pipeline.yaml")) as f:
             ci = yaml.safe_load(f)
-        jobs = next(s for s in ci["stages"]
-                    if s["name"] == "test-matrix")["jobs"]
-        referenced = " ".join(j["script"] for j in jobs)
+        scripts = []
+        for stage in ci["stages"]:
+            scripts.append(stage.get("script") or "")
+            scripts.extend(j["script"] for j in stage.get("jobs", []))
+        referenced = " ".join(scripts)
         missing = []
         for fname in sorted(os.listdir(os.path.join(REPO, "tests"))):
-            if fname.startswith("test_") and fname.endswith(".py") \
-                    and fname != "test_deploy.py":
+            if fname.startswith("test_") and fname.endswith(".py"):
                 if fname not in referenced:
                     missing.append(fname)
-        assert not missing, f"test files absent from CI matrix: {missing}"
+        assert not missing, f"test files absent from CI pipeline: {missing}"
 
     def test_ci_matrix_is_fresh(self):
         """pipeline.yaml is generated from tests/ — a new suite added

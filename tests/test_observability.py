@@ -1,0 +1,355 @@
+"""Unified telemetry layer: registry semantics (concurrency, bucket
+edges, label/type guards), Prometheus exposition golden text, the JSON
+event log, and end-to-end /metrics + /healthz on a live WorkerServer.
+
+The e2e test primes the process-global registry through the real hot
+paths (a jitted BatchRunner partition, then HTTP traffic) and asserts
+the scrape output contains the acceptance families: request-latency
+histogram, queue-depth gauge, runner stage counters, and compile-cache
+hit/miss/recompile counters.
+"""
+
+import json
+import logging
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import observability as obs
+from mmlspark_tpu.observability.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_series():
+    # zero every series but keep import-time metric objects registered —
+    # the contract that lets module-level metrics coexist with test runs
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+def _series_value(snap, name, **labels):
+    for s in snap[name]["series"]:
+        if s["labels"] == labels:
+            return s
+    raise AssertionError(f"{name}{labels} not in {snap[name]['series']}")
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+
+
+def test_counter_concurrent_increment_is_exact():
+    c = obs.counter("t_concurrent_total", "stress", ("worker",))
+    threads, per_thread = 8, 10_000
+
+    def bump(i):
+        for _ in range(per_thread):
+            c.inc(worker=str(i % 2))
+
+    ts = [threading.Thread(target=bump, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = obs.snapshot()
+    total = sum(s["value"]
+                for s in snap["t_concurrent_total"]["series"])
+    assert total == threads * per_thread
+    assert _series_value(snap, "t_concurrent_total",
+                         worker="0")["value"] == 4 * per_thread
+
+
+def test_counter_rejects_negative_and_gauge_moves_both_ways():
+    c = obs.counter("t_neg_total", "x")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = obs.gauge("t_gauge", "x")
+    g.set(5)
+    g.inc(2)
+    g.dec(4)
+    assert _series_value(obs.snapshot(), "t_gauge")["value"] == 3
+
+
+def test_histogram_bucket_edges_are_le_inclusive():
+    h = obs.histogram("t_edges_seconds", "x", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)    # exactly on the first boundary -> le="0.1"
+    h.observe(0.5)    # interior -> le="1.0"
+    h.observe(1.0)    # exactly on the second boundary -> le="1.0"
+    h.observe(99.0)   # overflow -> only +Inf
+    s = _series_value(obs.snapshot(), "t_edges_seconds")
+    assert s["buckets"] == {"0.1": 1, "1.0": 3, "10.0": 3, "+Inf": 4}
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(100.6)
+
+
+def test_histogram_timer_contextmanager():
+    h = obs.histogram("t_timer_seconds", "x", ("phase",))
+    with h.time(phase="p"):
+        pass
+    s = _series_value(obs.snapshot(), "t_timer_seconds", phase="p")
+    assert s["count"] == 1 and s["sum"] >= 0.0
+
+
+def test_registration_conflicts_raise():
+    obs.counter("t_conflict_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        obs.gauge("t_conflict_total", "x", ("a",))      # type mismatch
+    with pytest.raises(ValueError):
+        obs.counter("t_conflict_total", "x", ("b",))    # label mismatch
+    # same (type, labelnames) is idempotent: returns the same object
+    again = obs.counter("t_conflict_total", "x", ("a",))
+    assert again is obs.counter("t_conflict_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        obs.counter("t_conflict_total", "x", ("a",)).inc()  # missing label
+
+
+def test_gauge_callback_sampled_at_scrape_and_removable():
+    g = obs.gauge("t_cb_gauge", "x", ("port",))
+    box = {"v": 7.0}
+    g.set_function(lambda: box["v"], port="1234")
+    assert _series_value(obs.snapshot(), "t_cb_gauge",
+                         port="1234")["value"] == 7.0
+    box["v"] = 9.0
+    assert _series_value(obs.snapshot(), "t_cb_gauge",
+                         port="1234")["value"] == 9.0
+    g.remove(port="1234")
+    assert obs.snapshot()["t_cb_gauge"]["series"] == []
+
+
+def test_unlabeled_metrics_expose_zero_series_before_traffic():
+    # acceptance detail: cache hit/miss counters must appear in /metrics
+    # before the first dispatch, so dashboards see an explicit zero
+    import mmlspark_tpu.ops.compile_cache  # noqa: F401  (registers metrics)
+    text = obs.render()
+    assert "mmlspark_compile_cache_hits_total 0" in text.splitlines()
+    assert ("mmlspark_compile_cache_steady_state_recompiles_total 0"
+            in text.splitlines())
+
+
+def test_snapshot_is_json_serializable():
+    obs.counter("t_snap_total", "x").inc(3)
+    obs.histogram("t_snap_seconds", "x").observe(0.2)
+    snap = json.loads(json.dumps(obs.snapshot()))
+    assert snap["t_snap_total"]["series"][0]["value"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition golden test
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("app_requests_total", "Requests served", ("code",))
+    c.inc(3, code="200")
+    c.inc(code="500")
+    g = reg.gauge("app_queue_depth", "Queue depth")
+    g.set(2.5)
+    h = reg.histogram("app_latency_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    expected = (
+        "# HELP app_latency_seconds Latency\n"
+        "# TYPE app_latency_seconds histogram\n"
+        'app_latency_seconds_bucket{le="0.1"} 1\n'
+        'app_latency_seconds_bucket{le="1"} 2\n'
+        'app_latency_seconds_bucket{le="+Inf"} 3\n'
+        "app_latency_seconds_sum 5.55\n"
+        "app_latency_seconds_count 3\n"
+        "# HELP app_queue_depth Queue depth\n"
+        "# TYPE app_queue_depth gauge\n"
+        "app_queue_depth 2.5\n"
+        "# HELP app_requests_total Requests served\n"
+        "# TYPE app_requests_total counter\n"
+        'app_requests_total{code="200"} 3\n'
+        'app_requests_total{code="500"} 1\n'
+    )
+    assert reg.render() == expected
+
+
+def test_exposition_escapes_label_values_and_help():
+    reg = MetricsRegistry()
+    reg.counter("esc_total", 'has "quotes"\nand newline', ("p",)).inc(
+        p='a"b\nc')
+    text = reg.render()
+    assert '# HELP esc_total has "quotes"\\nand newline' in text
+    assert 'esc_total{p="a\\"b\\nc"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# structured event log
+
+
+def test_event_log_emits_json_and_counts(caplog):
+    with caplog.at_level(logging.DEBUG, logger=obs.LOGGER_NAME):
+        obs.log_event("unit_test", level=logging.INFO, k=1, who="x")
+    (rec,) = [r for r in caplog.records if r.name == obs.LOGGER_NAME]
+    payload = json.loads(rec.getMessage())
+    assert payload["event"] == "unit_test"
+    assert payload["k"] == 1 and payload["who"] == "x"
+    assert "ts" in payload
+    snap = obs.snapshot()
+    assert _series_value(snap, "mmlspark_events_total",
+                         level="info")["value"] == 1
+
+
+def test_event_counter_increments_even_when_level_suppressed(caplog):
+    logger = logging.getLogger(obs.LOGGER_NAME)
+    old = logger.level
+    logger.setLevel(logging.WARNING)
+    try:
+        with caplog.at_level(logging.WARNING, logger=obs.LOGGER_NAME):
+            obs.log_event("quiet", level=logging.DEBUG)
+        assert not [r for r in caplog.records if r.name == obs.LOGGER_NAME]
+    finally:
+        logger.setLevel(old)
+    assert _series_value(obs.snapshot(), "mmlspark_events_total",
+                         level="debug")["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: /metrics + /healthz on a live WorkerServer
+
+
+def _http_get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+_SAMPLE_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'            # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'    # optional {l="v",...}
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$')
+
+
+def _prime_runner_metrics():
+    """Push a partition through the real BatchRunner so stage + cache
+    counters carry traffic: run twice with the same shapes — the first
+    pass compiles (miss + steady-state recompile), the second hits."""
+    import jax
+
+    from mmlspark_tpu.models.runner import BatchRunner
+
+    @jax.jit
+    def jitted(params, feeds):
+        return {"y": feeds["x"] * params["w"]}
+
+    data = np.arange(16, dtype=np.float32)
+    runner = BatchRunner(jitted, {"w": 2.0},
+                         coerce=lambda sl: {"x": data[sl]},
+                         put=jax.device_put, mini_batch_size=16)
+    for _ in range(2):
+        (out, b), = runner.run_and_drain(16)
+        assert b == 16 and np.allclose(out["y"], data * 2.0)
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_worker_server_metrics_and_healthz(transport):
+    import requests
+
+    from mmlspark_tpu.serving import WorkerServer
+
+    _prime_runner_metrics()
+    server = WorkerServer(transport=transport)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        # /healthz: 200, JSON body, identifies the transport
+        status, headers, body = _http_get(base + "/healthz")
+        assert status == 200
+        assert headers["Content-Type"].startswith("application/json")
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["transport"] == transport
+        assert health["port"] == server.port
+
+        # push one real request through the queue so the latency
+        # histogram sees a POST as well as the control-route GETs
+        def _reply():
+            while True:
+                got = server.get_batch(10, timeout=0.2)
+                if got:
+                    server.reply_json(got[0].request_id, {"ok": True})
+                    return
+
+        t = threading.Thread(target=_reply, daemon=True)
+        t.start()
+        r = requests.post(base + "/", json={"x": 1.0}, timeout=10)
+        t.join(timeout=10)
+        assert r.status_code == 200 and r.json() == {"ok": True}
+
+        status, headers, text = _http_get(base + "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+
+        # acceptance families, with real traffic behind each
+        assert re.search(
+            r'mmlspark_serving_request_seconds_bucket\{transport="%s",'
+            r'le="\+Inf"\} [1-9]' % transport, text)
+        assert re.search(
+            r'mmlspark_serving_requests_total\{transport="%s",'
+            r'method="POST",code="200"\} 1' % transport, text)
+        assert (f'mmlspark_serving_queue_depth{{port="{server.port}"}} 0'
+                in text.splitlines())
+        assert re.search(
+            r'mmlspark_runner_stage_seconds_total\{stage="coerce"\} '
+            r'[0-9.e+-]+', text)
+        assert re.search(
+            r"mmlspark_compile_cache_hits_total [1-9]", text)
+        assert re.search(
+            r"mmlspark_compile_cache_misses_total [1-9]", text)
+        assert re.search(
+            r"mmlspark_compile_cache_steady_state_recompiles_total [1-9]",
+            text)
+
+        # every non-comment line must be a well-formed sample
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert _SAMPLE_LINE.match(line), line
+    finally:
+        server.close()
+
+    # closing the server retires its per-port callback gauges
+    assert not any(
+        s["labels"].get("port") == str(server.port)
+        for s in obs.snapshot()["mmlspark_serving_queue_depth"]["series"])
+
+
+def test_threaded_access_log_routes_through_event_log(caplog):
+    from mmlspark_tpu.serving import WorkerServer
+
+    server = WorkerServer(transport="threaded")
+    try:
+        with caplog.at_level(logging.DEBUG, logger=obs.LOGGER_NAME):
+            _http_get(f"http://127.0.0.1:{server.port}/healthz")
+        events = [json.loads(r.getMessage()) for r in caplog.records
+                  if r.name == obs.LOGGER_NAME]
+        access = [e for e in events if e["event"] == "http_access"]
+        assert access and "GET /healthz" in access[0]["line"]
+        assert access[0]["client"] == "127.0.0.1"
+    finally:
+        server.close()
+
+
+def test_serving_engine_batch_metrics():
+    import requests
+
+    from mmlspark_tpu.serving import ServingEngine
+
+    def pipeline(df):
+        return df.with_column("reply", np.asarray(df["x"]) * 2.0)
+
+    with ServingEngine(pipeline, schema={"x": float}) as eng:
+        r = requests.post(eng.address, json={"x": 21.0}, timeout=10)
+        assert r.status_code == 200
+    snap = obs.snapshot()
+    rows = _series_value(snap, "mmlspark_serving_batch_rows")
+    assert rows["count"] >= 1 and rows["sum"] >= 1
+    secs = _series_value(snap, "mmlspark_serving_batch_seconds")
+    assert secs["count"] >= 1

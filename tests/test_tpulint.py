@@ -394,6 +394,79 @@ def test_tpu006_stub_subset_is_quiet(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# TPU007 adhoc-telemetry
+
+_TIMER_CLASS = """\
+    import time
+
+    class Prof:
+        def __init__(self):
+            self.totals = {}
+
+        def mark(self, name):
+            now = time.perf_counter()
+            self.totals[name] = self.totals.get(name, 0.0) + (now - self._t0)
+            self._t0 = now
+    """
+
+
+def test_tpu007_adhoc_timer_class_fires_inside_package():
+    findings, _ = run_fixture(_TIMER_CLASS, relpath="mmlspark_tpu/x/mod.py")
+    assert "TPU007" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU007"]
+    assert f.severity == "warning" and "Prof" in f.message
+
+
+def test_tpu007_quiet_outside_package_and_in_observability():
+    findings, _ = run_fixture(_TIMER_CLASS, relpath="scripts/mod.py")
+    assert "TPU007" not in codes(findings)
+    findings, _ = run_fixture(
+        _TIMER_CLASS, relpath="mmlspark_tpu/observability/registry.py")
+    assert "TPU007" not in codes(findings)
+
+
+def test_tpu007_quiet_when_module_mirrors_into_registry():
+    findings, _ = run_fixture("""\
+        import time
+        from ..observability import histogram as _metric_histogram
+
+        class Prof:
+            def mark(self, name):
+                now = time.perf_counter()
+                self.totals[name] = self.totals.get(name, 0.0) + (now - self._t0)
+        """, relpath="mmlspark_tpu/x/mod.py")
+    assert "TPU007" not in codes(findings)
+
+
+def test_tpu007_quiet_on_plain_timestamp_store():
+    # a heartbeat/last-seen store reads the clock but accumulates nothing —
+    # the rule requires delta arithmetic on a clock value
+    findings, _ = run_fixture("""\
+        import time
+
+        class Registry:
+            def register(self, worker_id, address):
+                now = time.monotonic()
+                self._workers[worker_id] = {"address": address,
+                                            "last_seen": now}
+        """, relpath="mmlspark_tpu/x/mod.py")
+    assert "TPU007" not in codes(findings)
+
+
+def test_tpu007_suppressible():
+    findings, suppressed = run_fixture("""\
+        import time
+
+        class Watch:
+            def stop(self):
+                # tpulint: disable=TPU007 — reference-parity wall timer
+                self.elapsed_ns += time.perf_counter_ns() - self._start
+        """, relpath="mmlspark_tpu/x/mod.py", keep_suppressed=True)
+    assert "TPU007" not in codes(findings)
+    assert "TPU007" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

@@ -1,4 +1,5 @@
-"""Per-module rules TPU001-TPU004: the jit-boundary hazards.
+"""Per-module rules: the jit-boundary hazards (TPU001-TPU004) and the
+ad-hoc-telemetry check (TPU007).
 
 Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
 walks a module — the innermost *jit context* (entered through a
@@ -430,3 +431,106 @@ class _TPU004(_ContextVisitor):
                             f"jax_enable_x64 it widens the program — pin "
                             f"it with a dtype-matched constant",
                             severity="info"))
+
+
+# ---------------------------------------------------------------------------
+# TPU007 — ad-hoc telemetry
+# ---------------------------------------------------------------------------
+
+#: wall-clock sources whose accumulated deltas belong in the registry
+CLOCK_CALLS = {"time.perf_counter", "time.perf_counter_ns",
+               "time.monotonic", "time.monotonic_ns",
+               "time.time", "time.time_ns"}
+
+
+def _clock_accumulation(module: ModuleInfo, fn: ast.AST) -> Optional[ast.AST]:
+    """The statement where ``fn`` accumulates a wall-clock delta into
+    object state, or None. Two shapes, both requiring the clock read and
+    the store in the SAME method (calling out to a shared aggregator like
+    ``StageCounters.add`` is not accumulation):
+
+    - ``self.x += time.perf_counter() - t0`` / ``d[k] += now - last`` —
+      an AugAssign onto an attribute/subscript whose RHS involves a clock
+      value;
+    - ``self.t[name] = self.t.get(name, 0) + (now - last)`` — an Assign
+      onto a subscript whose RHS involves a clock value.
+
+    "Involves a clock value" means the RHS does *arithmetic* (a BinOp) on
+    a clock call or a local name assigned from one in this method — delta
+    math like ``now - last``. Storing a bare timestamp
+    (``{"last_seen": now}``, heartbeat registries) or unrelated state next
+    to a clock read (``self._slot[i] = None``) stays quiet: those are
+    state, not a metrics island.
+    """
+    clock_names: Set[str] = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call) \
+                and module.dotted(stmt.value.func) in CLOCK_CALLS:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    clock_names.add(t.id)
+
+    def is_clock(sub: ast.AST) -> bool:
+        return ((isinstance(sub, ast.Call)
+                 and module.dotted(sub.func) in CLOCK_CALLS)
+                or (isinstance(sub, ast.Name) and sub.id in clock_names))
+
+    def clock_arithmetic(expr: ast.AST) -> bool:
+        return any(isinstance(sub, ast.BinOp)
+                   and any(is_clock(s) for s in ast.walk(sub))
+                   for sub in ast.walk(expr))
+
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, (ast.Attribute, ast.Subscript)) \
+                and clock_arithmetic(stmt.value):
+            return stmt
+        if isinstance(stmt, ast.Assign) \
+                and any(isinstance(t, ast.Subscript) for t in stmt.targets) \
+                and clock_arithmetic(stmt.value):
+            return stmt
+    return None
+
+
+@register_rule
+class AdhocTelemetry(Rule):
+    code = "TPU007"
+    name = "adhoc-telemetry"
+    severity = "warning"
+    doc = ("A class under mmlspark_tpu/ accumulating wall-clock deltas "
+           "into its own state without touching mmlspark_tpu.observability "
+           "— a private metrics island invisible to GET /metrics and "
+           "bench telemetry (the pre-registry fragmentation this package "
+           "exists to end). Mirror the measurement into a registry metric; "
+           "importing the observability package marks the module as "
+           "integrated and quiets the rule.")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mmlspark_tpu/") \
+                or rel.startswith("mmlspark_tpu/observability/"):
+            return iter(())
+        # a module that imports the observability package has a path for
+        # its measurements to reach the registry — integrated, not ad hoc
+        for target in module.aliases.values():
+            if "observability" in target.split("."):
+                return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                hit = _clock_accumulation(module, fn)
+                if hit is not None:
+                    findings.append(self.finding(
+                        module, hit,
+                        f"'{node.name}.{fn.name}' accumulates wall-clock "
+                        f"deltas outside the metrics registry; mirror them "
+                        f"into mmlspark_tpu.observability (Counter or "
+                        f"Histogram) so /metrics and bench telemetry see "
+                        f"them"))
+                    break   # one finding per class is signal enough
+        return iter(findings)
