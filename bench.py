@@ -377,6 +377,7 @@ def main():
     pass_ips = []
     h2d_samples = []
     midrun_error = None
+    from mmlspark_tpu.observability import tracing as _tracing
     from mmlspark_tpu.ops.compile_cache import jit_cache_size
     cache_before_passes = jit_cache_size(m._jitted)
     for i in range(max(1, passes)):
@@ -395,9 +396,16 @@ def main():
             except Exception:                       # noqa: BLE001
                 pass
         try:
+            # each timed pass runs under a root trace: the flight recorder
+            # keeps the per-stage span tree (coerce/pad on the prefetch
+            # worker, h2d, dispatch, d2h) of every measured pass, so a
+            # slow pass is diagnosable from the emitted record alone
+            root = _tracing.start_trace("bench.pass", index=i)
             t0 = time.perf_counter()
-            out = m.transform(df)
+            with _tracing.activate(root):
+                out = m.transform(df)
             elapsed = time.perf_counter() - t0
+            root.end(rows=n_rows)
             assert len(out) == n_rows
             pass_ips.append(n_rows / elapsed)
             ips = max(ips, pass_ips[-1])
@@ -418,6 +426,12 @@ def main():
         cache_after_passes - cache_before_passes
         if cache_after_passes is not None and cache_before_passes is not None
         else None)
+    try:
+        record["pass_traces"] = [
+            t.summary() for t in _tracing.get_flight_recorder().traces()
+            if t.root is not None and t.root.name == "bench.pass"]
+    except Exception:                   # noqa: BLE001
+        pass
 
     h2d_gbps = None
     link_bound_ips = None

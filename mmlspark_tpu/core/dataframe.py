@@ -24,6 +24,8 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 
 import numpy as np
 
+from ..observability.tracing import propagate as _propagate
+
 __all__ = ["DataFrame", "concat", "object_col"]
 
 
@@ -337,7 +339,11 @@ class DataFrame:
                 finally:
                     _IN_POOL.active = False
             ex = _shared_pool(max_workers)
-            results = list(ex.map(wrapped, parts, range(len(parts))))
+            # pool workers are long-lived and start with an empty context:
+            # re-install the caller's (active trace span, SpanTracer) around
+            # each partition call so spans recorded there stay attributable
+            results = list(ex.map(_propagate(wrapped), parts,
+                                  range(len(parts))))
         out = concat(results, npartitions=self._npartitions)
         # per-partition result sizes become the output boundaries, so uneven
         # splits (parquet row groups) survive a map_partitions round

@@ -32,11 +32,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..observability import tracing as _tracing
 from ..ops.compile_cache import (M_CACHE_HITS, M_CACHE_MISSES,
                                  M_STEADY_RECOMPILES, StageCounters,
                                  jit_cache_size)
 from ..ops.padding import bucket_size, pad_axis
 from ..stages.batching import PrefetchIterator, batch_slices
+from ..utils.profiling import span as _span
 
 __all__ = ["BatchRunner"]
 
@@ -66,26 +68,32 @@ class BatchRunner:
     # -- host side: coerce + pad (runs on the prefetch worker) ---------------
     def _prepare(self, sl: slice) -> Tuple[Dict[str, np.ndarray], int]:
         c = self.counters
-        with c.timer("coerce"):
+        with c.timer("coerce"), _span("runner.coerce"):
             feeds = self.coerce(sl)
         b = 0
-        with c.timer("pad"):
+        with c.timer("pad"), _span("runner.pad"):
             padded_feeds = {}
+            padded = 0
             for name, arr in feeds.items():
                 b = len(arr)
                 padded = bucket_size(b)
                 padded = -(-padded // self.shards) * self.shards
                 padded_feeds[name] = pad_axis(arr, padded)
+            _tracing.add_event("pad_bucket", rows=b, padded=padded)
         return padded_feeds, b
 
     def _prepared_batches(self, n_rows: int):
         slices = batch_slices(n_rows, self.mini_batch_size)
-        gen = (self._prepare(sl) for sl in slices)
         if self.prefetch_depth > 0 and len(slices) > 1:
             # batch k+1's coerce/pad overlaps batch k's h2d + dispatch; the
-            # depth bound caps host memory at that many prepared batches
-            return PrefetchIterator(gen, depth=self.prefetch_depth)
-        return gen
+            # depth bound caps host memory at that many prepared batches.
+            # The worker thread starts with an empty context — propagate()
+            # carries the active trace + installed SpanTracer across, so
+            # coerce/pad spans land in the request's trace
+            prepare = _tracing.propagate(self._prepare)
+            return PrefetchIterator((prepare(sl) for sl in slices),
+                                    depth=self.prefetch_depth)
+        return (self._prepare(sl) for sl in slices)
 
     # -- device side: feed, dispatch, overlapped drain -----------------------
     def run(self, n_rows: int) -> List[Tuple[dict, int]]:
@@ -97,30 +105,36 @@ class BatchRunner:
         """
         c = self.counters
         pending: List[Tuple[dict, int]] = []
-        for feeds_host, b in self._prepared_batches(n_rows):
-            nbytes = sum(a.nbytes for a in feeds_host.values())
-            with c.timer("h2d", nbytes):
-                feeds = {k: self.put(v) for k, v in feeds_host.items()}
-            before = jit_cache_size(self.jitted)
-            t0 = time.perf_counter()
-            outs = self.jitted(self.params, feeds)
-            elapsed = time.perf_counter() - t0
-            after = jit_cache_size(self.jitted)
-            if before is not None and after is not None and after > before:
-                # the dispatch call blocked on trace+compile — a bucket the
-                # warm-up vocabulary missed; attribute the stall honestly
-                c.add("compile", elapsed, count=after - before)
-                M_CACHE_MISSES.inc(after - before)
-                M_STEADY_RECOMPILES.inc(after - before)
-            else:
-                c.add("dispatch", elapsed)
-                M_CACHE_HITS.inc()
-            for v in outs.values():
-                try:
-                    v.copy_to_host_async()
-                except Exception:
-                    break  # backend without async copy; drain still works
-            pending.append((outs, b))
+        with _span("runner.run", rows=n_rows):
+            for feeds_host, b in self._prepared_batches(n_rows):
+                nbytes = sum(a.nbytes for a in feeds_host.values())
+                with c.timer("h2d", nbytes):
+                    feeds = {k: self.put(v) for k, v in feeds_host.items()}
+                before = jit_cache_size(self.jitted)
+                t0 = time.perf_counter()
+                outs = self.jitted(self.params, feeds)
+                elapsed = time.perf_counter() - t0
+                after = jit_cache_size(self.jitted)
+                if before is not None and after is not None \
+                        and after > before:
+                    # the dispatch call blocked on trace+compile — a bucket
+                    # the warm-up vocabulary missed; attribute the stall
+                    # honestly
+                    c.add("compile", elapsed, count=after - before)
+                    M_CACHE_MISSES.inc(after - before)
+                    M_STEADY_RECOMPILES.inc(after - before)
+                    _tracing.add_event("cache_miss", compiles=after - before,
+                                       seconds=elapsed)
+                else:
+                    c.add("dispatch", elapsed)
+                    M_CACHE_HITS.inc()
+                    _tracing.add_event("cache_hit")
+                for v in outs.values():
+                    try:
+                        v.copy_to_host_async()
+                    except Exception:
+                        break  # backend without async copy; drain still works
+                pending.append((outs, b))
         return pending
 
     def drain(self, pending: List[Tuple[dict, int]]
@@ -129,7 +143,8 @@ class BatchRunner:
         if not pending:
             return []
         t0 = time.perf_counter()
-        host = jax.device_get([outs for outs, _ in pending])
+        with _span("runner.d2h", batches=len(pending)):
+            host = jax.device_get([outs for outs, _ in pending])
         elapsed = time.perf_counter() - t0
         nbytes = sum(a.nbytes for outs in host for a in outs.values())
         self.counters.add("d2h", elapsed, nbytes)

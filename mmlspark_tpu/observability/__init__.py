@@ -1,15 +1,25 @@
-"""Unified telemetry: metrics registry, Prometheus exposition, event log.
+"""Unified telemetry: metrics registry, Prometheus exposition, event log,
+and request-scoped tracing.
 
 Pure stdlib (importable before jax), process-global, default-on. See
-docs/observability.md for the metric catalog and label conventions; the
-serving plane scrapes the global registry at ``GET /metrics``.
+docs/observability.md for the metric catalog, label conventions, and the
+tracing/flight-recorder guide; the serving plane scrapes the global
+registry at ``GET /metrics`` and serves recorded traces at
+``GET /debug/traces``.
 """
 
 from .events import EventLog, LOGGER_NAME, get_event_log, log_event
 from .exposition import CONTENT_TYPE, render_prometheus
 from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
-                       MetricsRegistry, counter, gauge, get_registry,
-                       histogram, render, reset_all, snapshot)
+                       MetricsRegistry, build_info, counter, gauge,
+                       get_registry, histogram, process_uptime_seconds,
+                       render, reset_all, snapshot)
+from .tracing import (FlightRecorder, Span, Trace, activate, add_event,
+                      configure_recorder, current_request_id, current_span,
+                      current_trace_id, exemplars_enabled, format_traceparent,
+                      get_flight_recorder, new_request_id, new_span_id,
+                      new_trace_id, parse_traceparent, propagate,
+                      set_exemplars, start_span, start_trace)
 
 __all__ = [
     "Counter",
@@ -24,10 +34,32 @@ __all__ = [
     "snapshot",
     "render",
     "reset_all",
+    "build_info",
+    "process_uptime_seconds",
     "CONTENT_TYPE",
     "render_prometheus",
     "EventLog",
     "LOGGER_NAME",
     "get_event_log",
     "log_event",
+    "Span",
+    "Trace",
+    "FlightRecorder",
+    "start_trace",
+    "start_span",
+    "activate",
+    "add_event",
+    "propagate",
+    "current_span",
+    "current_trace_id",
+    "current_request_id",
+    "new_trace_id",
+    "new_span_id",
+    "new_request_id",
+    "parse_traceparent",
+    "format_traceparent",
+    "set_exemplars",
+    "exemplars_enabled",
+    "get_flight_recorder",
+    "configure_recorder",
 ]

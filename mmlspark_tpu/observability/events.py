@@ -20,6 +20,8 @@ import time
 from typing import Optional
 
 from .registry import counter as _counter
+from .tracing import (current_request_id as _current_request_id,
+                      current_trace_id as _current_trace_id)
 
 LOGGER_NAME = "mmlspark_tpu.events"
 
@@ -41,6 +43,10 @@ class EventLog:
              **fields: object) -> None:
         """Log ``{"event": ..., "ts": ..., **fields}`` at `level`.
 
+        When a trace context is active, ``trace_id``/``request_id`` are
+        stamped onto the record (explicit fields win), so event lines join
+        against /debug/traces span trees and journal entries.
+
         Never raises — telemetry must not take down the component
         emitting it (e.g. an HTTP handler mid-response).
         """
@@ -49,6 +55,12 @@ class EventLog:
             if not self._logger.isEnabledFor(level):
                 return
             record = {"event": event, "ts": time.time()}
+            trace_id = _current_trace_id()
+            if trace_id is not None:
+                record["trace_id"] = trace_id
+                request_id = _current_request_id()
+                if request_id is not None:
+                    record["request_id"] = request_id
             record.update(fields)
             self._logger.log(level, "%s",
                              json.dumps(record, sort_keys=True, default=str))

@@ -22,6 +22,7 @@ constraints, in order:
 from __future__ import annotations
 
 import bisect
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -39,7 +40,36 @@ __all__ = [
     "snapshot",
     "render",
     "reset_all",
+    "set_exemplar_provider",
+    "exemplar_provider",
+    "build_info",
+    "process_uptime_seconds",
 ]
+
+#: Wall-clock at first observability import — the process-uptime epoch
+#: reported by /healthz (observability is imported at package import, so
+#: this tracks process age for any consumer of the package).
+_PROCESS_START = time.time()
+
+#: When set (tracing.set_exemplars), histogram observations call this to
+#: capture the active trace_id as an OpenMetrics exemplar. None (the
+#: default) keeps observe() exemplar-free and the exposition byte-identical
+#: to plain Prometheus 0.0.4 text.
+_EXEMPLAR_PROVIDER: Optional[Callable[[], Optional[str]]] = None
+
+
+def set_exemplar_provider(
+        fn: Optional[Callable[[], Optional[str]]]) -> None:
+    global _EXEMPLAR_PROVIDER
+    _EXEMPLAR_PROVIDER = fn
+
+
+def exemplar_provider() -> Optional[Callable[[], Optional[str]]]:
+    return _EXEMPLAR_PROVIDER
+
+
+def process_uptime_seconds() -> float:
+    return time.time() - _PROCESS_START
 
 #: Default histogram boundaries, tuned for batch-inference latencies: the
 #: sub-millisecond region resolves per-stage host work (coerce/pad), the
@@ -113,7 +143,8 @@ class _GaugeSeries:
 
 
 class _HistogramSeries:
-    __slots__ = ("_lock", "_uppers", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_uppers", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, uppers: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
@@ -121,18 +152,32 @@ class _HistogramSeries:
         self._counts = [0] * (len(uppers) + 1)  # last slot is +Inf
         self._sum = 0.0
         self._count = 0
+        #: bucket index → (trace_id, observed value); lazily allocated so
+        #: the exemplar-free hot path stays two attribute reads
+        self._exemplars: Optional[Dict[int, Tuple[str, float]]] = None
 
     def observe(self, value: float) -> None:
         # le is inclusive: a value equal to a boundary lands in that bucket
         i = bisect.bisect_left(self._uppers, value)
+        provider = _EXEMPLAR_PROVIDER
+        trace_id = provider() if provider is not None else None
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if trace_id is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (trace_id, value)
 
     def get(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> Dict[int, Tuple[str, float]]:
+        """Last-observed exemplar per bucket index (+Inf = len(uppers))."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
 
 class _Metric:
@@ -372,3 +417,36 @@ def render() -> str:
 
 def reset_all() -> None:
     _REGISTRY.reset()
+
+
+def build_info() -> Gauge:
+    """Register/refresh the ``mmlspark_build_info`` identity gauge.
+
+    The standard *_build_info idiom: value 1, identity in the labels
+    (package version, jax version, jax backend) — scrapes can tell which
+    build and runtime they hit. jax is reported only if something else
+    already imported it (``sys.modules`` probe), and the backend only if
+    the runtime already initialized one: this function must never trigger
+    jax import or — worse — backend/TPU initialization (a WorkerServer
+    built in a jax-free process would otherwise stall ~30 s on the TPU
+    metadata probe).
+    """
+    version = jax_version = backend = "unknown"
+    try:
+        from .. import __version__ as version
+    except Exception:
+        pass
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        jax_version = getattr(jax_mod, "__version__", "unknown")
+        try:
+            from jax._src import xla_bridge as _xb
+            if _xb.backends_are_initialized():
+                backend = jax_mod.default_backend()
+        except Exception:
+            pass
+    g = gauge("mmlspark_build_info",
+              "Build/runtime identity (value is always 1; the labels carry "
+              "the information)", ("version", "jax", "backend"))
+    g.set(1, version=version, jax=jax_version, backend=backend)
+    return g

@@ -32,6 +32,7 @@ from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from ..observability import counter as _metric_counter
+from ..observability import tracing as _tracing
 from .padding import bucket_size
 
 __all__ = ["enable_persistent_cache", "persistent_cache_dir", "StageCounters",
@@ -265,13 +266,18 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
                       * max(1, shards) for b in batch_sizes if int(b) > 0})
     before = jit_cache_size(jitted)
     t_start = time.perf_counter()
-    for size in buckets:
-        feeds = {name: put(np.zeros((size,) + shape, dtype=dt))
-                 for name, (dt, shape) in specs.items()}
-        outs = jitted(params, feeds)
-        # tpulint: disable=TPU001 — warm-up MUST fence each bucket so the
-        # timed window covers the compile, not later steady-state batches
-        jax.block_until_ready(outs)
+    with _tracing.start_span("compile_cache.warm_up", buckets=len(buckets)):
+        for size in buckets:
+            t_b = time.perf_counter()
+            feeds = {name: put(np.zeros((size,) + shape, dtype=dt))
+                     for name, (dt, shape) in specs.items()}
+            outs = jitted(params, feeds)
+            # tpulint: disable=TPU001 — warm-up MUST fence each bucket so
+            # the timed window covers the compile, not later steady-state
+            # batches
+            jax.block_until_ready(outs)
+            _tracing.add_event("warm_bucket", padded=size,
+                               seconds=round(time.perf_counter() - t_b, 4))
     elapsed = time.perf_counter() - t_start
     after = jit_cache_size(jitted)
     compiles = (after - before) if (after is not None and before is not None) \

@@ -44,6 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import (counter as _metric_counter,
                              gauge as _metric_gauge,
                              histogram as _metric_histogram)
+from ..observability import tracing as _tracing
+from ..utils.profiling import span as _prof_span
 from ..models.zoo.transformer import (TransformerConfig,
                                       _warp_scaled_rows,
                                       decode_step_ragged,
@@ -762,21 +764,23 @@ class ContinuousDecoder:
         identical). Returns (logits, row_cache); rows past ``len(reqs)``
         are pad garbage."""
         padded = self._bucket(max(r.prompt.size for r in reqs))
-        k = 1 << (len(reqs) - 1).bit_length()
-        ids = np.zeros((k, padded), np.int32)
-        lengths = np.ones(k, np.int32)
-        for i, r in enumerate(reqs):
-            ids[i, :r.prompt.size] = r.prompt
-            lengths[i] = r.prompt.size
-        ids_d, lengths_d = jnp.asarray(ids), jnp.asarray(lengths)
-        logits, row_cache = self._prefill(self._params, ids_d, lengths_d)
-        if self._spec:
-            # draft rows ride the same generic row-cache list; insertion
-            # zips them against self._cache + self._d_cache
-            _, d_rows = self._d_prefill(self._d_params, ids_d, lengths_d)
-            row_cache = list(row_cache) + list(d_rows)
-        self.stats["prefills"] += 1
-        _M_PREFILLS.inc()
+        with _prof_span("continuous.prefill", requests=len(reqs),
+                        bucket=padded):
+            k = 1 << (len(reqs) - 1).bit_length()
+            ids = np.zeros((k, padded), np.int32)
+            lengths = np.ones(k, np.int32)
+            for i, r in enumerate(reqs):
+                ids[i, :r.prompt.size] = r.prompt
+                lengths[i] = r.prompt.size
+            ids_d, lengths_d = jnp.asarray(ids), jnp.asarray(lengths)
+            logits, row_cache = self._prefill(self._params, ids_d, lengths_d)
+            if self._spec:
+                # draft rows ride the same generic row-cache list; insertion
+                # zips them against self._cache + self._d_cache
+                _, d_rows = self._d_prefill(self._d_params, ids_d, lengths_d)
+                row_cache = list(row_cache) + list(d_rows)
+            self.stats["prefills"] += 1
+            _M_PREFILLS.inc()
         return logits, row_cache
 
     @staticmethod
@@ -1105,7 +1109,7 @@ class ContinuousDecoder:
         at scan step s iff its request is not yet done host-side when s is
         replayed in order — no device mask needed."""
         toks_dev, snapshot = self._pending.pop(0)
-        with _M_DRAIN_SECONDS.time():
+        with _M_DRAIN_SECONDS.time(), _prof_span("continuous.drain"):
             toks = np.asarray(toks_dev)
         if self._spec and toks.shape[0] > 1:
             # spec blocks mark unemitted lanes -1; count real emissions
@@ -1180,8 +1184,11 @@ class ContinuousDecoder:
                 self._stop.wait(idle_sleep)
 
     def start(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve_forever, daemon=True,
-                             name="continuous-decoder")
+        # the decoder thread starts with an empty context — propagate()
+        # carries whatever tracer/trace is active at start() into it, so
+        # prefill/drain spans stay attributable
+        t = threading.Thread(target=_tracing.propagate(self.serve_forever),
+                             daemon=True, name="continuous-decoder")
         t.start()
         return t
 

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..io.http.schema import (EntityData, HTTPRequestData, HTTPResponseData,
                               StatusLineData)
+from ..observability import log_event as _log_event
 from .server import CachedRequest, WorkerServer
 
 __all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
@@ -267,8 +268,13 @@ class DistributedWorker:
 
     def _handle_remote_reply(self, req: HTTPRequestData) -> HTTPResponseData:
         payload = json.loads(req.entity.content if req.entity else b"{}")
+        # server.reply is where the request's root span closes (exactly
+        # once, on THIS owning worker) and its counters tick — the hop
+        # itself only logs, so forwarded replies aren't double-billed
         ok = self.server.reply(payload["request_id"],
                                HTTPResponseData.from_dict(payload["response"]))
+        _log_event("remote_reply", worker_id=self.worker_id,
+                   request_id=payload.get("request_id"), ok=ok)
         return HTTPResponseData(
             entity=EntityData.from_string(json.dumps({"ok": ok})),
             status_line=StatusLineData(status_code=200 if ok else 404))

@@ -9,7 +9,9 @@ per worker plays that role, so a worker **process** restart (not just an
 engine restart) rehydrates every routed-but-unanswered request.
 
 Records (one JSON object per line):
-    {"t": "req",   "id": ..., "epoch": N, "request": {HTTPRequestData}}
+    {"t": "req",   "id": ..., "epoch": N, "request": {HTTPRequestData},
+     "trace": "32-hex trace id"}          # optional — joins journal lines
+                                          # against /debug/traces span trees
     {"t": "rep",   "id": ...}
     {"t": "epoch", "n": N}
 
@@ -33,7 +35,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from ..io.http.schema import HTTPRequestData
 
@@ -92,9 +94,13 @@ class ServingJournal:
             self._lines_since_compact += 1
 
     def record_request(self, request_id: str, epoch: int,
-                       request: HTTPRequestData) -> None:
-        self._append({"t": "req", "id": request_id, "epoch": epoch,
-                      "request": request.to_dict()})
+                       request: HTTPRequestData,
+                       trace_id: Optional[str] = None) -> None:
+        rec = {"t": "req", "id": request_id, "epoch": epoch,
+               "request": request.to_dict()}
+        if trace_id is not None:
+            rec["trace"] = trace_id
+        self._append(rec)
 
     def record_reply(self, request_id: str) -> None:
         self._append({"t": "rep", "id": request_id}, drop_if_closed=True)
@@ -147,23 +153,21 @@ class ServingJournal:
             self._fh.flush()
             # one lock span start-to-finish: an append racing between the
             # pending snapshot and the rename would be silently dropped
+            # keep the RAW record dicts (not re-parsed request objects) so
+            # optional fields ("trace", anything added later) survive the
+            # rewrite byte-for-byte
             pending = {}
             for rec in self._scan(self.path):
                 if rec.get("t") == "req":
-                    pending[rec["id"]] = (
-                        rec["epoch"],
-                        HTTPRequestData.from_dict(rec["request"]))
+                    pending[rec["id"]] = rec
                 elif rec.get("t") == "rep":
                     pending.pop(rec["id"], None)
             tmp = self.path + ".compact"
             with open(tmp, "w", encoding="utf-8") as out:
                 out.write(json.dumps({"t": "epoch", "n": epoch},
                                      separators=(",", ":")) + "\n")
-                for rid, (ep, req) in pending.items():
-                    out.write(json.dumps(
-                        {"t": "req", "id": rid, "epoch": ep,
-                         "request": req.to_dict()},
-                        separators=(",", ":")) + "\n")
+                for rec in pending.values():
+                    out.write(json.dumps(rec, separators=(",", ":")) + "\n")
                 out.flush()
                 os.fsync(out.fileno())
             self._fh.close()

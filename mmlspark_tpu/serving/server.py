@@ -20,19 +20,21 @@ import queue
 import socket
 import threading
 import time
-import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
                               HTTPResponseData, StatusLineData)
 from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
+                             build_info as _build_info,
                              counter as _metric_counter,
                              gauge as _metric_gauge,
                              histogram as _metric_histogram,
                              log_event as _log_event,
+                             process_uptime_seconds as _process_uptime,
                              render as _render_metrics)
+from ..observability import tracing as _tracing
 
 __all__ = ["CachedRequest", "WorkerServer"]
 
@@ -56,6 +58,17 @@ _M_INFLIGHT = _metric_gauge(
 
 
 _STREAM_TIMEOUT_EVENT = b'data: {"error": "stream reply timeout"}\n\n'
+
+
+def _trace_headers(cached: Optional["CachedRequest"]
+                   ) -> List[Tuple[str, str]]:
+    """Response correlation headers for a queued request: the request id
+    (the handle `reply` keys on) and the W3C traceparent of the root span,
+    so callers can fetch the span tree from /debug/traces."""
+    if cached is None or cached.trace_span is None:
+        return []
+    return [("X-Request-Id", cached.request_id),
+            ("traceparent", _tracing.format_traceparent(cached.trace_span))]
 
 
 class StreamingReply:
@@ -142,6 +155,9 @@ class CachedRequest:
     #: True when rehydrated from the journal after a process restart — the
     #: original connection is gone; the reply is journaled, not delivered
     replayed: bool = False
+    #: root span of this request's trace (observability/tracing.py); None
+    #: for replayed requests (the original caller's connection is gone)
+    trace_span: Optional[object] = field(default=None, repr=False)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _response: Optional[HTTPResponseData] = field(default=None, repr=False)
 
@@ -224,7 +240,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.close_connection = True
             ws._observe_request("threaded", self.command, 400,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0, path=self.path)
             return
         req = HTTPRequestData(
             url=self.path, method=self.command,
@@ -232,6 +248,7 @@ class _Handler(BaseHTTPRequestHandler):
             entity=EntityData(content=body, content_length=len(body)) if body else None)
         # control routes (internal cross-worker endpoints: reply forwarding,
         # request forwarding) answer synchronously, bypassing the queue
+        cached = None
         ctrl = ws._control_route(self.path)
         if ctrl is not None:
             try:
@@ -244,20 +261,30 @@ class _Handler(BaseHTTPRequestHandler):
             cached = ws._enqueue(req)
             resp = cached.wait(ws.reply_timeout)
         if resp is None:
+            if cached is not None and cached.trace_span is not None:
+                cached.trace_span.end(status=504)
             self.send_response(504, "serving reply timeout")
+            for name, value in _trace_headers(cached):
+                self.send_header(name, value)
             self.send_header("Content-Length", "0")
             self.end_headers()
             ws._observe_request("threaded", self.command, 504,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0, path=self.path,
+                                trace_span=cached.trace_span
+                                if cached is not None else None)
             return
+        tspan = cached.trace_span if cached is not None else None
         if isinstance(resp, StreamingReply):
             # incremental reply: preamble now, chunks until close(); the
             # connection ends with the stream (no content length exists)
             ws._observe_request("threaded", self.command, 200,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0, path=self.path,
+                                trace_span=tspan)
             self.send_response(200)
             self.send_header("Content-Type", resp.content_type)
             self.send_header("Cache-Control", "no-store")
+            for name, value in _trace_headers(cached):
+                self.send_header(name, value)
             self.send_header("Connection", "close")
             self.end_headers()
             self.close_connection = True
@@ -282,13 +309,17 @@ class _Handler(BaseHTTPRequestHandler):
         payload = resp.entity.content if resp.entity else b""
         ws._observe_request("threaded", self.command,
                             resp.status_line.status_code,
-                            time.perf_counter() - t0)
+                            time.perf_counter() - t0, path=self.path,
+                            trace_span=tspan)
         self.send_response(resp.status_line.status_code,
                            resp.status_line.reason_phrase or None)
         sent = {h.name.lower() for h in resp.headers}
         for h in resp.headers:
             if h.name.lower() not in ("content-length", "connection"):
                 self.send_header(h.name, h.value)
+        for name, value in _trace_headers(cached):
+            if name.lower() not in sent:
+                self.send_header(name, value)
         if "content-type" not in sent and payload:
             self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
@@ -394,7 +425,8 @@ class _AsyncHTTPServer:
         return req, hmap.get("connection", "").lower() == "close"
 
     @staticmethod
-    def _render(resp: HTTPResponseData) -> bytes:
+    def _render(resp: HTTPResponseData,
+                extra_headers: List[Tuple[str, str]] = ()) -> bytes:
         """Serialize status + headers + body into ONE buffer (a single send
         — immune to the Nagle/delayed-ACK stall by construction)."""
         payload = resp.entity.content if resp.entity else b""
@@ -407,6 +439,9 @@ class _AsyncHTTPServer:
             if h.name.lower() not in ("content-length", "connection"):
                 lines.append(f"{h.name}: {h.value}".encode("latin-1"))
                 sent.add(h.name.lower())
+        for name, value in extra_headers:
+            if name.lower() not in sent:
+                lines.append(f"{name}: {value}".encode("latin-1"))
         if "content-type" not in sent and payload:
             lines.append(b"Content-Type: application/json")
         lines.append(f"Content-Length: {len(payload)}".encode("latin-1"))
@@ -439,6 +474,7 @@ class _AsyncHTTPServer:
                     break
                 req, close = parsed
                 t0 = time.perf_counter()
+                cached = None
                 ctrl = ws._control_route(req.url)
                 if ctrl is not None:
                     # control routes may block on cross-worker HTTP — keep
@@ -475,18 +511,26 @@ class _AsyncHTTPServer:
                     try:
                         resp = await asyncio.wait_for(fut, ws.reply_timeout)
                     except asyncio.TimeoutError:
+                        if cached.trace_span is not None:
+                            cached.trace_span.end(status=504)
                         resp = HTTPResponseData(status_line=StatusLineData(
                             status_code=504,
                             reason_phrase="serving reply timeout"))
+                tspan = cached.trace_span if cached is not None else None
+                echo = _trace_headers(cached)
                 if isinstance(resp, StreamingReply):
                     ws._observe_request("async", req.method, 200,
-                                        time.perf_counter() - t0)
+                                        time.perf_counter() - t0,
+                                        path=req.url, trace_span=tspan)
+                    echo_raw = b"".join(
+                        f"{n}: {v}\r\n".encode("latin-1") for n, v in echo)
                     writer.write(
                         b"HTTP/1.1 200 OK\r\n"
                         b"Content-Type: "
                         + resp.content_type.encode("ascii")
                         + b"\r\nCache-Control: no-store\r\n"
-                        b"Connection: close\r\n\r\n")
+                        + echo_raw
+                        + b"Connection: close\r\n\r\n")
                     await writer.drain()
                     # chunks cross from dispatcher threads via a
                     # call_soon_threadsafe-set event; the IO thread never
@@ -516,8 +560,9 @@ class _AsyncHTTPServer:
                     break                      # stream ends the connection
                 ws._observe_request("async", req.method,
                                     resp.status_line.status_code,
-                                    time.perf_counter() - t0)
-                writer.write(self._render(resp))
+                                    time.perf_counter() - t0,
+                                    path=req.url, trace_span=tspan)
+                writer.write(self._render(resp, echo))
                 await writer.drain()
                 if close:
                     break
@@ -565,6 +610,7 @@ class WorkerServer:
         self.control_routes: Dict[str, object] = {
             "/healthz": self._healthz_route,
             "/metrics": self._metrics_route,
+            "/debug/traces": self._debug_traces_route,
         }
         #: request_id → CachedRequest (reference: routingTable ``:689``)
         self._routing: Dict[str, CachedRequest] = {}
@@ -620,6 +666,9 @@ class WorkerServer:
         # close() drops the series
         _M_QUEUE_DEPTH.set_function(self._queue.qsize, port=str(self.port))
         _M_INFLIGHT.set_function(self.pending_count, port=str(self.port))
+        # idempotent: (re)stamps mmlspark_build_info so any scraped server
+        # exposes version/jax/backend even after a registry reset in tests
+        _build_info()
 
     @property
     def address(self) -> str:
@@ -633,11 +682,21 @@ class WorkerServer:
 
     # -- telemetry ----------------------------------------------------------
     def _observe_request(self, transport: str, method: Optional[str],
-                         code: int, seconds: Optional[float]) -> None:
+                         code: int, seconds: Optional[float],
+                         path: Optional[str] = None,
+                         trace_span: Optional[object] = None) -> None:
+        # "/_"-prefixed paths are internal cross-worker hops (/_reply,
+        # /_forward) — counting them would double-bill one logical request
+        # across workers; only the OWNING worker's user-facing answer counts
+        if path is not None and path.startswith("/_"):
+            return
         _M_REQUESTS.inc(transport=transport, method=method or "?",
                         code=str(code))
         if seconds is not None:
-            _M_REQ_LATENCY.observe(seconds, transport=transport)
+            # under an active span the histogram captures the trace_id as
+            # an OpenMetrics exemplar (when tracing.set_exemplars is on)
+            with _tracing.activate(trace_span):
+                _M_REQ_LATENCY.observe(seconds, transport=transport)
 
     def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
         import json as _json
@@ -649,7 +708,8 @@ class WorkerServer:
                 "port": self.port,
                 "queued": self._queue.qsize(),
                 "pending": pending,
-                "epoch": epoch}
+                "epoch": epoch,
+                "uptime_seconds": round(_process_uptime(), 3)}
         return HTTPResponseData(
             headers=[HeaderData("Content-Type", "application/json")],
             entity=EntityData.from_string(_json.dumps(body)),
@@ -664,17 +724,65 @@ class WorkerServer:
                                           content_type=_PROM_CONTENT_TYPE),
             status_line=StatusLineData(status_code=200))
 
+    def _debug_traces_route(self, request: HTTPRequestData
+                            ) -> HTTPResponseData:
+        """Flight-recorder browser. ``GET /debug/traces`` lists summaries
+        (newest first, slow-kept traces ahead of the ring);
+        ``GET /debug/traces/{trace_id}`` returns one full span tree, or
+        Chrome-trace JSON with ``?format=chrome`` (loadable in
+        chrome://tracing / Perfetto, same shape SpanTracer.export writes).
+
+        Registered in ``control_routes`` ahead of any catch-all (the
+        distributed forwarder appends "/" LAST), so it stays reachable on
+        every worker."""
+        import json as _json
+
+        def _resp(payload: object, status: int = 200) -> HTTPResponseData:
+            return HTTPResponseData(
+                headers=[HeaderData("Content-Type", "application/json")],
+                entity=EntityData.from_string(_json.dumps(payload)),
+                status_line=StatusLineData(status_code=status))
+
+        recorder = _tracing.get_flight_recorder()
+        path, _, query = request.url.partition("?")
+        trace_id = path[len("/debug/traces"):].strip("/")
+        if not trace_id:
+            return _resp({"slow_threshold_seconds": recorder.slow_threshold,
+                          "traces": recorder.summaries()})
+        trace = recorder.get(trace_id)
+        if trace is None:
+            return _resp({"error": "unknown trace_id",
+                          "trace_id": trace_id}, status=404)
+        if "format=chrome" in query:
+            return _resp(trace.to_chrome())
+        return _resp(trace.to_dict())
+
     # -- ingest -------------------------------------------------------------
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
+        # ONE root span per logical request, minted at the single point
+        # every ingest shape funnels through — both transports AND the
+        # distributed forwarder (whose hop carries the original traceparent,
+        # so the forwarded leg continues the same trace)
+        request_id = _tracing.new_request_id()
+        traceparent = None
+        for h in request.headers:
+            if h.name.lower() == "traceparent":
+                traceparent = h.value
+                break
+        root = _tracing.start_trace(
+            "server.request", traceparent=traceparent,
+            request_id=request_id, method=request.method, url=request.url,
+            transport="async" if self._aio is not None else "threaded")
         with self._lock:
-            cached = CachedRequest(uuid.uuid4().hex, self._epoch, request)
+            cached = CachedRequest(request_id, self._epoch, request,
+                                   trace_span=root)
         # write-ahead, BEFORE the routing-table insert: a failed append
         # (disk full, journal closed mid-shutdown) must error this request
         # out cleanly instead of leaking a never-queued routing entry that
         # pins its epoch's history forever
         if self._journal is not None:
             self._journal.record_request(cached.request_id, cached.epoch,
-                                         request)
+                                         request, trace_id=root.trace_id)
         with self._lock:
             self._routing[cached.request_id] = cached
             self._history.setdefault(cached.epoch, {})[cached.request_id] = cached
@@ -709,12 +817,24 @@ class WorkerServer:
             self._journal.record_reply(request_id)
         return cached
 
+    def trace_span(self, request_id: str):
+        """Root span of a still-parked request (None when unknown/answered
+        or untraced) — the engine activates it to attach batch spans."""
+        with self._lock:
+            cached = self._routing.get(request_id)
+        return cached.trace_span if cached is not None else None
+
     def reply(self, request_id: str, response: HTTPResponseData) -> bool:
         """Route a response to the parked connection
         (parity: ``replyTo`` ``:536-554``)."""
         cached = self._take_answered(request_id)
         if cached is None:
             return False
+        if cached.trace_span is not None:
+            # idempotent close (False if the transport 504'd it already);
+            # ending the root hands the trace to the flight recorder
+            cached.trace_span.end(
+                status=response.status_line.status_code)
         cached.respond(response)
         return True
 
@@ -735,6 +855,10 @@ class WorkerServer:
         cached = self._take_answered(request_id)
         if cached is None:
             return None
+        if cached.trace_span is not None:
+            # the trace covers accept → stream OPEN (chunk timing belongs
+            # to the stream itself, which may outlive the span tree)
+            cached.trace_span.end(status=200, streaming=True)
         stream = StreamingReply(content_type)
         cached.respond(stream)
         return stream

@@ -13,6 +13,12 @@ The reference has no tracer — only ad-hoc ``StopWatch``/``Timer`` timings
   Perfetto JSON, so a whole pipeline run is inspectable without
   TensorBoard. :func:`span` writes to the installed tracer (no-op when
   none), so library code can annotate unconditionally.
+
+Installation is **contextvars-based** (observability/tracing.py): the
+active tracer rides the context, so worker threads entered through
+``tracing.propagate`` inherit it, and :func:`span` additionally records
+into the active request trace when one exists — the Chrome-trace,
+Prometheus, and /debug/traces views of the same run agree.
 """
 
 from __future__ import annotations
@@ -25,15 +31,13 @@ import time
 __all__ = ["trace", "annotate", "StopWatch", "SpanTracer", "span"]
 
 from ..observability import histogram as _metric_histogram
+from ..observability import tracing as _tracing
 from .shared import StopWatch  # re-export: the reference-style wall timer
 
 _M_SPANS = _metric_histogram(
     "mmlspark_span_seconds",
     "Closed SpanTracer spans, mirrored from the Chrome-trace view when the "
     "tracer is built with mirror_metrics=True", ("name",))
-
-_ACTIVE = threading.local()  # per-thread install: concurrent tracers in
-#                              different threads must not cross-record
 
 
 class SpanTracer:
@@ -84,12 +88,14 @@ class SpanTracer:
 
     # -- lifecycle ----------------------------------------------------------
     def __enter__(self) -> "SpanTracer":
-        self._prev = getattr(_ACTIVE, "tracer", None)
-        _ACTIVE.tracer = self
+        # contextvars install (was threading.local): child contexts — and
+        # workers entered via tracing.propagate — see this tracer; a
+        # concurrent tracer in an unrelated context still can't cross-record
+        self._token = _tracing.install_tracer(self)
         return self
 
     def __exit__(self, *exc) -> None:
-        _ACTIVE.tracer = self._prev
+        _tracing.uninstall_tracer(self._token)
 
     # -- inspection / export -------------------------------------------------
     @property
@@ -110,15 +116,25 @@ class SpanTracer:
 
 
 def span(name: str, **args):
-    """Span on the calling thread's active :class:`SpanTracer` (plus a
-    device-timeline annotation); cheap no-op when no tracer is installed.
-    Worker threads spawned inside a traced region record through the
-    tracer's own ``span`` method (pass it in), not this accessor."""
-    tracer = getattr(_ACTIVE, "tracer", None)
-    if tracer is None:
+    """Span on the context's active :class:`SpanTracer` AND the active
+    request trace (observability/tracing.py), plus a device-timeline
+    annotation; cheap no-op when neither is installed.
+
+    Worker threads spawned inside a traced region inherit both through
+    ``tracing.propagate`` — wrap the worker's callable at submission time
+    (models/runner.py does this for the prefetch worker, core/dataframe.py
+    for the partition pool) and spans opened there land in the parent
+    trace. The old ``threading.local`` dead-end (workers recording into
+    the void) is gone."""
+    tracer = _tracing.installed_tracer()
+    in_trace = _tracing.current_span() is not None
+    if tracer is None and not in_trace:
         return annotate(name)
     stack = contextlib.ExitStack()
-    stack.enter_context(tracer.span(name, **args))
+    if tracer is not None:
+        stack.enter_context(tracer.span(name, **args))
+    if in_trace:
+        stack.enter_context(_tracing.start_span(name, **args))
     stack.enter_context(annotate(name))
     return stack
 

@@ -166,3 +166,68 @@ def test_kill_and_replay():
         w.close()
     finally:
         reg.close()
+
+
+def test_remote_reply_closes_root_span_exactly_once():
+    """The forwarded reply path (engine on B, connection parked on A) must
+    end A's root span at the first reply and leave it untouched on a
+    late duplicate — a double-close would corrupt the recorded duration
+    and re-record the trace in the flight recorder."""
+    from mmlspark_tpu.observability import tracing as tr
+    cluster = ServingCluster(2, reply_timeout=15.0)
+    try:
+        wa, wb = cluster.workers
+        out = [None]
+        t = threading.Thread(target=_client,
+                             args=(wa.server.address, {"x": 1}, out, 0))
+        t.start()
+        batch = []
+        deadline = time.time() + 10
+        while not batch and time.time() < deadline:
+            batch = wa.get_batch(4, timeout=0.2)
+        assert batch
+        owner_id, cached = batch[0]
+        root = wa.server.trace_span(cached.request_id)
+        assert root is not None and not root.ended
+        assert wb.reply(owner_id, cached.request_id, _json_resp({"n": 1}))
+        t.join(timeout=15)
+        assert out[0][0] == 200
+        assert root.ended
+        dur = root.duration
+        # duplicate reply: dropped (routing entry gone), span untouched
+        assert not wb.reply(owner_id, cached.request_id, _json_resp({"n": 2}))
+        assert root.duration == dur
+        assert tr.get_flight_recorder().get(root.trace_id) is not None
+    finally:
+        cluster.close()
+
+
+def test_request_counted_on_owning_worker_only():
+    """One logical request crossing workers bills ONE increment of
+    mmlspark_serving_requests_total: the /_reply (and /_forward) internal
+    hops are skipped, so per-worker counters still sum to true traffic."""
+    from mmlspark_tpu import observability as obs
+    obs.reset_all()
+    cluster = ServingCluster(2, reply_timeout=15.0)
+    try:
+        wa, wb = cluster.workers
+        out = [None]
+        t = threading.Thread(target=_client,
+                             args=(wa.server.address, {"x": 1}, out, 0))
+        t.start()
+        batch = []
+        deadline = time.time() + 10
+        while not batch and time.time() < deadline:
+            batch = wa.get_batch(4, timeout=0.2)
+        assert batch
+        owner_id, cached = batch[0]
+        assert wb.reply(owner_id, cached.request_id, _json_resp({"ok": 1}))
+        t.join(timeout=15)
+        assert out[0][0] == 200
+        snap = obs.snapshot()
+        series = snap["mmlspark_serving_requests_total"]["series"]
+        total = sum(s["value"] for s in series)
+        assert total == 1, series          # the /_reply hop is not billed
+    finally:
+        cluster.close()
+        obs.reset_all()

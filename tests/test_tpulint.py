@@ -467,6 +467,75 @@ def test_tpu007_suppressible():
 
 
 # ---------------------------------------------------------------------------
+# TPU008 adhoc-id-minting
+
+
+def test_tpu008_request_id_uuid4_fires():
+    findings, _ = run_fixture("""\
+        import uuid
+
+        def enqueue(self, request):
+            cached = CachedRequest(uuid.uuid4().hex, self._epoch, request)
+            return cached
+        """, relpath="mmlspark_tpu/serving/server.py")
+    assert "TPU008" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU008"]
+    assert "new_request_id" in f.message
+
+
+def test_tpu008_catches_from_import_and_trace_names():
+    findings, _ = run_fixture("""\
+        from uuid import uuid4
+
+        def open_trace():
+            trace_id = uuid4().hex
+            return trace_id
+        """, relpath="mmlspark_tpu/x/mod.py")
+    assert "TPU008" in codes(findings)
+
+
+def test_tpu008_quiet_on_non_id_uuid4_uses():
+    # model artifact / run ids are not request-flow ids — the regexp gate
+    # (request|trace|span) keeps mlflow-style minting quiet
+    findings, _ = run_fixture("""\
+        import uuid
+
+        def log_model(model):
+            model_uuid = uuid.uuid4().hex
+            run_id = uuid.uuid4().hex[:12]
+            return model_uuid, run_id
+        """, relpath="mmlspark_tpu/x/mlflow.py")
+    assert "TPU008" not in codes(findings)
+
+
+def test_tpu008_quiet_in_tracing_module_and_outside_package():
+    src = """\
+        import uuid
+
+        def new_request_id():
+            return uuid.uuid4().hex
+        """
+    findings, _ = run_fixture(
+        src, relpath="mmlspark_tpu/observability/tracing.py")
+    assert "TPU008" not in codes(findings)
+    findings, _ = run_fixture(src, relpath="scripts/tool.py")
+    assert "TPU008" not in codes(findings)
+
+
+def test_tpu008_suppressible():
+    findings, suppressed = run_fixture("""\
+        import uuid
+
+        def mint():
+            # tpulint: disable=TPU008 — wire-compat with legacy clients
+            request_id = uuid.uuid4().hex
+            return request_id
+        """, relpath="mmlspark_tpu/x/mod.py", keep_suppressed=True)
+    assert "TPU008" not in codes(findings)
+    assert "TPU008" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

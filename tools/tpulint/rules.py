@@ -1,5 +1,5 @@
-"""Per-module rules: the jit-boundary hazards (TPU001-TPU004) and the
-ad-hoc-telemetry check (TPU007).
+"""Per-module rules: the jit-boundary hazards (TPU001-TPU004), the
+ad-hoc-telemetry check (TPU007), and the ad-hoc-id-minting check (TPU008).
 
 Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
 walks a module — the innermost *jit context* (entered through a
@@ -533,4 +533,58 @@ class AdhocTelemetry(Rule):
                         f"Histogram) so /metrics and bench telemetry see "
                         f"them"))
                     break   # one finding per class is signal enough
+        return iter(findings)
+
+
+#: the id-shaped context TPU008 polices: a uuid4 minted into anything
+#: named like a request/trace/span id
+_ID_CONTEXT_RE = re.compile(r"request|trace|span", re.IGNORECASE)
+
+
+@register_rule
+class AdhocIdMinting(Rule):
+    code = "TPU008"
+    name = "adhoc-id-minting"
+    severity = "warning"
+    doc = ("A request/trace/span id minted with ``uuid.uuid4()`` outside "
+           "mmlspark_tpu/observability/tracing.py. Ids minted ad hoc "
+           "don't join the trace-context machinery: the routing table, "
+           "journal, event log, and /debug/traces each end up keyed by "
+           "ids nothing else can correlate. Mint through "
+           "``tracing.new_request_id()`` / ``new_trace_id()`` / "
+           "``new_span_id()`` instead. uuid4 uses with no request/trace/"
+           "span context (model artifact ids, run ids) stay quiet.")
+
+    #: the one module allowed to mint — THE id source the doc points at
+    EXEMPT = "mmlspark_tpu/observability/tracing.py"
+
+    def _stmt_text(self, module: ModuleInfo, stmt: ast.stmt) -> str:
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        return "\n".join(module.lines[stmt.lineno - 1:end])
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mmlspark_tpu/") or rel == self.EXEMPT:
+            return iter(())
+        findings: List[Finding] = []
+        for stmt in ast.walk(module.tree):
+            # simple statements only: a compound statement (If/For/def)
+            # would re-flag every uuid4 its body already reported
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign, ast.Expr, ast.Return)):
+                continue
+            has_uuid4 = any(
+                isinstance(sub, ast.Call)
+                and module.dotted(sub.func) == "uuid.uuid4"
+                for sub in ast.walk(stmt))
+            if not has_uuid4:
+                continue
+            if not _ID_CONTEXT_RE.search(self._stmt_text(module, stmt)):
+                continue
+            findings.append(self.finding(
+                module, stmt,
+                "request/trace/span id minted with uuid.uuid4() outside "
+                "observability/tracing.py; use tracing.new_request_id() / "
+                "new_trace_id() / new_span_id() so the id joins the trace "
+                "context (routing table, journal, /debug/traces)"))
         return iter(findings)
