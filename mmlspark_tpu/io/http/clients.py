@@ -84,7 +84,10 @@ def send_with_retries(session: requests.Session, request: HTTPRequestData,
                       timeout: float = 60.0) -> HTTPResponseData:
     """Reference semantics of ``HandlingUtils.sendWithRetries:75-125``."""
     retries: List[int] = list(backoffs_ms)
-    while True:
+    # reference-parity retry ladder: fixed backoff list, Retry-After
+    # honored, 429 doesn't consume a retry — RetryPolicy's jittered
+    # exponential schedule would change observable reference semantics
+    while True:  # tpulint: disable=TPU009
         resp = _execute(session, request, timeout)
         code = resp.status_code
         if code in (200, 201, 202, 400):

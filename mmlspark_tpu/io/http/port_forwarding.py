@@ -95,7 +95,10 @@ class PortForwarder:
     # -- internals ----------------------------------------------------------
     def _connect_backend(self) -> Optional[socket.socket]:
         delay = self.backoff_s
-        for attempt in range(self.connect_retries + 1):
+        # deliberate un-jittered ladder: connect_retries/backoff_s are this
+        # class's public parity knobs (reference PortForwarding semantics)
+        # and the stop event must interrupt the wait mid-ladder
+        for attempt in range(self.connect_retries + 1):  # tpulint: disable=TPU009
             if self._stopping.is_set():
                 return None
             try:

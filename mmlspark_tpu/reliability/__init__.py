@@ -1,0 +1,37 @@
+"""Reliability substrate for the serving stack.
+
+Four cooperating pieces (PAPERS.md: ORCA/AlpaServe-style overload control
+and fail-fast serving):
+
+- :mod:`.policy` — :class:`RetryPolicy` (budgeted exponential backoff with
+  full jitter) and :class:`Deadline` (monotonic remaining-budget object,
+  propagated across worker hops via the ``X-Mmlspark-Deadline`` header).
+- :mod:`.breaker` — per-peer :class:`CircuitBreaker`
+  (closed → open → half-open, failure-ratio over a sliding window), state
+  exported as ``mmlspark_breaker_state{peer}``.
+- :mod:`.faults` — deterministic, seedable :class:`FaultInjector` with
+  named sites (``peer_http``, ``heartbeat``, ``device_run``, ``enqueue``)
+  driven programmatically or by the ``MMLSPARK_TPU_FAULTS`` env spec.
+
+``docs/reliability.md`` is the narrative companion.
+"""
+
+from .breaker import BreakerOpen, CircuitBreaker, breaker_for, reset_breakers
+from .faults import FaultInjector, InjectedFault, get_injector
+from .policy import (DEADLINE_HEADER, Deadline, DeadlineExceeded, RetryPolicy,
+                     record_retry)
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "breaker_for",
+    "reset_breakers",
+    "FaultInjector",
+    "InjectedFault",
+    "get_injector",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
+    "RetryPolicy",
+    "record_retry",
+]

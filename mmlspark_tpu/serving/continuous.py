@@ -43,8 +43,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..observability import (counter as _metric_counter,
                              gauge as _metric_gauge,
-                             histogram as _metric_histogram)
+                             histogram as _metric_histogram,
+                             log_event as _log_event)
 from ..observability import tracing as _tracing
+from ..reliability import get_injector as _get_injector
 from ..utils.profiling import span as _prof_span
 from ..models.zoo.transformer import (TransformerConfig,
                                       _warp_scaled_rows,
@@ -1013,6 +1015,9 @@ class ContinuousDecoder:
             return self._step_locked()
 
     def _step_locked(self) -> int:
+        injector = _get_injector()
+        if injector.enabled:
+            injector.fire("device_run")
         # adaptive drain under saturation: when requests are queued and
         # every slot is occupied, the only way a slot frees is through a
         # drained block's retirement — running `depth` ahead would keep
@@ -1178,9 +1183,34 @@ class ContinuousDecoder:
             req.event.set()
         return cancelled
 
-    def serve_forever(self, idle_sleep: float = 0.002):
+    def serve_forever(self, idle_sleep: float = 0.002,
+                      max_failures: int = 3,
+                      failure_backoff: float = 0.05):
+        """Engine loop with crash containment: a step() error is counted
+        and backed off (exponentially, capped at 1s); after
+        ``max_failures`` consecutive errors the decoder cancels all
+        in-flight requests (their waiters unblock with whatever tokens
+        were emitted) and keeps serving rather than dying silently with
+        every waiter parked forever."""
+        failures = 0
         while not self._stop.is_set():
-            if self.step() == 0:
+            try:
+                stepped = self.step()
+            except Exception as exc:
+                failures += 1
+                _log_event("continuous_step_failed", failures=failures,
+                           error=repr(exc))
+                if failures >= max_failures:
+                    try:
+                        self.cancel_all()
+                    except Exception as cancel_exc:
+                        _log_event("continuous_cancel_failed",
+                                   error=repr(cancel_exc))
+                    failures = 0
+                self._stop.wait(min(failure_backoff * (2 ** failures), 1.0))
+                continue
+            failures = 0
+            if stepped == 0:
                 self._stop.wait(idle_sleep)
 
     def start(self) -> threading.Thread:

@@ -28,21 +28,83 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from ..io.http.schema import (EntityData, HTTPRequestData, HTTPResponseData,
-                              StatusLineData)
+from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
+                              HTTPResponseData, StatusLineData)
+from ..observability import counter as _metric_counter
 from ..observability import log_event as _log_event
-from .server import CachedRequest, WorkerServer
+from ..observability import tracing as _tracing
+from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
+                           Deadline, DeadlineExceeded, RetryPolicy,
+                           breaker_for, get_injector)
+from .server import CachedRequest, Overloaded, WorkerServer
 
 __all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
 
+_M_HEARTBEAT_FAILURES = _metric_counter(
+    "mmlspark_heartbeat_failures_total",
+    "Heartbeat re-register attempts that exhausted their retry budget")
+
+
+def _giveup(exc: BaseException) -> bool:
+    # an HTTPError is a real response (the peer is up — 404 means "already
+    # answered", not "try again"); BreakerOpen/DeadlineExceeded are the
+    # fail-fast signals retrying would defeat
+    return isinstance(exc, (urllib.error.HTTPError, BreakerOpen,
+                            DeadlineExceeded))
+
+
+#: default client policy for cross-process hops: three quick attempts with
+#: full jitter — rides out one ECONNREFUSED during a worker restart without
+#: stretching a dead-peer verdict past ~1s
+_HTTP_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=0.5,
+                          retry_on=(OSError,), giveup=_giveup)
+
 
 def _http_json(url: str, payload: Optional[dict] = None,
-               timeout: float = 10.0) -> dict:
-    data = json.dumps(payload).encode() if payload is not None else None
-    req = urllib.request.Request(url, data=data,
-                                 headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read().decode() or "{}")
+               timeout: float = 10.0, *, site: str = "peer_http",
+               retry: Optional[RetryPolicy] = None,
+               breaker: Optional[CircuitBreaker] = None,
+               deadline: Optional[Deadline] = None) -> dict:
+    """Retrying, breaker-guarded, deadline-aware JSON-over-HTTP client for
+    every cross-process hop. With all guards at their defaults and faults
+    disabled the per-attempt work is identical to a plain ``urlopen``."""
+    policy = retry if retry is not None else _HTTP_RETRY
+
+    def attempt() -> dict:
+        budget = timeout if deadline is None else deadline.cap(timeout)
+        if budget <= 0:
+            # out of budget is the caller's clock running out, not a peer
+            # failure — checked before allow() so it can't strand the
+            # half-open probe slot
+            raise DeadlineExceeded(url)
+        if breaker is not None and not breaker.allow():
+            raise BreakerOpen(breaker.peer)
+        try:
+            injector = get_injector()
+            if injector.enabled:
+                injector.fire(site)
+            data = (json.dumps(payload).encode()
+                    if payload is not None else None)
+            headers = {"Content-Type": "application/json"}
+            if deadline is not None:
+                headers[DEADLINE_HEADER] = deadline.header_value()
+            req = urllib.request.Request(url, data=data, headers=headers)
+            with urllib.request.urlopen(req, timeout=budget) as r:
+                out = json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError:
+            # the peer answered — that's a transport success
+            if breaker is not None:
+                breaker.record_success()
+            raise
+        except BaseException:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return out
+
+    return policy.call(attempt, site=site, deadline=deadline)
 
 
 class _RegistryHandler(BaseHTTPRequestHandler):
@@ -191,9 +253,13 @@ class DistributedWorker:
         advertised = (f"http://{advertise_host}:{self.server.port}"
                       if advertise_host else self.server.address.rstrip("/"))
         self.advertised_address = advertised.rstrip("/")
+        # its own site name: construction-time registration is not a peer
+        # hop, and chaos specs targeting peer_http must not be able to kill
+        # a worker while it boots
         info = _http_json(driver_url + "/register",
                           {"worker_id": worker_id,
-                           "address": self.advertised_address})
+                           "address": self.advertised_address},
+                          site="register")
         self.generation = info["generation"]
         self.recovered = info["recovered"]
         self._peers = {w: a for w, a in info["peers"].items()
@@ -203,6 +269,12 @@ class DistributedWorker:
         # keep last_seen fresh — without this the registry's liveness filter
         # would silently drop every worker after liveness_timeout
         self._hb_stop = threading.Event()
+        # re-register retries get their own, more patient budget than the
+        # default client policy — losing the registry entry for good is
+        # worse than a slightly tardy heartbeat tick
+        self._hb_policy = RetryPolicy(max_attempts=4, base_delay=0.1,
+                                      max_delay=1.0, retry_on=(OSError,),
+                                      giveup=_giveup)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval,),
             name=f"heartbeat-{worker_id}", daemon=True)
@@ -210,18 +282,23 @@ class DistributedWorker:
 
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._hb_stop.wait(interval):
-            if not self.heartbeat():
-                # registry forgot us (pruned while unreachable) → re-register
-                try:
-                    _http_json(self.driver_url + "/register",
-                               {"worker_id": self.worker_id,
-                                "address": self.advertised_address})
-                except Exception:
-                    pass
+            if self.heartbeat():
+                continue
+            # registry forgot us (pruned while unreachable) → re-register;
+            # a permanently-lost worker must be VISIBLE, not silent
+            try:
+                _http_json(self.driver_url + "/register",
+                           {"worker_id": self.worker_id,
+                            "address": self.advertised_address},
+                           site="heartbeat", retry=self._hb_policy)
+            except Exception as exc:
+                _M_HEARTBEAT_FAILURES.inc()
+                _log_event("heartbeat_reregister_failed",
+                           worker_id=self.worker_id, error=repr(exc))
 
     # -- registry interaction ----------------------------------------------
     def refresh_peers(self) -> Dict[str, str]:
-        table = _http_json(self.driver_url + "/routing")
+        table = _http_json(self.driver_url + "/routing", site="peer_http")
         with self._lock:
             self._peers = {w: a for w, a in table.items()
                            if w != self.worker_id}
@@ -230,7 +307,8 @@ class DistributedWorker:
     def heartbeat(self) -> bool:
         try:
             return _http_json(self.driver_url + "/heartbeat",
-                              {"worker_id": self.worker_id}).get("known", False)
+                              {"worker_id": self.worker_id},
+                              site="heartbeat").get("known", False)
         except Exception:
             return False
 
@@ -259,7 +337,8 @@ class DistributedWorker:
         try:
             out = _http_json(addr + "/_reply",
                              {"request_id": request_id,
-                              "response": response.to_dict()})
+                              "response": response.to_dict()},
+                             breaker=breaker_for(addr))
         except Exception:
             # same contract as the local branch: an already-answered /
             # timed-out / unreachable target is False, never an exception
@@ -291,8 +370,14 @@ class DistributedWorker:
             if h.name == self._FWD_HDR:
                 req.method = h.value
         req.headers = [h for h in req.headers if h.name != self._FWD_HDR]
-        cached = self.server._enqueue(req)
-        resp = cached.wait(self.server.reply_timeout)
+        try:
+            cached = self.server._enqueue(req)
+        except Overloaded as exc:
+            return HTTPResponseData(
+                headers=[HeaderData("Retry-After", f"{exc.retry_after:g}")],
+                status_line=StatusLineData(status_code=429,
+                                           reason_phrase="overloaded"))
+        resp = cached.wait(self.server.wait_budget(cached))
         if resp is None:
             return HTTPResponseData(
                 status_line=StatusLineData(status_code=504,
@@ -312,48 +397,84 @@ class DistributedWorker:
     def _forward_out(self, req: HTTPRequestData) -> HTTPResponseData:
         with self._lock:
             peers = [a for w, a in sorted(self._peers.items())]
-            if not peers:
-                return HTTPResponseData(
-                    status_line=StatusLineData(status_code=503,
-                                               reason_phrase="no peers"))
-            addr = peers[self._rr % len(peers)]
+            start = self._rr
             self._rr += 1
+        if not peers:
+            return HTTPResponseData(
+                status_line=StatusLineData(status_code=503,
+                                           reason_phrase="no peers"))
+        # never wait past what the client has left: honor an inbound
+        # deadline, else budget this hop with our own reply_timeout
+        deadline = None
+        for h in req.headers:
+            if h.name.lower() == DEADLINE_HEADER.lower():
+                deadline = Deadline.from_header(h.value)
+        if deadline is None:
+            deadline = Deadline.after(self.server.reply_timeout)
         body = req.entity.content if req.entity else None
         # carry the client's path/query, method, and headers across the hop
-        hop_hdrs = {h.name: h.value for h in req.headers
-                    if h.name.lower() not in ("host", "content-length",
-                                              "connection")}
-        hop_hdrs[self._FWD_HDR] = req.method
-        fwd = urllib.request.Request(
-            addr + self._FWD_PREFIX + req.url, data=body, headers=hop_hdrs,
-            method="POST" if body else "GET")
-        try:
-            with urllib.request.urlopen(
-                    fwd, timeout=self.server.reply_timeout) as r:
-                payload = r.read()
+        base_hdrs = {h.name: h.value for h in req.headers
+                     if h.name.lower() not in ("host", "content-length",
+                                               "connection")}
+        base_hdrs[self._FWD_HDR] = req.method
+        injector = get_injector()
+        # try each peer at most once, from the round-robin cursor, skipping
+        # open circuits; 502 only once every peer has been exhausted
+        for i in range(len(peers)):
+            addr = peers[(start + i) % len(peers)]
+            brk = breaker_for(addr)
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                return HTTPResponseData(
+                    status_line=StatusLineData(status_code=504,
+                                               reason_phrase="deadline"))
+            if not brk.allow():
+                continue
+            hop_hdrs = dict(base_hdrs)
+            hop_hdrs[DEADLINE_HEADER] = deadline.header_value()
+            fwd = urllib.request.Request(
+                addr + self._FWD_PREFIX + req.url, data=body,
+                headers=hop_hdrs, method="POST" if body else "GET")
+            try:
+                if injector.enabled:
+                    injector.fire("peer_http")
+                # the peer enforces the deadline (parks at most `remaining`);
+                # the socket timeout is only a dead-peer guard, and needs
+                # slack so the peer's own 504 arrives instead of racing it
+                with urllib.request.urlopen(fwd, timeout=remaining + 1.0) as r:
+                    payload = r.read()
+                    brk.record_success()
+                    return HTTPResponseData(
+                        entity=EntityData(content=payload,
+                                          content_length=len(payload)),
+                        status_line=StatusLineData(status_code=r.status))
+            except urllib.error.HTTPError as e:
+                # the peer answered (504/429/...): relay it, don't fail over
+                payload = e.read()
+                brk.record_success()
                 return HTTPResponseData(
                     entity=EntityData(content=payload,
                                       content_length=len(payload)),
-                    status_line=StatusLineData(status_code=r.status))
-        except urllib.error.HTTPError as e:
-            payload = e.read()
-            return HTTPResponseData(
-                entity=EntityData(content=payload,
-                                  content_length=len(payload)),
-                status_line=StatusLineData(status_code=e.code))
-        except Exception:
-            return HTTPResponseData(
-                status_line=StatusLineData(status_code=502,
-                                           reason_phrase="peer unreachable"))
+                    status_line=StatusLineData(status_code=e.code))
+            except Exception as exc:
+                brk.record_failure()
+                _tracing.add_event("forward_failover", peer=addr,
+                                   error=type(exc).__name__)
+        return HTTPResponseData(
+            status_line=StatusLineData(status_code=502,
+                                       reason_phrase="no reachable peer"))
 
     def close(self, deregister: bool = True) -> None:
         self._hb_stop.set()
         if deregister:
             try:
                 _http_json(self.driver_url + "/deregister",
-                           {"worker_id": self.worker_id})
-            except Exception:
-                pass
+                           {"worker_id": self.worker_id}, site="register")
+            except Exception as exc:
+                # best-effort on shutdown (liveness pruning will finish the
+                # job), but leave a trace for anyone chasing ghosts
+                _log_event("deregister_failed", worker_id=self.worker_id,
+                           error=repr(exc))
         self.server.close()
         self._hb_thread.join(timeout=2)
 
@@ -406,7 +527,38 @@ class ServingCluster:
         try:
             return self.worker(owner_id).server.reply(request_id, response)
         except KeyError:
-            return self.workers[0].reply(owner_id, request_id, response)
+            pass
+        # unknown owner (registry drift / restarted elsewhere): route via
+        # the first worker whose server is still open — a closed worker
+        # can't speak HTTP to the owner anymore
+        for w in self.workers:
+            if not w.server.closed:
+                return w.reply(owner_id, request_id, response)
+        return False
+
+    def restart_worker(self, worker_id: str,
+                       reply_timeout: Optional[float] = None
+                       ) -> DistributedWorker:
+        """Chaos/ops helper: kill one worker ungracefully (no deregister —
+        a crash doesn't say goodbye) and re-register a replacement under
+        the SAME id, exercising the recovery contract."""
+        for i, w in enumerate(self.workers):
+            if w.worker_id != worker_id:
+                continue
+            w.close(deregister=False)
+            replacement = DistributedWorker(
+                self.driver.url, worker_id,
+                reply_timeout=(reply_timeout if reply_timeout is not None
+                               else w.server.reply_timeout))
+            self.workers[i] = replacement
+            for peer in self.workers:
+                try:
+                    peer.refresh_peers()
+                except Exception as exc:
+                    _log_event("refresh_peers_failed",
+                               worker_id=peer.worker_id, error=repr(exc))
+            return replacement
+        raise KeyError(worker_id)
 
     def close(self) -> None:
         for w in self.workers:

@@ -22,6 +22,8 @@ from ..core.dataframe import DataFrame
 from ..observability import (counter as _metric_counter,
                              histogram as _metric_histogram)
 from ..observability import tracing as _tracing
+from ..reliability import get_injector as _get_injector
+from ..reliability import record_retry as _record_retry
 from .server import WorkerServer
 from .source import HTTPSink, HTTPSource, parse_request
 
@@ -121,27 +123,64 @@ class ServingEngine:
                     _tracing.start_span("engine.batch", rows=len(df)):
                 try:
                     parsed = parse_request(df, self.schema)
-                    out = self.transform_fn(parsed)
-                    self.sink.write_batch(out)
-                    # rows the transform dropped (filters etc.) must still be
-                    # answered, or their CachedRequests leak in the routing
-                    # table
-                    surviving = set(out["id"]) if "id" in out else set()
-                    for rid in ids:
-                        if rid not in surviving:
-                            self.server.reply_json(
-                                rid, {"error": "row dropped by pipeline"},
-                                status=400)
                 except Exception:
                     _M_BATCH_ERRORS.inc()
-                    _tracing.add_event("batch_error")
-                    _log.error("serving batch failed:\n%s",
+                    _tracing.add_event("batch_error", stage="parse")
+                    _log.error("serving batch parse failed:\n%s",
                                traceback.format_exc())
                     for rid in ids:
                         self.server.reply_json(
                             rid, {"error": "internal error"}, status=500)
+                    _M_BATCH_SECONDS.observe(time.perf_counter() - t0)
+                    self.server.commit_epoch()
+                    continue
+                if not self._run_batch(parsed, ids):
+                    # graceful degradation: a whole-batch failure is often
+                    # OOM-shaped (too many rows in one device batch) — retry
+                    # ONCE at half size before failing rows individually
+                    if len(ids) > 1:
+                        mid = (len(ids) + 1) // 2
+                        splits = ((range(0, mid), ids[:mid]),
+                                  (range(mid, len(ids)), ids[mid:]))
+                        for rows, half_ids in splits:
+                            _record_retry("engine_batch", 1, 0.0,
+                                          "batch_error")
+                            if not self._run_batch(parsed.take(rows),
+                                                   half_ids):
+                                self._fail_rows(half_ids)
+                    else:
+                        self._fail_rows(ids)
                 _M_BATCH_SECONDS.observe(time.perf_counter() - t0)
             self.server.commit_epoch()
+
+    def _fail_rows(self, ids) -> None:
+        for rid in ids:
+            self.server.reply_json(rid, {"error": "internal error"},
+                                   status=500)
+
+    def _run_batch(self, parsed: DataFrame, ids) -> bool:
+        """Transform + route one (sub-)batch; False when the transform or
+        sink raised (rows unanswered — the caller decides retry vs 500)."""
+        try:
+            injector = _get_injector()
+            if injector.enabled:
+                injector.fire("device_run")
+            out = self.transform_fn(parsed)
+            self.sink.write_batch(out)
+            # rows the transform dropped (filters etc.) must still be
+            # answered, or their CachedRequests leak in the routing table
+            surviving = set(out["id"]) if "id" in out else set()
+            for rid in ids:
+                if rid not in surviving:
+                    self.server.reply_json(
+                        rid, {"error": "row dropped by pipeline"},
+                        status=400)
+            return True
+        except Exception:
+            _M_BATCH_ERRORS.inc()
+            _tracing.add_event("batch_error", rows=len(ids))
+            _log.error("serving batch failed:\n%s", traceback.format_exc())
+            return False
 
     def stop(self) -> None:
         self._stop.set()

@@ -38,6 +38,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..io.http.schema import HTTPRequestData
+from ..observability import log_event
 
 __all__ = ["ServingJournal"]
 
@@ -180,5 +181,8 @@ class ServingJournal:
         with self._lock:
             try:
                 self._fh.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                # a failed close can mean lost journal tail (buffered
+                # writes) — worth a trace when chasing replay gaps
+                log_event("journal_close_failed", path=self.path,
+                          error=repr(exc))

@@ -35,8 +35,9 @@ from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
                              process_uptime_seconds as _process_uptime,
                              render as _render_metrics)
 from ..observability import tracing as _tracing
+from ..reliability import Deadline, get_injector as _get_injector
 
-__all__ = ["CachedRequest", "WorkerServer"]
+__all__ = ["CachedRequest", "Overloaded", "WorkerServer"]
 
 # serving-plane metrics (docs/observability.md) — scraped at GET /metrics,
 # which every WorkerServer answers as a built-in control route
@@ -55,9 +56,22 @@ _M_INFLIGHT = _metric_gauge(
     "mmlspark_serving_inflight_requests",
     "Requests accepted but not yet answered (routing-table size)",
     ("port",))
+_M_SHED = _metric_counter(
+    "mmlspark_requests_shed_total",
+    "Requests rejected 429 by bounded-queue admission control")
 
 
 _STREAM_TIMEOUT_EVENT = b'data: {"error": "stream reply timeout"}\n\n'
+
+
+class Overloaded(RuntimeError):
+    """The parked-request queue is full — the transports turn this into
+    ``429 Too Many Requests`` + ``Retry-After`` (shed early rather than
+    park unboundedly and 504 late)."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("serving queue full")
+        self.retry_after = retry_after
 
 
 def _trace_headers(cached: Optional["CachedRequest"]
@@ -158,6 +172,9 @@ class CachedRequest:
     #: root span of this request's trace (observability/tracing.py); None
     #: for replayed requests (the original caller's connection is gone)
     trace_span: Optional[object] = field(default=None, repr=False)
+    #: remaining-budget carried in from X-Mmlspark-Deadline (reliability/
+    #: policy.py) — caps how long the transport parks this request
+    deadline: Optional[Deadline] = field(default=None, repr=False)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _response: Optional[HTTPResponseData] = field(default=None, repr=False)
 
@@ -258,8 +275,28 @@ class _Handler(BaseHTTPRequestHandler):
                     entity=EntityData.from_string(str(e)),
                     status_line=StatusLineData(status_code=500))
         else:
-            cached = ws._enqueue(req)
-            resp = cached.wait(ws.reply_timeout)
+            try:
+                cached = ws._enqueue(req)
+            except Overloaded as e:
+                self.send_response(429, "overloaded")
+                self.send_header("Retry-After", f"{e.retry_after:g}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                ws._observe_request("threaded", self.command, 429,
+                                    time.perf_counter() - t0, path=self.path)
+                return
+            except Exception as e:
+                # enqueue failure (journal append, injected fault): answer
+                # 500 instead of killing this connection's handler thread
+                body500 = str(e).encode()
+                self.send_response(500, "enqueue failed")
+                self.send_header("Content-Length", str(len(body500)))
+                self.end_headers()
+                self.wfile.write(body500)
+                ws._observe_request("threaded", self.command, 500,
+                                    time.perf_counter() - t0, path=self.path)
+                return
+            resp = cached.wait(ws.wait_budget(cached))
         if resp is None:
             if cached is not None and cached.trace_span is not None:
                 cached.trace_span.end(status=504)
@@ -492,30 +529,47 @@ class _AsyncHTTPServer:
                     # configured journal fsyncs per request — either would
                     # freeze EVERY multiplexed connection if run here. The
                     # executor provides natural backpressure instead.
-                    cached = await self._loop.run_in_executor(
-                        None, ws._enqueue, req)
-                    fut = self._loop.create_future()
-
-                    def _cb(response, fut=fut):
-                        try:
-                            self._loop.call_soon_threadsafe(
-                                lambda: None if fut.done()
-                                else fut.set_result(response))
-                        except RuntimeError:
-                            # loop already closed (shutdown race) — the
-                            # reply has nowhere to go; don't kill the
-                            # dispatcher thread delivering it
-                            pass
-
-                    cached.add_done_callback(_cb)
                     try:
-                        resp = await asyncio.wait_for(fut, ws.reply_timeout)
-                    except asyncio.TimeoutError:
-                        if cached.trace_span is not None:
-                            cached.trace_span.end(status=504)
-                        resp = HTTPResponseData(status_line=StatusLineData(
-                            status_code=504,
-                            reason_phrase="serving reply timeout"))
+                        cached = await self._loop.run_in_executor(
+                            None, ws._enqueue, req)
+                    except Overloaded as e:
+                        resp = HTTPResponseData(
+                            headers=[HeaderData("Retry-After",
+                                                f"{e.retry_after:g}")],
+                            status_line=StatusLineData(
+                                status_code=429,
+                                reason_phrase="overloaded"))
+                    except Exception as e:
+                        # enqueue failure (journal append, injected fault)
+                        # — answer 500, keep the connection multiplexing
+                        resp = HTTPResponseData(
+                            entity=EntityData.from_string(str(e)),
+                            status_line=StatusLineData(status_code=500))
+                    else:
+                        fut = self._loop.create_future()
+
+                        def _cb(response, fut=fut):
+                            try:
+                                self._loop.call_soon_threadsafe(
+                                    lambda: None if fut.done()
+                                    else fut.set_result(response))
+                            except RuntimeError:
+                                # loop already closed (shutdown race) — the
+                                # reply has nowhere to go; don't kill the
+                                # dispatcher thread delivering it
+                                pass
+
+                        cached.add_done_callback(_cb)
+                        try:
+                            resp = await asyncio.wait_for(
+                                fut, ws.wait_budget(cached))
+                        except asyncio.TimeoutError:
+                            if cached.trace_span is not None:
+                                cached.trace_span.end(status=504)
+                            resp = HTTPResponseData(
+                                status_line=StatusLineData(
+                                    status_code=504,
+                                    reason_phrase="serving reply timeout"))
                 tspan = cached.trace_span if cached is not None else None
                 echo = _trace_headers(cached)
                 if isinstance(resp, StreamingReply):
@@ -571,7 +625,10 @@ class _AsyncHTTPServer:
         finally:
             try:
                 writer.close()
-            except Exception:
+            # per-connection teardown race on an already-reset socket:
+            # nothing to recover, and an event per closed keep-alive
+            # connection would be pure noise
+            except Exception:  # tpulint: disable=TPU009
                 pass
 
     def close(self) -> None:
@@ -596,13 +653,17 @@ class WorkerServer:
                  max_queue: int = 10_000,
                  journal_path: Optional[str] = None,
                  journal_fsync: bool = True,
-                 transport: str = "threaded"):
+                 transport: str = "threaded",
+                 shed_retry_after: float = 1.0):
         if transport not in ("threaded", "async"):
             # validate BEFORE opening the journal: failing after would leak
             # the journal fd and leave a half-built object
             raise ValueError(f"unknown transport {transport!r} "
                              "(expected 'threaded' or 'async')")
         self.reply_timeout = reply_timeout
+        #: Retry-After hint (seconds) sent with 429 shed responses
+        self.shed_retry_after = shed_retry_after
+        self._closed = False
         #: path prefix → fn(HTTPRequestData) -> HTTPResponseData. The
         #: telemetry endpoints are registered FIRST: _control_route matches
         #: prefixes in insertion order, so a later catch-all (e.g. the
@@ -758,24 +819,40 @@ class WorkerServer:
         return _resp(trace.to_dict())
 
     # -- ingest -------------------------------------------------------------
+    def _shed(self) -> Overloaded:
+        _M_SHED.inc()
+        _log_event("request_shed", port=self.port,
+                   queued=self._queue.qsize())
+        return Overloaded(self.shed_retry_after)
+
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
+        # admission control FIRST: a full queue sheds before any span/
+        # journal/routing work is spent on a request we won't park
+        # (raises Overloaded → the transports answer 429 + Retry-After)
+        if self._queue.full():
+            raise self._shed()
+        injector = _get_injector()
+        if injector.enabled:
+            injector.fire("enqueue")
         # ONE root span per logical request, minted at the single point
         # every ingest shape funnels through — both transports AND the
         # distributed forwarder (whose hop carries the original traceparent,
         # so the forwarded leg continues the same trace)
         request_id = _tracing.new_request_id()
-        traceparent = None
+        traceparent = deadline = None
         for h in request.headers:
-            if h.name.lower() == "traceparent":
+            name = h.name.lower()
+            if name == "traceparent":
                 traceparent = h.value
-                break
+            elif name == "x-mmlspark-deadline":
+                deadline = Deadline.from_header(h.value)
         root = _tracing.start_trace(
             "server.request", traceparent=traceparent,
             request_id=request_id, method=request.method, url=request.url,
             transport="async" if self._aio is not None else "threaded")
         with self._lock:
             cached = CachedRequest(request_id, self._epoch, request,
-                                   trace_span=root)
+                                   trace_span=root, deadline=deadline)
         # write-ahead, BEFORE the routing-table insert: a failed append
         # (disk full, journal closed mid-shutdown) must error this request
         # out cleanly instead of leaking a never-queued routing entry that
@@ -786,8 +863,27 @@ class WorkerServer:
         with self._lock:
             self._routing[cached.request_id] = cached
             self._history.setdefault(cached.epoch, {})[cached.request_id] = cached
-        self._queue.put(cached)
+        try:
+            self._queue.put_nowait(cached)
+        except queue.Full:
+            # lost the admission race — undo the bookkeeping above so the
+            # shed request leaks no routing entry and won't rehydrate
+            with self._lock:
+                self._routing.pop(cached.request_id, None)
+                self._history.get(cached.epoch, {}).pop(cached.request_id,
+                                                        None)
+            if self._journal is not None:
+                self._journal.record_reply(cached.request_id)
+            root.end(status=429)
+            raise self._shed() from None
         return cached
+
+    def wait_budget(self, cached: CachedRequest) -> float:
+        """How long a transport may park this request: ``reply_timeout``,
+        clamped to the request's propagated deadline when it carries one."""
+        if cached.deadline is None:
+            return self.reply_timeout
+        return max(0.0, cached.deadline.cap(self.reply_timeout))
 
     # -- engine side --------------------------------------------------------
     def get_batch(self, max_rows: int, timeout: float = 0.1):
@@ -901,7 +997,12 @@ class WorkerServer:
         with self._lock:
             return len(self._routing)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def close(self) -> None:
+        self._closed = True
         _M_QUEUE_DEPTH.remove(port=str(self.port))
         _M_INFLIGHT.remove(port=str(self.port))
         if self._aio is not None:

@@ -1,0 +1,492 @@
+"""Reliability units on fake clocks — retry policy, deadline, circuit
+breaker, fault injector — plus the serving-facing behaviors they gate:
+bounded-queue shedding (429 + Retry-After), deadline-capped parking, and
+the engine's halved-batch degradation (docs/reliability.md)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import observability as obs
+from mmlspark_tpu.reliability import (BreakerOpen, CircuitBreaker, Deadline,
+                                      DeadlineExceeded, FaultInjector,
+                                      InjectedFault, RetryPolicy, breaker_for,
+                                      get_injector, reset_breakers)
+from mmlspark_tpu.reliability.breaker import CLOSED, HALF_OPEN, OPEN
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    obs.reset_all()
+    reset_breakers()
+    get_injector().clear()
+    yield
+    get_injector().clear()
+    reset_breakers()
+    obs.reset_all()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.sleeps.append(seconds)
+        self.t += seconds
+
+
+def _series_value(snap, name, **labels):
+    for s in snap[name]["series"]:
+        if s["labels"] == labels:
+            return s["value"]
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+def _flaky(failures, exc=ConnectionError):
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= failures:
+            raise exc(f"boom {state['calls']}")
+        return "ok"
+
+    return fn, state
+
+
+def test_retry_succeeds_after_transient_failures():
+    clk = FakeClock()
+    policy = RetryPolicy(max_attempts=4, base_delay=0.1, clock=clk,
+                         sleep=clk.sleep)
+    fn, state = _flaky(2)
+    assert policy.call(fn, site="unit") == "ok"
+    assert state["calls"] == 3
+    assert len(clk.sleeps) == 2
+    # re-attempts are counted by site
+    assert _series_value(obs.snapshot(), "mmlspark_retry_attempts_total",
+                         site="unit") == 2
+
+
+def test_retry_exhausts_max_attempts():
+    clk = FakeClock()
+    policy = RetryPolicy(max_attempts=3, clock=clk, sleep=clk.sleep)
+    fn, state = _flaky(99)
+    with pytest.raises(ConnectionError, match="boom 3"):
+        policy.call(fn)
+    assert state["calls"] == 3
+
+
+def test_retry_full_jitter_bounded_by_exponential_ceiling():
+    import random
+    clk = FakeClock()
+    policy = RetryPolicy(max_attempts=8, base_delay=0.1, max_delay=0.4,
+                         clock=clk, sleep=clk.sleep, rng=random.Random(7))
+    fn, _ = _flaky(7)
+    policy.call(fn)
+    ceilings = [0.1, 0.2, 0.4, 0.4, 0.4, 0.4, 0.4]
+    assert len(clk.sleeps) == 7
+    for delay, ceiling in zip(clk.sleeps, ceilings):
+        assert 0.0 <= delay <= ceiling
+
+
+def test_retry_giveup_predicate_short_circuits():
+    policy = RetryPolicy(max_attempts=5,
+                         giveup=lambda e: isinstance(e, ValueError),
+                         sleep=lambda s: None)
+    fn, state = _flaky(3, exc=ValueError)
+    with pytest.raises(ValueError):
+        policy.call(fn)
+    assert state["calls"] == 1
+
+
+def test_retry_respects_total_budget():
+    clk = FakeClock()
+    # backoff is deterministic 0.5 with a constant rng
+    policy = RetryPolicy(max_attempts=100, base_delay=1.0, max_delay=1.0,
+                         total_budget=1.2, clock=clk, sleep=clk.sleep)
+    policy.rng = type("R", (), {"uniform": lambda self, a, b: 0.5})()
+    fn, state = _flaky(99)
+    with pytest.raises(ConnectionError):
+        policy.call(fn)
+    # 0.5 + 0.5 spent; a third re-attempt would cross 1.2
+    assert state["calls"] == 3
+
+
+def test_retry_respects_deadline():
+    clk = FakeClock()
+    policy = RetryPolicy(max_attempts=100, base_delay=1.0, max_delay=1.0,
+                         clock=clk, sleep=clk.sleep)
+    policy.rng = type("R", (), {"uniform": lambda self, a, b: 0.4})()
+    deadline = Deadline.after(1.0, clock=clk)
+    fn, state = _flaky(99)
+    with pytest.raises(ConnectionError):
+        policy.call(fn, deadline=deadline)
+    # sleeps 0.4, 0.4; the next 0.4 would exceed the 0.2 remaining
+    assert state["calls"] == 3
+
+
+def test_retry_rejects_bad_max_attempts():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+
+
+def test_deadline_remaining_and_expiry():
+    clk = FakeClock()
+    d = Deadline.after(2.0, clock=clk)
+    assert d.remaining() == pytest.approx(2.0)
+    assert not d.expired
+    clk.t += 2.5
+    assert d.remaining() == pytest.approx(-0.5)
+    assert d.expired
+    assert d.cap(10.0) == pytest.approx(-0.5)
+
+
+def test_deadline_header_round_trip():
+    clk = FakeClock()
+    d = Deadline.after(2.0, clock=clk)
+    clk.t += 0.5
+    value = d.header_value()
+    assert value == "1.500"
+    d2 = Deadline.from_header(value, clock=clk)
+    assert d2.remaining() == pytest.approx(1.5)
+
+
+@pytest.mark.parametrize("garbage", ["", "abc", None, "nan", "inf", "1e999"])
+def test_deadline_malformed_header_is_none(garbage):
+    assert Deadline.from_header(garbage) is None
+
+
+def test_deadline_header_value_never_negative():
+    clk = FakeClock()
+    d = Deadline.after(0.1, clock=clk)
+    clk.t += 5.0
+    assert d.header_value() == "0.000"
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+
+
+def _trip(brk, n):
+    for _ in range(n):
+        brk.record_failure()
+
+
+def test_breaker_opens_at_failure_ratio_and_blocks():
+    clk = FakeClock()
+    brk = CircuitBreaker("p", window=10, min_calls=4, failure_ratio=0.5,
+                         open_seconds=5.0, clock=clk)
+    brk.record_success()
+    brk.record_success()
+    _trip(brk, 2)  # 2/4 = 0.5 → trips
+    assert brk.state == OPEN
+    assert not brk.allow()
+    snap = obs.snapshot()
+    assert _series_value(snap, "mmlspark_breaker_state", peer="p") == 1.0
+    assert _series_value(snap, "mmlspark_breaker_transitions_total",
+                         peer="p", to="open") == 1.0
+
+
+def test_breaker_stays_closed_below_min_calls():
+    brk = CircuitBreaker("p", min_calls=5, failure_ratio=0.5,
+                         clock=FakeClock())
+    _trip(brk, 4)
+    assert brk.state == CLOSED and brk.allow()
+
+
+def test_breaker_half_open_probe_success_closes():
+    clk = FakeClock()
+    brk = CircuitBreaker("p", window=10, min_calls=2, failure_ratio=0.5,
+                         open_seconds=3.0, clock=clk)
+    _trip(brk, 2)
+    assert brk.state == OPEN
+    clk.t += 3.1
+    assert brk.allow()               # the single half-open probe
+    assert brk.state == HALF_OPEN
+    assert not brk.allow()           # concurrent calls stay blocked
+    brk.record_success()
+    assert brk.state == CLOSED and brk.allow()
+    assert _series_value(obs.snapshot(), "mmlspark_breaker_state",
+                         peer="p") == 0.0
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = FakeClock()
+    brk = CircuitBreaker("p", min_calls=2, failure_ratio=0.5,
+                         open_seconds=3.0, clock=clk)
+    _trip(brk, 2)
+    clk.t += 3.1
+    assert brk.allow()
+    brk.record_failure()
+    assert brk.state == OPEN
+    assert not brk.allow()           # open window restarted
+    clk.t += 3.1
+    assert brk.allow()               # and a new probe after it elapses
+
+
+def test_breaker_registry_is_per_peer():
+    a, b = breaker_for("addr-a"), breaker_for("addr-b")
+    assert a is breaker_for("addr-a")
+    assert a is not b
+    assert isinstance(BreakerOpen("addr-a"), ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+
+
+def test_fault_injector_disabled_is_passthrough():
+    inj = FaultInjector()
+    assert not inj.enabled
+    assert inj.fire("peer_http", {"a": 1}) == {"a": 1}
+
+
+def test_fault_error_rule_raises_and_counts():
+    inj = FaultInjector()
+    inj.add("peer_http", "error")
+    with pytest.raises(InjectedFault) as err:
+        inj.fire("peer_http")
+    assert err.value.site == "peer_http"
+    assert isinstance(err.value, ConnectionError)
+    assert _series_value(obs.snapshot(), "mmlspark_faults_injected_total",
+                         site="peer_http", kind="error") == 1.0
+
+
+def test_fault_probability_is_seed_deterministic():
+    def decisions(seed):
+        inj = FaultInjector()
+        inj.add("s", "error", p=0.5, seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                inj.fire("s")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    a, b = decisions(42), decisions(42)
+    assert a == b                    # same seed → same schedule
+    assert True in a and False in a  # and it's actually probabilistic
+    assert decisions(43) != a
+
+
+def test_fault_every_and_times_schedules():
+    inj = FaultInjector()
+    rule = inj.add("s", "error", every=3, times=2)
+    fired = []
+    for i in range(1, 10):
+        try:
+            inj.fire("s")
+            fired.append(False)
+        except InjectedFault:
+            fired.append(True)
+    # fires on calls 3 and 6, then the `times` cap stops call 9
+    assert fired == [False, False, True, False, False, True,
+                     False, False, False]
+    assert rule.fires == 2
+
+
+def test_fault_delay_uses_injected_sleep():
+    slept = []
+    inj = FaultInjector(sleep=slept.append)
+    inj.add("s", "delay", seconds=0.25)
+    inj.fire("s")
+    assert slept == [0.25]
+
+
+def test_fault_corrupt_payloads():
+    inj = FaultInjector()
+    inj.add("s", "corrupt")
+    assert inj.fire("s", {"x": 1}) == {"x": 1, "_corrupted": True}
+    assert inj.fire("s", b"abc") == b"ab"
+    assert inj.fire("s", None) is None
+
+
+def test_fault_env_spec_grammar():
+    inj = FaultInjector()
+    inj.configure("peer_http:error:p=0.3:seed=7; heartbeat:delay:every=3:"
+                  "seconds=0.05;enqueue:error:times=2")
+    rules = {r.site: r for r in inj.rules()}
+    assert rules["peer_http"].p == 0.3 and rules["peer_http"].seed == 7
+    assert rules["heartbeat"].every == 3
+    assert rules["heartbeat"].seconds == 0.05
+    assert rules["enqueue"].times == 2
+    inj.clear()
+    assert not inj.enabled and inj.rules() == []
+
+
+@pytest.mark.parametrize("bad", ["peer_http", "s:explode", "s:error:p",
+                                 "s:error:bogus=1", "s:error:p=abc"])
+def test_fault_spec_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        FaultInjector().configure(bad)
+
+
+# ---------------------------------------------------------------------------
+# serving integration: shedding, deadlines, engine degradation
+
+
+def _post(url, payload, timeout=20.0, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers)
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_full_queue_sheds_429_with_retry_after(transport):
+    from mmlspark_tpu.serving.server import WorkerServer
+    ws = WorkerServer(max_queue=1, reply_timeout=10.0, transport=transport,
+                      shed_retry_after=2.5)
+    try:
+        parked = [None]
+        t = threading.Thread(
+            target=lambda: parked.__setitem__(0, _post(ws.address, {"n": 1})))
+        t.start()
+        deadline = time.time() + 5
+        while not ws._queue.full() and time.time() < deadline:
+            time.sleep(0.01)
+        assert ws._queue.full(), "first request never parked"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(ws.address, {"n": 2}, timeout=5.0)
+        assert err.value.code == 429
+        assert err.value.headers["Retry-After"] == "2.5"
+        assert _series_value(obs.snapshot(),
+                             "mmlspark_requests_shed_total") >= 1.0
+        # the shed request must leave no routing-table entry behind
+        assert ws.pending_count() == 1
+        rid = next(iter(ws._routing))
+        assert ws.reply_json(rid, {"ok": True})
+        t.join(timeout=10)
+        assert parked[0][0] == 200
+    finally:
+        ws.close()
+
+
+def test_deadline_header_caps_park_time():
+    from mmlspark_tpu.serving.server import WorkerServer
+    ws = WorkerServer(reply_timeout=30.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(ws.address, {"n": 1}, timeout=10.0,
+                  headers={"X-Mmlspark-Deadline": "0.3"})
+        assert err.value.code == 504
+        # parked for ~the propagated budget, nowhere near reply_timeout
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        ws.close()
+
+
+def test_closed_property_reflects_lifecycle():
+    from mmlspark_tpu.serving.server import WorkerServer
+    ws = WorkerServer()
+    assert not ws.closed
+    ws.close()
+    assert ws.closed
+
+
+def test_engine_retries_failed_batch_at_half_size():
+    from mmlspark_tpu.core.dataframe import DataFrame, object_col
+    from mmlspark_tpu.serving.engine import ServingEngine
+
+    sizes = []
+
+    def transform(df):
+        sizes.append(len(df))
+        if len(df) > 1:
+            raise RuntimeError("synthetic whole-batch OOM")
+        return DataFrame({"id": df["id"],
+                          "reply": object_col([{"ok": True}])})
+
+    engine = ServingEngine(transform, schema=None, poll_timeout=0.05,
+                           reply_timeout=15.0)
+    try:
+        out = [None, None]
+        threads = [threading.Thread(
+            target=lambda i=i: out.__setitem__(
+                i, _post(engine.address, {"n": i})))
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5
+        while engine.server._queue.qsize() < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert engine.server._queue.qsize() == 2, "requests did not coalesce"
+        engine.start()   # both park first → one batch of 2 → halves of 1
+        for t in threads:
+            t.join(timeout=15)
+        assert [o[0] for o in out] == [200, 200]
+        assert sizes[0] == 2 and sorted(sizes[1:]) == [1, 1]
+        assert _series_value(obs.snapshot(), "mmlspark_retry_attempts_total",
+                             site="engine_batch") == 2.0
+    finally:
+        engine.stop()
+
+
+def test_engine_fails_rows_when_halves_also_fail():
+    from mmlspark_tpu.serving.engine import ServingEngine
+
+    def transform(df):
+        raise RuntimeError("always broken")
+
+    engine = ServingEngine(transform, schema=None, poll_timeout=0.05,
+                           reply_timeout=15.0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(engine.address, {"n": 1}, timeout=10.0)
+        assert err.value.code == 500
+    finally:
+        engine.stop()
+
+
+def test_device_run_fault_site_degrades_gracefully():
+    from mmlspark_tpu.core.dataframe import DataFrame, object_col
+    from mmlspark_tpu.serving.engine import ServingEngine
+
+    def transform(df):
+        return DataFrame({"id": df["id"],
+                          "reply": object_col([{"ok": True}] * len(df))})
+
+    # one injected device fault kills the first (full) batch; the halved
+    # retry answers both requests anyway
+    get_injector().add("device_run", "error", times=1)
+    engine = ServingEngine(transform, schema=None, poll_timeout=0.05,
+                           reply_timeout=15.0)
+    try:
+        out = [None, None]
+        threads = [threading.Thread(
+            target=lambda i=i: out.__setitem__(
+                i, _post(engine.address, {"n": i})))
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5
+        while engine.server._queue.qsize() < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        engine.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert [o[0] for o in out] == [200, 200]
+    finally:
+        engine.stop()

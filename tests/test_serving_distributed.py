@@ -231,3 +231,273 @@ def test_request_counted_on_owning_worker_only():
     finally:
         cluster.close()
         obs.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# reliability layer (PR 5): chaos, failover, deadlines, heartbeat visibility
+
+
+def _reliability_sandbox():
+    """Fresh metrics/breakers/faults for tests that assert on them."""
+    from mmlspark_tpu import observability as obs
+    from mmlspark_tpu.reliability import get_injector, reset_breakers
+    obs.reset_all()
+    reset_breakers()
+    get_injector().clear()
+
+
+def test_cluster_reply_skips_closed_workers():
+    """Satellite fix: an unknown owner must be routed through the first
+    OPEN worker — the old hardcoded workers[0] fallback dead-ends when
+    that worker happens to be the closed one."""
+    _reliability_sandbox()
+    cluster = ServingCluster(3, reply_timeout=15.0)
+    try:
+        w0, w1, w2 = cluster.workers
+        out = [None]
+        t = threading.Thread(target=_client,
+                             args=(w2.server.address, {"q": 1}, out, 0))
+        t.start()
+        batch = []
+        deadline = time.time() + 10
+        while not batch and time.time() < deadline:
+            batch = w2.get_batch(4, timeout=0.2)
+        assert batch
+        owner, cached = batch[0]
+        # registry drift: the cluster record of worker-2 is gone, and the
+        # old fallback (workers[0]) is closed
+        cluster.workers.remove(w2)
+        w0.close(deregister=False)
+        ok = cluster.reply(owner, cached.request_id,
+                           _json_resp({"via": "fallback"}))
+        assert ok, "reply must route via the first open worker (w1)"
+        t.join(timeout=15)
+        status, payload = out[0]
+        assert status == 200 and payload == {"via": "fallback"}
+        w2.close()
+    finally:
+        cluster.close()
+
+
+def test_cluster_reply_all_closed_returns_false():
+    _reliability_sandbox()
+    cluster = ServingCluster(2, reply_timeout=5.0)
+    try:
+        for w in cluster.workers:
+            w.close(deregister=False)
+        assert cluster.reply("ghost", "nope", _json_resp({})) is False
+    finally:
+        cluster.close()
+
+
+def test_heartbeat_reregister_failure_is_visible():
+    """Satellite fix: the heartbeat loop used to swallow re-register
+    failures with a bare except/pass — now it retries under RetryPolicy
+    and, once exhausted, bumps mmlspark_heartbeat_failures_total."""
+    from mmlspark_tpu import observability as obs
+
+    def _failures():
+        snap = obs.snapshot().get("mmlspark_heartbeat_failures_total", {})
+        return sum(s["value"] for s in snap.get("series", []))
+
+    _reliability_sandbox()
+    reg = DriverRegistry()
+    w = DistributedWorker(reg.url, "w0", heartbeat_interval=0.05)
+    try:
+        before = _failures()
+        reg.close()  # driver gone: heartbeat fails → re-register fails
+        deadline = time.time() + 15
+        while _failures() <= before and time.time() < deadline:
+            time.sleep(0.05)
+        assert _failures() > before, "exhausted re-register never surfaced"
+    finally:
+        w.close(deregister=False)
+
+
+def test_forward_fails_over_and_opens_circuit():
+    """A forwarding worker must fail over past a dead peer (no 502 while
+    another peer can answer) and, after enough failures, skip it via an
+    OPEN circuit without re-dialing."""
+    from mmlspark_tpu.reliability import breaker_for
+    _reliability_sandbox()
+    cluster = ServingCluster(3, reply_timeout=15.0)
+    try:
+        wa, wb, wc = cluster.workers
+        wa.enable_forwarding()
+        dead_addr = wb.advertised_address
+        wb.close(deregister=False)  # crash: still in wa's peer table
+
+        def engine():
+            deadline = time.time() + 20
+            answered = 0
+            while answered < 6 and time.time() < deadline:
+                for owner, cached in wc.get_batch(8, timeout=0.1):
+                    wc.reply(owner, cached.request_id,
+                             _json_resp({"served": "worker-2"}))
+                    answered += 1
+
+        eng = threading.Thread(target=engine, daemon=True)
+        eng.start()
+        outs = [None] * 6
+        for i in range(6):
+            wa._rr = 0  # always try the dead peer (worker-1) first
+            _client(wa.server.address, {"i": i}, outs, i)
+        eng.join(timeout=20)
+        for o in outs:
+            assert isinstance(o, tuple), f"client failed: {o!r}"
+            status, payload = o
+            assert status == 200 and payload == {"served": "worker-2"}
+        # five consecutive dial failures opened worker-1's circuit
+        assert breaker_for(dead_addr).state == "open"
+    finally:
+        cluster.close()
+
+
+def test_forwarded_request_honors_propagated_deadline():
+    """X-Mmlspark-Deadline must cap the wait on the peer that parks the
+    forwarded request — nobody waits out the 15s reply_timeout."""
+    _reliability_sandbox()
+    cluster = ServingCluster(2, reply_timeout=15.0)
+    try:
+        wa, wb = cluster.workers
+        wa.enable_forwarding()   # no engine draining: parked until budget
+        req = urllib.request.Request(
+            wa.server.address, data=json.dumps({"q": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Mmlspark-Deadline": "0.5"})
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=12.0) as r:
+                status = r.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        elapsed = time.monotonic() - t0
+        assert status == 504
+        assert elapsed < 8.0, f"deadline not propagated ({elapsed:.1f}s)"
+    finally:
+        cluster.close()
+
+
+def test_chaos_faults_and_worker_restart_complete_every_request():
+    """Acceptance chaos drill: 200 requests over a 3-worker cluster while
+    the injector drops 30% of peer_http hops and worker-1 is killed and
+    re-registered mid-run. Every request must RESOLVE (200 normally, 504
+    for requests orphaned by the kill, 429 if shed) — zero client hangs —
+    and /metrics must show nonzero retry and breaker-transition counters."""
+    import re as _re
+    from mmlspark_tpu import observability as obs
+    from mmlspark_tpu.reliability import (RetryPolicy, breaker_for,
+                                          get_injector)
+    from mmlspark_tpu.serving.distributed import _http_json
+
+    _reliability_sandbox()
+    cluster = ServingCluster(3, reply_timeout=6.0)
+    stop = threading.Event()
+    injector = get_injector()
+    try:
+        # engine: drain everywhere, reply THROUGH a non-owner worker so
+        # every answer crosses a faultable peer_http hop; a hop the faults
+        # ate falls back to the cluster aggregate (open-worker routing)
+        def engine():
+            while not stop.is_set():
+                for owner, cached in cluster.get_batch(64, timeout=0.05):
+                    body = json.loads(cached.request.entity.content
+                                      if cached.request.entity else b"{}")
+                    resp = _json_resp({"n": body.get("n")})
+                    sender = next(
+                        (w for w in cluster.workers
+                         if w.worker_id != owner and not w.server.closed),
+                        None)
+                    ok = (sender.reply(owner, cached.request_id, resp)
+                          if sender is not None else False)
+                    if not ok:
+                        cluster.reply(owner, cached.request_id, resp)
+
+        eng = threading.Thread(target=engine, daemon=True)
+        eng.start()
+        injector.add("peer_http", "error", p=0.3, seed=42)
+
+        n_clients, per_client = 8, 25
+        results = [[None] * per_client for _ in range(n_clients)]
+        done = threading.Semaphore(0)
+
+        def client(tid):
+            for i in range(per_client):
+                target = cluster.workers[(tid + i) % len(cluster.workers)]
+                url = target.server.address
+                status = None
+                for _ in range(5):   # ride out the restart window
+                    try:
+                        status, _ = _post(url, {"n": tid * 100 + i},
+                                          timeout=20.0)
+                        break
+                    except urllib.error.HTTPError as e:
+                        status = e.code
+                        break
+                    except Exception:
+                        time.sleep(0.2)
+                        url = cluster.workers[
+                            (tid + i) % len(cluster.workers)].server.address
+                results[tid][i] = status
+                done.release()
+
+        threads = [threading.Thread(target=client, args=(tid,), daemon=True)
+                   for tid in range(n_clients)]
+        for t in threads:
+            t.start()
+        # kill worker-1 ungracefully mid-run and bring it back same-id
+        for _ in range(60):
+            done.acquire()
+        old_addr = cluster.worker("worker-1").advertised_address
+        cluster.restart_worker("worker-1")
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "client hang"
+
+        statuses = [s for row in results for s in row]
+        assert len(statuses) == n_clients * per_client
+        assert all(s in (200, 429, 504) for s in statuses), (
+            sorted({s for s in statuses if s not in (200, 429, 504)}))
+        assert statuses.count(200) >= 150, statuses.count(200)
+
+        # deterministically exercise the breaker against the dead
+        # incarnation's address (stale-route shape): refused dials push the
+        # sliding window past the failure ratio — it may already hold
+        # successes from replies routed there before the kill
+        injector.clear()
+        brk = breaker_for(old_addr)
+        one_shot = RetryPolicy(max_attempts=1)
+        for _ in range(25):
+            if brk.state == "open":
+                break
+            try:
+                _http_json(old_addr + "/_reply",
+                           {"request_id": "stale", "response": {}},
+                           timeout=1.0, retry=one_shot, breaker=brk)
+            except Exception:
+                pass
+        assert brk.state == "open"
+
+        snap = obs.snapshot()
+
+        def total(name):
+            return sum(s["value"]
+                       for s in snap.get(name, {}).get("series", []))
+
+        assert total("mmlspark_retry_attempts_total") > 0
+        assert total("mmlspark_faults_injected_total") > 0
+        assert total("mmlspark_breaker_transitions_total") > 0
+        # and the same series are visible on the wire at /metrics
+        live = next(w for w in cluster.workers if not w.server.closed)
+        with urllib.request.urlopen(live.server.address + "metrics",
+                                    timeout=5) as r:
+            text = r.read().decode()
+        for name in ("mmlspark_retry_attempts_total",
+                     "mmlspark_breaker_transitions_total"):
+            values = [float(m.group(1)) for m in _re.finditer(
+                _re.escape(name) + r"\{[^}]*\} ([0-9.e+-]+)", text)]
+            assert sum(values) > 0, f"{name} not on /metrics"
+    finally:
+        injector.clear()
+        stop.set()
+        cluster.close()

@@ -536,6 +536,150 @@ def test_tpu008_suppressible():
 
 
 # ---------------------------------------------------------------------------
+# TPU009 adhoc-resilience
+
+
+def test_tpu009_adhoc_retry_loop_fires():
+    findings, _ = run_fixture("""\
+        import time
+
+        def fetch(url):
+            for attempt in range(5):
+                try:
+                    return get(url)
+                except OSError:
+                    time.sleep(0.5 * attempt)
+        """, relpath="mmlspark_tpu/serving/mod.py")
+    assert "TPU009" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU009"]
+    assert "RetryPolicy" in f.message
+
+
+def test_tpu009_sleep_plus_continue_fires():
+    findings, _ = run_fixture("""\
+        import time
+
+        def poll(q):
+            while True:
+                if not q.ready():
+                    time.sleep(0.1)
+                    continue
+                return q.pop()
+        """, relpath="mmlspark_tpu/io/http/mod.py")
+    assert "TPU009" in codes(findings)
+
+
+def test_tpu009_swallowed_exception_fires():
+    findings, _ = run_fixture("""\
+        def heartbeat(self):
+            try:
+                self.ping()
+            except Exception:
+                pass
+        """, relpath="mmlspark_tpu/serving/mod.py")
+    assert "TPU009" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU009"]
+    assert "log_event" in f.message
+
+
+def test_tpu009_bare_except_pass_fires():
+    findings, _ = run_fixture("""\
+        def close(self):
+            try:
+                self.sock.close()
+            except:
+                pass
+        """, relpath="mmlspark_tpu/io/mod.py")
+    assert "TPU009" in codes(findings)
+
+
+def test_tpu009_quiet_on_typed_or_logged_except():
+    findings, _ = run_fixture("""\
+        import logging
+
+        def close(self):
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            try:
+                self.flush()
+            except Exception:
+                logging.warning("flush failed")
+        """, relpath="mmlspark_tpu/serving/mod.py")
+    assert "TPU009" not in codes(findings)
+
+
+def test_tpu009_quiet_on_event_wait_backoff_and_plain_loops():
+    # Event.wait-based backoff is interruptible (not time.sleep) and a
+    # sleep in a loop without catch/continue is just pacing, not retry
+    findings, _ = run_fixture("""\
+        import time
+
+        def run(self):
+            while not self._stop.is_set():
+                self.step()
+                self._stop.wait(0.5)
+
+        def pace(items):
+            for it in items:
+                emit(it)
+                time.sleep(0.01)
+        """, relpath="mmlspark_tpu/serving/mod.py")
+    assert "TPU009" not in codes(findings)
+
+
+def test_tpu009_sleep_in_nested_def_does_not_taint_loop():
+    findings, _ = run_fixture("""\
+        import time
+
+        def build(jobs):
+            for j in jobs:
+                def waiter():
+                    time.sleep(1.0)
+                try:
+                    j.submit(waiter)
+                except ValueError:
+                    record(j)
+        """, relpath="mmlspark_tpu/serving/mod.py")
+    assert "TPU009" not in codes(findings)
+
+
+def test_tpu009_scoped_to_serving_and_io():
+    src = """\
+        import time
+
+        def fetch(url):
+            for attempt in range(3):
+                try:
+                    return get(url)
+                except OSError:
+                    time.sleep(1)
+        """
+    findings, _ = run_fixture(src, relpath="mmlspark_tpu/ops/x.py")
+    assert "TPU009" not in codes(findings)
+    # the reliability package implements the primitives — exempt
+    findings, _ = run_fixture(src, relpath="mmlspark_tpu/reliability/policy.py")
+    assert "TPU009" not in codes(findings)
+
+
+def test_tpu009_suppressible():
+    findings, suppressed = run_fixture("""\
+        import time
+
+        def fetch(url):
+            # reference-parity ladder, semantics must not change
+            while True:  # tpulint: disable=TPU009
+                try:
+                    return get(url)
+                except OSError:
+                    time.sleep(1)
+        """, relpath="mmlspark_tpu/io/http/mod.py", keep_suppressed=True)
+    assert "TPU009" not in codes(findings)
+    assert "TPU009" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

@@ -588,3 +588,78 @@ class AdhocIdMinting(Rule):
                 "new_trace_id() / new_span_id() so the id joins the trace "
                 "context (routing table, journal, /debug/traces)"))
         return iter(findings)
+
+
+# TPU009 polices hand-rolled failure handling on the serving/io data
+# planes; the reliability package is the sanctioned home for retry loops
+# (and is outside both scopes anyway — listed for the doc, and as a guard
+# should io/ or serving/ ever absorb it)
+_RESILIENCE_SCOPES = ("mmlspark_tpu/serving/", "mmlspark_tpu/io/")
+_RESILIENCE_EXEMPT = "mmlspark_tpu/reliability/"
+
+
+def _loop_body_nodes(loop: ast.AST):
+    """Every node inside a loop's body, excluding nested function/lambda
+    bodies (their sleeps are not per-iteration work of this loop)."""
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register_rule
+class AdhocResilience(Rule):
+    code = "TPU009"
+    name = "adhoc-resilience"
+    severity = "warning"
+    doc = ("Hand-rolled failure handling on a serving/io path: a retry "
+           "loop (a loop that time.sleep()s and also catches or "
+           "continues past failures) outside mmlspark_tpu/reliability/, "
+           "or a broad `except: pass` that swallows a failure leaving no "
+           "metric or event behind. Route retries through "
+           "reliability.RetryPolicy (budgeted backoff + jitter + "
+           "mmlspark_retry_attempts_total) and surface swallowed "
+           "failures through observability.log_event; genuinely-benign "
+           "swallows and reference-parity retry ladders carry an inline "
+           "disable comment with the justification.")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if (not rel.startswith(_RESILIENCE_SCOPES)
+                or rel.startswith(_RESILIENCE_EXEMPT)):
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler):
+                broad = node.type is None or module.dotted(node.type) in (
+                    "Exception", "BaseException")
+                if broad and len(node.body) == 1 \
+                        and isinstance(node.body[0], ast.Pass):
+                    findings.append(self.finding(
+                        module, node,
+                        "broad except swallows the failure with `pass` — "
+                        "no metric, no event, no log; emit "
+                        "observability.log_event (or narrow the except) "
+                        "so the failure stays diagnosable"))
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                sleeps = catches = continues = False
+                for sub in _loop_body_nodes(node):
+                    if isinstance(sub, ast.Call) \
+                            and module.dotted(sub.func) == "time.sleep":
+                        sleeps = True
+                    elif isinstance(sub, ast.ExceptHandler):
+                        catches = True
+                    elif isinstance(sub, ast.Continue):
+                        continues = True
+                if sleeps and (catches or continues):
+                    findings.append(self.finding(
+                        module, node,
+                        "ad-hoc retry loop (sleep + catch/continue); use "
+                        "reliability.RetryPolicy — budgeted backoff with "
+                        "full jitter, deadline-aware, and counted in "
+                        "mmlspark_retry_attempts_total"))
+        return iter(findings)
