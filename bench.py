@@ -232,7 +232,7 @@ def main():
         "platform": "unknown", "platform_raw": None, "device": None,
         "mfu": None, "device_resident_ips": None, "device_mfu": None,
         "device_resident_ips_fused": None, "device_mfu_fused": None,
-        "h2d_gbps": None, "backend_probe": None,
+        "h2d_gbps": None, "backend_probe": None, "residency": None,
     }
     report = _OneShotReport(record)
     # registered once the model exists, so even a budget-truncated record
@@ -246,6 +246,35 @@ def main():
         from mmlspark_tpu.observability import snapshot
         return snapshot()
 
+    def _residency():
+        # data-plane residency scorecard: hit rate + transfer-op counts from
+        # the residency layer, staging-slab churn, and the h2d-overlap
+        # fraction (how much of coerce+pad host prep the prefetch worker hid
+        # from the dispatch thread; 1.0 = prep fully overlapped transfers)
+        try:
+            from mmlspark_tpu.core.residency import residency_stats
+            from mmlspark_tpu.models.runner import (M_SLAB_ALLOCS,
+                                                    M_SLAB_REUSE)
+            from mmlspark_tpu.ops.compile_cache import M_STAGE_SECONDS
+            stats = residency_stats()
+            allocs = M_SLAB_ALLOCS.labels().get()
+            reuses = M_SLAB_REUSE.labels().get()
+            issued = allocs + reuses
+            prep_s = (M_STAGE_SECONDS.labels(stage="coerce").get()
+                      + M_STAGE_SECONDS.labels(stage="pad").get())
+            wait_s = M_STAGE_SECONDS.labels(stage="prefetch_wait").get()
+            stats.update(
+                staging_slab_allocs=allocs,
+                staging_slab_reuses=reuses,
+                staging_slab_reuse_rate=(
+                    round(reuses / issued, 4) if issued else None),
+                h2d_overlap_fraction=(
+                    round(max(0.0, min(1.0, 1.0 - wait_s / prep_s)), 4)
+                    if prep_s > 0 else None))
+            return stats
+        except Exception:               # noqa: BLE001
+            return None
+
     def _watchdog():
         time.sleep(max(1.0, budget))
         record["budget_truncated"] = True
@@ -256,6 +285,7 @@ def main():
             for snap in counter_sources:
                 record["stage_counters"] = snap()
             record["telemetry"] = _telemetry()
+            record["residency"] = _residency()
         except Exception:                   # noqa: BLE001
             pass
         if report.emit():
@@ -346,6 +376,7 @@ def main():
             f"warmup failed: {type(e).__name__}: {e}"[:300]
         record["stage_counters"] = m.stage_counters.snapshot()
         record["telemetry"] = _telemetry()
+        record["residency"] = _residency()
         report.emit()
         return
 
@@ -563,6 +594,7 @@ def main():
                      if pass_ips else None),
         stage_counters=m.stage_counters.snapshot(),
         telemetry=_telemetry(),
+        residency=_residency(),
         wall_s=round(time.monotonic() - t_start, 2),
     )
     if midrun_error is not None:

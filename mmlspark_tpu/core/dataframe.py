@@ -14,6 +14,16 @@ Interop with pandas and pyarrow is provided for IO. Transformers operate on
 whole columns (vectorized) or via ``map_partitions`` when they need the
 per-partition device pinning the reference gets from Spark ``mapPartitions``
 (e.g. ``ONNXModel.scala:499-508``).
+
+Columns can also be **device-resident** (see :mod:`.residency`): a column
+staged with :meth:`DataFrame.device_put` lives on device across pipeline
+stages — ``filter``/``take``/``sort_values``/``repartition``/``head`` and
+partition traversal all stay on device, so a Transformer chain pays one h2d
+at ingest and one d2h at the sink instead of a round-trip per stage. A
+device-born column (a stage output attached via
+:meth:`DataFrame.with_device_column`) is represented on the host side by a
+lazy :class:`~.residency.HostMirror`; touching its data materializes it once,
+with the transfer counted in ``mmlspark_residency_*`` metrics.
 """
 
 from __future__ import annotations
@@ -25,6 +35,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 import numpy as np
 
 from ..observability.tracing import propagate as _propagate
+from .residency import DeviceColumn, HostMirror, is_device_array, record_hit
 
 __all__ = ["DataFrame", "concat", "object_col"]
 
@@ -63,6 +74,8 @@ def object_col(values) -> np.ndarray:
 def _as_column(values) -> np.ndarray:
     if isinstance(values, np.ndarray):
         return values
+    if isinstance(values, HostMirror):
+        return values  # lazy device-born facade; never list() a jax array
     if hasattr(values, "to_numpy"):
         return values.to_numpy()
     values = list(values)
@@ -79,11 +92,27 @@ class DataFrame:
 
     def __init__(self, columns: Dict[str, Union[np.ndarray, Sequence]],
                  npartitions: int = 1, metadata: Optional[Dict[str, dict]] = None,
-                 partition_sizes: Optional[Sequence[int]] = None):
+                 partition_sizes: Optional[Sequence[int]] = None,
+                 device_columns: Optional[Dict[str, DeviceColumn]] = None):
         self._columns: Dict[str, np.ndarray] = {}
         self._metadata: Dict[str, dict] = dict(metadata or {})
+        self._device: Dict[str, DeviceColumn] = {}
+        device_columns = dict(device_columns or {})
         n = None
         for name, col in columns.items():
+            if col is None and name in device_columns:
+                self._columns[name] = None  # placeholder: mirror comes below
+                continue
+            if isinstance(col, DeviceColumn):
+                device_columns.setdefault(name, col)
+                self._columns[name] = None  # placeholder keeps column order
+                continue
+            if is_device_array(col):
+                # a raw jax array is a device-born column, not host data —
+                # never round-trip it through list()/np.asarray
+                device_columns.setdefault(name, DeviceColumn.from_device([col]))
+                self._columns[name] = None
+                continue
             arr = _as_column(col)
             if n is None:
                 n = len(arr)
@@ -91,6 +120,21 @@ class DataFrame:
                 raise ValueError(
                     f"column {name!r} has {len(arr)} rows, expected {n}")
             self._columns[name] = arr
+        for name, dcol in device_columns.items():
+            if n is None:
+                n = dcol.nrows
+            elif dcol.nrows != n:
+                raise ValueError(
+                    f"device column {name!r} has {dcol.nrows} rows, "
+                    f"expected {n}")
+            self._device[name] = dcol
+            host = self._columns.get(name)
+            # keep a real host array (ingest-staged: host view is free) or an
+            # existing mirror of this very column (preserves its cache);
+            # otherwise install a fresh lazy mirror
+            if not (isinstance(host, np.ndarray)
+                    or (isinstance(host, HostMirror) and host.source is dcol)):
+                self._columns[name] = HostMirror(dcol)
         self._nrows = n if n is not None else 0
         # explicit (possibly uneven) partition sizes — e.g. parquet row
         # groups — override the equal-range split
@@ -142,9 +186,11 @@ class DataFrame:
 
     def to_pandas(self):
         import pandas as pd
-        # object and n-D tensor columns become per-row lists of arrays
+        # object and n-D tensor columns become per-row lists of arrays;
+        # self[k] materializes device-born columns (counted)
+        cols = {k: self[k] for k in self._columns}
         return pd.DataFrame({k: list(v) if (v.dtype == object or v.ndim > 1)
-                             else v for k, v in self._columns.items()})
+                             else v for k, v in cols.items()})
 
     def to_arrow(self):
         """Columnar handoff to pyarrow.
@@ -155,7 +201,8 @@ class DataFrame:
         import pyarrow as pa
 
         arrays, names = [], []
-        for name, col in self._columns.items():
+        for name in self._columns:
+            col = self[name]  # materializes device-born columns (counted)
             if col.dtype != object and col.ndim == 2:
                 flat = pa.array(np.ascontiguousarray(col).reshape(-1))
                 arrays.append(pa.FixedSizeListArray.from_arrays(
@@ -188,10 +235,84 @@ class DataFrame:
     def __getitem__(self, name: str) -> np.ndarray:
         if name not in self._columns:
             raise KeyError(f"no column {name!r}; have {self.columns}")
-        return self._columns[name]
+        col = self._columns[name]
+        if isinstance(col, HostMirror):
+            return col.materialize()  # counted d2h, once per mirror
+        return col
 
     def column(self, name: str) -> np.ndarray:
         return self[name]
+
+    # -- device residency ---------------------------------------------------
+    def device_put(self, names: Optional[Sequence[str]] = None,
+                   put=None) -> "DataFrame":
+        """Stage columns on device (idempotent — already-resident columns
+        count a residency *hit* and move no bytes; each newly staged column
+        is one counted ``site="ingest"`` h2d + one *miss*).
+
+        ``names=None`` stages every dense numeric column. ``put`` overrides
+        the transfer (e.g. a :class:`~..parallel.mesh.Placement` put).
+        """
+        if names is None:
+            names = [k for k, v in self._columns.items()
+                     if k in self._device
+                     or getattr(v, "dtype", None) != np.dtype(object)]
+        dev = dict(self._device)
+        for n in names:
+            if n in dev:
+                record_hit()
+                continue
+            arr = self[n]
+            dev[n] = DeviceColumn.from_host(arr, self.partition_bounds(),
+                                            put=put)
+        return DataFrame(self._columns, self._npartitions, self._metadata,
+                         partition_sizes=self._partition_sizes,
+                         device_columns=dev)
+
+    def with_device_column(self, name: str, dcol) -> "DataFrame":
+        """Attach a device-born column (a :class:`DeviceColumn` or a raw
+        ``jax.Array``) without any transfer; the host side becomes a lazy
+        mirror."""
+        if not isinstance(dcol, DeviceColumn):
+            dcol = DeviceColumn.from_device([dcol])
+        cols = {k: v for k, v in self._columns.items() if k != name}
+        cols[name] = HostMirror(dcol)
+        dev = {k: v for k, v in self._device.items() if k != name}
+        dev[name] = dcol
+        return DataFrame(cols, self._npartitions, self._metadata,
+                         partition_sizes=self._partition_sizes,
+                         device_columns=dev)
+
+    def device_column(self, name: str) -> DeviceColumn:
+        if name not in self._device:
+            raise KeyError(f"column {name!r} is not device-resident; "
+                           f"resident: {self.resident_columns}")
+        return self._device[name]
+
+    def is_resident(self, name: str) -> bool:
+        return name in self._device
+
+    @property
+    def resident_columns(self) -> List[str]:
+        return list(self._device)
+
+    def to_host(self, names: Optional[Sequence[str]] = None) -> "DataFrame":
+        """The sink: drop device residency, materializing device-born
+        columns in one counted ``site="sink"`` d2h each. Ingest-staged
+        columns still hold their host array, so their exit is free."""
+        names = list(self._device) if names is None else list(names)
+        cols = dict(self._columns)
+        dev = dict(self._device)
+        for n in names:
+            if n not in dev:
+                continue
+            dev.pop(n)
+            host = cols.get(n)
+            if isinstance(host, HostMirror):
+                cols[n] = host.fetch(site="sink")
+        return DataFrame(cols, self._npartitions, self._metadata,
+                         partition_sizes=self._partition_sizes,
+                         device_columns=dev)
 
     # -- column metadata (parity: Spark column Metadata / Categoricals) -----
     def column_metadata(self, name: str) -> dict:
@@ -201,7 +322,8 @@ class DataFrame:
         md = dict(self._metadata)
         md[name] = {**md.get(name, {}), **meta}
         return DataFrame(self._columns, self._npartitions, md,
-                         partition_sizes=self._partition_sizes)
+                         partition_sizes=self._partition_sizes,
+                         device_columns=self._device)
 
     def _meta_for(self, names) -> Dict[str, dict]:
         return {k: v for k, v in self._metadata.items() if k in names}
@@ -217,55 +339,85 @@ class DataFrame:
 
     # -- transformations (all return new DataFrames) ------------------------
     def with_column(self, name: str, values) -> "DataFrame":
+        if isinstance(values, DeviceColumn) or is_device_array(values):
+            return self.with_device_column(name, values)
         cols = dict(self._columns)
-        cols[name] = _as_column(values)
+        cols[name] = _as_column(values)  # host overwrite drops residency
+        dev = {k: v for k, v in self._device.items() if k != name}
         return DataFrame(cols, self._npartitions, self._metadata,
-                         partition_sizes=self._partition_sizes)
+                         partition_sizes=self._partition_sizes,
+                         device_columns=dev)
 
     def with_columns(self, new: Dict[str, Union[np.ndarray, Sequence]]) -> "DataFrame":
-        cols = dict(self._columns)
+        out = self
         for k, v in new.items():
-            cols[k] = _as_column(v)
-        return DataFrame(cols, self._npartitions, self._metadata,
-                         partition_sizes=self._partition_sizes)
+            out = out.with_column(k, v)
+        return out
 
     def select(self, names: Sequence[str]) -> "DataFrame":
-        return DataFrame({n: self[n] for n in names}, self._npartitions,
-                         self._meta_for(names),
-                         partition_sizes=self._partition_sizes)
+        return DataFrame({n: self._columns[n] for n in names},
+                         self._npartitions, self._meta_for(names),
+                         partition_sizes=self._partition_sizes,
+                         device_columns={n: self._device[n] for n in names
+                                         if n in self._device})
 
     def drop(self, *names: str) -> "DataFrame":
         keep = [k for k in self._columns if k not in names]
         return DataFrame({k: self._columns[k] for k in keep}, self._npartitions,
                          self._meta_for(keep),
-                         partition_sizes=self._partition_sizes)
+                         partition_sizes=self._partition_sizes,
+                         device_columns={k: self._device[k] for k in keep
+                                         if k in self._device})
 
     def rename(self, mapping: Dict[str, str]) -> "DataFrame":
         md = {mapping.get(k, k): v for k, v in self._metadata.items()}
         return DataFrame({mapping.get(k, k): v for k, v in self._columns.items()},
                          self._npartitions, md,
-                         partition_sizes=self._partition_sizes)
+                         partition_sizes=self._partition_sizes,
+                         device_columns={mapping.get(k, k): v
+                                         for k, v in self._device.items()})
+
+    def _gather(self, host_op, device_op, npartitions=None) -> "DataFrame":
+        """Shared row-gather: resident columns gather on device (no
+        round-trip), host columns on host."""
+        cols, dev = {}, {}
+        for k, v in self._columns.items():
+            if k in self._device:
+                dev[k] = device_op(self._device[k])
+                cols[k] = None
+            else:
+                cols[k] = host_op(v)
+        return DataFrame(cols, npartitions or self._npartitions,
+                         self._metadata, device_columns=dev)
 
     def filter(self, mask: np.ndarray) -> "DataFrame":
         mask = np.asarray(mask)
         if mask.dtype != bool:
             raise TypeError("filter expects a boolean mask")
-        return DataFrame({k: v[mask] for k, v in self._columns.items()},
-                         self._npartitions, self._metadata)
+        return self._gather(lambda v: v[mask], lambda d: d.compress(mask))
 
     def take(self, indices) -> "DataFrame":
         idx = np.asarray(indices)
-        return DataFrame({k: v[idx] for k, v in self._columns.items()},
-                         self._npartitions, self._metadata)
+        return self._gather(lambda v: v[idx], lambda d: d.take(idx))
 
     def head(self, n: int) -> "DataFrame":
-        return DataFrame({k: v[:n] for k, v in self._columns.items()}, 1, self._metadata)
+        return self._gather(lambda v: v[:n], lambda d: d.slice_rows(0, n),
+                            npartitions=1)
 
     def repartition(self, npartitions: int) -> "DataFrame":
-        return DataFrame(self._columns, npartitions, self._metadata)
+        # DeviceColumn chunking is alignment-agnostic: residency rides along
+        return DataFrame(self._columns, npartitions, self._metadata,
+                         device_columns=self._device)
 
     def sort_values(self, by: str, ascending: bool = True) -> "DataFrame":
-        order = np.argsort(self[by], kind="stable")
+        if by in self._device:
+            # argsort on device: only the index vector crosses the bus,
+            # never the key column's payload
+            order = np.asarray(self._device[by].device_array().argsort())
+            if order.ndim > 1:  # tensor column: sort by first component
+                order = order[:, 0]
+        else:
+            order = np.argsort(self[by], kind="stable")
         if not ascending:
             order = order[::-1]
         return self.take(order)
@@ -302,8 +454,16 @@ class DataFrame:
 
     def partitions(self) -> Iterator["DataFrame"]:
         for lo, hi in self.partition_bounds():
-            yield DataFrame({k: v[lo:hi] for k, v in self._columns.items()}, 1,
-                            self._metadata)
+            cols, dev = {}, {}
+            for k, v in self._columns.items():
+                if k in self._device:
+                    # slice on device; chunks covered exactly are shared, so
+                    # per-partition views cost no transfer and no LRU churn
+                    dev[k] = self._device[k].slice_rows(lo, hi)
+                    cols[k] = None
+                else:
+                    cols[k] = v[lo:hi]
+            yield DataFrame(cols, 1, self._metadata, device_columns=dev)
 
     def map_partitions(self, fn: Callable[["DataFrame", int], "DataFrame"],
                        max_workers: Optional[int] = None) -> "DataFrame":
@@ -349,7 +509,8 @@ class DataFrame:
         # splits (parquet row groups) survive a map_partitions round
         if len(results) > 1:
             out = DataFrame(dict(out._columns), metadata=out._metadata,
-                            partition_sizes=[len(r) for r in results])
+                            partition_sizes=[len(r) for r in results],
+                            device_columns=out._device)
         return out
 
     # -- row view (for HTTP/serving paths that are row-oriented) ------------
@@ -375,11 +536,23 @@ def concat(dfs: Sequence[DataFrame], npartitions: Optional[int] = None) -> DataF
     for d in dfs[1:]:
         if d.columns != names:
             raise ValueError(f"column mismatch in concat: {names} vs {d.columns}")
-    cols = {}
+    cols, dev = {}, {}
     for n in names:
-        # np.concatenate promotes mixed parts to object dtype on its own
+        if all(d.is_resident(n) for d in dfs):
+            # resident everywhere: stitch the chunk lists, zero transfers
+            dev[n] = DeviceColumn.concatenate([d._device[n] for d in dfs])
+            hosts = [d._columns[n] for d in dfs]
+            if all(isinstance(h, np.ndarray) for h in hosts):
+                cols[n] = np.concatenate(hosts)  # host views are free
+            else:
+                cols[n] = None  # lazy mirror of the combined column
+            continue
+        # np.concatenate promotes mixed parts to object dtype on its own;
+        # d[n] materializes any mirrors (counted) — concat off-device is a
+        # genuine host exit for device-born parts
         cols[n] = np.concatenate([d[n] for d in dfs])
     md = {}
     for d in dfs:
         md.update(d._metadata)
-    return DataFrame(cols, npartitions or dfs[0].npartitions, md)
+    return DataFrame(cols, npartitions or dfs[0].npartitions, md,
+                     device_columns=dev)

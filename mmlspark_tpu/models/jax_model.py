@@ -31,7 +31,7 @@ from ..core.params import ComplexParam, Param
 from ..core.pipeline import Model
 from ..ops.compile_cache import StageCounters, warm_up_model
 from ..parallel.mesh import feed_placement
-from .runner import BatchRunner
+from .runner import BatchRunner, StagingSlabPool
 
 __all__ = ["JaxModel"]
 
@@ -79,6 +79,7 @@ class JaxModel(Model):
         self._device_params: Dict[Optional[int], object] = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
+        self._staging = StagingSlabPool()
 
     @property
     def stage_counters(self) -> StageCounters:
@@ -180,15 +181,26 @@ class JaxModel(Model):
         feed = dict(self.feed_dict) or {"input": part.columns[0]}
         placement, params = self._placement_params(pidx)
 
+        # resident input columns feed device slices (no host coercion,
+        # zero h2d payload; BatchRunner counts the residency hits)
+        resident = {col_name: part.device_column(col_name).device_array()
+                    for col_name in feed.values()
+                    if part.is_resident(col_name)}
+
         def coerce(sl: slice) -> Dict[str, np.ndarray]:
-            return {feed_name: self._coerce_col(part[col_name][sl])
-                    for feed_name, col_name in feed.items()}
+            out = {}
+            for feed_name, col_name in feed.items():
+                dev = resident.get(col_name)
+                out[feed_name] = dev[sl] if dev is not None \
+                    else self._coerce_col(part[col_name][sl])
+            return out
 
         runner = BatchRunner(jitted, params, coerce, placement.put,
                              shards=placement.shards,
                              mini_batch_size=self.mini_batch_size,
                              prefetch_depth=self.prefetch_depth,
-                             counters=self._counters)
+                             counters=self._counters,
+                             staging=self._staging)
         pending = runner.run_and_drain(len(part))
 
         if not pending:
@@ -232,3 +244,4 @@ class JaxModel(Model):
         self._device_params = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
+        self._staging = StagingSlabPool()

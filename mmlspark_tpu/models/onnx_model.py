@@ -31,8 +31,9 @@ from ..core.pipeline import Model
 from ..onnx.convert import ConvertedModel, convert_model
 from ..ops.compile_cache import (StageCounters, resolve_input_specs,
                                  warm_up_model)
+from ..core.residency import DeviceColumn
 from ..parallel.mesh import feed_placement, local_devices
-from .runner import BatchRunner
+from .runner import BatchRunner, StagingSlabPool
 
 __all__ = ["ONNXModel"]
 
@@ -88,6 +89,13 @@ class ONNXModel(Model):
                                "dispatches; bounds host memory at that many "
                                "padded batches. 0 = prepare inline on the "
                                "dispatch thread")
+    output_device = Param(bool, default=False,
+                          doc="keep fetch outputs device-resident (attached "
+                              "as DeviceColumns, no drain) so a downstream "
+                              "device stage or sink pays the single d2h; "
+                              "outputs keep their device dtypes (bf16 stays "
+                              "bf16, argmax stays int32) until "
+                              "DataFrame.to_host materializes them")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -102,6 +110,7 @@ class ONNXModel(Model):
         self._device_params: Dict[Optional[int], dict] = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
+        self._staging = StagingSlabPool()
 
     @property
     def stage_counters(self) -> StageCounters:
@@ -239,6 +248,25 @@ class ONNXModel(Model):
         if device_prepped:
             return arr  # layout handled on device; shape is not NCHW yet
         # reshape flat rows to the model's per-row shape if one is declared
+        row_shape = [d for d in shape[1:] if isinstance(d, int)]
+        if row_shape and list(arr.shape[1:]) != row_shape \
+                and int(np.prod(arr.shape[1:])) == int(np.prod(row_shape)):
+            arr = arr.reshape((arr.shape[0],) + tuple(row_shape))
+        return arr
+
+    def _coerce_device(self, arr, dtype, shape,
+                       device_prepped: bool = False):
+        """:meth:`_coerce` for an already-resident (device) column slice —
+        same dtype/shape policy, but every op is a device op so the column
+        never round-trips through host."""
+        want = np.dtype(dtype)
+        if want.kind == "f":
+            if arr.dtype == jnp.float64:
+                arr = arr.astype(jnp.float32)
+        elif arr.dtype != want:
+            arr = arr.astype(want)
+        if device_prepped:
+            return arr
         row_shape = [d for d in shape[1:] if isinstance(d, int)]
         if row_shape and list(arr.shape[1:]) != row_shape \
                 and int(np.prod(arr.shape[1:])) == int(np.prod(row_shape)):
@@ -387,18 +415,48 @@ class ONNXModel(Model):
         in_meta = {vi.name: vi for vi in cm.inputs}
         placement, params = self._placement_params(pidx)
 
+        # resident input columns feed device slices straight through —
+        # no host coercion, no padding slab, zero h2d payload (BatchRunner
+        # counts the residency hits); one concat per partition, then every
+        # batch slice is a cheap device view
+        resident = {col_name: part.device_column(col_name).device_array()
+                    for col_name in feed.values()
+                    if part.is_resident(col_name)}
+
         def coerce(sl: slice) -> Dict[str, np.ndarray]:
-            return {input_name: self._coerce(
-                        part[col_name][sl], in_meta[input_name].numpy_dtype,
-                        in_meta[input_name].shape,
-                        device_prepped=input_name in self.transpose_dict)
-                    for input_name, col_name in feed.items()}
+            out = {}
+            for input_name, col_name in feed.items():
+                meta = in_meta[input_name]
+                prepped = input_name in self.transpose_dict
+                dev = resident.get(col_name)
+                if dev is not None:
+                    out[input_name] = self._coerce_device(
+                        dev[sl], meta.numpy_dtype, meta.shape,
+                        device_prepped=prepped)
+                else:
+                    out[input_name] = self._coerce(
+                        part[col_name][sl], meta.numpy_dtype, meta.shape,
+                        device_prepped=prepped)
+            return out
 
         runner = BatchRunner(jitted, params, coerce, placement.put,
                              shards=placement.shards,
                              mini_batch_size=self.mini_batch_size,
                              prefetch_depth=self.prefetch_depth,
-                             counters=self._counters)
+                             counters=self._counters,
+                             staging=self._staging)
+        if self.output_device:
+            # keep outputs resident: no drain — the sink (DataFrame.to_host
+            # or a downstream device stage) decides when to cross back
+            pending = runner.run(len(part))
+            out = part
+            for col_name in self._out_col_names:
+                chunks = [outs[col_name][:b] for outs, b in pending if b]
+                if not chunks:
+                    chunks = [jnp.zeros((0,), dtype=jnp.float32)]
+                out = out.with_device_column(
+                    col_name, DeviceColumn.from_device(chunks))
+            return out
         pending = runner.run_and_drain(len(part))
 
         out = part
@@ -472,6 +530,7 @@ class ONNXModel(Model):
         self._device_params = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
+        self._staging = StagingSlabPool()
 
 
 def _host_softmax(col: np.ndarray) -> np.ndarray:

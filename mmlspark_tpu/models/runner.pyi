@@ -6,6 +6,15 @@ import numpy as np
 
 from mmlspark_tpu.ops.compile_cache import StageCounters
 
+class StagingSlabPool:
+    depth: int
+    allocs: int
+    reuses: int
+    def __init__(self, depth: int = ...) -> None: ...
+    def acquire(self, shape: Any, dtype: Any) -> np.ndarray: ...
+    def release(self, arr: Any) -> bool: ...
+    def stats(self) -> Dict[str, float]: ...
+
 class BatchRunner:
     jitted: Any
     params: Any
@@ -15,11 +24,13 @@ class BatchRunner:
     mini_batch_size: int
     prefetch_depth: int
     counters: StageCounters
+    staging: Optional[StagingSlabPool]
     def __init__(self, jitted: Any, params: Any,
                  coerce: Callable[[slice], Dict[str, np.ndarray]],
                  put: Callable[..., Any], shards: int = ...,
                  mini_batch_size: int = ..., prefetch_depth: int = ...,
-                 counters: Optional[StageCounters] = ...) -> None: ...
+                 counters: Optional[StageCounters] = ...,
+                 staging: Optional[StagingSlabPool] = ...) -> None: ...
     def run(self, n_rows: int) -> List[Tuple[dict, int]]: ...
     def drain(self, pending: List[Tuple[dict, int]]
               ) -> List[Tuple[Dict[str, np.ndarray], int]]: ...

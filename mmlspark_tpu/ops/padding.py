@@ -71,6 +71,21 @@ def pad_axis(arr: np.ndarray, size: int, axis: int = 0,
     return np.pad(arr, widths, mode="constant", constant_values=fill)
 
 
+def pad_axis_device(arr, size: int, axis: int = 0, fill=0):
+    """``pad_axis`` for a device array: pads with ``jnp.pad`` so a
+    device-resident feed reaches its shape bucket *without* a host
+    round-trip (the device-feed path of ``BatchRunner``)."""
+    cur = arr.shape[axis]
+    if cur == size:
+        return arr
+    if cur > size:
+        raise ValueError(f"array dim {cur} exceeds pad target {size}")
+    import jax.numpy as jnp
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, size - cur)
+    return jnp.pad(arr, widths, mode="constant", constant_values=fill)
+
+
 def _coerce_host(v) -> np.ndarray:
     """Host coercion with the same dtype policy as the model feed paths:
     a Python float payload lands as float64, which TPUs have no ALU for —

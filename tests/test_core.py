@@ -141,6 +141,21 @@ class TestDataFrame:
         assert S.get_categorical_levels(df.rename({"x": "w"}), "w") == ["lo", "hi"]
         assert not S.is_categorical(df, "y")
 
+    def test_metadata_survives_row_ops(self):
+        # regression: the row-reshaping ops rebuild the frame — each must
+        # carry column_metadata through, not silently drop it
+        df = DataFrame({"x": [3, 1, 2], "y": [6, 4, 5]}, npartitions=2)
+        df = S.set_categorical_metadata(df, "x", ["lo", "hi"])
+        outs = {
+            "filter": df.filter(np.array([True, False, True])),
+            "take": df.take([0, 2]),
+            "sort_values": df.sort_values("x"),
+            "repartition": df.repartition(3),
+            "head": df.head(2),
+        }
+        for op, out in outs.items():
+            assert S.get_categorical_levels(out, "x") == ["lo", "hi"], op
+
     def test_unused_column_name(self):
         df = DataFrame({"x": [1], "x_1": [2]})
         assert S.find_unused_column_name("x", df) == "x_2"

@@ -613,7 +613,8 @@ def _all_stage_classes():
 
 def _is_abstract_base(cls) -> bool:
     name = cls.__qualname__
-    if name.startswith("_") or name in ("Transformer", "Estimator", "Model"):
+    if name.startswith("_") or name in ("Transformer", "DeviceTransformer",
+                                        "Estimator", "Model"):
         return True
     # family bases that subclasses specialize
     if any(c.__qualname__ == name for c in ()):  # placeholder

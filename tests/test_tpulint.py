@@ -680,6 +680,82 @@ def test_tpu009_suppressible():
 
 
 # ---------------------------------------------------------------------------
+# TPU010 host-roundtrip
+
+
+def test_tpu010_asarray_on_sliced_input_in_transform_fires():
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        class MyStage(Transformer):
+            def _transform(self, df):
+                x = np.asarray(df["x"][0:4])
+                return df.with_column("y", x * 2)
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU010"]
+    assert f.severity == "warning" and f.line == 5
+
+
+def test_tpu010_device_get_in_nested_closure_fires():
+    # the per-batch closures a _transform builds ARE the hot path
+    findings, _ = run_fixture("""\
+        import jax
+
+        class MyModel(core.pipeline.Model):
+            def _transform(self, df):
+                def coerce(sl):
+                    return jax.device_get(df["x"][sl])
+                return self._run(coerce)
+        """)
+    assert "TPU010" in codes(findings)
+
+
+def test_tpu010_quiet_outside_stage_hot_paths():
+    # not a stage class: quiet
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        class Helper:
+            def _transform(self, df):
+                return np.asarray(df["x"][0:4])
+        """)
+    assert "TPU010" not in codes(findings)
+    # a stage class, but not a transform method: quiet
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        class MyStage(Transformer):
+            def _fit(self, df):
+                return np.asarray(df["x"][0:4])
+        """)
+    assert "TPU010" not in codes(findings)
+    # unsubscripted arg (whole-object coercion, not a sliced input): quiet
+    findings, _ = run_fixture("""\
+        import numpy as np
+
+        class MyStage(Transformer):
+            def _transform(self, df):
+                return np.asarray(meta_vector)
+        """)
+    assert "TPU010" not in codes(findings)
+
+
+def test_tpu010_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import numpy as np
+
+        class MyStage(Transformer):
+            def _transform(self, df):
+                # label-table lookup: host-only metadata, never resident
+                # tpulint: disable=TPU010
+                idx = np.asarray([t[v] for v in df["y"][:]])
+                return df.with_column("i", idx)
+        """, keep_suppressed=True)
+    assert "TPU010" not in codes(findings)
+    assert "TPU010" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

@@ -663,3 +663,71 @@ class AdhocResilience(Rule):
                         "full jitter, deadline-aware, and counted in "
                         "mmlspark_retry_attempts_total"))
         return iter(findings)
+
+
+#: host materialization calls TPU010 polices inside stage hot paths
+_HOST_ROUNDTRIP_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_STAGE_BASE_RE = re.compile(r"(Transformer|Model)$")
+_STAGE_METHODS = {"transform", "_transform"}
+
+
+@register_rule
+class HostRoundtrip(Rule):
+    code = "TPU010"
+    name = "host-roundtrip"
+    severity = "warning"
+    doc = ("``np.asarray``/``np.array``/``jax.device_get`` applied to a "
+           "subscripted stage input inside a pipeline stage's "
+           "``transform``/``_transform`` hot path. On a device-resident "
+           "column that call silently materializes the data on host — the "
+           "per-stage d2h+h2d round-trip the residency layer exists to "
+           "eliminate (one h2d at ingest, one d2h at the sink). Keep the "
+           "slice on device: feed ``device_column(...).device_array()`` "
+           "views (see BatchRunner's device-feed path) and defer host "
+           "materialization to ``DataFrame.to_host``. Genuinely host-only "
+           "sites (metadata vectors, index arrays) carry an inline "
+           "disable comment with the justification.")
+
+    def check(self, module: ModuleInfo):
+        findings: List[Finding] = []
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(_STAGE_BASE_RE.search(_terminal_name(b) or "")
+                       for b in cls.bases):
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name in _STAGE_METHODS:
+                    # ast.walk(fn) covers nested defs too: the per-batch
+                    # closures these methods build ARE the hot path
+                    self._scan(module, cls, fn, findings)
+        return iter(findings)
+
+    def _scan(self, module: ModuleInfo, cls: ast.ClassDef, fn,
+              findings: List[Finding]) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.dotted(node.func)
+            if name not in _HOST_ROUNDTRIP_CALLS:
+                continue
+            subscripted = any(isinstance(sub, ast.Subscript)
+                              for arg in node.args
+                              for sub in ast.walk(arg))
+            if not subscripted:
+                continue
+            findings.append(self.finding(
+                module, node,
+                f"'{cls.name}.{fn.name}' materializes a sliced stage "
+                f"input on host via {name}(...) — a per-stage round-trip "
+                f"for resident columns; slice the device column instead "
+                f"and let DataFrame.to_host pay the one sink transfer"))
+
+
+def _terminal_name(base: ast.AST) -> Optional[str]:
+    """Rightmost identifier of a base-class expression (``core.pipeline.
+    Transformer`` → ``Transformer``)."""
+    while isinstance(base, ast.Attribute):
+        return base.attr
+    return base.id if isinstance(base, ast.Name) else None
