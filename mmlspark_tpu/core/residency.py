@@ -82,6 +82,9 @@ M_RESIDENT = _gauge("mmlspark_residency_resident_bytes",
                     "bytes currently resident on device under the manager")
 M_RESIDENT_CHUNKS = _gauge("mmlspark_residency_resident_chunks",
                            "partition chunks currently resident on device")
+M_RESERVED = _gauge("mmlspark_residency_reserved_bytes",
+                    "bytes pinned by fixed reservations (e.g. paged KV "
+                    "pools) — counted against the budget, never spilled")
 
 
 def is_device_array(value) -> bool:
@@ -155,6 +158,8 @@ class ResidencyManager:
         self._lru: "OrderedDict[int, object]" = OrderedDict()  # id -> weakref
         self._accounted: Dict[int, int] = {}                   # id -> bytes
         self._resident_bytes = 0
+        self._reservations: Dict[int, Tuple[int, str]] = {}    # token -> (bytes, label)
+        self._next_reservation = 0
 
     # -- bookkeeping --------------------------------------------------------
     def _publish(self) -> None:
@@ -186,6 +191,37 @@ class ResidencyManager:
             key = id(chunk)
             if key in self._lru:
                 self._lru.move_to_end(key)
+
+    # -- fixed reservations --------------------------------------------------
+    def reserve(self, nbytes: int, label: str = "reserved") -> int:
+        """Pin ``nbytes`` of device memory against the budget without a
+        spillable chunk behind it — engine state (a paged KV pool's page
+        buffers, a slot pool) that must never be evicted but must still
+        push LRU *columns* out so the total stays under budget. Returns a
+        token for :meth:`release`."""
+        nbytes = int(nbytes)
+        if nbytes < 0:
+            raise ValueError("reserve() needs nbytes >= 0")
+        with self._lock:
+            token = self._next_reservation
+            self._next_reservation += 1
+            self._reservations[token] = (nbytes, str(label))
+            self._resident_bytes += nbytes
+            M_RESERVED.set(self.reserved_bytes())
+            self._evict_over_budget()
+            self._publish()
+        return token
+
+    def release(self, token: int) -> None:
+        """Drop a :meth:`reserve` pin (idempotent for unknown tokens)."""
+        with self._lock:
+            nbytes, _ = self._reservations.pop(token, (0, ""))
+            self._resident_bytes -= nbytes
+            M_RESERVED.set(self.reserved_bytes())
+            self._publish()
+
+    def reserved_bytes(self) -> int:
+        return sum(n for n, _ in self._reservations.values())
 
     def _evict_over_budget(self, exclude: Optional[int] = None) -> None:
         if self.budget_bytes <= 0:
@@ -250,6 +286,7 @@ class ResidencyManager:
         with self._lock:
             return {"resident_bytes": self._resident_bytes,
                     "resident_chunks": len(self._lru),
+                    "reserved_bytes": self.reserved_bytes(),
                     "budget_bytes": self.budget_bytes}
 
 

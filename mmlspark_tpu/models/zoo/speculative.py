@@ -42,11 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .transformer import (TransformerConfig, _warp_scaled_rows,
-                          decode_step, decode_window, init_kv_cache,
-                          prefill_cache)
+                          decode_step, decode_window, decode_window_paged,
+                          init_kv_cache, init_paged_cache,
+                          paged_scatter_rows, prefill_cache)
 
 __all__ = ["generate_speculative", "generate_speculative_fused",
-           "generate_speculative_sampled"]
+           "generate_speculative_paged", "generate_speculative_sampled"]
 
 
 def generate_speculative_sampled(t_params: Dict, d_params: Dict,
@@ -452,6 +453,122 @@ def generate_speculative(t_params: Dict, d_params: Dict,
         # k == gamma: the draft never consumed d_gamma (it only proposed
         # it), so its cache misses position m+gamma — hand it back as the
         # next round's tail
+        tail = drafts[:, gamma - 1:gamma] if k == gamma \
+            else jnp.zeros((B, 0), prompt_ids.dtype)
+        pending = bonus
+        m = m + k + 1
+
+    new = np.concatenate(out, axis=1)
+    ids[:, P:] = new[:, :max_new_tokens]
+    return jnp.asarray(ids), stats
+
+
+def generate_speculative_paged(t_params: Dict, d_params: Dict,
+                               prompt_ids, t_cfg: TransformerConfig,
+                               d_cfg: TransformerConfig,
+                               max_new_tokens: int = 32,
+                               gamma: int = 4,
+                               page_size: int = 16) -> Tuple[jnp.ndarray,
+                                                             dict]:
+    """:func:`generate_speculative` with the TARGET cache held in a paged
+    pool — the reference loop for the paged verify path the continuous
+    decoder runs, and the parity oracle ``tests/test_kv_pool.py`` checks.
+
+    Each row owns a dense range of physical pages (block table row b maps
+    logical page j to ``1 + b*n + j``; page 0 is the trash page), prefill
+    output is scattered into the pool through the table, and every verify
+    window runs :func:`transformer.decode_window_paged` at the full
+    logical length — which delegates to the same ragged window math as
+    :func:`transformer.decode_window`, so output is token-for-token
+    IDENTICAL to :func:`generate_speculative` (and hence to greedy
+    target-only decoding). The draft cache stays contiguous: it is small,
+    never shared, and paging it buys nothing.
+    """
+    if t_cfg.vocab != d_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    if page_size < 1:
+        raise ValueError("page_size must be >= 1")
+    t_params = jax.tree.map(jnp.asarray, t_params)
+    d_params = jax.tree.map(jnp.asarray, d_params)
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, P = prompt_ids.shape
+    L = P + max_new_tokens + gamma + 1          # slack: windows overshoot
+    n_pages_row = -(-L // page_size)
+    # dense per-row page ranges: no fragmentation to manage here, the
+    # point is exercising the gather/scatter path, not the allocator
+    bt = (1 + np.arange(B)[:, None] * n_pages_row
+          + np.arange(n_pages_row)[None, :]).astype(np.int32)
+    bt = jnp.asarray(bt)
+    t_pages = init_paged_cache(t_cfg, 1 + B * n_pages_row, page_size)
+    d_cache = init_kv_cache(d_cfg, B, L)
+    lengths = jnp.full((B,), P, jnp.int32)
+
+    @jax.jit
+    def draft_propose(tail, pending, pos, cache):
+        for i in range(tail.shape[1]):
+            _, cache = decode_step(d_params, tail[:, i], pos + i, cache,
+                                   d_cfg)
+        start = pos + tail.shape[1]
+
+        def step(carry, _):
+            tok, p, cache = carry
+            logits, cache = decode_step(d_params, tok, p, cache, d_cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+            return (nxt, p + 1, cache), nxt
+
+        (_, _, cache), drafts = jax.lax.scan(
+            step, (pending, start, cache), None, length=gamma)
+        return jnp.moveaxis(drafts, 0, 1), cache       # (B, gamma)
+
+    @jax.jit
+    def verify(wtoks, pos, pages):
+        logits, pages = decode_window_paged(
+            t_params, wtoks, jnp.full((B,), pos, jnp.int32), pages, bt,
+            t_cfg, page_size=page_size, length=L)
+        greedy = jnp.argmax(logits, axis=-1)           # (B, gamma+1)
+        match = greedy[:, :-1] == wtoks[:, 1:].astype(greedy.dtype)
+        accept = jnp.min(jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), axis=-1), axis=-1))
+        return greedy, accept, pages
+
+    @jax.jit
+    def scatter_prefill(pages, rows):
+        return paged_scatter_rows(pages, rows, bt, page_size)
+
+    t_logits, t_rows = prefill_cache(t_params, prompt_ids, lengths,
+                                     t_cfg, L)
+    t_pages = scatter_prefill(t_pages, t_rows)
+    _, d_cache = prefill_cache(d_params, prompt_ids, lengths, d_cfg, L)
+    pending = jnp.argmax(t_logits, axis=-1).astype(prompt_ids.dtype)
+
+    ids = np.zeros((B, P + max_new_tokens), np.asarray(prompt_ids).dtype)
+    ids[:, :P] = np.asarray(prompt_ids)
+    out = [np.asarray(pending)[:, None]]
+    emitted = 1
+    m = P
+    tail = jnp.zeros((B, 0), prompt_ids.dtype)
+    stats = {"target_forwards": 1, "draft_steps": 0, "accepted_drafts": 0,
+             "rounds": 0, "pages_per_row": n_pages_row,
+             "page_size": page_size}
+
+    while emitted < max_new_tokens:
+        drafts, d_cache = draft_propose(tail, pending, m - tail.shape[1],
+                                        d_cache)
+        stats["draft_steps"] += gamma
+        wtoks = jnp.concatenate([pending[:, None], drafts], axis=1)
+        greedy, accept, t_pages = verify(wtoks, m, t_pages)
+        stats["target_forwards"] += 1
+        stats["rounds"] += 1
+        k = min(int(accept), max_new_tokens - emitted - 1)
+        stats["accepted_drafts"] += k
+        if k > 0:
+            out.append(np.asarray(drafts[:, :k]))
+            emitted += k
+        bonus = greedy[:, k].astype(prompt_ids.dtype)
+        out.append(np.asarray(bonus)[:, None])
+        emitted += 1
         tail = drafts[:, gamma - 1:gamma] if k == gamma \
             else jnp.zeros((B, 0), prompt_ids.dtype)
         pending = bonus

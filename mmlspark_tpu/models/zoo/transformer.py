@@ -28,7 +28,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["TransformerConfig", "init_transformer", "transformer_apply",
            "train_step", "param_shardings", "BERT_BASE", "BERT_MINI",
            "DECODER_MINI", "generate", "generate_cached",
-           "decode_step", "init_kv_cache", "decode_window_ragged"]
+           "decode_step", "init_kv_cache", "decode_window_ragged",
+           "init_paged_cache", "paged_gather", "paged_scatter_rows",
+           "decode_step_paged", "decode_window_paged"]
 
 
 class TransformerConfig(NamedTuple):
@@ -694,6 +696,145 @@ def decode_window_ragged(params: Dict, tokens: jnp.ndarray,
     hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
     logits = hidden.astype(jnp.float32) @ params["lm_head"]["w"]
     return logits, new_cache
+
+
+# ---- paged KV cache (vLLM-style PagedAttention, XLA-level) -----------------
+# The physical cache is a pool of fixed-size PAGES — per layer a
+# (num_pages, H, page_size, hd) buffer pair — and each batch row owns a
+# BLOCK TABLE row mapping its logical pages to physical ones. A decode/
+# window step gathers the row's pages into the familiar contiguous
+# (B, H, L, hd) layout, runs the EXACT ragged-step math on it (reusing
+# decode_step_ragged / decode_window_ragged — the paged path is bitwise
+# equal to the contiguous path by construction: post-mask scores are
+# identical and masked lanes contribute exactly 0 to the f32 softmax),
+# and scatters only the freshly-written positions back into their pages.
+# Physical page 0 is reserved as the TRASH page: block-table entries for
+# unallocated logical pages point at it, and inactive rows' writebacks
+# are redirected there, so a retired slot can never corrupt pages that
+# were freed and handed to another request.
+#
+# Gathering costs one O(B·L) copy per step — the price of page-granular
+# allocation and cross-request prefix sharing (serving/kv_pool.py); a
+# fused Pallas paged-attention kernel that reads pages in place is the
+# follow-up once the scheduler-level win is banked.
+
+def init_paged_cache(cfg: TransformerConfig, num_pages: int,
+                     page_size: int):
+    """Per-layer (num_pages, H, page_size, hd) k/v page pools (page 0 is
+    the trash page — allocators must never hand it out)."""
+    hd = cfg.d_model // cfg.heads
+    shape = (num_pages, cfg.heads, page_size, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.layers)]
+
+
+def paged_gather(cache_pages, block_tables, length: int):
+    """Assemble each row's pages into contiguous (B, H, length, hd) k/v.
+
+    ``block_tables`` (B, P) int32 physical page ids per logical page;
+    ``length`` trims the last page's tail so the result has EXACTLY the
+    contiguous cache's key length — attention reductions then run over
+    the same number of lanes, which is what keeps the paged step bitwise
+    equal to the contiguous one."""
+    out = []
+    for c in cache_pages:
+        row = {}
+        for kk in ("k", "v"):
+            g = c[kk][block_tables]              # (B, P, H, page, hd)
+            B, Pp, H, pg, hd = g.shape
+            g = g.transpose(0, 2, 1, 3, 4).reshape(B, H, Pp * pg, hd)
+            row[kk] = g[:, :, :length]
+        out.append(row)
+    return out
+
+
+def paged_scatter_rows(cache_pages, rows, block_tables, page_size: int):
+    """Write full contiguous (B, H, L, hd) k/v rows (a prefill output)
+    into the pool through each row's block table. Logical pages past a
+    row's allocation must map to the trash page in ``block_tables`` —
+    their writes collide harmlessly there."""
+    n_pages = (rows[0]["k"].shape[2] + page_size - 1) // page_size
+    dest = block_tables[:, :n_pages].reshape(-1)         # (B*n_pages,)
+    out = []
+    for c, rc in zip(cache_pages, rows):
+        row = {}
+        for kk in ("k", "v"):
+            r = rc[kk]                                   # (B, H, L, hd)
+            B, H, L, hd = r.shape
+            r = jnp.pad(r, ((0, 0), (0, 0),
+                            (0, n_pages * page_size - L), (0, 0)))
+            r = r.reshape(B, H, n_pages, page_size, hd)
+            r = r.transpose(0, 2, 1, 3, 4).reshape(
+                B * n_pages, H, page_size, hd)
+            row[kk] = c[kk].at[dest].set(r)
+        out.append(row)
+    return out
+
+
+def _paged_writeback(cache_pages, new_cache, block_tables, wpos,
+                     page_size: int, active):
+    """Scatter the freshly-written positions ``wpos`` (B, W) of an updated
+    gathered cache back into the physical pages. Inactive rows (and only
+    they) are redirected to trash page 0 — their "new" values are the old
+    ones decode_step_ragged preserved, but their block-table rows may
+    reference pages that were freed and reallocated to another request."""
+    B, W = wpos.shape
+    phys = jnp.take_along_axis(block_tables, wpos // page_size, axis=1)
+    if active is not None:
+        phys = jnp.where(active[:, None], phys, 0)
+    pf = phys.reshape(-1)
+    of = (wpos % page_size).reshape(-1)
+    out = []
+    for c, nc in zip(cache_pages, new_cache):
+        row = {}
+        for kk in ("k", "v"):
+            vals = jnp.take_along_axis(
+                nc[kk], wpos[:, None, :, None], axis=2)  # (B, H, W, hd)
+            H, hd = vals.shape[1], vals.shape[3]
+            vals = vals.transpose(0, 2, 1, 3).reshape(B * W, H, hd)
+            row[kk] = c[kk].at[pf, :, of].set(vals)
+        out.append(row)
+    return out
+
+
+def decode_step_paged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
+                      cache_pages, block_tables, cfg: TransformerConfig, *,
+                      page_size: int, length: int,
+                      active: Optional[jnp.ndarray] = None):
+    """:func:`decode_step_ragged` over a paged pool: gather through the
+    block table, run the IDENTICAL ragged-step math, scatter the one new
+    K/V position per row back to its page. Logits are bitwise equal to
+    the contiguous path on the same cache contents (masked garbage lanes
+    contribute exactly 0). ``length`` is the logical cache length (the
+    contiguous L); every ``pos`` must be < length."""
+    gathered = paged_gather(cache_pages, block_tables, length)
+    logits, new = decode_step_ragged(params, tokens, pos.astype(jnp.int32),
+                                     gathered, cfg, active)
+    pages = _paged_writeback(cache_pages, new, block_tables,
+                             pos.astype(jnp.int32)[:, None], page_size,
+                             active)
+    return logits, pages
+
+
+def decode_window_paged(params: Dict, tokens: jnp.ndarray,
+                        pos: jnp.ndarray, cache_pages, block_tables,
+                        cfg: TransformerConfig, *, page_size: int,
+                        length: int,
+                        active: Optional[jnp.ndarray] = None):
+    """:func:`decode_window_ragged` over a paged pool — the speculative
+    verify and chunked-prefill primitive. Row b's window writes positions
+    ``pos[b]..pos[b]+W-1`` into its pages; every such position must be
+    < ``length`` (the engine sizes allocations so windows never clamp)."""
+    W = tokens.shape[1]
+    pos = pos.astype(jnp.int32)
+    wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)
+    gathered = paged_gather(cache_pages, block_tables, length)
+    logits, new = decode_window_ragged(params, tokens, pos, gathered,
+                                       cfg, active)
+    pages = _paged_writeback(cache_pages, new, block_tables, wpos,
+                             page_size, active)
+    return logits, pages
 
 
 def generate_cached(params: Dict, prompt_ids, cfg: TransformerConfig,

@@ -25,7 +25,9 @@ from .source import HTTPSource, parse_request, make_reply, HTTPSink
 from .engine import ServingEngine
 from .continuous import ContinuousDecoder
 from .generation import GenerationEngine
+from .kv_pool import KVAutotuner, PagedKVPool, PoolExhausted
 
 __all__ = ["CachedRequest", "WorkerServer", "HTTPSource", "HTTPSink",
            "parse_request", "make_reply", "ServingEngine",
-           "ContinuousDecoder", "GenerationEngine"]
+           "ContinuousDecoder", "GenerationEngine",
+           "PagedKVPool", "KVAutotuner", "PoolExhausted"]

@@ -25,9 +25,32 @@ decoding leaves the chip >90% idle at batch 1. The standard fix
   paying prefill + a first-token round-trip on the admission critical
   path (first tokens ride the drain pipeline like decode blocks).
 
-No paging: a zoo-scale engine favors the dense static cache (paged KV adds
-a gather per step and matters once max_len × slots outgrows HBM, which a
-single-chip zoo model never approaches).
+The KV cache is **paged** (PagedAttention, vLLM): the physical cache is a
+pool of fixed-size pages (`serving/kv_pool.py`) and each slot owns a block
+table mapping its logical positions to physical pages, so
+
+* a request pins pages for the tokens it can actually produce (prompt +
+  max_new + speculative headroom), not a worst-case ``max_len`` region —
+  short requests stop stranding HBM;
+* common-prompt prefixes share PHYSICAL pages across requests
+  (copy-on-write: only the boundary page is copied), replacing the old
+  snapshot-and-recopy prefix cache;
+* retiring requests return pages to a min-heap free list; when the live
+  span drifts past the defrag threshold, one device gather compacts it.
+
+Attention still runs the exact contiguous math: every step gathers a
+slot's pages into the familiar dense layout and calls the same ragged
+kernels (``decode_step_paged`` is bitwise-equal to ``decode_step_ragged``
+by construction), so greedy outputs stay request-identical to
+:func:`generate_cached`.
+
+**Chunked prefill** (Orca-style iteration-level scheduling): prompts
+longer than ``prefill_chunk`` admit immediately but prefill in
+fixed-budget windows interleaved with decode ticks — a 4k-token prompt
+no longer freezes every live stream, bounding p99 decode-step latency.
+A ``KVAutotuner`` (optional, ``autotune=True``) closes the loop, walking
+speculative gamma with the measured acceptance rate and the chunk budget
+with live slot occupancy.
 """
 
 import functools
@@ -51,8 +74,13 @@ from ..utils.profiling import span as _prof_span
 from ..models.zoo.transformer import (TransformerConfig,
                                       _warp_scaled_rows,
                                       decode_step_ragged,
+                                      decode_step_paged,
+                                      decode_window_paged,
+                                      paged_scatter_rows,
                                       prefill_cache, shardings_for)
 from ..ops.padding import bucket_size
+from .kv_pool import (KVAutotuner, PagedKVPool, PoolExhausted,
+                      prefix_hash as _prefix_hash)
 
 _M_DRAIN_SECONDS = _metric_histogram(
     "mmlspark_continuous_drain_seconds",
@@ -107,6 +135,343 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
     return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+# ---- compiled-program factories (process-wide, config-keyed) ----
+# Every decode-path program is a pure function of STATIC configuration
+# (hashable scalars + the NamedTuple model configs) and its array
+# arguments, so ``lru_cache`` makes each jitted callable a process-wide
+# singleton per configuration: N engines with the same shapes (hot
+# reloads, A/B pools, a test suite's many tiny engines) trace and
+# compile every program ONCE instead of N times. Donation composes —
+# each call donates its own argument buffers, never another engine's.
+
+@functools.lru_cache(maxsize=None)
+def _tick_program(cfg, page, Lc, k, eos, sample, donate):
+    """The decode tick: k ragged paged steps fused in one lax.scan."""
+    eos_const = None if eos is None else jnp.int32(eos)
+
+    def tick(params, tok, pos, active, bufs, bt, remaining,
+             temp=None, topk=None, topp=None, key=None):
+        def body(carry, _):
+            tok, pos, active, bufs, remaining = carry
+            logits, bufs = decode_step_paged(
+                params, tok, pos, bufs, bt, cfg,
+                page_size=page, length=Lc, active=active)
+            if sample:
+                # emit position is pos+1 — generate_cached's key
+                # schedule (fold_in by absolute emit position), so
+                # sampled outputs are request-for-request
+                # identical to the offline generator
+                folded = jax.vmap(jax.random.fold_in)(key, pos + 1)
+                nxt = _sample_rows(logits.astype(jnp.float32),
+                                   temp, topk, topp, folded)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, pos + 1, pos)
+            remaining = jnp.where(active, remaining - 1, remaining)
+            fin = remaining <= 0
+            if eos_const is not None:
+                fin = fin | (nxt == eos_const)
+            active = active & ~fin
+            return (nxt, pos, active, bufs, remaining), nxt
+        carry, toks = jax.lax.scan(
+            body, (tok, pos, active, bufs, remaining), None, length=k)
+        return (*carry, toks)
+
+    return jax.jit(tick, donate_argnums=(1, 2, 3, 4, 6) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _prefill_program(cfg, L):
+    """Batched prompt prefill — one compile per padded prompt bucket."""
+    def _prefill(params, ids, length):
+        return prefill_cache(params, ids, length, cfg, L)
+
+    return jax.jit(_prefill)
+
+
+@functools.lru_cache(maxsize=None)
+def _extend_program(cfg, page, L, donate):
+    """Paged window extension: continue ONE slot's pages over a token
+    window — the prefix-cache suffix path and chunked prefill share this
+    single program (one compile per window bucket). Gathers at length L:
+    the exact reduction length the old contiguous extension used, so
+    greedy prefix-hit outputs stay identical."""
+    def _extend(params, ids, start, bufs, bt_row):
+        return decode_window_paged(params, ids, start, bufs, bt_row,
+                                   cfg, page_size=page, length=L,
+                                   active=None)
+
+    return jax.jit(_extend, donate_argnums=(3,) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _copy_pages_program(donate):
+    """Boundary-page copy for copy-on-write prefix admission (at most
+    one page per admission — compiles per copy count)."""
+    def _copy(bufs, src, dst):
+        return [{kk: c[kk].at[dst].set(c[kk][src])
+                 for kk in ("k", "v")} for c in bufs]
+
+    return jax.jit(_copy, donate_argnums=(0,) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _compact_program(donate):
+    """Defrag: permute the whole page dimension in one gather."""
+    def _compact(bufs, perm):
+        return [{kk: c[kk][perm] for kk in ("k", "v")} for c in bufs]
+
+    return jax.jit(_compact, donate_argnums=(0,) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _insert_group_program(page, donate):
+    """Group insert: ALL rows admitted from one prefill land in one
+    compiled call (slots is a (g,) vector, g gets its own tiny program —
+    bounded by max_slots), and their first tokens compute on device in
+    the same batch, so admission costs ONE dispatch + ONE fetch instead
+    of one sync per request (each ~RTT behind the tunnel). Target rows
+    scatter into the PAGE POOL through ``page_rows`` (each row's physical
+    pages; entries past a row's allocation map to the trash page); draft
+    rows land in the contiguous draft slot pool. Either row list may be
+    EMPTY — state-only activation for prefix hits and chunked prefills,
+    whose K/V is already in the pages — each emptiness pattern is its
+    own pytree structure, so jit compiles a handful of small variants,
+    not one per call. row lists are NOT donated: rows arrive as slices
+    of the prefill output and a copy of g rows is cheaper than the
+    sync."""
+    def _insert_group(bufs, d_cache, slots, rows_t, rows_d, page_rows,
+                      tok, pos, active, remaining, firsts, lengths,
+                      rems, sample_state, sample_rows):
+        g = slots.shape[0]
+        if len(rows_t):        # pytree STRUCTURE: static per variant
+            bufs = paged_scatter_rows(bufs, rows_t, page_rows, page)
+        for c, rc in zip(d_cache, rows_d):
+            for kk in ("k", "v"):
+                for i in range(g):            # g static: unrolled
+                    c[kk] = jax.lax.dynamic_update_slice(
+                        c[kk], rc[kk][i:i + 1], (slots[i], 0, 0, 0))
+        tok = tok.at[slots].set(firsts)
+        pos = pos.at[slots].set(lengths)
+        active = active.at[slots].set(True)
+        remaining = remaining.at[slots].set(rems)
+        temp, topk, topp, key = sample_state
+        rt, rk, rp, rkey = sample_rows
+        sample_state = (temp.at[slots].set(rt), topk.at[slots].set(rk),
+                        topp.at[slots].set(rp), key.at[slots].set(rkey))
+        return (bufs, d_cache, tok, pos, active, remaining,
+                sample_state)
+
+    return jax.jit(_insert_group,
+                   donate_argnums=(0, 1, 6, 7, 8, 9, 13) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _first_tokens_program():
+    """First emitted token for every prefilled row, on device: position
+    P_i sampled with fold_in(key_i, P_i) — generate_cached's exact
+    schedule (temp <= 0 rows reduce to argmax inside _sample_rows)."""
+    def _first_tokens(logits, temps, topks, topps, keys, lengths):
+        folded = jax.vmap(jax.random.fold_in)(keys, lengths)
+        return _sample_rows(logits.astype(jnp.float32),
+                            temps, topks, topps, folded)
+
+    return jax.jit(_first_tokens)
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
+                       sample, warp, donate):
+    """The speculative tick: k draft→verify rounds in one scan.
+
+    Per round, the draft proposes gamma tokens per slot (gamma+1 ragged
+    steps — the extra step writes the last proposal's K/V so the draft
+    cache is hole-free under full acceptance); the target scores every
+    slot's (pending + drafts) window in ONE ragged forward; each slot
+    accepts its own longest valid prefix plus a final token. Greedy
+    slots: proposals are draft argmaxes, acceptance is target-argmax
+    match, the final token is the target's greedy choice — outputs
+    request-identical to the plain greedy engine. Sampled slots
+    (sample=True): proposals are draft SAMPLES, token x accepted with
+    prob min(1, p_t(x)/p_d(x)), a rejection resamples from the
+    normalized residual max(p_t − p_d, 0) — the speculative-sampling
+    correction, so the output DISTRIBUTION exactly equals sampling from
+    the target (bit-identity to the plain sampled engine is impossible:
+    the procedures consume randomness differently; the per-slot contract
+    is distributional). Per-slot acceptance means no batch-min
+    truncation, so the zoo impl's accepted-at-cut case cannot arise: the
+    accepted count IS each slot's true rejection point, and a rejected
+    token can never be re-emitted (its residual mass is zero).
+    Randomness is keyed by (request key, absolute emit position,
+    purpose) — discarded tail draws never influence emitted state, so
+    replays are never of identical inputs. Rejected-tail cache entries
+    are stale by position and overwritten before any valid query sees
+    them. Emission: a (k*(gamma+1), S) block where -1 marks unemitted
+    lanes — the host drain skips negatives.
+
+    gamma is a compile-time constant of the round structure, so the
+    autotuner's gamma ladder memoizes one compiled program per
+    (mode, gamma) — bounded by 3 × gamma_max entries. The TARGET cache
+    is paged (verify gathers through the block table); the DRAFT cache
+    stays a contiguous slot pool — a draft is small by construction and
+    pays the gather for nothing."""
+    eos_const = None if eos is None else jnp.int32(eos)
+
+    def spec_tick(params, d_params, tok, pos, active, bufs,
+                  bt, d_cache, remaining, temp=None, key=None,
+                  topk=None, topp=None):
+        idx = jnp.arange(gamma + 1)
+
+        def keys_at(qpos, purpose):
+            # (S,) keys at absolute emit positions qpos
+            k1 = jax.vmap(jax.random.fold_in)(key, qpos)
+            return jax.vmap(jax.random.fold_in, (0, None))(
+                k1, purpose)
+
+        def warm_logp(lg):
+            # temp is (S,); lg is (S, V) or (S, W, V). The
+            # top-k/top-p warp applies to TARGET and DRAFT
+            # alike (rejection stays exact only under a
+            # shared warp). Greedy rows may carry non-neutral
+            # top_k/top_p values — harmless only because the
+            # temp>0 masks discard every warped quantity for
+            # them. The warp=False variant skips the
+            # sort-based filter entirely — the host picks it
+            # whenever no live row warps, keeping the
+            # temperature-only hot path at one log_softmax.
+            t = jnp.maximum(temp, 1e-6).reshape(
+                (lg.shape[0],) + (1,) * (lg.ndim - 1))
+            scaled = lg.astype(jnp.float32) / t
+            if not warp:
+                return jax.nn.log_softmax(scaled, -1)
+            if lg.ndim == 2:
+                warped = _warp_scaled_rows(scaled, topk, topp)
+            else:
+                s_, w_, v_ = scaled.shape
+                warped = _warp_scaled_rows(
+                    scaled.reshape(s_ * w_, v_),
+                    jnp.repeat(topk, w_),
+                    jnp.repeat(topp, w_)).reshape(s_, w_, v_)
+            return jax.nn.log_softmax(warped, -1)
+
+        def round_body(carry, _):
+            (tok, pos, active, bufs, d_cache,
+             remaining) = carry
+
+            def dstep(c, i):
+                dc, t = c
+                lg, dc = decode_step_ragged(
+                    d_params, t, pos + i, dc, d_cfg, active)
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                if sample:
+                    logp = warm_logp(lg)        # (S, V)
+                    samp = jax.vmap(jax.random.categorical)(
+                        keys_at(pos + i + 1, 1), logp)
+                    nxt = jnp.where(temp > 0.0,
+                                    samp.astype(jnp.int32),
+                                    nxt)
+                else:
+                    logp = jnp.zeros((lg.shape[0], 1),
+                                     jnp.float32)
+                return ((dc, jnp.where(active, nxt, t)),
+                        (nxt, logp))
+
+            (d_cache, _), (props, d_logps) = jax.lax.scan(
+                dstep, (d_cache, tok), jnp.arange(gamma + 1))
+            drafts = jnp.moveaxis(props[:gamma], 0, 1)
+            wtoks = jnp.concatenate([tok[:, None], drafts], 1)
+            w_logits, bufs = decode_window_paged(
+                params, wtoks, pos, bufs, bt, cfg,
+                page_size=page, length=Lc, active=active)
+            greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
+            match = greedy[:, :gamma] == drafts
+            if sample:
+                t_logp = warm_logp(w_logits)    # (S, g+1, V)
+                d_logp = jnp.moveaxis(d_logps[:gamma], 0, 1)
+                lp_t = jnp.take_along_axis(
+                    t_logp[:, :gamma], drafts[..., None],
+                    -1)[..., 0]
+                lp_d = jnp.take_along_axis(
+                    d_logp, drafts[..., None], -1)[..., 0]
+                us = jnp.stack(
+                    [jax.vmap(jax.random.uniform)(
+                        keys_at(pos + j + 1, 2))
+                     for j in range(gamma)], axis=1)
+                acc_s = (jnp.log(jnp.maximum(us, 1e-38))
+                         < lp_t - lp_d)
+                accepts = jnp.where(temp[:, None] > 0.0,
+                                    acc_s, match)
+            else:
+                accepts = match
+            k = jnp.sum(jnp.cumprod(
+                accepts.astype(jnp.int32), -1), -1)   # (S,)
+            final = jnp.take_along_axis(greedy, k[:, None],
+                                        1)[:, 0]
+            if sample:
+                p_t_k = jnp.take_along_axis(
+                    jnp.exp(t_logp),
+                    k[:, None, None].repeat(
+                        t_logp.shape[-1], 2)[:, :1], 1)[:, 0]
+                d_logp_pad = jnp.concatenate(
+                    [d_logp,
+                     jnp.full((d_logp.shape[0], 1,
+                               d_logp.shape[-1]),
+                              -jnp.inf, jnp.float32)], 1)
+                p_d_k = jnp.take_along_axis(
+                    jnp.exp(d_logp_pad),
+                    k[:, None, None].repeat(
+                        d_logp.shape[-1], 2)[:, :1], 1)[:, 0]
+                resid = jnp.maximum(p_t_k - p_d_k, 0.0)
+                tot = jnp.sum(resid, -1, keepdims=True)
+                resid = jnp.where(tot > 1e-30, resid / tot,
+                                  p_t_k)
+                resampled = jax.vmap(jax.random.categorical)(
+                    keys_at(pos + k + 1, 3),
+                    jnp.log(jnp.maximum(resid, 1e-38)))
+                final = jnp.where(temp > 0.0,
+                                  resampled.astype(jnp.int32),
+                                  final)
+            pad_drafts = jnp.concatenate(
+                [drafts, drafts[:, -1:]], 1)
+            cand = jnp.where(idx[None] < k[:, None],
+                             pad_drafts, final[:, None])
+            cnt = jnp.minimum(k + 1, remaining)
+            if eos_const is not None:
+                # truncate at the first emitted eos,
+                # inclusive — sequential-emission semantics
+                is_eos = ((cand == eos_const)
+                          & (idx[None] < cnt[:, None]))
+                cnt = jnp.where(jnp.any(is_eos, -1),
+                                jnp.argmax(is_eos, -1) + 1,
+                                cnt)
+            cnt = jnp.where(active, cnt, 0)
+            emit = jnp.where(idx[None] < cnt[:, None],
+                             cand, -1)
+            pos = pos + cnt
+            remaining = remaining - cnt
+            fin = remaining <= 0
+            if eos_const is not None:
+                fin = fin | jnp.any(emit == eos_const, -1)
+            active = active & ~fin
+            last = jnp.take_along_axis(
+                cand, jnp.maximum(cnt - 1, 0)[:, None],
+                1)[:, 0]
+            tok = jnp.where(cnt > 0, last, tok)
+            return ((tok, pos, active, bufs, d_cache,
+                     remaining), emit.T)
+
+        carry, emits = jax.lax.scan(
+            round_body,
+            (tok, pos, active, bufs, d_cache, remaining),
+            None, length=k_steps)
+        return (*carry, emits.reshape(-1, emits.shape[-1]))
+
+    return jax.jit(
+        spec_tick,
+        donate_argnums=(2, 3, 4, 5, 7, 8) if donate else ())
+
+
 class ContinuousDecoder:
     """Slot-pool continuous-batching engine over the zoo decoder.
 
@@ -130,7 +495,12 @@ class ContinuousDecoder:
                  prefill_ahead: int = 0,
                  draft_params: Optional[Dict] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
-                 gamma: int = 4):
+                 gamma: int = 4,
+                 page_size: int = 16,
+                 prefill_chunk: int = 256,
+                 kv_pages: Optional[int] = None,
+                 autotune: bool = False,
+                 defrag_threshold: Optional[int] = None):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -154,6 +524,13 @@ class ContinuousDecoder:
             # otherwise only explode when a draft is added later
             raise ValueError("gamma must be >= 1")
         self._gamma = int(gamma)
+        #: autotuned gamma walks a ladder up to gamma_max; the cache
+        #: headroom, page counts and retirement horizon all size for the
+        #: CEILING so a mid-stream gamma bump never outgrows a slot's
+        #: pages. Without autotune the ceiling IS gamma — sizes (and so
+        #: compiled programs and bitwise behavior) are unchanged.
+        self._gamma_max = (max(self._gamma, 8)
+                           if (autotune and self._spec) else self._gamma)
         self._d_cfg = draft_cfg
         if cfg.position == "learned" and max_len > cfg.max_len:
             # positions beyond the learned table would CLAMP (JAX gather
@@ -209,15 +586,14 @@ class ContinuousDecoder:
         params = jax.tree.map(jnp.asarray, params)
         hd = cfg.d_model // cfg.heads
         # speculative headroom: a verify window optimistically WRITES all
-        # gamma+1 positions even when fewer remain before max_new; the
-        # pool rows carry gamma+1 spare positions so the tail write never
-        # clamps onto live entries. Prefill rows stay _L long — their
-        # missing tail is zeros the key mask never exposes.
-        self._Lc = self._L + (self._gamma + 1 if self._spec else 0)
-        shape = (self._S, cfg.heads, self._Lc, hd)
+        # gamma+1 positions even when fewer remain before max_new; slot
+        # allocations carry gamma_max+1 spare positions so the tail write
+        # never clamps onto live entries. Prefill rows stay _L long —
+        # their missing tail is garbage the key mask never exposes.
+        self._Lc = self._L + (self._gamma_max + 1 if self._spec else 0)
         if mesh is None:
             self._params = jax.device_put(params)
-            cache_sharding = state_sharding = None
+            cache_sharding = state_sharding = pool_sharding = None
         else:
             # tensor-parallel serving: Megatron layout on the params
             # (shardings_for), KV heads over "tp", slots over "dp" when
@@ -234,6 +610,10 @@ class ContinuousDecoder:
             head_axis = "tp" if "tp" in mesh.axis_names else None
             cache_sharding = NamedSharding(
                 mesh, P(slot_axis, head_axis, None, None))
+            # page pools shard over heads only: the page dimension is a
+            # shared allocator arena, not a per-request batch axis
+            pool_sharding = NamedSharding(
+                mesh, P(None, head_axis, None, None))
             state_sharding = NamedSharding(mesh, P())
             # dp-only mesh: replicate params (shardings_for names "tp")
             self._params = jax.device_put(
@@ -258,7 +638,45 @@ class ContinuousDecoder:
                 z, cache_sharding if sharded else state_sharding)
 
         self._zeros = _zeros
-        self._cache_shape = shape
+
+        # ---- the paged KV pool + block tables ----
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if prefill_chunk < 8:
+            # the pad-bucket floor; a sub-bucket budget would chunk every
+            # prompt into windows the bucketing immediately re-inflates
+            raise ValueError("prefill_chunk must be >= 8")
+        self._page = int(page_size)
+        #: block-table width: logical pages per slot at full cache length
+        self._P_max = -(-self._Lc // self._page)
+        if kv_pages is None:
+            # every slot at worst case, plus slack so prefix sharing and
+            # admission bursts don't immediately hit the exhaustion path
+            kv_pages = (1 + self._S * self._P_max
+                        + max(self._P_max, self._S))
+        if kv_pages < 1 + self._P_max:
+            raise ValueError(
+                f"kv_pages {kv_pages} cannot hold one full-length slot "
+                f"({self._P_max} pages + the trash page)")
+
+        def _pool_buffer(shape_, dtype):
+            z = jnp.zeros(shape_, dtype)
+            return (z if pool_sharding is None
+                    else jax.device_put(z, pool_sharding))
+
+        self._kv = PagedKVPool(cfg, num_pages=int(kv_pages),
+                               page_size=self._page,
+                               make_buffer=_pool_buffer)
+        self._chunk = int(prefill_chunk)
+        self._defrag_thr = (max(1, self._kv.num_pages // 4)
+                            if defrag_threshold is None
+                            else max(1, int(defrag_threshold)))
+        self._tuner = (KVAutotuner(gamma=self._gamma,
+                                   gamma_max=self._gamma_max,
+                                   chunk=self._chunk,
+                                   chunk_min=min(32, self._chunk),
+                                   chunk_max=max(1024, self._chunk))
+                       if autotune else None)
         self._reset_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self._S
         self._waiting: List[_Request] = []
@@ -283,315 +701,84 @@ class ContinuousDecoder:
         # streams are identical to k single-step ticks; the host reads the
         # whole (k, S) token block in one fetch. One body serves greedy
         # and sampled (the only difference is how ``nxt`` is chosen).
-        eos_const = None if self._eos is None else jnp.int32(self._eos)
+        page, Lc = self._page, self._Lc
 
-        def _make_tick(sample: bool):
-            def tick(params, tok, pos, active, cache, remaining,
-                     temp=None, topk=None, topp=None, key=None):
-                def body(carry, _):
-                    tok, pos, active, cache, remaining = carry
-                    logits, cache = decode_step_ragged(
-                        params, tok, pos, cache, cfg, active)
-                    if sample:
-                        # emit position is pos+1 — generate_cached's key
-                        # schedule (fold_in by absolute emit position), so
-                        # sampled outputs are request-for-request
-                        # identical to the offline generator
-                        folded = jax.vmap(jax.random.fold_in)(key, pos + 1)
-                        nxt = _sample_rows(logits.astype(jnp.float32),
-                                           temp, topk, topp, folded)
-                    else:
-                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    nxt = jnp.where(active, nxt, tok)
-                    pos = jnp.where(active, pos + 1, pos)
-                    remaining = jnp.where(active, remaining - 1, remaining)
-                    fin = remaining <= 0
-                    if eos_const is not None:
-                        fin = fin | (nxt == eos_const)
-                    active = active & ~fin
-                    return (nxt, pos, active, cache, remaining), nxt
-                carry, toks = jax.lax.scan(
-                    body, (tok, pos, active, cache, remaining), None,
-                    length=self._k)
-                return (*carry, toks)
-            return jax.jit(tick,
-                           donate_argnums=(1, 2, 3, 4, 5) if donate else ())
-
-        self._tick = _make_tick(sample=False)
-        self._tick_sampled = _make_tick(sample=True)
+        # The block table rides every tick as a NON-donated, non-carried
+        # argument: the scan body reads it (gather + writeback routing)
+        # but never changes it — pages are remapped host-side between
+        # dispatches, and the engine re-binds self._bt outside jit.
+        self._tick = _tick_program(cfg, page, Lc, self._k, self._eos,
+                                   False, donate)
+        self._tick_sampled = _tick_program(cfg, page, Lc, self._k,
+                                           self._eos, True, donate)
         #: most tokens one dispatch can emit per slot (the retirement
-        #: horizon unit): k plain steps, or k rounds × (gamma+1) spec
-        self._max_per_dispatch = (self._k * (self._gamma + 1)
+        #: horizon unit): k plain steps, or k rounds × (gamma+1) spec —
+        #: sized at the autotune CEILING so the horizon stays an upper
+        #: bound whatever gamma the tuner is running
+        self._max_per_dispatch = (self._k * (self._gamma_max + 1)
                                   if self._spec else self._k)
 
-        # ---- the speculative tick: k draft→verify rounds in one scan ----
-        # Per round, the draft proposes gamma tokens per slot (gamma+1
-        # ragged steps — the extra step writes the last proposal's K/V so
-        # the draft cache is hole-free under full acceptance); the target
-        # scores every slot's (pending + drafts) window in ONE ragged
-        # forward; each slot accepts its own longest valid prefix plus a
-        # final token. Greedy slots: proposals are draft argmaxes,
-        # acceptance is target-argmax match, the final token is the
-        # target's greedy choice — outputs request-identical to the plain
-        # greedy engine. Sampled slots (sample=True tick): proposals are
-        # draft SAMPLES, token x accepted with prob min(1, p_t(x)/p_d(x)),
-        # a rejection resamples from the normalized residual
-        # max(p_t − p_d, 0) — the speculative-sampling correction, so the
-        # output DISTRIBUTION exactly equals sampling from the target
-        # (bit-identity to the plain sampled engine is impossible: the
-        # procedures consume randomness differently; the per-slot contract
-        # is distributional). Per-slot acceptance means no batch-min
-        # truncation, so the zoo impl's accepted-at-cut case cannot arise:
-        # k IS each slot's true rejection point, and a rejected token can
-        # never be re-emitted (its residual mass is zero). Randomness is
-        # keyed by (request key, absolute emit position, purpose) —
-        # discarded tail draws never influence emitted state, so replays
-        # are never of identical inputs. Rejected-tail cache entries are
-        # stale by position and overwritten before any valid query sees
-        # them. Emission: a (k*(gamma+1), S) block where -1 marks
-        # unemitted lanes — the host drain skips negatives.
+        # ---- the speculative tick (see _spec_tick_program) ----
         if self._spec:
-            d_cfg, gamma = self._d_cfg, self._gamma
-            from ..models.zoo.transformer import decode_window_ragged
+            d_cfg = self._d_cfg
 
-            def _make_spec_tick(sample: bool, warp: bool = False):
-                def spec_tick(params, d_params, tok, pos, active, t_cache,
-                              d_cache, remaining, temp=None, key=None,
-                              topk=None, topp=None):
-                    idx = jnp.arange(gamma + 1)
+            self._spec_ticks: Dict[tuple, object] = {}
 
-                    def keys_at(qpos, purpose):
-                        # (S,) keys at absolute emit positions qpos
-                        k1 = jax.vmap(jax.random.fold_in)(key, qpos)
-                        return jax.vmap(jax.random.fold_in, (0, None))(
-                            k1, purpose)
+            def _spec_tick_for(mode: str, g: int):
+                fn = self._spec_ticks.get((mode, g))
+                if fn is None:
+                    fn = _spec_tick_program(
+                        cfg, d_cfg, page, Lc, self._k, self._eos, g,
+                        sample=(mode != "greedy"),
+                        warp=(mode == "warped"), donate=donate)
+                    self._spec_ticks[(mode, g)] = fn
+                return fn
 
-                    def warm_logp(lg):
-                        # temp is (S,); lg is (S, V) or (S, W, V). The
-                        # top-k/top-p warp applies to TARGET and DRAFT
-                        # alike (rejection stays exact only under a
-                        # shared warp). Greedy rows may carry non-neutral
-                        # top_k/top_p values — harmless only because the
-                        # temp>0 masks discard every warped quantity for
-                        # them. The warp=False variant skips the
-                        # sort-based filter entirely — the host picks it
-                        # whenever no live row warps, keeping the
-                        # temperature-only hot path at one log_softmax.
-                        t = jnp.maximum(temp, 1e-6).reshape(
-                            (lg.shape[0],) + (1,) * (lg.ndim - 1))
-                        scaled = lg.astype(jnp.float32) / t
-                        if not warp:
-                            return jax.nn.log_softmax(scaled, -1)
-                        if lg.ndim == 2:
-                            warped = _warp_scaled_rows(scaled, topk, topp)
-                        else:
-                            s_, w_, v_ = scaled.shape
-                            warped = _warp_scaled_rows(
-                                scaled.reshape(s_ * w_, v_),
-                                jnp.repeat(topk, w_),
-                                jnp.repeat(topp, w_)).reshape(s_, w_, v_)
-                        return jax.nn.log_softmax(warped, -1)
-
-                    def round_body(carry, _):
-                        (tok, pos, active, t_cache, d_cache,
-                         remaining) = carry
-
-                        def dstep(c, i):
-                            dc, t = c
-                            lg, dc = decode_step_ragged(
-                                d_params, t, pos + i, dc, d_cfg, active)
-                            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-                            if sample:
-                                logp = warm_logp(lg)        # (S, V)
-                                samp = jax.vmap(jax.random.categorical)(
-                                    keys_at(pos + i + 1, 1), logp)
-                                nxt = jnp.where(temp > 0.0,
-                                                samp.astype(jnp.int32),
-                                                nxt)
-                            else:
-                                logp = jnp.zeros((lg.shape[0], 1),
-                                                 jnp.float32)
-                            return ((dc, jnp.where(active, nxt, t)),
-                                    (nxt, logp))
-
-                        (d_cache, _), (props, d_logps) = jax.lax.scan(
-                            dstep, (d_cache, tok), jnp.arange(gamma + 1))
-                        drafts = jnp.moveaxis(props[:gamma], 0, 1)
-                        wtoks = jnp.concatenate([tok[:, None], drafts], 1)
-                        w_logits, t_cache = decode_window_ragged(
-                            params, wtoks, pos, t_cache, cfg, active)
-                        greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
-                        match = greedy[:, :gamma] == drafts
-                        if sample:
-                            t_logp = warm_logp(w_logits)    # (S, g+1, V)
-                            d_logp = jnp.moveaxis(d_logps[:gamma], 0, 1)
-                            lp_t = jnp.take_along_axis(
-                                t_logp[:, :gamma], drafts[..., None],
-                                -1)[..., 0]
-                            lp_d = jnp.take_along_axis(
-                                d_logp, drafts[..., None], -1)[..., 0]
-                            us = jnp.stack(
-                                [jax.vmap(jax.random.uniform)(
-                                    keys_at(pos + j + 1, 2))
-                                 for j in range(gamma)], axis=1)
-                            acc_s = (jnp.log(jnp.maximum(us, 1e-38))
-                                     < lp_t - lp_d)
-                            accepts = jnp.where(temp[:, None] > 0.0,
-                                                acc_s, match)
-                        else:
-                            accepts = match
-                        k = jnp.sum(jnp.cumprod(
-                            accepts.astype(jnp.int32), -1), -1)   # (S,)
-                        final = jnp.take_along_axis(greedy, k[:, None],
-                                                    1)[:, 0]
-                        if sample:
-                            p_t_k = jnp.take_along_axis(
-                                jnp.exp(t_logp),
-                                k[:, None, None].repeat(
-                                    t_logp.shape[-1], 2)[:, :1], 1)[:, 0]
-                            d_logp_pad = jnp.concatenate(
-                                [d_logp,
-                                 jnp.full((d_logp.shape[0], 1,
-                                           d_logp.shape[-1]),
-                                          -jnp.inf, jnp.float32)], 1)
-                            p_d_k = jnp.take_along_axis(
-                                jnp.exp(d_logp_pad),
-                                k[:, None, None].repeat(
-                                    d_logp.shape[-1], 2)[:, :1], 1)[:, 0]
-                            resid = jnp.maximum(p_t_k - p_d_k, 0.0)
-                            tot = jnp.sum(resid, -1, keepdims=True)
-                            resid = jnp.where(tot > 1e-30, resid / tot,
-                                              p_t_k)
-                            resampled = jax.vmap(jax.random.categorical)(
-                                keys_at(pos + k + 1, 3),
-                                jnp.log(jnp.maximum(resid, 1e-38)))
-                            final = jnp.where(temp > 0.0,
-                                              resampled.astype(jnp.int32),
-                                              final)
-                        pad_drafts = jnp.concatenate(
-                            [drafts, drafts[:, -1:]], 1)
-                        cand = jnp.where(idx[None] < k[:, None],
-                                         pad_drafts, final[:, None])
-                        cnt = jnp.minimum(k + 1, remaining)
-                        if eos_const is not None:
-                            # truncate at the first emitted eos,
-                            # inclusive — sequential-emission semantics
-                            is_eos = ((cand == eos_const)
-                                      & (idx[None] < cnt[:, None]))
-                            cnt = jnp.where(jnp.any(is_eos, -1),
-                                            jnp.argmax(is_eos, -1) + 1,
-                                            cnt)
-                        cnt = jnp.where(active, cnt, 0)
-                        emit = jnp.where(idx[None] < cnt[:, None],
-                                         cand, -1)
-                        pos = pos + cnt
-                        remaining = remaining - cnt
-                        fin = remaining <= 0
-                        if eos_const is not None:
-                            fin = fin | jnp.any(emit == eos_const, -1)
-                        active = active & ~fin
-                        last = jnp.take_along_axis(
-                            cand, jnp.maximum(cnt - 1, 0)[:, None],
-                            1)[:, 0]
-                        tok = jnp.where(cnt > 0, last, tok)
-                        return ((tok, pos, active, t_cache, d_cache,
-                                 remaining), emit.T)
-
-                    carry, emits = jax.lax.scan(
-                        round_body,
-                        (tok, pos, active, t_cache, d_cache, remaining),
-                        None, length=self._k)
-                    return (*carry, emits.reshape(-1, emits.shape[-1]))
-
-                return jax.jit(
-                    spec_tick,
-                    donate_argnums=(2, 3, 4, 5, 6, 7) if donate else ())
-
-            self._spec_tick = _make_spec_tick(sample=False)
-            self._spec_tick_sampled = _make_spec_tick(sample=True)
-            self._spec_tick_warped = _make_spec_tick(sample=True,
-                                                     warp=True)
+            self._spec_tick_for = _spec_tick_for
 
         # one compiled prefill per padded prompt bucket
-        def _prefill(params, ids, length):
-            return prefill_cache(params, ids, length, cfg, self._L)
-
-        self._prefill = jax.jit(_prefill)
+        self._prefill = _prefill_program(cfg, self._L)
         if self._spec:
             # the draft pool prefills the same prompts (its cache must
             # hold the prompt K/V before it can propose)
-            def _d_prefill(d_params, ids, length):
-                return prefill_cache(d_params, ids, length, self._d_cfg,
-                                     self._L)
+            self._d_prefill = _prefill_program(self._d_cfg, self._L)
 
-            self._d_prefill = jax.jit(_d_prefill)
+        # prefix-cache suffix extension + chunked prefill (one program)
+        self._extend_paged = _extend_program(cfg, page, self._L, donate)
 
-        # prefix-cache suffix extension: continue a stored prefix cache
-        # over the request's remaining tokens (one window forward). The
-        # cache arg is donated (off-CPU): it is always the freshly-padded
-        # temporary, never the stored snapshot itself.
-        def _extend(params, ids, start, row_cache):
-            from ..models.zoo.transformer import decode_window
-            return decode_window(params, ids, start, row_cache, cfg)
-
-        self._extend = jax.jit(
-            _extend, donate_argnums=(3,) if donate else ())
-        #: key → (prefix token array, row cache snapshot, prefix length);
-        #: LRU — hits re-insert, eviction pops the coldest entry
-        self._prefix_store: Dict[str, tuple] = {}
+        # copy-on-write boundary-page copy + defrag permutation
+        self._copy_pages_j = _copy_pages_program(donate)
+        self._compact_j = _compact_program(donate)
+        #: key → (prefix token copy, pool prefix hash, prefix length);
+        #: the PAGES live in the pool's prefix registry — this host map
+        #: adds the engine-facing key, LRU promotion and FIFO eviction
         self._prefix_store_cap = int(prefix_cache_size)
         #: observability: prefill vs prefix-hit counts (tests + ops)
         self.stats = {"prefills": 0, "prefix_hits": 0}
 
-        # group insert: ALL rows admitted from one prefill land in one
-        # compiled call (slots is a (g,) vector, g gets its own tiny
-        # program — bounded by max_slots), and their first tokens compute
-        # on device in the same batch, so admission costs ONE dispatch +
-        # ONE fetch instead of one sync per request (each ~RTT behind the
-        # tunnel). row_cache is NOT donated: rows arrive as slices of the
-        # prefill output and a copy of g rows is cheaper than the sync.
-        def _insert_group(cache, slots, row_cache, tok, pos, active,
-                          remaining, firsts, lengths, rems,
-                          sample_state, sample_rows):
-            g = slots.shape[0]
-            for c, rc in zip(cache, row_cache):
-                for kk in ("k", "v"):
-                    for i in range(g):            # g static: unrolled
-                        c[kk] = jax.lax.dynamic_update_slice(
-                            c[kk], rc[kk][i:i + 1], (slots[i], 0, 0, 0))
-            tok = tok.at[slots].set(firsts)
-            pos = pos.at[slots].set(lengths)
-            active = active.at[slots].set(True)
-            remaining = remaining.at[slots].set(rems)
-            temp, topk, topp, key = sample_state
-            rt, rk, rp, rkey = sample_rows
-            sample_state = (temp.at[slots].set(rt), topk.at[slots].set(rk),
-                            topp.at[slots].set(rp), key.at[slots].set(rkey))
-            return cache, tok, pos, active, remaining, sample_state
-
-        self._insert_group_j = jax.jit(
-            _insert_group,
-            donate_argnums=(0, 3, 4, 5, 6, 10) if donate else ())
-
-        # first emitted token for every prefilled row, on device: position
-        # P_i sampled with fold_in(key_i, P_i) — generate_cached's exact
-        # schedule (temp <= 0 rows reduce to argmax inside _sample_rows)
-        def _first_tokens(logits, temps, topks, topps, keys, lengths):
-            folded = jax.vmap(jax.random.fold_in)(keys, lengths)
-            return _sample_rows(logits.astype(jnp.float32),
-                                temps, topks, topps, folded)
-
-        self._first_tokens = jax.jit(_first_tokens)
+        # group insert + first tokens (see the module factories)
+        self._insert_group_j = _insert_group_program(page, donate)
+        self._first_tokens = _first_tokens_program()
 
     def _reset_device_state(self):
         """(Re)build every slot-pool device buffer — at construction and in
         :meth:`cancel_all` (post-failure the old, possibly-donated buffers
         must never be reused). Mesh shardings are re-applied here so a
-        cancel on a tensor-parallel pool stays tensor-parallel."""
-        cfg, shape = self._cfg, self._cache_shape
-        self._cache = [{"k": self._zeros(shape, cfg.dtype, sharded=True),
-                        "v": self._zeros(shape, cfg.dtype, sharded=True)}
-                       for _ in range(cfg.layers)]
+        cancel on a tensor-parallel pool stays tensor-parallel. The page
+        pool resets with everything else — the prefix registry's pages die
+        with it, so the host prefix map is cleared too."""
+        cfg = self._cfg
+        self._kv.reset()
+        self._bt_host = np.zeros((self._S, self._P_max), np.int32)
+        self._bt = jnp.asarray(self._bt_host)
+        self._slot_pages: List[Optional[List[int]]] = [None] * self._S
+        #: slot → [request, prefill offset] for prompts mid-chunked-prefill
+        #: (occupied but device-inactive until the final chunk activates)
+        self._chunking: Dict[int, list] = {}
+        #: recent chunk sizes in tokens (tests + bench assert the budget
+        #: bound from this)
+        self._chunk_trace: List[int] = []
+        self._prefix_store: Dict[str, tuple] = {}
         if self._spec:
             dshape, dcfg = self._d_cache_shape, self._d_cfg
             self._d_cache = [{"k": self._zeros(dshape, dcfg.dtype),
@@ -702,10 +889,16 @@ class ContinuousDecoder:
                     group = [(free[i], reqs[off + i]) for i in range(m)]
                     for slot, req in group:
                         self._slot_req[slot] = req
-                self._insert_rows(
-                    group, logits[off:off + m],
-                    [{kk: c[kk][off:off + m] for kk in ("k", "v")}
-                     for c in rows])
+                if not self._insert_rows(
+                        group, logits[off:off + m],
+                        [{kk: c[kk][off:off + m] for kk in ("k", "v")}
+                         for c in rows]):
+                    # pool exhausted: un-assign, keep the unit parked —
+                    # pages free as slots retire, a later tick retries
+                    with self._lock:
+                        for slot, _ in group:
+                            self._slot_req[slot] = None
+                    return
                 unit[3] += m
                 if unit[3] >= len(unit[0]):
                     self._staged.pop(0)
@@ -723,9 +916,14 @@ class ContinuousDecoder:
                 if staged_any:
                     continue  # insertions may have freed slots (max_new=1)
                 return
-            plain = [(s, r) for s, r in batch if r.prefix_key is None]
-            prefixed = [(s, r) for s, r in batch
-                        if r.prefix_key is not None]
+            plain, chunked, prefixed = [], [], []
+            for s, r in batch:
+                if r.prefix_key is not None:
+                    prefixed.append((s, r))
+                elif self._needs_chunk(r):
+                    chunked.append((s, r))
+                else:
+                    plain.append((s, r))
 
             # grouped plain prefill, one call per pad bucket
             by_bucket: Dict[int, list] = {}
@@ -735,12 +933,12 @@ class ContinuousDecoder:
             for group in by_bucket.values():
                 logits, row_cache = self._prefill_group(
                     [r for _, r in group])
-                self._insert_rows(group, logits, row_cache)
-
+                if not self._insert_rows(group, logits, row_cache):
+                    self._requeue(group)
+                    return
             for slot, req in prefixed:
                 try:
-                    logits, row_cache = self._prompt_cache_for(
-                        req, req.prompt.size)
+                    ok = self._admit_prefixed(slot, req)
                 except ValueError as e:
                     # request-level validation (e.g. prefix mismatch)
                     # fails ALONE: slot freed, waiter woken with the
@@ -754,7 +952,15 @@ class ContinuousDecoder:
                     req.event.set()
                     self._release(slot)
                     continue
-                self._insert_rows([(slot, req)], logits, row_cache)
+                if not ok:
+                    self._requeue([(slot, req)])
+                    return
+            # long prompts admit into chunked prefill LAST: on page
+            # exhaustion everything already admitted above stays admitted
+            for i, (slot, req) in enumerate(chunked):
+                if not self._begin_chunked(slot, req):
+                    self._requeue(chunked[i:])
+                    return
             # loop: slots may have freed (eos/max_new on the first token)
             # while waiters remain — constant stack, unlike recursion
 
@@ -809,7 +1015,8 @@ class ContinuousDecoder:
                 self._padded_rows(len(u[0])) for u in self._staged)
             take = []
             bucket = None
-            while self._waiting and self._waiting[0].prefix_key is None:
+            while (self._waiting and self._waiting[0].prefix_key is None
+                   and not self._needs_chunk(self._waiting[0])):
                 b = self._bucket(self._waiting[0].prompt.size)
                 if bucket is None:
                     bucket = b
@@ -835,7 +1042,72 @@ class ContinuousDecoder:
             self.stats.get("staged_prefills", 0) + 1)
         self._staged.append([take, logits, row_cache, 0])
 
-    def _insert_rows(self, group, logits, row_cache):
+    # ---- page bookkeeping ----
+    def _need(self, prompt_len: int, max_new: int) -> int:
+        """Cache positions a request must own: prompt + every emittable
+        token + the speculative verify window's optimistic tail."""
+        return (prompt_len + max_new
+                + (self._gamma_max + 1 if self._spec else 0))
+
+    def _upload_bt(self):
+        """Re-publish the host block table to device (a few KB — cheap
+        relative to any dispatch that reads it)."""
+        self._bt = jnp.asarray(self._bt_host)
+
+    def _set_bt_row(self, slot: int, pages, upload: bool = True):
+        self._bt_host[slot, :] = 0
+        self._bt_host[slot, :len(pages)] = pages
+        if upload:
+            self._upload_bt()
+
+    def _alloc_with_pressure(self, n: int,
+                             protect: Optional[str] = None) -> List[int]:
+        """Allocate ``n`` pages, evicting cached prefixes oldest-first
+        under pressure (``protect`` shields the key being admitted
+        against). Raises :class:`PoolExhausted` once nothing is left to
+        evict."""
+        while True:
+            try:
+                return self._kv.alloc(n)
+            except PoolExhausted:
+                victim = next((k for k in self._prefix_store
+                               if k != protect), None)
+                if victim is None:
+                    raise
+                _, phash, _ = self._prefix_store.pop(victim)
+                self._kv.release_prefix(phash)
+
+    def _ensure_pages(self, group):
+        """Allocate pages + block-table rows for every slot in ``group``
+        that has none yet. Atomic: on exhaustion every allocation made
+        here is rolled back before the raise."""
+        fresh = []
+        try:
+            for slot, req in group:
+                if self._slot_pages[slot] is not None:
+                    continue
+                n = self._kv.pages_per_slot(
+                    self._need(req.prompt.size, req.max_new))
+                fresh.append((slot, self._alloc_with_pressure(n)))
+        except PoolExhausted:
+            for _, pages in fresh:
+                self._kv.free(pages)
+            raise
+        for slot, pages in fresh:
+            self._slot_pages[slot] = pages
+            self._set_bt_row(slot, pages, upload=False)
+        if fresh:
+            self._upload_bt()
+
+    def _requeue(self, group):
+        """Back out an admission the pool couldn't hold: slots freed,
+        requests back at the FRONT of the queue, order intact."""
+        with self._lock:
+            self._waiting[:0] = [r for _, r in group]
+            for slot, _ in group:
+                self._slot_req[slot] = None
+
+    def _insert_rows(self, group, logits, row_cache) -> bool:
         """Slot insertion + first-token emission for an admitted group.
 
         One device dispatch (``_insert_group_j``) and ONE host fetch per
@@ -847,19 +1119,36 @@ class ContinuousDecoder:
         measured a 23 s first-token stall from exactly this). Chunking to
         descending powers of two caps the program count at log2(S)+1.
         ``logits``/``row_cache`` may carry pad rows past ``len(group)``;
-        only the first g rows are used."""
+        only the first g rows are used. Returns False (nothing inserted)
+        when the page pool cannot hold the group."""
+        try:
+            self._ensure_pages(group)
+        except PoolExhausted:
+            return False
+        n_t = self._cfg.layers
         off = 0
         while off < len(group):
             size = 1 << ((len(group) - off).bit_length() - 1)
-            self._insert_chunk(group[off:off + size],
-                               logits[off:off + size],
-                               [{kk: c[kk][off:off + size]
-                                 for kk in ("k", "v")} for c in row_cache])
+            sl = slice(off, off + size)
+            self._insert_chunk(
+                group[sl], logits[sl],
+                [{kk: c[kk][sl] for kk in ("k", "v")}
+                 for c in row_cache[:n_t]],
+                [{kk: c[kk][sl] for kk in ("k", "v")}
+                 for c in row_cache[n_t:]])
             off += size
+        return True
 
-    def _insert_chunk(self, group, logits, row_cache):
+    def _insert_chunk(self, group, logits, rows_t, rows_d):
+        """One compiled insert: scatter target rows into the slots' pages
+        (``rows_t`` empty for state-only activation — prefix hits and
+        chunked prefills already wrote their K/V), write draft rows into
+        the draft slot pool, set the per-slot decode state, and queue the
+        first tokens on the drain pipeline. Pages must already be
+        assigned (:meth:`_ensure_pages`)."""
         g = len(group)
-        slots_v = jnp.asarray([s for s, _ in group], jnp.int32)
+        slots = [s for s, _ in group]
+        slots_v = jnp.asarray(slots, jnp.int32)
         lens_v = jnp.asarray([r.prompt.size for _, r in group], jnp.int32)
         rems_v = jnp.asarray([r.max_new - 1 for _, r in group], jnp.int32)
         temps_v = jnp.asarray([r.temperature for _, r in group], jnp.float32)
@@ -869,24 +1158,28 @@ class ContinuousDecoder:
                             for _, r in group]).astype(jnp.uint32)
         firsts = self._first_tokens(logits[:g], temps_v, topks_v, topps_v,
                                     keys_v, lens_v)
-        rows = [{kk: c[kk][:g] for kk in ("k", "v")} for c in row_cache]
-        sample_state = (self._temp, self._topk, self._topp, self._key)
-        # in spec mode the row list carries target + draft rows; the
-        # insert zips them against the concatenated pools and the result
-        # splits back at the target layer count
-        pool = (self._cache + self._d_cache if self._spec
-                else self._cache)
-        (pool, self._tok, self._pos, self._active, self._remaining,
-         sample_state) = self._insert_group_j(
-            pool, slots_v, rows, self._tok, self._pos,
-            self._active, self._remaining, firsts, lens_v, rems_v,
-            sample_state, (temps_v, topks_v, topps_v, keys_v))
-        if self._spec:
-            n_t = self._cfg.layers
-            self._cache, self._d_cache = pool[:n_t], pool[n_t:]
+        if rows_t:
+            n_pages = -(-rows_t[0]["k"].shape[2] // self._page)
+            page_rows = jnp.asarray(self._bt_host[slots, :n_pages],
+                                    jnp.int32)
         else:
-            self._cache = pool
+            page_rows = jnp.zeros((g, 1), jnp.int32)
+        d_cache = self._d_cache if self._spec else []
+        sample_state = (self._temp, self._topk, self._topp, self._key)
+        (bufs, d_cache, self._tok, self._pos, self._active,
+         self._remaining, sample_state) = self._insert_group_j(
+            self._kv.buffers, d_cache, slots_v, rows_t, rows_d, page_rows,
+            self._tok, self._pos, self._active, self._remaining,
+            firsts, lens_v, rems_v, sample_state,
+            (temps_v, topks_v, topps_v, keys_v))
+        self._kv.buffers = bufs
+        if self._spec:
+            self._d_cache = d_cache
         self._temp, self._topk, self._topp, self._key = sample_state
+        _tracing.add_event(
+            "kv_insert", slots=g,
+            pages=sum(len(self._slot_pages[s] or ()) for s in slots),
+            scattered_rows=g if rows_t else 0)
         # the first tokens ride the drain pipeline as a (1, g) block
         # instead of a synchronous fetch here (~RTT on the admission
         # critical path). Queued BEFORE any subsequent tick block, so
@@ -911,14 +1204,21 @@ class ContinuousDecoder:
         ids[0, :tokens.size] = tokens
         return ids
 
-    def _prompt_cache_for(self, req: _Request, P: int):
-        """Last-prompt-token logits + a (1, H, L, hd) row cache for the
-        request's prompt — via full prefill, or a stored prefix plus one
-        suffix window when ``prefix_key`` hits."""
-        hit = (self._prefix_store.get(req.prefix_key)
-               if req.prefix_key is not None else None)
+    def _admit_prefixed(self, slot: int, req: _Request) -> bool:
+        """Admit a ``prefix_key`` request into ``slot``.
+
+        Hit: the first pages of the stored prefix are SHARED physically
+        (refcount bump — copy-on-write; only the boundary page the new
+        request will write into is copied), private pages cover the rest
+        of the request's budget, and one window forward computes the
+        suffix. Miss: full prefill into the slot's own pages, then those
+        prefix pages register in the pool for the next request to share.
+        Raises ValueError on prefix mismatch (fail-alone contract);
+        returns False when the pool cannot hold the request."""
+        P = req.prompt.size
+        hit = self._prefix_store.get(req.prefix_key)
         if hit is not None:
-            stored_toks, stored_cache, plen = hit
+            stored_toks, phash, plen = hit
             # a caller-declared prefix_len shorter than the stored prefix
             # is honored: reuse just that much (the window rewrites the
             # rest), so one stored key serves nested prefixes
@@ -929,68 +1229,157 @@ class ContinuousDecoder:
                 raise ValueError(
                     f"prefix_key {req.prefix_key!r}: prompt does not "
                     f"start with the stored {plen}-token prefix")
+            # whole-prompt hits re-run the last prefix token — one row —
+            # to recover its logits
+            start = plen if P > plen else plen - 1
+            #: pages strictly below the write boundary are shared; the
+            #: boundary page itself is COPIED (the suffix window writes
+            #: into it, and shared pages are never written)
+            s0 = start // self._page
+            n_total = self._kv.pages_per_slot(self._need(P, req.max_new))
+            try:
+                private = self._alloc_with_pressure(
+                    n_total - s0, protect=req.prefix_key)
+            except PoolExhausted:
+                return False
+            pages_stored, _ = self._kv.acquire_prefix(phash, s0)
+            shared = list(pages_stored[:s0])
+            n_copy = -(-plen // self._page) - s0
+            if n_copy > 0:
+                self._kv.buffers = self._copy_pages_j(
+                    self._kv.buffers,
+                    jnp.asarray(pages_stored[s0:s0 + n_copy], jnp.int32),
+                    jnp.asarray(private[:n_copy], jnp.int32))
+            self._slot_pages[slot] = shared + private
+            self._set_bt_row(slot, shared + private)
             self.stats["prefix_hits"] += 1
             _M_PREFIX_HITS.inc()
             # LRU promotion: the hit entry becomes the newest
             self._prefix_store[req.prefix_key] = \
                 self._prefix_store.pop(req.prefix_key)
-            # suffix window (whole-prompt hits re-run the last prefix
-            # token — one row — to recover its logits). Bucketed pad: the
-            # garbage K/V a padded row writes sits at positions the
-            # engine overwrites before any mask ever exposes them.
-            # The snapshot passes to _extend as-is: the jit has no
-            # donation, so its inputs are never consumed (and the group
-            # insert does not donate its row_cache arg either — rows are
-            # copied into the slot pool).
-            start = plen if P > plen else plen - 1
+            # suffix window over the slot's own pages. Bucketed pad: the
+            # garbage K/V a padded lane writes sits at positions the
+            # engine overwrites before any mask ever exposes them (or
+            # past the allocation, where the block table routes it to
+            # the trash page).
             suffix = req.prompt[start:]
-            S = suffix.size
+            Sn = suffix.size
             ids = self._padded_ids(suffix, self._L - start)
-            # snapshots store only the prefix region; rebuild the
-            # full-length rows (everything past plen is garbage the
-            # window/decode overwrites before any mask exposes it)
-            full = [{k: jnp.pad(c[k], ((0, 0), (0, 0),
-                                       (0, self._L - c[k].shape[2]),
-                                       (0, 0)))
-                     for k in ("k", "v")} for c in stored_cache]
-            w_logits, row_cache = self._extend(
-                self._params, jnp.asarray(ids), jnp.int32(start), full)
-            return self._with_draft_rows(req, w_logits[:, S - 1],
-                                         row_cache)
-        # full prefill; cap the pad bucket at max_len: a 40-token prompt
-        # in a 48-len cache must not inflate to a 64-wide prefill
+            w_logits, bufs = self._extend_paged(
+                self._params, jnp.asarray(ids),
+                jnp.asarray([start], jnp.int32),
+                self._kv.buffers, self._bt[slot:slot + 1])
+            self._kv.buffers = bufs
+            self._insert_chunk([(slot, req)], w_logits[:, Sn - 1], [],
+                               self._draft_prompt_rows(req))
+            return True
+        # miss: full prefill into the slot's own pages; cap the pad
+        # bucket at max_len (a 40-token prompt in a 48-len cache must
+        # not inflate to a 64-wide prefill)
+        try:
+            self._ensure_pages([(slot, req)])
+        except PoolExhausted:
+            return False
         ids = self._padded_ids(req.prompt, self._L)
         logits, row_cache = self._prefill(
             self._params, jnp.asarray(ids), jnp.asarray([P], jnp.int32))
         self.stats["prefills"] += 1
         _M_PREFILLS.inc()
-        if req.prefix_key is not None and self._prefix_store_cap > 0:
-            # store-on-miss: snapshot ONLY the prefix region (a copy,
-            # bounding snapshot size to the prefix — full-length rows
-            # would hold max_len KV per entry)
+        self._insert_chunk(
+            [(slot, req)], logits,
+            [{kk: c[kk] for kk in ("k", "v")} for c in row_cache],
+            self._draft_prompt_rows(req))
+        if self._prefix_store_cap > 0:
+            # register-on-miss AFTER the insert scattered the rows: the
+            # prefix's pages exist only now. The registry increfs them,
+            # so they outlive this request's retirement. The slot's own
+            # later writes land at positions >= P >= plen — never inside
+            # the trusted prefix region (the boundary page's tail may go
+            # stale, but every joining request COPIES that page and
+            # rewrites the tail before exposing it).
             plen = req.prefix_len if req.prefix_len is not None else P
-            snap = [{k: jnp.array(c[k][:, :, :plen]) for k in ("k", "v")}
-                    for c in row_cache]
+            phash = _prefix_hash(req.prompt[:plen])
+            self._kv.register_prefix(
+                phash, self._slot_pages[slot][:-(-plen // self._page)],
+                plen)
             if len(self._prefix_store) >= self._prefix_store_cap:
-                self._prefix_store.pop(next(iter(self._prefix_store)))
+                _, old_hash, _ = self._prefix_store.pop(
+                    next(iter(self._prefix_store)))
+                self._kv.release_prefix(old_hash)
             self._prefix_store[req.prefix_key] = (
-                req.prompt[:plen].copy(), snap, plen)
-        return self._with_draft_rows(req, logits, row_cache)
+                req.prompt[:plen].copy(), phash, plen)
+        return True
 
-    def _with_draft_rows(self, req: _Request, logits, row_cache):
-        """Spec mode: append the draft's full-prompt prefill rows — ONE
-        enforcement point for the row-list convention (target layers then
-        draft layers) that ``_insert_chunk``'s pool zip expects. The
-        draft always re-prefills the whole prompt (a draft is cheap by
-        construction); the prefix store never holds draft rows — its
-        store-on-miss snapshot runs before this append."""
+    def _draft_prompt_rows(self, req: _Request):
+        """Spec mode: the draft's full-prompt prefill rows (the draft
+        always re-prefills the whole prompt — a draft is cheap by
+        construction). Empty list otherwise — the insert program's
+        rows_d slot."""
         if not self._spec:
-            return logits, row_cache
+            return []
         ids = jnp.asarray(self._padded_ids(req.prompt, self._L))
         _, d_rows = self._d_prefill(
             self._d_params, ids,
             jnp.asarray([req.prompt.size], np.int32))
-        return logits, list(row_cache) + list(d_rows)
+        return [{kk: c[kk] for kk in ("k", "v")} for c in d_rows]
+
+    # ---- chunked prefill ----
+    def _chunk_budget(self) -> int:
+        return self._tuner.chunk if self._tuner is not None else self._chunk
+
+    def _needs_chunk(self, req: _Request) -> bool:
+        """Long plain prompts prefill in budget-bounded chunks instead of
+        one monolithic forward (prefix-cache requests keep the suffix
+        path — their windows are already short)."""
+        return req.prefix_key is None and req.prompt.size > self._chunk_budget()
+
+    def _begin_chunked(self, slot: int, req: _Request) -> bool:
+        """Assign pages + block table and park the request in the chunk
+        scheduler. The slot is OCCUPIED but device-inactive — decode
+        ticks skip it until the final chunk activates it."""
+        try:
+            self._ensure_pages([(slot, req)])
+        except PoolExhausted:
+            return False
+        self._chunking[slot] = [req, 0]
+        return True
+
+    def _advance_chunks(self):
+        """Run ONE prefill chunk for the oldest prefilling slot — at most
+        one window forward per engine tick, so decode ticks interleave
+        with long-prompt prefill and no tick's prefill work exceeds the
+        chunk budget. The final chunk computes the first token and
+        activates the slot through the state-only insert."""
+        if not self._chunking:
+            return
+        slot = next(iter(self._chunking))
+        req, off = self._chunking[slot]
+        P = req.prompt.size
+        w = min(self._chunk_budget(), P - off)
+        ids = self._padded_ids(req.prompt[off:off + w], self._L - off)
+        with _prof_span("continuous.prefill_chunk", slot=slot,
+                        offset=off, tokens=w):
+            w_logits, bufs = self._extend_paged(
+                self._params, jnp.asarray(ids),
+                jnp.asarray([off], jnp.int32),
+                self._kv.buffers, self._bt[slot:slot + 1])
+        self._kv.buffers = bufs
+        self._kv.note_prefill_chunk(w)
+        self._chunk_trace.append(w)
+        _tracing.add_event("prefill_chunk", slot=slot, offset=off,
+                           tokens=w)
+        off += w
+        if off < P:
+            self._chunking[slot][1] = off
+            return
+        del self._chunking[slot]
+        self.stats["prefills"] += 1
+        _M_PREFILLS.inc()
+        # first token from the last REAL lane of the final window —
+        # logits after consuming prompt position P-1, sampled at emit
+        # position P: generate_cached's exact schedule
+        self._insert_chunk([(slot, req)], w_logits[:, w - 1], [],
+                           self._draft_prompt_rows(req))
 
     def _note_token(self, req: _Request, tok: int):
         now = time.perf_counter()
@@ -1006,6 +1395,41 @@ class ContinuousDecoder:
     def _release(self, slot: int):
         self._slot_req[slot] = None
         self._active = self._active.at[slot].set(False)
+        self._chunking.pop(slot, None)
+        pages = self._slot_pages[slot]
+        if pages:
+            # decref (prefix-shared pages survive under their registry
+            # refs). The DEVICE block-table row stays stale on purpose:
+            # in-flight ticks captured it legitimately, and future ticks
+            # see active=False, whose writebacks route to the trash page
+            # — a freed page can never be corrupted through a stale row.
+            self._kv.free(pages)
+            self._slot_pages[slot] = None
+            self._bt_host[slot, :] = 0
+            self._maybe_compact()
+
+    def _maybe_compact(self):
+        """Defrag on retire: when the pool's live span drifts past the
+        threshold, pack live pages dense with ONE device gather and remap
+        every host page reference. Safe under pipelining — the gather
+        consumes the same buffer refs the in-flight ticks produce, so
+        device program order serializes them."""
+        if not self._kv.should_compact(self._defrag_thr):
+            return
+        remap = self._kv.compact()
+        if remap is None:
+            return
+        perm = np.empty_like(remap)
+        perm[remap] = np.arange(remap.size)
+        self._kv.buffers = self._compact_j(
+            self._kv.buffers, jnp.asarray(perm, jnp.int32))
+        self._bt_host = remap[self._bt_host].astype(np.int32)
+        self._slot_pages = [
+            None if p is None else [int(remap[x]) for x in p]
+            for p in self._slot_pages]
+        self._upload_bt()
+        _tracing.add_event("kv_compact",
+                           pages_in_use=self._kv.pages_in_use)
 
     def step(self) -> int:
         """One engine tick; returns the number of live slots stepped.
@@ -1037,6 +1461,10 @@ class ContinuousDecoder:
                    and self._retirement_in_flight()):
                 self._drain_one()
         self._admit()
+        # one prefill chunk per tick, interleaved with the decode below —
+        # this IS the chunked-prefill scheduler: long prompts never run
+        # more than chunk-budget prefill work in any one tick
+        self._advance_chunks()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
         _M_LIVE_SLOTS.set(len(live))
         if not live:
@@ -1046,44 +1474,66 @@ class ContinuousDecoder:
                 self._drain_one()
                 return 1
             return 0
+        # slots mid-chunked-prefill are occupied but device-INACTIVE:
+        # they must stay out of the tick snapshot (their device lanes
+        # would replay tok=0 repeats as real tokens) and out of the
+        # temperature checks
+        decode_live = [i for i in live if i not in self._chunking]
+        if self._tuner is not None:
+            self._tuner.observe(
+                len(live), self._S,
+                self.stats.get("spec_emitted") if self._spec else None,
+                self.stats.get("spec_round_slots") if self._spec else None)
+        if not decode_live:
+            # everything live is still prefilling — the chunk above was
+            # this tick's work
+            while len(self._pending) > self._depth:
+                self._drain_one()
+            return len(live)
         if self._spec:
-            if any(self._slot_req[i].temperature > 0.0 for i in live):
+            gamma_now = (self._tuner.gamma if self._tuner is not None
+                         else self._gamma)
+            if any(self._slot_req[i].temperature > 0.0
+                   for i in decode_live):
                 warps = any(self._slot_req[i].temperature > 0.0
                             and (self._slot_req[i].top_k > 0
                                  or self._slot_req[i].top_p < 1.0)
-                            for i in live)
+                            for i in decode_live)
                 tick = functools.partial(
-                    self._spec_tick_warped if warps
-                    else self._spec_tick_sampled,
+                    self._spec_tick_for("warped" if warps else "sampled",
+                                        gamma_now),
                     temp=self._temp, key=self._key,
                     topk=self._topk, topp=self._topp)
             else:
-                tick = self._spec_tick
-            (self._tok, self._pos, self._active, self._cache,
+                tick = self._spec_tick_for("greedy", gamma_now)
+            (self._tok, self._pos, self._active, bufs,
              self._d_cache, self._remaining, toks) = tick(
                 self._params, self._d_params, self._tok, self._pos,
-                self._active, self._cache, self._d_cache,
+                self._active, self._kv.buffers, self._bt, self._d_cache,
                 self._remaining)
+            self._kv.buffers = bufs
             self.stats["spec_round_slots"] = (
                 self.stats.get("spec_round_slots", 0)
-                + self._k * len(live))
-        elif any(self._slot_req[i].temperature > 0.0 for i in live):
-            (self._tok, self._pos, self._active, self._cache,
+                + self._k * len(decode_live))
+        elif any(self._slot_req[i].temperature > 0.0 for i in decode_live):
+            (self._tok, self._pos, self._active, bufs,
              self._remaining, toks) = self._tick_sampled(
                 self._params, self._tok, self._pos, self._active,
-                self._cache, self._remaining,
+                self._kv.buffers, self._bt, self._remaining,
                 self._temp, self._topk, self._topp, self._key)
+            self._kv.buffers = bufs
         else:
-            (self._tok, self._pos, self._active, self._cache,
+            (self._tok, self._pos, self._active, bufs,
              self._remaining, toks) = self._tick(
                 self._params, self._tok, self._pos, self._active,
-                self._cache, self._remaining)
+                self._kv.buffers, self._bt, self._remaining)
+            self._kv.buffers = bufs
         # snapshot slot→REQUEST (not indices): by the time this block is
         # drained, a slot may have been freed and re-admitted; tokens must
         # go to the request that occupied the slot at DISPATCH time (its
         # done guard discards the inactive-slot repeats)
         self._pending.append((toks, {i: (i, self._slot_req[i])
-                                     for i in live}))
+                                     for i in decode_live}))
         # prefill-ahead: with the decode block dispatched (device busy for
         # k steps), background-prefill waiting prompts into the stage
         if self._stage_cap:

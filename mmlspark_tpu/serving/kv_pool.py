@@ -1,0 +1,376 @@
+"""Paged KV pool — vLLM-style page-granular cache management for serving.
+
+`serving/continuous.py` historically gave every slot a contiguous
+``(H, max_len, hd)`` cache region: simple, but each slot pins worst-case
+memory, prefix reuse needs a device copy into the slot, and a retiring
+short request strands the tail of its region. This module supplies the
+PagedAttention answer (PAPERS.md: vLLM) at the allocator level:
+
+* **pages** — the physical cache is ``(num_pages, H, page_size, hd)`` per
+  layer (`models/zoo/transformer.init_paged_cache`); requests are sized in
+  pages for the tokens they can actually produce, not ``max_len``;
+* **block tables** — each slot owns a row of physical page ids; attention
+  gathers through it (`decode_step_paged` / `decode_window_paged`) and the
+  result is bitwise-equal to the contiguous path;
+* **copy-on-write prefix sharing** — whole pages of a cached prompt prefix
+  are shared across requests by bumping a refcount; only the boundary page
+  (which the new request will write into) is copied. Shared pages are
+  never written: the first writable position of a joining request always
+  lands at or past the copy boundary;
+* **defrag on retire** — frees go back to a min-heap (lowest index first,
+  keeping the live span dense); when the live span still drifts past the
+  in-use count by `defrag threshold` pages, :meth:`compact` returns a
+  permutation the engine applies with one device gather;
+* **residency budgeting** — the pool's device bytes are pinned against the
+  `ResidencyManager` budget (PR 6) via a fixed reservation, so KV pressure
+  evicts LRU *data* columns instead of silently overcommitting HBM.
+
+Physical page 0 is the **trash page**: never allocated, the redirect
+target for inactive-row writebacks and for block-table entries past a
+row's allocation. Its contents are garbage by design and never read
+(attention masks trim reads to each row's true length).
+
+The pool is host-side bookkeeping plus a handle to the device buffers;
+all methods assume the caller (the engine) serializes access under its
+own lock — there is no internal locking.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.residency import get_residency_manager
+from ..observability import (counter as _metric_counter,
+                             gauge as _metric_gauge)
+
+__all__ = ["PagedKVPool", "PoolExhausted", "KVAutotuner", "prefix_hash"]
+
+M_PAGES_TOTAL = _metric_gauge(
+    "mmlspark_kvpool_pages_total",
+    "Physical KV pages in the pool (excluding the trash page)")
+M_PAGES_IN_USE = _metric_gauge(
+    "mmlspark_kvpool_pages_in_use",
+    "KV pages currently referenced by a slot or a cached prefix")
+M_PREFIX_SHARE_HITS = _metric_counter(
+    "mmlspark_kvpool_prefix_share_hits_total",
+    "Physical pages shared into an admitted request from a cached prefix "
+    "(each shared page counts once per acquiring request)")
+M_DEFRAG_MOVES = _metric_counter(
+    "mmlspark_kvpool_defrag_moves_total",
+    "Live pages relocated by compaction gathers")
+M_PREFILL_CHUNKS = _metric_counter(
+    "mmlspark_kvpool_prefill_chunks_total",
+    "Prefill chunks executed by the chunked-prefill scheduler")
+M_ALLOC_FAILURES = _metric_counter(
+    "mmlspark_kvpool_alloc_failures_total",
+    "Page allocations that failed even after prefix eviction")
+M_AUTOTUNE_GAMMA = _metric_gauge(
+    "mmlspark_kvpool_autotune_gamma",
+    "Current speculative draft length chosen by the KV autotuner")
+M_AUTOTUNE_CHUNK = _metric_gauge(
+    "mmlspark_kvpool_autotune_chunk_budget",
+    "Current prefill chunk budget (tokens) chosen by the KV autotuner")
+
+
+def prefix_hash(tokens: Sequence[int]) -> str:
+    """Stable content hash for a prompt prefix (the prefix-registry key)."""
+    h = hashlib.sha1()
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.hexdigest()
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages left — the engine sheds load or evicts prefixes."""
+
+
+class PagedKVPool:
+    """Page allocator + device buffer handle for one model's KV cache.
+
+    ``buffers`` is the per-layer list of ``{"k","v"}`` page arrays the
+    engine threads through its jitted steps (reassigning after every
+    dispatch, since XLA returns fresh buffers). Everything else is host
+    bookkeeping: a free min-heap over pages ``[1, num_pages)``, per-page
+    refcounts, and the shared-prefix registry.
+    """
+
+    def __init__(self, cfg, *, num_pages: int, page_size: int,
+                 make_buffer=None, residency: bool = True):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.cfg = cfg
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        hd = cfg.d_model // cfg.heads
+        shape = (self.num_pages, cfg.heads, self.page_size, hd)
+        self._mk = make_buffer or (lambda s, d: jnp.zeros(s, d))
+        self._shape = shape
+        self.buffers = [{"k": self._mk(shape, cfg.dtype),
+                         "v": self._mk(shape, cfg.dtype)}
+                        for _ in range(cfg.layers)]
+        self._free: List[int] = list(range(1, self.num_pages))
+        heapq.heapify(self._free)
+        self._refs = np.zeros(self.num_pages, np.int32)
+        # phash -> (pages tuple, prefix length in tokens)
+        self._prefixes: Dict[str, Tuple[Tuple[int, ...], int]] = {}
+        self.high_water = 0
+        self.stats = {"prefix_share_hits": 0, "defrag_moves": 0,
+                      "prefill_chunks": 0, "alloc_failures": 0}
+        M_PAGES_TOTAL.set(self.num_pages - 1)
+        M_PAGES_IN_USE.set(0)
+        self._reservation = None
+        if residency:
+            itemsize = jnp.dtype(cfg.dtype).itemsize
+            nbytes = 2 * cfg.layers * int(np.prod(shape)) * itemsize
+            mgr = get_residency_manager()
+            token = mgr.reserve(nbytes, label="kv_pool")
+            self._reservation = token
+            self._finalizer = weakref.finalize(self, mgr.release, token)
+
+    # -- allocation ----------------------------------------------------------
+
+    def pages_per_slot(self, length: int) -> int:
+        """Pages needed to hold ``length`` cache positions."""
+        return -(-int(length) // self.page_size)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` free pages (lowest physical index first — keeps the
+        live span dense so compaction rarely triggers). Raises
+        :class:`PoolExhausted` without partial effects."""
+        if n < 0:
+            raise ValueError("alloc() needs n >= 0")
+        if n > len(self._free):
+            self.stats["alloc_failures"] += 1
+            M_ALLOC_FAILURES.inc()
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"({self.pages_in_use}/{self.num_pages - 1} in use)")
+        pages = [heapq.heappop(self._free) for _ in range(n)]
+        self._refs[pages] += 1
+        self.high_water = max(self.high_water, self.pages_in_use)
+        M_PAGES_IN_USE.set(self.pages_in_use)
+        return pages
+
+    def incref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise ValueError(f"incref of free page {p}")
+        self._refs[list(pages)] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; refcount-0 pages return to the
+        free heap. Sharing makes double-free detectable: freeing an
+        already-free page raises."""
+        for p in pages:
+            p = int(p)
+            if p <= 0 or p >= self.num_pages or self._refs[p] <= 0:
+                raise ValueError(f"free of unallocated page {p}")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                heapq.heappush(self._free, p)
+        M_PAGES_IN_USE.set(self.pages_in_use)
+
+    # -- prefix sharing ------------------------------------------------------
+
+    def register_prefix(self, phash: str, pages: Sequence[int],
+                        plen: int) -> None:
+        """Retain ``pages`` (incref) as the cached cache-content of a
+        prompt prefix of ``plen`` tokens. Idempotent per hash."""
+        if phash in self._prefixes:
+            return
+        pages = tuple(int(p) for p in pages)
+        self.incref(pages)
+        self._prefixes[phash] = (pages, int(plen))
+
+    def lookup_prefix(self, phash: str):
+        """``(pages, plen)`` or None."""
+        return self._prefixes.get(phash)
+
+    def acquire_prefix(self, phash: str,
+                       n_shared: int) -> Tuple[Tuple[int, ...], int]:
+        """Share the first ``n_shared`` pages of a registered prefix into
+        a request (incref — copy-on-write: the request never writes
+        them). Returns the full (pages, plen) entry."""
+        pages, plen = self._prefixes[phash]
+        shared = pages[:n_shared]
+        self.incref(shared)
+        if shared:
+            self.stats["prefix_share_hits"] += len(shared)
+            M_PREFIX_SHARE_HITS.inc(len(shared))
+        return pages, plen
+
+    def release_prefix(self, phash: str) -> None:
+        """Drop a registered prefix's page references (idempotent)."""
+        entry = self._prefixes.pop(phash, None)
+        if entry is not None:
+            self.free(entry[0])
+
+    # -- defrag --------------------------------------------------------------
+
+    def fragmentation(self) -> int:
+        """Pages of dead space inside the live span: how far the highest
+        live page sits past where dense packing would put it."""
+        live = np.nonzero(self._refs[1:] > 0)[0]
+        if live.size == 0:
+            return 0
+        return int(live[-1] + 1) - int(live.size)
+
+    def should_compact(self, threshold: int) -> bool:
+        return self.fragmentation() >= max(1, int(threshold))
+
+    def compact(self) -> Optional[np.ndarray]:
+        """Pack live pages down to ``[1, n_live]``. Returns ``remap``
+        (old physical id -> new, a full permutation of ``[0, num_pages)``
+        with ``remap[0] == 0``) for the engine to (a) gather the device
+        buffers with its inverse and (b) rewrite block tables and every
+        host page list it holds — or None when nothing would move.
+        Internal refcounts, the free heap and the prefix registry are
+        rewritten here."""
+        live = (np.nonzero(self._refs > 0)[0]).astype(np.int64)
+        remap = np.zeros(self.num_pages, np.int64)
+        nxt = 1
+        moved = 0
+        for old in live:
+            if old == 0:
+                continue
+            remap[old] = nxt
+            if old != nxt:
+                moved += 1
+            nxt += 1
+        if moved == 0:
+            return None
+        # dead pages fill the remainder in index order (their contents are
+        # garbage either way; the permutation just has to be total)
+        dead = [p for p in range(1, self.num_pages) if self._refs[p] == 0]
+        for old in dead:
+            remap[old] = nxt
+            nxt += 1
+        new_refs = np.zeros_like(self._refs)
+        new_refs[remap] = self._refs
+        self._refs = new_refs
+        self._free = [int(remap[p]) for p in dead]
+        heapq.heapify(self._free)
+        self._prefixes = {
+            h: (tuple(int(remap[p]) for p in pages), plen)
+            for h, (pages, plen) in self._prefixes.items()}
+        self.stats["defrag_moves"] += moved
+        M_DEFRAG_MOVES.inc(moved)
+        return remap
+
+    # -- misc ----------------------------------------------------------------
+
+    def note_prefill_chunk(self, ntok: int) -> None:
+        self.stats["prefill_chunks"] += 1
+        M_PREFILL_CHUNKS.inc()
+
+    def reset(self) -> None:
+        """Forget every allocation and re-zero the device buffers (the
+        engine's abort path). Rebuilds through the construction-time
+        ``make_buffer`` so mesh shardings survive a reset."""
+        self.buffers = [{"k": self._mk(self._shape, self.cfg.dtype),
+                         "v": self._mk(self._shape, self.cfg.dtype)}
+                        for _ in range(self.cfg.layers)]
+        self._free = list(range(1, self.num_pages))
+        heapq.heapify(self._free)
+        self._refs[:] = 0
+        self._prefixes.clear()
+        M_PAGES_IN_USE.set(0)
+
+    def close(self) -> None:
+        """Release the residency reservation early (also runs at GC)."""
+        if self._reservation is not None:
+            self._finalizer()
+            self._reservation = None
+
+
+class KVAutotuner:
+    """Closed-loop tuner for speculative gamma and the prefill chunk budget.
+
+    Observations arrive once per engine tick; every ``interval`` ticks the
+    tuner turns the batch into two decisions:
+
+    * **gamma** (speculative draft length) follows the measured acceptance
+      rate. Each verify round emits ``accepted + 1`` tokens per live slot,
+      so ``acc = (emitted/round_slots - 1) / gamma``. High acceptance
+      (>= ``acc_hi``) means drafts are cheap wins -> gamma += 1 (up to
+      ``gamma_max``); low acceptance (<= ``acc_lo``) means wasted verify
+      width -> gamma -= 1 (floor 1). Changing gamma between rounds keeps
+      greedy output token-identical (accepted tokens are the target's own
+      argmax choices) and sampled output distributionally exact per round.
+    * **chunk budget** follows slot occupancy. A mostly-idle pool
+      (occupancy <= ``occ_lo``) can afford bigger prefill bites -> chunk
+      doubles (cap ``chunk_max``); a saturated pool (>= ``occ_hi``) needs
+      decode latency bounded tighter -> chunk halves (floor ``chunk_min``).
+      The power-of-two ladder keeps the window-width compile set small.
+    """
+
+    def __init__(self, *, gamma: int, gamma_max: int, chunk: int,
+                 chunk_min: int = 32, chunk_max: int = 1024,
+                 interval: int = 32, acc_lo: float = 0.55,
+                 acc_hi: float = 0.85, occ_lo: float = 0.25,
+                 occ_hi: float = 0.75):
+        self.gamma = int(gamma)
+        self.gamma_max = int(gamma_max)
+        self.chunk = int(chunk)
+        self.chunk_min = int(chunk_min)
+        self.chunk_max = int(chunk_max)
+        self.interval = max(1, int(interval))
+        self.acc_lo, self.acc_hi = float(acc_lo), float(acc_hi)
+        self.occ_lo, self.occ_hi = float(occ_lo), float(occ_hi)
+        self.history: List[Dict] = []
+        self._ticks = 0
+        self._occ_sum = 0.0
+        self._emitted0 = 0
+        self._rounds0 = 0
+        M_AUTOTUNE_GAMMA.set(self.gamma)
+        M_AUTOTUNE_CHUNK.set(self.chunk)
+
+    def observe(self, live: int, slots: int, spec_emitted: Optional[int] = None,
+                spec_round_slots: Optional[int] = None) -> None:
+        """One engine tick: ``live`` occupied of ``slots`` total, plus the
+        engine's cumulative speculative counters (deltas are taken here)."""
+        self._ticks += 1
+        self._occ_sum += live / max(1, slots)
+        if self._ticks < self.interval:
+            return
+        occ = self._occ_sum / self._ticks
+        self._ticks = 0
+        self._occ_sum = 0.0
+        if spec_emitted is not None and spec_round_slots is not None:
+            d_emit = spec_emitted - self._emitted0
+            d_rounds = spec_round_slots - self._rounds0
+            self._emitted0, self._rounds0 = spec_emitted, spec_round_slots
+            if d_rounds > 0 and self.gamma > 0:
+                acc = (d_emit / d_rounds - 1.0) / self.gamma
+                if acc >= self.acc_hi and self.gamma < self.gamma_max:
+                    self._set_gamma(self.gamma + 1, acc)
+                elif acc <= self.acc_lo and self.gamma > 1:
+                    self._set_gamma(self.gamma - 1, acc)
+        if occ <= self.occ_lo and self.chunk * 2 <= self.chunk_max:
+            self._set_chunk(self.chunk * 2, occ)
+        elif occ >= self.occ_hi and self.chunk // 2 >= self.chunk_min:
+            self._set_chunk(self.chunk // 2, occ)
+
+    def _set_gamma(self, g: int, acc: float) -> None:
+        self.history.append({"knob": "gamma", "from": self.gamma, "to": g,
+                             "acceptance": round(acc, 4)})
+        self.gamma = g
+        M_AUTOTUNE_GAMMA.set(g)
+
+    def _set_chunk(self, c: int, occ: float) -> None:
+        self.history.append({"knob": "chunk", "from": self.chunk, "to": c,
+                             "occupancy": round(occ, 4)})
+        self.chunk = c
+        M_AUTOTUNE_CHUNK.set(c)

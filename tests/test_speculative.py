@@ -19,7 +19,8 @@ from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
                                                  init_transformer,
                                                  prefill_cache)
 from mmlspark_tpu.models.zoo.speculative import (generate_speculative,
-                                                 generate_speculative_fused)
+                                                 generate_speculative_fused,
+                                                 generate_speculative_paged)
 
 
 def cfg_pair(position="rope", vocab=64):
@@ -149,6 +150,26 @@ class TestSpeculative:
         np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
         assert stats["target_forwards"] <= 2 + (max_new - 1) // (gamma + 1) + 1, \
             stats
+
+    @pytest.mark.parametrize("page_size", [3, 8])
+    def test_paged_matches_loop_and_target(self, page_size):
+        """The paged-target variant (block-table gather, CoW-style page
+        layout) is token-identical to the contiguous loop — paging moves
+        bytes, never changes tokens."""
+        t_params, d_params, t_cfg, d_cfg = make_models()
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(0, t_cfg.vocab, (2, 7)))
+        loop, lstats = generate_speculative(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            max_new_tokens=16, gamma=3)
+        paged, pstats = generate_speculative_paged(
+            t_params, d_params, prompt, t_cfg, d_cfg,
+            max_new_tokens=16, gamma=3, page_size=page_size)
+        assert np.array_equal(np.asarray(loop), np.asarray(paged))
+        assert pstats["accepted_drafts"] == lstats["accepted_drafts"]
+        target = generate_cached(t_params, prompt, t_cfg,
+                                 max_new_tokens=16, temperature=0.0)
+        assert np.array_equal(np.asarray(paged), np.asarray(target))
 
     def test_vocab_mismatch_rejected(self):
         t_params, d_params, t_cfg, d_cfg = make_models()
