@@ -925,18 +925,26 @@ class ContinuousDecoder:
                 else:
                     plain.append((s, r))
 
-            # grouped plain prefill, one call per pad bucket
             by_bucket: Dict[int, list] = {}
             for s, r in plain:
                 by_bucket.setdefault(self._bucket(r.prompt.size),
                                      []).append((s, r))
-            for group in by_bucket.values():
+            # grouped plain prefill, one call per pad bucket. On ANY
+            # insertion failure below, the failed request AND every
+            # still-uninserted assigned request (later bucket groups,
+            # remaining prefixed, all chunked) must go back to the
+            # queue together: a request left in _slot_req with no pages
+            # counts as decode_live, so the tick would replay its stale
+            # device lanes as real tokens until max_new "completes" it.
+            groups = list(by_bucket.values())
+            for gi, group in enumerate(groups):
                 logits, row_cache = self._prefill_group(
                     [r for _, r in group])
                 if not self._insert_rows(group, logits, row_cache):
-                    self._requeue(group)
+                    self._requeue([p for g in groups[gi:] for p in g]
+                                  + prefixed + chunked)
                     return
-            for slot, req in prefixed:
+            for pi, (slot, req) in enumerate(prefixed):
                 try:
                     ok = self._admit_prefixed(slot, req)
                 except ValueError as e:
@@ -953,7 +961,7 @@ class ContinuousDecoder:
                     self._release(slot)
                     continue
                 if not ok:
-                    self._requeue([(slot, req)])
+                    self._requeue(prefixed[pi:] + chunked)
                     return
             # long prompts admit into chunked prefill LAST: on page
             # exhaustion everything already admitted above stays admitted
@@ -1068,11 +1076,16 @@ class ContinuousDecoder:
         evict."""
         while True:
             try:
-                return self._kv.alloc(n)
+                # transient exhaustions resolved by the eviction below
+                # must not count as alloc_failures — only the terminal
+                # one (nothing evictable left) matches that metric's
+                # meaning ("failed even after prefix eviction")
+                return self._kv.alloc(n, count_failure=False)
             except PoolExhausted:
                 victim = next((k for k in self._prefix_store
                                if k != protect), None)
                 if victim is None:
+                    self._kv.note_alloc_failure()
                     raise
                 _, phash, _ = self._prefix_store.pop(victim)
                 self._kv.release_prefix(phash)
@@ -1512,9 +1525,11 @@ class ContinuousDecoder:
                 self._active, self._kv.buffers, self._bt, self._d_cache,
                 self._remaining)
             self._kv.buffers = bufs
-            self.stats["spec_round_slots"] = (
-                self.stats.get("spec_round_slots", 0)
-                + self._k * len(decode_live))
+            # round-slot accounting happens at DRAIN time (_drain_one),
+            # from the same block that feeds spec_emitted: counting
+            # dispatched slots here would include lanes already retired
+            # on device, skewing the autotuner's acceptance estimate
+            # low for the whole pipeline_depth window
         elif any(self._slot_req[i].temperature > 0.0 for i in decode_live):
             (self._tok, self._pos, self._active, bufs,
              self._remaining, toks) = self._tick_sampled(
@@ -1567,11 +1582,21 @@ class ContinuousDecoder:
         with _M_DRAIN_SECONDS.time(), _prof_span("continuous.drain"):
             toks = np.asarray(toks_dev)
         if self._spec and toks.shape[0] > 1:
-            # spec blocks mark unemitted lanes -1; count real emissions
-            # against dispatched round-slots for the acceptance stat
+            # spec blocks mark unemitted lanes -1. Both acceptance
+            # counters come from THIS block so they cover the same
+            # window: emissions are the non-negative lanes, and a
+            # (round, slot) pair counts as a round-slot iff the slot
+            # was still live in that round — a live round always emits
+            # >= 1 token (accepted prefix + final), a retired one emits
+            # none. The block is k_steps round groups of gamma+1 lanes.
+            lanes = toks.shape[0] // self._k
+            live_pairs = (toks.reshape(self._k, lanes, -1) >= 0).any(1)
             self.stats["spec_emitted"] = (
                 self.stats.get("spec_emitted", 0)
                 + int((toks >= 0).sum()))
+            self.stats["spec_round_slots"] = (
+                self.stats.get("spec_round_slots", 0)
+                + int(live_pairs.sum()))
         for s in range(toks.shape[0]):
             for col, (_, req) in snapshot.items():
                 if req.done:

@@ -121,6 +121,10 @@ class PagedKVPool:
         self._refs = np.zeros(self.num_pages, np.int32)
         # phash -> (pages tuple, prefix length in tokens)
         self._prefixes: Dict[str, Tuple[Tuple[int, ...], int]] = {}
+        # phash -> registration count. Two engine keys whose prefixes are
+        # token-identical hash to the same entry; the entry (and its page
+        # refs) must survive until EVERY registering key has released it.
+        self._prefix_regs: Dict[str, int] = {}
         self.high_water = 0
         self.stats = {"prefix_share_hits": 0, "defrag_moves": 0,
                       "prefill_chunks": 0, "alloc_failures": 0}
@@ -145,15 +149,20 @@ class PagedKVPool:
     def pages_in_use(self) -> int:
         return (self.num_pages - 1) - len(self._free)
 
-    def alloc(self, n: int) -> List[int]:
+    def alloc(self, n: int, *, count_failure: bool = True) -> List[int]:
         """Take ``n`` free pages (lowest physical index first — keeps the
         live span dense so compaction rarely triggers). Raises
-        :class:`PoolExhausted` without partial effects."""
+        :class:`PoolExhausted` without partial effects.
+
+        ``count_failure=False`` suppresses the failure stat/metric for
+        callers that retry under prefix-eviction pressure — only the
+        TERMINAL failure (nothing left to evict) should count as an
+        ``alloc_failure`` (see :meth:`note_alloc_failure`)."""
         if n < 0:
             raise ValueError("alloc() needs n >= 0")
         if n > len(self._free):
-            self.stats["alloc_failures"] += 1
-            M_ALLOC_FAILURES.inc()
+            if count_failure:
+                self.note_alloc_failure()
             raise PoolExhausted(
                 f"need {n} pages, {len(self._free)} free "
                 f"({self.pages_in_use}/{self.num_pages - 1} in use)")
@@ -162,6 +171,12 @@ class PagedKVPool:
         self.high_water = max(self.high_water, self.pages_in_use)
         M_PAGES_IN_USE.set(self.pages_in_use)
         return pages
+
+    def note_alloc_failure(self) -> None:
+        """Record a terminal allocation failure — one that stood even
+        after every evictable prefix was released."""
+        self.stats["alloc_failures"] += 1
+        M_ALLOC_FAILURES.inc()
 
     def incref(self, pages: Sequence[int]) -> None:
         for p in pages:
@@ -187,12 +202,18 @@ class PagedKVPool:
     def register_prefix(self, phash: str, pages: Sequence[int],
                         plen: int) -> None:
         """Retain ``pages`` (incref) as the cached cache-content of a
-        prompt prefix of ``plen`` tokens. Idempotent per hash."""
+        prompt prefix of ``plen`` tokens. Registrations are COUNTED per
+        hash: a re-registration keeps the existing entry's pages but
+        adds a release obligation, so the entry outlives every key that
+        registered it (releasing one of two token-identical keys must
+        not dangle the other)."""
         if phash in self._prefixes:
+            self._prefix_regs[phash] += 1
             return
         pages = tuple(int(p) for p in pages)
         self.incref(pages)
         self._prefixes[phash] = (pages, int(plen))
+        self._prefix_regs[phash] = 1
 
     def lookup_prefix(self, phash: str):
         """``(pages, plen)`` or None."""
@@ -212,10 +233,17 @@ class PagedKVPool:
         return pages, plen
 
     def release_prefix(self, phash: str) -> None:
-        """Drop a registered prefix's page references (idempotent)."""
-        entry = self._prefixes.pop(phash, None)
-        if entry is not None:
-            self.free(entry[0])
+        """Drop one registration of a prefix (no-op for unknown hashes);
+        the entry's page references fall only with the LAST one."""
+        regs = self._prefix_regs.get(phash)
+        if regs is None:
+            return
+        if regs > 1:
+            self._prefix_regs[phash] = regs - 1
+            return
+        del self._prefix_regs[phash]
+        pages, _ = self._prefixes.pop(phash)
+        self.free(pages)
 
     # -- defrag --------------------------------------------------------------
 
@@ -286,6 +314,7 @@ class PagedKVPool:
         heapq.heapify(self._free)
         self._refs[:] = 0
         self._prefixes.clear()
+        self._prefix_regs.clear()
         M_PAGES_IN_USE.set(0)
 
     def close(self) -> None:

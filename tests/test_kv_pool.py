@@ -102,6 +102,18 @@ class TestPoolAllocFree:
         pool.alloc(2)
         assert pool.high_water == 5
 
+    def test_pressure_retry_allocs_do_not_count_as_failures(self):
+        """alloc_failures means 'failed even after prefix eviction';
+        pressure-loop retries suppress the count and report the terminal
+        failure explicitly."""
+        pool = _pool(num_pages=4)
+        pool.alloc(3)
+        with pytest.raises(PoolExhausted):
+            pool.alloc(1, count_failure=False)
+        assert pool.stats["alloc_failures"] == 0
+        pool.note_alloc_failure()
+        assert pool.stats["alloc_failures"] == 1
+
 
 class TestPagedParity:
     """Block-table gather vs the contiguous path: bitwise, not approx."""
@@ -228,6 +240,65 @@ class TestPrefixSharing:
         assert pool.pages_in_use == 2      # the creator's own ref remains
         pool.free(pages)
         assert pool.pages_in_use == 0
+
+    def test_registry_counts_registrations_per_hash(self):
+        """Two engine keys with token-identical prefixes share one hash;
+        the registry entry must survive until BOTH have released it."""
+        pool = _pool(num_pages=16)
+        h = prefix_hash(np.arange(8, dtype=np.int32))
+        pages = pool.alloc(2)
+        pool.register_prefix(h, pages, 8)
+        pool.register_prefix(h, pages, 8)      # second key, same tokens
+        pool.release_prefix(h)                 # first key evicted
+        got, plen = pool.acquire_prefix(h, 2)  # second key still hits
+        assert got == tuple(pages) and plen == 8
+        pool.free(list(got))                   # the acquirer's handle
+        pool.release_prefix(h)                 # last registration frees
+        pool.free(pages)                       # the creator's own ref
+        assert pool.pages_in_use == 0
+        pool.release_prefix(h)                 # unknown hash: no-op
+
+    def test_token_identical_prefixes_under_distinct_keys(self, params):
+        """Store-cap eviction of one key must not dangle another key
+        whose stored prefix is token-identical (same pool hash): the
+        surviving key's next hit used to KeyError in acquire_prefix."""
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                page_size=4, prefix_cache_size=1)
+        rng = np.random.default_rng(13)
+        prompt = rng.integers(1, CFG.vocab, 8).astype(np.int32)
+        want = None
+        # "b"'s miss re-registers the same hash, and its cap eviction of
+        # "a" releases one registration; the second "b" submit must hit
+        for key in ("a", "b", "b"):
+            r = eng.submit(prompt.copy(), max_new_tokens=6, prefix_key=key)
+            while not r.done:
+                eng.step()
+            assert r.error is None
+            if want is None:
+                want = list(r.tokens)
+            assert r.tokens == want
+        assert eng.stats["prefix_hits"] >= 1
+
+    def test_pressure_eviction_is_not_an_alloc_failure(self, params):
+        """An admission resolved by evicting a cached prefix is a
+        success: the terminal-failure counter stays untouched."""
+        eng = ContinuousDecoder(params, CFG, max_slots=1, max_len=32,
+                                page_size=4, kv_pages=9)
+        rng = np.random.default_rng(14)
+        ra = eng.submit(rng.integers(1, CFG.vocab, 8).astype(np.int32),
+                        max_new_tokens=4, prefix_key="sys")
+        while not ra.done:
+            eng.step()
+        assert eng._kv.pages_in_use == 2       # the cached prefix
+        # 8 usable pages, 2 held by the prefix, next request needs all 8
+        prompt = rng.integers(1, CFG.vocab, 20).astype(np.int32)
+        rb = eng.submit(prompt, max_new_tokens=12)
+        while not rb.done:
+            eng.step()
+        assert eng._kv.stats["alloc_failures"] == 0
+        want = generate_cached(params, prompt[None, :], CFG,
+                                  max_new_tokens=12)
+        assert rb.tokens == list(np.asarray(want)[0, len(prompt):])
 
     def test_engine_shares_physical_pages_until_divergence(self, params):
         """Two requests with a common prefix: strictly-common full pages
@@ -406,6 +477,37 @@ class TestChunkedPrefill:
         assert eng._kv.stats["prefill_chunks"] == 0
 
 
+class TestAdmissionBackout:
+    def test_pool_exhaustion_requeues_every_uninserted_request(self, params):
+        """When a later bucket group's insertion hits an exhausted pool,
+        every assigned-but-uninserted request (that group, remaining
+        prefixed, chunked) must return to the queue — a request left in
+        a slot with no pages would replay stale device lanes as a
+        'successful' garbage completion — and then complete correctly
+        once pages free up."""
+        rng = np.random.default_rng(15)
+        eng = ContinuousDecoder(params, CFG, max_slots=3, max_len=32,
+                                page_size=4, kv_pages=9)  # 8 usable pages
+        p1 = rng.integers(1, CFG.vocab, 3).astype(np.int32)   # bucket 8
+        p2 = rng.integers(1, CFG.vocab, 12).astype(np.int32)  # bucket 16
+        p3 = rng.integers(1, CFG.vocab, 8).astype(np.int32)
+        r1 = eng.submit(p1, max_new_tokens=12)            # 4 pages
+        r2 = eng.submit(p2, max_new_tokens=12)            # 6 pages: fails
+        r3 = eng.submit(p3, max_new_tokens=8,             # 4 pages: fails
+                        prefix_key="sys")
+        for _ in range(500):
+            if r1.done and r2.done and r3.done:
+                break
+            eng.step()
+        for p, r in ((p1, r1), (p2, r2), (p3, r3)):
+            assert r.done and r.error is None
+            want = generate_cached(params, p[None, :], CFG,
+                                      max_new_tokens=r.max_new)
+            assert r.tokens == list(np.asarray(want)[0, len(p):])
+        # only the registered prefix survives the retirements
+        assert eng._kv.pages_in_use == 2
+
+
 class TestSpeculativePaged:
     def test_spec_engine_greedy_parity(self, params, d_params):
         eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
@@ -422,6 +524,30 @@ class TestSpeculativePaged:
                                       max_new_tokens=10)
             assert r.tokens == list(np.asarray(want)[0, len(p):])
         assert eng._kv.pages_in_use == 0
+
+    def test_acceptance_counters_cover_the_same_drained_window(self, params):
+        """spec_round_slots is accounted at drain time from the same
+        block as spec_emitted. With the draft IDENTICAL to the target,
+        acceptance is exactly 1.0: 8 post-insert tokens in 2 rounds —
+        dispatch-time accounting would also count the pipeline-depth
+        dispatches issued after the slot retired on device and hold the
+        measured acceptance below its true value."""
+        eng = ContinuousDecoder(params, CFG, max_slots=2, max_len=48,
+                                page_size=4, draft_params=params,
+                                draft_cfg=CFG, gamma=3, pipeline_depth=2)
+        rng = np.random.default_rng(16)
+        prompt = rng.integers(1, CFG.vocab, 5).astype(np.int32)
+        req = eng.submit(prompt, max_new_tokens=9)
+        for _ in range(200):
+            if req.done:
+                break
+            eng.step()
+        eng.flush()
+        want = generate_cached(params, prompt[None, :], CFG,
+                                  max_new_tokens=9)
+        assert req.tokens == list(np.asarray(want)[0, len(prompt):])
+        assert eng.stats["spec_emitted"] == 8
+        assert eng.stats["spec_round_slots"] == 2
 
 
 class TestAutotuner:
