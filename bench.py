@@ -395,6 +395,97 @@ def _generation_phase(on_tpu: bool) -> dict:
     return out
 
 
+def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
+                  ips: float) -> dict:
+    """Measurement-driven autotuning sub-record (ROADMAP item 4).
+
+    Folds this run's harvested runner samples together with every prior
+    ``BENCH_r0*.json`` into one observation store, fits the cost model, and
+    reports (a) the config it would pick for this workload, (b) per-knob
+    predicted deltas against the config that actually ran, and (c) a
+    regression guard comparing the headline number against the best prior
+    round on the same platform — a dip becomes a flagged field in the JSON
+    record, not a silent regression in the trajectory.
+    """
+    import glob
+
+    from mmlspark_tpu.tuning import (CostModel, ObservationStore,
+                                     get_store, import_bench_records)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    priors = sorted(glob.glob(os.path.join(here, "BENCH_r0*.json")))
+    sig = model.tuning_signature()
+    store = ObservationStore()          # scratch: this run + the trajectory
+    for row in get_store().rows(sig=sig):
+        store.record(row)
+    imported = import_bench_records(priors, store)
+    out = {"imported_bench_records": imported, "store_rows": len(store),
+           "sig": sig}
+
+    histogram = {batch: n_rows // batch}
+    if n_rows % batch:
+        histogram[n_rows % batch] = 1
+    depth0 = int(model.prefetch_depth)
+    rows = store.rows(sig=sig)
+    if rows:
+        cm = CostModel.fit(rows)
+        decision = cm.choose(histogram, defaults=(batch, depth0))
+        out["decision"] = decision.as_dict()
+        # predicted-vs-measured for the config that actually ran, plus the
+        # predicted effect of moving each knob alone to its chosen value
+        base = cm.predict_seconds(histogram, batch, depth0, None)
+        pred_cur = (n_rows / base) if base > 0 else None
+        out["predicted_rows_per_sec_current"] = (
+            round(pred_cur, 2) if pred_cur else None)
+        out["measured_rows_per_sec"] = round(ips, 2)
+        out["predicted_vs_measured_delta"] = (
+            round((pred_cur - ips) / ips, 4) if pred_cur and ips else None)
+        per_knob = {}
+        for name, chosen, default in (
+                ("mini_batch_size", decision.mini_batch_size, batch),
+                ("prefetch_depth", decision.prefetch_depth, depth0),
+                ("buckets",
+                 None if decision.buckets is None
+                 else list(decision.buckets), None)):
+            cand = {"mini_batch_size": batch, "prefetch_depth": depth0,
+                    "buckets": None}
+            cand[name] = chosen
+            sec = cm.predict_seconds(histogram, **cand)
+            per_knob[name] = {
+                "default": default, "chosen": chosen,
+                "predicted_speedup": (round(base / sec, 4)
+                                      if sec > 0 else None)}
+        out["per_knob"] = per_knob
+
+    # regression guard: best prior round of the same metric on the same
+    # platform (a CPU-fallback round must not be judged against TPU rounds)
+    best_prior, best_file = 0.0, None
+    for path in priors:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = raw.get("parsed") if isinstance(raw.get("parsed"), dict) \
+            else (raw if "value" in raw else None)
+        if not parsed or parsed.get("metric") != record.get("metric") \
+                or parsed.get("platform") != record.get("platform"):
+            continue
+        v = parsed.get("value")
+        if isinstance(v, (int, float)) and v > best_prior:
+            best_prior, best_file = float(v), os.path.basename(path)
+    tol = float(os.environ.get("BENCH_REGRESSION_TOLERANCE", "0.1"))
+    if best_prior > 0:
+        out["regression"] = {
+            "best_prior": round(best_prior, 2),
+            "best_prior_file": best_file, "tolerance": tol,
+            "delta": round((ips - best_prior) / best_prior, 4),
+            "dip": bool(ips < best_prior * (1.0 - tol))}
+    else:
+        out["regression"] = {"best_prior": None, "dip": False}
+    return out
+
+
 def main():
     t_start = time.monotonic()
     budget = float(os.environ.get("BENCH_WALL_BUDGET_S",
@@ -665,6 +756,18 @@ def main():
         except Exception as e:          # noqa: BLE001
             record["generation"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
+
+    # tuning phase: pure host arithmetic over this run's harvested samples
+    # + the historical bench records — chosen config, per-knob predicted
+    # deltas, and the trajectory regression guard
+    with _phase_guard(record, "tuning", min(remaining() - 20.0, 60.0)):
+        try:
+            record["tuning"] = _tuning_phase(record, m, batch=batch,
+                                             n_rows=n_rows, ips=ips)
+            record["regression_flag"] = bool(
+                (record["tuning"].get("regression") or {}).get("dip"))
+        except Exception as e:          # noqa: BLE001
+            record["tuning"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     h2d_gbps = None
     link_bound_ips = None

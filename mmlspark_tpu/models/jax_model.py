@@ -67,6 +67,15 @@ class JaxModel(Model):
                                "dispatches; bounds host memory at that many "
                                "padded batches. 0 = prepare inline on the "
                                "dispatch thread")
+    buckets = Param((list, int), default=[],
+                    doc="custom padding-bucket ladder (sorted batch sizes); "
+                        "empty = next-power-of-two. Warm-up and the runner "
+                        "derive every padded shape through the same ladder")
+    tuning = Param(str, default="", choices=["", "auto"],
+                   doc="'auto' consults the measurement-driven tuning store "
+                       "(MMLSPARK_TPU_TUNING_DIR): the fitted cost model "
+                       "picks mini_batch_size, prefetch_depth and the "
+                       "bucket ladder; a cold store keeps the defaults")
 
     def __init__(self, apply_fn: Optional[Callable] = None,
                  model_params=None, **kw):
@@ -80,6 +89,7 @@ class JaxModel(Model):
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
         self._staging = StagingSlabPool()
+        self._tuning_decisions: Dict[tuple, object] = {}
 
     @property
     def stage_counters(self) -> StageCounters:
@@ -94,7 +104,40 @@ class JaxModel(Model):
         if kwargs and hasattr(self, "_jitted"):
             self._jitted = None
             self._device_params = {}
+        if kwargs and getattr(self, "_tuning_decisions", None) is not None:
+            self._tuning_decisions.clear()
         return out
+
+    # -- tuning --------------------------------------------------------------
+    def tuning_signature(self) -> str:
+        """Stable identity for the observation store: the apply_fn's import
+        path (the callable IS the model) plus the compute dtype."""
+        fn = self.get_or_none("apply_fn")
+        name = (f"{getattr(fn, '__module__', '?')}."
+                f"{getattr(fn, '__qualname__', repr(fn))}" if fn is not None
+                else "unset")
+        return f"jax:{name}:{self.compute_dtype}"
+
+    def _resolve_tuning(self, histogram: Dict[int, int]):
+        """The store's pick for this histogram (None = off or cold store);
+        resolved sig-wide so warm-up and every partition share one ladder."""
+        if self.get_or_none("tuning") != "auto":
+            return None
+        key = tuple(sorted(histogram.items()))
+        if key not in self._tuning_decisions:
+            from ..tuning.cost_model import resolve_tuning
+            self._tuning_decisions[key] = resolve_tuning(
+                self.tuning_signature(), "default", histogram,
+                defaults=(self.mini_batch_size, self.prefetch_depth))
+        return self._tuning_decisions[key]
+
+    def _runner_config(self, n_rows: int):
+        ladder = tuple(self.buckets) if self.get_or_none("buckets") else None
+        decision = self._resolve_tuning({int(n_rows): 1})
+        if decision is None:
+            return self.mini_batch_size, self.prefetch_depth, ladder
+        return (decision.mini_batch_size, decision.prefetch_depth,
+                decision.buckets)
 
     # -- jit ----------------------------------------------------------------
     def _ensure_jitted(self):
@@ -195,12 +238,16 @@ class JaxModel(Model):
                     else self._coerce_col(part[col_name][sl])
             return out
 
+        mbs, depth, ladder = self._runner_config(len(part))
         runner = BatchRunner(jitted, params, coerce, placement.put,
                              shards=placement.shards,
-                             mini_batch_size=self.mini_batch_size,
-                             prefetch_depth=self.prefetch_depth,
+                             mini_batch_size=mbs,
+                             prefetch_depth=depth,
                              counters=self._counters,
-                             staging=self._staging)
+                             staging=self._staging,
+                             buckets=ladder,
+                             model_sig=self.tuning_signature(),
+                             placement_key=str(placement.key))
         pending = runner.run_and_drain(len(part))
 
         if not pending:
@@ -231,8 +278,13 @@ class JaxModel(Model):
         specs = {name: (np.dtype(dt), tuple(shape))
                  for name, (dt, shape) in input_specs.items()}
         sizes = [int(b) for b in (batch_sizes or [self.mini_batch_size])]
+        ladder = tuple(self.buckets) if self.get_or_none("buckets") else None
+        decision = self._resolve_tuning({s: 1 for s in sizes})
+        if decision is not None:
+            sizes = list(decision.warm_up_sizes) or sizes
+            ladder = decision.buckets
         return warm_up_model(self, jitted, specs, sizes,
-                             background=background)
+                             background=background, buckets=ladder)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self._ensure_jitted()
@@ -245,3 +297,4 @@ class JaxModel(Model):
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
         self._staging = StagingSlabPool()
+        self._tuning_decisions = {}

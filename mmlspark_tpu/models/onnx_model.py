@@ -96,6 +96,18 @@ class ONNXModel(Model):
                               "outputs keep their device dtypes (bf16 stays "
                               "bf16, argmax stays int32) until "
                               "DataFrame.to_host materializes them")
+    buckets = Param((list, int), default=[],
+                    doc="custom padding-bucket ladder (sorted batch sizes); "
+                        "empty = next-power-of-two. Warm-up and the runner "
+                        "derive every padded shape through the same ladder, "
+                        "so only these buckets ever compile")
+    tuning = Param(str, default="", choices=["", "auto"],
+                   doc="'auto' consults the measurement-driven tuning store "
+                       "(MMLSPARK_TPU_TUNING_DIR) at transform/warm_up: the "
+                       "fitted cost model picks mini_batch_size, "
+                       "prefetch_depth and the bucket ladder for the "
+                       "observed row counts; a cold store keeps the "
+                       "defaults and this run's measurements train it")
 
     def __init__(self, model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
@@ -111,12 +123,51 @@ class ONNXModel(Model):
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
         self._staging = StagingSlabPool()
+        self._tuning_sig: Optional[str] = None
+        self._tuning_decisions: Dict[tuple, object] = {}
 
     @property
     def stage_counters(self) -> StageCounters:
         """coerce/pad/h2d/compile/dispatch/d2h instrumentation, cumulative
         over every transform/warm_up on this instance."""
         return self._counters
+
+    # -- tuning --------------------------------------------------------------
+    def tuning_signature(self) -> str:
+        """Stable identity for the observation store: content hash of the
+        graph plus the knobs that change its cost profile."""
+        sig = getattr(self, "_tuning_sig", None)
+        if sig is None:
+            from ..onnx.proto import model_content_digest
+            mb = self.get_or_none("model_bytes") or b""
+            h = model_content_digest(bytes(mb))[:16]
+            sig = f"onnx:{h}:{self.compute_dtype}:{self.quantize or 'fp'}"
+            self._tuning_sig = sig
+        return sig
+
+    def _resolve_tuning(self, histogram: Dict[int, int]):
+        """The store's pick for this histogram (None = off or cold store).
+        Resolved sig-wide (placement "default"): one vocabulary serves all
+        chips, so warm-up and every partition agree on the ladder."""
+        if self.get_or_none("tuning") != "auto":
+            return None
+        key = tuple(sorted(histogram.items()))
+        if key not in self._tuning_decisions:
+            from ..tuning.cost_model import resolve_tuning
+            self._tuning_decisions[key] = resolve_tuning(
+                self.tuning_signature(), "default", histogram,
+                defaults=(self.mini_batch_size, self.prefetch_depth))
+        return self._tuning_decisions[key]
+
+    def _runner_config(self, n_rows: int):
+        """Effective ``(mini_batch_size, prefetch_depth, ladder)`` — the
+        Params unless ``tuning="auto"`` found a measured pick."""
+        ladder = tuple(self.buckets) if self.get_or_none("buckets") else None
+        decision = self._resolve_tuning({int(n_rows): 1})
+        if decision is None:
+            return self.mini_batch_size, self.prefetch_depth, ladder
+        return (decision.mini_batch_size, decision.prefetch_depth,
+                decision.buckets)
 
     # -- metadata (proto-only, no session) ----------------------------------
     def _ensure_converted(self) -> ConvertedModel:
@@ -351,6 +402,11 @@ class ONNXModel(Model):
             # the caches.
             with self._params_lock:
                 self._device_params.clear()
+        if kwargs and getattr(self, "_tuning_decisions", None) is not None:
+            # any reconfiguration may change the model signature or the
+            # defaults the tuner compares against
+            self._tuning_decisions.clear()
+            self._tuning_sig = None
         return super().set(**kwargs)
 
     def _params_for_device(self, device) -> dict:
@@ -439,12 +495,16 @@ class ONNXModel(Model):
                         device_prepped=prepped)
             return out
 
+        mbs, depth, ladder = self._runner_config(len(part))
         runner = BatchRunner(jitted, params, coerce, placement.put,
                              shards=placement.shards,
-                             mini_batch_size=self.mini_batch_size,
-                             prefetch_depth=self.prefetch_depth,
+                             mini_batch_size=mbs,
+                             prefetch_depth=depth,
                              counters=self._counters,
-                             staging=self._staging)
+                             staging=self._staging,
+                             buckets=ladder,
+                             model_sig=self.tuning_signature(),
+                             placement_key=str(placement.key))
         if self.output_device:
             # keep outputs resident: no drain — the sink (DataFrame.to_host
             # or a downstream device stage) decides when to cross back
@@ -500,8 +560,15 @@ class ONNXModel(Model):
         specs = resolve_input_specs(cm.inputs, fed, self.transpose_dict,
                                     overrides=input_specs)
         sizes = [int(b) for b in (batch_sizes or [self.mini_batch_size])]
+        ladder = tuple(self.buckets) if self.get_or_none("buckets") else None
+        decision = self._resolve_tuning({s: 1 for s in sizes})
+        if decision is not None:
+            # compile exactly the chosen vocabulary, not the full
+            # power-of-two ladder
+            sizes = list(decision.warm_up_sizes) or sizes
+            ladder = decision.buckets
         return warm_up_model(self, jitted, specs, sizes,
-                             background=background)
+                             background=background, buckets=ladder)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self._ensure_converted()
@@ -531,6 +598,8 @@ class ONNXModel(Model):
         self._params_lock = threading.Lock()
         self._counters = StageCounters()
         self._staging = StagingSlabPool()
+        self._tuning_sig = None
+        self._tuning_decisions = {}
 
 
 def _host_softmax(col: np.ndarray) -> np.ndarray:

@@ -124,7 +124,10 @@ class BatchRunner:
                  put: Callable, shards: int = 1, mini_batch_size: int = 64,
                  prefetch_depth: int = 2,
                  counters: Optional[StageCounters] = None,
-                 staging: Optional[StagingSlabPool] = None):
+                 staging: Optional[StagingSlabPool] = None,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 tuning: str = "", model_sig: Optional[str] = None,
+                 placement_key: str = "default"):
         self.jitted = jitted
         self.params = params
         self.coerce = coerce
@@ -136,10 +139,78 @@ class BatchRunner:
         # model-owned so slabs amortize across transform calls, not just
         # batches of one partition
         self.staging = staging
+        # custom padding-bucket ladder (None = power-of-two default); the
+        # ladder must cover the largest batch the runner can produce
+        self.buckets = (None if not buckets
+                        else tuple(sorted({int(b) for b in buckets})))
+        if self.buckets and self.mini_batch_size > self.buckets[-1]:
+            raise ValueError(
+                f"mini_batch_size={self.mini_batch_size} exceeds the "
+                f"largest bucket {self.buckets[-1]} of the ladder")
+        if tuning not in ("", "auto"):
+            raise ValueError(f"tuning must be '' or 'auto', got {tuning!r}")
+        self.tuning = tuning
+        self.model_sig = model_sig
+        self.placement_key = str(placement_key)
+        self._tuned = False           # "auto" resolved the store already
+        self.decision = None          # the applied TuningDecision, if any
+        self._samples: Dict[int, Dict[str, float]] = {}
+
+    # -- tuning: consult the observation store, harvest samples back ---------
+    def _resolve_auto(self, n_rows: int) -> None:
+        """``tuning="auto"``: on first run, fit the observation store for
+        this model signature and apply the picked config. A cold store is
+        not an error — the defaults stand and this run's harvest becomes
+        the training data a later process decides from."""
+        self._tuned = True
+        from ..tuning.cost_model import resolve_tuning
+        decision = resolve_tuning(
+            self.model_sig or "anonymous", self.placement_key,
+            {int(n_rows): 1},
+            defaults=(self.mini_batch_size, self.prefetch_depth))
+        if decision is None:
+            return
+        self.decision = decision
+        self.mini_batch_size = max(1, decision.mini_batch_size)
+        self.prefetch_depth = max(0, decision.prefetch_depth)
+        self.buckets = decision.buckets
+
+    def _note_sample(self, padded: int, b: int, *, seconds: float = 0.0,
+                     prep_seconds: float = 0.0, compile_seconds: float = 0.0,
+                     compiles: int = 0, batches: int = 0) -> None:
+        s = self._samples.setdefault(
+            int(padded), {"rows": 0, "batches": 0, "seconds": 0.0,
+                          "prep_seconds": 0.0, "compile_seconds": 0.0,
+                          "compiles": 0})
+        s["rows"] += int(b)
+        s["batches"] += int(batches)
+        s["seconds"] += float(seconds)
+        s["prep_seconds"] += float(prep_seconds)
+        s["compile_seconds"] += float(compile_seconds)
+        s["compiles"] += int(compiles)
+
+    def _flush_samples(self) -> None:
+        """Emit the accumulated per-bucket samples as observations (called
+        at drain time — the ``harvests at drain`` contract)."""
+        if not self._samples or self.model_sig is None:
+            self._samples.clear()
+            return
+        from ..tuning.observations import harvest_samples
+        samples = [dict(bucket=k, **v)
+                   for k, v in sorted(self._samples.items())]
+        self._samples.clear()
+        harvest_samples(
+            self.model_sig, self.placement_key,
+            {"mini_batch_size": self.mini_batch_size,
+             "prefetch_depth": self.prefetch_depth,
+             "buckets": None if self.buckets is None else list(self.buckets)},
+            samples)
 
     # -- host side: coerce + pad (runs on the prefetch worker) ---------------
-    def _prepare(self, sl: slice) -> Tuple[Dict[str, np.ndarray], int]:
+    def _prepare(self, sl: slice
+                 ) -> Tuple[Dict[str, np.ndarray], int, int, float]:
         c = self.counters
+        t_prep = time.perf_counter()
         with c.timer("coerce"), _span("runner.coerce"):
             feeds = self.coerce(sl)
         b = 0
@@ -148,7 +219,7 @@ class BatchRunner:
             padded = 0
             for name, arr in feeds.items():
                 b = len(arr)
-                padded = bucket_size(b)
+                padded = bucket_size(b, self.buckets)
                 padded = -(-padded // self.shards) * self.shards
                 if is_device_array(arr):
                     # device feed (resident column slice): pad on device,
@@ -164,7 +235,7 @@ class BatchRunner:
                 else:
                     padded_feeds[name] = pad_axis(arr, padded)
             _tracing.add_event("pad_bucket", rows=b, padded=padded)
-        return padded_feeds, b
+        return padded_feeds, b, padded, time.perf_counter() - t_prep
 
     def _prepared_batches(self, n_rows: int):
         slices = batch_slices(n_rows, self.mini_batch_size)
@@ -188,6 +259,8 @@ class BatchRunner:
         (``copy_to_host_async``) instead of at partition end.
         """
         c = self.counters
+        if self.tuning == "auto" and not self._tuned:
+            self._resolve_auto(n_rows)
         pending: List[Tuple[dict, int]] = []
         with _span("runner.run", rows=n_rows):
             batches = self._prepared_batches(n_rows)
@@ -199,7 +272,7 @@ class BatchRunner:
             while True:
                 t0 = time.perf_counter()
                 try:
-                    feeds_host, b = next(it)
+                    feeds_host, b, padded, prep_s = next(it)
                 except StopIteration:
                     break
                 if prefetching:
@@ -230,10 +303,16 @@ class BatchRunner:
                     M_STEADY_RECOMPILES.inc(after - before)
                     _tracing.add_event("cache_miss", compiles=after - before,
                                        seconds=elapsed)
+                    self._note_sample(padded, b, batches=1,
+                                      prep_seconds=prep_s,
+                                      compile_seconds=elapsed,
+                                      compiles=after - before)
                 else:
                     c.add("dispatch", elapsed)
                     M_CACHE_HITS.inc()
                     _tracing.add_event("cache_hit")
+                    self._note_sample(padded, b, batches=1, seconds=elapsed,
+                                      prep_seconds=prep_s)
                 if self.staging is not None:
                     # a slab may only circulate once its async h2d has
                     # finished reading it: block on the *input* transfers
@@ -260,8 +339,10 @@ class BatchRunner:
 
     def drain(self, pending: List[Tuple[dict, int]]
               ) -> List[Tuple[Dict[str, np.ndarray], int]]:
-        """One batched device→host fetch over every pending output."""
+        """One batched device→host fetch over every pending output; flushes
+        the per-bucket tuning samples accumulated since the last drain."""
         if not pending:
+            self._flush_samples()
             return []
         t0 = time.perf_counter()
         with _span("runner.d2h", batches=len(pending)):
@@ -269,6 +350,13 @@ class BatchRunner:
         elapsed = time.perf_counter() - t0
         nbytes = sum(a.nbytes for outs in host for a in outs.values())
         self.counters.add("d2h", elapsed, nbytes)
+        # async dispatch means compute largely settles inside device_get:
+        # attribute the drain across buckets by row share so the per-bucket
+        # fit sees the true device cost, not just the enqueue time
+        total_rows = sum(s["rows"] for s in self._samples.values()) or 1
+        for s in self._samples.values():
+            s["seconds"] += elapsed * (s["rows"] / total_rows)
+        self._flush_samples()
         return [(outs, b) for outs, (_, b) in zip(host, pending)]
 
     def run_and_drain(self, n_rows: int

@@ -25,12 +25,20 @@ class BatchRunner:
     prefetch_depth: int
     counters: StageCounters
     staging: Optional[StagingSlabPool]
+    buckets: Optional[Tuple[int, ...]]
+    tuning: str
+    model_sig: Optional[str]
+    placement_key: str
+    decision: Any
     def __init__(self, jitted: Any, params: Any,
                  coerce: Callable[[slice], Dict[str, np.ndarray]],
                  put: Callable[..., Any], shards: int = ...,
                  mini_batch_size: int = ..., prefetch_depth: int = ...,
                  counters: Optional[StageCounters] = ...,
-                 staging: Optional[StagingSlabPool] = ...) -> None: ...
+                 staging: Optional[StagingSlabPool] = ...,
+                 buckets: Optional[Tuple[int, ...]] = ...,
+                 tuning: str = ..., model_sig: Optional[str] = ...,
+                 placement_key: str = ...) -> None: ...
     def run(self, n_rows: int) -> List[Tuple[dict, int]]: ...
     def drain(self, pending: List[Tuple[dict, int]]
               ) -> List[Tuple[Dict[str, np.ndarray], int]]: ...

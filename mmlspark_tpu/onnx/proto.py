@@ -17,7 +17,8 @@ from .wire import iter_fields, read_varint
 
 __all__ = ["TensorProto", "AttributeProto", "NodeProto", "GraphProto",
            "ModelProto", "ValueInfo", "DataType", "tensor_to_numpy",
-           "parse_model", "NUMPY_TO_ONNX", "ONNX_TO_NUMPY"]
+           "parse_model", "model_content_digest", "NUMPY_TO_ONNX",
+           "ONNX_TO_NUMPY"]
 
 
 class DataType:
@@ -446,3 +447,57 @@ def parse_model(data: bytes) -> ModelProto:
     if m.graph is None:
         raise ValueError("not an ONNX model: no graph found")
     return m
+
+
+def _digest_tensor(h, t: TensorProto) -> None:
+    h.update(repr((t.name, tuple(t.dims), t.data_type,
+                   t.data_location)).encode())
+    h.update(t.raw_data)
+    for lst in (t.float_data, t.int32_data, t.int64_data, t.double_data,
+                t.uint64_data):
+        if lst:
+            h.update(repr(lst).encode())
+    for s in t.string_data:
+        h.update(s)
+
+
+def _digest_graph(h, g: GraphProto) -> None:
+    for vi in list(g.inputs) + list(g.outputs):
+        h.update(repr((vi.name, vi.elem_type, tuple(vi.shape))).encode())
+    for t in g.initializers:
+        _digest_tensor(h, t)
+    for n in g.nodes:
+        # n.name deliberately excluded: the builder auto-names nodes from
+        # object ids, so identical graphs serialize differently per process
+        h.update(repr((n.op_type, n.domain, tuple(n.input),
+                       tuple(n.output))).encode())
+        for aname in sorted(n.attributes):
+            a = n.attributes[aname]
+            h.update(repr((aname, a.type, a.f, a.i, a.s, tuple(a.floats),
+                           tuple(a.ints), tuple(a.strings))).encode())
+            if a.t is not None:
+                _digest_tensor(h, a.t)
+            for t in a.tensors:
+                _digest_tensor(h, t)
+            for sub in ([a.g] if a.g is not None else []) + list(a.graphs):
+                _digest_graph(h, sub)
+
+
+def model_content_digest(data: bytes) -> str:
+    """SHA-1 hex digest of a serialized model's *semantic* content —
+    opsets, graph topology, tensor types/shapes, initializer bytes — but
+    not node names, which the builder derives from object ids and which
+    therefore differ across processes for identical graphs. Stable
+    identity for caches keyed by "what does this model compute" (the
+    autotuner's observation store). Unparseable bytes fall back to a hash
+    of the bytes themselves."""
+    import hashlib
+    h = hashlib.sha1()
+    try:
+        m = parse_model(bytes(data))
+    except Exception:
+        h.update(bytes(data))
+        return h.hexdigest()
+    h.update(repr(sorted(m.opset_imports.items())).encode())
+    _digest_graph(h, m.graph)
+    return h.hexdigest()

@@ -241,18 +241,25 @@ def resolve_input_specs(inputs: Iterable, feed: Dict[str, str],
 def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
                    batch_sizes: Sequence[int], shards: int = 1,
                    put: Optional[Callable] = None,
-                   counters: Optional[StageCounters] = None) -> dict:
+                   counters: Optional[StageCounters] = None,
+                   buckets: Optional[Sequence[int]] = None) -> dict:
     """Compile (and prime the caches for) every padding-bucket shape.
 
     For each requested batch size the *padded* feed size is derived exactly
-    as the runner derives it (``bucket_size`` then rounded up to a multiple
-    of ``shards``), zero-filled feeds are placed with ``put`` and run through
-    ``jitted`` once, blocking on the result. That single throwaway execution
-    is what populates jax's in-process jit cache — a bare
-    ``lower().compile()`` produces an executable but leaves the cache cold,
-    so the first real batch would still pay tracing + compile. With
-    :func:`enable_persistent_cache` active the compile also lands on disk
-    for the next process.
+    as the runner derives it (``bucket_size`` over the active ladder, then
+    rounded up to a multiple of ``shards``), zero-filled feeds are placed
+    with ``put`` and run through ``jitted`` once, blocking on the result.
+    That single throwaway execution is what populates jax's in-process jit
+    cache — a bare ``lower().compile()`` produces an executable but leaves
+    the cache cold, so the first real batch would still pay tracing +
+    compile. With :func:`enable_persistent_cache` active the compile also
+    lands on disk for the next process.
+
+    ``buckets`` is the runner's padding ladder (``None`` = power-of-two):
+    warm-up derives each padded size through the *same* ladder, so it
+    compiles exactly the shapes the runner can produce — a caller on a
+    custom ladder no longer pays for power-of-two buckets its batches can
+    never land in.
 
     Returns ``{"buckets": [padded sizes], "compiles": n, "seconds": s}``.
     ``compiles`` is ``None`` when the jit cache is not introspectable.
@@ -262,7 +269,9 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
     enable_persistent_cache()
     if put is None:
         put = jax.device_put
-    buckets = sorted({-(-bucket_size(int(b)) // max(1, shards))
+    ladder = None if not buckets else tuple(sorted({int(b)
+                                                    for b in buckets}))
+    buckets = sorted({-(-bucket_size(int(b), ladder) // max(1, shards))
                       * max(1, shards) for b in batch_sizes if int(b) > 0})
     before = jit_cache_size(jitted)
     t_start = time.perf_counter()
@@ -292,7 +301,8 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
 
 
 def warm_up_model(model, jitted, specs, batch_sizes,
-                  background: bool = False):
+                  background: bool = False,
+                  buckets: Optional[Sequence[int]] = None):
     """Warm every placement a model's traffic can hit (shared by
     ``ONNXModel.warm_up`` / ``JaxModel.warm_up``).
 
@@ -321,7 +331,8 @@ def warm_up_model(model, jitted, specs, batch_sizes,
             seen.add(placement.key)
             s = warm_up_jitted(jitted, params, specs, batch_sizes,
                                shards=placement.shards, put=placement.put,
-                               counters=model.stage_counters)
+                               counters=model.stage_counters,
+                               buckets=buckets)
             stats["buckets"] = sorted(set(stats["buckets"])
                                       | set(s["buckets"]))
             if s["compiles"] is None:

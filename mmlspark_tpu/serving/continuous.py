@@ -675,7 +675,10 @@ class ContinuousDecoder:
                                    gamma_max=self._gamma_max,
                                    chunk=self._chunk,
                                    chunk_min=min(32, self._chunk),
-                                   chunk_max=max(1024, self._chunk))
+                                   chunk_max=max(1024, self._chunk),
+                                   depth=self._depth,
+                                   depth_min=min(1, self._depth),
+                                   depth_max=max(4, self._depth))
                        if autotune else None)
         self._reset_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self._S
@@ -1500,7 +1503,7 @@ class ContinuousDecoder:
         if not decode_live:
             # everything live is still prefilling — the chunk above was
             # this tick's work
-            while len(self._pending) > self._depth:
+            while len(self._pending) > self._depth_now():
                 self._drain_one()
             return len(live)
         if self._spec:
@@ -1555,9 +1558,16 @@ class ContinuousDecoder:
             self._stage_prefills()
         # the ONLY host↔device sync on the decode path: fetch the oldest
         # block once `depth` newer dispatches are already queued on device
-        while len(self._pending) > self._depth:
+        while len(self._pending) > self._depth_now():
             self._drain_one()
         return len(live)
+
+    def _depth_now(self) -> int:
+        """The live pipeline-depth bound: the autotuner's pick when it is
+        running (it follows pool occupancy), else the constructor's."""
+        if self._tuner is not None and self._tuner.depth is not None:
+            return self._tuner.depth
+        return self._depth
 
     def _retirement_in_flight(self) -> bool:
         """True iff some occupied slot's request could finish inside the

@@ -77,6 +77,10 @@ M_AUTOTUNE_GAMMA = _metric_gauge(
 M_AUTOTUNE_CHUNK = _metric_gauge(
     "mmlspark_kvpool_autotune_chunk_budget",
     "Current prefill chunk budget (tokens) chosen by the KV autotuner")
+M_AUTOTUNE_DEPTH = _metric_gauge(
+    "mmlspark_kvpool_autotune_pipeline_depth",
+    "Current decode pipeline depth (in-flight steps) chosen by the KV "
+    "autotuner")
 
 
 def prefix_hash(tokens: Sequence[int]) -> str:
@@ -343,13 +347,22 @@ class KVAutotuner:
       doubles (cap ``chunk_max``); a saturated pool (>= ``occ_hi``) needs
       decode latency bounded tighter -> chunk halves (floor ``chunk_min``).
       The power-of-two ladder keeps the window-width compile set small.
+    * **pipeline depth** (in-flight decode steps before the engine drains)
+      follows the same occupancy signal, in the same direction as chunk and
+      for the same reason: an idle pool hides dispatch latency behind a
+      deeper pipeline -> depth += 1 (cap ``depth_max``); a saturated pool
+      is throughput-bound on the chip anyway and every queued step adds a
+      full step-time to p99 time-to-token -> depth -= 1 (floor
+      ``depth_min``). Disabled when constructed with ``depth=None`` (the
+      engine keeps its static depth).
     """
 
     def __init__(self, *, gamma: int, gamma_max: int, chunk: int,
                  chunk_min: int = 32, chunk_max: int = 1024,
                  interval: int = 32, acc_lo: float = 0.55,
                  acc_hi: float = 0.85, occ_lo: float = 0.25,
-                 occ_hi: float = 0.75):
+                 occ_hi: float = 0.75, depth: Optional[int] = None,
+                 depth_min: int = 1, depth_max: int = 4):
         self.gamma = int(gamma)
         self.gamma_max = int(gamma_max)
         self.chunk = int(chunk)
@@ -358,6 +371,9 @@ class KVAutotuner:
         self.interval = max(1, int(interval))
         self.acc_lo, self.acc_hi = float(acc_lo), float(acc_hi)
         self.occ_lo, self.occ_hi = float(occ_lo), float(occ_hi)
+        self.depth = None if depth is None else int(depth)
+        self.depth_min = max(0, int(depth_min))
+        self.depth_max = max(self.depth_min, int(depth_max))
         self.history: List[Dict] = []
         self._ticks = 0
         self._occ_sum = 0.0
@@ -365,6 +381,8 @@ class KVAutotuner:
         self._rounds0 = 0
         M_AUTOTUNE_GAMMA.set(self.gamma)
         M_AUTOTUNE_CHUNK.set(self.chunk)
+        if self.depth is not None:
+            M_AUTOTUNE_DEPTH.set(self.depth)
 
     def observe(self, live: int, slots: int, spec_emitted: Optional[int] = None,
                 spec_round_slots: Optional[int] = None) -> None:
@@ -391,6 +409,11 @@ class KVAutotuner:
             self._set_chunk(self.chunk * 2, occ)
         elif occ >= self.occ_hi and self.chunk // 2 >= self.chunk_min:
             self._set_chunk(self.chunk // 2, occ)
+        if self.depth is not None:
+            if occ <= self.occ_lo and self.depth + 1 <= self.depth_max:
+                self._set_depth(self.depth + 1, occ)
+            elif occ >= self.occ_hi and self.depth - 1 >= self.depth_min:
+                self._set_depth(self.depth - 1, occ)
 
     def _set_gamma(self, g: int, acc: float) -> None:
         self.history.append({"knob": "gamma", "from": self.gamma, "to": g,
@@ -403,3 +426,9 @@ class KVAutotuner:
                              "occupancy": round(occ, 4)})
         self.chunk = c
         M_AUTOTUNE_CHUNK.set(c)
+
+    def _set_depth(self, d: int, occ: float) -> None:
+        self.history.append({"knob": "depth", "from": self.depth, "to": d,
+                             "occupancy": round(occ, 4)})
+        self.depth = d
+        M_AUTOTUNE_DEPTH.set(d)

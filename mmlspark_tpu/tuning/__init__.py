@@ -1,0 +1,50 @@
+"""Measurement-driven autotuning of the compile/batch configuration.
+
+Every hot-path knob of the inference data plane — the padding-bucket
+ladder, ``mini_batch_size``, ``prefetch_depth``, the warm-up vocabulary —
+used to be a hand-picked constant. This package replaces the constants
+with *measured choices* (ROADMAP item 4; PAPERS.md: "A Learned Performance
+Model for TPUs" arXiv:2008.01040, TVM's measure-and-search loop
+arXiv:1802.04799):
+
+* :mod:`~mmlspark_tpu.tuning.observations` — an append-only JSONL store of
+  per-bucket throughput / pad-waste / compile-cost samples, harvested from
+  every :class:`~mmlspark_tpu.models.runner.BatchRunner` drain and
+  persisted under ``MMLSPARK_TPU_TUNING_DIR`` (alongside the compile
+  cache). An importer backfills from historical ``BENCH_r0*.json``
+  records so the very first process starts with the bench trajectory.
+* :mod:`~mmlspark_tpu.tuning.cost_model` — a stdlib-fitted per-bucket
+  linear cost model (dispatch intercept + per-padded-row slope, with
+  pad-overhead and compile-amortization terms) that, given a row-size
+  histogram, predicts wall-clock for a candidate ``(ladder,
+  mini_batch_size, prefetch_depth)`` and returns the best one. Cold
+  models fall back to a bounded measured sweep executed through the real
+  runner, so every probe becomes a future observation.
+
+Wiring: ``BatchRunner``, ``ONNXModel``/``JaxModel`` and ``ServingEngine``
+accept ``tuning="auto"``; ``warm_up`` compiles exactly the chosen
+vocabulary. See the "Measurement-driven autotuning" section of
+docs/performance.md.
+"""
+
+from .cost_model import (CostModel, TuningDecision, candidate_configs,
+                         measured_sweep, probe_budget, resolve_tuning)
+from .observations import (TUNING_DIR_ENV, Observation, ObservationStore,
+                           get_store, import_bench_records, reset_store,
+                           set_store)
+
+__all__ = [
+    "TUNING_DIR_ENV",
+    "Observation",
+    "ObservationStore",
+    "get_store",
+    "set_store",
+    "reset_store",
+    "import_bench_records",
+    "CostModel",
+    "TuningDecision",
+    "candidate_configs",
+    "measured_sweep",
+    "probe_budget",
+    "resolve_tuning",
+]

@@ -1,0 +1,72 @@
+# Hand-written stub (cost_model.py defines no PipelineStage, so codegen
+# skips it); kept in sync by tpulint rule TPU006 (stub-drift).
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+from .observations import ObservationStore
+
+PROBE_BUDGET_ENV: str
+DEFAULT_PROBE_BUDGET: int
+
+def probe_budget() -> int: ...
+
+class TuningDecision:
+    mini_batch_size: int
+    prefetch_depth: int
+    buckets: Optional[Tuple[int, ...]]
+    warm_up_sizes: Tuple[int, ...]
+    vocabulary: Tuple[int, ...]
+    predicted_seconds: float
+    predicted_rows_per_sec: Optional[float]
+    source: str
+    details: Dict[str, Any]
+    def __init__(self, *, mini_batch_size: int, prefetch_depth: int,
+                 buckets: Optional[Tuple[int, ...]],
+                 warm_up_sizes: Tuple[int, ...],
+                 vocabulary: Tuple[int, ...], predicted_seconds: float,
+                 predicted_rows_per_sec: Optional[float], source: str,
+                 details: Optional[dict] = ...) -> None: ...
+    def as_dict(self) -> dict: ...
+
+def candidate_configs(histogram: Dict[int, int],
+                      defaults: Tuple[int, int] = ...,
+                      depths: Sequence[int] = ...,
+                      ) -> List[Tuple[int, int, Optional[Tuple[int, ...]]]]: ...
+
+class CostModel:
+    alpha: float
+    beta: float
+    prep_rate: float
+    compile_cost: float
+    direct: Dict[tuple, float]
+    n_samples: int
+    def __init__(self, *, alpha: float, beta: float, prep_rate: float,
+                 compile_cost: float,
+                 direct: Optional[Dict[tuple, float]] = ...,
+                 n_samples: int = ...) -> None: ...
+    @classmethod
+    def fit(cls, rows: Iterable[dict]) -> "CostModel": ...
+    def predict_seconds(self, histogram: Dict[int, int],
+                        mini_batch_size: int, prefetch_depth: int,
+                        buckets: Optional[Sequence[int]] = ...,
+                        compile_weight: float = ...) -> float: ...
+    def choose(self, histogram: Dict[int, int],
+               defaults: Tuple[int, int] = ...,
+               candidates: Optional[List[tuple]] = ...,
+               compile_weight: float = ...) -> TuningDecision: ...
+
+def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
+                   defaults: Tuple[int, int] = ...,
+                   store: Optional[ObservationStore] = ...,
+                   compile_weight: float = ...
+                   ) -> Optional[TuningDecision]: ...
+def measured_sweep(make_runner: Callable[..., Any], n_rows: int, *, sig: str,
+                   placement: str = ...,
+                   histogram: Optional[Dict[int, int]] = ...,
+                   candidates: Optional[List[tuple]] = ...,
+                   budget: Optional[int] = ...,
+                   store: Optional[ObservationStore] = ...,
+                   defaults: Tuple[int, int] = ...,
+                   ) -> TuningDecision: ...
+
+def __getattr__(name: str) -> Any: ...
