@@ -35,26 +35,72 @@ TARGET_IMG_PER_SEC = 3000.0
 DEFAULT_WALL_BUDGET_S = 540.0
 
 
+def _partial_path():
+    """Where per-phase checkpoints land. ``BENCH_PARTIAL_PATH`` overrides;
+    empty string disables; default sits next to this file so the driver
+    finds it with the BENCH_r0*.json trajectory."""
+    p = os.environ.get("BENCH_PARTIAL_PATH")
+    if p is None:
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_partial.json")
+    return p or None
+
+
 class _OneShotReport:
     """The bench's single JSON line, emittable exactly once from any thread.
 
     The main path fills ``record`` in place as results land and emits at the
     end; the budget watchdog emits the partial record at the deadline. The
     lock guarantees the driver never sees zero or two lines.
+
+    ``checkpoint`` additionally persists the record-so-far to
+    ``_partial_path()`` after every completed phase (tmp + atomic rename):
+    the SIGTERM handlers cannot outrun ``timeout -k``'s follow-up SIGKILL
+    (BENCH_r05.json: rc=124, empty tail, every completed phase lost), but
+    a file already on disk survives any kill.
     """
 
-    def __init__(self, record: dict):
+    def __init__(self, record: dict, path=None):
         self.record = record
+        self.path = path
+        self._phases = []
         self._lock = threading.Lock()
         self._emitted = False
+
+    def _write_file(self, payload: str) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+            os.replace(tmp, self.path)  # atomic: never a torn partial
+        except OSError:
+            pass                        # checkpointing must never kill a run
+
+    def checkpoint(self, phase: str) -> None:
+        """Persist the record after ``phase`` completed (atomic rename)."""
+        with self._lock:
+            if self._emitted:
+                return
+            self._phases.append(phase)
+            snap = dict(self.record)
+            snap["partial"] = {"complete": False,
+                               "phases_done": list(self._phases)}
+            payload = json.dumps(snap, default=str)
+        self._write_file(payload)
 
     def emit(self) -> bool:
         with self._lock:
             if self._emitted:
                 return False
             self._emitted = True
-        sys.stdout.write(json.dumps(self.record) + "\n")
+            self.record["partial"] = {"complete": True,
+                                      "phases_done": list(self._phases)}
+        payload = json.dumps(self.record, default=str)
+        sys.stdout.write(payload + "\n")
         sys.stdout.flush()
+        self._write_file(payload)
         return True
 
 class _PhaseTimeout(BaseException):
@@ -64,16 +110,20 @@ class _PhaseTimeout(BaseException):
 
 
 @contextlib.contextmanager
-def _phase_guard(record: dict, name: str, seconds: float):
+def _phase_guard(record: dict, name: str, seconds: float, report=None):
     """Per-phase wall-clock guard: arm SIGALRM so a stuck phase raises in
     the MAIN thread at its deadline and is skipped (named in the record)
     instead of dragging the whole bench into the external timeout — the
     BENCH_r05 failure mode was one overrunning section eating every later
     phase AND the JSON emit. No-ops off the main thread (signals only
-    deliver there) and for non-positive budgets."""
+    deliver there) and for non-positive budgets. When ``report`` is given,
+    the record-so-far is checkpointed to disk as the phase ends — timed
+    out or not — so a later SIGKILL cannot erase it."""
     if (seconds <= 0
             or threading.current_thread() is not threading.main_thread()):
         yield
+        if report is not None:
+            report.checkpoint(name)
         return
 
     def _on_alarm(signum, frame):
@@ -88,6 +138,8 @@ def _phase_guard(record: dict, name: str, seconds: float):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, prev)
+        if report is not None:
+            report.checkpoint(name)
 
 
 def _install_signal_handlers(report: "_OneShotReport", fill_partial):
@@ -384,6 +436,19 @@ def _generation_phase(on_tpu: bool) -> dict:
                    "high_water": pool.high_water,
                    "defrag_moves": pool.stats["defrag_moves"],
                    "prefill_chunks": pool.stats["prefill_chunks"]},
+        # which paged-attention impl decoded, and what the kernel saved:
+        # the gather fallback materializes a contiguous K/V copy per paged
+        # call — hbm_bytes_saved_per_step is that per-engine-tick traffic
+        # the Pallas kernel never moves (0 when gather actually ran,
+        # since nothing was saved)
+        "paged_attn": {
+            "impl": eng._attn_impl,
+            "ticks_kernel": pool.stats.get("attn_ticks_kernel", 0),
+            "ticks_gather": pool.stats.get("attn_ticks_gather", 0),
+            "gather_bytes_total": pool.stats.get("gather_bytes", 0),
+            "hbm_bytes_saved_per_step": (
+                eng._k * eng._gather_bytes_tick
+                if eng._attn_impl == "kernel" else 0)},
         "gamma_trajectory": [h for h in (eng._tuner.history
                                          if eng._tuner else [])
                              if h["knob"] == "gamma"],
@@ -410,7 +475,8 @@ def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
     import glob
 
     from mmlspark_tpu.tuning import (CostModel, ObservationStore,
-                                     get_store, import_bench_records)
+                                     compare_paged_attn, get_store,
+                                     import_bench_records)
 
     here = os.path.dirname(os.path.abspath(__file__))
     priors = sorted(glob.glob(os.path.join(here, "BENCH_r0*.json")))
@@ -421,6 +487,18 @@ def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
     imported = import_bench_records(priors, store)
     out = {"imported_bench_records": imported, "store_rows": len(store),
            "sig": sig}
+    # this run's generation phase + the imported trajectory, grouped by
+    # paged-attention impl: the kernel-vs-gather evidence per placement
+    gen = record.get("generation")
+    if isinstance(gen, dict) and isinstance(gen.get("tok_per_sec"),
+                                            (int, float)):
+        from mmlspark_tpu.tuning.observations import _generation_observation
+        row = _generation_observation(record, __file__)
+        if row is not None:
+            store.record(row)
+    pa = compare_paged_attn(store)
+    if pa:
+        out["paged_attn_comparison"] = pa
 
     histogram = {batch: n_rows // batch}
     if n_rows % batch:
@@ -502,7 +580,7 @@ def main():
         "device_resident_ips_fused": None, "device_mfu_fused": None,
         "h2d_gbps": None, "backend_probe": None, "residency": None,
     }
-    report = _OneShotReport(record)
+    report = _OneShotReport(record, path=_partial_path())
     # registered once the model exists, so even a budget-truncated record
     # carries the stage counters measured so far
     counter_sources = []
@@ -621,7 +699,8 @@ def main():
     # MMLSPARK_TPU_COMPILE_CACHE_DIR set the executables also persist to
     # disk for the next process.
     warm_sizes = sorted({batch, n_rows % batch or batch})
-    with _phase_guard(record, "warm_up", min(remaining() - 90.0, 300.0)):
+    with _phase_guard(record, "warm_up", min(remaining() - 90.0, 300.0),
+                      report=report):
         try:
             t0 = time.perf_counter()
             record["warm_up"] = m.warm_up(
@@ -686,7 +765,8 @@ def main():
     from mmlspark_tpu.observability import tracing as _tracing
     from mmlspark_tpu.ops.compile_cache import jit_cache_size
     cache_before_passes = jit_cache_size(m._jitted)
-    with _phase_guard(record, "timed_passes", remaining() - 60.0):
+    with _phase_guard(record, "timed_passes", remaining() - 60.0,
+                      report=report):
         for i in range(max(1, passes)):
             if remaining() < 45.0:
                 # keep enough budget to assemble and emit the report; a
@@ -747,7 +827,8 @@ def main():
     # by the SIGALRM guard, and must not starve this phase -- it is the
     # number this bench exists to move. Own guard + own try so a failure
     # here never costs the image numbers above.
-    with _phase_guard(record, "generation", min(remaining() - 30.0, 240.0)):
+    with _phase_guard(record, "generation", min(remaining() - 30.0, 240.0),
+                      report=report):
         try:
             if remaining() > 45.0:
                 record["generation"] = _generation_phase(on_tpu)
@@ -760,7 +841,8 @@ def main():
     # tuning phase: pure host arithmetic over this run's harvested samples
     # + the historical bench records — chosen config, per-knob predicted
     # deltas, and the trajectory regression guard
-    with _phase_guard(record, "tuning", min(remaining() - 20.0, 60.0)):
+    with _phase_guard(record, "tuning", min(remaining() - 20.0, 60.0),
+                      report=report):
         try:
             record["tuning"] = _tuning_phase(record, m, batch=batch,
                                              n_rows=n_rows, ips=ips)
@@ -783,7 +865,7 @@ def main():
     # one of these can silently eat the remaining budget -- the BENCH_r05
     # failure mode -- and starve the generation phase below.
     with _phase_guard(record, "device_probes",
-                      min(remaining() - 90.0, 300.0)):
+                      min(remaining() - 90.0, 300.0), report=report):
         try:
             if not h2d_samples and remaining() > 30.0:
                 h2d_samples.append(_h2d_streaming_gbps())

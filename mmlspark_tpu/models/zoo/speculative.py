@@ -35,7 +35,7 @@ Two contracts, two entry points:
 from __future__ import annotations
 
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,7 @@ from .transformer import (TransformerConfig, _warp_scaled_rows,
                           decode_step, decode_window, decode_window_paged,
                           init_kv_cache, init_paged_cache,
                           paged_scatter_rows, prefill_cache)
+from ...ops.paged_attention import resolve_impl
 
 __all__ = ["generate_speculative", "generate_speculative_fused",
            "generate_speculative_paged", "generate_speculative_sampled"]
@@ -468,8 +469,9 @@ def generate_speculative_paged(t_params: Dict, d_params: Dict,
                                d_cfg: TransformerConfig,
                                max_new_tokens: int = 32,
                                gamma: int = 4,
-                               page_size: int = 16) -> Tuple[jnp.ndarray,
-                                                             dict]:
+                               page_size: int = 16,
+                               paged_attn: Optional[str] = None,
+                               ) -> Tuple[jnp.ndarray, dict]:
     """:func:`generate_speculative` with the TARGET cache held in a paged
     pool — the reference loop for the paged verify path the continuous
     decoder runs, and the parity oracle ``tests/test_kv_pool.py`` checks.
@@ -483,6 +485,12 @@ def generate_speculative_paged(t_params: Dict, d_params: Dict,
     IDENTICAL to :func:`generate_speculative` (and hence to greedy
     target-only decoding). The draft cache stays contiguous: it is small,
     never shared, and paging it buys nothing.
+
+    ``paged_attn`` selects the verify window's implementation (``None``
+    → the ``MMLSPARK_TPU_PAGED_ATTN`` knob, default the Pallas kernel
+    reading pages in place; ``"gather"`` keeps the bitwise
+    gather-then-ragged path). The chosen impl is recorded in
+    ``stats["paged_attn_impl"]``.
     """
     if t_cfg.vocab != d_cfg.vocab:
         raise ValueError("draft and target must share a vocabulary")
@@ -490,6 +498,7 @@ def generate_speculative_paged(t_params: Dict, d_params: Dict,
         raise ValueError("gamma must be >= 1")
     if page_size < 1:
         raise ValueError("page_size must be >= 1")
+    impl = resolve_impl(paged_attn)
     t_params = jax.tree.map(jnp.asarray, t_params)
     d_params = jax.tree.map(jnp.asarray, d_params)
     prompt_ids = jnp.asarray(prompt_ids)
@@ -526,7 +535,7 @@ def generate_speculative_paged(t_params: Dict, d_params: Dict,
     def verify(wtoks, pos, pages):
         logits, pages = decode_window_paged(
             t_params, wtoks, jnp.full((B,), pos, jnp.int32), pages, bt,
-            t_cfg, page_size=page_size, length=L)
+            t_cfg, page_size=page_size, length=L, impl=impl)
         greedy = jnp.argmax(logits, axis=-1)           # (B, gamma+1)
         match = greedy[:, :-1] == wtoks[:, 1:].astype(greedy.dtype)
         accept = jnp.min(jnp.sum(jnp.cumprod(
@@ -551,7 +560,7 @@ def generate_speculative_paged(t_params: Dict, d_params: Dict,
     tail = jnp.zeros((B, 0), prompt_ids.dtype)
     stats = {"target_forwards": 1, "draft_steps": 0, "accepted_drafts": 0,
              "rounds": 0, "pages_per_row": n_pages_row,
-             "page_size": page_size}
+             "page_size": page_size, "paged_attn_impl": impl}
 
     while emitted < max_new_tokens:
         drafts, d_cache = draft_propose(tail, pending, m - tail.shape[1],

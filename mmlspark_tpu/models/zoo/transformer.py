@@ -798,16 +798,84 @@ def _paged_writeback(cache_pages, new_cache, block_tables, wpos,
     return out
 
 
+def _decode_window_paged_kernel(params: Dict, tokens: jnp.ndarray,
+                                pos: jnp.ndarray, cache_pages,
+                                block_tables, cfg: TransformerConfig,
+                                page_size: int,
+                                active: Optional[jnp.ndarray]):
+    """The Pallas paged-attention layer loop: identical embedding / rope /
+    projection / FFN math to :func:`decode_window_ragged`, but attention
+    reads K/V pages IN PLACE through the block table and scatters the
+    window's fresh rows in the same launch
+    (:func:`~mmlspark_tpu.ops.paged_attention.paged_attention_window`) —
+    no contiguous gather, no separate writeback. Page contents written
+    are bit-identical to ``_paged_writeback``'s; the context differs from
+    the gather path only by f32 online-softmax accumulation order."""
+    from ...ops.paged_attention import paged_attention_window
+    dt = cfg.dtype
+    B, W = tokens.shape
+    hd = cfg.d_model // cfg.heads
+    pos = pos.astype(jnp.int32)
+    wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)       # (B, W)
+    h = params["embed"]["tok"].astype(dt)[tokens]              # (B, W, D)
+    if cfg.position == "learned":
+        h = h + params["embed"]["pos"].astype(dt)[wpos]
+    if cfg.position == "rope":
+        cos, sin = _rope_tables(wpos, hd, cfg.rope_theta, dt)  # (B, W, h/2)
+        cos, sin = cos[:, None], sin[:, None]                  # (B,1,W,·)
+    new_pages = []
+    for lp, c in zip(params["layers"], cache_pages):
+        x = _norm(h.astype(jnp.float32), lp["ln1"], cfg).astype(dt)
+        qkv = x @ lp["qkv"]["w"].astype(dt) + lp["qkv"]["b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, W, cfg.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.position == "rope":
+            q = _rot_half(q, cos, sin)
+            k = _rot_half(k, cos, sin)
+        ctx, kp, vp = paged_attention_window(
+            q, k.astype(dt), v.astype(dt), c["k"], c["v"],
+            block_tables, pos, active=active)
+        new_pages.append({"k": kp, "v": vp})
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, W, cfg.d_model)
+        h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
+        x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
+        y = jax.nn.gelu(x @ lp["w1"]["w"].astype(dt) + lp["w1"]["b"].astype(dt))
+        y = y @ lp["w2"]["w"].astype(dt) + lp["w2"]["b"].astype(dt)
+        h = h + y
+    hidden = _norm(h.astype(jnp.float32), params["final_ln"], cfg).astype(dt)
+    logits = hidden.astype(jnp.float32) @ params["lm_head"]["w"]
+    return logits, new_pages
+
+
 def decode_step_paged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
                       cache_pages, block_tables, cfg: TransformerConfig, *,
                       page_size: int, length: int,
-                      active: Optional[jnp.ndarray] = None):
-    """:func:`decode_step_ragged` over a paged pool: gather through the
-    block table, run the IDENTICAL ragged-step math, scatter the one new
-    K/V position per row back to its page. Logits are bitwise equal to
-    the contiguous path on the same cache contents (masked garbage lanes
-    contribute exactly 0). ``length`` is the logical cache length (the
-    contiguous L); every ``pos`` must be < length."""
+                      active: Optional[jnp.ndarray] = None,
+                      impl: Optional[str] = None):
+    """One paged decode step. Two implementations, selected by ``impl``
+    (``None`` → the ``MMLSPARK_TPU_PAGED_ATTN`` env knob, default
+    ``"kernel"``):
+
+    * ``"kernel"`` — the Pallas paged-attention kernel attends directly
+      over the page pool through the block table and scatters the fresh
+      K/V row in the same launch. Page writes are bit-identical to the
+      gather path; logits agree to f32 accumulation-order tolerance.
+    * ``"gather"`` — PR 7's path: gather through the block table, run the
+      IDENTICAL ragged-step math, scatter the one new K/V position per
+      row back to its page. Logits are bitwise equal to the contiguous
+      path on the same cache contents (masked garbage lanes contribute
+      exactly 0). ``length`` is the logical cache length (the contiguous
+      L); every ``pos`` must be < length."""
+    from ...ops.paged_attention import resolve_impl
+    if resolve_impl(impl) == "kernel":
+        logits, pages = _decode_window_paged_kernel(
+            params, tokens[:, None], pos.astype(jnp.int32), cache_pages,
+            block_tables, cfg, page_size, active)
+        return logits[:, 0], pages
     gathered = paged_gather(cache_pages, block_tables, length)
     logits, new = decode_step_ragged(params, tokens, pos.astype(jnp.int32),
                                      gathered, cfg, active)
@@ -821,13 +889,21 @@ def decode_window_paged(params: Dict, tokens: jnp.ndarray,
                         pos: jnp.ndarray, cache_pages, block_tables,
                         cfg: TransformerConfig, *, page_size: int,
                         length: int,
-                        active: Optional[jnp.ndarray] = None):
-    """:func:`decode_window_ragged` over a paged pool — the speculative
-    verify and chunked-prefill primitive. Row b's window writes positions
-    ``pos[b]..pos[b]+W-1`` into its pages; every such position must be
-    < ``length`` (the engine sizes allocations so windows never clamp)."""
+                        active: Optional[jnp.ndarray] = None,
+                        impl: Optional[str] = None):
+    """Paged window decode — the speculative verify and chunked-prefill
+    primitive. Row b's window writes positions ``pos[b]..pos[b]+W-1``
+    into its pages; every such position must be < ``length`` (the engine
+    sizes allocations so windows never clamp). ``impl`` selects the
+    Pallas kernel (default) or PR 7's gather path exactly as in
+    :func:`decode_step_paged`."""
+    from ...ops.paged_attention import resolve_impl
     W = tokens.shape[1]
     pos = pos.astype(jnp.int32)
+    if resolve_impl(impl) == "kernel":
+        return _decode_window_paged_kernel(params, tokens, pos,
+                                           cache_pages, block_tables,
+                                           cfg, page_size, active)
     wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)
     gathered = paged_gather(cache_pages, block_tables, length)
     logits, new = decode_window_ragged(params, tokens, pos, gathered,

@@ -1,0 +1,20 @@
+# Hand-written stub (paged_attention.py defines no PipelineStage, so
+# codegen skips it); kept in sync by tpulint rule TPU006 (stub-drift).
+from typing import Any, Optional, Tuple
+
+ENV_KNOB: str
+
+def resolve_impl(override: Optional[str] = ...) -> str: ...
+def sublane_multiple(dtype: Any) -> int: ...
+def aligned_page_size(page_size: int, dtype: Any) -> int: ...
+def paged_attention(q: Any, k_pages: Any, v_pages: Any,
+                    block_tables: Any, lengths: Any, *,
+                    scale: Optional[float] = ...,
+                    interpret: Optional[bool] = ...) -> Any: ...
+def paged_attention_window(q: Any, k_new: Any, v_new: Any,
+                           k_pages: Any, v_pages: Any,
+                           block_tables: Any, pos: Any, *,
+                           active: Optional[Any] = ...,
+                           scale: Optional[float] = ...,
+                           interpret: Optional[bool] = ...
+                           ) -> Tuple[Any, Any, Any]: ...

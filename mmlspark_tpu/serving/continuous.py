@@ -79,6 +79,8 @@ from ..models.zoo.transformer import (TransformerConfig,
                                       paged_scatter_rows,
                                       prefill_cache, shardings_for)
 from ..ops.padding import bucket_size
+from ..ops.paged_attention import (resolve_impl as _resolve_paged_attn,
+                                   _auto_interpret as _pa_auto_interpret)
 from .kv_pool import (KVAutotuner, PagedKVPool, PoolExhausted,
                       prefix_hash as _prefix_hash)
 
@@ -145,8 +147,10 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
 # each call donates its own argument buffers, never another engine's.
 
 @functools.lru_cache(maxsize=None)
-def _tick_program(cfg, page, Lc, k, eos, sample, donate):
-    """The decode tick: k ragged paged steps fused in one lax.scan."""
+def _tick_program(cfg, page, Lc, k, eos, sample, donate, attn="kernel"):
+    """The decode tick: k paged steps fused in one lax.scan. ``attn``
+    (part of the cache key — the impl is baked in at trace time) selects
+    the Pallas paged-attention kernel or the gather fallback."""
     eos_const = None if eos is None else jnp.int32(eos)
 
     def tick(params, tok, pos, active, bufs, bt, remaining,
@@ -155,7 +159,7 @@ def _tick_program(cfg, page, Lc, k, eos, sample, donate):
             tok, pos, active, bufs, remaining = carry
             logits, bufs = decode_step_paged(
                 params, tok, pos, bufs, bt, cfg,
-                page_size=page, length=Lc, active=active)
+                page_size=page, length=Lc, active=active, impl=attn)
             if sample:
                 # emit position is pos+1 — generate_cached's key
                 # schedule (fold_in by absolute emit position), so
@@ -191,16 +195,17 @@ def _prefill_program(cfg, L):
 
 
 @functools.lru_cache(maxsize=None)
-def _extend_program(cfg, page, L, donate):
+def _extend_program(cfg, page, L, donate, attn="kernel"):
     """Paged window extension: continue ONE slot's pages over a token
     window — the prefix-cache suffix path and chunked prefill share this
-    single program (one compile per window bucket). Gathers at length L:
-    the exact reduction length the old contiguous extension used, so
-    greedy prefix-hit outputs stay identical."""
+    single program (one compile per window bucket). The gather impl
+    gathers at length L: the exact reduction length the old contiguous
+    extension used, so greedy prefix-hit outputs stay identical; the
+    kernel impl reads pages in place (f32-accumulation tolerance)."""
     def _extend(params, ids, start, bufs, bt_row):
         return decode_window_paged(params, ids, start, bufs, bt_row,
                                    cfg, page_size=page, length=L,
-                                   active=None)
+                                   active=None, impl=attn)
 
     return jax.jit(_extend, donate_argnums=(3,) if donate else ())
 
@@ -282,7 +287,7 @@ def _first_tokens_program():
 
 @functools.lru_cache(maxsize=None)
 def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
-                       sample, warp, donate):
+                       sample, warp, donate, attn="kernel"):
     """The speculative tick: k draft→verify rounds in one scan.
 
     Per round, the draft proposes gamma tokens per slot (gamma+1 ragged
@@ -383,7 +388,7 @@ def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
             wtoks = jnp.concatenate([tok[:, None], drafts], 1)
             w_logits, bufs = decode_window_paged(
                 params, wtoks, pos, bufs, bt, cfg,
-                page_size=page, length=Lc, active=active)
+                page_size=page, length=Lc, active=active, impl=attn)
             greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
             match = greedy[:, :gamma] == drafts
             if sample:
@@ -500,7 +505,8 @@ class ContinuousDecoder:
                  prefill_chunk: int = 256,
                  kv_pages: Optional[int] = None,
                  autotune: bool = False,
-                 defrag_threshold: Optional[int] = None):
+                 defrag_threshold: Optional[int] = None,
+                 paged_attn: Optional[str] = None):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -646,6 +652,26 @@ class ContinuousDecoder:
             # the pad-bucket floor; a sub-bucket budget would chunk every
             # prompt into windows the bucketing immediately re-inflates
             raise ValueError("prefill_chunk must be >= 8")
+        #: paged-attention implementation: the Pallas kernel (default)
+        #: reads K/V pages in place through the block table; "gather"
+        #: keeps PR 7's gather-then-ragged path (bitwise vs contiguous).
+        #: Resolved ONCE here and threaded into every compiled-program
+        #: cache key — the env knob must not leak into shared programs.
+        impl = _resolve_paged_attn(paged_attn)
+        if impl == "kernel" and mesh is not None:
+            # the kernel is not GSPMD-partitionable: a bare pallas_call
+            # inside a tp-sharded jit would gather the pool onto one
+            # device. Sharded engines keep the gather path (which GSPMD
+            # partitions like any einsum) until a shard_map mount lands.
+            impl = "gather"
+        self._attn_impl = impl
+        if impl == "kernel" and not _pa_auto_interpret():
+            # real TPU: the page dimension sits in the kernel's sublane
+            # slot — round the page size up to the dtype's tile
+            # (transparent to allocation accounting; interpret-mode CI
+            # keeps the requested size so test pool shapes are unchanged)
+            page_size = PagedKVPool.kernel_aligned_page_size(
+                page_size, cfg.dtype)
         self._page = int(page_size)
         #: block-table width: logical pages per slot at full cache length
         self._P_max = -(-self._Lc // self._page)
@@ -711,9 +737,20 @@ class ContinuousDecoder:
         # but never changes it — pages are remapped host-side between
         # dispatches, and the engine re-binds self._bt outside jit.
         self._tick = _tick_program(cfg, page, Lc, self._k, self._eos,
-                                   False, donate)
+                                   False, donate, self._attn_impl)
         self._tick_sampled = _tick_program(cfg, page, Lc, self._k,
-                                           self._eos, True, donate)
+                                           self._eos, True, donate,
+                                           self._attn_impl)
+        # per-call HBM traffic the gather impl pays materializing
+        # contiguous K/V (2 tensors x layers x (B, H, L, hd)); the
+        # kernel impl's figure is 0 by construction — these feed the
+        # mmlspark_kvpool_gather_bytes_total counter and bench's
+        # bytes-saved estimate
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        self._gather_bytes_tick = (2 * cfg.layers * self._S * cfg.heads
+                                   * Lc * hd * itemsize)
+        self._gather_bytes_extend = (2 * cfg.layers * cfg.heads
+                                     * self._L * hd * itemsize)
         #: most tokens one dispatch can emit per slot (the retirement
         #: horizon unit): k plain steps, or k rounds × (gamma+1) spec —
         #: sized at the autotune CEILING so the horizon stays an upper
@@ -733,7 +770,8 @@ class ContinuousDecoder:
                     fn = _spec_tick_program(
                         cfg, d_cfg, page, Lc, self._k, self._eos, g,
                         sample=(mode != "greedy"),
-                        warp=(mode == "warped"), donate=donate)
+                        warp=(mode == "warped"), donate=donate,
+                        attn=self._attn_impl)
                     self._spec_ticks[(mode, g)] = fn
                 return fn
 
@@ -747,7 +785,8 @@ class ContinuousDecoder:
             self._d_prefill = _prefill_program(self._d_cfg, self._L)
 
         # prefix-cache suffix extension + chunked prefill (one program)
-        self._extend_paged = _extend_program(cfg, page, self._L, donate)
+        self._extend_paged = _extend_program(cfg, page, self._L, donate,
+                                             self._attn_impl)
 
         # copy-on-write boundary-page copy + defrag permutation
         self._copy_pages_j = _copy_pages_program(donate)
@@ -1286,6 +1325,10 @@ class ContinuousDecoder:
                 jnp.asarray([start], jnp.int32),
                 self._kv.buffers, self._bt[slot:slot + 1])
             self._kv.buffers = bufs
+            self._kv.note_attn_tick(
+                self._attn_impl,
+                gather_bytes=(self._gather_bytes_extend
+                              if self._attn_impl == "gather" else 0))
             self._insert_chunk([(slot, req)], w_logits[:, Sn - 1], [],
                                self._draft_prompt_rows(req))
             return True
@@ -1380,6 +1423,10 @@ class ContinuousDecoder:
                 jnp.asarray([off], jnp.int32),
                 self._kv.buffers, self._bt[slot:slot + 1])
         self._kv.buffers = bufs
+        self._kv.note_attn_tick(
+            self._attn_impl,
+            gather_bytes=(self._gather_bytes_extend
+                          if self._attn_impl == "gather" else 0))
         self._kv.note_prefill_chunk(w)
         self._chunk_trace.append(w)
         _tracing.add_event("prefill_chunk", slot=slot, offset=off,
@@ -1546,6 +1593,12 @@ class ContinuousDecoder:
                 self._params, self._tok, self._pos, self._active,
                 self._kv.buffers, self._bt, self._remaining)
             self._kv.buffers = bufs
+        # per-dispatch attention accounting: k paged calls rode this
+        # dispatch; only the gather impl moves materialization bytes
+        self._kv.note_attn_tick(
+            self._attn_impl, calls=self._k,
+            gather_bytes=(self._k * self._gather_bytes_tick
+                          if self._attn_impl == "gather" else 0))
         # snapshot slot→REQUEST (not indices): by the time this block is
         # drained, a slot may have been freed and re-admitted; tokens must
         # go to the request that occupied the slot at DISPATCH time (its

@@ -38,7 +38,8 @@ class ContinuousDecoder:
                  prefill_chunk: int = ...,
                  kv_pages: Optional[int] = ...,
                  autotune: bool = ...,
-                 defrag_threshold: Optional[int] = ...) -> None: ...
+                 defrag_threshold: Optional[int] = ...,
+                 paged_attn: Optional[str] = ...) -> None: ...
     def submit(self, prompt_ids: Any, max_new_tokens: int = ..., *,
                temperature: float = ..., top_k: int = ...,
                top_p: float = ..., seed: int = ...,

@@ -81,6 +81,15 @@ M_AUTOTUNE_DEPTH = _metric_gauge(
     "mmlspark_kvpool_autotune_pipeline_depth",
     "Current decode pipeline depth (in-flight steps) chosen by the KV "
     "autotuner")
+M_GATHER_BYTES = _metric_counter(
+    "mmlspark_kvpool_gather_bytes_total",
+    "HBM bytes moved by gather-impl paged attention materializing "
+    "contiguous K/V before attending (0 under the Pallas kernel, which "
+    "reads pages in place)")
+M_KERNEL_TICKS = _metric_counter(
+    "mmlspark_kvpool_kernel_ticks_total",
+    "Paged-attention decode calls dispatched, by implementation",
+    labelnames=("impl",))
 
 
 def prefix_hash(tokens: Sequence[int]) -> str:
@@ -131,7 +140,9 @@ class PagedKVPool:
         self._prefix_regs: Dict[str, int] = {}
         self.high_water = 0
         self.stats = {"prefix_share_hits": 0, "defrag_moves": 0,
-                      "prefill_chunks": 0, "alloc_failures": 0}
+                      "prefill_chunks": 0, "alloc_failures": 0,
+                      "gather_bytes": 0, "attn_ticks_kernel": 0,
+                      "attn_ticks_gather": 0}
         M_PAGES_TOTAL.set(self.num_pages - 1)
         M_PAGES_IN_USE.set(0)
         self._reservation = None
@@ -306,6 +317,39 @@ class PagedKVPool:
     def note_prefill_chunk(self, ntok: int) -> None:
         self.stats["prefill_chunks"] += 1
         M_PREFILL_CHUNKS.inc()
+
+    def note_attn_tick(self, impl: str, *, calls: int = 1,
+                       gather_bytes: int = 0) -> None:
+        """Account one dispatched paged-attention batch: ``calls`` decode/
+        window invocations under ``impl`` ("kernel" or "gather"), plus the
+        HBM bytes the gather impl moved materializing contiguous K/V
+        (always 0 under the kernel — it reads pages in place)."""
+        key = f"attn_ticks_{impl}"
+        self.stats[key] = self.stats.get(key, 0) + calls
+        M_KERNEL_TICKS.inc(calls, impl=impl)
+        if gather_bytes:
+            self.stats["gather_bytes"] += gather_bytes
+            M_GATHER_BYTES.inc(gather_bytes)
+
+    # -- kernel page-layout contract -----------------------------------------
+
+    @staticmethod
+    def kernel_page_multiple(dtype) -> int:
+        """Sublane tile the Pallas paged-attention kernel needs
+        ``page_size`` to be a multiple of on a real TPU: 8 (f32),
+        16 (bf16), 32 (int8) — the page dimension sits in the sublane
+        slot of the kernel's ``(1, heads, page, head_dim)`` blocks."""
+        from ..ops.paged_attention import sublane_multiple
+        return sublane_multiple(dtype)
+
+    @classmethod
+    def kernel_aligned_page_size(cls, page_size: int, dtype) -> int:
+        """``page_size`` rounded up to the kernel-tileable multiple for
+        ``dtype`` (identity when it already complies). The engine applies
+        this whenever the kernel impl runs on a real TPU; interpret mode
+        (CPU CI) accepts any page size."""
+        from ..ops.paged_attention import aligned_page_size
+        return aligned_page_size(page_size, dtype)
 
     def reset(self) -> None:
         """Forget every allocation and re-zero the device buffers (the

@@ -39,8 +39,8 @@ from ..ops.padding import bucket_size
 from .observations import ObservationStore, get_store
 
 __all__ = ["CostModel", "TuningDecision", "candidate_configs",
-           "measured_sweep", "probe_budget", "resolve_tuning",
-           "PROBE_BUDGET_ENV"]
+           "compare_paged_attn", "measured_sweep", "probe_budget",
+           "resolve_tuning", "PROBE_BUDGET_ENV"]
 
 #: bounds the measured sweep: at most this many candidate configs are run
 PROBE_BUDGET_ENV = "MMLSPARK_TPU_TUNING_PROBES"
@@ -310,6 +310,40 @@ class CostModel:
                      "compile_cost": self.compile_cost,
                      "n_samples": self.n_samples,
                      "n_candidates": len(cands)})
+
+
+def compare_paged_attn(store: Optional[ObservationStore] = None,
+                       sig: str = "generation") -> Dict[str, dict]:
+    """Kernel-vs-gather generation throughput per placement.
+
+    Groups the harvested ``generation`` observations (each stamped with
+    ``paged_attn_impl`` by :func:`import_bench_records`) by placement and
+    implementation, and reports mean tok/s plus the kernel/gather
+    speedup where both impls have samples — the per-signature evidence
+    ROADMAP item 4's cross-signature transfer will generalize from.
+    Placements with no impl-stamped rows are omitted."""
+    store = store if store is not None else get_store()
+    by_placement: Dict[str, Dict[str, List[float]]] = {}
+    for r in store.rows(sig=sig):
+        impl = r.get("paged_attn_impl") or (r.get("config")
+                                            or {}).get("paged_attn_impl")
+        tps = r.get("rows_per_sec")
+        if impl is None or not isinstance(tps, (int, float)) or tps <= 0:
+            continue
+        by_placement.setdefault(str(r.get("placement", "default")),
+                                {}).setdefault(str(impl), []).append(
+                                    float(tps))
+    out: Dict[str, dict] = {}
+    for placement, impls in by_placement.items():
+        row = {impl: {"n": len(v),
+                      "tok_per_sec_mean": round(sum(v) / len(v), 2)}
+               for impl, v in impls.items()}
+        k = row.get("kernel", {}).get("tok_per_sec_mean")
+        g = row.get("gather", {}).get("tok_per_sec_mean")
+        row["kernel_vs_gather_speedup"] = (
+            round(k / g, 4) if k and g else None)
+        out[placement] = row
+    return out
 
 
 def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],

@@ -253,6 +253,39 @@ def _bench_observation(parsed: dict, source_file: str) -> Optional[dict]:
         if os.path.exists(source_file) else None)
 
 
+def _generation_observation(parsed: dict,
+                            source_file: str) -> Optional[dict]:
+    """One observation from a bench record's ``generation`` phase.
+
+    Carries ``paged_attn_impl`` (the attention implementation the engine
+    decoded with — ``kernel`` or ``gather``) so the cost model can
+    compare the two per signature across the trajectory."""
+    gen = parsed.get("generation")
+    if not isinstance(gen, dict):
+        return None
+    tps = gen.get("tok_per_sec")
+    if not isinstance(tps, (int, float)) or tps <= 0:
+        return None
+    pa = gen.get("paged_attn") if isinstance(gen.get("paged_attn"),
+                                             dict) else {}
+    obs = Observation(
+        sig="generation",
+        source="bench",
+        placement=str(parsed.get("device") or parsed.get("platform")
+                      or "default"),
+        config={"paged_attn_impl": pa.get("impl"),
+                "mini_batch_size": None, "prefetch_depth": None,
+                "buckets": None},
+        rows=int(gen.get("tokens", 0)),
+        seconds=float(gen.get("wall_s", 0.0)),
+        rows_per_sec=float(tps),
+        t=os.path.getmtime(source_file)
+        if os.path.exists(source_file) else None)
+    # top-level for cheap grouping without digging into config
+    obs["paged_attn_impl"] = pa.get("impl")
+    return obs
+
+
 def import_bench_records(paths: Sequence[str],
                          store: Optional[ObservationStore] = None) -> int:
     """Backfill the store from ``BENCH_r0*.json`` records.
@@ -279,6 +312,10 @@ def import_bench_records(paths: Sequence[str],
         obs = _bench_observation(parsed, path)
         if obs is not None:
             store.record(obs)
+            n += 1
+        gen = _generation_observation(parsed, path)
+        if gen is not None:
+            store.record(gen)
             n += 1
     return n
 

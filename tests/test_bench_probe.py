@@ -143,7 +143,35 @@ class TestOneShotReport:
         out = capsys.readouterr().out.strip().splitlines()
         assert len(out) == 1
         import json
-        assert json.loads(out[0]) == {"value": 1}
+        got = json.loads(out[0])
+        assert got["value"] == 1
+        # emit stamps the per-phase checkpoint bookkeeping as complete
+        assert got["partial"] == {"complete": True, "phases_done": []}
+
+    def test_phase_checkpoints_survive_on_disk(self, tmp_path, capsys):
+        # per-phase atomic checkpoints: a SIGKILL landing after a phase
+        # completed must leave that phase's results parseable on disk
+        # (BENCH_r05: rc=124, empty tail, everything lost)
+        import json
+        path = str(tmp_path / "partial.json")
+        rec = {"value": 3}
+        rep = bench._OneShotReport(rec, path=path)
+        rep.checkpoint("warm_up")
+        rec["value"] = 7                    # later phase updates the dict
+        rep.checkpoint("timed_passes")
+        with open(path, encoding="utf-8") as fh:
+            got = json.load(fh)
+        assert got["value"] == 7
+        assert got["partial"] == {
+            "complete": False, "phases_done": ["warm_up", "timed_passes"]}
+        rep.emit()
+        with open(path, encoding="utf-8") as fh:
+            got = json.load(fh)
+        assert got["partial"]["complete"] is True
+        # post-emit checkpoints are no-ops: the final record stays
+        rep.checkpoint("late")
+        with open(path, encoding="utf-8") as fh:
+            assert json.load(fh)["partial"]["complete"] is True
 
     def test_in_place_mutation_is_visible(self, capsys):
         # main() must update the shared dict in place (never rebind it):

@@ -125,6 +125,34 @@ class TestObservationStore:
         assert sorted(r["rows_per_sec"] for r in rows) == [9.13, 268.09]
         assert rows[1]["compiles"] == 3 or rows[0]["compiles"] == 3
 
+    def test_generation_observations_carry_paged_attn_impl(
+            self, tmp_path, store):
+        """Records with a generation phase yield an extra 'generation'
+        observation stamped with the paged-attention impl, and
+        compare_paged_attn turns them into per-placement speedups."""
+        from mmlspark_tpu.tuning import compare_paged_attn
+
+        def rec(val, tps, impl):
+            return {"metric": "resnet50_onnx_images_per_sec_per_chip",
+                    "value": val, "platform": "cpu", "device": "cpu",
+                    "generation": {"tok_per_sec": tps, "tokens": 100,
+                                   "wall_s": 1.0,
+                                   "paged_attn": {"impl": impl}}}
+        for name, payload in (("BENCH_r06.json", rec(5.0, 120.0, "kernel")),
+                              ("BENCH_r07.json", rec(6.0, 80.0, "gather"))):
+            with open(tmp_path / name, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+        n = import_bench_records(
+            [str(tmp_path / "BENCH_r06.json"),
+             str(tmp_path / "BENCH_r07.json")], store)
+        assert n == 4                      # headline + generation per file
+        gen = store.rows(sig="generation")
+        assert sorted(r["paged_attn_impl"] for r in gen) \
+            == ["gather", "kernel"]
+        cmp = compare_paged_attn(store)
+        assert cmp["cpu"]["kernel"]["tok_per_sec_mean"] == 120.0
+        assert cmp["cpu"]["kernel_vs_gather_speedup"] == 1.5
+
 
 # ---------------------------------------------------------------------------
 # cost model
