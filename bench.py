@@ -119,9 +119,22 @@ def _phase_guard(record: dict, name: str, seconds: float, report=None):
     deliver there) and for non-positive budgets. When ``report`` is given,
     the record-so-far is checkpointed to disk as the phase ends — timed
     out or not — so a later SIGKILL cannot erase it."""
+    def _observe_phase(elapsed: float, timed_out: bool) -> None:
+        # per-phase SLO sample: bench phases land in the same scorecard
+        # machinery the serving plane uses (transport="bench", route=phase),
+        # so the emitted record's "slo" block carries phase p99s/timeouts
+        try:
+            from mmlspark_tpu.observability import get_tracker
+            get_tracker().observe(transport="bench", route=name,
+                                  seconds=elapsed, error=timed_out)
+        except Exception:               # noqa: BLE001
+            pass
+
     if (seconds <= 0
             or threading.current_thread() is not threading.main_thread()):
+        t0 = time.perf_counter()
         yield
+        _observe_phase(time.perf_counter() - t0, False)
         if report is not None:
             report.checkpoint(name)
         return
@@ -131,13 +144,17 @@ def _phase_guard(record: dict, name: str, seconds: float, report=None):
 
     prev = signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(max(1, int(seconds)))
+    t0 = time.perf_counter()
+    timed_out = False
     try:
         yield
     except _PhaseTimeout:
+        timed_out = True
         record.setdefault("phase_timeouts", []).append(name)
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, prev)
+        _observe_phase(time.perf_counter() - t0, timed_out)
         if report is not None:
             report.checkpoint(name)
 
@@ -409,10 +426,16 @@ def _generation_phase(on_tpu: bool) -> dict:
                 max_new_tokens=max_new))
     step_s = []
     t0 = time.perf_counter()
-    while any(r is not None for r in eng._slot_req) or eng._waiting:
-        s0 = time.perf_counter()
-        eng.step()
-        step_s.append(time.perf_counter() - s0)
+    # one watch over the whole decode loop, heartbeat per engine tick: the
+    # stall budget bounds ONE step, so a wedged device call mid-generation
+    # produces a diagnostic bundle instead of a silent external timeout
+    from mmlspark_tpu.observability import watch as _wd_watch
+    with _wd_watch("bench_generation") as _w:
+        while any(r is not None for r in eng._slot_req) or eng._waiting:
+            s0 = time.perf_counter()
+            eng.step()
+            _w.beat()
+            step_s.append(time.perf_counter() - s0)
     elapsed = time.perf_counter() - t0
     toks = sum(len(r.tokens) for r in reqs)
     lat = np.sort(np.asarray(step_s))
@@ -585,6 +608,32 @@ def main():
     # carries the stage counters measured so far
     counter_sources = []
 
+    # device-stall watchdog: enabled for the whole bench run regardless of
+    # MMLSPARK_TPU_WATCHDOG (env budget/interval/diag-dir knobs still
+    # apply). A stall stamps the shared record with the bundle path and
+    # checkpoints the partial JSON immediately — a later SIGKILL cannot
+    # erase the verdict.
+    from mmlspark_tpu.observability import configure_watchdog
+
+    def _on_stall(stall: dict) -> None:
+        record.setdefault("watchdog_stalls", []).append(
+            {"site": stall.get("site"), "bundle": stall.get("bundle"),
+             "stalled_seconds": stall.get("stalled_seconds"),
+             "t": stall.get("t")})
+        _fill_partial()
+        report.checkpoint("watchdog_stall")
+
+    configure_watchdog(enabled=True).on_stall(_on_stall)
+
+    def _slo_card():
+        # rolling scorecard of the run's phases + any serving traffic —
+        # attached on EVERY exit path (budget watchdog, signals, clean end)
+        try:
+            from mmlspark_tpu.observability import get_tracker
+            return get_tracker().scorecard()
+        except Exception:               # noqa: BLE001
+            return None
+
     def _telemetry():
         # stdlib-only registry snapshot: compile-cache hits/misses/
         # steady_state_recompiles plus aggregate stage counters, so the
@@ -629,6 +678,7 @@ def main():
                 record["stage_counters"] = snap()
             record["telemetry"] = _telemetry()
             record["residency"] = _residency()
+            record["slo"] = _slo_card()
         except Exception:                   # noqa: BLE001
             pass
 
@@ -731,6 +781,7 @@ def main():
         record["stage_counters"] = m.stage_counters.snapshot()
         record["telemetry"] = _telemetry()
         record["residency"] = _residency()
+        record["slo"] = _slo_card()
         report.emit()
         return
 
@@ -749,13 +800,16 @@ def main():
     # this artifact).
     import jax.numpy as jnp
 
+    from mmlspark_tpu.observability import watch as _wd_watch
+
     def _h2d_streaming_gbps():
         parts = [X[lo:lo + batch] for lo in range(0, n_rows, batch)]
-        t0 = time.perf_counter()
-        devs = [jax.device_put(a) for a in parts]
-        for d in devs:
-            float(jnp.sum(d[0, 0, 0, :].astype(jnp.float32)))   # fence
-        el = time.perf_counter() - t0
+        with _wd_watch("bench_h2d_probe"):
+            t0 = time.perf_counter()
+            devs = [jax.device_put(a) for a in parts]
+            for d in devs:
+                float(jnp.sum(d[0, 0, 0, :].astype(jnp.float32)))   # fence
+            el = time.perf_counter() - t0
         return sum(a.nbytes for a in parts) / el / 1e9
 
     ips = 0.0
@@ -898,15 +952,17 @@ def main():
         if dev_setup is not None:
             jitted, params, xdev, rows_timed = dev_setup
             try:
-                tail = jax.jit(lambda c: jnp.sum(c["logits"][0, :2]
-                                                 .astype(jnp.float32)))
-                float(tail(jitted(params, {"input": xdev})))   # compile + warm
-                reps = 20 if on_tpu else 3
-                t0 = time.perf_counter()
-                outs = None
-                for _ in range(reps):
-                    outs = jitted(params, {"input": xdev})
-                float(tail(outs))
+                with _wd_watch("bench_device_resident"):
+                    tail = jax.jit(lambda c: jnp.sum(c["logits"][0, :2]
+                                                     .astype(jnp.float32)))
+                    float(tail(jitted(params,
+                                      {"input": xdev})))   # compile + warm
+                    reps = 20 if on_tpu else 3
+                    t0 = time.perf_counter()
+                    outs = None
+                    for _ in range(reps):
+                        outs = jitted(params, {"input": xdev})
+                    float(tail(outs))
                 device_ips = round(
                     rows_timed * reps / (time.perf_counter() - t0), 2)
             except Exception:
@@ -930,15 +986,16 @@ def main():
                         return (outs["pred"][0] % 2).astype(jnp.uint8), None
                     t, _ = jax.lax.scan(body, jnp.uint8(0), None, length=R)
                     return t
-                int(fused(params, xdev))                   # compile + warm
-                # mean over reps, matching the per-dispatch loop's estimator —
-                # a best-of here would overstate the dispatch-overhead gap the
-                # two numbers exist to expose
-                reps_f = 3 if on_tpu else 1
-                t0 = time.perf_counter()
-                for _ in range(reps_f):
-                    int(fused(params, xdev))               # fetched = fence
-                mean_f = (time.perf_counter() - t0) / reps_f
+                with _wd_watch("bench_fused_scan"):
+                    int(fused(params, xdev))               # compile + warm
+                    # mean over reps, matching the per-dispatch loop's
+                    # estimator — a best-of here would overstate the
+                    # dispatch-overhead gap the two numbers exist to expose
+                    reps_f = 3 if on_tpu else 1
+                    t0 = time.perf_counter()
+                    for _ in range(reps_f):
+                        int(fused(params, xdev))           # fetched = fence
+                    mean_f = (time.perf_counter() - t0) / reps_f
                 device_ips_fused = round(rows_timed * R / mean_f, 2)
             except Exception:
                 pass
@@ -949,9 +1006,11 @@ def main():
             if remaining() < 60.0:   # lower().compile() skips the jit cache —
                 raise TimeoutError   # a full compile a truncated run can't pay
             import jax.numpy as jnp
-            compiled = m._jitted.lower(
-                m._params_for_device(None),
-                {"input": jnp.zeros((batch, 224, 224, 3), jnp.uint8)}).compile()
+            with _wd_watch("bench_cost_analysis"):
+                compiled = m._jitted.lower(
+                    m._params_for_device(None),
+                    {"input": jnp.zeros((batch, 224, 224, 3),
+                                        jnp.uint8)}).compile()
             cost = compiled.cost_analysis()
             if isinstance(cost, list):
                 cost = cost[0]
@@ -988,6 +1047,7 @@ def main():
         stage_counters=m.stage_counters.snapshot(),
         telemetry=_telemetry(),
         residency=_residency(),
+        slo=_slo_card(),
         wall_s=round(time.monotonic() - t_start, 2),
     )
     if midrun_error is not None:

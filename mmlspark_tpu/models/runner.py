@@ -36,6 +36,7 @@ import numpy as np
 from ..core.residency import is_device_array, record_hit
 from ..observability import counter as _metric_counter
 from ..observability import tracing as _tracing
+from ..observability import watch as _watch
 from ..ops.compile_cache import (M_CACHE_HITS, M_CACHE_MISSES,
                                  M_STEADY_RECOMPILES, StageCounters,
                                  jit_cache_size)
@@ -345,7 +346,9 @@ class BatchRunner:
             self._flush_samples()
             return []
         t0 = time.perf_counter()
-        with _span("runner.d2h", batches=len(pending)):
+        # device_get is where a wedged device parks the dispatcher forever
+        # — the watchdog turns that silent hang into a diagnostic bundle
+        with _span("runner.d2h", batches=len(pending)), _watch("runner_drain"):
             host = jax.device_get([outs for outs, _ in pending])
         elapsed = time.perf_counter() - t0
         nbytes = sum(a.nbytes for outs in host for a in outs.values())

@@ -14,12 +14,17 @@ from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, build_info, counter, gauge,
                        get_registry, histogram, process_uptime_seconds,
                        render, reset_all, snapshot)
+from .slo import (SloPolicy, SloTracker, classify_route, get_tracker,
+                  reset_tracker, set_tracker)
 from .tracing import (FlightRecorder, Span, Trace, activate, add_event,
                       configure_recorder, current_request_id, current_span,
                       current_trace_id, exemplars_enabled, format_traceparent,
                       get_flight_recorder, new_request_id, new_span_id,
                       new_trace_id, parse_traceparent, propagate,
                       set_exemplars, start_span, start_trace)
+from .watchdog import (Watchdog, configure as configure_watchdog,
+                       get_watchdog, register_hbm_gauges, reset_watchdog,
+                       set_watchdog, watch)
 
 __all__ = [
     "Counter",
@@ -62,4 +67,17 @@ __all__ = [
     "exemplars_enabled",
     "get_flight_recorder",
     "configure_recorder",
+    "SloPolicy",
+    "SloTracker",
+    "classify_route",
+    "get_tracker",
+    "set_tracker",
+    "reset_tracker",
+    "Watchdog",
+    "watch",
+    "get_watchdog",
+    "set_watchdog",
+    "reset_watchdog",
+    "configure_watchdog",
+    "register_hbm_gauges",
 ]

@@ -1,0 +1,373 @@
+"""SLO engine: rolling per-class scorecards over the serving plane.
+
+ROADMAP item 5 asks for BENCH-style SLO scorecards (goodput, p50/p99/p999,
+shed rate, error-budget burn) that feed the tuning ``ObservationStore`` so
+the ``CostModel`` optimizes against traffic-shaped load. This module is
+the measurement half: a process-global :class:`SloTracker` that every
+request funnel (``WorkerServer._observe_request``, bench phases) reports
+into, bucketed by **workload class** — the ``{transport, route, model}``
+label triple.
+
+Design constraints mirror the registry's (registry.py): pure stdlib,
+default-on (one dict lookup + a few adds per request), process-global
+(``get_tracker()``), resettable (``reset_tracker()``), and snapshot-able
+(:meth:`SloTracker.scorecard` returns plain JSON served at
+``GET /debug/slo`` and harvested by
+``tuning.observations.harvest_scorecard`` as ``source="slo_scorecard"``
+rows).
+
+Two time scales per class, on purpose:
+
+- **cumulative totals** (``total`` / ``errors_total`` / ``shed_total``)
+  never decay — they reconcile exactly against
+  ``mmlspark_serving_requests_total`` at ``/metrics``;
+- a **rolling window** (``window_seconds``, default 60 s, split into
+  ``num_buckets`` ring buckets) carries the live rate/latency view the
+  burn-rate math runs on — stale buckets are recycled lazily on write,
+  so an idle tracker costs nothing.
+
+The latency sketch is the registry's fixed-bucket histogram shape
+(``DEFAULT_LATENCY_BUCKETS`` uppers, quantiles interpolated within a
+bucket) — no per-request list is ever kept, which is exactly why
+hand-rolled ``sorted()[int(0.99*len)]`` windows elsewhere are a lint
+finding (tpulint TPU011).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .registry import DEFAULT_LATENCY_BUCKETS
+from .registry import counter as _metric_counter
+from .registry import gauge as _metric_gauge
+
+__all__ = ["SloPolicy", "SloTracker", "classify_route", "get_tracker",
+           "set_tracker", "reset_tracker"]
+
+# the serving-plane SLO mirror: the same per-class counts the scorecard
+# reports, visible to a plain /metrics scrape (docs/observability.md)
+_M_SLO_REQUESTS = _metric_counter(
+    "mmlspark_slo_requests_total",
+    "Requests observed by the SLO tracker, by workload class",
+    ("transport", "route", "model"))
+_M_SLO_ERRORS = _metric_counter(
+    "mmlspark_slo_errors_total",
+    "Observed requests that counted against the error budget (5xx)",
+    ("transport", "route", "model"))
+_M_SLO_SHED = _metric_counter(
+    "mmlspark_slo_shed_total",
+    "Requests shed (429) per workload class — tracked apart from errors "
+    "because shedding is load policy, not failure",
+    ("transport", "route", "model"))
+_M_SLO_BURN = _metric_gauge(
+    "mmlspark_slo_error_budget_burn",
+    "Rolling-window error-budget burn rate per class (1.0 = burning "
+    "exactly the budget; refreshed at scorecard time)",
+    ("transport", "route", "model"))
+_M_SLO_P99 = _metric_gauge(
+    "mmlspark_slo_p99_seconds",
+    "Rolling-window p99 latency per class (refreshed at scorecard time)",
+    ("transport", "route", "model"))
+
+#: classes beyond this cap collapse into ("other", "other", "other") —
+#: a label-cardinality bound, same motivation as Prometheus practice
+MAX_CLASSES = 64
+_OVERFLOW_KEY = ("other", "other", "other")
+
+
+class SloPolicy:
+    """Service objectives the scorecard judges each class against.
+
+    ``target_p99`` — seconds; the window p99 at or under this passes.
+    ``availability`` — success-ratio objective in (0, 1); its complement
+    is the error budget the burn rate is normalized by (burn 1.0 = errors
+    arriving at exactly the budgeted rate; >1 exhausts the budget early).
+    """
+
+    __slots__ = ("target_p99", "availability")
+
+    def __init__(self, target_p99: float = 0.5,
+                 availability: float = 0.999):
+        if not 0.0 < availability < 1.0:
+            raise ValueError("availability must be in (0, 1)")
+        if target_p99 <= 0.0:
+            raise ValueError("target_p99 must be positive")
+        self.target_p99 = float(target_p99)
+        self.availability = float(availability)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"target_p99": self.target_p99,
+                "availability": self.availability}
+
+
+def classify_route(path: Optional[str]) -> str:
+    """Collapse a request path to a bounded route class.
+
+    The scorecard is per *workload class*, not per URL — unbounded label
+    sets would blow up both the tracker and the mirrored metrics."""
+    if not path:
+        return "api"
+    path = path.partition("?")[0]
+    if path.startswith("/healthz"):
+        return "healthz"
+    if path.startswith("/metrics"):
+        return "metrics"
+    if path.startswith("/debug"):
+        return "debug"
+    return "api"
+
+
+class _WinBucket:
+    """One ring slot: counts + a fixed-bucket latency sketch."""
+
+    __slots__ = ("epoch", "count", "errors", "shed", "lat_counts",
+                 "lat_sum")
+
+    def __init__(self, n_lat: int):
+        self.epoch = -1
+        self.count = 0
+        self.errors = 0
+        self.shed = 0
+        self.lat_counts = [0] * n_lat
+        self.lat_sum = 0.0
+
+    def reset(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.count = self.errors = self.shed = 0
+        for i in range(len(self.lat_counts)):
+            self.lat_counts[i] = 0
+        self.lat_sum = 0.0
+
+
+class _Class:
+    """Per-workload-class state: cumulative totals + the bucket ring."""
+
+    __slots__ = ("total", "errors_total", "shed_total", "ring")
+
+    def __init__(self, num_buckets: int, n_lat: int):
+        self.total = 0
+        self.errors_total = 0
+        self.shed_total = 0
+        self.ring = [_WinBucket(n_lat) for _ in range(num_buckets)]
+
+
+class SloTracker:
+    """Time-bucketed rolling SLO windows per ``{transport, route, model}``.
+
+    ``clock`` is injectable (monotonic seconds) so tests drive window
+    rotation deterministically. All mutation is under one lock — the
+    per-request cost is a dict lookup plus a handful of integer adds.
+    """
+
+    def __init__(self, policy: Optional[SloPolicy] = None,
+                 window_seconds: float = 60.0, num_buckets: int = 12,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_classes: int = MAX_CLASSES):
+        if window_seconds <= 0 or num_buckets < 1:
+            raise ValueError("window_seconds and num_buckets must be "
+                             "positive")
+        self.policy = policy or SloPolicy()
+        self.window_seconds = float(window_seconds)
+        self.num_buckets = int(num_buckets)
+        self._width = self.window_seconds / self.num_buckets
+        self._clock = clock
+        self._max_classes = int(max_classes)
+        self._uppers: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+        self._lock = threading.Lock()
+        self._classes: Dict[Tuple[str, str, str], _Class] = {}
+
+    # -- recording -----------------------------------------------------------
+    def _class(self, transport: str, route: str, model: str) -> _Class:
+        key = (str(transport), str(route), str(model))
+        cls = self._classes.get(key)
+        if cls is None:
+            if len(self._classes) >= self._max_classes:
+                key = _OVERFLOW_KEY
+                cls = self._classes.get(key)
+                if cls is not None:
+                    return cls
+            cls = self._classes[key] = _Class(self.num_buckets,
+                                              len(self._uppers) + 1)
+        return cls
+
+    def _bucket(self, cls: _Class) -> _WinBucket:
+        epoch = int(self._clock() / self._width)
+        b = cls.ring[epoch % self.num_buckets]
+        if b.epoch != epoch:
+            b.reset(epoch)
+        return b
+
+    def observe(self, transport: str = "api", route: str = "api",
+                model: str = "default",
+                seconds: Optional[float] = None,
+                error: bool = False) -> None:
+        """One answered request. ``seconds`` feeds the latency sketch when
+        known; ``error=True`` charges the class's error budget (5xx —
+        sheds go through :meth:`shed` instead)."""
+        with self._lock:
+            cls = self._class(transport, route, model)
+            b = self._bucket(cls)
+            cls.total += 1
+            b.count += 1
+            if error:
+                cls.errors_total += 1
+                b.errors += 1
+            if seconds is not None:
+                i = bisect.bisect_left(self._uppers, seconds)
+                b.lat_counts[i] += 1
+                b.lat_sum += seconds
+        _M_SLO_REQUESTS.inc(transport=transport, route=route, model=model)
+        if error:
+            _M_SLO_ERRORS.inc(transport=transport, route=route, model=model)
+
+    def shed(self, transport: str = "api", route: str = "api",
+             model: str = "default") -> None:
+        """One request refused by admission control (429)."""
+        with self._lock:
+            cls = self._class(transport, route, model)
+            b = self._bucket(cls)
+            cls.shed_total += 1
+            b.shed += 1
+        _M_SLO_SHED.inc(transport=transport, route=route, model=model)
+
+    # -- reading -------------------------------------------------------------
+    def _window_view(self, cls: _Class) -> Tuple[int, int, int, List[int],
+                                                 float]:
+        """Merge the ring's LIVE buckets (epoch within the window)."""
+        now_epoch = int(self._clock() / self._width)
+        count = errors = shed = 0
+        lat = [0] * (len(self._uppers) + 1)
+        lat_sum = 0.0
+        for b in cls.ring:
+            if b.epoch < 0 or now_epoch - b.epoch >= self.num_buckets:
+                continue
+            count += b.count
+            errors += b.errors
+            shed += b.shed
+            lat_sum += b.lat_sum
+            for i, c in enumerate(b.lat_counts):
+                lat[i] += c
+        return count, errors, shed, lat, lat_sum
+
+    def _quantile(self, lat: List[int], q: float) -> Optional[float]:
+        total = sum(lat)
+        if total == 0:
+            return None
+        rank = q * total
+        acc = 0
+        for i, c in enumerate(lat):
+            if c == 0:
+                continue
+            prev_acc = acc
+            acc += c
+            if acc >= rank:
+                if i >= len(self._uppers):
+                    # +Inf bucket: the last finite boundary is the best
+                    # honest answer a fixed sketch can give
+                    return self._uppers[-1]
+                lo = self._uppers[i - 1] if i > 0 else 0.0
+                hi = self._uppers[i]
+                frac = (rank - prev_acc) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        return self._uppers[-1]
+
+    def burn_rate(self, transport: str, route: str,
+                  model: str = "default") -> float:
+        """Window error rate over the policy's error budget: 1.0 means
+        errors arrive at exactly the budgeted rate, >1 exhausts the
+        budget early. 0.0 on an idle window."""
+        with self._lock:
+            cls = self._classes.get((str(transport), str(route),
+                                     str(model)))
+            if cls is None:
+                return 0.0
+            count, errors, _, _, _ = self._window_view(cls)
+        if count == 0:
+            return 0.0
+        budget = 1.0 - self.policy.availability
+        return (errors / count) / budget
+
+    def scorecard(self) -> Dict[str, object]:
+        """JSON-safe rolling scorecard over every workload class.
+
+        Per class: cumulative ``total``/``errors_total``/``shed_total``
+        (reconcile against ``mmlspark_serving_requests_total``), the live
+        ``window`` rates, interpolated p50/p99/p999 from the latency
+        sketch, availability, burn rate, and the pass/fail verdicts
+        against :class:`SloPolicy`. Also refreshes the
+        ``mmlspark_slo_error_budget_burn`` / ``mmlspark_slo_p99_seconds``
+        gauges so scrapes and scorecards agree."""
+        with self._lock:
+            items = sorted(self._classes.items())
+            views = [(key, cls.total, cls.errors_total, cls.shed_total,
+                      self._window_view(cls)) for key, cls in items]
+        budget = 1.0 - self.policy.availability
+        classes: List[Dict[str, object]] = []
+        for (transport, route, model), total, errors_total, shed_total, \
+                (count, errors, shed, lat, lat_sum) in views:
+            p50 = self._quantile(lat, 0.50)
+            p99 = self._quantile(lat, 0.99)
+            p999 = self._quantile(lat, 0.999)
+            availability = (1.0 - errors / count) if count else None
+            burn = (errors / count) / budget if count else 0.0
+            labels = dict(transport=transport, route=route, model=model)
+            _M_SLO_BURN.set(burn, **labels)
+            _M_SLO_P99.set(p99 if p99 is not None else 0.0, **labels)
+            classes.append({
+                "transport": transport, "route": route, "model": model,
+                "total": total, "errors_total": errors_total,
+                "shed_total": shed_total,
+                "window": {
+                    "count": count, "errors": errors, "shed": shed,
+                    "rps": round(count / self.window_seconds, 4),
+                    "latency_sum": round(lat_sum, 6)},
+                "p50": p50, "p99": p99, "p999": p999,
+                "availability": availability,
+                "error_budget_burn": round(burn, 4),
+                "p99_ok": (None if p99 is None
+                           else bool(p99 <= self.policy.target_p99)),
+                "availability_ok": (None if availability is None
+                                    else bool(availability
+                                              >= self.policy.availability)),
+            })
+        return {"t": time.time(),
+                "window_seconds": self.window_seconds,
+                "num_buckets": self.num_buckets,
+                "policy": self.policy.as_dict(),
+                "classes": classes}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._classes.clear()
+
+
+# -- the process-global tracker ----------------------------------------------
+
+_tracker_lock = threading.Lock()
+_tracker: Optional[SloTracker] = None
+
+
+def get_tracker() -> SloTracker:
+    """The process-global tracker, created on first use (default policy,
+    60 s window) — the one ``WorkerServer`` and bench.py report into."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            _tracker = SloTracker()
+        return _tracker
+
+
+def set_tracker(tracker: Optional[SloTracker]) -> None:
+    """Install a specific tracker (tests, custom policies)."""
+    global _tracker
+    with _tracker_lock:
+        _tracker = tracker
+
+
+def reset_tracker() -> None:
+    """Drop the global tracker (test hook — pair with
+    ``observability.reset_all`` to zero the mirrored metric series)."""
+    set_tracker(None)

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..observability import counter as _metric_counter
 from ..observability import tracing as _tracing
+from ..observability import watch as _watch
 from .padding import bucket_size
 
 __all__ = ["enable_persistent_cache", "persistent_cache_dir", "StageCounters",
@@ -275,7 +276,8 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
                       * max(1, shards) for b in batch_sizes if int(b) > 0})
     before = jit_cache_size(jitted)
     t_start = time.perf_counter()
-    with _tracing.start_span("compile_cache.warm_up", buckets=len(buckets)):
+    with _tracing.start_span("compile_cache.warm_up", buckets=len(buckets)), \
+            _watch("compile_warmup") as _w:
         for size in buckets:
             t_b = time.perf_counter()
             feeds = {name: put(np.zeros((size,) + shape, dtype=dt))
@@ -285,6 +287,9 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
             # the timed window covers the compile, not later steady-state
             # batches
             jax.block_until_ready(outs)
+            # heartbeat per bucket: the stall budget covers ONE compile,
+            # not the whole ladder
+            _w.beat()
             _tracing.add_event("warm_bucket", padded=size,
                                seconds=round(time.perf_counter() - t_b, 4))
     elapsed = time.perf_counter() - t_start

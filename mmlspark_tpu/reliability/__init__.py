@@ -16,7 +16,8 @@ and fail-fast serving):
 ``docs/reliability.md`` is the narrative companion.
 """
 
-from .breaker import BreakerOpen, CircuitBreaker, breaker_for, reset_breakers
+from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
+                      open_breakers, reset_breakers)
 from .faults import FaultInjector, InjectedFault, get_injector
 from .policy import (DEADLINE_HEADER, Deadline, DeadlineExceeded, RetryPolicy,
                      record_retry)
@@ -25,6 +26,7 @@ __all__ = [
     "BreakerOpen",
     "CircuitBreaker",
     "breaker_for",
+    "open_breakers",
     "reset_breakers",
     "FaultInjector",
     "InjectedFault",

@@ -15,7 +15,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict
+from typing import Callable, Dict, List
 
 from ..observability import counter as _metric_counter
 from ..observability import gauge as _metric_gauge
@@ -152,3 +152,12 @@ def reset_breakers() -> None:
     up by ``observability.reset_all``)."""
     with _BREAKERS_LOCK:
         _BREAKERS.clear()
+
+
+def open_breakers() -> List[str]:
+    """Peers whose circuit is currently open — the /healthz degraded
+    check (half-open circuits are probing their way back and don't count
+    as degraded)."""
+    with _BREAKERS_LOCK:
+        brks = list(_BREAKERS.values())
+    return sorted(b.peer for b in brks if b.state == OPEN)

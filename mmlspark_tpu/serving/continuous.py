@@ -67,7 +67,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import (counter as _metric_counter,
                              gauge as _metric_gauge,
                              histogram as _metric_histogram,
-                             log_event as _log_event)
+                             log_event as _log_event,
+                             watch as _watch)
 from ..observability import tracing as _tracing
 from ..reliability import get_injector as _get_injector
 from ..utils.profiling import span as _prof_span
@@ -1527,7 +1528,8 @@ class ContinuousDecoder:
         # one prefill chunk per tick, interleaved with the decode below —
         # this IS the chunked-prefill scheduler: long prompts never run
         # more than chunk-budget prefill work in any one tick
-        self._advance_chunks()
+        with _watch("decoder_prefill"):
+            self._advance_chunks()
         live = [i for i in range(self._S) if self._slot_req[i] is not None]
         _M_LIVE_SLOTS.set(len(live))
         if not live:
@@ -1569,11 +1571,12 @@ class ContinuousDecoder:
                     topk=self._topk, topp=self._topp)
             else:
                 tick = self._spec_tick_for("greedy", gamma_now)
-            (self._tok, self._pos, self._active, bufs,
-             self._d_cache, self._remaining, toks) = tick(
-                self._params, self._d_params, self._tok, self._pos,
-                self._active, self._kv.buffers, self._bt, self._d_cache,
-                self._remaining)
+            with _watch("decoder_decode"):
+                (self._tok, self._pos, self._active, bufs,
+                 self._d_cache, self._remaining, toks) = tick(
+                    self._params, self._d_params, self._tok, self._pos,
+                    self._active, self._kv.buffers, self._bt, self._d_cache,
+                    self._remaining)
             self._kv.buffers = bufs
             # round-slot accounting happens at DRAIN time (_drain_one),
             # from the same block that feeds spec_emitted: counting
@@ -1581,17 +1584,19 @@ class ContinuousDecoder:
             # on device, skewing the autotuner's acceptance estimate
             # low for the whole pipeline_depth window
         elif any(self._slot_req[i].temperature > 0.0 for i in decode_live):
-            (self._tok, self._pos, self._active, bufs,
-             self._remaining, toks) = self._tick_sampled(
-                self._params, self._tok, self._pos, self._active,
-                self._kv.buffers, self._bt, self._remaining,
-                self._temp, self._topk, self._topp, self._key)
+            with _watch("decoder_decode"):
+                (self._tok, self._pos, self._active, bufs,
+                 self._remaining, toks) = self._tick_sampled(
+                    self._params, self._tok, self._pos, self._active,
+                    self._kv.buffers, self._bt, self._remaining,
+                    self._temp, self._topk, self._topp, self._key)
             self._kv.buffers = bufs
         else:
-            (self._tok, self._pos, self._active, bufs,
-             self._remaining, toks) = self._tick(
-                self._params, self._tok, self._pos, self._active,
-                self._kv.buffers, self._bt, self._remaining)
+            with _watch("decoder_decode"):
+                (self._tok, self._pos, self._active, bufs,
+                 self._remaining, toks) = self._tick(
+                    self._params, self._tok, self._pos, self._active,
+                    self._kv.buffers, self._bt, self._remaining)
             self._kv.buffers = bufs
         # per-dispatch attention accounting: k paged calls rode this
         # dispatch; only the gather impl moves materialization bytes
@@ -1642,7 +1647,10 @@ class ContinuousDecoder:
         at scan step s iff its request is not yet done host-side when s is
         replayed in order — no device mask needed."""
         toks_dev, snapshot = self._pending.pop(0)
-        with _M_DRAIN_SECONDS.time(), _prof_span("continuous.drain"):
+        # the np.asarray is the decode path's only host↔device sync — the
+        # exact line a wedged device parks forever, so the watchdog covers it
+        with _M_DRAIN_SECONDS.time(), _prof_span("continuous.drain"), \
+                _watch("decoder_drain"):
             toks = np.asarray(toks_dev)
         if self._spec and toks.shape[0] > 1:
             # spec blocks mark unemitted lanes -1. Both acceptance

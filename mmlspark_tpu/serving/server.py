@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import queue
 import socket
 import threading
@@ -28,14 +29,19 @@ from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
                               HTTPResponseData, StatusLineData)
 from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
                              build_info as _build_info,
+                             classify_route as _classify_route,
                              counter as _metric_counter,
                              gauge as _metric_gauge,
+                             get_tracker as _get_tracker,
+                             get_watchdog as _get_watchdog,
                              histogram as _metric_histogram,
                              log_event as _log_event,
                              process_uptime_seconds as _process_uptime,
+                             register_hbm_gauges as _register_hbm_gauges,
                              render as _render_metrics)
 from ..observability import tracing as _tracing
-from ..reliability import Deadline, get_injector as _get_injector
+from ..reliability import (Deadline, get_injector as _get_injector,
+                           open_breakers as _open_breakers)
 
 __all__ = ["CachedRequest", "Overloaded", "WorkerServer"]
 
@@ -672,7 +678,13 @@ class WorkerServer:
             "/healthz": self._healthz_route,
             "/metrics": self._metrics_route,
             "/debug/traces": self._debug_traces_route,
+            "/debug/slo": self._debug_slo_route,
+            "/debug/profile": self._debug_profile_route,
         }
+        #: guards the single on-demand profiler capture slot
+        self._profile_lock = threading.Lock()
+        self._profile_active: Optional[dict] = None
+        self._profile_thread: Optional[threading.Thread] = None
         #: request_id → CachedRequest (reference: routingTable ``:689``)
         self._routing: Dict[str, CachedRequest] = {}
         #: epoch → {request_id: CachedRequest} (reference: historyQueues)
@@ -728,8 +740,11 @@ class WorkerServer:
         _M_QUEUE_DEPTH.set_function(self._queue.qsize, port=str(self.port))
         _M_INFLIGHT.set_function(self.pending_count, port=str(self.port))
         # idempotent: (re)stamps mmlspark_build_info so any scraped server
-        # exposes version/jax/backend even after a registry reset in tests
+        # exposes version/jax/backend even after a registry reset in tests;
+        # HBM gauges only register when jax is already initialized (neither
+        # triggers a backend import)
         _build_info()
+        _register_hbm_gauges()
 
     @property
     def address(self) -> str:
@@ -753,18 +768,46 @@ class WorkerServer:
             return
         _M_REQUESTS.inc(transport=transport, method=method or "?",
                         code=str(code))
+        # same admission rule as requests_total, so the per-class SLO
+        # scorecard totals reconcile against that counter exactly
+        _get_tracker().observe(transport=transport,
+                               route=_classify_route(path),
+                               seconds=seconds, error=code >= 500)
         if seconds is not None:
             # under an active span the histogram captures the trace_id as
             # an OpenMetrics exemplar (when tracing.set_exemplars is on)
             with _tracing.activate(trace_span):
                 _M_REQ_LATENCY.observe(seconds, transport=transport)
 
+    #: a watchdog stall younger than this marks /healthz degraded
+    STALL_DEGRADED_SECONDS = 60.0
+
+    def _degraded_reasons(self) -> List[str]:
+        """Soft-failure signals for /healthz. Degraded is advisory — the
+        response stays HTTP 200 so load balancers keep the worker in
+        rotation while operators (and the e2e suite) see WHY it is
+        struggling: open circuits to peers, a nearly-full admission queue,
+        or a recent device-stall verdict from the watchdog."""
+        reasons = []
+        for peer in _open_breakers():
+            reasons.append(f"breaker_open:{peer}")
+        maxsize = self._queue.maxsize
+        if maxsize > 0 and self._queue.qsize() >= 0.8 * maxsize:
+            reasons.append(
+                f"queue_pressure:{self._queue.qsize()}/{maxsize}")
+        age = _get_watchdog().last_stall_age()
+        if age is not None and age <= self.STALL_DEGRADED_SECONDS:
+            reasons.append(f"watchdog_stall:{round(age, 1)}s_ago")
+        return reasons
+
     def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
         import json as _json
         with self._lock:
             pending = len(self._routing)
             epoch = self._epoch
-        body = {"status": "ok",
+        reasons = self._degraded_reasons()
+        body = {"status": "degraded" if reasons else "ok",
+                "reasons": reasons,
                 "transport": "async" if self._aio is not None else "threaded",
                 "port": self.port,
                 "queued": self._queue.qsize(),
@@ -818,9 +861,100 @@ class WorkerServer:
             return _resp(trace.to_chrome())
         return _resp(trace.to_dict())
 
+    def _debug_slo_route(self, request: HTTPRequestData) -> HTTPResponseData:
+        """``GET /debug/slo`` — the rolling SLO scorecard for every
+        workload class this process has served, plus the policy verdicts
+        (p99 objective, availability, error-budget burn rate).
+
+        Each successful render is also harvested into the tuning
+        :class:`~mmlspark_tpu.tuning.observations.ObservationStore` as
+        ``source="slo_scorecard"`` rows (skip with ``?harvest=0``), so
+        the cost model sees quality alongside throughput."""
+        import json as _json
+        _, _, query = request.url.partition("?")
+        card = _get_tracker().scorecard()
+        if "harvest=0" not in query:
+            # lazy: tuning imports observability; importing it the other
+            # way at module scope would be a cycle
+            from ..tuning.observations import harvest_scorecard
+            card["harvested"] = harvest_scorecard(card)
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", "application/json")],
+            entity=EntityData.from_string(_json.dumps(card)),
+            status_line=StatusLineData(status_code=200))
+
+    #: on-demand profiler capture length ceiling (seconds)
+    MAX_PROFILE_SECONDS = 60.0
+
+    def _debug_profile_route(self, request: HTTPRequestData
+                             ) -> HTTPResponseData:
+        """``GET /debug/profile?seconds=N`` — capture an on-demand
+        ``jax.profiler`` device trace for N seconds (default 3, capped at
+        :data:`MAX_PROFILE_SECONDS`) into a fresh directory under the
+        watchdog's diagnostic dir, without restarting the worker.
+
+        The capture runs on a background thread so neither transport's
+        accept path blocks for N seconds; the response returns
+        immediately with the log dir to point TensorBoard at. One capture
+        at a time: a second request while one is running gets 409."""
+        import json as _json
+
+        def _resp(payload: object, status: int = 200) -> HTTPResponseData:
+            return HTTPResponseData(
+                headers=[HeaderData("Content-Type", "application/json")],
+                entity=EntityData.from_string(_json.dumps(payload)),
+                status_line=StatusLineData(status_code=status))
+
+        _, _, query = request.url.partition("?")
+        seconds = 3.0
+        for part in query.split("&"):
+            if part.startswith("seconds="):
+                try:
+                    seconds = float(part[len("seconds="):])
+                except ValueError:
+                    return _resp({"error": "bad seconds value"}, status=400)
+        seconds = min(max(seconds, 0.05), self.MAX_PROFILE_SECONDS)
+        wd = _get_watchdog()
+        log_dir = os.path.join(
+            wd.diag_dir(), f"profile_{self.port}_{int(time.time())}")
+        with self._profile_lock:
+            if self._profile_active is not None:
+                return _resp({"error": "profile capture already active",
+                              **self._profile_active}, status=409)
+            self._profile_active = {"log_dir": log_dir, "seconds": seconds}
+
+        def _capture() -> None:
+            # tracked so close() can wait for an in-flight capture: tearing
+            # the process down mid-stop_trace crashes inside the profiler
+            from ..utils import profiling as _profiling
+            try:
+                with _profiling.trace(log_dir):
+                    time.sleep(seconds)
+                _log_event("profile_captured", log_dir=log_dir,
+                           seconds=seconds, port=self.port)
+            except Exception as exc:
+                # profiler unavailable (no jax backend, capture collision)
+                # — the endpoint must never take the worker down
+                _log_event("profile_failed", level=logging.WARNING,
+                           log_dir=log_dir, error=repr(exc))
+            finally:
+                with self._profile_lock:
+                    self._profile_active = None
+
+        os.makedirs(log_dir, exist_ok=True)
+        t = threading.Thread(target=_capture, name="mmlspark-profile",
+                             daemon=True)
+        self._profile_thread = t
+        t.start()
+        return _resp({"started": True, "log_dir": log_dir,
+                      "seconds": seconds})
+
     # -- ingest -------------------------------------------------------------
     def _shed(self) -> Overloaded:
         _M_SHED.inc()
+        _get_tracker().shed(
+            transport="async" if self._aio is not None else "threaded",
+            route="api")
         _log_event("request_shed", port=self.port,
                    queued=self._queue.qsize())
         return Overloaded(self.shed_retry_after)
@@ -1003,6 +1137,11 @@ class WorkerServer:
 
     def close(self) -> None:
         self._closed = True
+        t = self._profile_thread
+        if t is not None and t.is_alive():
+            # bound the wait: a capture is at most MAX_PROFILE_SECONDS of
+            # sleep plus stop_trace; a wedged profiler must not wedge close
+            t.join(timeout=self.MAX_PROFILE_SECONDS + 10.0)
         _M_QUEUE_DEPTH.remove(port=str(self.port))
         _M_INFLIGHT.remove(port=str(self.port))
         if self._aio is not None:

@@ -31,8 +31,8 @@ from .cost_model import (CostModel, TuningDecision, candidate_configs,
                          compare_paged_attn, measured_sweep, probe_budget,
                          resolve_tuning)
 from .observations import (TUNING_DIR_ENV, Observation, ObservationStore,
-                           get_store, import_bench_records, reset_store,
-                           set_store)
+                           get_store, harvest_scorecard,
+                           import_bench_records, reset_store, set_store)
 
 __all__ = [
     "TUNING_DIR_ENV",
@@ -42,6 +42,7 @@ __all__ = [
     "set_store",
     "reset_store",
     "import_bench_records",
+    "harvest_scorecard",
     "CostModel",
     "TuningDecision",
     "candidate_configs",

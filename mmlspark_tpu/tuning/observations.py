@@ -28,7 +28,8 @@ from ..observability import counter as _metric_counter
 from ..observability import gauge as _metric_gauge
 
 __all__ = ["TUNING_DIR_ENV", "Observation", "ObservationStore", "get_store",
-           "set_store", "reset_store", "import_bench_records"]
+           "set_store", "reset_store", "import_bench_records",
+           "harvest_samples", "harvest_scorecard"]
 
 #: environment variable naming the persisted-observation directory (the
 #: tuning analogue of ``MMLSPARK_TPU_COMPILE_CACHE_DIR``)
@@ -340,5 +341,46 @@ def harvest_samples(sig: str, placement: str, config: Dict,
             compile_seconds=s.get("compile_seconds", 0.0),
             compiles=s.get("compiles", 0),
             rows_per_sec=s.get("rows_per_sec")))
+        n += 1
+    return n
+
+
+def harvest_scorecard(scorecard: dict,
+                      store: Optional[ObservationStore] = None,
+                      placement: str = "default") -> int:
+    """Land an SLO scorecard (``observability.slo.SloTracker.scorecard``)
+    in the store as one ``source="slo_scorecard"`` row per workload class.
+
+    The cost model reads the same store, so quality facts (p99 under
+    load, availability, burn rate) sit next to throughput facts and a
+    config that wins on rows/sec but blows the latency objective can be
+    penalised from data, not intuition. ``rows`` carries the class's
+    cumulative request count and ``rows_per_sec`` its windowed request
+    rate; the quality numbers ride under the extra ``slo`` key (the store
+    accepts any JSON-safe extras beyond the required schema)."""
+    store = store if store is not None else get_store()
+    n = 0
+    for cls in scorecard.get("classes", []):
+        win = cls.get("window") or {}
+        obs = Observation(
+            sig="slo:{}/{}/{}".format(cls.get("transport", "?"),
+                                      cls.get("route", "?"),
+                                      cls.get("model", "?")),
+            source="slo_scorecard", placement=placement,
+            rows=int(cls.get("total", 0)),
+            seconds=float(scorecard.get("window_seconds", 0.0)),
+            rows_per_sec=win.get("rps"),
+            t=scorecard.get("t"))
+        obs["slo"] = {
+            "p50": cls.get("p50"), "p99": cls.get("p99"),
+            "p999": cls.get("p999"),
+            "availability": cls.get("availability"),
+            "error_budget_burn": cls.get("error_budget_burn"),
+            "errors_total": cls.get("errors_total"),
+            "shed_total": cls.get("shed_total"),
+            "p99_ok": cls.get("p99_ok"),
+            "availability_ok": cls.get("availability_ok"),
+        }
+        store.record(obs)
         n += 1
     return n

@@ -1,0 +1,436 @@
+"""SLO engine: window rotation under a fake clock, burn-rate math, the
+scorecard shape, GET /debug/slo on both transports, the ObservationStore
+harvest, /healthz degraded reasons, and the multi-worker reconciliation
+e2e (scorecard totals == mmlspark_serving_requests_total under seeded
+faults).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu.io.http.schema import (EntityData, HeaderData,
+                                         HTTPRequestData, HTTPResponseData,
+                                         StatusLineData)
+from mmlspark_tpu.observability import reset_all, snapshot
+from mmlspark_tpu.observability.slo import (MAX_CLASSES, SloPolicy,
+                                            SloTracker, classify_route,
+                                            get_tracker, reset_tracker,
+                                            set_tracker)
+from mmlspark_tpu.observability.watchdog import configure as configure_watchdog
+from mmlspark_tpu.observability.watchdog import reset_watchdog
+from mmlspark_tpu.reliability import get_injector
+from mmlspark_tpu.reliability.breaker import breaker_for, reset_breakers
+from mmlspark_tpu.serving.server import WorkerServer
+from mmlspark_tpu.tuning import observations as obs_mod
+from mmlspark_tpu.tuning.observations import (ObservationStore,
+                                              harvest_scorecard)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Process-global state (tracker, injector, breakers, store, watchdog)
+    must not leak across tests."""
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    reset_all()
+    get_injector().clear()
+    # memory-only store: /debug/slo harvests here instead of any
+    # MMLSPARK_TPU_TUNING_DIR the environment happens to carry
+    obs_mod.set_store(ObservationStore())
+    yield
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    get_injector().clear()
+    obs_mod.reset_store()
+    reset_all()
+
+
+def _series_sum(name):
+    metric = snapshot().get(name)
+    if not metric:
+        return 0.0
+    return sum(s["value"] for s in metric["series"])
+
+
+# ---------------------------------------------------------------------------
+# tracker unit behavior
+
+
+def test_classify_route_collapses_paths():
+    assert classify_route("/healthz") == "healthz"
+    assert classify_route("/metrics?x=1") == "metrics"
+    assert classify_route("/debug/slo") == "debug"
+    assert classify_route("/debug/traces/abc") == "debug"
+    assert classify_route("/") == "api"
+    assert classify_route("/score?q=1") == "api"
+    assert classify_route(None) == "api"
+
+
+def test_window_rotation_under_fake_clock():
+    now = [0.0]
+    tr = SloTracker(window_seconds=12.0, num_buckets=4,
+                    clock=lambda: now[0])
+    for _ in range(5):
+        tr.observe(transport="t", route="r", seconds=0.01)
+    cls = tr.scorecard()["classes"][0]
+    assert cls["window"]["count"] == 5
+    assert cls["total"] == 5
+    # half a window later the samples are still live
+    now[0] = 6.0
+    assert tr.scorecard()["classes"][0]["window"]["count"] == 5
+    # past the window they rotate out; cumulative totals never decay
+    now[0] = 13.0
+    cls = tr.scorecard()["classes"][0]
+    assert cls["window"]["count"] == 0
+    assert cls["total"] == 5
+    assert cls["p99"] is None           # empty window has no latency view
+    # a recycled ring slot starts clean
+    tr.observe(transport="t", route="r", seconds=0.02)
+    cls = tr.scorecard()["classes"][0]
+    assert cls["window"]["count"] == 1
+    assert cls["total"] == 6
+
+
+def test_burn_rate_math():
+    now = [0.0]
+    tr = SloTracker(policy=SloPolicy(availability=0.99),
+                    window_seconds=60.0, num_buckets=6,
+                    clock=lambda: now[0])
+    # 2 errors in 100 requests against a 1% budget -> burn exactly 2.0
+    for i in range(100):
+        tr.observe(transport="t", route="r", seconds=0.001, error=(i < 2))
+    assert tr.burn_rate("t", "r") == pytest.approx(2.0)
+    cls = tr.scorecard()["classes"][0]
+    assert cls["error_budget_burn"] == pytest.approx(2.0)
+    assert cls["availability"] == pytest.approx(0.98)
+    assert cls["availability_ok"] is False
+    assert cls["errors_total"] == 2
+    # an unknown class (and an idle window) burns nothing
+    assert tr.burn_rate("t", "nope") == 0.0
+
+
+def test_scorecard_shape_and_quantiles():
+    now = [0.0]
+    tr = SloTracker(window_seconds=60.0, num_buckets=6,
+                    clock=lambda: now[0])
+    for _ in range(100):
+        tr.observe(transport="threaded", route="api", seconds=0.004)
+    tr.shed(transport="threaded", route="api")
+    card = tr.scorecard()
+    assert set(card) == {"t", "window_seconds", "num_buckets", "policy",
+                         "classes"}
+    assert card["policy"] == {"target_p99": 0.5, "availability": 0.999}
+    (cls,) = card["classes"]
+    assert set(cls) == {"transport", "route", "model", "total",
+                        "errors_total", "shed_total", "window", "p50",
+                        "p99", "p999", "availability",
+                        "error_budget_burn", "p99_ok", "availability_ok"}
+    assert cls["shed_total"] == 1
+    assert cls["window"]["shed"] == 1
+    # sheds are load policy, not answered requests
+    assert cls["total"] == 100
+    # every sample sits in one sketch bucket: quantiles interpolate
+    # inside it and stay near the true value
+    assert 0.0 < cls["p50"] <= 0.01
+    assert 0.0 < cls["p99"] <= 0.01
+    assert cls["p99_ok"] is True
+    assert cls["availability"] == 1.0
+    # JSON-safe end to end
+    json.dumps(card)
+
+
+def test_class_cardinality_bound_overflows_to_other():
+    tr = SloTracker(max_classes=2)
+    tr.observe(transport="a", route="r")
+    tr.observe(transport="b", route="r")
+    tr.observe(transport="c", route="r")   # over the cap
+    tr.observe(transport="d", route="r")   # joins the same overflow class
+    keys = {(c["transport"], c["route"], c["model"])
+            for c in tr.scorecard()["classes"]}
+    assert ("other", "other", "other") in keys
+    assert len(keys) == 3
+    other = [c for c in tr.scorecard()["classes"]
+             if c["transport"] == "other"][0]
+    assert other["total"] == 2
+
+
+def test_global_tracker_install_and_reset():
+    tr = SloTracker()
+    set_tracker(tr)
+    assert get_tracker() is tr
+    reset_tracker()
+    assert get_tracker() is not tr
+    assert isinstance(get_tracker(), SloTracker)
+
+
+# ---------------------------------------------------------------------------
+# ObservationStore harvest
+
+
+def test_harvest_scorecard_row_shape():
+    tr = SloTracker()
+    for i in range(10):
+        tr.observe(transport="threaded", route="api", seconds=0.002,
+                   error=(i == 0))
+    store = ObservationStore()
+    n = harvest_scorecard(tr.scorecard(), store=store)
+    assert n == 1
+    (row,) = store.rows(source="slo_scorecard")
+    assert row["sig"] == "slo:threaded/api/default"
+    assert row["rows"] == 10
+    assert row["seconds"] == 60.0
+    assert row["rows_per_sec"] == pytest.approx(10 / 60.0, rel=1e-3)
+    slo = row["slo"]
+    assert slo["errors_total"] == 1
+    assert slo["availability"] == pytest.approx(0.9)
+    assert slo["p99"] is not None
+    # the row satisfies the store's required schema and persists the same
+    # way every other observation source does
+    assert row["source"] == "slo_scorecard"
+    assert "t" in row
+
+
+def test_harvest_rows_reach_cost_model_store():
+    """The CostModel reads get_store(); harvested scorecards must land in
+    the same store unfiltered reads see."""
+    tr = SloTracker()
+    tr.observe(transport="bench", route="generation", seconds=0.1)
+    harvest_scorecard(tr.scorecard())
+    rows = obs_mod.get_store().rows(source="slo_scorecard")
+    assert len(rows) == 1
+    assert rows[0]["sig"].startswith("slo:")
+
+
+# ---------------------------------------------------------------------------
+# /debug/slo over HTTP, both transports
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_debug_slo_route_serves_scorecard(transport):
+    ws = WorkerServer(transport=transport)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        for _ in range(3):
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 200
+            r.read()
+        conn.request("GET", "/debug/slo")
+        r = conn.getresponse()
+        assert r.status == 200
+        card = json.loads(r.read())
+        by_route = {(c["transport"], c["route"]): c
+                    for c in card["classes"]}
+        cls = by_route[(transport, "healthz")]
+        assert cls["total"] == 3
+        assert cls["p99"] is not None
+        # the render harvested itself into the tuning store
+        assert card["harvested"] >= 1
+        rows = obs_mod.get_store().rows(source="slo_scorecard")
+        assert any(r["sig"] == f"slo:{transport}/healthz/default"
+                   for r in rows)
+        # harvest=0 renders without appending more rows
+        before = len(obs_mod.get_store())
+        conn.request("GET", "/debug/slo?harvest=0")
+        r = conn.getresponse()
+        card2 = json.loads(r.read())
+        assert "harvested" not in card2
+        assert len(obs_mod.get_store()) == before
+        conn.close()
+    finally:
+        ws.close()
+
+
+def test_slo_metrics_mirror_requests_total():
+    ws = WorkerServer(transport="threaded")
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        for _ in range(4):
+            conn.request("GET", "/healthz")
+            conn.getresponse().read()
+        conn.close()
+    finally:
+        ws.close()
+    assert _series_sum("mmlspark_slo_requests_total") == \
+        _series_sum("mmlspark_serving_requests_total") == 4
+
+
+# ---------------------------------------------------------------------------
+# /healthz degraded
+
+
+def _get_healthz(ws):
+    conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+    conn.request("GET", "/healthz")
+    r = conn.getresponse()
+    body = json.loads(r.read())
+    conn.close()
+    return r.status, body
+
+
+def test_healthz_ok_when_nothing_is_wrong():
+    ws = WorkerServer(transport="threaded")
+    try:
+        status, body = _get_healthz(ws)
+        assert status == 200
+        assert body["status"] == "ok"
+        assert body["reasons"] == []
+    finally:
+        ws.close()
+
+
+def test_healthz_degraded_on_open_breaker():
+    ws = WorkerServer(transport="threaded")
+    try:
+        brk = breaker_for("10.0.0.9:8080", min_calls=1, failure_ratio=0.5)
+        brk.record_failure()
+        assert brk.state == "open"
+        status, body = _get_healthz(ws)
+        assert status == 200                # degraded is advisory, not 503
+        assert body["status"] == "degraded"
+        assert "breaker_open:10.0.0.9:8080" in body["reasons"]
+    finally:
+        ws.close()
+
+
+def test_healthz_degraded_on_queue_pressure():
+    ws = WorkerServer(transport="threaded", max_queue=5)
+    try:
+        for i in range(4):                  # 4/5 >= 80%
+            ws._enqueue(HTTPRequestData(url="/", method="POST"))
+        status, body = _get_healthz(ws)
+        assert body["status"] == "degraded"
+        assert any(r.startswith("queue_pressure:4/5")
+                   for r in body["reasons"])
+    finally:
+        ws.close()
+
+
+def test_healthz_degraded_on_recent_watchdog_stall():
+    wd = configure_watchdog(enabled=True)
+    wd.last_stall = {"wall": time.time(), "monotonic": wd._clock(),
+                     "site": "runner_drain"}
+    ws = WorkerServer(transport="threaded")
+    try:
+        status, body = _get_healthz(ws)
+        assert body["status"] == "degraded"
+        assert any(r.startswith("watchdog_stall:") for r in body["reasons"])
+    finally:
+        ws.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-worker reconciliation e2e under seeded faults
+
+
+def _resp(payload, status=200):
+    return HTTPResponseData(
+        headers=[HeaderData("Content-Type", "application/json")],
+        entity=EntityData.from_string(json.dumps(payload)),
+        status_line=StatusLineData(status_code=status))
+
+
+def test_three_worker_reconciliation_with_seeded_faults():
+    """Drive traffic across three in-process workers (both transports)
+    with a deterministic enqueue fault seeded the MMLSPARK_TPU_FAULTS
+    way; the /debug/slo scorecard totals must reconcile exactly with
+    mmlspark_serving_requests_total, and the scorecard must land in the
+    ObservationStore as source="slo_scorecard" rows."""
+    # the env-spec grammar, applied programmatically (the module-import
+    # parse of MMLSPARK_TPU_FAULTS runs once, long before this test)
+    get_injector().configure("enqueue:error:every=5")
+    workers = [WorkerServer(transport="threaded", reply_timeout=10.0),
+               WorkerServer(transport="threaded", reply_timeout=10.0),
+               WorkerServer(transport="async", reply_timeout=10.0)]
+    stop = threading.Event()
+
+    def engine(ws):
+        while not stop.is_set():
+            for c in ws.get_batch(16, timeout=0.05):
+                body = json.loads(c.request.entity.string_content())
+                ws.reply(c.request_id, _resp({"ok": body["i"]}))
+
+    threads = [threading.Thread(target=engine, args=(w,), daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    n_per_worker = 10
+    codes = []
+    try:
+        for ws in workers:
+            conn = http.client.HTTPConnection("127.0.0.1", ws.port,
+                                              timeout=10)
+            for i in range(n_per_worker):
+                conn.request("POST", "/", json.dumps({"i": i}).encode(),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                codes.append(r.status)
+                r.read()
+            conn.close()
+        # every 5th enqueue across the shared injector errored out as 500
+        assert codes.count(500) == len(codes) // 5
+        assert codes.count(200) == len(codes) - codes.count(500)
+
+        conn = http.client.HTTPConnection("127.0.0.1", workers[0].port,
+                                          timeout=10)
+        conn.request("GET", "/debug/slo")
+        card = json.loads(conn.getresponse().read())
+        conn.close()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        for ws in workers:
+            ws.close()
+
+    # the HTTP-rendered card is a snapshot taken just before its own
+    # request is observed, so it holds exactly the 30 POSTs
+    assert sum(c["total"] for c in card["classes"]) == 3 * n_per_worker
+    # reconciliation: the tracker and the serving request counter
+    # increment at the same observation point, so a scorecard taken after
+    # the GET completes agrees with mmlspark_serving_requests_total
+    # exactly — 30 POSTs + the /debug/slo GET itself
+    live = get_tracker().scorecard()
+    total = sum(c["total"] for c in live["classes"])
+    assert total == _series_sum("mmlspark_serving_requests_total")
+    assert total == 3 * n_per_worker + 1
+    by_class = {(c["transport"], c["route"]): c for c in card["classes"]}
+    api_threaded = by_class[("threaded", "api")]
+    assert api_threaded["total"] == 2 * n_per_worker
+    assert api_threaded["errors_total"] == 4       # faults 5,10,15,20
+    api_async = by_class[("async", "api")]
+    assert api_async["total"] == n_per_worker
+    assert api_async["errors_total"] == 2          # faults 25,30
+
+    # the harvest rows are in the store the CostModel reads
+    rows = obs_mod.get_store().rows(source="slo_scorecard")
+    assert {r["sig"] for r in rows} >= {"slo:threaded/api/default",
+                                        "slo:async/api/default"}
+    for r in rows:
+        assert r["source"] == "slo_scorecard"
+        assert "slo" in r and "error_budget_burn" in r["slo"]
+
+
+def test_shed_is_tracked_per_class():
+    ws = WorkerServer(transport="threaded", max_queue=1, reply_timeout=0.5)
+    try:
+        ws._enqueue(HTTPRequestData(url="/", method="POST"))  # fill queue
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        conn.request("POST", "/", b'{"x": 1}')
+        r = conn.getresponse()
+        assert r.status == 429
+        r.read()
+        conn.close()
+    finally:
+        ws.close()
+    card = get_tracker().scorecard()
+    cls = [c for c in card["classes"]
+           if (c["transport"], c["route"]) == ("threaded", "api")][0]
+    assert cls["shed_total"] == 1
+    assert _series_sum("mmlspark_slo_shed_total") == 1

@@ -756,6 +756,82 @@ def test_tpu010_suppressible_with_justification():
 
 
 # ---------------------------------------------------------------------------
+# TPU011 adhoc-slo-window
+
+
+def test_tpu011_sorted_quantile_index_fires():
+    findings, _ = run_fixture("""\
+        lat = []
+
+        def report():
+            lat.sort()
+            return sorted(lat)[int(0.99 * len(lat))]
+        """, relpath="mmlspark_tpu/serving/stats.py")
+    (f,) = [f for f in findings if f.rule == "TPU011"]
+    assert f.severity == "warning" and f.line == 5
+
+
+def test_tpu011_timestamp_prune_loop_fires():
+    findings, _ = run_fixture("""\
+        import collections, time
+
+        events = collections.deque()
+
+        def observe(now):
+            events.append(now)
+            while now - events[0] > 60.0:
+                events.popleft()
+        """, relpath="mmlspark_tpu/serving/stats.py")
+    (f,) = [f for f in findings if f.rule == "TPU011"]
+    assert f.line == 7
+
+
+def test_tpu011_quiet_in_observability_and_outside_package():
+    src = """\
+        lat = []
+
+        def report():
+            return sorted(lat)[int(0.99 * len(lat))]
+        """
+    # the SLO engine itself is the sanctioned home for window math
+    findings, _ = run_fixture(
+        src, relpath="mmlspark_tpu/observability/slo.py")
+    assert "TPU011" not in codes(findings)
+    # scripts/tools/tests are out of scope
+    findings, _ = run_fixture(src, relpath="scripts/report.py")
+    assert "TPU011" not in codes(findings)
+
+
+def test_tpu011_quiet_on_benign_lookalikes():
+    # capacity prune (no timestamp-age test) and a fraction-scaled size
+    # (no len() in the same index) are not rolling-window math
+    findings, _ = run_fixture("""\
+        import collections
+
+        q = collections.deque()
+        F = 128
+
+        def trim(cap):
+            while len(q) > cap:
+                q.popleft()
+            return buckets[int(0.75 * F)]
+        """, relpath="mmlspark_tpu/serving/stats.py")
+    assert "TPU011" not in codes(findings)
+
+
+def test_tpu011_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        def report(lat):
+            # one-shot offline report, not a serving-path window
+            # tpulint: disable=TPU011
+            return sorted(lat)[int(0.5 * len(lat))]
+        """, relpath="mmlspark_tpu/tuning/offline.py",
+        keep_suppressed=True)
+    assert "TPU011" not in codes(findings)
+    assert "TPU011" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 
@@ -987,7 +1063,8 @@ def test_cli_json_format(tmp_path):
 def test_cli_list_rules():
     rc, out = _cli(["--list-rules"])
     assert rc == 0
-    for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006"):
+    for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
+                 "TPU010", "TPU011"):
         assert code in out
 
 

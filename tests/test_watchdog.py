@@ -1,0 +1,185 @@
+"""Stall watchdog: exactly-once firing per stall, atomic black-box
+bundles with the stalled thread's stack, beat() re-arming, the disabled
+fast path, and the env knobs. Everything runs against ``scan_once()``
+with an injected clock — no daemon timing, no real sleeps.
+"""
+
+import glob
+import json
+import os
+import threading
+
+import pytest
+
+from mmlspark_tpu.observability import reset_all, snapshot
+from mmlspark_tpu.observability.watchdog import (_NULL_WATCH, BUDGET_ENV,
+                                                 DIAG_DIR_ENV, INTERVAL_ENV,
+                                                 WATCHDOG_ENV, Watchdog,
+                                                 configure, get_watchdog,
+                                                 reset_watchdog,
+                                                 set_watchdog, watch)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    for env in (WATCHDOG_ENV, DIAG_DIR_ENV, BUDGET_ENV, INTERVAL_ENV):
+        monkeypatch.delenv(env, raising=False)
+    reset_watchdog()
+    reset_all()
+    yield
+    reset_watchdog()
+    reset_all()
+
+
+def _make(tmp_path, **kwargs):
+    """An enabled watchdog driven entirely by a fake clock; the scan
+    interval is huge so the daemon thread never races scan_once()."""
+    now = [0.0]
+    kwargs.setdefault("enabled", True)
+    kwargs.setdefault("interval", 3600.0)
+    kwargs.setdefault("default_budget", 1.0)
+    wd = Watchdog(diag_dir=str(tmp_path), clock=lambda: now[0], **kwargs)
+    return wd, now
+
+
+def _stall_count(site):
+    metric = snapshot().get("mmlspark_watchdog_stalls_total")
+    if not metric:
+        return 0.0
+    return sum(s["value"] for s in metric["series"]
+               if s["labels"].get("site") == site)
+
+
+def test_stall_fires_exactly_once(tmp_path):
+    wd, now = _make(tmp_path)
+    with wd.watch("device_run", budget_seconds=1.0):
+        now[0] = 5.0                       # heartbeat is 5s stale, budget 1s
+        records = wd.scan_once()
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["site"] == "device_run"
+        assert rec["budget_seconds"] == 1.0
+        assert rec["stalled_seconds"] == pytest.approx(5.0)
+        assert rec["thread"]["ident"] == threading.get_ident()
+        # the same stall does NOT fire again on later scans
+        now[0] = 50.0
+        assert wd.scan_once() == []
+        assert wd.scan_once() == []
+    assert _stall_count("device_run") == 1.0
+    assert len(glob.glob(str(tmp_path / "watchdog_*.json"))) == 1
+
+
+def test_beat_rearms_the_trigger(tmp_path):
+    wd, now = _make(tmp_path)
+    with wd.watch("decoder_decode", budget_seconds=1.0) as w:
+        now[0] = 5.0
+        assert len(wd.scan_once()) == 1    # first stall
+        w.beat()                           # loop recovered
+        assert wd.scan_once() == []
+        now[0] = 20.0                      # ...and wedged again
+        assert len(wd.scan_once()) == 1
+    assert _stall_count("decoder_decode") == 2.0
+    assert len(glob.glob(str(tmp_path / "watchdog_*.json"))) == 2
+
+
+def test_bundle_is_atomic_and_has_the_stalled_stack(tmp_path):
+    wd, now = _make(tmp_path)
+
+    def _the_wedged_device_call():
+        with wd.watch("runner_drain", budget_seconds=1.0):
+            now[0] = 10.0
+            return wd.scan_once()
+
+    (rec,) = _the_wedged_device_call()
+    path = rec["bundle"]
+    assert os.path.dirname(path) == str(tmp_path)
+    # atomic write: the bundle is complete JSON and no torn .tmp remains
+    assert glob.glob(str(tmp_path / "*.tmp")) == []
+    with open(path, encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    assert bundle["site"] == "runner_drain"
+    assert bundle["pid"] == os.getpid()
+    # the stalled thread's stack is in the bundle, wedged frame included
+    key = [k for k in bundle["stacks"]
+           if k.startswith(str(rec["thread"]["ident"]))]
+    assert key, bundle["stacks"].keys()
+    assert "_the_wedged_device_call" in "".join(bundle["stacks"][key[0]])
+    assert "faulthandler" in bundle
+    # the metrics snapshot rides along for post-mortems
+    assert "mmlspark_watchdog_stalls_total" in bundle["metrics"]
+
+
+def test_clean_exit_writes_nothing(tmp_path):
+    wd, now = _make(tmp_path)
+    with wd.watch("compile_warmup", budget_seconds=1.0):
+        pass                               # finished within budget
+    now[0] = 100.0
+    assert wd.scan_once() == []            # exited watches are unregistered
+    assert glob.glob(str(tmp_path / "*")) == []
+    assert _stall_count("compile_warmup") == 0.0
+
+
+def test_disabled_watch_is_the_shared_noop():
+    # default-constructed (env unset) watchdog is disabled
+    wd = Watchdog()
+    assert wd.enabled is False
+    assert wd.watch("x") is _NULL_WATCH
+    # the module-level hot path: no watchdog installed -> same no-op,
+    # without even constructing the global
+    assert watch("x") is _NULL_WATCH
+    set_watchdog(wd)
+    assert watch("x") is _NULL_WATCH
+    # and it is a working context manager with a no-op beat
+    with watch("x") as w:
+        w.beat()
+
+
+def test_module_watch_routes_to_enabled_global(tmp_path):
+    wd = configure(enabled=True, interval=3600.0,
+                   diag_dir=str(tmp_path))
+    assert get_watchdog() is wd
+    with watch("bench_generation", budget_seconds=99.0) as w:
+        assert w is not _NULL_WATCH
+        assert len(wd._watches) == 1
+        assert w.site == "bench_generation"
+    assert len(wd._watches) == 0
+
+
+def test_on_stall_callbacks_and_last_stall_age(tmp_path):
+    wd, now = _make(tmp_path)
+    assert wd.last_stall_age() is None
+    seen = []
+    wd.on_stall(seen.append)
+    with wd.watch("device_run", budget_seconds=1.0):
+        now[0] = 4.0
+        wd.scan_once()
+    assert len(seen) == 1
+    assert seen[0]["site"] == "device_run"
+    assert os.path.isfile(seen[0]["bundle"])
+    assert wd.last_stall is not None and wd.last_stall["site"] == "device_run"
+    now[0] = 10.0
+    assert wd.last_stall_age() == pytest.approx(6.0)
+
+
+def test_env_knobs(monkeypatch, tmp_path):
+    monkeypatch.setenv(WATCHDOG_ENV, "1")
+    monkeypatch.setenv(BUDGET_ENV, "7.5")
+    monkeypatch.setenv(INTERVAL_ENV, "0.25")
+    monkeypatch.setenv(DIAG_DIR_ENV, str(tmp_path / "diag"))
+    reset_watchdog()
+    wd = get_watchdog()
+    assert wd.enabled is True
+    assert wd.default_budget == 7.5
+    assert wd.interval == 0.25
+    assert wd.diag_dir() == str(tmp_path / "diag")
+    assert os.path.isdir(wd.diag_dir())
+
+
+def test_budget_falls_back_to_default(tmp_path):
+    wd, now = _make(tmp_path, default_budget=2.0)
+    with wd.watch("site_a") as w:          # no explicit budget
+        assert w.budget == 2.0
+        now[0] = 1.5
+        assert wd.scan_once() == []        # under budget: quiet
+        now[0] = 3.0
+        assert len(wd.scan_once()) == 1

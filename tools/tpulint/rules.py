@@ -731,3 +731,89 @@ def _terminal_name(base: ast.AST) -> Optional[str]:
     while isinstance(base, ast.Attribute):
         return base.attr
     return base.id if isinstance(base, ast.Name) else None
+
+
+def _quantile_subscript(node: ast.Subscript) -> bool:
+    """``sorted(lat)[int(0.99 * len(lat))]``-shaped indexing: the index
+    expression does arithmetic on BOTH a quantile-looking float constant
+    (strictly between 0 and 1) and a ``len(...)`` call. Plain fraction
+    math (``int(0.75 * F)``) and plain indexing (``lat[0]``) stay quiet —
+    both ingredients together are what spell "percentile by hand"."""
+    idx = node.slice
+    has_frac = any(isinstance(sub, ast.Constant)
+                   and isinstance(sub.value, float)
+                   and 0.0 < sub.value < 1.0
+                   for sub in ast.walk(idx))
+    has_len = any(isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Name)
+                  and sub.func.id == "len"
+                  for sub in ast.walk(idx))
+    return has_frac and has_len
+
+
+def _timestamp_prune_loop(loop: ast.While) -> bool:
+    """``while dq and now - dq[0] > window: dq.popleft()`` — a hand-rolled
+    rolling window over a deque of timestamps. The test must age-compare
+    the queue head (a ``[0]`` subscript inside subtraction arithmetic) and
+    the body must drop it (``popleft()`` or ``pop(0)``); capacity-shaped
+    prune loops (``while len(q) > cap``) have no subtraction on ``q[0]``
+    and stay quiet."""
+    head_aged = any(
+        isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub)
+        and any(isinstance(s, ast.Subscript)
+                and isinstance(s.slice, ast.Constant)
+                and s.slice.value == 0
+                for s in ast.walk(sub))
+        for sub in ast.walk(loop.test))
+    if not head_aged:
+        return False
+    for sub in _loop_body_nodes(loop):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if sub.func.attr == "popleft":
+                return True
+            if sub.func.attr == "pop" and sub.args \
+                    and isinstance(sub.args[0], ast.Constant) \
+                    and sub.args[0].value == 0:
+                return True
+    return False
+
+
+@register_rule
+class AdhocSloWindow(Rule):
+    code = "TPU011"
+    name = "adhoc-slo-window"
+    severity = "warning"
+    doc = ("A hand-rolled latency-quantile or rolling-window computation "
+           "outside mmlspark_tpu/observability/: percentile-by-sorting "
+           "(``sorted(lat)[int(0.99 * len(lat))]`` — O(n log n) per "
+           "report, unbounded memory) or a timestamp-deque prune loop "
+           "(``while now - dq[0] > window: dq.popleft()``). The SLO "
+           "tracker (observability/slo.py) already keeps O(1)-memory "
+           "time-bucketed windows with fixed-bucket latency sketches and "
+           "serves them at GET /debug/slo — observe into "
+           "``observability.get_tracker()`` (or a registry Histogram) "
+           "instead of growing another private window.")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mmlspark_tpu/") \
+                or rel.startswith("mmlspark_tpu/observability/"):
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Subscript) and _quantile_subscript(node):
+                findings.append(self.finding(
+                    module, node,
+                    "latency quantile computed by sorted-list indexing; "
+                    "observe samples into observability.get_tracker() (or "
+                    "a registry Histogram) and read p50/p99 from the "
+                    "scorecard instead of sorting per report"))
+            elif isinstance(node, ast.While) \
+                    and _timestamp_prune_loop(node):
+                findings.append(self.finding(
+                    module, node,
+                    "hand-rolled rolling window (timestamp deque pruned "
+                    "by age); the SLO tracker's time-bucketed ring keeps "
+                    "the same window in O(1) memory — observe into "
+                    "observability.get_tracker()"))
+        return iter(findings)
