@@ -46,6 +46,7 @@ import numpy as np
 
 from ..observability import counter as _counter
 from ..observability import gauge as _gauge
+from ..reliability.lock_sanitizer import new_rlock
 
 __all__ = [
     "DeviceColumn", "HostMirror", "ResidencyManager",
@@ -154,7 +155,7 @@ class ResidencyManager:
         self.budget_bytes = int(budget_bytes)
         # gc of a resident chunk can fire the weakref callback mid-admit on
         # the same thread — the lock must be reentrant
-        self._lock = threading.RLock()
+        self._lock = new_rlock("core.residency.ResidencyManager._lock")
         self._lru: "OrderedDict[int, object]" = OrderedDict()  # id -> weakref
         self._accounted: Dict[int, int] = {}                   # id -> bytes
         self._resident_bytes = 0
@@ -248,7 +249,13 @@ class ResidencyManager:
             return
         if chunk.host is None:
             import jax
-            host = np.asarray(jax.device_get(chunk.dev))
+            # the d2h writeback stays under the manager lock on purpose:
+            # it must be atomic with the state flip below — releasing
+            # between them would let a concurrent ensure_device resurrect
+            # a half-spilled chunk (dev still set, host mid-copy). Spills
+            # only happen on the over-budget path; the hold is measured
+            # by the lock sanitizer's mmlspark_lock_held_seconds metric.
+            host = np.asarray(jax.device_get(chunk.dev))  # tpulint: disable=TPU014
             M_D2H.inc(1, site="spill")
             M_D2H_BYTES.inc(chunk.nbytes, site="spill")
             chunk.host = host

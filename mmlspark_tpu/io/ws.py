@@ -84,7 +84,10 @@ class WebSocketConn:
         else:
             frame = head + payload
         with self._send_lock:
-            self.sock.sendall(frame)
+            # the socket write IS the critical section: _send_lock exists
+            # to keep concurrently-sent frames from interleaving on the
+            # wire (a split frame is a protocol error, not a slow call)
+            self.sock.sendall(frame)  # tpulint: disable=TPU014
 
     def send_text(self, s: str) -> None:
         self.send(s, OP_TEXT)

@@ -101,9 +101,13 @@ class JaxModel(Model):
         # any reconfiguration invalidates the compiled program and the
         # cached device-resident params (mirrors ONNXModel's _jit_sig)
         out = super().set(**kwargs)
-        if kwargs and hasattr(self, "_jitted"):
+        if kwargs and hasattr(self, "_params_lock"):
             self._jitted = None
-            self._device_params = {}
+            # under the lock like ONNXModel.set: a _params_for_device call
+            # racing the reset must see either the old cache or the empty
+            # one, never a dict it is mid-populating
+            with self._params_lock:
+                self._device_params = {}
         if kwargs and getattr(self, "_tuning_decisions", None) is not None:
             self._tuning_decisions.clear()
         return out
@@ -182,10 +186,15 @@ class JaxModel(Model):
             if key not in self._device_params:
                 params = self.get_or_none("model_params")
                 # f32 over the wire, compute_dtype cast on device (narrow
-                # host buffers hit a slow transfer path; see ONNXModel)
+                # host buffers hit a slow transfer path; see ONNXModel).
+                # staging stays under the lock on purpose: first touch per
+                # device must be single-flight — two racing threads would
+                # both device_put the full param tree (duplicate HBM +
+                # link traffic); steady state is a dict hit
                 self._device_params[key] = self._cast_tree(
-                    jax.device_put(params, device) if device is not None
-                    else jax.device_put(params))
+                    jax.device_put(params, device)  # tpulint: disable=TPU014
+                    if device is not None
+                    else jax.device_put(params))    # tpulint: disable=TPU014
             return self._device_params[key]
 
     def _params_for_mesh(self, mesh):
@@ -193,7 +202,8 @@ class JaxModel(Model):
         key = ("mesh", mesh)
         with self._params_lock:
             if key not in self._device_params:
-                self._device_params[key] = self._cast_tree(jax.device_put(
+                # single-flight staging, as in _params_for_device
+                self._device_params[key] = self._cast_tree(jax.device_put(  # tpulint: disable=TPU014
                     self.get_or_none("model_params"),
                     replicated_sharding(mesh)))
             return self._device_params[key]
@@ -293,6 +303,9 @@ class JaxModel(Model):
     # -- persistence --------------------------------------------------------
     def _load_extra(self, path: str) -> None:
         self._jitted = None
+        # load-time rebuild of a just-deserialized instance: the lock
+        # itself is recreated on the next line, so nothing can hold it
+        # tpulint: disable=TPU012
         self._device_params = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()

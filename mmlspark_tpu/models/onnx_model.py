@@ -422,9 +422,11 @@ class ONNXModel(Model):
                 # transfer in f32, cast on device: narrow-dtype host buffers
                 # (bfloat16) take a slow serialization path over the link
                 # params are committed to `device`; the cast jit follows
-                # its operands
+                # its operands. staging stays under the lock on purpose:
+                # first touch per device must be single-flight — racing
+                # threads would both device_put the full param tree
                 p = self._cast_params(
-                    jax.device_put(self._effective_params(cm), device))
+                    jax.device_put(self._effective_params(cm), device))  # tpulint: disable=TPU014
                 if self.quantize == "int8":
                     p = self._pack_params(p)
                 self._device_params[key] = p
@@ -437,8 +439,9 @@ class ONNXModel(Model):
         with self._params_lock:
             if key not in self._device_params:
                 cm = self._ensure_converted()
+                # single-flight staging, as in _params_for_device
                 p = self._cast_params(
-                    jax.device_put(self._effective_params(cm),
+                    jax.device_put(self._effective_params(cm),  # tpulint: disable=TPU014
                                    replicated_sharding(mesh)))
                 if self.quantize == "int8":
                     p = self._pack_params(p)
@@ -594,6 +597,9 @@ class ONNXModel(Model):
         self._fused_cols = set()
         self._argmax_cols = set()
         self._out_col_names = []
+        # load-time rebuild of a just-deserialized instance: the lock
+        # itself is recreated on the next line, so nothing can hold it
+        # tpulint: disable=TPU012
         self._device_params = {}
         self._params_lock = threading.Lock()
         self._counters = StageCounters()

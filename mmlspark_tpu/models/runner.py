@@ -27,6 +27,8 @@ to stay on in production and surfaced by ``bench.py``.
 from __future__ import annotations
 
 import threading
+
+from ..reliability.lock_sanitizer import new_lock
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -70,7 +72,7 @@ class StagingSlabPool:
 
     def __init__(self, depth: int = 2):
         self.depth = max(1, int(depth))
-        self._lock = threading.Lock()
+        self._lock = new_lock("models.runner.StagingSlabPool._lock")
         self._free: Dict[tuple, List[np.ndarray]] = {}
         self._issued: set = set()
         self.allocs = 0

@@ -297,6 +297,14 @@ class Watchdog:
             bundle["residency"] = residency_stats()
         except Exception:
             bundle["residency"] = None
+        try:
+            # which thread holds which sanitized lock, and for how long —
+            # a stalled device call plus this table is usually the whole
+            # deadlock/convoy diagnosis (empty when the sanitizer is off)
+            from ..reliability.lock_sanitizer import held_by_thread
+            bundle["locks_held"] = held_by_thread()
+        except Exception:
+            bundle["locks_held"] = None
         site = _SITE_SANITIZE_RE.sub("_", record["site"])[:64] or "site"
         name = (f"watchdog_{site}_{os.getpid()}_"
                 f"{next(self._bundle_seq)}.json")

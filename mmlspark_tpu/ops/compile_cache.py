@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ..reliability.lock_sanitizer import new_lock
 import time
 from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
 
@@ -75,7 +77,7 @@ M_WARMUP_SECONDS = _metric_counter(
     "mmlspark_compile_cache_warmup_seconds_total",
     "Wall-clock spent in AOT warm-up")
 
-_cache_lock = threading.Lock()
+_cache_lock = new_lock("ops.compile_cache._cache_lock")
 _cache_dir: Optional[str] = None
 
 
@@ -143,7 +145,7 @@ class StageCounters:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = new_lock("ops.compile_cache.StageCounters._lock")
         self._stages: Dict[str, Dict[str, float]] = {}
 
     def add(self, stage: str, seconds: float, nbytes: int = 0,

@@ -12,6 +12,11 @@ and fail-fast serving):
 - :mod:`.faults` — deterministic, seedable :class:`FaultInjector` with
   named sites (``peer_http``, ``heartbeat``, ``device_run``, ``enqueue``)
   driven programmatically or by the ``MMLSPARK_TPU_FAULTS`` env spec.
+- :mod:`.lock_sanitizer` — opt-in (``MMLSPARK_TPU_LOCK_SANITIZER=1``)
+  instrumented lock factory: dynamic lock-order-cycle detection with both
+  stacks, hold-time budgets into ``mmlspark_lock_held_seconds{site}``, and
+  the watchdog bundle's locks-held-per-thread table (the runtime half of
+  tpulint's TPU013).
 
 ``docs/reliability.md`` is the narrative companion.
 """
@@ -19,6 +24,8 @@ and fail-fast serving):
 from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
                       open_breakers, reset_breakers)
 from .faults import FaultInjector, InjectedFault, get_injector
+from .lock_sanitizer import (cycle_reports, held_by_thread, new_condition,
+                             new_lock, new_rlock)
 from .policy import (DEADLINE_HEADER, Deadline, DeadlineExceeded, RetryPolicy,
                      record_retry)
 
@@ -31,6 +38,11 @@ __all__ = [
     "FaultInjector",
     "InjectedFault",
     "get_injector",
+    "cycle_reports",
+    "held_by_thread",
+    "new_condition",
+    "new_lock",
+    "new_rlock",
     "DEADLINE_HEADER",
     "Deadline",
     "DeadlineExceeded",

@@ -21,6 +21,8 @@ from ..observability import counter as _metric_counter
 from ..observability import gauge as _metric_gauge
 from ..observability import tracing as _tracing
 
+from .lock_sanitizer import new_lock
+
 __all__ = ["BreakerOpen", "CircuitBreaker", "breaker_for", "reset_breakers",
            "CLOSED", "OPEN", "HALF_OPEN"]
 
@@ -68,7 +70,7 @@ class CircuitBreaker:
         self._state = CLOSED
         self._opened_at = 0.0
         self._probe_inflight = False
-        self._lock = threading.Lock()
+        self._lock = new_lock("reliability.breaker.CircuitBreaker._lock")
         _M_STATE.set(0.0, peer=peer)
 
     @property
@@ -131,7 +133,7 @@ class CircuitBreaker:
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
-_BREAKERS_LOCK = threading.Lock()
+_BREAKERS_LOCK = new_lock("reliability.breaker._BREAKERS_LOCK")
 
 
 def breaker_for(peer: str, **kwargs) -> CircuitBreaker:

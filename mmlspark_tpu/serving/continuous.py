@@ -71,6 +71,7 @@ from ..observability import (counter as _metric_counter,
                              watch as _watch)
 from ..observability import tracing as _tracing
 from ..reliability import get_injector as _get_injector
+from ..reliability.lock_sanitizer import new_lock
 from ..utils.profiling import span as _prof_span
 from ..models.zoo.transformer import (TransformerConfig,
                                       _warp_scaled_rows,
@@ -710,8 +711,10 @@ class ContinuousDecoder:
         self._reset_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self._S
         self._waiting: List[_Request] = []
-        self._lock = threading.Lock()           # guards _waiting/_next_rid
-        self._engine_lock = threading.Lock()    # serializes step/cancel_all
+        self._lock = new_lock(                  # guards _waiting/_next_rid
+            "serving.continuous.ContinuousDecoder._lock")
+        self._engine_lock = new_lock(           # serializes step/cancel_all
+            "serving.continuous.ContinuousDecoder._engine_lock")
         self._next_rid = 0
         self._stop = threading.Event()
 
@@ -1001,7 +1004,7 @@ class ContinuousDecoder:
                     req.done = True
                     req.finished_at = time.perf_counter()
                     req.event.set()
-                    self._release(slot)
+                    self._release_locked(slot)
                     continue
                 if not ok:
                     self._requeue(prefixed[pi:] + chunked)
@@ -1186,7 +1189,7 @@ class ContinuousDecoder:
         while off < len(group):
             size = 1 << ((len(group) - off).bit_length() - 1)
             sl = slice(off, off + size)
-            self._insert_chunk(
+            self._insert_chunk_locked(
                 group[sl], logits[sl],
                 [{kk: c[kk][sl] for kk in ("k", "v")}
                  for c in row_cache[:n_t]],
@@ -1195,7 +1198,7 @@ class ContinuousDecoder:
             off += size
         return True
 
-    def _insert_chunk(self, group, logits, rows_t, rows_d):
+    def _insert_chunk_locked(self, group, logits, rows_t, rows_d):
         """One compiled insert: scatter target rows into the slots' pages
         (``rows_t`` empty for state-only activation — prefix hits and
         chunked prefills already wrote their K/V), write draft rows into
@@ -1330,7 +1333,7 @@ class ContinuousDecoder:
                 self._attn_impl,
                 gather_bytes=(self._gather_bytes_extend
                               if self._attn_impl == "gather" else 0))
-            self._insert_chunk([(slot, req)], w_logits[:, Sn - 1], [],
+            self._insert_chunk_locked([(slot, req)], w_logits[:, Sn - 1], [],
                                self._draft_prompt_rows(req))
             return True
         # miss: full prefill into the slot's own pages; cap the pad
@@ -1345,7 +1348,7 @@ class ContinuousDecoder:
             self._params, jnp.asarray(ids), jnp.asarray([P], jnp.int32))
         self.stats["prefills"] += 1
         _M_PREFILLS.inc()
-        self._insert_chunk(
+        self._insert_chunk_locked(
             [(slot, req)], logits,
             [{kk: c[kk] for kk in ("k", "v")} for c in row_cache],
             self._draft_prompt_rows(req))
@@ -1442,7 +1445,7 @@ class ContinuousDecoder:
         # first token from the last REAL lane of the final window —
         # logits after consuming prompt position P-1, sampled at emit
         # position P: generate_cached's exact schedule
-        self._insert_chunk([(slot, req)], w_logits[:, w - 1], [],
+        self._insert_chunk_locked([(slot, req)], w_logits[:, w - 1], [],
                            self._draft_prompt_rows(req))
 
     def _note_token(self, req: _Request, tok: int):
@@ -1456,7 +1459,7 @@ class ContinuousDecoder:
             req.finished_at = now
             req.event.set()
 
-    def _release(self, slot: int):
+    def _release_locked(self, slot: int):
         self._slot_req[slot] = None
         self._active = self._active.at[slot].set(False)
         self._chunking.pop(slot, None)
@@ -1678,7 +1681,7 @@ class ContinuousDecoder:
                 self._note_token(req, tk)
         for _, (slot, req) in snapshot.items():
             if req.done and self._slot_req[slot] is req:
-                self._release(slot)
+                self._release_locked(slot)
 
     def flush(self):
         """Drain every outstanding dispatch (bounded: the pending queue

@@ -36,6 +36,7 @@ from ..observability import tracing as _tracing
 from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
                            Deadline, DeadlineExceeded, RetryPolicy,
                            breaker_for, get_injector)
+from ..reliability.lock_sanitizer import new_lock
 from .server import CachedRequest, Overloaded, WorkerServer
 
 __all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
@@ -157,7 +158,7 @@ class DriverRegistry:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  liveness_timeout: float = 30.0):
         self._workers: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.distributed.DriverRegistry._lock")
         self._generation = 0
         self.liveness_timeout = liveness_timeout
         self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
@@ -243,7 +244,7 @@ class DistributedWorker:
         self.has_engine = True
         self._peers: Dict[str, str] = {}
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.distributed.DistributedWorker._lock")
         # the registered address must be PEER-routable: a 0.0.0.0 bind
         # address handed to peers would make them connect to themselves
         # (and /_forward always serves locally, so the wrong worker answers)

@@ -35,6 +35,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from ..reliability.lock_sanitizer import new_lock
 from typing import Dict, Optional, Tuple
 
 from ..io.http.schema import HTTPRequestData
@@ -49,7 +51,7 @@ class ServingJournal:
     def __init__(self, path: str, fsync: bool = True):
         self.path = path
         self.fsync = fsync
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.journal.ServingJournal._lock")
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._repair_torn_tail(path)
         self._fh = open(path, "a", encoding="utf-8")

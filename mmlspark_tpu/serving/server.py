@@ -42,6 +42,7 @@ from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
 from ..observability import tracing as _tracing
 from ..reliability import (Deadline, get_injector as _get_injector,
                            open_breakers as _open_breakers)
+from ..reliability.lock_sanitizer import new_lock
 
 __all__ = ["CachedRequest", "Overloaded", "WorkerServer"]
 
@@ -110,7 +111,7 @@ class StreamingReply:
         self.content_type = content_type
         self._q: "queue.Queue" = queue.Queue()
         self._notify = None
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.server.StreamingReply._lock")
         self._closed = False
 
     def send(self, data) -> None:
@@ -119,7 +120,9 @@ class StreamingReply:
         with self._lock:
             if self._closed:
                 return
-            self._q.put(bytes(data))
+            # _q is unbounded: put() never blocks, it only appends — the
+            # lock pairs the closed-check with the enqueue
+            self._q.put(bytes(data))  # tpulint: disable=TPU014
             notify = self._notify
         if notify is not None:
             notify()
@@ -134,7 +137,8 @@ class StreamingReply:
             if self._closed:
                 return
             self._closed = True
-            self._q.put(StreamingReply._CLOSE)
+            # unbounded queue — see send()
+            self._q.put(StreamingReply._CLOSE)  # tpulint: disable=TPU014
             notify = self._notify
         if notify is not None:
             notify()

@@ -1,0 +1,254 @@
+"""Runtime lock-order sanitizer: cycle detection with both stacks, hold
+budgets, RLock/Condition correctness, the disabled-is-free identity, the
+watchdog bundle table, and the serving chaos drill re-run instrumented.
+
+Everything here is deterministic: cycles are created by taking locks in
+opposite orders *sequentially* (the graph sees the order inversion without
+any actual deadlock), and the chaos drill reuses the seeded scenario from
+test_serving_distributed.py.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu.reliability import lock_sanitizer as ls
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sanitizer():
+    ls.reset()
+    yield
+    ls.reset()
+
+
+# ---------------------------------------------------------------------------
+# cycle detection
+
+
+def test_two_lock_cycle_reported_with_both_stacks():
+    ls.configure(enabled=True)
+    a = ls.new_lock("t.A")
+    b = ls.new_lock("t.B")
+
+    def forward_order():
+        with a:
+            with b:
+                pass
+
+    def backward_order():
+        with b:
+            with a:
+                pass
+
+    forward_order()
+    t = threading.Thread(target=backward_order, name="backward")
+    t.start()
+    t.join()
+
+    reports = ls.cycle_reports()
+    assert len(reports) == 1
+    (rep,) = reports
+    assert set(rep["sites"]) == {"t.A", "t.B"}
+    # both stacks present: the edge that closed the cycle and the one
+    # that established the opposite order earlier
+    assert rep["forward"]["order"] == "t.B -> t.A"
+    assert any("backward_order" in line for line in rep["forward"]["stack"])
+    assert rep["reverse"][0]["order"] == "t.A -> t.B"
+    assert any("forward_order" in line
+               for line in rep["reverse"][0]["stack"])
+    # the cycle surfaced in metrics too
+    from mmlspark_tpu.observability.registry import snapshot
+    series = snapshot()["mmlspark_lock_order_cycles_total"]["series"]
+    assert series and series[0]["value"] >= 1.0
+
+
+def test_consistent_order_reports_nothing():
+    ls.configure(enabled=True)
+    a = ls.new_lock("t.A")
+    b = ls.new_lock("t.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert ls.cycle_reports() == []
+
+
+def test_three_lock_cycle_detected_via_path():
+    # A->B, B->C, then C->A closes a length-3 cycle no pair check sees
+    ls.configure(enabled=True)
+    a, b, c = (ls.new_lock(s) for s in ("t3.A", "t3.B", "t3.C"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    reports = ls.cycle_reports()
+    assert len(reports) == 1
+    assert set(reports[0]["sites"]) == {"t3.A", "t3.B", "t3.C"}
+
+
+# ---------------------------------------------------------------------------
+# hold budget
+
+
+def test_long_hold_lands_in_metric_and_report():
+    san = ls.configure(enabled=True, hold_budget=0.05)
+    lock = ls.new_lock("t.slow")
+    with lock:
+        time.sleep(0.08)
+    (rec,) = san.long_hold_reports()
+    assert rec["site"] == "t.slow" and rec["held_seconds"] >= 0.05
+    assert rec["stack"]   # where the hold started
+    from mmlspark_tpu.observability.registry import snapshot
+    snap = snapshot()["mmlspark_lock_held_seconds"]
+    (series,) = [s for s in snap["series"]
+                 if s["labels"].get("site") == "t.slow"]
+    assert series["count"] == 1 and series["sum"] >= 0.05
+
+
+def test_short_holds_stay_out_of_the_metric():
+    san = ls.configure(enabled=True, hold_budget=10.0)
+    lock = ls.new_lock("t.fast")
+    for _ in range(50):
+        with lock:
+            pass
+    assert san.long_hold_reports() == []
+
+
+# ---------------------------------------------------------------------------
+# re-entrant RLock + Condition correctness
+
+
+def test_rlock_reentrancy_books_outermost_only():
+    san = ls.configure(enabled=True)
+    r = ls.new_rlock("t.R")
+    with r:
+        with r:
+            assert r._is_owned()
+            held = san.held_by_thread()
+            (entries,) = held.values()
+            assert [e["site"] for e in entries] == ["t.R"]
+        assert r.locked()
+    assert not r.locked()
+    assert san.held_by_thread() == {}
+    # re-acquiring the same lock is not an order edge
+    assert ls.cycle_reports() == []
+
+
+def test_condition_on_sanitized_rlock_wait_notify():
+    ls.configure(enabled=True)
+    cond = ls.new_condition("t.C")
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with cond:
+            if cond._waiters:         # waiter parked → lock released
+                cond.notify_all()
+                break
+        time.sleep(0.01)
+    t.join(timeout=5.0)
+    assert woke == [True]
+
+
+def test_release_from_non_owner_raises():
+    ls.configure(enabled=True)
+    r = ls.new_rlock("t.R2")
+    with pytest.raises(RuntimeError):
+        r.release()
+
+
+# ---------------------------------------------------------------------------
+# disabled = identity
+
+
+def test_disabled_factories_return_plain_primitives():
+    ls.configure(enabled=False)
+    assert type(ls.new_lock("x")) is type(threading.Lock())
+    assert type(ls.new_rlock("x")) is type(threading.RLock())
+    assert isinstance(ls.new_condition("x"), threading.Condition)
+    assert ls.cycle_reports() == []
+    assert ls.held_by_thread() == {}
+
+
+def test_env_var_enables(monkeypatch):
+    monkeypatch.setenv(ls.SANITIZER_ENV, "1")
+    ls.reset()
+    assert ls.enabled()
+    assert isinstance(ls.new_lock("x"), ls.SanitizedLock)
+    monkeypatch.setenv(ls.SANITIZER_ENV, "0")
+    ls.reset()
+    assert not ls.enabled()
+
+
+# ---------------------------------------------------------------------------
+# watchdog bundle integration
+
+
+def test_watchdog_bundle_carries_locks_held_table(tmp_path):
+    from mmlspark_tpu.observability.watchdog import Watchdog
+
+    ls.configure(enabled=True)
+    lock = ls.new_lock("t.bundle")
+    clock = {"t": 0.0}
+    wd = Watchdog(enabled=True, diag_dir=str(tmp_path),
+                  default_budget=1.0, clock=lambda: clock["t"])
+    lock.acquire()
+    try:
+        with wd.watch("probe"):
+            clock["t"] = 10.0
+            (record,) = wd.scan_once()
+    finally:
+        lock.release()
+        wd.stop()
+    bundle = json.loads(open(record["bundle"]).read())
+    table = bundle["locks_held"]
+    assert any(e["site"] == "t.bundle"
+               for entries in table.values() for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# the serving chaos drill, instrumented
+
+
+def test_chaos_drill_under_sanitizer_reports_zero_cycles(monkeypatch):
+    """Acceptance: the 3-worker kill/re-register drill from
+    test_serving_distributed.py runs with MMLSPARK_TPU_LOCK_SANITIZER=1
+    and the dynamic acquisition graph stays acyclic — every lock the
+    serving plane takes nests in one global order."""
+    monkeypatch.setenv(ls.SANITIZER_ENV, "1")
+    ls.reset()
+    assert ls.enabled()
+    from tests.test_serving_distributed import (
+        test_chaos_faults_and_worker_restart_complete_every_request)
+
+    try:
+        test_chaos_faults_and_worker_restart_complete_every_request()
+
+        assert ls.cycle_reports() == [], (
+            "lock-order cycles under chaos:\n" + "\n".join(
+                " -> ".join(r["sites"]) for r in ls.cycle_reports()))
+    finally:
+        # the drill sandboxes global state BEFORE it runs, not after (its
+        # home module runs late in the alphabet); this file runs early, so
+        # scrub the breakers/faults/metrics it leaves open — later suites
+        # assert /healthz is "ok", not "degraded"
+        from mmlspark_tpu import observability as obs
+        from mmlspark_tpu.reliability import get_injector, reset_breakers
+        obs.reset_all()
+        reset_breakers()
+        get_injector().clear()

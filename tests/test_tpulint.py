@@ -1064,8 +1064,381 @@ def test_cli_list_rules():
     rc, out = _cli(["--list-rules"])
     assert rc == 0
     for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-                 "TPU010", "TPU011"):
+                 "TPU010", "TPU011", "TPU012", "TPU013", "TPU014"):
         assert code in out
+
+
+# ---------------------------------------------------------------------------
+# TPU012 unguarded-shared-mutation
+
+
+_GUARDED_CLASS = """\
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, v):
+            with self._lock:
+                self._items.append(v)
+
+        def drop(self, v):
+            with self._lock:
+                self._items.remove(v)
+
+        def rogue(self, v):
+            self._items.append(v)
+    """
+
+
+def test_tpu012_bare_write_to_inferred_guarded_field_fires():
+    findings, _ = run_fixture(_GUARDED_CLASS)
+    hits = [f for f in findings if f.rule == "TPU012"]
+    assert len(hits) == 1
+    assert "Pool._items" in hits[0].message
+    assert "_lock" in hits[0].message
+
+
+def test_tpu012_quiet_when_every_write_is_guarded_and_init_is_free():
+    findings, _ = run_fixture("""\
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []   # pre-publication write: never counted
+
+            def add(self, v):
+                with self._lock:
+                    self._items.append(v)
+
+            def drop(self, v):
+                with self._lock:
+                    self._items.remove(v)
+        """)
+    assert "TPU012" not in codes(findings)
+
+
+def test_tpu012_locked_suffix_method_counts_as_guarded():
+    # the _prune_locked convention: caller holds the class lock
+    findings, _ = run_fixture("""\
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, v):
+                with self._lock:
+                    self._items.append(v)
+                    self._prune_locked()
+
+            def drop(self, v):
+                with self._lock:
+                    self._items.remove(v)
+
+            def _prune_locked(self):
+                self._items.pop()
+        """)
+    assert "TPU012" not in codes(findings)
+
+
+def test_tpu012_module_global_under_module_lock():
+    findings, _ = run_fixture("""\
+        import threading
+
+        _LOCK = threading.Lock()
+        _CACHE = {}
+
+        def put(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+
+        def drop(k):
+            with _LOCK:
+                _CACHE.pop(k)
+
+        def rogue(k, v):
+            _CACHE[k] = v
+        """)
+    hits = [f for f in findings if f.rule == "TPU012"]
+    assert len(hits) == 1 and "_CACHE" in hits[0].message
+
+
+def test_tpu012_discovers_sanitizer_factory_locks():
+    # adoption must not blind the analysis: new_lock() IS a lock
+    findings, _ = run_fixture("""\
+        from mmlspark_tpu.reliability.lock_sanitizer import new_lock
+
+        class Pool:
+            def __init__(self):
+                self._lock = new_lock("pool")
+                self._items = []
+
+            def add(self, v):
+                with self._lock:
+                    self._items.append(v)
+
+            def drop(self, v):
+                with self._lock:
+                    self._items.remove(v)
+
+            def rogue(self, v):
+                self._items.append(v)
+        """)
+    assert "TPU012" in codes(findings)
+
+
+def test_tpu012_suppressible_with_justification():
+    findings, suppressed = run_fixture(
+        _GUARDED_CLASS.replace(
+            "self._items.append(v)\n    ",
+            "self._items.append(v)  # tpulint: disable=TPU012\n    "),
+        keep_suppressed=True)
+    assert "TPU012" not in codes(findings)
+    assert "TPU012" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# TPU013 lock-order-inversion
+
+
+def test_tpu013_ab_ba_inversion_fires_with_both_sites():
+    findings, _ = run_fixture("""\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def forward():
+            with A:
+                with B:
+                    pass
+
+        def backward():
+            with B:
+                with A:
+                    pass
+        """)
+    hits = [f for f in findings if f.rule == "TPU013"]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "A" in hits[0].message and "B" in hits[0].message
+    # the report names both conflicting locations
+    assert "forward" in hits[0].message or "backward" in hits[0].message
+
+
+def test_tpu013_consistent_order_is_quiet():
+    findings, _ = run_fixture("""\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+        def two():
+            with A:
+                with B:
+                    pass
+        """)
+    assert "TPU013" not in codes(findings)
+
+
+def test_tpu013_nonreentrant_self_reacquire_through_call_fires():
+    findings, _ = run_fixture("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    hits = [f for f in findings if f.rule == "TPU013"]
+    assert len(hits) == 1 and "self-deadlock" in hits[0].message
+
+
+def test_tpu013_rlock_self_reacquire_is_quiet():
+    findings, _ = run_fixture("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert "TPU013" not in codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU014 blocking-call-under-lock
+
+
+def test_tpu014_sleep_and_device_sync_under_lock_fire():
+    findings, _ = run_fixture("""\
+        import threading
+        import time
+        import jax
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def b(self, x):
+                with self._lock:
+                    return jax.device_get(x)
+        """)
+    hits = [f for f in findings if f.rule == "TPU014"]
+    assert len(hits) == 2
+    assert any("time.sleep" in f.message for f in hits)
+    assert any("jax.device_get" in f.message for f in hits)
+
+
+def test_tpu014_blocking_outside_lock_is_quiet():
+    findings, _ = run_fixture("""\
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    n = 1
+                time.sleep(n)
+        """)
+    assert "TPU014" not in codes(findings)
+
+
+def test_tpu014_sees_through_one_call_level():
+    # with self._lock: self._pull() — the sync lives in the helper
+    findings, _ = run_fixture("""\
+        import threading
+        import jax
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self):
+                with self._lock:
+                    self._pull()
+
+            def _pull(self):
+                return jax.device_get(1)
+        """)
+    hits = [f for f in findings if f.rule == "TPU014"]
+    assert len(hits) == 1 and "jax.device_get" in hits[0].message
+
+
+def test_tpu014_condition_wait_and_nonblocking_get_are_quiet():
+    # cond.wait releases the lock it is tied to; get(block=False) returns
+    findings, _ = run_fixture("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._q = object()
+
+            def a(self):
+                with self._lock:
+                    self._cond.wait()
+
+            def b(self):
+                with self._lock:
+                    return self._q.get(block=False)
+        """)
+    assert "TPU014" not in codes(findings)
+
+
+def test_tpu014_queue_wait_under_lock_fires():
+    findings, _ = run_fixture("""\
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = queue.Queue(4)
+
+            def a(self, v):
+                with self._lock:
+                    self._queue.put(v)
+        """)
+    hits = [f for f in findings if f.rule == "TPU014"]
+    assert len(hits) == 1 and "queue" in hits[0].message
+
+
+def test_tpu014_findings_are_baselinable():
+    findings, _ = run_fixture("""\
+        import threading
+        import time
+
+        _L = threading.Lock()
+
+        def a():
+            with _L:
+                time.sleep(1)
+        """)
+    hits = [f for f in findings if f.rule == "TPU014"]
+    assert hits
+    known = baseline_mod.counts(hits)
+    fresh, baselined, stale = baseline_mod.apply(hits, known)
+    assert fresh == [] and len(baselined) == len(hits) and not stale
+
+
+# ---------------------------------------------------------------------------
+# --jobs parallel scan
+
+
+def test_jobs_parallel_scan_matches_serial(tmp_path):
+    for i in range(8):
+        (tmp_path / f"m{i}.py").write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n"
+            "    return jax.device_get(x)\n")
+    serial = analyze_project(load_project([str(tmp_path)], jobs=1))[0]
+    threaded = analyze_project(load_project([str(tmp_path)], jobs=4),
+                               jobs=4)[0]
+    assert [(f.path, f.line, f.rule) for f in serial] \
+        == [(f.path, f.line, f.rule) for f in threaded]
+    assert len(serial) == 8
+
+
+def test_cli_jobs_flag(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("import jax\n\n@jax.jit\ndef f(x):\n"
+                 "    return jax.device_get(x)\n")
+    rc, out = _cli([str(p), "--jobs", "4"])
+    assert rc == 1 and "TPU001" in out
+    rc, _ = _cli([str(p), "--jobs", "0"])
+    assert rc == 2
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,15 @@ Rules
   registrations, unregistered handlers, unreachable registry modules).
 - **TPU006** stub-drift: ``.pyi`` stubs naming things their module no
   longer defines.
+- **TPU012** unguarded-shared-mutation: a write to an inferred-lock-guarded
+  field or module global without holding the owning lock (the guard
+  discipline is inferred from the code's own ``with self._lock:`` bodies).
+- **TPU013** lock-order-inversion: a cycle in the project-wide static
+  lock-acquisition graph, or nested re-acquisition of a non-reentrant
+  ``threading.Lock`` — the static half of the deadlock story
+  (``mmlspark_tpu.reliability.lock_sanitizer`` is the runtime half).
+- **TPU014** blocking-call-under-lock: a device sync, sleep, HTTP dial,
+  subprocess, queue wait, or thread join while holding a lock.
 
 Entry points: ``scripts/run_tpulint.py`` (CI gate, baseline-diff mode) and
 ``scripts/gen_tpulint_baseline.py`` (baseline regeneration). See
@@ -36,6 +45,7 @@ from .core import (Finding, ModuleInfo, Project, Rule, all_rules,
                    register_rule)
 from . import rules as _rules            # noqa: F401  (registers TPU001-004)
 from . import project_rules as _prules   # noqa: F401  (registers TPU005-006)
+from . import concurrency as _crules     # noqa: F401  (registers TPU012-014)
 
 __version__ = "0.1.0"
 

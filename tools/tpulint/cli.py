@@ -36,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "warning — info findings report but never gate)")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also report inline-suppressed findings (never fail)")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="parse files and run per-module rules on N threads "
+                        "(default 1; project-scope rules stay serial)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -59,9 +62,13 @@ def main(argv: Optional[List[str]] = None,
         print(f"tpulint: error: {e}", file=sys.stderr)
         return 2
 
-    project = load_project(args.paths)
+    if args.jobs < 1:
+        print("tpulint: error: --jobs must be >= 1", file=sys.stderr)
+        return 2
+    project = load_project(args.paths, jobs=args.jobs)
     findings, suppressed = analyze_project(
-        project, rules=rules, keep_suppressed=args.show_suppressed)
+        project, rules=rules, keep_suppressed=args.show_suppressed,
+        jobs=args.jobs)
 
     if args.write_baseline:
         baseline_mod.dump(findings, args.write_baseline)

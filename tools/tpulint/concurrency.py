@@ -1,0 +1,742 @@
+"""Whole-program concurrency rules: lock discipline across the threaded plane.
+
+The serving/data plane is a threaded Python program — WorkerServer request
+threads, the BatchRunner prefetch worker, the ContinuousDecoder tick thread,
+the Watchdog daemon, and process-global singletons (ResidencyManager,
+MetricsRegistry, ObservationStore, SloTracker, breaker registry) touched by
+all of them. Nothing in a conventional linter checks that this code keeps
+its own locking promises; the runtime watchdog only sees a wedged thread
+*after* it stalls. These rules see the hazard in the AST, before anything
+runs — and, in the spirit of Automap (PAPERS.md), the invariant is
+*inferred* from the code rather than hand-annotated: a class that mostly
+mutates a field under ``with self._lock:`` has declared, mechanically, that
+the field is lock-guarded; the outlier writes are the findings.
+
+Three rules share one :class:`ConcurrencyModel` built per project:
+
+- **TPU012 unguarded-shared-mutation** — a write to an inferred-guarded
+  instance field (or module global) outside the owning lock.
+- **TPU013 lock-order-inversion** — a cycle in the static lock-acquisition
+  graph built from nested ``with``-lock scopes (including one level of
+  same-class / same-module call expansion), plus nested re-acquisition of
+  a non-reentrant ``threading.Lock``.
+- **TPU014 blocking-call-under-lock** — a device sync
+  (``jax.device_get`` / ``block_until_ready``), ``time.sleep``, HTTP dial,
+  subprocess, or queue wait while a lock is held: every other thread that
+  needs the lock now waits on the device/network too. This is exactly the
+  bug class the watchdog can only report at runtime.
+
+Conventions the model understands (and the codebase follows):
+
+- ``self._lock = threading.Lock()`` / ``RLock`` / ``Condition`` in any
+  method, module-level ``_X_LOCK = threading.Lock()``, dataclass
+  ``field(default_factory=threading.Lock)``, and the sanitized factory
+  (``reliability.lock_sanitizer.new_lock/new_rlock/new_condition``).
+- Methods named ``*_locked`` are entered with the class lock held (the
+  ``_prune_locked`` / ``_step_locked`` convention): writes inside them
+  count as guarded and blocking calls inside them count as under-lock.
+- ``__init__``/``__new__`` construct the object before it is shared;
+  their writes never count against the discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo, Project, Rule, register_rule
+
+#: constructors recognized as lock objects, by dotted-name tail
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "rlock",
+}
+#: the sanitized factory (reliability/lock_sanitizer.py) — suffix-matched so
+#: ``from ..reliability.lock_sanitizer import new_lock`` and
+#: ``lock_sanitizer.new_lock`` both resolve
+_LOCK_FACTORIES = {
+    "new_lock": "lock",
+    "new_rlock": "rlock",
+    "new_condition": "condition",
+}
+
+#: mutating method names on containers — a call on a guarded field through
+#: one of these is a write event
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "setdefault",
+    "move_to_end", "sort", "reverse", "__setitem__",
+}
+
+#: calls that block on the device, the network, the disk, or the clock —
+#: held locks turn them into convoy points (TPU014)
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps",
+    "jax.device_get": "syncs the device",
+    "jax.block_until_ready": "syncs the device",
+    "jax.device_put": "stages to the device",
+    "urllib.request.urlopen": "dials HTTP",
+    "socket.create_connection": "dials a socket",
+    "subprocess.run": "waits on a subprocess",
+    "subprocess.call": "waits on a subprocess",
+    "subprocess.check_call": "waits on a subprocess",
+    "subprocess.check_output": "waits on a subprocess",
+    "subprocess.Popen": "spawns a subprocess",
+}
+#: attribute-method spellings of the same hazards
+_BLOCKING_METHODS = {
+    "block_until_ready": "syncs the device",
+    "copy_to_host": "syncs the device",
+    "urlopen": "dials HTTP",
+    "getresponse": "waits on an HTTP response",
+    "recv": "waits on a socket",
+    "accept": "waits on a socket",
+    "sendall": "writes to a socket",
+}
+#: ``q.get()`` / ``q.put()`` are queue waits only when the receiver is
+#: named like a queue (``self._queue.get`` yes, ``d.get(k)`` no)
+_QUEUE_NAME_RE = re.compile(r"(^|_)q(ueue)?\d*$", re.IGNORECASE)
+#: ``x.wait()`` blocks unless x is a condition tied to the held lock
+#: (Condition.wait releases it) — condition-ish receivers stay quiet
+_CONDITION_NAME_RE = re.compile(r"cond", re.IGNORECASE)
+#: ``x.join()`` blocks on a thread; str.join is ubiquitous, so only
+#: thread-ish receivers count
+_THREAD_NAME_RE = re.compile(r"thread|worker", re.IGNORECASE)
+
+_THREAD_TARGET_CTORS = {"threading.Thread", "Thread"}
+
+
+@dataclass(frozen=True)
+class LockId:
+    """Identity of a lock *site*: one per class attribute or module global
+    (instances share it — the granularity the discipline is written at)."""
+
+    module: str          # relpath of the defining module
+    owner: str           # class name, or "" for a module-level lock
+    name: str            # attribute / global name
+    kind: str = "lock"   # lock | rlock | condition
+
+    def __str__(self) -> str:
+        base = f"{self.owner}.{self.name}" if self.owner else self.name
+        return f"{self.module}::{base}"
+
+
+@dataclass
+class WriteEvent:
+    module: ModuleInfo
+    node: ast.AST
+    owner: str                    # class name or "" (module global)
+    target: str                   # field / global name
+    held: Tuple[LockId, ...]      # locks held at the write site
+    func: str                     # enclosing function qualname
+    assumed: bool                 # inside a *_locked method
+
+
+@dataclass
+class AcquireEvent:
+    module: ModuleInfo
+    node: ast.AST
+    lock: LockId
+    held: Tuple[LockId, ...]      # locks already held when acquiring
+    func: str
+
+
+@dataclass
+class BlockingEvent:
+    module: ModuleInfo
+    node: ast.AST
+    what: str                     # e.g. "jax.device_get"
+    why: str                      # e.g. "syncs the device"
+    held: Tuple[LockId, ...]
+    func: str
+
+
+@dataclass
+class FunctionInfo:
+    """Per-function summary used for the one-level call expansion."""
+
+    qualname: str                 # "Class.method" or "function"
+    module: str
+    acquires: Set[LockId] = field(default_factory=set)
+    #: (callee qualname as written, held locks at the call site, node)
+    calls: List[Tuple[str, Tuple[LockId, ...], ast.AST]] = \
+        field(default_factory=list)
+    #: every blocking-ish call in the body regardless of local locks, as
+    #: (what, why, node, locally-held locks) — consumed by the one-level
+    #: call expansion so ``with lock: self._spill()`` sees the device
+    #: sync inside ``_spill``
+    blocking: List[Tuple[str, str, ast.AST, Tuple[LockId, ...]]] = \
+        field(default_factory=list)
+
+
+class ConcurrencyModel:
+    """Everything the three rules need, built once per project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        #: (module relpath, class name) -> {attr name: LockId}
+        self.class_locks: Dict[Tuple[str, str], Dict[str, LockId]] = {}
+        #: module relpath -> {global name: LockId}
+        self.module_locks: Dict[str, Dict[str, LockId]] = {}
+        #: function qualnames passed to Thread(target=...)/executor.submit
+        self.thread_targets: Set[str] = set()
+        self.writes: List[WriteEvent] = []
+        self.acquires: List[AcquireEvent] = []
+        self.blocking: List[BlockingEvent] = []
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        for m in project.modules:
+            self._discover_locks(m)
+        for m in project.modules:
+            self._scan_module(m)
+        self._expand_calls()
+
+    # -- lock discovery ------------------------------------------------------
+    def _lock_kind(self, module: ModuleInfo,
+                   value: ast.AST) -> Optional[str]:
+        """The lock kind constructed by ``value``, or None."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = module.dotted(value.func) or ""
+        tail = name.split(".")[-1]
+        if name in _LOCK_CTORS:
+            return _LOCK_CTORS[name]
+        if tail in ("Lock", "RLock", "Condition") \
+                and name.split(".")[0] in ("threading", "multiprocessing"):
+            return {"Lock": "lock", "RLock": "rlock",
+                    "Condition": "condition"}[tail]
+        if tail in _LOCK_FACTORIES:
+            return _LOCK_FACTORIES[tail]
+        # dataclasses.field(default_factory=threading.Lock)
+        if tail == "field":
+            for kw in value.keywords:
+                if kw.arg == "default_factory":
+                    factory = module.dotted(kw.value) or ""
+                    if factory in _LOCK_CTORS:
+                        return _LOCK_CTORS[factory]
+        return None
+
+    def _discover_locks(self, module: ModuleInfo) -> None:
+        # module-level locks
+        globals_here: Dict[str, LockId] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = self._lock_kind(module, node.value)
+                if kind:
+                    name = node.targets[0].id
+                    globals_here[name] = LockId(module.relpath, "", name,
+                                                kind)
+        if globals_here:
+            self.module_locks[module.relpath] = globals_here
+        # class-attribute locks (``self._lock = ...`` in any method, or an
+        # annotated dataclass field with a Lock default_factory)
+        for cls in module.nodes(ast.ClassDef):
+            attrs: Dict[str, LockId] = {}
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id == "self":
+                    kind = self._lock_kind(module, node.value)
+                    if kind:
+                        attr = node.targets[0].attr
+                        attrs[attr] = LockId(module.relpath, cls.name,
+                                             attr, kind)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.value is not None:
+                    kind = self._lock_kind(module, node.value)
+                    if kind:
+                        attrs[node.target.id] = LockId(
+                            module.relpath, cls.name, node.target.id, kind)
+            if attrs:
+                self.class_locks[(module.relpath, cls.name)] = attrs
+
+    # -- per-module scan -----------------------------------------------------
+    def _scan_module(self, module: ModuleInfo) -> None:
+        # thread-entry discovery: Thread(target=f), executor.submit(f, ...)
+        for call in module.nodes(ast.Call):
+            name = module.dotted(call.func) or ""
+            target = None
+            if name in _THREAD_TARGET_CTORS or name.endswith(".Thread"):
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "submit" and call.args:
+                target = call.args[0]
+            if target is not None:
+                dotted = module.dotted(target)
+                if dotted:
+                    self.thread_targets.add(dotted.split(".")[-1])
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        self._scan_function(module, fn, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(module, node, "")
+
+    def _resolve_lock(self, module: ModuleInfo, owner: str,
+                      expr: ast.AST) -> Optional[LockId]:
+        """The LockId acquired by a ``with <expr>:`` item, if any."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and owner:
+            return self.class_locks.get(
+                (module.relpath, owner), {}).get(expr.attr)
+        if isinstance(expr, ast.Name):
+            hit = self.module_locks.get(module.relpath, {}).get(expr.id)
+            if hit is not None:
+                return hit
+            # ``from mod import _LOCK`` style cross-module locks
+            alias = module.aliases.get(expr.id, "")
+            tail = alias.split(".")[-1] if alias else expr.id
+            for locks in self.module_locks.values():
+                if tail in locks:
+                    return locks[tail]
+        return None
+
+    def _scan_function(self, module: ModuleInfo, fn, owner: str) -> None:
+        if fn.name in ("__init__", "__new__", "__del__"):
+            return   # pre-publication writes: not part of the discipline
+        qual = f"{owner}.{fn.name}" if owner else fn.name
+        info = FunctionInfo(qualname=qual, module=module.relpath)
+        self.functions[(module.relpath, qual)] = info
+        assumed = fn.name.endswith("_locked")
+        entry_held: Tuple[LockId, ...] = ()
+        if assumed and owner:
+            locks = self.class_locks.get((module.relpath, owner), {})
+            if len(locks) == 1:
+                entry_held = (next(iter(locks.values())),)
+        self._walk_scope(module, fn, owner, qual, info,
+                         list(entry_held), assumed, list(fn.body))
+
+    def _walk_scope(self, module: ModuleInfo, fn, owner: str, qual: str,
+                    info: FunctionInfo, held: List[LockId], assumed: bool,
+                    stmts: Sequence[ast.AST]) -> None:
+        for stmt in stmts:
+            self._walk_stmt(module, stmt, owner, qual, info, held, assumed)
+
+    def _walk_stmt(self, module: ModuleInfo, node: ast.AST, owner: str,
+                   qual: str, info: FunctionInfo, held: List[LockId],
+                   assumed: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def does not run at this point in the enclosing
+            # function — its body is not under these locks
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[LockId] = []
+            for item in node.items:
+                self._walk_expr(module, item.context_expr, owner, qual,
+                                info, held)
+                lock = self._resolve_lock(module, owner, item.context_expr)
+                if lock is not None:
+                    self.acquires.append(AcquireEvent(
+                        module, item.context_expr, lock, tuple(held), qual))
+                    info.acquires.add(lock)
+                    held.append(lock)
+                    acquired.append(lock)
+            self._walk_scope(module, node, owner, qual, info, held,
+                             assumed, node.body)
+            for lock in acquired:
+                held.remove(lock)
+            return
+        # expressions first (calls, writes live in child expressions)
+        self._record_writes(module, node, owner, qual, held, assumed)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._walk_expr(module, child, owner, qual, info, held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(module, child, owner, qual, info, held,
+                                assumed)
+            else:
+                # handlers, withitems of non-lock withs, etc.
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.stmt):
+                        self._walk_stmt(module, sub, owner, qual, info,
+                                        held, assumed)
+                    elif isinstance(sub, ast.expr):
+                        self._walk_expr(module, sub, owner, qual, info,
+                                        held)
+
+    def _walk_expr(self, module: ModuleInfo, node: ast.AST, owner: str,
+                   qual: str, info: FunctionInfo,
+                   held: List[LockId]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Call):
+                self._record_call(module, sub, owner, qual, info, held)
+
+    # -- events --------------------------------------------------------------
+    def _classify_blocking(self, module: ModuleInfo,
+                           call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(what, why) if this call blocks on device/network/clock/queue."""
+        name = module.dotted(call.func) or ""
+        if name in _BLOCKING_CALLS:
+            return name, _BLOCKING_CALLS[name]
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv = call.func.value
+            recv_name = ""
+            if isinstance(recv, ast.Attribute):
+                recv_name = recv.attr
+            elif isinstance(recv, ast.Name):
+                recv_name = recv.id
+            if attr in _BLOCKING_METHODS:
+                return f".{attr}()", _BLOCKING_METHODS[attr]
+            if attr in ("get", "put") \
+                    and _QUEUE_NAME_RE.search(recv_name) \
+                    and not _is_nonblocking_queue_call(call):
+                return f"{recv_name}.{attr}()", "waits on a queue"
+            if attr == "join" and _THREAD_NAME_RE.search(recv_name):
+                return f"{recv_name}.join()", "joins a thread"
+            if attr == "wait" \
+                    and not _CONDITION_NAME_RE.search(recv_name):
+                # Condition.wait releases the lock it is tied to; a bare
+                # Event.wait under someone ELSE's lock does not
+                return f"{recv_name}.wait()", "waits on an event"
+        return None
+
+    def _record_call(self, module: ModuleInfo, call: ast.Call, owner: str,
+                     qual: str, info: FunctionInfo,
+                     held: List[LockId]) -> None:
+        held_t = tuple(held)
+        # call expansion targets: self.m() and bare module functions
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self" and owner:
+            info.calls.append((f"{owner}.{call.func.attr}", held_t, call))
+        elif isinstance(call.func, ast.Name):
+            info.calls.append((call.func.id, held_t, call))
+        blk = self._classify_blocking(module, call)
+        if blk is not None:
+            info.blocking.append((blk[0], blk[1], call, held_t))
+            if held:
+                self.blocking.append(BlockingEvent(
+                    module, call, blk[0], blk[1], held_t, qual))
+
+    def _record_writes(self, module: ModuleInfo, stmt: ast.AST, owner: str,
+                       qual: str, held: List[LockId],
+                       assumed: bool) -> None:
+        held_t = tuple(held)
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _MUTATORS:
+                recv = call.func.value
+                field_name = self._field_of(recv, owner)
+                if field_name is not None:
+                    self.writes.append(WriteEvent(
+                        module, call, owner, field_name, held_t, qual,
+                        assumed))
+                g = self._global_of(module, recv)
+                if g is not None:
+                    self.writes.append(WriteEvent(
+                        module, call, "", g, held_t, qual, assumed))
+            return
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            field_name = self._field_of(base, owner)
+            if field_name is not None:
+                self.writes.append(WriteEvent(
+                    module, t, owner, field_name, held_t, qual, assumed))
+            g = self._global_of(module, base)
+            if g is not None:
+                # direct Name assignment only counts as a global write
+                # when the function declares ``global g`` — otherwise it
+                # just binds a local; subscript/attr writes always count
+                if isinstance(t, ast.Name) \
+                        and not self._declares_global(module, qual, t.id):
+                    continue
+                self.writes.append(WriteEvent(
+                    module, t, "", g, held_t, qual, assumed))
+
+    def _field_of(self, node: ast.AST, owner: str) -> Optional[str]:
+        if owner and isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _global_of(self, module: ModuleInfo,
+                   node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) \
+                and node.id in self._module_globals(module):
+            return node.id
+        return None
+
+    def _module_globals(self, module: ModuleInfo) -> Set[str]:
+        cached = getattr(module, "_conc_globals", None)
+        if cached is None:
+            cached = set()
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            cached.add(t.id)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    cached.add(node.target.id)
+            module._conc_globals = cached
+        return cached
+
+    def _declares_global(self, module: ModuleInfo, qual: str,
+                         name: str) -> bool:
+        key = (module.relpath, qual)
+        cached = getattr(module, "_conc_global_decls", None)
+        if cached is None:
+            cached = {}
+            module._conc_global_decls = cached
+        if key not in cached:
+            decls: Set[str] = set()
+            fn = self._find_function(module, qual)
+            if fn is not None:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Global):
+                        decls.update(node.names)
+            cached[key] = decls
+        return name in cached[key]
+
+    def _find_function(self, module: ModuleInfo, qual: str):
+        parts = qual.split(".")
+        body = module.tree.body
+        if len(parts) == 2:
+            for node in body:
+                if isinstance(node, ast.ClassDef) and node.name == parts[0]:
+                    body = node.body
+                    break
+            else:
+                return None
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == parts[-1]:
+                return node
+        return None
+
+    # -- one-level call expansion (TPU013/TPU014 edges through helpers) ------
+    def _expand_calls(self) -> None:
+        for info in self.functions.values():
+            for callee, held, node in info.calls:
+                if not held:
+                    continue
+                target = self.functions.get((info.module, callee))
+                if target is None:
+                    continue
+                caller_module = self.project.module(info.module)
+                chain = f"{info.qualname} -> {callee}"
+                for lock in target.acquires:
+                    if caller_module is not None:
+                        self.acquires.append(AcquireEvent(
+                            caller_module, node, lock, held, chain))
+                # the callee's blocking calls now run under the caller's
+                # locks: ``with self._lock: self._spill(...)`` convoys on
+                # the device_get inside _spill
+                callee_module = self.project.module(target.module)
+                if callee_module is None:
+                    continue
+                for what, why, blk_node, inner in target.blocking:
+                    combined = held + tuple(
+                        lk for lk in inner if lk not in held)
+                    self.blocking.append(BlockingEvent(
+                        callee_module, blk_node, what, why, combined,
+                        chain))
+
+    # -- inference -----------------------------------------------------------
+    def guarded_fields(self) -> Dict[Tuple[str, str, str], LockId]:
+        """{(module, owner, field): owning lock} for fields whose write
+        discipline says "guarded": at least two lock-held writes and at
+        least as many held as bare ones. Writes in ``*_locked`` methods
+        count toward the held side without voting for a specific lock."""
+        stats: Dict[Tuple[str, str, str], Dict] = {}
+        for w in self.writes:
+            key = (w.module.relpath, w.owner, w.target)
+            s = stats.setdefault(key, {"held": 0, "bare": 0, "locks": {}})
+            owning = self._owning_lock(w)
+            if owning is not None:
+                s["held"] += 1
+                s["locks"][owning] = s["locks"].get(owning, 0) + 1
+            elif w.assumed:
+                s["held"] += 1
+            else:
+                s["bare"] += 1
+        out: Dict[Tuple[str, str, str], LockId] = {}
+        for key, s in stats.items():
+            if s["held"] >= 2 and s["held"] >= s["bare"] and s["locks"]:
+                out[key] = max(s["locks"].items(), key=lambda kv: kv[1])[0]
+        return out
+
+    def _owning_lock(self, w: WriteEvent) -> Optional[LockId]:
+        """The innermost held lock eligible to own this write's target:
+        a same-class lock for fields, a same-module lock for globals."""
+        for lock in reversed(w.held):
+            if w.owner and lock.owner == w.owner \
+                    and lock.module == w.module.relpath:
+                return lock
+            if not w.owner and not lock.owner:
+                return lock
+        return None
+
+
+def get_model(project: Project) -> ConcurrencyModel:
+    """The per-project model, built once and shared by the three rules."""
+    model = getattr(project, "_concurrency_model", None)
+    if model is None or model.project is not project:
+        model = ConcurrencyModel(project)
+        project._concurrency_model = model
+    return model
+
+
+def _is_nonblocking_queue_call(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    # q.get(0)-style immediate timeouts stay flagged: they still park the
+    # holder for the timeout under contention
+    return False
+
+
+@register_rule
+class UnguardedSharedMutation(Rule):
+    code = "TPU012"
+    name = "unguarded-shared-mutation"
+    severity = "warning"
+    project_scope = True
+    doc = ("A write to a lock-guarded field outside the owning lock. The "
+           "guard discipline is *inferred* from the code itself: a field "
+           "mutated at least twice under ``with self._lock:`` (or a module "
+           "global under a module lock) is declared guarded, and the "
+           "outlier bare writes are reported. ``__init__`` writes and "
+           "``*_locked``-suffixed methods (entered with the lock held, "
+           "the ``_prune_locked`` convention) don't count as outliers. "
+           "Intentional lock-free paths (single-writer fields, "
+           "publish-only races) carry an inline disable with the "
+           "justification.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        guarded = model.guarded_fields()
+        findings: List[Finding] = []
+        for w in model.writes:
+            key = (w.module.relpath, w.owner, w.target)
+            lock = guarded.get(key)
+            if lock is None or w.assumed:
+                continue
+            if model._owning_lock(w) is not None:
+                continue
+            where = f"{w.owner}.{w.target}" if w.owner else w.target
+            findings.append(self.finding(
+                w.module, w.node,
+                f"'{where}' is written under {lock} elsewhere but "
+                f"mutated here (in {w.func}) without holding it — a "
+                f"racing thread sees partial state; take the lock or "
+                f"justify the lock-free path inline"))
+        return iter(findings)
+
+
+@register_rule
+class LockOrderInversion(Rule):
+    code = "TPU013"
+    name = "lock-order-inversion"
+    severity = "error"
+    project_scope = True
+    doc = ("A cycle in the static lock-acquisition graph: somewhere the "
+           "program takes lock A then B (nested ``with`` scopes, "
+           "including one level of same-class/same-module call "
+           "expansion), somewhere else B then A — two threads running "
+           "those paths concurrently deadlock. Also flags nested "
+           "re-acquisition of the same non-reentrant ``threading.Lock`` "
+           "through a self-call chain (guaranteed self-deadlock). The "
+           "runtime counterpart is reliability.lock_sanitizer, which "
+           "catches orders the static nesting cannot see.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        findings: List[Finding] = []
+        edges: Dict[Tuple[LockId, LockId], AcquireEvent] = {}
+        for ev in model.acquires:
+            for held in ev.held:
+                if held == ev.lock:
+                    if ev.lock.kind == "lock":
+                        findings.append(self.finding(
+                            ev.module, ev.node,
+                            f"{ev.lock} is acquired while already held "
+                            f"(via {ev.func}) and it is a non-reentrant "
+                            f"threading.Lock — this path self-deadlocks; "
+                            f"use an RLock or split the method into a "
+                            f"*_locked inner"))
+                    continue
+                edges.setdefault((held, ev.lock), ev)
+        reported: Set[frozenset] = set()
+        for (a, b), ev in sorted(edges.items(),
+                                 key=lambda kv: str(kv[0])):
+            back = edges.get((b, a))
+            if back is None:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            findings.append(self.finding(
+                ev.module, ev.node,
+                f"lock-order inversion: {a} -> {b} here (in {ev.func}) "
+                f"but {b} -> {a} at {back.module.relpath}:"
+                f"{getattr(back.node, 'lineno', '?')} (in {back.func}) — "
+                f"two threads interleaving these paths deadlock; pick one "
+                f"global order"))
+        return iter(findings)
+
+
+@register_rule
+class BlockingCallUnderLock(Rule):
+    code = "TPU014"
+    name = "blocking-call-under-lock"
+    severity = "warning"
+    project_scope = True
+    doc = ("A blocking call while holding a lock: jax.device_get / "
+           "block_until_ready (device sync), time.sleep, an HTTP dial, a "
+           "subprocess wait, a queue get/put, a thread join, or an "
+           "Event.wait inside a ``with <lock>:`` scope (or a ``*_locked`` "
+           "method). Every thread that needs the lock now waits on the "
+           "device or the network too — the convoy the stall watchdog "
+           "only sees at runtime. Move the slow call outside the critical "
+           "section (snapshot under lock, block outside), or justify the "
+           "hold inline (e.g. a spill that must be atomic with its LRU "
+           "bookkeeping).")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        model = get_model(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, int]] = set()
+        for ev in model.blocking:
+            loc = (ev.module.relpath, getattr(ev.node, "lineno", 0),
+                   getattr(ev.node, "col_offset", 0))
+            if loc in seen:   # direct event wins over call-expanded echo
+                continue
+            seen.add(loc)
+            locks = ", ".join(str(lk) for lk in ev.held)
+            findings.append(self.finding(
+                ev.module, ev.node,
+                f"{ev.what} {ev.why} while holding {locks} (in {ev.func}) "
+                f"— lock waiters convoy behind the slow call; snapshot "
+                f"state under the lock and block outside it"))
+        return iter(findings)

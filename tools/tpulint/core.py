@@ -114,6 +114,10 @@ class ModuleInfo:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=relpath)
+        #: node-type index built on first :meth:`nodes` call — every rule
+        #: that used to ``ast.walk`` the whole tree for one node type now
+        #: shares a single walk per module
+        self._node_index: Optional[Dict[type, List[ast.AST]]] = None
         self.aliases = _import_aliases(self.tree)
         self.suppressions = _parse_suppressions(self.lines)
         self.file_suppressions = _parse_file_suppressions(self.lines)
@@ -126,6 +130,22 @@ class ModuleInfo:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()
         return ""
+
+    def nodes(self, *types: type) -> List[ast.AST]:
+        """All nodes of the given types, from a per-module index built by
+        one full walk and reused by every rule (the shared AST cache —
+        previously each of the ~dozen rules re-walked the tree)."""
+        if self._node_index is None:
+            index: Dict[type, List[ast.AST]] = {}
+            for node in ast.walk(self.tree):
+                index.setdefault(type(node), []).append(node)
+            self._node_index = index
+        if len(types) == 1:
+            return list(self._node_index.get(types[0], ()))
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._node_index.get(t, ()))
+        return out
 
     # -- name canonicalization ---------------------------------------------
     def dotted(self, node: ast.AST) -> Optional[str]:
@@ -301,8 +321,14 @@ class Project:
         return None
 
 
-def load_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
-    """Parse every ``*.py`` under ``paths`` (files or directories)."""
+def load_project(paths: Sequence[str], root: Optional[str] = None,
+                 jobs: int = 1) -> Project:
+    """Parse every ``*.py`` under ``paths`` (files or directories).
+
+    ``jobs > 1`` reads and parses files on a thread pool — ``ast.parse``
+    holds the GIL, so the win is mostly overlapped file I/O, but the
+    results are identical and order is restored after the fan-out.
+    """
     root = os.path.abspath(root or os.getcwd())
     project = Project(root=root)
     files: List[str] = []
@@ -318,14 +344,26 @@ def load_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
                 files.extend(os.path.join(dirpath, f)
                              for f in sorted(filenames)
                              if f.endswith(".py"))
-    for path in sorted(set(files)):
+
+    def parse_one(path: str):
         relpath = os.path.relpath(path, root)
         try:
             with open(path, encoding="utf-8") as fh:
                 source = fh.read()
-            module = ModuleInfo(relpath, source)
+            return path, relpath, ModuleInfo(relpath, source), None
         except (OSError, SyntaxError, ValueError) as e:
-            project.parse_errors.append((relpath, str(e)))
+            return path, relpath, None, str(e)
+
+    ordered = sorted(set(files))
+    if jobs > 1 and len(ordered) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(parse_one, ordered))
+    else:
+        results = [parse_one(p) for p in ordered]
+    for path, relpath, module, error in results:
+        if module is None:
+            project.parse_errors.append((relpath, error))
             continue
         project.modules.append(module)
         stub = os.path.splitext(path)[0] + ".pyi"
@@ -336,25 +374,44 @@ def load_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
 
 def analyze_project(project: Project,
                     rules: Optional[Sequence[Rule]] = None,
-                    keep_suppressed: bool = False):
-    """Run the rules; returns (findings, suppressed) sorted by location."""
+                    keep_suppressed: bool = False,
+                    jobs: int = 1):
+    """Run the rules; returns (findings, suppressed) sorted by location.
+
+    ``jobs > 1`` runs the per-module rules across modules on a thread
+    pool (each module's rule set is independent); project-scope rules
+    stay serial — they see the whole project at once by design.
+    """
     rules = list(rules) if rules is not None else all_rules()
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     by_relpath = {m.relpath: m for m in project.modules}
-    for rule in rules:
-        raw: List[Finding] = []
-        if rule.project_scope:
-            raw.extend(rule.check_project(project))
+    module_rules = [r for r in rules if not r.project_scope]
+    project_rules = [r for r in rules if r.project_scope]
+
+    def check_module(module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for rule in module_rules:
+            out.extend(rule.check(module))
+        return out
+
+    raw: List[Finding] = []
+    if jobs > 1 and len(project.modules) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            for batch in pool.map(check_module, project.modules):
+                raw.extend(batch)
+    else:
+        for module in project.modules:
+            raw.extend(check_module(module))
+    for rule in project_rules:
+        raw.extend(rule.check_project(project))
+    for f in raw:
+        module = by_relpath.get(f.path)
+        if module is not None and module.is_suppressed(f):
+            suppressed.append(f)
         else:
-            for module in project.modules:
-                raw.extend(rule.check(module))
-        for f in raw:
-            module = by_relpath.get(f.path)
-            if module is not None and module.is_suppressed(f):
-                suppressed.append(f)
-            else:
-                findings.append(f)
+            findings.append(f)
     key = lambda f: (f.path, f.line, f.col, f.rule)   # noqa: E731
     findings.sort(key=key)
     suppressed.sort(key=key)
