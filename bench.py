@@ -109,6 +109,31 @@ class _PhaseTimeout(BaseException):
     swallow it and mislabel a phase deadline as a pass failure."""
 
 
+def _bench_costs(harvest=False):
+    """Cost-attribution sub-record from the process-global CostLedger:
+    per-class resource totals (device-seconds, transfer bytes, KV page
+    holds), the heavy-hitter table size and its top entry, and — on the
+    emit paths — how many rows landed in the tuning ObservationStore.
+    Refreshed on EVERY exit path, including the atomic per-phase partial
+    checkpoints, so a SIGKILLed run still reports where its device time
+    went (docs/observability.md, "Cost attribution")."""
+    try:
+        from mmlspark_tpu.observability.ledger import get_ledger
+        snap = get_ledger().snapshot()
+        out = {"classes": snap["classes"],
+               "weights": snap["weights"],
+               "top_k": snap["top_k"],
+               "heavy_hitters": len(snap["heavy_hitters"])}
+        if snap["heavy_hitters"]:
+            out["top_hitter"] = snap["heavy_hitters"][0]
+        if harvest:
+            from mmlspark_tpu.tuning.observations import harvest_costs
+            out["harvested_observations"] = harvest_costs(snap)
+        return out
+    except Exception:                   # noqa: BLE001
+        return None
+
+
 @contextlib.contextmanager
 def _phase_guard(record: dict, name: str, seconds: float, report=None):
     """Per-phase wall-clock guard: arm SIGALRM so a stuck phase raises in
@@ -129,6 +154,9 @@ def _phase_guard(record: dict, name: str, seconds: float, report=None):
                                   seconds=elapsed, error=timed_out)
         except Exception:               # noqa: BLE001
             pass
+        # keep the checkpoint's cost attribution as fresh as its phases
+        # (harvest only on the emit paths — not once per checkpoint)
+        record["costs"] = _bench_costs()
 
     if (seconds <= 0
             or threading.current_thread() is not threading.main_thread()):
@@ -679,6 +707,7 @@ def main():
             record["telemetry"] = _telemetry()
             record["residency"] = _residency()
             record["slo"] = _slo_card()
+            record["costs"] = _bench_costs(harvest=True)
         except Exception:                   # noqa: BLE001
             pass
 
@@ -782,6 +811,7 @@ def main():
         record["telemetry"] = _telemetry()
         record["residency"] = _residency()
         record["slo"] = _slo_card()
+        record["costs"] = _bench_costs(harvest=True)
         report.emit()
         return
 
@@ -1048,6 +1078,7 @@ def main():
         telemetry=_telemetry(),
         residency=_residency(),
         slo=_slo_card(),
+        costs=_bench_costs(harvest=True),
         wall_s=round(time.monotonic() - t_start, 2),
     )
     if midrun_error is not None:

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import charge as _ledger_charge
 from ..observability import counter as _counter
 from ..observability import gauge as _gauge
 from ..reliability.lock_sanitizer import new_rlock
@@ -258,6 +259,7 @@ class ResidencyManager:
             host = np.asarray(jax.device_get(chunk.dev))  # tpulint: disable=TPU014
             M_D2H.inc(1, site="spill")
             M_D2H_BYTES.inc(chunk.nbytes, site="spill")
+            _ledger_charge("d2h_bytes", chunk.nbytes)
             chunk.host = host
         chunk.dev = None
         chunk.state = "spilled"
@@ -273,6 +275,7 @@ class ResidencyManager:
                 chunk.state = "device"
                 M_H2D.inc(1, site="restage")
                 M_H2D_BYTES.inc(chunk.nbytes, site="restage")
+                _ledger_charge("h2d_bytes", chunk.nbytes)
                 self.admit(chunk)
             else:
                 self.touch(chunk)
@@ -375,6 +378,7 @@ class DeviceColumn:
         record_miss()
         M_H2D.inc(1, site="ingest")
         M_H2D_BYTES.inc(int(arr.nbytes), site="ingest")
+        _ledger_charge("h2d_bytes", int(arr.nbytes))
         chunks = [_Chunk(d, h, put) for d, h in zip(devs, hosts)]
         mgr = get_residency_manager()
         for c in chunks:
@@ -515,6 +519,7 @@ class DeviceColumn:
             nbytes = sum(int(getattr(d, "nbytes", 0)) for _, d in need)
             M_D2H.inc(1, site=site)
             M_D2H_BYTES.inc(nbytes, site=site)
+            _ledger_charge("d2h_bytes", nbytes)
             fetched = {i: np.asarray(a) for (i, _), a in zip(need, got)}
         parts = [fetched.get(i, c.host) for i, c in enumerate(self._chunks)]
         parts = [_to_host_dtype(np.asarray(p)) for p in parts]

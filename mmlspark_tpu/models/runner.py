@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from ..core.residency import is_device_array, record_hit
+from ..observability import charge as _ledger_charge
 from ..observability import counter as _metric_counter
 from ..observability import tracing as _tracing
 from ..observability import watch as _watch
@@ -286,6 +287,10 @@ class BatchRunner:
                     record_hit(len(device_fed))
                 nbytes = sum(a.nbytes for k, a in feeds_host.items()
                              if k not in device_fed)
+                # cost attribution: bill this batch's padding waste and
+                # feed bytes to the ambient trace's workload class
+                _ledger_charge("padding_waste_rows", padded - b)
+                _ledger_charge("h2d_bytes", nbytes)
                 with c.timer("h2d", nbytes):
                     # put() is placement-aware; for an already-resident feed
                     # it is a same-device no-op (or an on-chip move), never
@@ -302,6 +307,7 @@ class BatchRunner:
                     # the warm-up vocabulary missed; attribute the stall
                     # honestly
                     c.add("compile", elapsed, count=after - before)
+                    _ledger_charge("compile_seconds", elapsed)
                     M_CACHE_MISSES.inc(after - before)
                     M_STEADY_RECOMPILES.inc(after - before)
                     _tracing.add_event("cache_miss", compiles=after - before,
@@ -312,6 +318,7 @@ class BatchRunner:
                                       compiles=after - before)
                 else:
                     c.add("dispatch", elapsed)
+                    _ledger_charge("device_seconds", elapsed)
                     M_CACHE_HITS.inc()
                     _tracing.add_event("cache_hit")
                     self._note_sample(padded, b, batches=1, seconds=elapsed,
@@ -355,6 +362,11 @@ class BatchRunner:
         elapsed = time.perf_counter() - t0
         nbytes = sum(a.nbytes for outs in host for a in outs.values())
         self.counters.add("d2h", elapsed, nbytes)
+        # async dispatch settles inside device_get, so the drain wall time
+        # IS device time — ledger device_seconds reconciles with the
+        # dispatch+d2h stage counters by construction
+        _ledger_charge("device_seconds", elapsed)
+        _ledger_charge("d2h_bytes", nbytes)
         # async dispatch means compute largely settles inside device_get:
         # attribute the drain across buckets by row share so the per-bucket
         # fit sees the true device cost, not just the enqueue time

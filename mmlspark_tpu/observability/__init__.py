@@ -10,6 +10,9 @@ registry at ``GET /metrics`` and serves recorded traces at
 
 from .events import EventLog, LOGGER_NAME, get_event_log, log_event
 from .exposition import CONTENT_TYPE, render_prometheus
+from .federation import ClusterAggregator, snapshot_interval, worker_snapshot
+from .ledger import (COST_WEIGHTS, RESOURCES, CostLedger, charge, get_ledger,
+                     reset_ledger, resolve_context, set_ledger)
 from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, build_info, counter, gauge,
                        get_registry, histogram, process_uptime_seconds,
@@ -73,6 +76,17 @@ __all__ = [
     "get_tracker",
     "set_tracker",
     "reset_tracker",
+    "CostLedger",
+    "COST_WEIGHTS",
+    "RESOURCES",
+    "charge",
+    "get_ledger",
+    "set_ledger",
+    "reset_ledger",
+    "resolve_context",
+    "ClusterAggregator",
+    "worker_snapshot",
+    "snapshot_interval",
     "Watchdog",
     "watch",
     "get_watchdog",

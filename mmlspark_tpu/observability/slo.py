@@ -5,8 +5,9 @@ shed rate, error-budget burn) that feed the tuning ``ObservationStore`` so
 the ``CostModel`` optimizes against traffic-shaped load. This module is
 the measurement half: a process-global :class:`SloTracker` that every
 request funnel (``WorkerServer._observe_request``, bench phases) reports
-into, bucketed by **workload class** — the ``{transport, route, model}``
-label triple.
+into, bucketed by **workload class** — the ``{transport, route, model,
+tenant}`` label tuple (``tenant`` arrives via the optional
+``X-Mmlspark-Tenant`` request header and defaults to ``"default"``).
 
 Design constraints mirror the registry's (registry.py): pure stdlib,
 default-on (one dict lookup + a few adds per request), process-global
@@ -44,38 +45,41 @@ from .registry import DEFAULT_LATENCY_BUCKETS
 from .registry import counter as _metric_counter
 from .registry import gauge as _metric_gauge
 
-__all__ = ["SloPolicy", "SloTracker", "classify_route", "get_tracker",
-           "set_tracker", "reset_tracker"]
+__all__ = ["DEFAULT_TENANT", "SloPolicy", "SloTracker", "classify_route",
+           "get_tracker", "set_tracker", "reset_tracker"]
 
 # the serving-plane SLO mirror: the same per-class counts the scorecard
 # reports, visible to a plain /metrics scrape (docs/observability.md)
 _M_SLO_REQUESTS = _metric_counter(
     "mmlspark_slo_requests_total",
     "Requests observed by the SLO tracker, by workload class",
-    ("transport", "route", "model"))
+    ("transport", "route", "model", "tenant"))
 _M_SLO_ERRORS = _metric_counter(
     "mmlspark_slo_errors_total",
     "Observed requests that counted against the error budget (5xx)",
-    ("transport", "route", "model"))
+    ("transport", "route", "model", "tenant"))
 _M_SLO_SHED = _metric_counter(
     "mmlspark_slo_shed_total",
     "Requests shed (429) per workload class — tracked apart from errors "
     "because shedding is load policy, not failure",
-    ("transport", "route", "model"))
+    ("transport", "route", "model", "tenant"))
 _M_SLO_BURN = _metric_gauge(
     "mmlspark_slo_error_budget_burn",
     "Rolling-window error-budget burn rate per class (1.0 = burning "
     "exactly the budget; refreshed at scorecard time)",
-    ("transport", "route", "model"))
+    ("transport", "route", "model", "tenant"))
 _M_SLO_P99 = _metric_gauge(
     "mmlspark_slo_p99_seconds",
     "Rolling-window p99 latency per class (refreshed at scorecard time)",
-    ("transport", "route", "model"))
+    ("transport", "route", "model", "tenant"))
 
-#: classes beyond this cap collapse into ("other", "other", "other") —
-#: a label-cardinality bound, same motivation as Prometheus practice
+#: classes beyond this cap collapse into ("other", "other", "other",
+#: "other") — a label-cardinality bound, same motivation as Prometheus
+#: practice. The tenant dimension rides inside the same cap: a burst of
+#: novel tenant strings lands in the overflow class, not the label space.
 MAX_CLASSES = 64
-_OVERFLOW_KEY = ("other", "other", "other")
+_OVERFLOW_KEY = ("other", "other", "other", "other")
+DEFAULT_TENANT = "default"
 
 
 class SloPolicy:
@@ -155,7 +159,8 @@ class _Class:
 
 
 class SloTracker:
-    """Time-bucketed rolling SLO windows per ``{transport, route, model}``.
+    """Time-bucketed rolling SLO windows per ``{transport, route, model,
+    tenant}``.
 
     ``clock`` is injectable (monotonic seconds) so tests drive window
     rotation deterministically. All mutation is under one lock — the
@@ -177,11 +182,12 @@ class SloTracker:
         self._max_classes = int(max_classes)
         self._uppers: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
         self._lock = threading.Lock()
-        self._classes: Dict[Tuple[str, str, str], _Class] = {}
+        self._classes: Dict[Tuple[str, str, str, str], _Class] = {}
 
     # -- recording -----------------------------------------------------------
-    def _class(self, transport: str, route: str, model: str) -> _Class:
-        key = (str(transport), str(route), str(model))
+    def _class(self, transport: str, route: str, model: str,
+               tenant: str) -> _Class:
+        key = (str(transport), str(route), str(model), str(tenant))
         cls = self._classes.get(key)
         if cls is None:
             if len(self._classes) >= self._max_classes:
@@ -203,12 +209,13 @@ class SloTracker:
     def observe(self, transport: str = "api", route: str = "api",
                 model: str = "default",
                 seconds: Optional[float] = None,
-                error: bool = False) -> None:
+                error: bool = False,
+                tenant: str = DEFAULT_TENANT) -> None:
         """One answered request. ``seconds`` feeds the latency sketch when
         known; ``error=True`` charges the class's error budget (5xx —
         sheds go through :meth:`shed` instead)."""
         with self._lock:
-            cls = self._class(transport, route, model)
+            cls = self._class(transport, route, model, tenant)
             b = self._bucket(cls)
             cls.total += 1
             b.count += 1
@@ -219,19 +226,22 @@ class SloTracker:
                 i = bisect.bisect_left(self._uppers, seconds)
                 b.lat_counts[i] += 1
                 b.lat_sum += seconds
-        _M_SLO_REQUESTS.inc(transport=transport, route=route, model=model)
+        _M_SLO_REQUESTS.inc(transport=transport, route=route, model=model,
+                            tenant=tenant)
         if error:
-            _M_SLO_ERRORS.inc(transport=transport, route=route, model=model)
+            _M_SLO_ERRORS.inc(transport=transport, route=route,
+                              model=model, tenant=tenant)
 
     def shed(self, transport: str = "api", route: str = "api",
-             model: str = "default") -> None:
+             model: str = "default", tenant: str = DEFAULT_TENANT) -> None:
         """One request refused by admission control (429)."""
         with self._lock:
-            cls = self._class(transport, route, model)
+            cls = self._class(transport, route, model, tenant)
             b = self._bucket(cls)
             cls.shed_total += 1
             b.shed += 1
-        _M_SLO_SHED.inc(transport=transport, route=route, model=model)
+        _M_SLO_SHED.inc(transport=transport, route=route, model=model,
+                        tenant=tenant)
 
     # -- reading -------------------------------------------------------------
     def _window_view(self, cls: _Class) -> Tuple[int, int, int, List[int],
@@ -275,13 +285,14 @@ class SloTracker:
         return self._uppers[-1]
 
     def burn_rate(self, transport: str, route: str,
-                  model: str = "default") -> float:
+                  model: str = "default",
+                  tenant: str = DEFAULT_TENANT) -> float:
         """Window error rate over the policy's error budget: 1.0 means
         errors arrive at exactly the budgeted rate, >1 exhausts the
         budget early. 0.0 on an idle window."""
         with self._lock:
             cls = self._classes.get((str(transport), str(route),
-                                     str(model)))
+                                     str(model), str(tenant)))
             if cls is None:
                 return 0.0
             count, errors, _, _, _ = self._window_view(cls)
@@ -306,18 +317,20 @@ class SloTracker:
                       self._window_view(cls)) for key, cls in items]
         budget = 1.0 - self.policy.availability
         classes: List[Dict[str, object]] = []
-        for (transport, route, model), total, errors_total, shed_total, \
-                (count, errors, shed, lat, lat_sum) in views:
+        for (transport, route, model, tenant), total, errors_total, \
+                shed_total, (count, errors, shed, lat, lat_sum) in views:
             p50 = self._quantile(lat, 0.50)
             p99 = self._quantile(lat, 0.99)
             p999 = self._quantile(lat, 0.999)
             availability = (1.0 - errors / count) if count else None
             burn = (errors / count) / budget if count else 0.0
-            labels = dict(transport=transport, route=route, model=model)
+            labels = dict(transport=transport, route=route, model=model,
+                          tenant=tenant)
             _M_SLO_BURN.set(burn, **labels)
             _M_SLO_P99.set(p99 if p99 is not None else 0.0, **labels)
             classes.append({
                 "transport": transport, "route": route, "model": model,
+                "tenant": tenant,
                 "total": total, "errors_total": errors_total,
                 "shed_total": shed_total,
                 "window": {

@@ -64,10 +64,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..observability import (counter as _metric_counter,
+from ..observability import (charge as _ledger_charge,
+                             counter as _metric_counter,
                              gauge as _metric_gauge,
+                             get_ledger as _get_ledger,
                              histogram as _metric_histogram,
                              log_event as _log_event,
+                             resolve_context as _resolve_cost_ctx,
                              watch as _watch)
 from ..observability import tracing as _tracing
 from ..reliability import get_injector as _get_injector
@@ -105,7 +108,8 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new", "tokens", "done", "event",
                  "submitted_at", "first_token_at", "finished_at",
                  "temperature", "top_k", "top_p", "seed",
-                 "prefix_key", "prefix_len", "error")
+                 "prefix_key", "prefix_len", "error",
+                 "cost_cls", "cost_trace")
 
     def __init__(self, rid, prompt, max_new, temperature=0.0, top_k=0,
                  top_p=1.0, seed=0):
@@ -125,6 +129,9 @@ class _Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # cost-ledger workload class + trace, captured at submit time
+        # (engine-thread ticks run outside the request's trace context)
+        self.cost_cls, self.cost_trace = _resolve_cost_ctx()
 
 
 def _sample_rows(logits, temp, top_k, top_p, keys):
@@ -1420,6 +1427,7 @@ class ContinuousDecoder:
         P = req.prompt.size
         w = min(self._chunk_budget(), P - off)
         ids = self._padded_ids(req.prompt[off:off + w], self._L - off)
+        t0 = time.perf_counter()
         with _prof_span("continuous.prefill_chunk", slot=slot,
                         offset=off, tokens=w):
             w_logits, bufs = self._extend_paged(
@@ -1427,6 +1435,8 @@ class ContinuousDecoder:
                 jnp.asarray([off], jnp.int32),
                 self._kv.buffers, self._bt[slot:slot + 1])
         self._kv.buffers = bufs
+        _ledger_charge("device_seconds", time.perf_counter() - t0,
+                       cls=req.cost_cls, trace_id=req.cost_trace)
         self._kv.note_attn_tick(
             self._attn_impl,
             gather_bytes=(self._gather_bytes_extend
@@ -1460,6 +1470,7 @@ class ContinuousDecoder:
             req.event.set()
 
     def _release_locked(self, slot: int):
+        req = self._slot_req[slot]
         self._slot_req[slot] = None
         self._active = self._active.at[slot].set(False)
         self._chunking.pop(slot, None)
@@ -1470,7 +1481,9 @@ class ContinuousDecoder:
             # in-flight ticks captured it legitimately, and future ticks
             # see active=False, whose writebacks route to the trash page
             # — a freed page can never be corrupted through a stale row.
-            self._kv.free(pages)
+            self._kv.free(pages,
+                          cost_cls=None if req is None else req.cost_cls,
+                          cost_trace=None if req is None else req.cost_trace)
             self._slot_pages[slot] = None
             self._bt_host[slot, :] = 0
             self._maybe_compact()
@@ -1558,6 +1571,7 @@ class ContinuousDecoder:
             while len(self._pending) > self._depth_now():
                 self._drain_one()
             return len(live)
+        tick_t0 = time.perf_counter()
         if self._spec:
             gamma_now = (self._tuner.gamma if self._tuner is not None
                          else self._gamma)
@@ -1601,6 +1615,12 @@ class ContinuousDecoder:
                     self._params, self._tok, self._pos, self._active,
                     self._kv.buffers, self._bt, self._remaining)
             self._kv.buffers = bufs
+        # one dispatch covers every live decode slot: apportion its wall
+        # time equally across the requests that rode it
+        _get_ledger().charge_shares(
+            "device_seconds", time.perf_counter() - tick_t0,
+            [(self._slot_req[i].cost_cls, self._slot_req[i].cost_trace, 1.0)
+             for i in decode_live])
         # per-dispatch attention accounting: k paged calls rode this
         # dispatch; only the gather impl moves materialization bytes
         self._kv.note_attn_tick(
@@ -1652,9 +1672,14 @@ class ContinuousDecoder:
         toks_dev, snapshot = self._pending.pop(0)
         # the np.asarray is the decode path's only host↔device sync — the
         # exact line a wedged device parks forever, so the watchdog covers it
+        drain_t0 = time.perf_counter()
         with _M_DRAIN_SECONDS.time(), _prof_span("continuous.drain"), \
                 _watch("decoder_drain"):
             toks = np.asarray(toks_dev)
+        _get_ledger().charge_shares(
+            "device_seconds", time.perf_counter() - drain_t0,
+            [(req.cost_cls, req.cost_trace, 1.0)
+             for _, (_, req) in snapshot.items()])
         if self._spec and toks.shape[0] > 1:
             # spec blocks mark unemitted lanes -1. Both acceptance
             # counters come from THIS block so they cover the same

@@ -20,6 +20,8 @@ class _Request:
     submitted_at: float
     first_token_at: Optional[float]
     finished_at: Optional[float]
+    cost_cls: Any
+    cost_trace: Optional[str]
 
 class ContinuousDecoder:
     stats: Dict[str, int]

@@ -33,6 +33,8 @@ from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
 from ..observability import counter as _metric_counter
 from ..observability import log_event as _log_event
 from ..observability import tracing as _tracing
+from ..observability import (ClusterAggregator, snapshot_interval,
+                             worker_snapshot)
 from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
                            Deadline, DeadlineExceeded, RetryPolicy,
                            breaker_for, get_injector)
@@ -133,7 +135,9 @@ class _RegistryHandler(BaseHTTPRequestHandler):
             reg.deregister(payload["worker_id"])
             self._json(200, {"ok": True})
         elif self.path == "/heartbeat":
-            known = reg.heartbeat(payload["worker_id"])
+            known = reg.heartbeat(payload["worker_id"],
+                                  digest=payload.get("digest"),
+                                  telemetry=payload.get("telemetry"))
             self._json(200 if known else 410, {"known": known})
         else:
             self._json(404, {"error": f"no route {self.path}"})
@@ -142,6 +146,10 @@ class _RegistryHandler(BaseHTTPRequestHandler):
         reg: "DriverRegistry" = self.server.registry  # type: ignore[attr-defined]
         if self.path == "/routing":
             self._json(200, reg.routing_table())
+        elif self.path == "/workers":
+            self._json(200, reg.workers())
+        elif self.path == "/debug/cluster":
+            self._json(200, reg.cluster_view())
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -160,6 +168,9 @@ class DriverRegistry:
         self._workers: Dict[str, dict] = {}
         self._lock = new_lock("serving.distributed.DriverRegistry._lock")
         self._generation = 0
+        #: cluster-wide metrics federation: merges the counter/histogram/
+        #: SLO snapshots workers piggyback on their heartbeats
+        self.aggregator = ClusterAggregator()
         self.liveness_timeout = liveness_timeout
         self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
         # keep-alive handler threads must not block process exit
@@ -199,20 +210,47 @@ class DriverRegistry:
         with self._lock:
             self._workers.pop(worker_id, None)
             self._generation += 1
+        # federation history survives the departure (forget() keeps the
+        # accumulated totals — a dead worker's work still happened)
+        self.aggregator.forget(worker_id)
 
-    def heartbeat(self, worker_id: str) -> bool:
+    def heartbeat(self, worker_id: str, digest: Optional[dict] = None,
+                  telemetry: Optional[dict] = None) -> bool:
         with self._lock:
             info = self._workers.get(worker_id)
             if info is None:
                 return False
             info["last_seen"] = time.time()
-            return True
+            if digest is not None:
+                info["digest"] = digest
+        if telemetry is not None:
+            self.aggregator.ingest(worker_id, telemetry)
+        return True
 
     def routing_table(self) -> Dict[str, str]:
         now = time.time()
         with self._lock:
             self._prune_locked(now)
             return {w: i["address"] for w, i in self._workers.items()}
+
+    def workers(self) -> Dict[str, dict]:
+        """Per-worker health view: routing info + the latest heartbeat
+        digest (queue depth, in-flight, open breakers, stall age)."""
+        now = time.time()
+        with self._lock:
+            self._prune_locked(now)
+            return {w: {"address": i["address"],
+                        "generation": i["generation"],
+                        "last_seen_age": round(now - i["last_seen"], 3),
+                        "digest": i.get("digest")}
+                    for w, i in self._workers.items()}
+
+    def cluster_view(self) -> dict:
+        """The ``GET /debug/cluster`` payload: merged Prometheus text,
+        the cluster SLO scorecard, and per-worker health digests."""
+        return {"metrics": self.aggregator.render(),
+                "scorecard": self.aggregator.scorecard(),
+                "workers": self.workers()}
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -270,6 +308,8 @@ class DistributedWorker:
         # keep last_seen fresh — without this the registry's liveness filter
         # would silently drop every worker after liveness_timeout
         self._hb_stop = threading.Event()
+        # federation pacing: 0.0 forces telemetry on the FIRST heartbeat
+        self._last_telemetry_t = 0.0
         # re-register retries get their own, more patient budget than the
         # default client policy — losing the registry entry for good is
         # worse than a slightly tardy heartbeat tick
@@ -306,12 +346,28 @@ class DistributedWorker:
             return dict(self._peers)
 
     def heartbeat(self) -> bool:
+        """One keep-alive tick. Every heartbeat piggybacks the server's
+        health digest; a compact metrics+SLO snapshot rides along at the
+        federation interval (``MMLSPARK_TPU_FEDERATION_INTERVAL``: 0 =
+        every heartbeat, negative = disabled) — the driver merges it into
+        the cluster aggregator with counter-reset protection."""
+        payload = {"worker_id": self.worker_id,
+                   "digest": self.server.health_digest()}
+        interval = snapshot_interval()
+        now = time.monotonic()
+        send_telemetry = (interval >= 0
+                          and (interval == 0
+                               or now - self._last_telemetry_t >= interval))
+        if send_telemetry:
+            payload["telemetry"] = worker_snapshot()
         try:
-            return _http_json(self.driver_url + "/heartbeat",
-                              {"worker_id": self.worker_id},
-                              site="heartbeat").get("known", False)
+            out = _http_json(self.driver_url + "/heartbeat", payload,
+                             site="heartbeat").get("known", False)
         except Exception:
             return False
+        if send_telemetry and out:
+            self._last_telemetry_t = now
+        return out
 
     # -- engine surface ------------------------------------------------------
     def get_batch(self, max_rows: int, timeout: float = 0.1
@@ -536,6 +592,14 @@ class ServingCluster:
             if not w.server.closed:
                 return w.reply(owner_id, request_id, response)
         return False
+
+    def scorecard(self) -> dict:
+        """Cluster SLO scorecard from the driver's federation aggregator,
+        with per-worker health digests attached (the in-process twin of
+        ``GET /debug/cluster``)."""
+        card = dict(self.driver.aggregator.scorecard())
+        card["worker_health"] = self.driver.workers()
+        return card
 
     def restart_worker(self, worker_id: str,
                        reply_timeout: Optional[float] = None

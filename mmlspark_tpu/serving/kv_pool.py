@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import time
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -47,7 +48,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.residency import get_residency_manager
-from ..observability import (counter as _metric_counter,
+from ..observability import (charge as _ledger_charge,
+                             counter as _metric_counter,
                              gauge as _metric_gauge)
 
 __all__ = ["PagedKVPool", "PoolExhausted", "KVAutotuner", "prefix_hash"]
@@ -132,6 +134,9 @@ class PagedKVPool:
         self._free: List[int] = list(range(1, self.num_pages))
         heapq.heapify(self._free)
         self._refs = np.zeros(self.num_pages, np.int32)
+        # page -> monotonic time it left the free heap; feeds the cost
+        # ledger's kv_page_seconds charge when the last ref drops
+        self._alloc_t: Dict[int, float] = {}
         # phash -> (pages tuple, prefix length in tokens)
         self._prefixes: Dict[str, Tuple[Tuple[int, ...], int]] = {}
         # phash -> registration count. Two engine keys whose prefixes are
@@ -183,6 +188,9 @@ class PagedKVPool:
                 f"({self.pages_in_use}/{self.num_pages - 1} in use)")
         pages = [heapq.heappop(self._free) for _ in range(n)]
         self._refs[pages] += 1
+        now = time.monotonic()
+        for p in pages:
+            self._alloc_t[p] = now
         self.high_water = max(self.high_water, self.pages_in_use)
         M_PAGES_IN_USE.set(self.pages_in_use)
         return pages
@@ -199,10 +207,19 @@ class PagedKVPool:
                 raise ValueError(f"incref of free page {p}")
         self._refs[list(pages)] += 1
 
-    def free(self, pages: Sequence[int]) -> None:
+    def free(self, pages: Sequence[int], *, cost_cls=None,
+             cost_trace=None) -> None:
         """Drop one reference per page; refcount-0 pages return to the
         free heap. Sharing makes double-free detectable: freeing an
-        already-free page raises."""
+        already-free page raises.
+
+        Pages whose LAST reference drops here charge their whole hold
+        (pages x seconds since they left the free heap) to the cost
+        ledger as ``kv_page_seconds`` — under ``cost_cls``/``cost_trace``
+        when the caller knows the owning request (the decoder's slot
+        release does), else the ambient trace context."""
+        held = 0.0
+        now = time.monotonic()
         for p in pages:
             p = int(p)
             if p <= 0 or p >= self.num_pages or self._refs[p] <= 0:
@@ -210,7 +227,11 @@ class PagedKVPool:
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 heapq.heappush(self._free, p)
+                held += now - self._alloc_t.pop(p, now)
         M_PAGES_IN_USE.set(self.pages_in_use)
+        if held > 0.0:
+            _ledger_charge("kv_page_seconds", held, cls=cost_cls,
+                           trace_id=cost_trace)
 
     # -- prefix sharing ------------------------------------------------------
 
@@ -308,6 +329,8 @@ class PagedKVPool:
         self._prefixes = {
             h: (tuple(int(remap[p]) for p in pages), plen)
             for h, (pages, plen) in self._prefixes.items()}
+        self._alloc_t = {int(remap[p]): t
+                         for p, t in self._alloc_t.items()}
         self.stats["defrag_moves"] += moved
         M_DEFRAG_MOVES.inc(moved)
         return remap
@@ -361,6 +384,7 @@ class PagedKVPool:
         self._free = list(range(1, self.num_pages))
         heapq.heapify(self._free)
         self._refs[:] = 0
+        self._alloc_t.clear()
         self._prefixes.clear()
         self._prefix_regs.clear()
         M_PAGES_IN_USE.set(0)

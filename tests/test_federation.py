@@ -1,0 +1,352 @@
+"""Cluster-wide metrics federation: the compact heartbeat snapshot and
+its size shedding, exact histogram merging, counter-reset detection
+(merged counters never go backwards across a worker restart), departed-
+worker history retention, the cluster SLO scorecard, the driver's
+/workers + /debug/cluster routes, and the 3-worker ServingCluster
+end-to-end drill under seeded chaos with a mid-run restart_worker.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.observability import reset_all, snapshot
+from mmlspark_tpu.observability.federation import (DEFAULT_MAX_BYTES,
+                                                   FEDERATION_INTERVAL_ENV,
+                                                   FEDERATION_MAX_BYTES_ENV,
+                                                   ClusterAggregator,
+                                                   snapshot_interval,
+                                                   worker_snapshot)
+from mmlspark_tpu.observability.ledger import reset_ledger
+from mmlspark_tpu.observability.slo import get_tracker, reset_tracker
+from mmlspark_tpu.observability.watchdog import reset_watchdog
+from mmlspark_tpu.reliability import get_injector, reset_breakers
+from mmlspark_tpu.serving.distributed import ServingCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    reset_ledger()
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    reset_all()
+    get_injector().clear()
+    yield
+    reset_ledger()
+    reset_tracker()
+    reset_watchdog()
+    reset_breakers()
+    get_injector().clear()
+    reset_all()
+
+
+def _counter(name, value, **labels):
+    return {"type": "counter", "help": "h",
+            "series": [{"labels": labels, "value": value}]}
+
+
+def _hist(name, total, count, buckets, **labels):
+    return {"type": "histogram", "help": "h",
+            "series": [{"labels": labels, "sum": total, "count": count,
+                        "buckets": buckets}]}
+
+
+def _telemetry(metrics=None, slo_classes=None):
+    return {"metrics": metrics or {},
+            "slo": {"classes": slo_classes or []}}
+
+
+# ---------------------------------------------------------------------------
+# worker snapshot + knobs
+
+
+def test_worker_snapshot_carries_counters_histograms_and_slo_only():
+    from mmlspark_tpu.observability import counter, gauge
+    counter("fed_test_ctr", "h").inc(3)
+    gauge("fed_test_gauge", "h").set(7)
+    get_tracker().observe("threaded", "api", seconds=0.01)
+    snap = worker_snapshot()
+    assert snap["metrics"]["fed_test_ctr"]["type"] == "counter"
+    assert "fed_test_gauge" not in snap["metrics"]   # gauges don't merge
+    assert snap["slo"]["classes"], "SLO totals always ride along"
+    row = snap["slo"]["classes"][0]
+    assert set(row) >= {"transport", "route", "model", "tenant", "total",
+                        "errors_total", "shed_total"}
+    json.dumps(snap)
+
+
+def test_worker_snapshot_sheds_histograms_then_metrics():
+    from mmlspark_tpu.observability import counter, histogram
+    counter("fed_shed_ctr", "h").inc()
+    histogram("fed_shed_hist", "h").observe(0.5)
+    full = worker_snapshot()
+    assert "fed_shed_hist" in full["metrics"]
+    mid = worker_snapshot(max_bytes=len(json.dumps(full)) - 1)
+    assert all(m["type"] == "counter" for m in mid["metrics"].values())
+    tiny = worker_snapshot(max_bytes=1)
+    assert tiny["metrics"] == {}
+    assert tiny["slo"]["slo_classes_only"] is True
+
+
+def test_env_knobs(monkeypatch):
+    assert snapshot_interval() == 0.0
+    monkeypatch.setenv(FEDERATION_INTERVAL_ENV, "2.5")
+    assert snapshot_interval() == 2.5
+    monkeypatch.setenv(FEDERATION_INTERVAL_ENV, "-1")
+    assert snapshot_interval() == -1.0
+    monkeypatch.setenv(FEDERATION_INTERVAL_ENV, "bogus")
+    assert snapshot_interval() == 0.0
+    from mmlspark_tpu.observability import histogram
+    histogram("fed_env_hist", "h").observe(0.5)
+    monkeypatch.setenv(FEDERATION_MAX_BYTES_ENV, "1")
+    assert worker_snapshot()["metrics"] == {}
+    monkeypatch.setenv(FEDERATION_MAX_BYTES_ENV, str(DEFAULT_MAX_BYTES))
+    assert "fed_env_hist" in worker_snapshot()["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# merging
+
+
+def test_histogram_merge_is_exact():
+    agg = ClusterAggregator()
+    agg.ingest("w0", _telemetry({"lat": _hist(
+        "lat", 1.5, 3, {"0.1": 1, "1.0": 2, "+Inf": 3}, t="a")}))
+    agg.ingest("w1", _telemetry({"lat": _hist(
+        "lat", 2.5, 2, {"0.1": 0, "1.0": 1, "+Inf": 2}, t="a")}))
+    # w0 reports again with MORE data — only the delta lands
+    agg.ingest("w0", _telemetry({"lat": _hist(
+        "lat", 2.0, 4, {"0.1": 1, "1.0": 3, "+Inf": 4}, t="a")}))
+    merged = agg.merged_snapshot()["lat"]["series"][0]
+    assert merged["sum"] == pytest.approx(4.5)       # 2.0 + 2.5, exactly
+    assert merged["count"] == pytest.approx(6)       # 4 + 2
+    assert merged["buckets"] == {"0.1": 1.0, "1.0": 4.0, "+Inf": 6.0}
+    text = agg.render()
+    assert '# TYPE lat histogram' in text
+    assert 'lat_bucket{le="+Inf",t="a"} 6' in text or \
+        'lat_bucket{t="a",le="+Inf"} 6' in text
+    assert 'lat_count{t="a"} 6' in text
+
+
+def test_counter_reset_never_drives_merge_backwards():
+    agg = ClusterAggregator()
+    agg.ingest("w0", _telemetry({"req": _counter("req", 10.0)}))
+    agg.ingest("w1", _telemetry({"req": _counter("req", 4.0)}))
+    before = agg.merged_snapshot()["req"]["series"][0]["value"]
+    assert before == pytest.approx(14.0)
+    # w0 restarts: its cumulative counter starts over from 3
+    agg.ingest("w0", _telemetry({"req": _counter("req", 3.0)}))
+    after = agg.merged_snapshot()["req"]["series"][0]["value"]
+    assert after == pytest.approx(17.0)              # 10 (kept) + 3 + 4
+    assert after >= before
+    assert agg.resets == 1
+    # the fresh incarnation keeps accumulating normally
+    agg.ingest("w0", _telemetry({"req": _counter("req", 8.0)}))
+    assert agg.merged_snapshot()["req"]["series"][0]["value"] == \
+        pytest.approx(22.0)
+
+
+def test_histogram_reset_detected_via_count():
+    agg = ClusterAggregator()
+    agg.ingest("w0", _telemetry({"h": _hist("h", 5.0, 5, {"+Inf": 5})}))
+    agg.ingest("w0", _telemetry({"h": _hist("h", 1.0, 1, {"+Inf": 1})}))
+    s = agg.merged_snapshot()["h"]["series"][0]
+    assert s["count"] == pytest.approx(6)
+    assert s["sum"] == pytest.approx(6.0)
+    assert agg.resets == 1
+
+
+def test_forget_keeps_history_but_drops_live_worker():
+    agg = ClusterAggregator()
+    agg.ingest("w0", _telemetry({"req": _counter("req", 5.0)}))
+    agg.ingest("w1", _telemetry({"req": _counter("req", 2.0)}))
+    agg.forget("w0")
+    assert agg.merged_snapshot()["req"]["series"][0]["value"] == \
+        pytest.approx(7.0)                           # history not deducted
+    assert agg.scorecard()["workers"] == 1
+
+
+def test_malformed_telemetry_is_skipped_not_fatal():
+    agg = ClusterAggregator()
+    agg.ingest("w0", "garbage")
+    agg.ingest("w0", _telemetry({"bad": "not-a-dict",
+                                 "gauge": {"type": "gauge", "series": []},
+                                 "ok": _counter("ok", 1.0)}))
+    agg.ingest("w0", {"metrics": {"x": {"type": "counter", "series": [
+        {"labels": {"a": "b"}, "value": "NaN-ish"}, "not-a-dict"]}},
+        "slo": {"classes": ["junk", {"transport": "t", "total": 2,
+                                     "errors_total": 1}]}})
+    snap = agg.merged_snapshot()
+    assert snap["ok"]["series"][0]["value"] == pytest.approx(1.0)
+    assert "gauge" not in snap
+    card = agg.scorecard()
+    assert card["classes"][0]["total"] == 2
+    assert card["classes"][0]["availability"] == pytest.approx(0.5)
+
+
+def test_scorecard_merges_slo_totals_with_reset_protection():
+    agg = ClusterAggregator()
+    row = {"transport": "threaded", "route": "api", "model": "default",
+           "tenant": "acme", "total": 10, "errors_total": 2,
+           "shed_total": 1}
+    agg.ingest("w0", _telemetry(slo_classes=[row]))
+    agg.ingest("w1", _telemetry(slo_classes=[dict(row, total=4,
+                                                  errors_total=0,
+                                                  shed_total=0)]))
+    # w0 restarts and reports a smaller cumulative total
+    agg.ingest("w0", _telemetry(slo_classes=[dict(row, total=2,
+                                                  errors_total=1,
+                                                  shed_total=0)]))
+    card = agg.scorecard()
+    assert card["workers"] == 2
+    assert card["snapshots"] == 3
+    assert card["counter_resets"] >= 1
+    cls = card["classes"][0]
+    assert cls["tenant"] == "acme"
+    assert cls["total"] == 16                        # 10 + 4 + 2
+    assert cls["errors_total"] == 3
+    assert cls["availability"] == pytest.approx(13 / 16)
+
+
+def test_cluster_driver_metrics_mirror_ingest():
+    agg = ClusterAggregator()
+    agg.ingest("w0", _telemetry({"req": _counter("req", 5.0)}))
+    agg.ingest("w0", _telemetry({"req": _counter("req", 1.0)}))
+    snap = snapshot()
+    total = sum(s["value"]
+                for s in snap["mmlspark_cluster_snapshots_total"]["series"])
+    assert total == 2
+    resets = sum(s["value"] for s in
+                 snap["mmlspark_cluster_counter_resets_total"]["series"])
+    assert resets == 1
+
+
+# ---------------------------------------------------------------------------
+# 3-worker end-to-end drill
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(url, payload, headers=None, timeout=15.0):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode() or "{}")
+
+
+def test_three_worker_cluster_federation_e2e(monkeypatch):
+    """Acceptance drill: requests over a 3-worker cluster under seeded
+    enqueue faults plus one restart_worker. /debug/cluster's merged
+    requests_total must equal the sum of the per-worker reported counters
+    and never decrease; /workers carries live health digests; the
+    scorecard sees every request."""
+    from mmlspark_tpu.io.http.schema import (EntityData, HTTPResponseData,
+                                             StatusLineData)
+
+    monkeypatch.setenv(FEDERATION_INTERVAL_ENV, "0")
+    get_injector().configure("enqueue:error:every=5")
+    cluster = ServingCluster(3, reply_timeout=15.0)
+    stop = threading.Event()
+    try:
+        def engine():
+            while not stop.is_set():
+                for owner, cached in cluster.get_batch(16, timeout=0.05):
+                    resp = HTTPResponseData(
+                        entity=EntityData.from_string(
+                            json.dumps({"ok": True})),
+                        status_line=StatusLineData(status_code=200))
+                    cluster.reply(owner, cached.request_id, resp)
+
+        eng = threading.Thread(target=engine, daemon=True)
+        eng.start()
+
+        def drive(n):
+            ok = faulted = 0
+            for i in range(n):
+                w = cluster.workers[i % 3]
+                try:
+                    status, _ = _post(w.server.address, {"i": i},
+                                      headers={"X-Mmlspark-Tenant": "acme"})
+                    ok += status == 200
+                except urllib.error.HTTPError as e:
+                    assert e.code in (500, 503)
+                    faulted += 1
+            return ok, faulted
+
+        ok1, faulted1 = drive(30)
+        assert ok1 and faulted1, "chaos spec must actually bite"
+        time.sleep(0.2)          # let post-reply counter bumps land
+        for w in cluster.workers:
+            assert w.heartbeat()
+        url = cluster.driver.url
+        view1 = _get_json(url + "/debug/cluster")
+        merged1 = _merged_requests(view1["metrics"])
+        assert merged1 > 0
+
+        # kill worker-1 ungracefully, bring it back under the same id
+        cluster.restart_worker("worker-1")
+        ok2, _ = drive(30)
+        assert ok2
+        time.sleep(0.2)
+        for w in cluster.workers:
+            assert w.heartbeat()
+        view2 = _get_json(url + "/debug/cluster")
+        merged2 = _merged_requests(view2["metrics"])
+        assert merged2 >= merged1, "merged counter went backwards"
+
+        # each worker heartbeated at the same quiesced instant, so the
+        # merged value must equal the sum of the per-worker reported
+        # cumulative counters — federation loses nothing
+        reported = sum(
+            sum(s["value"] for s in
+                worker_snapshot()["metrics"]
+                ["mmlspark_serving_requests_total"]["series"])
+            for _ in cluster.workers)
+        assert merged2 == pytest.approx(reported)
+
+        # scorecard saw every accepted request under the tenant class
+        card = view2["scorecard"]
+        acme = [c for c in card["classes"] if c["tenant"] == "acme"]
+        assert acme and acme[0]["total"] >= (ok1 + ok2) * len(
+            cluster.workers)
+        assert card["workers"] == 3
+        assert card["counter_resets"] >= 0
+
+        # /workers: live health digests from the piggybacked heartbeat
+        workers = _get_json(url + "/workers")
+        assert set(workers) == {"worker-0", "worker-1", "worker-2"}
+        for info in workers.values():
+            digest = info["digest"]
+            assert set(digest) >= {"queue_depth", "in_flight",
+                                   "open_breakers", "stall_age_seconds",
+                                   "degraded"}
+            assert digest["degraded"] is False
+
+        # the in-process twin mirrors the HTTP view
+        card2 = cluster.scorecard()
+        assert card2["worker_health"].keys() == workers.keys()
+        assert card2["snapshots"] >= card["snapshots"]
+    finally:
+        stop.set()
+        get_injector().clear()
+        cluster.close()
+
+
+def _merged_requests(prom_text):
+    total = 0.0
+    hits = 0
+    for line in prom_text.splitlines():
+        if line.startswith("mmlspark_serving_requests_total{"):
+            total += float(line.rsplit(" ", 1)[1])
+            hits += 1
+    assert hits, "merged exposition lacks requests_total"
+    return total

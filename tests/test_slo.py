@@ -127,10 +127,11 @@ def test_scorecard_shape_and_quantiles():
                          "classes"}
     assert card["policy"] == {"target_p99": 0.5, "availability": 0.999}
     (cls,) = card["classes"]
-    assert set(cls) == {"transport", "route", "model", "total",
+    assert set(cls) == {"transport", "route", "model", "tenant", "total",
                         "errors_total", "shed_total", "window", "p50",
                         "p99", "p999", "availability",
                         "error_budget_burn", "p99_ok", "availability_ok"}
+    assert cls["tenant"] == "default"
     assert cls["shed_total"] == 1
     assert cls["window"]["shed"] == 1
     # sheds are load policy, not answered requests
@@ -151,9 +152,9 @@ def test_class_cardinality_bound_overflows_to_other():
     tr.observe(transport="b", route="r")
     tr.observe(transport="c", route="r")   # over the cap
     tr.observe(transport="d", route="r")   # joins the same overflow class
-    keys = {(c["transport"], c["route"], c["model"])
+    keys = {(c["transport"], c["route"], c["model"], c["tenant"])
             for c in tr.scorecard()["classes"]}
-    assert ("other", "other", "other") in keys
+    assert ("other", "other", "other", "other") in keys
     assert len(keys) == 3
     other = [c for c in tr.scorecard()["classes"]
              if c["transport"] == "other"][0]

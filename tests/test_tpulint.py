@@ -832,6 +832,82 @@ def test_tpu011_suppressible_with_justification():
 
 
 # ---------------------------------------------------------------------------
+# TPU015 unbounded-label-cardinality
+
+
+def test_tpu015_request_derived_label_fires():
+    findings, _ = run_fixture("""\
+        from ..observability import counter
+
+        M_REQS = counter("x_requests_total", "requests", labelnames=("path",))
+
+        def handle(req):
+            M_REQS.inc(path=req.url)
+        """, relpath="mmlspark_tpu/serving/handlers.py")
+    (f,) = [f for f in findings if f.rule == "TPU015"]
+    assert f.severity == "warning" and f.line == 6
+    assert "path" in f.message and "url" in f.message
+
+
+def test_tpu015_header_value_into_labels_chain_fires():
+    findings, _ = run_fixture("""\
+        def observe(metric, request):
+            metric.labels(tenant=request.headers.get("x-t")).observe(0.5)
+        """, relpath="mmlspark_tpu/io/http/sink.py")
+    assert "TPU015" in codes(findings)
+
+
+def test_tpu015_classify_route_is_sanctioned():
+    findings, _ = run_fixture("""\
+        from ..observability import classify_route, counter
+
+        M_REQS = counter("x_requests_total", "requests", labelnames=("route",))
+
+        def handle(req):
+            M_REQS.inc(route=classify_route(req.url))
+        """, relpath="mmlspark_tpu/serving/handlers.py")
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_quiet_on_bounded_values_and_non_metric_set():
+    # bounded label values (no request-derived identifier) stay quiet,
+    # and a PipelineStage-style .set(url=...) param setter is not a metric
+    findings, _ = run_fixture("""\
+        M_TICKS = object()
+
+        def tick(stage, impl, url):
+            M_TICKS.inc(1, impl=impl)
+            stage.set(url=url, timeout=30)
+        """, relpath="mmlspark_tpu/serving/engine.py")
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_quiet_inside_observability_and_outside_package():
+    src = """\
+        def expose(m_hits, req):
+            m_hits.inc(path=req.url)
+        """
+    # the observability package itself is the sanctioned home
+    findings, _ = run_fixture(
+        src, relpath="mmlspark_tpu/observability/exposition.py")
+    assert "TPU015" not in codes(findings)
+    findings, _ = run_fixture(src, relpath="scripts/report.py")
+    assert "TPU015" not in codes(findings)
+
+
+def test_tpu015_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        def record(m_debug, req):
+            # bounded in practice: the bench harness replays 3 fixed URLs
+            # tpulint: disable=TPU015
+            m_debug.inc(path=req.url)
+        """, relpath="mmlspark_tpu/serving/bench_hooks.py",
+        keep_suppressed=True)
+    assert "TPU015" not in codes(findings)
+    assert "TPU015" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 
@@ -1064,7 +1140,8 @@ def test_cli_list_rules():
     rc, out = _cli(["--list-rules"])
     assert rc == 0
     for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-                 "TPU010", "TPU011", "TPU012", "TPU013", "TPU014"):
+                 "TPU010", "TPU011", "TPU012", "TPU013", "TPU014",
+                 "TPU015"):
         assert code in out
 
 

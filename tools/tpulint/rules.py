@@ -1,5 +1,6 @@
 """Per-module rules: the jit-boundary hazards (TPU001-TPU004), the
-ad-hoc-telemetry check (TPU007), and the ad-hoc-id-minting check (TPU008).
+ad-hoc-telemetry check (TPU007), the ad-hoc-id-minting check (TPU008),
+and the observability-hygiene checks (TPU010, TPU011, TPU015).
 
 Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
 walks a module — the innermost *jit context* (entered through a
@@ -816,4 +817,110 @@ class AdhocSloWindow(Rule):
                     "by age); the SLO tracker's time-bucketed ring keeps "
                     "the same window in O(1) memory — observe into "
                     "observability.get_tracker()"))
+        return iter(findings)
+
+
+#: metric mutators whose keyword arguments are label values
+_LABEL_METHODS = {"inc", "set", "observe", "labels"}
+
+#: identifier shapes that mean "this value came off the wire": a URL or
+#: path, a header bag, a query string, or a request payload/body/entity
+_REQUEST_SOURCE_RE = re.compile(
+    r"(^|_)(url|path|headers?|query|payload|body|entity)(_|$)")
+
+
+#: receiver identifiers that look like telemetry sinks — the repo's
+#: ``M_FOO`` / ``_M_FOO`` metric-handle convention plus the obvious
+#: metric/tracker/ledger spellings (keeps ``stage.set(url=...)`` param
+#: setters and similar non-metric ``.set()`` calls out of scope)
+_METRIC_RECEIVER_RE = re.compile(
+    r"^_?m_|metric|counter|gauge|histogram|tracker|ledger")
+
+
+def _metric_receiver(value: ast.AST) -> bool:
+    """True when ``value`` (the mutator call's receiver) is plausibly a
+    metric handle: a ``.labels(...)`` chain, or an identifier matching
+    the metric-handle naming convention."""
+    if isinstance(value, ast.Call) \
+            and isinstance(value.func, ast.Attribute) \
+            and value.func.attr == "labels":
+        return True
+    ident = None
+    if isinstance(value, ast.Name):
+        ident = value.id
+    elif isinstance(value, ast.Attribute):
+        ident = value.attr
+    return ident is not None \
+        and bool(_METRIC_RECEIVER_RE.search(ident.lower()))
+
+
+def _request_source_in(module: ModuleInfo,
+                       value: ast.AST) -> Optional[str]:
+    """The first request-derived identifier feeding ``value``, skipping
+    subtrees bounded by ``classify_route(...)`` (the sanctioned
+    normalizer — its output is a small fixed route vocabulary)."""
+    stack = [value]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            dotted = module.dotted(node.func) or ""
+            if dotted.split(".")[-1] == "classify_route":
+                continue
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is not None \
+                and _REQUEST_SOURCE_RE.search(ident.lower()):
+            return ident
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+@register_rule
+class UnboundedLabelCardinality(Rule):
+    code = "TPU015"
+    name = "unbounded-label-cardinality"
+    severity = "warning"
+    doc = ("A request-derived string (URL, path, header, query, payload) "
+           "used as a metric label value outside mmlspark_tpu/"
+           "observability/. Every distinct label value mints a new "
+           "time series that lives for the life of the process: labeling "
+           "by raw request strings lets any client grow the registry "
+           "without bound (memory, /metrics payload, and downstream "
+           "Prometheus cardinality all follow). Normalize through "
+           "``observability.classify_route()`` (bounded route "
+           "vocabulary) or an explicit allow-list before labeling; "
+           "classify_route-wrapped values are recognized and stay "
+           "quiet. Scoped to metric-shaped receivers (``M_FOO`` handle "
+           "naming, ``.labels()`` chains, tracker/ledger objects) so "
+           "non-metric ``.set()`` calls don't trip it.")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mmlspark_tpu/") \
+                or rel.startswith("mmlspark_tpu/observability/"):
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _LABEL_METHODS \
+                    or not _metric_receiver(node.func.value):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                src = _request_source_in(module, kw.value)
+                if src is not None:
+                    findings.append(self.finding(
+                        module, node,
+                        f"metric label '{kw.arg}' takes the "
+                        f"request-derived value '{src}' — each distinct "
+                        f"request mints a new time series (unbounded "
+                        f"cardinality); normalize through "
+                        f"classify_route() or an explicit allow-list "
+                        f"first"))
+                    break   # one finding per call site is signal enough
         return iter(findings)
