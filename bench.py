@@ -134,6 +134,108 @@ def _bench_costs(harvest=False):
         return None
 
 
+_MULTI_MODEL_DRILL: dict = {}
+
+
+def _multi_model_drill() -> dict:
+    """Deterministic in-process drill of the multi-model traffic plane
+    (docs/guide.md, "Multi-model serving and tenant fairness"): no
+    sockets, no sleeps — measures the three headline properties directly
+    against the primitives the worker server composes."""
+    import types as _types
+
+    from mmlspark_tpu.observability import get_tracker
+    from mmlspark_tpu.serving.admission import (AdmissionQueue,
+                                                ConsistentHashRing)
+    from mmlspark_tpu.serving.registry import ModelRegistry
+
+    # (a) weighted-fair goodput shares under standing backlog: with
+    # weights 3/2/1 the first 24 DRR dequeues must split 12/8/4
+    weights = {"acme": 3.0, "beta": 2.0, "gamma": 1.0}
+    q = AdmissionQueue(weight_fn=lambda t: weights.get(t, 1.0))
+    for _ in range(12):
+        for t in weights:
+            q.put_nowait(_types.SimpleNamespace(tenant=t))
+    drained = [q.get_nowait().tenant for _ in range(24)]
+    shares = {t: round(drained.count(t) / 24, 4) for t in weights}
+
+    # (b) prefix-affinity retention across one membership change: the
+    # ring moves ~1/n of the keyspace where hash(key) % n moves ~(n-1)/n
+    ring = ConsistentHashRing()
+    ring.rebuild(["w0", "w1", "w2"])
+    keys = [f"prefix-{i:03d}" for i in range(200)]
+    before = {k: ring.route(k) for k in keys}
+    ring.rebuild(["w0", "w1", "w2", "w3"])
+    kept = sum(before[k] == ring.route(k) for k in keys)
+    hit_rate = round(kept / len(keys), 4)
+
+    # (c) canary auto-rollback: a local registry (the process-global one
+    # stays untouched) with a breaching canary window must roll back
+    reg = ModelRegistry(min_requests=5, check_every=1)
+    reg.load("bench-canary", "v1", handle=lambda df: df)
+    reg.load("bench-canary", "v2", handle=lambda df: df, canary_percent=50)
+    tracker = get_tracker()
+    for _ in range(8):
+        tracker.observe(transport="bench", route="api",
+                        model="bench-canary@v1", seconds=0.01, error=False)
+        tracker.observe(transport="bench", route="api",
+                        model="bench-canary@v2", seconds=0.01, error=True)
+    verdicts = reg.check_canaries()
+    rollbacks = sum(1 for v in verdicts if v.get("breach"))
+    states = {v.label: v.state for v in reg.versions("bench-canary")}
+    reg.reset()
+    return {"goodput_shares": shares,
+            "goodput_shares_expected": {"acme": 0.5, "beta": round(1 / 3, 4),
+                                        "gamma": round(1 / 6, 4)},
+            "ring_hit_rate_after_member_join": hit_rate,
+            "canary_rollbacks": rollbacks,
+            "canary_states_after_drill": states}
+
+
+def _bench_multi_model():
+    """Multi-model traffic-plane sub-record: the cached one-shot drill
+    above plus the live registry/WFQ/ring counters, re-read on EVERY
+    exit path (like the cost sub-record) so partial checkpoints still
+    carry the traffic plane's state."""
+    try:
+        if not _MULTI_MODEL_DRILL:
+            _MULTI_MODEL_DRILL.update(_multi_model_drill())
+        out: dict = {"drill": dict(_MULTI_MODEL_DRILL)}
+    except Exception as e:              # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        from mmlspark_tpu.observability import snapshot
+        snap = snapshot()
+
+        def _series(name):
+            return (snap.get(name) or {}).get("series") or []
+
+        def _total(name):
+            return sum(s.get("value", 0) for s in _series(name))
+
+        deq = {s["labels"].get("tenant", "?"): s["value"]
+               for s in _series("mmlspark_wfq_dequeued_total")}
+        total_deq = sum(deq.values())
+        routes = {s["labels"].get("outcome", "?"): s["value"]
+                  for s in _series("mmlspark_ring_routes_total")}
+        total_routes = sum(routes.values())
+        out["counters"] = {
+            "wfq_dequeued": total_deq,
+            "wfq_goodput_shares": (
+                {t: round(v / total_deq, 4) for t, v in sorted(deq.items())}
+                if total_deq else {}),
+            "wfq_shed": _total("mmlspark_wfq_shed_total"),
+            "canary_rollbacks": _total("mmlspark_registry_rollbacks_total"),
+            "ring_rebuilds": _total("mmlspark_ring_rebuilds_total"),
+            "ring_affine_route_rate": (
+                round(routes.get("affine", 0) / total_routes, 4)
+                if total_routes else None),
+        }
+    except Exception:                   # noqa: BLE001
+        pass
+    return out
+
+
 @contextlib.contextmanager
 def _phase_guard(record: dict, name: str, seconds: float, report=None):
     """Per-phase wall-clock guard: arm SIGALRM so a stuck phase raises in
@@ -157,6 +259,7 @@ def _phase_guard(record: dict, name: str, seconds: float, report=None):
         # keep the checkpoint's cost attribution as fresh as its phases
         # (harvest only on the emit paths — not once per checkpoint)
         record["costs"] = _bench_costs()
+        record["multi_model"] = _bench_multi_model()
 
     if (seconds <= 0
             or threading.current_thread() is not threading.main_thread()):
@@ -708,6 +811,7 @@ def main():
             record["residency"] = _residency()
             record["slo"] = _slo_card()
             record["costs"] = _bench_costs(harvest=True)
+            record["multi_model"] = _bench_multi_model()
         except Exception:                   # noqa: BLE001
             pass
 
@@ -812,6 +916,7 @@ def main():
         record["residency"] = _residency()
         record["slo"] = _slo_card()
         record["costs"] = _bench_costs(harvest=True)
+        record["multi_model"] = _bench_multi_model()
         report.emit()
         return
 
@@ -1079,6 +1184,7 @@ def main():
         residency=_residency(),
         slo=_slo_card(),
         costs=_bench_costs(harvest=True),
+        multi_model=_bench_multi_model(),
         wall_s=round(time.monotonic() - t_start, 2),
     )
     if midrun_error is not None:

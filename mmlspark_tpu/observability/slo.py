@@ -301,6 +301,26 @@ class SloTracker:
         budget = 1.0 - self.policy.availability
         return (errors / count) / budget
 
+    def model_window(self, model: str) -> Dict[str, object]:
+        """Rolling-window stats aggregated across every class whose
+        ``model`` dimension matches — the canary-governance read: the
+        model registry compares a candidate version's window (model =
+        ``name@candidate``) against its incumbent's, regardless of which
+        transports/routes/tenants the traffic arrived on."""
+        with self._lock:
+            views = [self._window_view(cls)
+                     for key, cls in self._classes.items()
+                     if key[2] == str(model)]
+        count = sum(v[0] for v in views)
+        errors = sum(v[1] for v in views)
+        lat = [0] * (len(self._uppers) + 1)
+        for v in views:
+            for i, c in enumerate(v[3]):
+                lat[i] += c
+        return {"model": str(model), "count": count, "errors": errors,
+                "error_rate": (errors / count) if count else 0.0,
+                "p99": self._quantile(lat, 0.99)}
+
     def scorecard(self) -> Dict[str, object]:
         """JSON-safe rolling scorecard over every workload class.
 

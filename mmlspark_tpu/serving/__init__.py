@@ -20,14 +20,22 @@ a served model hits the chip with large static-shape batches instead of
 row-at-a-time inference.
 """
 
+from .admission import AdmissionQueue, ConsistentHashRing, TenantOverBudget
+from .registry import (ModelRegistry, ModelVersion, Resolution,
+                       get_registry, reset_registry, set_registry)
 from .server import CachedRequest, WorkerServer
 from .source import HTTPSource, parse_request, make_reply, HTTPSink
 from .engine import ServingEngine
 from .continuous import ContinuousDecoder
 from .generation import GenerationEngine
-from .kv_pool import KVAutotuner, PagedKVPool, PoolExhausted
+from .kv_pool import (AFFINITY_HEADER, KVAutotuner, PagedKVPool,
+                      PoolExhausted, affinity_headers)
 
 __all__ = ["CachedRequest", "WorkerServer", "HTTPSource", "HTTPSink",
            "parse_request", "make_reply", "ServingEngine",
            "ContinuousDecoder", "GenerationEngine",
-           "PagedKVPool", "KVAutotuner", "PoolExhausted"]
+           "PagedKVPool", "KVAutotuner", "PoolExhausted",
+           "AFFINITY_HEADER", "affinity_headers",
+           "AdmissionQueue", "ConsistentHashRing", "TenantOverBudget",
+           "ModelRegistry", "ModelVersion", "Resolution",
+           "get_registry", "set_registry", "reset_registry"]

@@ -39,6 +39,9 @@ from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
                            Deadline, DeadlineExceeded, RetryPolicy,
                            breaker_for, get_injector)
 from ..reliability.lock_sanitizer import new_lock
+from .admission import ConsistentHashRing
+from .kv_pool import AFFINITY_HEADER
+from .registry import get_registry as _get_model_registry
 from .server import CachedRequest, Overloaded, WorkerServer
 
 __all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
@@ -282,6 +285,12 @@ class DistributedWorker:
         self.has_engine = True
         self._peers: Dict[str, str] = {}
         self._rr = 0
+        #: prefix-affine placement: requests carrying a KV-prefix key
+        #: (X-Mmlspark-Prefix) route to the worker whose pool already
+        #: holds those pages; rebuilt on every peer-table change
+        self._ring = ConsistentHashRing()
+        #: worker id → forwards currently in flight (bounded-load input)
+        self._fwd_inflight: Dict[str, int] = {}
         self._lock = new_lock("serving.distributed.DistributedWorker._lock")
         # the registered address must be PEER-routable: a 0.0.0.0 bind
         # address handed to peers would make them connect to themselves
@@ -303,6 +312,7 @@ class DistributedWorker:
         self.recovered = info["recovered"]
         self._peers = {w: a for w, a in info["peers"].items()
                        if w != worker_id}
+        self._ring.rebuild(self._peers)
         # forwarding entry: serve locally, never re-forward
         self.server.control_routes["/_forward"] = self._handle_forwarded
         # keep last_seen fresh — without this the registry's liveness filter
@@ -343,7 +353,14 @@ class DistributedWorker:
         with self._lock:
             self._peers = {w: a for w, a in table.items()
                            if w != self.worker_id}
-            return dict(self._peers)
+            peers = dict(self._peers)
+        # ring membership follows the routing table — restart_worker and
+        # deregister both end here (ServingCluster refreshes every peer),
+        # so only ~1/n of the prefix keyspace moves per membership change
+        if self._ring.rebuild(peers):
+            _log_event("ring_rebuilt", worker_id=self.worker_id,
+                       nodes=len(peers))
+        return peers
 
     def heartbeat(self) -> bool:
         """One keep-alive tick. Every heartbeat piggybacks the server's
@@ -351,6 +368,14 @@ class DistributedWorker:
         federation interval (``MMLSPARK_TPU_FEDERATION_INTERVAL``: 0 =
         every heartbeat, negative = disabled) — the driver merges it into
         the cluster aggregator with counter-reset protection."""
+        # canary governance ticks here, off the request path: one rolling
+        # window comparison per heartbeat interval (auto-rollback fires
+        # even on a worker receiving no canary traffic of its own)
+        try:
+            _get_model_registry().check_canaries()
+        except Exception as exc:
+            _log_event("canary_check_failed", worker_id=self.worker_id,
+                       error=repr(exc))
         payload = {"worker_id": self.worker_id,
                    "digest": self.server.health_digest()}
         interval = snapshot_interval()
@@ -451,12 +476,48 @@ class DistributedWorker:
         self.has_engine = True
         self.server.control_routes.pop("/", None)
 
-    def _forward_out(self, req: HTTPRequestData) -> HTTPResponseData:
+    def _note_forward(self, worker_id: str, delta: int) -> None:
         with self._lock:
-            peers = [a for w, a in sorted(self._peers.items())]
+            n = self._fwd_inflight.get(worker_id, 0) + delta
+            if n > 0:
+                self._fwd_inflight[worker_id] = n
+            else:
+                self._fwd_inflight.pop(worker_id, None)
+
+    def _forward_candidates(self, req: HTTPRequestData
+                            ) -> List[Tuple[str, str]]:
+        """Peer try-order for one forwarded request as ``(worker_id,
+        address)`` pairs. Requests carrying a KV-prefix affinity key
+        (``X-Mmlspark-Prefix``, the hex of ``PagedKVPool.prefix_hash``)
+        go ring-first: the owning worker's pool already holds their
+        shared-prefix pages, with bounded-load fallback to the next ring
+        position when the owner is saturated. Unkeyed requests keep the
+        round-robin rotation."""
+        affinity = None
+        for h in req.headers:
+            if h.name.lower() == AFFINITY_HEADER.lower():
+                affinity = h.value.strip() or None
+        with self._lock:
+            peer_map = dict(self._peers)
             start = self._rr
             self._rr += 1
-        if not peers:
+            load = dict(self._fwd_inflight)
+        if not peer_map:
+            return []
+        if affinity is not None and len(self._ring):
+            first = self._ring.route(affinity, load=load)
+            order = [w for w in self._ring.preferred(affinity)
+                     if w in peer_map]
+            if first in peer_map:
+                order = [first] + [w for w in order if w != first]
+            if order:
+                return [(w, peer_map[w]) for w in order]
+        items = sorted(peer_map.items())
+        return [items[(start + i) % len(items)] for i in range(len(items))]
+
+    def _forward_out(self, req: HTTPRequestData) -> HTTPResponseData:
+        candidates = self._forward_candidates(req)
+        if not candidates:
             return HTTPResponseData(
                 status_line=StatusLineData(status_code=503,
                                            reason_phrase="no peers"))
@@ -475,10 +536,9 @@ class DistributedWorker:
                                                "connection")}
         base_hdrs[self._FWD_HDR] = req.method
         injector = get_injector()
-        # try each peer at most once, from the round-robin cursor, skipping
-        # open circuits; 502 only once every peer has been exhausted
-        for i in range(len(peers)):
-            addr = peers[(start + i) % len(peers)]
+        # try each peer at most once, in candidate order, skipping open
+        # circuits; 502 only once every peer has been exhausted
+        for wid, addr in candidates:
             brk = breaker_for(addr)
             remaining = deadline.remaining()
             if remaining <= 0:
@@ -492,6 +552,7 @@ class DistributedWorker:
             fwd = urllib.request.Request(
                 addr + self._FWD_PREFIX + req.url, data=body,
                 headers=hop_hdrs, method="POST" if body else "GET")
+            self._note_forward(wid, +1)
             try:
                 if injector.enabled:
                     injector.fire("peer_http")
@@ -517,6 +578,8 @@ class DistributedWorker:
                 brk.record_failure()
                 _tracing.add_event("forward_failover", peer=addr,
                                    error=type(exc).__name__)
+            finally:
+                self._note_forward(wid, -1)
         return HTTPResponseData(
             status_line=StatusLineData(status_code=502,
                                        reason_phrase="no reachable peer"))

@@ -24,6 +24,7 @@ from ..observability import (counter as _metric_counter,
 from ..observability import tracing as _tracing
 from ..reliability import get_injector as _get_injector
 from ..reliability import record_retry as _record_retry
+from .registry import get_registry as _get_registry
 from .server import WorkerServer
 from .source import HTTPSink, HTTPSource, parse_request
 
@@ -56,6 +57,16 @@ class ServingEngine:
     the first request of each padding bucket never eats an XLA compile stall.
     A warm-up failure is logged, not fatal — serving starts cold rather than
     not at all.
+
+    Multi-model dispatch: ``transform_fn`` may also be a dict mapping model
+    NAME → transform. Requests carrying ``X-Mmlspark-Model`` resolve to a
+    ``name@version`` through the :class:`~.registry.ModelRegistry` at
+    ingest; each drained batch is then grouped by resolved version and each
+    group dispatched to that version's registered handle (so a canary or
+    shadow version actually exercises its own code), falling back to the
+    dict entry for the name, then to ``"default"``. Versions are registered
+    via :meth:`register_model` (which delegates to the process-global
+    registry and runs the version's warm-up before it becomes routable).
     """
 
     def __init__(self, transform_fn: Callable[[DataFrame], DataFrame],
@@ -104,6 +115,52 @@ class ServingEngine:
     @property
     def address(self) -> str:
         return self.server.address
+
+    def register_model(self, name: str, version: str,
+                       transform_fn: Callable[[DataFrame], DataFrame],
+                       warm_up: Optional[Callable[[], object]] = None,
+                       **kwargs):
+        """Register ``name@version`` with the process-global registry,
+        using ``transform_fn`` as the version's handle — the per-version
+        dispatch target for batches this engine drains. Keyword args
+        (``canary_percent``, ``shadow_percent``, ``block``, ...) pass
+        through to :meth:`~.registry.ModelRegistry.load`."""
+        return _get_registry().load(name, version, handle=transform_fn,
+                                    warm_up=warm_up, **kwargs)
+
+    def _dispatch_groups(self, parsed: DataFrame, ids):
+        """Split a drained batch by resolved model version. Returns
+        ``[(fn, sub_parsed, sub_ids), ...]``; ``fn`` is None for rows
+        naming a model nothing serves (answered 404 by the caller). The
+        single-model fast path (plain callable, no versioned rows) is a
+        single zero-copy group."""
+        labels = [self.server.model_label(r) for r in ids]
+        if not isinstance(self.transform_fn, dict) \
+                and not any(labels):
+            return [(self.transform_fn, parsed, ids)]
+        registry = _get_registry()
+        fns: Dict[int, object] = {}
+        rows: Dict[int, list] = {}
+        for i, label in enumerate(labels):
+            fn = None
+            if label:
+                handle = registry.handle_for(label)
+                if callable(handle):
+                    fn = handle
+            if fn is None:
+                name = (label or "default").partition("@")[0]
+                if isinstance(self.transform_fn, dict):
+                    fn = (self.transform_fn.get(name)
+                          or self.transform_fn.get("default"))
+                else:
+                    fn = self.transform_fn
+            key = id(fn)
+            fns[key] = fn
+            rows.setdefault(key, []).append(i)
+        return [(fns[key],
+                 parsed.take(idxs),
+                 [ids[i] for i in idxs])
+                for key, idxs in rows.items()]
 
     def start(self) -> "ServingEngine":
         if self.tuning == "auto":
@@ -157,22 +214,31 @@ class ServingEngine:
                     self.server.commit_epoch()
                     continue
                 parsed = self._stage_ingest(parsed)
-                if not self._run_batch(parsed, ids):
-                    # graceful degradation: a whole-batch failure is often
-                    # OOM-shaped (too many rows in one device batch) — retry
-                    # ONCE at half size before failing rows individually
-                    if len(ids) > 1:
-                        mid = (len(ids) + 1) // 2
-                        splits = ((range(0, mid), ids[:mid]),
-                                  (range(mid, len(ids)), ids[mid:]))
-                        for rows, half_ids in splits:
-                            _record_retry("engine_batch", 1, 0.0,
-                                          "batch_error")
-                            if not self._run_batch(parsed.take(rows),
-                                                   half_ids):
-                                self._fail_rows(half_ids)
-                    else:
-                        self._fail_rows(ids)
+                for fn, sub, sub_ids in self._dispatch_groups(parsed, ids):
+                    if fn is None:
+                        for rid in sub_ids:
+                            self.server.reply_json(
+                                rid, {"error": "unknown model"},
+                                status=404)
+                        continue
+                    if not self._run_batch(sub, sub_ids, fn):
+                        # graceful degradation: a whole-batch failure is
+                        # often OOM-shaped (too many rows in one device
+                        # batch) — retry ONCE at half size before failing
+                        # rows individually
+                        if len(sub_ids) > 1:
+                            mid = (len(sub_ids) + 1) // 2
+                            splits = ((range(0, mid), sub_ids[:mid]),
+                                      (range(mid, len(sub_ids)),
+                                       sub_ids[mid:]))
+                            for rows, half_ids in splits:
+                                _record_retry("engine_batch", 1, 0.0,
+                                              "batch_error")
+                                if not self._run_batch(sub.take(rows),
+                                                       half_ids, fn):
+                                    self._fail_rows(half_ids)
+                        else:
+                            self._fail_rows(sub_ids)
                 _M_BATCH_SECONDS.observe(time.perf_counter() - t0)
             self.server.commit_epoch()
 
@@ -196,14 +262,19 @@ class ServingEngine:
             self.server.reply_json(rid, {"error": "internal error"},
                                    status=500)
 
-    def _run_batch(self, parsed: DataFrame, ids) -> bool:
+    def _run_batch(self, parsed: DataFrame, ids,
+                   transform_fn: Optional[Callable] = None) -> bool:
         """Transform + route one (sub-)batch; False when the transform or
-        sink raised (rows unanswered — the caller decides retry vs 500)."""
+        sink raised (rows unanswered — the caller decides retry vs 500).
+        ``transform_fn`` overrides the engine default (per-version
+        dispatch)."""
         try:
             injector = _get_injector()
             if injector.enabled:
                 injector.fire("device_run")
-            out = self.transform_fn(parsed)
+            fn = transform_fn if transform_fn is not None \
+                else self.transform_fn
+            out = fn(parsed)
             self.sink.write_batch(out)
             # rows the transform dropped (filters etc.) must still be
             # answered, or their CachedRequests leak in the routing table

@@ -44,6 +44,8 @@ from ..observability import tracing as _tracing
 from ..reliability import (Deadline, get_injector as _get_injector,
                            open_breakers as _open_breakers)
 from ..reliability.lock_sanitizer import new_lock
+from .admission import AdmissionQueue, TenantOverBudget
+from .registry import get_registry as _get_model_registry
 
 __all__ = ["CachedRequest", "Overloaded", "WorkerServer"]
 
@@ -80,6 +82,14 @@ class Overloaded(RuntimeError):
     def __init__(self, retry_after: float = 1.0):
         super().__init__("serving queue full")
         self.retry_after = retry_after
+
+
+def _entity_bytes(response) -> Optional[bytes]:
+    """Reply body bytes for the shadow diff (None for streaming replies —
+    stream content is unjoinable, the diff records only arrival)."""
+    entity = getattr(response, "entity", None)
+    content = getattr(entity, "content", None)
+    return content if isinstance(content, bytes) else None
 
 
 def _trace_headers(cached: Optional["CachedRequest"]
@@ -188,13 +198,19 @@ class CachedRequest:
     deadline: Optional[Deadline] = field(default=None, repr=False)
     #: tenant from X-Mmlspark-Tenant (SLO/cost workload class dimension)
     tenant: str = "default"
+    #: resolved model version ("name@version") from X-Mmlspark-Model via
+    #: the registry; None for unversioned (single-model) requests
+    model_label: Optional[str] = None
+    #: True for a synthetic shadow mirror — never journaled, its reply is
+    #: joined/diffed by the registry instead of reaching a caller
+    shadow: bool = False
     #: monotonic enqueue timestamp — get_batch charges the ledger's
     #: queue_wait_seconds from it at dequeue
     enqueued_at: float = field(default_factory=time.monotonic, repr=False)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _response: Optional[HTTPResponseData] = field(default=None, repr=False)
 
-    _cb: Optional[object] = field(default=None, repr=False)
+    _cbs: List[object] = field(default_factory=list, repr=False)
     _cb_lock: threading.Lock = field(default_factory=threading.Lock,
                                      repr=False)
 
@@ -202,17 +218,20 @@ class CachedRequest:
         with self._cb_lock:
             self._response = response
             self._done.set()
-            cb = self._cb
-        if cb is not None:
+            cbs = list(self._cbs)
+            self._cbs.clear()
+        for cb in cbs:
             cb(response)
 
     def add_done_callback(self, cb) -> None:
         """Fire ``cb(response)`` exactly once when the reply lands — the
-        async transport's bridge out of dispatcher threads. Safe against
-        respond() racing the registration."""
+        async transport's bridge out of dispatcher threads (and the
+        shadow-traffic join). Multiple callbacks are supported; each
+        fires once, in registration order. Safe against respond() racing
+        the registration."""
         with self._cb_lock:
             if not self._done.is_set():
-                self._cb = cb
+                self._cbs.append(cb)
                 return
             response = self._response
         cb(response)
@@ -691,6 +710,8 @@ class WorkerServer:
             "/debug/slo": self._debug_slo_route,
             "/debug/costs": self._debug_costs_route,
             "/debug/profile": self._debug_profile_route,
+            "/debug/registry": self._debug_registry_route,
+            "/models": self._models_route,
         }
         #: guards the single on-demand profiler capture slot
         self._profile_lock = threading.Lock()
@@ -712,14 +733,18 @@ class WorkerServer:
             self._epoch, pending = self._journal.replay()
         # the queue must hold every rehydrated request up front (no consumer
         # exists yet) — a journal larger than max_queue must not deadlock
-        # the constructor
-        self._queue: "queue.Queue[CachedRequest]" = queue.Queue(
-            max(max_queue, len(pending)))
+        # the constructor. Tenant weights come live from the process-global
+        # model registry, so /models tenant edits apply without a restart.
+        self._queue: AdmissionQueue = AdmissionQueue(
+            max(max_queue, len(pending)),
+            weight_fn=lambda t: _get_model_registry().tenant_weight(t))
         for rid, (epoch, request) in pending.items():
             cached = CachedRequest(rid, epoch, request, replayed=True)
             self._routing[rid] = cached
             self._history.setdefault(epoch, {})[rid] = cached
-            self._queue.put_nowait(cached)
+            # unconditional append: rehydrated requests were admitted in a
+            # previous life — tenant budgets must not drop them now
+            self._queue.put(cached)
         self.host = host
         self.api_path = api_path
         try:
@@ -780,13 +805,18 @@ class WorkerServer:
         _M_REQUESTS.inc(transport=transport, method=method or "?",
                         code=str(code))
         tenant = "default"
+        model = "default"
         if trace_span is not None:
-            tenant = getattr(trace_span, "attrs", {}).get("tenant",
-                                                          "default")
+            attrs = getattr(trace_span, "attrs", {})
+            tenant = attrs.get("tenant", "default")
+            # registry-resolved requests carry "name@version" — the SLO
+            # class dimension check_canaries() compares windows over
+            model = attrs.get("model", "default")
         # same admission rule as requests_total, so the per-class SLO
         # scorecard totals reconcile against that counter exactly
         _get_tracker().observe(transport=transport,
                                route=_classify_route(path),
+                               model=model,
                                seconds=seconds, error=code >= 500,
                                tenant=tenant)
         if seconds is not None:
@@ -827,7 +857,13 @@ class WorkerServer:
                 "in_flight": self.pending_count(),
                 "open_breakers": sorted(_open_breakers()),
                 "stall_age_seconds": None if age is None else round(age, 3),
-                "degraded": bool(self._degraded_reasons())}
+                "degraded": bool(self._degraded_reasons()),
+                # federated registry/admission state: which versions this
+                # worker serves (live/canary per model) and its per-tenant
+                # backlog — GET /workers shows rollout + fairness posture
+                # cluster-wide without per-worker scrapes
+                "registry": _get_model_registry().digest(),
+                "admission": self._queue.snapshot()}
 
     def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
         import json as _json
@@ -1001,32 +1037,104 @@ class WorkerServer:
         return _resp({"started": True, "log_dir": log_dir,
                       "seconds": seconds})
 
+    def _models_route(self, request: HTTPRequestData) -> HTTPResponseData:
+        """``GET /models`` — registry snapshot; ``POST /models`` — admin
+        actions (load/promote/rollback/retire/tenant/check) as a JSON
+        body. Registered on both transports via control_routes. HTTP
+        loads are declarative (no in-process handle/warm_up — engines
+        register those directly via ``get_registry().load``)."""
+        import json as _json
+
+        def _resp(payload: object, status: int = 200) -> HTTPResponseData:
+            return HTTPResponseData(
+                entity=EntityData.from_string(_json.dumps(payload)),
+                status_line=StatusLineData(status_code=status))
+
+        registry = _get_model_registry()
+        if (request.method or "GET").upper() != "POST":
+            return _resp(registry.snapshot())
+        try:
+            req_body = (_json.loads(request.entity.string_content())
+                        if request.entity else {})
+        except ValueError:
+            return _resp({"error": "invalid JSON body"}, 400)
+        action = str(req_body.get("action", "")).lower()
+        try:
+            if action == "load":
+                mv = registry.load(
+                    req_body["name"], req_body["version"],
+                    canary_percent=float(req_body.get("canary_percent",
+                                                      0.0)),
+                    shadow_percent=float(req_body.get("shadow_percent",
+                                                      0.0)),
+                    block=bool(req_body.get("block", True)))
+                return _resp({"loaded": mv.snapshot()})
+            if action == "promote":
+                mv = registry.promote(req_body["name"], req_body["version"])
+                return _resp({"promoted": mv.snapshot()})
+            if action == "rollback":
+                mv = registry.rollback(req_body["name"],
+                                       req_body.get("version"),
+                                       reason=str(req_body.get(
+                                           "reason", "manual")))
+                return _resp({"rolled_back":
+                              mv.snapshot() if mv else None})
+            if action in ("retire", "unload"):
+                out = registry.retire(
+                    req_body["name"], req_body["version"],
+                    drain_timeout=float(req_body.get("drain_timeout",
+                                                     5.0)))
+                return _resp(out)
+            if action == "tenant":
+                registry.set_tenant(req_body["tenant"],
+                                    float(req_body["weight"]))
+                return _resp({"tenants": registry.tenants()})
+            if action == "check":
+                return _resp({"verdicts": registry.check_canaries()})
+        except KeyError as exc:
+            return _resp({"error": f"missing field: {exc}"}, 400)
+        except ValueError as exc:
+            return _resp({"error": str(exc)}, 400)
+        return _resp({"error": f"unknown action {action!r}"}, 400)
+
+    def _debug_registry_route(self, request: HTTPRequestData
+                              ) -> HTTPResponseData:
+        """``GET /debug/registry`` — full rollout state plus this
+        worker's admission (WFQ) snapshot: version states, canary
+        verdicts, shadow diffs, tenant weights and backlogs."""
+        import json as _json
+        registry = _get_model_registry()
+        payload = {"registry": registry.snapshot(),
+                   "canary_verdicts": registry.check_canaries(),
+                   "admission": self._queue.snapshot()}
+        return HTTPResponseData(
+            entity=EntityData.from_string(_json.dumps(payload)),
+            status_line=StatusLineData(status_code=200))
+
     # -- ingest -------------------------------------------------------------
-    def _shed(self) -> Overloaded:
+    def _shed(self, tenant: str = "default", reason: str = "queue_full",
+              exc: Optional[BaseException] = None) -> Overloaded:
         _M_SHED.inc()
         _get_tracker().shed(
             transport="async" if self._aio is not None else "threaded",
-            route="api")
+            route="api", tenant=tenant)
+        # load-aware Retry-After: backlog over the measured drain rate,
+        # scaled up for a tenant shed over its weighted budget; the
+        # shed_retry_after knob survives as the floor
+        retry_after = self._queue.suggest_retry_after(
+            floor=self.shed_retry_after,
+            tenant=tenant if isinstance(exc, TenantOverBudget) else None)
         _log_event("request_shed", port=self.port,
-                   queued=self._queue.qsize())
-        return Overloaded(self.shed_retry_after)
+                   queued=self._queue.qsize(), tenant=tenant,
+                   reason=reason, retry_after=retry_after)
+        return Overloaded(retry_after)
 
     def _enqueue(self, request: HTTPRequestData) -> CachedRequest:
-        # admission control FIRST: a full queue sheds before any span/
-        # journal/routing work is spent on a request we won't park
-        # (raises Overloaded → the transports answer 429 + Retry-After)
-        if self._queue.full():
-            raise self._shed()
-        injector = _get_injector()
-        if injector.enabled:
-            injector.fire("enqueue")
-        # ONE root span per logical request, minted at the single point
-        # every ingest shape funnels through — both transports AND the
-        # distributed forwarder (whose hop carries the original traceparent,
-        # so the forwarded leg continues the same trace)
-        request_id = _tracing.new_request_id()
+        # headers FIRST: the tenant decides which admission budget applies
+        # and the model header decides which registry version serves
         traceparent = deadline = None
         tenant = "default"
+        model_name = None
         for h in request.headers:
             name = h.name.lower()
             if name == "traceparent":
@@ -1038,18 +1146,47 @@ class WorkerServer:
                 # and cost ledger both collapse classes beyond MAX_CLASSES
                 # into "other", so a tenant burst cannot blow up labels
                 tenant = h.value.strip() or "default"
-        # the root span attrs double as the ledger's class-resolution
-        # source (observability/ledger.resolve_context): any charge made
-        # under this trace bills {transport, route, model, tenant}
+            elif name == "x-mmlspark-model":
+                model_name = h.value.strip() or None
+        # admission control BEFORE any span/journal/routing work is spent
+        # on a request we won't park: global full sheds everyone, tenant
+        # budget sheds the over-budget tenant first (raises Overloaded →
+        # the transports answer 429 + Retry-After)
+        try:
+            self._queue.check_admit(tenant)
+        except TenantOverBudget as exc:
+            raise self._shed(tenant, reason="tenant_budget",
+                             exc=exc) from None
+        except queue.Full as exc:
+            raise self._shed(tenant, reason="queue_full", exc=exc) from None
+        injector = _get_injector()
+        if injector.enabled:
+            injector.fire("enqueue")
+        # ONE root span per logical request, minted at the single point
+        # every ingest shape funnels through — both transports AND the
+        # distributed forwarder (whose hop carries the original traceparent,
+        # so the forwarded leg continues the same trace)
+        request_id = _tracing.new_request_id()
+        registry = _get_model_registry()
+        resolution = None
+        span_extra = {}
+        if model_name is not None:
+            # canary/shadow split happens HERE, at ingest: the resolved
+            # "name@version" rides the root span's model attr, so SLO
+            # windows and ledger classes separate candidate from incumbent
+            resolution = registry.resolve(model_name, request_id)
+            span_extra["model"] = resolution.label
         root = _tracing.start_trace(
             "server.request", traceparent=traceparent,
             request_id=request_id, method=request.method, url=request.url,
             route=_classify_route(request.url), tenant=tenant,
-            transport="async" if self._aio is not None else "threaded")
+            transport="async" if self._aio is not None else "threaded",
+            **span_extra)
         with self._lock:
-            cached = CachedRequest(request_id, self._epoch, request,
-                                   trace_span=root, deadline=deadline,
-                                   tenant=tenant)
+            cached = CachedRequest(
+                request_id, self._epoch, request, trace_span=root,
+                deadline=deadline, tenant=tenant,
+                model_label=resolution.label if resolution else None)
         # write-ahead, BEFORE the routing-table insert: a failed append
         # (disk full, journal closed mid-shutdown) must error this request
         # out cleanly instead of leaking a never-queued routing entry that
@@ -1062,7 +1199,7 @@ class WorkerServer:
             self._history.setdefault(cached.epoch, {})[cached.request_id] = cached
         try:
             self._queue.put_nowait(cached)
-        except queue.Full:
+        except queue.Full as exc:
             # lost the admission race — undo the bookkeeping above so the
             # shed request leaks no routing entry and won't rehydrate
             with self._lock:
@@ -1071,9 +1208,51 @@ class WorkerServer:
                                                         None)
             if self._journal is not None:
                 self._journal.record_reply(cached.request_id)
+            if resolution is not None:
+                registry.note_done(resolution.label)
+                if resolution.shadow is not None:
+                    registry.note_done(resolution.shadow)
             root.end(status=429)
-            raise self._shed() from None
+            reason = ("tenant_budget" if isinstance(exc, TenantOverBudget)
+                      else "queue_full")
+            raise self._shed(tenant, reason=reason, exc=exc) from None
+        if resolution is not None and resolution.shadow is not None:
+            self._mirror_shadow(cached, resolution.shadow)
         return cached
+
+    def _mirror_shadow(self, primary: CachedRequest,
+                       shadow_label: str) -> None:
+        """Mirror an admitted request to the shadow (candidate) version: a
+        synthetic CachedRequest that flows through the normal queue/engine
+        path but is never journaled and never answers a caller — both
+        replies land in the registry's shadow join, which diffs them."""
+        registry = _get_model_registry()
+        shadow_id = _tracing.new_request_id()
+        cached = CachedRequest(shadow_id, primary.epoch, primary.request,
+                               tenant=primary.tenant,
+                               model_label=shadow_label, shadow=True)
+        with self._lock:
+            self._routing[shadow_id] = cached
+            self._history.setdefault(cached.epoch, {})[shadow_id] = cached
+        try:
+            # best-effort: a full queue drops the mirror, never the primary
+            self._queue.put_nowait(cached)
+        except queue.Full:
+            with self._lock:
+                self._routing.pop(shadow_id, None)
+                self._history.get(cached.epoch, {}).pop(shadow_id, None)
+            registry.note_done(shadow_label)
+            return
+        trace_id = (primary.trace_span.trace.trace_id
+                    if primary.trace_span is not None else None)
+        registry.shadow_begin(primary.request_id, shadow_id, shadow_label,
+                              trace_id=trace_id)
+        primary.add_done_callback(
+            lambda resp: registry.shadow_result(
+                primary.request_id, _entity_bytes(resp), from_shadow=False))
+        cached.add_done_callback(
+            lambda resp: registry.shadow_result(
+                primary.request_id, _entity_bytes(resp), from_shadow=True))
 
     def wait_budget(self, cached: CachedRequest) -> float:
         """How long a transport may park this request: ``reply_timeout``,
@@ -1125,8 +1304,15 @@ class WorkerServer:
             cached = self._routing.pop(request_id, None)
             if cached is not None:
                 self._history.get(cached.epoch, {}).pop(request_id, None)
-        if cached is not None and self._journal is not None:
-            self._journal.record_reply(request_id)
+        if cached is not None:
+            if cached.model_label is not None:
+                # in-flight accounting: retire()'s drain barrier unblocks
+                # once every resolved request of a version has answered
+                _get_model_registry().note_done(cached.model_label)
+            # shadow mirrors were never journaled as requests — recording
+            # a reply for them would orphan the journal's pairing
+            if self._journal is not None and not cached.shadow:
+                self._journal.record_reply(request_id)
         return cached
 
     def trace_span(self, request_id: str):
@@ -1135,6 +1321,14 @@ class WorkerServer:
         with self._lock:
             cached = self._routing.get(request_id)
         return cached.trace_span if cached is not None else None
+
+    def model_label(self, request_id: str) -> Optional[str]:
+        """Resolved ``name@version`` of a still-parked request (None when
+        unknown or unversioned) — serving engines group a drained batch
+        by it to dispatch each row to its version's handle."""
+        with self._lock:
+            cached = self._routing.get(request_id)
+        return cached.model_label if cached is not None else None
 
     def reply(self, request_id: str, response: HTTPResponseData) -> bool:
         """Route a response to the parked connection

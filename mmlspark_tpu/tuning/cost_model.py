@@ -39,8 +39,8 @@ from ..ops.padding import bucket_size
 from .observations import ObservationStore, get_store
 
 __all__ = ["CostModel", "TuningDecision", "candidate_configs",
-           "compare_paged_attn", "measured_sweep", "probe_budget",
-           "resolve_tuning", "PROBE_BUDGET_ENV"]
+           "compare_paged_attn", "measured_sweep", "predecessor_signature",
+           "probe_budget", "resolve_tuning", "PROBE_BUDGET_ENV"]
 
 #: bounds the measured sweep: at most this many candidate configs are run
 PROBE_BUDGET_ENV = "MMLSPARK_TPU_TUNING_PROBES"
@@ -346,6 +346,39 @@ def compare_paged_attn(store: Optional[ObservationStore] = None,
     return out
 
 
+def predecessor_signature(sig: str,
+                          known: Iterable[str]) -> Optional[str]:
+    """The nearest sibling signature for a cold *versioned* model: a
+    known signature naming the same model but a different ``@version``
+    (sigs shaped ``cost:{transport}/{route}/{name@version}[@tenant]``).
+    A freshly rolled-out version seeds its tuning decision from its
+    predecessor's rows — the transfer move of "A Learned Performance
+    Model for TPUs" (arXiv:2008.01040): variants of one workload share
+    cost structure, so starting from the predecessor's fit beats
+    starting cold. Picks the candidate sharing the longest common
+    prefix with ``sig`` (ties: lexicographically greatest, i.e. the
+    newest version string). None when ``sig`` is unversioned."""
+    segment = sig.rsplit("/", 1)[-1]
+    if "@" not in segment:
+        return None
+    # everything through the model name's '@' — siblings differ past it
+    base = sig[:sig.rfind("/") + 1 + segment.index("@") + 1]
+    cands = [s for s in known if s != sig and s.startswith(base)]
+    if not cands:
+        return None
+
+    def common(a: str, b: str) -> int:
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        return n
+
+    cands.sort(key=lambda s: (common(s, sig), s))
+    return cands[-1]
+
+
 def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
                    defaults: Tuple[int, int] = (64, 2),
                    store: Optional[ObservationStore] = None,
@@ -357,14 +390,28 @@ def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
 
     Placement-matched rows are preferred; with none, every row of the
     signature trains the fit (a chip and its neighbor share cost
-    structure — better than abstaining)."""
+    structure — better than abstaining). A cold *versioned* signature
+    (``name@version``) falls back to its :func:`predecessor_signature`'s
+    rows before abstaining; such decisions carry ``source="transfer"``
+    and name the seed in ``details["seeded_from"]``."""
     store = store if store is not None else get_store()
     rows = store.rows(sig=sig, placement=placement) or store.rows(sig=sig)
+    seeded_from = None
+    if not rows:
+        pred = predecessor_signature(sig, store.signatures())
+        if pred is not None:
+            rows = (store.rows(sig=pred, placement=placement)
+                    or store.rows(sig=pred))
+            if rows:
+                seeded_from = pred
     if not rows:
         M_DECISIONS.inc(source="default")
         return None
     decision = CostModel.fit(rows).choose(histogram, defaults,
                                           compile_weight=compile_weight)
+    if seeded_from is not None:
+        decision.source = "transfer"
+        decision.details["seeded_from"] = seeded_from
     M_DECISIONS.inc(source=decision.source)
     _tracing.add_event("tuning_decision", sig=sig,
                        mini_batch_size=decision.mini_batch_size,

@@ -378,6 +378,12 @@ def harvest_scorecard(scorecard: dict,
             rows_per_sec=win.get("rps"),
             t=scorecard.get("t"))
         obs["tenant"] = tenant
+        # registry-resolved classes carry "name@version"; split so rows
+        # are queryable by version and the cost model can tell a canary's
+        # trajectory from its incumbent's
+        model = str(cls.get("model", "?"))
+        obs["model"] = model.partition("@")[0]
+        obs["model_version"] = model.partition("@")[2] or None
         obs["slo"] = {
             "p50": cls.get("p50"), "p99": cls.get("p99"),
             "p999": cls.get("p999"),
@@ -422,6 +428,9 @@ def harvest_costs(snapshot: dict,
             compile_seconds=float(res.get("compile_seconds", 0.0)),
             t=snapshot.get("t"))
         obs["tenant"] = tenant
+        model = str(cls.get("model", "?"))
+        obs["model"] = model.partition("@")[0]
+        obs["model_version"] = model.partition("@")[2] or None
         obs["cost"] = dict(res)
         obs["weighted_cost"] = cls.get("weighted_cost")
         store.record(obs)

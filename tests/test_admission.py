@@ -1,0 +1,321 @@
+"""Weighted-fair admission and prefix-affine placement primitives:
+deficit-round-robin dequeue shares, per-tenant budgets and the
+TenantOverBudget shed, drain-rate-scaled Retry-After hints, the
+queue.Queue surface contract the worker server relies on, consistent-hash
+ring stability/bounded-load/rebuild, and the server-level 429 path.
+"""
+
+import queue
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.observability import reset_all
+from mmlspark_tpu.observability.ledger import reset_ledger
+from mmlspark_tpu.observability.slo import reset_tracker
+from mmlspark_tpu.reliability import get_injector, reset_breakers
+from mmlspark_tpu.serving.admission import (AdmissionQueue,
+                                            ConsistentHashRing,
+                                            TenantOverBudget)
+from mmlspark_tpu.serving.registry import reset_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    reset_registry()
+    reset_ledger()
+    reset_tracker()
+    reset_breakers()
+    reset_all()
+    get_injector().clear()
+    yield
+    reset_registry()
+    reset_ledger()
+    reset_tracker()
+    reset_breakers()
+    get_injector().clear()
+    reset_all()
+
+
+def _item(tenant="default"):
+    return types.SimpleNamespace(tenant=tenant)
+
+
+def _weights(table):
+    return lambda t: table.get(t, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# DRR fairness
+
+
+def test_drr_shares_track_weights_exactly_under_backlog():
+    q = AdmissionQueue(weight_fn=_weights({"a": 3.0, "b": 2.0, "c": 1.0}))
+    for _ in range(12):
+        for t in ("a", "b", "c"):
+            q.put_nowait(_item(t))
+    # while every tenant stays backlogged, each DRR round serves quanta
+    # proportional to weights: 3 + 2 + 1 per round, so the first 24
+    # dequeues split exactly 12 / 8 / 4
+    drained = [q.get_nowait().tenant for _ in range(24)]
+    counts = {t: drained.count(t) for t in ("a", "b", "c")}
+    assert counts == {"a": 12, "b": 8, "c": 4}
+    for t, want in (("a", 0.5), ("b", 1 / 3), ("c", 1 / 6)):
+        assert abs(counts[t] / 24 - want) / want <= 0.15
+
+
+def test_drr_preserves_fifo_within_a_tenant():
+    q = AdmissionQueue()
+    for i in range(5):
+        it = _item("solo")
+        it.seq = i
+        q.put_nowait(it)
+    assert [q.get_nowait().seq for _ in range(5)] == list(range(5))
+
+
+def test_single_tenant_degenerates_to_plain_fifo_bound():
+    q = AdmissionQueue(maxsize=4)
+    for _ in range(4):
+        q.put_nowait(_item())
+    # a lone tenant's budget is >= maxsize: the global Full fires, never
+    # the tenant budget
+    with pytest.raises(queue.Full) as exc:
+        q.put_nowait(_item())
+    assert not isinstance(exc.value, TenantOverBudget)
+
+
+def test_idle_tenant_banks_no_deficit():
+    q = AdmissionQueue(weight_fn=_weights({"heavy": 5.0}))
+    q.put_nowait(_item("heavy"))
+    assert q.get_nowait().tenant == "heavy"
+    # tenant drained -> retired from the round order; re-arriving later it
+    # starts from zero deficit (no credit accrued while idle)
+    q.put_nowait(_item("other"))
+    q.put_nowait(_item("heavy"))
+    assert q.snapshot()["deficits"]["heavy"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# budgets + shed
+
+
+def test_tenant_over_budget_sheds_offender_before_global_full():
+    q = AdmissionQueue(maxsize=12, burst=2.0)
+    q.put_nowait(_item("b"))
+    q.put_nowait(_item("c"))
+    # three active tenants, equal weights: budget = 12 * (1/3) * 2 = 8
+    for _ in range(8):
+        q.put_nowait(_item("a"))
+    with pytest.raises(TenantOverBudget) as exc:
+        q.put_nowait(_item("a"))
+    assert exc.value.tenant == "a"
+    assert exc.value.depth == 8 and exc.value.budget == 8
+    # other tenants still admit — capacity remains for them
+    q.put_nowait(_item("b"))
+    # and TenantOverBudget IS a queue.Full, so legacy shed paths catch it
+    assert isinstance(exc.value, queue.Full)
+
+
+def test_check_admit_is_advisory_twin_of_put_nowait():
+    q = AdmissionQueue(maxsize=2)
+    q.check_admit("t")          # room: no raise
+    q.put_nowait(_item("t"))
+    q.put_nowait(_item("u"))
+    with pytest.raises(queue.Full):
+        q.check_admit("t")
+
+
+def test_put_bypasses_budgets_for_replay():
+    q = AdmissionQueue(maxsize=2)
+    for _ in range(5):
+        q.put(_item("replayed"))    # rehydration must never drop
+    assert q.qsize() == 5
+
+
+# ---------------------------------------------------------------------------
+# queue.Queue surface
+
+
+def test_queue_surface_contract():
+    q = AdmissionQueue(maxsize=3)
+    assert q.empty() and not q.full() and q.qsize() == 0
+    q.put_nowait(_item())
+    assert not q.empty() and q.qsize() == 1
+    with pytest.raises(queue.Empty):
+        AdmissionQueue().get_nowait()
+    with pytest.raises(queue.Empty):
+        AdmissionQueue().get(timeout=0.01)
+    assert q.get(timeout=0.1) is not None
+
+
+def test_get_wakes_on_concurrent_put():
+    q = AdmissionQueue()
+    got = []
+
+    def consumer():
+        got.append(q.get(timeout=5.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.put_nowait(_item("late"))
+    t.join(timeout=5.0)
+    assert got and got[0].tenant == "late"
+
+
+# ---------------------------------------------------------------------------
+# drain rate / Retry-After
+
+
+def test_retry_after_floor_when_no_drain_observed():
+    q = AdmissionQueue()
+    assert q.drain_rate() == 0.0
+    assert q.suggest_retry_after(floor=2.5) == 2.5
+
+
+def test_retry_after_scales_with_backlog_and_offender_deficit():
+    q = AdmissionQueue(maxsize=10, burst=1.0,
+                       weight_fn=_weights({"hog": 1.0, "meek": 1.0}))
+    for _ in range(8):
+        q.put(_item("hog"))
+    q.put(_item("meek"))
+    # two dequeues ~20ms apart -> drain rate ~50/s, backlog 7
+    q.get_nowait()
+    time.sleep(0.02)
+    q.get_nowait()
+    assert q.drain_rate() > 0
+    base = q.suggest_retry_after(floor=0.001)
+    assert 0.001 <= base <= AdmissionQueue.MAX_RETRY_AFTER
+    # the over-budget tenant's hint is scaled up by depth/budget
+    hog = q.suggest_retry_after(floor=0.001, tenant="hog")
+    assert hog >= base
+    # and the floor always wins from below
+    assert q.suggest_retry_after(floor=29.0) >= 29.0
+    assert q.suggest_retry_after(floor=60.0) == \
+        AdmissionQueue.MAX_RETRY_AFTER
+
+
+def test_snapshot_is_json_safe_and_live():
+    import json
+    q = AdmissionQueue(maxsize=7)
+    q.put_nowait(_item("x"))
+    snap = q.snapshot()
+    json.dumps(snap)
+    assert snap["size"] == 1 and snap["maxsize"] == 7
+    assert snap["tenants"] == {"x": 1}
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+
+
+def test_ring_rebuild_reports_membership_change():
+    ring = ConsistentHashRing()
+    assert ring.rebuild(["w0", "w1"]) is True
+    assert ring.rebuild(["w1", "w0"]) is False     # same set, any order
+    assert ring.rebuild(["w0", "w1", "w2"]) is True
+    assert len(ring) == 3
+    assert ring.nodes() == ("w0", "w1", "w2")
+
+
+def test_ring_route_is_deterministic_and_total():
+    ring = ConsistentHashRing()
+    ring.rebuild(["w0", "w1", "w2"])
+    keys = [f"prefix-{i}" for i in range(64)]
+    owners = {k: ring.route(k) for k in keys}
+    assert set(owners.values()) <= {"w0", "w1", "w2"}
+    assert {k: ring.route(k) for k in keys} == owners
+    # virtual nodes spread keys across every member
+    assert len(set(owners.values())) == 3
+
+
+def test_ring_membership_change_moves_only_a_fraction():
+    ring = ConsistentHashRing()
+    ring.rebuild(["w0", "w1", "w2"])
+    keys = [f"prefix-{i}" for i in range(200)]
+    before = {k: ring.route(k) for k in keys}
+    ring.rebuild(["w0", "w1", "w2", "w3"])
+    after = {k: ring.route(k) for k in keys}
+    moved = sum(before[k] != after[k] for k in keys)
+    # expected ~1/4 of the keyspace; hash(key) % n would move ~3/4
+    assert moved / len(keys) < 0.5
+    # every moved key landed on some node, none vanished
+    assert set(after.values()) <= {"w0", "w1", "w2", "w3"}
+
+
+def test_ring_bounded_load_walks_past_overloaded_owner():
+    ring = ConsistentHashRing(load_factor=1.25)
+    ring.rebuild(["w0", "w1", "w2"])
+    key = "hot-prefix"
+    owner = ring.route(key)
+    order = ring.preferred(key)
+    assert order[0] == owner and len(order) == 3
+    # owner saturated, others idle: bounded load falls back to the next
+    # ring position, keeping fallback deterministic too
+    load = {owner: 100.0}
+    assert ring.route(key, load=load) == order[1]
+    # all uniformly overloaded: the affinity owner is still best (its
+    # pool holds the prefix pages)
+    flat = {n: 100.0 for n in order}
+    assert ring.route(key, load=flat) == owner
+
+
+def test_ring_empty_and_single_node():
+    ring = ConsistentHashRing()
+    assert ring.route("k") is None
+    assert ring.preferred("k") == []
+    ring.rebuild(["only"])
+    assert ring.route("k") == "only"
+    assert ring.preferred("k", n=5) == ["only"]
+
+
+# ---------------------------------------------------------------------------
+# server-level 429 (both transports carry the load-aware Retry-After)
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_server_429_carries_retry_after_at_least_floor(transport):
+    from mmlspark_tpu.serving.server import WorkerServer
+    server = WorkerServer(max_queue=1, shed_retry_after=2.0,
+                          transport=transport)
+    try:
+        req = urllib.request.Request(
+            server.address, data=b"{}",
+            headers={"Content-Type": "application/json"})
+
+        parked = {}
+
+        def park():
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as r:
+                    parked["status"] = r.status
+            except urllib.error.HTTPError as e:
+                parked["status"] = e.code
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while server._queue.qsize() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server._queue.qsize() == 1
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10.0)
+        assert exc.value.code == 429
+        retry_after = float(exc.value.headers["Retry-After"])
+        # no drain observed yet -> the static knob is the floor
+        assert retry_after >= 2.0
+        cached = server.get_batch(1, timeout=1.0)[0]
+        from mmlspark_tpu.io.http.schema import (EntityData,
+                                                 HTTPResponseData,
+                                                 StatusLineData)
+        server.reply(cached.request_id, HTTPResponseData(
+            entity=EntityData.from_string("{}"),
+            status_line=StatusLineData(status_code=200)))
+        t.join(timeout=5.0)
+        assert parked.get("status") == 200
+    finally:
+        server.close()

@@ -908,6 +908,74 @@ def test_tpu015_suppressible_with_justification():
 
 
 # ---------------------------------------------------------------------------
+# TPU016 adhoc-hash-routing
+
+
+def test_tpu016_hash_modulo_peers_fires():
+    findings, _ = run_fixture("""\
+        def pick(self, key):
+            return self._peers[hash(key) % len(self._peers)]
+        """, relpath="mmlspark_tpu/serving/router.py")
+    (f,) = [f for f in findings if f.rule == "TPU016"]
+    assert f.severity == "warning"
+    assert "ConsistentHashRing" in f.message
+
+
+def test_tpu016_hexdigest_modulo_workers_fires():
+    findings, _ = run_fixture("""\
+        import hashlib
+
+        def owner(key, workers):
+            return workers[
+                int(hashlib.sha1(key.encode()).hexdigest(), 16)
+                % len(workers)]
+        """, relpath="mmlspark_tpu/serving/placement.py")
+    assert codes(findings).count("TPU016") == 1
+
+
+def test_tpu016_quiet_for_round_robin_and_non_peer_pools():
+    findings, _ = run_fixture("""\
+        def next_peer(self):
+            # rotation is not placement: no key is being mapped
+            self._rr += 1
+            return self._peers[self._rr % len(self._peers)]
+
+        def bucket(self, key):
+            # hash modulo a NON-peer collection (histogram buckets)
+            return self.buckets[hash(key) % len(self.buckets)]
+        """, relpath="mmlspark_tpu/serving/scheduler.py")
+    assert "TPU016" not in codes(findings)
+
+
+def test_tpu016_quiet_in_sanctioned_modules_and_outside_package():
+    src = """\
+        def _point(self, key):
+            return hash(key) % len(self._members)
+        """
+    # admission.py owns ConsistentHashRing — its internals are exempt
+    findings, _ = run_fixture(
+        src, relpath="mmlspark_tpu/serving/admission.py")
+    assert "TPU016" not in codes(findings)
+    findings, _ = run_fixture(
+        src, relpath="mmlspark_tpu/serving/registry.py")
+    assert "TPU016" not in codes(findings)
+    findings, _ = run_fixture(src, relpath="tools/somewhere.py")
+    assert "TPU016" not in codes(findings)
+
+
+def test_tpu016_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        def shard(self, key, nodes):
+            # test-only deterministic placement for the fixture cluster
+            # tpulint: disable=TPU016
+            return nodes[hash(key) % len(nodes)]
+        """, relpath="mmlspark_tpu/serving/testkit.py",
+        keep_suppressed=True)
+    assert "TPU016" not in codes(findings)
+    assert "TPU016" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

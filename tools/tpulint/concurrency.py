@@ -378,8 +378,24 @@ class ConcurrencyModel:
                 self._record_call(module, sub, owner, qual, info, held)
 
     # -- events --------------------------------------------------------------
-    def _classify_blocking(self, module: ModuleInfo,
-                           call: ast.Call) -> Optional[Tuple[str, str]]:
+    def _is_declared_condition(self, module: ModuleInfo, owner: str,
+                               recv: ast.AST) -> bool:
+        """True when ``recv`` resolves to a field/global this model saw
+        constructed as a ``threading.Condition`` — its ``.wait()``
+        releases the tied lock regardless of how the field is named."""
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and owner:
+            lock = self.class_locks.get(
+                (module.relpath, owner), {}).get(recv.attr)
+        elif isinstance(recv, ast.Name):
+            lock = self.module_locks.get(module.relpath, {}).get(recv.id)
+        else:
+            return False
+        return lock is not None and lock.kind == "condition"
+
+    def _classify_blocking(self, module: ModuleInfo, call: ast.Call,
+                           owner: str = "") -> Optional[Tuple[str, str]]:
         """(what, why) if this call blocks on device/network/clock/queue."""
         name = module.dotted(call.func) or ""
         if name in _BLOCKING_CALLS:
@@ -401,9 +417,13 @@ class ConcurrencyModel:
             if attr == "join" and _THREAD_NAME_RE.search(recv_name):
                 return f"{recv_name}.join()", "joins a thread"
             if attr == "wait" \
-                    and not _CONDITION_NAME_RE.search(recv_name):
-                # Condition.wait releases the lock it is tied to; a bare
-                # Event.wait under someone ELSE's lock does not
+                    and not _CONDITION_NAME_RE.search(recv_name) \
+                    and not self._is_declared_condition(module, owner,
+                                                        recv):
+                # Condition.wait releases the lock it is tied to
+                # (recognized by cond-ish naming OR a seen
+                # threading.Condition construction); a bare Event.wait
+                # under someone ELSE's lock does not
                 return f"{recv_name}.wait()", "waits on an event"
         return None
 
@@ -418,7 +438,7 @@ class ConcurrencyModel:
             info.calls.append((f"{owner}.{call.func.attr}", held_t, call))
         elif isinstance(call.func, ast.Name):
             info.calls.append((call.func.id, held_t, call))
-        blk = self._classify_blocking(module, call)
+        blk = self._classify_blocking(module, call, owner)
         if blk is not None:
             info.blocking.append((blk[0], blk[1], call, held_t))
             if held:

@@ -1,6 +1,7 @@
 """Per-module rules: the jit-boundary hazards (TPU001-TPU004), the
 ad-hoc-telemetry check (TPU007), the ad-hoc-id-minting check (TPU008),
-and the observability-hygiene checks (TPU010, TPU011, TPU015).
+the observability-hygiene checks (TPU010, TPU011, TPU015), and the
+ad-hoc-hash-routing check (TPU016).
 
 Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
 walks a module — the innermost *jit context* (entered through a
@@ -923,4 +924,86 @@ class UnboundedLabelCardinality(Rule):
                         f"classify_route() or an explicit allow-list "
                         f"first"))
                     break   # one finding per call site is signal enough
+        return iter(findings)
+
+
+#: identifiers marking the left operand as hash-derived (builtin hash(),
+#: hashlib digests, crc32, and local *_hash helpers all match)
+_HASH_SOURCE_RE = re.compile(r"hash|digest|crc32|md5|sha1|sha256|fnv")
+#: identifiers marking a collection as a peer pool worth routing over
+_PEER_POOL_RE = re.compile(r"peer|worker|node|member|replica|backend|"
+                           r"host|endpoint|addr|shard|server")
+#: the sanctioned routing layer — ConsistentHashRing and its registry
+#: consumer live here, and _ring_hash % internals are its implementation
+_ROUTING_EXEMPT = ("mmlspark_tpu/serving/admission.py",
+                   "mmlspark_tpu/serving/registry.py")
+
+
+def _hash_ident_in(node: ast.AST) -> Optional[str]:
+    """The first hash-flavored identifier feeding ``node``, or None."""
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident is not None and _HASH_SOURCE_RE.search(ident.lower()):
+            return ident
+    return None
+
+
+@register_rule
+class AdhocHashRouting(Rule):
+    code = "TPU016"
+    name = "adhoc-hash-routing"
+    severity = "warning"
+    doc = ("Peer selection by ``hash(key) % len(peers)`` (or any "
+           "hash-derived value modulo a peer-pool length) outside "
+           "mmlspark_tpu/serving/admission.py and serving/registry.py. "
+           "Modulo placement remaps nearly EVERY key whenever the pool "
+           "size changes — one worker restart reshuffles the whole "
+           "keyspace, losing prefix-cache affinity and stampeding cold "
+           "workers. Route through serving.ConsistentHashRing instead: "
+           "a membership change moves only ~1/n of the keys, and its "
+           "bounded-load fallback absorbs hot keys. Non-hash modulo "
+           "(round-robin cursors like ``self._rr % len(peers)``) stays "
+           "quiet — rotation is not placement.")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if not rel.startswith("mmlspark_tpu/") or rel in _ROUTING_EXEMPT:
+            return iter(())
+        findings: List[Finding] = []
+        for node in module.nodes(ast.BinOp):
+            if not isinstance(node.op, ast.Mod):
+                continue
+            right = node.right
+            if not (isinstance(right, ast.Call)
+                    and module.dotted(right.func) == "len"
+                    and right.args):
+                continue
+            pool = None
+            for sub in ast.walk(right.args[0]):
+                ident = None
+                if isinstance(sub, ast.Name):
+                    ident = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    ident = sub.attr
+                if ident is not None \
+                        and _PEER_POOL_RE.search(ident.lower()):
+                    pool = ident
+                    break
+            if pool is None:
+                continue
+            src = _hash_ident_in(node.left)
+            if src is None:
+                continue
+            findings.append(self.finding(
+                module, node,
+                f"peer selected by '{src}' % len({pool}) — modulo "
+                f"placement remaps ~every key when the pool resizes "
+                f"(one restart reshuffles the keyspace and stampedes "
+                f"cold caches); route through "
+                f"serving.ConsistentHashRing, which moves only ~1/n of "
+                f"keys per membership change"))
         return iter(findings)
