@@ -575,6 +575,7 @@ def _generation_phase(on_tpu: bool) -> dict:
     n_prefix = sum(1 for i in range(n_reqs) if i % 3 == 2)
     out = {
         "tok_per_sec": round(toks / elapsed, 2),
+        "mesh_shape": "single",
         "tokens": toks, "requests": n_reqs, "wall_s": round(elapsed, 3),
         "decode_step_p50_ms": round(float(lat[len(lat) // 2]) * 1e3, 3),
         "decode_step_p99_ms": round(
@@ -612,6 +613,82 @@ def _generation_phase(on_tpu: bool) -> dict:
         "engine_stats": dict(eng.stats),
     }
     return out
+
+
+def _multichip_generation_phase(mesh=None) -> dict:
+    """Mesh-sharded decode: the same paged-KV engine run once single-chip
+    and once shard_map-mounted on ``mesh`` (default: a dp×tp mesh over
+    every visible device — dp4×tp2 on 8), with the SAME greedy workload,
+    so the record carries tok/s vs chips, scaling efficiency against the
+    single-chip rate, and a per-tick collective-time estimate (mesh step
+    p50 minus single-chip step p50 — what the ICI adds to a tick). On
+    simulated CPU devices the absolute numbers mean nothing; the phase
+    exists so real-mesh runs land these fields in the trajectory and so
+    the dryrun counter-asserts the kernel actually ran sharded."""
+    import jax
+    from jax.sharding import Mesh
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     init_transformer)
+    from mmlspark_tpu.parallel.mesh import mesh_shape
+    from mmlspark_tpu.serving.continuous import ContinuousDecoder
+    if mesh is None:
+        devs = jax.devices()
+        n = len(devs)
+        tp = 2 if (n % 2 == 0 and n >= 2) else 1
+        dp = max(1, n // tp)
+        mesh = Mesh(np.array(devs[:dp * tp]).reshape(dp, tp),
+                    ("dp", "tp"))
+    chips = int(mesh.devices.size)
+    # vocab/heads/d_ff all divisible by tp — the Megatron shardings split
+    # lm_head on the vocab axis, so the tiny config must tile cleanly
+    cfg = TransformerConfig(vocab=256, d_model=64, heads=4, layers=2,
+                            d_ff=128, max_len=96, causal=True)
+    params = init_transformer(cfg, 0)
+    dp = mesh.shape.get("dp", 1)
+    slots = max(4, int(dp))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab, 6 + (i % 3) * 7, dtype=np.int32)
+               for i in range(2 * slots)]
+
+    def _run(m):
+        eng = ContinuousDecoder(params, cfg, max_slots=slots, max_len=64,
+                                mesh=m, page_size=8)
+        warm = [eng.submit(p, max_new_tokens=2) for p in prompts[:3]]
+        while any(r is not None for r in eng._slot_req) or eng._waiting:
+            eng.step()
+        reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        step_s = []
+        t0 = time.perf_counter()
+        while any(r is not None for r in eng._slot_req) or eng._waiting:
+            s0 = time.perf_counter()
+            eng.step()
+            step_s.append(time.perf_counter() - s0)
+        elapsed = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in reqs)
+        p50 = float(np.sort(np.asarray(step_s))[len(step_s) // 2])
+        return (toks / elapsed, toks, elapsed, p50,
+                [list(r.tokens) for r in reqs], eng)
+
+    tps_1, _, _, p50_1, toks_1, _ = _run(None)
+    tps_m, toks, wall, p50_m, toks_m, eng = _run(mesh)
+    pool = eng._kv
+    return {
+        "mesh_shape": mesh_shape(mesh), "chips": chips,
+        "tok_per_sec": round(tps_m, 2), "tokens": toks,
+        "wall_s": round(wall, 3),
+        "tok_per_sec_single_chip": round(tps_1, 2),
+        # fixed workload: ideal scaling is chips × the single-chip rate
+        "scaling_efficiency": round(tps_m / (tps_1 * chips), 4)
+        if tps_1 > 0 else None,
+        "collective_ms_per_tick_est": round(
+            max(0.0, p50_m - p50_1) * 1e3, 3),
+        "token_parity_vs_single_chip": toks_m == toks_1,
+        "paged_attn": {
+            "impl": eng._attn_impl,
+            "ticks_kernel": pool.stats.get("attn_ticks_kernel", 0),
+            "ticks_gather": pool.stats.get("attn_ticks_gather", 0),
+            "gather_bytes_total": pool.stats.get("gather_bytes", 0)},
+    }
 
 
 def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
@@ -1025,6 +1102,26 @@ def main():
                 record["generation"] = {"skipped": "budget exhausted"}
         except Exception as e:          # noqa: BLE001
             record["generation"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+
+    # multichip generation: the mesh-mounted engine vs single chip on the
+    # same workload — tok/s vs chips, scaling efficiency, per-tick
+    # collective estimate. Needs >= 2 devices (real or simulated); on one
+    # device the phase records why it abstained instead of fake numbers.
+    with _phase_guard(record, "multichip_generation",
+                      min(remaining() - 25.0, 180.0), report=report):
+        try:
+            if jax.device_count() < 2:
+                record["multichip_generation"] = {
+                    "skipped": "single device"}
+            elif remaining() > 40.0:
+                record["multichip_generation"] = \
+                    _multichip_generation_phase()
+            else:
+                record["multichip_generation"] = {
+                    "skipped": "budget exhausted"}
+        except Exception as e:          # noqa: BLE001
+            record["multichip_generation"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
 
     # tuning phase: pure host arithmetic over this run's harvested samples

@@ -122,17 +122,31 @@ class JaxModel(Model):
                 else "unset")
         return f"jax:{name}:{self.compute_dtype}"
 
+    def _mesh_shape(self) -> str:
+        """Topology stamp for tuning decisions: the default mesh's
+        canonical shape string when this model dispatches mesh-sharded,
+        else ``"single"`` — decisions learned on one chip layout never
+        seed another (their cost surfaces differ by ICI collectives)."""
+        from ..parallel.mesh import get_default_mesh, mesh_shape
+        if not self.get("mesh_sharded"):
+            return "single"
+        return mesh_shape(get_default_mesh())
+
     def _resolve_tuning(self, histogram: Dict[int, int]):
         """The store's pick for this histogram (None = off or cold store);
-        resolved sig-wide so warm-up and every partition share one ladder."""
+        resolved sig-wide so warm-up and every partition share one ladder.
+        Decisions are keyed (and the store filtered) by mesh shape too, so
+        toggling ``mesh_sharded`` mid-life never reuses a stale ladder."""
         if self.get_or_none("tuning") != "auto":
             return None
-        key = tuple(sorted(histogram.items()))
+        mesh = self._mesh_shape()
+        key = (tuple(sorted(histogram.items())), mesh)
         if key not in self._tuning_decisions:
             from ..tuning.cost_model import resolve_tuning
             self._tuning_decisions[key] = resolve_tuning(
                 self.tuning_signature(), "default", histogram,
-                defaults=(self.mini_batch_size, self.prefetch_depth))
+                defaults=(self.mini_batch_size, self.prefetch_depth),
+                mesh_shape=mesh)
         return self._tuning_decisions[key]
 
     def _runner_config(self, n_rows: int):

@@ -714,9 +714,12 @@ def decode_window_ragged(params: Dict, tokens: jnp.ndarray,
 # were freed and handed to another request.
 #
 # Gathering costs one O(B·L) copy per step — the price of page-granular
-# allocation and cross-request prefix sharing (serving/kv_pool.py); a
-# fused Pallas paged-attention kernel that reads pages in place is the
-# follow-up once the scheduler-level win is banked.
+# allocation and cross-request prefix sharing (serving/kv_pool.py). The
+# fused Pallas paged-attention kernel (ops/paged_attention.py) reads
+# pages in place and eliminates that copy; under a mesh it mounts via
+# shard_map with heads split over tp and slots over dp, so the gather
+# path below survives only as the parity oracle and env-knob escape
+# hatch.
 
 def init_paged_cache(cfg: TransformerConfig, num_pages: int,
                      page_size: int):
@@ -802,7 +805,8 @@ def _decode_window_paged_kernel(params: Dict, tokens: jnp.ndarray,
                                 pos: jnp.ndarray, cache_pages,
                                 block_tables, cfg: TransformerConfig,
                                 page_size: int,
-                                active: Optional[jnp.ndarray]):
+                                active: Optional[jnp.ndarray],
+                                mesh=None, slot_axis=None, head_axis=None):
     """The Pallas paged-attention layer loop: identical embedding / rope /
     projection / FFN math to :func:`decode_window_ragged`, but attention
     reads K/V pages IN PLACE through the block table and scatters the
@@ -838,7 +842,8 @@ def _decode_window_paged_kernel(params: Dict, tokens: jnp.ndarray,
             k = _rot_half(k, cos, sin)
         ctx, kp, vp = paged_attention_window(
             q, k.astype(dt), v.astype(dt), c["k"], c["v"],
-            block_tables, pos, active=active)
+            block_tables, pos, active=active, mesh=mesh,
+            slot_axis=slot_axis, head_axis=head_axis)
         new_pages.append({"k": kp, "v": vp})
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, W, cfg.d_model)
         h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
@@ -855,7 +860,8 @@ def decode_step_paged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
                       cache_pages, block_tables, cfg: TransformerConfig, *,
                       page_size: int, length: int,
                       active: Optional[jnp.ndarray] = None,
-                      impl: Optional[str] = None):
+                      impl: Optional[str] = None,
+                      mesh=None, slot_axis=None, head_axis=None):
     """One paged decode step. Two implementations, selected by ``impl``
     (``None`` → the ``MMLSPARK_TPU_PAGED_ATTN`` env knob, default
     ``"kernel"``):
@@ -874,7 +880,8 @@ def decode_step_paged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
     if resolve_impl(impl) == "kernel":
         logits, pages = _decode_window_paged_kernel(
             params, tokens[:, None], pos.astype(jnp.int32), cache_pages,
-            block_tables, cfg, page_size, active)
+            block_tables, cfg, page_size, active, mesh=mesh,
+            slot_axis=slot_axis, head_axis=head_axis)
         return logits[:, 0], pages
     gathered = paged_gather(cache_pages, block_tables, length)
     logits, new = decode_step_ragged(params, tokens, pos.astype(jnp.int32),
@@ -890,7 +897,8 @@ def decode_window_paged(params: Dict, tokens: jnp.ndarray,
                         cfg: TransformerConfig, *, page_size: int,
                         length: int,
                         active: Optional[jnp.ndarray] = None,
-                        impl: Optional[str] = None):
+                        impl: Optional[str] = None,
+                        mesh=None, slot_axis=None, head_axis=None):
     """Paged window decode — the speculative verify and chunked-prefill
     primitive. Row b's window writes positions ``pos[b]..pos[b]+W-1``
     into its pages; every such position must be < ``length`` (the engine
@@ -903,7 +911,9 @@ def decode_window_paged(params: Dict, tokens: jnp.ndarray,
     if resolve_impl(impl) == "kernel":
         return _decode_window_paged_kernel(params, tokens, pos,
                                            cache_pages, block_tables,
-                                           cfg, page_size, active)
+                                           cfg, page_size, active,
+                                           mesh=mesh, slot_axis=slot_axis,
+                                           head_axis=head_axis)
     wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)
     gathered = paged_gather(cache_pages, block_tables, length)
     logits, new = decode_window_ragged(params, tokens, pos, gathered,

@@ -43,6 +43,24 @@ Design notes (TPU-first):
   write range (``pos[b] .. pos[b]+W-1``) must be exclusively owned by
   that row. The pool's copy-on-write admission guarantees this — shared
   prefix pages are never written (serving/kv_pool.py).
+* MESH MOUNT: a bare ``pallas_call`` inside a sharded jit is not
+  GSPMD-partitionable — XLA would gather the whole pool onto one
+  device. ``paged_attention``/``paged_attention_window`` therefore take
+  ``mesh=`` and mount the kernel via ``jax.shard_map`` with heads split
+  over the ``tp`` axis: Q, the page pools and the online-softmax VMEM
+  scratch all shard on the head axis (specs
+  ``P(slot_axis, head_axis, None, None)`` / ``P(None, head_axis, None,
+  None)``), each shard runs the UNCHANGED kernel over its ``heads/tp``
+  slice, and only the caller's post-attention projection pays an ICI
+  collective (GSPMD inserts it, exactly as for ``transformer_apply``).
+  Slots optionally shard over ``dp``. Under a mesh the mount is
+  READ-ONLY — the fused in-kernel scatter cannot run per-shard when
+  slots split over ``dp`` while the pool replicates over it (each dp
+  shard would apply only its own rows' writes and the replicas would
+  diverge) — so the window's fresh K/V rows are written OUTSIDE the
+  mount by :func:`_pool_write_rows`, a GSPMD-partitionable scatter that
+  writes bytes bit-identical to both ``_paged_writeback`` and the fused
+  kernel's in-launch scatter.
 
 Tiling contract: the page dimension sits in the SUBLANE slot of the
 ``(1, H, page, hd)`` block, so on a real TPU ``page_size`` must be a
@@ -260,6 +278,55 @@ def _pa_fused_kernel(bt_ref, pos_ref, wlo_ref, whi_ref, q_ref, kn_ref,
         _finalize(o_ref, l_scr, acc_scr)
 
 
+def _pa_window_kernel(bt_ref, pos_ref, q_ref, kn_ref, vn_ref, kp_ref,
+                      vp_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      scale, page, W, n_pages):
+    """One (b, p) grid step of the READ-ONLY decode-window sweep — the
+    shard_map-mounted variant. Identical online-softmax math to
+    :func:`_pa_fused_kernel` (window rows folded once at p == 0 under
+    the in-window causal mask, pages masked strictly below ``pos[b]``),
+    minus the in-kernel page scatter: under a mesh the fresh rows are
+    written outside the mount (:func:`_pool_write_rows`), so only two
+    scalar-prefetch operands (block table, pos) remain and no output
+    aliases the pool."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    pos = pos_ref[b]
+    Wp = q_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init_and_window():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        q = q_ref[0].astype(jnp.float32)                # (H, Wp, hd)
+        kn = kn_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kn, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # (H, Wp, Wp)
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, Wp, Wp), 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, Wp, Wp), 2)
+        valid = jnp.logical_and(
+            jnp.logical_or(col <= row, row >= W), col < W)
+        _fold(m_scr, l_scr, acc_scr, s, valid,
+              vn_ref[0].astype(jnp.float32))
+
+    @pl.when(p * page < pos)
+    def _pages():
+        q = q_ref[0].astype(jnp.float32)
+        s = _page_scores(q, kp_ref, scale)
+        t = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page), 2)
+        _fold(m_scr, l_scr, acc_scr, s, t < pos,
+              vp_ref[0].astype(jnp.float32))
+
+    @pl.when(p == n_pages - 1)
+    def _fin():
+        _finalize(o_ref, l_scr, acc_scr)
+
+
 def _grid_spec(n_scalar, B, n_pages, in_specs, out_specs, H, Wp, hd):
     from jax.experimental.pallas import tpu as pltpu
     return pltpu.PrefetchScalarGridSpec(
@@ -375,6 +442,88 @@ def _pa_fused_call(q, k_new, v_new, k_pages, v_pages, block_tables,
                 k_pages, v_pages)
 
 
+@functools.partial(jax.jit, static_argnames=("W", "scale", "interpret"))
+def _pa_window_read_call(q, k_new, v_new, k_pages, v_pages, block_tables,
+                         pos, *, W, scale, interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, Wp, hd = q.shape
+    page = k_pages.shape[2]
+    n_pages = block_tables.shape[1]
+    kernel = functools.partial(_pa_window_kernel, scale=scale, page=page,
+                               W=W, n_pages=n_pages)
+
+    def _row_map(b, p, bt, pos_):
+        return (b, 0, 0, 0)
+
+    def _page_map(b, p, bt, pos_):
+        return (bt[b, p], 0, 0, 0)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=_grid_spec(
+            2, B, n_pages,
+            in_specs=[
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # q
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # k_new
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # v_new
+                pl.BlockSpec((1, H, page, hd), _page_map),  # k pages
+                pl.BlockSpec((1, H, page, hd), _page_map),  # v pages
+            ],
+            out_specs=pl.BlockSpec((1, H, Wp, hd), _row_map),
+            H=H, Wp=Wp, hd=hd),
+        out_shape=jax.ShapeDtypeStruct((B, H, Wp, hd), q.dtype),
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )
+    return call(block_tables, pos, q, k_new, v_new, k_pages, v_pages)
+
+
+# ---- mesh mount (shard_map) -------------------------------------------------
+
+def _mount_specs(slot_axis, head_axis):
+    """The per-shard partition specs of the mount, derived mechanically
+    from the engine's cache layout (``continuous.py``): batch rows over
+    ``slot_axis`` ("dp" or None), heads over ``head_axis`` ("tp" or
+    None), page/lane dims never split."""
+    from jax.sharding import PartitionSpec as P
+    row = P(slot_axis, head_axis, None, None)     # q / k_new / v_new / out
+    pool = P(None, head_axis, None, None)         # the K/V page pools
+    return row, pool, P(slot_axis, None), P(slot_axis)
+
+
+def _check_mount(mesh, B, H, slot_axis, head_axis):
+    if head_axis is not None:
+        tp = mesh.shape[head_axis]
+        if H % tp:
+            raise ValueError(
+                f"heads {H} not divisible by mesh {head_axis}={tp}")
+    if slot_axis is not None:
+        dp = mesh.shape[slot_axis]
+        if B % dp:
+            raise ValueError(
+                f"batch {B} not divisible by mesh {slot_axis}={dp}")
+
+
+def _pool_write_rows(pool, rows, block_tables, pos, active):
+    """Scatter each row's W fresh K/V rows into its pages — the mesh
+    path's page write, OUTSIDE the shard_map mount. Plain ``.at[].set``
+    indexing that GSPMD partitions on the untouched head axis, writing
+    bytes bit-identical to ``transformer._paged_writeback`` (same index
+    math: physical page via the block table, offset ``pos+j`` mod page).
+    Inactive rows redirect to trash page 0, like every other writer."""
+    B, H, W, hd = rows.shape
+    page = pool.shape[2]
+    wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)       # (B, W)
+    phys = jnp.take_along_axis(block_tables, wpos // page, axis=1)
+    if active is not None:
+        phys = jnp.where(active[:, None], phys, 0)
+    pf = phys.reshape(-1)
+    of = (wpos % page).reshape(-1)
+    vals = rows.transpose(0, 2, 1, 3).reshape(B * W, H, hd)
+    return pool.at[pf, :, of].set(vals.astype(pool.dtype))
+
+
 def _pad_window(t, Wp):
     W = t.shape[2]
     if W == Wp:
@@ -384,29 +533,55 @@ def _pad_window(t, Wp):
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    mesh=None, slot_axis: Optional[str] = None,
+                    head_axis: Optional[str] = None):
     """Read-only paged attention: queries ``q`` (B, H, W, hd) attend the
     first ``lengths[b]`` cached keys of row ``b``, read in place from
     the ``(N, H, page, hd)`` page pools through ``block_tables`` (B, P).
     A row with ``lengths[b] == 0`` yields zeros (the flash convention
-    for fully-masked rows). Returns (B, H, W, hd) in ``q.dtype``."""
+    for fully-masked rows). Returns (B, H, W, hd) in ``q.dtype``.
+
+    With ``mesh=`` the kernel is mounted via ``jax.shard_map``: heads
+    split over ``head_axis`` (typically ``"tp"``) and rows optionally
+    over ``slot_axis`` (``"dp"``); each shard runs the unchanged kernel
+    over its head slice and the result carries the caller's row spec —
+    no collective inside the mount."""
     if interpret is None:
         interpret = _auto_interpret()
     B, H, W, hd = q.shape
     if scale is None:
         scale = float(1.0 / math.sqrt(hd))
     Wp = _round_up(W, sublane_multiple(q.dtype))
-    out = _pa_read_call(
-        _pad_window(q, Wp), k_pages, v_pages,
-        block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-        scale=scale, interpret=bool(interpret))
+    qp = _pad_window(q, Wp)
+    bt = block_tables.astype(jnp.int32)
+    lens = lengths.astype(jnp.int32)
+    if mesh is None:
+        out = _pa_read_call(qp, k_pages, v_pages, bt, lens,
+                            scale=scale, interpret=bool(interpret))
+        return out[:, :, :W]
+    _check_mount(mesh, B, H, slot_axis, head_axis)
+    from ..parallel.mesh import get_shard_map
+    shard_map, unchecked = get_shard_map()
+    row, pool, bt_spec, vec = _mount_specs(slot_axis, head_axis)
+
+    def _shard(q_, kp_, vp_, bt_, len_):
+        return _pa_read_call(q_, kp_, vp_, bt_, len_,
+                             scale=scale, interpret=bool(interpret))
+
+    out = shard_map(_shard, mesh=mesh,
+                    in_specs=(row, pool, pool, bt_spec, vec),
+                    out_specs=row, **unchecked)(
+        qp, k_pages, v_pages, bt, lens)
     return out[:, :, :W]
 
 
 def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
                            block_tables, pos, *, active=None,
                            scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           mesh=None, slot_axis: Optional[str] = None,
+                           head_axis: Optional[str] = None):
     """Fused decode-window attention + page scatter, one launch.
 
     Row ``b``'s W queries sit at absolute positions
@@ -417,7 +592,14 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
     in the same launch. Rows where ``active`` is False neither write
     their pages (their writes redirect to trash page 0) nor produce
     meaningful context. Returns ``(ctx, k_pages, v_pages)`` with the
-    pool buffers updated in place (aliased)."""
+    pool buffers updated in place (aliased).
+
+    With ``mesh=`` the attention mounts via ``jax.shard_map`` (heads
+    over ``head_axis``, rows optionally over ``slot_axis``) in
+    READ-ONLY form, and the fresh rows are scattered by
+    :func:`_pool_write_rows` outside the mount — the written bytes are
+    bit-identical to the fused in-kernel scatter, so single-chip and
+    mesh engines produce the same pages."""
     if interpret is None:
         interpret = _auto_interpret()
     B, H, W, hd = q.shape
@@ -425,6 +607,27 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
     if scale is None:
         scale = float(1.0 / math.sqrt(hd))
     pos = pos.astype(jnp.int32)
+    Wp = _round_up(W, sublane_multiple(q.dtype))
+    bt = block_tables.astype(jnp.int32)
+    if mesh is not None:
+        _check_mount(mesh, B, H, slot_axis, head_axis)
+        from ..parallel.mesh import get_shard_map
+        shard_map, unchecked = get_shard_map()
+        row, pool, bt_spec, vec = _mount_specs(slot_axis, head_axis)
+
+        def _shard(q_, kn_, vn_, kp_, vp_, bt_, pos_):
+            return _pa_window_read_call(q_, kn_, vn_, kp_, vp_, bt_, pos_,
+                                        W=W, scale=scale,
+                                        interpret=bool(interpret))
+
+        ctx = shard_map(_shard, mesh=mesh,
+                        in_specs=(row, row, row, pool, pool, bt_spec, vec),
+                        out_specs=row, **unchecked)(
+            _pad_window(q, Wp), _pad_window(k_new, Wp),
+            _pad_window(v_new, Wp), k_pages, v_pages, bt, pos)
+        kp = _pool_write_rows(k_pages, k_new, bt, pos, active)
+        vp = _pool_write_rows(v_pages, v_new, bt, pos, active)
+        return ctx[:, :, :W], kp, vp
     wlo = pos // page
     whi = (pos + W - 1) // page
     if active is not None:
@@ -432,10 +635,9 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
         # of the row to trash and the overlay never fires
         wlo = jnp.where(active, wlo, 1)
         whi = jnp.where(active, whi, 0)
-    Wp = _round_up(W, sublane_multiple(q.dtype))
     out, kp, vp = _pa_fused_call(
         _pad_window(q, Wp), _pad_window(k_new, Wp), _pad_window(v_new, Wp),
-        k_pages, v_pages, block_tables.astype(jnp.int32), pos,
+        k_pages, v_pages, bt, pos,
         wlo.astype(jnp.int32), whi.astype(jnp.int32),
         W=W, scale=scale, interpret=bool(interpret))
     return out[:, :, :W], kp, vp

@@ -10,11 +10,17 @@ def aligned_page_size(page_size: int, dtype: Any) -> int: ...
 def paged_attention(q: Any, k_pages: Any, v_pages: Any,
                     block_tables: Any, lengths: Any, *,
                     scale: Optional[float] = ...,
-                    interpret: Optional[bool] = ...) -> Any: ...
+                    interpret: Optional[bool] = ...,
+                    mesh: Optional[Any] = ...,
+                    slot_axis: Optional[str] = ...,
+                    head_axis: Optional[str] = ...) -> Any: ...
 def paged_attention_window(q: Any, k_new: Any, v_new: Any,
                            k_pages: Any, v_pages: Any,
                            block_tables: Any, pos: Any, *,
                            active: Optional[Any] = ...,
                            scale: Optional[float] = ...,
-                           interpret: Optional[bool] = ...
+                           interpret: Optional[bool] = ...,
+                           mesh: Optional[Any] = ...,
+                           slot_axis: Optional[str] = ...,
+                           head_axis: Optional[str] = ...
                            ) -> Tuple[Any, Any, Any]: ...

@@ -20,7 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["local_devices", "device_for_partition", "make_mesh",
            "batch_placement", "feed_placement", "Placement",
            "data_parallel_sharding", "replicated_sharding",
-           "MeshContext", "get_default_mesh", "set_default_mesh"]
+           "MeshContext", "get_default_mesh", "set_default_mesh",
+           "mesh_shape"]
 
 
 def local_devices():
@@ -132,6 +133,20 @@ def data_parallel_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_shape(mesh: Optional[Mesh]) -> str:
+    """Canonical string for a mesh's axis layout, e.g. ``"dp4xtp2"``.
+
+    ``"single"`` when ``mesh`` is None. Used to stamp tuning observations
+    and decisions so ladders learned on one chip topology are never
+    transferred onto another (a dp4xtp2 engine and a single-chip engine
+    have different per-tick cost surfaces even at identical batch shapes).
+    """
+    if mesh is None:
+        return "single"
+    return "x".join(f"{name}{int(mesh.shape[name])}"
+                    for name in mesh.axis_names)
 
 
 _default_mesh: Optional[Mesh] = None

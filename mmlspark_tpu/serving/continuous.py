@@ -86,6 +86,7 @@ from ..models.zoo.transformer import (TransformerConfig,
 from ..ops.padding import bucket_size
 from ..ops.paged_attention import (resolve_impl as _resolve_paged_attn,
                                    _auto_interpret as _pa_auto_interpret)
+from ..parallel.mesh import mesh_shape
 from .kv_pool import (KVAutotuner, PagedKVPool, PoolExhausted,
                       prefix_hash as _prefix_hash)
 
@@ -156,10 +157,15 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
 # each call donates its own argument buffers, never another engine's.
 
 @functools.lru_cache(maxsize=None)
-def _tick_program(cfg, page, Lc, k, eos, sample, donate, attn="kernel"):
+def _tick_program(cfg, page, Lc, k, eos, sample, donate, attn="kernel",
+                  mesh=None, slot_axis=None, head_axis=None):
     """The decode tick: k paged steps fused in one lax.scan. ``attn``
     (part of the cache key — the impl is baked in at trace time) selects
-    the Pallas paged-attention kernel or the gather fallback."""
+    the Pallas paged-attention kernel or the gather fallback. ``mesh``
+    (a hashable jax Mesh: axis names + sizes + devices) plus the engine's
+    slot/head axis names are part of the cache key too, so a sharded
+    engine and a single-chip engine with otherwise-identical shapes never
+    share a trace — the kernel mounts via shard_map under a mesh."""
     eos_const = None if eos is None else jnp.int32(eos)
 
     def tick(params, tok, pos, active, bufs, bt, remaining,
@@ -168,7 +174,8 @@ def _tick_program(cfg, page, Lc, k, eos, sample, donate, attn="kernel"):
             tok, pos, active, bufs, remaining = carry
             logits, bufs = decode_step_paged(
                 params, tok, pos, bufs, bt, cfg,
-                page_size=page, length=Lc, active=active, impl=attn)
+                page_size=page, length=Lc, active=active, impl=attn,
+                mesh=mesh, slot_axis=slot_axis, head_axis=head_axis)
             if sample:
                 # emit position is pos+1 — generate_cached's key
                 # schedule (fold_in by absolute emit position), so
@@ -204,17 +211,21 @@ def _prefill_program(cfg, L):
 
 
 @functools.lru_cache(maxsize=None)
-def _extend_program(cfg, page, L, donate, attn="kernel"):
+def _extend_program(cfg, page, L, donate, attn="kernel",
+                    mesh=None, head_axis=None):
     """Paged window extension: continue ONE slot's pages over a token
     window — the prefix-cache suffix path and chunked prefill share this
     single program (one compile per window bucket). The gather impl
     gathers at length L: the exact reduction length the old contiguous
     extension used, so greedy prefix-hit outputs stay identical; the
-    kernel impl reads pages in place (f32-accumulation tolerance)."""
+    kernel impl reads pages in place (f32-accumulation tolerance).
+    Under a mesh only heads shard (slot_axis stays None: the extension
+    operates on a single B=1 row, which cannot split over dp)."""
     def _extend(params, ids, start, bufs, bt_row):
         return decode_window_paged(params, ids, start, bufs, bt_row,
                                    cfg, page_size=page, length=L,
-                                   active=None, impl=attn)
+                                   active=None, impl=attn, mesh=mesh,
+                                   slot_axis=None, head_axis=head_axis)
 
     return jax.jit(_extend, donate_argnums=(3,) if donate else ())
 
@@ -296,7 +307,8 @@ def _first_tokens_program():
 
 @functools.lru_cache(maxsize=None)
 def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
-                       sample, warp, donate, attn="kernel"):
+                       sample, warp, donate, attn="kernel",
+                       mesh=None, slot_axis=None, head_axis=None):
     """The speculative tick: k draft→verify rounds in one scan.
 
     Per round, the draft proposes gamma tokens per slot (gamma+1 ragged
@@ -397,7 +409,8 @@ def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
             wtoks = jnp.concatenate([tok[:, None], drafts], 1)
             w_logits, bufs = decode_window_paged(
                 params, wtoks, pos, bufs, bt, cfg,
-                page_size=page, length=Lc, active=active, impl=attn)
+                page_size=page, length=Lc, active=active, impl=attn,
+                mesh=mesh, slot_axis=slot_axis, head_axis=head_axis)
             greedy = jnp.argmax(w_logits, -1).astype(jnp.int32)
             match = greedy[:, :gamma] == drafts
             if sample:
@@ -609,6 +622,7 @@ class ContinuousDecoder:
         if mesh is None:
             self._params = jax.device_put(params)
             cache_sharding = state_sharding = pool_sharding = None
+            slot_axis = head_axis = None
         else:
             # tensor-parallel serving: Megatron layout on the params
             # (shardings_for), KV heads over "tp", slots over "dp" when
@@ -634,6 +648,13 @@ class ContinuousDecoder:
             self._params = jax.device_put(
                 params, shardings_for(params, mesh)
                 if head_axis else state_sharding)
+        #: mesh identity for program cache keys + tuning stamps: the mesh
+        #: itself (hashable — axis names, sizes, devices), the resolved
+        #: shard axes, and the canonical "dp4xtp2"-style shape string
+        self._mesh = mesh
+        self._slot_axis = slot_axis
+        self._head_axis = head_axis
+        self._mesh_shape = mesh_shape(mesh)
         if self._spec:
             d_params = jax.tree.map(jnp.asarray, draft_params)
             # the draft is small by construction: replicate it on a mesh
@@ -667,18 +688,19 @@ class ContinuousDecoder:
         #: Resolved ONCE here and threaded into every compiled-program
         #: cache key — the env knob must not leak into shared programs.
         impl = _resolve_paged_attn(paged_attn)
-        if impl == "kernel" and mesh is not None:
-            # the kernel is not GSPMD-partitionable: a bare pallas_call
-            # inside a tp-sharded jit would gather the pool onto one
-            # device. Sharded engines keep the gather path (which GSPMD
-            # partitions like any einsum) until a shard_map mount lands.
-            impl = "gather"
+        # under a mesh the kernel mounts via shard_map (heads over tp,
+        # slots over dp) — ops/paged_attention.py runs the unchanged
+        # per-shard kernel over each heads/tp slice, so no downgrade:
+        # sharded engines and single-chip engines run the same impl
         self._attn_impl = impl
         if impl == "kernel" and not _pa_auto_interpret():
             # real TPU: the page dimension sits in the kernel's sublane
             # slot — round the page size up to the dtype's tile
             # (transparent to allocation accounting; interpret-mode CI
-            # keeps the requested size so test pool shapes are unchanged)
+            # keeps the requested size so test pool shapes are unchanged).
+            # The rounding is per-SHARD invariant: sharding splits heads,
+            # not the page dimension, so the same aligned size serves
+            # every mesh shape
             page_size = PagedKVPool.kernel_aligned_page_size(
                 page_size, cfg.dtype)
         self._page = int(page_size)
@@ -701,7 +723,8 @@ class ContinuousDecoder:
 
         self._kv = PagedKVPool(cfg, num_pages=int(kv_pages),
                                page_size=self._page,
-                               make_buffer=_pool_buffer)
+                               make_buffer=_pool_buffer,
+                               sharding=pool_sharding)
         self._chunk = int(prefill_chunk)
         self._defrag_thr = (max(1, self._kv.num_pages // 4)
                             if defrag_threshold is None
@@ -748,10 +771,12 @@ class ContinuousDecoder:
         # but never changes it — pages are remapped host-side between
         # dispatches, and the engine re-binds self._bt outside jit.
         self._tick = _tick_program(cfg, page, Lc, self._k, self._eos,
-                                   False, donate, self._attn_impl)
+                                   False, donate, self._attn_impl,
+                                   mesh, slot_axis, head_axis)
         self._tick_sampled = _tick_program(cfg, page, Lc, self._k,
                                            self._eos, True, donate,
-                                           self._attn_impl)
+                                           self._attn_impl,
+                                           mesh, slot_axis, head_axis)
         # per-call HBM traffic the gather impl pays materializing
         # contiguous K/V (2 tensors x layers x (B, H, L, hd)); the
         # kernel impl's figure is 0 by construction — these feed the
@@ -782,7 +807,9 @@ class ContinuousDecoder:
                         cfg, d_cfg, page, Lc, self._k, self._eos, g,
                         sample=(mode != "greedy"),
                         warp=(mode == "warped"), donate=donate,
-                        attn=self._attn_impl)
+                        attn=self._attn_impl, mesh=self._mesh,
+                        slot_axis=self._slot_axis,
+                        head_axis=self._head_axis)
                     self._spec_ticks[(mode, g)] = fn
                 return fn
 
@@ -797,7 +824,8 @@ class ContinuousDecoder:
 
         # prefix-cache suffix extension + chunked prefill (one program)
         self._extend_paged = _extend_program(cfg, page, self._L, donate,
-                                             self._attn_impl)
+                                             self._attn_impl,
+                                             mesh, head_axis)
 
         # copy-on-write boundary-page copy + defrag permutation
         self._copy_pages_j = _copy_pages_program(donate)

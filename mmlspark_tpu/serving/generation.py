@@ -61,7 +61,7 @@ class GenerationEngine:
                  draft_params=None, draft_cfg=None, gamma: int = 4,
                  page_size: int = 16, prefill_chunk: int = 256,
                  kv_pages: Optional[int] = None, autotune: bool = False,
-                 paged_attn: Optional[str] = None):
+                 paged_attn: Optional[str] = None, mesh=None):
         self.decoder = ContinuousDecoder(
             params, cfg, max_slots=max_slots, max_len=max_len,
             eos_id=eos_id, steps_per_dispatch=steps_per_dispatch,
@@ -69,7 +69,7 @@ class GenerationEngine:
             draft_params=draft_params, draft_cfg=draft_cfg, gamma=gamma,
             page_size=page_size, prefill_chunk=prefill_chunk,
             kv_pages=kv_pages, autotune=autotune,
-            paged_attn=paged_attn)
+            paged_attn=paged_attn, mesh=mesh)
         self.default_max_new = int(default_max_new)
         self.server = WorkerServer(host, port, api_path,
                                    reply_timeout=reply_timeout,

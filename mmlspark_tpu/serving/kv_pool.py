@@ -131,7 +131,8 @@ class PagedKVPool:
     """
 
     def __init__(self, cfg, *, num_pages: int, page_size: int,
-                 make_buffer=None, residency: bool = True):
+                 make_buffer=None, residency: bool = True,
+                 sharding=None):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if page_size < 1:
@@ -141,6 +142,13 @@ class PagedKVPool:
         self.page_size = int(page_size)
         hd = cfg.d_model // cfg.heads
         shape = (self.num_pages, cfg.heads, self.page_size, hd)
+        #: how the page arrays lay out on a mesh (None = single-device).
+        #: Under tensor parallelism this is P(None, "tp", None, None) —
+        #: heads shard, the page dimension stays a shared allocator arena,
+        #: so alloc/free/block tables/CoW/compact() remain device-count-
+        #: invariant host bookkeeping and defrag's permutation gathers
+        #: per-shard with no resharding round-trip.
+        self.pool_sharding = sharding
         self._mk = make_buffer or (lambda s, d: jnp.zeros(s, d))
         self._shape = shape
         self.buffers = [{"k": self._mk(shape, cfg.dtype),

@@ -379,10 +379,19 @@ def predecessor_signature(sig: str,
     return cands[-1]
 
 
+def _row_mesh(row: dict) -> str:
+    """A stored row's mesh-shape stamp (``"single"`` when unstamped —
+    every pre-mesh observation was measured on one chip)."""
+    return str(row.get("mesh_shape")
+               or (row.get("config") or {}).get("mesh_shape")
+               or "single")
+
+
 def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
                    defaults: Tuple[int, int] = (64, 2),
                    store: Optional[ObservationStore] = None,
-                   compile_weight: float = 1.0
+                   compile_weight: float = 1.0,
+                   mesh_shape: Optional[str] = None
                    ) -> Optional[TuningDecision]:
     """Consult the store for ``sig`` and return a decision, or ``None``
     when the model is cold (no rows for this signature) — the caller
@@ -390,20 +399,42 @@ def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
 
     Placement-matched rows are preferred; with none, every row of the
     signature trains the fit (a chip and its neighbor share cost
-    structure — better than abstaining). A cold *versioned* signature
-    (``name@version``) falls back to its :func:`predecessor_signature`'s
-    rows before abstaining; such decisions carry ``source="transfer"``
-    and name the seed in ``details["seeded_from"]``."""
+    structure — better than abstaining). When ``mesh_shape`` is given
+    (``"single"``, ``"dp4xtp2"``, ...), only rows stamped with the SAME
+    mesh shape train the fit — a single-chip ladder must never transfer
+    onto a sharded engine (its per-tick cost surface includes ICI
+    collectives a single chip never pays), and vice versa. A cold
+    *versioned* signature (``name@version``) falls back to its
+    :func:`predecessor_signature`'s rows before abstaining, preferring
+    predecessor candidates whose rows match the mesh shape; such
+    decisions carry ``source="transfer"`` and name the seed in
+    ``details["seeded_from"]``."""
     store = store if store is not None else get_store()
-    rows = store.rows(sig=sig, placement=placement) or store.rows(sig=sig)
+
+    def _rows_for(s: str) -> list:
+        got = (store.rows(sig=s, placement=placement)
+               or store.rows(sig=s))
+        if mesh_shape is not None:
+            got = [r for r in got if _row_mesh(r) == mesh_shape]
+        return got
+
+    rows = _rows_for(sig)
     seeded_from = None
     if not rows:
-        pred = predecessor_signature(sig, store.signatures())
-        if pred is not None:
-            rows = (store.rows(sig=pred, placement=placement)
-                    or store.rows(sig=pred))
+        known = list(store.signatures())
+        remaining = set(known)
+        # walk predecessor candidates nearest-first until one has rows
+        # (under a mesh_shape filter the nearest sibling may hold only
+        # other-topology rows — the next-nearest can still seed)
+        while remaining:
+            pred = predecessor_signature(sig, remaining)
+            if pred is None:
+                break
+            remaining.discard(pred)
+            rows = _rows_for(pred)
             if rows:
                 seeded_from = pred
+                break
     if not rows:
         M_DECISIONS.inc(source="default")
         return None
@@ -412,6 +443,8 @@ def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
     if seeded_from is not None:
         decision.source = "transfer"
         decision.details["seeded_from"] = seeded_from
+    if mesh_shape is not None:
+        decision.details["mesh_shape"] = mesh_shape
     M_DECISIONS.inc(source=decision.source)
     _tracing.add_event("tuning_decision", sig=sig,
                        mini_batch_size=decision.mini_batch_size,

@@ -60,7 +60,8 @@ def compare_paged_attn(store: Optional[ObservationStore] = ...,
 def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],
                    defaults: Tuple[int, int] = ...,
                    store: Optional[ObservationStore] = ...,
-                   compile_weight: float = ...
+                   compile_weight: float = ...,
+                   mesh_shape: Optional[str] = ...
                    ) -> Optional[TuningDecision]: ...
 def measured_sweep(make_runner: Callable[..., Any], n_rows: int, *, sig: str,
                    placement: str = ...,

@@ -254,14 +254,17 @@ def _bench_observation(parsed: dict, source_file: str) -> Optional[dict]:
         if os.path.exists(source_file) else None)
 
 
-def _generation_observation(parsed: dict,
-                            source_file: str) -> Optional[dict]:
-    """One observation from a bench record's ``generation`` phase.
+def _generation_observation(parsed: dict, source_file: str,
+                            phase: str = "generation") -> Optional[dict]:
+    """One observation from a bench record's ``generation`` phase (or the
+    ``multichip_generation`` phase via ``phase=``).
 
     Carries ``paged_attn_impl`` (the attention implementation the engine
     decoded with — ``kernel`` or ``gather``) so the cost model can
-    compare the two per signature across the trajectory."""
-    gen = parsed.get("generation")
+    compare the two per signature across the trajectory, and
+    ``mesh_shape`` (``"single"`` or ``"dp4xtp2"``-style) so a ladder
+    learned on one chip topology is never transferred onto another."""
+    gen = parsed.get(phase)
     if not isinstance(gen, dict):
         return None
     tps = gen.get("tok_per_sec")
@@ -269,12 +272,14 @@ def _generation_observation(parsed: dict,
         return None
     pa = gen.get("paged_attn") if isinstance(gen.get("paged_attn"),
                                              dict) else {}
+    mesh = str(gen.get("mesh_shape") or "single")
     obs = Observation(
         sig="generation",
         source="bench",
         placement=str(parsed.get("device") or parsed.get("platform")
                       or "default"),
         config={"paged_attn_impl": pa.get("impl"),
+                "mesh_shape": mesh,
                 "mini_batch_size": None, "prefetch_depth": None,
                 "buckets": None},
         rows=int(gen.get("tokens", 0)),
@@ -284,6 +289,7 @@ def _generation_observation(parsed: dict,
         if os.path.exists(source_file) else None)
     # top-level for cheap grouping without digging into config
     obs["paged_attn_impl"] = pa.get("impl")
+    obs["mesh_shape"] = mesh
     return obs
 
 
@@ -314,10 +320,11 @@ def import_bench_records(paths: Sequence[str],
         if obs is not None:
             store.record(obs)
             n += 1
-        gen = _generation_observation(parsed, path)
-        if gen is not None:
-            store.record(gen)
-            n += 1
+        for phase in ("generation", "multichip_generation"):
+            gen = _generation_observation(parsed, path, phase=phase)
+            if gen is not None:
+                store.record(gen)
+                n += 1
     return n
 
 

@@ -976,6 +976,89 @@ def test_tpu016_suppressible_with_justification():
 
 
 # ---------------------------------------------------------------------------
+# TPU017 unsharded-pallas-call
+
+
+def test_tpu017_bare_pallas_in_mesh_jit_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.sharding import Mesh
+
+        @jax.jit
+        def run(mesh: Mesh, x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU017"]
+    assert f.severity == "warning"
+    assert "shard_map" in f.message
+
+
+def test_tpu017_pallas_via_helper_fires():
+    # the hazard hides one call deep: the jit entry takes the mesh, a
+    # plain helper owns the pallas_call — reachability must catch it
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.experimental import pallas as pl
+
+        def attend(x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+
+        @jax.jit
+        def serve(mesh, x):
+            return attend(x)
+        """)
+    assert codes(findings).count("TPU017") == 1
+
+
+def test_tpu017_sharding_annotation_counts_as_mesh():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.sharding import NamedSharding
+
+        @jax.jit
+        def run(spec: NamedSharding, x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """)
+    assert codes(findings).count("TPU017") == 1
+
+
+def test_tpu017_quiet_when_mounted_or_unmeshed():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.experimental import pallas as pl
+
+        @jax.jit
+        def mounted(mesh, x):
+            def shard(xs):
+                return pl.pallas_call(kern, out_shape=xs)(xs)
+            return jax.shard_map(shard, mesh=mesh, in_specs=None,
+                                 out_specs=None)(x)
+
+        @jax.jit
+        def single_chip(x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """)
+    assert "TPU017" not in codes(findings)
+
+
+def test_tpu017_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax
+        from jax.experimental import pallas as pl
+
+        @jax.jit
+        def run(mesh, x):
+            # single-device submesh by contract here
+            # tpulint: disable=TPU017
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """, keep_suppressed=True)
+    assert "TPU017" not in codes(findings)
+    assert "TPU017" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 
 

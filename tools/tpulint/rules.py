@@ -1,7 +1,8 @@
 """Per-module rules: the jit-boundary hazards (TPU001-TPU004), the
 ad-hoc-telemetry check (TPU007), the ad-hoc-id-minting check (TPU008),
-the observability-hygiene checks (TPU010, TPU011, TPU015), and the
-ad-hoc-hash-routing check (TPU016).
+the observability-hygiene checks (TPU010, TPU011, TPU015), the
+ad-hoc-hash-routing check (TPU016), and the unsharded-pallas-call
+check (TPU017).
 
 Each rule is an ``ast.NodeVisitor`` that tracks two context stacks while it
 walks a module — the innermost *jit context* (entered through a
@@ -1006,4 +1007,103 @@ class AdhocHashRouting(Rule):
                 f"cold caches); route through "
                 f"serving.ConsistentHashRing, which moves only ~1/n of "
                 f"keys per membership change"))
+        return iter(findings)
+
+
+def _mesh_param(module: ModuleInfo, fn: ast.AST) -> Optional[str]:
+    """The parameter of ``fn`` that carries a mesh, or None: a parameter
+    named ``mesh``, or one annotated with ``Mesh``/``NamedSharding``
+    (including inside ``Optional[...]`` and string annotations)."""
+    args = fn.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        if a.arg == "mesh":
+            return a.arg
+        if a.annotation is None:
+            continue
+        for sub in ast.walk(a.annotation):
+            ident = None
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ident = sub.attr
+            elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if re.search(r"\b(Mesh|NamedSharding)\b", sub.value):
+                    return a.arg
+            if ident in ("Mesh", "NamedSharding"):
+                return a.arg
+    return None
+
+
+@register_rule
+class UnshardedPallasCall(Rule):
+    code = "TPU017"
+    name = "unsharded-pallas-call"
+    severity = "warning"
+    doc = ("A bare ``pallas_call`` reachable from a jitted function that "
+           "takes a ``Mesh``/``NamedSharding`` argument, with no "
+           "``shard_map`` mount anywhere on the path. A Pallas kernel is "
+           "not GSPMD-partitionable: inside a sharded jit, XLA gathers "
+           "every operand onto one device, silently serializing the "
+           "'parallel' program and blowing per-device memory at scale. "
+           "Mount the kernel with ``jax.shard_map`` (per-shard specs over "
+           "the mesh axes) so each device runs it on its own slice — the "
+           "pattern ops/paged_attention.py uses — or drop the mesh "
+           "argument if the program is genuinely single-device.")
+
+    def check(self, module: ModuleInfo):
+        funcs = {}
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            funcs.setdefault(fn.name, fn)
+        # per function: bare pallas_call sites, whether a shard_map mount
+        # appears anywhere inside (mounted subtrees are quiet — the mount
+        # governs everything it wraps), and intra-module callees by name
+        info = {}
+        for name, fn in funcs.items():
+            pallas: List[ast.Call] = []
+            mounted = False
+            callees: Set[str] = set()
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = module.dotted(sub.func)
+                if dotted is not None:
+                    if (dotted == "pallas_call"
+                            or dotted.endswith(".pallas_call")):
+                        pallas.append(sub)
+                    if "shard_map" in dotted:
+                        mounted = True
+                if isinstance(sub.func, ast.Name):
+                    callees.add(sub.func.id)
+            info[name] = (pallas, mounted, callees)
+        findings: List[Finding] = []
+        flagged: Set[int] = set()
+        for name, fn in funcs.items():
+            if jit_decoration(module, fn) is None:
+                continue
+            mp = _mesh_param(module, fn)
+            if mp is None:
+                continue
+            seen: Set[str] = set()
+            stack = [name]
+            while stack:
+                cur = stack.pop()
+                if cur in seen or cur not in info:
+                    continue
+                seen.add(cur)
+                pallas, mounted, callees = info[cur]
+                if mounted:
+                    continue
+                for node in pallas:
+                    if id(node) in flagged:
+                        continue
+                    flagged.add(id(node))
+                    findings.append(self.finding(
+                        module, node,
+                        f"bare pallas_call reachable from jitted "
+                        f"'{fn.name}' (mesh argument '{mp}') with no "
+                        f"shard_map mount — under a sharded jit XLA "
+                        f"gathers the kernel's operands onto ONE device; "
+                        f"mount it via jax.shard_map with per-shard "
+                        f"specs, as ops/paged_attention.py does"))
+                stack.extend(callees)
         return iter(findings)
