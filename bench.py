@@ -598,6 +598,7 @@ def _generation_phase(on_tpu: bool) -> dict:
         # since nothing was saved)
         "paged_attn": {
             "impl": eng._attn_impl,
+            "kv_dtype": eng._kv_dtype,
             "ticks_kernel": pool.stats.get("attn_ticks_kernel", 0),
             "ticks_gather": pool.stats.get("attn_ticks_gather", 0),
             "gather_bytes_total": pool.stats.get("gather_bytes", 0),
@@ -612,7 +613,68 @@ def _generation_phase(on_tpu: bool) -> dict:
                              if h["knob"] == "chunk"],
         "engine_stats": dict(eng.stats),
     }
+    out["quantized"] = _quantized_generation_pass(cfg, params)
     return out
+
+
+def _quantized_generation_pass(cfg, params) -> dict:
+    """One int8-KV pass through the same engine: the quantized data plane's
+    realized savings, counter-asserted from the pool's own byte accounting.
+
+    ``hbm_bytes_saved_per_step`` is what a decode tick stopped reading from
+    HBM versus the bf16 layout at identical geometry (the >=1.9x acceptance
+    number at hd=64); ``contexts_held_at_budget`` is how many max_len
+    contexts the SAME page-budget bytes now hold. ``kv_quant_error_*`` is
+    the dequant-oracle relative RMS the SLO canary watches."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.kv_quant import kv_bytes_per_position
+    from mmlspark_tpu.serving.continuous import ContinuousDecoder
+    eng = ContinuousDecoder(params, cfg, max_slots=4, max_len=min(
+        cfg.max_len, 96), page_size=16, kv_dtype="int8", quant_probe=1)
+    rng = np.random.default_rng(7)
+    reqs = [eng.submit(rng.integers(1, cfg.vocab, 6 + 5 * i,
+                                    dtype=np.int32), max_new_tokens=8)
+            for i in range(4)]
+    t0 = time.perf_counter()
+    steps = 0
+    while any(r is not None for r in eng._slot_req) or eng._waiting:
+        eng.step()
+        steps += 1
+    elapsed = time.perf_counter() - t0
+    toks = sum(len(r.tokens) for r in reqs)
+    pool = eng._kv
+    hd = cfg.d_model // cfg.heads
+    bf16_pos = cfg.layers * kv_bytes_per_position(
+        cfg.heads, hd, jnp.bfloat16, False)
+    quant_pos = pool.bytes_per_position()
+    bf16_tick = eng._S * eng._Lc * bf16_pos
+    stats = pool.stats
+    probes = stats["quant_error_probes"]
+    return {
+        "kv_dtype": eng._kv_dtype,
+        "tok_per_sec": round(toks / elapsed, 2) if elapsed > 0 else None,
+        "tokens": toks, "steps": steps,
+        "kv_bytes_per_position": quant_pos,
+        "kv_bytes_per_position_bf16": bf16_pos,
+        "hbm_bytes_per_tick": eng._gather_bytes_tick,
+        "hbm_bytes_saved_per_step": bf16_tick - eng._gather_bytes_tick,
+        "hbm_bytes_ratio_vs_bf16": round(bf16_pos / quant_pos, 4),
+        "bytes_per_token": round(
+            steps * eng._gather_bytes_tick / max(1, toks), 1),
+        # fixed byte budget = the bf16 pool's device footprint; the
+        # quantized layout packs this many more max_len contexts in it
+        "contexts_held_at_budget": {
+            "budget_bytes": pool.num_pages * eng._page * bf16_pos,
+            "bf16": pool.num_pages * eng._page * bf16_pos
+            // max(1, eng._L * bf16_pos),
+            "quantized": pool.num_pages * eng._page * bf16_pos
+            // max(1, eng._L * quant_pos)},
+        "kv_quant_error_probes": probes,
+        "kv_quant_error_mean": (
+            round(stats["quant_error_sum"] / probes, 6) if probes else None),
+        "kv_quant_error_max": (
+            round(stats["quant_error_max"], 6) if probes else None),
+    }
 
 
 def _multichip_generation_phase(mesh=None) -> dict:
@@ -650,9 +712,10 @@ def _multichip_generation_phase(mesh=None) -> dict:
     prompts = [rng.integers(1, cfg.vocab, 6 + (i % 3) * 7, dtype=np.int32)
                for i in range(2 * slots)]
 
-    def _run(m):
+    def _run(m, kv_dtype=None):
         eng = ContinuousDecoder(params, cfg, max_slots=slots, max_len=64,
-                                mesh=m, page_size=8)
+                                mesh=m, page_size=8, kv_dtype=kv_dtype,
+                                quant_probe=1 if kv_dtype else 0)
         warm = [eng.submit(p, max_new_tokens=2) for p in prompts[:3]]
         while any(r is not None for r in eng._slot_req) or eng._waiting:
             eng.step()
@@ -671,6 +734,12 @@ def _multichip_generation_phase(mesh=None) -> dict:
 
     tps_1, _, _, p50_1, toks_1, _ = _run(None)
     tps_m, toks, wall, p50_m, toks_m, eng = _run(mesh)
+    # one quantized pass through the SAME mesh mount: the sharded int8
+    # data plane (scale pools ride P(None, tp, None)) must decode the
+    # same workload; token parity vs the quantized single-chip run is
+    # the dryrun counter-assert that the sharded dequant kernel ran
+    _, _, _, _, toks_q1, _ = _run(None, kv_dtype="int8")
+    tps_q, toks_qn, _, _, toks_qm, eng_q = _run(mesh, kv_dtype="int8")
     pool = eng._kv
     return {
         "mesh_shape": mesh_shape(mesh), "chips": chips,
@@ -685,9 +754,25 @@ def _multichip_generation_phase(mesh=None) -> dict:
         "token_parity_vs_single_chip": toks_m == toks_1,
         "paged_attn": {
             "impl": eng._attn_impl,
+            "kv_dtype": eng._kv_dtype,
             "ticks_kernel": pool.stats.get("attn_ticks_kernel", 0),
             "ticks_gather": pool.stats.get("attn_ticks_gather", 0),
             "gather_bytes_total": pool.stats.get("gather_bytes", 0)},
+        "quantized": {
+            "kv_dtype": eng_q._kv_dtype,
+            "tok_per_sec": round(tps_q, 2), "tokens": toks_qn,
+            "hbm_bytes_per_tick": eng_q._gather_bytes_tick,
+            # int8 rounding amplifies the tp psum reduction-order ulps,
+            # so mesh-vs-single parity is asserted over a short horizon;
+            # drift past it is accumulation, not a data-plane bug (the
+            # written pages themselves are bit-identical per write)
+            "token_parity_horizon": 4,
+            "token_parity_vs_single_chip": (
+                [t[:4] for t in toks_qm] == [t[:4] for t in toks_q1]),
+            "kv_quant_error_probes":
+                eng_q._kv.stats["quant_error_probes"],
+            "kv_quant_error_last":
+                eng_q._kv.stats["quant_error_last"]},
     }
 
 
@@ -706,8 +791,8 @@ def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
     import glob
 
     from mmlspark_tpu.tuning import (CostModel, ObservationStore,
-                                     compare_paged_attn, get_store,
-                                     import_bench_records)
+                                     compare_kv_dtype, compare_paged_attn,
+                                     get_store, import_bench_records)
 
     here = os.path.dirname(os.path.abspath(__file__))
     priors = sorted(glob.glob(os.path.join(here, "BENCH_r0*.json")))
@@ -730,6 +815,9 @@ def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
     pa = compare_paged_attn(store)
     if pa:
         out["paged_attn_comparison"] = pa
+    kd = compare_kv_dtype(store)
+    if kd:
+        out["kv_dtype_comparison"] = kd
 
     histogram = {batch: n_rows // batch}
     if n_rows % batch:

@@ -12,6 +12,7 @@ matches the main class at ``ImageTransformer.scala:417+``.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -22,7 +23,8 @@ from ..core.pipeline import Transformer
 from .schema import ImageSchema, decode_image, make_image
 
 __all__ = ["ImageTransformer", "ResizeImage", "CropImage", "CenterCropImage",
-           "ColorFormat", "Blur", "Threshold", "GaussianKernel", "Flip"]
+           "ColorFormat", "Blur", "Threshold", "GaussianKernel", "Flip",
+           "normalize_program"]
 
 
 def _cv2():
@@ -140,6 +142,45 @@ class Flip:
         return {"action": "flip", "flipCode": flip_code}
 
 
+@functools.lru_cache(maxsize=None)
+def normalize_program(scale: float, mean: Optional[tuple],
+                      std: Optional[tuple], channels: int,
+                      bgr_to_rgb: bool = True):
+    """The jitted on-device half of the tensor path: dense ``(N, H, W, C)``
+    **uint8** batch in, normalized float32 ``(N, C, H, W)`` batch out.
+
+    Same math as the host tensor branch of :class:`ImageTransformer`
+    (scale, BGR→RGB flip, mean/std), but it runs AFTER the h2d transfer —
+    so the wire carries one byte per pixel-channel instead of four. The
+    cache key is the normalization config, so steady state reuses one
+    compiled program per transformer configuration."""
+    import jax
+    import jax.numpy as jnp
+
+    perm = ([2, 1, 0] + list(range(3, channels))
+            if bgr_to_rgb and channels >= 3 else list(range(channels)))
+    mean_t = None if mean is None else np.asarray(mean, np.float32)
+    std_t = None if std is None else np.asarray(std, np.float32)
+
+    def _norm(x):
+        y = x.astype(jnp.float32) * jnp.float32(scale)
+        y = y[..., jnp.asarray(perm)]
+        if mean_t is not None:
+            y = y - mean_t
+        if std_t is not None:
+            y = y / std_t
+        return jnp.transpose(y, (0, 3, 1, 2))
+
+    return jax.jit(_norm)
+
+
+def _as_key(v) -> Optional[tuple]:
+    if v is None:
+        return None
+    arr = np.asarray(v, np.float32).reshape(-1)
+    return tuple(float(x) for x in arr)
+
+
 class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
     """Apply a list of image ops; optionally emit a normalized float tensor.
 
@@ -232,3 +273,87 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
         col = df[self.get("input_col")]
         return df.with_column(self.get("output_col"),
                               object_col([self._apply_one(c) for c in col]))
+
+    # -- dense uint8 device ingest -------------------------------------------
+    def _apply_uint8(self, cell) -> Optional[np.ndarray]:
+        """The host half of :meth:`transform_resident`: decode + cv2 stages
+        only, staying HWC **uint8** end to end (no float cast, no
+        normalize — that happens on device, after the transfer)."""
+        if cell is None:
+            return None
+        if isinstance(cell, (bytes, bytearray)):
+            struct = decode_image(bytes(cell))
+            if struct is None:
+                return None
+            img = struct["data"]
+        elif ImageSchema.is_image(cell):
+            img = np.asarray(cell["data"], dtype=np.uint8)
+        else:
+            img = np.asarray(cell, dtype=np.uint8)
+        for stage in self.get("stages"):
+            op = _OPS.get(stage["action"])
+            if op is None:
+                raise ValueError(
+                    f"unsupported transformation {stage['action']!r}")
+            img = op(img, stage)
+            if img.ndim == 2:
+                img = img[:, :, None]
+        return np.ascontiguousarray(img, dtype=np.uint8)
+
+    def transform_resident(self, df: DataFrame,
+                           slab_pool=None) -> DataFrame:
+        """Dense-uint8 device tensor path: cv2 stages on the host (uint8
+        throughout), ONE counted ingest h2d of the dense ``(N, H, W, C)``
+        uint8 batch, then the jitted :func:`normalize_program` turns it
+        into the normalized float32 CHW tensor ON DEVICE.
+
+        Versus staging the host-normalized float32 tensor, the wire moves
+        4x fewer bytes for the same resident result — the
+        ``mmlspark_residency_h2d_bytes_total{site="ingest"}`` counter is
+        the proof, and the tests pin it. The output column lands device-
+        born via :meth:`DataFrame.with_device_column` (its host side is a
+        lazy mirror; no d2h until someone materializes it).
+
+        Requires the stage list to produce one uniform image shape (a
+        ``resize``/``crop``/``centercrop`` stage in the list); raises
+        ``ValueError`` otherwise. ``slab_pool`` (a
+        :class:`~mmlspark_tpu.models.runner.StagingSlabPool`) makes the
+        dense host batch a reusable pre-touched uint8 slab so the async
+        put streams from warm pages."""
+        from ..core.residency import DeviceColumn
+        cells = [self._apply_uint8(c) for c in df[self.get("input_col")]]
+        imgs = [c for c in cells if c is not None]
+        if not imgs:
+            raise ValueError("transform_resident: no decodable images")
+        shape = imgs[0].shape
+        if any(i.shape != shape for i in imgs):
+            raise ValueError(
+                "transform_resident needs a uniform output shape — add a "
+                f"resize/crop stage (saw {sorted({i.shape for i in imgs})})")
+        if any(c is None for c in cells):
+            raise ValueError("transform_resident: null image cells")
+        n = len(cells)
+        if slab_pool is not None:
+            slab = slab_pool.acquire((n,) + shape, np.uint8)
+        else:
+            slab = np.empty((n,) + shape, np.uint8)
+        for i, img in enumerate(cells):
+            slab[i] = img
+        # counted: ONE site="ingest" h2d of n*H*W*C uint8 bytes
+        dense = DeviceColumn.from_host(slab, df.partition_bounds())
+        prog = normalize_program(
+            float(self.get("color_scale_factor")),
+            _as_key(self.get_or_none("normalize_mean")),
+            _as_key(self.get_or_none("normalize_std")),
+            int(shape[-1]))
+        # device-born: no transfer, no count
+        chunks = [prog(chunk) for chunk in dense.device_chunks()]
+        if slab_pool is not None:
+            # the CPU backend may alias the numpy buffer into the "device"
+            # array — only recycle the slab once the normalized outputs
+            # (which read through it) are materialized
+            import jax
+            jax.block_until_ready(chunks)
+            slab_pool.release(slab)
+        out = DeviceColumn.from_device(chunks)
+        return df.with_device_column(self.get("output_col"), out)

@@ -722,30 +722,56 @@ def decode_window_ragged(params: Dict, tokens: jnp.ndarray,
 # hatch.
 
 def init_paged_cache(cfg: TransformerConfig, num_pages: int,
-                     page_size: int):
+                     page_size: int, kv_dtype=None):
     """Per-layer (num_pages, H, page_size, hd) k/v page pools (page 0 is
-    the trash page — allocators must never hand it out)."""
+    the trash page — allocators must never hand it out). With
+    ``kv_dtype`` ("int8"/"fp8") pages store quantized values and each
+    layer dict gains ``(num_pages, H, page_size)`` ``k_scale``/
+    ``v_scale`` arrays (see ``ops/kv_quant.py``)."""
+    from ...ops.kv_quant import SCALE_DTYPE, kv_store_dtype
     hd = cfg.d_model // cfg.heads
     shape = (num_pages, cfg.heads, page_size, hd)
-    return [{"k": jnp.zeros(shape, cfg.dtype),
-             "v": jnp.zeros(shape, cfg.dtype)}
+    store = kv_store_dtype(kv_dtype)
+    if store is None:
+        return [{"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
+                for _ in range(cfg.layers)]
+    sshape = shape[:3]
+    return [{"k": jnp.zeros(shape, store),
+             "v": jnp.zeros(shape, store),
+             "k_scale": jnp.ones(sshape, SCALE_DTYPE),
+             "v_scale": jnp.ones(sshape, SCALE_DTYPE)}
             for _ in range(cfg.layers)]
 
 
-def paged_gather(cache_pages, block_tables, length: int):
+def _is_quant_cache(c) -> bool:
+    """A quantized page-pool layer dict carries its scale arrays."""
+    return "k_scale" in c
+
+
+def paged_gather(cache_pages, block_tables, length: int, out_dtype=None):
     """Assemble each row's pages into contiguous (B, H, length, hd) k/v.
 
     ``block_tables`` (B, P) int32 physical page ids per logical page;
     ``length`` trims the last page's tail so the result has EXACTLY the
     contiguous cache's key length — attention reductions then run over
     the same number of lanes, which is what keeps the paged step bitwise
-    equal to the contiguous one."""
+    equal to the contiguous one. Quantized pools dequantize through
+    their gathered scales (in ``out_dtype``, default f32) — this is the
+    oracle path the quant-error gauge measures the kernel against."""
+    from ...ops.kv_quant import dequantize_kv
     out = []
     for c in cache_pages:
+        quant = _is_quant_cache(c)
         row = {}
         for kk in ("k", "v"):
             g = c[kk][block_tables]              # (B, P, H, page, hd)
             B, Pp, H, pg, hd = g.shape
+            if quant:
+                s = c[kk + "_scale"][block_tables]   # (B, P, H, page)
+                g = dequantize_kv(g, s, out_dtype or jnp.float32)
+            elif out_dtype is not None:
+                g = g.astype(out_dtype)
             g = g.transpose(0, 2, 1, 3, 4).reshape(B, H, Pp * pg, hd)
             row[kk] = g[:, :, :length]
         out.append(row)
@@ -756,11 +782,15 @@ def paged_scatter_rows(cache_pages, rows, block_tables, page_size: int):
     """Write full contiguous (B, H, L, hd) k/v rows (a prefill output)
     into the pool through each row's block table. Logical pages past a
     row's allocation must map to the trash page in ``block_tables`` —
-    their writes collide harmlessly there."""
+    their writes collide harmlessly there. Quantized pools quantize each
+    position through the sanctioned ``quantize_kv`` and scatter the
+    per-head scales alongside."""
+    from ...ops.kv_quant import quantize_kv
     n_pages = (rows[0]["k"].shape[2] + page_size - 1) // page_size
     dest = block_tables[:, :n_pages].reshape(-1)         # (B*n_pages,)
     out = []
     for c, rc in zip(cache_pages, rows):
+        quant = _is_quant_cache(c)
         row = {}
         for kk in ("k", "v"):
             r = rc[kk]                                   # (B, H, L, hd)
@@ -770,7 +800,13 @@ def paged_scatter_rows(cache_pages, rows, block_tables, page_size: int):
             r = r.reshape(B, H, n_pages, page_size, hd)
             r = r.transpose(0, 2, 1, 3, 4).reshape(
                 B * n_pages, H, page_size, hd)
-            row[kk] = c[kk].at[dest].set(r)
+            if quant:
+                q, sc = quantize_kv(r, c[kk].dtype)
+                row[kk] = c[kk].at[dest].set(q)
+                row[kk + "_scale"] = c[kk + "_scale"].at[dest].set(
+                    sc.astype(c[kk + "_scale"].dtype))
+            else:
+                row[kk] = c[kk].at[dest].set(r)
         out.append(row)
     return out
 
@@ -781,7 +817,10 @@ def _paged_writeback(cache_pages, new_cache, block_tables, wpos,
     gathered cache back into the physical pages. Inactive rows (and only
     they) are redirected to trash page 0 — their "new" values are the old
     ones decode_step_ragged preserved, but their block-table rows may
-    reference pages that were freed and reallocated to another request."""
+    reference pages that were freed and reallocated to another request.
+    Quantized pools write ``quantize_kv``'d bytes plus scales — the same
+    helper every other writer uses, so the bytes agree bit-for-bit."""
+    from ...ops.kv_quant import quantize_kv
     B, W = wpos.shape
     phys = jnp.take_along_axis(block_tables, wpos // page_size, axis=1)
     if active is not None:
@@ -790,13 +829,20 @@ def _paged_writeback(cache_pages, new_cache, block_tables, wpos,
     of = (wpos % page_size).reshape(-1)
     out = []
     for c, nc in zip(cache_pages, new_cache):
+        quant = _is_quant_cache(c)
         row = {}
         for kk in ("k", "v"):
             vals = jnp.take_along_axis(
                 nc[kk], wpos[:, None, :, None], axis=2)  # (B, H, W, hd)
             H, hd = vals.shape[1], vals.shape[3]
             vals = vals.transpose(0, 2, 1, 3).reshape(B * W, H, hd)
-            row[kk] = c[kk].at[pf, :, of].set(vals)
+            if quant:
+                q, sc = quantize_kv(vals, c[kk].dtype)
+                row[kk] = c[kk].at[pf, :, of].set(q)
+                row[kk + "_scale"] = c[kk + "_scale"].at[pf, :, of].set(
+                    sc.astype(c[kk + "_scale"].dtype))
+            else:
+                row[kk] = c[kk].at[pf, :, of].set(vals)
         out.append(row)
     return out
 
@@ -840,11 +886,20 @@ def _decode_window_paged_kernel(params: Dict, tokens: jnp.ndarray,
         if cfg.position == "rope":
             q = _rot_half(q, cos, sin)
             k = _rot_half(k, cos, sin)
-        ctx, kp, vp = paged_attention_window(
-            q, k.astype(dt), v.astype(dt), c["k"], c["v"],
-            block_tables, pos, active=active, mesh=mesh,
-            slot_axis=slot_axis, head_axis=head_axis)
-        new_pages.append({"k": kp, "v": vp})
+        if _is_quant_cache(c):
+            ctx, kp, vp, ks, vs = paged_attention_window(
+                q, k.astype(dt), v.astype(dt), c["k"], c["v"],
+                block_tables, pos, active=active,
+                k_scale=c["k_scale"], v_scale=c["v_scale"], mesh=mesh,
+                slot_axis=slot_axis, head_axis=head_axis)
+            new_pages.append({"k": kp, "v": vp,
+                              "k_scale": ks, "v_scale": vs})
+        else:
+            ctx, kp, vp = paged_attention_window(
+                q, k.astype(dt), v.astype(dt), c["k"], c["v"],
+                block_tables, pos, active=active, mesh=mesh,
+                slot_axis=slot_axis, head_axis=head_axis)
+            new_pages.append({"k": kp, "v": vp})
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, W, cfg.d_model)
         h = h + ctx @ lp["out"]["w"].astype(dt) + lp["out"]["b"].astype(dt)
         x = _norm(h.astype(jnp.float32), lp["ln2"], cfg).astype(dt)
@@ -883,7 +938,8 @@ def decode_step_paged(params: Dict, tokens: jnp.ndarray, pos: jnp.ndarray,
             block_tables, cfg, page_size, active, mesh=mesh,
             slot_axis=slot_axis, head_axis=head_axis)
         return logits[:, 0], pages
-    gathered = paged_gather(cache_pages, block_tables, length)
+    gathered = paged_gather(cache_pages, block_tables, length,
+                            out_dtype=cfg.dtype)
     logits, new = decode_step_ragged(params, tokens, pos.astype(jnp.int32),
                                      gathered, cfg, active)
     pages = _paged_writeback(cache_pages, new, block_tables,
@@ -915,7 +971,8 @@ def decode_window_paged(params: Dict, tokens: jnp.ndarray,
                                            mesh=mesh, slot_axis=slot_axis,
                                            head_axis=head_axis)
     wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)
-    gathered = paged_gather(cache_pages, block_tables, length)
+    gathered = paged_gather(cache_pages, block_tables, length,
+                            out_dtype=cfg.dtype)
     logits, new = decode_window_ragged(params, tokens, pos, gathered,
                                        cfg, active)
     pages = _paged_writeback(cache_pages, new, block_tables, wpos,

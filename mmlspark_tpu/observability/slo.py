@@ -72,6 +72,12 @@ _M_SLO_P99 = _metric_gauge(
     "mmlspark_slo_p99_seconds",
     "Rolling-window p99 latency per class (refreshed at scorecard time)",
     ("transport", "route", "model", "tenant"))
+_M_KV_QUANT = _metric_gauge(
+    "mmlspark_kv_quant_error",
+    "Latest sampled KV quantization error per model: relative RMS of "
+    "dequantize(quantize(rows)) vs the bf16 oracle rows at write time "
+    "(0 on unquantized engines; feeds the registry's canary check)",
+    ("model",))
 
 #: classes beyond this cap collapse into ("other", "other", "other",
 #: "other") — a label-cardinality bound, same motivation as Prometheus
@@ -183,6 +189,10 @@ class SloTracker:
         self._uppers: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
         self._lock = threading.Lock()
         self._classes: Dict[Tuple[str, str, str, str], _Class] = {}
+        # model -> ring of [epoch, sum, count, max] KV quant-error
+        # samples (same epoch math as the request ring; bounded by
+        # max_classes like everything else label-shaped)
+        self._quant: Dict[str, List[List[float]]] = {}
 
     # -- recording -----------------------------------------------------------
     def _class(self, transport: str, route: str, model: str,
@@ -242,6 +252,52 @@ class SloTracker:
             b.shed += 1
         _M_SLO_SHED.inc(transport=transport, route=route, model=model,
                         tenant=tenant)
+
+    def note_kv_quant_error(self, model: str, rms: float) -> None:
+        """One sampled KV quantization-error observation for ``model``
+        (the engine's write-time oracle probe — relative RMS of the
+        quantize/dequantize roundtrip vs the bf16 rows). Rolls through
+        the same window ring as request stats so
+        :meth:`model_window`'s ``kv_quant_error`` and a canary's
+        latency/error view cover the same period."""
+        model = str(model)
+        rms = float(rms)
+        with self._lock:
+            ring = self._quant.get(model)
+            if ring is None:
+                if len(self._quant) >= self._max_classes:
+                    model = "other"
+                    ring = self._quant.get(model)
+                if ring is None:
+                    ring = self._quant[model] = [
+                        [-1, 0.0, 0, 0.0] for _ in range(self.num_buckets)]
+            epoch = int(self._clock() / self._width)
+            b = ring[epoch % self.num_buckets]
+            if b[0] != epoch:
+                b[0], b[1], b[2], b[3] = epoch, 0.0, 0, 0.0
+            b[1] += rms
+            b[2] += 1
+            b[3] = max(b[3], rms)
+        _M_KV_QUANT.set(rms, model=model)
+
+    def _quant_window(self, model: str) -> Dict[str, object]:
+        """Merged live-window quant-error stats for ``model`` (caller
+        holds the lock). ``mean`` is None when nothing was sampled."""
+        ring = self._quant.get(str(model))
+        out = {"count": 0, "mean": None, "max": None}
+        if ring is None:
+            return out
+        now_epoch = int(self._clock() / self._width)
+        total, n, mx = 0.0, 0, 0.0
+        for b in ring:
+            if b[0] < 0 or now_epoch - b[0] >= self.num_buckets:
+                continue
+            total += b[1]
+            n += b[2]
+            mx = max(mx, b[3])
+        if n:
+            out = {"count": n, "mean": total / n, "max": mx}
+        return out
 
     # -- reading -------------------------------------------------------------
     def _window_view(self, cls: _Class) -> Tuple[int, int, int, List[int],
@@ -311,6 +367,7 @@ class SloTracker:
             views = [self._window_view(cls)
                      for key, cls in self._classes.items()
                      if key[2] == str(model)]
+            quant = self._quant_window(model)
         count = sum(v[0] for v in views)
         errors = sum(v[1] for v in views)
         lat = [0] * (len(self._uppers) + 1)
@@ -319,7 +376,9 @@ class SloTracker:
                 lat[i] += c
         return {"model": str(model), "count": count, "errors": errors,
                 "error_rate": (errors / count) if count else 0.0,
-                "p99": self._quantile(lat, 0.99)}
+                "p99": self._quantile(lat, 0.99),
+                "kv_quant_error": quant["mean"],
+                "kv_quant_samples": quant["count"]}
 
     def scorecard(self) -> Dict[str, object]:
         """JSON-safe rolling scorecard over every workload class.
@@ -335,6 +394,8 @@ class SloTracker:
             items = sorted(self._classes.items())
             views = [(key, cls.total, cls.errors_total, cls.shed_total,
                       self._window_view(cls)) for key, cls in items]
+            kv_quant = {m: self._quant_window(m)
+                        for m in sorted(self._quant)}
         budget = 1.0 - self.policy.availability
         classes: List[Dict[str, object]] = []
         for (transport, route, model, tenant), total, errors_total, \
@@ -370,11 +431,13 @@ class SloTracker:
                 "window_seconds": self.window_seconds,
                 "num_buckets": self.num_buckets,
                 "policy": self.policy.as_dict(),
-                "classes": classes}
+                "classes": classes,
+                "kv_quant": kv_quant}
 
     def reset(self) -> None:
         with self._lock:
             self._classes.clear()
+            self._quant.clear()
 
 
 # -- the process-global tracker ----------------------------------------------

@@ -85,6 +85,7 @@ import jax
 import jax.numpy as jnp
 
 from .pallas_kernels import _LANE, _round_up
+from .kv_quant import quantize_kv
 
 __all__ = ["paged_attention", "paged_attention_window", "resolve_impl",
            "sublane_multiple", "aligned_page_size"]
@@ -173,6 +174,21 @@ def _page_scores(q, kp_ref, scale):
     kp = kp_ref[0].astype(jnp.float32)                  # (H, page, hd)
     return jax.lax.dot_general(
         q, kp, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale     # (H, W, page)
+
+
+def _deq_block(p_ref, s_ref):
+    """Dequantize one (1, H, page, hd) page block with its (1, H, page)
+    scale block — the IN-KERNEL dequant: both blocks arrived through the
+    same block-table index_map, so this multiply happens in VMEM right
+    after the page DMA and the quantized bytes are all HBM ever moves."""
+    return (p_ref[0].astype(jnp.float32) *
+            s_ref[0].astype(jnp.float32)[:, :, None])   # (H, page, hd)
+
+
+def _page_scores_q(q, kp_ref, ks_ref, scale):
+    return jax.lax.dot_general(
+        q, _deq_block(kp_ref, ks_ref), (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32) * scale     # (H, W, page)
 
 
@@ -321,6 +337,152 @@ def _pa_window_kernel(bt_ref, pos_ref, q_ref, kn_ref, vn_ref, kp_ref,
             jnp.int32, (1, 1, page), 2)
         _fold(m_scr, l_scr, acc_scr, s, t < pos,
               vp_ref[0].astype(jnp.float32))
+
+    @pl.when(p == n_pages - 1)
+    def _fin():
+        _finalize(o_ref, l_scr, acc_scr)
+
+
+# ---- quantized kernels ------------------------------------------------------
+#
+# Same grid, same online-softmax state, same masks as the bf16 kernels
+# above — the only differences are (a) two extra (1, H, page) scale
+# blocks riding the SAME block-table index_map as their page blocks,
+# dequantized in VMEM by _deq_block before the dot, and (b) the fused
+# variant's in-kernel scatter quantizing each window row through
+# quantize_kv (the sanctioned helper — bit-identical to what
+# _pool_write_rows/_paged_writeback write, so every writer agrees).
+
+def _pa_read_kernel_q(bt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref,
+                      vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      scale, page, n_pages):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bound = len_ref[b]
+
+    @pl.when(p * page < bound)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                # (H, W, hd)
+        s = _page_scores_q(q, kp_ref, ks_ref, scale)
+        t = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page), 2)
+        _fold(m_scr, l_scr, acc_scr, s, t < bound,
+              _deq_block(vp_ref, vs_ref))
+
+    @pl.when(p == n_pages - 1)
+    def _fin():
+        _finalize(o_ref, l_scr, acc_scr)
+
+
+def _window_fold(m_scr, l_scr, acc_scr, q_ref, kn_ref, vn_ref, scale, W):
+    """The p == 0 window fold shared by the fused/window kernels: fresh
+    rows arrive unquantized (they are direct inputs, not pages), folded
+    under the in-window causal mask."""
+    Wp = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)                    # (H, Wp, hd)
+    kn = kn_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, kn, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale     # (H, Wp, Wp)
+    row = jax.lax.broadcasted_iota(jnp.int32, (1, Wp, Wp), 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, Wp, Wp), 2)
+    valid = jnp.logical_and(
+        jnp.logical_or(col <= row, row >= W), col < W)
+    _fold(m_scr, l_scr, acc_scr, s, valid,
+          vn_ref[0].astype(jnp.float32))
+
+
+def _pa_fused_kernel_q(bt_ref, pos_ref, wlo_ref, whi_ref, q_ref, kn_ref,
+                       vn_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
+                       ko_ref, vo_ref, kso_ref, vso_ref,
+                       m_scr, l_scr, acc_scr, *, scale, page, W, n_pages):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(p == 0)
+    def _init_and_window():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        _window_fold(m_scr, l_scr, acc_scr, q_ref, kn_ref, vn_ref,
+                     scale, W)
+
+    @pl.when(p * page < pos)
+    def _pages():
+        q = q_ref[0].astype(jnp.float32)
+        s = _page_scores_q(q, kp_ref, ks_ref, scale)
+        t = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page), 2)
+        _fold(m_scr, l_scr, acc_scr, s, t < pos,
+              _deq_block(vp_ref, vs_ref))
+
+    in_write_range = jnp.logical_and(p >= wlo_ref[b], p <= whi_ref[b])
+
+    @pl.when(in_write_range)
+    def _scatter():
+        kblk = kp_ref[0]                                # (H, page, hd)
+        vblk = vp_ref[0]
+        ksblk = ks_ref[0]                               # (H, page)
+        vsblk = vs_ref[0]
+        ridx = jax.lax.broadcasted_iota(jnp.int32, (1, page, 1), 1)
+        sidx = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        for j in range(W):                              # W static, small
+            tgt = pos + j - p * page
+            hit = ridx == tgt                           # all-False if out
+            shit = sidx == tgt
+            kq, ksc = quantize_kv(kn_ref[0, :, j, :], kblk.dtype)
+            vq, vsc = quantize_kv(vn_ref[0, :, j, :], vblk.dtype)
+            kblk = jnp.where(hit, kq[:, None, :], kblk)
+            vblk = jnp.where(hit, vq[:, None, :], vblk)
+            ksblk = jnp.where(shit, ksc[:, None].astype(ksblk.dtype), ksblk)
+            vsblk = jnp.where(shit, vsc[:, None].astype(vsblk.dtype), vsblk)
+        ko_ref[0] = kblk
+        vo_ref[0] = vblk
+        kso_ref[0] = ksblk
+        vso_ref[0] = vsblk
+
+    @pl.when(p == n_pages - 1)
+    def _fin():
+        _finalize(o_ref, l_scr, acc_scr)
+
+
+def _pa_window_kernel_q(bt_ref, pos_ref, q_ref, kn_ref, vn_ref, kp_ref,
+                        vp_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+                        acc_scr, *, scale, page, W, n_pages):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(p == 0)
+    def _init_and_window():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        _window_fold(m_scr, l_scr, acc_scr, q_ref, kn_ref, vn_ref,
+                     scale, W)
+
+    @pl.when(p * page < pos)
+    def _pages():
+        q = q_ref[0].astype(jnp.float32)
+        s = _page_scores_q(q, kp_ref, ks_ref, scale)
+        t = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page), 2)
+        _fold(m_scr, l_scr, acc_scr, s, t < pos,
+              _deq_block(vp_ref, vs_ref))
 
     @pl.when(p == n_pages - 1)
     def _fin():
@@ -479,6 +641,156 @@ def _pa_window_read_call(q, k_new, v_new, k_pages, v_pages, block_tables,
     return call(block_tables, pos, q, k_new, v_new, k_pages, v_pages)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _pa_read_call_q(q, k_pages, v_pages, k_scale, v_scale, block_tables,
+                    lengths, *, scale, interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, Wp, hd = q.shape
+    page = k_pages.shape[2]
+    n_pages = block_tables.shape[1]
+    kernel = functools.partial(_pa_read_kernel_q, scale=scale, page=page,
+                               n_pages=n_pages)
+
+    def _q_map(b, p, bt, lens):
+        return (b, 0, 0, 0)
+
+    def _page_map(b, p, bt, lens):
+        return (bt[b, p], 0, 0, 0)
+
+    def _scale_map(b, p, bt, lens):
+        return (bt[b, p], 0, 0)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=_grid_spec(
+            2, B, n_pages,
+            in_specs=[
+                pl.BlockSpec((1, H, Wp, hd), _q_map),
+                pl.BlockSpec((1, H, page, hd), _page_map),
+                pl.BlockSpec((1, H, page, hd), _page_map),
+                pl.BlockSpec((1, H, page), _scale_map),
+                pl.BlockSpec((1, H, page), _scale_map),
+            ],
+            out_specs=pl.BlockSpec((1, H, Wp, hd), _q_map),
+            H=H, Wp=Wp, hd=hd),
+        out_shape=jax.ShapeDtypeStruct((B, H, Wp, hd), q.dtype),
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )
+    return call(block_tables, lengths, q, k_pages, v_pages,
+                k_scale, v_scale)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "scale", "interpret"))
+def _pa_fused_call_q(q, k_new, v_new, k_pages, v_pages, k_scale, v_scale,
+                     block_tables, pos, wlo, whi, *, W, scale, interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, Wp, hd = q.shape
+    page = k_pages.shape[2]
+    n_pages = block_tables.shape[1]
+    kernel = functools.partial(_pa_fused_kernel_q, scale=scale, page=page,
+                               W=W, n_pages=n_pages)
+
+    def _row_map(b, p, bt, pos_, wlo_, whi_):
+        return (b, 0, 0, 0)
+
+    def _page_map(b, p, bt, pos_, wlo_, whi_):
+        return (bt[b, p], 0, 0, 0)
+
+    def _scale_map(b, p, bt, pos_, wlo_, whi_):
+        return (bt[b, p], 0, 0)
+
+    def _write_map(b, p, bt, pos_, wlo_, whi_):
+        inr = jnp.logical_and(p >= wlo_[b], p <= whi_[b])
+        return (jnp.where(inr, bt[b, p], 0), 0, 0, 0)
+
+    def _swrite_map(b, p, bt, pos_, wlo_, whi_):
+        inr = jnp.logical_and(p >= wlo_[b], p <= whi_[b])
+        return (jnp.where(inr, bt[b, p], 0), 0, 0)
+
+    pool_shape = jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype)
+    scale_shape = jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype)
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=_grid_spec(
+            4, B, n_pages,
+            in_specs=[
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # q
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # k_new
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # v_new
+                pl.BlockSpec((1, H, page, hd), _page_map),  # k pages
+                pl.BlockSpec((1, H, page, hd), _page_map),  # v pages
+                pl.BlockSpec((1, H, page), _scale_map),     # k scales
+                pl.BlockSpec((1, H, page), _scale_map),     # v scales
+            ],
+            out_specs=[
+                pl.BlockSpec((1, H, Wp, hd), _row_map),
+                pl.BlockSpec((1, H, page, hd), _write_map),
+                pl.BlockSpec((1, H, page, hd), _write_map),
+                pl.BlockSpec((1, H, page), _swrite_map),
+                pl.BlockSpec((1, H, page), _swrite_map),
+            ],
+            H=H, Wp=Wp, hd=hd),
+        out_shape=[jax.ShapeDtypeStruct((B, H, Wp, hd), q.dtype),
+                   pool_shape, pool_shape, scale_shape, scale_shape],
+        # operand indices count the 4 scalar-prefetch args: k/v pages are
+        # operands 7/8, their scale pools 9/10 — all four alias their
+        # outputs so pages AND scales update in place through the same
+        # trash-redirected write maps
+        input_output_aliases={7: 1, 8: 2, 9: 3, 10: 4},
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )
+    return call(block_tables, pos, wlo, whi, q, k_new, v_new,
+                k_pages, v_pages, k_scale, v_scale)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "scale", "interpret"))
+def _pa_window_read_call_q(q, k_new, v_new, k_pages, v_pages, k_scale,
+                           v_scale, block_tables, pos, *, W, scale,
+                           interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, Wp, hd = q.shape
+    page = k_pages.shape[2]
+    n_pages = block_tables.shape[1]
+    kernel = functools.partial(_pa_window_kernel_q, scale=scale, page=page,
+                               W=W, n_pages=n_pages)
+
+    def _row_map(b, p, bt, pos_):
+        return (b, 0, 0, 0)
+
+    def _page_map(b, p, bt, pos_):
+        return (bt[b, p], 0, 0, 0)
+
+    def _scale_map(b, p, bt, pos_):
+        return (bt[b, p], 0, 0)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=_grid_spec(
+            2, B, n_pages,
+            in_specs=[
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # q
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # k_new
+                pl.BlockSpec((1, H, Wp, hd), _row_map),     # v_new
+                pl.BlockSpec((1, H, page, hd), _page_map),  # k pages
+                pl.BlockSpec((1, H, page, hd), _page_map),  # v pages
+                pl.BlockSpec((1, H, page), _scale_map),     # k scales
+                pl.BlockSpec((1, H, page), _scale_map),     # v scales
+            ],
+            out_specs=pl.BlockSpec((1, H, Wp, hd), _row_map),
+            H=H, Wp=Wp, hd=hd),
+        out_shape=jax.ShapeDtypeStruct((B, H, Wp, hd), q.dtype),
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )
+    return call(block_tables, pos, q, k_new, v_new, k_pages, v_pages,
+                k_scale, v_scale)
+
+
 # ---- mesh mount (shard_map) -------------------------------------------------
 
 def _mount_specs(slot_axis, head_axis):
@@ -490,6 +802,13 @@ def _mount_specs(slot_axis, head_axis):
     row = P(slot_axis, head_axis, None, None)     # q / k_new / v_new / out
     pool = P(None, head_axis, None, None)         # the K/V page pools
     return row, pool, P(slot_axis, None), P(slot_axis)
+
+
+def _scale_mount_spec(head_axis):
+    """Partition spec of the (N, H, page) scale pools under a mesh —
+    heads over ``head_axis``, like the page pools they scale."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, head_axis, None)
 
 
 def _check_mount(mesh, B, H, slot_axis, head_axis):
@@ -524,6 +843,27 @@ def _pool_write_rows(pool, rows, block_tables, pos, active):
     return pool.at[pf, :, of].set(vals.astype(pool.dtype))
 
 
+def _pool_write_rows_quant(pool, scales, rows, block_tables, pos, active):
+    """Quantizing twin of :func:`_pool_write_rows`: the same index math,
+    but each (H, hd) row goes through :func:`quantize_kv` first and its
+    per-head scale lands in the ``(N, H, page)`` scale pool at the same
+    (physical page, offset). Bit-identical bytes to the fused kernel's
+    in-launch quantized scatter and to ``_paged_writeback``'s quant
+    branch — same helper, same order of operations."""
+    B, H, W, hd = rows.shape
+    page = pool.shape[2]
+    wpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)       # (B, W)
+    phys = jnp.take_along_axis(block_tables, wpos // page, axis=1)
+    if active is not None:
+        phys = jnp.where(active[:, None], phys, 0)
+    pf = phys.reshape(-1)
+    of = (wpos % page).reshape(-1)
+    vals = rows.transpose(0, 2, 1, 3).reshape(B * W, H, hd)
+    q, sc = quantize_kv(vals, pool.dtype)
+    return (pool.at[pf, :, of].set(q),
+            scales.at[pf, :, of].set(sc.astype(scales.dtype)))
+
+
 def _pad_window(t, Wp):
     W = t.shape[2]
     if W == Wp:
@@ -532,6 +872,7 @@ def _pad_window(t, Wp):
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    k_scale=None, v_scale=None,
                     scale: Optional[float] = None,
                     interpret: Optional[bool] = None,
                     mesh=None, slot_axis: Optional[str] = None,
@@ -541,6 +882,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     the ``(N, H, page, hd)`` page pools through ``block_tables`` (B, P).
     A row with ``lengths[b] == 0`` yields zeros (the flash convention
     for fully-masked rows). Returns (B, H, W, hd) in ``q.dtype``.
+
+    With ``k_scale``/``v_scale`` (the pool's ``(N, H, page)`` scale
+    arrays) the pools hold QUANTIZED values: the scale blocks ride the
+    same block-table index_map as their pages and the kernel dequantizes
+    in VMEM — HBM only ever moves the quantized bytes.
 
     With ``mesh=`` the kernel is mounted via ``jax.shard_map``: heads
     split over ``head_axis`` (typically ``"tp"``) and rows optionally
@@ -556,14 +902,33 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     qp = _pad_window(q, Wp)
     bt = block_tables.astype(jnp.int32)
     lens = lengths.astype(jnp.int32)
+    quant = k_scale is not None
     if mesh is None:
-        out = _pa_read_call(qp, k_pages, v_pages, bt, lens,
-                            scale=scale, interpret=bool(interpret))
+        if quant:
+            out = _pa_read_call_q(qp, k_pages, v_pages, k_scale, v_scale,
+                                  bt, lens, scale=scale,
+                                  interpret=bool(interpret))
+        else:
+            out = _pa_read_call(qp, k_pages, v_pages, bt, lens,
+                                scale=scale, interpret=bool(interpret))
         return out[:, :, :W]
     _check_mount(mesh, B, H, slot_axis, head_axis)
     from ..parallel.mesh import get_shard_map
     shard_map, unchecked = get_shard_map()
     row, pool, bt_spec, vec = _mount_specs(slot_axis, head_axis)
+    if quant:
+        spool = _scale_mount_spec(head_axis)
+
+        def _shard_q(q_, kp_, vp_, ks_, vs_, bt_, len_):
+            return _pa_read_call_q(q_, kp_, vp_, ks_, vs_, bt_, len_,
+                                   scale=scale, interpret=bool(interpret))
+
+        out = shard_map(_shard_q, mesh=mesh,
+                        in_specs=(row, pool, pool, spool, spool,
+                                  bt_spec, vec),
+                        out_specs=row, **unchecked)(
+            qp, k_pages, v_pages, k_scale, v_scale, bt, lens)
+        return out[:, :, :W]
 
     def _shard(q_, kp_, vp_, bt_, len_):
         return _pa_read_call(q_, kp_, vp_, bt_, len_,
@@ -578,6 +943,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
 
 def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
                            block_tables, pos, *, active=None,
+                           k_scale=None, v_scale=None,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            mesh=None, slot_axis: Optional[str] = None,
@@ -594,12 +960,20 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
     meaningful context. Returns ``(ctx, k_pages, v_pages)`` with the
     pool buffers updated in place (aliased).
 
+    With ``k_scale``/``v_scale`` (the ``(N, H, page)`` scale pools) the
+    page pools hold QUANTIZED values: page reads dequantize in VMEM and
+    the in-launch scatter quantizes each fresh row through the
+    sanctioned :func:`~mmlspark_tpu.ops.kv_quant.quantize_kv` before
+    writing. The return grows to ``(ctx, k_pages, v_pages, k_scale,
+    v_scale)`` — scales alias and update in place exactly like pages.
+
     With ``mesh=`` the attention mounts via ``jax.shard_map`` (heads
     over ``head_axis``, rows optionally over ``slot_axis``) in
     READ-ONLY form, and the fresh rows are scattered by
-    :func:`_pool_write_rows` outside the mount — the written bytes are
-    bit-identical to the fused in-kernel scatter, so single-chip and
-    mesh engines produce the same pages."""
+    :func:`_pool_write_rows` / :func:`_pool_write_rows_quant` outside
+    the mount — the written bytes are bit-identical to the fused
+    in-kernel scatter, so single-chip and mesh engines produce the same
+    pages."""
     if interpret is None:
         interpret = _auto_interpret()
     B, H, W, hd = q.shape
@@ -609,11 +983,32 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
     pos = pos.astype(jnp.int32)
     Wp = _round_up(W, sublane_multiple(q.dtype))
     bt = block_tables.astype(jnp.int32)
+    quant = k_scale is not None
     if mesh is not None:
         _check_mount(mesh, B, H, slot_axis, head_axis)
         from ..parallel.mesh import get_shard_map
         shard_map, unchecked = get_shard_map()
         row, pool, bt_spec, vec = _mount_specs(slot_axis, head_axis)
+        if quant:
+            spool = _scale_mount_spec(head_axis)
+
+            def _shard_q(q_, kn_, vn_, kp_, vp_, ks_, vs_, bt_, pos_):
+                return _pa_window_read_call_q(
+                    q_, kn_, vn_, kp_, vp_, ks_, vs_, bt_, pos_,
+                    W=W, scale=scale, interpret=bool(interpret))
+
+            ctx = shard_map(_shard_q, mesh=mesh,
+                            in_specs=(row, row, row, pool, pool,
+                                      spool, spool, bt_spec, vec),
+                            out_specs=row, **unchecked)(
+                _pad_window(q, Wp), _pad_window(k_new, Wp),
+                _pad_window(v_new, Wp), k_pages, v_pages,
+                k_scale, v_scale, bt, pos)
+            kp, ks = _pool_write_rows_quant(k_pages, k_scale, k_new,
+                                            bt, pos, active)
+            vp, vs = _pool_write_rows_quant(v_pages, v_scale, v_new,
+                                            bt, pos, active)
+            return ctx[:, :, :W], kp, vp, ks, vs
 
         def _shard(q_, kn_, vn_, kp_, vp_, bt_, pos_):
             return _pa_window_read_call(q_, kn_, vn_, kp_, vp_, bt_, pos_,
@@ -635,6 +1030,13 @@ def paged_attention_window(q, k_new, v_new, k_pages, v_pages,
         # of the row to trash and the overlay never fires
         wlo = jnp.where(active, wlo, 1)
         whi = jnp.where(active, whi, 0)
+    if quant:
+        out, kp, vp, ks, vs = _pa_fused_call_q(
+            _pad_window(q, Wp), _pad_window(k_new, Wp),
+            _pad_window(v_new, Wp), k_pages, v_pages, k_scale, v_scale,
+            bt, pos, wlo.astype(jnp.int32), whi.astype(jnp.int32),
+            W=W, scale=scale, interpret=bool(interpret))
+        return out[:, :, :W], kp, vp, ks, vs
     out, kp, vp = _pa_fused_call(
         _pad_window(q, Wp), _pad_window(k_new, Wp), _pad_window(v_new, Wp),
         k_pages, v_pages, bt, pos,

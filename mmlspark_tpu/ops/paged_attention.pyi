@@ -9,6 +9,8 @@ def sublane_multiple(dtype: Any) -> int: ...
 def aligned_page_size(page_size: int, dtype: Any) -> int: ...
 def paged_attention(q: Any, k_pages: Any, v_pages: Any,
                     block_tables: Any, lengths: Any, *,
+                    k_scale: Optional[Any] = ...,
+                    v_scale: Optional[Any] = ...,
                     scale: Optional[float] = ...,
                     interpret: Optional[bool] = ...,
                     mesh: Optional[Any] = ...,
@@ -18,9 +20,11 @@ def paged_attention_window(q: Any, k_new: Any, v_new: Any,
                            k_pages: Any, v_pages: Any,
                            block_tables: Any, pos: Any, *,
                            active: Optional[Any] = ...,
+                           k_scale: Optional[Any] = ...,
+                           v_scale: Optional[Any] = ...,
                            scale: Optional[float] = ...,
                            interpret: Optional[bool] = ...,
                            mesh: Optional[Any] = ...,
                            slot_axis: Optional[str] = ...,
                            head_axis: Optional[str] = ...
-                           ) -> Tuple[Any, Any, Any]: ...
+                           ) -> Tuple[Any, ...]: ...

@@ -73,6 +73,7 @@ from ..observability import (charge as _ledger_charge,
                              resolve_context as _resolve_cost_ctx,
                              watch as _watch)
 from ..observability import tracing as _tracing
+from ..observability.slo import get_tracker as _slo_tracker
 from ..reliability import get_injector as _get_injector
 from ..reliability.lock_sanitizer import new_lock
 from ..utils.profiling import span as _prof_span
@@ -158,14 +159,18 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
 
 @functools.lru_cache(maxsize=None)
 def _tick_program(cfg, page, Lc, k, eos, sample, donate, attn="kernel",
-                  mesh=None, slot_axis=None, head_axis=None):
+                  mesh=None, slot_axis=None, head_axis=None,
+                  kv_dtype=None):
     """The decode tick: k paged steps fused in one lax.scan. ``attn``
     (part of the cache key — the impl is baked in at trace time) selects
     the Pallas paged-attention kernel or the gather fallback. ``mesh``
     (a hashable jax Mesh: axis names + sizes + devices) plus the engine's
     slot/head axis names are part of the cache key too, so a sharded
     engine and a single-chip engine with otherwise-identical shapes never
-    share a trace — the kernel mounts via shard_map under a mesh."""
+    share a trace — the kernel mounts via shard_map under a mesh.
+    ``kv_dtype`` ("int8"/"fp8"/None) likewise: the quantized and bf16
+    data planes differ in buffer pytree structure AND kernel choice, and
+    must never share a program."""
     eos_const = None if eos is None else jnp.int32(eos)
 
     def tick(params, tok, pos, active, bufs, bt, remaining,
@@ -212,7 +217,7 @@ def _prefill_program(cfg, L):
 
 @functools.lru_cache(maxsize=None)
 def _extend_program(cfg, page, L, donate, attn="kernel",
-                    mesh=None, head_axis=None):
+                    mesh=None, head_axis=None, kv_dtype=None):
     """Paged window extension: continue ONE slot's pages over a token
     window — the prefix-cache suffix path and chunked prefill share this
     single program (one compile per window bucket). The gather impl
@@ -233,25 +238,32 @@ def _extend_program(cfg, page, L, donate, attn="kernel",
 @functools.lru_cache(maxsize=None)
 def _copy_pages_program(donate):
     """Boundary-page copy for copy-on-write prefix admission (at most
-    one page per admission — compiles per copy count)."""
+    one page per admission — compiles per copy count). Generic over the
+    layer-dict keys: a quantized pool's ``k_scale``/``v_scale`` arrays
+    copy through the same src/dst page indices as their values (page 0,
+    dim 0, for every buffer), so CoW admission needs no quant-specific
+    path."""
     def _copy(bufs, src, dst):
         return [{kk: c[kk].at[dst].set(c[kk][src])
-                 for kk in ("k", "v")} for c in bufs]
+                 for kk in c} for c in bufs]
 
     return jax.jit(_copy, donate_argnums=(0,) if donate else ())
 
 
 @functools.lru_cache(maxsize=None)
 def _compact_program(donate):
-    """Defrag: permute the whole page dimension in one gather."""
+    """Defrag: permute the whole page dimension in one gather — every
+    buffer in each layer dict (values AND scales: a quantized page is
+    meaningless without its scale row, so they remap through the SAME
+    permutation in the same dispatch)."""
     def _compact(bufs, perm):
-        return [{kk: c[kk][perm] for kk in ("k", "v")} for c in bufs]
+        return [{kk: c[kk][perm] for kk in c} for c in bufs]
 
     return jax.jit(_compact, donate_argnums=(0,) if donate else ())
 
 
 @functools.lru_cache(maxsize=None)
-def _insert_group_program(page, donate):
+def _insert_group_program(page, donate, kv_dtype=None):
     """Group insert: ALL rows admitted from one prefill land in one
     compiled call (slots is a (g,) vector, g gets its own tiny program —
     bounded by max_slots), and their first tokens compute on device in
@@ -306,9 +318,31 @@ def _first_tokens_program():
 
 
 @functools.lru_cache(maxsize=None)
+def _quant_probe_program(kv_dtype):
+    """Write-time quant-error probe: the relative RMS between the bf16
+    prefill rows a quantized insert is about to scatter and their
+    ``dequantize(quantize(.))`` roundtrip — exactly the delta between
+    what the quantized kernel will read back and what the byte-exact
+    bf16 oracle would have read. Returns ``(err_rms, ref_rms)`` so the
+    host forms the scale-free ratio. One tiny program per kv_dtype."""
+    from ..ops.kv_quant import dequantize_kv, kv_store_dtype, quantize_kv
+    store = kv_store_dtype(kv_dtype)
+
+    def _probe(rows):
+        x = rows.astype(jnp.float32)
+        q, s = quantize_kv(x, store)
+        d = dequantize_kv(q, s) - x
+        return (jnp.sqrt(jnp.mean(d * d)),
+                jnp.sqrt(jnp.mean(x * x)))
+
+    return jax.jit(_probe)
+
+
+@functools.lru_cache(maxsize=None)
 def _spec_tick_program(cfg, d_cfg, page, Lc, k_steps, eos, gamma,
                        sample, warp, donate, attn="kernel",
-                       mesh=None, slot_axis=None, head_axis=None):
+                       mesh=None, slot_axis=None, head_axis=None,
+                       kv_dtype=None):
     """The speculative tick: k draft→verify rounds in one scan.
 
     Per round, the draft proposes gamma tokens per slot (gamma+1 ragged
@@ -528,7 +562,10 @@ class ContinuousDecoder:
                  kv_pages: Optional[int] = None,
                  autotune: bool = False,
                  defrag_threshold: Optional[int] = None,
-                 paged_attn: Optional[str] = None):
+                 paged_attn: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
+                 quant_probe: int = 64,
+                 slo_model: str = "default"):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -693,16 +730,32 @@ class ContinuousDecoder:
         # per-shard kernel over each heads/tp slice, so no downgrade:
         # sharded engines and single-chip engines run the same impl
         self._attn_impl = impl
+        #: quantized KV data plane: "int8"/"fp8" store quantized pages +
+        #: per-position per-head scales; None keeps bf16 pages (the
+        #: byte-exact oracle). Resolved ONCE and threaded into every
+        #: compiled-program cache key.
+        from ..ops.kv_quant import kv_store_dtype as _kv_store_dtype
+        from ..ops.kv_quant import resolve_kv_dtype as _resolve_kv_dtype
+        self._kv_dtype = _resolve_kv_dtype(kv_dtype)
+        kv_value_dtype = _kv_store_dtype(self._kv_dtype) or cfg.dtype
+        if quant_probe < 0:
+            raise ValueError("quant_probe must be >= 0")
+        self._quant_probe = int(quant_probe) if self._kv_dtype else 0
+        self._quant_inserts = 0
+        self._slo_model = str(slo_model)
+        self._quant_probe_j = (_quant_probe_program(self._kv_dtype)
+                               if self._quant_probe else None)
         if impl == "kernel" and not _pa_auto_interpret():
             # real TPU: the page dimension sits in the kernel's sublane
-            # slot — round the page size up to the dtype's tile
+            # slot — round the page size up to the tile of the dtype the
+            # pages are STORED in (int8 pages tile at 32, bf16 at 16)
             # (transparent to allocation accounting; interpret-mode CI
             # keeps the requested size so test pool shapes are unchanged).
             # The rounding is per-SHARD invariant: sharding splits heads,
             # not the page dimension, so the same aligned size serves
             # every mesh shape
             page_size = PagedKVPool.kernel_aligned_page_size(
-                page_size, cfg.dtype)
+                page_size, kv_value_dtype)
         self._page = int(page_size)
         #: block-table width: logical pages per slot at full cache length
         self._P_max = -(-self._Lc // self._page)
@@ -716,13 +769,21 @@ class ContinuousDecoder:
                 f"kv_pages {kv_pages} cannot hold one full-length slot "
                 f"({self._P_max} pages + the trash page)")
 
+        scale_sharding = (None if pool_sharding is None
+                          else NamedSharding(mesh, P(None, head_axis, None)))
+
         def _pool_buffer(shape_, dtype):
             z = jnp.zeros(shape_, dtype)
-            return (z if pool_sharding is None
-                    else jax.device_put(z, pool_sharding))
+            if pool_sharding is None:
+                return z
+            # 4D (N, H, page, hd) value pools vs 3D (N, H, page) scale
+            # pools — both shard heads over tp, nothing else
+            return jax.device_put(
+                z, pool_sharding if len(shape_) == 4 else scale_sharding)
 
         self._kv = PagedKVPool(cfg, num_pages=int(kv_pages),
                                page_size=self._page,
+                               kv_dtype=self._kv_dtype,
                                make_buffer=_pool_buffer,
                                sharding=pool_sharding)
         self._chunk = int(prefill_chunk)
@@ -772,21 +833,25 @@ class ContinuousDecoder:
         # dispatches, and the engine re-binds self._bt outside jit.
         self._tick = _tick_program(cfg, page, Lc, self._k, self._eos,
                                    False, donate, self._attn_impl,
-                                   mesh, slot_axis, head_axis)
+                                   mesh, slot_axis, head_axis,
+                                   self._kv_dtype)
         self._tick_sampled = _tick_program(cfg, page, Lc, self._k,
                                            self._eos, True, donate,
                                            self._attn_impl,
-                                           mesh, slot_axis, head_axis)
-        # per-call HBM traffic the gather impl pays materializing
-        # contiguous K/V (2 tensors x layers x (B, H, L, hd)); the
-        # kernel impl's figure is 0 by construction — these feed the
-        # mmlspark_kvpool_gather_bytes_total counter and bench's
-        # bytes-saved estimate
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        self._gather_bytes_tick = (2 * cfg.layers * self._S * cfg.heads
-                                   * Lc * hd * itemsize)
-        self._gather_bytes_extend = (2 * cfg.layers * cfg.heads
-                                     * self._L * hd * itemsize)
+                                           mesh, slot_axis, head_axis,
+                                           self._kv_dtype)
+        # per-call KV HBM traffic of one full sweep over the cache at
+        # worst-case length, in the bytes the pool ACTUALLY stores — the
+        # quantized plane shrinks this ~2x (int8 values + bf16 scales vs
+        # bf16 values), which is exactly what bench's
+        # hbm_bytes_saved_per_step counter-asserts. Under the gather impl
+        # this is also what materializing contiguous K/V reads from the
+        # pool (feeding mmlspark_kvpool_gather_bytes_total); the kernel
+        # impl reads the same pages in place.
+        self._gather_bytes_tick = (self._S * Lc *
+                                   self._kv.bytes_per_position())
+        self._gather_bytes_extend = (self._L *
+                                     self._kv.bytes_per_position())
         #: most tokens one dispatch can emit per slot (the retirement
         #: horizon unit): k plain steps, or k rounds × (gamma+1) spec —
         #: sized at the autotune CEILING so the horizon stays an upper
@@ -809,7 +874,8 @@ class ContinuousDecoder:
                         warp=(mode == "warped"), donate=donate,
                         attn=self._attn_impl, mesh=self._mesh,
                         slot_axis=self._slot_axis,
-                        head_axis=self._head_axis)
+                        head_axis=self._head_axis,
+                        kv_dtype=self._kv_dtype)
                     self._spec_ticks[(mode, g)] = fn
                 return fn
 
@@ -825,7 +891,8 @@ class ContinuousDecoder:
         # prefix-cache suffix extension + chunked prefill (one program)
         self._extend_paged = _extend_program(cfg, page, self._L, donate,
                                              self._attn_impl,
-                                             mesh, head_axis)
+                                             mesh, head_axis,
+                                             self._kv_dtype)
 
         # copy-on-write boundary-page copy + defrag permutation
         self._copy_pages_j = _copy_pages_program(donate)
@@ -838,7 +905,8 @@ class ContinuousDecoder:
         self.stats = {"prefills": 0, "prefix_hits": 0}
 
         # group insert + first tokens (see the module factories)
-        self._insert_group_j = _insert_group_program(page, donate)
+        self._insert_group_j = _insert_group_program(page, donate,
+                                                     self._kv_dtype)
         self._first_tokens = _first_tokens_program()
 
     def _reset_device_state(self):
@@ -1258,6 +1326,19 @@ class ContinuousDecoder:
                                     jnp.int32)
         else:
             page_rows = jnp.zeros((g, 1), jnp.int32)
+        if rows_t and self._quant_probe:
+            # sampled write-time oracle probe: every quant_probe'th
+            # insert roundtrips its (about-to-be-quantized) bf16 rows
+            # through quantize/dequantize and reports the relative RMS —
+            # the exact kernel-vs-oracle content delta — to the pool
+            # gauge and the SLO tracker (one host sync per probe, off
+            # the steady-state decode path)
+            self._quant_inserts += 1
+            if self._quant_inserts % self._quant_probe == 0:
+                err, ref = self._quant_probe_j(rows_t[0]["k"])
+                rms = float(err) / max(float(ref), 1e-12)
+                self._kv.note_quant_error(rms)
+                _slo_tracker().note_kv_quant_error(self._slo_model, rms)
         d_cache = self._d_cache if self._spec else []
         sample_state = (self._temp, self._topk, self._topp, self._key)
         (bufs, d_cache, self._tok, self._pos, self._active,

@@ -41,7 +41,10 @@ class ContinuousDecoder:
                  kv_pages: Optional[int] = ...,
                  autotune: bool = ...,
                  defrag_threshold: Optional[int] = ...,
-                 paged_attn: Optional[str] = ...) -> None: ...
+                 paged_attn: Optional[str] = ...,
+                 kv_dtype: Optional[str] = ...,
+                 quant_probe: int = ...,
+                 slo_model: str = ...) -> None: ...
     def submit(self, prompt_ids: Any, max_new_tokens: int = ..., *,
                temperature: float = ..., top_k: int = ...,
                top_p: float = ..., seed: int = ...,

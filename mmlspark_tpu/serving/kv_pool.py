@@ -131,8 +131,10 @@ class PagedKVPool:
     """
 
     def __init__(self, cfg, *, num_pages: int, page_size: int,
-                 make_buffer=None, residency: bool = True,
-                 sharding=None):
+                 kv_dtype: Optional[str] = None, make_buffer=None,
+                 residency: bool = True, sharding=None):
+        from ..ops.kv_quant import (SCALE_DTYPE, kv_store_dtype,
+                                    resolve_kv_dtype)
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if page_size < 1:
@@ -142,18 +144,27 @@ class PagedKVPool:
         self.page_size = int(page_size)
         hd = cfg.d_model // cfg.heads
         shape = (self.num_pages, cfg.heads, self.page_size, hd)
+        #: canonical quantized-page dtype name ("int8"/"fp8") or None for
+        #: bf16 pages (the byte-exact oracle representation)
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        store = kv_store_dtype(self.kv_dtype)
+        #: the jnp dtype K/V VALUES are stored in — what page alignment,
+        #: residency accounting and HBM byte math must all be sized to
+        self.value_dtype = cfg.dtype if store is None else store
+        self.scale_dtype = None if store is None else SCALE_DTYPE
         #: how the page arrays lay out on a mesh (None = single-device).
         #: Under tensor parallelism this is P(None, "tp", None, None) —
         #: heads shard, the page dimension stays a shared allocator arena,
         #: so alloc/free/block tables/CoW/compact() remain device-count-
         #: invariant host bookkeeping and defrag's permutation gathers
-        #: per-shard with no resharding round-trip.
+        #: per-shard with no resharding round-trip. Quantized pools keep
+        #: (num_pages, heads, page_size) scale arrays on P(None, "tp",
+        #: None) — scales shard with the heads they rescale.
         self.pool_sharding = sharding
         self._mk = make_buffer or (lambda s, d: jnp.zeros(s, d))
         self._shape = shape
-        self.buffers = [{"k": self._mk(shape, cfg.dtype),
-                         "v": self._mk(shape, cfg.dtype)}
-                        for _ in range(cfg.layers)]
+        self._scale_shape = shape[:3]
+        self.buffers = self._make_buffers()
         self._free: List[int] = list(range(1, self.num_pages))
         heapq.heapify(self._free)
         self._refs = np.zeros(self.num_pages, np.int32)
@@ -170,17 +181,68 @@ class PagedKVPool:
         self.stats = {"prefix_share_hits": 0, "defrag_moves": 0,
                       "prefill_chunks": 0, "alloc_failures": 0,
                       "gather_bytes": 0, "attn_ticks_kernel": 0,
-                      "attn_ticks_gather": 0}
+                      "attn_ticks_gather": 0, "quant_error_probes": 0,
+                      "quant_error_last": None, "quant_error_sum": 0.0,
+                      "quant_error_max": 0.0}
         M_PAGES_TOTAL.set(self.num_pages - 1)
         M_PAGES_IN_USE.set(0)
         self._reservation = None
         if residency:
-            itemsize = jnp.dtype(cfg.dtype).itemsize
-            nbytes = 2 * cfg.layers * int(np.prod(shape)) * itemsize
             mgr = get_residency_manager()
-            token = mgr.reserve(nbytes, label="kv_pool")
+            token = mgr.reserve(self.device_bytes(), label="kv_pool")
             self._reservation = token
             self._finalizer = weakref.finalize(self, mgr.release, token)
+
+    def _make_buffers(self):
+        """Fresh per-layer page buffers through ``make_buffer`` (so mesh
+        shardings apply): ``{"k","v"}`` in the value dtype, plus
+        ``{"k_scale","v_scale"}`` when quantized."""
+        layers = []
+        for _ in range(self.cfg.layers):
+            c = {"k": self._mk(self._shape, self.value_dtype),
+                 "v": self._mk(self._shape, self.value_dtype)}
+            if self.scale_dtype is not None:
+                c["k_scale"] = self._mk(self._scale_shape, self.scale_dtype)
+                c["v_scale"] = self._mk(self._scale_shape, self.scale_dtype)
+            layers.append(c)
+        return layers
+
+    def device_bytes(self) -> int:
+        """Exact device bytes of the pool's buffers — K+V values in the
+        (possibly quantized) value dtype plus the scale arrays. This is
+        what :func:`~mmlspark_tpu.core.residency.get_residency_manager`'s
+        ``reserve()`` pins, so the budget sees the QUANTIZED itemsize: a
+        fixed byte budget holds ~2x the pages under int8."""
+        nbytes = (2 * self.cfg.layers * int(np.prod(self._shape)) *
+                  jnp.dtype(self.value_dtype).itemsize)
+        if self.scale_dtype is not None:
+            nbytes += (2 * self.cfg.layers *
+                       int(np.prod(self._scale_shape)) *
+                       jnp.dtype(self.scale_dtype).itemsize)
+        return nbytes
+
+    def bytes_per_position(self) -> int:
+        """HBM bytes one cached position costs across K+V and all layers
+        (values + scales) — the unit the engine's per-tick byte
+        accounting multiplies out."""
+        from ..ops.kv_quant import kv_bytes_per_position
+        hd = self.cfg.d_model // self.cfg.heads
+        return self.cfg.layers * kv_bytes_per_position(
+            self.cfg.heads, hd, self.value_dtype,
+            self.scale_dtype is not None)
+
+    def note_quant_error(self, rms: float) -> None:
+        """Record one sampled write-time roundtrip error (relative RMS of
+        ``dequantize(quantize(rows))`` vs the bf16 rows — exactly the
+        delta between what the kernel reads and what the byte-exact
+        oracle would have read). The engine forwards the same sample to
+        the SLO tracker under its model label."""
+        rms = float(rms)
+        self.stats["quant_error_probes"] += 1
+        self.stats["quant_error_last"] = rms
+        self.stats["quant_error_sum"] += rms
+        self.stats["quant_error_max"] = max(
+            self.stats["quant_error_max"], rms)
 
     # -- allocation ----------------------------------------------------------
 
@@ -401,9 +463,7 @@ class PagedKVPool:
         """Forget every allocation and re-zero the device buffers (the
         engine's abort path). Rebuilds through the construction-time
         ``make_buffer`` so mesh shardings survive a reset."""
-        self.buffers = [{"k": self._mk(self._shape, self.cfg.dtype),
-                         "v": self._mk(self._shape, self.cfg.dtype)}
-                        for _ in range(self.cfg.layers)]
+        self.buffers = self._make_buffers()
         self._free = list(range(1, self.num_pages))
         heapq.heapify(self._free)
         self._refs[:] = 0

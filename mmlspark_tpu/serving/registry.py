@@ -136,7 +136,12 @@ class ModelRegistry:
     Canary auto-rollback margins: the candidate rolls back when, with at
     least ``min_requests`` observed in its rolling window, its window
     error rate exceeds the incumbent's by ``err_margin`` (absolute) OR
-    its window p99 exceeds ``p99_margin`` times the incumbent's.
+    its window p99 exceeds ``p99_margin`` times the incumbent's OR its
+    window KV quantization error (``kv_quant_error`` — the relative RMS
+    reported by a quantized engine's dequant oracle probe) exceeds the
+    incumbent's by ``quant_margin`` (absolute). The quant breach is what
+    lets a ``model@quant`` canary A/B against a ``model@bf16`` incumbent
+    with automatic rollback when the quantized KV plane drifts.
     ``check_every`` bounds hot-path cost: the rollback check runs every
     N canary resolutions (and on every :meth:`check_canaries`, which
     heartbeats call off the request path).
@@ -144,9 +149,10 @@ class ModelRegistry:
 
     def __init__(self, err_margin: float = 0.05, p99_margin: float = 1.5,
                  min_requests: int = 20, check_every: int = 16,
-                 shadow_keep: int = 64):
+                 shadow_keep: int = 64, quant_margin: float = 0.05):
         self.err_margin = float(err_margin)
         self.p99_margin = float(p99_margin)
+        self.quant_margin = float(quant_margin)
         self.min_requests = int(min_requests)
         self.check_every = max(1, int(check_every))
         self._lock = threading.Lock()
@@ -425,6 +431,14 @@ class ModelRegistry:
                     verdict["breach"] = (
                         f"p99 {cand['p99']:.4f}s > "
                         f"{inc['p99']:.4f}s x {self.p99_margin}")
+                elif (cand.get("kv_quant_error") is not None
+                      and cand["kv_quant_error"]
+                      > (inc.get("kv_quant_error") or 0.0)
+                      + self.quant_margin):
+                    verdict["breach"] = (
+                        f"kv_quant_error {cand['kv_quant_error']:.4f} > "
+                        f"{inc.get('kv_quant_error') or 0.0:.4f} + "
+                        f"{self.quant_margin}")
             if verdict["breach"]:
                 _, _, v = cand_label.partition("@")
                 self.rollback(name, v, reason=verdict["breach"])
@@ -508,6 +522,7 @@ class ModelRegistry:
                 "shadow_diffs": self.shadow_diffs(),
                 "margins": {"err_margin": self.err_margin,
                             "p99_margin": self.p99_margin,
+                            "quant_margin": self.quant_margin,
                             "min_requests": self.min_requests}}
 
     def digest(self) -> Dict[str, object]:

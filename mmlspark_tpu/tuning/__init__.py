@@ -28,8 +28,8 @@ docs/performance.md.
 """
 
 from .cost_model import (CostModel, TuningDecision, candidate_configs,
-                         compare_paged_attn, measured_sweep, probe_budget,
-                         resolve_tuning)
+                         compare_kv_dtype, compare_paged_attn,
+                         measured_sweep, probe_budget, resolve_tuning)
 from .observations import (TUNING_DIR_ENV, Observation, ObservationStore,
                            get_store, harvest_scorecard,
                            import_bench_records, reset_store, set_store)
@@ -46,6 +46,7 @@ __all__ = [
     "CostModel",
     "TuningDecision",
     "candidate_configs",
+    "compare_kv_dtype",
     "compare_paged_attn",
     "measured_sweep",
     "probe_budget",

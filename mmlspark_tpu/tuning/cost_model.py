@@ -39,8 +39,9 @@ from ..ops.padding import bucket_size
 from .observations import ObservationStore, get_store
 
 __all__ = ["CostModel", "TuningDecision", "candidate_configs",
-           "compare_paged_attn", "measured_sweep", "predecessor_signature",
-           "probe_budget", "resolve_tuning", "PROBE_BUDGET_ENV"]
+           "compare_kv_dtype", "compare_paged_attn", "measured_sweep",
+           "predecessor_signature", "probe_budget", "resolve_tuning",
+           "PROBE_BUDGET_ENV"]
 
 #: bounds the measured sweep: at most this many candidate configs are run
 PROBE_BUDGET_ENV = "MMLSPARK_TPU_TUNING_PROBES"
@@ -342,6 +343,42 @@ def compare_paged_attn(store: Optional[ObservationStore] = None,
         g = row.get("gather", {}).get("tok_per_sec_mean")
         row["kernel_vs_gather_speedup"] = (
             round(k / g, 4) if k and g else None)
+        out[placement] = row
+    return out
+
+
+def compare_kv_dtype(store: Optional[ObservationStore] = None,
+                     sig: str = "generation") -> Dict[str, dict]:
+    """Quantized-vs-bf16 KV-plane generation throughput per placement.
+
+    The ``kv_dtype`` twin of :func:`compare_paged_attn`: groups the
+    harvested generation observations by placement and the KV store
+    dtype the engine decoded with (``int8``/``fp8``, or ``bf16`` when
+    unstamped/None — the full-precision pool), and reports mean tok/s
+    plus the quantized/bf16 speedup where both have samples. This is
+    the evidence a CostModel candidate sweep over ``kv_dtype`` reads:
+    on HBM-bound decode the ~2x byte reduction should show up here as
+    realized tok/s, not just the counter-asserted byte ratio."""
+    store = store if store is not None else get_store()
+    by_placement: Dict[str, Dict[str, List[float]]] = {}
+    for r in store.rows(sig=sig):
+        dt = r.get("kv_dtype") or (r.get("config") or {}).get("kv_dtype")
+        dt = str(dt) if dt else "bf16"
+        tps = r.get("rows_per_sec")
+        if not isinstance(tps, (int, float)) or tps <= 0:
+            continue
+        by_placement.setdefault(str(r.get("placement", "default")),
+                                {}).setdefault(dt, []).append(float(tps))
+    out: Dict[str, dict] = {}
+    for placement, dts in by_placement.items():
+        row = {dt: {"n": len(v),
+                    "tok_per_sec_mean": round(sum(v) / len(v), 2)}
+               for dt, v in dts.items()}
+        q = (row.get("int8") or row.get("fp8")
+             or {}).get("tok_per_sec_mean")
+        b = row.get("bf16", {}).get("tok_per_sec_mean")
+        row["quant_vs_bf16_speedup"] = (
+            round(q / b, 4) if q and b else None)
         out[placement] = row
     return out
 

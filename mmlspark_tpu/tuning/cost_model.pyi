@@ -55,6 +55,8 @@ class CostModel:
                candidates: Optional[List[tuple]] = ...,
                compile_weight: float = ...) -> TuningDecision: ...
 
+def compare_kv_dtype(store: Optional[ObservationStore] = ...,
+                     sig: str = ...) -> Dict[str, dict]: ...
 def compare_paged_attn(store: Optional[ObservationStore] = ...,
                        sig: str = ...) -> Dict[str, dict]: ...
 def resolve_tuning(sig: str, placement: str, histogram: Dict[int, int],

@@ -279,6 +279,7 @@ def _generation_observation(parsed: dict, source_file: str,
         placement=str(parsed.get("device") or parsed.get("platform")
                       or "default"),
         config={"paged_attn_impl": pa.get("impl"),
+                "kv_dtype": pa.get("kv_dtype"),
                 "mesh_shape": mesh,
                 "mini_batch_size": None, "prefetch_depth": None,
                 "buckets": None},
@@ -289,6 +290,7 @@ def _generation_observation(parsed: dict, source_file: str,
         if os.path.exists(source_file) else None)
     # top-level for cheap grouping without digging into config
     obs["paged_attn_impl"] = pa.get("impl")
+    obs["kv_dtype"] = pa.get("kv_dtype")
     obs["mesh_shape"] = mesh
     return obs
 

@@ -1177,6 +1177,74 @@ def test_baseline_load_rejects_bad_version(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# TPU018 unscaled-quant-cast
+
+
+def test_tpu018_bare_int8_cast_on_kv_fires():
+    findings, _ = run_fixture("""\
+        import jax.numpy as jnp
+
+        def write_rows(pool, k_new):
+            return pool.at[0].set(k_new.astype(jnp.int8))
+        """, relpath="mmlspark_tpu/serving/pool.py")
+    (f,) = [f for f in findings if f.rule == "TPU018"]
+    assert f.severity == "warning"
+    assert "quantize_kv" in f.message
+
+
+def test_tpu018_convert_element_type_on_cache_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        import jax.numpy as jnp
+
+        def stash(cache_rows):
+            return jax.lax.convert_element_type(cache_rows,
+                                                jnp.float8_e4m3fn)
+        """, relpath="mmlspark_tpu/serving/pool.py")
+    assert codes(findings).count("TPU018") == 1
+
+
+def test_tpu018_quiet_on_uint8_and_unrelated_names():
+    # the dense image ingest column is raw bytes (uint8 is not a scaled
+    # encoding), and int8 casts on non-KV tensors are out of scope
+    findings, _ = run_fixture("""\
+        import jax.numpy as jnp
+
+        def ingest(img_batch):
+            return img_batch.astype(jnp.uint8)
+
+        def labels_to_i8(y):
+            return y.astype(jnp.int8)
+        """, relpath="mmlspark_tpu/image/io.py")
+    assert "TPU018" not in codes(findings)
+
+
+def test_tpu018_sanctioned_helper_module_exempt():
+    findings, _ = run_fixture("""\
+        import jax.numpy as jnp
+
+        def quantize_kv(x, store_dtype):
+            scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+            return (x / scale[..., None]).astype(jnp.int8), scale
+        """, relpath="mmlspark_tpu/ops/kv_quant.py")
+    assert "TPU018" not in codes(findings)
+
+
+def test_tpu018_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax.numpy as jnp
+
+        def debug_dump(k_rows):
+            # lossy by design: a debug histogram, never read back
+            # tpulint: disable=TPU018
+            return k_rows.astype(jnp.int8)
+        """, relpath="mmlspark_tpu/serving/pool.py",
+        keep_suppressed=True)
+    assert "TPU018" not in codes(findings)
+    assert "TPU018" in codes(suppressed)
+
+
 # CLI exit codes
 
 
@@ -1202,6 +1270,8 @@ def test_cli_positive_fixtures_exit_nonzero(tmp_path):
                   "        x = jax.jit(fn)(x)\n    return x\n",
         "TPU003": "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n"
                   "        return x\n    return -x\n",
+        "TPU018": "import jax.numpy as jnp\n\ndef w(k_rows):\n"
+                  "    return k_rows.astype(jnp.int8)\n",
     }
     for rule, src in fixtures.items():
         p = tmp_path / f"{rule.lower()}.py"

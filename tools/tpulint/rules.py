@@ -1107,3 +1107,96 @@ class UnshardedPallasCall(Rule):
                         f"specs, as ops/paged_attention.py does"))
                 stack.extend(callees)
         return iter(findings)
+
+
+#: receiver-name tokens that mark a tensor as KV-plane / activation data —
+#: a bare low-bit cast on these loses the per-row scale a quantized page
+#: needs to dequantize
+_QUANT_TENSOR_TOKENS = {
+    "k", "q", "v", "kv", "key", "keys", "val", "vals", "value", "values",
+    "cache", "caches", "act", "acts", "activation", "activations",
+    "row", "rows", "page", "pages", "hidden", "ctx", "attn", "logits",
+}
+
+#: modules sanctioned to cast to quantized storage dtypes — the scale-
+#: carrying helpers every writer must route through
+_QUANT_SANCTIONED = ("ops/kv_quant.py",)
+
+
+def _is_quant_store_dtype(module: ModuleInfo, node: ast.AST) -> bool:
+    """True when ``node`` names an int8/fp8 STORAGE dtype (``jnp.int8``,
+    ``jnp.float8_e4m3fn``, a bare ``"int8"`` string...). ``uint8`` is NOT
+    one — the dense image ingest column is a real byte payload, not a
+    scaled quantization of anything."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        s = node.value.lower()
+        return s == "int8" or s.startswith("float8") or s == "fp8"
+    dotted = module.dotted(node)
+    if dotted is None:
+        return False
+    leaf = dotted.rsplit(".", 1)[-1].lower()
+    return leaf == "int8" or leaf.startswith("float8")
+
+
+def _receiver_tokens(node: ast.AST) -> Set[str]:
+    toks: Set[str] = set()
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name:
+            toks.update(t for t in name.lower().split("_") if t)
+    return toks
+
+
+@register_rule
+class UnscaledQuantCast(Rule):
+    code = "TPU018"
+    name = "unscaled-quant-cast"
+    severity = "warning"
+    doc = ("A bare ``.astype(int8/fp8)`` (or "
+           "``lax.convert_element_type``) on a KV/activation tensor "
+           "outside the sanctioned quant helpers (ops/kv_quant.py). A "
+           "low-bit storage cast without a recorded scale either "
+           "truncates the tensor to the [-1, 1]-ish integer lattice "
+           "(silent catastrophic rounding) or, if a scale was applied "
+           "inline, strands it where no reader can find it — the paged "
+           "pools dequantize through the ``(N, H, page)`` scale arrays "
+           "that ``quantize_kv`` produces. Route the cast through "
+           "``mmlspark_tpu.ops.kv_quant.quantize_kv`` (absmax scale "
+           "riding the same block-table index_map as the pages) so every "
+           "writer and the in-kernel dequant agree byte-for-byte. "
+           "``uint8`` is exempt: the dense image ingest column is raw "
+           "bytes, not a scaled encoding.")
+
+    def check(self, module: ModuleInfo):
+        if module.relpath.replace("\\", "/").endswith(_QUANT_SANCTIONED):
+            return iter(())
+        findings: List[Finding] = []
+        for call in module.nodes(ast.Call):
+            target = None
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "astype" and call.args
+                    and _is_quant_store_dtype(module, call.args[0])):
+                target = call.func.value
+            else:
+                dotted = module.dotted(call.func)
+                if (dotted is not None
+                        and dotted.endswith("convert_element_type")
+                        and len(call.args) >= 2
+                        and _is_quant_store_dtype(module, call.args[1])):
+                    target = call.args[0]
+            if target is None:
+                continue
+            if not (_receiver_tokens(target) & _QUANT_TENSOR_TOKENS):
+                continue
+            findings.append(self.finding(
+                module, call,
+                "bare low-bit cast on a KV/activation tensor — the scale "
+                "is lost (or stranded); quantize through "
+                "mmlspark_tpu.ops.kv_quant.quantize_kv so the per-row "
+                "absmax scale lands in the page-aligned scale pool the "
+                "dequant kernel reads"))
+        return iter(findings)
