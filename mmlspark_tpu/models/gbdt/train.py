@@ -459,6 +459,10 @@ def train(params: Dict,
             # hides most of the h2d time behind the native binning loop,
             # and the full host-side binned matrix never materializes
             CHR = 1 << 21
+            # tpulint: disable=TPU021 — single-device branch by
+            # construction (``not will_shard`` above): the chunked upload
+            # stages bins on the default device; the mesh path device_puts
+            # rows under NamedSharding(mesh, P("data")) (row_sharding)
             parts = [jax.device_put(mapper.transform(X[lo:lo + CHR]))
                      for lo in range(0, n, CHR)]
             xb_dev_early = (jnp.concatenate(parts, axis=0)

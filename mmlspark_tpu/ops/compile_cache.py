@@ -245,7 +245,8 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
                    batch_sizes: Sequence[int], shards: int = 1,
                    put: Optional[Callable] = None,
                    counters: Optional[StageCounters] = None,
-                   buckets: Optional[Sequence[int]] = None) -> dict:
+                   buckets: Optional[Sequence[int]] = None,
+                   prog: Optional[str] = None) -> dict:
     """Compile (and prime the caches for) every padding-bucket shape.
 
     For each requested batch size the *padded* feed size is derived exactly
@@ -264,10 +265,19 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
     custom ladder no longer pays for power-of-two buckets its batches can
     never land in.
 
+    ``prog`` names the program for the collective auditor
+    (``parallel.collective_audit``): with the audit enabled, every
+    warmed bucket's compiled HLO is walked for collectives right after
+    its warm-up call (which has just primed jax's compilation cache, so
+    the extra ``lower().compile()`` is a lookup, not a second compile).
+
     Returns ``{"buckets": [padded sizes], "compiles": n, "seconds": s}``.
     ``compiles`` is ``None`` when the jit cache is not introspectable.
     """
     import jax
+
+    # lazy: ops must stay importable without pulling the parallel package
+    from ..parallel import collective_audit as _collective_audit
 
     enable_persistent_cache()
     if put is None:
@@ -289,6 +299,9 @@ def warm_up_jitted(jitted, params, specs: Dict[str, Tuple[np.dtype, tuple]],
             # the timed window covers the compile, not later steady-state
             # batches
             jax.block_until_ready(outs)
+            if prog is not None and _collective_audit.enabled():
+                _collective_audit.get_auditor().record_lowered(
+                    prog, jitted, params, feeds)
             # heartbeat per bucket: the stall budget covers ONE compile,
             # not the whole ladder
             _w.beat()

@@ -1,0 +1,540 @@
+"""Compiled-HLO collective auditor: the runtime half of the sharding story.
+
+``tools/tpulint/sharding.py`` (TPU019–TPU022) catches the sharding
+mistakes visible in the AST; this module catches the ones only the
+compiler can see. GSPMD is free to *insert* collectives the source never
+wrote — a spec that forces a resharding materializes as an all-gather
+nothing in the Python program names, and the bench only notices on a
+real TPU pod. The auditor makes the compiled collective structure a
+checked artifact instead:
+
+- Opt in with ``MMLSPARK_TPU_COLLECTIVE_AUDIT=1``.
+  :func:`audit_program` then wraps each cached decode program
+  (``serving/continuous.py`` factories, ``compile_cache.warm_up_jitted``
+  buckets) so the first call per argument signature walks
+  ``jit(...).lower(...).compile().as_text()`` and counts collective ops
+  by kind — all-reduce, all-gather, reduce-scatter, collective-permute,
+  all-to-all — with output-shape byte estimates. Disabled (the default)
+  it returns the program unchanged: zero overhead, zero imports of jax.
+- Counts mirror as ``mmlspark_collective_ops_total{prog,kind}`` /
+  ``mmlspark_collective_bytes_total{prog,kind}`` and land in the
+  :class:`~mmlspark_tpu.tuning.observations.ObservationStore` via
+  ``harvest_collectives`` (``source="collective_audit"``) so the cost
+  model's ``collective_ms_per_tick_est`` gets a measured op-count basis.
+- The per-program table diffs against a committed, line-number-free
+  budget (``tools/tpulint/collective_budget.json``, the same
+  versioned-JSON shape as the tpulint baseline). ``python -m
+  mmlspark_tpu.parallel.collective_audit`` rebuilds the meshed programs
+  on the simulated 8-device mesh, re-audits, and exits 1 when any
+  program exceeds its budget — the PR 15 invariant (meshed decode tick
+  = exactly one all-reduce, zero all-gathers) breaks the build instead
+  of a future TPU round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar
+
+from ..observability import counter as _metric_counter
+
+_F = TypeVar("_F", bound=Callable[..., Any])
+
+ENV_FLAG = "MMLSPARK_TPU_COLLECTIVE_AUDIT"
+
+#: HLO collective kinds the auditor counts (async ``-start`` forms fold
+#: into their base kind; ``-done`` ops carry no payload and are skipped)
+KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+         "collective-permute", "all-to-all")
+
+#: the committed budget, colocated with the tpulint baseline it mirrors
+DEFAULT_BUDGET_PATH = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
+    "tools", "tpulint", "collective_budget.json"))
+
+M_COLLECTIVE_OPS = _metric_counter(
+    "mmlspark_collective_ops_total",
+    "Collective ops in audited compiled programs, by program and kind",
+    ("prog", "kind"))
+M_COLLECTIVE_BYTES = _metric_counter(
+    "mmlspark_collective_bytes_total",
+    "Estimated bytes moved by audited collectives (output-shape bytes)",
+    ("prog", "kind"))
+
+
+def enabled() -> bool:
+    """The audit opt-in: ``MMLSPARK_TPU_COLLECTIVE_AUDIT=1`` (anything
+    but empty/0/false/no)."""
+    return os.environ.get(ENV_FLAG, "").strip().lower() \
+        not in ("", "0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# HLO text → collective counts
+# ---------------------------------------------------------------------------
+
+# "%x = f32[4,8]{1,0} all-reduce(...)" / "... all-gather-start(...)".
+# Requiring "(" right after the (optionally -start) kind keeps the
+# payload-free "-done" halves of async pairs out of the count.
+_COLLECTIVE_RE = re.compile(
+    r"=\s*([^\n]*?)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+
+#: dtype token → bytes per element, for the output-shape byte estimate
+_DTYPE_BYTES = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+                "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                "s32": 4, "u32": 4, "f32": 4, "c64": 8,
+                "s64": 8, "u64": 8, "f64": 8, "c128": 16}
+
+_SHAPE_TOKEN_RE = re.compile(r"\b([a-z]\w*)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Bytes of every ``dtype[dims]`` token in an HLO shape string —
+    tuple shapes sum their elements; layout suffixes don't match."""
+    total = 0
+    for dtype, dims in _SHAPE_TOKEN_RE.findall(shape_text):
+        per = _DTYPE_BYTES.get(dtype)
+        if per is None:
+            per = 1 if dtype.startswith("f8") else None
+        if per is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * per
+    return total
+
+
+def count_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Collectives in one compiled module's HLO text, by kind:
+    ``{kind: {"ops": n, "bytes": estimated_output_bytes}}`` (kinds with
+    zero ops are omitted)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        kind = m.group(2)
+        row = out.setdefault(kind, {"ops": 0, "bytes": 0})
+        row["ops"] += 1
+        row["bytes"] += _shape_bytes(m.group(1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the auditor: per-program table + metrics mirror
+# ---------------------------------------------------------------------------
+
+def _call_signature(args: tuple, kwargs: dict) -> Tuple:
+    """Hashable (treedef, leaf shape/dtype) signature of one call — the
+    unit the audit dedupes on, matching jit's own cache key shape."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", "?"))))
+        else:
+            sig.append((type(leaf).__name__, repr(leaf)[:64]))
+    return str(treedef), tuple(sig)
+
+
+class CollectiveAuditor:
+    """Per-program collective table: ``sigs`` audited signatures and the
+    elementwise MAX of each kind's ops/bytes across them (a budget bounds
+    the worst signature, so the max is the honest summary)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._table: Dict[str, Dict[str, Any]] = {}
+
+    def record_hlo(self, prog: str, hlo_text: str) -> Dict[str, Dict]:
+        counts = count_collectives(hlo_text)
+        with self._lock:
+            row = self._table.setdefault(prog, {"sigs": 0, "kinds": {}})
+            row["sigs"] += 1
+            for kind, c in counts.items():
+                k = row["kinds"].setdefault(kind, {"ops": 0, "bytes": 0})
+                k["ops"] = max(k["ops"], c["ops"])
+                k["bytes"] = max(k["bytes"], c["bytes"])
+        for kind, c in counts.items():
+            M_COLLECTIVE_OPS.inc(c["ops"], prog=prog, kind=kind)
+            M_COLLECTIVE_BYTES.inc(c["bytes"], prog=prog, kind=kind)
+        return counts
+
+    def record_lowered(self, prog: str, fn, *args,
+                       **kwargs) -> Optional[Dict[str, Dict]]:
+        """Lower/compile ``fn`` for these arguments and record its HLO.
+        Never raises into the serving path — a program that resists
+        lowering (donation quirks, non-jitted callable) audits as
+        nothing rather than killing the tick."""
+        try:
+            hlo = fn.lower(*args, **kwargs).compile().as_text()
+        except Exception:
+            return None
+        return self.record_hlo(prog, hlo)
+
+    def table(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-safe deep copy of the per-program table."""
+        with self._lock:
+            return {prog: {"sigs": row["sigs"],
+                           "kinds": {k: dict(v)
+                                     for k, v in row["kinds"].items()}}
+                    for prog, row in self._table.items()}
+
+
+_AUDITOR = CollectiveAuditor()
+
+
+def get_auditor() -> CollectiveAuditor:
+    return _AUDITOR
+
+
+def reset_auditor() -> None:
+    """Tests: drop the accumulated table (metrics reset separately via
+    ``observability.reset_all``)."""
+    global _AUDITOR
+    _AUDITOR = CollectiveAuditor()
+
+
+def audit_program(prog: str, fn: _F) -> _F:
+    """Wrap a jitted program so each new argument signature is lowered
+    once more and its compiled HLO's collectives recorded under ``prog``.
+
+    With the audit disabled (the default) this returns ``fn`` itself —
+    the serving path pays nothing, not even a wrapper frame. Enabled, the
+    one extra ``lower().compile()`` per signature hits jax's compilation
+    cache the jitted call just warmed, so the audit costs a cache lookup
+    and a text render, not a second compile.
+    """
+    if not enabled():
+        return fn
+
+    auditor = get_auditor()
+    seen: set = set()
+    lock = threading.Lock()
+
+    def wrapper(*args, **kwargs):
+        try:
+            sig = _call_signature(args, kwargs)
+        except Exception:
+            sig = None
+        if sig is not None:
+            with lock:
+                fresh = sig not in seen
+                if fresh:
+                    seen.add(sig)
+            if fresh:
+                auditor.record_lowered(prog, fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = getattr(fn, "__name__", prog)
+    wrapper._audited_prog = prog
+    wrapper._audited_fn = fn
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:
+        # keep compile_cache.jit_cache_size introspection working
+        wrapper._cache_size = cache_size
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# budget file (same versioned-JSON discipline as the tpulint baseline)
+# ---------------------------------------------------------------------------
+
+BUDGET_VERSION = 1
+
+
+def budget_from_table(table: Dict[str, Dict]) -> Dict[str, Any]:
+    """Collapse an auditor table into the committed budget shape:
+    ``{"version": 1, "budgets": {prog: {kind: max_ops}}}``. Programs with
+    no collectives get an empty dict — their budget is *zero of
+    everything*, so a regression inserting any collective trips CI."""
+    budgets = {prog: {kind: row["kinds"][kind]["ops"]
+                      for kind in sorted(row.get("kinds", {}))
+                      if row["kinds"][kind]["ops"] > 0}
+               for prog, row in sorted(table.items())}
+    return {"version": BUDGET_VERSION, "budgets": budgets}
+
+
+def load_budget(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BUDGET_VERSION:
+        raise ValueError(
+            f"unknown collective budget version {data.get('version')!r} "
+            f"in {path} (expected {BUDGET_VERSION})")
+    return data
+
+
+def write_budget(table: Dict[str, Dict], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(budget_from_table(table), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def check_budget(table: Dict[str, Dict],
+                 budget: Dict[str, Any]) -> Tuple[List[str], List[str]]:
+    """Diff an observed table against the committed budget.
+
+    Returns ``(violations, drift)``: violations are observed counts
+    above budget or programs the budget has never seen (both gate CI);
+    drift is counts *below* budget — an improvement worth re-recording
+    with ``--write-budget``, reported but not gating."""
+    budgets = budget.get("budgets", {})
+    violations: List[str] = []
+    drift: List[str] = []
+    for prog in sorted(table):
+        kinds = table[prog].get("kinds", {})
+        allowed = budgets.get(prog)
+        if allowed is None:
+            observed = {k: v["ops"] for k, v in sorted(kinds.items())}
+            desc = json.dumps(observed) if observed else "none"
+            violations.append(
+                f"{prog}: program not in budget (observed {desc}) — "
+                f"record it with --write-budget")
+            continue
+        for kind in sorted(set(kinds) | set(allowed)):
+            ops = kinds.get(kind, {}).get("ops", 0)
+            cap = int(allowed.get(kind, 0))
+            if ops > cap:
+                violations.append(
+                    f"{prog}: {kind} x{ops} exceeds budget of {cap} — "
+                    f"a resharding crept into the compiled program")
+            elif ops < cap:
+                drift.append(
+                    f"{prog}: {kind} x{ops} under budget of {cap} — "
+                    f"improvement; tighten with --write-budget")
+    return violations, drift
+
+
+# ---------------------------------------------------------------------------
+# CLI: rebuild the meshed programs, re-audit, diff against the budget
+# ---------------------------------------------------------------------------
+
+def _audit_reference_programs() -> None:
+    """Build and drive every meshed program on the simulated 8-device
+    mesh so the process-wide auditor sees each one at least once: the
+    engine's tick/spec-tick/prefill/extend family (plus its page-plumbing
+    programs), and standalone ring/flash/MoE steps."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models.zoo.transformer import TransformerConfig, init_transformer
+    from ..ops.flash_attention import flash_attention_sharded
+    from ..serving.continuous import ContinuousDecoder
+    from .mesh import get_shard_map, make_mesh
+    from .moe import init_moe_params, moe_capacity, moe_ffn_gspmd
+    from .ring import wrap_ring_attention
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise SystemExit(
+            "collective_audit: needs 8 (simulated) devices — run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "JAX_PLATFORMS=cpu")
+    mesh = make_mesh({"dp": 4, "tp": 2}, devs[:8])
+
+    cfg = TransformerConfig(vocab=128, layers=2, d_model=64, heads=4,
+                            d_ff=128, max_len=96, causal=True,
+                            norm="rmsnorm", position="rope",
+                            dtype=jnp.float32)
+    d_cfg = cfg._replace(layers=1, d_model=32, heads=2, d_ff=64)
+    params = init_transformer(cfg, seed=0)
+    d_params = init_transformer(d_cfg, seed=1)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab, 4 + 3 * i).astype(np.int32)
+               for i in range(4)]
+
+    def drain(eng, ps, max_new=8):
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in ps]
+        while any(r is not None for r in eng._slot_req) or eng._waiting:
+            eng.step()
+        return reqs
+
+    # plain meshed engine: tick, prefill, extend (prefill_chunk smaller
+    # than the longest prompt forces the chunked path), page plumbing
+    # (defrag_threshold=1 makes retirement compact the pool mid-run)
+    eng = ContinuousDecoder(params, cfg, max_slots=4, max_len=64,
+                            mesh=mesh, paged_attn="kernel",
+                            prefill_chunk=8, defrag_threshold=1)
+    drain(eng, prompts)
+    # sampled tick
+    eng2 = ContinuousDecoder(params, cfg, max_slots=4, max_len=64,
+                             mesh=mesh, paged_attn="kernel")
+    req = eng2.submit(prompts[0], max_new_tokens=4, temperature=0.7)
+    while not req.done:
+        eng2.step()
+    # speculative tick (draft model riding the same mesh)
+    eng3 = ContinuousDecoder(params, cfg, max_slots=4, max_len=64,
+                             mesh=mesh, paged_attn="kernel",
+                             draft_params=d_params, draft_cfg=d_cfg,
+                             gamma=2)
+    drain(eng3, prompts[:2], max_new=6)
+
+    # standalone meshed steps: sequence-parallel attention (sp over all
+    # 8 devices; the ulysses impl — ring-proper needs lax.pcast, newer
+    # than the pinned jax), flash attention (dp×tp), MoE dispatch (ep)
+    sp_mesh = make_mesh({"sp": 8}, devs[:8])
+    # B divisible by dp (flash), H by sp (ulysses) and tp (flash), S by sp
+    B, H, S, D = 4, 8, 64, 16
+    k = jax.random.PRNGKey(0)
+    q, kk, v = (jax.random.normal(jax.random.fold_in(k, i), (B, H, S, D),
+                                  jnp.float32) for i in range(3))
+    sp_fn = audit_program(
+        "sp_step",
+        jax.jit(wrap_ring_attention(sp_mesh, "sp", "ulysses")))
+    jax.block_until_ready(sp_fn(q, kk, v))
+
+    # the PR 15 invariant, stated as its own budgeted program: the
+    # decode tick's attention core. Heads shard over tp, attention is
+    # entirely head-local, and the row-parallel output projection pays
+    # the ONE psum that merges head contributions. Its committed budget
+    # is exactly {all-reduce: 1} — no all-gathers — so a resharding
+    # that re-inserts a gather into this step breaks CI. (The full
+    # "tick" program is budgeted too, at its recorded compiled counts:
+    # norm statistics and the host-fetch gather legitimately add
+    # collectives there that are not part of this invariant.)
+    shard_map, uncheck = get_shard_map()
+    tp_mesh = make_mesh({"tp": 2}, devs[:2])
+    wo = jax.random.normal(jax.random.fold_in(k, 9), (H * D, H * D),
+                           jnp.float32) * 0.05
+
+    def _attn_core(ql, kl, vl, wo_shard):
+        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl,
+                       preferred_element_type=jnp.float32) / (D ** 0.5)
+        o = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(s, axis=-1).astype(vl.dtype), vl)
+        flat = o.transpose(0, 2, 1, 3).reshape(
+            ql.shape[0], ql.shape[2], -1)
+        return jax.lax.psum(flat @ wo_shard, "tp")
+
+    core = shard_map(_attn_core, mesh=tp_mesh,
+                     in_specs=(P(None, "tp", None, None),) * 3
+                     + (P("tp", None),),
+                     out_specs=P(), **uncheck)
+    core_fn = audit_program("tick_core", jax.jit(core))
+    jax.block_until_ready(core_fn(q, kk, v, wo))
+
+    flash_fn = audit_program(
+        "flash_step",
+        jax.jit(lambda a, b, c: flash_attention_sharded(a, b, c, mesh)))
+    jax.block_until_ready(flash_fn(q, kk, v))
+
+    # MoE dispatch: the GSPMD variant — XLA inserts the dispatch/return
+    # all-to-alls from the sharding constraints, which is exactly the
+    # "compiler-inserted collective" class the audit exists to pin down
+    # (moe_ffn_sharded's explicit path needs lax.axis_size, newer than
+    # the pinned jax)
+    n_exp = 4
+    cap = moe_capacity(6, n_exp)
+    moe_params = init_moe_params(cfg.d_model, cfg.d_ff, n_exp, seed=2)
+    t = jax.random.normal(jax.random.fold_in(k, 7),
+                          (8, 6, cfg.d_model), jnp.float32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    pd = jax.device_put(moe_params, {
+        "gate": NamedSharding(mesh, P()),
+        "w1": NamedSharding(mesh, P("dp", None, "tp")),
+        "b1": NamedSharding(mesh, P("dp", "tp")),
+        "w2": NamedSharding(mesh, P("dp", "tp", None)),
+        "b2": NamedSharding(mesh, P("dp", None))})
+    td = jax.device_put(t, NamedSharding(mesh, P("dp", None, None)))
+    moe_fn = audit_program(
+        "moe_dispatch",
+        jax.jit(lambda a, p: moe_ffn_gspmd(a, p, n_exp, cap, mesh=mesh,
+                                           ep_axis="dp", tp_axis="tp")))
+    jax.block_until_ready(moe_fn(td, pd))
+
+
+def _report(table: Dict[str, Dict], out) -> None:
+    for prog in sorted(table):
+        row = table[prog]
+        kinds = ", ".join(f"{k}:{v['ops']} (~{v['bytes']}B)"
+                          for k, v in sorted(row["kinds"].items())) \
+            or "no collectives"
+        print(f"  {prog:<14} sigs={row['sigs']:<3} {kinds}", file=out)
+
+
+def main(argv: Optional[List[str]] = None, stdout=None) -> int:
+    out = stdout if stdout is not None else sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="python -m mmlspark_tpu.parallel.collective_audit",
+        description="Audit compiled-HLO collectives against the "
+                    "committed per-program budget.")
+    ap.add_argument("--budget", default=DEFAULT_BUDGET_PATH,
+                    help="budget JSON path (default: the committed "
+                         "tools/tpulint/collective_budget.json)")
+    ap.add_argument("--write-budget", action="store_true",
+                    help="record the observed table as the new budget "
+                         "instead of diffing against it")
+    ap.add_argument("--table",
+                    help="audit a previously dumped table JSON instead "
+                         "of rebuilding the meshed programs (tests)")
+    ap.add_argument("--dump-table",
+                    help="also write the observed table JSON here")
+    ap.add_argument("--harvest", action="store_true",
+                    help="land the table in the ObservationStore "
+                         "(source=collective_audit)")
+    args = ap.parse_args(argv)
+
+    if args.table:
+        with open(args.table, encoding="utf-8") as fh:
+            table = json.load(fh)
+    else:
+        os.environ[ENV_FLAG] = "1"
+        reset_auditor()
+        _audit_reference_programs()
+        table = get_auditor().table()
+
+    print(f"collective_audit: {len(table)} program(s)", file=out)
+    _report(table, out)
+
+    if args.dump_table:
+        with open(args.dump_table, "w", encoding="utf-8") as fh:
+            json.dump(table, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.harvest:
+        from ..tuning.observations import harvest_collectives
+        n = harvest_collectives(table)
+        print(f"collective_audit: harvested {n} observation row(s)",
+              file=out)
+
+    if args.write_budget:
+        write_budget(table, args.budget)
+        print(f"collective_audit: wrote budget for {len(table)} "
+              f"program(s) to {args.budget}", file=out)
+        return 0
+
+    try:
+        budget = load_budget(args.budget)
+    except OSError:
+        print(f"collective_audit: no budget at {args.budget} — record "
+              f"one with --write-budget", file=out)
+        return 1
+    violations, drift = check_budget(table, budget)
+    for line in drift:
+        print(f"collective_audit: note: {line}", file=out)
+    if violations:
+        for line in violations:
+            print(f"collective_audit: BUDGET EXCEEDED: {line}", file=out)
+        return 1
+    print("collective_audit: within budget", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    # run the CANONICAL module's main: under ``python -m`` this file
+    # executes as ``__main__``, a second module instance whose auditor
+    # the engine (which imports the canonical name) would never touch
+    from mmlspark_tpu.parallel.collective_audit import main as _main
+    sys.exit(_main())

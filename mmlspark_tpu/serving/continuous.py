@@ -87,6 +87,7 @@ from ..models.zoo.transformer import (TransformerConfig,
 from ..ops.padding import bucket_size
 from ..ops.paged_attention import (resolve_impl as _resolve_paged_attn,
                                    _auto_interpret as _pa_auto_interpret)
+from ..parallel.collective_audit import audit_program as _audit_program
 from ..parallel.mesh import mesh_shape
 from .kv_pool import (KVAutotuner, PagedKVPool, PoolExhausted,
                       prefix_hash as _prefix_hash)
@@ -831,15 +832,18 @@ class ContinuousDecoder:
         # argument: the scan body reads it (gather + writeback routing)
         # but never changes it — pages are remapped host-side between
         # dispatches, and the engine re-binds self._bt outside jit.
-        self._tick = _tick_program(cfg, page, Lc, self._k, self._eos,
-                                   False, donate, self._attn_impl,
-                                   mesh, slot_axis, head_axis,
-                                   self._kv_dtype)
-        self._tick_sampled = _tick_program(cfg, page, Lc, self._k,
-                                           self._eos, True, donate,
-                                           self._attn_impl,
-                                           mesh, slot_axis, head_axis,
-                                           self._kv_dtype)
+        # every cached program mounts through the collective auditor —
+        # identity when MMLSPARK_TPU_COLLECTIVE_AUDIT is unset, else the
+        # compiled HLO's collectives are counted per argument signature
+        # and diffed against tools/tpulint/collective_budget.json
+        self._tick = _audit_program("tick", _tick_program(
+            cfg, page, Lc, self._k, self._eos, False, donate,
+            self._attn_impl, mesh, slot_axis, head_axis,
+            self._kv_dtype))
+        self._tick_sampled = _audit_program("tick_sampled", _tick_program(
+            cfg, page, Lc, self._k, self._eos, True, donate,
+            self._attn_impl, mesh, slot_axis, head_axis,
+            self._kv_dtype))
         # per-call KV HBM traffic of one full sweep over the cache at
         # worst-case length, in the bytes the pool ACTUALLY stores — the
         # quantized plane shrinks this ~2x (int8 values + bf16 scales vs
@@ -868,35 +872,38 @@ class ContinuousDecoder:
             def _spec_tick_for(mode: str, g: int):
                 fn = self._spec_ticks.get((mode, g))
                 if fn is None:
-                    fn = _spec_tick_program(
+                    fn = _audit_program("spec_tick", _spec_tick_program(
                         cfg, d_cfg, page, Lc, self._k, self._eos, g,
                         sample=(mode != "greedy"),
                         warp=(mode == "warped"), donate=donate,
                         attn=self._attn_impl, mesh=self._mesh,
                         slot_axis=self._slot_axis,
                         head_axis=self._head_axis,
-                        kv_dtype=self._kv_dtype)
+                        kv_dtype=self._kv_dtype))
                     self._spec_ticks[(mode, g)] = fn
                 return fn
 
             self._spec_tick_for = _spec_tick_for
 
         # one compiled prefill per padded prompt bucket
-        self._prefill = _prefill_program(cfg, self._L)
+        self._prefill = _audit_program("prefill",
+                                       _prefill_program(cfg, self._L))
         if self._spec:
             # the draft pool prefills the same prompts (its cache must
             # hold the prompt K/V before it can propose)
-            self._d_prefill = _prefill_program(self._d_cfg, self._L)
+            self._d_prefill = _audit_program(
+                "draft_prefill", _prefill_program(self._d_cfg, self._L))
 
         # prefix-cache suffix extension + chunked prefill (one program)
-        self._extend_paged = _extend_program(cfg, page, self._L, donate,
-                                             self._attn_impl,
-                                             mesh, head_axis,
-                                             self._kv_dtype)
+        self._extend_paged = _audit_program("extend", _extend_program(
+            cfg, page, self._L, donate, self._attn_impl, mesh,
+            head_axis, self._kv_dtype))
 
         # copy-on-write boundary-page copy + defrag permutation
-        self._copy_pages_j = _copy_pages_program(donate)
-        self._compact_j = _compact_program(donate)
+        self._copy_pages_j = _audit_program("copy_pages",
+                                            _copy_pages_program(donate))
+        self._compact_j = _audit_program("compact",
+                                         _compact_program(donate))
         #: key → (prefix token copy, pool prefix hash, prefix length);
         #: the PAGES live in the pool's prefix registry — this host map
         #: adds the engine-facing key, LRU promotion and FIFO eviction
@@ -905,9 +912,11 @@ class ContinuousDecoder:
         self.stats = {"prefills": 0, "prefix_hits": 0}
 
         # group insert + first tokens (see the module factories)
-        self._insert_group_j = _insert_group_program(page, donate,
-                                                     self._kv_dtype)
-        self._first_tokens = _first_tokens_program()
+        self._insert_group_j = _audit_program(
+            "insert_group", _insert_group_program(page, donate,
+                                                  self._kv_dtype))
+        self._first_tokens = _audit_program("first_tokens",
+                                            _first_tokens_program())
 
     def _reset_device_state(self):
         """(Re)build every slot-pool device buffer — at construction and in

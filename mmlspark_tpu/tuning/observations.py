@@ -29,7 +29,8 @@ from ..observability import gauge as _metric_gauge
 
 __all__ = ["TUNING_DIR_ENV", "Observation", "ObservationStore", "get_store",
            "set_store", "reset_store", "import_bench_records",
-           "harvest_samples", "harvest_scorecard", "harvest_costs"]
+           "harvest_samples", "harvest_scorecard", "harvest_costs",
+           "harvest_collectives"]
 
 #: environment variable naming the persisted-observation directory (the
 #: tuning analogue of ``MMLSPARK_TPU_COMPILE_CACHE_DIR``)
@@ -442,6 +443,37 @@ def harvest_costs(snapshot: dict,
         obs["model_version"] = model.partition("@")[2] or None
         obs["cost"] = dict(res)
         obs["weighted_cost"] = cls.get("weighted_cost")
+        store.record(obs)
+        n += 1
+    return n
+
+
+def harvest_collectives(table: dict,
+                        store: Optional[ObservationStore] = None,
+                        placement: str = "default") -> int:
+    """Land a collective-audit table (``parallel.collective_audit.
+    CollectiveAuditor.table``) in the store as one
+    ``source="collective_audit"`` row per audited program.
+
+    The cost model's ``collective_ms_per_tick_est`` so far extrapolated
+    from mesh shape alone; these rows give it a *measured* per-program
+    op-count basis — compiled-HLO truth, not topology arithmetic.
+    ``rows`` carries the number of audited argument signatures; the
+    per-kind ops/bytes breakdown rides under the extra ``collectives``
+    key with ``ops_total``/``bytes_total`` roll-ups beside it."""
+    store = store if store is not None else get_store()
+    n = 0
+    for prog in sorted(table):
+        row = table[prog]
+        kinds = {k: dict(v) for k, v in (row.get("kinds") or {}).items()}
+        obs = Observation(sig="collective:" + prog,
+                          source="collective_audit", placement=placement,
+                          rows=int(row.get("sigs", 0)))
+        obs["prog"] = prog
+        obs["collectives"] = kinds
+        obs["ops_total"] = sum(v.get("ops", 0) for v in kinds.values())
+        obs["bytes_total"] = sum(v.get("bytes", 0)
+                                 for v in kinds.values())
         store.record(obs)
         n += 1
     return n

@@ -1,0 +1,328 @@
+"""Compiled-HLO collective auditor (``parallel/collective_audit.py``).
+
+Covers the contract the CI ``collective-audit`` stage gates on:
+
+* disabled = zero overhead: ``audit_program`` returns the callable
+  itself, not a wrapper;
+* HLO count exactness on hand-written HLO text and on a real
+  shard_mapped psum (exactly one all-reduce, async pairs deduped);
+* per-signature dedup: re-calling at a warmed shape re-audits nothing;
+* the ``mmlspark_collective_ops_total`` / ``_bytes_total`` metrics
+  mirror;
+* ``harvest_collectives`` rows (``source="collective_audit"``);
+* budget round-trip, exceed/unbudgeted violations vs under-budget
+  drift, and CLI exit codes in ``--table`` mode;
+* the committed ``tools/tpulint/collective_budget.json`` asserts the
+  PR 15 invariant — ``tick_core`` at exactly one all-reduce, zero
+  all-gathers — and a deliberately injected all-gather demonstrably
+  fails against it (the acceptance negative test).
+"""
+
+import json
+import io
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mmlspark_tpu.observability import reset_all, snapshot
+from mmlspark_tpu.parallel import collective_audit as ca
+from mmlspark_tpu.parallel.mesh import get_shard_map
+from mmlspark_tpu.tuning.observations import (ObservationStore,
+                                              harvest_collectives)
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs 8 (simulated) devices — tier-1's conftest provides them")
+
+
+@pytest.fixture
+def audited(monkeypatch):
+    """Audit enabled against a fresh auditor (and fresh metrics)."""
+    monkeypatch.setenv(ca.ENV_FLAG, "1")
+    ca.reset_auditor()
+    reset_all()
+    yield ca.get_auditor()
+    ca.reset_auditor()
+    reset_all()
+
+
+def _psum_fn(n_dev=4):
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
+    shard_map, uncheck = get_shard_map()
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                             out_specs=P(), **uncheck))
+
+
+# ---------------------------------------------------------------------------
+# disabled = zero overhead
+
+
+def test_disabled_returns_program_unchanged(monkeypatch):
+    monkeypatch.delenv(ca.ENV_FLAG, raising=False)
+    f = lambda x: x  # noqa: E731
+    assert ca.audit_program("anything", f) is f
+    assert not ca.enabled()
+
+
+def test_enabled_flag_values(monkeypatch):
+    for off in ("", "0", "false", "no", "NO"):
+        monkeypatch.setenv(ca.ENV_FLAG, off)
+        assert not ca.enabled()
+    monkeypatch.setenv(ca.ENV_FLAG, "1")
+    assert ca.enabled()
+
+
+# ---------------------------------------------------------------------------
+# HLO count exactness
+
+
+_HLO_SAMPLE = """\
+HloModule jit_step
+
+ENTRY %main {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %ar = f32[4,8]{1,0} all-reduce(%p0), replica_groups={{0,1,2,3}}
+  %ags = (f32[4,8]{1,0}, f32[16,8]{1,0}) all-gather-start(%ar)
+  %ag = f32[16,8]{1,0} all-gather-done(%ags)
+  %cp = bf16[2,4]{1,0} collective-permute(%p1), source_target_pairs={{0,1}}
+  ROOT %t = (f32[16,8]{1,0}) tuple(%ag)
+}
+"""
+
+
+def test_count_collectives_on_hlo_text():
+    counts = ca.count_collectives(_HLO_SAMPLE)
+    # the -start/-done async pair is ONE all-gather, not two
+    assert counts["all-reduce"]["ops"] == 1
+    assert counts["all-gather"]["ops"] == 1
+    assert counts["collective-permute"]["ops"] == 1
+    assert "all-to-all" not in counts
+    # bytes: f32[4,8] = 128; the all-gather's tuple shape sums both
+    # elements (128 + 512); bf16[2,4] = 16
+    assert counts["all-reduce"]["bytes"] == 128
+    assert counts["all-gather"]["bytes"] == 640
+    assert counts["collective-permute"]["bytes"] == 16
+
+
+@needs_devices
+def test_shard_mapped_psum_counts_exactly_one_all_reduce(audited):
+    fn = ca.audit_program("toy", _psum_fn())
+    x = jnp.ones((8, 16), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(fn(x)), 4.0)
+    row = audited.table()["toy"]
+    assert row["sigs"] == 1
+    assert row["kinds"]["all-reduce"]["ops"] == 1
+    assert "all-gather" not in row["kinds"]
+    assert row["kinds"]["all-reduce"]["bytes"] > 0
+
+
+@needs_devices
+def test_signature_dedup_and_new_shapes(audited):
+    fn = ca.audit_program("toy", _psum_fn())
+    fn(jnp.ones((8, 16), jnp.float32))
+    fn(jnp.ones((8, 16), jnp.float32))     # warmed shape: no re-audit
+    assert audited.table()["toy"]["sigs"] == 1
+    fn(jnp.ones((8, 32), jnp.float32))     # new shape: one more audit
+    assert audited.table()["toy"]["sigs"] == 2
+
+
+# ---------------------------------------------------------------------------
+# metrics mirror + ObservationStore harvest
+
+
+@needs_devices
+def test_metrics_mirror(audited):
+    fn = ca.audit_program("toy", _psum_fn())
+    fn(jnp.ones((8, 16), jnp.float32))
+    snap = snapshot()
+    ops = {tuple(sorted(s["labels"].items())): s["value"]
+           for s in snap["mmlspark_collective_ops_total"]["series"]}
+    key = (("kind", "all-reduce"), ("prog", "toy"))
+    assert ops[key] == 1.0
+    bts = {tuple(sorted(s["labels"].items())): s["value"]
+           for s in snap["mmlspark_collective_bytes_total"]["series"]}
+    assert bts[key] > 0
+
+
+def test_harvest_collectives_rows():
+    table = {
+        "tick": {"sigs": 2, "kinds": {
+            "all-reduce": {"ops": 9, "bytes": 1044},
+            "all-gather": {"ops": 15, "bytes": 3472}}},
+        "compact": {"sigs": 1, "kinds": {}},
+    }
+    store = ObservationStore()
+    assert harvest_collectives(table, store=store) == 2
+    rows = store.rows(source="collective_audit")
+    assert len(rows) == 2
+    tick = next(r for r in rows if r["prog"] == "tick")
+    assert tick["sig"] == "collective:tick"
+    assert tick["rows"] == 2
+    assert tick["ops_total"] == 24
+    assert tick["bytes_total"] == 4516
+    assert tick["collectives"]["all-reduce"]["ops"] == 9
+    quiet = next(r for r in rows if r["prog"] == "compact")
+    assert quiet["ops_total"] == 0 and quiet["collectives"] == {}
+
+
+# ---------------------------------------------------------------------------
+# budget round-trip + violation semantics
+
+
+def _table(kinds):
+    return {"tick": {"sigs": 1, "kinds": kinds}}
+
+
+def test_budget_roundtrip(tmp_path):
+    table = _table({"all-reduce": {"ops": 1, "bytes": 64}})
+    path = str(tmp_path / "budget.json")
+    ca.write_budget(table, path)
+    budget = ca.load_budget(path)
+    assert budget == {"version": 1, "budgets": {"tick": {"all-reduce": 1}}}
+    violations, drift = ca.check_budget(table, budget)
+    assert not violations and not drift
+
+
+def test_budget_exceed_unbudgeted_and_drift():
+    budget = {"version": 1, "budgets": {"tick": {"all-reduce": 2}}}
+    # exceed: one op over
+    v, d = ca.check_budget(_table({"all-reduce": {"ops": 3, "bytes": 1}}),
+                           budget)
+    assert len(v) == 1 and "exceeds" in v[0] and not d
+    # a kind the budget never allowed: zero-budget semantics
+    v, d = ca.check_budget(
+        _table({"all-reduce": {"ops": 2, "bytes": 1},
+                "all-gather": {"ops": 1, "bytes": 1}}), budget)
+    assert len(v) == 1 and "all-gather" in v[0]
+    # unbudgeted program gates
+    v, _ = ca.check_budget({"mystery": {"sigs": 1, "kinds": {}}}, budget)
+    assert len(v) == 1 and "not in budget" in v[0]
+    # under budget is drift, not a violation
+    v, d = ca.check_budget(_table({"all-reduce": {"ops": 1, "bytes": 1}}),
+                           budget)
+    assert not v and len(d) == 1 and "under budget" in d[0]
+
+
+def test_budget_load_rejects_bad_version(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"version": 9, "budgets": {}}))
+    with pytest.raises(ValueError):
+        ca.load_budget(str(path))
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (--table mode: no program rebuild)
+
+
+def _cli(args):
+    out = io.StringIO()
+    rc = ca.main(args, stdout=out)
+    return rc, out.getvalue()
+
+
+def test_cli_within_budget_exits_zero(tmp_path):
+    table = _table({"all-reduce": {"ops": 1, "bytes": 64}})
+    tpath, bpath = str(tmp_path / "t.json"), str(tmp_path / "b.json")
+    with open(tpath, "w") as fh:
+        json.dump(table, fh)
+    ca.write_budget(table, bpath)
+    rc, out = _cli(["--table", tpath, "--budget", bpath])
+    assert rc == 0 and "within budget" in out
+
+
+def test_cli_exceeded_budget_exits_nonzero(tmp_path):
+    tpath, bpath = str(tmp_path / "t.json"), str(tmp_path / "b.json")
+    ca.write_budget(_table({"all-reduce": {"ops": 1, "bytes": 64}}), bpath)
+    with open(tpath, "w") as fh:
+        json.dump(_table({"all-reduce": {"ops": 1, "bytes": 64},
+                          "all-gather": {"ops": 1, "bytes": 64}}), fh)
+    rc, out = _cli(["--table", tpath, "--budget", bpath])
+    assert rc == 1 and "BUDGET EXCEEDED" in out and "all-gather" in out
+
+
+def test_cli_missing_budget_exits_nonzero(tmp_path):
+    tpath = str(tmp_path / "t.json")
+    with open(tpath, "w") as fh:
+        json.dump(_table({}), fh)
+    rc, out = _cli(["--table", tpath,
+                    "--budget", str(tmp_path / "absent.json")])
+    assert rc == 1 and "--write-budget" in out
+
+
+def test_cli_write_budget_then_check(tmp_path):
+    tpath, bpath = str(tmp_path / "t.json"), str(tmp_path / "b.json")
+    with open(tpath, "w") as fh:
+        json.dump(_table({"all-to-all": {"ops": 4, "bytes": 9}}), fh)
+    rc, _ = _cli(["--table", tpath, "--budget", bpath, "--write-budget"])
+    assert rc == 0
+    rc, out = _cli(["--table", tpath, "--budget", bpath])
+    assert rc == 0 and "within budget" in out
+
+
+# ---------------------------------------------------------------------------
+# the committed budget: PR 15 invariant + the acceptance negative test
+
+
+def _committed_budget():
+    return ca.load_budget(ca.DEFAULT_BUDGET_PATH)
+
+
+def test_committed_budget_asserts_tick_core_invariant():
+    budget = _committed_budget()
+    # the meshed decode tick's attention core: EXACTLY one all-reduce,
+    # zero of everything else (absent kind = zero budget)
+    assert budget["budgets"]["tick_core"] == {"all-reduce": 1}
+    # and every engine program the reference build audits is budgeted
+    for prog in ("tick", "tick_sampled", "spec_tick", "prefill",
+                 "draft_prefill", "extend", "sp_step", "flash_step",
+                 "moe_dispatch"):
+        assert prog in budget["budgets"], prog
+
+
+@needs_devices
+def test_injected_all_gather_fails_committed_budget(audited):
+    """The acceptance negative test: a deliberate extra all-gather in
+    the meshed tick-core program must trip the committed budget."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    shard_map, uncheck = get_shard_map()
+
+    def body(x):
+        y = jax.lax.psum(x, "dp")
+        return y + jax.lax.all_gather(x, "dp").sum(0)   # the regression
+
+    fn = ca.audit_program("tick_core", jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P("dp"),), out_specs=P(),
+                  **uncheck)))
+    fn(jnp.ones((8, 16), jnp.float32))
+    row = audited.table()["tick_core"]["kinds"]
+    assert row["all-gather"]["ops"] >= 1
+    violations, _ = ca.check_budget(audited.table(), _committed_budget())
+    assert any("tick_core" in v and "all-gather" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# warm_up_jitted hook
+
+
+@needs_devices
+def test_warm_up_jitted_records_under_prog(audited):
+    from mmlspark_tpu.ops.compile_cache import warm_up_jitted
+
+    fn = _psum_fn()
+    jitted = jax.jit(lambda params, feeds: fn(feeds["x"] * params))
+    specs = {"x": (np.dtype(np.float32), (16,))}
+    res = warm_up_jitted(jitted, jnp.float32(2.0), specs,
+                         batch_sizes=[8], prog="warm_toy")
+    assert res["buckets"] == [8]
+    row = audited.table()["warm_toy"]
+    assert row["sigs"] == 1
+    assert row["kinds"]["all-reduce"]["ops"] == 1

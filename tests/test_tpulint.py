@@ -1245,6 +1245,306 @@ def test_tpu018_suppressible_with_justification():
     assert "TPU018" in codes(suppressed)
 
 
+# ---------------------------------------------------------------------------
+# TPU019 unknown-mesh-axis (sharding.py)
+
+
+def test_tpu019_axis_typo_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+        row = P("dp", None)
+        bad = P("tpp", None)
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU019"]
+    assert f.severity == "error"
+    assert "'tpp'" in f.message and "dp" in f.message
+
+
+def test_tpu019_quiet_when_no_mesh_constructed():
+    # single-device trees never define a vocabulary; stay silent rather
+    # than flag every axis string in sight
+    findings, _ = run_fixture("""\
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("model")
+        """)
+    assert "TPU019" not in codes(findings)
+
+
+def test_tpu019_vocabulary_sources():
+    # make_mesh dict keys, mesh.shape.get probes, and canonical
+    # mesh_shape() strings all feed the axis vocabulary
+    findings, _ = run_fixture("""\
+        from mmlspark_tpu.parallel.mesh import make_mesh, mesh_shape
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"dp": 4})
+        tp = mesh.shape.get("tp", 1)
+
+        def route(m):
+            if mesh_shape(m) == "dp4xsp2":
+                return P("sp")
+            return P("dp", "tp")
+        """)
+    assert "TPU019" not in codes(findings)
+
+
+def test_tpu019_collective_axis_name_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+
+        def allreduce(x):
+            return jax.lax.psum(x, axis_name="dta")
+        """)
+    assert codes(findings).count("TPU019") == 1
+
+
+def test_tpu019_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        # axis exists only on the pod config loaded at runtime
+        # tpulint: disable=TPU019
+        wide = P("pod")
+        """, keep_suppressed=True)
+    assert "TPU019" not in codes(findings)
+    assert "TPU019" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# TPU020 spec-rank-mismatch
+
+
+def test_tpu020_in_specs_arity_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x
+
+        def mount(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("dp"), P()),
+                                 out_specs=P())
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU020"]
+    assert f.severity == "error"
+    assert "binds 1..1" in f.message
+
+
+def test_tpu020_quiet_through_partial_binding():
+    # the pipeline.py idiom: partial-bound kwargs don't count against
+    # the spec arity
+    findings, _ = run_fixture("""\
+        import functools
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def _body(params, x, *, stage_fn, pp_axis):
+            return stage_fn(params, x, pp_axis)
+
+        def mount(mesh, stage_fn):
+            body = functools.partial(_body, stage_fn=stage_fn,
+                                     pp_axis="pp")
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("pp"), P()),
+                                 out_specs=P())
+        """)
+    assert "TPU020" not in codes(findings)
+
+
+def test_tpu020_out_specs_tuple_arity_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x, x, x
+
+        def mount(mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+                                 out_specs=(P(), P()))
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU020"]
+    assert "3-tuple" in f.message
+
+
+def test_tpu020_p_longer_than_literal_rank_fires():
+    findings, _ = run_fixture("""\
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(mesh):
+            x = jnp.zeros((4, 8))
+            return jax.device_put(
+                x, NamedSharding(mesh, P("dp", None, None)))
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU020"]
+    assert "rank 2" in f.message
+
+
+def test_tpu020_annotation_rank_quiet_when_matching():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def constrain(q: Float[Array, "b h d"]):
+            return jax.lax.with_sharding_constraint(
+                q, P("dp", "tp", None))
+        """)
+    assert "TPU020" not in codes(findings)
+
+
+def test_tpu020_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x
+
+        def mount(mesh):
+            # callee rebinds through a wrapper one-level expansion
+            # cannot see
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P()),  # tpulint: disable=TPU020
+                out_specs=P())
+        """, keep_suppressed=True)
+    assert "TPU020" not in codes(findings)
+    assert "TPU020" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# TPU021 unsharded-device-put
+
+
+def test_tpu021_bare_device_put_under_mesh_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def load(params, mesh):
+            return jax.device_put(params)
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU021"]
+    assert f.severity == "warning"
+    assert "replicates" in f.message
+
+
+def test_tpu021_quiet_on_sharded_put_and_mesh_none_branch():
+    findings, _ = run_fixture("""\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def load(params, mesh):
+            if mesh is None:
+                return jax.device_put(params)
+            return jax.device_put(params, NamedSharding(mesh, P()))
+        """)
+    assert "TPU021" not in codes(findings)
+
+
+def test_tpu021_get_default_mesh_counts_as_mesh_in_scope():
+    findings, _ = run_fixture("""\
+        import jax
+        from mmlspark_tpu.parallel.mesh import get_default_mesh
+
+        def load(params):
+            mesh = get_default_mesh()
+            return jax.device_put(params)
+        """)
+    assert codes(findings).count("TPU021") == 1
+
+
+def test_tpu021_quiet_without_mesh_in_scope():
+    findings, _ = run_fixture("""\
+        import jax
+
+        def load(params):
+            return jax.device_put(params)
+        """)
+    assert "TPU021" not in codes(findings)
+
+
+def test_tpu021_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax
+
+        def load(params, mesh):
+            # single-device branch by construction: the caller only
+            # reaches this path with mesh unset
+            # tpulint: disable=TPU021
+            return jax.device_put(params)
+        """, keep_suppressed=True)
+    assert "TPU021" not in codes(findings)
+    assert "TPU021" in codes(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# TPU022 collective-in-loop
+
+
+def test_tpu022_collective_in_python_loop_fires():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def ring(x):
+            for _ in range(8):
+                x = jax.lax.psum(x, "dp")
+            return x
+        """)
+    (f,) = [f for f in findings if f.rule == "TPU022"]
+    assert f.severity == "warning"
+    assert "unrolls" in f.message
+
+
+def test_tpu022_quiet_in_fori_loop_body_and_outside_jit():
+    findings, _ = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def ring(x):
+            def body(i, acc):
+                return acc + jax.lax.psum(acc, "dp")
+            return jax.lax.fori_loop(0, 8, body, x)
+
+        def host_side(xs):
+            for x in xs:
+                jax.lax.psum(x, "dp")
+        """)
+    assert "TPU022" not in codes(findings)
+
+
+def test_tpu022_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import jax
+
+        @jax.jit
+        def warmup(x):
+            for _ in range(2):
+                # two-iteration handshake by design
+                # tpulint: disable=TPU022
+                x = jax.lax.ppermute(x, "dp", [(0, 1)])
+            return x
+        """, keep_suppressed=True)
+    assert "TPU022" not in codes(findings)
+    assert "TPU022" in codes(suppressed)
+
+
 # CLI exit codes
 
 
@@ -1272,6 +1572,16 @@ def test_cli_positive_fixtures_exit_nonzero(tmp_path):
                   "        return x\n    return -x\n",
         "TPU018": "import jax.numpy as jnp\n\ndef w(k_rows):\n"
                   "    return k_rows.astype(jnp.int8)\n",
+        "TPU020": "import jax\nfrom jax.sharding import "
+                  "PartitionSpec as P\n\ndef body(x):\n    return x\n\n"
+                  "def mount(mesh):\n    return jax.shard_map(\n"
+                  "        body, mesh=mesh, in_specs=(P(), P()),\n"
+                  "        out_specs=P())\n",
+        "TPU021": "import jax\n\ndef load(params, mesh):\n"
+                  "    return jax.device_put(params)\n",
+        "TPU022": "import jax\n\n@jax.jit\ndef ring(x):\n"
+                  "    for _ in range(4):\n"
+                  "        x = jax.lax.psum(x, \"dp\")\n    return x\n",
     }
     for rule, src in fixtures.items():
         p = tmp_path / f"{rule.lower()}.py"
@@ -1301,6 +1611,20 @@ def test_cli_tpu006_stub_drift_exits_nonzero(tmp_path):
         "def foo() -> int: ...\ndef gone() -> int: ...\n")
     rc, out = _cli([str(tmp_path)])
     assert rc == 1 and "TPU006" in out and "gone" in out
+
+
+def test_cli_tpu019_axis_typo_exits_nonzero(tmp_path):
+    # project-scope rule: the mesh in one module defines the vocabulary
+    # the spec in another is checked against
+    (tmp_path / "meshes.py").write_text(
+        "import jax\nimport numpy as np\n"
+        "from jax.sharding import Mesh\n\n"
+        "mesh = Mesh(np.array(jax.devices()), (\"dp\", \"tp\"))\n")
+    (tmp_path / "specs.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "row = P(\"dpp\", None)\n")
+    rc, out = _cli([str(tmp_path)])
+    assert rc == 1 and "TPU019" in out and "dpp" in out
 
 
 def test_cli_tpu004_warning_gates_but_info_does_not(tmp_path):

@@ -34,6 +34,20 @@ Rules
   (``mmlspark_tpu.reliability.lock_sanitizer`` is the runtime half).
 - **TPU014** blocking-call-under-lock: a device sync, sleep, HTTP dial,
   subprocess, queue wait, or thread join while holding a lock.
+- **TPU019** unknown-mesh-axis: a ``P(...)``/``axis_name=`` axis that no
+  mesh constructed anywhere in the project declares — the typo that
+  silently replicates instead of sharding.
+- **TPU020** spec-rank-mismatch: ``shard_map`` in/out specs that can't
+  bind the mounted callee, or a ``P(...)`` longer than the array's rank.
+- **TPU021** unsharded-device-put: a bare ``jax.device_put`` with a mesh
+  in scope — full replication onto every device by default.
+- **TPU022** collective-in-loop: ``psum``/``all_gather``/... inside a
+  Python loop under jit — one trace-unrolled collective per iteration.
+
+The static half of the sharding story only; the runtime half is
+``mmlspark_tpu.parallel.collective_audit``, which counts collectives in
+compiled HLO against ``tools/tpulint/collective_budget.json`` (the CI
+``collective-audit`` stage).
 
 Entry points: ``scripts/run_tpulint.py`` (CI gate, baseline-diff mode) and
 ``scripts/gen_tpulint_baseline.py`` (baseline regeneration). See
@@ -46,6 +60,7 @@ from .core import (Finding, ModuleInfo, Project, Rule, all_rules,
 from . import rules as _rules            # noqa: F401  (registers TPU001-004)
 from . import project_rules as _prules   # noqa: F401  (registers TPU005-006)
 from . import concurrency as _crules     # noqa: F401  (registers TPU012-014)
+from . import sharding as _srules        # noqa: F401  (registers TPU019-022)
 
 __version__ = "0.1.0"
 

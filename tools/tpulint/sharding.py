@@ -1,0 +1,609 @@
+"""Whole-program sharding analysis: TPU019–TPU022.
+
+The mesh data plane (PR 15/16) made sharding *correctness* the thing a
+typo breaks: an axis name no mesh defines fails only at trace time on a
+real mesh, a ``shard_map`` spec tuple that drifted from its callee's
+signature fails the same way, a bare ``jax.device_put`` under a mesh
+silently replicates a buffer onto every chip, and a collective inside a
+Python loop trace-unrolls into a collective storm. All four are visible
+in the AST. This module discovers the program's mesh constructions and
+axis-name vocabulary (``parallel/mesh.py`` factories, literal
+``Mesh(...)`` tuples, ``mesh.shape``/``axis_names`` contract probes,
+canonical ``mesh_shape()`` strings), then threads
+``PartitionSpec``/``shard_map`` specs through import aliases and one
+level of name/``functools.partial`` expansion to power the rules:
+
+- **TPU019** unknown-mesh-axis: a literal axis name in ``P(...)``, a
+  collective's ``axis_name``, or an ``*_axis=`` keyword that no
+  reachable mesh construction or axis-contract probe defines.
+- **TPU020** spec-rank-mismatch: ``shard_map`` ``in_specs`` arity
+  inconsistent with the mounted callee's positional parameters (through
+  one level of ``partial``), ``out_specs`` arity vs the callee's literal
+  tuple returns, and ``P(...)`` specs longer than the rank of the array
+  they constrain (literal-shape constructors and jaxtyping-style
+  ``Float[Array, "b h d"]`` annotations, including in sibling stubs).
+- **TPU021** unsharded-device-put: a single-argument ``jax.device_put``
+  in a function with a mesh in scope — under a mesh the default
+  placement fully replicates the buffer onto every device.
+- **TPU022** collective-in-loop: ``psum``/``all_gather``/``ppermute``/…
+  lexically inside a Python loop in a jitted function — the trace
+  unrolls one collective per iteration (``lax.fori_loop``/``scan``
+  bodies are traced once and stay quiet).
+
+The static half of the sharding story; the runtime half is
+``mmlspark_tpu/parallel/collective_audit.py``, which walks the compiled
+HLO and gates CI on per-program collective budgets.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (Finding, ModuleInfo, Project, Rule, jit_decoration,
+                   register_rule)
+from .rules import _ContextVisitor, _mesh_param
+
+#: collective primitives whose axis argument must name a live mesh axis
+COLLECTIVE_NAMES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "all_to_all", "ppermute", "pshuffle", "psum_scatter"}
+
+#: canonical mesh_shape() string, e.g. "dp4xtp2" / "data8"
+_MESH_SHAPE_RE = re.compile(r"^[a-z]{1,12}\d+(?:x[a-z]{1,12}\d+)*$")
+#: the "x" separator always follows the size digits, and axis names
+#: never start with one — split there, then strip each segment's size
+_MESH_SHAPE_SEP_RE = re.compile(r"(?<=\d)x")
+_MESH_SHAPE_AXIS_RE = re.compile(r"^([a-z]+)\d+$")
+
+
+def _is_partition_spec(module: ModuleInfo, call: ast.Call) -> bool:
+    name = module.dotted(call.func)
+    return bool(name) and (name == "PartitionSpec"
+                           or name.endswith(".PartitionSpec"))
+
+
+def _str_consts(node: ast.AST) -> Iterable[Tuple[ast.AST, str]]:
+    """String constants in ``node`` — itself, or elements of a literal
+    tuple/list (a P dim may carry several axes: ``P(("dp", "tp"))``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node, node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                yield e, e.value
+
+
+def _resolve_name(module: ModuleInfo, node: ast.AST,
+                  scope: Optional[ast.AST] = None) -> ast.AST:
+    """One-level name expansion: if ``node`` is a Name assigned exactly
+    once by a simple ``name = value`` (searching ``scope`` first, then
+    the whole module), return the assigned value, else ``node``."""
+    if not isinstance(node, ast.Name):
+        return node
+    for tree in ([scope] if scope is not None else []) + [module.tree]:
+        hits = [a.value for a in ast.walk(tree)
+                if isinstance(a, ast.Assign) and len(a.targets) == 1
+                and isinstance(a.targets[0], ast.Name)
+                and a.targets[0].id == node.id]
+        if len(hits) == 1:
+            return hits[0]
+        if hits:
+            return node          # ambiguous: don't guess
+    return node
+
+
+def _is_collective(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    base = name.rsplit(".", 1)[-1]
+    if base not in COLLECTIVE_NAMES:
+        return False
+    return name == base or "lax" in name or name.startswith("jax.")
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis vocabulary discovery (shared by TPU019)
+# ---------------------------------------------------------------------------
+
+_MESH_FACTORIES = ("make_mesh", "MeshContext")
+
+
+def declared_axes(module: ModuleInfo) -> Set[str]:
+    """Axis names this module's mesh constructions and contract probes
+    define: literal ``Mesh(devs, ("dp", "tp"))`` tuples /
+    ``axis_names=`` keywords, dict-literal keys fed to
+    ``make_mesh``/``MeshContext`` (through one level of name
+    resolution), ``mesh.shape.get("tp")`` / ``mesh.shape["tp"]`` /
+    ``"tp" in mesh.axis_names`` contract probes, and the axis segments
+    of canonical ``mesh_shape()`` strings compared against a
+    ``mesh_shape(...)`` call."""
+    axes: Set[str] = set()
+    for call in module.nodes(ast.Call):
+        name = module.dotted(call.func) or ""
+        base = name.rsplit(".", 1)[-1]
+        if base == "Mesh" or name.endswith("sharding.Mesh"):
+            cand = [kw.value for kw in call.keywords
+                    if kw.arg == "axis_names"]
+            if not cand and len(call.args) >= 2:
+                cand = [call.args[1]]
+            for c in cand:
+                axes.update(v for _, v in _str_consts(c))
+        elif base in _MESH_FACTORIES:
+            cand = call.args[:1] + [kw.value for kw in call.keywords
+                                    if kw.arg == "axis_shapes"]
+            if not cand:
+                axes.add("data")   # make_mesh() default 1-D data mesh
+            for c in cand:
+                c = _resolve_name(module, c)
+                if isinstance(c, ast.Dict):
+                    axes.update(k.value for k in c.keys
+                                if isinstance(k, ast.Constant)
+                                and isinstance(k.value, str))
+                elif isinstance(c, ast.Constant) and c.value is None:
+                    axes.add("data")
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr == "get"
+              and isinstance(call.func.value, ast.Attribute)
+              and call.func.value.attr == "shape" and call.args):
+            # mesh.shape.get("tp", ...) — the engine's axis contract
+            axes.update(v for _, v in _str_consts(call.args[0]))
+    for sub in module.nodes(ast.Subscript):
+        # mesh.shape["tp"]
+        if isinstance(sub.value, ast.Attribute) and sub.value.attr == "shape":
+            axes.update(v for _, v in _str_consts(sub.slice))
+    for cmp in module.nodes(ast.Compare):
+        operands = [cmp.left] + list(cmp.comparators)
+        # "tp" in mesh.axis_names
+        if any(isinstance(op, ast.In) for op in cmp.ops):
+            if any(isinstance(o, ast.Attribute) and o.attr == "axis_names"
+                   for o in operands):
+                for o in operands:
+                    axes.update(v for _, v in _str_consts(o))
+        # mesh_shape(m) == "dp4xtp2" — parse the canonical string's axes
+        if any(isinstance(o, ast.Call)
+               and (module.dotted(o.func) or "").endswith("mesh_shape")
+               for o in operands):
+            for o in operands:
+                for _, v in _str_consts(o):
+                    if _MESH_SHAPE_RE.match(v):
+                        for seg in _MESH_SHAPE_SEP_RE.split(v):
+                            m_ax = _MESH_SHAPE_AXIS_RE.match(seg)
+                            if m_ax:
+                                axes.add(m_ax.group(1))
+    return axes
+
+
+def _axis_uses(module: ModuleInfo):
+    """Yield ``(node, axis)`` for every literal axis-name usage: ``P``
+    positional dims, collective axis arguments, and ``axis_name=`` /
+    ``*_axis=`` keywords."""
+    for call in module.nodes(ast.Call):
+        name = module.dotted(call.func)
+        if name and _is_partition_spec(module, call):
+            for arg in call.args:
+                yield from _str_consts(arg)
+        elif _is_collective(name):
+            cand = list(call.args[1:2]) + [kw.value for kw in call.keywords
+                                           if kw.arg == "axis_name"]
+            for c in cand:
+                yield from _str_consts(c)
+        for kw in call.keywords:
+            if kw.arg and (kw.arg == "axis_name"
+                           or kw.arg.endswith("_axis")):
+                yield from _str_consts(kw.value)
+
+
+@register_rule
+class UnknownMeshAxis(Rule):
+    code = "TPU019"
+    name = "unknown-mesh-axis"
+    severity = "error"
+    project_scope = True
+    doc = ("A literal mesh-axis name — in a ``P(...)`` spec, a "
+           "collective's ``axis_name``, or an ``*_axis=`` keyword — that "
+           "no reachable mesh construction defines. The vocabulary is "
+           "discovered whole-program: literal ``Mesh(..., names)`` "
+           "tuples, ``make_mesh``/``MeshContext`` axis dicts, "
+           "``mesh.shape.get(axis)``/``'axis' in mesh.axis_names`` "
+           "contract probes, and canonical ``mesh_shape()`` strings. An "
+           "axis typo compiles fine and fails only at trace time on a "
+           "real mesh — usually the TPU pod run the bench queue waited "
+           "a week for. Quiet when the project constructs no meshes.")
+
+    def check_project(self, project: Project):
+        vocab: Set[str] = set()
+        for m in project.modules:
+            vocab |= declared_axes(m)
+        if not vocab:
+            return iter(())
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for m in project.modules:
+            for node, axis in _axis_uses(m):
+                if axis in vocab or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                findings.append(self.finding(
+                    m, node,
+                    f"axis name '{axis}' is not defined by any mesh this "
+                    f"program constructs (known axes: "
+                    f"{', '.join(sorted(vocab))}) — a sharding spec "
+                    f"naming a nonexistent axis fails only at trace "
+                    f"time on a real mesh"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU020 spec-rank-mismatch
+# ---------------------------------------------------------------------------
+
+#: array constructors whose first literal tuple argument fixes the rank
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _annotation_rank(annotation: Optional[ast.AST]) -> Optional[int]:
+    """Rank from a jaxtyping-style annotation — ``Float[Array, "b h d"]``
+    → 3. None when the annotation carries no shape string."""
+    if not isinstance(annotation, ast.Subscript):
+        return None
+    sl = annotation.slice
+    elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            toks = e.value.split()
+            if toks and all(re.match(r"^[#*]?[A-Za-z0-9_]+$", t)
+                            for t in toks):
+                return len(toks)
+    return None
+
+
+def _spec_len(node: ast.AST) -> Optional[int]:
+    """Number of dims a literal ``P(...)`` call constrains."""
+    if isinstance(node, ast.Call) and isinstance(node.func, (ast.Name,
+                                                             ast.Attribute)):
+        return len(node.args)
+    return None
+
+
+def _partial_parts(module: ModuleInfo, node: ast.AST):
+    """Decompose ``functools.partial(fn, a, kw=...)`` → (fn node,
+    n_bound_positional, bound_kwarg_names); identity for anything else."""
+    if isinstance(node, ast.Call) \
+            and module.dotted(node.func) in ("functools.partial", "partial") \
+            and node.args:
+        return (node.args[0], len(node.args) - 1,
+                {kw.arg for kw in node.keywords if kw.arg})
+    return node, 0, set()
+
+
+def _pick_def(defs: List[ast.FunctionDef], name: str,
+              scope: Optional[ast.AST],
+              before_line: int) -> Optional[ast.FunctionDef]:
+    """The def ``name`` resolves to at ``before_line``: prefer defs
+    nested in the enclosing ``scope``, then the nearest one above the
+    use site — local ``def fn`` shadows an earlier same-named def, so a
+    module-wide first-match would bind the wrong signature."""
+    cands = [f for f in defs if f.name == name]
+    if not cands:
+        return None
+    if len(cands) == 1:
+        return cands[0]
+    if scope is not None:
+        in_scope = {id(n) for n in ast.walk(scope)}
+        scoped = [f for f in cands if id(f) in in_scope]
+        if scoped:
+            cands = scoped
+    preceding = [f for f in cands if f.lineno <= before_line]
+    return max(preceding or cands, key=lambda f: f.lineno)
+
+
+def _callee_fn(module: ModuleInfo, defs: List[ast.FunctionDef],
+               node: ast.AST, scope: Optional[ast.AST], use_line: int):
+    """Resolve the mounted callee through one level of name assignment
+    and one level of ``partial``; returns (FunctionDef | None,
+    n_bound_positional, bound_kwargs)."""
+    node = _resolve_name(module, node, scope)
+    node, n_pos, kws = _partial_parts(module, node)
+    node = _resolve_name(module, node, scope)
+    if isinstance(node, ast.Name):
+        return _pick_def(defs, node.id, scope, use_line), n_pos, kws
+    return None, n_pos, kws
+
+
+def _literal_tuple_returns(fn: ast.FunctionDef) -> Optional[int]:
+    """If every ``return`` at ``fn``'s own level is a literal tuple of
+    one consistent length, that length; else None."""
+    lengths: Set[int] = set()
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return):
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            lengths.add(len(node.value.elts))
+        stack.extend(ast.iter_child_nodes(node))
+    return lengths.pop() if len(lengths) == 1 else None
+
+
+@register_rule
+class SpecRankMismatch(Rule):
+    code = "TPU020"
+    name = "spec-rank-mismatch"
+    severity = "error"
+    doc = ("A sharding spec structurally inconsistent with what it "
+           "shards: a ``shard_map`` ``in_specs`` tuple whose arity "
+           "cannot bind the mounted callee's positional parameters "
+           "(resolved through one level of name assignment and "
+           "``functools.partial``), an ``out_specs`` tuple whose arity "
+           "differs from the callee's literal tuple returns, or a "
+           "``P(...)`` spec with more dims than the rank of the array "
+           "it constrains (literal-shape constructors like "
+           "``jnp.zeros((4, 8))``, or a jaxtyping-style "
+           "``Float[Array, \"b h d\"]`` annotation — module or sibling "
+           "``.pyi`` stub). Every one of these traces as a shape error "
+           "only once a mesh is live.")
+
+    def check(self, module: ModuleInfo):
+        defs = [fn for fn in module.nodes(ast.FunctionDef,
+                                          ast.AsyncFunctionDef)]
+        findings: List[Finding] = []
+        findings.extend(self._shard_map_checks(module, defs))
+        findings.extend(self._rank_checks(module, defs))
+        return iter(findings)
+
+    # -- shard_map in/out_specs vs the mounted callee -----------------------
+    def _shard_map_checks(self, module: ModuleInfo, defs):
+        findings: List[Finding] = []
+        enclosing: Dict[int, ast.AST] = {}
+        for fn in defs:
+            for sub in ast.walk(fn):
+                enclosing.setdefault(id(sub), fn)
+        for call in module.nodes(ast.Call):
+            name = module.dotted(call.func) or ""
+            if "shard_map" not in name:
+                continue
+            scope = enclosing.get(id(call))
+            kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+            callee_node = call.args[0] if call.args else None
+            # decorator form: @partial(jax.shard_map, mesh=..., ...)
+            decorated = None
+            for fn in defs:
+                for dec in fn.decorator_list:
+                    for sub in ast.walk(dec):
+                        if sub is call:
+                            decorated = fn
+            if decorated is not None:
+                callee, n_pos, bound = decorated, 0, set()
+            else:
+                callee, n_pos, bound = _callee_fn(module, defs,
+                                                  callee_node, scope,
+                                                  call.lineno)
+            in_specs = _resolve_name(module, kwargs.get("in_specs"), scope) \
+                if "in_specs" in kwargs else None
+            if callee is not None and isinstance(in_specs, ast.Tuple) \
+                    and callee.args.vararg is None:
+                params = callee.args.posonlyargs + callee.args.args
+                names = [a.arg for a in params if a.arg not in ("self",
+                                                                "cls")]
+                free = [n for n in names[n_pos:] if n not in bound]
+                n_default = len(callee.args.defaults)
+                required = [n for n in names[:len(names) - n_default]
+                            if n not in bound][n_pos:]
+                n = len(in_specs.elts)
+                if n > len(free) or n < len(required):
+                    findings.append(self.finding(
+                        module, in_specs,
+                        f"shard_map in_specs has {n} spec(s) but mounted "
+                        f"callee '{callee.name}' binds "
+                        f"{len(required)}..{len(free)} positional "
+                        f"argument(s) — the mount fails at trace time "
+                        f"on a live mesh"))
+            out_specs = _resolve_name(module, kwargs.get("out_specs"),
+                                      scope) if "out_specs" in kwargs \
+                else None
+            if callee is not None and isinstance(out_specs, ast.Tuple):
+                ret_n = _literal_tuple_returns(callee)
+                if ret_n is not None and ret_n != len(out_specs.elts):
+                    findings.append(self.finding(
+                        module, out_specs,
+                        f"shard_map out_specs has {len(out_specs.elts)} "
+                        f"spec(s) but mounted callee '{callee.name}' "
+                        f"returns a {ret_n}-tuple"))
+        return findings
+
+    # -- P(...) longer than the constrained array's rank --------------------
+    _CONSTRAINERS = ("with_sharding_constraint", "device_put",
+                     "NamedSharding")
+
+    def _rank_checks(self, module: ModuleInfo, funcs):
+        findings: List[Finding] = []
+        # parameter ranks from jaxtyping-style annotations (module body,
+        # or the sibling .pyi stub parsed into the same project by the
+        # caller — stubs re-declare the signatures, so scanning both
+        # costs nothing and keeps hand-written stubs load-bearing)
+        for call in module.nodes(ast.Call):
+            name = module.dotted(call.func) or ""
+            base = name.rsplit(".", 1)[-1]
+            if base not in ("with_sharding_constraint", "device_put"):
+                continue
+            if len(call.args) < 2:
+                continue
+            target, spec = call.args[0], call.args[1]
+            if isinstance(spec, ast.Call):
+                sname = module.dotted(spec.func) or ""
+                if sname.rsplit(".", 1)[-1] == "NamedSharding" \
+                        and len(spec.args) >= 2:
+                    spec = spec.args[1]
+            n_spec = (_spec_len(spec)
+                      if isinstance(spec, ast.Call)
+                      and _is_partition_spec(module, spec) else None)
+            rank = self._rank_of(module, funcs, call, target)
+            if n_spec is not None and rank is not None and n_spec > rank:
+                findings.append(self.finding(
+                    module, spec,
+                    f"P(...) names {n_spec} dims but the constrained "
+                    f"array has rank {rank} — the spec cannot bind"))
+        return findings
+
+    def _rank_of(self, module, funcs, call, target) -> Optional[int]:
+        enclosing = None
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            for sub in ast.walk(fn):
+                if sub is call:
+                    enclosing = fn
+        node = _resolve_name(module, target, enclosing)
+        if isinstance(node, ast.Call):
+            cname = module.dotted(node.func) or ""
+            if cname.rsplit(".", 1)[-1] in _SHAPE_CTORS and node.args \
+                    and isinstance(node.args[0], ast.Tuple):
+                return len(node.args[0].elts)
+        if isinstance(target, ast.Name) and enclosing is not None:
+            for a in (enclosing.args.posonlyargs + enclosing.args.args
+                      + enclosing.args.kwonlyargs):
+                if a.arg == target.id:
+                    return _annotation_rank(a.annotation)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPU021 unsharded-device-put
+# ---------------------------------------------------------------------------
+
+_DEVICE_PUT = ("jax.device_put", "device_put")
+
+
+def _mesh_none_exempt(fn: ast.AST, mesh_name: str) -> Set[int]:
+    """Node ids of subtrees where ``mesh`` is knowably absent: the body
+    of ``if mesh is None:`` (and the matching arm of an ``IfExp``), the
+    orelse of ``if mesh is not None:``."""
+    exempt: Set[int] = set()
+
+    def test_kind(test: ast.AST) -> Optional[bool]:
+        # True → "is None", False → "is not None", None → unrelated
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.left, ast.Name) \
+                and test.left.id == mesh_name \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            if isinstance(test.ops[0], ast.Is):
+                return True
+            if isinstance(test.ops[0], ast.IsNot):
+                return False
+        return None
+
+    def mark(node: ast.AST):
+        for sub in ast.walk(node):
+            exempt.add(id(sub))
+
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.If):
+            kind = test_kind(sub.test)
+            if kind is True:
+                for stmt in sub.body:
+                    mark(stmt)
+            elif kind is False:
+                for stmt in sub.orelse:
+                    mark(stmt)
+        elif isinstance(sub, ast.IfExp):
+            kind = test_kind(sub.test)
+            if kind is True:
+                mark(sub.body)
+            elif kind is False:
+                mark(sub.orelse)
+    return exempt
+
+
+@register_rule
+class UnshardedDevicePut(Rule):
+    code = "TPU021"
+    name = "unsharded-device-put"
+    severity = "warning"
+    doc = ("A single-argument ``jax.device_put`` in a function with a "
+           "mesh in scope (a ``mesh`` parameter, a "
+           "``Mesh``/``NamedSharding`` annotation, or a "
+           "``get_default_mesh()`` read). With no placement argument "
+           "the array lands replicated on every device — N silent "
+           "copies of the buffer and an all-gather the moment a sharded "
+           "consumer touches it. Pass ``NamedSharding(mesh, P(...))`` "
+           "(or the placement's ``put``); code on the ``mesh is None`` "
+           "branch is recognized and stays quiet.")
+
+    def check(self, module: ModuleInfo):
+        findings: List[Finding] = []
+        flagged: Set[int] = set()
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            mesh = _mesh_param(module, fn)
+            if mesh is None:
+                has_default = any(
+                    (module.dotted(c.func) or "").endswith(
+                        "get_default_mesh")
+                    for c in ast.walk(fn) if isinstance(c, ast.Call))
+                if not has_default:
+                    continue
+                mesh = "mesh"
+            exempt = _mesh_none_exempt(fn, mesh)
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call) or id(sub) in flagged \
+                        or id(sub) in exempt:
+                    continue
+                if module.dotted(sub.func) not in _DEVICE_PUT:
+                    continue
+                if len(sub.args) != 1 or any(
+                        kw.arg in ("device", "sharding", "src")
+                        for kw in sub.keywords):
+                    continue
+                flagged.add(id(sub))
+                findings.append(self.finding(
+                    module, sub,
+                    f"device_put with no placement inside "
+                    f"'{fn.name}' (mesh '{mesh}' in scope) — the array "
+                    f"replicates onto every device by default; pass a "
+                    f"NamedSharding(mesh, P(...)) or route through the "
+                    f"resolved Placement.put"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# TPU022 collective-in-loop
+# ---------------------------------------------------------------------------
+
+@register_rule
+class CollectiveInLoop(Rule):
+    code = "TPU022"
+    name = "collective-in-loop"
+    severity = "warning"
+    doc = ("A collective (``psum``/``all_gather``/``ppermute``/"
+           "``all_to_all``/…) lexically inside a Python loop in a "
+           "jitted function. The trace unrolls the loop, so N "
+           "iterations emit N independent collectives — an ICI storm "
+           "the profiler shows as a wall of tiny all-reduces. Hoist the "
+           "collective out of the loop or convert the loop to "
+           "``lax.fori_loop``/``lax.scan`` (whose bodies trace once and "
+           "stay quiet here).")
+
+    def check(self, module: ModuleInfo):
+        visitor = _TPU022(module, self)
+        visitor.visit(module.tree)
+        return iter(visitor.findings)
+
+
+class _TPU022(_ContextVisitor):
+    def __init__(self, module, rule):
+        super().__init__(module)
+        self.rule = rule
+
+    def handle_call(self, node: ast.Call):
+        if self.jit_ctx is None or self.loop_depth == 0:
+            return
+        name = self.module.dotted(node.func)
+        if _is_collective(name):
+            self.findings.append(self.rule.finding(
+                self.module, node,
+                f"collective '{name}' inside a Python loop in a jitted "
+                f"function — the trace unrolls one collective per "
+                f"iteration; hoist it, or use lax.fori_loop/lax.scan "
+                f"(bodies trace once)"))
