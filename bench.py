@@ -576,6 +576,9 @@ def _generation_phase(on_tpu: bool) -> dict:
     out = {
         "tok_per_sec": round(toks / elapsed, 2),
         "mesh_shape": "single",
+        # send-wait-send latency regime: never compare these quantiles
+        # with the scenarios phase's open-loop (CO-corrected) numbers
+        "loop_mode": "closed",
         "tokens": toks, "requests": n_reqs, "wall_s": round(elapsed, 3),
         "decode_step_p50_ms": round(float(lat[len(lat) // 2]) * 1e3, 3),
         "decode_step_p99_ms": round(
@@ -774,6 +777,47 @@ def _multichip_generation_phase(mesh=None) -> dict:
             "kv_quant_error_last":
                 eng_q._kv.stats["quant_error_last"]},
     }
+
+
+def _scenarios_phase(record: dict) -> dict:
+    """Open-loop scenario sub-record (ROADMAP item 5): run the seeded
+    ``smoke`` scenario from ``mmlspark_tpu.loadgen`` against a live
+    3-worker ServingCluster and report its scorecard — the only latency
+    numbers in BENCH measured from *scheduled* send time
+    (``loop_mode: "open"``), next to the generation phase's closed-loop
+    quantiles. The harvest lands ``slo_scorecard``/``cost_ledger`` rows
+    in the ObservationStore, so the tuning phase that follows sees
+    traffic-shaped observations from the same run."""
+    import threading as _threading
+
+    from mmlspark_tpu.loadgen import (cluster_echo_engine, get_scenario,
+                                      run_scenario)
+    from mmlspark_tpu.observability.federation import (
+        FEDERATION_INTERVAL_ENV)
+    from mmlspark_tpu.serving.distributed import ServingCluster
+
+    gen = record.get("generation") or {}
+    mesh_shape = str(gen.get("mesh_shape", "single"))
+    kv_dtype = (gen.get("paged_attn") or {}).get("kv_dtype")
+    prior = os.environ.get(FEDERATION_INTERVAL_ENV)
+    os.environ[FEDERATION_INTERVAL_ENV] = "0"   # federate every heartbeat
+    cluster = ServingCluster(3, reply_timeout=10.0, max_queue=256)
+    stop = _threading.Event()
+    engine = cluster_echo_engine(cluster, stop, service_s=0.005, batch=16)
+    try:
+        card = run_scenario(get_scenario("smoke"), cluster,
+                            closed_loop_n=20,
+                            mesh_shape=mesh_shape,
+                            kv_dtype=kv_dtype)
+    finally:
+        stop.set()
+        engine.join(timeout=2.0)
+        cluster.close()
+        if prior is None:
+            os.environ.pop(FEDERATION_INTERVAL_ENV, None)
+        else:
+            os.environ[FEDERATION_INTERVAL_ENV] = prior
+    return card
 
 
 def _tuning_phase(record: dict, model, *, batch: int, n_rows: int,
@@ -1210,6 +1254,21 @@ def main():
                     "skipped": "budget exhausted"}
         except Exception as e:          # noqa: BLE001
             record["multichip_generation"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+
+    # scenarios phase: the smoke scenario open-loop against a 3-worker
+    # in-process cluster — scorecard rows land in the ObservationStore
+    # BEFORE the tuning phase reads it, so the tuner scores against
+    # traffic-shaped observations from this very run
+    with _phase_guard(record, "scenarios", min(remaining() - 25.0, 90.0),
+                      report=report):
+        try:
+            if remaining() > 35.0:
+                record["scenarios"] = {"smoke": _scenarios_phase(record)}
+            else:
+                record["scenarios"] = {"skipped": "budget exhausted"}
+        except Exception as e:          # noqa: BLE001
+            record["scenarios"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
 
     # tuning phase: pure host arithmetic over this run's harvested samples

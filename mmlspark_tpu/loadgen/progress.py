@@ -1,0 +1,118 @@
+"""Live scenario progress: the process-global snapshot behind
+``GET /debug/scenario``.
+
+The scenario runner (``loadgen.scenarios``) updates this singleton as it
+drives traffic; the serving plane's debug route reads it — on BOTH
+WorkerServer transports — so an operator watching a chaos drill can see
+sent/completed/shed counts move without waiting for the final scorecard.
+Standalone on purpose: ``serving.server`` imports this lazily, and this
+module imports nothing from ``serving``, so there is no cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["ScenarioProgress", "get_progress", "set_progress",
+           "reset_progress"]
+
+
+class ScenarioProgress:
+    """Thread-safe counters for the scenario currently driving traffic."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self.scenario: Optional[str] = None
+        self.state = "idle"            # idle | running | done
+        self.total = 0
+        self.sent = 0
+        self.done = 0
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+        self.retries = 0
+        self.started_t: Optional[float] = None
+        self.updated_t: Optional[float] = None
+        self.summary: Optional[Dict[str, object]] = None
+
+    def begin(self, scenario: str, total: int) -> None:
+        with self._lock:
+            self._reset_locked()
+            self.scenario = scenario
+            self.state = "running"
+            self.total = int(total)
+            self.started_t = time.time()
+            self.updated_t = self.started_t
+
+    def note_sent(self, n: int = 1) -> None:
+        with self._lock:
+            self.sent += n
+            self.updated_t = time.time()
+
+    def note_done(self, outcome: str, retries: int = 0) -> None:
+        with self._lock:
+            self.done += 1
+            self.retries += int(retries)
+            if outcome == "ok":
+                self.ok += 1
+            elif outcome == "shed":
+                self.shed += 1
+            else:
+                self.errors += 1
+            self.updated_t = time.time()
+
+    def finish(self, summary: Optional[Dict[str, object]] = None) -> None:
+        with self._lock:
+            self.state = "done"
+            self.summary = dict(summary) if summary else None
+            self.updated_t = time.time()
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-safe live view (the /debug/scenario payload)."""
+        with self._lock:
+            out: Dict[str, object] = {
+                "scenario": self.scenario, "state": self.state,
+                "total": self.total, "sent": self.sent, "done": self.done,
+                "ok": self.ok, "shed": self.shed, "errors": self.errors,
+                "retries": self.retries,
+                "started_t": self.started_t, "updated_t": self.updated_t,
+            }
+            if self.started_t is not None and self.state == "running":
+                # a live debug-view field, not accumulated telemetry: the
+                # run's durable numbers go through mmlspark_scenario_*
+                # metrics in loadgen.scorecard
+                # tpulint: disable=TPU007
+                out["elapsed_s"] = round(time.time() - self.started_t, 3)
+            if self.summary is not None:
+                out["summary"] = dict(self.summary)
+            return out
+
+
+_progress_lock = threading.Lock()
+_progress: Optional[ScenarioProgress] = None
+
+
+def get_progress() -> ScenarioProgress:
+    """The process-global progress object, created on first use."""
+    global _progress
+    with _progress_lock:
+        if _progress is None:
+            _progress = ScenarioProgress()
+        return _progress
+
+
+def set_progress(progress: ScenarioProgress) -> None:
+    global _progress
+    with _progress_lock:
+        _progress = progress
+
+
+def reset_progress() -> None:
+    global _progress
+    with _progress_lock:
+        _progress = None

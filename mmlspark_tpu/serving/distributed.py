@@ -276,11 +276,14 @@ class DistributedWorker:
                  host: str = "127.0.0.1", port: int = 0,
                  reply_timeout: float = 60.0,
                  heartbeat_interval: float = 10.0,
-                 advertise_host: str = ""):
+                 advertise_host: str = "",
+                 max_queue: int = 10_000):
         self.driver_url = driver_url
         self.worker_id = worker_id
+        self.max_queue = int(max_queue)
         self.server = WorkerServer(host=host, port=port,
-                                   reply_timeout=reply_timeout)
+                                   reply_timeout=reply_timeout,
+                                   max_queue=self.max_queue)
         self.server.control_routes["/_reply"] = self._handle_remote_reply
         self.has_engine = True
         self._peers: Dict[str, str] = {}
@@ -605,11 +608,13 @@ class ServingCluster:
     SURVEY §4). The aggregate ``get_batch``/``reply`` pair is the
     distributed source/sink surface an engine loop drives."""
 
-    def __init__(self, n_workers: int, reply_timeout: float = 60.0):
+    def __init__(self, n_workers: int, reply_timeout: float = 60.0,
+                 max_queue: int = 10_000):
         self.driver = DriverRegistry()
         self.workers: List[DistributedWorker] = [
             DistributedWorker(self.driver.url, f"worker-{i}",
-                              reply_timeout=reply_timeout)
+                              reply_timeout=reply_timeout,
+                              max_queue=max_queue)
             for i in range(n_workers)]
         for w in self.workers:
             w.refresh_peers()
@@ -677,7 +682,8 @@ class ServingCluster:
             replacement = DistributedWorker(
                 self.driver.url, worker_id,
                 reply_timeout=(reply_timeout if reply_timeout is not None
-                               else w.server.reply_timeout))
+                               else w.server.reply_timeout),
+                max_queue=w.max_queue)
             self.workers[i] = replacement
             for peer in self.workers:
                 try:

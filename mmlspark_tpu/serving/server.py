@@ -709,6 +709,7 @@ class WorkerServer:
             "/debug/traces": self._debug_traces_route,
             "/debug/slo": self._debug_slo_route,
             "/debug/costs": self._debug_costs_route,
+            "/debug/scenario": self._debug_scenario_route,
             "/debug/profile": self._debug_profile_route,
             "/debug/registry": self._debug_registry_route,
             "/models": self._models_route,
@@ -946,6 +947,23 @@ class WorkerServer:
         return HTTPResponseData(
             headers=[HeaderData("Content-Type", "application/json")],
             entity=EntityData.from_string(_json.dumps(card)),
+            status_line=StatusLineData(status_code=200))
+
+    def _debug_scenario_route(self, request: HTTPRequestData
+                              ) -> HTTPResponseData:
+        """``GET /debug/scenario`` — live progress of the scenario the
+        loadgen harness is currently driving (sent/done/ok/shed/error
+        counts and, once finished, the scorecard summary). Registered in
+        ``control_routes``, so it serves on both transports; idle state
+        when no scenario has ever run in this process."""
+        import json as _json
+        # lazy import: loadgen is a *client* of the serving plane — the
+        # server must not require it at construction time
+        from ..loadgen.progress import get_progress
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", "application/json")],
+            entity=EntityData.from_string(
+                _json.dumps(get_progress().snapshot())),
             status_line=StatusLineData(status_code=200))
 
     def _debug_costs_route(self, request: HTTPRequestData

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Run a named loadgen scenario against a local 3-worker ServingCluster.
+
+Examples::
+
+    python scripts/run_scenarios.py --list
+    python scripts/run_scenarios.py smoke
+    python scripts/run_scenarios.py smoke --duration 2 --rate 40 --check
+    python scripts/run_scenarios.py mixed-tenant-chaos --json card.json
+
+The cluster, echo engine, and generator all live in this process (the
+same shape the federation tests use), so the run is deterministic,
+CPU-only, and CI-safe. ``--check`` exits nonzero when the run loses a
+request or the federated reconciliation fails — the scenario-smoke CI
+gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from mmlspark_tpu.loadgen import SCENARIOS, get_scenario
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("scenario", nargs="?", help="scenario name")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered scenarios and exit")
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="override Scenario.duration_s")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="override Scenario.rate (requests/second)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override Scenario.seed")
+    ap.add_argument("--max-queue", type=int, default=256,
+                    help="per-worker admission queue depth")
+    ap.add_argument("--service-ms", type=float, default=5.0,
+                    help="echo-engine hold per batch (saturation knob)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the scorecard JSON here ('-' = stdout)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on lost requests or failed reconciliation")
+    args = ap.parse_args(argv)
+
+    if args.list or not args.scenario:
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            print(f"{name:>20}  {sc.duration_s:>4.1f}s @ {sc.rate:>5.1f}/s"
+                  f"  {sc.arrival:<8} {sc.description}")
+        return 0
+
+    overrides = {}
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+    if args.rate is not None:
+        overrides["rate"] = args.rate
+    if args.seed is not None:
+        overrides["seed"] = args.seed
+    scenario = get_scenario(args.scenario, **overrides)
+
+    from mmlspark_tpu.loadgen import cluster_echo_engine, run_scenario
+    from mmlspark_tpu.observability.federation import FEDERATION_INTERVAL_ENV
+    from mmlspark_tpu.serving.distributed import ServingCluster
+
+    os.environ.setdefault(FEDERATION_INTERVAL_ENV, "0")
+    cluster = ServingCluster(args.workers, reply_timeout=10.0,
+                             max_queue=args.max_queue)
+    stop = threading.Event()
+    engine = cluster_echo_engine(cluster, stop,
+                                 service_s=args.service_ms / 1e3, batch=16)
+    try:
+        card = run_scenario(scenario, cluster, log=print)
+    finally:
+        stop.set()
+        engine.join(timeout=2.0)
+        cluster.close()
+
+    if args.json == "-":
+        json.dump(card, sys.stdout, indent=2)
+        print()
+    elif args.json:
+        with open(args.json, "w") as fh:
+            json.dump(card, fh, indent=2)
+        print(f"scorecard written to {args.json}")
+
+    lat = card.get("latency_ms") or {}
+    print(f"== {scenario.name}: arrivals={card['arrivals']} "
+          f"ok={card['ok']} shed={card['shed']} errors={card['errors']} "
+          f"lost={card['lost']} goodput={card['goodput_rps']}/s "
+          f"p99={lat.get('p99_ms')}ms "
+          f"fairness_err={card['fairness_error']}")
+    if args.check:
+        cluster_view = card.get("cluster") or {}
+        problems = []
+        if card["lost"]:
+            problems.append(f"lost {card['lost']} requests")
+        if not cluster_view.get("reconciled"):
+            problems.append("federated counter reconciliation failed")
+        if card["arrivals"] == 0:
+            problems.append("empty arrival plan")
+        if problems:
+            print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
+            return 1
+        print("check passed: zero lost, counters reconciled")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1545,6 +1545,97 @@ def test_tpu022_suppressible_with_justification():
     assert "TPU022" in codes(suppressed)
 
 
+# ---------------------------------------------------------------------------
+# TPU023 closed-loop-latency
+
+
+CLOSED_LOOP_SRC = """\
+    import time
+    import urllib.request
+
+    def bench(urls):
+        lats = []
+        for u in urls:
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(u) as r:
+                r.read()
+            lats.append(time.perf_counter() - t0)
+        return lats
+    """
+
+
+def test_tpu023_adhoc_closed_loop_fires():
+    findings, _ = run_fixture(CLOSED_LOOP_SRC,
+                              relpath="scripts/adhoc_bench.py")
+    assert "TPU023" in codes(findings)
+
+
+def test_tpu023_paced_loop_quiet():
+    # an explicit pacing call means the loop schedules sends instead of
+    # letting the reply throttle the generator — open-loop-ish, allowed
+    findings, _ = run_fixture("""\
+        import time
+        import urllib.request
+
+        def bench(urls):
+            lats = []
+            for u in urls:
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(u) as r:
+                    r.read()
+                lats.append(time.perf_counter() - t0)
+                time.sleep(0.01)
+            return lats
+        """, relpath="scripts/adhoc_bench.py")
+    assert "TPU023" not in codes(findings)
+
+
+def test_tpu023_single_clock_read_quiet():
+    # one clock read is progress logging, not a latency measurement
+    findings, _ = run_fixture("""\
+        import time
+        import urllib.request
+
+        def drain(urls):
+            start = time.monotonic()
+            for u in urls:
+                with urllib.request.urlopen(u) as r:
+                    r.read()
+            return time.monotonic() - start
+        """, relpath="scripts/adhoc_bench.py")
+    assert "TPU023" not in codes(findings)
+
+
+def test_tpu023_loadgen_and_tests_exempt():
+    # loadgen owns the sanctioned (labeled) closed-loop probe; tests
+    # assert on single requests, not latency distributions
+    for relpath in ("mmlspark_tpu/loadgen/scenarios.py",
+                    "tests/test_serving.py",
+                    "pkg/tests/test_x.py"):
+        findings, _ = run_fixture(CLOSED_LOOP_SRC, relpath=relpath)
+        assert "TPU023" not in codes(findings), relpath
+
+
+def test_tpu023_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import time
+        import urllib.request
+
+        def wait_ready(url):
+            # polling for readiness while logging elapsed time — not a
+            # latency benchmark, nothing is measured per request
+            # tpulint: disable=TPU023
+            while True:
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(url) as r:
+                    r.read()
+                if time.perf_counter() - t0 >= 0:
+                    return
+        """, relpath="scripts/wait_ready.py", keep_suppressed=True)
+    assert "TPU023" not in codes(findings)
+    assert "TPU023" in codes(suppressed)
+
+
 # CLI exit codes
 
 
@@ -1582,6 +1673,14 @@ def test_cli_positive_fixtures_exit_nonzero(tmp_path):
         "TPU022": "import jax\n\n@jax.jit\ndef ring(x):\n"
                   "    for _ in range(4):\n"
                   "        x = jax.lax.psum(x, \"dp\")\n    return x\n",
+        "TPU023": "import time\nimport urllib.request\n\n"
+                  "def bench(urls):\n    lats = []\n"
+                  "    for u in urls:\n"
+                  "        t0 = time.perf_counter()\n"
+                  "        with urllib.request.urlopen(u) as r:\n"
+                  "            r.read()\n"
+                  "        lats.append(time.perf_counter() - t0)\n"
+                  "    return lats\n",
     }
     for rule, src in fixtures.items():
         p = tmp_path / f"{rule.lower()}.py"

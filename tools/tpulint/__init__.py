@@ -43,6 +43,10 @@ Rules
   in scope — full replication onto every device by default.
 - **TPU022** collective-in-loop: ``psum``/``all_gather``/... inside a
   Python loop under jit — one trace-unrolled collective per iteration.
+- **TPU023** closed-loop-latency: an ad-hoc benchmark loop that times a
+  blocking send with no pacing — the reply throttles the generator, so
+  the measured p99 never sees queueing delay (coordinated omission);
+  drive traffic through ``mmlspark_tpu.loadgen`` instead.
 
 The static half of the sharding story only; the runtime half is
 ``mmlspark_tpu.parallel.collective_audit``, which counts collectives in

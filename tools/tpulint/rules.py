@@ -1200,3 +1200,91 @@ class UnscaledQuantCast(Rule):
                 "absmax scale lands in the page-aligned scale pool the "
                 "dequant kernel reads"))
         return iter(findings)
+
+
+# -- TPU023 closed-loop-latency ----------------------------------------------
+
+#: clock reads that bracket a timed request inside a loop
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                "monotonic", "perf_counter"}
+#: blocking send-and-wait calls: the reply gates the next iteration
+_SEND_BLOCK_ATTRS = {"urlopen", "getresponse"}
+#: pacing primitives: their presence means the loop schedules sends
+#: instead of letting the reply throttle the generator
+_PACING_ATTRS = {"sleep", "wait"}
+#: paths allowed to run closed loops: the loadgen package (it owns the
+#: sanctioned closed-loop probe, clearly labeled as the comparison
+#: baseline) and tests (fixtures assert on single requests, not latency)
+_CLOSED_LOOP_EXEMPT_PREFIXES = ("mmlspark_tpu/loadgen/", "tests/")
+
+
+def _loop_call_profile(loop: ast.AST, module: ModuleInfo):
+    """(clock_reads, send_blocks, paced) over one loop body, nested
+    function bodies excluded (a worker fn defined in a loop is its own
+    analysis scope, not this loop's per-iteration behavior)."""
+    clocks = 0
+    sends = 0
+    paced = False
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = module.dotted(node.func)
+        attr = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else dotted)
+        if dotted in _CLOCK_CALLS or (
+                dotted is not None
+                and dotted.rsplit(".", 1)[-1] in ("monotonic",
+                                                  "perf_counter")):
+            clocks += 1
+        elif attr in _SEND_BLOCK_ATTRS:
+            sends += 1
+        elif attr in _PACING_ATTRS or dotted == "time.sleep":
+            paced = True
+    return clocks, sends, paced
+
+
+@register_rule
+class ClosedLoopLatency(Rule):
+    code = "TPU023"
+    name = "closed-loop-latency"
+    severity = "warning"
+    doc = ("An ad-hoc benchmark loop that reads a clock around a "
+           "blocking send (``urlopen``/``getresponse``) with no pacing "
+           "call — the closed-loop shape: the next request fires only "
+           "after the last reply, so a slow server throttles its own "
+           "load generator and the measured p99 never sees queueing "
+           "delay (coordinated omission). Latency numbers from such "
+           "loops are only comparable to other closed-loop numbers, yet "
+           "they end up in records next to open-loop quantiles. Use "
+           "``mmlspark_tpu.loadgen`` instead: arrivals are stamped with "
+           "their scheduled send time and latency is measured from that "
+           "instant. ``loadgen/`` itself (its labeled closed-loop probe "
+           "is the sanctioned comparison baseline) and ``tests/`` are "
+           "exempt. Suppress only for a loop that genuinely is not a "
+           "latency measurement (e.g. polling until a condition holds "
+           "while logging elapsed time).")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if rel.startswith(_CLOSED_LOOP_EXEMPT_PREFIXES) \
+                or "/tests/" in rel:
+            return iter(())
+        findings: List[Finding] = []
+        for loop in module.nodes(ast.For, ast.While):
+            clocks, sends, paced = _loop_call_profile(loop, module)
+            if clocks >= 2 and sends >= 1 and not paced:
+                findings.append(self.finding(
+                    module, loop,
+                    "closed-loop latency measurement: this loop times a "
+                    "blocking send and lets the reply gate the next "
+                    "request, so queueing delay is invisible "
+                    "(coordinated omission) — drive traffic through "
+                    "mmlspark_tpu.loadgen (open-loop, scheduled-send "
+                    "latency) or pace sends explicitly"))
+        return iter(findings)
