@@ -561,12 +561,19 @@ def _generation_phase(on_tpu: bool) -> dict:
     # stall budget bounds ONE step, so a wedged device call mid-generation
     # produces a diagnostic bundle instead of a silent external timeout
     from mmlspark_tpu.observability import watch as _wd_watch
+    from mmlspark_tpu.observability.timeseries import get_store as _ts_store
+    _history = _ts_store()
     with _wd_watch("bench_generation") as _w:
         while any(r is not None for r in eng._slot_req) or eng._waiting:
             s0 = time.perf_counter()
             eng.step()
             _w.beat()
-            step_s.append(time.perf_counter() - s0)
+            step = time.perf_counter() - s0
+            step_s.append(step)
+            # per-tick history: the embedded timeline shows step latency
+            # over the run (warmup spike, steady state), not just the
+            # batch quantiles below
+            _history.record("bench_decode_step_ms", step * 1e3)
     elapsed = time.perf_counter() - t0
     toks = sum(len(r.tokens) for r in reqs)
     lat = np.sort(np.asarray(step_s))
@@ -615,6 +622,11 @@ def _generation_phase(on_tpu: bool) -> dict:
                                          if eng._tuner else [])
                              if h["knob"] == "chunk"],
         "engine_stats": dict(eng.stats),
+        # time-resolved view of the same run: per-bucket min/max/mean of
+        # the step latency series recorded in the loop above, so a spike
+        # mid-run is visible even though the quantiles flatten it
+        "timeseries": _history.snapshot(max(elapsed + 5.0, 30.0),
+                                        names=["bench_decode_step_ms"]),
     }
     out["quantized"] = _quantized_generation_pass(cfg, params)
     return out
@@ -817,6 +829,14 @@ def _scenarios_phase(record: dict) -> dict:
             os.environ.pop(FEDERATION_INTERVAL_ENV, None)
         else:
             os.environ[FEDERATION_INTERVAL_ENV] = prior
+    # worker-side sampled history (the store outlives cluster.close()):
+    # queue pressure over the run, next to the scorecard's own
+    # `timeline` sub-record
+    from mmlspark_tpu.observability.timeseries import get_store as _ts_store
+    card["timeseries"] = _ts_store().snapshot(
+        max(float(card.get("window_s") or 0.0) + 10.0, 60.0),
+        names=["mmlspark_queue_saturation",
+               "mmlspark_queue_drain_rate"])
     return card
 
 

@@ -18,9 +18,17 @@ from typing import Dict, Optional
 __all__ = ["ScenarioProgress", "get_progress", "set_progress",
            "reset_progress"]
 
+#: live-timeline ring size cap — the structure is preallocated at begin()
+#: and never grows, whatever the scenario duration (overflow completions
+#: clamp into the last bucket)
+MAX_LIVE_BUCKETS = 64
+
 
 class ScenarioProgress:
-    """Thread-safe counters for the scenario currently driving traffic."""
+    """Thread-safe counters for the scenario currently driving traffic,
+    plus a fixed-size live timeline (per-bucket outcome counts and
+    latency stats) so ``GET /debug/scenario`` shows the run's shape
+    *mid-run*, not just after the scorecard lands."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -39,8 +47,14 @@ class ScenarioProgress:
         self.started_t: Optional[float] = None
         self.updated_t: Optional[float] = None
         self.summary: Optional[Dict[str, object]] = None
+        self.bucket_s = 1.0
+        # preallocated at begin(); [ok, shed, errors, lat_sum, lat_max, n]
+        self._buckets: list = []
+        self._hi_bucket = -1
 
-    def begin(self, scenario: str, total: int) -> None:
+    def begin(self, scenario: str, total: int,
+              duration_s: Optional[float] = None,
+              bucket_s: Optional[float] = None) -> None:
         with self._lock:
             self._reset_locked()
             self.scenario = scenario
@@ -48,13 +62,24 @@ class ScenarioProgress:
             self.total = int(total)
             self.started_t = time.time()
             self.updated_t = self.started_t
+            if bucket_s is None:
+                bucket_s = (max(round(float(duration_s) / 12.0, 3), 0.1)
+                            if duration_s else 1.0)
+            self.bucket_s = float(bucket_s)
+            n = MAX_LIVE_BUCKETS
+            if duration_s:
+                # +2 slack: completions trail the planned duration
+                n = min(n, int(float(duration_s) / self.bucket_s) + 2)
+            self._buckets = [[0, 0, 0, 0.0, 0.0, 0] for _ in range(n)]
 
     def note_sent(self, n: int = 1) -> None:
         with self._lock:
             self.sent += n
             self.updated_t = time.time()
 
-    def note_done(self, outcome: str, retries: int = 0) -> None:
+    def note_done(self, outcome: str, retries: int = 0,
+                  at_s: Optional[float] = None,
+                  lat_s: Optional[float] = None) -> None:
         with self._lock:
             self.done += 1
             self.retries += int(retries)
@@ -65,6 +90,19 @@ class ScenarioProgress:
             else:
                 self.errors += 1
             self.updated_t = time.time()
+            if at_s is not None and self._buckets:
+                i = min(max(int(at_s // self.bucket_s), 0),
+                        len(self._buckets) - 1)
+                if i > self._hi_bucket:
+                    self._hi_bucket = i
+                b = self._buckets[i]
+                col = {"ok": 0, "shed": 1}.get(outcome, 2)
+                b[col] += 1
+                if outcome == "ok" and lat_s is not None:
+                    b[3] += float(lat_s)
+                    if lat_s > b[4]:
+                        b[4] = float(lat_s)
+                    b[5] += 1
 
     def finish(self, summary: Optional[Dict[str, object]] = None) -> None:
         with self._lock:
@@ -88,6 +126,19 @@ class ScenarioProgress:
                 # metrics in loadgen.scorecard
                 # tpulint: disable=TPU007
                 out["elapsed_s"] = round(time.time() - self.started_t, 3)
+            if self._hi_bucket >= 0:
+                rows = []
+                for i in range(self._hi_bucket + 1):
+                    ok, shed, errors, lat_sum, lat_max, n = self._buckets[i]
+                    rows.append({
+                        "t0": round(i * self.bucket_s, 3),
+                        "ok": ok, "shed": shed, "errors": errors,
+                        "lat_mean_ms": (round(lat_sum / n * 1e3, 3)
+                                        if n else None),
+                        "lat_max_ms": (round(lat_max * 1e3, 3)
+                                       if n else None)})
+                out["timeline"] = {"bucket_s": self.bucket_s,
+                                   "buckets": rows}
             if self.summary is not None:
                 out["summary"] = dict(self.summary)
             return out

@@ -276,10 +276,15 @@ def _drive_arrival(scenario: Scenario, a: Arrival, t0: float,
         else:
             time.sleep(min(0.01 * attempts, 0.05))
     done = time.monotonic()
-    get_progress().note_done(outcome, retries=attempts - 1)
+    get_progress().note_done(outcome, retries=attempts - 1,
+                             at_s=done - t0,
+                             lat_s=done - scheduled)
     return {
         "index": a.index, "tenant": a.tenant, "workload": a.workload,
         "rows": a.rows, "outcome": outcome, "attempts": attempts,
+        # scheduled arrival offset from scenario start — the timeline
+        # sub-record buckets by this (scorecard.build_timeline)
+        "at": round(a.at, 6),
         "honored_retries": honored, "send_lag_s": round(send_lag, 6),
         "sched_lat_s": round(done - scheduled, 6),
         "send_lat_s": round(done - first_send, 6),
@@ -366,7 +371,8 @@ def run_scenario(scenario: Scenario, cluster, *,
     targets = [w.server.address.rstrip("/") + "/" for w in cluster.workers]
     arrivals = plan(scenario)
     progress = get_progress()
-    progress.begin(scenario.name, len(arrivals))
+    progress.begin(scenario.name, len(arrivals),
+                   duration_s=scenario.duration_s)
 
     say(f"closed-loop probe ({closed_loop_n} requests)")
     closed = closed_loop_probe(scenario, targets, n=closed_loop_n)

@@ -18,6 +18,7 @@ instead of growing another tracker.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -26,9 +27,9 @@ from ..observability import gauge as _metric_gauge
 from ..observability import snapshot as _registry_snapshot
 from ..tuning.observations import harvest_scorecard as _harvest_scorecard
 
-__all__ = ["build_scorecard", "counters_delta", "counters_snapshot",
-           "fairness_error", "harvest_slo", "merged_requests_total",
-           "quantiles_ms"]
+__all__ = ["build_scorecard", "build_timeline", "counters_delta",
+           "counters_snapshot", "fairness_error", "harvest_slo",
+           "merged_requests_total", "quantiles_ms"]
 
 _M_SCN_REQUESTS = _metric_counter(
     "mmlspark_scenario_requests_total",
@@ -92,6 +93,56 @@ def fairness_error(goodput: Dict[str, float],
         w_share = max(float(weights.get(t, 0.0)), 0.0) / w_total
         err += abs(g_share - w_share)
     return round(err / 2.0, 6)
+
+
+def build_timeline(samples: Sequence[Optional[dict]], *,
+                   duration_s: float,
+                   weights: Optional[Dict[str, float]] = None,
+                   bucket_s: Optional[float] = None) -> Dict[str, object]:
+    """Time-resolved scorecard sub-record: the run as fixed-width buckets.
+
+    Each landed sample is assigned to the bucket of its *scheduled*
+    arrival offset (``at``), so the timeline shows the offered-load shape
+    the scenario planned (diurnal waves, bursts) with the outcomes that
+    befell it — a mid-run worker restart reads as a goodput dip and a
+    p99 spike in the buckets it hit, then recovery. Per bucket: arrival/
+    ok/shed/error counts, goodput_rps, coordinated-omission-corrected
+    p99 over scheduled-send latency, and the DRR fairness error of that
+    bucket's goodput against the configured tenant ``weights``.
+    """
+    landed = [s for s in samples
+              if s is not None and s.get("at") is not None]
+    if bucket_s is None:
+        # ~12 buckets per run, floored so sub-second runs still resolve
+        bucket_s = max(round(float(duration_s) / 12.0, 3), 0.1)
+    bucket_s = float(bucket_s)
+    if not landed:
+        return {"bucket_s": bucket_s, "buckets": []}
+    count = int(math.floor(max(float(s["at"]) for s in landed)
+                           / bucket_s)) + 1
+    rows: List[dict] = [
+        {"t0": round(i * bucket_s, 3), "arrivals": 0, "ok": 0,
+         "shed": 0, "errors": 0} for i in range(count)]
+    lats: List[List[float]] = [[] for _ in range(count)]
+    tenant_ok: List[Dict[str, float]] = [{} for _ in range(count)]
+    for s in landed:
+        i = min(int(float(s["at"]) // bucket_s), count - 1)
+        row = rows[i]
+        row["arrivals"] += 1
+        outcome = s.get("outcome")
+        key = {"ok": "ok", "shed": "shed"}.get(outcome, "errors")
+        row[key] += 1
+        if outcome == "ok":
+            tenant = str(s.get("tenant", "default"))
+            tenant_ok[i][tenant] = tenant_ok[i].get(tenant, 0.0) + 1.0
+            if s.get("sched_lat_s") is not None:
+                lats[i].append(float(s["sched_lat_s"]))
+    for i, row in enumerate(rows):
+        row["goodput_rps"] = round(row["ok"] / bucket_s, 3)
+        row["p99_ms"] = (round(_quantile(sorted(lats[i]), 0.99) * 1e3, 3)
+                         if lats[i] else None)
+        row["fairness_error"] = fairness_error(tenant_ok[i], weights or {})
+    return {"bucket_s": bucket_s, "buckets": rows}
 
 
 # -- counter snapshots (breaker flaps, sheds, faults) -------------------------
@@ -275,6 +326,13 @@ def build_scorecard(scenario, samples: List[dict], *,
         "faults_injected": deltas.get("faults_injected"),
         "tenants": tenant_rows,
         "fairness_error": fair_err,
+        # time-resolved view of the same run (see build_timeline): the
+        # scenario's load shape and how each slice of it fared
+        "timeline": build_timeline(
+            samples,
+            duration_s=float(getattr(scenario, "duration_s", 0.0)
+                             or window_s),
+            weights=weights),
         "cluster": dict(cluster_view) if cluster_view else None,
         "closed_loop": dict(closed_loop) if closed_loop else None,
     }

@@ -33,8 +33,8 @@ from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
 from ..observability import counter as _metric_counter
 from ..observability import log_event as _log_event
 from ..observability import tracing as _tracing
-from ..observability import (ClusterAggregator, snapshot_interval,
-                             worker_snapshot)
+from ..observability import (ClusterAggregator, ClusterSampler,
+                             snapshot_interval, worker_snapshot)
 from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
                            Deadline, DeadlineExceeded, RetryPolicy,
                            breaker_for, get_injector)
@@ -174,6 +174,12 @@ class DriverRegistry:
         #: cluster-wide metrics federation: merges the counter/histogram/
         #: SLO snapshots workers piggyback on their heartbeats
         self.aggregator = ClusterAggregator()
+        #: driver-side time-series plane: cluster series (per-worker
+        #: queue depth / in-flight / HBM from digests, merged goodput and
+        #: burn rate from the aggregator) accrue at the heartbeat — the
+        #: same observation point /debug/cluster serves. Keyed by
+        #: worker_id, so a restarted worker continues its series.
+        self.timeseries = ClusterSampler()
         self.liveness_timeout = liveness_timeout
         self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
         # keep-alive handler threads must not block process exit
@@ -228,6 +234,14 @@ class DriverRegistry:
                 info["digest"] = digest
         if telemetry is not None:
             self.aggregator.ingest(worker_id, telemetry)
+        # feed the cluster series at the observation point: digest fields
+        # directly, goodput/burn from the aggregator's merged totals only
+        # when this heartbeat actually carried telemetry (otherwise the
+        # delta window would dilute to zero)
+        self.timeseries.observe(
+            worker_id, digest=digest,
+            scorecard=(self.aggregator.scorecard()
+                       if telemetry is not None else None))
         return True
 
     def routing_table(self) -> Dict[str, str]:
@@ -253,6 +267,7 @@ class DriverRegistry:
         the cluster SLO scorecard, and per-worker health digests."""
         return {"metrics": self.aggregator.render(),
                 "scorecard": self.aggregator.scorecard(),
+                "timeseries": self.timeseries.snapshot(),
                 "workers": self.workers()}
 
     def close(self) -> None:

@@ -41,6 +41,12 @@ from ..observability import (CONTENT_TYPE as _PROM_CONTENT_TYPE,
                              register_hbm_gauges as _register_hbm_gauges,
                              render as _render_metrics)
 from ..observability import tracing as _tracing
+from ..observability.timeseries import (acquire_sampler as _acquire_sampler,
+                                        get_alert_engine as _get_alert_engine,
+                                        get_store as _get_ts_store,
+                                        release_sampler as _release_sampler,
+                                        render_sparklines as
+                                        _render_sparklines)
 from ..reliability import (Deadline, get_injector as _get_injector,
                            open_breakers as _open_breakers)
 from ..reliability.lock_sanitizer import new_lock
@@ -66,6 +72,12 @@ _M_INFLIGHT = _metric_gauge(
     "mmlspark_serving_inflight_requests",
     "Requests accepted but not yet answered (routing-table size)",
     ("port",))
+# same object the watchdog registers per-device callbacks on — declared
+# here so health_digest can sum it without touching watchdog internals
+_M_HBM_IN_USE = _metric_gauge(
+    "mmlspark_device_hbm_bytes_in_use",
+    "Device memory in use (memory_stats; backends without it expose "
+    "nothing)", ("device",))
 _M_SHED = _metric_counter(
     "mmlspark_requests_shed_total",
     "Requests rejected 429 by bounded-queue admission control")
@@ -710,6 +722,7 @@ class WorkerServer:
             "/debug/slo": self._debug_slo_route,
             "/debug/costs": self._debug_costs_route,
             "/debug/scenario": self._debug_scenario_route,
+            "/debug/timeseries": self._debug_timeseries_route,
             "/debug/profile": self._debug_profile_route,
             "/debug/registry": self._debug_registry_route,
             "/models": self._models_route,
@@ -782,6 +795,21 @@ class WorkerServer:
         # triggers a backend import)
         _build_info()
         _register_hbm_gauges()
+        # time-series plane (observability/timeseries.py): the registry
+        # sampler is process-global and refcounted — however many servers
+        # a test process runs, one scrape thread feeds one store; close()
+        # releases it. The per-port sources feed the queue-saturation
+        # alert and the drain-rate history suggest_retry_after seeds its
+        # EWMA from after an idle gap (history_key ties the queue to its
+        # labeled series).
+        self._ts_sampler = _acquire_sampler()
+        self._ts_sampler.add_source(
+            "mmlspark_queue_saturation", self._queue_saturation,
+            port=str(self.port))
+        self._ts_sampler.add_source(
+            "mmlspark_queue_drain_rate",
+            lambda: self._queue.drain_rate() or None, port=str(self.port))
+        self._queue.history_key = str(self.port)
 
     @property
     def address(self) -> str:
@@ -845,7 +873,35 @@ class WorkerServer:
         age = _get_watchdog().last_stall_age()
         if age is not None and age <= self.STALL_DEGRADED_SECONDS:
             reasons.append(f"watchdog_stall:{round(age, 1)}s_ago")
+        # sustained-signal alerts (observability/timeseries.py): a rule in
+        # its firing state names itself here until it resolves — one bad
+        # sample never degrades health, the hysteresis window must hold
+        for rule in _get_alert_engine().firing():
+            reasons.append(f"alert_firing:{rule}")
         return reasons
+
+    def _queue_saturation(self) -> float:
+        """Admission-queue fill fraction, sampled into the store per tick
+        (the default queue-saturation alert reads this series)."""
+        maxsize = self._queue.maxsize
+        return self._queue.qsize() / maxsize if maxsize > 0 else 0.0
+
+    def _hbm_bytes_in_use(self) -> Optional[float]:
+        """Summed ``mmlspark_device_hbm_bytes_in_use`` across devices, or
+        None before the watchdog's HBM gauges register (jax not yet
+        initialized). Rides the health digest because worker_snapshot()
+        federates counters and histograms only — a gauge would never
+        reach the driver's cluster series otherwise."""
+        rows = _M_HBM_IN_USE.series()
+        if not rows:
+            return None
+        total = 0.0
+        for _labels, series in rows:
+            try:
+                total += float(series.get())
+            except Exception:
+                return None
+        return total
 
     def health_digest(self) -> Dict[str, object]:
         """Compact health fields the distributed heartbeat piggybacks to
@@ -858,6 +914,7 @@ class WorkerServer:
                 "in_flight": self.pending_count(),
                 "open_breakers": sorted(_open_breakers()),
                 "stall_age_seconds": None if age is None else round(age, 3),
+                "hbm_bytes_in_use": self._hbm_bytes_in_use(),
                 "degraded": bool(self._degraded_reasons()),
                 # federated registry/admission state: which versions this
                 # worker serves (live/canary per model) and its per-tenant
@@ -964,6 +1021,46 @@ class WorkerServer:
             headers=[HeaderData("Content-Type", "application/json")],
             entity=EntityData.from_string(
                 _json.dumps(get_progress().snapshot())),
+            status_line=StatusLineData(status_code=200))
+
+    def _debug_timeseries_route(self, request: HTTPRequestData
+                                ) -> HTTPResponseData:
+        """``GET /debug/timeseries`` — the process-global metric history
+        (observability/timeseries.py): per-series downsampled windows plus
+        the alert engine's rule state. Registered in ``control_routes``,
+        so it serves on both transports.
+
+        Query params: ``seconds`` (trailing window, default 120),
+        ``series`` (comma-separated name filter), and ``format=text`` for
+        the terminal sparkline triage view."""
+        import json as _json
+        _, _, query = request.url.partition("?")
+        params: Dict[str, str] = {}
+        for part in query.split("&"):
+            key, _, value = part.partition("=")
+            if key:
+                params[key] = value
+        try:
+            seconds = float(params.get("seconds", "120"))
+        except ValueError:
+            seconds = 120.0
+        names = ([n for n in params["series"].split(",") if n]
+                 if params.get("series") else None)
+        store = _get_ts_store()
+        if params.get("format") == "text":
+            return HTTPResponseData(
+                headers=[HeaderData("Content-Type",
+                                    "text/plain; charset=utf-8")],
+                entity=EntityData.from_string(
+                    _render_sparklines(store, seconds, names=names)),
+                status_line=StatusLineData(status_code=200))
+        engine = _get_alert_engine()
+        payload = store.snapshot(seconds, names=names)
+        payload["alerts"] = engine.state()
+        payload["firing"] = engine.firing()
+        return HTTPResponseData(
+            headers=[HeaderData("Content-Type", "application/json")],
+            entity=EntityData.from_string(_json.dumps(payload)),
             status_line=StatusLineData(status_code=200))
 
     def _debug_costs_route(self, request: HTTPRequestData
@@ -1438,6 +1535,16 @@ class WorkerServer:
             t.join(timeout=self.MAX_PROFILE_SECONDS + 10.0)
         _M_QUEUE_DEPTH.remove(port=str(self.port))
         _M_INFLIGHT.remove(port=str(self.port))
+        # drop this port's sampler sources, then release the refcounted
+        # sampler (the scrape thread stops with the last server); None'd
+        # so a double close() cannot over-release
+        if self._ts_sampler is not None:
+            self._ts_sampler.remove_source("mmlspark_queue_saturation",
+                                           port=str(self.port))
+            self._ts_sampler.remove_source("mmlspark_queue_drain_rate",
+                                           port=str(self.port))
+            self._ts_sampler = None
+            _release_sampler()
         if self._aio is not None:
             self._aio.close()
         if self._httpd is not None:

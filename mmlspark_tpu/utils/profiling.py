@@ -77,6 +77,10 @@ class SpanTracer:
         finally:
             end = time.perf_counter()
             with self._lock:
+                # bounded by the tracer's `with` block, not process
+                # lifetime: events are exported/discarded on exit — not a
+                # live history (that's observability.timeseries)
+                # tpulint: disable=TPU024
                 self._events.append({
                     "name": name, "ph": "X", "pid": 0,
                     "tid": self._tid(),

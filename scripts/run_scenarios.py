@@ -105,10 +105,18 @@ def main(argv=None) -> int:
             problems.append("federated counter reconciliation failed")
         if card["arrivals"] == 0:
             problems.append("empty arrival plan")
+        timeline = card.get("timeline") or {}
+        if not timeline.get("buckets"):
+            problems.append("scorecard timeline is empty")
+        elif sum(b["ok"] + b["shed"] + b["errors"]
+                 for b in timeline["buckets"]) != card["ok"] + \
+                card["shed"] + card["errors"]:
+            problems.append("timeline buckets do not sum to card outcomes")
         if problems:
             print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
             return 1
-        print("check passed: zero lost, counters reconciled")
+        print("check passed: zero lost, counters reconciled, "
+              "timeline populated")
     return 0
 
 

@@ -124,7 +124,7 @@ def test_scorecard_shape_and_quantiles():
     tr.shed(transport="threaded", route="api")
     card = tr.scorecard()
     assert set(card) == {"t", "window_seconds", "num_buckets", "policy",
-                         "classes"}
+                         "classes", "kv_quant"}
     assert card["policy"] == {"target_p99": 0.5, "availability": 0.999}
     (cls,) = card["classes"]
     assert set(cls) == {"transport", "route", "model", "tenant", "total",

@@ -1636,6 +1636,132 @@ def test_tpu023_suppressible_with_justification():
     assert "TPU023" in codes(suppressed)
 
 
+# ---------------------------------------------------------------------------
+# TPU024 adhoc-timeseries
+
+
+ADHOC_TS_SRC = """\
+    import time
+
+    class QueueMonitor:
+        def __init__(self):
+            self._history = []
+
+        def sample(self, depth):
+            self._history.append((time.monotonic(), depth))
+    """
+
+
+def test_tpu024_adhoc_timeseries_fires():
+    findings, _ = run_fixture(ADHOC_TS_SRC,
+                              relpath="mmlspark_tpu/serving/monitor.py")
+    assert "TPU024" in codes(findings)
+
+
+def test_tpu024_clock_via_local_fires():
+    # the timestamp rides a local assigned from a clock read — same
+    # accumulation, one hop removed
+    findings, _ = run_fixture("""\
+        import time
+
+        class Runner:
+            def __init__(self):
+                self._samples = []
+
+            def note(self, value):
+                now = time.perf_counter()
+                self._samples.append({"t": now, "v": value})
+        """, relpath="mmlspark_tpu/serving/monitor.py")
+    assert "TPU024" in codes(findings)
+
+
+def test_tpu024_bounded_variants_quiet():
+    # any in-class bounding evidence silences the rule: deque(maxlen=),
+    # a tail-slice rebind, or a len-guarded pop drain
+    for src in (
+        """\
+        import time
+        from collections import deque
+
+        class A:
+            def __init__(self):
+                self._history = deque(maxlen=128)
+
+            def sample(self, d):
+                self._history.append((time.monotonic(), d))
+        """,
+        """\
+        import time
+
+        class B:
+            def __init__(self):
+                self._history = []
+
+            def sample(self, d):
+                self._history.append((time.monotonic(), d))
+                self._history = self._history[-128:]
+        """,
+        """\
+        import time
+
+        class C:
+            def __init__(self):
+                self._history = []
+
+            def sample(self, d):
+                self._history.append((time.monotonic(), d))
+                while len(self._history) > 128:
+                    self._history.pop(0)
+        """,
+    ):
+        findings, _ = run_fixture(
+            src, relpath="mmlspark_tpu/serving/monitor.py")
+        assert "TPU024" not in codes(findings), src
+
+
+def test_tpu024_scalar_append_quiet():
+    # a bare scalar append is a worklist, not a (timestamp, value) series
+    findings, _ = run_fixture("""\
+        import time
+
+        class Q:
+            def __init__(self):
+                self._items = []
+
+            def put(self, item):
+                self._items.append(item)
+        """, relpath="mmlspark_tpu/serving/monitor.py")
+    assert "TPU024" not in codes(findings)
+
+
+def test_tpu024_observability_and_tests_exempt():
+    # the store's own package holds the sanctioned rings; tests build
+    # tiny traces on purpose
+    for relpath in ("mmlspark_tpu/observability/timeseries.py",
+                    "tests/test_monitor.py",
+                    "pkg/tests/test_x.py"):
+        findings, _ = run_fixture(ADHOC_TS_SRC, relpath=relpath)
+        assert "TPU024" not in codes(findings), relpath
+
+
+def test_tpu024_suppressible_with_justification():
+    findings, suppressed = run_fixture("""\
+        import time
+
+        class R:
+            def __init__(self):
+                self._marks = []
+
+            def mark(self, v):
+                # trimmed by the flush helper outside this class
+                # tpulint: disable=TPU024
+                self._marks.append((time.monotonic(), v))
+        """, relpath="mmlspark_tpu/serving/monitor.py",
+        keep_suppressed=True)
+    assert "TPU024" not in codes(findings)
+    assert "TPU024" in codes(suppressed)
+
+
 # CLI exit codes
 
 
@@ -1681,6 +1807,11 @@ def test_cli_positive_fixtures_exit_nonzero(tmp_path):
                   "            r.read()\n"
                   "        lats.append(time.perf_counter() - t0)\n"
                   "    return lats\n",
+        "TPU024": "import time\n\nclass M:\n"
+                  "    def __init__(self):\n"
+                  "        self._history = []\n\n"
+                  "    def sample(self, d):\n"
+                  "        self._history.append((time.monotonic(), d))\n",
     }
     for rule, src in fixtures.items():
         p = tmp_path / f"{rule.lower()}.py"

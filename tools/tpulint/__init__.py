@@ -47,6 +47,11 @@ Rules
   blocking send with no pacing — the reply throttles the generator, so
   the measured p99 never sees queueing delay (coordinated omission);
   drive traffic through ``mmlspark_tpu.loadgen`` instead.
+- **TPU024** adhoc-timeseries: an instance attribute accumulating
+  ``(timestamp, value)`` records by ``append`` with no size bound in the
+  class — an ad-hoc history that grows for the life of the process;
+  record through ``observability.timeseries.get_store()`` (fixed-memory
+  rings, shared trend queries) or bound it explicitly.
 
 The static half of the sharding story only; the runtime half is
 ``mmlspark_tpu.parallel.collective_audit``, which counts collectives in

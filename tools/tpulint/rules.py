@@ -1288,3 +1288,162 @@ class ClosedLoopLatency(Rule):
                     "mmlspark_tpu.loadgen (open-loop, scheduled-send "
                     "latency) or pace sends explicitly"))
         return iter(findings)
+
+
+# -- TPU024 adhoc-timeseries ---------------------------------------------------
+
+#: paths allowed to accumulate history: the observability package owns the
+#: sanctioned fixed-memory TimeSeriesStore; tests build tiny ad-hoc traces
+#: on purpose
+_TIMESERIES_EXEMPT_PREFIXES = ("mmlspark_tpu/observability/", "tests/")
+
+
+def _is_clock_call(module: ModuleInfo, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = module.dotted(node.func)
+    return dotted in _CLOCK_CALLS or (
+        dotted is not None
+        and dotted.rsplit(".", 1)[-1] in ("monotonic", "perf_counter"))
+
+
+def _clock_bound_names(func: ast.AST, module: ModuleInfo):
+    """Local names assigned directly from a clock read in this function."""
+    names = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) \
+                and _is_clock_call(module, node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _self_attr(node: ast.AST):
+    """``'attr'`` when node is ``self.attr``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _bounded_attrs(cls: ast.ClassDef):
+    """self-attributes with any in-class size-bounding evidence: a
+    deque(maxlen=), pop/popleft/clear drains, del/slice reassignment, or
+    a len() check (the usual trim-guard shape)."""
+    bounded = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                attr = _self_attr(node.func.value)
+                if attr and node.func.attr in ("pop", "popleft", "clear"):
+                    bounded.add(attr)
+            if (isinstance(node.func, ast.Name) and node.func.id == "len"
+                    and node.args):
+                attr = _self_attr(node.args[0])
+                if attr:
+                    bounded.add(attr)
+        elif isinstance(node, ast.Assign):
+            value = node.value
+            if isinstance(value, ast.Call):
+                fn = value.func
+                fn_name = (fn.id if isinstance(fn, ast.Name)
+                           else fn.attr if isinstance(fn, ast.Attribute)
+                           else None)
+                if fn_name == "deque" and any(
+                        k.arg == "maxlen" for k in value.keywords):
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            bounded.add(attr)
+            for t in node.targets:
+                # self.attr[...] = ... (slice trim in place)
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        bounded.add(attr)
+                # self.attr = self.attr[-n:] (rebind to a tail slice)
+                attr = _self_attr(t)
+                if (attr and isinstance(value, ast.Subscript)
+                        and _self_attr(value.value) == attr):
+                    bounded.add(attr)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        bounded.add(attr)
+    return bounded
+
+
+@register_rule
+class AdhocTimeseries(Rule):
+    code = "TPU024"
+    name = "adhoc-timeseries"
+    severity = "warning"
+    doc = ("An instance attribute accumulating ``(timestamp, value)`` "
+           "records via ``append`` with no size bound in sight — an "
+           "ad-hoc time series. In a long-lived serving process such a "
+           "list grows until the OOM killer becomes the retention "
+           "policy, and every consumer reinvents windowing/rate/quantile "
+           "math over it, badly. Record the series through "
+           "``mmlspark_tpu.observability.timeseries.get_store()`` "
+           "instead: fixed-memory ring tiers, spike-preserving "
+           "downsampling, and query helpers (``range``/``rate``/"
+           "``ewma``/``sustained``) shared with the alert engine. "
+           "Bounding evidence in the same class silences the rule: a "
+           "``deque(maxlen=)``, ``pop``/``popleft``/``clear`` drains, "
+           "``del``/slice trims, or a ``len()`` guard. "
+           "``mmlspark_tpu/observability/`` (the store's own home) and "
+           "``tests/`` are exempt. Suppress only for genuinely bounded "
+           "accumulation the heuristic cannot see (e.g. trimmed by a "
+           "helper outside the class).")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if rel.startswith(_TIMESERIES_EXEMPT_PREFIXES) \
+                or "/tests/" in rel:
+            return iter(())
+        findings: List[Finding] = []
+        seen = set()
+        for cls in module.nodes(ast.ClassDef):
+            bounded = _bounded_attrs(cls)
+            funcs = [n for n in ast.walk(cls)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for func in funcs:
+                clock_names = _clock_bound_names(func, module)
+                for call in ast.walk(func):
+                    if not (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "append"
+                            and len(call.args) == 1):
+                        continue
+                    attr = _self_attr(call.func.value)
+                    if attr is None or attr in bounded:
+                        continue
+                    arg = call.args[0]
+                    # records, not scalars: a tuple/list/dict/call whose
+                    # payload carries a clock read (direct or via a local
+                    # assigned from one)
+                    if not isinstance(arg, (ast.Tuple, ast.List,
+                                            ast.Dict, ast.Call)):
+                        continue
+                    stamped = any(
+                        _is_clock_call(module, sub)
+                        or (isinstance(sub, ast.Name)
+                            and sub.id in clock_names)
+                        for sub in ast.walk(arg))
+                    if not stamped or call.lineno in seen:
+                        continue
+                    seen.add(call.lineno)
+                    findings.append(self.finding(
+                        module, call,
+                        f"unbounded (timestamp, value) accumulation on "
+                        f"self.{attr} — an ad-hoc time series that grows "
+                        f"for the life of the process; record it through "
+                        f"observability.timeseries.get_store() (fixed-"
+                        f"memory rings, shared trend queries) or bound "
+                        f"it (deque(maxlen=), trim on append)"))
+        return iter(findings)
