@@ -692,6 +692,76 @@ def _quantized_generation_pass(cfg, params) -> dict:
     }
 
 
+def _failover_phase() -> dict:
+    """Session-failover sub-record: checkpoint a live mid-decode session
+    on engine A, restore it on engine B both cold (journal-style
+    re-prefill of prompt+emitted) and warm (KV page-blob adoption), and
+    time each handoff. ``*_parity`` must be True — both paths are
+    token-identical to the uninterrupted run by construction; the numbers
+    this phase exists for are ``warm_adopt_ms`` vs ``cold_restore_ms``
+    (what a graceful drain saves over a kill) and ``blob_bytes`` (what
+    the warm path costs on the wire)."""
+    from mmlspark_tpu.models.zoo.transformer import (TransformerConfig,
+                                                     init_transformer)
+    from mmlspark_tpu.serving.continuous import ContinuousDecoder
+    cfg = TransformerConfig(vocab=128, d_model=64, heads=4, layers=2,
+                            d_ff=128, max_len=64, causal=True)
+    params = init_transformer(cfg, 0)
+    prompt = np.arange(5, 13, dtype=np.int32)
+    max_new = 16
+
+    def _drain(eng, req):
+        while not req.done:
+            eng.step()
+        return eng.session_result(req)
+
+    base = ContinuousDecoder(params, cfg, max_slots=2, max_len=64,
+                             page_size=8)
+    want = _drain(base, base.submit(prompt, max_new))
+    src = ContinuousDecoder(params, cfg, max_slots=2, max_len=64,
+                            page_size=8)
+    live = src.submit(prompt, max_new)
+    for _ in range(6):                  # genuinely mid-decode
+        src.step()
+    ckpt = src.checkpoint_session(live)
+    blob_bytes = (sum(len(e[k]) for e in ckpt["kv"]["data"] for k in e)
+                  if ckpt["kv"] else 0)
+    cold_eng = ContinuousDecoder(params, cfg, max_slots=2, max_len=64,
+                                 page_size=8)
+    warm_eng = ContinuousDecoder(params, cfg, max_slots=2, max_len=64,
+                                 page_size=8)
+    # prime both engines' compiled programs so the timings below measure
+    # the handoff, not first-touch compilation
+    for e in (cold_eng, warm_eng):
+        _drain(e, e.submit(prompt, 2))
+    t0 = time.perf_counter()
+    cold_req = cold_eng.restore_session(ckpt["session"])
+    while not cold_req.tokens and not cold_req.done:
+        cold_eng.step()                 # includes the re-prefill
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    cold = cold_eng.session_result(cold_req) if cold_req.done else \
+        _drain(cold_eng, cold_req)
+    t0 = time.perf_counter()
+    warm_req = warm_eng.restore_session(ckpt["session"],
+                                        kv_blob=ckpt["kv"])
+    while not warm_req.tokens and not warm_req.done:
+        warm_eng.step()                 # first token off adopted pages
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    warm = warm_eng.session_result(warm_req) if warm_req.done else \
+        _drain(warm_eng, warm_req)
+    return {
+        "emitted_at_checkpoint": len(ckpt["session"]["emitted"]),
+        "blob_bytes": blob_bytes,
+        "cold_restore_ms": round(cold_ms, 3),
+        "warm_adopt_ms": round(warm_ms, 3),
+        # prefill count past the priming request — 0 proves the warm
+        # path re-prefilled nothing
+        "warm_reprefills": warm_eng.stats["prefills"] - 1,
+        "cold_parity": cold == want,
+        "warm_parity": warm == want,
+    }
+
+
 def _multichip_generation_phase(mesh=None) -> dict:
     """Mesh-sharded decode: the same paged-KV engine run once single-chip
     and once shard_map-mounted on ``mesh`` (default: a dp×tp mesh over
@@ -1274,6 +1344,19 @@ def main():
                     "skipped": "budget exhausted"}
         except Exception as e:          # noqa: BLE001
             record["multichip_generation"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+
+    # failover phase: checkpoint/restore a live session cold and warm —
+    # the drain-vs-kill handoff cost numbers, with token parity asserted
+    with _phase_guard(record, "failover", min(remaining() - 25.0, 90.0),
+                      report=report):
+        try:
+            if remaining() > 35.0:
+                record["failover"] = _failover_phase()
+            else:
+                record["failover"] = {"skipped": "budget exhausted"}
+        except Exception as e:          # noqa: BLE001
+            record["failover"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
 
     # scenarios phase: the smoke scenario open-loop against a 3-worker

@@ -16,6 +16,10 @@ metrics and harvested into the ``ObservationStore``).
   open-loop runner, chaos scripts, closed-loop probe.
 * :mod:`.scorecard` — scorecard assembly, fairness error, counter
   reconciliation, metric mirrors, ObservationStore harvest.
+* :mod:`.sessions` — journal-backed long-decode session drill: worker
+  kills mid-decode, recovery over the real ``/_adopt`` hop, token-parity
+  accounting (``sessions_lost``/``sessions_recovered``/
+  ``recovery_p99_ms`` in the scorecard).
 * :mod:`.progress` — the live snapshot behind ``GET /debug/scenario``.
 """
 
@@ -28,12 +32,15 @@ from .scenarios import (SCENARIOS, Scenario, closed_loop_probe,
                         run_scenario)
 from .scorecard import (build_scorecard, counters_snapshot, fairness_error,
                         harvest_slo, merged_requests_total, quantiles_ms)
+from .sessions import SessionDrill, session_token
 
 __all__ = [
-    "Arrival", "SCENARIOS", "Scenario", "ScenarioProgress", "TenantMix",
+    "Arrival", "SCENARIOS", "Scenario", "ScenarioProgress", "SessionDrill",
+    "TenantMix",
     "build_scorecard", "closed_loop_probe", "cluster_echo_engine",
     "counters_snapshot", "diurnal_offsets", "fairness_error",
     "get_progress", "get_scenario", "harvest_slo", "heavy_tail_rows",
     "interarrivals", "merged_requests_total", "plan", "poisson_offsets",
-    "quantiles_ms", "reset_progress", "run_scenario", "weighted_choice",
+    "quantiles_ms", "reset_progress", "run_scenario", "session_token",
+    "weighted_choice",
 ]

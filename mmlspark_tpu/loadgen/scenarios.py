@@ -79,6 +79,12 @@ class Scenario:
     #: whole retry envelope of one arrival
     deadline_s: float = 5.0
     max_retries: int = 3
+    #: long-decode sessions riding the run (``loadgen.sessions``): 0 = no
+    #: session drill; with a chaos restart these exercise journal-replay
+    #: failover over the real ``/_adopt`` hop
+    decode_sessions: int = 0
+    decode_tokens: int = 24
+    decode_tick_s: float = 0.02
 
 
 SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
@@ -98,6 +104,19 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
         duration_s=4.0, rate=120.0, arrival="diurnal", diurnal_depth=0.6,
         faults="enqueue:error:every=2:times=40",
         restart_at_s=1.5, restart_worker="worker-1",
+    ),
+    Scenario(
+        name="decode-kill",
+        description="Session survivability drill: long-decode sessions "
+                    "ride the traffic, one owning worker is killed "
+                    "mid-decode, and every session must finish "
+                    "token-identical via journal-replay failover over "
+                    "/_adopt (scorecard: sessions_lost == 0).",
+        duration_s=2.5, rate=30.0, arrival="poisson",
+        restart_at_s=1.0, restart_worker="worker-1",
+        # 40 tokens x 50ms = ~2s of decoding: the 1.0s restart lands
+        # mid-stream, so worker-1's sessions MUST take the failover path
+        decode_sessions=6, decode_tokens=40, decode_tick_s=0.05,
     ),
 )}
 
@@ -398,6 +417,16 @@ def run_scenario(scenario: Scenario, cluster, *,
         chaos_timer.daemon = True
         chaos_timer.start()
 
+    drill = None
+    if scenario.decode_sessions > 0:
+        from .sessions import SessionDrill
+        say(f"session drill: {scenario.decode_sessions} decode sessions "
+            f"x {scenario.decode_tokens} tokens")
+        drill = SessionDrill(
+            cluster, n_sessions=scenario.decode_sessions,
+            tokens_per_session=scenario.decode_tokens,
+            tick_s=scenario.decode_tick_s).start()
+
     say(f"open-loop drive: {len(arrivals)} arrivals over "
         f"{scenario.duration_s:.1f}s")
     samples: List[Optional[dict]] = [None] * len(arrivals)
@@ -423,6 +452,10 @@ def run_scenario(scenario: Scenario, cluster, *,
             samples[i] = _drive_arrival(scenario, arrivals[i], t0, live,
                                         breakers)
 
+    # tpulint: disable=TPU025 — bounded sender pool, joined before the
+    # scenario returns; a crash surfaces as missing samples in the
+    # reconciliation counters, and supervisor backoff/restart would
+    # distort the open-loop arrival schedule the scenario measures
     threads = [threading.Thread(target=sender, name=f"scenario-send-{k}",
                                 daemon=True)
                for k in range(max(1, min(senders, len(arrivals) or 1)))]
@@ -435,6 +468,16 @@ def run_scenario(scenario: Scenario, cluster, *,
     if chaos_timer is not None:
         chaos_timer.cancel()
     injector.clear()
+
+    sessions = None
+    if drill is not None:
+        sessions = drill.finish(
+            timeout=max(scenario.duration_s * 2.0,
+                        scenario.decode_tokens * scenario.decode_tick_s
+                        * 4.0, 5.0))
+        say(f"session drill: lost={sessions['lost']} "
+            f"recovered={sessions['recovered']} "
+            f"recovery_p99={sessions['recovery_p99_ms']}ms")
 
     # server-side harvest of cost_ledger rows + tenant cost join
     costs = _fetch_json(targets[0].rstrip("/") + "/debug/costs")
@@ -467,7 +510,7 @@ def run_scenario(scenario: Scenario, cluster, *,
         scenario, samples, window_s=window_s,
         counters_before=before, counters_after=after, costs=costs,
         cluster_view=cluster_view, closed_loop=closed,
-        mesh_shape=mesh_shape, kv_dtype=kv_dtype)
+        mesh_shape=mesh_shape, kv_dtype=kv_dtype, sessions=sessions)
 
     if harvest:
         harvested = harvest_slo(get_tracker().scorecard(), store=store)

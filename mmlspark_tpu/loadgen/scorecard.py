@@ -51,6 +51,14 @@ _M_SCN_FAIRNESS = _metric_gauge(
     "mmlspark_scenario_fairness_error",
     "DRR fairness error (0 = per-tenant goodput shares match weights)",
     ("scenario",))
+_M_SCN_SESSIONS = _metric_counter(
+    "mmlspark_scenario_sessions_total",
+    "Session-drill decode sessions by final outcome "
+    "(completed/recovered/lost)", ("scenario", "outcome"))
+_M_SCN_RECOVERY_P99 = _metric_gauge(
+    "mmlspark_scenario_session_recovery_p99_ms",
+    "p99 session failover latency (journal scan -> /_adopt accepted) of "
+    "the last run", ("scenario",))
 
 
 def _quantile(sorted_vals: Sequence[float], q: float) -> float:
@@ -204,7 +212,8 @@ def build_scorecard(scenario, samples: List[dict], *,
                     cluster_view: Optional[dict] = None,
                     closed_loop: Optional[dict] = None,
                     mesh_shape: Optional[str] = None,
-                    kv_dtype: Optional[str] = None) -> dict:
+                    kv_dtype: Optional[str] = None,
+                    sessions: Optional[dict] = None) -> dict:
     """Assemble the per-scenario scorecard and mirror it to metrics.
 
     ``samples`` is the runner's per-arrival outcome list (one dict per
@@ -335,6 +344,10 @@ def build_scorecard(scenario, samples: List[dict], *,
             weights=weights),
         "cluster": dict(cluster_view) if cluster_view else None,
         "closed_loop": dict(closed_loop) if closed_loop else None,
+        # session-drill block (loadgen.sessions): decode sessions that
+        # rode the run, how many survived the chaos script, and the
+        # failover latency tail — sessions_lost == 0 is the CI gate
+        "sessions": dict(sessions) if sessions else None,
     }
 
     name = str(card["scenario"])
@@ -350,6 +363,17 @@ def build_scorecard(scenario, samples: List[dict], *,
     if isinstance(lat, dict):
         _M_SCN_P99.set(float(lat["p99_ms"]), scenario=name)
     _M_SCN_FAIRNESS.set(fair_err, scenario=name)
+    if sessions:
+        n_lost = int(sessions.get("lost", 0))
+        n_rec = int(sessions.get("recovered", 0))
+        n_done = int(sessions.get("sessions", 0)) - n_lost - n_rec
+        for outcome, n in (("completed", max(n_done, 0)),
+                           ("recovered", n_rec), ("lost", n_lost)):
+            if n:
+                _M_SCN_SESSIONS.inc(n, scenario=name, outcome=outcome)
+        if sessions.get("recovery_p99_ms") is not None:
+            _M_SCN_RECOVERY_P99.set(float(sessions["recovery_p99_ms"]),
+                                    scenario=name)
     return card
 
 

@@ -46,6 +46,7 @@ from .registry import snapshot as _registry_snapshot
 
 __all__ = ["Watchdog", "watch", "get_watchdog", "set_watchdog",
            "reset_watchdog", "configure", "register_hbm_gauges",
+           "register_bundle_provider", "unregister_bundle_provider",
            "DIAG_DIR_ENV", "WATCHDOG_ENV", "BUDGET_ENV", "INTERVAL_ENV"]
 
 WATCHDOG_ENV = "MMLSPARK_TPU_WATCHDOG"
@@ -75,6 +76,22 @@ _M_HBM_LIMIT = _metric_gauge(
     "Device memory limit (memory_stats)", ("device",))
 
 _SITE_SANITIZE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+# Extra stall-bundle blocks contributed by other subsystems (the journal
+# registers one) without the watchdog importing them — the bundle must
+# stay writable from a process where those layers never loaded.
+_BUNDLE_PROVIDERS: Dict[str, Callable[[], object]] = {}
+
+
+def register_bundle_provider(name: str, fn: Callable[[], object]) -> None:
+    """Add a ``bundle[name] = fn()`` block to every future stall bundle.
+    Provider failures degrade to an ``unavailable: ...`` string — a broken
+    provider must never cost the stacks and metrics the bundle exists for."""
+    _BUNDLE_PROVIDERS[name] = fn
+
+
+def unregister_bundle_provider(name: str) -> None:
+    _BUNDLE_PROVIDERS.pop(name, None)
 
 
 def _truthy(value: Optional[str]) -> bool:
@@ -305,6 +322,11 @@ class Watchdog:
             bundle["locks_held"] = held_by_thread()
         except Exception:
             bundle["locks_held"] = None
+        for name, fn in list(_BUNDLE_PROVIDERS.items()):
+            try:
+                bundle[name] = fn()
+            except Exception as e:
+                bundle[name] = f"unavailable: {type(e).__name__}: {e}"
         site = _SITE_SANITIZE_RE.sub("_", record["site"])[:64] or "site"
         name = (f"watchdog_{site}_{os.getpid()}_"
                 f"{next(self._bundle_seq)}.json")

@@ -364,6 +364,9 @@ def warm_up_model(model, jitted, specs, batch_sizes,
         return stats
 
     if background:
+        # tpulint: disable=TPU025 — run-once background warm-up over a
+        # finite placement list, not a service loop; a crash leaves the
+        # cache cold (first real request compiles) and must not restart
         t = threading.Thread(target=_warm, daemon=True,
                              name=f"warmup-{model.uid}")
         t.start()

@@ -106,6 +106,10 @@ def coordinator_rendezvous(role: str, driver_host: str, driver_port: int,
             finally:
                 srv.close()
 
+        # tpulint: disable=TPU025 — run-once bootstrap rendezvous: serves
+        # exactly num_workers payloads then exits; OSError containment
+        # around the loop is the intended single-shot cleanup, and a
+        # restart would re-listen on a closed socket
         threading.Thread(target=serve, daemon=True).start()
         return f"{driver_host}:{coord_port}"
     # worker

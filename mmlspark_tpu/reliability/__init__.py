@@ -1,6 +1,6 @@
 """Reliability substrate for the serving stack.
 
-Four cooperating pieces (PAPERS.md: ORCA/AlpaServe-style overload control
+Five cooperating pieces (PAPERS.md: ORCA/AlpaServe-style overload control
 and fail-fast serving):
 
 - :mod:`.policy` — :class:`RetryPolicy` (budgeted exponential backoff with
@@ -12,6 +12,11 @@ and fail-fast serving):
 - :mod:`.faults` — deterministic, seedable :class:`FaultInjector` with
   named sites (``peer_http``, ``heartbeat``, ``device_run``, ``enqueue``)
   driven programmatically or by the ``MMLSPARK_TPU_FAULTS`` env spec.
+- :mod:`.loops` — :func:`run_supervised`/:func:`start_supervised`, the
+  crash-contained daemon-loop harness (backoff + restart accounting into
+  ``mmlspark_supervised_loop_restarts_total{loop}``) that heartbeat and
+  sweeper threads run under; tpulint TPU025 flags daemon loops that skip
+  it.
 - :mod:`.lock_sanitizer` — opt-in (``MMLSPARK_TPU_LOCK_SANITIZER=1``)
   instrumented lock factory: dynamic lock-order-cycle detection with both
   stacks, hold-time budgets into ``mmlspark_lock_held_seconds{site}``, and
@@ -26,6 +31,7 @@ from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
 from .faults import FaultInjector, InjectedFault, get_injector
 from .lock_sanitizer import (cycle_reports, held_by_thread, new_condition,
                              new_lock, new_rlock)
+from .loops import run_supervised, start_supervised
 from .policy import (DEADLINE_HEADER, Deadline, DeadlineExceeded, RetryPolicy,
                      record_retry)
 
@@ -43,6 +49,8 @@ __all__ = [
     "new_condition",
     "new_lock",
     "new_rlock",
+    "run_supervised",
+    "start_supervised",
     "DEADLINE_HEADER",
     "Deadline",
     "DeadlineExceeded",

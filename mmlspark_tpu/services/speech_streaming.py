@@ -89,6 +89,10 @@ class SpeechRecognitionSession:
                            "bits_per_sample": fmt.bits_per_sample,
                            "channels": fmt.channels}}))
             done = threading.Event()
+            # tpulint: disable=TPU025 — session-scoped receiver, joined
+            # when the stream ends; a crash tears down this one session
+            # (surfaced by the closed connection), and restarting it would
+            # replay partial phrase events into the transcript
             receiver = threading.Thread(
                 target=self._recv_loop, args=(conn, done), daemon=True)
             receiver.start()

@@ -112,7 +112,8 @@ class _Request:
                  "submitted_at", "first_token_at", "finished_at",
                  "temperature", "top_k", "top_p", "seed",
                  "prefix_key", "prefix_len", "error",
-                 "cost_cls", "cost_trace")
+                 "cost_cls", "cost_trace",
+                 "session_id", "pre_emitted", "journaled")
 
     def __init__(self, rid, prompt, max_new, temperature=0.0, top_k=0,
                  top_p=1.0, seed=0):
@@ -135,6 +136,14 @@ class _Request:
         # cost-ledger workload class + trace, captured at submit time
         # (engine-thread ticks run outside the request's trace context)
         self.cost_cls, self.cost_trace = _resolve_cost_ctx()
+        #: durable-session identity (journal key; defaults to the rid)
+        self.session_id: str = str(rid)
+        #: tokens emitted by a PREVIOUS incarnation of this session — a
+        #: restored request only generates the remainder; callers read the
+        #: full completion via ``ContinuousDecoder.session_result``
+        self.pre_emitted: List[int] = []
+        #: how many of ``tokens`` have reached the journal tail
+        self.journaled = 0
 
 
 def _sample_rows(logits, temp, top_k, top_p, keys):
@@ -566,7 +575,8 @@ class ContinuousDecoder:
                  paged_attn: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
                  quant_probe: int = 64,
-                 slo_model: str = "default"):
+                 slo_model: str = "default",
+                 journal=None):
         if cfg.moe_experts:
             raise ValueError("continuous decoding does not support MoE")
         if not cfg.causal:
@@ -744,6 +754,11 @@ class ContinuousDecoder:
         self._quant_probe = int(quant_probe) if self._kv_dtype else 0
         self._quant_inserts = 0
         self._slo_model = str(slo_model)
+        #: optional ServingJournal for durable sessions: a ``sess`` record
+        #: at submit (write-ahead — a failed append errors the submit, not
+        #: the engine), one batched ``tail`` record per drain tick, and a
+        #: ``sess_end`` at completion. None = sessions die with the process.
+        self._journal = journal
         self._quant_probe_j = (_quant_probe_program(self._kv_dtype)
                                if self._quant_probe else None)
         if impl == "kernel" and not _pa_auto_interpret():
@@ -944,6 +959,9 @@ class ContinuousDecoder:
                              for _ in range(dcfg.layers)]
         self._tok = self._zeros((self._S,), jnp.int32)
         self._pos = self._zeros((self._S,), jnp.int32)
+        # tpulint: disable=TPU012 — every post-construction caller
+        # (cancel_all) already holds _engine_lock; the other call site is
+        # the constructor, before any engine thread exists
         self._active = self._zeros((self._S,), bool)
         #: tokens each slot may still emit (drives in-scan retirement for
         #: steps_per_dispatch > 1; maintained for k = 1 too)
@@ -960,7 +978,9 @@ class ContinuousDecoder:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0,
                prefix_key: Optional[str] = None,
-               prefix_len: Optional[int] = None) -> _Request:
+               prefix_len: Optional[int] = None,
+               session_id: Optional[str] = None,
+               _journal_record: bool = True) -> _Request:
         """``prefix_key`` enables prefix caching (the shared-system-prompt
         pattern): the first request carrying a key prefills normally and
         snapshots its prompt's first ``prefix_len`` positions (default:
@@ -1008,6 +1028,23 @@ class ContinuousDecoder:
                            top_p=top_p, seed=seed)
             req.prefix_key = prefix_key
             req.prefix_len = prefix_len
+            if session_id is not None:
+                req.session_id = str(session_id)
+            if self._journal is not None and _journal_record:
+                # write-ahead durable session: journaled BEFORE the request
+                # is visible to the engine, so a crash at any later point
+                # leaves a reconstructible session; an append failure
+                # errors THIS submit instead of admitting an
+                # unrecoverable request (restore_session suppresses this —
+                # it journals the canonical un-forced session itself)
+                self._journal.record_session(
+                    req.session_id, prompt.tolist(), {
+                        "max_new": int(max_new_tokens),
+                        "temperature": float(temperature),
+                        "top_k": int(top_k), "top_p": float(top_p),
+                        "seed": int(seed), "prefix_key": prefix_key,
+                        "prefix_len": prefix_len,
+                    }, phash=_prefix_hash(prompt))
             self._waiting.append(req)
         return req
 
@@ -1017,6 +1054,210 @@ class ContinuousDecoder:
         if req.error is not None:
             raise req.error
         return list(req.tokens)
+
+    def session_result(self, req: _Request,
+                       timeout: Optional[float] = None) -> List[int]:
+        """Full session completion: tokens emitted by previous
+        incarnations of a restored session, then this incarnation's
+        output. For a never-restored request this equals :meth:`result`."""
+        return list(req.pre_emitted) + self.result(req, timeout)
+
+    # ---- session survivability (checkpoint / restore) ----
+    def checkpoint_session(self, req: _Request, *,
+                           export_kv: bool = True) -> dict:
+        """Snapshot a live request into a portable session checkpoint.
+
+        Returns ``{"session": {...}, "kv": blob-or-None}`` in canonical
+        session form — the ORIGINAL prompt, the original sampling params,
+        and every token emitted across all incarnations — so a checkpoint
+        of a restored session round-trips losslessly. ``kv`` carries the
+        exported page blob (:meth:`PagedKVPool.export_session`) when the
+        request occupies a slot with written pages; it is None for
+        waiting/mid-prefill/finished requests (and when ``export_kv`` is
+        false), in which case the receiver takes the cold re-prefill path.
+
+        Pending drains are flushed first so the emitted-token view and the
+        KV length agree; the compact permutation has already been applied
+        to ``_slot_pages`` by ``_maybe_compact``, so the page list handed
+        to the pool is in logical order."""
+        with self._engine_lock:
+            while self._pending:
+                self._drain_one()
+            n_pre = len(req.pre_emitted)
+            orig_prompt = req.prompt[:req.prompt.size - n_pre]
+            sess = {
+                "id": req.session_id,
+                "prompt": [int(t) for t in orig_prompt],
+                "params": {
+                    "max_new": int(req.max_new) + n_pre,
+                    "temperature": req.temperature, "top_k": req.top_k,
+                    "top_p": req.top_p, "seed": req.seed,
+                },
+                "phash": _prefix_hash(orig_prompt),
+                "emitted": list(req.pre_emitted) + list(req.tokens),
+            }
+            kv = None
+            if export_kv and not req.done and not self._spec:
+                slot = next((i for i in range(self._S)
+                             if self._slot_req[i] is req), None)
+                if (slot is not None and slot not in self._chunking
+                        and req.tokens and self._slot_pages[slot]):
+                    # positions written so far: the full (possibly forced)
+                    # prompt plus every emitted token EXCEPT the last —
+                    # the last emission is the next tick's input and has
+                    # no KV entry yet
+                    written = req.prompt.size + len(req.tokens) - 1
+                    n_live = self._kv.pages_per_slot(written)
+                    kv = self._kv.export_session(
+                        self._slot_pages[slot][:n_live], length=written)
+            return {"session": sess, "kv": kv}
+
+    def restore_session(self, sess: dict,
+                        kv_blob: Optional[dict] = None) -> _Request:
+        """Rebuild a journaled/checkpointed session on THIS engine.
+
+        Cold path (``kv_blob is None``): re-prefill the original prompt
+        plus every previously emitted token as a forced prefix and decode
+        the remainder — deterministic for greedy (teacher-forcing the
+        emitted tokens reproduces the uninterrupted run's schedule
+        exactly; sampled sessions also continue on-schedule because the
+        PRNG folds the request seed at absolute emit positions).
+
+        Warm path: adopt the exported KV pages into this engine's pool and
+        occupy a slot directly — ZERO re-prefilled tokens; the next tick
+        feeds the last emitted token at its original position.
+
+        Either way the returned request generates only the REMAINDER;
+        read the full completion with :meth:`session_result`. A session
+        whose budget is already spent (or that already emitted eos)
+        returns a completed request immediately."""
+        prompt = np.asarray(sess.get("prompt", ()), np.int32).reshape(-1)
+        params = dict(sess.get("params", {}))
+        emitted = [int(t) for t in sess.get("emitted", ())]
+        sid = sess.get("id")
+        max_new = int(params.get("max_new", 32))
+        temperature = float(params.get("temperature", 0.0))
+        top_k = int(params.get("top_k", 0))
+        top_p = float(params.get("top_p", 1.0))
+        seed = int(params.get("seed", 0))
+        remaining = max_new - len(emitted)
+        finished = (remaining <= 0
+                    or (self._eos is not None and self._eos in emitted))
+        if finished:
+            with self._lock:
+                rid = self._next_rid
+                self._next_rid += 1
+            req = _Request(rid, prompt, max(1, max_new),
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
+            if sid is not None:
+                req.session_id = str(sid)
+            req.pre_emitted = emitted
+            req.done = True
+            req.journaled = -1
+            req.finished_at = time.perf_counter()
+            req.event.set()
+            return req
+        forced = (np.concatenate([prompt,
+                                  np.asarray(emitted, np.int32)])
+                  if emitted else prompt)
+        if sid is None:
+            with self._lock:
+                sid = f"sess-{self._next_rid}"
+        sid = str(sid)
+        if self._journal is not None:
+            # re-journal the CANONICAL session on this engine (original
+            # prompt + merged tail) BEFORE the request becomes visible —
+            # the engine thread's first tail record must find its sess
+            # record — so a second failover replays from here without
+            # accumulating forced prefixes
+            self._journal.record_session(
+                sid, prompt.tolist(), {
+                    "max_new": max_new, "temperature": temperature,
+                    "top_k": top_k, "top_p": top_p, "seed": seed,
+                    "prefix_key": None, "prefix_len": None,
+                }, phash=_prefix_hash(prompt))
+            if emitted:
+                self._journal.record_session_tokens(sid, emitted)
+        if kv_blob is None:
+            # cold: the forced prompt re-prefills through the normal
+            # admission path (grouped/chunked prefill, page budgeting)
+            req = self.submit(forced, max_new_tokens=remaining,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, seed=seed,
+                              session_id=sid, _journal_record=False)
+            req.pre_emitted = emitted
+            return req
+        return self._adopt_warm(sess, kv_blob, forced, remaining,
+                                temperature, top_k, top_p, seed, sid,
+                                emitted)
+
+    def _adopt_warm(self, sess, kv_blob, forced, remaining, temperature,
+                    top_k, top_p, seed, sid, emitted) -> _Request:
+        """Warm-path slot occupation for :meth:`restore_session`."""
+        if self._spec:
+            raise ValueError("warm adopt is not supported on speculative "
+                             "engines (the draft cache is not exported); "
+                             "restore cold instead")
+        if not emitted:
+            raise ValueError("warm adopt needs >= 1 emitted token (the "
+                             "next tick's input); restore cold instead")
+        written = int(kv_blob.get("length", -1))
+        if written != forced.size - 1:
+            raise ValueError(
+                f"kv blob holds {written} positions; session expects "
+                f"{forced.size - 1} (prompt+emitted minus the pending "
+                f"last token)")
+        if forced.size + remaining > self._L:
+            raise ValueError(
+                f"session needs {forced.size + remaining} positions; "
+                f"this engine's max_len is {self._L}")
+        with self._engine_lock:
+            slot = next((i for i in range(self._S)
+                         if self._slot_req[i] is None
+                         and i not in self._chunking), None)
+            if slot is None:
+                raise PoolExhausted("no free slot to adopt session into")
+            adopted = self._kv.adopt_session(kv_blob)
+            n_total = self._kv.pages_per_slot(
+                self._need(forced.size, remaining))
+            try:
+                extra = (self._kv.alloc(n_total - len(adopted))
+                         if n_total > len(adopted) else [])
+            except PoolExhausted:
+                self._kv.free(adopted)
+                raise
+            with self._lock:
+                rid = self._next_rid
+                self._next_rid += 1
+            req = _Request(rid, forced, remaining,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
+            if sid is not None:
+                req.session_id = str(sid)
+            req.pre_emitted = list(emitted)
+            self._slot_req[slot] = req
+            self._slot_pages[slot] = adopted + extra
+            self._set_bt_row(slot, adopted + extra)
+            # device state: the last emitted token is the next input, at
+            # the position it would occupy in the uninterrupted run; the
+            # base PRNG key is a pure function of the seed and folds at
+            # absolute positions, so sampling continues on-schedule too
+            self._tok = self._tok.at[slot].set(int(forced[-1]))
+            self._pos = self._pos.at[slot].set(written)
+            self._active = self._active.at[slot].set(True)
+            self._remaining = self._remaining.at[slot].set(remaining)
+            self._temp = self._temp.at[slot].set(temperature)
+            self._topk = self._topk.at[slot].set(top_k)
+            self._topp = self._topp.at[slot].set(top_p)
+            self._key = self._key.at[slot].set(
+                jax.random.PRNGKey(seed).astype(jnp.uint32))
+            self.stats["sessions_adopted"] = \
+                self.stats.get("sessions_adopted", 0) + 1
+            _tracing.add_event("session_adopt", slot=slot,
+                               pages=len(adopted), extra=len(extra),
+                               written=written)
+        return req
 
     # ---- engine ----
     def _admit(self):
@@ -1116,6 +1357,11 @@ class ContinuousDecoder:
                     req.done = True
                     req.finished_at = time.perf_counter()
                     req.event.set()
+                    if self._journal is not None and req.journaled >= 0:
+                        # a validation-failed request is not recoverable —
+                        # retire its journaled session
+                        self._journal.record_session_end(req.session_id)
+                        req.journaled = -1
                     self._release_locked(slot)
                     continue
                 if not ok:
@@ -1822,6 +2068,22 @@ class ContinuousDecoder:
                 if tk < 0:
                     continue        # spec lane beyond the accepted count
                 self._note_token(req, tk)
+        if self._journal is not None:
+            # one tail record per session per drain tick (batched: a k-step
+            # block journals k tokens in one line); completion closes the
+            # session so compaction can drop it
+            seen = set()
+            for _, (_, req) in snapshot.items():
+                if id(req) in seen or req.journaled < 0:
+                    continue        # -1 = session already closed
+                seen.add(id(req))
+                new = req.tokens[req.journaled:]
+                if new:
+                    self._journal.record_session_tokens(req.session_id, new)
+                    req.journaled = len(req.tokens)
+                if req.done:
+                    self._journal.record_session_end(req.session_id)
+                    req.journaled = -1
         for _, (slot, req) in snapshot.items():
             if req.done and self._slot_req[slot] is req:
                 self._release_locked(slot)

@@ -22,6 +22,9 @@ class _Request:
     finished_at: Optional[float]
     cost_cls: Any
     cost_trace: Optional[str]
+    session_id: str
+    pre_emitted: List[int]
+    journaled: int
 
 class ContinuousDecoder:
     stats: Dict[str, int]
@@ -44,14 +47,23 @@ class ContinuousDecoder:
                  paged_attn: Optional[str] = ...,
                  kv_dtype: Optional[str] = ...,
                  quant_probe: int = ...,
-                 slo_model: str = ...) -> None: ...
+                 slo_model: str = ...,
+                 journal: Optional[Any] = ...) -> None: ...
     def submit(self, prompt_ids: Any, max_new_tokens: int = ..., *,
                temperature: float = ..., top_k: int = ...,
                top_p: float = ..., seed: int = ...,
                prefix_key: Optional[str] = ...,
-               prefix_len: Optional[int] = ...) -> _Request: ...
+               prefix_len: Optional[int] = ...,
+               session_id: Optional[str] = ...,
+               _journal_record: bool = ...) -> _Request: ...
     def result(self, req: _Request,
                timeout: Optional[float] = ...) -> List[int]: ...
+    def session_result(self, req: _Request,
+                       timeout: Optional[float] = ...) -> List[int]: ...
+    def checkpoint_session(self, req: _Request, *,
+                           export_kv: bool = ...) -> dict: ...
+    def restore_session(self, sess: dict,
+                        kv_blob: Optional[dict] = ...) -> _Request: ...
     def step(self) -> int: ...
     def flush(self) -> None: ...
     def cancel_all(self) -> None: ...

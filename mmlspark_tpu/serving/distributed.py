@@ -21,26 +21,30 @@ local-mode Spark (SURVEY §4).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..io.http.schema import (EntityData, HeaderData, HTTPRequestData,
                               HTTPResponseData, StatusLineData)
 from ..observability import counter as _metric_counter
+from ..observability import gauge as _metric_gauge
 from ..observability import log_event as _log_event
 from ..observability import tracing as _tracing
 from ..observability import (ClusterAggregator, ClusterSampler,
                              snapshot_interval, worker_snapshot)
 from ..reliability import (DEADLINE_HEADER, BreakerOpen, CircuitBreaker,
                            Deadline, DeadlineExceeded, RetryPolicy,
-                           breaker_for, get_injector)
+                           breaker_for, get_injector, start_supervised)
 from ..reliability.lock_sanitizer import new_lock
 from .admission import ConsistentHashRing
+from .journal import ServingJournal
 from .kv_pool import AFFINITY_HEADER
+from .registry import WORKER_LIVENESS_STATES
 from .registry import get_registry as _get_model_registry
 from .server import CachedRequest, Overloaded, WorkerServer
 
@@ -49,6 +53,25 @@ __all__ = ["DriverRegistry", "DistributedWorker", "ServingCluster"]
 _M_HEARTBEAT_FAILURES = _metric_counter(
     "mmlspark_heartbeat_failures_total",
     "Heartbeat re-register attempts that exhausted their retry budget")
+
+_M_WORKER_LIVENESS = _metric_gauge(
+    "mmlspark_worker_liveness",
+    "Per-worker liveness state as a one-hot over "
+    "alive/suspect/draining/dead (1 for the current state)",
+    ("worker", "state"))
+
+_M_DEAD_VERDICTS = _metric_counter(
+    "mmlspark_worker_dead_verdicts_total",
+    "Workers declared dead by the driver's liveness sweeper")
+
+
+def _adopt_policy() -> str:
+    """``MMLSPARK_TPU_ADOPT_POLICY``: ``warm`` (default) ships exported KV
+    page blobs over ``/_adopt`` on graceful drain — zero recompute on the
+    receiver; ``cold`` strips the blobs and relies on journal replay /
+    re-prefill (deterministic for greedy, cheaper on the wire)."""
+    policy = os.environ.get("MMLSPARK_TPU_ADOPT_POLICY", "warm").strip().lower()
+    return policy if policy in ("warm", "cold") else "warm"
 
 
 def _giveup(exc: BaseException) -> bool:
@@ -167,7 +190,9 @@ class DriverRegistry:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 liveness_timeout: float = 30.0):
+                 liveness_timeout: float = 30.0,
+                 liveness_interval: Optional[float] = None,
+                 sweep_multiplier: Optional[float] = None):
         self._workers: Dict[str, dict] = {}
         self._lock = new_lock("serving.distributed.DriverRegistry._lock")
         self._generation = 0
@@ -181,6 +206,25 @@ class DriverRegistry:
         #: worker_id, so a restarted worker continues its series.
         self.timeseries = ClusterSampler()
         self.liveness_timeout = liveness_timeout
+        # active liveness: a sweeper thread walks the worker table every
+        # `liveness_interval` seconds, promoting missed heartbeats past
+        # interval x sweep_multiplier to a DEAD verdict (eviction + the
+        # on-dead callbacks that reassign journaled sessions). Unset /
+        # non-positive keeps the legacy lazy-prune-only behavior.
+        if liveness_interval is None:
+            raw = os.environ.get("MMLSPARK_TPU_LIVENESS_INTERVAL", "")
+            liveness_interval = float(raw) if raw else 0.0
+        self.liveness_interval = float(liveness_interval or 0.0)
+        if sweep_multiplier is None:
+            sweep_multiplier = float(os.environ.get(
+                "MMLSPARK_TPU_LIVENESS_SWEEP_MULT", "3.0"))
+        self.sweep_multiplier = max(1.0, float(sweep_multiplier))
+        #: worker ids mid-graceful-drain: still heartbeating (so not dead),
+        #: but excluded from the routing table so no new traffic lands
+        self._draining: set = set()
+        self._dead_callbacks: List[Callable[[str, dict], None]] = []
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: Optional[threading.Thread] = None
         self._httpd = ThreadingHTTPServer((host, port), _RegistryHandler)
         # keep-alive handler threads must not block process exit
         self._httpd.daemon_threads = True
@@ -190,6 +234,10 @@ class DriverRegistry:
                                         name=f"driver-registry-{self.port}",
                                         daemon=True)
         self._thread.start()
+        if self.liveness_interval > 0:
+            self._sweep_thread = start_supervised(
+                self._sweep_once, name=f"liveness-sweeper-{self.port}",
+                stop=self._sweep_stop, interval=self.liveness_interval)
 
     @property
     def url(self) -> str:
@@ -200,6 +248,73 @@ class DriverRegistry:
                  if now - i["last_seen"] >= self.liveness_timeout]
         for w in stale:
             del self._workers[w]
+            self._draining.discard(w)
+
+    # -- active liveness ---------------------------------------------------
+    def _state_locked(self, worker_id: str, info: dict, now: float) -> str:
+        """One of :data:`~.registry.WORKER_LIVENESS_STATES` for a worker
+        still present in the table (``dead`` means the sweeper is about to
+        evict it — the verdict)."""
+        if worker_id in self._draining:
+            return "draining"
+        if self.liveness_interval <= 0:
+            return "alive"
+        age = now - info["last_seen"]
+        if age < self.liveness_interval:
+            return "alive"
+        if age < self.liveness_interval * self.sweep_multiplier:
+            return "suspect"
+        return "dead"
+
+    def _sweep_once(self) -> None:
+        """One sweeper tick: refresh the liveness gauge for every worker,
+        promote missed heartbeats past interval x multiplier to a dead
+        verdict — evict from the table (and hence every routing view), then
+        fire the on-dead callbacks outside the lock so they can take HTTP
+        hops (session reassignment) without stalling registrations."""
+        now = time.time()
+        dead: List[Tuple[str, dict]] = []
+        with self._lock:
+            for w, i in list(self._workers.items()):
+                state = self._state_locked(w, i, now)
+                for s in WORKER_LIVENESS_STATES:
+                    _M_WORKER_LIVENESS.set(1.0 if s == state else 0.0,
+                                           worker=w, state=s)
+                if state == "dead":
+                    dead.append((w, dict(i)))
+                    del self._workers[w]
+                    self._draining.discard(w)
+                    self._generation += 1
+        for w, info in dead:
+            _M_DEAD_VERDICTS.inc()
+            _M_WORKER_LIVENESS.set(1.0, worker=w, state="dead")
+            _log_event("worker_dead_verdict", worker_id=w,
+                       address=info.get("address"),
+                       last_seen_age=round(now - info["last_seen"], 3))
+            for fn in list(self._dead_callbacks):
+                try:
+                    fn(w, info)
+                except Exception as exc:
+                    _log_event("dead_callback_failed", worker_id=w,
+                               error=repr(exc))
+
+    def add_dead_callback(self, fn: Callable[[str, dict], None]) -> None:
+        """Register ``fn(worker_id, info)`` to run after a dead verdict
+        (post-eviction; ``info`` still carries the last known address and
+        digest). ServingCluster hooks session reassignment here."""
+        self._dead_callbacks.append(fn)
+
+    def mark_draining(self, worker_id: str) -> bool:
+        """Graceful-drain entry: keep the worker registered (it still
+        heartbeats and answers its parked requests) but drop it from
+        :meth:`routing_table` so peers stop forwarding new work to it."""
+        with self._lock:
+            if worker_id not in self._workers:
+                return False
+            self._draining.add(worker_id)
+            self._generation += 1
+        _log_event("worker_draining", worker_id=worker_id)
+        return True
 
     def register(self, worker_id: str, address: str) -> dict:
         now = time.time()
@@ -207,6 +322,8 @@ class DriverRegistry:
             self._prune_locked(now)  # crashed workers never /deregister
             prior = self._workers.get(worker_id)
             self._generation += 1
+            # a re-registration is a fresh incarnation — it starts routable
+            self._draining.discard(worker_id)
             self._workers[worker_id] = {"address": address,
                                         "generation": self._generation,
                                         "last_seen": now}
@@ -218,6 +335,7 @@ class DriverRegistry:
     def deregister(self, worker_id: str) -> None:
         with self._lock:
             self._workers.pop(worker_id, None)
+            self._draining.discard(worker_id)
             self._generation += 1
         # federation history survives the departure (forget() keeps the
         # accumulated totals — a dead worker's work still happened)
@@ -248,17 +366,23 @@ class DriverRegistry:
         now = time.time()
         with self._lock:
             self._prune_locked(now)
-            return {w: i["address"] for w, i in self._workers.items()}
+            # draining workers are alive but not routable: peers rebuild
+            # their ConsistentHashRing from this table, so exclusion here
+            # is what actually moves the prefix keyspace off the worker
+            return {w: i["address"] for w, i in self._workers.items()
+                    if w not in self._draining}
 
     def workers(self) -> Dict[str, dict]:
-        """Per-worker health view: routing info + the latest heartbeat
-        digest (queue depth, in-flight, open breakers, stall age)."""
+        """Per-worker health view: routing info + liveness state + the
+        latest heartbeat digest (queue depth, in-flight, open breakers,
+        stall age)."""
         now = time.time()
         with self._lock:
             self._prune_locked(now)
             return {w: {"address": i["address"],
                         "generation": i["generation"],
                         "last_seen_age": round(now - i["last_seen"], 3),
+                        "state": self._state_locked(w, i, now),
                         "digest": i.get("digest")}
                     for w, i in self._workers.items()}
 
@@ -271,6 +395,9 @@ class DriverRegistry:
                 "workers": self.workers()}
 
     def close(self) -> None:
+        self._sweep_stop.set()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=2)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
@@ -292,14 +419,29 @@ class DistributedWorker:
                  reply_timeout: float = 60.0,
                  heartbeat_interval: float = 10.0,
                  advertise_host: str = "",
-                 max_queue: int = 10_000):
+                 max_queue: int = 10_000,
+                 journal_path: Optional[str] = None,
+                 journal_fsync: bool = False):
         self.driver_url = driver_url
         self.worker_id = worker_id
         self.max_queue = int(max_queue)
+        self.journal_path = journal_path
         self.server = WorkerServer(host=host, port=port,
                                    reply_timeout=reply_timeout,
-                                   max_queue=self.max_queue)
+                                   max_queue=self.max_queue,
+                                   journal_path=journal_path,
+                                   journal_fsync=journal_fsync)
         self.server.control_routes["/_reply"] = self._handle_remote_reply
+        self.server.control_routes["/_adopt"] = self._handle_adopt
+        #: failover pluggables: ``adopt_handler(payload) -> dict`` overrides
+        #: the journal-only default (a decoder harness attaches
+        #: ``restore_session`` here); ``session_exporter() -> [entries]``
+        #: is what drain_worker calls to checkpoint live sessions
+        self.adopt_handler: Optional[Callable[[dict], dict]] = None
+        self.session_exporter: Optional[Callable[[], List[dict]]] = None
+        #: sessions accepted over ``/_adopt`` (newest last) — the in-memory
+        #: twin of the journal record, inspectable by drills and tests
+        self.adopted_sessions: List[dict] = []
         self.has_engine = True
         self._peers: Dict[str, str] = {}
         self._rr = 0
@@ -344,26 +486,28 @@ class DistributedWorker:
         self._hb_policy = RetryPolicy(max_attempts=4, base_delay=0.1,
                                       max_delay=1.0, retry_on=(OSError,),
                                       giveup=_giveup)
-        self._hb_thread = threading.Thread(
-            target=self._heartbeat_loop, args=(heartbeat_interval,),
-            name=f"heartbeat-{worker_id}", daemon=True)
-        self._hb_thread.start()
+        # supervised, not a bare daemon loop: a tick that raises (e.g. a
+        # bug in digest collection) is contained and backed off instead of
+        # silently killing the heartbeat — which would look exactly like a
+        # dead worker to the driver's sweeper
+        self._hb_thread = start_supervised(
+            self._heartbeat_tick, name=f"heartbeat-{worker_id}",
+            stop=self._hb_stop, interval=heartbeat_interval)
 
-    def _heartbeat_loop(self, interval: float) -> None:
-        while not self._hb_stop.wait(interval):
-            if self.heartbeat():
-                continue
-            # registry forgot us (pruned while unreachable) → re-register;
-            # a permanently-lost worker must be VISIBLE, not silent
-            try:
-                _http_json(self.driver_url + "/register",
-                           {"worker_id": self.worker_id,
-                            "address": self.advertised_address},
-                           site="heartbeat", retry=self._hb_policy)
-            except Exception as exc:
-                _M_HEARTBEAT_FAILURES.inc()
-                _log_event("heartbeat_reregister_failed",
-                           worker_id=self.worker_id, error=repr(exc))
+    def _heartbeat_tick(self) -> None:
+        if self.heartbeat():
+            return
+        # registry forgot us (pruned while unreachable) → re-register;
+        # a permanently-lost worker must be VISIBLE, not silent
+        try:
+            _http_json(self.driver_url + "/register",
+                       {"worker_id": self.worker_id,
+                        "address": self.advertised_address},
+                       site="heartbeat", retry=self._hb_policy)
+        except Exception as exc:
+            _M_HEARTBEAT_FAILURES.inc()
+            _log_event("heartbeat_reregister_failed",
+                       worker_id=self.worker_id, error=repr(exc))
 
     # -- registry interaction ----------------------------------------------
     def refresh_peers(self) -> Dict[str, str]:
@@ -457,6 +601,61 @@ class DistributedWorker:
         return HTTPResponseData(
             entity=EntityData.from_string(json.dumps({"ok": ok})),
             status_line=StatusLineData(status_code=200 if ok else 404))
+
+    # -- session adoption (failover / drain handoff) -------------------------
+    def adopt_sessions(self, payload: dict) -> dict:
+        """Accept sessions handed over by the driver/cluster.
+
+        Payload: ``{"sessions": [{"session": <canonical session>,
+        "kv": <page blob or null>}], "mode": "warm"|"cold", "from": id}``.
+        With :attr:`adopt_handler` set (a decoder harness binding
+        ``ContinuousDecoder.restore_session``), the whole payload is
+        delegated there. The default journals each session into this
+        worker's own journal — write-ahead, so an adopted session survives
+        a second failure before any engine picks it up — and records it in
+        :attr:`adopted_sessions`.
+        """
+        entries = payload.get("sessions") or []
+        mode = payload.get("mode", "cold")
+        if self.adopt_handler is not None:
+            out = self.adopt_handler(payload)
+            if isinstance(out, dict):
+                return out
+            return {"ok": True, "adopted": len(entries), "mode": mode,
+                    "worker": self.worker_id}
+        adopted = 0
+        journal = self.server._journal
+        for entry in entries:
+            sess = entry.get("session") or {}
+            sid = str(sess.get("id") or "")
+            if not sid:
+                continue
+            if journal is not None:
+                journal.record_session(sid, sess.get("prompt") or [],
+                                       sess.get("params") or {},
+                                       phash=sess.get("phash"))
+                emitted = sess.get("emitted") or []
+                if emitted:
+                    journal.record_session_tokens(sid, emitted)
+            self.adopted_sessions.append(entry)
+            adopted += 1
+        _log_event("sessions_adopted", worker_id=self.worker_id,
+                   n=adopted, mode=mode, source=payload.get("from"))
+        return {"ok": True, "adopted": adopted, "mode": mode,
+                "worker": self.worker_id}
+
+    def _handle_adopt(self, req: HTTPRequestData) -> HTTPResponseData:
+        payload = json.loads(req.entity.content if req.entity else b"{}")
+        try:
+            out = self.adopt_sessions(payload)
+        except Exception as exc:
+            body = json.dumps({"ok": False, "error": repr(exc)})
+            return HTTPResponseData(
+                entity=EntityData.from_string(body),
+                status_line=StatusLineData(status_code=500))
+        return HTTPResponseData(
+            entity=EntityData.from_string(json.dumps(out)),
+            status_line=StatusLineData(status_code=200))
 
     # -- request forwarding (load balancing) ---------------------------------
     _FWD_PREFIX = "/_forward"
@@ -624,15 +823,33 @@ class ServingCluster:
     distributed source/sink surface an engine loop drives."""
 
     def __init__(self, n_workers: int, reply_timeout: float = 60.0,
-                 max_queue: int = 10_000):
-        self.driver = DriverRegistry()
-        self.workers: List[DistributedWorker] = [
-            DistributedWorker(self.driver.url, f"worker-{i}",
-                              reply_timeout=reply_timeout,
-                              max_queue=max_queue)
-            for i in range(n_workers)]
+                 max_queue: int = 10_000,
+                 liveness_interval: Optional[float] = None,
+                 heartbeat_interval: float = 10.0,
+                 journal_dir: Optional[str] = None):
+        self.driver = DriverRegistry(liveness_interval=liveness_interval)
+        #: worker id → journal path (survives the worker object: the dead
+        #: worker's journal is what cold reassignment scans)
+        self._journal_paths: Dict[str, str] = {}
+        self.workers: List[DistributedWorker] = []
+        for i in range(n_workers):
+            wid = f"worker-{i}"
+            jp = None
+            if journal_dir is not None:
+                jp = os.path.join(journal_dir, f"{wid}.journal")
+                self._journal_paths[wid] = jp
+            self.workers.append(
+                DistributedWorker(self.driver.url, wid,
+                                  reply_timeout=reply_timeout,
+                                  max_queue=max_queue,
+                                  heartbeat_interval=heartbeat_interval,
+                                  journal_path=jp))
         for w in self.workers:
             w.refresh_peers()
+        # failover: a sweeper dead-verdict evicts the worker from routing;
+        # this callback evicts it from every survivor's ring (refresh) and
+        # replays its journaled sessions onto a survivor via /_adopt
+        self.driver.add_dead_callback(self._on_worker_dead)
 
     def worker(self, worker_id: str) -> DistributedWorker:
         for w in self.workers:
@@ -689,7 +906,9 @@ class ServingCluster:
                        ) -> DistributedWorker:
         """Chaos/ops helper: kill one worker ungracefully (no deregister —
         a crash doesn't say goodbye) and re-register a replacement under
-        the SAME id, exercising the recovery contract."""
+        the SAME id, exercising the recovery contract. The replacement
+        reopens the same journal, so the dead incarnation's sessions are
+        replayable on it (``scan_sessions``/``replay_sessions``)."""
         for i, w in enumerate(self.workers):
             if w.worker_id != worker_id:
                 continue
@@ -698,7 +917,8 @@ class ServingCluster:
                 self.driver.url, worker_id,
                 reply_timeout=(reply_timeout if reply_timeout is not None
                                else w.server.reply_timeout),
-                max_queue=w.max_queue)
+                max_queue=w.max_queue,
+                journal_path=self._journal_paths.get(worker_id))
             self.workers[i] = replacement
             for peer in self.workers:
                 try:
@@ -708,6 +928,106 @@ class ServingCluster:
                                worker_id=peer.worker_id, error=repr(exc))
             return replacement
         raise KeyError(worker_id)
+
+    # -- session failover --------------------------------------------------
+    def _survivors(self, exclude: str) -> List[DistributedWorker]:
+        return [w for w in self.workers
+                if w.worker_id != exclude and not w.server.closed]
+
+    def _on_worker_dead(self, worker_id: str, info: dict) -> None:
+        """Sweeper dead-verdict hook: the registry already evicted the
+        worker from the routing table; refresh every survivor (ring
+        eviction) and cold-reassign the dead worker's journaled sessions."""
+        survivors = self._survivors(worker_id)
+        for w in survivors:
+            try:
+                w.refresh_peers()
+            except Exception as exc:
+                _log_event("refresh_peers_failed", worker_id=w.worker_id,
+                           error=repr(exc))
+        self.reassign_sessions(worker_id, survivors=survivors)
+
+    def reassign_sessions(self, worker_id: str,
+                          survivors: Optional[List[DistributedWorker]] = None
+                          ) -> dict:
+        """Cold path: scan the (dead) worker's journal for live sessions
+        and replay them onto a survivor over ``/_adopt``. Read-only on the
+        journal — safe while the dead incarnation's fd is still open."""
+        path = self._journal_paths.get(worker_id)
+        if path is None or not os.path.exists(path):
+            return {"ok": True, "adopted": 0, "mode": "cold"}
+        try:
+            sessions = ServingJournal.scan_sessions(path)
+        except Exception as exc:
+            _log_event("session_reassign_failed", worker_id=worker_id,
+                       error=repr(exc))
+            return {"ok": False, "adopted": 0, "error": repr(exc)}
+        if not sessions:
+            return {"ok": True, "adopted": 0, "mode": "cold"}
+        survivors = (survivors if survivors is not None
+                     else self._survivors(worker_id))
+        if not survivors:
+            _log_event("session_reassign_failed", worker_id=worker_id,
+                       error="no surviving workers")
+            return {"ok": False, "adopted": 0, "error": "no survivors"}
+        target = survivors[0]
+        # scan_sessions keys by id; the canonical per-session form the
+        # adopter expects carries it inline
+        payload = {"sessions": [{"session": dict(s, id=sid), "kv": None}
+                                for sid, s in sessions.items()],
+                   "mode": "cold", "from": worker_id}
+        try:
+            out = _http_json(target.advertised_address + "/_adopt", payload,
+                             site="peer_http")
+        except Exception as exc:
+            _log_event("session_reassign_failed", worker_id=worker_id,
+                       error=repr(exc))
+            return {"ok": False, "adopted": 0, "error": repr(exc)}
+        _log_event("sessions_reassigned", worker_id=worker_id,
+                   target=target.worker_id, n=out.get("adopted"))
+        return out
+
+    def drain_worker(self, worker_id: str,
+                     target_id: Optional[str] = None) -> dict:
+        """Graceful drain: mark the worker draining (no new routed traffic),
+        hand its live sessions to a survivor over ``/_adopt`` — warm by
+        default (exported KV page blobs, zero recompute on the receiver),
+        cold under ``MMLSPARK_TPU_ADOPT_POLICY=cold`` — then deregister and
+        retire the worker. Preserves the at-most-once reply edge: parked
+        requests drain on the old worker; only *sessions* move."""
+        w = self.worker(worker_id)
+        self.driver.mark_draining(worker_id)
+        policy = _adopt_policy()
+        entries: List[dict] = []
+        if w.session_exporter is not None:
+            entries = list(w.session_exporter() or [])
+        if policy == "cold":
+            entries = [{"session": e.get("session"), "kv": None}
+                       for e in entries]
+        out = {"ok": True, "adopted": 0, "mode": policy}
+        if entries:
+            survivors = self._survivors(worker_id)
+            if not survivors:
+                raise RuntimeError(
+                    f"drain {worker_id}: no surviving worker to adopt "
+                    f"{len(entries)} session(s)")
+            target = (self.worker(target_id) if target_id is not None
+                      else survivors[0])
+            out = _http_json(target.advertised_address + "/_adopt",
+                             {"sessions": entries, "mode": policy,
+                              "from": worker_id},
+                             site="peer_http")
+        w.close(deregister=True)
+        self.workers = [x for x in self.workers if x.worker_id != worker_id]
+        for peer in self.workers:
+            try:
+                peer.refresh_peers()
+            except Exception as exc:
+                _log_event("refresh_peers_failed", worker_id=peer.worker_id,
+                           error=repr(exc))
+        _log_event("worker_drained", worker_id=worker_id,
+                   adopted=out.get("adopted"), mode=policy)
+        return out
 
     def close(self) -> None:
         for w in self.workers:

@@ -14,6 +14,19 @@ Records (one JSON object per line):
                                           # against /debug/traces span trees
     {"t": "rep",   "id": ...}
     {"t": "epoch", "n": N}
+    {"t": "sess",     "id": ..., "prompt": [ids], "params": {...},
+     "phash": "40-hex prefix hash"}       # a live decode session, written
+                                          # at insert (before any compute)
+    {"t": "tail",     "id": ..., "toks": [ids]}   # emitted-token tail,
+                                          # appended per drain tick
+    {"t": "sess_end", "id": ...}          # session completed or retired
+
+Session records make an in-flight *generation* reconstructible from the
+journal alone (prompt + sampling params + every emitted token), which is
+what driver-orchestrated failover replays through ``/_adopt``: the cold
+path re-prefills prompt+tail on a surviving worker (deterministic for
+greedy), the warm path ships the KV pages and only needs the tail to know
+where decoding resumes.
 
 The write protocol is write-ahead (a request is journaled before it is
 visible to the engine), replies are journaled after routing succeeds, and
@@ -35,14 +48,50 @@ from __future__ import annotations
 import json
 import os
 import threading
+import weakref
 
 from ..reliability.lock_sanitizer import new_lock
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..io.http.schema import HTTPRequestData
+from ..observability import counter as _metric_counter
+from ..observability import gauge as _metric_gauge
 from ..observability import log_event
 
 __all__ = ["ServingJournal"]
+
+M_JOURNAL_BYTES = _metric_gauge(
+    "mmlspark_journal_bytes",
+    "Bytes on disk across this process's live serving journals (per-journal "
+    "values are in ServingJournal.digest() and the watchdog stall bundle)")
+M_JOURNAL_RECORDS = _metric_counter(
+    "mmlspark_journal_records_total",
+    "Journal records appended, by record type", ("type",))
+M_JOURNAL_COMPACTIONS = _metric_counter(
+    "mmlspark_journal_compactions_total",
+    "Journal compactions (atomic rewrite down to the live set)")
+M_JOURNAL_REPLAYED_SESS = _metric_counter(
+    "mmlspark_journal_replayed_sessions_total",
+    "Live decode sessions rehydrated from a journal (restart or /_adopt)")
+
+#: live journals in this process — feeds the bytes gauge and the watchdog
+#: stall bundle's ``journal`` block without keeping closed journals alive
+_LIVE: "weakref.WeakSet[ServingJournal]" = weakref.WeakSet()
+
+
+def _refresh_bytes_gauge() -> None:
+    M_JOURNAL_BYTES.set(float(sum(j._bytes for j in list(_LIVE))))
+
+
+def _journal_bundle_block() -> List[dict]:
+    return [j.digest() for j in list(_LIVE)]
+
+
+try:
+    from ..observability.watchdog import register_bundle_provider
+    register_bundle_provider("journal", _journal_bundle_block)
+except Exception as _exc:  # pragma: no cover - watchdog optional at import
+    log_event("journal_bundle_provider_unavailable", error=repr(_exc))
 
 
 class ServingJournal:
@@ -56,6 +105,16 @@ class ServingJournal:
         self._repair_torn_tail(path)
         self._fh = open(path, "a", encoding="utf-8")
         self._lines_since_compact = 0
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+        #: per-type append counts since open (digest() + stall bundle)
+        self._record_counts: Dict[str, int] = {}
+        #: session ids with a "sess" record and no "sess_end" yet
+        self._live_sessions: set = set()
+        _LIVE.add(self)
+        _refresh_bytes_gauge()
 
     @staticmethod
     def _repair_torn_tail(path: str) -> None:
@@ -90,11 +149,17 @@ class ServingJournal:
             # note: a closed handle WITHOUT drop_if_closed raises — the
             # write-ahead invariant (server._enqueue) depends on a failed
             # request append erroring the request out before it is queued
-            self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            line = json.dumps(rec, separators=(",", ":")) + "\n"
+            self._fh.write(line)
             self._fh.flush()
             if self.fsync:
                 os.fsync(self._fh.fileno())
             self._lines_since_compact += 1
+            self._bytes += len(line.encode("utf-8"))
+            t = str(rec.get("t"))
+            self._record_counts[t] = self._record_counts.get(t, 0) + 1
+            M_JOURNAL_RECORDS.inc(type=t)
+            _refresh_bytes_gauge()
 
     def record_request(self, request_id: str, epoch: int,
                        request: HTTPRequestData,
@@ -110,6 +175,37 @@ class ServingJournal:
 
     def record_epoch(self, epoch: int) -> None:
         self._append({"t": "epoch", "n": epoch}, drop_if_closed=True)
+
+    # -- decode sessions ----------------------------------------------------
+    def record_session(self, session_id: str, prompt: Sequence[int],
+                       params: dict,
+                       phash: Optional[str] = None) -> None:
+        """Journal a live decode session at insert time. Write-ahead like
+        ``record_request``: a closed journal raises, erroring the submit
+        out before any compute is spent on an unrecoverable session."""
+        rec = {"t": "sess", "id": session_id,
+               "prompt": [int(t) for t in prompt], "params": dict(params)}
+        if phash is not None:
+            rec["phash"] = phash
+        self._append(rec)
+        with self._lock:
+            self._live_sessions.add(session_id)
+
+    def record_session_tokens(self, session_id: str,
+                              tokens: Sequence[int]) -> None:
+        """Append one emitted-token tail record (batched per drain tick).
+        Dropped when closed: losing a tail only widens the cold-replay
+        re-decode window, never corrupts the session."""
+        if not tokens:
+            return
+        self._append({"t": "tail", "id": session_id,
+                      "toks": [int(t) for t in tokens]}, drop_if_closed=True)
+
+    def record_session_end(self, session_id: str) -> None:
+        self._append({"t": "sess_end", "id": session_id},
+                     drop_if_closed=True)
+        with self._lock:
+            self._live_sessions.discard(session_id)
 
     # -- recovery side ------------------------------------------------------
     @staticmethod
@@ -146,6 +242,41 @@ class ServingJournal:
                 epoch = max(epoch, int(rec["n"]))
         return epoch, pending
 
+    @staticmethod
+    def scan_sessions(path: str) -> Dict[str, dict]:
+        """Live decode sessions in the journal at ``path``:
+        ``{id: {"prompt", "params", "phash", "emitted"}}``. A staticmethod
+        on purpose — the driver reads a *dead* worker's journal for cold
+        failover without opening the file for append (which would repair
+        the tail and race a worker that is merely slow, not dead)."""
+        sessions: Dict[str, dict] = {}
+        for rec in ServingJournal._scan(path):
+            t = rec.get("t")
+            if t == "sess":
+                sessions[rec["id"]] = {
+                    "prompt": list(rec.get("prompt", ())),
+                    "params": dict(rec.get("params", {})),
+                    "phash": rec.get("phash"),
+                    "emitted": [],
+                }
+            elif t == "tail":
+                sess = sessions.get(rec["id"])
+                if sess is not None:
+                    sess["emitted"].extend(rec.get("toks", ()))
+            elif t == "sess_end":
+                sessions.pop(rec["id"], None)
+        return sessions
+
+    def replay_sessions(self) -> Dict[str, dict]:
+        """Rehydrate this journal's live sessions (restart path). Counted
+        into ``mmlspark_journal_replayed_sessions_total``."""
+        sessions = self.scan_sessions(self.path)
+        with self._lock:
+            self._live_sessions.update(sessions)
+        if sessions:
+            M_JOURNAL_REPLAYED_SESS.inc(len(sessions))
+        return sessions
+
     # -- compaction ---------------------------------------------------------
     def maybe_compact(self, epoch: int, min_lines: int = 256) -> bool:
         """Rewrite the journal down to the live set once enough dead lines
@@ -160,27 +291,77 @@ class ServingJournal:
             # optional fields ("trace", anything added later) survive the
             # rewrite byte-for-byte
             pending = {}
+            sess: Dict[str, dict] = {}
+            tails: Dict[str, List[int]] = {}
             for rec in self._scan(self.path):
-                if rec.get("t") == "req":
+                t = rec.get("t")
+                if t == "req":
                     pending[rec["id"]] = rec
-                elif rec.get("t") == "rep":
+                elif t == "rep":
                     pending.pop(rec["id"], None)
+                elif t == "sess":
+                    sess[rec["id"]] = rec
+                    tails[rec["id"]] = []
+                elif t == "tail":
+                    if rec["id"] in tails:
+                        tails[rec["id"]].extend(rec.get("toks", ()))
+                elif t == "sess_end":
+                    # an ended session is dead weight: drop its sess record
+                    # and every tail line with it
+                    sess.pop(rec["id"], None)
+                    tails.pop(rec["id"], None)
             tmp = self.path + ".compact"
             with open(tmp, "w", encoding="utf-8") as out:
                 out.write(json.dumps({"t": "epoch", "n": epoch},
                                      separators=(",", ":")) + "\n")
                 for rec in pending.values():
                     out.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                for sid, rec in sess.items():
+                    # live sessions survive as sess + ONE merged tail, so
+                    # a long decode compacts to two lines, not N drain
+                    # ticks' worth
+                    out.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                    if tails.get(sid):
+                        out.write(json.dumps(
+                            {"t": "tail", "id": sid, "toks": tails[sid]},
+                            separators=(",", ":")) + "\n")
                 out.flush()
                 os.fsync(out.fileno())
             self._fh.close()
             os.replace(tmp, self.path)
             self._fh = open(self.path, "a", encoding="utf-8")
             self._lines_since_compact = 0
+            self._live_sessions = set(sess)
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                pass
+            M_JOURNAL_COMPACTIONS.inc()
+            _refresh_bytes_gauge()
         return True
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._fh.closed
+
+    def digest(self) -> dict:
+        """Small JSON-able summary for ``/healthz`` digests and the
+        watchdog stall bundle's ``journal`` block."""
+        with self._lock:
+            return {
+                "path": self.path,
+                "bytes": self._bytes,
+                "closed": self._fh.closed,
+                "lines_since_compact": self._lines_since_compact,
+                "live_sessions": len(self._live_sessions),
+                "records": dict(self._record_counts),
+            }
 
     def close(self) -> None:
         with self._lock:
+            _LIVE.discard(self)
+            _refresh_bytes_gauge()
             try:
                 self._fh.close()
             except Exception as exc:

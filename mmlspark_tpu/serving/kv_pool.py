@@ -420,6 +420,94 @@ class PagedKVPool:
         M_DEFRAG_MOVES.inc(moved)
         return remap
 
+    # -- session export / adopt ----------------------------------------------
+
+    def export_session(self, pages: Sequence[int], *, length: int) -> dict:
+        """Serialize one session's KV pages into a portable JSON-able blob.
+
+        ``pages`` is the session's page list in *logical* (block-table)
+        order — the caller runs the compact permutation first (the engine's
+        ``_maybe_compact`` remap) and hands over the post-remap list, so
+        the blob is position-ordered regardless of physical placement on
+        this pool. Quant scale pools (int8/fp8) ride along per layer under
+        the same page indices. ``length`` is the number of positions the
+        pages actually hold (prompt + written tokens); the receiver uses
+        it to rebuild the block-table row and resume mid-page."""
+        import base64
+        pages = [int(p) for p in pages]
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        data = []
+        for c in self.buffers:
+            entry = {}
+            for key, buf in c.items():
+                arr = np.asarray(buf[idx])
+                entry[key] = base64.b64encode(arr.tobytes()).decode("ascii")
+            data.append(entry)
+        self.stats["sessions_exported"] = \
+            self.stats.get("sessions_exported", 0) + 1
+        return {
+            "v": 1,
+            "page_size": self.page_size,
+            "n_pages": len(pages),
+            "length": int(length),
+            "kv_dtype": self.kv_dtype,
+            "value_dtype": np.dtype(self.value_dtype).name,
+            "scale_dtype": (np.dtype(self.scale_dtype).name
+                            if self.scale_dtype is not None else None),
+            "layers": int(self.cfg.layers),
+            "page_shape": [int(x) for x in self._shape[1:]],
+            "data": data,
+        }
+
+    def adopt_session(self, blob: dict) -> List[int]:
+        """Allocate pages on THIS pool and scatter ``blob``'s contents into
+        them (the warm-handoff receive side). Returns the new page list in
+        the blob's logical order — the caller rebuilds its block-table row
+        from it. Raises ``ValueError`` on a layout mismatch (page size,
+        layer count, head geometry, quantization mode must agree) and
+        ``PoolExhausted`` — with nothing leaked — when this pool lacks the
+        pages."""
+        import base64
+        if blob.get("v") != 1:
+            raise ValueError(f"unknown session blob version {blob.get('v')}")
+        want = {
+            "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
+            "value_dtype": np.dtype(self.value_dtype).name,
+            "scale_dtype": (np.dtype(self.scale_dtype).name
+                            if self.scale_dtype is not None else None),
+            "layers": int(self.cfg.layers),
+            "page_shape": [int(x) for x in self._shape[1:]],
+        }
+        got = {k: blob.get(k) for k in want}
+        if got != want:
+            raise ValueError(
+                f"session blob layout mismatch: blob {got} != pool {want}")
+        n = int(blob["n_pages"])
+        pages = self.alloc(n)
+        try:
+            idx = jnp.asarray(np.asarray(pages, np.int32))
+            new_buffers = []
+            for c, entry in zip(self.buffers, blob["data"]):
+                nc = {}
+                for key, buf in c.items():
+                    dt = np.dtype(self.scale_dtype if key.endswith("_scale")
+                                  else self.value_dtype)
+                    tail = (self._scale_shape[1:]
+                            if key.endswith("_scale") else self._shape[1:])
+                    arr = np.frombuffer(
+                        base64.b64decode(entry[key]),
+                        dtype=dt).reshape((n,) + tuple(tail))
+                    nc[key] = buf.at[idx].set(jnp.asarray(arr, buf.dtype))
+                new_buffers.append(nc)
+            self.buffers = new_buffers
+        except Exception:
+            self.free(pages)
+            raise
+        self.stats["sessions_adopted"] = \
+            self.stats.get("sessions_adopted", 0) + 1
+        return pages
+
     # -- misc ----------------------------------------------------------------
 
     def note_prefill_chunk(self, ntok: int) -> None:

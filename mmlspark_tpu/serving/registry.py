@@ -46,11 +46,20 @@ from ..observability import log_event as _log_event
 from ..observability import tracing as _tracing
 
 __all__ = ["ModelRegistry", "ModelVersion", "Resolution", "VERSION_STATES",
+           "WORKER_LIVENESS_STATES",
            "get_registry", "set_registry", "reset_registry"]
 
 #: the per-version lifecycle, in order; transitions only move forward
 #: except rollback (canary -> retired via draining)
 VERSION_STATES = ("loading", "canary", "live", "draining", "retired")
+
+#: the per-worker liveness lifecycle the driver's sweeper walks
+#: (serving/distributed.py): heartbeats keep a worker ``alive``; a missed
+#: beat past the liveness interval makes it ``suspect``; past
+#: interval x sweep-multiplier the sweeper issues a ``dead`` verdict and
+#: reassigns its journaled sessions. ``draining`` is the operator-initiated
+#: graceful path (excluded from routing, sessions handed off warm).
+WORKER_LIVENESS_STATES = ("alive", "suspect", "draining", "dead")
 
 _M_VERSIONS = _metric_gauge(
     "mmlspark_registry_versions",

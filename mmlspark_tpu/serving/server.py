@@ -741,10 +741,16 @@ class WorkerServer:
         #: ``HTTPSourceV2.scala:96-113``) — survives PROCESS death
         self._journal = None
         pending = {}
+        #: live decode sessions rehydrated from the journal at construction
+        #: — a restarted worker hands these to its engine via
+        #: ``ContinuousDecoder.restore_session`` (cold path; the pages died
+        #: with the previous process)
+        self.replayed_sessions: Dict[str, dict] = {}
         if journal_path is not None:
             from .journal import ServingJournal
             self._journal = ServingJournal(journal_path, fsync=journal_fsync)
             self._epoch, pending = self._journal.replay()
+            self.replayed_sessions = self._journal.replay_sessions()
         # the queue must hold every rehydrated request up front (no consumer
         # exists yet) — a journal larger than max_queue must not deadlock
         # the constructor. Tenant weights come live from the process-global
@@ -921,7 +927,13 @@ class WorkerServer:
                 # backlog — GET /workers shows rollout + fairness posture
                 # cluster-wide without per-worker scrapes
                 "registry": _get_model_registry().digest(),
-                "admission": self._queue.snapshot()}
+                "admission": self._queue.snapshot(),
+                # durability posture: journal size, live (recoverable)
+                # sessions, per-type record counts — the fields the driver
+                # needs to decide whether a dead worker's sessions are
+                # worth a cold reassignment sweep
+                "journal": (self._journal.digest()
+                            if self._journal is not None else None)}
 
     def _healthz_route(self, request: HTTPRequestData) -> HTTPResponseData:
         import json as _json

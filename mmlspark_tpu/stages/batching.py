@@ -208,6 +208,10 @@ class _QueueProducer:
             finally:
                 self.queue.put(self.SENTINEL)
 
+        # tpulint: disable=TPU025 — producer crash IS contained: the
+        # BaseException is captured for raise_pending() on the consumer
+        # side and the sentinel still lands in finally; a restart would
+        # re-iterate the source and duplicate items
         self.thread = threading.Thread(target=produce, daemon=True)
         self.thread.start()
 

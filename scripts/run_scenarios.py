@@ -96,6 +96,11 @@ def main(argv=None) -> int:
           f"lost={card['lost']} goodput={card['goodput_rps']}/s "
           f"p99={lat.get('p99_ms')}ms "
           f"fairness_err={card['fairness_error']}")
+    if card.get("sessions"):
+        s = card["sessions"]
+        print(f"   sessions={s['sessions']} lost={s['lost']} "
+              f"recovered={s['recovered']} "
+              f"recovery_p99={s['recovery_p99_ms']}ms")
     if args.check:
         cluster_view = card.get("cluster") or {}
         problems = []
@@ -112,6 +117,11 @@ def main(argv=None) -> int:
                  for b in timeline["buckets"]) != card["ok"] + \
                 card["shed"] + card["errors"]:
             problems.append("timeline buckets do not sum to card outcomes")
+        sessions = card.get("sessions") or {}
+        if sessions.get("lost"):
+            problems.append(
+                f"lost {sessions['lost']} decode sessions "
+                f"(recovered={sessions.get('recovered')})")
         if problems:
             print("CHECK FAILED: " + "; ".join(problems), file=sys.stderr)
             return 1

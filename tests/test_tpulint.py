@@ -1762,6 +1762,146 @@ def test_tpu024_suppressible_with_justification():
     assert "TPU024" in codes(suppressed)
 
 
+# ---------------------------------------------------------------------------
+# TPU025 unsupervised-daemon-loop
+
+
+DAEMON_LOOP_SRC = """\
+    import threading
+
+    class Worker:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while True:
+                self.tick()
+    """
+
+
+def test_tpu025_bare_daemon_loop_fires():
+    findings, _ = run_fixture(DAEMON_LOOP_SRC,
+                              relpath="mmlspark_tpu/serving/worker.py")
+    assert "TPU025" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "TPU025"]
+    assert f.severity == "warning"
+    assert "_run" in f.message
+
+
+def test_tpu025_module_level_function_target_fires():
+    findings, _ = run_fixture("""\
+        import threading
+
+        def pump(q):
+            while True:
+                q.get()
+
+        t = threading.Thread(target=pump, daemon=True)
+        """, relpath="mmlspark_tpu/serving/worker.py")
+    assert "TPU025" in codes(findings)
+
+
+def test_tpu025_supervised_variants_quiet():
+    for src in (
+        # started through the supervision helper — the blessed idiom
+        """\
+        from mmlspark_tpu.reliability import start_supervised
+
+        class A:
+            def start(self):
+                self._t = start_supervised(self._tick, name="a",
+                                           stop=self._stop, interval=0.1)
+
+            def _tick(self):
+                self.poll()
+        """,
+        # try/except INSIDE the loop contains each iteration's crash
+        """\
+        import threading
+
+        class B:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    try:
+                        self.tick()
+                    except Exception:
+                        continue
+        """,
+        # non-daemon thread: a crash is loud at join/shutdown
+        """\
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                while True:
+                    self.tick()
+        """,
+        # target without a loop: run-once threads finish and die anyway
+        """\
+        import threading
+
+        class D:
+            def start(self):
+                self._t = threading.Thread(target=self._once, daemon=True)
+
+            def _once(self):
+                self.tick()
+        """,
+    ):
+        findings, _ = run_fixture(src,
+                                  relpath="mmlspark_tpu/serving/worker.py")
+        assert "TPU025" not in codes(findings), src
+
+
+def test_tpu025_unresolvable_target_is_skipped():
+    # a lambda / computed target can't be resolved to a function body —
+    # skipped, not flagged (no false positives on dynamic dispatch)
+    findings, _ = run_fixture("""\
+        import threading
+
+        class E:
+            def start(self, fn):
+                self._t = threading.Thread(target=lambda: fn(),
+                                           daemon=True)
+        """, relpath="mmlspark_tpu/serving/worker.py")
+    assert "TPU025" not in codes(findings)
+
+
+def test_tpu025_exempt_paths_quiet():
+    # the reliability package (home of the supervisor itself) and tests
+    # are exempt by path prefix
+    for relpath in ("mmlspark_tpu/reliability/loops.py",
+                    "tests/test_threads.py"):
+        findings, _ = run_fixture(DAEMON_LOOP_SRC, relpath=relpath)
+        assert "TPU025" not in codes(findings), relpath
+
+
+def test_tpu025_suppression_comment_respected():
+    findings, suppressed = run_fixture("""\
+        import threading
+
+        class F:
+            def start(self):
+                # session-scoped: dies with the request, crash captured
+                # tpulint: disable=TPU025
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    self.tick()
+        """, relpath="mmlspark_tpu/serving/worker.py",
+        keep_suppressed=True)
+    assert "TPU025" not in codes(findings)
+    assert "TPU025" in codes(suppressed)
+
+
 # CLI exit codes
 
 

@@ -52,6 +52,12 @@ Rules
   class — an ad-hoc history that grows for the life of the process;
   record through ``observability.timeseries.get_store()`` (fixed-memory
   rings, shared trend queries) or bound it explicitly.
+- **TPU025** unsupervised-daemon-loop: a ``threading.Thread(daemon=True)``
+  whose target function loops with no crash guard — one unhandled
+  exception silently kills the thread (heartbeat, sweeper, engine tick)
+  and the process limps on without it; run the loop under
+  ``reliability.loops.start_supervised`` (contained crashes, backoff,
+  restart accounting) or contain each iteration in ``try``/``except``.
 
 The static half of the sharding story only; the runtime half is
 ``mmlspark_tpu.parallel.collective_audit``, which counts collectives in

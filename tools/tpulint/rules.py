@@ -1447,3 +1447,113 @@ class AdhocTimeseries(Rule):
                         f"memory rings, shared trend queries) or bound "
                         f"it (deque(maxlen=), trim on append)"))
         return iter(findings)
+
+
+# -- TPU025 unsupervised-daemon-loop -------------------------------------------
+
+#: paths allowed to run bare daemon loops: the reliability package owns the
+#: sanctioned supervisor (run_supervised IS the guard — it cannot wrap
+#: itself), and tests spin short-lived helper threads on purpose
+_DAEMON_EXEMPT_PREFIXES = ("mmlspark_tpu/reliability/", "tests/")
+
+
+def _daemon_thread_target(call: ast.Call) -> Optional[str]:
+    """The bare name of a ``Thread(daemon=True)`` target when it is
+    resolvable inside this module: ``target=fn`` or ``target=self.fn``
+    → ``'fn'``. Lambdas and bound methods of *other* objects
+    (``httpd.serve_forever`` — analyzed where they are defined, or in the
+    stdlib) return None and are skipped, not flagged."""
+    daemon = any(kw.arg == "daemon"
+                 and isinstance(kw.value, ast.Constant)
+                 and kw.value.value is True
+                 for kw in call.keywords)
+    if not daemon:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if (isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name)
+                and v.value.id == "self"):
+            return v.attr
+        return None
+    return None
+
+
+def _loop_supervision(func: ast.AST) -> "tuple[bool, bool]":
+    """(has_loop, guarded) for a thread-target function. Guarded means a
+    ``try`` *inside* a loop body (each iteration's crash is contained, so
+    the loop survives it) or any call to a ``*supervised*`` helper —
+    a ``try`` wrapped *around* the loop still dies on first crash and
+    does not count."""
+    has_loop = False
+    guarded = False
+    for node in ast.walk(func):
+        if isinstance(node, (ast.While, ast.For)):
+            has_loop = True
+            if any(isinstance(sub, ast.Try) for sub in ast.walk(node)):
+                guarded = True
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else "")
+            if "supervised" in name:
+                guarded = True
+    return has_loop, guarded
+
+
+@register_rule
+class UnsupervisedDaemonLoop(Rule):
+    code = "TPU025"
+    name = "unsupervised-daemon-loop"
+    severity = "warning"
+    doc = ("A ``threading.Thread(daemon=True)`` whose target function "
+           "loops with no crash containment — the serving stack's silent "
+           "killer: one unhandled exception ends the thread, and the "
+           "process limps on with its heartbeat/sweeper/engine tick gone. "
+           "A dead heartbeat looks exactly like a dead worker to the "
+           "driver's liveness sweeper, which then evicts a healthy worker "
+           "and reassigns its sessions. Run the loop under "
+           "``mmlspark_tpu.reliability.loops.start_supervised`` "
+           "(contained crashes, exponential backoff, restarts counted in "
+           "``mmlspark_supervised_loop_restarts_total{loop}``) or put a "
+           "``try``/``except`` inside the loop body so an iteration's "
+           "crash cannot end the loop. Targets that cannot be resolved in "
+           "the same module (lambdas, ``httpd.serve_forever``) are "
+           "skipped, not flagged. ``mmlspark_tpu/reliability/`` (the "
+           "supervisor's own home) and ``tests/`` are exempt. Suppress "
+           "only for a loop that genuinely must die on first failure "
+           "(e.g. a run-once bootstrap on a background thread).")
+
+    def check(self, module: ModuleInfo):
+        rel = module.relpath.replace("\\", "/")
+        if rel.startswith(_DAEMON_EXEMPT_PREFIXES) or "/tests/" in rel:
+            return iter(())
+        funcs = {}
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            funcs.setdefault(fn.name, fn)
+        findings: List[Finding] = []
+        for call in module.nodes(ast.Call):
+            dotted = module.dotted(call.func)
+            ctor = dotted.rsplit(".", 1)[-1] if dotted else None
+            if ctor != "Thread":
+                continue
+            target_name = _daemon_thread_target(call)
+            if target_name is None:
+                continue
+            target = funcs.get(target_name)
+            if target is None:
+                continue
+            has_loop, guarded = _loop_supervision(target)
+            if has_loop and not guarded:
+                findings.append(self.finding(
+                    module, call,
+                    f"daemon thread runs {target_name}()'s loop "
+                    f"unsupervised — one unhandled exception silently "
+                    f"kills the thread and the process limps on without "
+                    f"it; start it via reliability.loops.start_supervised "
+                    f"(contained crashes + backoff + restart accounting) "
+                    f"or contain each iteration in try/except"))
+        return iter(findings)
